@@ -1,0 +1,21 @@
+"""Data ingest + host input pipeline.
+
+TPU-native replacement for the reference's ``torchvision.datasets.MNIST`` + ``DataLoader``
+stack (reference ``src/train.py:25-41``, ``src/train_dist.py:15-47``; worker pool
+``num_workers=4``/``pin_memory`` at ``src/train_dist.py:43-45``). Strategy per SURVEY.md §3.5:
+load the full dataset once into host numpy arrays, normalize once, and feed the device with
+epoch-seeded permutations — no per-sample transform pipeline, no worker processes. A native C++
+batch-assembly path (``data/_native``) covers the DataLoader-worker-pool role at speed.
+"""
+
+from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
+    MNIST_MEAN,
+    MNIST_STD,
+    Dataset,
+    load_mnist,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.data.loader import (
+    BatchLoader,
+)
+
+__all__ = ["MNIST_MEAN", "MNIST_STD", "Dataset", "load_mnist", "BatchLoader"]

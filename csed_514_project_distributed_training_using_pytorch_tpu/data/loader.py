@@ -1,0 +1,75 @@
+"""Host-side batch loader.
+
+Replaces the reference's ``torch.utils.data.DataLoader`` (reference ``src/train.py:25-41``,
+``src/train_dist.py:40-45``). Because the whole dataset is a resident numpy array (see
+``data/mnist.py``), "loading" a batch is a single fancy-index gather — there is no per-sample
+transform to hide, so no worker pool (``num_workers=4``, reference ``src/train_dist.py:43``) is
+needed; the optional native C++ gather (``data/_native``) covers that role where the Python
+gather ever matters. Shuffling follows the reference's two modes:
+
+- single-process: ``shuffle=True`` per epoch (reference ``src/train.py:32``) — here an
+  epoch-seeded permutation;
+- distributed: sharding is delegated to ``parallel.ShardedSampler`` (the
+  ``DistributedSampler`` contract) and the loader itself does not shuffle, mirroring the
+  reference's ``shuffle=False  # Must be False!`` (``src/train_dist.py:41-42``).
+
+``drop_last`` defaults to False like torch's: the final short batch is emitted (60,000/64 →
+937×64 + 1×32, two jit specializations — the only two shapes ever compiled).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import Dataset
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.sampler import (
+    ShardedSampler,
+)
+
+
+class BatchLoader:
+    """Iterates (images, labels) numpy batches in a sampler-defined order.
+
+    ``set_epoch`` mirrors ``train_loader.sampler.set_epoch(i)`` (reference
+    ``src/train_dist.py:72``); for the single-process shuffle case the same mechanism provides
+    the per-epoch reshuffle.
+    """
+
+    def __init__(self, dataset: Dataset, batch_size: int, *,
+                 sampler: ShardedSampler | None = None, shuffle: bool = False,
+                 seed: int = 0, drop_last: bool = False):
+        if sampler is not None and shuffle:
+            raise ValueError("shuffle must be False when a sampler is given "
+                             "(reference src/train_dist.py:41-42)")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.sampler = sampler or ShardedSampler(
+            len(dataset), num_replicas=1, rank=0, shuffle=shuffle, seed=seed)
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __len__(self) -> int:
+        n = self.sampler.num_samples
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        indices = self.sampler.epoch_indices(self._epoch)
+        n = len(indices)
+        end = n - n % self.batch_size if self.drop_last else n
+        for start in range(0, end, self.batch_size):
+            idx = indices[start:start + self.batch_size]
+            yield self.dataset.images[idx], self.dataset.labels[idx]
+
+    def epoch_index_matrix(self, epoch: int, steps_multiple: int = 1) -> np.ndarray:
+        """This epoch's order as a ``[num_steps, batch_size]`` index matrix for the
+        device-resident fast path (``lax.scan`` over gathered batches): full batches only,
+        optionally truncated to a multiple of ``steps_multiple`` (e.g. ``log_interval``)."""
+        indices = self.sampler.epoch_indices(self._epoch if epoch is None else epoch)
+        steps = len(indices) // self.batch_size
+        steps -= steps % steps_multiple
+        return indices[:steps * self.batch_size].reshape(steps, self.batch_size)

@@ -1,0 +1,6 @@
+"""Model zoo. The reference defines exactly one model — the MNIST CNN ``Net``
+(reference ``src/model.py:4-22``); ours is the TPU-native re-expression of it."""
+
+from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
+
+__all__ = ["Net"]

@@ -1,0 +1,72 @@
+"""The MNIST CNN (``Net``), TPU-native.
+
+Re-expression of the reference's only model (reference ``src/model.py:4-22``):
+
+    conv(1→10, k5) → maxpool2 → relu → conv(10→20, k5) → Dropout2d → maxpool2 → relu
+    → flatten(320) → fc(320→50) → relu → dropout → fc(50→10) → log_softmax
+
+21,840 trainable parameters (conv1 260 + conv2 5,020 + fc1 16,050 + fc2 510 — the oracle in
+SURVEY.md §3.4). Differences from the reference are deliberate TPU-first choices:
+
+- **NHWC layout** (``[B, 28, 28, 1]`` input) instead of NCHW — what XLA:TPU tiles best.
+- The whole forward is pure and jit-traceable; train/eval mode is the static
+  ``deterministic`` flag (so each variant compiles once), not mutable module state
+  (reference ``network.train()``/``network.eval()`` at ``src/train.py:70,91``).
+- Dropout randomness comes from an explicit ``'dropout'`` PRNG collection threaded per step
+  (and folded per-replica under SPMD) instead of a global RNG.
+"""
+
+from __future__ import annotations
+
+import flax.linen as fnn
+import jax
+import jax.numpy as jnp
+
+from csed_514_project_distributed_training_using_pytorch_tpu import ops
+
+
+class Net(fnn.Module):
+    """MNIST classifier emitting log-probabilities (reference ``src/model.py:22``)."""
+
+    num_classes: int = 10
+    conv_dropout_rate: float = 0.5   # nn.Dropout2d default p, reference src/model.py:11
+    fc_dropout_rate: float = 0.5     # F.dropout default p, reference src/model.py:20
+    dtype: jnp.dtype = jnp.float32
+
+    @fnn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        """Forward pass. ``x: [B, 28, 28, 1]`` float. Returns ``[B, num_classes]`` log-probs."""
+        x = x.astype(self.dtype)
+
+        w1 = self.param("conv1_kernel", ops.torch_kaiming_uniform, (5, 5, 1, 10))
+        b1 = self.param("conv1_bias", ops.torch_fan_in_uniform(5 * 5 * 1), (10,))
+        x = ops.conv2d(x, w1.astype(self.dtype), b1.astype(self.dtype))   # [B,24,24,10]
+        x = ops.relu(ops.max_pool2d(x, 2))                                # [B,12,12,10]
+
+        w2 = self.param("conv2_kernel", ops.torch_kaiming_uniform, (5, 5, 10, 20))
+        b2 = self.param("conv2_bias", ops.torch_fan_in_uniform(5 * 5 * 10), (20,))
+        x = ops.conv2d(x, w2.astype(self.dtype), b2.astype(self.dtype))   # [B,8,8,20]
+        if not deterministic:
+            x = ops.dropout2d(self.make_rng("dropout"), x, self.conv_dropout_rate,
+                              deterministic=False)
+        x = ops.relu(ops.max_pool2d(x, 2))                                # [B,4,4,20]
+
+        x = x.reshape((x.shape[0], -1))                                   # [B,320]
+
+        w3 = self.param("fc1_kernel", ops.torch_kaiming_uniform, (320, 50))
+        b3 = self.param("fc1_bias", ops.torch_fan_in_uniform(320), (50,))
+        x = ops.relu(ops.dense(x, w3.astype(self.dtype), b3.astype(self.dtype)))
+        if not deterministic:
+            x = ops.dropout(self.make_rng("dropout"), x, self.fc_dropout_rate,
+                            deterministic=False)
+
+        w4 = self.param("fc2_kernel", ops.torch_kaiming_uniform, (50, self.num_classes))
+        b4 = self.param("fc2_bias", ops.torch_fan_in_uniform(50), (self.num_classes,))
+        x = ops.dense(x, w4.astype(self.dtype), b4.astype(self.dtype))
+
+        return ops.log_softmax(x.astype(jnp.float32))
+
+
+def param_count(params) -> int:
+    """Total trainable parameter count of a params pytree (oracle: 21,840 for ``Net``)."""
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,38 @@
+"""Parameter initializers matching PyTorch layer defaults.
+
+The reference never sets initializers explicitly, so its training dynamics (loss starting at
+~2.30 and the SGD lr=0.01/0.02 momentum=0.5 schedule converging, BASELINE.md) are those of
+PyTorch's defaults for ``nn.Conv2d``/``nn.Linear``: ``kaiming_uniform_(a=sqrt(5))`` for weights
+— which reduces to U(-1/sqrt(fan_in), 1/sqrt(fan_in)) — and the same fan-in-uniform bound for
+biases. We reproduce those distributions here (with JAX PRNG keys) so convergence behavior is
+comparable; any ``jax.nn.initializers`` callable can be swapped in.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _fan_in(shape: tuple[int, ...]) -> int:
+    """Fan-in for HWIO conv kernels (h*w*in) and [in, out] dense kernels."""
+    if len(shape) == 2:  # dense [in, out]
+        return shape[0]
+    if len(shape) == 4:  # conv HWIO
+        return shape[0] * shape[1] * shape[2]
+    raise ValueError(f"unsupported param shape {shape}")
+
+
+def torch_kaiming_uniform(key: jax.Array, shape: tuple[int, ...],
+                          dtype: jnp.dtype = jnp.float32) -> jax.Array:
+    """PyTorch default weight init: ``kaiming_uniform_(a=sqrt(5))`` == U(±1/sqrt(fan_in))."""
+    bound = 1.0 / jnp.sqrt(_fan_in(shape))
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def torch_fan_in_uniform(fan_in: int):
+    """PyTorch default bias init: U(±1/sqrt(fan_in)) with fan-in taken from the weight."""
+    def init(key: jax.Array, shape: tuple[int, ...], dtype: jnp.dtype = jnp.float32) -> jax.Array:
+        bound = 1.0 / jnp.sqrt(jnp.asarray(fan_in, dtype=jnp.float32))
+        return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+    return init

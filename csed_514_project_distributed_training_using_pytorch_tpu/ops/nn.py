@@ -1,0 +1,136 @@
+"""Core functional NN ops, NHWC/TPU-first.
+
+TPU-native analog of the ATen kernels invoked by the reference model's forward
+(reference ``src/model.py:15-22``): conv2d, max-pool, dense, dropout (elementwise and
+channelwise), log_softmax, and the two loss formulations the reference uses
+(``F.nll_loss`` at ``src/train.py:74,94`` and ``nn.CrossEntropyLoss`` at
+``src/train_dist.py:67``).
+
+Layout note: everything here is NHWC (``[batch, height, width, channels]``) with HWIO conv
+kernels — the layout XLA:TPU tiles best onto the MXU — rather than the reference's NCHW.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv2d(x: jax.Array, w: jax.Array, b: jax.Array | None = None, *,
+           stride: int = 1, padding: str = "VALID") -> jax.Array:
+    """2-D convolution, NHWC x HWIO -> NHWC.
+
+    Equivalent of ``nn.Conv2d`` with default stride/no padding as used at reference
+    ``src/model.py:9-10`` (kernel 5, valid padding). Runs on the MXU.
+    """
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if b is not None:
+        out = out + b
+    return out
+
+
+def max_pool2d(x: jax.Array, window: int = 2, stride: int | None = None) -> jax.Array:
+    """Max pooling over spatial dims of an NHWC tensor.
+
+    Equivalent of ``F.max_pool2d(x, 2)`` at reference ``src/model.py:16-17``.
+    """
+    if stride is None:
+        stride = window
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID",
+    )
+
+
+def dense(x: jax.Array, w: jax.Array, b: jax.Array | None = None) -> jax.Array:
+    """Affine layer ``x @ w + b`` with ``w: [in, out]``.
+
+    Equivalent of ``nn.Linear`` at reference ``src/model.py:12-13``. Batched matmul on the MXU;
+    accumulation is requested in float32 regardless of input dtype so bfloat16 activations
+    keep full-precision sums.
+    """
+    out = jnp.matmul(x, w, preferred_element_type=jnp.float32)
+    out = out.astype(x.dtype)
+    if b is not None:
+        out = out + b
+    return out
+
+
+def relu(x: jax.Array) -> jax.Array:
+    """Rectified linear unit (``F.relu``, reference ``src/model.py:16-19``)."""
+    return jnp.maximum(x, 0)
+
+
+def log_softmax(x: jax.Array, axis: int = -1) -> jax.Array:
+    """Numerically-stable log-softmax (``F.log_softmax(x)``, reference ``src/model.py:22``)."""
+    shifted = x - lax.stop_gradient(jnp.max(x, axis=axis, keepdims=True))
+    return shifted - jnp.log(jnp.sum(jnp.exp(shifted), axis=axis, keepdims=True))
+
+
+def nll_loss(log_probs: jax.Array, labels: jax.Array, *, reduction: str = "mean") -> jax.Array:
+    """Negative log-likelihood of integer labels under ``log_probs``.
+
+    Equivalent of ``F.nll_loss`` (reference ``src/train.py:74``) and of its deprecated
+    ``size_average=False`` sum-reduction form (reference ``src/train.py:94``) via
+    ``reduction="sum"``.
+    """
+    picked = jnp.take_along_axis(log_probs, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    if reduction == "mean":
+        return -jnp.mean(picked)
+    if reduction == "sum":
+        return -jnp.sum(picked)
+    if reduction == "none":
+        return -picked
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array, *, reduction: str = "mean") -> jax.Array:
+    """Softmax cross-entropy from unnormalized (or, as in the reference's distributed path,
+    already-log-softmaxed) inputs.
+
+    Equivalent of ``nn.CrossEntropyLoss`` (reference ``src/train_dist.py:67``). Note the
+    reference feeds it the output of a model that already ends in log_softmax
+    (``src/model.py:22``) — an effective double log-softmax (SURVEY.md §2d.1). Since
+    log_softmax is idempotent, that composition is *mathematically identical* to the
+    single-process ``log_softmax + nll`` objective (verified in tests/test_ops.py), so this
+    framework uses the one canonical ``nll_loss(model(x))`` formulation everywhere; this
+    function is provided for API parity and for users porting loss code.
+    """
+    return nll_loss(log_softmax(logits), labels, reduction=reduction)
+
+
+def dropout(rng: jax.Array, x: jax.Array, rate: float, *, deterministic: bool) -> jax.Array:
+    """Elementwise inverted dropout (``F.dropout``, reference ``src/model.py:20``).
+
+    ``deterministic=True`` (eval mode) is the identity, mirroring ``model.eval()`` semantics
+    at reference ``src/train.py:91`` / ``src/train_dist.py:93``.
+    """
+    if deterministic or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def dropout2d(rng: jax.Array, x: jax.Array, rate: float, *, deterministic: bool) -> jax.Array:
+    """Channelwise (spatial) dropout on NHWC: zeroes whole feature maps.
+
+    Equivalent of ``nn.Dropout2d`` (reference ``src/model.py:11,17``), which drops entire
+    channels rather than independent elements.
+    """
+    if deterministic or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask_shape = (x.shape[0], 1, 1, x.shape[-1])
+    mask = jax.random.bernoulli(rng, keep, mask_shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))

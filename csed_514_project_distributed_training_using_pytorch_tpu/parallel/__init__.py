@@ -1,0 +1,31 @@
+"""Parallelism layer: device mesh, SPMD data parallelism, sharded sampling, collectives.
+
+TPU-native replacement for the reference's L3/L1 stack (SURVEY.md §1): ``DDP(model)`` +
+``DistributedSampler`` + ``init_process_group("gloo")`` (reference ``src/train_dist.py:63``,
+``:33-37``, ``:146``). There is no wrapper object and no backend string here: parallelism is a
+``jax.sharding.Mesh`` plus sharding annotations on one jit-compiled train step; XLA inserts the
+gradient all-reduce (the DDP-Reducer analog) and maps it onto ICI within a slice and DCN across
+slices.
+"""
+
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.sampler import (
+    ShardedSampler,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import (
+    make_mesh,
+    initialize_cluster,
+    process_info,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.collectives import (
+    ring_pass,
+    all_reduce_sum,
+)
+
+__all__ = [
+    "ShardedSampler",
+    "make_mesh",
+    "initialize_cluster",
+    "process_info",
+    "ring_pass",
+    "all_reduce_sum",
+]

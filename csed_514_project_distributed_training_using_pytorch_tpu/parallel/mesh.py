@@ -1,0 +1,97 @@
+"""Device mesh + cluster bootstrap.
+
+Replaces the reference's rendezvous layer: ``os.environ['MASTER_ADDR']='10.128.0.2'`` /
+``MASTER_PORT`` + ``dist.init_process_group("gloo", rank, world_size)`` (reference
+``src/train_dist.py:144-146``, ``src/run1.py:21-23``), where the master IP is an
+edit-the-source constant and the rank is encoded in *which launcher file you run*
+(``src/run1.py:31`` vs ``src/run2.py:31``). Here:
+
+- on a TPU pod slice, ``initialize_cluster()`` calls ``jax.distributed.initialize()`` with no
+  arguments — coordinator address, process id, and world size all come from slice metadata, so
+  every host runs the *same* command (this deletes the run1/run2 hand-editing pattern, the
+  north-star ask in BASELINE.json);
+- explicit coordinator/rank arguments remain available for non-TPU fleets (the gloo-style
+  TCP-rendezvous analog);
+- ``make_mesh()`` builds the ``jax.sharding.Mesh`` the SPMD step is compiled over. Default is
+  the reference-parity one-axis ``('data',)`` mesh; multi-axis shapes (e.g. ``(data, model)``)
+  are supported so wider parallelism can be layered on without redesign.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclass(frozen=True)
+class ProcessInfo:
+    """This host's coordinates in the cluster (≙ the reference's rank/world_size pair,
+    ``src/train_dist.py:131,141``, but discovered rather than hand-assigned)."""
+
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        """True on the process that owns rank-gated side effects (checkpoint writes, plots);
+        ≙ the reference's ``if rank == 0`` (``src/train_dist.py:163``)."""
+        return self.process_index == 0
+
+
+def initialize_cluster(coordinator_address: str | None = None,
+                       num_processes: int | None = None,
+                       process_id: int | None = None) -> ProcessInfo:
+    """Join (or create) the distributed runtime and report this process's coordinates.
+
+    No-op on a single-process run — safe to call unconditionally from every entry point.
+    """
+    multi_host = (
+        coordinator_address is not None
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("TPU_WORKER_HOSTNAMES")  # set by TPU pod runtime metadata
+    )
+    if multi_host and jax.process_count() == 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return process_info()
+
+
+def process_info() -> ProcessInfo:
+    return ProcessInfo(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )
+
+
+def make_mesh(num_devices: int | None = None,
+              axis_names: tuple[str, ...] = ("data",),
+              axis_shape: tuple[int, ...] | None = None) -> Mesh:
+    """Build a device mesh.
+
+    ``num_devices=None`` uses every addressable device (all chips on all hosts). With the
+    default one-axis ``('data',)`` layout this is the analog of the reference's flat world of N
+    single-process machines (``world_size``, ``src/train_dist.py:131``) — except chips within a
+    host ride ICI and the axis order follows the physical topology, since
+    ``jax.devices()`` enumerates in topology order.
+    """
+    devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(f"requested {num_devices} devices, have {len(devices)}")
+        devices = devices[:num_devices]
+    if axis_shape is None:
+        axis_shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    if int(np.prod(axis_shape)) != len(devices):
+        raise ValueError(f"axis_shape {axis_shape} != {len(devices)} devices")
+    return Mesh(np.asarray(devices).reshape(axis_shape), axis_names)

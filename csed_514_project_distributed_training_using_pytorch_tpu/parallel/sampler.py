@@ -1,0 +1,67 @@
+"""Epoch-seeded sharded sampling — the ``DistributedSampler`` contract, functionally.
+
+The reference shards data with ``torch.utils.data.DistributedSampler(num_replicas, rank,
+shuffle=True, seed=42)`` re-seeded per epoch via ``sampler.set_epoch(i)`` (reference
+``src/train_dist.py:33-37,72``). Its contract, which this module reproduces exactly
+(SURVEY.md §7 "hard parts (a)"):
+
+1. one *global* permutation of all indices, keyed on ``(seed, epoch)`` — identical on every
+   replica with no communication;
+2. pad the permuted list to a multiple of ``num_replicas`` by recycling its head
+   (torch's ``drop_last=False`` behavior), so every replica gets the same count;
+3. stride-shard: replica ``r`` takes ``indices[r::num_replicas]``.
+
+Consequences preserved: per-epoch per-replica shards are disjoint, cover the dataset, change
+every epoch, and are computable independently on every host (a pure function — the TPU-friendly
+property, since there is no sampler object state to synchronize). The permutation itself comes
+from JAX's threefry PRNG rather than torch's MT19937, so index *sequences* differ from the
+reference while the contract is identical.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ShardedSampler:
+    """Pure-function sampler: ``epoch_indices(epoch)`` -> this replica's index array."""
+
+    dataset_size: int
+    num_replicas: int = 1
+    rank: int = 0
+    shuffle: bool = True
+    seed: int = 42  # reference src/train_dist.py:37
+
+    def __post_init__(self):
+        if not (0 <= self.rank < self.num_replicas):
+            raise ValueError(f"rank {self.rank} out of range for {self.num_replicas} replicas")
+
+    @property
+    def total_size(self) -> int:
+        """Padded global size (multiple of num_replicas)."""
+        per = -(-self.dataset_size // self.num_replicas)  # ceil
+        return per * self.num_replicas
+
+    @property
+    def num_samples(self) -> int:
+        """Samples per replica per epoch."""
+        return self.total_size // self.num_replicas
+
+    def global_permutation(self, epoch: int) -> np.ndarray:
+        """The (seed, epoch)-keyed global order, padded — identical on every replica."""
+        if self.shuffle:
+            rng = np.random.default_rng(np.random.SeedSequence([self.seed, epoch]))
+            indices = rng.permutation(self.dataset_size)
+        else:
+            indices = np.arange(self.dataset_size)
+        pad = self.total_size - self.dataset_size
+        if pad:
+            indices = np.concatenate([indices, indices[:pad]])
+        return indices
+
+    def epoch_indices(self, epoch: int) -> np.ndarray:
+        """This replica's shard for ``epoch`` (the ``set_epoch`` + iterate equivalent)."""
+        return self.global_permutation(epoch)[self.rank::self.num_replicas]

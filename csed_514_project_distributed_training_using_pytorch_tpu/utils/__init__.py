@@ -1,0 +1,1 @@
+"""Support subsystems: config, checkpointing, metrics/plots, profiling, determinism checks."""

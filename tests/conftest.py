@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+This is the TPU-world analog of a fake distributed backend (SURVEY.md §4): multi-chip SPMD
+logic (mesh construction, batch sharding, the fused gradient all-reduce, ppermute rings) runs
+and is verified on 8 virtual CPU devices, no TPU pod required.
+
+Ordering subtlety: this environment's ``sitecustomize`` may already have imported JAX and
+registered a TPU PJRT plugin at interpreter start, so setting env vars here can be too late for
+``import jax`` — we also push the platform choice through ``jax.config`` before any backend is
+initialized, which keeps the (exclusive, possibly tunnelled) TPU unclaimed while tests run.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

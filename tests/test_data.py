@@ -1,0 +1,134 @@
+"""Data-pipeline tests: IDX parsing against hand-built files, normalization constants,
+synthetic-fallback determinism/learnability shape contract, loader batching semantics
+(reference src/train.py:25-41, src/train_dist.py:15-47)."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.data import (
+    BatchLoader, Dataset, MNIST_MEAN, MNIST_STD, load_mnist,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import _read_idx
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.sampler import (
+    ShardedSampler,
+)
+
+
+def _write_idx_images(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        f.write(struct.pack(">3I", *arr.shape))
+        f.write(arr.tobytes())
+
+
+def _write_idx_labels(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", arr.shape[0]))
+        f.write(arr.tobytes())
+
+
+def test_idx_roundtrip(tmp_path):
+    imgs = np.random.default_rng(0).integers(0, 256, (5, 28, 28), dtype=np.uint8)
+    p = tmp_path / "imgs"
+    _write_idx_images(p, imgs)
+    np.testing.assert_array_equal(_read_idx(str(p)), imgs)
+
+
+def test_idx_gzip(tmp_path):
+    labels = np.asarray([3, 1, 4], dtype=np.uint8)
+    p = tmp_path / "labels.gz"
+    with gzip.open(p, "wb") as f:
+        f.write(struct.pack(">I", 0x00000801) + struct.pack(">I", 3) + labels.tobytes())
+    np.testing.assert_array_equal(_read_idx(str(p)), labels)
+
+
+def test_load_real_idx_layout(tmp_path):
+    """torchvision's MNIST/raw cache layout is found and parsed (src/train.py:26-31)."""
+    raw = tmp_path / "MNIST" / "raw"
+    os.makedirs(raw)
+    rng = np.random.default_rng(1)
+    _write_idx_images(raw / "train-images-idx3-ubyte",
+                      rng.integers(0, 256, (20, 28, 28), dtype=np.uint8))
+    _write_idx_labels(raw / "train-labels-idx1-ubyte",
+                      rng.integers(0, 10, 20).astype(np.uint8))
+    _write_idx_images(raw / "t10k-images-idx3-ubyte",
+                      rng.integers(0, 256, (10, 28, 28), dtype=np.uint8))
+    _write_idx_labels(raw / "t10k-labels-idx1-ubyte",
+                      rng.integers(0, 10, 10).astype(np.uint8))
+    train, test = load_mnist(str(tmp_path))
+    assert train.source == "idx" and test.source == "idx"
+    assert train.images.shape == (20, 28, 28, 1) and test.images.shape == (10, 28, 28, 1)
+
+
+def test_normalization_applied(tmp_path):
+    raw = tmp_path
+    imgs = np.full((2, 28, 28), 255, dtype=np.uint8)
+    _write_idx_images(raw / "train-images-idx3-ubyte", imgs)
+    _write_idx_labels(raw / "train-labels-idx1-ubyte", np.zeros(2, dtype=np.uint8))
+    _write_idx_images(raw / "t10k-images-idx3-ubyte", imgs)
+    _write_idx_labels(raw / "t10k-labels-idx1-ubyte", np.zeros(2, dtype=np.uint8))
+    train, _ = load_mnist(str(tmp_path))
+    np.testing.assert_allclose(train.images, (1.0 - MNIST_MEAN) / MNIST_STD, rtol=1e-5)
+
+
+def test_synthetic_fallback_shapes_and_determinism(tmp_path):
+    t1, e1 = load_mnist(str(tmp_path / "nothing_here"))
+    assert t1.source == "synthetic"
+    assert t1.images.shape == (60_000, 28, 28, 1) and e1.images.shape == (10_000, 28, 28, 1)
+    assert t1.images.dtype == np.float32 and t1.labels.dtype == np.int32
+    assert set(np.unique(t1.labels)) == set(range(10))
+    t2, _ = load_mnist(str(tmp_path / "nothing_here"))
+    np.testing.assert_array_equal(t1.images[:100], t2.images[:100])
+
+
+def test_synthetic_disabled_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_mnist(str(tmp_path / "absent"), allow_synthetic=False)
+
+
+def _tiny_dataset(n=100):
+    rng = np.random.default_rng(0)
+    return Dataset(rng.normal(size=(n, 28, 28, 1)).astype(np.float32),
+                   rng.integers(0, 10, n).astype(np.int32), "test")
+
+
+def test_loader_batch_shapes_and_last_partial():
+    ds = _tiny_dataset(100)
+    loader = BatchLoader(ds, 64, shuffle=True, seed=1)
+    batches = list(loader)
+    assert len(batches) == len(loader) == 2
+    assert batches[0][0].shape == (64, 28, 28, 1)
+    assert batches[1][0].shape == (36, 28, 28, 1)  # drop_last=False, torch default
+
+
+def test_loader_drop_last():
+    loader = BatchLoader(_tiny_dataset(100), 64, drop_last=True)
+    assert len(list(loader)) == len(loader) == 1
+
+
+def test_loader_epoch_reshuffle_covers_dataset():
+    ds = _tiny_dataset(100)
+    loader = BatchLoader(ds, 10, shuffle=True, seed=7)
+    loader.set_epoch(0)
+    first = np.concatenate([b[1] for b in loader])
+    loader.set_epoch(1)
+    second = np.concatenate([b[1] for b in loader])
+    assert sorted(first.tolist()) == sorted(ds.labels.tolist())
+    assert not np.array_equal(first, second)
+
+
+def test_loader_with_sampler_rejects_shuffle():
+    with pytest.raises(ValueError):
+        BatchLoader(_tiny_dataset(), 10,
+                    sampler=ShardedSampler(100, num_replicas=2, rank=0), shuffle=True)
+
+
+def test_epoch_index_matrix():
+    loader = BatchLoader(_tiny_dataset(100), 8, shuffle=True, seed=3)
+    mat = loader.epoch_index_matrix(0, steps_multiple=5)
+    assert mat.shape == (10, 8)  # 12 full batches -> truncated to multiple of 5

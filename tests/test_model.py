@@ -1,0 +1,82 @@
+"""Model-layer tests: shape/param-count oracles from SURVEY.md §3.4, op semantics, dropout
+modes. The reference has no tests (SURVEY.md §4); these encode its model contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu import ops
+from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net, param_count
+
+
+@pytest.fixture(scope="module")
+def net_and_params():
+    net = Net()
+    params = net.init({"params": jax.random.PRNGKey(0)}, jnp.zeros((2, 28, 28, 1)))
+    return net, params
+
+
+def test_param_count_matches_reference(net_and_params):
+    # conv1 260 + conv2 5020 + fc1 16050 + fc2 510 (reference src/model.py:9-13)
+    _, params = net_and_params
+    assert param_count(params["params"]) == 21_840
+
+
+def test_param_shapes(net_and_params):
+    _, params = net_and_params
+    shapes = {k: v.shape for k, v in params["params"].items()}
+    assert shapes == {
+        "conv1_kernel": (5, 5, 1, 10), "conv1_bias": (10,),
+        "conv2_kernel": (5, 5, 10, 20), "conv2_bias": (20,),
+        "fc1_kernel": (320, 50), "fc1_bias": (50,),
+        "fc2_kernel": (50, 10), "fc2_bias": (10,),
+    }
+
+
+def test_forward_shape_and_log_probs(net_and_params):
+    net, params = net_and_params
+    x = jax.random.normal(jax.random.PRNGKey(1), (7, 28, 28, 1))
+    out = net.apply(params, x)
+    assert out.shape == (7, 10)
+    # log_softmax output: rows exp-sum to 1 (reference src/model.py:22)
+    np.testing.assert_allclose(np.exp(np.asarray(out)).sum(-1), np.ones(7), rtol=1e-5)
+
+
+def test_eval_mode_deterministic(net_and_params):
+    net, params = net_and_params
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, 28, 28, 1))
+    np.testing.assert_array_equal(net.apply(params, x), net.apply(params, x))
+
+
+def test_train_mode_applies_dropout(net_and_params):
+    net, params = net_and_params
+    x = jax.random.normal(jax.random.PRNGKey(3), (3, 28, 28, 1))
+    a = net.apply(params, x, deterministic=False, rngs={"dropout": jax.random.PRNGKey(4)})
+    b = net.apply(params, x, deterministic=False, rngs={"dropout": jax.random.PRNGKey(5)})
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+def test_forward_jits_once_per_mode(net_and_params):
+    net, params = net_and_params
+    fwd = jax.jit(lambda p, x: net.apply(p, x))
+    x = jnp.zeros((4, 28, 28, 1))
+    out1 = fwd(params, x)
+    out2 = fwd(params, x + 1.0)
+    assert out1.shape == out2.shape == (4, 10)
+
+
+def test_intermediate_shapes():
+    """The layer-by-layer shape trace of SURVEY.md §3.4 (model.py:16-21)."""
+    x = jnp.zeros((2, 28, 28, 1))
+    w1 = jnp.zeros((5, 5, 1, 10))
+    h = ops.conv2d(x, w1)
+    assert h.shape == (2, 24, 24, 10)
+    h = ops.max_pool2d(h, 2)
+    assert h.shape == (2, 12, 12, 10)
+    w2 = jnp.zeros((5, 5, 10, 20))
+    h = ops.conv2d(h, w2)
+    assert h.shape == (2, 8, 8, 20)
+    h = ops.max_pool2d(h, 2)
+    assert h.shape == (2, 4, 4, 20)
+    assert h.reshape(2, -1).shape == (2, 320)

@@ -1,0 +1,52 @@
+"""Sharded-sampler contract tests (SURVEY.md §4 "sampler-sharding disjointness/coverage"):
+the DistributedSampler semantics of reference src/train_dist.py:33-37,72."""
+
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.sampler import (
+    ShardedSampler,
+)
+
+
+@pytest.mark.parametrize("n,replicas", [(60_000, 1), (60_000, 2), (60_000, 8), (1003, 4)])
+def test_disjoint_and_covering(n, replicas):
+    shards = [ShardedSampler(n, num_replicas=replicas, rank=r).epoch_indices(0)
+              for r in range(replicas)]
+    sizes = {len(s) for s in shards}
+    assert len(sizes) == 1  # equal per-replica counts
+    union = np.concatenate(shards)
+    assert len(union) == ShardedSampler(n, num_replicas=replicas).total_size
+    # padded union covers every example; overlap only from the <replicas pad tail
+    assert set(union.tolist()) == set(range(n))
+
+
+def test_padding_recycles_head():
+    s = ShardedSampler(10, num_replicas=4, rank=0, shuffle=False)
+    perm = s.global_permutation(0)
+    assert len(perm) == 12
+    np.testing.assert_array_equal(perm[:10], np.arange(10))
+    np.testing.assert_array_equal(perm[10:], [0, 1])  # drop_last=False recycle
+
+
+def test_epoch_reshuffles_globally():
+    a = ShardedSampler(1000, num_replicas=2, rank=0).epoch_indices(0)
+    b = ShardedSampler(1000, num_replicas=2, rank=0).epoch_indices(1)
+    assert not np.array_equal(a, b)  # set_epoch changes the order (src/train_dist.py:72)
+
+
+def test_same_epoch_is_deterministic_across_replicas():
+    """Every replica derives the same global permutation with no communication."""
+    p0 = ShardedSampler(500, num_replicas=4, rank=0).global_permutation(3)
+    p3 = ShardedSampler(500, num_replicas=4, rank=3).global_permutation(3)
+    np.testing.assert_array_equal(p0, p3)
+
+
+def test_no_shuffle_is_stride_sharding():
+    s = ShardedSampler(8, num_replicas=2, rank=1, shuffle=False)
+    np.testing.assert_array_equal(s.epoch_indices(0), [1, 3, 5, 7])
+
+
+def test_rank_validation():
+    with pytest.raises(ValueError):
+        ShardedSampler(10, num_replicas=2, rank=2)
