@@ -65,11 +65,17 @@ class BatchLoader:
             idx = indices[start:start + self.batch_size]
             yield self.dataset.images[idx], self.dataset.labels[idx]
 
-    def epoch_index_matrix(self, epoch: int, steps_multiple: int = 1) -> np.ndarray:
+    def epoch_index_matrix(self, epoch: int | None = None,
+                           steps_multiple: int = 1) -> np.ndarray:
         """This epoch's order as a ``[num_steps, batch_size]`` index matrix for the
         device-resident fast path (``lax.scan`` over gathered batches): full batches only,
-        optionally truncated to a multiple of ``steps_multiple`` (e.g. ``log_interval``)."""
+        optionally truncated to a multiple of ``steps_multiple`` (e.g. ``log_interval``).
+        ``epoch=None`` uses the ``set_epoch`` value."""
         indices = self.sampler.epoch_indices(self._epoch if epoch is None else epoch)
         steps = len(indices) // self.batch_size
         steps -= steps % steps_multiple
+        if steps == 0:
+            raise ValueError(
+                f"no full batch groups: {len(indices)} samples, batch {self.batch_size}, "
+                f"steps_multiple {steps_multiple} — lower batch_size or steps_multiple")
         return indices[:steps * self.batch_size].reshape(steps, self.batch_size)

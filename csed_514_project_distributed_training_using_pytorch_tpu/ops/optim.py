@@ -1,0 +1,33 @@
+"""SGD with momentum — the reference's only optimizer, as a pure pytree transform.
+
+Reproduces ``torch.optim.SGD(lr, momentum)`` semantics exactly (reference
+``src/train.py:60-61`` lr=0.01 mom=0.5; ``src/train_dist.py:66`` lr=0.02 mom=0.5), i.e. the
+torch update with no dampening/nesterov/weight-decay:
+
+    v <- momentum * v + g
+    p <- p - lr * v
+
+(Torch initializes the buffer to the first gradient; starting from v=0 gives the identical
+sequence since ``momentum*0 + g == g``.) Implemented first-party rather than via optax to keep
+the update rule explicit and dependency-free; it is a drop-in ``(init_fn, update_fn)`` pair in
+the optax style, so an optax ``GradientTransformation`` can be substituted where desired.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sgd_init(params):
+    """Zero velocity buffers, one per parameter leaf (the torch momentum_buffer analog)."""
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def sgd_update(params, velocity, grads, *, learning_rate: float, momentum: float):
+    """One SGD-momentum step; returns (new_params, new_velocity)."""
+    new_velocity = jax.tree_util.tree_map(
+        lambda v, g: momentum * v + g, velocity, grads)
+    new_params = jax.tree_util.tree_map(
+        lambda p, v: p - learning_rate * v, params, new_velocity)
+    return new_params, new_velocity

@@ -34,7 +34,7 @@ def ring_pass(mesh: Mesh, values: jax.Array, *, axis_name: str = "data",
     perm = [(i, (i + shift) % n) for i in range(n)]
 
     @partial(shard_map, mesh=mesh, in_specs=P(axis_name), out_specs=P(axis_name),
-             check_rep=False)
+             check_vma=False)
     def _shift(x):
         return lax.ppermute(x, axis_name, perm)
 
@@ -50,7 +50,7 @@ def all_reduce_sum(mesh: Mesh, values: jax.Array, *, axis_name: str = "data") ->
     """
 
     @partial(shard_map, mesh=mesh, in_specs=P(axis_name), out_specs=P(None),
-             check_rep=False)
+             check_vma=False)
     def _sum(x):
         return lax.psum(jnp.sum(x, axis=0, keepdims=True), axis_name)
 

@@ -1,0 +1,110 @@
+"""SPMD data parallelism: compile the train step/epoch over a device mesh.
+
+The reference's data parallelism is a wrapper object plus autograd hooks: ``DDP(model)``
+broadcasts params, registers per-bucket hooks, and ring-allreduces gradients over gloo/TCP
+during every ``backward()`` (reference ``src/train_dist.py:63,83``; SURVEY.md §2b). Here the
+same math is expressed with *sharding annotations only*:
+
+- the global batch is sharded along the mesh's ``data`` axis (the ``DistributedSampler``
+  division of labor, reference ``src/train_dist.py:33-37``, but enforced by the compiler);
+- params/optimizer state are replicated (``P()``);
+- XLA's SPMD partitioner then auto-inserts the gradient ``all-reduce`` inside the one compiled
+  step program, scheduled on ICI within a slice / DCN across slices, overlapped with compute
+  where profitable — the Reducer/bucketing machinery DDP hand-builds.
+
+The compiled step is numerically the *same program* as the single-device one (GSPMD
+semantics), which is the DDP-equivalence oracle tests assert (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_sharding(mesh: Mesh, axis_name: str = "data") -> NamedSharding:
+    """Leading-dim sharding for per-example arrays (images, labels, per-step index plans)."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Full replication — params, optimizer state, PRNG keys."""
+    return NamedSharding(mesh, P())
+
+
+def compile_step(step_fn: Callable, mesh: Mesh, *, axis_name: str = "data") -> Callable:
+    """Compile ``step(state, images, labels, rng)`` over ``mesh`` with DP shardings.
+
+    State is donated (buffers reused in-place on device — no reallocation per step).
+    """
+    rep, bsh = replicated(mesh), batch_sharding(mesh, axis_name)
+    return jax.jit(step_fn,
+                   in_shardings=(rep, bsh, bsh, rep),
+                   out_shardings=(rep, rep),
+                   donate_argnums=(0,))
+
+
+def compile_epoch(epoch_fn: Callable, mesh: Mesh, *, axis_name: str = "data") -> Callable:
+    """Compile ``epoch(state, images, labels, idx_matrix, rng)`` over ``mesh``.
+
+    The dataset stays replicated on every device (MNIST is ~180 MB — far under HBM); the
+    ``[steps, batch]`` index plan is sharded along the batch axis, so each device gathers and
+    computes only its shard of every step's batch. Gradient/loss reductions become global
+    all-reduces inserted by XLA.
+    """
+    rep = replicated(mesh)
+    idx_sh = NamedSharding(mesh, P(None, axis_name))
+    return jax.jit(epoch_fn,
+                   in_shardings=(rep, rep, rep, idx_sh, rep),
+                   out_shardings=(rep, rep),
+                   donate_argnums=(0,))
+
+
+def compile_eval(eval_fn: Callable, mesh: Mesh, *, axis_name: str = "data",
+                 shard: bool = False) -> Callable:
+    """Compile ``evaluate(params, images, labels)`` over ``mesh``.
+
+    ``shard=False`` reproduces the reference's duplicated evaluation — every replica computes
+    the full test set (reference ``src/train_dist.py:21-24,92-109``, SURVEY.md §2d.7); with
+    one compiled SPMD program this costs nothing extra to express. ``shard=True`` is the
+    fixed version: examples sharded, partial sums all-reduced by XLA.
+    """
+    rep = replicated(mesh)
+    data_sh = batch_sharding(mesh, axis_name) if shard else rep
+    return jax.jit(eval_fn,
+                   in_shardings=(rep, data_sh, data_sh),
+                   out_shardings=(rep, rep))
+
+
+def device_put_dataset(mesh: Mesh, images: np.ndarray, labels: np.ndarray):
+    """Place the full dataset on devices, replicated (single-host path)."""
+    rep = replicated(mesh)
+    return jax.device_put(images, rep), jax.device_put(labels, rep)
+
+
+def put_global(mesh: Mesh, array: np.ndarray, spec: P):
+    """Place a host-resident array on the mesh under ``spec``, working on both a single
+    controller and a multi-host fleet. Every process must hold the (identical) full array —
+    true for our datasets and index plans, which are pure functions of (seed, epoch) on every
+    host (see ``parallel.sampler``); each process materializes only its addressable shards.
+    """
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(array.shape, sharding, lambda i: array[i])
+
+
+def global_batch_from_host_local(mesh: Mesh, local_images: np.ndarray,
+                                 local_labels: np.ndarray,
+                                 axis_name: str = "data"):
+    """Assemble a globally-sharded batch from this process's host-local shard (multi-host
+    path: each host feeds only its addressable devices, SURVEY.md §7 hard part (d)).
+
+    ``local_*`` must be this process's contiguous slice of the global batch, in the order
+    given by the sampler's global permutation.
+    """
+    bsh = batch_sharding(mesh, axis_name)
+    gi = jax.make_array_from_process_local_data(bsh, local_images)
+    gl = jax.make_array_from_process_local_data(bsh, local_labels)
+    return gi, gl

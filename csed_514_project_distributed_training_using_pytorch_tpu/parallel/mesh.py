@@ -51,12 +51,17 @@ def initialize_cluster(coordinator_address: str | None = None,
 
     No-op on a single-process run — safe to call unconditionally from every entry point.
     """
+    # TPU pod slice metadata lists one hostname per host; a single entry means this is not
+    # a multi-host fleet and no coordinator service is needed.
+    slice_hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
     multi_host = (
         coordinator_address is not None
         or os.environ.get("JAX_COORDINATOR_ADDRESS")
-        or os.environ.get("TPU_WORKER_HOSTNAMES")  # set by TPU pod runtime metadata
+        or len(slice_hosts) > 1
     )
-    if multi_host and jax.process_count() == 1:
+    # Check the distributed-runtime state directly: touching jax.process_count() here would
+    # initialize the local XLA backend first, after which jax.distributed.initialize raises.
+    if multi_host and not jax.distributed.is_initialized():
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -1,0 +1,19 @@
+"""Training drivers (the reference's L4/L5 layers): jit-compiled step/epoch functions plus the
+three entry points — single-process (reference ``src/train.py``), distributed
+(``src/train_dist.py``), and the connectivity smoke test (``src/run1.py``/``src/run2.py``)."""
+
+from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+    TrainState,
+    create_train_state,
+    make_train_step,
+    make_epoch_fn,
+    make_eval_fn,
+)
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_train_step",
+    "make_epoch_fn",
+    "make_eval_fn",
+]

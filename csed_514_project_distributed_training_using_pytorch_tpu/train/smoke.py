@@ -1,0 +1,52 @@
+"""Connectivity smoke test — the reference ``src/run1.py``/``src/run2.py`` analog.
+
+The reference validates its cluster before training by sending a 1-element tensor rank0→rank1
+over gloo and printing it on both sides (reference ``src/run1.py:8-17``; SURVEY.md §3.3). The
+TPU-native equivalent: join the cluster (rendezvous ≙ ``init_process_group``), build the
+mesh, and run one ``ppermute`` ring rotation — every device's value must arrive at its
+neighbor, exercising rendezvous + ICI/DCN p2p in one shot. One launcher for every host
+(no per-machine rank-edited files — the rank hardcoding at ``src/run1.py:31`` vs
+``src/run2.py:31`` is exactly what this replaces).
+
+Run: ``python -m csed_514_project_distributed_training_using_pytorch_tpu.train.smoke``
+(identical command on every host of a fleet).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+    data_parallel as dp,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.collectives import (
+    ring_pass,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import (
+    initialize_cluster, make_mesh,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import metrics as M
+
+
+def main(num_devices: int | None = None) -> bool:
+    """Returns True iff the ring pass delivered every value to its neighbor."""
+    info = initialize_cluster()
+    mesh = make_mesh(num_devices)
+    n = mesh.shape["data"]
+    M.log(f"smoke: {info.process_count} process(es), {n}-device mesh {mesh.devices.ravel()}")
+
+    values = np.arange(n, dtype=np.float32)       # device i holds value i (≙ the tensor
+    rotated = ring_pass(mesh, dp.put_global(mesh, values, P("data")))  # rank0 sends, run1.py:13)
+    got = np.asarray(rotated)
+    want = np.roll(values, 1)
+
+    ok = bool(np.array_equal(got, want))
+    for i in range(n):                            # ≙ 'Rank k has data tensor(1.)', run1.py:17
+        M.log(f"Device {i} has data {got[i]:.1f} (expected {want[i]:.1f})")
+    M.log(f"smoke: {'OK — rendezvous + ring p2p verified' if ok else 'FAILED'}")
+    return ok
+
+
+if __name__ == "__main__":
+    raise SystemExit(0 if main() else 1)

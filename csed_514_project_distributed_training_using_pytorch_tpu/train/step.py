@@ -1,0 +1,132 @@
+"""The compiled train/eval step — forward, backward, and update as ONE XLA program.
+
+This is the TPU-native replacement for the reference's per-batch sequence
+``zero_grad → forward → nll → backward → optimizer.step`` (reference ``src/train.py:72-76``,
+``src/train_dist.py:80-84``), which there spans the Python interpreter, the C++ autograd
+engine, and (distributed) DDP's bucketed allreduce hooks. Here the whole thing — including the
+gradient all-reduce when compiled over a multi-device mesh (see
+``parallel/data_parallel.py``) — is a single jit-compiled, fused XLA program:
+
+- ``make_train_step``: one optimizer step; the autograd-engine analog is ``jax.value_and_grad``.
+- ``make_epoch_fn``: a ``lax.scan`` over a whole epoch (or a log-interval segment) of steps,
+  gathering batches from the *device-resident* dataset by index — zero host↔device transfer
+  and zero Python dispatch on the hot path, unlike the reference's per-step ``.item()`` sync
+  (``src/train_dist.py:85``, SURVEY.md §7 hard part (c)).
+- ``make_eval_fn``: full-split evaluation (sum-NLL + correct count) as one scanned program —
+  the reference's ``test()`` loop (``src/train.py:87-104``, ``src/train_dist.py:92-109``)
+  with its deprecated ``size_average=False`` sum-then-divide semantics.
+
+Dropout randomness: a per-epoch PRNG key folded with the global step index gives every step a
+fresh, reproducible key (SURVEY.md §7 hard part (b)); under SPMD the mask array itself is
+batch-sharded, so replicas draw distinct masks from the same key.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from csed_514_project_distributed_training_using_pytorch_tpu import ops
+from csed_514_project_distributed_training_using_pytorch_tpu.ops.optim import (
+    sgd_init,
+    sgd_update,
+)
+
+
+class TrainState(NamedTuple):
+    """Model + optimizer state as one pytree (params, SGD velocity, global step)."""
+
+    params: dict
+    velocity: dict
+    step: jax.Array  # int32 scalar
+
+
+def create_train_state(model, rng: jax.Array,
+                       sample_input_shape=(1, 28, 28, 1)) -> TrainState:
+    """Initialize params (PyTorch-default distributions, see ``ops/initializers.py``) and
+    zero velocity. Under SPMD every process derives identical state from the same seed — the
+    replica-consistency analog of DDP's initial parameter broadcast
+    (reference ``src/train_dist.py:63``)."""
+    variables = model.init({"params": rng}, jnp.zeros(sample_input_shape))
+    params = variables["params"]
+    return TrainState(params=params, velocity=sgd_init(params),
+                      step=jnp.zeros((), jnp.int32))
+
+
+def make_train_step(model, *, learning_rate: float, momentum: float) -> Callable:
+    """Build ``step(state, images, labels, rng) -> (state, loss)``.
+
+    The loss is the canonical ``nll(log_probs)`` formulation (see
+    ``ops.cross_entropy_loss`` for why this also covers the reference's distributed
+    CrossEntropyLoss objective). Wrap in ``jax.jit`` (or compile over a mesh via
+    ``parallel.data_parallel.compile_step``) before use.
+    """
+
+    def loss_fn(params, images, labels, rng):
+        log_probs = model.apply({"params": params}, images,
+                                deterministic=False, rngs={"dropout": rng})
+        return ops.nll_loss(log_probs, labels)
+
+    def step(state: TrainState, images, labels, rng) -> tuple[TrainState, jax.Array]:
+        step_rng = jax.random.fold_in(rng, state.step)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, images, labels, step_rng)
+        params, velocity = sgd_update(state.params, state.velocity, grads,
+                                      learning_rate=learning_rate, momentum=momentum)
+        return TrainState(params, velocity, state.step + 1), loss
+
+    return step
+
+
+def make_epoch_fn(model, *, learning_rate: float, momentum: float) -> Callable:
+    """Build ``epoch(state, images, labels, idx_matrix, rng) -> (state, losses)``.
+
+    ``images``/``labels`` are the full (device-resident) training split; ``idx_matrix`` is a
+    ``[num_steps, batch]`` int32 index plan (from ``BatchLoader.epoch_index_matrix`` — the
+    sampler output). The scan runs ``num_steps`` optimizer steps with no host round-trip;
+    per-step losses come back as one ``[num_steps]`` array for logging, replacing the
+    reference's per-step ``loss.item()`` host syncs (``src/train_dist.py:85``).
+    """
+    train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum)
+
+    def epoch(state: TrainState, images, labels, idx_matrix, rng):
+        def body(state, idx):
+            return train_step(state, jnp.take(images, idx, axis=0),
+                              jnp.take(labels, idx, axis=0), rng)
+
+        return lax.scan(body, state, idx_matrix)
+
+    return epoch
+
+
+def make_eval_fn(model, *, batch_size: int = 1000) -> Callable:
+    """Build ``evaluate(params, images, labels) -> (sum_nll, num_correct)``.
+
+    Reproduces the reference ``test()`` semantics: deterministic forward, NLL summed over the
+    split then divided by its size by the caller (``src/train.py:94-97``), plus argmax
+    accuracy (``src/train.py:95-96``). The split size must divide by ``batch_size`` (MNIST
+    test: 10,000 / 1,000, reference ``src/train.py:14``).
+    """
+
+    def evaluate(params, images, labels):
+        n = images.shape[0]
+        num_batches = n // batch_size
+        xs = images[:num_batches * batch_size].reshape(
+            (num_batches, batch_size) + images.shape[1:])
+        ys = labels[:num_batches * batch_size].reshape(num_batches, batch_size)
+
+        def body(carry, batch):
+            x, y = batch
+            log_probs = model.apply({"params": params}, x)
+            sum_nll, correct = carry
+            sum_nll += ops.nll_loss(log_probs, y, reduction="sum")
+            correct += jnp.sum(jnp.argmax(log_probs, axis=-1) == y)
+            return (sum_nll, correct), None
+
+        (sum_nll, correct), _ = lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)), (xs, ys))
+        return sum_nll, correct
+
+    return evaluate

@@ -35,6 +35,8 @@ class SingleProcessConfig:
     images_dir: str = "images"        # src/train.py:57,117 plot target
     profile: bool = False             # optional jax.profiler capture (reference has none, §5)
     profile_dir: str = "results/profile"
+    resume_from: str = ""             # checkpoint path to resume from (the restore path the
+                                      # reference lacks, SURVEY.md §5 "checkpoint/resume")
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,82 @@
+"""Metric history + stdout reporting.
+
+Covers the reference's observability surface (SURVEY.md §5 "metrics/logging"): the four
+module-level loss/counter lists (reference ``src/train.py:64-67``, ``src/train_dist.py:150-153``),
+the every-``log_interval`` train progress line (``src/train.py:77-80``), the post-eval test
+summary with average loss / correct / accuracy%% / elapsed seconds (``src/train.py:100-104``),
+and the distributed per-epoch summary (``src/train_dist.py:113-114``). Elapsed time is
+wall-clock since trainer start — the very number behind the reference's
+time-vs-machines scaling plot (BASELINE.md), so it is measured identically here (but around
+``block_until_ready``'d device work, SURVEY.md §7 hard part (c)).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+
+@dataclass
+class MetricsHistory:
+    """Loss trajectories for the loss-curve plot (≙ reference src/train.py:64-67)."""
+
+    train_losses: list = field(default_factory=list)
+    train_counter: list = field(default_factory=list)   # examples seen at each train point
+    test_losses: list = field(default_factory=list)
+    test_counter: list = field(default_factory=list)    # examples seen at each eval point
+
+    def record_train(self, examples_seen: int, loss: float) -> None:
+        self.train_counter.append(int(examples_seen))
+        self.train_losses.append(float(loss))
+
+    def record_test(self, examples_seen: int, loss: float) -> None:
+        self.test_counter.append(int(examples_seen))
+        self.test_losses.append(float(loss))
+
+
+class Stopwatch:
+    """Wall-clock since construction (≙ ``t0 = time.time()`` reference src/train.py:10)."""
+
+    def __init__(self):
+        self.t0 = time.time()
+
+    def elapsed(self) -> float:
+        return time.time() - self.t0
+
+
+def is_logging_process() -> bool:
+    """Metric emission is process-0-gated — unlike the reference, where every rank prints and
+    plots duplicate output (SURVEY.md §5)."""
+    return jax.process_index() == 0
+
+
+def log(msg: str) -> None:
+    if is_logging_process():
+        print(msg, flush=True)
+
+
+def train_progress_line(epoch: int, examples_seen: int, dataset_size: int,
+                        loss: float) -> str:
+    """Per-log-interval progress (≙ reference src/train.py:78-80 format)."""
+    pct = 100.0 * examples_seen / dataset_size
+    return (f"Train Epoch: {epoch} [{examples_seen}/{dataset_size} ({pct:.0f}%)]"
+            f"\tLoss: {loss:.6f}")
+
+
+def test_summary_line(avg_loss: float, correct: int, total: int,
+                      elapsed_s: float) -> str:
+    """Post-eval summary (≙ reference src/train.py:100-104: avg loss = summed NLL / dataset
+    size, argmax accuracy, elapsed seconds)."""
+    pct = 100.0 * correct / total
+    return (f"\nTest set: Avg. loss: {avg_loss:.4f}, "
+            f"Accuracy: {correct}/{total} ({pct:.0f}%), "
+            f"Time elapsed: {elapsed_s:.2f}s\n")
+
+
+def dist_epoch_summary_line(epoch: int, train_loss: float, val_loss: float,
+                            accuracy: float, elapsed_s: float) -> str:
+    """Distributed per-epoch summary (≙ reference src/train_dist.py:113-114)."""
+    return (f"Epoch {epoch}: train_loss: {train_loss:.4f}, val_loss: {val_loss:.4f}, "
+            f"accuracy: {accuracy:.4f}, time_elapsed: {elapsed_s:.2f}s")

@@ -1,0 +1,92 @@
+"""Plot artifacts: sample grid + loss curves (matplotlib, gated).
+
+Reproduces the reference's three figure artifacts (SURVEY.md §2a #5, #7, #11): the 6-digit
+sample grid (reference ``src/train.py:43-57`` → images/train_images.png), the single-process
+train/test loss curve (``src/train.py:111-117`` → images/train_test_curve.png), and the
+distributed curve (``src/train_dist.py:49-56`` → images/train_test_curve_dist.png). All
+plotting is process-0 gated and degrades to a no-op if matplotlib is unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
+    MNIST_MEAN,
+    MNIST_STD,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.metrics import (
+    MetricsHistory,
+    is_logging_process,
+)
+
+try:
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    HAVE_MATPLOTLIB = True
+except ImportError:  # plotting is optional — training never depends on it
+    HAVE_MATPLOTLIB = False
+
+
+def _ensure_dir(path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+
+def save_sample_grid(images: np.ndarray, labels: np.ndarray, path: str,
+                     n: int = 6) -> str | None:
+    """Grid of ``n`` example digits with their labels (≙ reference src/train.py:43-57).
+
+    ``images`` are normalized NHWC; de-normalized for display.
+    """
+    if not (HAVE_MATPLOTLIB and is_logging_process()):
+        return None
+    _ensure_dir(path)
+    fig = plt.figure()
+    for i in range(n):
+        plt.subplot(2, 3, i + 1)
+        plt.tight_layout()
+        img = np.asarray(images[i, :, :, 0]) * MNIST_STD + MNIST_MEAN
+        plt.imshow(img, cmap="gray", interpolation="none")
+        plt.title(f"Ground Truth: {int(labels[i])}")
+        plt.xticks([])
+        plt.yticks([])
+    fig.savefig(path)
+    plt.close(fig)
+    return path
+
+
+def save_loss_curves(history: MetricsHistory, path: str) -> str | None:
+    """Train-loss trajectory + test-loss points vs examples seen
+    (≙ reference src/train.py:111-117 and src/train_dist.py:49-56)."""
+    if not (HAVE_MATPLOTLIB and is_logging_process()):
+        return None
+    _ensure_dir(path)
+    fig = plt.figure()
+    plt.plot(history.train_counter, history.train_losses, color="blue")
+    plt.scatter(history.test_counter, history.test_losses, color="red")
+    plt.legend(["Train Loss", "Test Loss"], loc="upper right")
+    plt.xlabel("number of training examples seen")
+    plt.ylabel("negative log likelihood loss")
+    fig.savefig(path)
+    plt.close(fig)
+    return path
+
+
+def save_scaling_curve(worker_counts: list[int], epoch_seconds: list[float],
+                       path: str) -> str | None:
+    """Time-to-train-one-epoch vs number of workers — the reference's headline result
+    (README.md:20, 'Time to train (1 epoch) vs. Number of machines.png')."""
+    if not (HAVE_MATPLOTLIB and is_logging_process()):
+        return None
+    _ensure_dir(path)
+    fig = plt.figure()
+    plt.plot(worker_counts, epoch_seconds, marker="o")
+    plt.xlabel("Number of devices")
+    plt.ylabel("Time to train 1 epoch (s)")
+    plt.title("Time to train (1 epoch) vs. Number of devices")
+    fig.savefig(path)
+    plt.close(fig)
+    return path

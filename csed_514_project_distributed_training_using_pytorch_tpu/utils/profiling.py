@@ -1,0 +1,34 @@
+"""Optional tracing/profiling.
+
+The reference's only instrument is coarse wall-clock (``t0 = time.time()``, reference
+``src/train.py:10,99``; SURVEY.md §5 "tracing/profiling") — kept, in ``utils.metrics.Stopwatch``,
+because it *is* the baseline metric. This module adds what the reference lacks: an opt-in
+``jax.profiler`` device trace (TPU timeline incl. ICI collectives, viewable in
+TensorBoard/Perfetto) behind a flag, costing nothing when disabled.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def maybe_profile(enabled: bool, log_dir: str):
+    """Capture a jax.profiler trace of the enclosed block when ``enabled``."""
+    if not enabled:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region in the device trace (TraceAnnotation)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield

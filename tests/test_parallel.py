@@ -1,0 +1,141 @@
+"""Parallelism-layer tests on the 8-device virtual CPU mesh (SURVEY.md §4): mesh construction,
+ppermute ring (the p2p smoke analog of reference src/run1.py), explicit all-reduce, and the
+DDP-equivalence oracle — the mesh-compiled SPMD step must reproduce the single-device step on
+the same global batch, since XLA's auto-inserted gradient all-reduce is the DDP Reducer analog
+(reference src/train_dist.py:63,83)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+    all_reduce_sum, make_mesh, ring_pass,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel import data_parallel as dp
+from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+    create_train_state, make_epoch_fn, make_eval_fn, make_train_step,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh8(devices8):
+    return make_mesh(8)
+
+
+@pytest.fixture
+def model_and_states():
+    # function-scoped: donated steps consume state buffers (device_put may alias the
+    # device-0 shard), so each test needs a fresh state
+    model = Net()
+    return model, create_train_state(model, jax.random.PRNGKey(0))
+
+
+def test_make_mesh_shapes(devices8):
+    assert make_mesh(8).shape == {"data": 8}
+    assert make_mesh(4).shape == {"data": 4}
+    m = make_mesh(8, axis_names=("data", "model"), axis_shape=(4, 2))
+    assert m.shape == {"data": 4, "model": 2}
+    with pytest.raises(ValueError):
+        make_mesh(8, axis_names=("data", "model"), axis_shape=(3, 2))
+
+
+def test_ring_pass_rotates(mesh8):
+    """Device i's value lands on device i+1 (mod 8) — the send/recv smoke-test analog
+    (reference src/run1.py:8-17, where rank 0's tensor arrives at rank 1)."""
+    vals = jnp.arange(8.0)
+    out = np.asarray(ring_pass(mesh8, vals))
+    np.testing.assert_array_equal(out, np.roll(np.arange(8.0), 1))
+
+
+def test_ring_pass_full_cycle_identity(mesh8):
+    x = jnp.arange(8.0)
+    for _ in range(8):
+        x = ring_pass(mesh8, x)
+    np.testing.assert_array_equal(np.asarray(x), np.arange(8.0))
+
+
+def test_all_reduce_sum(mesh8):
+    vals = jnp.arange(16.0).reshape(8, 2)  # 2 elements per device
+    out = np.asarray(all_reduce_sum(mesh8, vals))
+    np.testing.assert_allclose(out, np.arange(16.0).reshape(8, 2).sum(0))
+
+
+def test_dp_step_equals_single_device(mesh8, model_and_states):
+    """THE oracle (SURVEY.md §7 build step 3): N-chip SPMD step == 1-chip step on the same
+    global batch, i.e. 'psum grad == sequential grad on the concatenated batch'."""
+    model, state0 = model_and_states
+    x = jax.random.normal(jax.random.PRNGKey(1), (64, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(2), (64,), 0, 10)
+    rng = jax.random.PRNGKey(3)
+    step = make_train_step(model, learning_rate=0.02, momentum=0.5)
+
+    single = jax.jit(step)
+    state_s = state0
+    for _ in range(3):
+        state_s, loss_s = single(state_s, x, y, rng)
+
+    sharded = dp.compile_step(step, mesh8)
+    state_d = jax.device_put(state0, dp.replicated(mesh8))
+    xd = jax.device_put(x, dp.batch_sharding(mesh8))
+    yd = jax.device_put(y, dp.batch_sharding(mesh8))
+    for _ in range(3):
+        state_d, loss_d = sharded(state_d, xd, yd, rng)
+
+    np.testing.assert_allclose(float(loss_s), float(loss_d), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(state_s.params),
+                    jax.tree_util.tree_leaves(state_d.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_dp_epoch_equals_single_device(mesh8, model_and_states):
+    """Same oracle for the scanned-epoch fast path with a sharded index plan."""
+    model, state0 = model_and_states
+    images = jax.random.normal(jax.random.PRNGKey(4), (128, 28, 28, 1))
+    labels = jax.random.randint(jax.random.PRNGKey(5), (128,), 0, 10)
+    idx = jnp.arange(128).reshape(4, 32)
+    rng = jax.random.PRNGKey(6)
+    epoch = make_epoch_fn(model, learning_rate=0.01, momentum=0.5)
+
+    state_s, losses_s = jax.jit(epoch)(state0, images, labels, idx, rng)
+
+    ep_d = dp.compile_epoch(epoch, mesh8)
+    state_d = jax.device_put(state0, dp.replicated(mesh8))
+    img_d, lab_d = dp.device_put_dataset(mesh8, np.asarray(images), np.asarray(labels))
+    idx_d = jax.device_put(idx, jax.sharding.NamedSharding(
+        mesh8, jax.sharding.PartitionSpec(None, "data")))
+    state_d, losses_d = ep_d(state_d, img_d, lab_d, idx_d, rng)
+
+    np.testing.assert_allclose(np.asarray(losses_s), np.asarray(losses_d),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(state_s.params),
+                    jax.tree_util.tree_leaves(state_d.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("shard", [False, True])
+def test_eval_modes_agree(mesh8, model_and_states, shard):
+    """Replicated eval (the reference's every-rank-full-test-set behavior, §2d.7) and
+    sharded+psum eval (the fixed version) must produce identical numbers."""
+    model, state = model_and_states
+    x = jax.random.normal(jax.random.PRNGKey(7), (80, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(8), (80,), 0, 10)
+    ev = make_eval_fn(model, batch_size=10)
+    want_nll, want_correct = jax.jit(ev)(state.params, x, y)
+
+    ev_c = dp.compile_eval(ev, mesh8, shard=shard)
+    params_d = jax.device_put(state.params, dp.replicated(mesh8))
+    sh = dp.batch_sharding(mesh8) if shard else dp.replicated(mesh8)
+    got_nll, got_correct = ev_c(params_d, jax.device_put(x, sh), jax.device_put(y, sh))
+    np.testing.assert_allclose(float(got_nll), float(want_nll), rtol=1e-4)
+    assert int(got_correct) == int(want_correct)
+
+
+def test_global_batch_from_host_local(mesh8):
+    """Single-process degenerate case: the host-local slice is the whole global batch."""
+    x = np.arange(32.0).reshape(16, 2)
+    y = np.arange(16)
+    gx, gy = dp.global_batch_from_host_local(mesh8, x, y)
+    assert gx.shape == (16, 2) and gy.shape == (16,)
+    np.testing.assert_array_equal(np.asarray(gx), x)

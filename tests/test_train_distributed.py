@@ -1,0 +1,101 @@
+"""End-to-end distributed trainer + smoke test on the 8-device virtual CPU mesh (the
+multi-node-without-a-cluster setup the reference cannot do, SURVEY.md §4.4): full workflow of
+reference src/train_dist.py, plus the index-plan layout contract."""
+
+import os
+
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
+    Dataset, _normalize, _synthesize_split,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.sampler import (
+    ShardedSampler,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.train import distributed, smoke
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+    DistributedConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_datasets():
+    xs, ys = _synthesize_split(2048, seed=200)
+    train = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+    xs, ys = _synthesize_split(400, seed=201)
+    test = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+    return train, test
+
+
+def test_epoch_index_plan_layout():
+    """Column-block r of the plan must be replica r's DistributedSampler shard."""
+    world, per_b = 4, 8
+    samplers = [ShardedSampler(1000, num_replicas=world, rank=r, seed=42)
+                for r in range(world)]
+    plan = distributed.epoch_index_plan(samplers, epoch=3, per_replica_batch=per_b)
+    assert plan.shape == (1000 // world // per_b, world * per_b)
+    for r in range(world):
+        block = plan[:, r * per_b:(r + 1) * per_b].ravel()
+        np.testing.assert_array_equal(block, samplers[r].epoch_indices(3)[:len(block)])
+
+
+def test_distributed_trainer_end_to_end(tmp_path, tiny_datasets, capsys, devices8):
+    cfg = DistributedConfig(
+        epochs=3, global_batch_size=64, batch_size_test=100, learning_rate=0.05,
+        momentum=0.5, results_dir=str(tmp_path / "results"),
+        images_dir=str(tmp_path / "images"))
+    state, history = distributed.main(cfg, num_devices=8, datasets=tiny_datasets)
+
+    out = capsys.readouterr().out
+    assert "Distributed training: 8 devices" in out
+    assert "Epoch 0: train_loss:" in out and "Epoch 2: train_loss:" in out
+    # 3 epochs -> 3 eval records; loss must clearly drop on the learnable task
+    assert len(history.test_losses) == 3
+    assert history.test_losses[-1] < history.test_losses[0] - 0.1
+    # 2048/8 = 256 per replica, per-replica batch 8 -> 32 steps/epoch, 3 epochs
+    assert int(state.step) == 96
+    # process-0 final params export (≙ reference src/train_dist.py:163-164)
+    assert os.path.exists(os.path.join(cfg.results_dir, "model_dist.msgpack"))
+
+
+def test_distributed_matches_world1(tmp_path, tiny_datasets, devices8):
+    """Same config on a 1-device vs 8-device mesh: same global batch sequence ⇒ same final
+    val loss trajectory would require identical sampler layout, which differs (world-size
+    enters the sharding); instead assert both converge and world-8 keeps replicas in one
+    compiled program (state identical across devices by construction)."""
+    cfg = DistributedConfig(epochs=2, global_batch_size=64, batch_size_test=100,
+                            learning_rate=0.05, momentum=0.5,
+                            results_dir=str(tmp_path / "r1"),
+                            images_dir=str(tmp_path / "i1"))
+    _, h1 = distributed.main(cfg, num_devices=1, datasets=tiny_datasets)
+    _, h8 = distributed.main(cfg, num_devices=8, datasets=tiny_datasets)
+    assert h1.test_losses[-1] < h1.test_losses[0]
+    assert h8.test_losses[-1] < h8.test_losses[0]
+
+
+def test_distributed_shard_eval(tmp_path, tiny_datasets, devices8):
+    """shard_eval=True (the fixed version of quirk §2d.7) must give the same val metrics."""
+    base = dict(epochs=1, global_batch_size=64, batch_size_test=50, learning_rate=0.05,
+                momentum=0.5)
+    cfg_rep = DistributedConfig(**base, results_dir=str(tmp_path / "r"),
+                                images_dir=str(tmp_path / "i"))
+    cfg_sh = DistributedConfig(**base, shard_eval=True,
+                               results_dir=str(tmp_path / "rs"),
+                               images_dir=str(tmp_path / "is"))
+    _, h_rep = distributed.main(cfg_rep, num_devices=8, datasets=tiny_datasets)
+    _, h_sh = distributed.main(cfg_sh, num_devices=8, datasets=tiny_datasets)
+    np.testing.assert_allclose(h_rep.test_losses, h_sh.test_losses, rtol=1e-4)
+
+
+def test_indivisible_batch_raises(tiny_datasets, devices8):
+    with pytest.raises(ValueError):
+        distributed.main(DistributedConfig(global_batch_size=60), num_devices=8,
+                         datasets=tiny_datasets)
+
+
+def test_smoke_ring(capsys, devices8):
+    assert smoke.main(num_devices=8)
+    out = capsys.readouterr().out
+    assert "Device 1 has data 0.0" in out
+    assert "OK — rendezvous + ring p2p verified" in out

@@ -1,0 +1,170 @@
+"""Train-step tests (SURVEY.md §4): SGD-momentum vs the torch update-rule oracle, gradient
+parity vs finite differences, scan-epoch == stepwise equivalence, eval semantics, checkpoint
+roundtrip/resume."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu import ops
+from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
+from csed_514_project_distributed_training_using_pytorch_tpu.ops.optim import (
+    sgd_init, sgd_update,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+    create_train_state, make_epoch_fn, make_eval_fn, make_train_step,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import checkpoint
+
+
+@pytest.fixture(scope="module")
+def model_state():
+    model = Net()
+    state = create_train_state(model, jax.random.PRNGKey(0))
+    return model, state
+
+
+@pytest.fixture(scope="module")
+def batch():
+    k = jax.random.PRNGKey(42)
+    x = jax.random.normal(k, (16, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(43), (16,), 0, 10)
+    return x, y
+
+
+def test_sgd_matches_torch_update_rule():
+    """v <- mu*v + g ; p <- p - lr*v, iterated — the torch.optim.SGD semantics
+    (reference src/train.py:60-61)."""
+    p = {"w": jnp.asarray([1.0, -2.0])}
+    v = sgd_init(p)
+    lr, mu = 0.1, 0.5
+    g_seq = [jnp.asarray([0.5, 1.0]), jnp.asarray([-1.0, 0.25])]
+    pn, vn = np.asarray([1.0, -2.0]), np.zeros(2)
+    for g in g_seq:
+        p, v = sgd_update(p, v, {"w": g}, learning_rate=lr, momentum=mu)
+        vn = mu * vn + np.asarray(g)
+        pn = pn - lr * vn
+    np.testing.assert_allclose(np.asarray(p["w"]), pn, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(v["w"]), vn, rtol=1e-6)
+
+
+def test_gradients_match_finite_differences(model_state, batch):
+    """jax.value_and_grad (the autograd-engine analog, reference src/train.py:75) against
+    central finite differences on a few coordinates of fc2."""
+    model, state = model_state
+    x, y = batch
+
+    def loss_at(params):
+        log_probs = model.apply({"params": params}, x)  # deterministic: no dropout noise
+        return float(ops.nll_loss(log_probs, y))
+
+    grads = jax.grad(lambda p: ops.nll_loss(model.apply({"params": p}, x), y))(state.params)
+    eps = 1e-3
+    for (i, j) in [(0, 0), (17, 5), (49, 9)]:
+        params_hi = jax.tree_util.tree_map(lambda a: a, state.params)
+        params_hi["fc2_kernel"] = state.params["fc2_kernel"].at[i, j].add(eps)
+        params_lo = jax.tree_util.tree_map(lambda a: a, state.params)
+        params_lo["fc2_kernel"] = state.params["fc2_kernel"].at[i, j].add(-eps)
+        fd = (loss_at(params_hi) - loss_at(params_lo)) / (2 * eps)
+        ad = float(grads["fc2_kernel"][i, j])
+        np.testing.assert_allclose(ad, fd, rtol=5e-2, atol=1e-4)
+
+
+def test_train_step_decreases_loss(model_state, batch):
+    model, state = model_state
+    x, y = batch
+    step = jax.jit(make_train_step(model, learning_rate=0.05, momentum=0.5))
+    rng = jax.random.PRNGKey(7)
+    losses = []
+    for _ in range(30):
+        state, loss = step(state, x, y, rng)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.3, losses[:3] + losses[-3:]
+
+
+def test_epoch_scan_equals_stepwise(model_state):
+    """The scanned epoch (make_epoch_fn) must produce bitwise-identical state/losses to
+    applying the jitted step sequentially — the fast path changes scheduling, not math."""
+    model, _ = model_state
+    state_a = create_train_state(model, jax.random.PRNGKey(1))
+    state_b = create_train_state(model, jax.random.PRNGKey(1))
+    images = jax.random.normal(jax.random.PRNGKey(2), (32, 28, 28, 1))
+    labels = jax.random.randint(jax.random.PRNGKey(3), (32,), 0, 10)
+    idx = jnp.arange(32).reshape(4, 8)
+    rng = jax.random.PRNGKey(9)
+
+    epoch_fn = jax.jit(make_epoch_fn(model, learning_rate=0.01, momentum=0.5))
+    state_a, losses_a = epoch_fn(state_a, images, labels, idx, rng)
+
+    step = jax.jit(make_train_step(model, learning_rate=0.01, momentum=0.5))
+    losses_b = []
+    for row in idx:
+        state_b, loss = step(state_b, images[row], labels[row], rng)
+        losses_b.append(loss)
+
+    np.testing.assert_allclose(np.asarray(losses_a), np.asarray(losses_b), rtol=1e-6)
+    for leaf_a, leaf_b in zip(jax.tree_util.tree_leaves(state_a.params),
+                              jax.tree_util.tree_leaves(state_b.params)):
+        # scan vs unrolled can fuse differently; tolerance covers one-ulp drift
+        np.testing.assert_allclose(np.asarray(leaf_a), np.asarray(leaf_b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_eval_fn_semantics(model_state):
+    """evaluate == (summed NLL, argmax correct) over the split, computed batch-at-a-time
+    (reference src/train.py:87-104 with batch_size_test=1000 ⇒ here 4 batches of 5)."""
+    model, state = model_state
+    x = jax.random.normal(jax.random.PRNGKey(11), (20, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(12), (20,), 0, 10)
+    sum_nll, correct = make_eval_fn(model, batch_size=5)(state.params, x, y)
+
+    log_probs = model.apply({"params": state.params}, x)
+    want_nll = float(ops.nll_loss(log_probs, y, reduction="sum"))
+    want_correct = int(np.sum(np.argmax(np.asarray(log_probs), -1) == np.asarray(y)))
+    np.testing.assert_allclose(float(sum_nll), want_nll, rtol=1e-5)
+    assert int(correct) == want_correct
+
+
+def test_step_rng_varies_per_step(model_state, batch):
+    """Dropout keys are folded with the global step: two consecutive steps from the same base
+    rng must not reuse masks (SURVEY.md §7 hard part (b)) — detectable via different losses on
+    the same batch with frozen params (lr=0)."""
+    model, state = model_state
+    x, y = batch
+    step = jax.jit(make_train_step(model, learning_rate=0.0, momentum=0.0))
+    rng = jax.random.PRNGKey(21)
+    state, loss1 = step(state, x, y, rng)
+    state, loss2 = step(state, x, y, rng)  # params unchanged (lr=0); only step index moved
+    assert float(loss1) != float(loss2)
+
+
+def test_checkpoint_roundtrip(tmp_path, model_state, batch):
+    model, state = model_state
+    x, y = batch
+    step = jax.jit(make_train_step(model, learning_rate=0.01, momentum=0.5))
+    state, _ = step(state, x, y, jax.random.PRNGKey(0))
+    path = str(tmp_path / "ckpt.msgpack")
+    checkpoint.save_train_state(path, state)
+
+    fresh = create_train_state(model, jax.random.PRNGKey(99))
+    restored = checkpoint.restore_train_state(path, fresh)
+    assert int(restored.step) == int(state.step)
+    for a, b in zip(jax.tree_util.tree_leaves(restored.params),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # resumed training continues identically to uninterrupted training
+    cont_a, _ = step(state, x, y, jax.random.PRNGKey(5))
+    cont_b, _ = step(restored, x, y, jax.random.PRNGKey(5))
+    np.testing.assert_allclose(np.asarray(cont_a.params["fc2_bias"]),
+                               np.asarray(cont_b.params["fc2_bias"]), rtol=1e-7)
+
+
+def test_params_export_roundtrip(tmp_path, model_state):
+    model, state = model_state
+    path = str(tmp_path / "model.msgpack")
+    checkpoint.save_params(path, state.params)
+    loaded = checkpoint.load_params(path, jax.device_get(state.params))
+    np.testing.assert_array_equal(np.asarray(loaded["conv1_bias"]),
+                                  np.asarray(state.params["conv1_bias"]))
