@@ -47,6 +47,10 @@ MOMENTUM = 0.5               # reference src/train.py:16
 def run() -> dict:
     mesh = make_mesh()
     world = mesh.shape["data"]
+    if GLOBAL_BATCH % world:
+        raise ValueError(f"global batch {GLOBAL_BATCH} not divisible by device count "
+                         f"{world} — the reported protocol would be wrong (same check as "
+                         f"train.distributed.main)")
     train_ds, test_ds = load_mnist("files")
 
     model = Net()

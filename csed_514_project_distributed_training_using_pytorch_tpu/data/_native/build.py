@@ -1,0 +1,50 @@
+"""Build the native loader shared library (g++, no pybind11 — plain C ABI for ctypes).
+
+Invoked lazily on first import of ``data.native`` and cached by source mtime; also runnable
+directly: ``python -m csed_514_project_distributed_training_using_pytorch_tpu.data._native.build``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SOURCE = os.path.join(_DIR, "loader.cc")
+LIBRARY = os.path.join(_DIR, "libnativeloader.so")
+
+
+def build(force: bool = False, quiet: bool = True) -> str | None:
+    """Compile loader.cc → libnativeloader.so if stale/missing. Returns the library path, or
+    None when the toolchain is unavailable or compilation fails (callers fall back to numpy).
+    """
+    if (not force and os.path.exists(LIBRARY)
+            and os.path.getmtime(LIBRARY) >= os.path.getmtime(SOURCE)):
+        return LIBRARY
+    # Compile to a per-process temp path, then atomically os.replace into place: every
+    # process runs this same module (the framework's launch contract), so concurrent
+    # builders must never interleave writes into the .so another process may be dlopening.
+    tmp = f"{LIBRARY}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           SOURCE, "-o", tmp, "-lz"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            if not quiet:
+                raise RuntimeError(f"native loader build failed:\n{proc.stderr}")
+            return None
+        os.replace(tmp, LIBRARY)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return LIBRARY
+
+
+if __name__ == "__main__":
+    path = build(force=True, quiet=False)
+    print(f"built {path}")

@@ -58,12 +58,31 @@ class BatchLoader:
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        from csed_514_project_distributed_training_using_pytorch_tpu.data import native
+        gather = native.gather if native.available() else (
+            lambda imgs, labs, idx: (imgs[idx], labs[idx]))
         indices = self.sampler.epoch_indices(self._epoch)
         n = len(indices)
         end = n - n % self.batch_size if self.drop_last else n
         for start in range(0, end, self.batch_size):
             idx = indices[start:start + self.batch_size]
-            yield self.dataset.images[idx], self.dataset.labels[idx]
+            yield gather(self.dataset.images, self.dataset.labels, idx)
+
+    def prefetch_iter(self, epoch: int | None = None,
+                      num_workers: int = 4) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """Iterate full batches through the native threaded prefetcher (the
+        ``num_workers=4`` DataLoader worker pool analog, reference
+        ``src/train_dist.py:43-45``); falls back to the plain ``__iter__`` gather when the
+        native library isn't built. Full batches only (the plan is rectangular)."""
+        from csed_514_project_distributed_training_using_pytorch_tpu.data import native
+        plan = self.epoch_index_matrix(epoch)
+        if not native.available():
+            for row in plan:
+                yield self.dataset.images[row], self.dataset.labels[row]
+            return
+        with native.Prefetcher(self.dataset.images, self.dataset.labels, plan,
+                               num_workers=num_workers) as pf:
+            yield from pf
 
     def epoch_index_matrix(self, epoch: int | None = None,
                            steps_multiple: int = 1) -> np.ndarray:

@@ -138,10 +138,15 @@ def load_mnist(data_dir: str = "files", *, synthetic_seed: int = 514,
     """
     paths = {k: _find_idx_file(data_dir, stem) for k, stem in _IDX_FILES.items()}
     if all(paths.values()):
-        train_x = _read_idx(paths["train_images"])
-        train_y = _read_idx(paths["train_labels"]).astype(np.int64)
-        test_x = _read_idx(paths["test_images"])
-        test_y = _read_idx(paths["test_labels"]).astype(np.int64)
+        # Prefer the native (C++) IDX reader — the first-party analog of torchvision's
+        # C++-backed cache read (see data/native.py); the numpy parser is the bit-exact
+        # fallback when the library isn't built.
+        from csed_514_project_distributed_training_using_pytorch_tpu.data import native
+        read = native.load_idx if native.available() else _read_idx
+        train_x = read(paths["train_images"])
+        train_y = read(paths["train_labels"]).astype(np.int64)
+        test_x = read(paths["test_images"])
+        test_y = read(paths["test_labels"]).astype(np.int64)
         source = "idx"
     elif allow_synthetic:
         train_x, train_y = _synthesize_split(60_000, synthetic_seed)

@@ -99,9 +99,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     def train_epoch(state: TrainState, epoch: int) -> TrainState:
         train_loader.set_epoch(epoch)
         indices = train_loader.sampler.epoch_indices(epoch)
-        full_steps = len(indices) // config.batch_size_train
-        idx_full = indices[:full_steps * config.batch_size_train].reshape(
-            full_steps, config.batch_size_train)
+        idx_full = train_loader.epoch_index_matrix(epoch)
+        full_steps = idx_full.shape[0]
 
         # log_interval-sized jit'd scan segments, then the ragged tail.
         li = config.log_interval

@@ -112,6 +112,10 @@ def make_eval_fn(model, *, batch_size: int = 1000) -> Callable:
 
     def evaluate(params, images, labels):
         n = images.shape[0]
+        if n % batch_size:
+            raise ValueError(f"eval split size {n} not divisible by eval batch "
+                             f"{batch_size} — the tail would be silently dropped while "
+                             f"callers divide by the full split size")
         num_batches = n // batch_size
         xs = images[:num_batches * batch_size].reshape(
             (num_batches, batch_size) + images.shape[1:])

@@ -1,0 +1,151 @@
+"""Native (C++) data-loader runtime: bit-exact parity with the pure-numpy paths.
+
+The native library (``data/_native/loader.cc`` via ``data/native.py``) re-creates the C++
+substrate the reference's input path leans on (torchvision cache reader + DataLoader worker
+pool, reference ``src/train.py:26-31``, ``src/train_dist.py:43-45``). These tests assert that
+every native entry point produces exactly what the numpy fallback produces, so the two paths
+are interchangeable.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.data import (
+    BatchLoader, load_mnist, mnist, native,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native loader library not built (no toolchain)")
+
+
+@pytest.fixture(scope="module")
+def imgs_u8():
+    return np.random.default_rng(7).integers(0, 256, size=(64, 28, 28), dtype=np.uint8)
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    train, _ = load_mnist("/nonexistent-data-dir", synthetic_seed=99)
+    return train
+
+
+def _write_idx(path: str, arr: np.ndarray, gz: bool = False) -> str:
+    header = struct.pack(">I", 0x0800 | arr.ndim) + struct.pack(
+        f">{arr.ndim}I", *arr.shape)
+    opener = gzip.open if gz else open
+    with opener(path, "wb") as f:
+        f.write(header + arr.tobytes())
+    return path
+
+
+class TestIdxParsing:
+    def test_images_plain_and_gz(self, tmp_path, imgs_u8):
+        plain = _write_idx(str(tmp_path / "imgs"), imgs_u8)
+        gzed = _write_idx(str(tmp_path / "imgs.gz"), imgs_u8, gz=True)
+        np.testing.assert_array_equal(native.load_idx(plain), imgs_u8)
+        np.testing.assert_array_equal(native.load_idx(gzed), imgs_u8)
+        np.testing.assert_array_equal(native.load_idx(plain), mnist._read_idx(plain))
+
+    def test_labels_1d(self, tmp_path):
+        labels = np.arange(100, dtype=np.uint8) % 10
+        path = _write_idx(str(tmp_path / "labels"), labels)
+        np.testing.assert_array_equal(native.load_idx(path), labels)
+
+    def test_missing_file_raises(self, tmp_path):
+        with pytest.raises(ValueError):
+            native.load_idx(str(tmp_path / "nope"))
+
+    def test_bad_magic_raises(self, tmp_path):
+        path = tmp_path / "bad"
+        path.write_bytes(b"\x00\x00\x07\x03" + b"\x00" * 32)
+        with pytest.raises(ValueError):
+            native.load_idx(str(path))
+
+
+class TestNormalize:
+    def test_bit_exact_vs_numpy(self, imgs_u8):
+        got = native.normalize(imgs_u8, mnist.MNIST_MEAN, mnist.MNIST_STD)
+        want = mnist._normalize(imgs_u8)
+        assert got.shape == want.shape == (64, 28, 28, 1)
+        assert got.dtype == np.float32
+        np.testing.assert_array_equal(got, want)
+
+    def test_multithreaded_matches_single(self, imgs_u8):
+        a = native.normalize(imgs_u8, mnist.MNIST_MEAN, mnist.MNIST_STD, num_threads=1)
+        b = native.normalize(imgs_u8, mnist.MNIST_MEAN, mnist.MNIST_STD, num_threads=8)
+        np.testing.assert_array_equal(a, b)
+
+
+class TestGather:
+    def test_matches_fancy_index(self, dataset):
+        idx = np.random.default_rng(3).permutation(len(dataset))[:128].astype(np.int32)
+        gi, gl = native.gather(dataset.images, dataset.labels, idx)
+        np.testing.assert_array_equal(gi, dataset.images[idx])
+        np.testing.assert_array_equal(gl, dataset.labels[idx])
+
+    def test_out_of_range_raises(self, dataset):
+        with pytest.raises(IndexError):
+            native.gather(dataset.images, dataset.labels,
+                          np.array([0, len(dataset)], dtype=np.int32))
+
+
+class TestPrefetcher:
+    def test_order_and_content(self, dataset):
+        rng = np.random.default_rng(11)
+        plan = rng.integers(0, len(dataset), size=(23, 32)).astype(np.int32)
+        with native.Prefetcher(dataset.images, dataset.labels, plan,
+                               num_workers=3, capacity=4) as pf:
+            steps = 0
+            for s, (bi, bl) in enumerate(pf):
+                np.testing.assert_array_equal(bi, dataset.images[plan[s]])
+                np.testing.assert_array_equal(bl, dataset.labels[plan[s]])
+                steps += 1
+        assert steps == 23
+
+    def test_capacity_smaller_than_steps(self, dataset):
+        plan = np.arange(40 * 8, dtype=np.int32).reshape(40, 8)
+        with native.Prefetcher(dataset.images, dataset.labels, plan,
+                               num_workers=2, capacity=2) as pf:
+            got = [bl.copy() for _, bl in pf]
+        assert len(got) == 40
+        for s, bl in enumerate(got):
+            np.testing.assert_array_equal(bl, dataset.labels[plan[s]])
+
+    def test_early_close_does_not_hang(self, dataset):
+        plan = np.arange(100 * 16, dtype=np.int32).reshape(100, 16) % len(dataset)
+        pf = native.Prefetcher(dataset.images, dataset.labels, plan,
+                               num_workers=4, capacity=2)
+        next(iter(pf))
+        pf.close()  # workers blocked on a full ring must exit cleanly
+
+    def test_bad_plan_index_reported(self, dataset):
+        plan = np.full((3, 4), len(dataset), dtype=np.int32)  # every index out of range
+        with native.Prefetcher(dataset.images, dataset.labels, plan) as pf:
+            with pytest.raises(IndexError):
+                list(pf)
+
+
+class TestBatchLoaderIntegration:
+    def test_iter_uses_native_and_matches_numpy(self, dataset):
+        loader = BatchLoader(dataset, 64, shuffle=True, seed=5)
+        loader.set_epoch(2)
+        indices = loader.sampler.epoch_indices(2)
+        for i, (bi, bl) in enumerate(loader):
+            idx = indices[i * 64:(i + 1) * 64]
+            np.testing.assert_array_equal(bi, dataset.images[idx])
+            np.testing.assert_array_equal(bl, dataset.labels[idx])
+            if i >= 3:
+                break
+
+    def test_prefetch_iter_matches_index_matrix(self, dataset):
+        loader = BatchLoader(dataset, 128, shuffle=True, seed=6)
+        loader.set_epoch(1)
+        plan = loader.epoch_index_matrix(1)
+        for s, (bi, bl) in enumerate(loader.prefetch_iter(1)):
+            np.testing.assert_array_equal(bi, dataset.images[plan[s]])
+            np.testing.assert_array_equal(bl, dataset.labels[plan[s]])
+        assert s == plan.shape[0] - 1
