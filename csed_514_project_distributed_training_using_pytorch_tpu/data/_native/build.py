@@ -18,9 +18,12 @@ def build(force: bool = False, quiet: bool = True) -> str | None:
     """Compile loader.cc → libnativeloader.so if stale/missing. Returns the library path, or
     None when the toolchain is unavailable or compilation fails (callers fall back to numpy).
     """
-    if (not force and os.path.exists(LIBRARY)
-            and os.path.getmtime(LIBRARY) >= os.path.getmtime(SOURCE)):
-        return LIBRARY
+    if not force and os.path.exists(LIBRARY):
+        try:
+            if os.path.getmtime(LIBRARY) >= os.path.getmtime(SOURCE):
+                return LIBRARY
+        except OSError:
+            return LIBRARY  # source missing (e.g. binary-only install): use the built .so
     # Compile to a per-process temp path, then atomically os.replace into place: every
     # process runs this same module (the framework's launch contract), so concurrent
     # builders must never interleave writes into the .so another process may be dlopening.

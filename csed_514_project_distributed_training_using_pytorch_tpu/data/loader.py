@@ -59,8 +59,15 @@ class BatchLoader:
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         from csed_514_project_distributed_training_using_pytorch_tpu.data import native
-        gather = native.gather if native.available() else (
-            lambda imgs, labs, idx: (imgs[idx], labs[idx]))
+        if native.available():
+            # Threads only pay off once a batch is memcpy-heavy; below that the native
+            # call runs single-threaded inline (no per-batch thread spawn/join).
+            sample_bytes = int(np.prod(self.dataset.images.shape[1:])) * 4
+            threads = 4 if self.batch_size * sample_bytes >= (4 << 20) else 1
+            gather = lambda imgs, labs, idx: native.gather(imgs, labs, idx,
+                                                           num_threads=threads)
+        else:
+            gather = lambda imgs, labs, idx: (imgs[idx], labs[idx])
         indices = self.sampler.epoch_indices(self._epoch)
         n = len(indices)
         end = n - n % self.batch_size if self.drop_last else n
@@ -84,16 +91,18 @@ class BatchLoader:
                                num_workers=num_workers) as pf:
             yield from pf
 
-    def epoch_index_matrix(self, epoch: int | None = None,
-                           steps_multiple: int = 1) -> np.ndarray:
+    def epoch_index_matrix(self, epoch: int | None = None, steps_multiple: int = 1,
+                           allow_empty: bool = False) -> np.ndarray:
         """This epoch's order as a ``[num_steps, batch_size]`` index matrix for the
         device-resident fast path (``lax.scan`` over gathered batches): full batches only,
         optionally truncated to a multiple of ``steps_multiple`` (e.g. ``log_interval``).
-        ``epoch=None`` uses the ``set_epoch`` value."""
+        ``epoch=None`` uses the ``set_epoch`` value. With zero full batch groups, raises —
+        or returns a ``[0, batch_size]`` matrix when ``allow_empty`` (callers that train the
+        ragged tail separately, e.g. the single-process trainer's drop_last=False path)."""
         indices = self.sampler.epoch_indices(self._epoch if epoch is None else epoch)
         steps = len(indices) // self.batch_size
         steps -= steps % steps_multiple
-        if steps == 0:
+        if steps == 0 and not allow_empty:
             raise ValueError(
                 f"no full batch groups: {len(indices)} samples, batch {self.batch_size}, "
                 f"steps_multiple {steps_multiple} — lower batch_size or steps_multiple")

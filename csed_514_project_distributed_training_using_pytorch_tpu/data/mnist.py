@@ -156,6 +156,11 @@ def load_mnist(data_dir: str = "files", *, synthetic_seed: int = 514,
         raise FileNotFoundError(
             f"no MNIST IDX files under {data_dir!r} and synthetic fallback disabled")
 
-    train = Dataset(_normalize(train_x), train_y.astype(np.int32), source)
-    test = Dataset(_normalize(test_x), test_y.astype(np.int32), source)
+    from csed_514_project_distributed_training_using_pytorch_tpu.data import native
+    if native.available():
+        norm = lambda x: native.normalize(x, MNIST_MEAN, MNIST_STD)
+    else:
+        norm = _normalize
+    train = Dataset(norm(train_x), train_y.astype(np.int32), source)
+    test = Dataset(norm(test_x), test_y.astype(np.int32), source)
     return train, test

@@ -176,6 +176,8 @@ class Prefetcher:
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         while True:
+            if self._handle is None:
+                raise ValueError("Prefetcher is closed")
             out_i = np.empty((self.batch,) + self._sample_shape, dtype=np.float32)
             out_l = np.empty(self.batch, dtype=np.int32)
             step = self._lib.nl_prefetcher_next(

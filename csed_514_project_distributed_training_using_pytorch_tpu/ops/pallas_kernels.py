@@ -1,0 +1,199 @@
+"""Pallas TPU kernels for the framework's fusible hot spots.
+
+The reference executes its loss and optimizer as separate ATen CPU kernels chained by the
+autograd engine (``F.log_softmax`` reference ``src/model.py:22`` → ``F.nll_loss``
+``src/train.py:74`` → ``optimizer.step()`` ``src/train.py:76``). On TPU, XLA already fuses
+most of this; these Pallas kernels make the two memory-bound fusions explicit, first-party
+native code — the kernel-level counterpart of the reference's C++ compute substrate
+(SURVEY.md §2b):
+
+- ``nll_from_logits``: log-softmax + negative-log-likelihood in ONE VMEM pass over the
+  logits (one read, no materialized ``[B, C]`` log-probability intermediate in HBM), with a
+  custom VJP whose backward pass is a second single-pass kernel emitting
+  ``(softmax - onehot) * upstream`` directly.
+- ``sgd_momentum_step``: the fused SGD-with-momentum update ``v ← μv + g; p ← p − λv`` over a
+  flattened parameter leaf — reads (p, v, g) once, writes (p, v) once; HBM-bandwidth optimal
+  for the elementwise optimizer the reference applies per-tensor
+  (``torch.optim.SGD``, reference ``src/train.py:60-61``).
+
+Both kernels run compiled on TPU and in Pallas interpret mode elsewhere (CPU tests), chosen
+automatically. Numerics match the ``ops.nn`` / ``ops.optim`` reference implementations to
+float32 round-off (asserted by tests/test_pallas.py); the train step uses them when
+``use_pallas_kernels`` is enabled in config.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128          # TPU lane width: last-dim tile granularity
+BATCH_BLOCK = 256   # rows per grid step for the loss kernels
+
+
+def _interpret() -> bool:
+    """Compiled on TPU; interpret mode on CPU/GPU (the test platforms)."""
+    return jax.default_backend() != "tpu"
+
+
+def _pad_to(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
+# =========================================================================================
+# Fused log-softmax + NLL loss
+# =========================================================================================
+
+
+def _nll_fwd_kernel(logits_ref, labels_ref, nll_ref):
+    """One [bb, C] block: per-row -log_softmax(logits)[label].
+
+    Padded class columns hold -1e30 → exp underflows to 0, so they contribute nothing to
+    the log-sum-exp; padded batch rows produce garbage that the wrapper slices off.
+    """
+    x = logits_ref[:]                                       # [bb, C] f32
+    lab = labels_ref[:]                                     # [bb, 1] i32
+    m = jnp.max(x, axis=1, keepdims=True)
+    s = x - m
+    lse = jnp.log(jnp.sum(jnp.exp(s), axis=1, keepdims=True))
+    classes = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    picked = jnp.sum(jnp.where(classes == lab, s - lse, 0.0), axis=1, keepdims=True)
+    nll_ref[:] = -picked                                    # [bb, 1]
+
+
+def _nll_bwd_kernel(logits_ref, labels_ref, ct_ref, dlogits_ref):
+    """One [bb, C] block of d/dlogits: (softmax(logits) - onehot(label)) * ct_row."""
+    x = logits_ref[:]
+    lab = labels_ref[:]
+    ct = ct_ref[:]                                          # [bb, 1] f32
+    m = jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x - m)
+    softmax = e / jnp.sum(e, axis=1, keepdims=True)
+    classes = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    onehot = jnp.where(classes == lab, 1.0, 0.0)
+    dlogits_ref[:] = (softmax - onehot) * ct
+
+
+def _padded_call(kernel, extra_inputs, logits, labels, out_cols):
+    """Pad [B, C] to tile-aligned shape, run `kernel` over a batch grid, unpad."""
+    b, c = logits.shape
+    bp, cp = _pad_to(b, BATCH_BLOCK), _pad_to(c, LANE)
+    logits_p = jnp.full((bp, cp), -1e30, jnp.float32).at[:b, :c].set(
+        logits.astype(jnp.float32))
+    labels_p = jnp.zeros((bp, 1), jnp.int32).at[:b, 0].set(labels.astype(jnp.int32))
+    extras_p = [jnp.zeros((bp, 1), jnp.float32).at[:b, :].set(e) for e in extra_inputs]
+
+    grid = (bp // BATCH_BLOCK,)
+    row_block = lambda width: pl.BlockSpec((BATCH_BLOCK, width), lambda i: (i, 0),
+                                           memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[row_block(cp), row_block(1)] + [row_block(1)] * len(extras_p),
+        out_specs=row_block(out_cols),
+        out_shape=jax.ShapeDtypeStruct((bp, out_cols), jnp.float32),
+        interpret=_interpret(),
+    )(logits_p, labels_p, *extras_p)
+    return out[:b]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def nll_from_logits(logits: jax.Array, labels: jax.Array,
+                    reduction: str = "mean") -> jax.Array:
+    """Fused ``nll_loss(log_softmax(logits), labels)`` as one Pallas kernel pass.
+
+    Drop-in for the composition of ``ops.log_softmax`` + ``ops.nll_loss`` (the reference's
+    two objectives — ``src/train.py:74`` and, by log-softmax idempotence, the distributed
+    CrossEntropyLoss path ``src/train_dist.py:67`` — see ``ops.cross_entropy_loss``).
+    Differentiable via a custom VJP with a fused backward kernel.
+    """
+    return _nll_reduce(_padded_call(_nll_fwd_kernel, [], logits, labels, 1)[:, 0],
+                       reduction)
+
+
+def _nll_reduce(per_example: jax.Array, reduction: str) -> jax.Array:
+    if reduction == "mean":
+        return jnp.mean(per_example)
+    if reduction == "sum":
+        return jnp.sum(per_example)
+    if reduction == "none":
+        return per_example
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def _nll_fwd(logits, labels, reduction):
+    per_example = _padded_call(_nll_fwd_kernel, [], logits, labels, 1)[:, 0]
+    return _nll_reduce(per_example, reduction), (logits, labels)
+
+
+def _nll_bwd(reduction, residuals, ct):
+    logits, labels = residuals
+    b = logits.shape[0]
+    if reduction == "mean":
+        ct_rows = jnp.full((b, 1), 1.0 / b, jnp.float32) * ct
+    elif reduction == "sum":
+        ct_rows = jnp.full((b, 1), 1.0, jnp.float32) * ct
+    else:  # none: ct is per-example
+        ct_rows = ct.astype(jnp.float32)[:, None]
+    dlogits = _padded_call(_nll_bwd_kernel, [ct_rows], logits, labels,
+                           _pad_to(logits.shape[1], LANE))[:, :logits.shape[1]]
+    return dlogits.astype(logits.dtype), None
+
+
+nll_from_logits.defvjp(_nll_fwd, _nll_bwd)
+
+
+# =========================================================================================
+# Fused SGD-momentum update
+# =========================================================================================
+
+
+def _sgd_kernel(momentum: float, learning_rate: float, p_ref, v_ref, g_ref,
+                new_p_ref, new_v_ref):
+    v = momentum * v_ref[:] + g_ref[:]
+    new_v_ref[:] = v
+    new_p_ref[:] = p_ref[:] - learning_rate * v
+
+
+def _sgd_leaf(p: jax.Array, v: jax.Array, g: jax.Array, *, learning_rate: float,
+              momentum: float) -> tuple[jax.Array, jax.Array]:
+    """Fused update for one parameter leaf: flatten → [rows, LANE] tiles → kernel → unflatten."""
+    shape, dtype, n = p.shape, p.dtype, p.size
+    rows = _pad_to(max(n, 1), LANE * 8) // LANE      # sublane-aligned row count
+
+    def tile(a):
+        flat = jnp.zeros(rows * LANE, jnp.float32).at[:n].set(
+            a.astype(jnp.float32).reshape(-1))
+        return flat.reshape(rows, LANE)
+
+    kernel = functools.partial(_sgd_kernel, momentum, learning_rate)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    new_p, new_v = pl.pallas_call(
+        kernel,
+        in_specs=[vmem, vmem, vmem],
+        out_specs=[vmem, vmem],
+        out_shape=[jax.ShapeDtypeStruct((rows, LANE), jnp.float32)] * 2,
+        interpret=_interpret(),
+    )(tile(p), tile(v), tile(g))
+    unflatten = lambda a: a.reshape(-1)[:n].reshape(shape).astype(dtype)
+    return unflatten(new_p), unflatten(new_v)
+
+
+def sgd_momentum_step(params, velocity, grads, *, learning_rate: float, momentum: float):
+    """Pytree-wide fused SGD-momentum step — the Pallas counterpart of
+    ``ops.optim.sgd_update`` (torch-SGD semantics, reference ``src/train.py:60-61``).
+
+    Returns ``(new_params, new_velocity)``.
+    """
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_v = treedef.flatten_up_to(velocity)
+    flat_g = treedef.flatten_up_to(grads)
+    out = [_sgd_leaf(p, v, g, learning_rate=learning_rate, momentum=momentum)
+           for p, v, g in zip(flat_p, flat_v, flat_g)]
+    new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in out])
+    new_v = jax.tree_util.tree_unflatten(treedef, [o[1] for o in out])
+    return new_p, new_v

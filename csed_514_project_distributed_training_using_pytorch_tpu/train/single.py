@@ -78,11 +78,13 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
 
     segment_fn = jax.jit(
         make_epoch_fn(model, learning_rate=config.learning_rate,
-                      momentum=config.momentum),
+                      momentum=config.momentum,
+                      use_pallas=config.use_pallas_kernels),
         donate_argnums=(0,))
     step_fn = jax.jit(
         make_train_step(model, learning_rate=config.learning_rate,
-                        momentum=config.momentum),
+                        momentum=config.momentum,
+                        use_pallas=config.use_pallas_kernels),
         donate_argnums=(0,))
     eval_fn = jax.jit(make_eval_fn(model, batch_size=config.batch_size_test))
 
@@ -99,7 +101,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     def train_epoch(state: TrainState, epoch: int) -> TrainState:
         train_loader.set_epoch(epoch)
         indices = train_loader.sampler.epoch_indices(epoch)
-        idx_full = train_loader.epoch_index_matrix(epoch)
+        idx_full = train_loader.epoch_index_matrix(epoch, allow_empty=True)
         full_steps = idx_full.shape[0]
 
         # log_interval-sized jit'd scan segments, then the ragged tail.

@@ -56,31 +56,50 @@ def create_train_state(model, rng: jax.Array,
                       step=jnp.zeros((), jnp.int32))
 
 
-def make_train_step(model, *, learning_rate: float, momentum: float) -> Callable:
+def make_train_step(model, *, learning_rate: float, momentum: float,
+                    use_pallas: bool = False) -> Callable:
     """Build ``step(state, images, labels, rng) -> (state, loss)``.
 
     The loss is the canonical ``nll(log_probs)`` formulation (see
     ``ops.cross_entropy_loss`` for why this also covers the reference's distributed
     CrossEntropyLoss objective). Wrap in ``jax.jit`` (or compile over a mesh via
     ``parallel.data_parallel.compile_step``) before use.
+
+    ``use_pallas=True`` swaps in the fused Pallas loss and optimizer kernels
+    (``ops/pallas_kernels.py``) — numerically equivalent to float32 round-off; intended for
+    the single-device step path (a Pallas call is an opaque unit to the GSPMD partitioner,
+    so the multi-mesh ``compile_epoch`` path keeps the XLA-fused default).
     """
+    if use_pallas:
+        from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
+            pallas_kernels as pk,
+        )
 
     def loss_fn(params, images, labels, rng):
         log_probs = model.apply({"params": params}, images,
                                 deterministic=False, rngs={"dropout": rng})
+        if use_pallas:
+            # log_softmax is idempotent: fused nll-from-logits on log-probs is identical.
+            return pk.nll_from_logits(log_probs, labels)
         return ops.nll_loss(log_probs, labels)
 
     def step(state: TrainState, images, labels, rng) -> tuple[TrainState, jax.Array]:
         step_rng = jax.random.fold_in(rng, state.step)
         loss, grads = jax.value_and_grad(loss_fn)(state.params, images, labels, step_rng)
-        params, velocity = sgd_update(state.params, state.velocity, grads,
-                                      learning_rate=learning_rate, momentum=momentum)
+        if use_pallas:
+            params, velocity = pk.sgd_momentum_step(
+                state.params, state.velocity, grads,
+                learning_rate=learning_rate, momentum=momentum)
+        else:
+            params, velocity = sgd_update(state.params, state.velocity, grads,
+                                          learning_rate=learning_rate, momentum=momentum)
         return TrainState(params, velocity, state.step + 1), loss
 
     return step
 
 
-def make_epoch_fn(model, *, learning_rate: float, momentum: float) -> Callable:
+def make_epoch_fn(model, *, learning_rate: float, momentum: float,
+                  use_pallas: bool = False) -> Callable:
     """Build ``epoch(state, images, labels, idx_matrix, rng) -> (state, losses)``.
 
     ``images``/``labels`` are the full (device-resident) training split; ``idx_matrix`` is a
@@ -89,7 +108,8 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float) -> Callable:
     per-step losses come back as one ``[num_steps]`` array for logging, replacing the
     reference's per-step ``loss.item()`` host syncs (``src/train_dist.py:85``).
     """
-    train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum)
+    train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum,
+                                 use_pallas=use_pallas)
 
     def epoch(state: TrainState, images, labels, idx_matrix, rng):
         def body(state, idx):

@@ -37,6 +37,8 @@ class SingleProcessConfig:
     profile_dir: str = "results/profile"
     resume_from: str = ""             # checkpoint path to resume from (the restore path the
                                       # reference lacks, SURVEY.md §5 "checkpoint/resume")
+    use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
+                                      # (ops/pallas_kernels.py; single-device step path)
 
 
 @dataclass(frozen=True)

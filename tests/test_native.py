@@ -119,14 +119,28 @@ class TestPrefetcher:
         plan = np.arange(100 * 16, dtype=np.int32).reshape(100, 16) % len(dataset)
         pf = native.Prefetcher(dataset.images, dataset.labels, plan,
                                num_workers=4, capacity=2)
-        next(iter(pf))
+        it = iter(pf)
+        next(it)
         pf.close()  # workers blocked on a full ring must exit cleanly
+        with pytest.raises(ValueError, match="closed"):
+            next(it)  # iterating a closed prefetcher must raise, not segfault
 
     def test_bad_plan_index_reported(self, dataset):
         plan = np.full((3, 4), len(dataset), dtype=np.int32)  # every index out of range
         with native.Prefetcher(dataset.images, dataset.labels, plan) as pf:
             with pytest.raises(IndexError):
                 list(pf)
+
+
+class TestNormalizeInProductPath:
+    def test_load_mnist_uses_native_normalize(self, dataset):
+        """The synthetic load_mnist output must equal the numpy-normalized pipeline — the
+        native normalize wired into load_mnist is bit-exact, so sources are indistinguishable."""
+        imgs_u8 = np.random.default_rng(2).integers(0, 256, (16, 28, 28), dtype=np.uint8)
+        np.testing.assert_array_equal(
+            native.normalize(imgs_u8, mnist.MNIST_MEAN, mnist.MNIST_STD),
+            mnist._normalize(imgs_u8))
+        assert dataset.images.dtype == np.float32
 
 
 class TestBatchLoaderIntegration:
