@@ -33,6 +33,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128          # TPU lane width: last-dim tile granularity
 BATCH_BLOCK = 256   # rows per grid step for the loss kernels
+SGD_ROW_BLOCK = 1024  # rows per grid step for the optimizer kernel (5×512 KiB in VMEM)
 
 
 def _interpret() -> bool:
@@ -161,9 +162,16 @@ def _sgd_kernel(momentum: float, learning_rate: float, p_ref, v_ref, g_ref,
 
 def _sgd_leaf(p: jax.Array, v: jax.Array, g: jax.Array, *, learning_rate: float,
               momentum: float) -> tuple[jax.Array, jax.Array]:
-    """Fused update for one parameter leaf: flatten → [rows, LANE] tiles → kernel → unflatten."""
+    """Fused update for one parameter leaf: flatten → [rows, LANE] tiles → kernel → unflatten.
+
+    Gridded over SGD_ROW_BLOCK-row blocks so VMEM residency stays bounded (5 buffers ×
+    block × LANE × 4 B ≈ 2.5 MiB) regardless of leaf size — an ungridded call would place
+    the whole padded leaf in VMEM and fail to compile for multi-million-param leaves.
+    """
     shape, dtype, n = p.shape, p.dtype, p.size
-    rows = _pad_to(max(n, 1), LANE * 8) // LANE      # sublane-aligned row count
+    rows8 = _pad_to(max(n, 1), LANE * 8) // LANE     # sublane-aligned row count
+    block = min(rows8, SGD_ROW_BLOCK)
+    rows = _pad_to(rows8, block)                     # whole number of grid blocks
 
     def tile(a):
         flat = jnp.zeros(rows * LANE, jnp.float32).at[:n].set(
@@ -171,11 +179,12 @@ def _sgd_leaf(p: jax.Array, v: jax.Array, g: jax.Array, *, learning_rate: float,
         return flat.reshape(rows, LANE)
 
     kernel = functools.partial(_sgd_kernel, momentum, learning_rate)
-    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    row_block = pl.BlockSpec((block, LANE), lambda i: (i, 0), memory_space=pltpu.VMEM)
     new_p, new_v = pl.pallas_call(
         kernel,
-        in_specs=[vmem, vmem, vmem],
-        out_specs=[vmem, vmem],
+        grid=(rows // block,),
+        in_specs=[row_block, row_block, row_block],
+        out_specs=[row_block, row_block],
         out_shape=[jax.ShapeDtypeStruct((rows, LANE), jnp.float32)] * 2,
         interpret=_interpret(),
     )(tile(p), tile(v), tile(g))

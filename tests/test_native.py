@@ -133,14 +133,36 @@ class TestPrefetcher:
 
 
 class TestNormalizeInProductPath:
-    def test_load_mnist_uses_native_normalize(self, dataset):
-        """The synthetic load_mnist output must equal the numpy-normalized pipeline — the
-        native normalize wired into load_mnist is bit-exact, so sources are indistinguishable."""
-        imgs_u8 = np.random.default_rng(2).integers(0, 256, (16, 28, 28), dtype=np.uint8)
-        np.testing.assert_array_equal(
-            native.normalize(imgs_u8, mnist.MNIST_MEAN, mnist.MNIST_STD),
-            mnist._normalize(imgs_u8))
-        assert dataset.images.dtype == np.float32
+    def test_load_mnist_routes_through_native_normalize(self, tmp_path, monkeypatch):
+        """load_mnist must actually call native.normalize when the library is available,
+        and its output must equal the pure-numpy pipeline bit-for-bit. Exercised end to end
+        with real IDX files so both the native IDX read and normalize wiring run."""
+        rng = np.random.default_rng(2)
+        train_x = rng.integers(0, 256, (20, 28, 28), dtype=np.uint8)
+        test_x = rng.integers(0, 256, (8, 28, 28), dtype=np.uint8)
+        train_y = (np.arange(20) % 10).astype(np.uint8)
+        test_y = (np.arange(8) % 10).astype(np.uint8)
+        _write_idx(str(tmp_path / "train-images-idx3-ubyte"), train_x)
+        _write_idx(str(tmp_path / "train-labels-idx1-ubyte"), train_y)
+        _write_idx(str(tmp_path / "t10k-images-idx3-ubyte"), test_x)
+        _write_idx(str(tmp_path / "t10k-labels-idx1-ubyte"), test_y)
+
+        calls = []
+        real_normalize = native.normalize
+
+        def recording_normalize(*args, **kwargs):
+            calls.append(args[0].shape)
+            return real_normalize(*args, **kwargs)
+
+        monkeypatch.setattr(native, "normalize", recording_normalize)
+        train, test = load_mnist(str(tmp_path), allow_synthetic=False)
+
+        assert train.source == "idx"
+        assert calls == [(20, 28, 28), (8, 28, 28)]
+        np.testing.assert_array_equal(train.images, mnist._normalize(train_x))
+        np.testing.assert_array_equal(test.images, mnist._normalize(test_x))
+        np.testing.assert_array_equal(train.labels, train_y.astype(np.int32))
+        np.testing.assert_array_equal(test.labels, test_y.astype(np.int32))
 
 
 class TestBatchLoaderIntegration:
