@@ -8,8 +8,13 @@ seconds. Anything >1 beats the whole reference cluster with this framework.
 
 Protocol: full training epoch (60,000 examples, global batch 64 — reference
 ``src/train.py:12-13`` scale) as one jit-compiled scanned program over the device mesh; one
-warmup epoch to compile and fault in data, then the median of 3 timed epochs around
-``block_until_ready`` (honest async-dispatch timing, SURVEY.md §7 hard part (c)).
+warmup epoch to compile and fault in data, then the median of 3 timed epochs, each closed by
+a host fetch of the epoch's final loss scalar. The fetch — not ``block_until_ready`` — is the
+sync point on purpose: on tunnelled/experimental PJRT backends (this image's axon TPU),
+``block_until_ready`` can resolve at enqueue-ack rather than device completion and
+under-reports by orders of magnitude (measured: 0.0016 s "epoch"); a device→host transfer of
+a value data-dependent on the whole epoch cannot lie (honest async-dispatch timing,
+SURVEY.md §7 hard part (c)).
 
 Prints exactly ONE JSON line on stdout.
 """
@@ -72,7 +77,9 @@ def run() -> dict:
         plan = epoch_index_plan(samplers, epoch, GLOBAL_BATCH // world)
         plan_d = dp.put_global(mesh, plan, P(None, "data"))
         state, losses = epoch_fn(state, train_x, train_y, plan_d, rng)
-        jax.block_until_ready(state)
+        # Sync by fetching the last per-step loss scalar: data-dependent on (almost) every
+        # step of the epoch, so the transfer completing proves the device finished it.
+        float(jax.device_get(losses[-1]))
         return state, losses
 
     state, _ = one_epoch(state, 0)  # warmup: compile + fault-in

@@ -24,6 +24,22 @@ Layout (mirrors the reference's five functional layers, SURVEY.md §1):
                  metrics/plots, profiling, determinism checks
 """
 
+import os as _os
+
+# Honor an explicit JAX_PLATFORMS env choice where an interpreter-startup hook has pinned a
+# different platform through jax.config (this build container's sitecustomize does exactly
+# that for its tunnelled "axon" TPU plugin, making `JAX_PLATFORMS=cpu python -m <trainer>`
+# silently target the TPU). Scope the correction narrowly: only when the *current config*
+# disagrees with the env because it holds that hook's pin — a programmatic
+# jax.config.update() by the user sets any other value and is never overwritten.
+_requested_platforms = _os.environ.get("JAX_PLATFORMS", "")
+if _requested_platforms and "axon" not in _requested_platforms.split(","):
+    import jax as _jax
+
+    # The hook pins "axon" first in the platform priority list (observed: "axon,cpu").
+    if (_jax.config.jax_platforms or "").split(",")[0] == "axon":
+        _jax.config.update("jax_platforms", _requested_platforms)
+
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
     SingleProcessConfig,
     DistributedConfig,

@@ -62,6 +62,14 @@ class Dataset:
         return self.images.shape[0]
 
 
+def truncate(ds: Dataset, n: int) -> Dataset:
+    """First-``n``-examples view of a split (``n <= 0`` means the whole split). Dev/CI
+    shortening knob — the reference always trains the full split."""
+    if n <= 0 or n >= len(ds):
+        return ds
+    return Dataset(ds.images[:n], ds.labels[:n], ds.source)
+
+
 def _read_idx(path: str) -> np.ndarray:
     """Parse one IDX file (optionally gzipped). Format: the classic LeCun IDX layout."""
     opener = gzip.open if path.endswith(".gz") else open

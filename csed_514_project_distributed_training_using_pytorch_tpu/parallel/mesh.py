@@ -51,14 +51,21 @@ def initialize_cluster(coordinator_address: str | None = None,
 
     No-op on a single-process run — safe to call unconditionally from every entry point.
     """
+    # Explicit arguments win; otherwise the rendezvous coordinates come from the environment
+    # (as set by train.launch or a fleet runner). This is the analog of the reference's
+    # MASTER_ADDR/MASTER_PORT env pair (src/train_dist.py:144-145) — except the process id is
+    # handed in by the launcher, never hand-edited into the source.
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS") or None
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+
     # TPU pod slice metadata lists one hostname per host; a single entry means this is not
     # a multi-host fleet and no coordinator service is needed.
     slice_hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
-    multi_host = (
-        coordinator_address is not None
-        or os.environ.get("JAX_COORDINATOR_ADDRESS")
-        or len(slice_hosts) > 1
-    )
+    multi_host = coordinator_address is not None or len(slice_hosts) > 1
     # Check the distributed-runtime state directly: touching jax.process_count() here would
     # initialize the local XLA backend first, after which jax.distributed.initialize raises.
     if multi_host and not jax.distributed.is_initialized():

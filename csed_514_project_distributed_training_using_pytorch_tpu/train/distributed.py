@@ -35,7 +35,7 @@ import jax
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from csed_514_project_distributed_training_using_pytorch_tpu.data import load_mnist
+from csed_514_project_distributed_training_using_pytorch_tpu.data import load_mnist, mnist
 from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     data_parallel as dp,
@@ -94,6 +94,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     init_rng, dropout_rng = jax.random.split(root)
 
     train_ds, test_ds = datasets if datasets is not None else load_mnist(config.data_dir)
+    train_ds = mnist.truncate(train_ds, config.max_train_examples)
+    test_ds = mnist.truncate(test_ds, config.max_test_examples)
     n_train, n_test = len(train_ds), len(test_ds)
     M.log(f"Distributed training: {world} devices on {info.process_count} process(es), "
           f"global batch {config.global_batch_size} "

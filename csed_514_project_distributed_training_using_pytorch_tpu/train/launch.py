@@ -1,0 +1,130 @@
+"""Local multi-process fleet launcher — run one command as N rendezvous'd processes.
+
+The reference launches its fleet by hand: SSH into each VM, run a per-machine file whose
+source encodes the rank (``src/run1.py:31`` vs ``src/run2.py:31``) or pass ``--local_rank``
+to ``src/train_dist.py:121``, with the coordinator IP hardcoded in the program
+(``src/train_dist.py:144``). Here the launch contract is: **every process runs the same
+command**; its cluster coordinates arrive via environment (``JAX_COORDINATOR_ADDRESS``,
+``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``), which ``parallel.mesh.initialize_cluster`` reads.
+On a real TPU pod none of this is needed — slice metadata supplies everything — so this
+launcher's jobs are (a) multi-host *emulation* on one machine (N processes × M virtual CPU
+devices each — the fake-backend analog, SURVEY.md §4) and (b) documenting the env contract a
+non-TPU fleet runner must provide.
+
+Usage (≙ running run1.py and run2.py on two VMs, but one command, no editing)::
+
+    python -m csed_514_project_distributed_training_using_pytorch_tpu.train.launch \
+        --num-processes 2 -- \
+        -m csed_514_project_distributed_training_using_pytorch_tpu.train.smoke
+
+Everything after ``--`` is passed to ``python`` in each process. Exit status is 0 iff every
+process exits 0 (a failed peer also causes the others to fail their collectives — the same
+all-or-nothing failure model as the reference's gloo world, SURVEY.md §5 "failure detection").
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _child_env(base: dict, *, port: int, num_processes: int, process_id: int,
+               platform: str | None, devices_per_process: int) -> dict:
+    env = dict(base)
+    env["JAX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+    env["JAX_NUM_PROCESSES"] = str(num_processes)
+    env["JAX_PROCESS_ID"] = str(process_id)
+    if platform:
+        env["JAX_PLATFORMS"] = platform
+    if (platform or env.get("JAX_PLATFORMS")) == "cpu":
+        # Each emulated host owns its own virtual device set; replace any inherited count.
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       env.get("XLA_FLAGS", ""))
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={devices_per_process}"
+        ).strip()
+    return env
+
+
+def launch(command: list[str], *, num_processes: int, platform: str | None = None,
+           devices_per_process: int = 1, port: int | None = None,
+           timeout: float | None = None) -> int:
+    """Spawn ``python <command>`` ``num_processes`` times with rendezvous env; returns the
+    first nonzero child exit code, else 0. Output streams through inherited stdout/stderr
+    (process-0 gating in ``utils.metrics.log`` keeps it single-voiced)."""
+    port = port or _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, *command],
+            env=_child_env(os.environ, port=port, num_processes=num_processes,
+                           process_id=i, platform=platform,
+                           devices_per_process=devices_per_process),
+        )
+        for i in range(num_processes)
+    ]
+    # Poll all children together: the first nonzero exit wins immediately (peers blocked on
+    # a dead partner's rendezvous/collective get terminated rather than waited out), and a
+    # shared deadline bounds total wall time instead of letting each child consume its own.
+    deadline = None if timeout is None else time.monotonic() + timeout
+    result: int | None = None
+    try:
+        live = list(procs)
+        while live and result is None:
+            for p in list(live):
+                if p.poll() is not None:
+                    live.remove(p)
+                    if p.returncode != 0:
+                        result = p.returncode
+                        break
+            if result is None and live:
+                if deadline is not None and time.monotonic() > deadline:
+                    result = 124        # timeout convention of coreutils `timeout`
+                    break
+                time.sleep(0.05)
+    finally:
+        for p in procs:          # a hung or failed peer must not leave zombies behind
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:          # reap everything; escalate if SIGTERM is ignored
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+    return result or 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.split("\n", 1)[0],
+        usage="python -m ....train.launch --num-processes N [options] -- <python args>")
+    parser.add_argument("--num-processes", type=int, default=2)
+    parser.add_argument("--platform", default=None,
+                        help="force a JAX platform in children (e.g. cpu for emulation)")
+    parser.add_argument("--devices-per-process", type=int, default=1,
+                        help="virtual devices per emulated host (cpu platform only)")
+    parser.add_argument("--port", type=int, default=None,
+                        help="coordinator port (default: pick a free one)")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="everything after -- is run as: python <command>")
+    args = parser.parse_args(argv)
+    command = args.command[1:] if args.command[:1] == ["--"] else args.command
+    if not command:
+        parser.error("no command given — pass e.g. `-- -m <module> [args]`")
+    return launch(command, num_processes=args.num_processes, platform=args.platform,
+                  devices_per_process=args.devices_per_process, port=args.port)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

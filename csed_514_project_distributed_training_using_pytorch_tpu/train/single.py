@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from csed_514_project_distributed_training_using_pytorch_tpu.data import (
-    BatchLoader, load_mnist,
+    BatchLoader, load_mnist, mnist,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
@@ -57,6 +57,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     init_rng, dropout_rng = jax.random.split(root)
 
     train_ds, test_ds = datasets if datasets is not None else load_mnist(config.data_dir)
+    train_ds = mnist.truncate(train_ds, config.max_train_examples)
+    test_ds = mnist.truncate(test_ds, config.max_test_examples)
     M.log(f"Loaded MNIST ({train_ds.source}): {len(train_ds)} train / {len(test_ds)} test")
     train_loader = BatchLoader(train_ds, config.batch_size_train, shuffle=True,
                                seed=config.seed)

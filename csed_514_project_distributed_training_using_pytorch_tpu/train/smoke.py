@@ -15,6 +15,7 @@ Run: ``python -m csed_514_project_distributed_training_using_pytorch_tpu.train.s
 from __future__ import annotations
 
 import numpy as np
+from jax.experimental import multihost_utils
 from jax.sharding import PartitionSpec as P
 
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
@@ -38,7 +39,9 @@ def main(num_devices: int | None = None) -> bool:
 
     values = np.arange(n, dtype=np.float32)       # device i holds value i (≙ the tensor
     rotated = ring_pass(mesh, dp.put_global(mesh, values, P("data")))  # rank0 sends, run1.py:13)
-    got = np.asarray(rotated)
+    # The result is sharded across every process's devices; allgather so each host can
+    # print/verify the full ring (a plain np.asarray would see non-addressable shards).
+    got = np.asarray(multihost_utils.process_allgather(rotated, tiled=True))
     want = np.roll(values, 1)
 
     ok = bool(np.array_equal(got, want))

@@ -39,6 +39,8 @@ class SingleProcessConfig:
                                       # reference lacks, SURVEY.md §5 "checkpoint/resume")
     use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
                                       # (ops/pallas_kernels.py; single-device step path)
+    max_train_examples: int = 0       # 0 = full split; >0 truncates (dev/CI shortening —
+    max_test_examples: int = 0        # no reference analog; the reference always trains full)
 
 
 @dataclass(frozen=True)
@@ -61,6 +63,8 @@ class DistributedConfig:
                                       # §2d.7); True shards eval + psums the sums.
     profile: bool = False
     profile_dir: str = "results/profile"
+    max_train_examples: int = 0       # 0 = full split; >0 truncates (dev/CI shortening —
+    max_test_examples: int = 0        # no reference analog; the reference always trains full)
 
 
 def _add_args(parser: argparse.ArgumentParser, cfg) -> None:
