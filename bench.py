@@ -6,26 +6,17 @@ e2-standard-8 CPU machine and ≈7.6 on four machines with DDP/gloo, unit unlabe
 (4-machine, 7.6) figure under the *most conservative* reading of its unlabeled y-axis —
 seconds. Anything >1 beats the whole reference cluster with this framework.
 
-Protocol: full training epoch (60,000 examples, global batch 64 — reference
-``src/train.py:12-13`` scale) as one jit-compiled scanned program over the device mesh; one
-warmup epoch to compile and fault in data, then the median of 3 timed epochs, each closed by
-a host fetch of the epoch's final loss scalar. The fetch — not ``block_until_ready`` — is the
-sync point on purpose: on tunnelled/experimental PJRT backends (this image's axon TPU),
-``block_until_ready`` can resolve at enqueue-ack rather than device completion and
-under-reports by orders of magnitude (measured: 0.0016 s "epoch"); a device→host transfer of
-a value data-dependent on the whole epoch cannot lie (honest async-dispatch timing,
-SURVEY.md §7 hard part (c)).
+Measurement protocol (warmup + median of 3 timed epochs, each closed by a host fetch of the
+epoch's final loss scalar — not ``block_until_ready``, which can resolve at enqueue-ack on
+tunnelled PJRT backends): ``utils/benchmarks.py``.
 
 Prints exactly ONE JSON line on stdout.
 """
 
 import json
-import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec as P
 
 from csed_514_project_distributed_training_using_pytorch_tpu.data import load_mnist
 from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
@@ -33,77 +24,38 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     data_parallel as dp,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import make_mesh
-from csed_514_project_distributed_training_using_pytorch_tpu.parallel.sampler import (
-    ShardedSampler,
-)
-from csed_514_project_distributed_training_using_pytorch_tpu.train.distributed import (
-    epoch_index_plan,
-)
-from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
-    create_train_state, make_epoch_fn, make_eval_fn,
+from csed_514_project_distributed_training_using_pytorch_tpu.train.step import make_eval_fn
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+    GLOBAL_BATCH, LEARNING_RATE, MOMENTUM, time_epochs,
 )
 
 BASELINE_BEST = 7.6          # reference 4-machine DDP/gloo epoch time (BASELINE.md)
-GLOBAL_BATCH = 64            # reference src/train.py:13
-LEARNING_RATE = 0.01         # reference src/train.py:15
-MOMENTUM = 0.5               # reference src/train.py:16
 
 
 def run() -> dict:
     mesh = make_mesh()
-    world = mesh.shape["data"]
-    if GLOBAL_BATCH % world:
-        raise ValueError(f"global batch {GLOBAL_BATCH} not divisible by device count "
-                         f"{world} — the reported protocol would be wrong (same check as "
-                         f"train.distributed.main)")
     train_ds, test_ds = load_mnist("files")
 
-    model = Net()
-    state = jax.device_put(create_train_state(model, jax.random.PRNGKey(1)),
-                           dp.replicated(mesh))
-    rng = jax.random.PRNGKey(2)
+    result = time_epochs(mesh, train_ds, global_batch=GLOBAL_BATCH,
+                         learning_rate=LEARNING_RATE, momentum=MOMENTUM,
+                         seed=1, timed_epochs=3)
 
-    train_x = dp.put_global(mesh, train_ds.images, P())
-    train_y = dp.put_global(mesh, train_ds.labels, P())
+    eval_fn = dp.compile_eval(make_eval_fn(Net(), batch_size=1000), mesh)
+    test_x = dp.put_global(mesh, test_ds.images, jax.sharding.PartitionSpec())
+    test_y = dp.put_global(mesh, test_ds.labels, jax.sharding.PartitionSpec())
+    sum_nll, correct = jax.device_get(
+        eval_fn(result.final_state.params, test_x, test_y))
 
-    epoch_fn = dp.compile_epoch(
-        make_epoch_fn(model, learning_rate=LEARNING_RATE, momentum=MOMENTUM), mesh)
-    eval_fn = dp.compile_eval(make_eval_fn(model, batch_size=1000), mesh)
-
-    samplers = [ShardedSampler(len(train_ds), num_replicas=world, rank=r, seed=42)
-                for r in range(world)]
-
-    def one_epoch(state, epoch):
-        plan = epoch_index_plan(samplers, epoch, GLOBAL_BATCH // world)
-        plan_d = dp.put_global(mesh, plan, P(None, "data"))
-        state, losses = epoch_fn(state, train_x, train_y, plan_d, rng)
-        # Sync by fetching the last per-step loss scalar: data-dependent on (almost) every
-        # step of the epoch, so the transfer completing proves the device finished it.
-        float(jax.device_get(losses[-1]))
-        return state, losses
-
-    state, _ = one_epoch(state, 0)  # warmup: compile + fault-in
-
-    times = []
-    for epoch in range(1, 4):
-        t0 = time.perf_counter()
-        state, losses = one_epoch(state, epoch)
-        times.append(time.perf_counter() - t0)
-
-    test_x = dp.put_global(mesh, test_ds.images, P())
-    test_y = dp.put_global(mesh, test_ds.labels, P())
-    sum_nll, correct = jax.device_get(eval_fn(state.params, test_x, test_y))
-
-    epoch_s = float(np.median(times))
     return {
         "metric": "MNIST 1-epoch wall-clock (60k examples, global batch 64)",
-        "value": round(epoch_s, 4),
+        "value": round(result.median_seconds, 4),
         "unit": "s",
-        "vs_baseline": round(BASELINE_BEST / epoch_s, 2),
-        "devices": world,
+        "vs_baseline": round(BASELINE_BEST / result.median_seconds, 2),
+        "devices": result.devices,
         "platform": jax.devices()[0].platform,
-        "steps_per_epoch": 60_000 // GLOBAL_BATCH,
-        "final_train_loss": round(float(np.asarray(losses)[-1]), 4),
+        "steps_per_epoch": result.steps_per_epoch,
+        "final_train_loss": round(result.final_train_loss, 4),
+        "test_nll_after_4_epochs": round(float(sum_nll) / len(test_ds), 4),
         "test_accuracy_after_4_epochs": round(float(correct) / len(test_ds), 4),
         "data_source": train_ds.source,
     }

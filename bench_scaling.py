@@ -1,0 +1,78 @@
+"""Scaling benchmark: time-to-train-one-epoch vs device count — the reference's headline
+chart (README.md:20, ``images/Time to train (1 epoch) vs. Number of machines.png``:
+≈17.5 / 11.3 / 7.6 / 5.0 at 1 / 2 / 4 / 8 gloo machines — 3.5× at 8 workers, 44% efficiency;
+BASELINE.md). Same weak-scaling regime: fixed global batch 64, per-device batch 64/N
+(reference ``src/train_dist.py:133``).
+
+Runs one measurement per power-of-two device count up to everything addressable (a single
+chip yields just N=1), prints one JSON line per count plus a summary line with speedups and
+parallel efficiency, and writes the reference-format chart to
+``images/time_vs_devices.png``. Measurement protocol (warmup + median of timed epochs closed
+by a host fetch of the final loss scalar): ``utils/benchmarks.py``.
+
+Run on real hardware: ``python bench_scaling.py``. Multi-chip logic can be exercised without
+a pod on the virtual CPU mesh (``JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8``), but virtual devices share one host's
+cores, so those times do NOT measure scaling — the JSON carries ``platform`` so nobody
+mistakes one for the other.
+"""
+
+import json
+
+import jax
+
+from csed_514_project_distributed_training_using_pytorch_tpu.data import load_mnist
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import make_mesh
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import plotting
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+    GLOBAL_BATCH, LEARNING_RATE, MOMENTUM, time_epochs,
+)
+
+
+def device_counts(available: int) -> list[int]:
+    counts = []
+    n = 1
+    while n <= available and GLOBAL_BATCH % n == 0:
+        counts.append(n)
+        n *= 2
+    return counts
+
+
+def run() -> list[dict]:
+    available = len(jax.devices())
+    platform = jax.devices()[0].platform
+    train_ds, _ = load_mnist("files")
+
+    rows = []
+    for n in device_counts(available):
+        result = time_epochs(make_mesh(n), train_ds, global_batch=GLOBAL_BATCH,
+                             learning_rate=LEARNING_RATE, momentum=MOMENTUM,
+                             timed_epochs=3)
+        rows.append({
+            "devices": n,
+            "epoch_seconds": round(result.median_seconds, 4),
+            "platform": platform,
+            "steps_per_epoch": result.steps_per_epoch,
+            "data_source": train_ds.source,
+        })
+        print(json.dumps(rows[-1]), flush=True)
+
+    base = rows[0]["epoch_seconds"]
+    for row in rows:
+        row["speedup"] = round(base / row["epoch_seconds"], 2)
+        row["efficiency"] = round(row["speedup"] / row["devices"], 2)
+    print(json.dumps({
+        "metric": "1-epoch wall-clock scaling (fixed global batch 64)",
+        "reference_speedups": {"1": 1.0, "2": 1.55, "4": 2.30, "8": 3.5},
+        "measured": [{k: r[k] for k in ("devices", "epoch_seconds", "speedup",
+                                        "efficiency")} for r in rows],
+    }), flush=True)
+
+    plotting.save_scaling_curve([r["devices"] for r in rows],
+                                [r["epoch_seconds"] for r in rows],
+                                "images/time_vs_devices.png")
+    return rows
+
+
+if __name__ == "__main__":
+    run()

@@ -116,6 +116,9 @@ def main(argv: list[str] | None = None) -> int:
                         help="virtual devices per emulated host (cpu platform only)")
     parser.add_argument("--port", type=int, default=None,
                         help="coordinator port (default: pick a free one)")
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="wall-clock seconds before the whole fleet is killed "
+                             "(exit 124); default: wait forever")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="everything after -- is run as: python <command>")
     args = parser.parse_args(argv)
@@ -123,7 +126,8 @@ def main(argv: list[str] | None = None) -> int:
     if not command:
         parser.error("no command given — pass e.g. `-- -m <module> [args]`")
     return launch(command, num_processes=args.num_processes, platform=args.platform,
-                  devices_per_process=args.devices_per_process, port=args.port)
+                  devices_per_process=args.devices_per_process, port=args.port,
+                  timeout=args.timeout)
 
 
 if __name__ == "__main__":
