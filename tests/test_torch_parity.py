@@ -1,0 +1,164 @@
+"""Cross-framework numerical parity: this framework vs a PyTorch realization of the
+reference's exact model/loss/optimizer contract.
+
+The strongest correctness oracle available: the reference's semantics (model architecture
+``src/model.py:4-22``, ``F.nll_loss`` objective ``src/train.py:74``, ``torch.optim.SGD``
+update ``src/train.py:60-61``) realized in torch (CPU) must produce the same numbers as this
+framework's JAX realization — same forward log-probs, same loss, same gradients, same
+parameter trajectory — once weights are mapped between layouts (NHWC/HWIO + H,W,C flatten
+here vs torch's NCHW/OIHW + C,H,W flatten).
+
+The torch module below is written fresh from the architecture spec in SURVEY.md §3.4 to
+serve as the oracle; it is not the reference's source.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+from torch import nn  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from csed_514_project_distributed_training_using_pytorch_tpu import ops  # noqa: E402
+from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net  # noqa: E402
+from csed_514_project_distributed_training_using_pytorch_tpu.ops.optim import (  # noqa: E402
+    sgd_init, sgd_update,
+)
+
+
+class TorchNet(nn.Module):
+    """The reference architecture (SURVEY.md §3.4): conv(1→10,k5) → maxpool2 → relu →
+    conv(10→20,k5) → Dropout2d → maxpool2 → relu → flatten(320) → fc(320→50) → relu →
+    dropout → fc(50→10) → log_softmax."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+        self.conv2_drop = nn.Dropout2d()
+        self.fc1 = nn.Linear(320, 50)
+        self.fc2 = nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2_drop(self.conv2(x)), 2))
+        x = x.reshape(-1, 320)   # ≡ the reference's view(-1, 320); robust to strides
+        x = F.relu(self.fc1(x))
+        x = F.dropout(x, training=self.training)
+        x = self.fc2(x)
+        return F.log_softmax(x, dim=1)
+
+
+def flax_to_torch(params) -> dict:
+    """Map this framework's NHWC/HWIO params onto the torch module's NCHW/OIHW layout."""
+    p = {k: np.asarray(v) for k, v in params.items()}
+    fc1 = p["fc1_kernel"].reshape(4, 4, 20, 50)          # flatten order here is (H, W, C)
+    fc1 = fc1.transpose(2, 0, 1, 3).reshape(320, 50)     # → torch's (C, H, W) order
+    sd = {
+        "conv1.weight": p["conv1_kernel"].transpose(3, 2, 0, 1),   # HWIO → OIHW
+        "conv1.bias": p["conv1_bias"],
+        "conv2.weight": p["conv2_kernel"].transpose(3, 2, 0, 1),
+        "conv2.bias": p["conv2_bias"],
+        "fc1.weight": fc1.T,                                        # [in,out] → [out,in]
+        "fc1.bias": p["fc1_bias"],
+        "fc2.weight": p["fc2_kernel"].T,
+        "fc2.bias": p["fc2_bias"],
+    }
+    return {k: torch.tensor(v) for k, v in sd.items()}
+
+
+def torch_grads_to_flax(tnet) -> dict:
+    """Inverse mapping, applied to .grad tensors, for gradient comparison."""
+    g = {k: v.grad.numpy() for k, v in tnet.named_parameters()}
+    fc1 = g["fc1.weight"].T.reshape(20, 4, 4, 50).transpose(1, 2, 0, 3).reshape(320, 50)
+    return {
+        "conv1_kernel": g["conv1.weight"].transpose(2, 3, 1, 0),
+        "conv1_bias": g["conv1.bias"],
+        "conv2_kernel": g["conv2.weight"].transpose(2, 3, 1, 0),
+        "conv2_bias": g["conv2.bias"],
+        "fc1_kernel": fc1,
+        "fc1_bias": g["fc1.bias"],
+        "fc2_kernel": g["fc2.weight"].T,
+        "fc2_bias": g["fc2.bias"],
+    }
+
+
+@pytest.fixture(scope="module")
+def setup():
+    net = Net()
+    variables = net.init({"params": jax.random.PRNGKey(0)}, jnp.zeros((2, 28, 28, 1)))
+    params = variables["params"]
+    tnet = TorchNet()
+    tnet.load_state_dict(flax_to_torch(params))
+    tnet.eval()
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(16, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, size=16).astype(np.int64)
+    return net, params, tnet, x, y
+
+
+def test_forward_parity(setup):
+    net, params, tnet, x, y = setup
+    ours = np.asarray(net.apply({"params": params}, jnp.asarray(x)))
+    with torch.no_grad():
+        theirs = tnet(torch.tensor(x).permute(0, 3, 1, 2).contiguous()).numpy()
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_loss_and_grad_parity(setup):
+    net, params, tnet, x, y = setup
+
+    def loss_fn(p):
+        log_probs = net.apply({"params": p}, jnp.asarray(x))
+        return ops.nll_loss(log_probs, jnp.asarray(y.astype(np.int32)))
+
+    our_loss, our_grads = jax.value_and_grad(loss_fn)(params)
+
+    tnet.zero_grad()
+    tloss = F.nll_loss(tnet(torch.tensor(x).permute(0, 3, 1, 2).contiguous()), torch.tensor(y))
+    tloss.backward()
+    their_grads = torch_grads_to_flax(tnet)
+
+    np.testing.assert_allclose(float(our_loss), float(tloss), atol=1e-6)
+    assert set(their_grads) == set(our_grads)
+    for k in our_grads:
+        np.testing.assert_allclose(np.asarray(our_grads[k]), their_grads[k],
+                                   atol=2e-6, err_msg=f"grad mismatch at {k}")
+
+
+def test_sum_reduction_eval_metric_parity(setup):
+    """The eval objective: the deprecated ``size_average=False`` sum form the reference uses
+    (src/train.py:94) must match reduction='sum'."""
+    net, params, tnet, x, y = setup
+    ours = float(ops.nll_loss(net.apply({"params": params}, jnp.asarray(x)),
+                              jnp.asarray(y.astype(np.int32)), reduction="sum"))
+    with torch.no_grad():
+        theirs = float(F.nll_loss(tnet(torch.tensor(x).permute(0, 3, 1, 2).contiguous()),
+                                  torch.tensor(y), reduction="sum"))
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+def test_sgd_momentum_trajectory_parity():
+    """Three optimizer steps under identical synthetic gradients: torch.optim.SGD's
+    momentum-buffer semantics (src/train.py:60-61) vs ops.optim.sgd_update."""
+    rng = np.random.default_rng(3)
+    p0 = rng.normal(size=(7, 5)).astype(np.float32)
+    grads = [rng.normal(size=(7, 5)).astype(np.float32) for _ in range(3)]
+
+    tp = torch.nn.Parameter(torch.tensor(p0.copy()))
+    opt = torch.optim.SGD([tp], lr=0.01, momentum=0.5)
+    for g in grads:
+        opt.zero_grad()
+        tp.grad = torch.tensor(g)
+        opt.step()
+
+    params = {"w": jnp.asarray(p0)}
+    vel = sgd_init(params)
+    for g in grads:
+        params, vel = sgd_update(params, vel, {"w": jnp.asarray(g)},
+                                 learning_rate=0.01, momentum=0.5)
+
+    np.testing.assert_allclose(np.asarray(params["w"]), tp.detach().numpy(), atol=1e-6)
