@@ -1,0 +1,62 @@
+"""Utility-layer tests: torch-default initializer parity and the profiler flag."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu import ops
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.profiling import (
+    maybe_profile,
+)
+
+
+class TestTorchDefaultInit:
+    """The reference trains from torch's default inits (it never sets any — SURVEY.md §2a #1);
+    our initializers must reproduce those distributions so loss trajectories are comparable."""
+
+    def test_conv_kernel_bound_and_moments(self):
+        # fan_in for a 5x5x10-in kernel = 250 → U(±1/sqrt(250))
+        shape, fan_in = (5, 5, 10, 20), 250
+        w = np.asarray(ops.torch_kaiming_uniform(jax.random.PRNGKey(0), shape))
+        bound = 1.0 / np.sqrt(fan_in)
+        assert np.abs(w).max() <= bound
+        assert np.abs(w).max() > 0.95 * bound          # actually fills the support
+        assert abs(w.mean()) < 0.1 * bound
+        np.testing.assert_allclose(w.var(), bound**2 / 3, rtol=0.1)  # uniform variance
+
+    def test_bound_matches_torch_formula(self):
+        """torch kaiming_uniform_(a=sqrt(5)): bound = sqrt(6 / ((1+a^2) * fan_in))
+        = 1/sqrt(fan_in) — cross-checked against a real torch layer's observed support."""
+        torch = pytest.importorskip("torch")
+        conv = torch.nn.Conv2d(10, 20, kernel_size=5)
+        observed = conv.weight.detach().abs().max().item()
+        bound = 1.0 / np.sqrt(250)
+        assert observed <= bound
+        assert observed > 0.9 * bound
+        lin = torch.nn.Linear(320, 50)
+        lin_observed = lin.weight.detach().abs().max().item()
+        lin_bound = 1.0 / np.sqrt(320)
+        assert lin_observed <= lin_bound
+        assert lin_observed > 0.9 * lin_bound
+
+    def test_bias_uses_weight_fan_in(self):
+        b = np.asarray(ops.torch_fan_in_uniform(320)(jax.random.PRNGKey(1), (50,)))
+        assert np.abs(b).max() <= 1.0 / np.sqrt(320)
+
+
+def test_maybe_profile_writes_trace(tmp_path):
+    log_dir = str(tmp_path / "trace")
+    with maybe_profile(True, log_dir):
+        jax.block_until_ready(jax.jit(lambda x: x @ x)(jnp.ones((64, 64))))
+    found = [os.path.join(r, f) for r, _, fs in os.walk(log_dir) for f in fs]
+    assert found, "profiler trace directory is empty"
+
+
+def test_maybe_profile_disabled_is_noop(tmp_path):
+    log_dir = str(tmp_path / "trace")
+    with maybe_profile(False, log_dir):
+        pass
+    assert not os.path.exists(log_dir)
