@@ -17,11 +17,12 @@ cores, so those times do NOT measure scaling — the JSON carries ``platform`` s
 mistakes one for the other.
 """
 
+import argparse
 import json
 
 import jax
 
-from csed_514_project_distributed_training_using_pytorch_tpu.data import load_mnist
+from csed_514_project_distributed_training_using_pytorch_tpu.data import load_mnist, mnist
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import make_mesh
 from csed_514_project_distributed_training_using_pytorch_tpu.utils import plotting
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
@@ -38,16 +39,17 @@ def device_counts(available: int) -> list[int]:
     return counts
 
 
-def run() -> list[dict]:
+def run(max_train_examples: int = 0, timed_epochs: int = 3) -> list[dict]:
     available = len(jax.devices())
     platform = jax.devices()[0].platform
     train_ds, _ = load_mnist("files")
+    train_ds = mnist.truncate(train_ds, max_train_examples)
 
     rows = []
     for n in device_counts(available):
         result = time_epochs(make_mesh(n), train_ds, global_batch=GLOBAL_BATCH,
                              learning_rate=LEARNING_RATE, momentum=MOMENTUM,
-                             timed_epochs=3)
+                             timed_epochs=timed_epochs)
         rows.append({
             "devices": n,
             "epoch_seconds": round(result.median_seconds, 4),
@@ -75,4 +77,10 @@ def run() -> list[dict]:
 
 
 if __name__ == "__main__":
-    run()
+    parser = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    parser.add_argument("--max-train-examples", type=int, default=0,
+                        help="0 = full 60k (the published protocol); >0 truncates for "
+                             "quick functional runs")
+    parser.add_argument("--timed-epochs", type=int, default=3)
+    args = parser.parse_args()
+    run(args.max_train_examples, args.timed_epochs)
