@@ -46,10 +46,18 @@ class ProcessInfo:
 
 def initialize_cluster(coordinator_address: str | None = None,
                        num_processes: int | None = None,
-                       process_id: int | None = None) -> ProcessInfo:
+                       process_id: int | None = None,
+                       initialization_timeout: int | None = None) -> ProcessInfo:
     """Join (or create) the distributed runtime and report this process's coordinates.
 
     No-op on a single-process run — safe to call unconditionally from every entry point.
+
+    ``initialization_timeout`` (seconds; or env ``JAX_INITIALIZATION_TIMEOUT``) bounds the
+    rendezvous wait — the clean-abort behavior SURVEY.md §5 "failure detection" asks for,
+    where the reference's gloo rendezvous blocks forever on a missing peer
+    (``src/train_dist.py:146``). On expiry the coordination client terminates the process
+    with a DEADLINE_EXCEEDED fatal (not a catchable exception); exceptions jax does raise
+    are re-raised with the cluster coordinates attached.
     """
     # Explicit arguments win; otherwise the rendezvous coordinates come from the environment
     # (as set by train.launch or a fleet runner). This is the analog of the reference's
@@ -62,6 +70,9 @@ def initialize_cluster(coordinator_address: str | None = None,
     if process_id is None and os.environ.get("JAX_PROCESS_ID"):
         process_id = int(os.environ["JAX_PROCESS_ID"])
 
+    if initialization_timeout is None and os.environ.get("JAX_INITIALIZATION_TIMEOUT"):
+        initialization_timeout = int(os.environ["JAX_INITIALIZATION_TIMEOUT"])
+
     # TPU pod slice metadata lists one hostname per host; a single entry means this is not
     # a multi-host fleet and no coordinator service is needed.
     slice_hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
@@ -69,11 +80,23 @@ def initialize_cluster(coordinator_address: str | None = None,
     # Check the distributed-runtime state directly: touching jax.process_count() here would
     # initialize the local XLA backend first, after which jax.distributed.initialize raises.
     if multi_host and not jax.distributed.is_initialized():
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+        kwargs = {}
+        if initialization_timeout is not None:
+            kwargs["initialization_timeout"] = initialization_timeout
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+        except Exception as e:
+            raise RuntimeError(
+                f"cluster rendezvous failed: coordinator={coordinator_address!r}, "
+                f"process_id={process_id}, num_processes={num_processes}, "
+                f"timeout={initialization_timeout or 'default'}s — check that every "
+                f"peer is up and reachable (≙ a hung init_process_group in the "
+                f"reference, src/train_dist.py:146)") from e
     return process_info()
 
 

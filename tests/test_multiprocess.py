@@ -65,6 +65,34 @@ def test_smoke_failure_propagates(tmp_path, monkeypatch):
     assert time.monotonic() - t0 < 120
 
 
+def test_rendezvous_timeout_aborts_promptly(tmp_path, monkeypatch):
+    """A peer whose coordinator never appears must abort within the bounded timeout
+    (JAX_INITIALIZATION_TIMEOUT), with the deadline error on stderr — a clean failure,
+    not the forever-block of the reference's gloo rendezvous (src/train_dist.py:146).
+    (The coordination client terminates the process at LOG(FATAL) severity, so this
+    surfaces as a nonzero exit + stderr message rather than a catchable exception.)"""
+    import subprocess
+    import sys
+
+    monkeypatch.chdir(tmp_path)
+    prog = (
+        "from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh "
+        "import initialize_cluster\n"
+        "initialize_cluster()\n"
+    )
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               JAX_COORDINATOR_ADDRESS="localhost:1",   # nothing listens on port 1
+               JAX_NUM_PROCESSES="2", JAX_PROCESS_ID="1",
+               JAX_INITIALIZATION_TIMEOUT="5")
+    t0 = time.monotonic()
+    proc = subprocess.run([sys.executable, "-c", prog], env=env, timeout=120,
+                          capture_output=True, text=True)
+    assert proc.returncode != 0
+    assert time.monotonic() - t0 < 90          # bounded, not the forever-block
+    assert "DEADLINE_EXCEEDED" in proc.stderr or "Deadline" in proc.stderr
+
+
 def test_distributed_training_two_processes(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     code = launch(TRAIN_ARGS, num_processes=2, platform="cpu",
