@@ -99,7 +99,7 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
 
 
 def make_epoch_fn(model, *, learning_rate: float, momentum: float,
-                  use_pallas: bool = False) -> Callable:
+                  use_pallas: bool = False, unroll: int = 1) -> Callable:
     """Build ``epoch(state, images, labels, idx_matrix, rng) -> (state, losses)``.
 
     ``images``/``labels`` are the full (device-resident) training split; ``idx_matrix`` is a
@@ -107,6 +107,11 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
     sampler output). The scan runs ``num_steps`` optimizer steps with no host round-trip;
     per-step losses come back as one ``[num_steps]`` array for logging, replacing the
     reference's per-step ``loss.item()`` host syncs (``src/train_dist.py:85``).
+
+    ``unroll`` replicates the step body that many times per scan iteration (semantics
+    unchanged — SGD stays strictly sequential); on a tiny model, per-iteration control
+    overhead can rival the step's compute, and unrolling amortizes it at the cost of
+    compile time.
     """
     train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum,
                                  use_pallas=use_pallas)
@@ -116,7 +121,7 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
             return train_step(state, jnp.take(images, idx, axis=0),
                               jnp.take(labels, idx, axis=0), rng)
 
-        return lax.scan(body, state, idx_matrix)
+        return lax.scan(body, state, idx_matrix, unroll=unroll)
 
     return epoch
 

@@ -168,3 +168,25 @@ def test_params_export_roundtrip(tmp_path, model_state):
     loaded = checkpoint.load_params(path, jax.device_get(state.params))
     np.testing.assert_array_equal(np.asarray(loaded["conv1_bias"]),
                                   np.asarray(state.params["conv1_bias"]))
+
+
+def test_epoch_unroll_is_semantics_preserving(model_state):
+    """unroll>1 is a codegen knob only: the scanned epoch must produce the same state and
+    losses as the sequential (unroll=1) program."""
+    model, state0 = model_state
+    x = jax.random.normal(jax.random.PRNGKey(5), (64, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(6), (64,), 0, 10)
+    idx = jnp.arange(64, dtype=jnp.int32).reshape(8, 8)
+    rng = jax.random.PRNGKey(7)
+
+    outs = {}
+    for unroll in (1, 4):
+        fn = jax.jit(make_epoch_fn(model, learning_rate=0.01, momentum=0.5,
+                                   unroll=unroll))
+        outs[unroll] = fn(state0, x, y, idx, rng)
+
+    np.testing.assert_allclose(np.asarray(outs[1][1]), np.asarray(outs[4][1]),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(outs[1][0].params),
+                    jax.tree_util.tree_leaves(outs[4][0].params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
