@@ -134,6 +134,31 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                                train_y[jnp.asarray(tail)], dropout_rng)
         return state
 
+    def train_epoch_host_pipeline(state: TrainState, epoch: int) -> TrainState:
+        """The reference-shaped loop: host batches through the native C++ threaded
+        prefetcher (the DataLoader worker-pool analog), one device dispatch per batch.
+        Identical step sequence (same index plan, same per-step RNG fold) to the scan fast
+        path — only the feeding mechanism differs."""
+        train_loader.set_epoch(epoch)
+        full_steps = train_loader.epoch_index_matrix(epoch, allow_empty=True).shape[0]
+        for b, (bx, by) in enumerate(train_loader.prefetch_iter(epoch), start=1):
+            state, loss = step_fn(state, jnp.asarray(bx), jnp.asarray(by), dropout_rng)
+            if b % config.log_interval == 0 or b == full_steps:
+                examples_seen = (epoch - 1) * n_train + b * config.batch_size_train
+                M.log(M.train_progress_line(epoch, b * config.batch_size_train,
+                                            n_train, float(loss)))
+                history.record_train(examples_seen, float(loss))
+                checkpoint.save_train_state(ckpt_path, state)
+        tail = train_loader.sampler.epoch_indices(epoch)[
+            full_steps * config.batch_size_train:]
+        if len(tail):
+            state, _ = step_fn(state, jnp.asarray(train_ds.images[tail]),
+                               jnp.asarray(train_ds.labels[tail]), dropout_rng)
+        return state
+
+    if config.use_host_pipeline:
+        train_epoch = train_epoch_host_pipeline
+
     with maybe_profile(config.profile, config.profile_dir):
         evaluate(state, 0)                      # baseline eval, ≙ src/train.py:106
         for epoch in range(1, config.n_epochs + 1):

@@ -39,6 +39,10 @@ class SingleProcessConfig:
                                       # reference lacks, SURVEY.md §5 "checkpoint/resume")
     use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
                                       # (ops/pallas_kernels.py; single-device step path)
+    use_host_pipeline: bool = False   # feed batches through the native C++ threaded
+                                      # prefetcher (the DataLoader num_workers=4 analog,
+                                      # src/train_dist.py:43-45) instead of the device-
+                                      # resident scan fast path; same math, same order
     max_train_examples: int = 0       # 0 = full split; >0 truncates (dev/CI shortening —
     max_test_examples: int = 0        # no reference analog; the reference always trains full)
 
