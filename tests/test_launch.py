@@ -1,0 +1,68 @@
+"""Unit tests for the fleet launcher's plumbing (train/launch.py) — env contract assembly,
+flag rewriting, CLI parsing — without spawning fleets (those run in test_multiprocess.py)."""
+
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.train import launch as L
+
+
+class TestChildEnv:
+    def test_rendezvous_env_contract(self):
+        env = L._child_env({}, port=12345, num_processes=4, process_id=2,
+                           platform=None, devices_per_process=1)
+        assert env["JAX_COORDINATOR_ADDRESS"] == "localhost:12345"
+        assert env["JAX_NUM_PROCESSES"] == "4"
+        assert env["JAX_PROCESS_ID"] == "2"
+        assert "JAX_PLATFORMS" not in env
+
+    def test_cpu_platform_sets_device_count(self):
+        env = L._child_env({}, port=1, num_processes=2, process_id=0,
+                           platform="cpu", devices_per_process=3)
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert env["XLA_FLAGS"] == "--xla_force_host_platform_device_count=3"
+
+    def test_inherited_device_count_is_replaced(self):
+        base = {"XLA_FLAGS": "--foo --xla_force_host_platform_device_count=8 --bar",
+                "JAX_PLATFORMS": "cpu"}
+        env = L._child_env(base, port=1, num_processes=2, process_id=1,
+                           platform=None, devices_per_process=2)
+        assert "device_count=8" not in env["XLA_FLAGS"]
+        assert "--xla_force_host_platform_device_count=2" in env["XLA_FLAGS"]
+        assert "--foo" in env["XLA_FLAGS"] and "--bar" in env["XLA_FLAGS"]
+
+    def test_non_cpu_platform_keeps_flags(self):
+        base = {"XLA_FLAGS": "--keep-me"}
+        env = L._child_env(base, port=1, num_processes=2, process_id=0,
+                           platform="tpu", devices_per_process=4)
+        assert env["XLA_FLAGS"] == "--keep-me"
+
+
+class TestCli:
+    def test_no_command_errors(self, capsys):
+        with pytest.raises(SystemExit) as e:
+            L.main(["--num-processes", "2"])
+        assert e.value.code == 2
+
+    def test_remainder_after_double_dash(self, monkeypatch):
+        seen = {}
+
+        def fake_launch(command, **kwargs):
+            seen["command"] = command
+            seen.update(kwargs)
+            return 0
+
+        monkeypatch.setattr(L, "launch", fake_launch)
+        assert L.main(["--num-processes", "3", "--platform", "cpu", "--timeout", "9",
+                       "--", "-m", "somemod", "--flag"]) == 0
+        assert seen["command"] == ["-m", "somemod", "--flag"]
+        assert seen["num_processes"] == 3
+        assert seen["platform"] == "cpu"
+        assert seen["timeout"] == 9.0
+
+
+def test_free_port_is_bindable():
+    import socket
+
+    port = L._free_port()
+    with socket.socket() as s:
+        s.bind(("localhost", port))   # free at allocation time
