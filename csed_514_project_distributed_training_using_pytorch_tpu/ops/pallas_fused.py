@@ -1,0 +1,305 @@
+"""Fully-fused Pallas train step: the ENTIRE forward+backward of the flagship CNN in one
+TPU kernel, plus the fused SGD update.
+
+The reference executes its hot loop as ~dozens of separate ATen kernels chained by the C++
+autograd engine (forward ``src/model.py:15-22``, backward ``src/train.py:75``); the default
+XLA path here compiles the same math into a fused-but-multi-kernel program. This module goes
+one step further down the stack — the whole step body (both convs via im2col matmuls on the
+MXU, both poolings, both dropouts, both dense layers, log-softmax + NLL, and the full
+backward chain to every weight gradient) runs as ONE Pallas kernel, gridded over batch
+blocks with gradient accumulation in VMEM-resident output refs, followed by the fused SGD
+kernel from ``ops/pallas_kernels.py``. Per-step HBM traffic collapses to: batch in, grads +
+loss out; every activation lives and dies in VMEM.
+
+Architecture constants are the flagship model's (models/cnn.py — 28×28×1 input, conv 5×5
+1→10, pool, conv 5×5 10→20, pool, fc 320→50, fc 50→10); like production fused-attention
+kernels, the kernel is specialized to its model. Dropout masks are sampled OUTSIDE the
+kernel (two small bernoulli draws per step) and passed in as {0, 1/keep} scale arrays, so
+the kernel stays deterministic given its inputs and the step stays reproducible from the
+same fold-in RNG discipline as the unfused path (train/step.py).
+
+Numerics: pinned by tests against a pure-jnp twin (identical math, including the
+distribute-to-ties max-pool backward) and — with dropout disabled — against
+``jax.value_and_grad`` of the real flax model.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BATCH_BLOCK = 16      # batch rows per grid step (~6 MB peak VMEM residency)
+
+# Flagship-model dimensions (models/cnn.py; reference src/model.py:9-13).
+H = W = 28
+K = 5
+C1, C2 = 10, 20
+R1 = H - K + 1        # 24 — conv1 output
+P1 = R1 // 2          # 12 — pool1 output
+R2 = P1 - K + 1       # 8  — conv2 output
+P2 = R2 // 2          # 4  — pool2 output
+F_IN = P2 * P2 * C2   # 320
+F_HID = 50
+F_OUT = 10
+
+
+class FusedGrads(NamedTuple):
+    """Flat gradient layout produced by the kernel (reshaped to model shapes by callers)."""
+
+    w1: jax.Array   # [K*K, C1]
+    b1: jax.Array   # [1, C1]
+    w2: jax.Array   # [K*K*C1, C2]
+    b2: jax.Array   # [1, C2]
+    w3: jax.Array   # [F_IN, F_HID]
+    b3: jax.Array   # [1, F_HID]
+    w4: jax.Array   # [F_HID, F_OUT]
+    b4: jax.Array   # [1, F_OUT]
+
+
+def _dot(a, b):
+    return jnp.dot(a, b, preferred_element_type=jnp.float32)
+
+
+def _pool_fwd(z, side):
+    """2×2 max pool of [BB, side, side, C] -> [BB, side//2, side//2, C]."""
+    bb, _, _, c = z.shape
+    zr = z.reshape(bb, side // 2, 2, side // 2, 2, c)
+    return zr.max(axis=(2, 4))
+
+
+def _pool_bwd(z, pooled, dpooled, side):
+    """Distribute-to-ties backward of `_pool_fwd` (ties are measure-zero on conv outputs)."""
+    bb, _, _, c = z.shape
+    zr = z.reshape(bb, side // 2, 2, side // 2, 2, c)
+    eq = (zr == pooled[:, :, None, :, None, :]).astype(jnp.float32)
+    cnt = eq.sum(axis=(2, 4), keepdims=True)
+    dz = eq * (dpooled[:, :, None, :, None, :] / cnt)
+    return dz.reshape(bb, side, side, c)
+
+
+def _im2col(x, out_side):
+    """[BB, s, s, C] -> [BB, out_side, out_side, K*K*C] patches in (ky, kx, c) order —
+    matching an HWIO kernel reshaped to [K*K*C, C_out]."""
+    cols = [x[:, ky:ky + out_side, kx:kx + out_side, :]
+            for ky in range(K) for kx in range(K)]
+    return jnp.concatenate(cols, axis=-1)
+
+
+def _col2im(dpatches, out_side, in_side, c):
+    """Adjoint of `_im2col`: scatter-add patch gradients back to the input feature map,
+    expressed as a sum of zero-padded shifts (static shapes, Mosaic-friendly)."""
+    bb = dpatches.shape[0]
+    acc = jnp.zeros((bb, in_side, in_side, c), jnp.float32)
+    for ky in range(K):
+        for kx in range(K):
+            i = (ky * K + kx) * c
+            piece = dpatches[..., i:i + c]
+            acc = acc + jnp.pad(
+                piece,
+                ((0, 0), (ky, in_side - out_side - ky), (kx, in_side - out_side - kx),
+                 (0, 0)))
+    return acc
+
+
+def _fused_kernel(inv_total, x_ref, lab_ref, d2_ref, d1_ref,
+                  w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref, w4_ref, b4_ref,
+                  loss_ref, dw1_ref, db1_ref, dw2_ref, db2_ref, dw3_ref, db3_ref,
+                  dw4_ref, db4_ref):
+    """One batch block: full forward + backward; grads accumulate across grid steps."""
+    bb = x_ref.shape[0]
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _zero():
+        loss_ref[:] = jnp.zeros_like(loss_ref)
+        for r in (dw1_ref, db1_ref, dw2_ref, db2_ref, dw3_ref, db3_ref,
+                  dw4_ref, db4_ref):
+            r[:] = jnp.zeros_like(r)
+
+    x = x_ref[:]                                        # [bb, 28, 28, 1]
+    lab = lab_ref[:]                                    # [bb, 1] i32
+    drop2 = d2_ref[:]                                   # [bb, C2] {0, 1/keep}
+    drop1 = d1_ref[:]                                   # [bb, F_HID]
+    w1, b1 = w1_ref[:], b1_ref[:]
+    w2, b2 = w2_ref[:], b2_ref[:]
+    w3, b3 = w3_ref[:], b3_ref[:]
+    w4, b4 = w4_ref[:], b4_ref[:]
+
+    # ---- forward ----
+    pat1 = _im2col(x, R1)                               # [bb, 24, 24, 25]
+    z1 = (_dot(pat1.reshape(bb * R1 * R1, K * K), w1) + b1).reshape(bb, R1, R1, C1)
+    p1 = _pool_fwd(z1, R1)                              # [bb, 12, 12, 10]
+    a1 = jnp.maximum(p1, 0.0)
+
+    pat2 = _im2col(a1, R2)                              # [bb, 8, 8, 250]
+    z2 = (_dot(pat2.reshape(bb * R2 * R2, K * K * C1), w2) + b2).reshape(bb, R2, R2, C2)
+    zd2 = z2 * drop2[:, None, None, :]                  # channelwise Dropout2d
+    p2 = _pool_fwd(zd2, R2)                             # [bb, 4, 4, 20]
+    a2 = jnp.maximum(p2, 0.0)
+    f = a2.reshape(bb, F_IN)                            # (H, W, C) flatten == model's
+
+    z3 = _dot(f, w3) + b3                               # [bb, 50]
+    a3 = jnp.maximum(z3, 0.0)
+    a3d = a3 * drop1                                    # elementwise dropout
+    z4 = _dot(a3d, w4) + b4                             # [bb, 10]
+
+    m = jnp.max(z4, axis=1, keepdims=True)
+    s = z4 - m
+    lse = jnp.log(jnp.sum(jnp.exp(s), axis=1, keepdims=True))
+    classes = jax.lax.broadcasted_iota(jnp.int32, z4.shape, 1)
+    onehot = (classes == lab).astype(jnp.float32)
+    picked = jnp.sum(onehot * (s - lse), axis=1, keepdims=True)
+    loss_ref[:] += -jnp.sum(picked) * inv_total         # mean over the FULL batch
+
+    # ---- backward (of the mean loss) ----
+    softmax = jnp.exp(s - lse)
+    dz4 = (softmax - onehot) * inv_total                # [bb, 10]
+    dw4_ref[:] += _dot(a3d.T, dz4)
+    db4_ref[:] += jnp.sum(dz4, axis=0, keepdims=True)
+
+    da3 = _dot(dz4, w4.T) * drop1                       # through dropout
+    dz3 = da3 * (z3 > 0.0).astype(jnp.float32)
+    dw3_ref[:] += _dot(f.T, dz3)
+    db3_ref[:] += jnp.sum(dz3, axis=0, keepdims=True)
+
+    da2 = _dot(dz3, w3.T).reshape(bb, P2, P2, C2)
+    dp2 = da2 * (p2 > 0.0).astype(jnp.float32)
+    dzd2 = _pool_bwd(zd2, p2, dp2, R2)
+    dz2 = dzd2 * drop2[:, None, None, :]
+    dz2f = dz2.reshape(bb * R2 * R2, C2)
+    dw2_ref[:] += _dot(pat2.reshape(bb * R2 * R2, K * K * C1).T, dz2f)
+    db2_ref[:] += jnp.sum(dz2f, axis=0, keepdims=True)
+
+    dpat2 = _dot(dz2f, w2.T).reshape(bb, R2, R2, K * K * C1)
+    da1 = _col2im(dpat2, R2, P1, C1)
+    dp1 = da1 * (p1 > 0.0).astype(jnp.float32)
+    dz1 = _pool_bwd(z1, p1, dp1, R1)
+    dz1f = dz1.reshape(bb * R1 * R1, C1)
+    dw1_ref[:] += _dot(pat1.reshape(bb * R1 * R1, K * K).T, dz1f)
+    db1_ref[:] += jnp.sum(dz1f, axis=0, keepdims=True)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("batch_block",))
+def fused_loss_and_grads(params_flat: dict, images: jax.Array, labels: jax.Array,
+                         drop2: jax.Array, drop1: jax.Array, *,
+                         batch_block: int | None = None):
+    """Run the fused kernel over the whole batch; returns (mean_loss, FusedGrads).
+
+    ``params_flat``: dict with keys w1 [K*K, C1], b1 [1, C1], w2 [K*K*C1, C2], b2, w3, b3,
+    w4, b4 (the model's HWIO conv kernels reshaped; see ``flatten_params``).
+    ``drop2``/``drop1``: {0, 1/keep} scale arrays of shape [B, C2] / [B, F_HID].
+    ``batch_block=None`` picks the largest divisor of the batch ≤ BATCH_BLOCK (any batch
+    size works, at worst block 1); an explicit block must divide the batch.
+    """
+    b = images.shape[0]
+    if batch_block is None:
+        bb = next(d for d in range(min(BATCH_BLOCK, b), 0, -1) if b % d == 0)
+    else:
+        bb = min(batch_block, b)
+    if b % bb:
+        raise ValueError(f"batch {b} not divisible by batch block {bb}")
+    grid = (b // bb,)
+
+    row = lambda width: pl.BlockSpec((bb,) + width, lambda i: (i,) + (0,) * len(width),
+                                     memory_space=pltpu.VMEM)
+    whole = lambda shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape),
+                                       memory_space=pltpu.VMEM)
+    p = params_flat
+    out_shapes = [
+        jax.ShapeDtypeStruct((1, 1), jnp.float32),                 # loss
+        jax.ShapeDtypeStruct((K * K, C1), jnp.float32),
+        jax.ShapeDtypeStruct((1, C1), jnp.float32),
+        jax.ShapeDtypeStruct((K * K * C1, C2), jnp.float32),
+        jax.ShapeDtypeStruct((1, C2), jnp.float32),
+        jax.ShapeDtypeStruct((F_IN, F_HID), jnp.float32),
+        jax.ShapeDtypeStruct((1, F_HID), jnp.float32),
+        jax.ShapeDtypeStruct((F_HID, F_OUT), jnp.float32),
+        jax.ShapeDtypeStruct((1, F_OUT), jnp.float32),
+    ]
+    outs = pl.pallas_call(
+        functools.partial(_fused_kernel, 1.0 / b),
+        grid=grid,
+        in_specs=[
+            row((H, W, 1)), row((1,)), row((C2,)), row((F_HID,)),
+            whole((K * K, C1)), whole((1, C1)),
+            whole((K * K * C1, C2)), whole((1, C2)),
+            whole((F_IN, F_HID)), whole((1, F_HID)),
+            whole((F_HID, F_OUT)), whole((1, F_OUT)),
+        ],
+        out_specs=[whole((1, 1))] + [whole(s.shape) for s in out_shapes[1:]],
+        out_shape=out_shapes,
+        interpret=_interpret(),
+    )(images.astype(jnp.float32), labels.astype(jnp.int32)[:, None],
+      drop2.astype(jnp.float32), drop1.astype(jnp.float32),
+      p["w1"], p["b1"], p["w2"], p["b2"], p["w3"], p["b3"], p["w4"], p["b4"])
+    loss = outs[0][0, 0]
+    return loss, FusedGrads(*outs[1:])
+
+
+def flatten_params(params: dict) -> dict:
+    """Model params (models/cnn.py naming/shapes) -> the kernel's flat matmul layout."""
+    return {
+        "w1": params["conv1_kernel"].reshape(K * K, C1),
+        "b1": params["conv1_bias"].reshape(1, C1),
+        "w2": params["conv2_kernel"].reshape(K * K * C1, C2),
+        "b2": params["conv2_bias"].reshape(1, C2),
+        "w3": params["fc1_kernel"],
+        "b3": params["fc1_bias"].reshape(1, F_HID),
+        "w4": params["fc2_kernel"],
+        "b4": params["fc2_bias"].reshape(1, F_OUT),
+    }
+
+
+def unflatten_grads(g: FusedGrads) -> dict:
+    """Kernel gradient layout -> model params pytree (for the SGD update)."""
+    return {
+        "conv1_kernel": g.w1.reshape(K, K, 1, C1),
+        "conv1_bias": g.b1.reshape(C1),
+        "conv2_kernel": g.w2.reshape(K, K, C1, C2),
+        "conv2_bias": g.b2.reshape(C2),
+        "fc1_kernel": g.w3,
+        "fc1_bias": g.b3.reshape(F_HID),
+        "fc2_kernel": g.w4,
+        "fc2_bias": g.b4.reshape(F_OUT),
+    }
+
+
+def make_fused_train_step(*, learning_rate: float, momentum: float,
+                          conv_dropout_rate: float = 0.5,
+                          fc_dropout_rate: float = 0.5):
+    """Drop-in replacement for ``train.step.make_train_step`` built on the fused kernel:
+    ``step(state, images, labels, rng) -> (state, loss)``. Dropout masks are drawn outside
+    the kernel from the same per-step fold-in discipline; the update runs through the fused
+    Pallas SGD kernel."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_kernels import (
+        sgd_momentum_step,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        TrainState,
+    )
+
+    keep2, keep1 = 1.0 - conv_dropout_rate, 1.0 - fc_dropout_rate
+
+    def step(state, images, labels, rng):
+        b = images.shape[0]
+        step_rng = jax.random.fold_in(rng, state.step)
+        k2, k1 = jax.random.split(step_rng)
+        drop2 = jax.random.bernoulli(k2, keep2, (b, C2)).astype(jnp.float32) / keep2
+        drop1 = jax.random.bernoulli(k1, keep1, (b, F_HID)).astype(jnp.float32) / keep1
+        loss, grads = fused_loss_and_grads(
+            flatten_params(state.params), images, labels, drop2, drop1)
+        params, velocity = sgd_momentum_step(
+            state.params, state.velocity, unflatten_grads(grads),
+            learning_rate=learning_rate, momentum=momentum)
+        return TrainState(params, velocity, state.step + 1), loss
+
+    return step

@@ -78,16 +78,28 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     train_x, train_y = jnp.asarray(train_ds.images), jnp.asarray(train_ds.labels)
     test_x, test_y = jnp.asarray(test_ds.images), jnp.asarray(test_ds.labels)
 
-    segment_fn = jax.jit(
-        make_epoch_fn(model, learning_rate=config.learning_rate,
-                      momentum=config.momentum,
-                      use_pallas=config.use_pallas_kernels),
-        donate_argnums=(0,))
-    step_fn = jax.jit(
-        make_train_step(model, learning_rate=config.learning_rate,
-                        momentum=config.momentum,
-                        use_pallas=config.use_pallas_kernels),
-        donate_argnums=(0,))
+    if config.use_fused_step:
+        from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_fused import (
+            make_fused_train_step,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+            make_epoch_from_step,
+        )
+        raw_step = make_fused_train_step(learning_rate=config.learning_rate,
+                                         momentum=config.momentum)
+        segment_fn = jax.jit(make_epoch_from_step(raw_step), donate_argnums=(0,))
+        step_fn = jax.jit(raw_step, donate_argnums=(0,))
+    else:
+        segment_fn = jax.jit(
+            make_epoch_fn(model, learning_rate=config.learning_rate,
+                          momentum=config.momentum,
+                          use_pallas=config.use_pallas_kernels),
+            donate_argnums=(0,))
+        step_fn = jax.jit(
+            make_train_step(model, learning_rate=config.learning_rate,
+                            momentum=config.momentum,
+                            use_pallas=config.use_pallas_kernels),
+            donate_argnums=(0,))
     eval_fn = jax.jit(make_eval_fn(model, batch_size=config.batch_size_test))
 
     history = M.MetricsHistory()

@@ -115,6 +115,13 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
     """
     train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum,
                                  use_pallas=use_pallas)
+    return make_epoch_from_step(train_step, unroll=unroll)
+
+
+def make_epoch_from_step(train_step: Callable, *, unroll: int = 1) -> Callable:
+    """Wrap any ``step(state, images, labels, rng)`` into the scanned epoch program
+    (same contract as ``make_epoch_fn`` — used for alternative step implementations such
+    as the fused Pallas step, ``ops/pallas_fused.py``)."""
 
     def epoch(state: TrainState, images, labels, idx_matrix, rng):
         def body(state, idx):

@@ -39,6 +39,9 @@ class SingleProcessConfig:
                                       # reference lacks, SURVEY.md §5 "checkpoint/resume")
     use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
                                       # (ops/pallas_kernels.py; single-device step path)
+    use_fused_step: bool = False      # run the ENTIRE train step (fwd+bwd+update) through
+                                      # the whole-model Pallas kernel (ops/pallas_fused.py;
+                                      # single-device path, flagship model only)
     use_host_pipeline: bool = False   # feed batches through the native C++ threaded
                                       # prefetcher (the DataLoader num_workers=4 analog,
                                       # src/train_dist.py:43-45) instead of the device-
