@@ -204,9 +204,11 @@ def fused_loss_and_grads(params_flat: dict, images: jax.Array, labels: jax.Array
     if batch_block is None:
         bb = next(d for d in range(min(BATCH_BLOCK, b), 0, -1) if b % d == 0)
     else:
-        bb = min(batch_block, b)
-    if b % bb:
-        raise ValueError(f"batch {b} not divisible by batch block {bb}")
+        bb = batch_block
+        if bb < 1:
+            raise ValueError(f"batch block must be >= 1, got {bb}")
+        if b % bb:
+            raise ValueError(f"batch {b} not divisible by batch block {bb}")
     grid = (b // bb,)
 
     row = lambda width: pl.BlockSpec((bb,) + width, lambda i: (i,) + (0,) * len(width),
@@ -273,19 +275,74 @@ def unflatten_grads(g: FusedGrads) -> dict:
     }
 
 
+def probe_compiles(batch: int = BATCH_BLOCK) -> Exception | None:
+    """Eagerly compile + run the fused kernel once on a dummy batch; returns the failure
+    (or None).  On TPU this exercises the real Mosaic compile path — the interpreter used
+    everywhere else cannot prove the hardware lowering works, so callers that opt into the
+    fused step should probe before committing to it (advisor finding r1).  Block shapes
+    are batch-dependent (the auto-picked block is the largest divisor of ``batch`` ≤
+    BATCH_BLOCK), so probe with the batch size you will train at."""
+    try:
+        flat = {
+            "w1": jnp.zeros((K * K, C1)), "b1": jnp.zeros((1, C1)),
+            "w2": jnp.zeros((K * K * C1, C2)), "b2": jnp.zeros((1, C2)),
+            "w3": jnp.zeros((F_IN, F_HID)), "b3": jnp.zeros((1, F_HID)),
+            "w4": jnp.zeros((F_HID, F_OUT)), "b4": jnp.zeros((1, F_OUT)),
+        }
+        loss, _ = fused_loss_and_grads(
+            flat, jnp.zeros((batch, H, W, 1)), jnp.zeros((batch,), jnp.int32),
+            jnp.ones((batch, C2)), jnp.ones((batch, F_HID)))
+        jax.block_until_ready(loss)
+        return None
+    except Exception as e:  # Mosaic/XLA compile errors span many exception types
+        return e
+
+
 def make_fused_train_step(*, learning_rate: float, momentum: float,
                           conv_dropout_rate: float = 0.5,
-                          fc_dropout_rate: float = 0.5):
+                          fc_dropout_rate: float = 0.5,
+                          fallback_on_compile_error: bool = False,
+                          probe_batches: tuple[int, ...] = (BATCH_BLOCK,)):
     """Drop-in replacement for ``train.step.make_train_step`` built on the fused kernel:
     ``step(state, images, labels, rng) -> (state, loss)``. Dropout masks are drawn outside
     the kernel from the same per-step fold-in discipline; the update runs through the fused
-    Pallas SGD kernel."""
+    Pallas SGD kernel.
+
+    ``fallback_on_compile_error=True`` probes the kernel's real compile path first
+    (``probe_compiles``, one probe per batch size in ``probe_batches`` — pass the batch
+    sizes the trainer will actually step at, since Mosaic failures can be block-shape
+    dependent) and, if any fails, warns and returns the standard unfused step with the
+    same hyperparameters — so ``--use-fused-step`` degrades to a working trainer instead
+    of crashing.  The probe only runs where Mosaic does (TPU backend): in interpret mode
+    it could only confirm what the test suite already guarantees, at the cost of an extra
+    startup compile."""
     from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_kernels import (
         sgd_momentum_step,
     )
     from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
         TrainState,
     )
+
+    if fallback_on_compile_error and jax.default_backend() == "tpu":
+        err = next((e for e in map(probe_compiles, probe_batches) if e is not None),
+                   None)
+        if err is not None:
+            import warnings
+
+            from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import (
+                Net,
+            )
+            from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+                make_train_step,
+            )
+            warnings.warn(
+                f"fused Pallas step failed to compile on backend "
+                f"'{jax.default_backend()}' ({type(err).__name__}: {err}); "
+                f"falling back to the unfused XLA step", RuntimeWarning)
+            return make_train_step(
+                Net(conv_dropout_rate=conv_dropout_rate,
+                    fc_dropout_rate=fc_dropout_rate),
+                learning_rate=learning_rate, momentum=momentum)
 
     keep2, keep1 = 1.0 - conv_dropout_rate, 1.0 - fc_dropout_rate
 

@@ -85,8 +85,14 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
             make_epoch_from_step,
         )
-        raw_step = make_fused_train_step(learning_rate=config.learning_rate,
-                                         momentum=config.momentum)
+        # Probe every batch size this run will actually step at (main batches plus the
+        # drop_last=False tail) — Mosaic compile failures can be block-shape dependent.
+        tail = len(train_ds) % config.batch_size_train
+        raw_step = make_fused_train_step(
+            learning_rate=config.learning_rate, momentum=config.momentum,
+            fallback_on_compile_error=True,
+            probe_batches=tuple(dict.fromkeys(
+                b for b in (config.batch_size_train, tail) if b)))
         segment_fn = jax.jit(make_epoch_from_step(raw_step), donate_argnums=(0,))
         step_fn = jax.jit(raw_step, donate_argnums=(0,))
     else:

@@ -113,15 +113,61 @@ def test_batch_block_independence(setup):
 
 
 def test_indivisible_batch_rejected(setup):
+    """An explicit batch_block that does not divide the batch must raise, per the
+    documented contract — never silently clamp (r1 verdict: the old min() clamp meant
+    this contract could not fire)."""
     state, x, y = setup
+    flat = pf.flatten_params(state.params)
     with pytest.raises(ValueError, match="not divisible"):
-        pf.fused_loss_and_grads(pf.flatten_params(state.params), x[:30], y[:30],
-                                jnp.ones((30, pf.C2)), jnp.ones((30, pf.F_HID)))
+        pf.fused_loss_and_grads(flat, x[:30], y[:30],
+                                jnp.ones((30, pf.C2)), jnp.ones((30, pf.F_HID)),
+                                batch_block=16)
+    # batch_block=None auto-picks a dividing block: any batch size must work.
+    loss, _ = pf.fused_loss_and_grads(flat, x[:30], y[:30],
+                                      jnp.ones((30, pf.C2)), jnp.ones((30, pf.F_HID)))
+    assert np.isfinite(float(loss))
+
+
+def test_epoch_trajectory_pinned_to_unfused(setup):
+    """One full scanned epoch (16 steps), fused kernel vs the standard flax/XLA path, with
+    dropout rates 0 so both see identical math: every parameter and the velocity must track
+    step-for-step.  This is the end-to-end wiring oracle — a mis-wired fused trainer
+    diverges immediately even when single-step micro-tests pass."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        make_epoch_from_step,
+    )
+
+    state, _, _ = setup
+    n, batch = 256, 16
+    x = jax.random.normal(jax.random.PRNGKey(20), (n, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(21), (n,), 0, 10)
+    idx = jnp.arange(n, dtype=jnp.int32).reshape(n // batch, batch)
+    rng = jax.random.PRNGKey(7)
+
+    unfused_step = make_train_step(Net(conv_dropout_rate=0.0, fc_dropout_rate=0.0),
+                                   learning_rate=0.05, momentum=0.5)
+    fused_step = pf.make_fused_train_step(learning_rate=0.05, momentum=0.5,
+                                          conv_dropout_rate=0.0, fc_dropout_rate=0.0)
+    s_a, losses_a = jax.jit(make_epoch_from_step(unfused_step))(state, x, y, idx, rng)
+    s_b, losses_b = jax.jit(make_epoch_from_step(fused_step))(state, x, y, idx, rng)
+
+    np.testing.assert_allclose(np.asarray(losses_a), np.asarray(losses_b),
+                               rtol=1e-4, atol=1e-6)
+    assert int(s_a.step) == int(s_b.step) == idx.shape[0]
+    for k in s_a.params:
+        np.testing.assert_allclose(np.asarray(s_a.params[k]), np.asarray(s_b.params[k]),
+                                   rtol=1e-4, atol=1e-6, err_msg=f"param diverged: {k}")
+        np.testing.assert_allclose(np.asarray(s_a.velocity[k]),
+                                   np.asarray(s_b.velocity[k]),
+                                   rtol=1e-4, atol=1e-6, err_msg=f"velocity: {k}")
 
 
 def test_trainer_with_fused_step_trains(tmp_path):
-    """End-to-end single trainer with --use-fused-step: the whole-model kernel drives a real
-    epoch (scan over fused steps) and the loss drops on a learnable task."""
+    """End-to-end single trainer with --use-fused-step: the whole-model kernel drives real
+    epochs and the loss drops on a learnable task.  Settings (lr=0.1, 4 epochs) are chosen
+    so the UNFUSED trainer also clears the same threshold under dropout — r1's version
+    failed on settings where neither path learned fast enough, which said nothing about
+    the kernel."""
     from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
         Dataset, _normalize, _synthesize_split,
     )
@@ -136,9 +182,52 @@ def test_trainer_with_fused_step_trains(tmp_path):
     test = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
 
     cfg = SingleProcessConfig(
-        n_epochs=2, batch_size_train=64, batch_size_test=100,
-        learning_rate=0.05, log_interval=8, use_fused_step=True,
+        n_epochs=4, batch_size_train=64, batch_size_test=100,
+        learning_rate=0.1, log_interval=8, use_fused_step=True,
         results_dir=str(tmp_path / "results"), images_dir=str(tmp_path / "images"))
     state, history = single.main(cfg, datasets=(train, test))
-    assert int(state.step) == 2 * 16
-    assert history.test_losses[-1] < history.test_losses[0] - 0.1
+    assert int(state.step) == 4 * 16
+    assert history.test_losses[-1] < history.test_losses[0] - 0.3
+
+
+def test_compile_probe_and_fallback(monkeypatch):
+    """The probe must pass on every backend where the suite runs (interpret mode off-TPU,
+    Mosaic on TPU), and the fallback path must produce a working unfused step when the
+    probe reports failure on a TPU backend (the only place the probe runs — in interpret
+    mode it proves nothing this suite doesn't already)."""
+    assert pf.probe_compiles(batch=4) is None
+
+    # Force the failure branch (pretend we're on TPU with a probe that fails) and confirm
+    # the returned step still trains.
+    monkeypatch.setattr(pf.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(pf, "probe_compiles", lambda batch=4: RuntimeError("forced"))
+    try:
+        with pytest.warns(RuntimeWarning, match="falling back"):
+            step = pf.make_fused_train_step(learning_rate=0.05, momentum=0.5,
+                                            fallback_on_compile_error=True)
+    finally:
+        monkeypatch.undo()
+    state = create_train_state(Net(), jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 10)
+    new_state, loss = jax.jit(step)(state, x, y, jax.random.PRNGKey(3))
+    assert np.isfinite(float(loss)) and int(new_state.step) == 1
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="real Mosaic compile path only exists on TPU hardware")
+def test_fused_step_on_tpu_matches_unfused(setup):
+    """TPU-gated hardware smoke (advisor r1): compile the fused kernel through Mosaic (not
+    the interpreter) and pin one full optimizer step against the unfused XLA path."""
+    state, x, y = setup
+    unfused = make_train_step(Net(conv_dropout_rate=0.0, fc_dropout_rate=0.0),
+                              learning_rate=0.01, momentum=0.5)
+    fused = pf.make_fused_train_step(learning_rate=0.01, momentum=0.5,
+                                     conv_dropout_rate=0.0, fc_dropout_rate=0.0)
+    rng = jax.random.PRNGKey(7)
+    s_a, loss_a = jax.jit(unfused)(state, x, y, rng)
+    s_b, loss_b = jax.jit(fused)(state, x, y, rng)
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-4)
+    for k in s_a.params:
+        np.testing.assert_allclose(np.asarray(s_a.params[k]), np.asarray(s_b.params[k]),
+                                   rtol=1e-3, atol=1e-5, err_msg=k)
