@@ -6,35 +6,66 @@ e2-standard-8 CPU machine and ≈7.6 on four machines with DDP/gloo, unit unlabe
 (4-machine, 7.6) figure under the *most conservative* reading of its unlabeled y-axis —
 seconds. Anything >1 beats the whole reference cluster with this framework.
 
-Measurement protocol (warmup + median of 3 timed epochs, each closed by a host fetch of the
-epoch's final loss scalar — not ``block_until_ready``, which can resolve at enqueue-ack on
-tunnelled PJRT backends): ``utils/benchmarks.py``.
+Robustness (r1 verdict item 1): the round-1 bench died with rc=1 on a transient
+``UNAVAILABLE: TPU backend setup/compile error`` — and a backend-init failure is cached
+in-process by jax, while a wedged TPU claim can make init *hang* rather than fail. So the
+measurement runs in a CHILD process driven by a parent retry loop: each attempt gets a fresh
+interpreter and a hard deadline (graceful SIGTERM first — SIGKILL on a process holding the
+TPU claim wedges the lease); on exhausting the retry budget (``BENCH_TPU_RETRY_SECONDS``,
+default 900) the parent re-runs the child on the CPU backend so the round still records a
+real, parseable measurement — clearly labeled ``"platform": "cpu"`` with the TPU failure in
+``fallback_reason`` — instead of a stack trace.
+
+Throughput/MFU (r1 verdict item 3): alongside epoch seconds the JSON carries steps/s,
+examples/s, achieved model FLOP/s, and an MFU estimate against the chip's bf16 peak (the
+model runs f32, so the estimate is conservative). Model FLOPs/step are computed statically
+from the flagship architecture (SURVEY.md §3.4).
+
+Measurement protocol (warmup + median of 3 timed epochs, each closed by a host fetch of a
+scalar data-dependent on the epoch's final *parameter update* — not ``block_until_ready``,
+which can resolve at enqueue-ack on tunnelled PJRT backends): ``utils/benchmarks.py``.
 
 Prints exactly ONE JSON line on stdout.
 """
 
 import json
-
-import jax
-import numpy as np
-
-from csed_514_project_distributed_training_using_pytorch_tpu.data import load_mnist
-from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
-from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
-    data_parallel as dp,
-)
-from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import make_mesh
-from csed_514_project_distributed_training_using_pytorch_tpu.train.step import make_eval_fn
-from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
-    GLOBAL_BATCH, LEARNING_RATE, MOMENTUM, time_epochs,
-)
+import os
+import signal
+import subprocess
+import sys
+import time
 
 BASELINE_BEST = 7.6          # reference 4-machine DDP/gloo epoch time (BASELINE.md)
 
 
-def run() -> dict:
+def measure() -> dict:
+    """The actual measurement — runs in the child process (``bench.py --inner``)."""
+    import jax
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.data import load_mnist
+    from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        data_parallel as dp,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        make_eval_fn,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        GLOBAL_BATCH, LEARNING_RATE, MOMENTUM, TRAIN_FLOPS_PER_EXAMPLE, peak_flops,
+        time_epochs,
+    )
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.data import mnist
+
     mesh = make_mesh()
     train_ds, test_ds = load_mnist("files")
+    # Functional-test knob only — the published protocol is the full 60k split (0).
+    truncated_to = int(os.environ.get("BENCH_MAX_TRAIN_EXAMPLES", "0"))
+    full_split = truncated_to <= 0 or truncated_to >= len(train_ds)
+    train_ds = mnist.truncate(train_ds, truncated_to)
 
     result = time_epochs(mesh, train_ds, global_batch=GLOBAL_BATCH,
                          learning_rate=LEARNING_RATE, momentum=MOMENTUM,
@@ -46,14 +77,35 @@ def run() -> dict:
     sum_nll, correct = jax.device_get(
         eval_fn(result.final_state.params, test_x, test_y))
 
+    dev = jax.devices()[0]
+    examples_per_epoch = result.steps_per_epoch * GLOBAL_BATCH
+    examples_per_s = examples_per_epoch / result.median_seconds
+    achieved_flops = examples_per_s * TRAIN_FLOPS_PER_EXAMPLE
+    peak = peak_flops(getattr(dev, "device_kind", "")) if dev.platform == "tpu" else None
+
     return {
-        "metric": "MNIST 1-epoch wall-clock (60k examples, global batch 64)",
+        # A truncated functional run is labeled as such and never compared against the
+        # reference's FULL-epoch time — a 16-step "epoch" beating 7.6 s means nothing.
+        "metric": ("MNIST 1-epoch wall-clock (60k examples, global batch 64)"
+                   if full_split else
+                   f"MNIST truncated-epoch wall-clock ({len(train_ds)} examples, "
+                   f"global batch 64) — FUNCTIONAL TEST, not the published protocol"),
         "value": round(result.median_seconds, 4),
         "unit": "s",
-        "vs_baseline": round(BASELINE_BEST / result.median_seconds, 2),
+        "vs_baseline": (round(BASELINE_BEST / result.median_seconds, 2)
+                        if full_split else None),
         "devices": result.devices,
-        "platform": jax.devices()[0].platform,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
         "steps_per_epoch": result.steps_per_epoch,
+        "train_examples": len(train_ds),
+        "steps_per_s": round(result.steps_per_epoch / result.median_seconds, 1),
+        "examples_per_s": round(examples_per_s, 1),
+        "model_train_flops_per_example": TRAIN_FLOPS_PER_EXAMPLE,
+        "achieved_model_flops_per_s": round(achieved_flops),
+        "mfu_vs_bf16_peak": (round(achieved_flops / (peak * result.devices), 8)
+                             if peak else None),
+        "epoch_seconds_all": [round(t, 4) for t in result.epoch_seconds],
         "final_train_loss": round(result.final_train_loss, 4),
         "test_nll_after_4_epochs": round(float(sum_nll) / len(test_ds), 4),
         "test_accuracy_after_4_epochs": round(float(correct) / len(test_ds), 4),
@@ -61,5 +113,100 @@ def run() -> dict:
     }
 
 
+def _parse_child_json(out: str) -> dict | None:
+    """Last stdout line of a child as a JSON object, or None if it isn't one."""
+    try:
+        payload = json.loads(out.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def _run_child(env_overrides: dict, timeout_s: float) -> tuple[int | None, str, str]:
+    """One measurement attempt in a fresh interpreter. Returns (rc, stdout, stderr);
+    rc=None on timeout. Termination is graceful (SIGTERM, then a grace period) — a
+    SIGKILLed holder of the tunnelled TPU claim wedges the lease for later attempts."""
+    env = dict(os.environ, **env_overrides)
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__), "--inner"],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, env=env)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            out, err = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+        return None, out or "", err or ""
+
+
+def main() -> int:
+    retry_budget = float(os.environ.get("BENCH_TPU_RETRY_SECONDS", "900"))
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_SECONDS", "600"))
+    deadline = time.monotonic() + retry_budget
+
+    attempts, last_error = 0, ""
+    while True:
+        attempts += 1
+        rc, out, err = _run_child({}, attempt_timeout)
+        if rc == 0 and out.strip():
+            payload = _parse_child_json(out)
+            if payload is None:
+                last_error = f"unparseable child stdout: {out[-300:]!r}"
+            else:
+                payload["attempts"] = attempts
+                print(json.dumps(payload))
+                return 0
+        else:
+            tail = (err or out).strip().splitlines()
+            last_error = (f"attempt timed out after {attempt_timeout:.0f}s"
+                          if rc is None else
+                          (tail[-1] if tail else f"child exited rc={rc}"))
+        print(f"bench attempt {attempts} failed: {last_error}", file=sys.stderr)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        time.sleep(min(30.0, 5.0 * attempts, max(1.0, remaining)))
+
+    # Retry budget exhausted — fall back to a labeled CPU measurement so the round still
+    # records a real number instead of a stack trace (r1: BENCH_r01.json was rc=1).
+    print(f"bench: TPU unavailable after {attempts} attempts; falling back to CPU",
+          file=sys.stderr)
+    # Drop only the sitecustomize dir that force-registers the tunnelled TPU plugin
+    # (a failing/hung plugin is the very thing we're falling back from); keep every
+    # other PYTHONPATH entry the user set, with the repo dir prepended.
+    keep = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon_site" not in p]
+    rc, out, err = _run_child(
+        {"JAX_PLATFORMS": "cpu",
+         "PYTHONPATH": os.pathsep.join(
+             [os.path.dirname(os.path.abspath(__file__))] + keep)},
+        max(attempt_timeout, 1800.0))
+    if rc == 0 and out.strip():
+        payload = _parse_child_json(out)
+        if payload is not None:
+            payload["attempts"] = attempts
+            payload["fallback_reason"] = f"tpu unavailable: {last_error}"
+            print(json.dumps(payload))
+            return 0
+        err = f"unparseable CPU-fallback stdout: {out[-300:]!r}"
+
+    # Even the CPU fallback failed: emit a structured, parseable error line.
+    print(json.dumps({
+        "metric": "MNIST 1-epoch wall-clock (60k examples, global batch 64)",
+        "value": None, "unit": "s", "vs_baseline": None,
+        "error": last_error,
+        "cpu_fallback_error": (err or out).strip().splitlines()[-1:],
+        "attempts": attempts,
+    }))
+    return 1
+
+
 if __name__ == "__main__":
-    print(json.dumps(run()))
+    if "--inner" in sys.argv:
+        print(json.dumps(measure()))
+    else:
+        sys.exit(main())

@@ -76,11 +76,80 @@ def run(max_train_examples: int = 0, timed_epochs: int = 3) -> list[dict]:
     return rows
 
 
+def run_batch_sweep(batches: list[int], max_train_examples: int = 0,
+                    timed_epochs: int = 3) -> list[dict]:
+    """Global-batch sweep at fixed (maximum) device count — BASELINE.json configs[3]
+    ("8-chip pmap MNIST ... global-batch sweep 256/1024/4096"). The reference's regime is
+    throughput-oriented weak scaling of work per step: per-device batch = global/N grows
+    with the global batch while the device count stays fixed, so examples/s rising with
+    batch size is the MXU-utilization story the sweep exists to show. Learning rate stays
+    at the reference value — this sweep measures throughput, not convergence tuning.
+
+    Writes one JSON line per batch size, a summary line, and
+    ``images/time_vs_global_batch.png``.
+    """
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        TRAIN_FLOPS_PER_EXAMPLE,
+    )
+
+    n = len(jax.devices())
+    platform = jax.devices()[0].platform
+    train_ds, _ = load_mnist("files")
+    train_ds = mnist.truncate(train_ds, max_train_examples)
+    mesh = make_mesh(n)
+
+    rows = []
+    for gb in batches:
+        if gb % n or gb > len(train_ds):
+            print(json.dumps({"global_batch": gb,
+                              "skipped": f"not divisible by {n} devices or larger "
+                                         f"than the {len(train_ds)}-example split"}),
+                  flush=True)
+            continue
+        result = time_epochs(mesh, train_ds, global_batch=gb,
+                             learning_rate=LEARNING_RATE, momentum=MOMENTUM,
+                             timed_epochs=timed_epochs)
+        examples = result.steps_per_epoch * gb
+        rows.append({
+            "global_batch": gb,
+            "devices": n,
+            "per_device_batch": gb // n,
+            "epoch_seconds": round(result.median_seconds, 4),
+            "examples_per_s": round(examples / result.median_seconds, 1),
+            "achieved_model_flops_per_s": round(
+                examples / result.median_seconds * TRAIN_FLOPS_PER_EXAMPLE),
+            "steps_per_epoch": result.steps_per_epoch,
+            "platform": platform,
+            "data_source": train_ds.source,
+        })
+        print(json.dumps(rows[-1]), flush=True)
+
+    print(json.dumps({
+        "metric": "global-batch sweep, fixed device count (BASELINE.json configs[3])",
+        "devices": n, "platform": platform,
+        "measured": [{k: r[k] for k in ("global_batch", "epoch_seconds",
+                                        "examples_per_s")} for r in rows],
+    }), flush=True)
+    if rows:
+        plotting.save_batch_sweep_curve(
+            [r["global_batch"] for r in rows], [r["examples_per_s"] for r in rows],
+            "images/time_vs_global_batch.png")
+    return rows
+
+
 if __name__ == "__main__":
     parser = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     parser.add_argument("--max-train-examples", type=int, default=0,
                         help="0 = full 60k (the published protocol); >0 truncates for "
                              "quick functional runs")
     parser.add_argument("--timed-epochs", type=int, default=3)
+    parser.add_argument("--sweep-global-batch", nargs="*", type=int, default=None,
+                        metavar="B",
+                        help="run the global-batch sweep instead of the device sweep "
+                             "(default sizes 256 1024 4096 when given no values)")
     args = parser.parse_args()
-    run(args.max_train_examples, args.timed_epochs)
+    if args.sweep_global_batch is not None:
+        run_batch_sweep(args.sweep_global_batch or [256, 1024, 4096],
+                        args.max_train_examples, args.timed_epochs)
+    else:
+        run(args.max_train_examples, args.timed_epochs)

@@ -38,7 +38,22 @@ if _requested_platforms and "axon" not in _requested_platforms.split(","):
 
     # The hook pins "axon" first in the platform priority list (observed: "axon,cpu").
     if (_jax.config.jax_platforms or "").split(",")[0] == "axon":
-        _jax.config.update("jax_platforms", _requested_platforms)
+        try:
+            from jax._src import xla_bridge as _xb
+            _too_late = _xb.backends_are_initialized()
+        except (ImportError, AttributeError):   # private API — fail open
+            _too_late = False
+        if _too_late:
+            # The config flip below would be a silent no-op (or an error): make the
+            # platform mismatch visible instead (advisor finding r1).
+            import warnings as _warnings
+            _warnings.warn(
+                f"JAX_PLATFORMS={_requested_platforms!r} was requested, but a JAX "
+                f"backend already initialized under the startup hook's 'axon' pin — "
+                f"import this package (or set the env var) before touching "
+                f"jax.devices() to get the requested platform.", RuntimeWarning)
+        else:
+            _jax.config.update("jax_platforms", _requested_platforms)
 
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
     SingleProcessConfig,

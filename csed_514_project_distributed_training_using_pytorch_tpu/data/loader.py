@@ -82,7 +82,13 @@ class BatchLoader:
         ``src/train_dist.py:43-45``); falls back to the plain ``__iter__`` gather when the
         native library isn't built. Full batches only (the plan is rectangular)."""
         from csed_514_project_distributed_training_using_pytorch_tpu.data import native
-        plan = self.epoch_index_matrix(epoch)
+        # allow_empty so a split smaller than one batch yields zero full batches here and
+        # leaves the ragged tail to the caller — identical contract to the scan fast path
+        # (advisor finding r1: the old allow_empty=False raised where the scan path
+        # trained fine).
+        plan = self.epoch_index_matrix(epoch, allow_empty=True)
+        if plan.shape[0] == 0:
+            return
         if not native.available():
             for row in plan:
                 yield self.dataset.images[row], self.dataset.labels[row]

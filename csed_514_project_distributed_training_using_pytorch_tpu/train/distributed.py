@@ -76,6 +76,22 @@ def epoch_index_plan(samplers: list[ShardedSampler], epoch: int,
     return np.concatenate(blocks, axis=1)
 
 
+def _host_local_columns(mesh, per_replica_batch: int) -> tuple[int, int]:
+    """This process's contiguous column block of the ``[steps, global_batch]`` plan: the
+    rows owned by its addressable devices under the ``P('data')`` batch sharding. The
+    device order of the mesh groups devices by process (jax.devices() ordering), which the
+    host-local feed contract requires (``dp.global_batch_from_host_local``); asserted, not
+    assumed."""
+    mesh_devs = list(mesh.devices.flat)
+    local_ids = {d.id for d in jax.local_devices()}
+    positions = [i for i, d in enumerate(mesh_devs) if d.id in local_ids]
+    if positions != list(range(positions[0], positions[0] + len(positions))):
+        raise RuntimeError(
+            f"addressable devices are not contiguous in the mesh ({positions}) — the "
+            f"host-local feed path requires process-contiguous device order")
+    return positions[0] * per_replica_batch, (positions[-1] + 1) * per_replica_batch
+
+
 def main(config: DistributedConfig = DistributedConfig(), *,
          num_devices: int | None = None,
          datasets=None) -> tuple[TrainState, M.MetricsHistory]:
@@ -105,10 +121,29 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                                seed=config.sampler_seed) for r in range(world)]
 
     model = Net()
-    state = jax.device_put(create_train_state(model, init_rng), dp.replicated(mesh))
+    state = create_train_state(model, init_rng)
+    steps_per_epoch = samplers[0].num_samples // per_replica_batch
+    start_epoch = 0
+    if config.resume_from:                        # the resume path the reference lacks
+        # Checkpoints are process-0-gated writes, so on a fleet without a shared
+        # filesystem only process 0 can read one back: restore there and broadcast the
+        # full TrainState to every process (the resume analog of DDP's initial param
+        # broadcast, reference src/train_dist.py:63).
+        if info.process_index == 0:
+            state = checkpoint.restore_train_state(config.resume_from, state)
+        if info.process_count > 1:
+            from jax.experimental import multihost_utils
+            state = jax.tree_util.tree_map(
+                np.asarray, multihost_utils.broadcast_one_to_all(state))
+        start_epoch = int(state.step) // max(steps_per_epoch, 1)
+        M.log(f"Resumed from {config.resume_from} at step {int(state.step)} "
+              f"(starting epoch {start_epoch})")
+    state = jax.device_put(state, dp.replicated(mesh))
+    ckpt_path = os.path.join(config.results_dir, "model_dist.ckpt")
 
-    train_x = dp.put_global(mesh, train_ds.images, P())
-    train_y = dp.put_global(mesh, train_ds.labels, P())
+    if not config.host_local_feed:
+        train_x = dp.put_global(mesh, train_ds.images, P())
+        train_y = dp.put_global(mesh, train_ds.labels, P())
     eval_spec = P("data") if config.shard_eval else P()
     test_x = dp.put_global(mesh, test_ds.images, eval_spec)
     test_y = dp.put_global(mesh, test_ds.labels, eval_spec)
@@ -120,13 +155,46 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         make_eval_fn(model, batch_size=config.batch_size_test), mesh,
         shard=config.shard_eval)
 
+    if config.host_local_feed:
+        from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+            make_train_step,
+        )
+        step_fn = dp.compile_step(
+            make_train_step(model, learning_rate=config.learning_rate,
+                            momentum=config.momentum), mesh)
+        col_lo, col_hi = _host_local_columns(mesh, per_replica_batch)
+        M.log(f"Host-local feed: this process feeds global-batch columns "
+              f"[{col_lo}:{col_hi}]")
+
+    def run_epoch_device_resident(state, plan):
+        """Fast path: whole epoch as one compiled scan over the device-resident split."""
+        plan_d = dp.put_global(mesh, plan, P(None, "data"))
+        return epoch_fn(state, train_x, train_y, plan_d, dropout_rng)
+
+    def run_epoch_host_local(state, plan):
+        """Multi-host input pipeline (SURVEY.md §7 hard part (d)): per step, this process
+        gathers ONLY its addressable devices' rows of the global batch on host and
+        assembles the globally-sharded arrays from per-process shards — the dataset never
+        needs to be resident on (or even known to) other hosts. Identical plan and step
+        math to the fast path; only the feeding mechanism differs."""
+        losses = []
+        for s in range(plan.shape[0]):
+            local_idx = plan[s, col_lo:col_hi]
+            gi, gl = dp.global_batch_from_host_local(
+                mesh, train_ds.images[local_idx], train_ds.labels[local_idx])
+            state, loss = step_fn(state, gi, gl, dropout_rng)
+            losses.append(loss)
+        return state, jax.numpy.stack(losses)
+
     history = M.MetricsHistory()
 
     with maybe_profile(config.profile and M.is_logging_process(), config.profile_dir):
-        for epoch in range(config.epochs):        # ≙ the epoch loop, :70
+        for epoch in range(start_epoch, config.epochs):   # ≙ the epoch loop, :70
             plan = epoch_index_plan(samplers, epoch, per_replica_batch)  # ≙ set_epoch, :72
-            plan_d = dp.put_global(mesh, plan, P(None, "data"))
-            state, losses = epoch_fn(state, train_x, train_y, plan_d, dropout_rng)
+            if config.host_local_feed:
+                state, losses = run_epoch_host_local(state, plan)
+            else:
+                state, losses = run_epoch_device_resident(state, plan)
 
             losses = np.asarray(jax.device_get(losses))
             train_loss = float(losses.mean())     # per-epoch mean of per-step global means
@@ -142,6 +210,9 @@ def main(config: DistributedConfig = DistributedConfig(), *,
             history.record_test(examples, val_loss)
             M.log(M.dist_epoch_summary_line(epoch, train_loss, val_loss, accuracy,
                                             watch.elapsed()))  # ≙ :113-114
+            # Per-epoch full-state checkpoint (process-0 gated, atomic) so a killed run
+            # can resume with --resume-from; the reference only ever saves final params.
+            checkpoint.save_train_state(ckpt_path, state)
 
     assert_replicas_synced(state.params)          # the desync "race detector" (SURVEY.md §5)
 

@@ -9,12 +9,14 @@ Protocol details (SURVEY.md §7 hard part (c)):
 
 - the whole epoch is ONE jit-compiled scanned program over the mesh (no per-step Python);
 - one untimed warmup epoch pays for compilation and data fault-in;
-- each timed epoch is closed by a device→host fetch of the epoch's final loss scalar. The
-  fetch — not ``block_until_ready`` — is the sync point on purpose: on tunnelled/experimental
+- each timed epoch is closed by a device→host fetch of a scalar that is data-dependent on
+  the epoch's final loss AND on the final step's parameter update (a leaf of the returned
+  state), so the last backward/all-reduce/SGD cannot still be in flight at t1. The fetch —
+  not ``block_until_ready`` — is the sync point on purpose: on tunnelled/experimental
   PJRT backends (this build image's axon TPU) ``block_until_ready`` can resolve at
   enqueue-ack rather than device completion and under-reports by orders of magnitude
-  (measured: 1.6 ms for a 937-step epoch); a transfer of a value data-dependent on the whole
-  epoch cannot lie.
+  (measured: 1.6 ms for a 937-step epoch); a transfer of a value data-dependent on the
+  whole epoch cannot lie.
 """
 
 from __future__ import annotations
@@ -47,6 +49,31 @@ from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
 GLOBAL_BATCH = 64
 LEARNING_RATE = 0.01
 MOMENTUM = 0.5
+
+# Per-example model FLOPs, forward pass, computed statically from the flagship
+# architecture (models/cnn.py; SURVEY.md §3.4): conv as 2·H_out·W_out·C_out·(K·K·C_in)
+# MACs, dense as 2·in·out.
+FWD_FLOPS_PER_EXAMPLE = (
+    2 * 24 * 24 * 10 * (5 * 5 * 1)      # conv1: 288,000
+    + 2 * 8 * 8 * 20 * (5 * 5 * 10)     # conv2: 640,000
+    + 2 * 320 * 50                      # fc1:    32,000
+    + 2 * 50 * 10                       # fc2:     1,000
+)
+TRAIN_FLOPS_PER_EXAMPLE = 3 * FWD_FLOPS_PER_EXAMPLE   # fwd + ~2× for backward
+
+# bf16 peak per chip by device_kind substring (public spec sheets). The model computes in
+# f32, so an MFU against bf16 peak is a conservative lower bound. Ordered: first match
+# wins, so more specific kinds come before their prefixes.
+PEAK_FLOPS_BY_KIND = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12), ("v4", 275e12),
+    ("v3", 123e12), ("v2", 45e12),
+]
+
+
+def peak_flops(device_kind: str) -> float | None:
+    """bf16 peak FLOP/s for a TPU ``device_kind`` string, or None if unknown."""
+    kind = device_kind.lower()
+    return next((peak for key, peak in PEAK_FLOPS_BY_KIND if key in kind), None)
 
 
 @dataclass(frozen=True)
@@ -93,7 +120,13 @@ def time_epochs(mesh: Mesh, train_ds: Dataset, *, global_batch: int = 64,
         plan = epoch_index_plan(samplers, epoch, global_batch // world)
         plan_d = dp.put_global(mesh, plan, P(None, "data"))
         state, losses = epoch_fn(state, train_x, train_y, plan_d, rng)
-        final_loss = float(jax.device_get(losses[-1]))   # the honest sync point
+        # The honest sync point: fetch a scalar data-dependent on BOTH the final step's
+        # forward (losses[-1]) and its backward/all-reduce/SGD update (a parameter leaf of
+        # the returned state) — losses[-1] alone would let the last update stay in flight
+        # at t1 (advisor finding r1).
+        probe = losses[-1] + jax.tree_util.tree_leaves(state.params)[0].ravel()[0]
+        jax.device_get(probe)
+        final_loss = float(jax.device_get(losses[-1]))
         return state, final_loss, plan.shape[0]
 
     state, final_loss, steps = one_epoch(state, 0)       # warmup: compile + fault-in

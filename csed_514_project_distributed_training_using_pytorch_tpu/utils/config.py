@@ -68,6 +68,15 @@ class DistributedConfig:
     shard_eval: bool = False          # False reproduces the reference's every-rank-evaluates-
                                       # the-full-test-set behavior (src/train_dist.py:21-24,
                                       # §2d.7); True shards eval + psums the sums.
+    resume_from: str = ""             # full-TrainState checkpoint to resume from (the
+                                      # restore path the reference lacks; the distributed
+                                      # trainer writes one per epoch to
+                                      # results_dir/model_dist.ckpt)
+    host_local_feed: bool = False     # multi-host input pipeline: each process gathers and
+                                      # feeds ONLY its addressable devices' shard of every
+                                      # batch (SURVEY.md §7 hard part (d)) instead of the
+                                      # device-resident replicated dataset + on-device
+                                      # gather fast path; same plan, same math
     profile: bool = False
     profile_dir: str = "results/profile"
     max_train_examples: int = 0       # 0 = full split; >0 truncates (dev/CI shortening —

@@ -75,6 +75,24 @@ def save_loss_curves(history: MetricsHistory, path: str) -> str | None:
     return path
 
 
+def save_batch_sweep_curve(global_batches: list[int], examples_per_s: list[float],
+                           path: str) -> str | None:
+    """Training throughput vs global batch size at fixed device count — the
+    BASELINE.json configs[3] sweep (256/1024/4096) artifact."""
+    if not (HAVE_MATPLOTLIB and is_logging_process()):
+        return None
+    _ensure_dir(path)
+    fig = plt.figure()
+    plt.plot(global_batches, examples_per_s, marker="o")
+    plt.xscale("log", base=2)
+    plt.xlabel("Global batch size")
+    plt.ylabel("Training throughput (examples/s)")
+    plt.title("Throughput vs. global batch size (fixed device count)")
+    fig.savefig(path)
+    plt.close(fig)
+    return path
+
+
 def save_scaling_curve(worker_counts: list[int], epoch_seconds: list[float],
                        path: str) -> str | None:
     """Time-to-train-one-epoch vs number of workers — the reference's headline result

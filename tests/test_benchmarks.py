@@ -44,3 +44,36 @@ def test_time_epochs_trains():
 def test_indivisible_batch_rejected(tiny_ds):
     with pytest.raises(ValueError, match="not divisible"):
         time_epochs(make_mesh(3), tiny_ds, global_batch=64)
+
+
+def test_flops_constants_and_peak_lookup():
+    """Static model-FLOPs arithmetic (SURVEY.md §3.4 shapes) and the device-kind → peak
+    mapping behind the bench's MFU estimate."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import benchmarks as B
+
+    assert B.FWD_FLOPS_PER_EXAMPLE == 288_000 + 640_000 + 32_000 + 1_000
+    assert B.TRAIN_FLOPS_PER_EXAMPLE == 3 * B.FWD_FLOPS_PER_EXAMPLE
+    assert B.peak_flops("TPU v5 lite") == 197e12
+    assert B.peak_flops("TPU v5p") == 459e12
+    assert B.peak_flops("TPU v4") == 275e12
+    assert B.peak_flops("warp drive") is None
+
+
+def test_batch_sweep_functional(tmp_path, monkeypatch):
+    """run_batch_sweep on tiny data: one row per admissible batch size, skip markers for
+    inadmissible ones, throughput fields populated, and the plot artifact written."""
+    import json
+    import bench_scaling
+
+    imgs, labels = mnist._synthesize_split(512, seed=5)
+    ds = Dataset(mnist._normalize(imgs), labels.astype(np.int32), "synthetic")
+    monkeypatch.setattr(bench_scaling, "load_mnist", lambda _: (ds, ds))
+    monkeypatch.chdir(tmp_path)
+
+    rows = bench_scaling.run_batch_sweep([64, 256, 4096], timed_epochs=1)
+    assert [r["global_batch"] for r in rows] == [64, 256]   # 4096 > 512 examples: skipped
+    for r in rows:
+        assert r["epoch_seconds"] > 0
+        assert r["examples_per_s"] > 0
+        assert r["per_device_batch"] * r["devices"] == r["global_batch"]
+    assert (tmp_path / "images" / "time_vs_global_batch.png").exists()

@@ -132,3 +132,11 @@ def test_epoch_index_matrix():
     loader = BatchLoader(_tiny_dataset(100), 8, shuffle=True, seed=3)
     mat = loader.epoch_index_matrix(0, steps_multiple=5)
     assert mat.shape == (10, 8)  # 12 full batches -> truncated to multiple of 5
+
+
+def test_prefetch_iter_tiny_dataset_yields_nothing():
+    """A split smaller than one batch has zero full batches: prefetch_iter must yield
+    nothing (leaving the ragged tail to the caller, like the scan fast path) instead of
+    raising — advisor finding r1 on the host-pipeline trainer."""
+    loader = BatchLoader(_tiny_dataset(40), 64, shuffle=True, seed=1)
+    assert list(loader.prefetch_iter(1)) == []
