@@ -4,12 +4,21 @@ TPU kernel, plus the fused SGD update.
 The reference executes its hot loop as ~dozens of separate ATen kernels chained by the C++
 autograd engine (forward ``src/model.py:15-22``, backward ``src/train.py:75``); the default
 XLA path here compiles the same math into a fused-but-multi-kernel program. This module goes
-one step further down the stack — the whole step body (both convs via im2col matmuls on the
-MXU, both poolings, both dropouts, both dense layers, log-softmax + NLL, and the full
-backward chain to every weight gradient) runs as ONE Pallas kernel, gridded over batch
-blocks with gradient accumulation in VMEM-resident output refs, followed by the fused SGD
-kernel from ``ops/pallas_kernels.py``. Per-step HBM traffic collapses to: batch in, grads +
-loss out; every activation lives and dies in VMEM.
+one step further down the stack — the whole step body (both convs as shifted-slice matmul
+accumulations on the MXU, both poolings, both dropouts, both dense layers, log-softmax + NLL,
+and the full backward chain to every weight gradient) runs as ONE Pallas kernel, gridded over
+batch blocks with gradient accumulation in VMEM-resident output refs, followed by the fused
+SGD kernel from ``ops/pallas_kernels.py``. Per-step HBM traffic collapses to: batch in,
+grads + loss out; every activation lives and dies in VMEM.
+
+Mosaic lowering notes (verified on TPU v5e): the convs deliberately avoid im2col — Mosaic
+rejects concatenation along the lane (last) dimension of narrow-channel patches, and rejects
+lane-merging reshapes like ``[bb,4,4,20] -> [bb,320]`` (``infer-vector-layout: unsupported
+shape cast``) — so conv1 (C_in=1) is 25 shifted broadcast-MACs on the VPU, conv2 is 25
+shifted ``[bb*64, C1] @ [C1, C2]`` MXU matmuls, and fc1 is decomposed over the 16 spatial
+positions of its input (matching the model's (H, W, C) flatten order). The 6-D
+reshape-and-reduce max-pooling, zero-padded-shift scatter adds, in-kernel 2-D transposes,
+and row-slice accumulation into output refs all lower cleanly.
 
 Architecture constants are the flagship model's (models/cnn.py — 28×28×1 input, conv 5×5
 1→10, pool, conv 5×5 10→20, pool, fc 320→50, fc 50→10); like production fused-attention
@@ -26,6 +35,10 @@ distribute-to-ties max-pool backward) and — with dropout disabled — against
 from __future__ import annotations
 
 import functools
+import os
+import signal
+import subprocess
+import sys
 from typing import NamedTuple
 
 import jax
@@ -82,30 +95,6 @@ def _pool_bwd(z, pooled, dpooled, side):
     return dz.reshape(bb, side, side, c)
 
 
-def _im2col(x, out_side):
-    """[BB, s, s, C] -> [BB, out_side, out_side, K*K*C] patches in (ky, kx, c) order —
-    matching an HWIO kernel reshaped to [K*K*C, C_out]."""
-    cols = [x[:, ky:ky + out_side, kx:kx + out_side, :]
-            for ky in range(K) for kx in range(K)]
-    return jnp.concatenate(cols, axis=-1)
-
-
-def _col2im(dpatches, out_side, in_side, c):
-    """Adjoint of `_im2col`: scatter-add patch gradients back to the input feature map,
-    expressed as a sum of zero-padded shifts (static shapes, Mosaic-friendly)."""
-    bb = dpatches.shape[0]
-    acc = jnp.zeros((bb, in_side, in_side, c), jnp.float32)
-    for ky in range(K):
-        for kx in range(K):
-            i = (ky * K + kx) * c
-            piece = dpatches[..., i:i + c]
-            acc = acc + jnp.pad(
-                piece,
-                ((0, 0), (ky, in_side - out_side - ky), (kx, in_side - out_side - kx),
-                 (0, 0)))
-    return acc
-
-
 def _fused_kernel(inv_total, x_ref, lab_ref, d2_ref, d1_ref,
                   w1_ref, b1_ref, w2_ref, b2_ref, w3_ref, b3_ref, w4_ref, b4_ref,
                   loss_ref, dw1_ref, db1_ref, dw2_ref, db2_ref, dw3_ref, db3_ref,
@@ -131,19 +120,33 @@ def _fused_kernel(inv_total, x_ref, lab_ref, d2_ref, d1_ref,
     w4, b4 = w4_ref[:], b4_ref[:]
 
     # ---- forward ----
-    pat1 = _im2col(x, R1)                               # [bb, 24, 24, 25]
-    z1 = (_dot(pat1.reshape(bb * R1 * R1, K * K), w1) + b1).reshape(bb, R1, R1, C1)
+    # conv1 (C_in=1): 25 shifted broadcast-MACs — each tap contributes
+    # x[:, ky:ky+24, kx:kx+24, :] * w1[tap, :] to every output channel at once.
+    z1 = jnp.zeros((bb, R1, R1, C1), jnp.float32) + b1[0, :]
+    for ky in range(K):
+        for kx in range(K):
+            z1 = z1 + x[:, ky:ky + R1, kx:kx + R1, :] * w1[ky * K + kx, :]
     p1 = _pool_fwd(z1, R1)                              # [bb, 12, 12, 10]
     a1 = jnp.maximum(p1, 0.0)
 
-    pat2 = _im2col(a1, R2)                              # [bb, 8, 8, 250]
-    z2 = (_dot(pat2.reshape(bb * R2 * R2, K * K * C1), w2) + b2).reshape(bb, R2, R2, C2)
+    # conv2: 25 shifted [bb*64, C1] @ [C1, C2] MXU matmuls accumulated.
+    z2 = jnp.zeros((bb, R2, R2, C2), jnp.float32) + b2[0, :]
+    for ky in range(K):
+        for kx in range(K):
+            i = (ky * K + kx) * C1
+            s = a1[:, ky:ky + R2, kx:kx + R2, :].reshape(bb * R2 * R2, C1)
+            z2 = z2 + _dot(s, w2[i:i + C1, :]).reshape(bb, R2, R2, C2)
     zd2 = z2 * drop2[:, None, None, :]                  # channelwise Dropout2d
     p2 = _pool_fwd(zd2, R2)                             # [bb, 4, 4, 20]
     a2 = jnp.maximum(p2, 0.0)
-    f = a2.reshape(bb, F_IN)                            # (H, W, C) flatten == model's
 
-    z3 = _dot(f, w3) + b3                               # [bb, 50]
+    # fc1 decomposed over the 16 spatial positions of a2, in the model's (H, W, C)
+    # flatten order: position (y, x) pairs with weight rows [(y*4+x)*C2, +C2).
+    z3 = jnp.zeros((bb, F_HID), jnp.float32) + b3       # [bb, 50]
+    for y in range(P2):
+        for xx in range(P2):
+            i = (y * P2 + xx) * C2
+            z3 = z3 + _dot(a2[:, y, xx, :], w3[i:i + C2, :])
     a3 = jnp.maximum(z3, 0.0)
     a3d = a3 * drop1                                    # elementwise dropout
     z4 = _dot(a3d, w4) + b4                             # [bb, 10]
@@ -164,24 +167,43 @@ def _fused_kernel(inv_total, x_ref, lab_ref, d2_ref, d1_ref,
 
     da3 = _dot(dz4, w4.T) * drop1                       # through dropout
     dz3 = da3 * (z3 > 0.0).astype(jnp.float32)
-    dw3_ref[:] += _dot(f.T, dz3)
     db3_ref[:] += jnp.sum(dz3, axis=0, keepdims=True)
 
-    da2 = _dot(dz3, w3.T).reshape(bb, P2, P2, C2)
+    # fc1 backward, per spatial position: weight-row gradients land in the matching
+    # row slice of dw3; da2 is rebuilt as a sum of zero-padded single-position maps.
+    da2 = jnp.zeros((bb, P2, P2, C2), jnp.float32)
+    for y in range(P2):
+        for xx in range(P2):
+            i = (y * P2 + xx) * C2
+            dw3_ref[i:i + C2, :] += _dot(a2[:, y, xx, :].T, dz3)
+            piece = _dot(dz3, w3[i:i + C2, :].T).reshape(bb, 1, 1, C2)
+            da2 = da2 + jnp.pad(
+                piece, ((0, 0), (y, P2 - 1 - y), (xx, P2 - 1 - xx), (0, 0)))
     dp2 = da2 * (p2 > 0.0).astype(jnp.float32)
     dzd2 = _pool_bwd(zd2, p2, dp2, R2)
     dz2 = dzd2 * drop2[:, None, None, :]
     dz2f = dz2.reshape(bb * R2 * R2, C2)
-    dw2_ref[:] += _dot(pat2.reshape(bb * R2 * R2, K * K * C1).T, dz2f)
     db2_ref[:] += jnp.sum(dz2f, axis=0, keepdims=True)
 
-    dpat2 = _dot(dz2f, w2.T).reshape(bb, R2, R2, K * K * C1)
-    da1 = _col2im(dpat2, R2, P1, C1)
+    # conv2 backward, per tap: dw2 rows accumulate patch^T @ dz2; da1 accumulates the
+    # zero-padded shift of dz2 @ w2_tap^T (the adjoint of the forward's slicing).
+    da1 = jnp.zeros((bb, P1, P1, C1), jnp.float32)
+    for ky in range(K):
+        for kx in range(K):
+            i = (ky * K + kx) * C1
+            s2 = a1[:, ky:ky + R2, kx:kx + R2, :].reshape(bb * R2 * R2, C1)
+            dw2_ref[i:i + C1, :] += _dot(s2.T, dz2f)
+            piece = _dot(dz2f, w2[i:i + C1, :].T).reshape(bb, R2, R2, C1)
+            da1 = da1 + jnp.pad(
+                piece, ((0, 0), (ky, P1 - R2 - ky), (kx, P1 - R2 - kx), (0, 0)))
     dp1 = da1 * (p1 > 0.0).astype(jnp.float32)
     dz1 = _pool_bwd(z1, p1, dp1, R1)
-    dz1f = dz1.reshape(bb * R1 * R1, C1)
-    dw1_ref[:] += _dot(pat1.reshape(bb * R1 * R1, K * K).T, dz1f)
-    db1_ref[:] += jnp.sum(dz1f, axis=0, keepdims=True)
+    db1_ref[:] += jnp.sum(dz1.reshape(bb * R1 * R1, C1), axis=0, keepdims=True)
+    for ky in range(K):
+        for kx in range(K):
+            i = ky * K + kx
+            dw1_ref[i:i + 1, :] += jnp.sum(
+                x[:, ky:ky + R1, kx:kx + R1, :] * dz1, axis=(0, 1, 2)).reshape(1, C1)
 
 
 def _interpret() -> bool:
@@ -298,11 +320,104 @@ def probe_compiles(batch: int = BATCH_BLOCK) -> Exception | None:
         return e
 
 
+# Child exit-code contract for the subprocess probe (see probe_compiles_subprocess).
+_PROBE_RC_COMPILE_FAILED = 17
+_PROBE_RC_NOT_TPU = 21
+
+# Fixed allowance for the probe child's interpreter start + jax import + backend claim,
+# on top of the per-batch compile budget.
+_PROBE_STARTUP_ALLOWANCE_S = 60.0
+
+_UNPROBED = object()     # sentinel: "no precomputed probe verdict was supplied"
+
+
+def _configured_platform() -> str:
+    """The first explicitly-configured jax platform ('' when unset), read from config/env
+    WITHOUT initializing a backend — ``jax.default_backend()`` would claim the chip."""
+    cfg = jax.config.jax_platforms or os.environ.get("JAX_PLATFORMS", "")
+    return cfg.split(",")[0].strip().lower()
+
+
+def probe_compiles_subprocess(batches: tuple[int, ...] = (BATCH_BLOCK,), *,
+                              timeout_s: float | None = None) -> Exception | None:
+    """``probe_compiles`` for every batch size in ``batches``, in a fresh child
+    interpreter with a hard deadline; returns the failure (or None).
+
+    Why a child process: a Mosaic compile cannot be cancelled in-process, and through a
+    remote-compile service it can take tens of minutes or hang outright (observed on this
+    image's tunnelled TPU backend) — an in-process probe would turn the opt-in
+    ``--use-fused-step`` into a trainer that never starts. The deadline
+    (``FUSED_PROBE_TIMEOUT_SECONDS``, default 180 s **per batch size**, plus a fixed
+    60 s child-startup allowance) treats slower-than-budget compiles as failures, which
+    is the right verdict for a trainer that would face the same compile again for the
+    real step.
+
+    MUST run before this process touches the TPU: the chip's claim is exclusive, so a
+    child probing while the parent holds the backend blocks until the deadline and
+    reports a (safe, conservative) timeout. The child decides platform applicability
+    itself — on a non-TPU backend it reports "nothing to probe" (interpret mode proves
+    nothing the test suite doesn't already) and this returns None. Termination on
+    timeout is graceful (SIGTERM first): SIGKILL on a process holding the tunnelled TPU
+    claim can wedge the lease for the parent's own subsequent claim.
+    """
+    if _configured_platform() == "cpu":
+        return None     # explicitly CPU: interpret mode, nothing Mosaic to probe —
+        #                 skip the child entirely (it would only import jax to say so)
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("FUSED_PROBE_TIMEOUT_SECONDS", "180"))
+    # The per-batch budget scales to the whole child: one backend init plus one compile
+    # per batch size — otherwise two legitimately-under-budget compiles would blow a
+    # shared deadline and silently disable the fused step.
+    total_timeout_s = _PROBE_STARTUP_ALLOWANCE_S + timeout_s * max(1, len(batches))
+    child_code = (
+        "import os, sys, time\n"
+        "hold = float(os.environ.get('FUSED_PROBE_TEST_SLEEP', '0'))\n"
+        "time.sleep(hold) if hold else None\n"
+        "import jax\n"
+        "from csed_514_project_distributed_training_using_pytorch_tpu.ops import "
+        "pallas_fused as pf\n"
+        f"if jax.default_backend() != 'tpu': sys.exit({_PROBE_RC_NOT_TPU})\n"
+        f"for b in {tuple(batches)!r}:\n"
+        "    err = pf.probe_compiles(batch=b)\n"
+        "    if err is not None:\n"
+        "        sys.stderr.write(f'batch {b}: {type(err).__name__}: {err}')\n"
+        f"        sys.exit({_PROBE_RC_COMPILE_FAILED})\n"
+        "sys.exit(0)\n")
+    proc = subprocess.Popen([sys.executable, "-c", child_code],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        _, err_text = proc.communicate(timeout=total_timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+        return TimeoutError(
+            f"fused-kernel compile probe exceeded {total_timeout_s:.0f}s for batches "
+            f"{tuple(batches)} (slow/hung Mosaic compile, or the TPU claim is already "
+            f"held by this process — probe before the first jax operation)")
+    if proc.returncode in (0, _PROBE_RC_NOT_TPU):
+        return None
+    # Keep enough stderr to act on, and don't blame Mosaic for an environment problem
+    # (import failure, crashed interpreter, ...) — only rc 17 is a real compile verdict.
+    tail = "\n".join((err_text or "").strip().splitlines()[-5:])
+    if proc.returncode == _PROBE_RC_COMPILE_FAILED:
+        return RuntimeError(f"fused kernel failed to compile in the probe child:\n{tail}"
+                            if tail else "fused kernel failed to compile in the probe "
+                                         "child (no stderr)")
+    return RuntimeError(
+        f"compile-probe child failed for a reason other than kernel compilation "
+        f"(rc={proc.returncode}) — environment problem, not a Mosaic verdict:\n{tail}")
+
+
 def make_fused_train_step(*, learning_rate: float, momentum: float,
                           conv_dropout_rate: float = 0.5,
                           fc_dropout_rate: float = 0.5,
                           fallback_on_compile_error: bool = False,
-                          probe_batches: tuple[int, ...] = (BATCH_BLOCK,)):
+                          probe_batches: tuple[int, ...] = (BATCH_BLOCK,),
+                          probe_result: Exception | None | object = _UNPROBED):
     """Drop-in replacement for ``train.step.make_train_step`` built on the fused kernel:
     ``step(state, images, labels, rng) -> (state, loss)``. Dropout masks are drawn outside
     the kernel from the same per-step fold-in discipline; the update runs through the fused
@@ -315,7 +430,12 @@ def make_fused_train_step(*, learning_rate: float, momentum: float,
     same hyperparameters — so ``--use-fused-step`` degrades to a working trainer instead
     of crashing.  The probe only runs where Mosaic does (TPU backend): in interpret mode
     it could only confirm what the test suite already guarantees, at the cost of an extra
-    startup compile."""
+    startup compile.
+
+    ``probe_result`` optionally supplies a precomputed verdict (from
+    ``probe_compiles_subprocess``, run before this process first touched the TPU) instead
+    of probing in-process here — the in-process probe cannot be cancelled if the Mosaic
+    compile is slow or hung, so callers that can probe early (the trainers) should."""
     from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_kernels import (
         sgd_momentum_step,
     )
@@ -323,9 +443,13 @@ def make_fused_train_step(*, learning_rate: float, momentum: float,
         TrainState,
     )
 
-    if fallback_on_compile_error and jax.default_backend() == "tpu":
-        err = next((e for e in map(probe_compiles, probe_batches) if e is not None),
-                   None)
+    if fallback_on_compile_error and (
+            probe_result is not _UNPROBED or jax.default_backend() == "tpu"):
+        if probe_result is not _UNPROBED:
+            err = probe_result
+        else:
+            err = next((e for e in map(probe_compiles, probe_batches) if e is not None),
+                       None)
         if err is not None:
             import warnings
 

@@ -53,13 +53,29 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     notebooks); by default MNIST is loaded from ``config.data_dir``.
     """
     watch = M.Stopwatch()                       # ≙ t0, reference src/train.py:10
-    root = jax.random.PRNGKey(config.seed)      # ≙ torch.manual_seed, src/train.py:19-21
-    init_rng, dropout_rng = jax.random.split(root)
 
     train_ds, test_ds = datasets if datasets is not None else load_mnist(config.data_dir)
     train_ds = mnist.truncate(train_ds, config.max_train_examples)
     test_ds = mnist.truncate(test_ds, config.max_test_examples)
+
+    # The fused-step compile probe runs in a child interpreter and must happen BEFORE this
+    # process's first jax operation — even M.log claims the backend (jax.process_index),
+    # and the TPU claim is exclusive, so once we hold it a probing child could only block
+    # (see probe_compiles_subprocess). Probe every batch size this run will step at (main
+    # batches + the drop_last=False tail) — Mosaic failures can be block-shape dependent.
+    fused_probe_batches, fused_probe_result = (), None
+    if config.use_fused_step:
+        from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_fused import (
+            probe_compiles_subprocess,
+        )
+        tail = len(train_ds) % config.batch_size_train
+        fused_probe_batches = tuple(dict.fromkeys(
+            b for b in (config.batch_size_train, tail) if b))
+        fused_probe_result = probe_compiles_subprocess(fused_probe_batches)
+
     M.log(f"Loaded MNIST ({train_ds.source}): {len(train_ds)} train / {len(test_ds)} test")
+    root = jax.random.PRNGKey(config.seed)      # ≙ torch.manual_seed, src/train.py:19-21
+    init_rng, dropout_rng = jax.random.split(root)
     train_loader = BatchLoader(train_ds, config.batch_size_train, shuffle=True,
                                seed=config.seed)
 
@@ -85,14 +101,11 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
             make_epoch_from_step,
         )
-        # Probe every batch size this run will actually step at (main batches plus the
-        # drop_last=False tail) — Mosaic compile failures can be block-shape dependent.
-        tail = len(train_ds) % config.batch_size_train
+        # probe_result always supplied -> the uncancellable in-process probe never runs.
         raw_step = make_fused_train_step(
             learning_rate=config.learning_rate, momentum=config.momentum,
             fallback_on_compile_error=True,
-            probe_batches=tuple(dict.fromkeys(
-                b for b in (config.batch_size_train, tail) if b)))
+            probe_result=fused_probe_result)
         segment_fn = jax.jit(make_epoch_from_step(raw_step), donate_argnums=(0,))
         step_fn = jax.jit(raw_step, donate_argnums=(0,))
     else:

@@ -12,15 +12,29 @@ initialized, which keeps the (exclusive, possibly tunnelled) TPU unclaimed while
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+# Opt-in hardware mode: ``FRAMEWORK_TEST_PLATFORM=tpu pytest tests/ -k tpu`` leaves the
+# real backend alone so the TPU-gated smokes (e.g. the Mosaic compile path in
+# test_pallas_fused.py) actually run when a chip is reachable. Default remains the
+# 8-virtual-device CPU platform — the suite must never claim the (exclusive, tunnelled)
+# TPU by accident.
+_platform = os.environ.get("FRAMEWORK_TEST_PLATFORM", "cpu").strip().lower()
+if _platform not in ("cpu", "tpu"):
+    # Fail fast: a typo here must not silently skip the CPU pin and claim the
+    # (exclusive, tunnelled) TPU for the whole suite.
+    raise RuntimeError(
+        f"FRAMEWORK_TEST_PLATFORM must be 'cpu' or 'tpu', got {_platform!r}")
+
+if _platform == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if _platform == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

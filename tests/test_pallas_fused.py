@@ -214,6 +214,61 @@ def test_compile_probe_and_fallback(monkeypatch):
     assert np.isfinite(float(loss)) and int(new_state.step) == 1
 
 
+@pytest.mark.skipif(pf._configured_platform() != "cpu",
+                    reason="exercises the explicit-CPU fast path; under hardware mode "
+                           "the platform is deliberately unpinned")
+def test_subprocess_probe_skips_on_explicit_cpu_platform():
+    """With the platform explicitly configured to CPU (this suite's conftest), the probe
+    must answer 'nothing Mosaic to probe' without even spawning the child — and the
+    parent must not fall back (interpret mode is the tested path off the chip). Named
+    without the accelerator substring so the hardware-mode filter `-k` on that substring
+    never selects it (on a chip this probe would really compile, for minutes)."""
+    assert pf.probe_compiles_subprocess((4,), timeout_s=120.0) is None
+
+
+def test_subprocess_probe_spawns_child_when_platform_unconfigured(monkeypatch):
+    """When no platform is pinned, the verdict must come from the child interpreter
+    (which decides backend applicability itself). Forcing the platform string empty here
+    drives the child path on CPU: the child sees default_backend()=='cpu' and reports
+    'nothing to probe'."""
+    monkeypatch.setattr(pf, "_configured_platform", lambda: "")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")   # the child itself must still be CPU
+    assert pf.probe_compiles_subprocess((4,), timeout_s=120.0) is None
+
+
+def test_subprocess_probe_timeout_is_a_failure(monkeypatch):
+    """A compile slower than the deadline (or a child blocked on a parent-held chip
+    claim) must come back as an exception, not a hang — this is the property that keeps
+    --use-fused-step from wedging a trainer at startup."""
+    monkeypatch.setattr(pf, "_configured_platform", lambda: "")
+    monkeypatch.setattr(pf, "_PROBE_STARTUP_ALLOWANCE_S", 0.0)
+    monkeypatch.setenv("FUSED_PROBE_TEST_SLEEP", "30")
+    err = pf.probe_compiles_subprocess((4,), timeout_s=2.0)
+    assert isinstance(err, TimeoutError)
+
+
+def test_probe_result_short_circuits_in_process_probe(monkeypatch):
+    """A precomputed subprocess verdict must be honored without re-probing in-process
+    (the in-process probe is uncancellable — the very thing the trainer avoids)."""
+    def boom(batch=4):
+        raise AssertionError("in-process probe must not run when probe_result is given")
+
+    monkeypatch.setattr(pf, "probe_compiles", boom)
+    # Failure verdict -> fallback (works even off-TPU: the verdict was computed early).
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        step = pf.make_fused_train_step(
+            learning_rate=0.05, momentum=0.5, fallback_on_compile_error=True,
+            probe_result=TimeoutError("probe exceeded budget"))
+    state = create_train_state(Net(), jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 10)
+    new_state, loss = jax.jit(step)(state, x, y, jax.random.PRNGKey(3))
+    assert np.isfinite(float(loss)) and int(new_state.step) == 1
+    # Success verdict -> fused step, still no in-process probe.
+    pf.make_fused_train_step(learning_rate=0.05, momentum=0.5,
+                             fallback_on_compile_error=True, probe_result=None)
+
+
 @pytest.mark.skipif(jax.default_backend() != "tpu",
                     reason="real Mosaic compile path only exists on TPU hardware")
 def test_fused_step_on_tpu_matches_unfused(setup):

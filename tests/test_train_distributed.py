@@ -144,6 +144,21 @@ def test_distributed_resume_reproduces_uninterrupted_run(tmp_path, tiny_datasets
                                    rtol=1e-5, atol=1e-7, err_msg=f"velocity {k}")
 
 
+def _available_cores() -> int:
+    try:
+        return len(os.sched_getaffinity(0))    # respects cgroup/affinity limits (Linux)
+    except AttributeError:
+        return os.cpu_count() or 1
+
+
+@pytest.mark.skipif(
+    _available_cores() < 8,
+    reason="the host-local per-step path runs a cross-module all-reduce whose 8 "
+           "rendezvous participants spin-wait; on a host with fewer cores than mesh "
+           "devices XLA:CPU can starve 3+ participants for its full 40s termination "
+           "timeout and then hard-abort the process (observed at 1 visible core). "
+           "Virtual-CPU-only artifact — the collective rides ICI on real chips, and the "
+           "2-process fleet variant in test_multiprocess.py still covers the path here.")
 def test_host_local_feed_matches_device_resident(tmp_path, tiny_datasets, devices8):
     """--host-local-feed (the multi-host input pipeline, SURVEY.md §7d) must produce the
     SAME final params as the device-resident scan fast path: identical plan, identical
