@@ -66,10 +66,13 @@ def measure() -> dict:
     truncated_to = int(os.environ.get("BENCH_MAX_TRAIN_EXAMPLES", "0"))
     full_split = truncated_to <= 0 or truncated_to >= len(train_ds)
     train_ds = mnist.truncate(train_ds, truncated_to)
+    # Scan-body unroll factor (semantics-preserving, equivalence-tested); >1 amortizes
+    # per-iteration control overhead, which can rival compute on a model this small.
+    unroll = int(os.environ.get("BENCH_UNROLL", "1"))
 
     result = time_epochs(mesh, train_ds, global_batch=GLOBAL_BATCH,
                          learning_rate=LEARNING_RATE, momentum=MOMENTUM,
-                         seed=1, timed_epochs=3)
+                         seed=1, timed_epochs=3, unroll=unroll)
 
     eval_fn = dp.compile_eval(make_eval_fn(Net(), batch_size=1000), mesh)
     test_x = dp.put_global(mesh, test_ds.images, jax.sharding.PartitionSpec())
@@ -99,6 +102,7 @@ def measure() -> dict:
         "device_kind": getattr(dev, "device_kind", dev.platform),
         "steps_per_epoch": result.steps_per_epoch,
         "train_examples": len(train_ds),
+        "scan_unroll": unroll,
         "steps_per_s": round(result.steps_per_epoch / result.median_seconds, 1),
         "examples_per_s": round(examples_per_s, 1),
         "model_train_flops_per_example": TRAIN_FLOPS_PER_EXAMPLE,

@@ -39,7 +39,8 @@ def device_counts(available: int) -> list[int]:
     return counts
 
 
-def run(max_train_examples: int = 0, timed_epochs: int = 3) -> list[dict]:
+def run(max_train_examples: int = 0, timed_epochs: int = 3,
+        unroll: int = 1) -> list[dict]:
     available = len(jax.devices())
     platform = jax.devices()[0].platform
     train_ds, _ = load_mnist("files")
@@ -49,7 +50,7 @@ def run(max_train_examples: int = 0, timed_epochs: int = 3) -> list[dict]:
     for n in device_counts(available):
         result = time_epochs(make_mesh(n), train_ds, global_batch=GLOBAL_BATCH,
                              learning_rate=LEARNING_RATE, momentum=MOMENTUM,
-                             timed_epochs=timed_epochs)
+                             timed_epochs=timed_epochs, unroll=unroll)
         rows.append({
             "devices": n,
             "epoch_seconds": round(result.median_seconds, 4),
@@ -143,6 +144,10 @@ if __name__ == "__main__":
                         help="0 = full 60k (the published protocol); >0 truncates for "
                              "quick functional runs")
     parser.add_argument("--timed-epochs", type=int, default=3)
+    parser.add_argument("--unroll", type=int, default=1,
+                        help="scan-body unroll factor for the device sweep "
+                             "(semantics-preserving; amortizes per-step control "
+                             "overhead on tiny models)")
     parser.add_argument("--sweep-global-batch", nargs="*", type=int, default=None,
                         metavar="B",
                         help="run the global-batch sweep instead of the device sweep "
@@ -152,4 +157,4 @@ if __name__ == "__main__":
         run_batch_sweep(args.sweep_global_batch or [256, 1024, 4096],
                         args.max_train_examples, args.timed_epochs)
     else:
-        run(args.max_train_examples, args.timed_epochs)
+        run(args.max_train_examples, args.timed_epochs, args.unroll)

@@ -63,15 +63,14 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     # and the TPU claim is exclusive, so once we hold it a probing child could only block
     # (see probe_compiles_subprocess). Probe every batch size this run will step at (main
     # batches + the drop_last=False tail) — Mosaic failures can be block-shape dependent.
-    fused_probe_batches, fused_probe_result = (), None
+    fused_probe_result = None
     if config.use_fused_step:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_fused import (
             probe_compiles_subprocess,
         )
         tail = len(train_ds) % config.batch_size_train
-        fused_probe_batches = tuple(dict.fromkeys(
-            b for b in (config.batch_size_train, tail) if b))
-        fused_probe_result = probe_compiles_subprocess(fused_probe_batches)
+        fused_probe_result = probe_compiles_subprocess(tuple(dict.fromkeys(
+            b for b in (config.batch_size_train, tail) if b)))
 
     M.log(f"Loaded MNIST ({train_ds.source}): {len(train_ds)} train / {len(test_ds)} test")
     root = jax.random.PRNGKey(config.seed)      # ≙ torch.manual_seed, src/train.py:19-21
