@@ -56,6 +56,7 @@ def run(max_train_examples: int = 0, timed_epochs: int = 3,
             "epoch_seconds": round(result.median_seconds, 4),
             "platform": platform,
             "steps_per_epoch": result.steps_per_epoch,
+            "scan_unroll": unroll,
             "data_source": train_ds.source,
         })
         print(json.dumps(rows[-1]), flush=True)

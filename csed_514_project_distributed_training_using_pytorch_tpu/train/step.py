@@ -99,7 +99,8 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
 
 
 def make_epoch_fn(model, *, learning_rate: float, momentum: float,
-                  use_pallas: bool = False, unroll: int = 1) -> Callable:
+                  use_pallas: bool = False, unroll: int = 1,
+                  pregather: bool = False) -> Callable:
     """Build ``epoch(state, images, labels, idx_matrix, rng) -> (state, losses)``.
 
     ``images``/``labels`` are the full (device-resident) training split; ``idx_matrix`` is a
@@ -112,18 +113,35 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
     unchanged — SGD stays strictly sequential); on a tiny model, per-iteration control
     overhead can rival the step's compute, and unrolling amortizes it at the cost of
     compile time.
+
+    ``pregather`` (semantics unchanged) gathers the whole epoch's batches ONCE before the
+    scan — one big take instead of one small gather per step — and scans over the
+    pre-batched arrays; trades HBM (one epoch-sized copy of the split) for per-step
+    gather latency.
     """
     train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum,
                                  use_pallas=use_pallas)
-    return make_epoch_from_step(train_step, unroll=unroll)
+    return make_epoch_from_step(train_step, unroll=unroll, pregather=pregather)
 
 
-def make_epoch_from_step(train_step: Callable, *, unroll: int = 1) -> Callable:
+def make_epoch_from_step(train_step: Callable, *, unroll: int = 1,
+                         pregather: bool = False) -> Callable:
     """Wrap any ``step(state, images, labels, rng)`` into the scanned epoch program
     (same contract as ``make_epoch_fn`` — used for alternative step implementations such
     as the fused Pallas step, ``ops/pallas_fused.py``)."""
 
     def epoch(state: TrainState, images, labels, idx_matrix, rng):
+        if pregather:
+            def body(state, batch):
+                x, y = batch
+                return train_step(state, x, y, rng)
+
+            xs = (jnp.take(images, idx_matrix.reshape(-1), axis=0)
+                  .reshape(idx_matrix.shape + images.shape[1:]))
+            ys = jnp.take(labels, idx_matrix.reshape(-1),
+                          axis=0).reshape(idx_matrix.shape)
+            return lax.scan(body, state, (xs, ys), unroll=unroll)
+
         def body(state, idx):
             return train_step(state, jnp.take(images, idx, axis=0),
                               jnp.take(labels, idx, axis=0), rng)

@@ -91,7 +91,8 @@ class EpochBenchResult:
 def time_epochs(mesh: Mesh, train_ds: Dataset, *, global_batch: int = 64,
                 learning_rate: float = 0.01, momentum: float = 0.5,
                 seed: int = 1, sampler_seed: int = 42,
-                timed_epochs: int = 3, unroll: int = 1) -> EpochBenchResult:
+                timed_epochs: int = 3, unroll: int = 1,
+                pregather: bool = False) -> EpochBenchResult:
     """Measure full-epoch wall-clock on ``mesh`` under the protocol above.
 
     Hyperparameter defaults are the reference's single-trainer values
@@ -112,7 +113,7 @@ def time_epochs(mesh: Mesh, train_ds: Dataset, *, global_batch: int = 64,
     train_y = dp.put_global(mesh, train_ds.labels, P())
     epoch_fn = dp.compile_epoch(
         make_epoch_fn(model, learning_rate=learning_rate, momentum=momentum,
-                      unroll=unroll), mesh)
+                      unroll=unroll, pregather=pregather), mesh)
     samplers = [ShardedSampler(len(train_ds), num_replicas=world, rank=r,
                                seed=sampler_seed) for r in range(world)]
 

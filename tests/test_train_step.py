@@ -190,3 +190,27 @@ def test_epoch_unroll_is_semantics_preserving(model_state):
     for a, b in zip(jax.tree_util.tree_leaves(outs[1][0].params),
                     jax.tree_util.tree_leaves(outs[4][0].params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+
+
+def test_epoch_pregather_is_semantics_preserving(model_state):
+    """pregather=True (one epoch-wide gather before the scan instead of one per step) is
+    a data-movement knob only: same state and losses as the per-step-gather program,
+    including with a shuffled, repeated index plan."""
+    model, state0 = model_state
+    x = jax.random.normal(jax.random.PRNGKey(5), (48, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(6), (48,), 0, 10)
+    # Shuffled plan with repeats across rows — the gather must honor arbitrary indexing.
+    idx = jax.random.randint(jax.random.PRNGKey(8), (8, 8), 0, 48).astype(jnp.int32)
+    rng = jax.random.PRNGKey(7)
+
+    outs = {}
+    for pregather in (False, True):
+        fn = jax.jit(make_epoch_fn(model, learning_rate=0.01, momentum=0.5,
+                                   pregather=pregather))
+        outs[pregather] = fn(state0, x, y, idx, rng)
+
+    np.testing.assert_allclose(np.asarray(outs[False][1]), np.asarray(outs[True][1]),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(outs[False][0].params),
+                    jax.tree_util.tree_leaves(outs[True][0].params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
