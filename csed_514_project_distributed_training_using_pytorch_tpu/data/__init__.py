@@ -17,5 +17,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
 from csed_514_project_distributed_training_using_pytorch_tpu.data.loader import (
     BatchLoader,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.data.download import (
+    download_mnist,
+)
 
-__all__ = ["MNIST_MEAN", "MNIST_STD", "Dataset", "load_mnist", "BatchLoader"]
+__all__ = ["MNIST_MEAN", "MNIST_STD", "Dataset", "load_mnist", "BatchLoader",
+           "download_mnist"]

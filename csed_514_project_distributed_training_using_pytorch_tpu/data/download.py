@@ -1,0 +1,109 @@
+"""First-party MNIST downloader — the analog of ``torchvision.datasets.MNIST(download=True)``
+(reference ``src/train.py:26-31``: first run fetches the four IDX archives into the data
+root before training).
+
+Stdlib-only (``urllib``): mirror list tried in order, MD5 verification against
+torchvision's pinned digests, atomic install (fetch to a temp file in the target dir,
+verify, then ``os.replace``) so a crashed or failed download never leaves a truncated
+archive where ``load_mnist`` would find it. Files already present and passing their
+checksum are not re-fetched.
+
+This build environment has zero egress, so the default mirrors are unreachable here —
+the function is exercised in CI against a local HTTP server serving the golden IDX
+fixture (``tests/test_download.py``), and works unchanged against the real mirrors on a
+connected machine.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+import urllib.error
+import urllib.request
+
+# Same archive set and layout torchvision installs under <root>/MNIST/raw; we install
+# directly into <data_dir>, which load_mnist also searches (data/mnist.py).
+FILES = (
+    "train-images-idx3-ubyte.gz",
+    "train-labels-idx1-ubyte.gz",
+    "t10k-images-idx3-ubyte.gz",
+    "t10k-labels-idx1-ubyte.gz",
+)
+
+# Mirrors in preference order (the classic yann.lecun.com host throttles/403s).
+DEFAULT_MIRRORS = (
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "https://storage.googleapis.com/cvdf-datasets/mnist/",
+)
+
+# torchvision's pinned MD5 digests for the four archives.
+MD5S = {
+    "train-images-idx3-ubyte.gz": "f68b3c2dcbeaaa9fbdd348bbdeb94873",
+    "train-labels-idx1-ubyte.gz": "d53e105ee54ea40749a09fcbcd1e9432",
+    "t10k-images-idx3-ubyte.gz": "9fb629c4189551a2d022fa330f9573f3",
+    "t10k-labels-idx1-ubyte.gz": "ec29112dd5afa0611ce80d1b7f02629c",
+}
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download_mnist(data_dir: str = "files", *,
+                   mirrors: tuple[str, ...] = DEFAULT_MIRRORS,
+                   checksums: dict[str, str] | None = None,
+                   timeout: float = 30.0) -> list[str]:
+    """Ensure the four MNIST IDX archives exist (and verify) under ``data_dir``.
+
+    ``checksums`` maps filename -> expected MD5; defaults to torchvision's pinned
+    digests (pass ``{}`` to skip verification, e.g. for non-canonical fixtures).
+    Returns the four local paths. A checksum mismatch counts as that mirror failing
+    (the corrupt download is removed and the next mirror tried); when every mirror
+    fails for a file, raises ``RuntimeError`` chained from the last underlying error —
+    which is the ``ValueError`` mismatch if corruption was the cause.
+    """
+    if checksums is None:
+        checksums = MD5S
+    os.makedirs(data_dir, exist_ok=True)
+    paths = []
+    for name in FILES:
+        dest = os.path.join(data_dir, name)
+        expected = checksums.get(name)
+        if os.path.exists(dest) and (expected is None or _md5(dest) == expected):
+            paths.append(dest)
+            continue
+
+        last_err: Exception | None = None
+        for base in mirrors:
+            url = base + name
+            fd, tmp = tempfile.mkstemp(dir=data_dir, prefix=name + ".part-")
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) as resp, \
+                        os.fdopen(fd, "wb") as out:
+                    fd = None
+                    while chunk := resp.read(1 << 20):
+                        out.write(chunk)
+                if expected is not None and (got := _md5(tmp)) != expected:
+                    raise ValueError(f"{url}: MD5 mismatch — got {got}, "
+                                     f"expected {expected}")
+                os.replace(tmp, dest)     # atomic: never a truncated file at dest
+                tmp = None
+                break
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                last_err = e
+            finally:
+                if fd is not None:
+                    os.close(fd)
+                if tmp is not None and os.path.exists(tmp):
+                    os.remove(tmp)
+        else:
+            raise RuntimeError(
+                f"could not download {name} from any of {list(mirrors)}"
+            ) from last_err
+        paths.append(dest)
+    return paths

@@ -35,7 +35,9 @@ import jax
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from csed_514_project_distributed_training_using_pytorch_tpu.data import load_mnist, mnist
+from csed_514_project_distributed_training_using_pytorch_tpu.data import (
+    download_mnist, load_mnist, mnist,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     data_parallel as dp,
@@ -109,6 +111,9 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     root = jax.random.PRNGKey(config.seed)        # ≙ torch.manual_seed, :135-137
     init_rng, dropout_rng = jax.random.split(root)
 
+    if config.download_data and datasets is None:
+        download_mnist(config.data_dir)   # ≙ download=True, src/train_dist.py:22-30;
+        #                                   atomic per-file install → fleet-safe
     train_ds, test_ds = datasets if datasets is not None else load_mnist(config.data_dir)
     train_ds = mnist.truncate(train_ds, config.max_train_examples)
     test_ds = mnist.truncate(test_ds, config.max_test_examples)

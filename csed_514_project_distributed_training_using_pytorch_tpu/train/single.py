@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from csed_514_project_distributed_training_using_pytorch_tpu.data import (
-    BatchLoader, load_mnist, mnist,
+    BatchLoader, download_mnist, load_mnist, mnist,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
@@ -54,6 +54,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     """
     watch = M.Stopwatch()                       # ≙ t0, reference src/train.py:10
 
+    if config.download_data and datasets is None:
+        download_mnist(config.data_dir)   # ≙ torchvision download=True, src/train.py:26-31
     train_ds, test_ds = datasets if datasets is not None else load_mnist(config.data_dir)
     train_ds = mnist.truncate(train_ds, config.max_train_examples)
     test_ds = mnist.truncate(test_ds, config.max_test_examples)

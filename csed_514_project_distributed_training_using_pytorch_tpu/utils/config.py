@@ -31,6 +31,9 @@ class SingleProcessConfig:
     seed: int = 1                     # src/train.py:19 (torch.manual_seed(random_seed))
     data_dir: str = "files"           # src/train.py:26 ({CURR_PATH}/files/; one dir, not the
                                       # reference's hardcoded /home/abhishek test path, §2d.2)
+    download_data: bool = False       # fetch the MNIST IDX archives into data_dir first
+                                      # (≙ torchvision download=True, src/train.py:26-31;
+                                      # off by default — this build env has no egress)
     results_dir: str = "results"      # src/train.py:84-85 checkpoint target
     images_dir: str = "images"        # src/train.py:57,117 plot target
     profile: bool = False             # optional jax.profiler capture (reference has none, §5)
@@ -63,6 +66,9 @@ class DistributedConfig:
     seed: int = 1                     # src/train_dist.py:135 (model/init seed)
     sampler_seed: int = 42            # src/train_dist.py:37 (DistributedSampler seed)
     data_dir: str = "files"
+    download_data: bool = False       # ≙ torchvision download=True (src/train_dist.py:22-30);
+                                      # atomic install makes concurrent fetches by
+                                      # co-hosted processes safe (last replace wins)
     results_dir: str = "results"
     images_dir: str = "images"
     shard_eval: bool = False          # False reproduces the reference's every-rank-evaluates-

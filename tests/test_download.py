@@ -1,0 +1,95 @@
+"""MNIST downloader (data/download.py — ≙ torchvision ``download=True``, reference
+src/train.py:26-31) against a local HTTP server serving the golden IDX fixture: no
+network egress needed, and the fetched files must flow through the real ingest path."""
+
+import functools
+import hashlib
+import http.server
+import os
+import threading
+
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.data import (
+    download, load_mnist,
+)
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures", "mnist_idx")
+
+
+class _CountingHandler(http.server.SimpleHTTPRequestHandler):
+    requests: list[str] = []
+
+    def do_GET(self):
+        type(self).requests.append(self.path)
+        super().do_GET()
+
+    def log_message(self, *a):      # keep pytest output clean
+        pass
+
+
+@pytest.fixture()
+def fixture_server():
+    handler = functools.partial(_CountingHandler, directory=FIXTURE_DIR)
+    _CountingHandler.requests = []
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}/", _CountingHandler.requests
+    finally:
+        srv.shutdown()
+        thread.join()
+
+
+def _fixture_md5s():
+    out = {}
+    for name in download.FILES:
+        with open(os.path.join(FIXTURE_DIR, name), "rb") as f:
+            out[name] = hashlib.md5(f.read()).hexdigest()
+    return out
+
+
+def test_download_fetch_verify_and_load(tmp_path, fixture_server):
+    """Full path: fetch all four archives, verify MD5s, then load them through
+    load_mnist — the downloaded cache must be indistinguishable from a torchvision one."""
+    url, _ = fixture_server
+    data_dir = str(tmp_path / "files")
+    paths = download.download_mnist(data_dir, mirrors=(url,),
+                                    checksums=_fixture_md5s())
+    assert [os.path.basename(p) for p in paths] == list(download.FILES)
+    train, test = load_mnist(data_dir)
+    assert train.source == "idx" and test.source == "idx"
+    assert train.images.shape[1:] == (28, 28, 1)
+
+
+def test_download_skips_existing_valid_files(tmp_path, fixture_server):
+    url, requests = fixture_server
+    data_dir = str(tmp_path / "files")
+    sums = _fixture_md5s()
+    download.download_mnist(data_dir, mirrors=(url,), checksums=sums)
+    first = len(requests)
+    assert first == len(download.FILES)
+    download.download_mnist(data_dir, mirrors=(url,), checksums=sums)
+    assert len(requests) == first       # second call: verified on disk, no re-fetch
+
+
+def test_download_mirror_fallback(tmp_path, fixture_server):
+    """A dead first mirror must not fail the download — the next mirror serves it."""
+    url, _ = fixture_server
+    dead = "http://127.0.0.1:9/"        # port 9 (discard): connection refused
+    paths = download.download_mnist(str(tmp_path / "files"), mirrors=(dead, url),
+                                    checksums=_fixture_md5s(), timeout=5.0)
+    assert all(os.path.exists(p) for p in paths)
+
+
+def test_download_checksum_mismatch_leaves_no_file(tmp_path, fixture_server):
+    url, _ = fixture_server
+    bad = dict(_fixture_md5s(), **{download.FILES[0]: "0" * 32})
+    with pytest.raises(RuntimeError) as exc_info:
+        download.download_mnist(str(tmp_path / "files"), mirrors=(url,),
+                                checksums=bad)
+    assert isinstance(exc_info.value.__cause__, ValueError)   # the MD5 mismatch
+    dest = tmp_path / "files" / download.FILES[0]
+    assert not dest.exists()            # no truncated/corrupt file installed
+    assert not list((tmp_path / "files").glob("*.part-*"))    # no temp litter
