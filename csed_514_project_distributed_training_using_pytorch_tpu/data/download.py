@@ -17,6 +17,7 @@ connected machine.
 from __future__ import annotations
 
 import hashlib
+import http.client
 import os
 import tempfile
 import urllib.error
@@ -91,10 +92,16 @@ def download_mnist(data_dir: str = "files", *,
                 if expected is not None and (got := _md5(tmp)) != expected:
                     raise ValueError(f"{url}: MD5 mismatch — got {got}, "
                                      f"expected {expected}")
+                # mkstemp creates 0600; install with normal umask-based permissions so a
+                # shared data_dir cache stays readable by other users (as torchvision's).
+                umask = os.umask(0)
+                os.umask(umask)
+                os.chmod(tmp, 0o666 & ~umask)
                 os.replace(tmp, dest)     # atomic: never a truncated file at dest
                 tmp = None
                 break
-            except (urllib.error.URLError, OSError, ValueError) as e:
+            except (urllib.error.URLError, http.client.HTTPException,
+                    OSError, ValueError) as e:
                 last_err = e
             finally:
                 if fd is not None:

@@ -155,7 +155,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
 
     epoch_fn = dp.compile_epoch(
         make_epoch_fn(model, learning_rate=config.learning_rate,
-                      momentum=config.momentum), mesh)
+                      momentum=config.momentum,
+                      unroll=config.scan_unroll, pregather=config.pregather), mesh)
     eval_fn = dp.compile_eval(
         make_eval_fn(model, batch_size=config.batch_size_test), mesh,
         shard=config.shard_eval)

@@ -107,13 +107,17 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
             learning_rate=config.learning_rate, momentum=config.momentum,
             fallback_on_compile_error=True,
             probe_result=fused_probe_result)
-        segment_fn = jax.jit(make_epoch_from_step(raw_step), donate_argnums=(0,))
+        segment_fn = jax.jit(
+            make_epoch_from_step(raw_step, unroll=config.scan_unroll,
+                                 pregather=config.pregather),
+            donate_argnums=(0,))
         step_fn = jax.jit(raw_step, donate_argnums=(0,))
     else:
         segment_fn = jax.jit(
             make_epoch_fn(model, learning_rate=config.learning_rate,
                           momentum=config.momentum,
-                          use_pallas=config.use_pallas_kernels),
+                          use_pallas=config.use_pallas_kernels,
+                          unroll=config.scan_unroll, pregather=config.pregather),
             donate_argnums=(0,))
         step_fn = jax.jit(
             make_train_step(model, learning_rate=config.learning_rate,

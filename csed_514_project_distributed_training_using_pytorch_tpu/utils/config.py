@@ -49,6 +49,11 @@ class SingleProcessConfig:
                                       # prefetcher (the DataLoader num_workers=4 analog,
                                       # src/train_dist.py:43-45) instead of the device-
                                       # resident scan fast path; same math, same order
+    scan_unroll: int = 1              # epoch-scan body unroll factor (semantics-preserving
+                                      # codegen knob; amortizes per-step control overhead)
+    pregather: bool = False           # gather each scan segment's batches once up front
+                                      # instead of per step (semantics-preserving; trades
+                                      # HBM for per-step gather latency)
     max_train_examples: int = 0       # 0 = full split; >0 truncates (dev/CI shortening —
     max_test_examples: int = 0        # no reference analog; the reference always trains full)
 
@@ -83,6 +88,9 @@ class DistributedConfig:
                                       # batch (SURVEY.md §7 hard part (d)) instead of the
                                       # device-resident replicated dataset + on-device
                                       # gather fast path; same plan, same math
+    scan_unroll: int = 1              # epoch-scan body unroll factor (semantics-preserving)
+    pregather: bool = False           # whole-epoch up-front batch gather (semantics-
+                                      # preserving; trades HBM for per-step gather latency)
     profile: bool = False
     profile_dir: str = "results/profile"
     max_train_examples: int = 0       # 0 = full split; >0 truncates (dev/CI shortening —

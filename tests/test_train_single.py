@@ -83,3 +83,26 @@ def test_host_pipeline_matches_fast_path(tmp_path, tiny_datasets):
     for a, b in zip(jax.tree_util.tree_leaves(results["fast"].params),
                     jax.tree_util.tree_leaves(results["host"].params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5)
+
+
+def test_scan_unroll_and_pregather_flags_match_defaults(tmp_path, tiny_datasets):
+    """--scan-unroll / --pregather are codegen/data-movement knobs only: the trainer must
+    produce the same final params as the default configuration (epoch-fn-level
+    equivalence is pinned in test_train_step.py; this guards the config wiring)."""
+    import jax
+
+    base = dict(n_epochs=1, batch_size_train=64, batch_size_test=100,
+                learning_rate=0.05, momentum=0.5, log_interval=10)
+    ref_cfg = SingleProcessConfig(
+        **base, results_dir=str(tmp_path / "r0"), images_dir=str(tmp_path / "i0"))
+    knob_cfg = SingleProcessConfig(
+        **base, scan_unroll=4, pregather=True,
+        results_dir=str(tmp_path / "r1"), images_dir=str(tmp_path / "i1"))
+    ref_state, _ = single.main(ref_cfg, datasets=tiny_datasets)
+    knob_state, _ = single.main(knob_cfg, datasets=tiny_datasets)
+
+    assert int(ref_state.step) == int(knob_state.step)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_state.params),
+                    jax.tree_util.tree_leaves(knob_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
