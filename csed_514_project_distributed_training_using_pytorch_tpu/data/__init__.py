@@ -16,10 +16,11 @@ from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.data.loader import (
     BatchLoader,
+    iter_plan_batches,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.data.download import (
     download_mnist,
 )
 
 __all__ = ["MNIST_MEAN", "MNIST_STD", "Dataset", "load_mnist", "BatchLoader",
-           "download_mnist"]
+           "download_mnist", "iter_plan_batches"]

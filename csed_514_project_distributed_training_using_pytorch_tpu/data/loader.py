@@ -29,6 +29,26 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.sampler im
 )
 
 
+def iter_plan_batches(dataset: Dataset, plan: np.ndarray, *,
+                      num_workers: int = 4) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    """Yield one ``(images, labels)`` host batch per row of a ``[steps, batch]`` index
+    plan, through the native threaded prefetcher when built (the ``num_workers=4``
+    DataLoader worker-pool analog, reference ``src/train_dist.py:43-45`` — workers gather
+    ahead into a bounded ring while the consumer's previous batch is in flight), else a
+    plain numpy gather. Used by both the single-process host pipeline and the
+    distributed host-local feed."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.data import native
+    if plan.shape[0] == 0:
+        return
+    if not native.available():
+        for row in plan:
+            yield dataset.images[row], dataset.labels[row]
+        return
+    with native.Prefetcher(dataset.images, dataset.labels, plan,
+                           num_workers=num_workers) as pf:
+        yield from pf
+
+
 class BatchLoader:
     """Iterates (images, labels) numpy batches in a sampler-defined order.
 
@@ -81,21 +101,12 @@ class BatchLoader:
         ``num_workers=4`` DataLoader worker pool analog, reference
         ``src/train_dist.py:43-45``); falls back to the plain ``__iter__`` gather when the
         native library isn't built. Full batches only (the plan is rectangular)."""
-        from csed_514_project_distributed_training_using_pytorch_tpu.data import native
         # allow_empty so a split smaller than one batch yields zero full batches here and
         # leaves the ragged tail to the caller — identical contract to the scan fast path
         # (advisor finding r1: the old allow_empty=False raised where the scan path
         # trained fine).
         plan = self.epoch_index_matrix(epoch, allow_empty=True)
-        if plan.shape[0] == 0:
-            return
-        if not native.available():
-            for row in plan:
-                yield self.dataset.images[row], self.dataset.labels[row]
-            return
-        with native.Prefetcher(self.dataset.images, self.dataset.labels, plan,
-                               num_workers=num_workers) as pf:
-            yield from pf
+        yield from iter_plan_batches(self.dataset, plan, num_workers=num_workers)
 
     def epoch_index_matrix(self, epoch: int | None = None, steps_multiple: int = 1,
                            allow_empty: bool = False) -> np.ndarray:

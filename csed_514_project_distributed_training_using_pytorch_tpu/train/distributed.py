@@ -38,6 +38,9 @@ from jax.sharding import PartitionSpec as P
 from csed_514_project_distributed_training_using_pytorch_tpu.data import (
     download_mnist, load_mnist, mnist,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.data.loader import (
+    iter_plan_batches,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     data_parallel as dp,
@@ -182,12 +185,14 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         gathers ONLY its addressable devices' rows of the global batch on host and
         assembles the globally-sharded arrays from per-process shards — the dataset never
         needs to be resident on (or even known to) other hosts. Identical plan and step
-        math to the fast path; only the feeding mechanism differs."""
+        math to the fast path; only the feeding mechanism differs. Host batches come
+        through the native threaded prefetcher when built (the reference's distributed
+        loader is exactly where its ``num_workers=4`` pool lives,
+        ``src/train_dist.py:43-45``): workers gather step s+1's shard while step s runs
+        on device."""
         losses = []
-        for s in range(plan.shape[0]):
-            local_idx = plan[s, col_lo:col_hi]
-            gi, gl = dp.global_batch_from_host_local(
-                mesh, train_ds.images[local_idx], train_ds.labels[local_idx])
+        for bx, by in iter_plan_batches(train_ds, plan[:, col_lo:col_hi]):
+            gi, gl = dp.global_batch_from_host_local(mesh, bx, by)
             state, loss = step_fn(state, gi, gl, dropout_rng)
             losses.append(loss)
         return state, jax.numpy.stack(losses)

@@ -140,3 +140,27 @@ def test_prefetch_iter_tiny_dataset_yields_nothing():
     raising — advisor finding r1 on the host-pipeline trainer."""
     loader = BatchLoader(_tiny_dataset(40), 64, shuffle=True, seed=1)
     assert list(loader.prefetch_iter(1)) == []
+
+
+def test_iter_plan_batches_numpy_fallback(monkeypatch):
+    """The pure-numpy leg of iter_plan_batches (used when the C++ library isn't built)
+    must match a plain gather — forced here so it stays covered even on machines where
+    the native path is available (test_native.py skips entirely when it isn't)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.data import native
+    from csed_514_project_distributed_training_using_pytorch_tpu.data.loader import (
+        iter_plan_batches,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
+        Dataset, _normalize, _synthesize_split,
+    )
+
+    xs, ys = _synthesize_split(256, seed=77)
+    ds = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+    plan = np.random.default_rng(3).integers(0, 256, size=(5, 16)).astype(np.int32)
+    monkeypatch.setattr(native, "available", lambda: False)
+    batches = list(iter_plan_batches(ds, plan))
+    assert len(batches) == 5
+    for s, (bi, bl) in enumerate(batches):
+        np.testing.assert_array_equal(bi, ds.images[plan[s]])
+        np.testing.assert_array_equal(bl, ds.labels[plan[s]])
+    assert list(iter_plan_batches(ds, plan[:0])) == []

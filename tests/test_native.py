@@ -185,3 +185,21 @@ class TestBatchLoaderIntegration:
             np.testing.assert_array_equal(bi, dataset.images[plan[s]])
             np.testing.assert_array_equal(bl, dataset.labels[plan[s]])
         assert s == plan.shape[0] - 1
+
+    def test_iter_plan_batches_on_noncontiguous_column_slice(self, dataset):
+        """The distributed host-local feed passes a column slice of the global plan
+        (non-contiguous view) — native-path batches must equal a plain gather of the
+        same rows (the numpy-fallback leg is covered unconditionally in
+        test_data.py::test_iter_plan_batches_numpy_fallback)."""
+        from csed_514_project_distributed_training_using_pytorch_tpu.data.loader import (
+            iter_plan_batches,
+        )
+        rng = np.random.default_rng(13)
+        full = rng.integers(0, len(dataset), size=(9, 32)).astype(np.int32)
+        local = full[:, 8:24]            # a process's column block, as in _host_local_columns
+        steps = 0
+        for s, (bi, bl) in enumerate(iter_plan_batches(dataset, local)):
+            np.testing.assert_array_equal(bi, dataset.images[local[s]])
+            np.testing.assert_array_equal(bl, dataset.labels[local[s]])
+            steps += 1
+        assert steps == 9
