@@ -81,6 +81,11 @@ def _read_idx(path: str) -> np.ndarray:
             raise ValueError(f"{path}: unsupported IDX dtype 0x{dtype_code:02x}")
         shape = struct.unpack(f">{ndim}I", f.read(4 * ndim))
         data = np.frombuffer(f.read(), dtype=np.uint8)
+    expected = int(np.prod(shape))
+    if data.size != expected:
+        raise ValueError(f"{path}: IDX payload size mismatch — header {shape} needs "
+                         f"{expected} bytes, got {data.size} (truncated download or "
+                         f"corrupt file)")
     return data.reshape(shape)
 
 

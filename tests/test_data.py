@@ -47,6 +47,26 @@ def test_idx_gzip(tmp_path):
     np.testing.assert_array_equal(_read_idx(str(p)), labels)
 
 
+def test_idx_unsupported_dtype_raises(tmp_path):
+    p = tmp_path / "bad_dtype"
+    with open(p, "wb") as f:
+        f.write(struct.pack(">I", 0x00000D03))   # dtype 0x0D (float), not MNIST's 0x08
+        f.write(struct.pack(">3I", 1, 2, 2))
+        f.write(b"\x00" * 4)
+    with pytest.raises(ValueError, match="unsupported IDX dtype"):
+        _read_idx(str(p))
+
+
+def test_idx_truncated_payload_raises(tmp_path):
+    imgs = np.random.default_rng(1).integers(0, 256, (4, 28, 28), dtype=np.uint8)
+    p = tmp_path / "truncated"
+    _write_idx_images(p, imgs)
+    with open(p, "r+b") as f:
+        f.truncate(16 + imgs.nbytes - 100)       # drop the last 100 payload bytes
+    with pytest.raises(ValueError, match="payload size mismatch"):
+        _read_idx(str(p))
+
+
 def test_load_real_idx_layout(tmp_path):
     """torchvision's MNIST/raw cache layout is found and parsed (src/train.py:26-31)."""
     raw = tmp_path / "MNIST" / "raw"
