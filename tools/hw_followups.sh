@@ -1,0 +1,33 @@
+#!/bin/bash
+# Serialized hardware follow-ups to run whenever a real TPU chip is reachable.
+# The TPU claim is exclusive (a second jax process BLOCKS in backend init until the
+# holder exits), so each step must fully finish before the next starts.
+#
+# Outputs land under ${HW_OUT:-/tmp/hw}. Run from anywhere:  bash tools/hw_followups.sh
+set -u
+cd "$(dirname "$0")/.."
+OUT=${HW_OUT:-/tmp/hw}
+mkdir -p "$OUT"
+
+echo "=== 1. fused-kernel Mosaic hardware parity test ==="
+# Settles whether the full whole-model Pallas kernel compiles through Mosaic on this
+# chip (every individual construct is probe-verified; the full-kernel compile was
+# still unresolved when the round-2 tunnel died — see ops/pallas_fused.py notes).
+FRAMEWORK_TEST_PLATFORM=tpu timeout --signal=TERM 1800 python -m pytest \
+  tests/test_pallas_fused.py::test_fused_step_on_tpu_matches_unfused -q \
+  > "$OUT/fused_tpu_test.out" 2>&1
+echo "fused test rc=$? (out: $OUT/fused_tpu_test.out)"
+
+echo "=== 2. bench scan-unroll sweep ==="
+for U in 1 4 8; do
+  BENCH_UNROLL=$U timeout --signal=TERM 1200 python bench.py \
+    > "$OUT/bench_unroll_$U.json" 2> "$OUT/bench_unroll_$U.err"
+  echo "unroll=$U rc=$?"
+done
+
+echo "=== 3. bench pregather ==="
+BENCH_PREGATHER=1 timeout --signal=TERM 1200 python bench.py \
+  > "$OUT/bench_pregather.json" 2> "$OUT/bench_pregather.err"
+echo "pregather rc=$?"
+
+echo "=== done — compare values against bench_results/bench_r2_tpu.json (0.1944 s) ==="
