@@ -1,0 +1,70 @@
+"""Plot-artifact functions (utils/plotting.py — the reference's three figures plus the
+two bench curves): every save_* must write a PNG on the logging process and degrade to a
+silent no-op when matplotlib is unavailable (training must never depend on plotting)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import plotting
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.metrics import (
+    MetricsHistory,
+)
+
+
+@pytest.fixture()
+def history():
+    h = MetricsHistory()
+    for i in range(5):
+        h.record_train(i * 640, 2.3 - 0.3 * i)
+    for i in range(3):
+        h.record_test(i * 2000, 2.0 - 0.5 * i)
+    return h
+
+
+def _png(path):
+    assert os.path.exists(path)
+    with open(path, "rb") as f:
+        assert f.read(8) == b"\x89PNG\r\n\x1a\n"
+
+
+@pytest.mark.skipif(not plotting.HAVE_MATPLOTLIB, reason="matplotlib not installed")
+def test_all_savers_write_png(tmp_path, history):
+    images = np.zeros((8, 28, 28, 1), np.float32)
+    labels = np.arange(8) % 10
+    cases = [
+        plotting.save_sample_grid(images, labels, str(tmp_path / "grid.png")),
+        plotting.save_loss_curves(history, str(tmp_path / "curve.png")),
+        plotting.save_batch_sweep_curve([256, 1024, 4096], [3e5, 3.5e5, 3.4e5],
+                                        str(tmp_path / "sweep.png")),
+        plotting.save_scaling_curve([1, 2, 4, 8], [17.5, 11.3, 7.6, 5.0],
+                                    str(tmp_path / "scaling.png")),
+    ]
+    assert all(cases), "every saver must return its path on the logging process"
+    for path in cases:
+        _png(path)
+
+
+def test_savers_no_op_without_matplotlib(tmp_path, history, monkeypatch):
+    """The documented degradation: no matplotlib -> return None, write nothing, never
+    raise (reference src/train.py would crash; training here must not)."""
+    monkeypatch.setattr(plotting, "HAVE_MATPLOTLIB", False)
+    assert plotting.save_sample_grid(np.zeros((8, 28, 28, 1), np.float32),
+                                     np.zeros(8), str(tmp_path / "g.png")) is None
+    assert plotting.save_loss_curves(history, str(tmp_path / "c.png")) is None
+    assert plotting.save_batch_sweep_curve([1], [1.0], str(tmp_path / "b.png")) is None
+    assert plotting.save_scaling_curve([1], [1.0], str(tmp_path / "s.png")) is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_savers_gated_off_nonzero_process(tmp_path, history, monkeypatch):
+    """Only process 0 writes figures (unlike the reference, where every rank plots the
+    same file — SURVEY.md §5 metrics/logging). All four savers share the gate."""
+    monkeypatch.setattr(plotting, "is_logging_process", lambda: False)
+    assert plotting.save_sample_grid(np.zeros((8, 28, 28, 1), np.float32),
+                                     np.zeros(8), str(tmp_path / "g.png")) is None
+    assert plotting.save_loss_curves(history, str(tmp_path / "c.png")) is None
+    assert plotting.save_batch_sweep_curve([1], [1.0], str(tmp_path / "b.png")) is None
+    assert plotting.save_scaling_curve([1], [1.0], str(tmp_path / "s.png")) is None
+    assert list(tmp_path.iterdir()) == []

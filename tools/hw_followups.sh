@@ -13,20 +13,22 @@ echo "=== 1. fused-kernel Mosaic hardware parity test ==="
 # Settles whether the full whole-model Pallas kernel compiles through Mosaic on this
 # chip (every individual construct is probe-verified; the full-kernel compile was
 # still unresolved when the round-2 tunnel died — see ops/pallas_fused.py notes).
-FRAMEWORK_TEST_PLATFORM=tpu timeout --signal=TERM 1800 python -m pytest \
+FRAMEWORK_TEST_PLATFORM=tpu timeout --kill-after=60 --signal=TERM 1800 python -m pytest \
   tests/test_pallas_fused.py::test_fused_step_on_tpu_matches_unfused -q \
   > "$OUT/fused_tpu_test.out" 2>&1
 echo "fused test rc=$? (out: $OUT/fused_tpu_test.out)"
 
 echo "=== 2. bench scan-unroll sweep ==="
 for U in 1 4 8; do
-  BENCH_UNROLL=$U timeout --signal=TERM 1200 python bench.py \
+  BENCH_UNROLL=$U BENCH_TPU_RETRY_SECONDS=300 BENCH_ATTEMPT_TIMEOUT_SECONDS=240 \
+    timeout --kill-after=60 --signal=TERM 1200 python bench.py \
     > "$OUT/bench_unroll_$U.json" 2> "$OUT/bench_unroll_$U.err"
   echo "unroll=$U rc=$?"
 done
 
 echo "=== 3. bench pregather ==="
-BENCH_PREGATHER=1 timeout --signal=TERM 1200 python bench.py \
+BENCH_PREGATHER=1 BENCH_TPU_RETRY_SECONDS=300 BENCH_ATTEMPT_TIMEOUT_SECONDS=240 \
+  timeout --kill-after=60 --signal=TERM 1200 python bench.py \
   > "$OUT/bench_pregather.json" 2> "$OUT/bench_pregather.err"
 echo "pregather rc=$?"
 
