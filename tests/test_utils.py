@@ -60,3 +60,44 @@ def test_maybe_profile_disabled_is_noop(tmp_path):
     with maybe_profile(False, log_dir):
         pass
     assert not os.path.exists(log_dir)
+
+
+class TestReplicaSyncCheck:
+    """utils/determinism.py — the desync 'race detector' the reference lacks. The happy
+    path runs in every 2-process fleet test; the failure branch is faked here (a real
+    desynced fleet would have to be built broken on purpose)."""
+
+    def test_fingerprint_is_order_independent(self):
+        # List pytrees preserve leaf order (dicts would sort keys and prove nothing),
+        # so swapping elements genuinely permutes the leaf sequence.
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            determinism as D,
+        )
+        w, b = jnp.arange(6.0).reshape(2, 3), jnp.ones(3)
+        assert D.param_fingerprint([w, b]) == D.param_fingerprint([b, w])
+
+    def test_single_process_is_noop(self):
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            determinism as D,
+        )
+        assert jax.process_count() == 1
+        D.assert_replicas_synced({"w": jnp.ones(3)})   # must not raise, no collective
+
+    def test_desync_raises_and_sync_passes(self, monkeypatch):
+        from jax.experimental import multihost_utils
+
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            determinism as D,
+        )
+        params = {"w": jnp.ones(3)}
+        mine = D.param_fingerprint(params)
+        monkeypatch.setattr(D.jax, "process_count", lambda: 2)
+
+        monkeypatch.setattr(multihost_utils, "process_allgather",
+                            lambda x: np.asarray([[mine], [mine + 0.5]]))
+        with pytest.raises(RuntimeError, match="desync"):
+            D.assert_replicas_synced(params)
+
+        monkeypatch.setattr(multihost_utils, "process_allgather",
+                            lambda x: np.asarray([[mine], [mine]]))
+        D.assert_replicas_synced(params)               # identical fingerprints: fine

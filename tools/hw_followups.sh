@@ -21,14 +21,14 @@ echo "fused test rc=$? (out: $OUT/fused_tpu_test.out)"
 echo "=== 2. bench scan-unroll sweep ==="
 for U in 1 4 8; do
   BENCH_UNROLL=$U BENCH_TPU_RETRY_SECONDS=300 BENCH_ATTEMPT_TIMEOUT_SECONDS=240 \
-    timeout --kill-after=60 --signal=TERM 1200 python bench.py \
+    timeout --kill-after=60 --signal=TERM 2700 python bench.py \
     > "$OUT/bench_unroll_$U.json" 2> "$OUT/bench_unroll_$U.err"
   echo "unroll=$U rc=$?"
 done
 
 echo "=== 3. bench pregather ==="
 BENCH_PREGATHER=1 BENCH_TPU_RETRY_SECONDS=300 BENCH_ATTEMPT_TIMEOUT_SECONDS=240 \
-  timeout --kill-after=60 --signal=TERM 1200 python bench.py \
+  timeout --kill-after=60 --signal=TERM 2700 python bench.py \
   > "$OUT/bench_pregather.json" 2> "$OUT/bench_pregather.err"
 echo "pregather rc=$?"
 
