@@ -1,6 +1,15 @@
-"""Model zoo. The reference defines exactly one model — the MNIST CNN ``Net``
-(reference ``src/model.py:4-22``); ours is the TPU-native re-expression of it."""
+"""Model zoo.
+
+The reference defines exactly one model — the MNIST CNN ``Net`` (reference
+``src/model.py:4-22``); ``models.cnn.Net`` is its TPU-native re-expression.
+``models.transformer`` is the beyond-parity attention family that exercises the
+framework's sequence-parallel machinery (``parallel/ring_attention.py``); both share the
+same call contract, so every trainer accepts either.
+"""
 
 from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
+from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer import (
+    TransformerClassifier,
+)
 
-__all__ = ["Net"]
+__all__ = ["Net", "TransformerClassifier"]

@@ -1,0 +1,175 @@
+"""Transformer model family (the framework's long-context/attention surface).
+
+The reference has exactly one model — the 28×28 MNIST CNN (reference ``src/model.py:4-22``)
+— and no attention op anywhere, so sequence parallelism is "structurally inapplicable" for
+parity (SURVEY.md §2c). This module is the beyond-parity model family that makes the
+framework's sequence-parallel machinery (``parallel/ring_attention.py``) a first-class,
+exercised capability rather than dead plumbing:
+
+- ``TransformerClassifier`` treats an image as a **sequence of pixel-row tokens** and
+  classifies it with a pre-LN transformer encoder. It accepts the same ``[B, 28, 28, 1]``
+  input and exposes the same ``(x, *, deterministic)`` call signature as ``models.cnn.Net``,
+  so it is **drop-in** for every existing trainer, checkpointer, and eval path
+  (``train/step.py`` treats the model as an opaque apply + params pytree).
+- The attention implementation is **pluggable** (``attention_fn``): the default is the
+  dense single-device ``ops.full_attention``; passing
+  ``parallel.make_ring_attention_fn(mesh)`` runs the identical model with its sequence
+  axis sharded across the mesh — numerics pinned equal in ``tests/test_transformer.py``.
+
+TPU-first choices: all matmuls are MXU-shaped einsums/denses; softmax/LayerNorm statistics
+run in float32 while activations may be bfloat16 (``dtype`` field); dropout uses the same
+explicit ``'dropout'`` PRNG collection as the CNN so the trainers' key threading works
+unchanged; the whole forward is pure and traced once per ``deterministic`` variant.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as fnn
+import jax
+import jax.numpy as jnp
+
+from csed_514_project_distributed_training_using_pytorch_tpu import ops
+
+
+# Stock flax initializers (transformer-standard trunc-free normal(0.02) embeddings/
+# projections, zero biases, unit LN scales) — the torch-parity initializers in
+# ops/initializers.py are CNN-specific and stay there.
+_normal_init = fnn.initializers.normal
+_zeros_init = fnn.initializers.zeros_init()
+_ones_init = fnn.initializers.ones_init()
+
+
+class MultiHeadSelfAttention(fnn.Module):
+    """Multi-head self-attention with a pluggable core.
+
+    ``attention_fn(q, k, v, *, causal) -> out`` operates on ``[B, S, H, D]``; the module
+    owns only the projections, so swapping the dense core for the sequence-parallel ring
+    core changes no parameters — the two variants share checkpoints bit-for-bit.
+    """
+
+    num_heads: int
+    attention_fn: Callable = ops.full_attention
+    causal: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @fnn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, s, e = x.shape
+        if e % self.num_heads:
+            raise ValueError(f"embed dim {e} not divisible by {self.num_heads} heads")
+        head_dim = e // self.num_heads
+
+        wqkv = self.param("qkv_kernel", _normal_init(0.02), (e, 3 * e))
+        bqkv = self.param("qkv_bias", _zeros_init, (3 * e,))
+        qkv = ops.dense(x, wqkv.astype(self.dtype), bqkv.astype(self.dtype))
+        qkv = qkv.reshape(b, s, 3, self.num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        out = self.attention_fn(q, k, v, causal=self.causal)
+        out = out.reshape(b, s, e)
+
+        wo = self.param("out_kernel", _normal_init(0.02), (e, e))
+        bo = self.param("out_bias", _zeros_init, (e,))
+        return ops.dense(out, wo.astype(self.dtype), bo.astype(self.dtype))
+
+
+class TransformerBlock(fnn.Module):
+    """Pre-LN encoder block: ``x + MHA(LN(x))`` then ``x + MLP(LN(x))``."""
+
+    num_heads: int
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.1
+    attention_fn: Callable = ops.full_attention
+    causal: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @fnn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        e = x.shape[-1]
+
+        g1 = self.param("ln1_scale", _ones_init, (e,))
+        b1 = self.param("ln1_bias", _zeros_init, (e,))
+        h = ops.layer_norm(x, g1, b1)
+        h = MultiHeadSelfAttention(
+            num_heads=self.num_heads, attention_fn=self.attention_fn,
+            causal=self.causal, dtype=self.dtype, name="attn")(h)
+        if not deterministic:
+            h = ops.dropout(self.make_rng("dropout"), h, self.dropout_rate,
+                            deterministic=False)
+        x = x + h
+
+        g2 = self.param("ln2_scale", _ones_init, (e,))
+        b2 = self.param("ln2_bias", _zeros_init, (e,))
+        h = ops.layer_norm(x, g2, b2)
+        w_up = self.param("mlp_up_kernel", _normal_init(0.02),
+                          (e, self.mlp_ratio * e))
+        b_up = self.param("mlp_up_bias", _zeros_init, (self.mlp_ratio * e,))
+        h = ops.gelu(ops.dense(h, w_up.astype(self.dtype), b_up.astype(self.dtype)))
+        w_dn = self.param("mlp_down_kernel", _normal_init(0.02),
+                          (self.mlp_ratio * e, e))
+        b_dn = self.param("mlp_down_bias", _zeros_init, (e,))
+        h = ops.dense(h, w_dn.astype(self.dtype), b_dn.astype(self.dtype))
+        if not deterministic:
+            h = ops.dropout(self.make_rng("dropout"), h, self.dropout_rate,
+                            deterministic=False)
+        return x + h
+
+
+class TransformerClassifier(fnn.Module):
+    """Image classifier over a pixel-token sequence, emitting log-probabilities.
+
+    Accepts ``[B, 28, 28, 1]`` images (tokenized internally to ``seq_len`` tokens of
+    ``784 // seq_len`` features) or an already-tokenized ``[B, S, F]`` batch. The output
+    contract matches ``models.cnn.Net`` (``[B, num_classes]`` log-probs), so trainers,
+    eval, metrics, and checkpointing work unchanged.
+    """
+
+    num_classes: int = 10
+    seq_len: int = 16           # 784 = 16 tokens × 49 features; divisible by an 8-way mesh
+    embed_dim: int = 64
+    num_layers: int = 2
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.1
+    attention_fn: Callable = ops.full_attention
+    causal: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @fnn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        if x.ndim == 4:
+            b = x.shape[0]
+            if x.shape[1] * x.shape[2] * x.shape[3] % self.seq_len:
+                raise ValueError(
+                    f"image size {x.shape[1:]} not divisible into {self.seq_len} tokens")
+            x = x.reshape(b, self.seq_len, -1)
+        b, s, f = x.shape
+        if s != self.seq_len:
+            raise ValueError(f"expected seq_len {self.seq_len}, got {s}")
+        x = x.astype(self.dtype)
+
+        w_embed = self.param("embed_kernel", _normal_init(0.02), (f, self.embed_dim))
+        b_embed = self.param("embed_bias", _zeros_init, (self.embed_dim,))
+        h = ops.dense(x, w_embed.astype(self.dtype), b_embed.astype(self.dtype))
+        pos = self.param("pos_embed", _normal_init(0.02), (self.seq_len, self.embed_dim))
+        h = h + pos.astype(self.dtype)[None]
+
+        for i in range(self.num_layers):
+            h = TransformerBlock(
+                num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+                dropout_rate=self.dropout_rate, attention_fn=self.attention_fn,
+                causal=self.causal, dtype=self.dtype, name=f"block_{i}")(
+                    h, deterministic=deterministic)
+
+        g = self.param("ln_f_scale", _ones_init, (self.embed_dim,))
+        beta = self.param("ln_f_bias", _zeros_init, (self.embed_dim,))
+        h = ops.layer_norm(h, g, beta)
+        h = jnp.mean(h, axis=1)  # mean-pool over tokens
+
+        w_head = self.param("head_kernel", _normal_init(0.02),
+                            (self.embed_dim, self.num_classes))
+        b_head = self.param("head_bias", _zeros_init, (self.num_classes,))
+        logits = ops.dense(h, w_head.astype(self.dtype), b_head.astype(self.dtype))
+        return ops.log_softmax(logits.astype(jnp.float32))

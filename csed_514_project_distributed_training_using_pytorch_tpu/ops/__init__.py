@@ -16,6 +16,11 @@ from csed_514_project_distributed_training_using_pytorch_tpu.ops.nn import (
     cross_entropy_loss,
     dropout,
     dropout2d,
+    layer_norm,
+    gelu,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
+    full_attention,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.initializers import (
     torch_kaiming_uniform,
@@ -32,6 +37,9 @@ __all__ = [
     "cross_entropy_loss",
     "dropout",
     "dropout2d",
+    "layer_norm",
+    "gelu",
+    "full_attention",
     "torch_kaiming_uniform",
     "torch_fan_in_uniform",
 ]

@@ -1,0 +1,43 @@
+"""Scaled dot-product attention (the dense, single-device formulation).
+
+The reference has no attention anywhere (its only model is a fixed-28×28-input CNN,
+reference ``src/model.py:4-22``; SURVEY.md §2c marks sequence parallelism "structurally
+inapplicable"). This op exists for the beyond-parity long-context surface this framework
+adds on top of reference parity: it is the numerics oracle that the sequence-parallel
+ring attention (``parallel/ring_attention.py``) is pinned against, and the default
+attention implementation of the transformer model family (``models/transformer.py``).
+
+TPU notes: both einsums are MXU matmuls; softmax statistics are computed in float32
+regardless of activation dtype (bfloat16-safe), matching the online-softmax accumulation
+the ring formulation uses so the two paths agree to float32 round-off.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Large-but-finite mask value: keeps ``exp`` exactly 0 for masked scores without the
+# NaN hazards of -inf arithmetic in the online-softmax recurrence.
+MASK_VALUE = -1e30
+
+
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   causal: bool = False) -> jax.Array:
+    """Dense softmax attention. ``q, k, v: [B, S, H, D]`` → ``[B, S, H, D]``.
+
+    ``causal=True`` masks key positions strictly after the query position (decoder-style
+    self-attention). Scores and the softmax run in float32; output is cast back to
+    ``q.dtype``.
+    """
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :]
+        scores = jnp.where(mask[None, None], scores, MASK_VALUE)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -109,6 +109,28 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array, *, reduction: str =
     return nll_loss(log_softmax(logits), labels, reduction=reduction)
 
 
+def layer_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+               *, eps: float = 1e-5) -> jax.Array:
+    """Layer normalization over the last axis with learned scale/shift.
+
+    Not used by the reference's CNN (it has no normalization layers) — this is part of the
+    beyond-parity attention model family (``models/transformer.py``). Statistics are computed
+    in float32 so bfloat16 activations normalize accurately, then cast back.
+    """
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    normed = (xf - mean) * lax.rsqrt(var + eps)
+    return (normed * gamma.astype(jnp.float32)
+            + beta.astype(jnp.float32)).astype(x.dtype)
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    """Gaussian-error linear unit (tanh approximation — the transformer-standard
+    nonlinearity; XLA fuses it into the surrounding matmuls)."""
+    return jax.nn.gelu(x, approximate=True)
+
+
 def dropout(rng: jax.Array, x: jax.Array, rate: float, *, deterministic: bool) -> jax.Array:
     """Elementwise inverted dropout (``F.dropout``, reference ``src/model.py:20``).
 

@@ -20,6 +20,10 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.collective
     ring_pass,
     all_reduce_sum,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_attention import (
+    ring_attention,
+    make_ring_attention_fn,
+)
 
 __all__ = [
     "ShardedSampler",
@@ -28,4 +32,6 @@ __all__ = [
     "process_info",
     "ring_pass",
     "all_reduce_sum",
+    "ring_attention",
+    "make_ring_attention_fn",
 ]

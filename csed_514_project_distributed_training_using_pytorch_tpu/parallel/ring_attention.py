@@ -1,0 +1,142 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Beyond-parity capability (the reference is DP-only — SURVEY.md §2c — and has no attention
+op at all): self-attention over a sequence that is **sharded across devices along the
+sequence axis**, so context length scales with the number of chips instead of being
+bounded by one chip's HBM.
+
+Design (TPU-first, the blockwise/ring formulation):
+
+- Each device holds its local ``S/n`` slice of Q, K, V. K/V blocks rotate around the mesh
+  axis ring with ``lax.ppermute`` — on hardware these hops ride **ICI** neighbor links,
+  and XLA overlaps the permute with the block's attention math.
+- Attention is accumulated with the **online softmax** recurrence (running max ``m``,
+  running normalizer ``l``, running numerator ``acc``) in float32, so the sharded result
+  equals the dense softmax to float32 round-off — pinned against
+  ``ops.attention.full_attention`` in ``tests/test_ring_attention.py``.
+- The hop loop is a ``lax.scan`` (not ``fori_loop``) so the whole thing is **reverse-mode
+  differentiable**: ``ppermute`` transposes to the inverse permutation, and the scan gives
+  XLA a static, compiler-friendly loop. Gradients are likewise parity-tested.
+- Causal masking uses *global* positions reconstructed from ``lax.axis_index`` and the hop
+  count, so decoder-style attention works identically under sharding.
+
+No backend strings, no explicit sends: the collective schedule is the compiler's job
+(same philosophy as ``parallel/collectives.py``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
+    MASK_VALUE,
+)
+
+
+def _ring_attention_local(ql: jax.Array, kl: jax.Array, vl: jax.Array, *,
+                          axis_name: str, num_shards: int,
+                          causal: bool) -> jax.Array:
+    """Per-device body: local Q block stays put; K/V blocks arrive via the ring.
+
+    ``ql, kl, vl: [B, S/n, H, D]`` (this device's shard). Runs inside ``shard_map``.
+    """
+    b, s_q, h, d = ql.shape
+    s_k = kl.shape[1]
+    my_index = lax.axis_index(axis_name)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    qf = ql.astype(jnp.float32) * scale
+
+    # K/V move one step "forward" per hop: after hop t, the block sitting on device i
+    # originated on device (i - t) mod n — that origin gives the block's global positions.
+    perm = [(j, (j + 1) % num_shards) for j in range(num_shards)]
+    q_pos = my_index * s_q + jnp.arange(s_q)  # global query positions [S/n]
+
+    def update(carry, k_blk, v_blk, origin):
+        """Fold one K/V block into the online-softmax accumulators."""
+        acc, m, l = carry
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            k_blk.astype(jnp.float32))  # [B,H,Sq,Sk]
+        if causal:
+            k_pos = origin * s_k + jnp.arange(s_k)
+            visible = q_pos[:, None] >= k_pos[None, :]  # [Sq,Sk]
+            scores = jnp.where(visible[None, None], scores, MASK_VALUE)
+        m_block = jnp.max(scores, axis=-1)                # [B,H,Sq]
+        m_new = jnp.maximum(m, m_block)
+        p = jnp.exp(scores - m_new[..., None])            # [B,H,Sq,Sk]
+        if causal:
+            # A fully-masked block leaves m_new at MASK_VALUE; exp(0)=1 rows must not
+            # leak into the normalizer.
+            p = jnp.where(visible[None, None], p, 0.0)
+        correction = jnp.exp(m - m_new)                   # [B,H,Sq]
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        acc_corr = jnp.transpose(correction, (0, 2, 1))[..., None]  # [B,Sq,H,1]
+        acc_new = acc * acc_corr + jnp.einsum("bhqk,bkhd->bqhd", p,
+                                              v_blk.astype(jnp.float32))
+        return acc_new, m_new, l_new
+
+    def hop(carry, t):
+        acc, m, l, k_cur, v_cur = carry
+        acc, m, l = update((acc, m, l), k_cur, v_cur,
+                           (my_index - t) % num_shards)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m, l, k_next, v_next), None
+
+    acc0 = jnp.zeros((b, s_q, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s_q), MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((b, h, s_q), jnp.float32)
+    # Scan the first n-1 hops (each: block math, then rotate K/V); the last arriving
+    # block is folded in outside the scan so no ppermute is issued whose result is
+    # discarded (XLA cannot DCE collectives inside a scan — that would otherwise cost an
+    # extra round of ICI transfers per call).
+    (acc, m, l, k_last, v_last), _ = lax.scan(
+        hop, (acc0, m0, l0, kl, vl), jnp.arange(num_shards - 1))
+    acc, _, l = update((acc, m, l), k_last, v_last,
+                       (my_index - (num_shards - 1)) % num_shards)
+
+    # Under causal masking every query sees at least itself, so l > 0; the guard only
+    # protects pathological all-masked rows from dividing by zero.
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / jnp.transpose(l_safe, (0, 2, 1))[..., None]
+    return out.astype(ql.dtype)
+
+
+def ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str = "seq", causal: bool = False) -> jax.Array:
+    """Sequence-parallel attention: ``[B, S, H, D]`` with S sharded over ``axis_name``.
+
+    Drop-in equivalent of ``ops.full_attention`` (same signature modulo the mesh);
+    callable under ``jax.jit`` (the mesh is static). The sequence length must divide by
+    the mesh axis size.
+    """
+    n = mesh.shape[axis_name]
+    if q.shape[1] % n:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by mesh axis "
+            f"{axis_name!r} size {n} — ring attention shards the sequence evenly")
+    spec = P(None, axis_name, None, None)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+             check_vma=False)
+    def _ring(ql, kl, vl):
+        return _ring_attention_local(ql, kl, vl, axis_name=axis_name,
+                                     num_shards=n, causal=causal)
+
+    return _ring(q, k, v)
+
+
+def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq"):
+    """Bind a mesh into a ``(q, k, v, *, causal) -> out`` callable with
+    ``ops.full_attention``'s exact signature — the injection point for
+    ``models/transformer.py``'s pluggable ``attention_fn``."""
+
+    def attention_fn(q, k, v, *, causal: bool = False):
+        return ring_attention(mesh, q, k, v, axis_name=axis_name, causal=causal)
+
+    return attention_fn

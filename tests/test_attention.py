@@ -1,0 +1,89 @@
+"""ops.attention + the new transformer-support ops (layer_norm, gelu).
+
+These are beyond-parity ops (the reference has no attention or normalization anywhere —
+its only model is the conv/fc CNN, reference ``src/model.py:4-22``); the oracle here is
+direct numpy math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu import ops
+
+
+def _numpy_attention(q, k, v, causal=False):
+    b, s, h, d = q.shape
+    scores = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        mask = np.tril(np.ones((s, s), bool))
+        scores = np.where(mask[None, None], scores, -np.inf)
+    scores -= scores.max(-1, keepdims=True)
+    w = np.exp(scores)
+    w /= w.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_full_attention_matches_numpy(causal):
+    rng = np.random.default_rng(0)
+    q, k, v = (rng.normal(size=(2, 6, 3, 4)).astype(np.float32) for _ in range(3))
+    out = ops.full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                             causal=causal)
+    np.testing.assert_allclose(np.asarray(out), _numpy_attention(q, k, v, causal),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_causal_first_token_attends_only_to_itself():
+    rng = np.random.default_rng(1)
+    q, k = (rng.normal(size=(1, 5, 1, 4)).astype(np.float32) for _ in range(2))
+    v = rng.normal(size=(1, 5, 1, 4)).astype(np.float32)
+    out = ops.full_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                             causal=True)
+    # Query 0 sees only key 0 → its output IS v[0] exactly (softmax over one entry).
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0], v[0, 0, 0],
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_layer_norm_matches_numpy():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 7, 16)).astype(np.float32) * 3 + 1
+    gamma = rng.normal(size=(16,)).astype(np.float32)
+    beta = rng.normal(size=(16,)).astype(np.float32)
+    out = ops.layer_norm(jnp.asarray(x), jnp.asarray(gamma), jnp.asarray(beta))
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    expected = (x - mean) / np.sqrt(var + 1e-5) * gamma + beta
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm_output_standardized():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(8, 32)).astype(np.float32) * 10 + 5)
+    out = ops.layer_norm(x, jnp.ones(32), jnp.zeros(32))
+    np.testing.assert_allclose(np.asarray(out).mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out).std(-1), 1.0, atol=1e-3)
+
+
+def test_gelu_basic_properties():
+    x = jnp.linspace(-5, 5, 101)
+    y = ops.gelu(x)
+    assert bool(jnp.all(jnp.isfinite(y)))
+    # gelu(0)=0; positive tail ≈ identity, negative tail ≈ 0
+    np.testing.assert_allclose(float(ops.gelu(jnp.zeros(()))), 0.0, atol=1e-7)
+    np.testing.assert_allclose(float(y[-1]), 5.0, atol=1e-3)
+    np.testing.assert_allclose(float(y[0]), 0.0, atol=1e-3)
+
+
+def test_full_attention_is_jittable_and_differentiable():
+    rng = np.random.default_rng(4)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 4, 2, 4)).astype(np.float32))
+               for _ in range(3))
+
+    @jax.jit
+    def loss(q, k, v):
+        return jnp.sum(jnp.square(ops.full_attention(q, k, v, causal=True)))
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert all(bool(jnp.all(jnp.isfinite(g))) for g in grads)
