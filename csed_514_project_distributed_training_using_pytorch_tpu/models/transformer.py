@@ -6,7 +6,8 @@ parity (SURVEY.md §2c). This module is the beyond-parity model family that make
 framework's sequence-parallel machinery (``parallel/ring_attention.py``) a first-class,
 exercised capability rather than dead plumbing:
 
-- ``TransformerClassifier`` treats an image as a **sequence of pixel-row tokens** and
+- ``TransformerClassifier`` treats an image as a **sequence of flat pixel-chunk tokens**
+  (``seq_len`` tokens of ``784 // seq_len`` consecutive pixels in raster order) and
   classifies it with a pre-LN transformer encoder. It accepts the same ``[B, 28, 28, 1]``
   input and exposes the same ``(x, *, deterministic)`` call signature as ``models.cnn.Net``,
   so it is **drop-in** for every existing trainer, checkpointer, and eval path

@@ -24,6 +24,21 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_atten
     ring_attention,
     make_ring_attention_fn,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.tensor_parallel import (
+    param_partition_specs,
+    shard_train_state,
+    compile_step_tp,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.pipeline import (
+    pipeline_apply,
+    make_pipelined_blocks_fn,
+    stack_stage_params,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.expert_parallel import (
+    init_moe_params,
+    moe_apply,
+    shard_moe_params,
+)
 
 __all__ = [
     "ShardedSampler",
@@ -34,4 +49,13 @@ __all__ = [
     "all_reduce_sum",
     "ring_attention",
     "make_ring_attention_fn",
+    "param_partition_specs",
+    "shard_train_state",
+    "compile_step_tp",
+    "pipeline_apply",
+    "make_pipelined_blocks_fn",
+    "stack_stage_params",
+    "init_moe_params",
+    "moe_apply",
+    "shard_moe_params",
 ]

@@ -1,0 +1,143 @@
+"""Expert parallelism: a mixture-of-experts MLP with experts sharded over a mesh axis.
+
+Beyond-parity capability (the reference has no routing/expert code — SURVEY.md §2c):
+a Switch-style top-1-routed MoE feed-forward layer whose expert weights shard across an
+``expert`` mesh axis, so total parameter count scales with chips while per-token FLOPs
+stay constant.
+
+TPU-first expression:
+
+- Routing, dispatch, and combine are **einsums over a one-hot capacity layout**
+  (``[tokens, experts, capacity]`` — the GShard/Switch formulation): everything is static
+  shapes and MXU-friendly batched matmuls, no scatter/gather with data-dependent shapes
+  (which would defeat XLA).
+- Expert weights carry a leading ``[num_experts, ...]`` dim sharded ``P('expert')``; a
+  ``with_sharding_constraint`` pins the dispatched ``[experts, capacity, d]`` activations
+  to the same axis, and GSPMD derives the all-to-all-shaped collectives that move tokens
+  to their experts and back. No hand-written collective, no backend string.
+- Over-capacity tokens are dropped (output zero) — callers place MoE layers on a residual
+  path, so a dropped token degrades to identity, the standard Switch behavior. The
+  auxiliary load-balance loss (Switch §2.2's ``num_experts * mean(frac_tokens *
+  frac_probs)``) is returned for the trainer to add.
+
+The oracle (``tests/test_expert_parallel.py``): the same routed computation evaluated
+densely — every expert on every token, masked select — matches the dispatched/sharded
+layer exactly, forward and gradients.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from csed_514_project_distributed_training_using_pytorch_tpu.ops import gelu
+
+
+def init_moe_params(rng: jax.Array, *, d_model: int, d_hidden: int,
+                    num_experts: int) -> dict:
+    """Router + per-expert MLP weights (leading dim = expert). Router follows the
+    transformer family's normal(0.02) init; expert biases start at zero."""
+    k_router, k_up, k_down = jax.random.split(rng, 3)
+    scale = 0.02
+    return {
+        "router_kernel": jax.random.normal(k_router, (d_model, num_experts)) * scale,
+        "up_kernel": jax.random.normal(k_up, (num_experts, d_model, d_hidden)) * scale,
+        "up_bias": jnp.zeros((num_experts, d_hidden)),
+        "down_kernel": jax.random.normal(k_down, (num_experts, d_hidden, d_model)) * scale,
+        "down_bias": jnp.zeros((num_experts, d_model)),
+    }
+
+
+def moe_partition_specs(params: dict, *, axis_name: str = "expert") -> dict:
+    """Per-leaf specs: expert-stacked weights shard on their expert dim, the router
+    replicates (every device routes every token)."""
+    return {
+        "router_kernel": P(),
+        "up_kernel": P(axis_name, None, None),
+        "up_bias": P(axis_name, None),
+        "down_kernel": P(axis_name, None, None),
+        "down_bias": P(axis_name, None),
+    }
+
+
+def shard_moe_params(mesh: Mesh, params: dict, *, axis_name: str = "expert") -> dict:
+    specs = moe_partition_specs(params, axis_name=axis_name)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
+
+
+def _route(params: dict, tokens: jax.Array, *, capacity: int):
+    """Top-1 routing to a ``[N, E, C]`` dispatch/combine layout (static shapes)."""
+    logits = tokens @ params["router_kernel"]              # [N, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_index = jnp.argmax(probs, axis=-1)              # [N]
+    num_experts = logits.shape[-1]
+    onehot = jax.nn.one_hot(expert_index, num_experts)     # [N, E]
+    gate = jnp.sum(probs * onehot, axis=-1)                # [N]
+    # Position of each token in its expert's queue; ≥capacity ⇒ dropped.
+    position = jnp.cumsum(onehot, axis=0) - onehot         # [N, E] (0-based, own slot)
+    position = jnp.sum(position * onehot, axis=-1).astype(jnp.int32)  # [N]
+    kept = position < capacity
+    dispatch = (onehot * kept[:, None])[:, :, None] * jax.nn.one_hot(
+        jnp.clip(position, 0, capacity - 1), capacity)[:, None, :]   # [N, E, C]
+    combine = dispatch * gate[:, None, None]
+    # Switch load-balance auxiliary: num_experts * Σ_e frac_tokens_e * frac_probs_e.
+    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux_loss = num_experts * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux_loss
+
+
+def _expert_mlp(params: dict, x_e: jax.Array) -> jax.Array:
+    """Per-expert MLP over the dispatched ``[E, C, d]`` layout — batched MXU matmuls."""
+    h = gelu(jnp.einsum("ecd,edh->ech", x_e, params["up_kernel"])
+             + params["up_bias"][:, None])
+    return (jnp.einsum("ech,ehd->ecd", h, params["down_kernel"])
+            + params["down_bias"][:, None])
+
+
+def moe_apply(params: dict, tokens: jax.Array, *, capacity_factor: float = 1.25,
+              mesh: Mesh | None = None, axis_name: str = "expert") -> tuple[jax.Array, jax.Array]:
+    """Apply the MoE layer to ``tokens: [N, d]`` → ``(outputs [N, d], aux_loss)``.
+
+    With ``mesh``, the dispatched activations are constrained onto the expert axis so the
+    expert matmuls run where the (sharded) weights live; without it the same program runs
+    on one device. Identical numerics either way (the EP oracle test).
+    """
+    num_experts = params["router_kernel"].shape[-1]
+    n = tokens.shape[0]
+    capacity = max(1, math.ceil(n / num_experts * capacity_factor))
+    dispatch, combine, aux_loss = _route(params, tokens, capacity=capacity)
+    x_e = jnp.einsum("nec,nd->ecd", dispatch, tokens)      # [E, C, d]
+    if mesh is not None:
+        x_e = jax.lax.with_sharding_constraint(
+            x_e, NamedSharding(mesh, P(axis_name, None, None)))
+    y_e = _expert_mlp(params, x_e)
+    if mesh is not None:
+        y_e = jax.lax.with_sharding_constraint(
+            y_e, NamedSharding(mesh, P(axis_name, None, None)))
+    outputs = jnp.einsum("nec,ecd->nd", combine, y_e)
+    return outputs.astype(tokens.dtype), aux_loss
+
+
+def moe_apply_dense_oracle(params: dict, tokens: jax.Array, *,
+                           capacity_factor: float = 1.25) -> tuple[jax.Array, jax.Array]:
+    """Reference semantics with no dispatch machinery: every expert computes every token,
+    then the routed/kept one is selected and gated. O(E·N·d·h) — test oracle only."""
+    num_experts = params["router_kernel"].shape[-1]
+    n = tokens.shape[0]
+    capacity = max(1, math.ceil(n / num_experts * capacity_factor))
+    dispatch, _, aux_loss = _route(params, tokens, capacity=capacity)
+    kept_gate = jnp.sum(dispatch, axis=-1)                 # [N, E] ∈ {0,1}
+    logits = tokens @ params["router_kernel"]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    per_expert = jnp.einsum("nd,edh->neh", tokens, params["up_kernel"])
+    per_expert = gelu(per_expert + params["up_bias"][None])
+    per_expert = jnp.einsum("neh,ehd->ned", per_expert, params["down_kernel"])
+    per_expert = per_expert + params["down_bias"][None]
+    weights = kept_gate * probs                            # gate only the kept top-1 slot
+    out = jnp.einsum("ne,ned->nd", weights, per_expert)
+    return out.astype(tokens.dtype), aux_loss

@@ -1,0 +1,120 @@
+"""Pipeline parallelism: stage-sharded layers with a microbatch ring.
+
+Beyond-parity capability (the reference's model is a single 21.8k-param forward,
+SURVEY.md §2c — no stage split possible or needed): a stack of identically-shaped layers
+is sharded across devices along a ``stage`` mesh axis, and microbatches stream through the
+stages GPipe-style. Depth then scales with chips: each device holds only its stage's
+weights.
+
+TPU-first expression — one ``shard_map`` program, no per-stage processes or RPC:
+
+- Stage ``s`` holds slice ``s`` of the **stacked** layer parameters (leading dim =
+  number of stages, sharded ``P('stage')`` — the natural SPMD layout for a homogeneous
+  layer stack).
+- A ``lax.scan`` runs ``M + S - 1`` ticks (M microbatches, S stages — the classic GPipe
+  schedule incl. its fill/drain bubble). Every tick, each device applies its stage to its
+  current activation and the activations rotate one hop with ``lax.ppermute`` (ICI
+  neighbor traffic on hardware). Stage 0 ingests microbatch ``t``; the last stage banks
+  microbatch ``t - (S-1)``.
+- The banked outputs are combined with a masked ``psum`` so every device returns the full
+  result replicated — and the whole schedule is reverse-mode differentiable (scan +
+  ppermute transpose), so the pipeline composes with ``jax.value_and_grad`` training.
+
+Bubble fraction is the textbook ``(S-1)/(M+S-1)``; choose ``M >> S`` to amortize.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def stack_stage_params(stage_param_list):
+    """Stack per-stage parameter pytrees (identical structure) into one pytree with a
+    leading ``[num_stages, ...]`` dim — the shardable layout ``pipeline_apply`` consumes.
+
+    For the transformer family: ``stack_stage_params([params[f"block_{i}"] for i in
+    range(L)])`` turns L blocks into an L-stage stack (see tests).
+    """
+    return jax.tree_util.tree_map(lambda *leaves: jnp.stack(leaves), *stage_param_list)
+
+
+def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params,
+                   microbatches: jax.Array, *, axis_name: str = "stage") -> jax.Array:
+    """Run ``microbatches`` through the stage pipeline.
+
+    ``stage_fn(stage_params, x) -> y`` is one stage's computation with ``y.shape ==
+    x.shape`` (residual-block-shaped, as transformer blocks are). ``stacked_params`` has
+    leading dim == mesh axis size; ``microbatches: [M, mb, ...]``. Returns ``[M, mb, ...]``
+    outputs, replicated.
+    """
+    num_stages = mesh.shape[axis_name]
+    if jax.tree_util.tree_leaves(stacked_params)[0].shape[0] != num_stages:
+        raise ValueError(
+            f"stacked params leading dim "
+            f"{jax.tree_util.tree_leaves(stacked_params)[0].shape[0]} != mesh axis "
+            f"{axis_name!r} size {num_stages}")
+    num_micro = microbatches.shape[0]
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(axis_name), P()), out_specs=P(),
+             check_vma=False)
+    def run(params_stacked, xs):
+        # This device's stage slice ([1, ...] shard → drop the stage dim).
+        params = jax.tree_util.tree_map(lambda p: p[0], params_stacked)
+        stage = lax.axis_index(axis_name)
+        perm = [(j, (j + 1) % num_stages) for j in range(num_stages)]
+
+        def tick(carry, t):
+            x_cur, banked = carry
+            # Stage 0 ingests microbatch t (clip keeps the gather in range during drain;
+            # the value is discarded by the stage-0 select on those ticks anyway).
+            feed = xs[jnp.clip(t, 0, num_micro - 1)]
+            x_in = jnp.where(stage == 0, feed, x_cur)
+            y = stage_fn(params, x_in)
+            # The last stage banks finished microbatch t-(S-1) once the pipe has filled.
+            w = t - (num_stages - 1)
+            w_clipped = jnp.clip(w, 0, num_micro - 1)
+            do_bank = jnp.logical_and(stage == num_stages - 1, w >= 0)
+            banked = lax.dynamic_update_index_in_dim(
+                banked,
+                jnp.where(do_bank, y, lax.dynamic_index_in_dim(
+                    banked, w_clipped, 0, keepdims=False)),
+                w_clipped, 0)
+            x_next = lax.ppermute(y, axis_name, perm)
+            return (x_next, banked), None
+
+        banked0 = jnp.zeros_like(xs)
+        (_, banked), _ = lax.scan(
+            tick, (jnp.zeros_like(xs[0]), banked0),
+            jnp.arange(num_micro + num_stages - 1))
+        # Only the last stage holds real outputs; the masked psum replicates them.
+        return lax.psum(
+            jnp.where(stage == num_stages - 1, banked, jnp.zeros_like(banked)),
+            axis_name)
+
+    return run(stacked_params, microbatches)
+
+
+def make_pipelined_blocks_fn(mesh: Mesh, stage_fn: Callable, *,
+                             axis_name: str = "stage",
+                             num_microbatches: int = 8) -> Callable:
+    """Bind a mesh/microbatch count into ``f(stacked_params, x) -> y`` over a flat
+    ``[B, ...]`` batch: splits B into microbatches, pipelines them, and re-flattens.
+    ``B`` must divide by ``num_microbatches``."""
+
+    def apply(stacked_params, x):
+        b = x.shape[0]
+        if b % num_microbatches:
+            raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
+        xs = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+        ys = pipeline_apply(mesh, stage_fn, stacked_params, xs, axis_name=axis_name)
+        return ys.reshape(x.shape)
+
+    return apply

@@ -1,0 +1,109 @@
+"""Expert parallelism: the dispatched (and expert-sharded) MoE layer vs the dense oracle.
+
+Contract (``parallel/expert_parallel.py``): the einsum dispatch/combine machinery — and
+sharding expert weights over an ``expert`` mesh axis — never changes what is computed:
+every token's output equals its routed expert's MLP scaled by the gate (or zero when the
+expert is over capacity), exactly as the dense every-expert-on-every-token evaluation
+selects it.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel import make_mesh
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+    expert_parallel as ep,
+)
+
+NUM_EXPERTS = 8
+D_MODEL, D_HIDDEN = 32, 64
+
+
+@pytest.fixture(scope="module")
+def params():
+    return ep.init_moe_params(jax.random.PRNGKey(0), d_model=D_MODEL,
+                              d_hidden=D_HIDDEN, num_experts=NUM_EXPERTS)
+
+
+def _tokens(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(n, D_MODEL)).astype(np.float32))
+
+
+def test_dispatched_matches_dense_oracle(params):
+    tokens = _tokens()
+    y_disp, aux_disp = ep.moe_apply(params, tokens)
+    y_dense, aux_dense = ep.moe_apply_dense_oracle(params, tokens)
+    np.testing.assert_allclose(np.asarray(y_disp), np.asarray(y_dense),
+                               rtol=1e-5, atol=1e-6)
+    assert abs(float(aux_disp) - float(aux_dense)) < 1e-6
+
+
+def test_expert_sharded_matches_dense_oracle(params):
+    mesh = make_mesh(NUM_EXPERTS, axis_names=("expert",))
+    sharded = ep.shard_moe_params(mesh, params)
+    # one expert's weights per device
+    assert sharded["up_kernel"].addressable_shards[0].data.shape == (1, D_MODEL, D_HIDDEN)
+    tokens = _tokens(seed=1)
+    y_ep, _ = jax.jit(lambda p, t: ep.moe_apply(p, t, mesh=mesh))(sharded, tokens)
+    y_dense, _ = ep.moe_apply_dense_oracle(params, tokens)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gradients_match_dense_oracle(params):
+    tokens = _tokens(seed=2)
+    g_disp = jax.grad(lambda p: jnp.sum(jnp.sin(ep.moe_apply(p, tokens)[0])))(params)
+    g_dense = jax.grad(
+        lambda p: jnp.sum(jnp.sin(ep.moe_apply_dense_oracle(p, tokens)[0])))(params)
+    for k in g_disp:
+        np.testing.assert_allclose(np.asarray(g_disp[k]), np.asarray(g_dense[k]),
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
+
+
+def test_over_capacity_tokens_drop_to_zero(params):
+    """capacity_factor → 0 forces capacity 1: at most one token per expert survives;
+    all others output exactly zero (the residual-identity contract)."""
+    tokens = _tokens(n=32, seed=3)
+    y, _ = ep.moe_apply(params, tokens, capacity_factor=1.0 / 32)
+    norms = np.linalg.norm(np.asarray(y), axis=-1)
+    assert (norms == 0).sum() >= 32 - NUM_EXPERTS  # ≤1 survivor per expert
+    assert bool(jnp.all(jnp.isfinite(y)))
+
+
+def test_capacity_rounds_up(params):
+    """ceil semantics (Switch/GShard): n=12, E=8, factor=1.25 → capacity 2, so an expert
+    receiving 2 tokens under balanced routing keeps both (int() would floor to 1)."""
+    tokens = _tokens(n=12, seed=6)
+    y_disp, _ = ep.moe_apply(params, tokens)
+    y_dense, _ = ep.moe_apply_dense_oracle(params, tokens)
+    np.testing.assert_allclose(np.asarray(y_disp), np.asarray(y_dense),
+                               rtol=1e-5, atol=1e-6)
+    dispatch, _, _ = ep._route(params, tokens, capacity=2)
+    assert dispatch.shape == (12, NUM_EXPERTS, 2)
+
+
+def test_load_balance_aux_loss_bounds(params):
+    """aux = E·Σ frac_tokens·frac_probs is 1 at perfect balance and ≤ E always."""
+    tokens = _tokens(n=128, seed=4)
+    _, aux = ep.moe_apply(params, tokens)
+    assert 0.0 < float(aux) <= NUM_EXPERTS + 1e-6
+
+
+def test_routing_is_sparse_top1(params):
+    """Each kept token receives exactly its gate weight once: summing the combine layout
+    over experts/capacity reproduces the per-token gate (or 0 when dropped)."""
+    tokens = _tokens(seed=5)
+    n = tokens.shape[0]
+    capacity = max(1, math.ceil(n / NUM_EXPERTS * 1.25))
+    dispatch, combine, _ = ep._route(params, tokens, capacity=capacity)
+    slots = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    assert set(np.unique(slots)).issubset({0.0, 1.0})
+    probs = jax.nn.softmax((tokens @ params["router_kernel"]).astype(jnp.float32), -1)
+    gate = np.asarray(jnp.max(probs, axis=-1))
+    np.testing.assert_allclose(np.asarray(jnp.sum(combine, axis=(1, 2))),
+                               gate * slots, rtol=1e-5, atol=1e-6)
