@@ -172,24 +172,29 @@ def test_params_export_roundtrip(tmp_path, model_state):
 
 def test_epoch_unroll_is_semantics_preserving(model_state):
     """unroll>1 is a codegen knob only: the scanned epoch must produce the same state and
-    losses as the sequential (unroll=1) program."""
+    losses as the sequential (unroll=1) program — including the shipped bench default
+    (unroll=8) and a step count (11) that 8 does not divide, so remainder handling is
+    covered too."""
     model, state0 = model_state
     x = jax.random.normal(jax.random.PRNGKey(5), (64, 28, 28, 1))
     y = jax.random.randint(jax.random.PRNGKey(6), (64,), 0, 10)
-    idx = jnp.arange(64, dtype=jnp.int32).reshape(8, 8)
+    # 11 steps of batch 8, indices repeating across rows — 11 % 8 != 0 on purpose.
+    idx = jax.random.randint(jax.random.PRNGKey(8), (11, 8), 0, 64).astype(jnp.int32)
     rng = jax.random.PRNGKey(7)
 
     outs = {}
-    for unroll in (1, 4):
+    for unroll in (1, 4, 8):
         fn = jax.jit(make_epoch_fn(model, learning_rate=0.01, momentum=0.5,
                                    unroll=unroll))
         outs[unroll] = fn(state0, x, y, idx, rng)
 
-    np.testing.assert_allclose(np.asarray(outs[1][1]), np.asarray(outs[4][1]),
-                               rtol=1e-6)
-    for a, b in zip(jax.tree_util.tree_leaves(outs[1][0].params),
-                    jax.tree_util.tree_leaves(outs[4][0].params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+    for unroll in (4, 8):
+        np.testing.assert_allclose(np.asarray(outs[1][1]), np.asarray(outs[unroll][1]),
+                                   rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(outs[1][0].params),
+                        jax.tree_util.tree_leaves(outs[unroll][0].params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
 
 
 def test_epoch_pregather_is_semantics_preserving(model_state):
@@ -204,13 +209,17 @@ def test_epoch_pregather_is_semantics_preserving(model_state):
     rng = jax.random.PRNGKey(7)
 
     outs = {}
-    for pregather in (False, True):
+    # (pregather, unroll): includes the shipped bench default combination (True, 8).
+    for key in ((False, 1), (True, 1), (True, 8)):
+        pregather, unroll = key
         fn = jax.jit(make_epoch_fn(model, learning_rate=0.01, momentum=0.5,
-                                   pregather=pregather))
-        outs[pregather] = fn(state0, x, y, idx, rng)
+                                   pregather=pregather, unroll=unroll))
+        outs[key] = fn(state0, x, y, idx, rng)
 
-    np.testing.assert_allclose(np.asarray(outs[False][1]), np.asarray(outs[True][1]),
-                               rtol=1e-6)
-    for a, b in zip(jax.tree_util.tree_leaves(outs[False][0].params),
-                    jax.tree_util.tree_leaves(outs[True][0].params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+    for key in ((True, 1), (True, 8)):
+        np.testing.assert_allclose(np.asarray(outs[(False, 1)][1]),
+                                   np.asarray(outs[key][1]), rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(outs[(False, 1)][0].params),
+                        jax.tree_util.tree_leaves(outs[key][0].params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
