@@ -1,0 +1,96 @@
+"""Long-context attention microbench: flash (Pallas) vs dense (XLA) on one chip.
+
+Measures forward+backward wall time of a causal multi-head self-attention at growing
+sequence lengths. The dense path materializes the ``[H, S, S]`` score matrix (O(S²) HBM);
+the flash kernels (``ops/pallas_attention.py``) stream K/V blocks through VMEM (O(S·D)),
+so it keeps scaling after the dense path exhausts memory — the single-chip half of the
+framework's long-context story (the cross-chip half is ``parallel/ring_attention.py``).
+
+Honest timing: each measurement fetches a scalar data-dependent on the full
+forward+backward before the clock stops (same protocol as ``utils/benchmarks.py`` —
+``block_until_ready`` alone under-reports on tunnelled PJRT backends).
+
+Usage: ``python bench_attention.py [--out results.jsonl]`` — one JSON line per
+(impl, seq_len); dense rows appear up to the longest S that fits/compiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+B, H, D = 1, 8, 64
+SEQ_LENS = (1024, 2048, 4096, 8192, 16384)
+DENSE_MAX_S = 8192      # [H, S, S] f32 residuals: 8k → 2 GiB of score-matrix traffic
+WARMUP, REPS = 1, 3
+
+
+def _measure(fn, q, k, v):
+    import jax
+    import jax.numpy as jnp
+
+    grad_fn = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(fn(q, k, v, causal=True) ** 2), argnums=(0, 1, 2)))
+    for _ in range(WARMUP):
+        g = grad_fn(q, k, v)
+        float(jnp.sum(g[0][0, 0, 0]))  # device→host sync on a grad-dependent scalar
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        g = grad_fn(q, k, v)
+        float(jnp.sum(g[0][0, 0, 0]))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None, help="also append JSONL here")
+    parser.add_argument("--seq-lens", type=int, nargs="+", default=list(SEQ_LENS),
+                        help="sequence lengths to measure (must divide by 128); "
+                             "small values make the tool drivable on CPU interpret mode")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from csed_514_project_distributed_training_using_pytorch_tpu import ops
+
+    platform = jax.default_backend()
+    device_kind = jax.devices()[0].device_kind
+    rows = []
+    for s in args.seq_lens:
+        rng = np.random.default_rng(s)
+        q, k, v = (jnp.asarray(rng.normal(size=(B, s, H, D)).astype(np.float32))
+                   for _ in range(3))
+        row = {"seq_len": s, "batch": B, "heads": H, "head_dim": D,
+               "platform": platform, "device_kind": device_kind, "causal": True,
+               "reps": REPS}
+        row["flash_fwdbwd_s"] = _measure(ops.flash_attention, q, k, v)
+        if s <= DENSE_MAX_S:
+            try:
+                row["dense_fwdbwd_s"] = _measure(ops.full_attention, q, k, v)
+                row["speedup_flash_vs_dense"] = round(
+                    row["dense_fwdbwd_s"] / row["flash_fwdbwd_s"], 3)
+            except Exception as e:  # OOM/compile failure: the dense wall, recorded
+                row["dense_fwdbwd_s"] = None
+                row["dense_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        else:
+            row["dense_fwdbwd_s"] = None
+            row["dense_error"] = f"skipped: O(S^2) scores beyond {DENSE_MAX_S}"
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    if args.out:
+        with open(args.out, "a") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,6 +22,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.ops.nn import (
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
     full_attention,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+    flash_attention,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.initializers import (
     torch_kaiming_uniform,
     torch_fan_in_uniform,
@@ -40,6 +43,7 @@ __all__ = [
     "layer_norm",
     "gelu",
     "full_attention",
+    "flash_attention",
     "torch_kaiming_uniform",
     "torch_fan_in_uniform",
 ]

@@ -1,0 +1,332 @@
+"""Flash attention as first-party Pallas TPU kernels (forward + full backward).
+
+The single-chip long-context hot path: dense attention materializes the ``[S, S]`` score
+matrix in HBM (O(S²) memory and bandwidth); these kernels stream K/V blocks through VMEM
+with the online-softmax recurrence, so HBM traffic is O(S·D) and the score matrix never
+exists. This is the intra-chip complement of the cross-chip ring attention in
+``parallel/ring_attention.py`` (same math, different memory wall).
+
+Kernel layout (FlashAttention-2 style, in the canonical Pallas-TPU grid formulation):
+
+- **Forward**: grid ``(B·H, S/BLOCK, S/BLOCK)`` — the innermost (fastest-varying) axis
+  walks K/V blocks while the query block and the online-softmax accumulators
+  ``(acc, m, l)`` persist in **VMEM scratch** across those steps (``@pl.when`` on the
+  first/last K/V step initializes/finalizes them). Streaming and double-buffering come
+  from Pallas's automatic grid pipelining — each operand's ``index_map`` names the block
+  the step needs and the next block's copy overlaps the current block's math. VMEM
+  residency is a handful of ``[128, D]`` blocks regardless of S, so sequence length is
+  HBM-bound: an earlier full-K/V-in-VMEM variant hit the 16 MB scoped-vmem wall at
+  S=16k, and a hand-rolled in-kernel DMA variant (``run_scoped`` + ``make_async_copy``
+  double buffering) wedged this environment's AOT Mosaic compile helper the same way the
+  whole-model fused kernel does — the grid formulation compiles in seconds.
+- **Backward**: the standard two-kernel recompute formulation — no O(S²) residuals, only
+  ``(out, lse = m + log l)``. A ``dq`` kernel re-walks K/V blocks per query block; a
+  ``dk/dv`` kernel walks query/dout blocks per key block; both recompute
+  ``p = exp(q·kᵀ·scale − lse)`` blockwise and apply ``ds = p ∘ (dout·vᵀ − Δ)`` with
+  ``Δ = rowsum(dout ∘ out)`` computed once outside the kernels (XLA fuses it).
+- **Causal**: blocks strictly above the diagonal are skipped via ``@pl.when`` — their
+  fetch still pipelines (grids cannot skip steps) but they cost no FLOPs.
+
+All matmuls request ``preferred_element_type=float32`` (MXU accumulation), block shapes
+are lane-aligned (``BLOCK = 128``, head dim on the lane axis), masks use 2-D
+``broadcasted_iota``, and the only in-kernel reshapes drop/add leading unit dims — every
+construct from the probe-verified list in ``ops/pallas_fused.py``'s lowering notes.
+
+Like the other Pallas modules: compiled on TPU, interpret mode elsewhere (the CPU test
+platform), numerics pinned against ``ops.attention.full_attention`` in
+``tests/test_pallas_attention.py`` (hardware-gated Mosaic re-check included). Sequences
+must divide by ``BLOCK`` (128); callers wanting odd lengths use the dense path (the
+transformer family's default).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
+    MASK_VALUE as NEG,
+)
+
+BLOCK = 128            # query/key block rows (sublane-aligned for f32, MXU-shaped)
+
+
+def _interpret() -> bool:
+    """Compiled on TPU; interpret mode on CPU/GPU (the test platforms)."""
+    return jax.default_backend() != "tpu"
+
+
+def _causal_mask(iq, ik, bq, bk):
+    """[bq, bk] visibility mask for query block iq vs key block ik (global positions)."""
+    q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return q_pos >= k_pos
+
+
+# =========================================================================================
+# Forward
+# =========================================================================================
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, num_k):
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    bq = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: key blocks strictly above the diagonal contribute nothing — no FLOPs
+    # (their fetch still pipelines; grids cannot skip steps).
+    @pl.when(jnp.logical_or(jnp.logical_not(causal), j <= iq))
+    def _():
+        q = q_ref[0].astype(jnp.float32) * scale                           # [bq, D]
+        k_blk = k_ref[0].astype(jnp.float32)                               # [bk, D]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)        # [bq, bk]
+        if causal:
+            visible = _causal_mask(iq, j, bq, BLOCK)
+            s = jnp.where(visible, s, NEG)
+        m = m_ref[:]
+        l = l_ref[:]
+        m_blk = jnp.max(s, axis=1, keepdims=True)                          # [bq, 1]
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(visible, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        v_blk = v_ref[0].astype(jnp.float32)
+        acc_ref[:] = (acc_ref[:] * corr
+                      + jnp.dot(p, v_blk, preferred_element_type=jnp.float32))
+        m_ref[:] = m_new
+        l_ref[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
+
+    @pl.when(j == num_k - 1)
+    def _():
+        l_safe = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse = m_ref[:] + jnp.log(l_safe)                                   # [bq, 1]
+        lse_ref[:] = jnp.transpose(lse).reshape(1, 1, 1, bq)
+
+
+def _flash_forward(q3, k3, v3, *, causal: bool):
+    """q3/k3/v3: [BH, S, D] → (out [BH, S, D], lse [BH, S/BLOCK, 1, BLOCK])."""
+    bh, s, d = q3.shape
+    scale = 1.0 / (d ** 0.5)
+    nq = s // BLOCK
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, num_k=nq)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nq),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BLOCK, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, BLOCK, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            # lse rides as [BH, nq, 1, BLOCK]: the (1, BLOCK) trailing dims equal the
+            # array's, satisfying Mosaic's last-two-dims block constraint.
+            pl.BlockSpec((1, 1, 1, BLOCK), lambda b, i, j: (b, i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, nq, 1, BLOCK), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK, d), jnp.float32),    # acc
+            pltpu.VMEM((BLOCK, 1), jnp.float32),    # running max m
+            pltpu.VMEM((BLOCK, 1), jnp.float32),    # running normalizer l
+        ],
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return out, lse
+
+
+# =========================================================================================
+# Backward (recompute formulation: residuals are out + lse only)
+# =========================================================================================
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc_ref, *, scale, causal, num_k):
+    iq = pl.program_id(1)
+    j = pl.program_id(2)
+    bq = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    @pl.when(jnp.logical_or(jnp.logical_not(causal), j <= iq))
+    def _():
+        q = q_ref[0].astype(jnp.float32)                          # [bq, D]
+        do = do_ref[0].astype(jnp.float32)                        # [bq, D]
+        lse = jnp.transpose(lse_ref[0, 0])                        # [1,bq] -> [bq, 1]
+        delta = jnp.transpose(delta_ref[0, 0])                    # [1,bq] -> [bq, 1]
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            visible = _causal_mask(iq, j, bq, BLOCK)
+            s = jnp.where(visible, s, NEG)
+        p = jnp.exp(s - lse)                                      # [bq, bk]
+        if causal:
+            p = jnp.where(visible, p, 0.0)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_acc_ref[:] = dq_acc_ref[:] + jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_k - 1)
+    def _():
+        dq_ref[0] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                dk_acc_ref, dv_acc_ref, *, scale, causal, num_q):
+    ik = pl.program_id(1)
+    i = pl.program_id(2)
+    bk = k_ref.shape[1]
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    # Causal: query blocks strictly before this key block see none of it.
+    @pl.when(jnp.logical_or(jnp.logical_not(causal), i >= ik))
+    def _():
+        k = k_ref[0].astype(jnp.float32)                          # [bk, D]
+        v = v_ref[0].astype(jnp.float32)                          # [bk, D]
+        q_blk = q_ref[0].astype(jnp.float32)                      # [bq, D]
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = jnp.transpose(lse_ref[0, 0])                    # [bq, 1]
+        delta_blk = jnp.transpose(delta_ref[0, 0])                # [bq, 1]
+        s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            visible = _causal_mask(i, ik, BLOCK, bk)
+            s = jnp.where(visible, s, NEG)
+        p = jnp.exp(s - lse_blk)                                  # [bq, bk]
+        if causal:
+            p = jnp.where(visible, p, 0.0)
+        # dv += pᵀ · do ; dk += dsᵀ · q
+        dv_acc_ref[:] = dv_acc_ref[:] + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                   # [bk, D]
+        dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk)
+        dk_acc_ref[:] = dk_acc_ref[:] + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == num_q - 1)
+    def _():
+        dk_ref[0] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(res, g, *, causal: bool):
+    q3, k3, v3, out, lse = res
+    bh, s, d = q3.shape
+    scale = 1.0 / (d ** 0.5)
+    nq = s // BLOCK
+    # Δ = rowsum(dout ∘ out), reshaped to the lse layout — XLA fuses this small pass.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(bh, nq, 1, BLOCK)
+
+    def row_i(b, i, j):
+        return (b, i, 0)
+
+    def row_j(b, i, j):
+        return (b, j, 0)
+
+    row_i_spec = pl.BlockSpec((1, BLOCK, d), row_i, memory_space=pltpu.VMEM)
+    row_j_spec = pl.BlockSpec((1, BLOCK, d), row_j, memory_space=pltpu.VMEM)
+    lse_i_spec = pl.BlockSpec((1, 1, 1, BLOCK), lambda b, i, j: (b, i, 0, 0),
+                              memory_space=pltpu.VMEM)
+    lse_j_spec = pl.BlockSpec((1, 1, 1, BLOCK), lambda b, i, j: (b, j, 0, 0),
+                              memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal, num_k=nq),
+        grid=(bh, nq, nq),
+        in_specs=[row_i_spec, row_j_spec, row_j_spec, row_i_spec, lse_i_spec,
+                  lse_i_spec],
+        out_specs=[row_i_spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q3.dtype)],
+        scratch_shapes=[pltpu.VMEM((BLOCK, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, g, lse, delta)[0]
+
+    # dkv grid: axis 1 = key block (accumulators persist), axis 2 = query block.
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, num_q=nq),
+        grid=(bh, nq, nq),
+        in_specs=[row_j_spec, row_i_spec, row_i_spec, row_j_spec, lse_j_spec,
+                  lse_j_spec],
+        out_specs=[row_i_spec, row_i_spec],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
+                   jax.ShapeDtypeStruct((bh, s, d), v3.dtype)],
+        scratch_shapes=[pltpu.VMEM((BLOCK, d), jnp.float32),
+                        pltpu.VMEM((BLOCK, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q3, k3, v3, g, lse, delta)
+    return dq, dk, dv
+
+
+# =========================================================================================
+# Public API: custom-vjp op on [B, S, H, D], ops.full_attention-compatible
+# =========================================================================================
+
+
+@functools.lru_cache(maxsize=2)
+def _make_op(causal: bool):
+    @jax.custom_vjp
+    def op(q3, k3, v3):
+        out, _ = _flash_forward(q3, k3, v3, causal=causal)
+        return out
+
+    def fwd(q3, k3, v3):
+        out, lse = _flash_forward(q3, k3, v3, causal=causal)
+        return out, (q3, k3, v3, out, lse)
+
+    def bwd(res, g):
+        return _flash_backward(res, g, causal=causal)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False) -> jax.Array:
+    """Drop-in for ``ops.full_attention``: ``[B, S, H, D]`` → ``[B, S, H, D]``.
+
+    Requires ``S % 128 == 0`` (lane-aligned blocks). Differentiable via the two-kernel
+    flash backward; usable as the transformer family's ``attention_fn``.
+    """
+    b, s, h, d = q.shape
+    if s % BLOCK:
+        raise ValueError(
+            f"flash_attention requires sequence length divisible by {BLOCK}, got {s} "
+            f"(use ops.full_attention for odd lengths)")
+    to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+    out3 = _make_op(bool(causal))(to3(q), to3(k), to3(v))
+    return jnp.transpose(out3.reshape(b, h, s, d), (0, 2, 1, 3))
