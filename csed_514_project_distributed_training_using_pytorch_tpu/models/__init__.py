@@ -12,4 +12,25 @@ from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer 
     TransformerClassifier,
 )
 
-__all__ = ["Net", "TransformerClassifier"]
+
+VALID_MODELS = ("cnn", "transformer")
+
+
+def validate_model_name(name: str) -> None:
+    """Fail fast on a bad ``--model`` value — callers run this before any data download,
+    dataset load, or cluster init so typos cost milliseconds, not side effects."""
+    if name not in VALID_MODELS:
+        raise ValueError(
+            f"unknown model {name!r} — choose one of {', '.join(VALID_MODELS)}")
+
+
+def build_model(name: str):
+    """Model factory behind the trainers' ``--model`` flag. Both families share the
+    ``(x, *, deterministic)`` call contract on ``[B, 28, 28, 1]`` input, so every
+    trainer/eval/checkpoint path works with either."""
+    validate_model_name(name)
+    return Net() if name == "cnn" else TransformerClassifier()
+
+
+__all__ = ["Net", "TransformerClassifier", "build_model", "validate_model_name",
+           "VALID_MODELS"]

@@ -40,6 +40,9 @@ class SingleProcessConfig:
     profile_dir: str = "results/profile"
     resume_from: str = ""             # checkpoint path to resume from (the restore path the
                                       # reference lacks, SURVEY.md §5 "checkpoint/resume")
+    model: str = "cnn"                # model family: 'cnn' (the reference's Net) or
+                                      # 'transformer' (the beyond-parity attention family,
+                                      # models/transformer.py); same data/trainer surface
     use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
                                       # (ops/pallas_kernels.py; single-device step path)
     use_fused_step: bool = False      # run the ENTIRE train step (fwd+bwd+update) through
@@ -83,6 +86,8 @@ class DistributedConfig:
                                       # restore path the reference lacks; the distributed
                                       # trainer writes one per epoch to
                                       # results_dir/model_dist.ckpt)
+    model: str = "cnn"                # model family: 'cnn' or 'transformer' (see
+                                      # SingleProcessConfig.model)
     host_local_feed: bool = False     # multi-host input pipeline: each process gathers and
                                       # feeds ONLY its addressable devices' shard of every
                                       # batch (SURVEY.md §7 hard part (d)) instead of the

@@ -179,3 +179,16 @@ def test_host_local_feed_matches_device_resident(tmp_path, tiny_datasets, device
         np.testing.assert_allclose(np.asarray(s_host.params[k]),
                                    np.asarray(s_fast.params[k]),
                                    rtol=1e-5, atol=1e-7, err_msg=f"param {k}")
+
+
+def test_distributed_trainer_with_transformer_model(tmp_path, tiny_datasets, devices8):
+    """--model transformer through the full SPMD trainer: the attention family trains
+    data-parallel on the 8-device mesh with no CNN-specific assumptions."""
+    cfg = DistributedConfig(
+        epochs=1, global_batch_size=64, batch_size_test=100, learning_rate=0.05,
+        momentum=0.5, model="transformer", results_dir=str(tmp_path / "results"),
+        images_dir=str(tmp_path / "images"))
+    state, history = distributed.main(cfg, num_devices=8, datasets=tiny_datasets)
+    assert "pos_embed" in state.params
+    assert np.isfinite(history.test_losses[-1])
+    assert os.path.exists(os.path.join(cfg.results_dir, "model_dist.msgpack"))

@@ -106,3 +106,33 @@ def test_scan_unroll_and_pregather_flags_match_defaults(tmp_path, tiny_datasets)
                     jax.tree_util.tree_leaves(knob_state.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-7)
+
+
+def test_single_trainer_with_transformer_model(tmp_path, tiny_datasets):
+    """--model transformer: the attention family is a drop-in through the full trainer
+    workflow (train, eval, checkpoint) with no CNN-specific assumptions."""
+    cfg = SingleProcessConfig(
+        n_epochs=1, batch_size_train=64, batch_size_test=100, learning_rate=0.05,
+        momentum=0.5, log_interval=10, model="transformer",
+        results_dir=str(tmp_path / "results"), images_dir=str(tmp_path / "images"))
+    state, history = single.main(cfg, datasets=tiny_datasets)
+    assert int(state.step) == 32
+    assert "pos_embed" in state.params            # transformer, not the CNN
+    assert np.isfinite(history.test_losses[-1])
+    assert os.path.exists(os.path.join(cfg.results_dir, "model.ckpt"))
+
+
+def test_fused_step_rejects_non_cnn_model(tmp_path, tiny_datasets):
+    cfg = SingleProcessConfig(
+        n_epochs=1, model="transformer", use_fused_step=True,
+        results_dir=str(tmp_path / "results"), images_dir=str(tmp_path / "images"))
+    with pytest.raises(ValueError, match="flagship CNN"):
+        single.main(cfg, datasets=tiny_datasets)
+
+
+def test_unknown_model_rejected(tmp_path, tiny_datasets):
+    cfg = SingleProcessConfig(
+        n_epochs=1, model="mlp",
+        results_dir=str(tmp_path / "results"), images_dir=str(tmp_path / "images"))
+    with pytest.raises(ValueError, match="unknown model"):
+        single.main(cfg, datasets=tiny_datasets)
