@@ -13,24 +13,38 @@ from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer 
 )
 
 
+import jax.numpy as jnp
+
 VALID_MODELS = ("cnn", "transformer")
 
 
-def validate_model_name(name: str) -> None:
-    """Fail fast on a bad ``--model`` value — callers run this before any data download,
-    dataset load, or cluster init so typos cost milliseconds, not side effects."""
+def validate_model_config(name: str, *, remat: bool = False) -> None:
+    """Fail fast on a bad ``--model`` value or model/knob combination — callers run this
+    before any data download, dataset load, or cluster rendezvous so typos cost
+    milliseconds, not side effects (on a fleet: not a full rendezvous per host)."""
     if name not in VALID_MODELS:
         raise ValueError(
             f"unknown model {name!r} — choose one of {', '.join(VALID_MODELS)}")
+    if remat and name == "cnn":
+        raise ValueError("--remat applies to the transformer family only "
+                         "(the CNN's activations are a few hundred KB)")
 
 
-def build_model(name: str):
+def build_model(name: str, *, bf16: bool = False, remat: bool = False):
     """Model factory behind the trainers' ``--model`` flag. Both families share the
     ``(x, *, deterministic)`` call contract on ``[B, 28, 28, 1]`` input, so every
-    trainer/eval/checkpoint path works with either."""
-    validate_model_name(name)
-    return Net() if name == "cnn" else TransformerClassifier()
+    trainer/eval/checkpoint path works with either.
+
+    ``bf16`` runs activations in bfloat16 (the MXU's native dtype) with float32 master
+    weights and float32 softmax/loss statistics. ``remat`` (transformer only) recomputes
+    each block's activations on backward — the ``jax.checkpoint`` memory/FLOPs trade.
+    """
+    validate_model_config(name, remat=remat)
+    dtype = jnp.bfloat16 if bf16 else jnp.float32
+    if name == "cnn":
+        return Net(dtype=dtype)
+    return TransformerClassifier(dtype=dtype, remat=remat)
 
 
-__all__ = ["Net", "TransformerClassifier", "build_model", "validate_model_name",
+__all__ = ["Net", "TransformerClassifier", "build_model", "validate_model_config",
            "VALID_MODELS"]

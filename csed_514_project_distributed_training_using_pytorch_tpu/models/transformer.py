@@ -87,7 +87,9 @@ class TransformerBlock(fnn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @fnn.compact
-    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        # `deterministic` is positional-or-keyword (not keyword-only) so fnn.remat can
+        # mark it static by argnum when the classifier enables rematerialization.
         e = x.shape[-1]
 
         g1 = self.param("ln1_scale", _ones_init, (e,))
@@ -137,6 +139,11 @@ class TransformerClassifier(fnn.Module):
     attention_fn: Callable = ops.full_attention
     causal: bool = False
     dtype: jnp.dtype = jnp.float32
+    remat: bool = False         # rematerialize each block on backward (jax.checkpoint):
+                                # activation memory drops from O(layers) to O(1) blocks at
+                                # ~1/3 extra FLOPs — the long-context memory knob the
+                                # brief's HBM math calls for; numerics unchanged
+                                # (pinned in tests/test_transformer.py)
 
     @fnn.compact
     def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
@@ -157,12 +164,17 @@ class TransformerClassifier(fnn.Module):
         pos = self.param("pos_embed", _normal_init(0.02), (self.seq_len, self.embed_dim))
         h = h + pos.astype(self.dtype)[None]
 
+        block_cls = TransformerBlock
+        if self.remat:
+            # Recompute the block's activations during backward instead of storing them;
+            # `deterministic` is a static argument (two traces, not a traced branch).
+            block_cls = fnn.remat(TransformerBlock, static_argnums=(2,))
         for i in range(self.num_layers):
-            h = TransformerBlock(
+            h = block_cls(
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 dropout_rate=self.dropout_rate, attention_fn=self.attention_fn,
                 causal=self.causal, dtype=self.dtype, name=f"block_{i}")(
-                    h, deterministic=deterministic)
+                    h, deterministic)
 
         g = self.param("ln_f_scale", _ones_init, (self.embed_dim,))
         beta = self.param("ln_f_bias", _zeros_init, (self.embed_dim,))

@@ -43,7 +43,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.data.loader import 
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.models import (
     build_model,
-    validate_model_name,
+    validate_model_config,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     data_parallel as dp,
@@ -106,7 +106,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     """Run distributed training over all (or ``num_devices``) addressable devices; every host
     in a multi-host fleet runs this same function."""
     watch = M.Stopwatch()                         # ≙ t0, reference src/train_dist.py:119
-    validate_model_name(config.model)             # fail fast, before rendezvous/data
+    validate_model_config(config.model, remat=config.remat)  # fail fast, pre-rendezvous
     info = initialize_cluster()                   # ≙ init_process_group, :146
     mesh = make_mesh(num_devices)
     world = mesh.shape["data"]                    # ≙ world_size, :131 — but discovered
@@ -132,7 +132,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     samplers = [ShardedSampler(n_train, num_replicas=world, rank=r,
                                seed=config.sampler_seed) for r in range(world)]
 
-    model = build_model(config.model)
+    model = build_model(config.model, bf16=config.bf16, remat=config.remat)
     state = create_train_state(model, init_rng)
     steps_per_epoch = samplers[0].num_samples // per_replica_batch
     start_epoch = 0

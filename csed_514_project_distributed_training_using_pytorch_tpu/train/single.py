@@ -31,7 +31,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.data import (
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.models import (
     build_model,
-    validate_model_name,
+    validate_model_config,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
     TrainState, create_train_state, make_epoch_fn, make_eval_fn, make_train_step,
@@ -56,7 +56,11 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     notebooks); by default MNIST is loaded from ``config.data_dir``.
     """
     watch = M.Stopwatch()                       # ≙ t0, reference src/train.py:10
-    validate_model_name(config.model)           # fail fast, before download/load
+    validate_model_config(config.model, remat=config.remat)  # fail fast, pre-side-effects
+    if config.use_fused_step and (config.model != "cnn" or config.bf16):
+        raise ValueError("--use-fused-step is specialized to the flagship CNN's f32 "
+                         "step (ops/pallas_fused.py); drop it, or use --model cnn "
+                         "without --bf16")
 
     if config.download_data and datasets is None:
         download_mnist(config.data_dir)   # ≙ torchvision download=True, src/train.py:26-31
@@ -70,9 +74,6 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     # (see probe_compiles_subprocess). Probe every batch size this run will step at (main
     # batches + the drop_last=False tail) — Mosaic failures can be block-shape dependent.
     fused_probe_result = None
-    if config.use_fused_step and config.model != "cnn":
-        raise ValueError("--use-fused-step is specialized to the flagship CNN "
-                         "(ops/pallas_fused.py); drop it or use --model cnn")
     if config.use_fused_step:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_fused import (
             probe_compiles_subprocess,
@@ -91,7 +92,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     plotting.save_sample_grid(test_ds.images, test_ds.labels,
                               os.path.join(config.images_dir, "train_images.png"))
 
-    model = build_model(config.model)
+    model = build_model(config.model, bf16=config.bf16, remat=config.remat)
     state = create_train_state(model, init_rng)
     resume_from = resume_from or config.resume_from or None
     if resume_from:                             # the restore path the reference lacks

@@ -43,6 +43,10 @@ class SingleProcessConfig:
     model: str = "cnn"                # model family: 'cnn' (the reference's Net) or
                                       # 'transformer' (the beyond-parity attention family,
                                       # models/transformer.py); same data/trainer surface
+    bf16: bool = False                # bfloat16 activations (f32 master weights + f32
+                                      # softmax/loss statistics — the MXU-native dtype)
+    remat: bool = False               # jax.checkpoint each transformer block on backward
+                                      # (O(1)-blocks activation memory; transformer only)
     use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
                                       # (ops/pallas_kernels.py; single-device step path)
     use_fused_step: bool = False      # run the ENTIRE train step (fwd+bwd+update) through
@@ -88,6 +92,9 @@ class DistributedConfig:
                                       # results_dir/model_dist.ckpt)
     model: str = "cnn"                # model family: 'cnn' or 'transformer' (see
                                       # SingleProcessConfig.model)
+    bf16: bool = False                # bfloat16 activations (see SingleProcessConfig.bf16)
+    remat: bool = False               # jax.checkpoint transformer blocks (see
+                                      # SingleProcessConfig.remat)
     host_local_feed: bool = False     # multi-host input pipeline: each process gathers and
                                       # feeds ONLY its addressable devices' shard of every
                                       # batch (SURVEY.md §7 hard part (d)) instead of the

@@ -138,3 +138,62 @@ def test_causal_variant_forward():
     images, _ = _batch(seed=7)
     log_probs = model.apply({"params": state.params}, images)
     assert bool(jnp.all(jnp.isfinite(log_probs)))
+
+
+def test_remat_is_numerically_identical():
+    """remat=True (jax.checkpoint per block) is a memory knob only: forward, loss, and
+    one optimizer step are bit-identical, on both the deterministic and dropout paths."""
+    base = TransformerClassifier(dropout_rate=0.1)
+    remat = TransformerClassifier(dropout_rate=0.1, remat=True)
+    s0 = create_train_state(base, jax.random.PRNGKey(0))
+    images, labels = _batch(seed=8)
+
+    np.testing.assert_array_equal(
+        np.asarray(base.apply({"params": s0.params}, images)),
+        np.asarray(remat.apply({"params": s0.params}, images)))
+    np.testing.assert_array_equal(
+        np.asarray(base.apply({"params": s0.params}, images, deterministic=False,
+                              rngs={"dropout": jax.random.PRNGKey(5)})),
+        np.asarray(remat.apply({"params": s0.params}, images, deterministic=False,
+                               rngs={"dropout": jax.random.PRNGKey(5)})))
+
+    outs = []
+    for m in (base, remat):
+        step = jax.jit(make_train_step(m, learning_rate=0.05, momentum=0.5))
+        s1, loss = step(s0, images, labels, jax.random.PRNGKey(1))
+        outs.append((s1, float(loss)))
+    (sa, la), (sb, lb) = outs
+    assert la == lb
+    for a, b in zip(jax.tree_util.tree_leaves(sa.params),
+                    jax.tree_util.tree_leaves(sb.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bf16_activations_train_with_f32_master_weights():
+    import jax.numpy as jnp
+
+    model = TransformerClassifier(dtype=jnp.bfloat16, dropout_rate=0.0)
+    state = create_train_state(model, jax.random.PRNGKey(0))
+    assert all(p.dtype == jnp.float32
+               for p in jax.tree_util.tree_leaves(state.params))
+    images, labels = _batch(n=32, seed=9)
+    step = jax.jit(make_train_step(model, learning_rate=0.05, momentum=0.5))
+    first = None
+    for _ in range(30):
+        state, loss = step(state, images, labels, jax.random.PRNGKey(2))
+        first = first if first is not None else float(loss)
+    assert np.isfinite(float(loss)) and float(loss) < first
+
+
+def test_build_model_factory_knobs():
+    import jax.numpy as jnp
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        build_model,
+    )
+
+    assert build_model("transformer", bf16=True).dtype == jnp.bfloat16
+    assert build_model("transformer", remat=True).remat is True
+    assert build_model("cnn", bf16=True).dtype == jnp.bfloat16
+    with pytest.raises(ValueError, match="transformer family only"):
+        build_model("cnn", remat=True)
