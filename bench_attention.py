@@ -62,7 +62,6 @@ def main() -> int:
 
     platform = jax.default_backend()
     device_kind = jax.devices()[0].device_kind
-    rows = []
     for s in args.seq_lens:
         rng = np.random.default_rng(s)
         q, k, v = (jnp.asarray(rng.normal(size=(B, s, H, D)).astype(np.float32))
@@ -70,24 +69,26 @@ def main() -> int:
         row = {"seq_len": s, "batch": B, "heads": H, "head_dim": D,
                "platform": platform, "device_kind": device_kind, "causal": True,
                "reps": REPS}
-        row["flash_fwdbwd_s"] = _measure(ops.flash_attention, q, k, v)
+        try:
+            row["flash_fwdbwd_s"] = _measure(ops.flash_attention, q, k, v)
+        except Exception as e:  # a memory/compile wall is a result, not a crash
+            row["flash_fwdbwd_s"] = None
+            row["flash_error"] = f"{type(e).__name__}: {str(e)[:200]}"
         if s <= DENSE_MAX_S:
             try:
                 row["dense_fwdbwd_s"] = _measure(ops.full_attention, q, k, v)
-                row["speedup_flash_vs_dense"] = round(
-                    row["dense_fwdbwd_s"] / row["flash_fwdbwd_s"], 3)
+                if row["flash_fwdbwd_s"]:
+                    row["speedup_flash_vs_dense"] = round(
+                        row["dense_fwdbwd_s"] / row["flash_fwdbwd_s"], 3)
             except Exception as e:  # OOM/compile failure: the dense wall, recorded
                 row["dense_fwdbwd_s"] = None
                 row["dense_error"] = f"{type(e).__name__}: {str(e)[:200]}"
         else:
             row["dense_fwdbwd_s"] = None
             row["dense_error"] = f"skipped: O(S^2) scores beyond {DENSE_MAX_S}"
-        rows.append(row)
         print(json.dumps(row), flush=True)
-
-    if args.out:
-        with open(args.out, "a") as f:
-            for row in rows:
+        if args.out:  # append per row — a later-size failure must not lose earlier rows
+            with open(args.out, "a") as f:
                 f.write(json.dumps(row) + "\n")
     return 0
 

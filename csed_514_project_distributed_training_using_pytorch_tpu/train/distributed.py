@@ -107,6 +107,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     in a multi-host fleet runs this same function."""
     watch = M.Stopwatch()                         # ≙ t0, reference src/train_dist.py:119
     validate_model_config(config.model, remat=config.remat)  # fail fast, pre-rendezvous
+    if config.grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
     info = initialize_cluster()                   # ≙ init_process_group, :146
     mesh = make_mesh(num_devices)
     world = mesh.shape["data"]                    # ≙ world_size, :131 — but discovered
@@ -114,6 +116,10 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         raise ValueError(f"global batch {config.global_batch_size} not divisible by "
                          f"world size {world}")
     per_replica_batch = config.global_batch_size // world   # ≙ :133
+    if config.grad_accum > 1 and per_replica_batch % config.grad_accum:
+        raise ValueError(
+            f"per-replica batch {per_replica_batch} not divisible by grad_accum "
+            f"{config.grad_accum} — each microbatch must still shard evenly")
 
     root = jax.random.PRNGKey(config.seed)        # ≙ torch.manual_seed, :135-137
     init_rng, dropout_rng = jax.random.split(root)
@@ -163,7 +169,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     epoch_fn = dp.compile_epoch(
         make_epoch_fn(model, learning_rate=config.learning_rate,
                       momentum=config.momentum,
-                      unroll=config.scan_unroll, pregather=config.pregather), mesh)
+                      unroll=config.scan_unroll, pregather=config.pregather,
+                      grad_accum=config.grad_accum), mesh)
     eval_fn = dp.compile_eval(
         make_eval_fn(model, batch_size=config.batch_size_test), mesh,
         shard=config.shard_eval)
@@ -174,7 +181,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         )
         step_fn = dp.compile_step(
             make_train_step(model, learning_rate=config.learning_rate,
-                            momentum=config.momentum), mesh)
+                            momentum=config.momentum,
+                            grad_accum=config.grad_accum), mesh)
         col_lo, col_hi = _host_local_columns(mesh, per_replica_batch)
         M.log(f"Host-local feed: this process feeds global-batch columns "
               f"[{col_lo}:{col_hi}]")

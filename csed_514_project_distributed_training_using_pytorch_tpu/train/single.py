@@ -57,10 +57,16 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     """
     watch = M.Stopwatch()                       # ≙ t0, reference src/train.py:10
     validate_model_config(config.model, remat=config.remat)  # fail fast, pre-side-effects
-    if config.use_fused_step and (config.model != "cnn" or config.bf16):
+    if config.grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
+    if config.grad_accum > 1 and config.batch_size_train % config.grad_accum:
+        raise ValueError(f"batch_size_train {config.batch_size_train} not divisible "
+                         f"by grad_accum {config.grad_accum}")
+    if config.use_fused_step and (config.model != "cnn" or config.bf16
+                                  or config.grad_accum > 1):
         raise ValueError("--use-fused-step is specialized to the flagship CNN's f32 "
-                         "step (ops/pallas_fused.py); drop it, or use --model cnn "
-                         "without --bf16")
+                         "single-microbatch step (ops/pallas_fused.py); drop it, or "
+                         "use --model cnn without --bf16/--grad-accum")
 
     if config.download_data and datasets is None:
         download_mnist(config.data_dir)   # ≙ torchvision download=True, src/train.py:26-31
@@ -125,9 +131,21 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
             make_epoch_fn(model, learning_rate=config.learning_rate,
                           momentum=config.momentum,
                           use_pallas=config.use_pallas_kernels,
-                          unroll=config.scan_unroll, pregather=config.pregather),
+                          unroll=config.scan_unroll, pregather=config.pregather,
+                          grad_accum=config.grad_accum),
             donate_argnums=(0,))
         step_fn = jax.jit(
+            make_train_step(model, learning_rate=config.learning_rate,
+                            momentum=config.momentum,
+                            use_pallas=config.use_pallas_kernels,
+                            grad_accum=config.grad_accum),
+            donate_argnums=(0,))
+    # The final partial batch (drop_last=False) is ragged and need not divide by
+    # grad_accum; accumulation is a memory knob, so the tail just steps unaccumulated.
+    if config.use_fused_step or config.grad_accum == 1:
+        tail_step_fn = step_fn
+    else:
+        tail_step_fn = jax.jit(
             make_train_step(model, learning_rate=config.learning_rate,
                             momentum=config.momentum,
                             use_pallas=config.use_pallas_kernels),
@@ -174,8 +192,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         # final partial batch (drop_last=False, ≙ torch DataLoader default)
         tail = indices[full_steps * config.batch_size_train:]
         if len(tail):
-            state, _ = step_fn(state, train_x[jnp.asarray(tail)],
-                               train_y[jnp.asarray(tail)], dropout_rng)
+            state, _ = tail_step_fn(state, train_x[jnp.asarray(tail)],
+                                    train_y[jnp.asarray(tail)], dropout_rng)
         return state
 
     def train_epoch_host_pipeline(state: TrainState, epoch: int) -> TrainState:
@@ -196,8 +214,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         tail = train_loader.sampler.epoch_indices(epoch)[
             full_steps * config.batch_size_train:]
         if len(tail):
-            state, _ = step_fn(state, jnp.asarray(train_ds.images[tail]),
-                               jnp.asarray(train_ds.labels[tail]), dropout_rng)
+            state, _ = tail_step_fn(state, jnp.asarray(train_ds.images[tail]),
+                                    jnp.asarray(train_ds.labels[tail]), dropout_rng)
         return state
 
     if config.use_host_pipeline:

@@ -57,7 +57,7 @@ def create_train_state(model, rng: jax.Array,
 
 
 def make_train_step(model, *, learning_rate: float, momentum: float,
-                    use_pallas: bool = False) -> Callable:
+                    use_pallas: bool = False, grad_accum: int = 1) -> Callable:
     """Build ``step(state, images, labels, rng) -> (state, loss)``.
 
     The loss is the canonical ``nll(log_probs)`` formulation (see
@@ -69,7 +69,15 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     (``ops/pallas_kernels.py``) — numerically equivalent to float32 round-off; intended for
     the single-device step path (a Pallas call is an opaque unit to the GSPMD partitioner,
     so the multi-mesh ``compile_epoch`` path keeps the XLA-fused default).
+
+    ``grad_accum=N`` splits the batch into N equal microbatches, accumulates their
+    gradients in a ``lax.scan``, and applies ONE optimizer update on the mean — peak
+    activation memory shrinks N× while the update equals the full-batch step exactly
+    (equal-size microbatch means average to the batch mean; pinned in
+    ``tests/test_train_step.py``). Dropout draws a distinct mask per microbatch.
     """
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     if use_pallas:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
             pallas_kernels as pk,
@@ -83,9 +91,7 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
             return pk.nll_from_logits(log_probs, labels)
         return ops.nll_loss(log_probs, labels)
 
-    def step(state: TrainState, images, labels, rng) -> tuple[TrainState, jax.Array]:
-        step_rng = jax.random.fold_in(rng, state.step)
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, images, labels, step_rng)
+    def apply_update(state, grads, loss):
         if use_pallas:
             params, velocity = pk.sgd_momentum_step(
                 state.params, state.velocity, grads,
@@ -95,12 +101,44 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
                                           learning_rate=learning_rate, momentum=momentum)
         return TrainState(params, velocity, state.step + 1), loss
 
-    return step
+    def step(state: TrainState, images, labels, rng) -> tuple[TrainState, jax.Array]:
+        step_rng = jax.random.fold_in(rng, state.step)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, images, labels, step_rng)
+        return apply_update(state, grads, loss)
+
+    if grad_accum == 1:
+        return step
+
+    def accum_step(state: TrainState, images, labels, rng) -> tuple[TrainState, jax.Array]:
+        b = images.shape[0]
+        if b % grad_accum:
+            raise ValueError(f"batch {b} not divisible by grad_accum {grad_accum}")
+        micro = b // grad_accum
+        xs = images.reshape((grad_accum, micro) + images.shape[1:])
+        ys = labels.reshape(grad_accum, micro)
+        step_rng = jax.random.fold_in(rng, state.step)
+
+        def body(carry, chunk):
+            grads_sum, loss_sum = carry
+            x, y, i = chunk
+            loss, grads = jax.value_and_grad(loss_fn)(
+                state.params, x, y, jax.random.fold_in(step_rng, i))
+            return (jax.tree_util.tree_map(jnp.add, grads_sum, grads),
+                    loss_sum + loss), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        (grads_sum, loss_sum), _ = lax.scan(
+            body, (zeros, jnp.zeros((), jnp.float32)),
+            (xs, ys, jnp.arange(grad_accum)))
+        grads = jax.tree_util.tree_map(lambda g: g / grad_accum, grads_sum)
+        return apply_update(state, grads, loss_sum / grad_accum)
+
+    return accum_step
 
 
 def make_epoch_fn(model, *, learning_rate: float, momentum: float,
                   use_pallas: bool = False, unroll: int = 1,
-                  pregather: bool = False) -> Callable:
+                  pregather: bool = False, grad_accum: int = 1) -> Callable:
     """Build ``epoch(state, images, labels, idx_matrix, rng) -> (state, losses)``.
 
     ``images``/``labels`` are the full (device-resident) training split; ``idx_matrix`` is a
@@ -120,7 +158,7 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
     gather latency.
     """
     train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum,
-                                 use_pallas=use_pallas)
+                                 use_pallas=use_pallas, grad_accum=grad_accum)
     return make_epoch_from_step(train_step, unroll=unroll, pregather=pregather)
 
 

@@ -58,6 +58,9 @@ class SingleProcessConfig:
                                       # resident scan fast path; same math, same order
     scan_unroll: int = 1              # epoch-scan body unroll factor (semantics-preserving
                                       # codegen knob; amortizes per-step control overhead)
+    grad_accum: int = 1               # accumulate gradients over N equal microbatches per
+                                      # optimizer step (N× less activation memory; update
+                                      # exactly equals the full-batch step — pinned)
     pregather: bool = False           # gather each scan segment's batches once up front
                                       # instead of per step (semantics-preserving; trades
                                       # HBM for per-step gather latency)
@@ -103,6 +106,8 @@ class DistributedConfig:
     scan_unroll: int = 1              # epoch-scan body unroll factor (semantics-preserving)
     pregather: bool = False           # whole-epoch up-front batch gather (semantics-
                                       # preserving; trades HBM for per-step gather latency)
+    grad_accum: int = 1               # gradient accumulation microbatches per step (see
+                                      # SingleProcessConfig.grad_accum)
     profile: bool = False
     profile_dir: str = "results/profile"
     max_train_examples: int = 0       # 0 = full split; >0 truncates (dev/CI shortening —

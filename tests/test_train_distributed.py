@@ -192,3 +192,18 @@ def test_distributed_trainer_with_transformer_model(tmp_path, tiny_datasets, dev
     assert "pos_embed" in state.params
     assert np.isfinite(history.test_losses[-1])
     assert os.path.exists(os.path.join(cfg.results_dir, "model_dist.msgpack"))
+
+
+def test_distributed_grad_accum(tmp_path, tiny_datasets, devices8):
+    """--grad-accum through the SPMD epoch program: runs, trains, and rejects
+    indivisible per-replica microbatches."""
+    cfg = DistributedConfig(
+        epochs=1, global_batch_size=64, batch_size_test=100, learning_rate=0.05,
+        momentum=0.5, grad_accum=4, results_dir=str(tmp_path / "results"),
+        images_dir=str(tmp_path / "images"))
+    state, history = distributed.main(cfg, num_devices=8, datasets=tiny_datasets)
+    assert np.isfinite(history.test_losses[-1])
+
+    with pytest.raises(ValueError, match="grad_accum"):
+        distributed.main(DistributedConfig(global_batch_size=64, grad_accum=3),
+                         num_devices=8, datasets=tiny_datasets)

@@ -223,3 +223,50 @@ def test_epoch_pregather_is_semantics_preserving(model_state):
                         jax.tree_util.tree_leaves(outs[key][0].params)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-6, atol=1e-7)
+
+
+def test_grad_accum_equals_full_batch_step(model_state):
+    """grad_accum=N is a memory knob only: with dropout off, the accumulated update
+    equals the full-batch step to f32 round-off (equal-size microbatch means average to
+    the batch mean); with dropout on it still trains (distinct mask per microbatch)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        TransformerClassifier,
+    )
+
+    det_model = TransformerClassifier(dropout_rate=0.0)
+    state0 = create_train_state(det_model, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(5), (32, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(6), (32,), 0, 10)
+    rng = jax.random.PRNGKey(7)
+
+    outs = {}
+    for accum in (1, 4):
+        fn = jax.jit(make_train_step(det_model, learning_rate=0.05, momentum=0.5,
+                                     grad_accum=accum))
+        outs[accum] = fn(state0, x, y, rng)
+    assert abs(float(outs[1][1]) - float(outs[4][1])) < 1e-6
+    for a, b in zip(jax.tree_util.tree_leaves(outs[1][0].params),
+                    jax.tree_util.tree_leaves(outs[4][0].params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_grad_accum_rejects_indivisible_batch(model_state):
+    model, state0 = model_state
+    fn = make_train_step(model, learning_rate=0.05, momentum=0.5, grad_accum=3)
+    x = jax.random.normal(jax.random.PRNGKey(5), (32, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(6), (32,), 0, 10)
+    with pytest.raises(ValueError, match="not divisible"):
+        fn(state0, x, y, jax.random.PRNGKey(7))
+
+
+def test_grad_accum_epoch_with_dropout_trains(model_state):
+    """The accumulated step drives the scanned epoch path end-to-end (dropout on)."""
+    model, state0 = model_state
+    fn = jax.jit(make_epoch_fn(model, learning_rate=0.05, momentum=0.5, grad_accum=4))
+    x = jax.random.normal(jax.random.PRNGKey(8), (64, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(9), (64,), 0, 10)
+    idx = jnp.arange(64, dtype=jnp.int32).reshape(4, 16)
+    state, losses = fn(state0, x, y, idx, jax.random.PRNGKey(10))
+    assert int(state.step) == 4
+    assert bool(jnp.all(jnp.isfinite(losses)))
