@@ -39,6 +39,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.expert_par
     moe_apply,
     shard_moe_params,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel import fsdp
 
 __all__ = [
     "ShardedSampler",
@@ -58,4 +59,5 @@ __all__ = [
     "init_moe_params",
     "moe_apply",
     "shard_moe_params",
+    "fsdp",
 ]

@@ -1,0 +1,107 @@
+"""FSDP/ZeRO-style sharding: params + optimizer state sharded over the ``data`` axis.
+
+Beyond-parity capability (SURVEY.md §2c lists "ZeRO/FSDP-style sharded optimizer" as
+absent from the reference, which keeps full SGD state per rank —
+``src/train_dist.py:66``): every sufficiently large parameter leaf — and its SGD
+velocity — is sharded across the SAME mesh axis the batch is sharded over, so per-device
+weight+optimizer memory shrinks with the number of data-parallel workers.
+
+Expressed the TPU-first way, as annotations only: a leaf gets ``P('data')`` on its
+largest axis-divisible dimension. Because weights and batch share the mesh axis, XLA's
+SPMD partitioner materializes each weight where it is consumed via a per-use
+**all-gather** (forward and backward) and a **reduce-scatter** of its gradient back onto
+the shards — exactly the ZeRO-3 schedule, derived by the compiler rather than
+hand-built with bucketing hooks. The optimizer update runs shard-local (ZeRO-1), since
+velocity shards match parameter shards.
+
+Leaves with no axis-divisible dimension (or smaller than ``min_leaf_size``) replicate —
+the rules degrade gracefully: on the 21.8k-param CNN most leaves replicate and the
+program is plain DP; on the transformer every matrix shards. Numerics are pinned equal
+to the single-device step in ``tests/test_fsdp.py``.
+
+Composes with the rest of the mesh surface: this is the ``data``-axis analog of
+``parallel/tensor_parallel.py``'s ``model``-axis sharding (there: weights sharded,
+compute local + psum; here: weights sharded, gathered per use).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.data_parallel import (
+    batch_sharding,
+    replicated,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.train.step import TrainState
+
+
+def fsdp_partition_specs(params, axis_size: int, *, axis_name: str = "data",
+                         min_leaf_size: int = 2048):
+    """Per-leaf specs: shard the largest ``axis_size``-divisible dimension; replicate
+    leaves that are small (sharding overhead beats the memory win) or indivisible."""
+
+    def spec_for(leaf):
+        if leaf.size < min_leaf_size:
+            return P()
+        divisible = [d for d in range(leaf.ndim) if leaf.shape[d] % axis_size == 0
+                     and leaf.shape[d] >= axis_size]
+        if not divisible:
+            return P()
+        best = max(divisible, key=lambda d: leaf.shape[d])
+        spec = [None] * leaf.ndim
+        spec[best] = axis_name
+        return P(*spec)
+
+    return jax.tree_util.tree_map(spec_for, params)
+
+
+def state_shardings(mesh: Mesh, state: TrainState, *,
+                    axis_name: str = "data", min_leaf_size: int = 2048) -> TrainState:
+    """``TrainState``-shaped ``NamedSharding`` pytree: velocity shards exactly like its
+    parameter (the ZeRO invariant), the step counter replicates."""
+    axis_size = mesh.shape[axis_name]
+    specs = fsdp_partition_specs(state.params, axis_size, axis_name=axis_name,
+                                 min_leaf_size=min_leaf_size)
+    to_sh = lambda spec: NamedSharding(mesh, spec)
+    param_sh = jax.tree_util.tree_map(to_sh, specs)
+    vel_specs = fsdp_partition_specs(state.velocity, axis_size, axis_name=axis_name,
+                                     min_leaf_size=min_leaf_size)
+    vel_sh = jax.tree_util.tree_map(to_sh, vel_specs)
+    return TrainState(params=param_sh, velocity=vel_sh,
+                      step=NamedSharding(mesh, P()))
+
+
+def shard_train_state(mesh: Mesh, state: TrainState, *,
+                      axis_name: str = "data") -> TrainState:
+    """Place a ``TrainState`` onto the mesh with FSDP shardings — the moment weight and
+    optimizer memory actually divides across the data-parallel workers."""
+    return jax.device_put(state, state_shardings(mesh, state, axis_name=axis_name))
+
+
+def compile_step_fsdp(step_fn: Callable, mesh: Mesh, *,
+                      axis_name: str = "data") -> Callable:
+    """Compile ``step(state, images, labels, rng)`` with FSDP state shardings and the
+    batch sharded over the same axis. XLA inserts the all-gathers/reduce-scatters; state
+    is donated so shards update in place."""
+    compiled = {}
+
+    def wrapper(state, images, labels, rng):
+        # Specs depend on leaf SHAPES (largest divisible dim), not just the tree
+        # structure — key on both, unlike tensor_parallel's name-based rules.
+        key = (jax.tree_util.tree_structure(state),
+               tuple(leaf.shape for leaf in jax.tree_util.tree_leaves(state)))
+        if key not in compiled:
+            state_sh = state_shardings(mesh, state, axis_name=axis_name)
+            batch_sh = batch_sharding(mesh, axis_name)
+            rep = replicated(mesh)
+            compiled[key] = jax.jit(
+                step_fn,
+                in_shardings=(state_sh, batch_sh, batch_sh, rep),
+                out_shardings=(state_sh, rep),
+                donate_argnums=(0,))
+        return compiled[key](state, images, labels, rng)
+
+    return wrapper
