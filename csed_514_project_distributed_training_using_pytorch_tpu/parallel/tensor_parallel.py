@@ -71,7 +71,16 @@ def state_shardings(mesh: Mesh, state: TrainState, *,
                     axis_name: str = "model") -> TrainState:
     """``TrainState``-shaped pytree of ``NamedSharding``s: params and their SGD velocity
     shard identically (the optimizer update stays elementwise-local, ZeRO-style for the
-    sharded slices); the step counter replicates."""
+    sharded slices); the step counter replicates.
+
+    On a mesh without a ``model`` axis every leaf replicates — the rules degrade to
+    plain DP, so one code path serves any mesh declaration."""
+    if axis_name not in mesh.shape:
+        rep = NamedSharding(mesh, P())
+        return TrainState(
+            params=jax.tree_util.tree_map(lambda _: rep, state.params),
+            velocity=jax.tree_util.tree_map(lambda _: rep, state.velocity),
+            step=rep)
     specs = param_partition_specs(state.params, axis_name=axis_name)
     to_sharding = lambda spec: NamedSharding(mesh, spec)
     param_sh = jax.tree_util.tree_map(to_sharding, specs)

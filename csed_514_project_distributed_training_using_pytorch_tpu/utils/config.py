@@ -114,6 +114,29 @@ class DistributedConfig:
     max_test_examples: int = 0        # no reference analog; the reference always trains full)
 
 
+@dataclass(frozen=True)
+class ComposedConfig:
+    """Knobs of the composed-parallelism trainer (``train/composed.py`` — beyond-parity:
+    the reference has no TP/SP mode to mirror, so defaults are small-demo-sized)."""
+
+    mesh: str = "data=2,seq=2,model=2"  # named axes: data (DP), seq (ring attention),
+                                        # model (Megatron TP); product = device count
+    seq_len: int = 16                   # tokens per image (784 must divide by it; a seq
+                                        # mesh axis must divide it)
+    epochs: int = 2
+    batch_size: int = 64
+    batch_size_test: int = 1000
+    learning_rate: float = 0.05
+    momentum: float = 0.5
+    dropout_rate: float = 0.0           # 0 keeps composed runs comparable across meshes
+    seed: int = 1
+    data_dir: str = "files"
+    download_data: bool = False
+    results_dir: str = "results"
+    max_train_examples: int = 0
+    max_test_examples: int = 0
+
+
 def _add_args(parser: argparse.ArgumentParser, cfg) -> None:
     for f in dataclasses.fields(cfg):
         arg = "--" + f.name.replace("_", "-")
