@@ -32,6 +32,9 @@ import jax
 import jax.numpy as jnp
 
 from csed_514_project_distributed_training_using_pytorch_tpu import ops
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+    expert_parallel as ep,  # submodule has no deps back into models/ (no cycle)
+)
 
 
 # Stock flax initializers (transformer-standard trunc-free normal(0.02) embeddings/
@@ -77,7 +80,21 @@ class MultiHeadSelfAttention(fnn.Module):
 
 
 class TransformerBlock(fnn.Module):
-    """Pre-LN encoder block: ``x + MHA(LN(x))`` then ``x + MLP(LN(x))``."""
+    """Pre-LN encoder block: ``x + MHA(LN(x))`` then ``x + FFN(LN(x))``.
+
+    ``num_experts > 0`` replaces the dense MLP with the Switch-style top-1 MoE
+    feed-forward (``parallel/expert_parallel.py``): per-token routed experts on the
+    residual path (a dropped over-capacity token degrades to identity). The router's
+    load-balance auxiliary loss is ``sow``n into the ``"aux_loss"`` collection;
+    ``train.step.make_train_step`` collects it automatically (``aux_loss_weight``), and
+    direct callers can pull it with ``model.apply(..., mutable=["aux_loss"])``.
+
+    Capacity note (standard Switch semantics): the expert capacity budget is computed
+    over the whole ``B·S`` token batch, so which over-capacity tokens drop depends on
+    batch composition — an example's output can differ slightly between batch sizes.
+    Parameter names match ``expert_parallel``'s layout (``router_kernel``/``up_kernel``/
+    ``up_bias``/``down_kernel``/``down_bias``), so its partition specs apply per block.
+    """
 
     num_heads: int
     mlp_ratio: int = 4
@@ -85,6 +102,10 @@ class TransformerBlock(fnn.Module):
     attention_fn: Callable = ops.full_attention
     causal: bool = False
     dtype: jnp.dtype = jnp.float32
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    expert_mesh: object = None          # optional Mesh: pin dispatched tokens onto its
+                                        # 'expert' axis (EP execution; numerics identical)
 
     @fnn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
@@ -106,14 +127,38 @@ class TransformerBlock(fnn.Module):
         g2 = self.param("ln2_scale", _ones_init, (e,))
         b2 = self.param("ln2_bias", _zeros_init, (e,))
         h = ops.layer_norm(x, g2, b2)
-        w_up = self.param("mlp_up_kernel", _normal_init(0.02),
-                          (e, self.mlp_ratio * e))
-        b_up = self.param("mlp_up_bias", _zeros_init, (self.mlp_ratio * e,))
-        h = ops.gelu(ops.dense(h, w_up.astype(self.dtype), b_up.astype(self.dtype)))
-        w_dn = self.param("mlp_down_kernel", _normal_init(0.02),
-                          (self.mlp_ratio * e, e))
-        b_dn = self.param("mlp_down_bias", _zeros_init, (e,))
-        h = ops.dense(h, w_dn.astype(self.dtype), b_dn.astype(self.dtype))
+        hidden = self.mlp_ratio * e
+        if self.num_experts > 0:
+            moe_params = {
+                "router_kernel": self.param("router_kernel", _normal_init(0.02),
+                                            (e, self.num_experts)),
+                "up_kernel": self.param("up_kernel", _normal_init(0.02),
+                                        (self.num_experts, e, hidden)),
+                "up_bias": self.param("up_bias", _zeros_init,
+                                      (self.num_experts, hidden)),
+                "down_kernel": self.param("down_kernel", _normal_init(0.02),
+                                          (self.num_experts, hidden, e)),
+                "down_bias": self.param("down_bias", _zeros_init,
+                                        (self.num_experts, e)),
+            }
+            # Activations may be bfloat16 (master weights stay f32, same as the dense
+            # branch); moe_apply keeps router softmax statistics in f32 internally.
+            moe_params = {k: v.astype(self.dtype) for k, v in moe_params.items()}
+            b, s, _ = h.shape
+            tokens = h.astype(self.dtype).reshape(b * s, e)
+            routed, aux = ep.moe_apply(
+                moe_params, tokens, capacity_factor=self.expert_capacity_factor,
+                mesh=self.expert_mesh)
+            self.sow("aux_loss", "load_balance", aux)
+            h = routed.reshape(b, s, e)
+        else:
+            w_up = self.param("mlp_up_kernel", _normal_init(0.02), (e, hidden))
+            b_up = self.param("mlp_up_bias", _zeros_init, (hidden,))
+            h = ops.gelu(ops.dense(h, w_up.astype(self.dtype),
+                                   b_up.astype(self.dtype)))
+            w_dn = self.param("mlp_down_kernel", _normal_init(0.02), (hidden, e))
+            b_dn = self.param("mlp_down_bias", _zeros_init, (e,))
+            h = ops.dense(h, w_dn.astype(self.dtype), b_dn.astype(self.dtype))
         if not deterministic:
             h = ops.dropout(self.make_rng("dropout"), h, self.dropout_rate,
                             deterministic=False)
@@ -144,6 +189,11 @@ class TransformerClassifier(fnn.Module):
                                 # ~1/3 extra FLOPs — the long-context memory knob the
                                 # brief's HBM math calls for; numerics unchanged
                                 # (pinned in tests/test_transformer.py)
+    num_experts: int = 0        # >0: every block's MLP becomes a Switch top-1 MoE with
+                                # this many experts (see TransformerBlock docstring for
+                                # the sown load-balance aux loss)
+    expert_capacity_factor: float = 1.25
+    expert_mesh: object = None  # optional Mesh with an 'expert' axis → EP execution
 
     @fnn.compact
     def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
@@ -173,7 +223,10 @@ class TransformerClassifier(fnn.Module):
             h = block_cls(
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 dropout_rate=self.dropout_rate, attention_fn=self.attention_fn,
-                causal=self.causal, dtype=self.dtype, name=f"block_{i}")(
+                causal=self.causal, dtype=self.dtype,
+                num_experts=self.num_experts,
+                expert_capacity_factor=self.expert_capacity_factor,
+                expert_mesh=self.expert_mesh, name=f"block_{i}")(
                     h, deterministic)
 
         g = self.param("ln_f_scale", _ones_init, (self.embed_dim,))

@@ -39,6 +39,11 @@ from csed_514_project_distributed_training_using_pytorch_tpu.train.step import T
 _COLUMN_PARALLEL = {"qkv_kernel", "mlp_up_kernel"}
 _ROW_PARALLEL = {"out_kernel", "mlp_down_kernel"}
 _COLUMN_PARALLEL_BIAS = {"qkv_bias", "mlp_up_bias"}
+# MoE blocks (num_experts>0): expert-stacked weights shard their expert dim — the names
+# match parallel/expert_parallel's layout, so the same rules cover both the standalone
+# layer and the in-model blocks. The router replicates (every device routes every token).
+_EXPERT_STACKED = {"up_kernel", "down_kernel"}   # [E, in, out]
+_EXPERT_STACKED_BIAS = {"up_bias", "down_bias"}  # [E, out]
 
 
 def _leaf_name(path) -> str:
@@ -46,12 +51,14 @@ def _leaf_name(path) -> str:
     return getattr(last, "key", str(last))
 
 
-def param_partition_specs(params, *, axis_name: str = "model"):
+def param_partition_specs(params, *, axis_name: str = "model",
+                          expert_axis: str = "expert"):
     """Map a transformer params pytree to per-leaf ``PartitionSpec``s.
 
     Unrecognized leaves (embeddings, LayerNorm scales, classifier head, row-parallel
     biases — and every CNN parameter) replicate: the rules degrade gracefully to plain DP
-    for models with nothing to shard.
+    for models with nothing to shard. Specs may name axes the target mesh lacks; use
+    ``state_shardings`` (which filters against the mesh) for placement.
     """
 
     def spec_for(path, leaf):
@@ -62,9 +69,25 @@ def param_partition_specs(params, *, axis_name: str = "model"):
             return P(axis_name, None)
         if name in _COLUMN_PARALLEL_BIAS and leaf.ndim == 1:
             return P(axis_name)
+        if name in _EXPERT_STACKED and leaf.ndim == 3:
+            return P(expert_axis, None, None)
+        if name in _EXPERT_STACKED_BIAS and leaf.ndim == 2:
+            return P(expert_axis, None)
         return P()
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def _filter_to_mesh(specs, mesh: Mesh):
+    """Replace any spec entry naming an axis the mesh lacks with replication on that
+    dim — one rule set serves every mesh declaration."""
+
+    def filt(spec):
+        entries = tuple(e if (e is None or e in mesh.shape) else None for e in spec)
+        return P(*entries)
+
+    return jax.tree_util.tree_map(filt, specs,
+                                  is_leaf=lambda x: isinstance(x, P))
 
 
 def state_shardings(mesh: Mesh, state: TrainState, *,
@@ -73,19 +96,17 @@ def state_shardings(mesh: Mesh, state: TrainState, *,
     shard identically (the optimizer update stays elementwise-local, ZeRO-style for the
     sharded slices); the step counter replicates.
 
-    On a mesh without a ``model`` axis every leaf replicates — the rules degrade to
-    plain DP, so one code path serves any mesh declaration."""
-    if axis_name not in mesh.shape:
-        rep = NamedSharding(mesh, P())
-        return TrainState(
-            params=jax.tree_util.tree_map(lambda _: rep, state.params),
-            velocity=jax.tree_util.tree_map(lambda _: rep, state.velocity),
-            step=rep)
-    specs = param_partition_specs(state.params, axis_name=axis_name)
+    Spec entries naming axes the mesh lacks are filtered to replication, so one rule
+    set serves any mesh declaration (plain DP, TP-only, TP×EP, ...)."""
     to_sharding = lambda spec: NamedSharding(mesh, spec)
-    param_sh = jax.tree_util.tree_map(to_sharding, specs)
-    vel_specs = param_partition_specs(state.velocity, axis_name=axis_name)
-    vel_sh = jax.tree_util.tree_map(to_sharding, vel_specs)
+    specs = _filter_to_mesh(
+        param_partition_specs(state.params, axis_name=axis_name), mesh)
+    param_sh = jax.tree_util.tree_map(to_sharding, specs,
+                                      is_leaf=lambda x: isinstance(x, P))
+    vel_specs = _filter_to_mesh(
+        param_partition_specs(state.velocity, axis_name=axis_name), mesh)
+    vel_sh = jax.tree_util.tree_map(to_sharding, vel_specs,
+                                    is_leaf=lambda x: isinstance(x, P))
     return TrainState(params=param_sh, velocity=vel_sh,
                       step=NamedSharding(mesh, P()))
 

@@ -7,6 +7,9 @@ transformer family with any combination of
 - ``data``  — batch sharding + compiler-inserted gradient all-reduce (DP),
 - ``seq``   — ring attention over a sequence-sharded axis (SP, ``parallel/ring_attention.py``),
 - ``model`` — Megatron column/row weight sharding (TP, ``parallel/tensor_parallel.py``),
+- ``expert`` — Switch MoE blocks with expert-sharded weights (EP,
+  ``parallel/expert_parallel.py``; the axis size sets the expert count, and the
+  load-balance aux loss flows into the objective via ``make_train_step``),
 
 declared as one ``--mesh`` string, e.g. ``--mesh data=2,seq=2,model=2`` on 8 devices.
 Axes of size 1 are legal (``--mesh data=8`` is plain DP). Everything else is the
@@ -50,7 +53,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import
     ComposedConfig, parse_config,
 )
 
-_KNOWN_AXES = ("data", "seq", "model")
+_KNOWN_AXES = ("data", "seq", "model", "expert")
 
 
 def parse_mesh_spec(spec: str) -> tuple[tuple[str, ...], tuple[int, ...]]:
@@ -95,6 +98,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     mesh = make_mesh(n_mesh_devices, axis_names=axis_names, axis_shape=axis_sizes)
     data_size = mesh.shape.get("data", 1)
     seq_size = mesh.shape.get("seq", 1)
+    expert_size = mesh.shape.get("expert", 1)
     if config.batch_size % max(data_size, 1):
         raise ValueError(f"batch {config.batch_size} not divisible by data axis "
                          f"{data_size}")
@@ -106,6 +110,9 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                     "seq_len": config.seq_len}
     if attention_fn is not None:
         model_kwargs["attention_fn"] = attention_fn
+    if expert_size > 1:
+        model_kwargs["num_experts"] = expert_size
+        model_kwargs["expert_mesh"] = mesh
     model = TransformerClassifier(**model_kwargs)
     if seq_size > 1 and model.seq_len % seq_size:
         raise ValueError(f"model seq_len {model.seq_len} not divisible by seq axis "

@@ -57,7 +57,8 @@ def create_train_state(model, rng: jax.Array,
 
 
 def make_train_step(model, *, learning_rate: float, momentum: float,
-                    use_pallas: bool = False, grad_accum: int = 1) -> Callable:
+                    use_pallas: bool = False, grad_accum: int = 1,
+                    aux_loss_weight: float = 0.01) -> Callable:
     """Build ``step(state, images, labels, rng) -> (state, loss)``.
 
     The loss is the canonical ``nll(log_probs)`` formulation (see
@@ -75,6 +76,11 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     activation memory shrinks N× while the update equals the full-batch step exactly
     (equal-size microbatch means average to the batch mean; pinned in
     ``tests/test_train_step.py``). Dropout draws a distinct mask per microbatch.
+
+    Models that ``sow`` auxiliary losses into the ``"aux_loss"`` collection (the MoE
+    transformer's load-balance term, ``models/transformer.py``) have their sum added to
+    the objective scaled by ``aux_loss_weight``; for every other model the collection is
+    empty and the term is exactly zero.
     """
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -84,12 +90,15 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
         )
 
     def loss_fn(params, images, labels, rng):
-        log_probs = model.apply({"params": params}, images,
-                                deterministic=False, rngs={"dropout": rng})
+        log_probs, variables = model.apply(
+            {"params": params}, images, deterministic=False,
+            rngs={"dropout": rng}, mutable=["aux_loss"])
+        aux_leaves = jax.tree_util.tree_leaves(variables.get("aux_loss", {}))
+        aux = (aux_loss_weight * sum(aux_leaves)) if aux_leaves else 0.0
         if use_pallas:
             # log_softmax is idempotent: fused nll-from-logits on log-probs is identical.
-            return pk.nll_from_logits(log_probs, labels)
-        return ops.nll_loss(log_probs, labels)
+            return pk.nll_from_logits(log_probs, labels) + aux
+        return ops.nll_loss(log_probs, labels) + aux
 
     def apply_update(state, grads, loss):
         if use_pallas:

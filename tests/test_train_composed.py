@@ -32,8 +32,9 @@ def test_parse_mesh_spec():
     assert composed.parse_mesh_spec("data=2,seq=2,model=2") == (
         ("data", "seq", "model"), (2, 2, 2))
     assert composed.parse_mesh_spec("data=8") == (("data",), (8,))
+    assert composed.parse_mesh_spec("data=2,expert=4") == (("data", "expert"), (2, 4))
     with pytest.raises(ValueError, match="unknown mesh axis"):
-        composed.parse_mesh_spec("expert=8")
+        composed.parse_mesh_spec("stage=8")
     with pytest.raises(ValueError, match="name=size"):
         composed.parse_mesh_spec("data")
     with pytest.raises(ValueError, match="duplicate"):
@@ -103,3 +104,13 @@ def test_batch_larger_than_split_rejected(tiny_datasets):
         composed.main(
             ComposedConfig(mesh="data=8", batch_size=2048, results_dir=""),
             datasets=tiny_datasets)
+
+
+def test_expert_axis_builds_moe_model(tmp_path, tiny_datasets):
+    """--mesh with an expert axis turns on MoE blocks (expert count = axis size) with
+    expert-sharded weights, and the run trains through the standard step (aux loss
+    included automatically)."""
+    state, history = _run(tmp_path, tiny_datasets, "data=2,expert=4", "ep")
+    assert "router_kernel" in state.params["block_0"]
+    assert state.params["block_0"]["up_kernel"].shape[0] == 4
+    assert history.test_losses[-1] < history.test_losses[0] + 1e-6

@@ -197,3 +197,69 @@ def test_build_model_factory_knobs():
     assert build_model("cnn", bf16=True).dtype == jnp.bfloat16
     with pytest.raises(ValueError, match="transformer family only"):
         build_model("cnn", remat=True)
+
+
+def test_moe_blocks_forward_and_aux_loss():
+    """num_experts>0 swaps each block's MLP for the Switch MoE; per-block load-balance
+    aux losses arrive via the sown 'aux_loss' collection."""
+    model = TransformerClassifier(num_experts=8, dropout_rate=0.0)
+    state = create_train_state(model, jax.random.PRNGKey(0))
+    assert "router_kernel" in state.params["block_0"]
+    assert state.params["block_0"]["up_kernel"].shape == (8, 64, 256)
+    images, _ = _batch(seed=10)
+    log_probs, variables = model.apply({"params": state.params}, images,
+                                       mutable=["aux_loss"])
+    np.testing.assert_allclose(np.asarray(jnp.sum(jnp.exp(log_probs), axis=-1)),
+                               1.0, rtol=1e-5)
+    aux_leaves = jax.tree_util.tree_leaves(variables["aux_loss"])
+    assert len(aux_leaves) == model.num_layers
+    assert all(0.0 < float(a) <= 8.0 for a in aux_leaves)
+
+
+def test_moe_expert_mesh_execution_identical():
+    """Pinning dispatched tokens onto an 'expert' mesh axis (EP execution) changes
+    nothing numerically."""
+    mesh = make_mesh(8, axis_names=("expert",))
+    local = TransformerClassifier(num_experts=8, dropout_rate=0.0)
+    sharded = TransformerClassifier(num_experts=8, dropout_rate=0.0, expert_mesh=mesh)
+    state = create_train_state(local, jax.random.PRNGKey(0))
+    images, _ = _batch(seed=11)
+    a, _ = local.apply({"params": state.params}, images, mutable=["aux_loss"])
+    b, _ = sharded.apply({"params": state.params}, images, mutable=["aux_loss"])
+    np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5, atol=1e-6)
+
+
+def test_moe_trains_through_standard_train_step():
+    """The MoE model is genuinely drop-in: make_train_step collects the sown aux losses
+    into the objective automatically (aux_loss_weight), so the router trains — its
+    gradient is nonzero and loss falls — through the SAME step every trainer uses."""
+    model = TransformerClassifier(num_experts=8, dropout_rate=0.0)
+    state = create_train_state(model, jax.random.PRNGKey(0))
+    images, labels = _batch(n=32, seed=12)
+    router0 = np.asarray(state.params["block_0"]["router_kernel"]).copy()
+    step = jax.jit(make_train_step(model, learning_rate=0.05, momentum=0.5))
+    first = None
+    for _ in range(30):
+        state, loss = step(state, images, labels, jax.random.PRNGKey(3))
+        first = first if first is not None else float(loss)
+    assert float(loss) < first
+    assert np.max(np.abs(np.asarray(state.params["block_0"]["router_kernel"])
+                         - router0)) > 0
+
+
+def test_moe_expert_weights_shard_over_expert_axis():
+    """tensor_parallel's rules recognize the in-model MoE leaves: on a mesh with an
+    'expert' axis the stacked expert weights (and their velocity) shard per expert."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        tensor_parallel as tp,
+    )
+
+    mesh = make_mesh(8, axis_names=("expert",))
+    model = TransformerClassifier(num_experts=8, dropout_rate=0.0)
+    state = tp.shard_train_state(mesh, create_train_state(model, jax.random.PRNGKey(0)))
+    up = state.params["block_0"]["up_kernel"]
+    assert up.addressable_shards[0].data.shape == (1, 64, 256)  # one expert per device
+    vel = state.velocity["block_0"]["up_kernel"]
+    assert vel.addressable_shards[0].data.shape == (1, 64, 256)
+    router = state.params["block_0"]["router_kernel"]
+    assert router.addressable_shards[0].data.shape == tuple(router.shape)  # replicated
