@@ -18,6 +18,9 @@ unsharded trainers — pinned in tests), same metric lines.
 
 This is deliberately a thin composition of the parallel/ primitives: the entire
 "strategy" is the mesh declaration plus sharding rules; XLA inserts every collective.
+(Pipeline/stage parallelism is the one strategy not exposed here: it needs the
+stage-stacked parameter layout rather than this trainer's per-name block tree — use
+``parallel.pipeline`` directly, as its tests do.)
 """
 
 from __future__ import annotations
