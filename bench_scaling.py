@@ -40,7 +40,7 @@ def device_counts(available: int) -> list[int]:
 
 
 def run(max_train_examples: int = 0, timed_epochs: int = 3,
-        unroll: int = 1) -> list[dict]:
+        unroll: int = 1, pregather: bool = False) -> list[dict]:
     available = len(jax.devices())
     platform = jax.devices()[0].platform
     train_ds, _ = load_mnist("files")
@@ -50,13 +50,15 @@ def run(max_train_examples: int = 0, timed_epochs: int = 3,
     for n in device_counts(available):
         result = time_epochs(make_mesh(n), train_ds, global_batch=GLOBAL_BATCH,
                              learning_rate=LEARNING_RATE, momentum=MOMENTUM,
-                             timed_epochs=timed_epochs, unroll=unroll)
+                             timed_epochs=timed_epochs, unroll=unroll,
+                             pregather=pregather)
         rows.append({
             "devices": n,
             "epoch_seconds": round(result.median_seconds, 4),
             "platform": platform,
             "steps_per_epoch": result.steps_per_epoch,
             "scan_unroll": unroll,
+            "pregather": pregather,
             "data_source": train_ds.source,
         })
         print(json.dumps(rows[-1]), flush=True)
@@ -149,6 +151,9 @@ if __name__ == "__main__":
                         help="scan-body unroll factor for the device sweep "
                              "(semantics-preserving; amortizes per-step control "
                              "overhead on tiny models)")
+    parser.add_argument("--pregather", action="store_true",
+                        help="gather each epoch's batches once before the scan "
+                             "(semantics-preserving; the shipped bench.py default)")
     parser.add_argument("--sweep-global-batch", nargs="*", type=int, default=None,
                         metavar="B",
                         help="run the global-batch sweep instead of the device sweep "
@@ -158,4 +163,5 @@ if __name__ == "__main__":
         run_batch_sweep(args.sweep_global_batch or [256, 1024, 4096],
                         args.max_train_examples, args.timed_epochs)
     else:
-        run(args.max_train_examples, args.timed_epochs, args.unroll)
+        run(args.max_train_examples, args.timed_epochs, args.unroll,
+            args.pregather)
