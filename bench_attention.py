@@ -53,6 +53,8 @@ def main() -> int:
     parser.add_argument("--seq-lens", type=int, nargs="+", default=list(SEQ_LENS),
                         help="sequence lengths to measure (must divide by 128); "
                              "small values make the tool drivable on CPU interpret mode")
+    parser.add_argument("--plot", default=None,
+                        help="also save the flash-vs-dense curve PNG here")
     args = parser.parse_args()
 
     import jax
@@ -62,6 +64,7 @@ def main() -> int:
 
     platform = jax.default_backend()
     device_kind = jax.devices()[0].device_kind
+    all_rows = []
     for s in args.seq_lens:
         rng = np.random.default_rng(s)
         q, k, v = (jnp.asarray(rng.normal(size=(B, s, H, D)).astype(np.float32))
@@ -87,9 +90,17 @@ def main() -> int:
             row["dense_fwdbwd_s"] = None
             row["dense_error"] = f"skipped: O(S^2) scores beyond {DENSE_MAX_S}"
         print(json.dumps(row), flush=True)
+        all_rows.append(row)
         if args.out:  # append per row — a later-size failure must not lose earlier rows
             with open(args.out, "a") as f:
                 f.write(json.dumps(row) + "\n")
+        if args.plot:  # re-save per row for the same reason (overwrite-in-place)
+            from csed_514_project_distributed_training_using_pytorch_tpu.utils.plotting import (
+                save_attention_curve,
+            )
+            if save_attention_curve(all_rows, args.plot) is None:
+                print(f"warning: --plot {args.plot} not written "
+                      f"(matplotlib unavailable)", file=sys.stderr)
     return 0
 
 

@@ -93,6 +93,34 @@ def save_batch_sweep_curve(global_batches: list[int], examples_per_s: list[float
     return path
 
 
+def save_attention_curve(rows: list[dict], path: str) -> str | None:
+    """Flash-vs-dense attention fwd+bwd time vs sequence length (the long-context
+    microbench artifact, ``bench_attention.py``). ``rows`` are the tool's JSON rows;
+    a missing ``dense_fwdbwd_s`` (the O(S²) memory wall) truncates the dense line —
+    that truncation is the point of the chart."""
+    if not (HAVE_MATPLOTLIB and is_logging_process()):
+        return None
+    _ensure_dir(path)
+    flash_pts = [(r["seq_len"], r["flash_fwdbwd_s"]) for r in rows
+                 if r.get("flash_fwdbwd_s")]
+    dense_pts = [(r["seq_len"], r["dense_fwdbwd_s"]) for r in rows
+                 if r.get("dense_fwdbwd_s")]
+    fig = plt.figure()
+    plt.plot([s for s, _ in flash_pts], [f for _, f in flash_pts],
+             marker="o", label="flash (Pallas, O(S·D) HBM)")
+    if dense_pts:
+        plt.plot([s for s, _ in dense_pts], [d for _, d in dense_pts],
+                 marker="s", label="dense (XLA, O(S²) HBM)")
+    plt.xscale("log", base=2)
+    plt.xlabel("Sequence length (tokens)")
+    plt.ylabel("Attention fwd+bwd time (s)")
+    plt.title("Flash vs dense attention vs sequence length")
+    plt.legend()
+    fig.savefig(path)
+    plt.close(fig)
+    return path
+
+
 def save_scaling_curve(worker_counts: list[int], epoch_seconds: list[float],
                        path: str) -> str | None:
     """Time-to-train-one-epoch vs number of workers — the reference's headline result

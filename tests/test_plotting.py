@@ -60,11 +60,31 @@ def test_savers_no_op_without_matplotlib(tmp_path, history, monkeypatch):
 
 def test_savers_gated_off_nonzero_process(tmp_path, history, monkeypatch):
     """Only process 0 writes figures (unlike the reference, where every rank plots the
-    same file — SURVEY.md §5 metrics/logging). All four savers share the gate."""
+    same file — SURVEY.md §5 metrics/logging). All savers share the gate."""
     monkeypatch.setattr(plotting, "is_logging_process", lambda: False)
     assert plotting.save_sample_grid(np.zeros((8, 28, 28, 1), np.float32),
                                      np.zeros(8), str(tmp_path / "g.png")) is None
     assert plotting.save_loss_curves(history, str(tmp_path / "c.png")) is None
     assert plotting.save_batch_sweep_curve([1], [1.0], str(tmp_path / "b.png")) is None
     assert plotting.save_scaling_curve([1], [1.0], str(tmp_path / "s.png")) is None
+    assert plotting.save_attention_curve(
+        [{"seq_len": 128, "flash_fwdbwd_s": 0.1}], str(tmp_path / "a.png")) is None
     assert list(tmp_path.iterdir()) == []
+
+
+def test_save_attention_curve(tmp_path):
+    """The long-context artifact: dense-line truncation at its memory wall must not
+    break the plot (that truncation is the chart's point)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.plotting import (
+        save_attention_curve,
+    )
+
+    rows = [
+        {"seq_len": 1024, "flash_fwdbwd_s": 0.09, "dense_fwdbwd_s": 0.087},
+        {"seq_len": 8192, "flash_fwdbwd_s": 0.088, "dense_fwdbwd_s": 0.1},
+        {"seq_len": 16384, "flash_fwdbwd_s": 0.12, "dense_fwdbwd_s": None,
+         "dense_error": "skipped: O(S^2)"},
+    ]
+    path = str(tmp_path / "attention.png")
+    assert save_attention_curve(rows, path) == path
+    assert os.path.getsize(path) > 0

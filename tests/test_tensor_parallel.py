@@ -145,3 +145,14 @@ def test_multi_step_tp_trajectory_matches(model):
         state, loss = step(state, x, y, jax.random.PRNGKey(1))
     assert abs(float(loss) - float(ref_loss)) < 1e-5
     _assert_params_match(state.params, ref_state.params, atol=1e-5)
+
+
+def test_filter_to_mesh_drops_absent_axes():
+    """Specs naming axes the mesh lacks are filtered to replication on that dim, so one
+    rule set serves every mesh declaration."""
+    mesh = make_mesh(8)  # ('data',) only
+    specs = {"a": P(None, "model"), "b": P("expert", None, None), "c": P("data")}
+    out = tp._filter_to_mesh(specs, mesh)
+    assert out["a"] == P(None, None)
+    assert out["b"] == P(None, None, None)
+    assert out["c"] == P("data")
