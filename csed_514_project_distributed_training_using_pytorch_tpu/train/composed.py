@@ -38,12 +38,17 @@ from csed_514_project_distributed_training_using_pytorch_tpu.models import (
     TransformerClassifier,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+    initialize_cluster,
     make_mesh,
     make_ring_attention_fn,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+    data_parallel as dp,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     tensor_parallel as tp,
 )
+from jax.sharding import PartitionSpec as P
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
     TrainState,
     create_train_state,
@@ -91,6 +96,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     watch = M.Stopwatch()
     axis_names, axis_sizes = parse_mesh_spec(config.mesh)
     n_mesh_devices = int(np.prod(axis_sizes))
+    info = initialize_cluster()   # no-op single-process; multi-host rendezvous otherwise
 
     if config.download_data and datasets is None:
         download_mnist(config.data_dir)
@@ -122,7 +128,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                          f"{seq_size}")
 
     M.log(f"Composed training: mesh "
-          f"{dict(zip(axis_names, axis_sizes))} over {n_mesh_devices} devices, "
+          f"{dict(zip(axis_names, axis_sizes))} over {n_mesh_devices} devices "
+          f"on {info.process_count} process(es), "
           f"batch {config.batch_size}, data source: {train_ds.source}")
 
     state = tp.shard_train_state(mesh, create_train_state(model, jax.random.PRNGKey(
@@ -131,12 +138,23 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         make_train_step(model, learning_rate=config.learning_rate,
                         momentum=config.momentum),
         mesh, data_axis="data" if data_size > 1 else None)
-    eval_fn = jax.jit(make_eval_fn(model, batch_size=config.batch_size_test))
+    # Eval consumes the sharded params in place (no host gather — multi-host safe);
+    # sums/counts come back replicated, which every process can read.
+    rep = dp.replicated(mesh)
+    param_shardings = tp.state_shardings(mesh, state).params
+    eval_fn = jax.jit(make_eval_fn(model, batch_size=config.batch_size_test),
+                      in_shardings=(param_shardings, rep, rep),
+                      out_shardings=(rep, rep))
 
-    train_x = jnp.asarray(train_ds.images)
-    train_y = jnp.asarray(train_ds.labels)
-    test_x = jnp.asarray(test_ds.images)
-    test_y = jnp.asarray(test_ds.labels)
+    # Every process holds the identical dataset (pure function of the seed / the same
+    # files) and derives the identical permutation — the same contract parallel/sampler
+    # documents. The split uploads ONCE, replicated; per-step batches are on-device
+    # gathers (only the 64-int index plan crosses the host boundary each step).
+    train_x = dp.put_global(mesh, train_ds.images, P())
+    train_y = dp.put_global(mesh, train_ds.labels, P())
+    test_x = dp.put_global(mesh, test_ds.images, P())
+    test_y = dp.put_global(mesh, test_ds.labels, P())
+    batch_sharding = (dp.batch_sharding(mesh) if data_size > 1 else rep)
     history = M.MetricsHistory()
     n_train, n_test = len(train_ds), len(test_ds)
     steps_per_epoch = n_train // config.batch_size
@@ -149,15 +167,18 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         perm = rng.permutation(n_train)
         losses = []
         for s in range(steps_per_epoch):
-            idx = jnp.asarray(perm[s * config.batch_size:(s + 1) * config.batch_size])
-            state, loss = step(state, train_x[idx], train_y[idx],
-                               jax.random.PRNGKey(config.seed + 1))
+            idx = dp.put_global(
+                mesh, perm[s * config.batch_size:(s + 1) * config.batch_size]
+                .astype(np.int32), P())
+            # On-device gather from the replicated split, then a (local-slice) reshard
+            # onto the batch layout the compiled step declares.
+            bx = jax.device_put(jnp.take(train_x, idx, axis=0), batch_sharding)
+            by = jax.device_put(jnp.take(train_y, idx, axis=0), batch_sharding)
+            state, loss = step(state, bx, by, jax.random.PRNGKey(config.seed + 1))
             losses.append(loss)
         jax.block_until_ready(state.params)
         epoch_loss = float(jnp.mean(jnp.stack(losses)))
-        # Eval runs on gathered (host) params — the interchange property under test.
-        host_params = jax.device_get(state.params)
-        sum_nll, correct = jax.device_get(eval_fn(host_params, test_x, test_y))
+        sum_nll, correct = jax.device_get(eval_fn(state.params, test_x, test_y))
         examples_trained = (epoch + 1) * steps_per_epoch * config.batch_size
         history.record_train(examples_trained, epoch_loss)
         history.record_test(examples_trained, float(sum_nll) / n_test)
@@ -166,11 +187,14 @@ def main(config: ComposedConfig = ComposedConfig(), *,
               f"accuracy: {int(correct) / n_test:.4f}, "
               f"time_elapsed: {watch.elapsed():.2f}s")
 
-    host_state = jax.device_get(state)
+    # Replicate shards on device (all-gather), then fetch — device_get on a sharded
+    # array would fail on a multi-host fleet where no process addresses every shard.
+    gather = jax.jit(lambda s: s, out_shardings=rep)
+    host_state = jax.device_get(gather(state))
     if config.results_dir:
         os.makedirs(config.results_dir, exist_ok=True)
         path = os.path.join(config.results_dir, "model_composed.ckpt")
-        checkpoint.save_train_state(path, host_state)
+        checkpoint.save_train_state(path, host_state)  # process-0 gate lives inside
         M.log(f"Saved {path}")
     return host_state, history
 

@@ -174,3 +174,31 @@ def test_distributed_resume_two_processes(tmp_path, monkeypatch):
     assert launch(resume_args + ["--resume-from", str(ckpt)], num_processes=2,
                   platform="cpu", devices_per_process=1, timeout=600) == 0
     assert (tmp_path / "results" / "model_dist.msgpack").exists()
+
+
+def test_composed_tp_two_processes_matches_single_process(tmp_path, monkeypatch):
+    """Composed DP×TP across a REAL process boundary: 2 processes × 2 devices
+    (mesh data=2,model=2 — the data axis spans the processes, TP stays intra-process,
+    exactly a pod's layout) must train to the same checkpoint as 1 process × 4 devices."""
+    from flax import serialization
+
+    args = ["-m", f"{PKG}.train.composed",
+            "--mesh", "data=2,model=2", "--epochs", "1", "--batch-size", "64",
+            "--batch-size-test", "256",
+            "--max-train-examples", "512", "--max-test-examples", "256"]
+    results = {}
+    for name, procs, dpp in [("two_proc", 2, 2), ("one_proc", 1, 4)]:
+        cwd = tmp_path / name
+        cwd.mkdir()
+        monkeypatch.chdir(cwd)
+        assert launch(args, num_processes=procs, platform="cpu",
+                      devices_per_process=dpp, timeout=600) == 0
+        with open(cwd / "results" / "model_composed.ckpt", "rb") as f:
+            results[name] = serialization.msgpack_restore(f.read())
+
+    flat_a = jax_flatten(results["two_proc"])
+    flat_b = jax_flatten(results["one_proc"])
+    assert flat_a.keys() == flat_b.keys()
+    for k in flat_a:
+        np.testing.assert_allclose(flat_a[k], flat_b[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=f"leaf {k} diverged across launch modes")
