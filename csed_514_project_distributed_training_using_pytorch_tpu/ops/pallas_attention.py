@@ -315,6 +315,18 @@ def _make_op(causal: bool):
     return op
 
 
+def flash_forward_with_lse(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
+                           causal: bool = False):
+    """Forward-only flash attention that also returns the per-row log-sum-exp:
+    ``[BH, S, D]³ → (out [BH, S, D], lse [BH, S/BLOCK, 1, BLOCK])``.
+
+    The lse rows are what blockwise/ring merges need to combine partial attention
+    results exactly (``parallel.ring_attention.ring_flash_attention``). Not wrapped in
+    the custom VJP — differentiate through ``flash_attention`` instead.
+    """
+    return _flash_forward(q3, k3, v3, causal=causal)
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False) -> jax.Array:
     """Drop-in for ``ops.full_attention``: ``[B, S, H, D]`` → ``[B, S, H, D]``.

@@ -22,6 +22,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.collective
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_attention import (
     ring_attention,
+    ring_flash_attention,
     make_ring_attention_fn,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel.tensor_parallel import (
@@ -49,6 +50,7 @@ __all__ = [
     "ring_pass",
     "all_reduce_sum",
     "ring_attention",
+    "ring_flash_attention",
     "make_ring_attention_fn",
     "param_partition_specs",
     "shard_train_state",

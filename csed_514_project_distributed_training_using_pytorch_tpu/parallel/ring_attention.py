@@ -140,3 +140,80 @@ def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq"):
         return ring_attention(mesh, q, k, v, axis_name=axis_name, causal=causal)
 
     return attention_fn
+
+
+def ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
+                         axis_name: str = "seq") -> jax.Array:
+    """Ring-of-flash: sequence-parallel attention whose per-hop block math runs through
+    the Pallas flash kernels (``ops/pallas_attention.py``) instead of dense einsums.
+
+    The true long-context composition on TPU: the ring shards the sequence across chips
+    (K/V hops on ICI), and within each hop the arriving block attends via the
+    O(block·D)-VMEM flash kernel, so neither level ever materializes a score matrix.
+    Per-hop partial results carry their log-sum-exp rows and are merged with the
+    standard blockwise-softmax combination
+
+        lse = logsumexp_t(lse_t),   out = Σ_t exp(lse_t − lse) · out_t
+
+    which is exact (pinned against the dense oracle in ``tests/test_ring_attention.py``).
+    Bidirectional (non-causal) attention — the encoder/classifier case; causal ring
+    attention uses the einsum formulation above, whose masking works from global
+    positions. Per-device sequence shard must divide by the flash BLOCK (128), so
+    ``S % (shards · 128) == 0``. Forward/serving path: the flash kernels' AD lives in
+    their custom VJP (``flash_attention``), which this bypasses to reach the lse rows —
+    train with ``ring_attention`` or single-chip ``flash_attention``.
+    """
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
+        pallas_attention as pa,
+    )
+
+    n = mesh.shape[axis_name]
+    b, s, h, d = q.shape
+    if s % (n * pa.BLOCK):
+        raise ValueError(
+            f"ring_flash_attention needs sequence length divisible by "
+            f"shards·BLOCK = {n}·{pa.BLOCK}, got {s}")
+    spec = P(None, axis_name, None, None)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+             check_vma=False)
+    def _ring(ql, kl, vl):
+        bq = ql.shape[1]                                  # local shard = S/n
+        to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, bq, d)
+        # Convert to the kernel layout ONCE and promote to f32 at entry: the kernel
+        # emits its output in the input dtype, and merging n bf16-rounded partials
+        # would lose precision the f32 merge math cannot recover. K/V ride the ring in
+        # 3-D form (ppermute is shape-agnostic) — no per-hop relayout.
+        q3 = to3(ql).astype(jnp.float32)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        def merge(carry, k_blk, v_blk):
+            acc, m, l = carry
+            out3, lse = pa.flash_forward_with_lse(q3, k_blk, v_blk)
+            # lse: [BH, nq, 1, BLOCK] → per-query-row [BH, bq, 1]
+            lse_rows = jnp.transpose(lse, (0, 1, 3, 2)).reshape(b * h, bq, 1)
+            m_new = jnp.maximum(m, lse_rows)
+            corr = jnp.exp(m - m_new)
+            w = jnp.exp(lse_rows - m_new)
+            return acc * corr + out3 * w, m_new, l * corr + w
+
+        def hop(carry, _):
+            acc, m, l, k_cur, v_cur = carry
+            acc, m, l = merge((acc, m, l), k_cur, v_cur)
+            k_next = lax.ppermute(k_cur, axis_name, perm)
+            v_next = lax.ppermute(v_cur, axis_name, perm)
+            return (acc, m, l, k_next, v_next), None
+
+        acc0 = jnp.zeros((b * h, bq, d), jnp.float32)
+        m0 = jnp.full((b * h, bq, 1), MASK_VALUE, jnp.float32)
+        l0 = jnp.zeros((b * h, bq, 1), jnp.float32)
+        # n-1 permuting hops, then fold the last arriving block without rotating —
+        # no discarded collective (same structure as _ring_attention_local above).
+        (acc, m, l, k_last, v_last), _ = lax.scan(
+            hop, (acc0, m0, l0, to3(kl).astype(jnp.float32),
+                  to3(vl).astype(jnp.float32)), None, length=n - 1)
+        acc, _, l = merge((acc, m, l), k_last, v_last)
+        out3 = (acc / jnp.where(l == 0.0, 1.0, l)).astype(ql.dtype)
+        return jnp.transpose(out3.reshape(b, h, bq, d), (0, 2, 1, 3))
+
+    return _ring(q, k, v)

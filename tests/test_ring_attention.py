@@ -94,3 +94,28 @@ def test_ring_respects_sequence_sharding(seq_mesh):
     v = jax.device_put(v, NamedSharding(seq_mesh, spec))
     out = ring_attention(seq_mesh, q, k, v)
     assert out.sharding.spec == spec
+
+
+def test_ring_of_flash_matches_dense(seq_mesh):
+    """Ring-of-flash (ring across shards, Pallas flash kernel within each hop, exact
+    lse-weighted merge) equals dense attention — the two-level long-context composition,
+    forward/serving path."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_attention import (
+        ring_flash_attention,
+    )
+
+    q, k, v = _qkv(b=1, s=1024, h=2, d=64, seed=6)
+    out = ring_flash_attention(seq_mesh, q, k, v)
+    ref = ops.full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_of_flash_block_divisibility_enforced(seq_mesh):
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_attention import (
+        ring_flash_attention,
+    )
+
+    q, k, v = _qkv(b=1, s=512, h=1, d=64, seed=7)  # 512 / 8 shards = 64 < BLOCK
+    with pytest.raises(ValueError, match="shards"):
+        ring_flash_attention(seq_mesh, q, k, v)
