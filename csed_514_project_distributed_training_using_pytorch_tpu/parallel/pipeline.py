@@ -45,6 +45,34 @@ def stack_stage_params(stage_param_list):
     return jax.tree_util.tree_map(lambda *leaves: jnp.stack(leaves), *stage_param_list)
 
 
+def stack_transformer_blocks(params, num_layers: int):
+    """Bridge a ``TransformerClassifier`` params tree (per-name ``block_i`` subtrees —
+    the checkpoint layout) to the stacked ``[num_layers, ...]`` layout this module
+    shards: returns ``(stacked_blocks, rest)`` where ``rest`` is the tree minus the
+    blocks (embeddings, final LN, head). Inverse: ``unstack_transformer_blocks``."""
+    expected = {f"block_{i}" for i in range(num_layers)}
+    missing = sorted(expected - set(params))
+    if missing:
+        raise ValueError(f"params tree lacks block subtrees {missing}")
+    extra = sorted(k for k in params if k.startswith("block_") and k not in expected)
+    if extra:
+        raise ValueError(
+            f"params tree has block subtrees beyond num_layers={num_layers}: {extra} "
+            f"— silently dropping layers would corrupt the round-trip")
+    stacked = stack_stage_params([params[f"block_{i}"] for i in range(num_layers)])
+    rest = {k: v for k, v in params.items() if not k.startswith("block_")}
+    return stacked, rest
+
+
+def unstack_transformer_blocks(stacked, rest) -> dict:
+    """Rebuild the per-name checkpoint layout from ``(stacked_blocks, rest)``."""
+    num_layers = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    out = dict(rest)
+    for i in range(num_layers):
+        out[f"block_{i}"] = jax.tree_util.tree_map(lambda p: p[i], stacked)
+    return out
+
+
 def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params,
                    microbatches: jax.Array, *, axis_name: str = "stage") -> jax.Array:
     """Run ``microbatches`` through the stage pipeline.

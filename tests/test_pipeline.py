@@ -108,3 +108,47 @@ def test_indivisible_microbatching_rejected(mesh, block, stage_params):
     f = pp.make_pipelined_blocks_fn(mesh, _stage_fn(block), num_microbatches=5)
     with pytest.raises(ValueError, match="not divisible"):
         f(pp.stack_stage_params(stage_params), _x(b=16))
+
+
+def test_transformer_checkpoint_bridges_to_pipeline(mesh):
+    """A classifier checkpoint's per-name block subtrees stack into the pipeline layout,
+    the pipelined blocks compute exactly what the classifier's block stack computes, and
+    the layout round-trips bit-for-bit."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        TransformerClassifier,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state,
+    )
+
+    model = TransformerClassifier(num_layers=NUM_STAGES, dropout_rate=0.0)
+    params = create_train_state(model, jax.random.PRNGKey(3)).params
+    stacked, rest = pp.stack_transformer_blocks(params, NUM_STAGES)
+    assert "embed_kernel" in rest and not any(k.startswith("block_") for k in rest)
+
+    rebuilt = pp.unstack_transformer_blocks(stacked, rest)
+    for a, b in zip(jax.tree_util.tree_leaves(rebuilt),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    blk = TransformerBlock(num_heads=model.num_heads, dropout_rate=0.0)
+    x = _x(b=8, seed=4)[:, :, :64]
+    f = pp.make_pipelined_blocks_fn(mesh, lambda p, a: blk.apply({"params": p}, a),
+                                    num_microbatches=4)
+    y_pipe = f(stacked, x)
+    y_seq = x
+    for i in range(NUM_STAGES):
+        y_seq = blk.apply({"params": params[f"block_{i}"]}, y_seq)
+    np.testing.assert_allclose(np.asarray(y_pipe), np.asarray(y_seq),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_stack_transformer_blocks_missing_block_rejected():
+    with pytest.raises(ValueError, match="lacks block"):
+        pp.stack_transformer_blocks({"block_0": {}, "embed_kernel": 1}, 2)
+
+
+def test_stack_transformer_blocks_extra_block_rejected():
+    with pytest.raises(ValueError, match="beyond num_layers"):
+        pp.stack_transformer_blocks(
+            {"block_0": {}, "block_1": {}, "block_2": {}, "embed_kernel": 1}, 2)
