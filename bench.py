@@ -11,10 +11,15 @@ Robustness (r1 verdict item 1): the round-1 bench died with rc=1 on a transient
 in-process by jax, while a wedged TPU claim can make init *hang* rather than fail. So the
 measurement runs in a CHILD process driven by a parent retry loop: each attempt gets a fresh
 interpreter and a hard deadline (graceful SIGTERM first — SIGKILL on a process holding the
-TPU claim wedges the lease); on exhausting the retry budget (``BENCH_TPU_RETRY_SECONDS``,
-default 900) the parent re-runs the child on the CPU backend so the round still records a
-real, parseable measurement — clearly labeled ``"platform": "cpu"`` with the TPU failure in
-``fallback_reason`` — instead of a stack trace.
+TPU claim wedges the lease). r2 hardening: every measurement attempt is preceded by a cheap
+chip-claim PROBE child (seconds when healthy, ~90 s cap when wedged), so a wedged lease
+burns probes, not 600-s attempts; the child enables a persistent XLA compilation cache under
+``bench_results/.jax_cache`` so a claim that succeeds after priming costs seconds, not a
+full compile. On exhausting the retry budget (``BENCH_TPU_RETRY_SECONDS``, default 900) the
+parent re-runs the child on the CPU backend so the round still records a real, parseable
+measurement — clearly labeled ``"platform": "cpu"`` with the TPU failure in
+``fallback_reason`` and the newest committed hardware capture embedded as
+``last_hardware_capture`` — instead of a stack trace.
 
 Throughput/MFU (r1 verdict item 3): alongside epoch seconds the JSON carries steps/s,
 examples/s, achieved model FLOP/s, and an MFU estimate against the chip's bf16 peak (the
@@ -41,6 +46,19 @@ BASELINE_BEST = 7.6          # reference 4-machine DDP/gloo epoch time (BASELINE
 def measure() -> dict:
     """The actual measurement — runs in the child process (``bench.py --inner``)."""
     import jax
+
+    # Persistent compilation cache (r2 verdict item 1a): once a hardware window has
+    # primed this directory, a later successful chip claim costs seconds instead of a
+    # full XLA compile that can eat most of a 600-s attempt. Harmless on CPU fallback
+    # (cache entries are keyed by platform).
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_results", ".jax_cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as exc:  # cache is an optimization, never a failure mode
+        print(f"bench: compilation cache disabled: {exc}", file=sys.stderr)
 
     from csed_514_project_distributed_training_using_pytorch_tpu.data import load_mnist
     from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
@@ -134,14 +152,21 @@ def _parse_child_json(out: str) -> dict | None:
     return payload if isinstance(payload, dict) else None
 
 
-def _run_child(env_overrides: dict, timeout_s: float) -> tuple[int | None, str, str]:
-    """One measurement attempt in a fresh interpreter. Returns (rc, stdout, stderr);
-    rc=None on timeout. Termination is graceful (SIGTERM, then a grace period) — a
-    SIGKILLed holder of the tunnelled TPU claim wedges the lease for later attempts."""
+_ABANDONED: list = []   # hung children we deliberately do NOT SIGKILL (see _run_child)
+
+
+def _run_child(env_overrides: dict, timeout_s: float,
+               argv: list | None = None) -> tuple[int | None, str, str]:
+    """One child in a fresh interpreter (default: this file with ``--inner``).
+    Returns (rc, stdout, stderr); rc=None on timeout. Termination is graceful
+    (SIGTERM, then a grace period). A child still alive after the grace is ABANDONED,
+    not SIGKILLed: a child hung *post-claim* in backend init is a holder, and a
+    SIGKILLed holder of the tunnelled TPU claim wedges the lease for hours. An
+    abandoned probe merely lists devices and exits on its own once unblocked."""
     env = dict(os.environ, **env_overrides)
-    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__), "--inner"],
-                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                            text=True, env=env)
+    proc = subprocess.Popen(
+        argv or [sys.executable, os.path.abspath(__file__), "--inner"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
     try:
         out, err = proc.communicate(timeout=timeout_s)
         return proc.returncode, out, err
@@ -150,38 +175,131 @@ def _run_child(env_overrides: dict, timeout_s: float) -> tuple[int | None, str, 
         try:
             out, err = proc.communicate(timeout=30)
         except subprocess.TimeoutExpired:
-            proc.kill()
-            out, err = proc.communicate()
+            for pipe in (proc.stdout, proc.stderr):
+                if pipe is not None:
+                    pipe.close()
+            _ABANDONED.append(proc)
+            out, err = "", ""
         return None, out or "", err or ""
+
+
+def _probe_chip(timeout_s: float) -> tuple[str, str]:
+    """Cheap chip-claim probe in a fresh interpreter (r2 verdict item 1b).
+
+    A wedged TPU lease (a previously-killed holder — see SETUP.md) makes backend init
+    *hang*, so committing a full 600-s measurement attempt to find that out wastes most
+    of the retry budget. This child only claims the backend, prints the platform, and
+    exits cleanly — detectable in seconds when healthy, and cheap to give up on when
+    not. Returns (status, detail) with status one of:
+      'tpu'   — chip claimed, measure now;
+      'other' — backend init SUCCEEDED but resolved to a non-TPU platform — a
+                deterministic condition (no plugin / JAX_PLATFORMS override), so the
+                caller should fall back immediately instead of burning the budget;
+      'retry' — transient/unknown failure or a timeout (claim likely wedged)."""
+    code = ("import jax, json; d = jax.devices(); "
+            "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))")
+    rc, out, err = _run_child({}, timeout_s, argv=[sys.executable, "-c", code])
+    if rc is None:
+        return "retry", f"probe timed out after {timeout_s:.0f}s (claim likely wedged)"
+    info = _parse_child_json(out or "")
+    if rc == 0 and info and info.get("platform") == "tpu":
+        return "tpu", f"tpu x{info.get('n')}"
+    if rc == 0 and info:
+        return "other", f"backend is {info.get('platform')!r}, not tpu"
+    tail = (err or out or "").strip().splitlines()
+    return "retry", tail[-1] if tail else f"probe exited rc={rc}"
+
+
+def _latest_hardware_capture() -> dict | None:
+    """Newest committed TPU capture under bench_results/ (r2 verdict item 1c), so the
+    driver artifact carries hardware evidence even when the chip is wedged all round."""
+    import glob
+    import re
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_results")
+    candidates = [p for p in glob.glob(os.path.join(root, "bench_r*_tpu*.json"))
+                  if os.path.isfile(p)]
+    if not candidates:
+        return None
+
+    # Newest by ROUND NUMBER in the filename, not mtime — on a fresh clone every file
+    # shares the checkout mtime. Within a round, prefer the curated "*best*" capture.
+    def rank(p: str) -> tuple:
+        m = re.search(r"bench_r(\d+)_tpu", os.path.basename(p))
+        return (int(m.group(1)) if m else -1, "best" in os.path.basename(p))
+
+    path = max(candidates, key=rank)
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return {
+        "file": os.path.relpath(path, os.path.dirname(root)),
+        "selected_by": "highest round number in filename, preferring '*best*'",
+        "provenance": ("builder-side capture during a live TPU window; committed to "
+                       "bench_results/ with the measurement protocol in RESULTS.md"),
+        "payload": payload,
+    }
 
 
 def main() -> int:
     retry_budget = float(os.environ.get("BENCH_TPU_RETRY_SECONDS", "900"))
     attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_SECONDS", "600"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_SECONDS", "90"))
     deadline = time.monotonic() + retry_budget
 
-    attempts, last_error = 0, ""
+    # Probe-first (r2 verdict item 1b): only commit a full measurement attempt after a
+    # cheap probe child proves the chip claim is obtainable. A wedged claim burns a
+    # ~90-s probe instead of a 600-s attempt, leaving budget for many retries.
+    attempts, probes, last_error = 0, 0, ""
     while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        probes += 1
+        status, detail = _probe_chip(min(probe_timeout, max(10.0, remaining)))
+        if status == "other":
+            # Deterministic: this interpreter will never see a TPU. Don't burn the
+            # retry budget re-discovering it — go straight to the labeled fallback.
+            last_error = detail
+            print(f"bench probe {probes}: {detail}; skipping TPU retries",
+                  file=sys.stderr)
+            break
+        if status != "tpu":
+            last_error = detail
+            print(f"bench probe {probes} failed: {detail}", file=sys.stderr)
+            time.sleep(min(20.0, max(1.0, deadline - time.monotonic())))
+            continue
+        print(f"bench probe {probes}: chip alive ({detail}); measuring",
+              file=sys.stderr)
         attempts += 1
-        rc, out, err = _run_child({}, attempt_timeout)
+        this_timeout = min(attempt_timeout,
+                           max(60.0, deadline - time.monotonic()))
+        rc, out, err = _run_child({}, this_timeout)
         if rc == 0 and out.strip():
             payload = _parse_child_json(out)
             if payload is None:
                 last_error = f"unparseable child stdout: {out[-300:]!r}"
             else:
                 payload["attempts"] = attempts
+                payload["probes"] = probes
                 print(json.dumps(payload))
                 return 0
         else:
             tail = (err or out).strip().splitlines()
-            last_error = (f"attempt timed out after {attempt_timeout:.0f}s"
+            last_error = (f"attempt timed out after {this_timeout:.0f}s"
                           if rc is None else
                           (tail[-1] if tail else f"child exited rc={rc}"))
         print(f"bench attempt {attempts} failed: {last_error}", file=sys.stderr)
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
+        if rc is None and _ABANDONED:
+            # Our own hung measurement child now holds (or queues on) the exclusive
+            # TPU claim; every further probe is doomed to time out against it. Skip
+            # straight to the CPU fallback instead of burning the rest of the budget.
+            print("bench: hung attempt child abandoned; no further TPU retries "
+                  "possible this run", file=sys.stderr)
             break
-        time.sleep(min(30.0, 5.0 * attempts, max(1.0, remaining)))
+        time.sleep(min(30.0, 5.0 * attempts,
+                       max(1.0, deadline - time.monotonic())))
 
     # Retry budget exhausted — fall back to a labeled CPU measurement so the round still
     # records a real number instead of a stack trace (r1: BENCH_r01.json was rc=1).
@@ -192,16 +310,23 @@ def main() -> int:
     # other PYTHONPATH entry the user set, with the repo dir prepended.
     keep = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
             if p and "axon_site" not in p]
+    fallback_timeout = max(attempt_timeout, 1800.0)
     rc, out, err = _run_child(
         {"JAX_PLATFORMS": "cpu",
          "PYTHONPATH": os.pathsep.join(
              [os.path.dirname(os.path.abspath(__file__))] + keep)},
-        max(attempt_timeout, 1800.0))
+        fallback_timeout)
+    if rc is None and not (err or out):
+        err = f"cpu fallback timed out after {fallback_timeout:.0f}s"
+    capture = _latest_hardware_capture()
     if rc == 0 and out.strip():
         payload = _parse_child_json(out)
         if payload is not None:
             payload["attempts"] = attempts
+            payload["probes"] = probes
             payload["fallback_reason"] = f"tpu unavailable: {last_error}"
+            if capture is not None:
+                payload["last_hardware_capture"] = capture
             print(json.dumps(payload))
             return 0
         err = f"unparseable CPU-fallback stdout: {out[-300:]!r}"
@@ -212,7 +337,8 @@ def main() -> int:
         "value": None, "unit": "s", "vs_baseline": None,
         "error": last_error,
         "cpu_fallback_error": (err or out).strip().splitlines()[-1:],
-        "attempts": attempts,
+        "attempts": attempts, "probes": probes,
+        **({"last_hardware_capture": capture} if capture is not None else {}),
     }))
     return 1
 
