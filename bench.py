@@ -47,18 +47,15 @@ def measure() -> dict:
     """The actual measurement — runs in the child process (``bench.py --inner``)."""
     import jax
 
-    # Persistent compilation cache (r2 verdict item 1a): once a hardware window has
-    # primed this directory, a later successful chip claim costs seconds instead of a
-    # full XLA compile that can eat most of a 600-s attempt. Harmless on CPU fallback
-    # (cache entries are keyed by platform).
-    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "bench_results", ".jax_cache")
-    try:
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception as exc:  # cache is an optimization, never a failure mode
-        print(f"bench: compilation cache disabled: {exc}", file=sys.stderr)
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        enable_compile_cache,
+    )
+
+    # Persistent compilation cache (r2 verdict item 1a): priming during any hardware
+    # window makes later claims cost seconds. Harmless on CPU fallback (cache entries
+    # are keyed by platform).
+    enable_compile_cache(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_results", ".jax_cache"))
 
     from csed_514_project_distributed_training_using_pytorch_tpu.data import load_mnist
     from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
@@ -275,6 +272,7 @@ def main() -> int:
         attempts += 1
         this_timeout = min(attempt_timeout,
                            max(60.0, deadline - time.monotonic()))
+        abandoned_before = len(_ABANDONED)
         rc, out, err = _run_child({}, this_timeout)
         if rc == 0 and out.strip():
             payload = _parse_child_json(out)
@@ -291,10 +289,12 @@ def main() -> int:
                           if rc is None else
                           (tail[-1] if tail else f"child exited rc={rc}"))
         print(f"bench attempt {attempts} failed: {last_error}", file=sys.stderr)
-        if rc is None and _ABANDONED:
-            # Our own hung measurement child now holds (or queues on) the exclusive
-            # TPU claim; every further probe is doomed to time out against it. Skip
-            # straight to the CPU fallback instead of burning the rest of the budget.
+        if rc is None and len(_ABANDONED) > abandoned_before:
+            # THIS attempt's hung child was just abandoned and now holds (or queues
+            # on) the exclusive TPU claim; every further probe is doomed to time out
+            # against it. Skip straight to the CPU fallback instead of burning the
+            # rest of the budget. (An earlier abandoned *probe* doesn't trigger this —
+            # it may have exited by now, so later probes stay worth trying.)
             print("bench: hung attempt child abandoned; no further TPU retries "
                   "possible this run", file=sys.stderr)
             break

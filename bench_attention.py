@@ -80,7 +80,7 @@ def main() -> int:
         if s <= DENSE_MAX_S:
             try:
                 row["dense_fwdbwd_s"] = _measure(ops.full_attention, q, k, v)
-                if row["flash_fwdbwd_s"]:
+                if row["flash_fwdbwd_s"]:  # speedup needs a nonzero flash denominator
                     row["speedup_flash_vs_dense"] = round(
                         row["dense_fwdbwd_s"] / row["flash_fwdbwd_s"], 3)
             except Exception as e:  # OOM/compile failure: the dense wall, recorded

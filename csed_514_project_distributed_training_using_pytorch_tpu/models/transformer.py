@@ -45,6 +45,23 @@ _zeros_init = fnn.initializers.zeros_init()
 _ones_init = fnn.initializers.ones_init()
 
 
+def tokenize_images(x: jax.Array, seq_len: int) -> jax.Array:
+    """``[B, H, W, C]`` images → ``[B, seq_len, feat]`` pixel-chunk tokens.
+
+    Zero-pads the flat pixel stream up to ``seq_len·ceil(total/seq_len)`` so ANY
+    seq_len tokenizes (e.g. the flash kernels' 128-aligned lengths on 784-pixel
+    MNIST). Padding lands in the last tokens' trailing FEATURES — the sequence length
+    is exactly ``seq_len`` either way, so attention structure is unchanged. Shared by
+    ``TransformerClassifier`` and the pipelined stage engine
+    (``parallel.pipeline.PipelinedClassifier``), which must tokenize identically."""
+    b = x.shape[0]
+    total = x.shape[1] * x.shape[2] * x.shape[3]
+    feat = -(-total // seq_len)          # ceil: features per token
+    if total % seq_len:
+        x = jnp.pad(x.reshape(b, total), ((0, 0), (0, seq_len * feat - total)))
+    return x.reshape(b, seq_len, feat)
+
+
 class MultiHeadSelfAttention(fnn.Module):
     """Multi-head self-attention with a pluggable core.
 
@@ -198,11 +215,7 @@ class TransformerClassifier(fnn.Module):
     @fnn.compact
     def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
         if x.ndim == 4:
-            b = x.shape[0]
-            if x.shape[1] * x.shape[2] * x.shape[3] % self.seq_len:
-                raise ValueError(
-                    f"image size {x.shape[1:]} not divisible into {self.seq_len} tokens")
-            x = x.reshape(b, self.seq_len, -1)
+            x = tokenize_images(x, self.seq_len)
         b, s, f = x.shape
         if s != self.seq_len:
             raise ValueError(f"expected seq_len {self.seq_len}, got {s}")

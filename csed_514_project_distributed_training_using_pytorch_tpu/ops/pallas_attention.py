@@ -246,11 +246,33 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 def _flash_backward(res, g, *, causal: bool):
     q3, k3, v3, out, lse = res
     bh, s, d = q3.shape
-    scale = 1.0 / (d ** 0.5)
     nq = s // BLOCK
     # Δ = rowsum(dout ∘ out), reshaped to the lse layout — XLA fuses this small pass.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).reshape(bh, nq, 1, BLOCK)
+    return flash_backward_blocks(q3, k3, v3, g, lse, delta, causal=causal)
+
+
+def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool):
+    """One flash-backward pass of a query-block set against a key/value-block set,
+    given the GLOBAL softmax statistics: ``(dq, dk, dv)`` contributions.
+
+    ``q3/g: [BH, Sq, D]``, ``k3/v3: [BH, Sk, D]`` with ``Sq == Sk``; ``lse/delta:
+    [BH, Sq/BLOCK, 1, BLOCK]`` are the log-sum-exp and ``rowsum(dout ∘ out)`` of the
+    FULL attention row (all keys, not just this block set). Because
+    ``p = exp(q·kᵀ·scale − lse)`` then yields the true softmax coefficients restricted
+    to these keys, the returned contributions sum exactly over block sets — this is the
+    per-hop building block of the trainable ring-of-flash
+    (``parallel.ring_attention.ring_flash_attention``), where dk/dv ride the ring with
+    their K/V blocks. ``causal=True`` masks with LOCAL block indices, i.e. it assumes
+    q and k share a global origin — ring callers use it only for the diagonal hop."""
+    bh, s, d = q3.shape
+    if k3.shape != (bh, s, d):
+        raise ValueError(
+            f"flash_backward_blocks needs equal q/k block sets, got {q3.shape} vs "
+            f"{k3.shape}")
+    scale = 1.0 / (d ** 0.5)
+    nq = s // BLOCK
 
     def row_i(b, i, j):
         return (b, i, 0)

@@ -346,7 +346,7 @@ def probe_compiles_subprocess(batches: tuple[int, ...] = (BATCH_BLOCK,), *,
     Why a child process: a Mosaic compile cannot be cancelled in-process, and through a
     remote-compile service it can take tens of minutes or hang outright (observed on this
     image's tunnelled TPU backend) — an in-process probe would turn the opt-in
-    ``--use-fused-step`` into a trainer that never starts. The deadline
+    ``--experimental-fused-step`` into a trainer that never starts. The deadline
     (``FUSED_PROBE_TIMEOUT_SECONDS``, default 180 s **per batch size**, plus a fixed
     60 s child-startup allowance) treats slower-than-budget compiles as failures, which
     is the right verdict for a trainer that would face the same compile again for the
@@ -427,7 +427,7 @@ def make_fused_train_step(*, learning_rate: float, momentum: float,
     (``probe_compiles``, one probe per batch size in ``probe_batches`` — pass the batch
     sizes the trainer will actually step at, since Mosaic failures can be block-shape
     dependent) and, if any fails, warns and returns the standard unfused step with the
-    same hyperparameters — so ``--use-fused-step`` degrades to a working trainer instead
+    same hyperparameters — so ``--experimental-fused-step`` degrades to a working trainer instead
     of crashing.  The probe only runs where Mosaic does (TPU backend): in interpret mode
     it could only confirm what the test suite already guarantees, at the cost of an extra
     startup compile.

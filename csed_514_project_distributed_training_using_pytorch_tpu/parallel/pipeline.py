@@ -74,13 +74,19 @@ def unstack_transformer_blocks(stacked, rest) -> dict:
 
 
 def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params,
-                   microbatches: jax.Array, *, axis_name: str = "stage") -> jax.Array:
+                   microbatches: jax.Array, *, axis_name: str = "stage",
+                   batch_axis: str | None = None) -> jax.Array:
     """Run ``microbatches`` through the stage pipeline.
 
     ``stage_fn(stage_params, x) -> y`` is one stage's computation with ``y.shape ==
     x.shape`` (residual-block-shaped, as transformer blocks are). ``stacked_params`` has
     leading dim == mesh axis size; ``microbatches: [M, mb, ...]``. Returns ``[M, mb, ...]``
-    outputs, replicated.
+    outputs, replicated over the stage axis.
+
+    ``batch_axis`` ('data' in the composed trainer) additionally shards the microbatch
+    dim (dim 1) over that mesh axis: each data coordinate streams its own batch slice
+    through the same stage ring — PP × DP as one program, no cross-talk (every
+    collective here names only ``axis_name``).
     """
     num_stages = mesh.shape[axis_name]
     if jax.tree_util.tree_leaves(stacked_params)[0].shape[0] != num_stages:
@@ -89,9 +95,10 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params,
             f"{jax.tree_util.tree_leaves(stacked_params)[0].shape[0]} != mesh axis "
             f"{axis_name!r} size {num_stages}")
     num_micro = microbatches.shape[0]
+    x_spec = P(*((None, batch_axis) + (None,) * (microbatches.ndim - 2)))
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(P(axis_name), P()), out_specs=P(),
+             in_specs=(P(axis_name), x_spec), out_specs=x_spec,
              check_vma=False)
     def run(params_stacked, xs):
         # This device's stage slice ([1, ...] shard → drop the stage dim).
@@ -132,7 +139,8 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params,
 
 def make_pipelined_blocks_fn(mesh: Mesh, stage_fn: Callable, *,
                              axis_name: str = "stage",
-                             num_microbatches: int = 8) -> Callable:
+                             num_microbatches: int = 8,
+                             batch_axis: str | None = None) -> Callable:
     """Bind a mesh/microbatch count into ``f(stacked_params, x) -> y`` over a flat
     ``[B, ...]`` batch: splits B into microbatches, pipelines them, and re-flattens.
     ``B`` must divide by ``num_microbatches``."""
@@ -142,7 +150,110 @@ def make_pipelined_blocks_fn(mesh: Mesh, stage_fn: Callable, *,
         if b % num_microbatches:
             raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
         xs = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
-        ys = pipeline_apply(mesh, stage_fn, stacked_params, xs, axis_name=axis_name)
+        ys = pipeline_apply(mesh, stage_fn, stacked_params, xs, axis_name=axis_name,
+                            batch_axis=batch_axis)
         return ys.reshape(x.shape)
 
     return apply
+
+
+class PipelinedClassifier:
+    """``TransformerClassifier`` forward with the block stack streamed GPipe-style —
+    the composed trainer's ``--mesh ...,stage=K`` execution engine.
+
+    Operates on the STACKED parameter layout ``{"blocks": stacked, "rest": rest}``
+    (from ``stack_transformer_blocks``; inverse bridge restores the per-name checkpoint
+    layout, so PP checkpoints interchange with every other sharding layout). Exposes
+    flax's ``apply(variables, x, ...)`` calling convention, so ``train.step``'s
+    ``make_train_step`` / ``make_epoch_fn`` / ``make_eval_fn`` drive it unchanged.
+
+    The embed/head math intentionally mirrors ``models.transformer.
+    TransformerClassifier.__call__`` (drift is pinned by
+    ``tests/test_pipeline.py::test_pipelined_classifier_matches_model``); the per-stage
+    body reuses ``TransformerBlock`` itself, scanned over the stage's layer sub-stack
+    when ``num_layers > num_stages``. Dropout is unsupported (the composed trainer
+    validates ``dropout_rate == 0`` for stage meshes): microbatches would need
+    per-tick key threading through the ring.
+    """
+
+    def __init__(self, model, mesh: Mesh, *, axis_name: str = "stage",
+                 num_microbatches: int = 4, batch_axis: str | None = None):
+        from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer import (
+            TransformerBlock,  # lazy: models.transformer imports parallel/ at load
+        )
+
+        num_stages = mesh.shape[axis_name]
+        if model.num_layers % num_stages:
+            raise ValueError(
+                f"num_layers {model.num_layers} not divisible by stage axis "
+                f"{num_stages}")
+        if model.num_experts:
+            raise ValueError("stage pipelining of MoE blocks is unsupported")
+        self.model = model
+        self.layers_per_stage = model.num_layers // num_stages
+        self.num_stages = num_stages
+        block = TransformerBlock(
+            num_heads=model.num_heads, mlp_ratio=model.mlp_ratio,
+            dropout_rate=0.0, attention_fn=model.attention_fn,
+            causal=model.causal, dtype=model.dtype)
+
+        def stage_fn(stage_params, x):
+            # stage_params leaves: [layers_per_stage, ...] — apply in stack order.
+            def body(h, p):
+                return block.apply({"params": p}, h, True), None
+
+            h, _ = lax.scan(body, x, stage_params)
+            return h
+
+        self._blocks_fn = make_pipelined_blocks_fn(
+            mesh, stage_fn, axis_name=axis_name,
+            num_microbatches=num_microbatches, batch_axis=batch_axis)
+
+    def apply(self, variables, x, deterministic: bool = True, rngs=None,
+              mutable=None):
+        from csed_514_project_distributed_training_using_pytorch_tpu import ops
+
+        from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer import (
+            tokenize_images,
+        )
+
+        model = self.model
+        params = variables["params"]
+        rest, blocks = params["rest"], params["blocks"]
+        if x.ndim == 4:
+            x = tokenize_images(x, model.seq_len)
+        x = x.astype(model.dtype)
+
+        h = ops.dense(x, rest["embed_kernel"].astype(model.dtype),
+                      rest["embed_bias"].astype(model.dtype))
+        h = h + rest["pos_embed"].astype(model.dtype)[None]
+
+        stacked = jax.tree_util.tree_map(
+            lambda p: p.reshape((self.num_stages, self.layers_per_stage)
+                                + p.shape[1:]), blocks)
+        h = self._blocks_fn(stacked, h)
+
+        h = ops.layer_norm(h, rest["ln_f_scale"], rest["ln_f_bias"])
+        h = jnp.mean(h, axis=1)
+        logits = ops.dense(h, rest["head_kernel"].astype(model.dtype),
+                           rest["head_bias"].astype(model.dtype))
+        out = ops.log_softmax(logits.astype(jnp.float32))
+        return (out, {}) if mutable is not None else out
+
+
+def stacked_state_shardings(mesh: Mesh, state, *, axis_name: str = "stage"):
+    """``TrainState``-shaped ``NamedSharding`` tree for the stacked PP layout: every
+    ``blocks`` leaf shards its leading (layer-stack) dim over ``axis_name`` — each
+    device stores only its stage's layers — everything else replicates."""
+    from jax.sharding import NamedSharding
+
+    stage_sh = NamedSharding(mesh, P(axis_name))
+    rep = NamedSharding(mesh, P())
+
+    def tree_sh(tree):
+        return {"blocks": jax.tree_util.tree_map(lambda _: stage_sh, tree["blocks"]),
+                "rest": jax.tree_util.tree_map(lambda _: rep, tree["rest"])}
+
+    import csed_514_project_distributed_training_using_pytorch_tpu.train.step as _step
+    return _step.TrainState(params=tree_sh(state.params),
+                            velocity=tree_sh(state.velocity), step=rep)

@@ -26,6 +26,7 @@ No backend strings, no explicit sends: the collective schedule is the compiler's
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 
 import jax
@@ -107,20 +108,39 @@ def _ring_attention_local(ql: jax.Array, kl: jax.Array, vl: jax.Array, *,
     return out.astype(ql.dtype)
 
 
+def _qkv_spec(mesh: Mesh, shape: tuple, axis_name: str) -> P:
+    """shard_map partition spec for a ``[B, S, H, D]`` operand on a composed mesh.
+
+    The sequence dim always shards over ``axis_name``; the batch dim additionally
+    shards over ``data`` and the head dim over ``model`` whenever those axes exist in
+    the mesh and divide the corresponding dimension — attention is independent per
+    batch element and per head, so the ring body is unchanged and each (data, model)
+    coordinate works only its own slice instead of redundantly recomputing the full
+    batch/all heads (the replication cost flagged in the round-2 advisor review)."""
+    b, _, h, _ = shape
+
+    def axis_if(name: str, dim: int):
+        size = mesh.shape.get(name, 1)
+        return name if (name != axis_name and size > 1 and dim % size == 0) else None
+
+    return P(axis_if("data", b), axis_name, axis_if("model", h), None)
+
+
 def ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
                    axis_name: str = "seq", causal: bool = False) -> jax.Array:
     """Sequence-parallel attention: ``[B, S, H, D]`` with S sharded over ``axis_name``.
 
     Drop-in equivalent of ``ops.full_attention`` (same signature modulo the mesh);
     callable under ``jax.jit`` (the mesh is static). The sequence length must divide by
-    the mesh axis size.
+    the mesh axis size. On a composed mesh the batch/head dims co-shard over the
+    ``data``/``model`` axes (see ``_qkv_spec``).
     """
     n = mesh.shape[axis_name]
     if q.shape[1] % n:
         raise ValueError(
             f"sequence length {q.shape[1]} not divisible by mesh axis "
             f"{axis_name!r} size {n} — ring attention shards the sequence evenly")
-    spec = P(None, axis_name, None, None)
+    spec = _qkv_spec(mesh, q.shape, axis_name)
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
              check_vma=False)
@@ -131,19 +151,155 @@ def ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
     return _ring(q, k, v)
 
 
-def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq"):
+def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq",
+                           use_flash: bool = False):
     """Bind a mesh into a ``(q, k, v, *, causal) -> out`` callable with
     ``ops.full_attention``'s exact signature — the injection point for
-    ``models/transformer.py``'s pluggable ``attention_fn``."""
+    ``models/transformer.py``'s pluggable ``attention_fn``.
+
+    ``use_flash=True`` routes every hop's block math through the Pallas flash kernels
+    (``ring_flash_attention`` — trainable, causal-capable); the per-device sequence
+    shard must then divide by the flash ``BLOCK`` (128)."""
 
     def attention_fn(q, k, v, *, causal: bool = False):
+        if use_flash:
+            return ring_flash_attention(mesh, q, k, v, axis_name=axis_name,
+                                        causal=causal)
         return ring_attention(mesh, q, k, v, axis_name=axis_name, causal=causal)
 
     return attention_fn
 
 
+@functools.lru_cache(maxsize=None)
+def _make_ring_flash_op(axis_name: str, n: int, causal: bool):
+    """Per-device ring-of-flash op on kernel-layout operands ``[BH, S/n, D]`` (f32),
+    with a custom VJP so the composition TRAINS.
+
+    Causal structure: because shards are equal-sized and K/V blocks arrive whole, every
+    hop's block is (relative to the local queries) entirely in the past, on the
+    diagonal, or entirely in the future — so per hop a ``lax.switch`` picks the
+    non-causal flash kernel, the causal flash kernel, or skips the block outright
+    (future hops cost no kernel launch; their fetch already rode the ring). No
+    per-offset masks enter the kernels. The naive ring order leaves device i with
+    ``i+1`` live hops of ``n`` — the inherent load imbalance of causal ring attention
+    (a zig-zag block schedule would level it; not implemented).
+
+    Backward: the saved residuals are the inputs plus the MERGED ``(out, lse)`` only —
+    O(S·D) per device, no score matrix. Each reverse hop recomputes the block's softmax
+    coefficients from the GLOBAL lse via ``ops.pallas_attention.flash_backward_blocks``
+    (``p = exp(q·kᵀ·scale − lse)`` restricted to the block is exactly the true
+    coefficient set), accumulates dq locally, and accumulates dk/dv into buffers that
+    RIDE THE RING with their K/V blocks; after the last hop one extra ppermute delivers
+    every dk/dv block back to its home device.
+    """
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
+        pallas_attention as pa,
+    )
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def rot(x):
+        return lax.ppermute(x, axis_name, perm)
+
+    def _case_index(origin, my_index):
+        # 0 = future (skip), 1 = past (non-causal flash), 2 = diagonal (causal flash)
+        return jnp.where(origin == my_index, 2,
+                         jnp.where(origin < my_index, 1, 0))
+
+    def _forward(q3, k3, v3):
+        bh, sq, d = q3.shape
+        nq = sq // pa.BLOCK
+        my_index = lax.axis_index(axis_name)
+
+        def fold(carry, k_blk, v_blk, origin):
+            acc, m, l = carry
+
+            def apply(flag):
+                def f(args):
+                    acc, m, l, kb, vb = args
+                    out3, lse = pa.flash_forward_with_lse(q3, kb, vb, causal=flag)
+                    lse_rows = jnp.transpose(lse, (0, 1, 3, 2)).reshape(bh, sq, 1)
+                    m_new = jnp.maximum(m, lse_rows)
+                    corr = jnp.exp(m - m_new)
+                    w = jnp.exp(lse_rows - m_new)
+                    return acc * corr + out3 * w, m_new, l * corr + w
+                return f
+
+            args = (acc, m, l, k_blk, v_blk)
+            if not causal:
+                return apply(False)(args)
+            return lax.switch(_case_index(origin, my_index),
+                              [lambda a: a[:3], apply(False), apply(True)], args)
+
+        def hop(carry, t):
+            acc, m, l, k_cur, v_cur = carry
+            acc, m, l = fold((acc, m, l), k_cur, v_cur, (my_index - t) % n)
+            return (acc, m, l, rot(k_cur), rot(v_cur)), None
+
+        acc0 = jnp.zeros((bh, sq, d), jnp.float32)
+        m0 = jnp.full((bh, sq, 1), MASK_VALUE, jnp.float32)
+        l0 = jnp.zeros((bh, sq, 1), jnp.float32)
+        # n-1 permuting hops, then fold the last arriving block without rotating —
+        # no discarded collective (same structure as _ring_attention_local above).
+        (acc, m, l, k_last, v_last), _ = lax.scan(
+            hop, (acc0, m0, l0, k3, v3), jnp.arange(n - 1))
+        acc, m, l = fold((acc, m, l), k_last, v_last,
+                         (my_index - (n - 1)) % n)
+        # Under causal masking the diagonal hop gives every query at least itself, so
+        # l > 0; the guard only protects pathological all-masked rows.
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        out3 = acc / l_safe
+        lse4 = (m + jnp.log(l_safe)).reshape(bh, nq, pa.BLOCK)[:, :, None, :]
+        return out3, lse4
+
+    @jax.custom_vjp
+    def op(q3, k3, v3):
+        return _forward(q3, k3, v3)[0]
+
+    def fwd(q3, k3, v3):
+        out3, lse4 = _forward(q3, k3, v3)
+        return out3, (q3, k3, v3, out3, lse4)
+
+    def bwd(res, g):
+        q3, k3, v3, out3, lse4 = res
+        bh, sq, d = q3.shape
+        nq = sq // pa.BLOCK
+        my_index = lax.axis_index(axis_name)
+        g = g.astype(jnp.float32)
+        # Δ = rowsum(dout ∘ out) over the FULL row — constant across hops, in the
+        # kernels' [BH, nq, 1, BLOCK] statistics layout.
+        delta4 = jnp.sum(g * out3, axis=-1).reshape(bh, nq, pa.BLOCK)[:, :, None, :]
+
+        def contrib(k_blk, v_blk, origin):
+            args = (q3, k_blk, v_blk, g, lse4, delta4)
+            if not causal:
+                return pa.flash_backward_blocks(*args, causal=False)
+            return lax.switch(
+                _case_index(origin, my_index),
+                [lambda a: (jnp.zeros_like(q3), jnp.zeros_like(a[1]),
+                            jnp.zeros_like(a[2])),
+                 lambda a: pa.flash_backward_blocks(*a, causal=False),
+                 lambda a: pa.flash_backward_blocks(*a, causal=True)], args)
+
+        def hop(carry, t):
+            dq, dk_cur, dv_cur, k_cur, v_cur = carry
+            dq_h, dk_h, dv_h = contrib(k_cur, v_cur, (my_index - t) % n)
+            # dk/dv accumulators travel WITH their K/V blocks around the ring.
+            return (dq + dq_h, rot(dk_cur + dk_h), rot(dv_cur + dv_h),
+                    rot(k_cur), rot(v_cur)), None
+
+        init = (jnp.zeros_like(q3), jnp.zeros_like(k3), jnp.zeros_like(v3), k3, v3)
+        (dq, dk_t, dv_t, k_last, v_last), _ = lax.scan(hop, init, jnp.arange(n - 1))
+        dq_h, dk_h, dv_h = contrib(k_last, v_last, (my_index - (n - 1)) % n)
+        # After n-1 rotations the accumulators sit one hop short of home.
+        return dq + dq_h, rot(dk_t + dk_h), rot(dv_t + dv_h)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
 def ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
-                         axis_name: str = "seq") -> jax.Array:
+                         axis_name: str = "seq", causal: bool = False) -> jax.Array:
     """Ring-of-flash: sequence-parallel attention whose per-hop block math runs through
     the Pallas flash kernels (``ops/pallas_attention.py``) instead of dense einsums.
 
@@ -156,12 +312,15 @@ def ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *
         lse = logsumexp_t(lse_t),   out = Σ_t exp(lse_t − lse) · out_t
 
     which is exact (pinned against the dense oracle in ``tests/test_ring_attention.py``).
-    Bidirectional (non-causal) attention — the encoder/classifier case; causal ring
-    attention uses the einsum formulation above, whose masking works from global
-    positions. Per-device sequence shard must divide by the flash BLOCK (128), so
-    ``S % (shards · 128) == 0``. Forward/serving path: the flash kernels' AD lives in
-    their custom VJP (``flash_attention``), which this bypasses to reach the lse rows —
-    train with ``ring_attention`` or single-chip ``flash_attention``.
+
+    Trainable AND causal (round-3; previously forward-only, non-causal): gradients flow
+    through a custom VJP whose reverse pass runs the flash backward kernels per hop with
+    the merged global softmax statistics, dk/dv riding the ring home with their blocks —
+    see ``_make_ring_flash_op``. Causal masking decomposes per hop into
+    past/diagonal/future cases (non-causal kernel / causal kernel / skipped), so decoder
+    training composes with sequence parallelism. Per-device sequence shard must divide
+    by the flash BLOCK (128), i.e. ``S % (shards · 128) == 0``. On a composed mesh the
+    batch/head dims co-shard over ``data``/``model`` (``_qkv_spec``).
     """
     from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
         pallas_attention as pa,
@@ -173,47 +332,22 @@ def ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *
         raise ValueError(
             f"ring_flash_attention needs sequence length divisible by "
             f"shards·BLOCK = {n}·{pa.BLOCK}, got {s}")
-    spec = P(None, axis_name, None, None)
+    spec = _qkv_spec(mesh, q.shape, axis_name)
+    op = _make_ring_flash_op(axis_name, n, bool(causal))
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
              check_vma=False)
     def _ring(ql, kl, vl):
-        bq = ql.shape[1]                                  # local shard = S/n
-        to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, bq, d)
+        lb, ls, lh, ld = ql.shape
         # Convert to the kernel layout ONCE and promote to f32 at entry: the kernel
         # emits its output in the input dtype, and merging n bf16-rounded partials
         # would lose precision the f32 merge math cannot recover. K/V ride the ring in
-        # 3-D form (ppermute is shape-agnostic) — no per-hop relayout.
-        q3 = to3(ql).astype(jnp.float32)
-        perm = [(j, (j + 1) % n) for j in range(n)]
-
-        def merge(carry, k_blk, v_blk):
-            acc, m, l = carry
-            out3, lse = pa.flash_forward_with_lse(q3, k_blk, v_blk)
-            # lse: [BH, nq, 1, BLOCK] → per-query-row [BH, bq, 1]
-            lse_rows = jnp.transpose(lse, (0, 1, 3, 2)).reshape(b * h, bq, 1)
-            m_new = jnp.maximum(m, lse_rows)
-            corr = jnp.exp(m - m_new)
-            w = jnp.exp(lse_rows - m_new)
-            return acc * corr + out3 * w, m_new, l * corr + w
-
-        def hop(carry, _):
-            acc, m, l, k_cur, v_cur = carry
-            acc, m, l = merge((acc, m, l), k_cur, v_cur)
-            k_next = lax.ppermute(k_cur, axis_name, perm)
-            v_next = lax.ppermute(v_cur, axis_name, perm)
-            return (acc, m, l, k_next, v_next), None
-
-        acc0 = jnp.zeros((b * h, bq, d), jnp.float32)
-        m0 = jnp.full((b * h, bq, 1), MASK_VALUE, jnp.float32)
-        l0 = jnp.zeros((b * h, bq, 1), jnp.float32)
-        # n-1 permuting hops, then fold the last arriving block without rotating —
-        # no discarded collective (same structure as _ring_attention_local above).
-        (acc, m, l, k_last, v_last), _ = lax.scan(
-            hop, (acc0, m0, l0, to3(kl).astype(jnp.float32),
-                  to3(vl).astype(jnp.float32)), None, length=n - 1)
-        acc, _, l = merge((acc, m, l), k_last, v_last)
-        out3 = (acc / jnp.where(l == 0.0, 1.0, l)).astype(ql.dtype)
-        return jnp.transpose(out3.reshape(b, h, bq, d), (0, 2, 1, 3))
+        # 3-D form (ppermute is shape-agnostic) — no per-hop relayout. Local (not
+        # global) b/h sizes: the batch/head dims may be sharded over data/model.
+        to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(
+            lb * lh, ls, ld).astype(jnp.float32)
+        out3 = op(to3(ql), to3(kl), to3(vl))
+        return jnp.transpose(out3.reshape(lb, lh, ls, ld),
+                             (0, 2, 1, 3)).astype(ql.dtype)
 
     return _ring(q, k, v)

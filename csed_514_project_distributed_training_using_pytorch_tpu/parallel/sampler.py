@@ -14,8 +14,9 @@ shuffle=True, seed=42)`` re-seeded per epoch via ``sampler.set_epoch(i)`` (refer
 Consequences preserved: per-epoch per-replica shards are disjoint, cover the dataset, change
 every epoch, and are computable independently on every host (a pure function — the TPU-friendly
 property, since there is no sampler object state to synchronize). The permutation itself comes
-from JAX's threefry PRNG rather than torch's MT19937, so index *sequences* differ from the
-reference while the contract is identical.
+from numpy's PCG64 (``np.random.default_rng`` seeded with ``SeedSequence([seed, epoch])``)
+rather than torch's MT19937, so index *sequences* differ from the reference while the
+contract is identical.
 """
 
 from __future__ import annotations

@@ -118,6 +118,32 @@ def shard_train_state(mesh: Mesh, state: TrainState, *,
     return jax.device_put(state, state_shardings(mesh, state, axis_name=axis_name))
 
 
+def compile_epoch_tp(epoch_fn: Callable, mesh: Mesh, *, data_axis: str = "data",
+                     model_axis: str = "model") -> Callable:
+    """Compile ``epoch(state, images, labels, idx_matrix, rng)`` under composed
+    shardings: weights over ``model_axis``, the ``[steps, batch]`` index plan's batch
+    dim over ``data_axis``, the dataset replicated — ``data_parallel.compile_epoch``'s
+    whole-epoch scanned program generalized to a TP/composed mesh (the composed
+    trainer's hot path; per-step Python dispatch dominates at this model size,
+    SURVEY.md §7e)."""
+    compiled = {}
+
+    def wrapper(state, images, labels, idx_matrix, rng):
+        key = jax.tree_util.tree_structure(state)
+        if key not in compiled:
+            state_sh = state_shardings(mesh, state, axis_name=model_axis)
+            rep = replicated(mesh)
+            idx_sh = (NamedSharding(mesh, P(None, data_axis)) if data_axis else rep)
+            compiled[key] = jax.jit(
+                epoch_fn,
+                in_shardings=(state_sh, rep, rep, idx_sh, rep),
+                out_shardings=(state_sh, rep),
+                donate_argnums=(0,))
+        return compiled[key](state, images, labels, idx_matrix, rng)
+
+    return wrapper
+
+
 def compile_step_tp(step_fn: Callable, mesh: Mesh, *, data_axis: str = "data",
                     model_axis: str = "model") -> Callable:
     """Compile ``step(state, images, labels, rng)`` with weights sharded over

@@ -62,9 +62,9 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     if config.grad_accum > 1 and config.batch_size_train % config.grad_accum:
         raise ValueError(f"batch_size_train {config.batch_size_train} not divisible "
                          f"by grad_accum {config.grad_accum}")
-    if config.use_fused_step and (config.model != "cnn" or config.bf16
+    if config.experimental_fused_step and (config.model != "cnn" or config.bf16
                                   or config.grad_accum > 1):
-        raise ValueError("--use-fused-step is specialized to the flagship CNN's f32 "
+        raise ValueError("--experimental-fused-step is specialized to the flagship CNN's f32 "
                          "single-microbatch step (ops/pallas_fused.py); drop it, or "
                          "use --model cnn without --bf16/--grad-accum")
 
@@ -80,7 +80,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     # (see probe_compiles_subprocess). Probe every batch size this run will step at (main
     # batches + the drop_last=False tail) — Mosaic failures can be block-shape dependent.
     fused_probe_result = None
-    if config.use_fused_step:
+    if config.experimental_fused_step:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_fused import (
             probe_compiles_subprocess,
         )
@@ -109,7 +109,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     train_x, train_y = jnp.asarray(train_ds.images), jnp.asarray(train_ds.labels)
     test_x, test_y = jnp.asarray(test_ds.images), jnp.asarray(test_ds.labels)
 
-    if config.use_fused_step:
+    if config.experimental_fused_step:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_fused import (
             make_fused_train_step,
         )
@@ -142,7 +142,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
             donate_argnums=(0,))
     # The final partial batch (drop_last=False) is ragged and need not divide by
     # grad_accum; accumulation is a memory knob, so the tail just steps unaccumulated.
-    if config.use_fused_step or config.grad_accum == 1:
+    if config.experimental_fused_step or config.grad_accum == 1:
         tail_step_fn = step_fn
     else:
         tail_step_fn = jax.jit(

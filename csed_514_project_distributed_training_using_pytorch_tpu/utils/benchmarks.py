@@ -70,6 +70,27 @@ PEAK_FLOPS_BY_KIND = [
 ]
 
 
+def enable_compile_cache(default_dir: str) -> None:
+    """Enable jax's persistent compilation cache (best-effort; never a failure mode).
+
+    Shared by the bench entry points (bench.py, bench_transformer.py): once any
+    hardware window has primed the cache, a later successful chip claim costs seconds
+    instead of a full XLA compile that can eat most of a bench attempt's deadline.
+    ``JAX_COMPILATION_CACHE_DIR`` overrides ``default_dir``."""
+    import os
+    import sys
+
+    import jax as _jax
+
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or default_dir
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as exc:
+        print(f"benchmarks: compilation cache disabled: {exc}", file=sys.stderr)
+
+
 def peak_flops(device_kind: str) -> float | None:
     """bf16 peak FLOP/s for a TPU ``device_kind`` string, or None if unknown."""
     kind = device_kind.lower()

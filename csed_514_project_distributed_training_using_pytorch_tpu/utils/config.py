@@ -49,9 +49,16 @@ class SingleProcessConfig:
                                       # (O(1)-blocks activation memory; transformer only)
     use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
                                       # (ops/pallas_kernels.py; single-device step path)
-    use_fused_step: bool = False      # run the ENTIRE train step (fwd+bwd+update) through
-                                      # the whole-model Pallas kernel (ops/pallas_fused.py;
-                                      # single-device path, flagship model only)
+    experimental_fused_step: bool = False
+                                      # EXPERIMENTAL (off the documented surface): run the
+                                      # ENTIRE train step (fwd+bwd+update) through the
+                                      # whole-model Pallas kernel (ops/pallas_fused.py;
+                                      # single-device path, flagship model only). Every
+                                      # construct lowers through Mosaic on v5e, but the
+                                      # full-kernel compile has exceeded 30-min deadlines
+                                      # on tunnelled hardware; a startup compile probe in a
+                                      # child interpreter gates it and falls back to the
+                                      # unfused step on timeout/rejection (SETUP.md §5).
     use_host_pipeline: bool = False   # feed batches through the native C++ threaded
                                       # prefetcher (the DataLoader num_workers=4 analog,
                                       # src/train_dist.py:43-45) instead of the device-
@@ -121,8 +128,17 @@ class ComposedConfig:
 
     mesh: str = "data=2,seq=2,model=2"  # named axes: data (DP), seq (ring attention),
                                         # model (Megatron TP); product = device count
-    seq_len: int = 16                   # tokens per image (784 must divide by it; a seq
-                                        # mesh axis must divide it)
+    seq_len: int = 16                   # tokens per image (a seq mesh axis must divide
+                                        # it; indivisible 784/seq_len zero-pads the
+                                        # pixel stream — see TransformerClassifier)
+    flash_attention: bool = False       # route attention through the Pallas flash
+                                        # kernels: ring-of-flash when a seq axis > 1 is
+                                        # present, single-chip flash otherwise. Needs
+                                        # seq_len % (seq_axis_size * 128) == 0.
+    pipeline_microbatches: int = 4      # GPipe microbatches per step under a stage
+                                        # axis (bubble fraction (S-1)/(M+S-1));
+                                        # batch_size must divide by it, and the
+                                        # microbatch by the data axis
     epochs: int = 2
     batch_size: int = 64
     batch_size_test: int = 1000

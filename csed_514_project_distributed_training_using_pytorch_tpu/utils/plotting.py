@@ -101,10 +101,11 @@ def save_attention_curve(rows: list[dict], path: str) -> str | None:
     if not (HAVE_MATPLOTLIB and is_logging_process()):
         return None
     _ensure_dir(path)
+    # 'is not None', not truthiness: a legitimate 0.0-second timing must plot.
     flash_pts = [(r["seq_len"], r["flash_fwdbwd_s"]) for r in rows
-                 if r.get("flash_fwdbwd_s")]
+                 if r.get("flash_fwdbwd_s") is not None]
     dense_pts = [(r["seq_len"], r["dense_fwdbwd_s"]) for r in rows
-                 if r.get("dense_fwdbwd_s")]
+                 if r.get("dense_fwdbwd_s") is not None]
     fig = plt.figure()
     plt.plot([s for s, _ in flash_pts], [f for _, f in flash_pts],
              marker="o", label="flash (Pallas, O(S·D) HBM)")

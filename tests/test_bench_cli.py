@@ -1,7 +1,8 @@
 """bench.py parent-loop contract (r1 verdict item 1: the round's perf artifact must
-survive transient backend failures). The child measurement is faked at the
-``_run_child`` seam so every branch — retry, success, labeled CPU fallback, structured
-final error — is pinned without real TPU (or even real child) processes."""
+survive transient backend failures; r2 item 1: probe-first attempts + embedded hardware
+capture). The child measurement is faked at the ``_run_child``/``_probe_chip`` seams so
+every branch — probe gating, retry, success, labeled CPU fallback, structured final
+error — is pinned without real TPU (or even real child) processes."""
 
 import importlib.util
 import json
@@ -24,20 +25,28 @@ def bench(monkeypatch):
     monkeypatch.setattr(mod, "time", types.SimpleNamespace(
         sleep=lambda s: None, monotonic=time.monotonic))
     # Budget large enough that a CI-VM pause between attempts can't flip the control
-    # flow into the fallback path (sleeps are no-ops, so tests never actually wait);
-    # zero-budget tests override this.
+    # flow into the fallback path (sleeps are no-ops, so tests never actually wait).
     monkeypatch.setenv("BENCH_TPU_RETRY_SECONDS", "100000")
     monkeypatch.setenv("BENCH_ATTEMPT_TIMEOUT_SECONDS", "60")
     return mod
 
 
+def _chip_alive(monkeypatch, bench):
+    monkeypatch.setattr(bench, "_probe_chip", lambda t: ("tpu", "tpu x1"))
+
+
 def _scripted(monkeypatch, bench, script):
-    """Replace _run_child with a scripted sequence; record each call's env overrides."""
+    """Replace _run_child with a scripted sequence; record each call's env overrides.
+    A scripted rc=None also marks the child abandoned, mirroring the real
+    grace-expired path."""
     calls = []
 
-    def fake(env_overrides, timeout_s):
+    def fake(env_overrides, timeout_s, argv=None):
         calls.append(env_overrides)
-        return script.pop(0)
+        rc, out, err = script.pop(0)
+        if rc is None:
+            bench._ABANDONED.append(object())
+        return rc, out, err
 
     monkeypatch.setattr(bench, "_run_child", fake)
     return calls
@@ -46,6 +55,7 @@ def _scripted(monkeypatch, bench, script):
 def test_transient_failure_then_success(bench, monkeypatch, capsys):
     """The exact r1 failure (one UNAVAILABLE init error) must cost one retry, not the
     round's perf number."""
+    _chip_alive(monkeypatch, bench)
     good = json.dumps({"metric": "m", "value": 1.5, "unit": "s"})
     _scripted(monkeypatch, bench, [
         (1, "", "RuntimeError: Unable to initialize backend 'axon': UNAVAILABLE"),
@@ -54,18 +64,19 @@ def test_transient_failure_then_success(bench, monkeypatch, capsys):
     assert bench.main() == 0
     payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert payload["value"] == 1.5 and payload["attempts"] == 2
+    assert payload["probes"] == 2                 # one probe gated each attempt
     assert "fallback_reason" not in payload
 
 
-def test_timeout_counts_as_failure_then_fallback(bench, monkeypatch, capsys):
-    """A hung child (rc=None) burns the budget; the CPU fallback must then run with
-    JAX_PLATFORMS=cpu and without the TPU-plugin sitecustomize on PYTHONPATH, and its
-    result must be labeled with the TPU failure."""
-    monkeypatch.setenv("BENCH_TPU_RETRY_SECONDS", "0")       # one attempt, then fallback
+def test_hung_attempt_goes_straight_to_fallback(bench, monkeypatch, capsys):
+    """A hung measurement child is abandoned still holding (or queued on) the exclusive
+    TPU claim, so no further probe can succeed — the loop must skip the rest of the
+    budget and run the CPU fallback (labeled, clean env) immediately."""
     monkeypatch.setenv("PYTHONPATH", "/keep/me:/root/.axon_site/x")
+    _chip_alive(monkeypatch, bench)
     good = json.dumps({"metric": "m", "value": 9.0, "unit": "s", "platform": "cpu"})
     calls = _scripted(monkeypatch, bench, [
-        (None, "", ""),                                      # hung attempt
+        (None, "", ""),                                      # hung attempt → abandoned
         (0, good + "\n", ""),                                # CPU fallback child
     ])
     assert bench.main() == 0
@@ -78,12 +89,29 @@ def test_timeout_counts_as_failure_then_fallback(bench, monkeypatch, capsys):
     assert "axon_site" not in calls[1]["PYTHONPATH"]
 
 
+def test_non_tpu_backend_skips_retries_and_embeds_capture(bench, monkeypatch, capsys):
+    """A probe that reaches a healthy non-TPU backend is a deterministic condition:
+    ONE probe, zero attempts, straight to the labeled fallback — and the fallback
+    payload must embed the newest committed hardware capture (r2 verdict item 1c)."""
+    monkeypatch.setattr(bench, "_probe_chip",
+                        lambda t: ("other", "backend is 'cpu', not tpu"))
+    good = json.dumps({"metric": "m", "value": 9.0, "unit": "s", "platform": "cpu"})
+    _scripted(monkeypatch, bench, [(0, good + "\n", "")])    # only the fallback runs
+    assert bench.main() == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["probes"] == 1 and payload["attempts"] == 0
+    assert "not tpu" in payload["fallback_reason"]
+    capture = payload["last_hardware_capture"]               # real committed artifact
+    assert capture["payload"]["platform"] == "tpu"
+    assert capture["file"].startswith("bench_results/")
+
+
 def test_total_failure_emits_structured_error(bench, monkeypatch, capsys):
     """Even with every child dead, stdout must carry ONE parseable JSON line (r1:
     BENCH_r01.json was a stack trace with rc=1 and nothing parseable)."""
-    monkeypatch.setenv("BENCH_TPU_RETRY_SECONDS", "0")
+    monkeypatch.setattr(bench, "_probe_chip",
+                        lambda t: ("other", "backend is 'cpu', not tpu"))
     _scripted(monkeypatch, bench, [
-        (1, "", "boom"),
         (1, "", "cpu fallback also broken"),
     ])
     assert bench.main() == 1
@@ -95,6 +123,7 @@ def test_total_failure_emits_structured_error(bench, monkeypatch, capsys):
 def test_unparseable_child_stdout_is_retried(bench, monkeypatch, capsys):
     """rc=0 with garbage stdout (a child that printed warnings over the JSON) must not
     be accepted as a measurement."""
+    _chip_alive(monkeypatch, bench)
     good = json.dumps({"metric": "m", "value": 2.0, "unit": "s"})
     _scripted(monkeypatch, bench, [
         (0, "not json at all\n", ""),
@@ -103,3 +132,25 @@ def test_unparseable_child_stdout_is_retried(bench, monkeypatch, capsys):
     assert bench.main() == 0
     payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert payload["value"] == 2.0 and payload["attempts"] == 2
+
+
+def test_wedged_probe_burns_probes_not_attempts(bench, monkeypatch, capsys):
+    """A wedged chip claim (probe timeouts) must never commit a measurement attempt;
+    on budget exhaustion the fallback runs with the probe failure as the reason."""
+    monkeypatch.setenv("BENCH_TPU_RETRY_SECONDS", "0.2")     # a few real-clock probes
+    monkeypatch.setattr(
+        bench, "_probe_chip",
+        lambda t: ("retry", "probe timed out after 90s (claim likely wedged)"))
+    good = json.dumps({"metric": "m", "value": 9.0, "unit": "s", "platform": "cpu"})
+    _scripted(monkeypatch, bench, [(0, good + "\n", "")])
+    assert bench.main() == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["attempts"] == 0 and payload["probes"] >= 1
+    assert "wedged" in payload["fallback_reason"]
+
+
+def test_latest_hardware_capture_prefers_highest_round_best(bench):
+    cap = bench._latest_hardware_capture()
+    assert cap is not None
+    assert "best" in cap["file"] or "tpu" in cap["file"]
+    assert cap["payload"]["platform"] == "tpu"

@@ -163,7 +163,7 @@ def test_epoch_trajectory_pinned_to_unfused(setup):
 
 
 def test_trainer_with_fused_step_trains(tmp_path):
-    """End-to-end single trainer with --use-fused-step: the whole-model kernel drives real
+    """End-to-end single trainer with --experimental-fused-step: the whole-model kernel drives real
     epochs and the loss drops on a learnable task.  Settings (lr=0.1, 4 epochs) are chosen
     so the UNFUSED trainer also clears the same threshold under dropout — r1's version
     failed on settings where neither path learned fast enough, which said nothing about
@@ -183,7 +183,7 @@ def test_trainer_with_fused_step_trains(tmp_path):
 
     cfg = SingleProcessConfig(
         n_epochs=4, batch_size_train=64, batch_size_test=100,
-        learning_rate=0.1, log_interval=8, use_fused_step=True,
+        learning_rate=0.1, log_interval=8, experimental_fused_step=True,
         results_dir=str(tmp_path / "results"), images_dir=str(tmp_path / "images"))
     state, history = single.main(cfg, datasets=(train, test))
     assert int(state.step) == 4 * 16
@@ -239,7 +239,7 @@ def test_subprocess_probe_spawns_child_when_platform_unconfigured(monkeypatch):
 def test_subprocess_probe_timeout_is_a_failure(monkeypatch):
     """A compile slower than the deadline (or a child blocked on a parent-held chip
     claim) must come back as an exception, not a hang — this is the property that keeps
-    --use-fused-step from wedging a trainer at startup."""
+    --experimental-fused-step from wedging a trainer at startup."""
     monkeypatch.setattr(pf, "_configured_platform", lambda: "")
     monkeypatch.setattr(pf, "_PROBE_STARTUP_ALLOWANCE_S", 0.0)
     monkeypatch.setenv("FUSED_PROBE_TEST_SLEEP", "30")

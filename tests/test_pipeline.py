@@ -143,6 +143,43 @@ def test_transformer_checkpoint_bridges_to_pipeline(mesh):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_pipelined_classifier_matches_model(mesh):
+    """``PipelinedClassifier`` (the composed trainer's stage engine) computes exactly
+    ``TransformerClassifier.apply`` on the bridged stacked layout — including the
+    embed/head math it mirrors and multi-layer-per-stage sub-stacks."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        TransformerClassifier,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state,
+    )
+
+    # 2·NUM_STAGES layers → 2 layers per stage (exercises the sub-stack scan).
+    model = TransformerClassifier(num_layers=2 * NUM_STAGES, dropout_rate=0.0)
+    params = create_train_state(model, jax.random.PRNGKey(5)).params
+    stacked, rest = pp.stack_transformer_blocks(params, model.num_layers)
+    engine = pp.PipelinedClassifier(model, mesh, num_microbatches=4)
+
+    images = jnp.asarray(
+        np.random.default_rng(6).normal(size=(8, 28, 28, 1)).astype(np.float32))
+    ref = model.apply({"params": params}, images)
+    out = engine.apply({"params": {"blocks": stacked, "rest": rest}}, images)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipelined_classifier_guards(mesh):
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        TransformerClassifier,
+    )
+
+    with pytest.raises(ValueError, match="not divisible by stage axis"):
+        pp.PipelinedClassifier(TransformerClassifier(num_layers=NUM_STAGES + 1), mesh)
+    with pytest.raises(ValueError, match="MoE"):
+        pp.PipelinedClassifier(
+            TransformerClassifier(num_layers=NUM_STAGES, num_experts=2), mesh)
+
+
 def test_stack_transformer_blocks_missing_block_rejected():
     with pytest.raises(ValueError, match="lacks block"):
         pp.stack_transformer_blocks({"block_0": {}, "embed_kernel": 1}, 2)

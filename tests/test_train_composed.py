@@ -33,8 +33,9 @@ def test_parse_mesh_spec():
         ("data", "seq", "model"), (2, 2, 2))
     assert composed.parse_mesh_spec("data=8") == (("data",), (8,))
     assert composed.parse_mesh_spec("data=2,expert=4") == (("data", "expert"), (2, 4))
+    assert composed.parse_mesh_spec("data=2,stage=2") == (("data", "stage"), (2, 2))
     with pytest.raises(ValueError, match="unknown mesh axis"):
-        composed.parse_mesh_spec("stage=8")
+        composed.parse_mesh_spec("rank=8")
     with pytest.raises(ValueError, match="name=size"):
         composed.parse_mesh_spec("data")
     with pytest.raises(ValueError, match="duplicate"):
@@ -104,6 +105,80 @@ def test_batch_larger_than_split_rejected(tiny_datasets):
         composed.main(
             ComposedConfig(mesh="data=8", batch_size=2048, results_dir=""),
             datasets=tiny_datasets)
+
+
+def test_flash_attention_mesh_invariant(tmp_path, tiny_datasets):
+    """--flash-attention with a seq axis trains through the ring-of-flash custom VJP
+    (flash kernels on every hop) and reproduces the dense-attention trajectory — the
+    r2 verdict's 'composed --mesh data=2,seq=2 run matching the dense oracle'. seq_len
+    256 exercises the zero-padded 784-pixel tokenization (256·4 ≥ 784)."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100, seq_len=256,
+                  max_train_examples=256)
+    state_f, hist_f = composed.main(
+        ComposedConfig(mesh="data=2,seq=2", flash_attention=True,
+                       results_dir=str(tmp_path / "flash"), **common),
+        datasets=tiny_datasets)
+    state_d, hist_d = composed.main(
+        ComposedConfig(mesh="data=4", results_dir=str(tmp_path / "dense"), **common),
+        datasets=tiny_datasets)
+    np.testing.assert_allclose(hist_f.train_losses, hist_d.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(state_f.params["pos_embed"]),
+                               np.asarray(state_d.params["pos_embed"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_flash_attention_seq_len_guard(tiny_datasets):
+    with pytest.raises(ValueError, match="flash-attention needs seq_len"):
+        composed.main(ComposedConfig(mesh="data=2,seq=2", flash_attention=True,
+                                     seq_len=16, results_dir=""),
+                      datasets=tiny_datasets)
+
+
+def test_stage_axis_trains_and_matches_dp(tmp_path, tiny_datasets):
+    """--mesh data=2,stage=2 (r3: PP now CLI-reachable) trains the block stack
+    GPipe-style in the stacked layout and reproduces the plain-DP trajectory; the
+    final state/checkpoint come back in the standard per-name layout (the interchange
+    bridge)."""
+    state_pp, hist_pp = _run(tmp_path, tiny_datasets, "data=2,stage=2", "pp")
+    state_dp, hist_dp = _run(tmp_path, tiny_datasets, "data=4", "dp_oracle")
+    np.testing.assert_allclose(hist_pp.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(state_pp.params["block_1"]["attn"]["qkv_kernel"]),
+        np.asarray(state_dp.params["block_1"]["attn"]["qkv_kernel"]),
+        rtol=1e-4, atol=1e-6)
+    # The CLI-path checkpoint restores into the standard unsharded template — the PP
+    # round-trip of the interchange contract.
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        TransformerClassifier,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import checkpoint
+    import jax
+
+    template = create_train_state(TransformerClassifier(), jax.random.PRNGKey(9))
+    restored = checkpoint.restore_train_state(
+        os.path.join(str(tmp_path / "pp"), "model_composed.ckpt"), template)
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["block_0"]["attn"]["out_kernel"]),
+        np.asarray(state_pp.params["block_0"]["attn"]["out_kernel"]))
+
+
+def test_stage_axis_guards(tiny_datasets):
+    with pytest.raises(ValueError, match="composes with data only"):
+        composed.main(ComposedConfig(mesh="stage=2,model=2", results_dir=""),
+                      datasets=tiny_datasets)
+    with pytest.raises(ValueError, match="dropout_rate == 0"):
+        composed.main(ComposedConfig(mesh="data=2,stage=2", dropout_rate=0.1,
+                                     results_dir=""),
+                      datasets=tiny_datasets)
+    with pytest.raises(ValueError, match="pipeline microbatches"):
+        composed.main(ComposedConfig(mesh="data=2,stage=2", batch_size=66,
+                                     results_dir=""),
+                      datasets=tiny_datasets)
 
 
 def test_expert_axis_builds_moe_model(tmp_path, tiny_datasets):

@@ -27,12 +27,23 @@ FRAMEWORK_TEST_PLATFORM=tpu timeout --kill-after=60 --signal=TERM 1200 python -m
   tests/test_pallas_attention.py -q > "$OUT/flash_tpu_test.out" 2>&1
 echo "flash tests rc=$? (out: $OUT/flash_tpu_test.out)"
 
-echo "=== 2. long-context attention microbench (flash vs dense, to 16k tokens) ==="
-timeout --kill-after=60 --signal=TERM 1800 python bench_attention.py \
+echo "=== 2. long-context attention microbench (flash vs dense; r3: through 64k tokens," \
+     "where dense hits the O(S^2) wall — that wall is the result) ==="
+timeout --kill-after=60 --signal=TERM 2700 python bench_attention.py \
+  --seq-lens 1024 2048 4096 8192 16384 32768 65536 \
+  --plot "$OUT/attention_flash_vs_dense_tpu.png" \
   --out "$OUT/bench_attention_tpu.jsonl" > /dev/null 2> "$OUT/bench_attention.err"
 echo "bench_attention rc=$? (rows: $OUT/bench_attention_tpu.jsonl)"
 
-echo "=== 3. headline bench at shipped defaults (sanity re-capture) ==="
+echo "=== 2b. transformer MFU bench (MXU-shaped: d_model 256, seq 256, batch 64; r3) ==="
+timeout --kill-after=60 --signal=TERM 1800 python bench_transformer.py \
+  > "$OUT/bench_transformer_tpu.json" 2> "$OUT/bench_transformer.err"
+echo "bench_transformer rc=$? ($OUT/bench_transformer_tpu.json)"
+timeout --kill-after=60 --signal=TERM 1800 python bench_transformer.py --flash \
+  > "$OUT/bench_transformer_flash_tpu.json" 2> "$OUT/bench_transformer_flash.err"
+echo "bench_transformer --flash rc=$? ($OUT/bench_transformer_flash_tpu.json)"
+
+echo "=== 3. headline bench at shipped defaults (also primes bench_results/.jax_cache) ==="
 BENCH_TPU_RETRY_SECONDS=300 BENCH_ATTEMPT_TIMEOUT_SECONDS=240 \
   timeout --kill-after=60 --signal=TERM 2700 python bench.py \
   > "$OUT/bench_defaults.json" 2> "$OUT/bench_defaults.err"
