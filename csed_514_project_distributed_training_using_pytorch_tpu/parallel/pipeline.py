@@ -189,6 +189,11 @@ class PipelinedClassifier:
                 f"{num_stages}")
         if model.num_experts:
             raise ValueError("stage pipelining of MoE blocks is unsupported")
+        if model.dropout_rate:
+            raise ValueError(
+                "stage pipelining requires dropout_rate == 0 — the microbatch ring "
+                "does not thread dropout keys, so a nonzero rate would silently "
+                "train without dropout")
         self.model = model
         self.layers_per_stage = model.num_layers // num_stages
         self.num_stages = num_stages

@@ -40,6 +40,15 @@ from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention impor
 )
 
 
+def _case_index(origin, my_index):
+    """Causal-hop classification for equal shards arriving whole:
+    0 = entirely future (skip), 1 = entirely past (unmasked), 2 = diagonal (masked).
+    Shared by the einsum ring and ring-of-flash — the switch branch order in both
+    depends on this encoding."""
+    return jnp.where(origin == my_index, 2,
+                     jnp.where(origin < my_index, 1, 0))
+
+
 def _ring_attention_local(ql: jax.Array, kl: jax.Array, vl: jax.Array, *,
                           axis_name: str, num_shards: int,
                           causal: bool) -> jax.Array:
@@ -58,20 +67,21 @@ def _ring_attention_local(ql: jax.Array, kl: jax.Array, vl: jax.Array, *,
     perm = [(j, (j + 1) % num_shards) for j in range(num_shards)]
     q_pos = my_index * s_q + jnp.arange(s_q)  # global query positions [S/n]
 
-    def update(carry, k_blk, v_blk, origin):
-        """Fold one K/V block into the online-softmax accumulators."""
+    def update(carry, k_blk, v_blk, origin, masked: bool):
+        """Fold one K/V block into the online-softmax accumulators. ``masked`` is
+        static: only the diagonal hop applies the causal mask (see ``fold``)."""
         acc, m, l = carry
         scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
                             k_blk.astype(jnp.float32))  # [B,H,Sq,Sk]
-        if causal:
+        if masked:
             k_pos = origin * s_k + jnp.arange(s_k)
             visible = q_pos[:, None] >= k_pos[None, :]  # [Sq,Sk]
             scores = jnp.where(visible[None, None], scores, MASK_VALUE)
         m_block = jnp.max(scores, axis=-1)                # [B,H,Sq]
         m_new = jnp.maximum(m, m_block)
         p = jnp.exp(scores - m_new[..., None])            # [B,H,Sq,Sk]
-        if causal:
-            # A fully-masked block leaves m_new at MASK_VALUE; exp(0)=1 rows must not
+        if masked:
+            # A fully-masked row leaves m_new at MASK_VALUE; exp(0)=1 entries must not
             # leak into the normalizer.
             p = jnp.where(visible[None, None], p, 0.0)
         correction = jnp.exp(m - m_new)                   # [B,H,Sq]
@@ -81,10 +91,24 @@ def _ring_attention_local(ql: jax.Array, kl: jax.Array, vl: jax.Array, *,
                                               v_blk.astype(jnp.float32))
         return acc_new, m_new, l_new
 
+    def fold(carry, k_blk, v_blk, origin):
+        """One hop's block math. Causal hops decompose by the block's position
+        relative to the local queries (equal shards arrive whole): entirely past →
+        unmasked math, diagonal → masked math, entirely future → skipped outright
+        (r3: previously every hop paid full einsums plus masking)."""
+        if not causal:
+            return update(carry, k_blk, v_blk, origin, masked=False)
+        return lax.switch(
+            _case_index(origin, my_index),
+            [lambda c, kb, vb, o: c,
+             lambda c, kb, vb, o: update(c, kb, vb, o, masked=False),
+             lambda c, kb, vb, o: update(c, kb, vb, o, masked=True)],
+            carry, k_blk, v_blk, origin)
+
     def hop(carry, t):
         acc, m, l, k_cur, v_cur = carry
-        acc, m, l = update((acc, m, l), k_cur, v_cur,
-                           (my_index - t) % num_shards)
+        acc, m, l = fold((acc, m, l), k_cur, v_cur,
+                         (my_index - t) % num_shards)
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
         return (acc, m, l, k_next, v_next), None
@@ -98,8 +122,8 @@ def _ring_attention_local(ql: jax.Array, kl: jax.Array, vl: jax.Array, *,
     # extra round of ICI transfers per call).
     (acc, m, l, k_last, v_last), _ = lax.scan(
         hop, (acc0, m0, l0, kl, vl), jnp.arange(num_shards - 1))
-    acc, _, l = update((acc, m, l), k_last, v_last,
-                       (my_index - (num_shards - 1)) % num_shards)
+    acc, _, l = fold((acc, m, l), k_last, v_last,
+                     (my_index - (num_shards - 1)) % num_shards)
 
     # Under causal masking every query sees at least itself, so l > 0; the guard only
     # protects pathological all-masked rows from dividing by zero.
@@ -200,11 +224,6 @@ def _make_ring_flash_op(axis_name: str, n: int, causal: bool):
 
     def rot(x):
         return lax.ppermute(x, axis_name, perm)
-
-    def _case_index(origin, my_index):
-        # 0 = future (skip), 1 = past (non-causal flash), 2 = diagonal (causal flash)
-        return jnp.where(origin == my_index, 2,
-                         jnp.where(origin < my_index, 1, 0))
 
     def _forward(q3, k3, v3):
         bh, sq, d = q3.shape

@@ -33,6 +33,7 @@ from __future__ import annotations
 import os
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from csed_514_project_distributed_training_using_pytorch_tpu.data import (
@@ -120,6 +121,11 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     if config.batch_size % max(data_size, 1):
         raise ValueError(f"batch {config.batch_size} not divisible by data axis "
                          f"{data_size}")
+    if config.grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
+    if config.batch_size % config.grad_accum:
+        raise ValueError(f"batch {config.batch_size} not divisible by grad_accum "
+                         f"{config.grad_accum}")
     if stage_size > 1:
         if seq_size > 1 or model_size > 1 or expert_size > 1:
             raise ValueError(
@@ -128,13 +134,19 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         if config.dropout_rate:
             raise ValueError("stage pipelining requires dropout_rate == 0 "
                              "(microbatch ticks do not thread dropout keys)")
-        if config.batch_size % config.pipeline_microbatches:
+        if config.remat:
+            raise ValueError("--remat has no effect under a stage axis (the pipeline "
+                             "engine applies blocks itself) — drop it")
+        # The engine sees batch_size // grad_accum per call (the accumulation path
+        # feeds microbatches), so the pipeline divisibility guards must use that.
+        step_batch = config.batch_size // config.grad_accum
+        if step_batch % config.pipeline_microbatches:
             raise ValueError(
-                f"batch {config.batch_size} not divisible by "
+                f"per-call batch {step_batch} (batch/grad_accum) not divisible by "
                 f"{config.pipeline_microbatches} pipeline microbatches")
-        if (config.batch_size // config.pipeline_microbatches) % data_size:
+        if (step_batch // config.pipeline_microbatches) % data_size:
             raise ValueError(
-                f"microbatch {config.batch_size // config.pipeline_microbatches} "
+                f"pipeline microbatch {step_batch // config.pipeline_microbatches} "
                 f"not divisible by data axis {data_size}")
         if config.batch_size_test % config.pipeline_microbatches:
             raise ValueError(
@@ -158,7 +170,9 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     elif seq_size > 1:
         attention_fn = make_ring_attention_fn(mesh)
     model_kwargs = {"dropout_rate": config.dropout_rate,
-                    "seq_len": config.seq_len}
+                    "seq_len": config.seq_len,
+                    "dtype": jnp.bfloat16 if config.bf16 else jnp.float32,
+                    "remat": config.remat}
     if attention_fn is not None:
         model_kwargs["attention_fn"] = attention_fn
     if expert_size > 1:
@@ -200,7 +214,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                   if data_size > 1 else rep)
         epoch_fn = jax.jit(
             make_epoch_fn(engine, learning_rate=config.learning_rate,
-                          momentum=config.momentum),
+                          momentum=config.momentum,
+                          grad_accum=config.grad_accum),
             in_shardings=(state_sh, rep, rep, idx_sh, rep),
             out_shardings=(state_sh, rep), donate_argnums=(0,))
         param_shardings = state_sh.params
@@ -213,7 +228,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         state = tp.shard_train_state(mesh, base_state)
         epoch_fn = tp.compile_epoch_tp(
             make_epoch_fn(model, learning_rate=config.learning_rate,
-                          momentum=config.momentum),
+                          momentum=config.momentum,
+                          grad_accum=config.grad_accum),
             mesh, data_axis="data" if data_size > 1 else None)
         param_shardings = tp.state_shardings(mesh, state).params
         eval_model = model

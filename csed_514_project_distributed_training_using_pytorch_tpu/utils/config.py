@@ -139,6 +139,13 @@ class ComposedConfig:
                                         # axis (bubble fraction (S-1)/(M+S-1));
                                         # batch_size must divide by it, and the
                                         # microbatch by the data axis
+    bf16: bool = False                  # bfloat16 activations (f32 master weights;
+                                        # see SingleProcessConfig.bf16)
+    remat: bool = False                 # jax.checkpoint each block on backward (not
+                                        # with a stage axis — the pipeline engine
+                                        # applies blocks itself)
+    grad_accum: int = 1                 # gradient accumulation microbatches per step
+                                        # (see SingleProcessConfig.grad_accum)
     epochs: int = 2
     batch_size: int = 64
     batch_size_test: int = 1000

@@ -178,6 +178,9 @@ def test_pipelined_classifier_guards(mesh):
     with pytest.raises(ValueError, match="MoE"):
         pp.PipelinedClassifier(
             TransformerClassifier(num_layers=NUM_STAGES, num_experts=2), mesh)
+    with pytest.raises(ValueError, match="dropout_rate == 0"):
+        pp.PipelinedClassifier(
+            TransformerClassifier(num_layers=NUM_STAGES, dropout_rate=0.1), mesh)
 
 
 def test_stack_transformer_blocks_missing_block_rejected():

@@ -181,6 +181,34 @@ def test_stage_axis_guards(tiny_datasets):
                       datasets=tiny_datasets)
 
 
+def test_knobs_compose_on_composed_mesh(tmp_path, tiny_datasets):
+    """--bf16/--remat/--grad-accum (r3: unified with the other trainers' flag surface)
+    compose with a data×model mesh and still train."""
+    state, history = composed.main(
+        ComposedConfig(mesh="data=2,model=2", bf16=True, remat=True, grad_accum=2,
+                       epochs=2, batch_size=64, batch_size_test=100,
+                       results_dir=str(tmp_path / "knobs")),
+        datasets=tiny_datasets)
+    assert np.isfinite(history.test_losses[-1])
+    assert history.test_losses[-1] < history.test_losses[0] + 1e-6
+    # master weights stay f32 regardless of activation dtype
+    assert state.params["pos_embed"].dtype == np.float32
+
+
+def test_remat_rejected_with_stage_axis(tiny_datasets):
+    with pytest.raises(ValueError, match="remat has no effect"):
+        composed.main(ComposedConfig(mesh="data=2,stage=2", remat=True,
+                                     results_dir=""),
+                      datasets=tiny_datasets)
+
+
+def test_grad_accum_must_divide_batch(tiny_datasets):
+    with pytest.raises(ValueError, match="not divisible by grad_accum"):
+        composed.main(ComposedConfig(mesh="data=2", grad_accum=3, batch_size=64,
+                                     results_dir=""),
+                      datasets=tiny_datasets)
+
+
 def test_expert_axis_builds_moe_model(tmp_path, tiny_datasets):
     """--mesh with an expert axis turns on MoE blocks (expert count = axis size) with
     expert-sharded weights, and the run trains through the standard step (aux loss
