@@ -24,6 +24,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_atten
     ring_attention,
     ring_flash_attention,
     make_ring_attention_fn,
+    zigzag_ring_attention,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel.tensor_parallel import (
     param_partition_specs,
@@ -52,6 +53,7 @@ __all__ = [
     "ring_attention",
     "ring_flash_attention",
     "make_ring_attention_fn",
+    "zigzag_ring_attention",
     "param_partition_specs",
     "shard_train_state",
     "compile_step_tp",

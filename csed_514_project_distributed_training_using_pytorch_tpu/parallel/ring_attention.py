@@ -40,6 +40,34 @@ from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention impor
 )
 
 
+def _online_softmax_update(carry, q_scaled, k_blk, v_blk, visible):
+    """Fold one K/V block into the online-softmax accumulators.
+
+    ``carry = (acc [B,Sq,H,D] f32, m [B,H,Sq] f32, l [B,H,Sq] f32)``;
+    ``q_scaled`` is the f32, pre-scaled query block; ``visible`` is a ``[Sq, Sk]``
+    bool mask or ``None`` for a fully-visible block. Shared by the einsum ring and
+    the zig-zag schedule — the numerically delicate part (running max, masked-row
+    normalizer hygiene, correction factors) lives once."""
+    acc, m, l = carry
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q_scaled,
+                        k_blk.astype(jnp.float32))    # [B,H,Sq,Sk]
+    if visible is not None:
+        scores = jnp.where(visible[None, None], scores, MASK_VALUE)
+    m_block = jnp.max(scores, axis=-1)                # [B,H,Sq]
+    m_new = jnp.maximum(m, m_block)
+    p = jnp.exp(scores - m_new[..., None])            # [B,H,Sq,Sk]
+    if visible is not None:
+        # A fully-masked row leaves m_new at MASK_VALUE; exp(0)=1 entries must not
+        # leak into the normalizer.
+        p = jnp.where(visible[None, None], p, 0.0)
+    correction = jnp.exp(m - m_new)                   # [B,H,Sq]
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    acc_corr = jnp.transpose(correction, (0, 2, 1))[..., None]  # [B,Sq,H,1]
+    acc_new = acc * acc_corr + jnp.einsum("bhqk,bkhd->bqhd", p,
+                                          v_blk.astype(jnp.float32))
+    return acc_new, m_new, l_new
+
+
 def _case_index(origin, my_index):
     """Causal-hop classification for equal shards arriving whole:
     0 = entirely future (skip), 1 = entirely past (unmasked), 2 = diagonal (masked).
@@ -68,28 +96,13 @@ def _ring_attention_local(ql: jax.Array, kl: jax.Array, vl: jax.Array, *,
     q_pos = my_index * s_q + jnp.arange(s_q)  # global query positions [S/n]
 
     def update(carry, k_blk, v_blk, origin, masked: bool):
-        """Fold one K/V block into the online-softmax accumulators. ``masked`` is
-        static: only the diagonal hop applies the causal mask (see ``fold``)."""
-        acc, m, l = carry
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                            k_blk.astype(jnp.float32))  # [B,H,Sq,Sk]
+        """One block fold; ``masked`` is static — only the diagonal hop applies the
+        causal mask (see ``fold``), built from global positions."""
+        visible = None
         if masked:
             k_pos = origin * s_k + jnp.arange(s_k)
             visible = q_pos[:, None] >= k_pos[None, :]  # [Sq,Sk]
-            scores = jnp.where(visible[None, None], scores, MASK_VALUE)
-        m_block = jnp.max(scores, axis=-1)                # [B,H,Sq]
-        m_new = jnp.maximum(m, m_block)
-        p = jnp.exp(scores - m_new[..., None])            # [B,H,Sq,Sk]
-        if masked:
-            # A fully-masked row leaves m_new at MASK_VALUE; exp(0)=1 entries must not
-            # leak into the normalizer.
-            p = jnp.where(visible[None, None], p, 0.0)
-        correction = jnp.exp(m - m_new)                   # [B,H,Sq]
-        l_new = l * correction + jnp.sum(p, axis=-1)
-        acc_corr = jnp.transpose(correction, (0, 2, 1))[..., None]  # [B,Sq,H,1]
-        acc_new = acc * acc_corr + jnp.einsum("bhqk,bkhd->bqhd", p,
-                                              v_blk.astype(jnp.float32))
-        return acc_new, m_new, l_new
+        return _online_softmax_update(carry, qf, k_blk, v_blk, visible)
 
     def fold(carry, k_blk, v_blk, origin):
         """One hop's block math. Causal hops decompose by the block's position
@@ -176,22 +189,138 @@ def ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 
 def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq",
-                           use_flash: bool = False):
+                           use_flash: bool = False, use_zigzag: bool = False):
     """Bind a mesh into a ``(q, k, v, *, causal) -> out`` callable with
     ``ops.full_attention``'s exact signature — the injection point for
     ``models/transformer.py``'s pluggable ``attention_fn``.
 
     ``use_flash=True`` routes every hop's block math through the Pallas flash kernels
     (``ring_flash_attention`` — trainable, causal-capable); the per-device sequence
-    shard must then divide by the flash ``BLOCK`` (128)."""
+    shard must then divide by the flash ``BLOCK`` (128). ``use_zigzag=True`` uses the
+    load-balanced zig-zag causal schedule (``zigzag_ring_attention``; causal-only,
+    mutually exclusive with ``use_flash``)."""
+    if use_flash and use_zigzag:
+        raise ValueError("use_flash and use_zigzag are mutually exclusive")
 
     def attention_fn(q, k, v, *, causal: bool = False):
+        if use_zigzag:
+            if not causal:
+                raise ValueError("the zig-zag schedule is causal-only — use "
+                                 "ring_attention for bidirectional attention")
+            return zigzag_ring_attention(mesh, q, k, v, axis_name=axis_name)
         if use_flash:
             return ring_flash_attention(mesh, q, k, v, axis_name=axis_name,
                                         causal=causal)
         return ring_attention(mesh, q, k, v, axis_name=axis_name, causal=causal)
 
     return attention_fn
+
+
+def zigzag_ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
+                          axis_name: str = "seq") -> jax.Array:
+    """Load-balanced CAUSAL ring attention via zig-zag chunk pairing.
+
+    The naive causal ring leaves device ``i`` with ``i+1`` live hops out of ``n`` —
+    utilization ≈ 50% at scale, the critical path being the last device. Zig-zag
+    (the Megatron-CP / zigzag-ring schedule) splits the sequence into ``2n`` chunks
+    and assigns device ``i`` the PAIR ``(i, 2n-1-i)`` — one early chunk, one late
+    chunk. Per hop the K/V pair originating on device ``o`` meets the local query
+    pair in 4 chunk-pair combinations, of which exactly TWO are live on every device
+    at every non-diagonal hop (early-vs-early when ``my > o``, or late-vs-late when
+    ``o > my``; the late-vs-early pair is always live, the early-vs-late never) and
+    THREE on the diagonal hop — uniform load by construction. Each live pair is
+    folded with the same online-softmax math as the plain ring; the within-chunk
+    diagonal mask is the ordinary lower-triangular one, so no global-position
+    plumbing is needed.
+
+    The wrapper permutes chunks into the zig-zag layout before the shard_map and
+    inverts it after, so the call is a drop-in for ``ring_attention(..., causal=
+    True)`` (pinned equal to the dense causal oracle in tests); on hardware the
+    boundary permutes are two collective-permutes that a long-context trainer can
+    amortize by keeping activations in the zig-zag layout between layers.
+    ``S % (2n) == 0`` required. Differentiable through scan/switch/ppermute — no
+    custom VJP needed (einsum formulation).
+    """
+    n = mesh.shape[axis_name]
+    b, s, h, d = q.shape
+    if s % (2 * n):
+        raise ValueError(
+            f"zigzag ring attention needs sequence length divisible by 2·shards = "
+            f"{2 * n}, got {s}")
+    c = s // (2 * n)
+    order = []
+    for i in range(n):
+        order += [i, 2 * n - 1 - i]
+    inv = [0] * (2 * n)
+    for pos, chunk in enumerate(order):
+        inv[chunk] = pos
+    spec = _qkv_spec(mesh, q.shape, axis_name)
+
+    def to_zigzag(x):
+        return x.reshape(b, 2 * n, c, h, d)[:, jnp.asarray(order)].reshape(
+            b, s, h, d)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+             check_vma=False)
+    def _ring(ql, kl, vl):
+        # LOCAL shapes: batch/head dims may be sharded over data/model (_qkv_spec).
+        lb, ls, lh, ld = ql.shape
+        my_index = lax.axis_index(axis_name)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(ld, jnp.float32))
+        qf = ql.astype(jnp.float32) * scale
+        qa, qb = qf[:, :c], qf[:, c:]                 # chunks (my, 2n-1-my)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        tri = (jnp.arange(c)[:, None] >= jnp.arange(c)[None, :])  # within-chunk diag
+
+        def pair_fold(carry, qx, k_blk, v_blk, q_chunk, k_chunk):
+            """Fold one (query-chunk, key-chunk) pair whose case varies by hop:
+            future → skip, past → unmasked, equal → within-chunk diagonal mask."""
+            return lax.switch(
+                _case_index(k_chunk, q_chunk),
+                [lambda a: a[:3],
+                 lambda a: _online_softmax_update(a[:3], qx, a[3], a[4], None),
+                 lambda a: _online_softmax_update(a[:3], qx, a[3], a[4], tri)],
+                (*carry, k_blk, v_blk))
+
+        def hop(carry, t):
+            ca, cb, k_cur, v_cur = carry
+            o = (my_index - t) % n
+            ko, k2 = k_cur[:, :c], k_cur[:, c:]       # chunks (o, 2n-1-o)
+            vo, v2 = v_cur[:, :c], v_cur[:, c:]
+            # Of the 4 chunk-pair combinations, two are statically decided: the early
+            # query chunk never sees the late key chunk (my ≤ n-1 < n ≤ 2n-1-o —
+            # skipped outright, no switch), and the late query chunk always sees the
+            # early key chunk in full (2n-1-my ≥ n > o). Only the early-vs-early and
+            # late-vs-late pairs vary by hop.
+            ca = pair_fold(ca, qa, ko, vo, my_index, o)
+            cb = _online_softmax_update(cb, qb, ko, vo, None)
+            cb = pair_fold(cb, qb, k2, v2, 2 * n - 1 - my_index, 2 * n - 1 - o)
+            return (ca, cb, lax.ppermute(k_cur, axis_name, perm),
+                    lax.ppermute(v_cur, axis_name, perm)), None
+
+        def init():
+            return (jnp.zeros((lb, c, lh, ld), jnp.float32),
+                    jnp.full((lb, lh, c), MASK_VALUE, jnp.float32),
+                    jnp.zeros((lb, lh, c), jnp.float32))
+
+        (ca, cb, k_last, v_last), _ = lax.scan(
+            hop, (init(), init(), kl, vl), jnp.arange(n - 1))
+        o = (my_index - (n - 1)) % n
+        ko, k2 = k_last[:, :c], k_last[:, c:]
+        vo, v2 = v_last[:, :c], v_last[:, c:]
+        ca = pair_fold(ca, qa, ko, vo, my_index, o)
+        cb = _online_softmax_update(cb, qb, ko, vo, None)
+        cb = pair_fold(cb, qb, k2, v2, 2 * n - 1 - my_index, 2 * n - 1 - o)
+
+        def finish(carry):
+            acc, _, l = carry
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            return acc / jnp.transpose(l_safe, (0, 2, 1))[..., None]
+
+        return jnp.concatenate([finish(ca), finish(cb)], axis=1).astype(ql.dtype)
+
+    out = _ring(to_zigzag(q), to_zigzag(k), to_zigzag(v))
+    return out.reshape(b, 2 * n, c, h, d)[:, jnp.asarray(inv)].reshape(b, s, h, d)
 
 
 @functools.lru_cache(maxsize=None)

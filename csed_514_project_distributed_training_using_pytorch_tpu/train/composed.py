@@ -126,6 +126,14 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     if config.batch_size % config.grad_accum:
         raise ValueError(f"batch {config.batch_size} not divisible by grad_accum "
                          f"{config.grad_accum}")
+    if (config.grad_accum > 1
+            and (config.batch_size // config.grad_accum) % max(data_size, 1)):
+        # Same fail-fast as train/distributed.py: an indivisible microbatch would make
+        # GSPMD silently reshard inside the hot program, defeating DP scaling.
+        raise ValueError(
+            f"microbatch {config.batch_size // config.grad_accum} "
+            f"(batch/grad_accum) not divisible by data axis {data_size} — each "
+            f"microbatch must still shard evenly")
     if stage_size > 1:
         if seq_size > 1 or model_size > 1 or expert_size > 1:
             raise ValueError(
@@ -137,6 +145,10 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         if config.remat:
             raise ValueError("--remat has no effect under a stage axis (the pipeline "
                              "engine applies blocks itself) — drop it")
+        if config.flash_attention or config.zigzag_attention:
+            raise ValueError(
+                "--flash-attention/--zigzag-attention do not compose with a stage "
+                "axis (their shard_map cannot nest inside the pipeline's)")
         # The engine sees batch_size // grad_accum per call (the accumulation path
         # feeds microbatches), so the pipeline divisibility guards must use that.
         step_batch = config.batch_size // config.grad_accum
@@ -154,7 +166,20 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                 f"{config.pipeline_microbatches} pipeline microbatches")
 
     attention_fn = None
-    if config.flash_attention:
+    if config.zigzag_attention:
+        if config.flash_attention:
+            raise ValueError("--zigzag-attention and --flash-attention are mutually "
+                             "exclusive")
+        if not config.causal:
+            raise ValueError("--zigzag-attention is causal-only — add --causal")
+        if "seq" not in mesh.shape:
+            raise ValueError("--zigzag-attention needs a seq axis in --mesh")
+        if config.seq_len % (2 * max(seq_size, 1)):
+            raise ValueError(
+                f"--zigzag-attention needs seq_len divisible by 2·seq_axis = "
+                f"{2 * max(seq_size, 1)}, got {config.seq_len}")
+        attention_fn = make_ring_attention_fn(mesh, use_zigzag=True)
+    elif config.flash_attention:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
             pallas_attention as pa,
         )
@@ -172,7 +197,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     model_kwargs = {"dropout_rate": config.dropout_rate,
                     "seq_len": config.seq_len,
                     "dtype": jnp.bfloat16 if config.bf16 else jnp.float32,
-                    "remat": config.remat}
+                    "remat": config.remat,
+                    "causal": config.causal}
     if attention_fn is not None:
         model_kwargs["attention_fn"] = attention_fn
     if expert_size > 1:

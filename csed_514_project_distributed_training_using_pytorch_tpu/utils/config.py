@@ -146,6 +146,11 @@ class ComposedConfig:
                                         # applies blocks itself)
     grad_accum: int = 1                 # gradient accumulation microbatches per step
                                         # (see SingleProcessConfig.grad_accum)
+    causal: bool = False                # decoder-style (causal) attention over the
+                                        # token sequence instead of bidirectional
+    zigzag_attention: bool = False      # load-balanced zig-zag causal ring schedule
+                                        # (parallel.zigzag_ring_attention); requires
+                                        # --causal and seq_len % (2*seq_axis) == 0
     epochs: int = 2
     batch_size: int = 64
     batch_size_test: int = 1000

@@ -173,6 +173,43 @@ def test_ring_matches_dense_on_composed_mesh(causal):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_zigzag_matches_dense_causal(seq_mesh):
+    """Zig-zag causal ring (load-balanced chunk pairing) equals the dense causal
+    oracle — forward and gradients — through the permute/ring/inverse-permute path."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_attention import (
+        zigzag_ring_attention,
+    )
+
+    q, k, v = _qkv(s=64, seed=10)
+    out = zigzag_ring_attention(seq_mesh, q, k, v)
+    ref = ops.full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    def make_loss(attn):
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v)))
+
+    ref_grads = jax.grad(make_loss(
+        lambda q, k, v: ops.full_attention(q, k, v, causal=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    zz_grads = jax.grad(make_loss(
+        lambda q, k, v: zigzag_ring_attention(seq_mesh, q, k, v)),
+        argnums=(0, 1, 2))(q, k, v)
+    for g_ref, g_zz in zip(ref_grads, zz_grads):
+        np.testing.assert_allclose(np.asarray(g_zz), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_zigzag_divisibility_enforced(seq_mesh):
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_attention import (
+        zigzag_ring_attention,
+    )
+
+    q, k, v = _qkv(s=40, seed=11)  # 40 % 16 != 0
+    with pytest.raises(ValueError, match="2·shards"):
+        zigzag_ring_attention(seq_mesh, q, k, v)
+
+
 def test_ring_of_flash_block_divisibility_enforced(seq_mesh):
     from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_attention import (
         ring_flash_attention,

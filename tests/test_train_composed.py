@@ -181,6 +181,41 @@ def test_stage_axis_guards(tiny_datasets):
                       datasets=tiny_datasets)
 
 
+def test_zigzag_causal_mesh_invariant(tmp_path, tiny_datasets):
+    """--causal --zigzag-attention on a data×seq mesh (the load-balanced causal ring,
+    CLI-reachable) reproduces the plain-DP causal trajectory."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100, seq_len=16,
+                  max_train_examples=512, causal=True)
+    state_z, hist_z = composed.main(
+        ComposedConfig(mesh="data=2,seq=2", zigzag_attention=True,
+                       results_dir=str(tmp_path / "zz"), **common),
+        datasets=tiny_datasets)
+    state_d, hist_d = composed.main(
+        ComposedConfig(mesh="data=4", results_dir=str(tmp_path / "zzd"), **common),
+        datasets=tiny_datasets)
+    np.testing.assert_allclose(hist_z.train_losses, hist_d.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(state_z.params["pos_embed"]),
+                               np.asarray(state_d.params["pos_embed"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_zigzag_guards(tiny_datasets):
+    with pytest.raises(ValueError, match="causal-only"):
+        composed.main(ComposedConfig(mesh="data=2,seq=2", zigzag_attention=True,
+                                     results_dir=""),
+                      datasets=tiny_datasets)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        composed.main(ComposedConfig(mesh="data=2,seq=2", zigzag_attention=True,
+                                     flash_attention=True, causal=True,
+                                     results_dir=""),
+                      datasets=tiny_datasets)
+    with pytest.raises(ValueError, match="needs a seq axis"):
+        composed.main(ComposedConfig(mesh="data=4", zigzag_attention=True,
+                                     causal=True, results_dir=""),
+                      datasets=tiny_datasets)
+
+
 def test_knobs_compose_on_composed_mesh(tmp_path, tiny_datasets):
     """--bf16/--remat/--grad-accum (r3: unified with the other trainers' flag surface)
     compose with a data×model mesh and still train."""
@@ -206,6 +241,19 @@ def test_grad_accum_must_divide_batch(tiny_datasets):
     with pytest.raises(ValueError, match="not divisible by grad_accum"):
         composed.main(ComposedConfig(mesh="data=2", grad_accum=3, batch_size=64,
                                      results_dir=""),
+                      datasets=tiny_datasets)
+    # The microbatch must still shard over the data axis (same fail-fast as
+    # train/distributed.py) — 64/16 = 4 cannot shard 8 ways.
+    with pytest.raises(ValueError, match="microbatch 4"):
+        composed.main(ComposedConfig(mesh="data=8", grad_accum=16, batch_size=64,
+                                     results_dir=""),
+                      datasets=tiny_datasets)
+
+
+def test_attention_overrides_rejected_with_stage(tiny_datasets):
+    with pytest.raises(ValueError, match="do not compose with a stage axis"):
+        composed.main(ComposedConfig(mesh="stage=2,seq=1", causal=True,
+                                     zigzag_attention=True, results_dir=""),
                       datasets=tiny_datasets)
 
 
