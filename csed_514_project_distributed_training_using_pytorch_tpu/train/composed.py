@@ -68,6 +68,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.utils import metric
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
     ComposedConfig, parse_config,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.profiling import (
+    maybe_profile,
+)
 
 _KNOWN_AXES = ("data", "seq", "model", "expert", "stage")
 
@@ -215,7 +218,28 @@ def main(config: ComposedConfig = ComposedConfig(), *,
           f"batch {config.batch_size}, data source: {train_ds.source}")
 
     rep = dp.replicated(mesh)
+    n_train, n_test = len(train_ds), len(test_ds)
+    steps_per_epoch = n_train // config.batch_size
+    if steps_per_epoch == 0:
+        raise ValueError(f"batch {config.batch_size} larger than the train split "
+                         f"({n_train} examples) — nothing to step")
     base_state = create_train_state(model, jax.random.PRNGKey(config.seed))
+    start_epoch = 0
+    if config.resume_from:
+        # Checkpoints are always in the standard per-name layout, so a composed run
+        # resumes from ANY mesh's checkpoint — including across stage layouts (the
+        # bridge below re-stacks). Process-0 restore + broadcast, as in
+        # train/distributed.py.
+        if info.process_index == 0:
+            base_state = checkpoint.restore_train_state(config.resume_from,
+                                                        base_state)
+        if info.process_count > 1:
+            from jax.experimental import multihost_utils
+            base_state = jax.tree_util.tree_map(
+                np.asarray, multihost_utils.broadcast_one_to_all(base_state))
+        start_epoch = int(base_state.step) // max(steps_per_epoch, 1)
+        M.log(f"Resumed from {config.resume_from} at step {int(base_state.step)} "
+              f"(starting epoch {start_epoch})")
     # Whole epochs run as ONE compiled scan under the composed shardings (same program
     # structure as train/distributed.py): per-step Python dispatch — an index-plan
     # upload, an on-device gather, a reshard, a step call — dominates at this model
@@ -274,53 +298,68 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     test_x = dp.put_global(mesh, test_ds.images, P())
     test_y = dp.put_global(mesh, test_ds.labels, P())
     history = M.MetricsHistory()
-    n_train, n_test = len(train_ds), len(test_ds)
-    steps_per_epoch = n_train // config.batch_size
-    if steps_per_epoch == 0:
-        raise ValueError(f"batch {config.batch_size} larger than the train split "
-                         f"({n_train} examples) — nothing to step")
-    rng = np.random.default_rng(config.seed)
     plan_spec = P(None, "data") if data_size > 1 else P()
     # One dropout key for the whole run, hoisted out of the loop (each step folds it
     # with state.step inside the compiled program — same per-step keys as before).
     dropout_rng = jax.random.PRNGKey(config.seed + 1)
-
-    for epoch in range(config.epochs):
-        perm = rng.permutation(n_train)
-        plan = dp.put_global(
-            mesh,
-            perm[:steps_per_epoch * config.batch_size].astype(np.int32)
-            .reshape(steps_per_epoch, config.batch_size), plan_spec)
-        state, losses = epoch_fn(state, train_x, train_y, plan, dropout_rng)
-        jax.block_until_ready(state.params)
-        epoch_loss = float(np.asarray(jax.device_get(losses)).mean())
-        sum_nll, correct = jax.device_get(eval_fn(state.params, test_x, test_y))
-        examples_trained = (epoch + 1) * steps_per_epoch * config.batch_size
-        history.record_train(examples_trained, epoch_loss)
-        history.record_test(examples_trained, float(sum_nll) / n_test)
-        M.log(f"Epoch {epoch}: train_loss: {epoch_loss:.4f}, "
-              f"val_loss: {float(sum_nll) / n_test:.4f}, "
-              f"accuracy: {int(correct) / n_test:.4f}, "
-              f"time_elapsed: {watch.elapsed():.2f}s")
-
     # Replicate shards on device (all-gather), then fetch — device_get on a sharded
     # array would fail on a multi-host fleet where no process addresses every shard.
     gather = jax.jit(lambda s: s, out_shardings=rep)
-    host_state = jax.device_get(gather(state))
-    if stage_size > 1:
-        # Bridge the stacked PP layout back to the standard per-name checkpoint layout
-        # — the interchange contract with every other mesh.
-        host_state = TrainState(
-            pipeline.unstack_transformer_blocks(host_state.params["blocks"],
-                                                host_state.params["rest"]),
-            pipeline.unstack_transformer_blocks(host_state.velocity["blocks"],
-                                                host_state.velocity["rest"]),
-            host_state.step)
-    if config.results_dir:
+
+    def to_host_standard(state) -> TrainState:
+        """Gathered host copy in the standard per-name checkpoint layout (the
+        interchange contract with every other mesh — stage layouts bridge back)."""
+        host_state = jax.device_get(gather(state))
+        if stage_size > 1:
+            host_state = TrainState(
+                pipeline.unstack_transformer_blocks(host_state.params["blocks"],
+                                                    host_state.params["rest"]),
+                pipeline.unstack_transformer_blocks(host_state.velocity["blocks"],
+                                                    host_state.velocity["rest"]),
+                host_state.step)
+        return host_state
+
+    ckpt_path = (os.path.join(config.results_dir, "model_composed.ckpt")
+                 if config.results_dir else "")
+    if ckpt_path:
         os.makedirs(config.results_dir, exist_ok=True)
-        path = os.path.join(config.results_dir, "model_composed.ckpt")
-        checkpoint.save_train_state(path, host_state)  # process-0 gate lives inside
-        M.log(f"Saved {path}")
+
+    host_state = None
+    with maybe_profile(config.profile and M.is_logging_process(),
+                       config.profile_dir):
+        for epoch in range(start_epoch, config.epochs):
+            # (seed, epoch)-keyed permutation — a pure function, so a resumed run
+            # replays exactly the epochs it missed (same contract as
+            # parallel/sampler.py's global_permutation).
+            perm = np.random.default_rng(
+                np.random.SeedSequence([config.seed, epoch])).permutation(n_train)
+            plan = dp.put_global(
+                mesh,
+                perm[:steps_per_epoch * config.batch_size].astype(np.int32)
+                .reshape(steps_per_epoch, config.batch_size), plan_spec)
+            state, losses = epoch_fn(state, train_x, train_y, plan, dropout_rng)
+            jax.block_until_ready(state.params)
+            epoch_loss = float(np.asarray(jax.device_get(losses)).mean())
+            sum_nll, correct = jax.device_get(eval_fn(state.params, test_x, test_y))
+            examples_trained = (epoch + 1) * steps_per_epoch * config.batch_size
+            history.record_train(examples_trained, epoch_loss)
+            history.record_test(examples_trained, float(sum_nll) / n_test)
+            M.log(f"Epoch {epoch}: train_loss: {epoch_loss:.4f}, "
+                  f"val_loss: {float(sum_nll) / n_test:.4f}, "
+                  f"accuracy: {int(correct) / n_test:.4f}, "
+                  f"time_elapsed: {watch.elapsed():.2f}s")
+            # Per-epoch full-state checkpoint (standard layout, process-0 gated,
+            # atomic) so a killed run resumes with --resume-from on ANY mesh. The
+            # final epoch's host copy doubles as the return value — no second
+            # gather/save after the loop.
+            if ckpt_path:
+                host_state = to_host_standard(state)
+                checkpoint.save_train_state(ckpt_path, host_state)
+
+    if host_state is None:      # no results_dir, or the resume skipped every epoch
+        host_state = to_host_standard(state)
+    if ckpt_path:
+        M.log(f"Saved {ckpt_path}")
     return host_state, history
 
 

@@ -151,6 +151,12 @@ class ComposedConfig:
     zigzag_attention: bool = False      # load-balanced zig-zag causal ring schedule
                                         # (parallel.zigzag_ring_attention); requires
                                         # --causal and seq_len % (2*seq_axis) == 0
+    resume_from: str = ""               # full-TrainState checkpoint to resume from;
+                                        # checkpoints are layout-standard, so a run
+                                        # resumes from ANY mesh's checkpoint (incl.
+                                        # across stage layouts via the bridge)
+    profile: bool = False               # jax.profiler capture around the epoch loop
+    profile_dir: str = "results/profile"
     epochs: int = 2
     batch_size: int = 64
     batch_size_test: int = 1000

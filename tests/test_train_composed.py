@@ -257,6 +257,36 @@ def test_attention_overrides_rejected_with_stage(tiny_datasets):
                       datasets=tiny_datasets)
 
 
+def test_resume_across_meshes(tmp_path, tiny_datasets):
+    """Kill-and-resume ACROSS mesh layouts (r3): one DP epoch + one epoch resumed on
+    a data×stage mesh equals two uninterrupted DP epochs — checkpoints are
+    layout-standard, permutations are (seed, epoch)-keyed pure functions, and the
+    stacked-PP bridge restacks a restored standard-layout state."""
+    full, _ = composed.main(
+        ComposedConfig(mesh="data=4", epochs=2, batch_size=64, batch_size_test=100,
+                       results_dir=str(tmp_path / "full")),
+        datasets=tiny_datasets)
+    composed.main(
+        ComposedConfig(mesh="data=4", epochs=1, batch_size=64, batch_size_test=100,
+                       results_dir=str(tmp_path / "half")),
+        datasets=tiny_datasets)
+    resumed, _ = composed.main(
+        ComposedConfig(mesh="data=2,stage=2", epochs=2, batch_size=64,
+                       batch_size_test=100,
+                       resume_from=os.path.join(str(tmp_path / "half"),
+                                                "model_composed.ckpt"),
+                       results_dir=str(tmp_path / "resumed")),
+        datasets=tiny_datasets)
+    assert int(resumed.step) == int(full.step)
+    np.testing.assert_allclose(np.asarray(resumed.params["pos_embed"]),
+                               np.asarray(full.params["pos_embed"]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(resumed.params["block_0"]["attn"]["qkv_kernel"]),
+        np.asarray(full.params["block_0"]["attn"]["qkv_kernel"]),
+        rtol=1e-4, atol=1e-6)
+
+
 def test_expert_axis_builds_moe_model(tmp_path, tiny_datasets):
     """--mesh with an expert axis turns on MoE blocks (expert count = axis size) with
     expert-sharded weights, and the run trains through the standard step (aux loss
