@@ -18,7 +18,8 @@ import jax.numpy as jnp
 VALID_MODELS = ("cnn", "transformer")
 
 
-def validate_model_config(name: str, *, remat: bool = False) -> None:
+def validate_model_config(name: str, *, remat: bool = False,
+                          causal: bool = False) -> None:
     """Fail fast on a bad ``--model`` value or model/knob combination — callers run this
     before any data download, dataset load, or cluster rendezvous so typos cost
     milliseconds, not side effects (on a fleet: not a full rendezvous per host)."""
@@ -28,9 +29,13 @@ def validate_model_config(name: str, *, remat: bool = False) -> None:
     if remat and name == "cnn":
         raise ValueError("--remat applies to the transformer family only "
                          "(the CNN's activations are a few hundred KB)")
+    if causal and name == "cnn":
+        raise ValueError("--causal applies to the transformer family only "
+                         "(the CNN has no attention to mask)")
 
 
-def build_model(name: str, *, bf16: bool = False, remat: bool = False):
+def build_model(name: str, *, bf16: bool = False, remat: bool = False,
+                causal: bool = False):
     """Model factory behind the trainers' ``--model`` flag. Both families share the
     ``(x, *, deterministic)`` call contract on ``[B, 28, 28, 1]`` input, so every
     trainer/eval/checkpoint path works with either.
@@ -38,12 +43,13 @@ def build_model(name: str, *, bf16: bool = False, remat: bool = False):
     ``bf16`` runs activations in bfloat16 (the MXU's native dtype) with float32 master
     weights and float32 softmax/loss statistics. ``remat`` (transformer only) recomputes
     each block's activations on backward — the ``jax.checkpoint`` memory/FLOPs trade.
+    ``causal`` (transformer only) masks attention decoder-style.
     """
-    validate_model_config(name, remat=remat)
+    validate_model_config(name, remat=remat, causal=causal)
     dtype = jnp.bfloat16 if bf16 else jnp.float32
     if name == "cnn":
         return Net(dtype=dtype)
-    return TransformerClassifier(dtype=dtype, remat=remat)
+    return TransformerClassifier(dtype=dtype, remat=remat, causal=causal)
 
 
 __all__ = ["Net", "TransformerClassifier", "build_model", "validate_model_config",

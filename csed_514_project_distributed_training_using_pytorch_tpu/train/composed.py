@@ -228,16 +228,13 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     if config.resume_from:
         # Checkpoints are always in the standard per-name layout, so a composed run
         # resumes from ANY mesh's checkpoint — including across stage layouts (the
-        # bridge below re-stacks). Process-0 restore + broadcast, as in
-        # train/distributed.py.
-        if info.process_index == 0:
-            base_state = checkpoint.restore_train_state(config.resume_from,
-                                                        base_state)
-        if info.process_count > 1:
-            from jax.experimental import multihost_utils
-            base_state = jax.tree_util.tree_map(
-                np.asarray, multihost_utils.broadcast_one_to_all(base_state))
-        start_epoch = int(base_state.step) // max(steps_per_epoch, 1)
+        # bridge below re-stacks).
+        base_state, start_epoch, warning = checkpoint.restore_for_resume(
+            config.resume_from, base_state,
+            process_index=info.process_index, process_count=info.process_count,
+            steps_per_epoch=steps_per_epoch)
+        if warning:
+            M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(base_state.step)} "
               f"(starting epoch {start_epoch})")
     # Whole epochs run as ONE compiled scan under the composed shardings (same program
@@ -358,6 +355,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
 
     if host_state is None:      # no results_dir, or the resume skipped every epoch
         host_state = to_host_standard(state)
+        if ckpt_path:           # zero-epoch resume must still leave a checkpoint
+            checkpoint.save_train_state(ckpt_path, host_state)
     if ckpt_path:
         M.log(f"Saved {ckpt_path}")
     return host_state, history

@@ -106,7 +106,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     """Run distributed training over all (or ``num_devices``) addressable devices; every host
     in a multi-host fleet runs this same function."""
     watch = M.Stopwatch()                         # ≙ t0, reference src/train_dist.py:119
-    validate_model_config(config.model, remat=config.remat)  # fail fast, pre-rendezvous
+    validate_model_config(config.model, remat=config.remat, causal=config.causal)  # fail fast, pre-rendezvous
     if config.grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
     info = initialize_cluster()                   # ≙ init_process_group, :146
@@ -138,22 +138,18 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     samplers = [ShardedSampler(n_train, num_replicas=world, rank=r,
                                seed=config.sampler_seed) for r in range(world)]
 
-    model = build_model(config.model, bf16=config.bf16, remat=config.remat)
+    model = build_model(config.model, bf16=config.bf16, remat=config.remat,
+                        causal=config.causal)
     state = create_train_state(model, init_rng)
     steps_per_epoch = samplers[0].num_samples // per_replica_batch
     start_epoch = 0
     if config.resume_from:                        # the resume path the reference lacks
-        # Checkpoints are process-0-gated writes, so on a fleet without a shared
-        # filesystem only process 0 can read one back: restore there and broadcast the
-        # full TrainState to every process (the resume analog of DDP's initial param
-        # broadcast, reference src/train_dist.py:63).
-        if info.process_index == 0:
-            state = checkpoint.restore_train_state(config.resume_from, state)
-        if info.process_count > 1:
-            from jax.experimental import multihost_utils
-            state = jax.tree_util.tree_map(
-                np.asarray, multihost_utils.broadcast_one_to_all(state))
-        start_epoch = int(state.step) // max(steps_per_epoch, 1)
+        state, start_epoch, warning = checkpoint.restore_for_resume(
+            config.resume_from, state,
+            process_index=info.process_index, process_count=info.process_count,
+            steps_per_epoch=steps_per_epoch)
+        if warning:
+            M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(state.step)} "
               f"(starting epoch {start_epoch})")
     state = jax.device_put(state, dp.replicated(mesh))

@@ -56,7 +56,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     notebooks); by default MNIST is loaded from ``config.data_dir``.
     """
     watch = M.Stopwatch()                       # ≙ t0, reference src/train.py:10
-    validate_model_config(config.model, remat=config.remat)  # fail fast, pre-side-effects
+    validate_model_config(config.model, remat=config.remat, causal=config.causal)  # fail fast, pre-side-effects
     if config.grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
     if config.grad_accum > 1 and config.batch_size_train % config.grad_accum:
@@ -98,7 +98,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     plotting.save_sample_grid(test_ds.images, test_ds.labels,
                               os.path.join(config.images_dir, "train_images.png"))
 
-    model = build_model(config.model, bf16=config.bf16, remat=config.remat)
+    model = build_model(config.model, bf16=config.bf16, remat=config.remat,
+                        causal=config.causal)
     state = create_train_state(model, init_rng)
     resume_from = resume_from or config.resume_from or None
     if resume_from:                             # the restore path the reference lacks

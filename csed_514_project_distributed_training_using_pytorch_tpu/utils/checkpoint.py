@@ -46,6 +46,37 @@ def restore_train_state(path: str, reference_state: TrainState) -> TrainState:
     return TrainState(**restored)
 
 
+def restore_for_resume(path: str, reference_state: TrainState, *,
+                       process_index: int, process_count: int,
+                       steps_per_epoch: int):
+    """Shared resume prologue of the distributed and composed trainers: process-0
+    restore, full-state broadcast to the fleet (the resume analog of DDP's initial
+    param broadcast — checkpoints are process-0-gated writes, so on a fleet without a
+    shared filesystem only process 0 can read one back), and start-epoch derivation.
+
+    Returns ``(state, start_epoch, warning)`` where ``warning`` is a log-worthy
+    message when the checkpoint's step count is not a whole number of THIS config's
+    epochs — the tell-tale of a mid-epoch checkpoint or a checkpoint written under a
+    different batch size (the step counter is the only progress metadata stored)."""
+    state = reference_state
+    if process_index == 0:
+        state = restore_train_state(path, state)
+    if process_count > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+        state = jax.tree_util.tree_map(
+            np.asarray, multihost_utils.broadcast_one_to_all(state))
+    spe = max(steps_per_epoch, 1)
+    start_epoch = int(state.step) // spe
+    warning = None
+    if int(state.step) % spe:
+        warning = (f"checkpoint step {int(state.step)} is not a multiple of "
+                   f"steps_per_epoch {spe} — a mid-epoch checkpoint, or one written "
+                   f"under a different batch size; resuming at epoch {start_epoch} "
+                   f"replays the partial epoch")
+    return state, start_epoch, warning
+
+
 def save_params(path: str, params) -> None:
     """Final params-only export (≙ rank-0 ``torch.save(model.state_dict(), 'model.pt')``,
     reference src/train_dist.py:163-164). Process-0 gated."""

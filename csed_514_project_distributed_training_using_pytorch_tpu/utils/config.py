@@ -47,6 +47,8 @@ class SingleProcessConfig:
                                       # softmax/loss statistics — the MXU-native dtype)
     remat: bool = False               # jax.checkpoint each transformer block on backward
                                       # (O(1)-blocks activation memory; transformer only)
+    causal: bool = False              # decoder-style (causal) attention
+                                      # (transformer only)
     use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
                                       # (ops/pallas_kernels.py; single-device step path)
     experimental_fused_step: bool = False
@@ -105,6 +107,8 @@ class DistributedConfig:
     bf16: bool = False                # bfloat16 activations (see SingleProcessConfig.bf16)
     remat: bool = False               # jax.checkpoint transformer blocks (see
                                       # SingleProcessConfig.remat)
+    causal: bool = False              # decoder-style attention (see
+                                      # SingleProcessConfig.causal)
     host_local_feed: bool = False     # multi-host input pipeline: each process gathers and
                                       # feeds ONLY its addressable devices' shard of every
                                       # batch (SURVEY.md §7 hard part (d)) instead of the

@@ -122,6 +122,21 @@ def test_single_trainer_with_transformer_model(tmp_path, tiny_datasets):
     assert os.path.exists(os.path.join(cfg.results_dir, "model.ckpt"))
 
 
+def test_single_trainer_causal_transformer(tmp_path, tiny_datasets):
+    """--causal trains decoder-style attention through the standard workflow and is
+    rejected for the CNN (which has no attention to mask)."""
+    cfg = SingleProcessConfig(
+        n_epochs=1, batch_size_train=64, batch_size_test=100, learning_rate=0.05,
+        momentum=0.5, model="transformer", causal=True,
+        max_train_examples=512,
+        results_dir=str(tmp_path / "results"), images_dir=str(tmp_path / "images"))
+    state, history = single.main(cfg, datasets=tiny_datasets)
+    assert np.isfinite(history.test_losses[-1])
+    with pytest.raises(ValueError, match="transformer family only"):
+        single.main(SingleProcessConfig(model="cnn", causal=True),
+                    datasets=tiny_datasets)
+
+
 def test_fused_step_rejects_non_cnn_model(tmp_path, tiny_datasets):
     cfg = SingleProcessConfig(
         n_epochs=1, model="transformer", experimental_fused_step=True,
