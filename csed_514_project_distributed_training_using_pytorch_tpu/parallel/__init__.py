@@ -25,6 +25,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_atten
     ring_flash_attention,
     make_ring_attention_fn,
     zigzag_ring_attention,
+    zigzag_ring_flash_attention,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel.tensor_parallel import (
     param_partition_specs,
@@ -54,6 +55,7 @@ __all__ = [
     "ring_flash_attention",
     "make_ring_attention_fn",
     "zigzag_ring_attention",
+    "zigzag_ring_flash_attention",
     "param_partition_specs",
     "shard_train_state",
     "compile_step_tp",

@@ -197,16 +197,18 @@ def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq",
     ``use_flash=True`` routes every hop's block math through the Pallas flash kernels
     (``ring_flash_attention`` — trainable, causal-capable); the per-device sequence
     shard must then divide by the flash ``BLOCK`` (128). ``use_zigzag=True`` uses the
-    load-balanced zig-zag causal schedule (``zigzag_ring_attention``; causal-only,
-    mutually exclusive with ``use_flash``)."""
-    if use_flash and use_zigzag:
-        raise ValueError("use_flash and use_zigzag are mutually exclusive")
+    load-balanced zig-zag causal schedule (``zigzag_ring_attention``; causal-only).
+    Both together select ``zigzag_ring_flash_attention`` — the full long-context
+    causal training composition."""
 
     def attention_fn(q, k, v, *, causal: bool = False):
         if use_zigzag:
             if not causal:
                 raise ValueError("the zig-zag schedule is causal-only — use "
                                  "ring_attention for bidirectional attention")
+            if use_flash:
+                return zigzag_ring_flash_attention(mesh, q, k, v,
+                                                   axis_name=axis_name)
             return zigzag_ring_attention(mesh, q, k, v, axis_name=axis_name)
         if use_flash:
             return ring_flash_attention(mesh, q, k, v, axis_name=axis_name,
@@ -214,6 +216,18 @@ def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq",
         return ring_attention(mesh, q, k, v, axis_name=axis_name, causal=causal)
 
     return attention_fn
+
+
+def _zigzag_order(n: int) -> tuple[list, list]:
+    """Chunk permutation for the zig-zag layout and its inverse: 2n chunks laid out so
+    shard_map's n contiguous slices are the pairs (i, 2n-1-i)."""
+    order = []
+    for i in range(n):
+        order += [i, 2 * n - 1 - i]
+    inv = [0] * (2 * n)
+    for pos, chunk in enumerate(order):
+        inv[chunk] = pos
+    return order, inv
 
 
 def zigzag_ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -248,12 +262,7 @@ def zigzag_ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, 
             f"zigzag ring attention needs sequence length divisible by 2·shards = "
             f"{2 * n}, got {s}")
     c = s // (2 * n)
-    order = []
-    for i in range(n):
-        order += [i, 2 * n - 1 - i]
-    inv = [0] * (2 * n)
-    for pos, chunk in enumerate(order):
-        inv[chunk] = pos
+    order, inv = _zigzag_order(n)
     spec = _qkv_spec(mesh, q.shape, axis_name)
 
     def to_zigzag(x):
@@ -323,6 +332,30 @@ def zigzag_ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, 
     return out.reshape(b, 2 * n, c, h, d)[:, jnp.asarray(inv)].reshape(b, s, h, d)
 
 
+def _flash_merge(carry, out3, lse4):
+    """Merge one flash-kernel partial — ``out3 [BH, S, D]`` plus its log-sum-exp in
+    the kernels' ``[BH, S/BLOCK, 1, BLOCK]`` statistics layout — into the blockwise-
+    softmax accumulators ``(acc [BH,S,D], m [BH,S,1], l [BH,S,1])``. The exact
+    combination ``lse = logsumexp_t(lse_t), out = Σ_t exp(lse_t − lse)·out_t``,
+    shared by both ring-of-flash variants — the numerically delicate part lives
+    once (as ``_online_softmax_update`` does for the einsum rings)."""
+    acc, m, l = carry
+    bh, srows, _ = out3.shape
+    lse_rows = jnp.transpose(lse4, (0, 1, 3, 2)).reshape(bh, srows, 1)
+    m_new = jnp.maximum(m, lse_rows)
+    corr = jnp.exp(m - m_new)
+    w = jnp.exp(lse_rows - m_new)
+    return acc * corr + out3 * w, m_new, l * corr + w
+
+
+def _flash_finish(carry):
+    """Normalize blockwise-softmax accumulators: ``(out [BH,S,D], lse [BH,S,1])``.
+    The guard only protects pathological all-masked rows from dividing by zero."""
+    acc, m, l = carry
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return acc / l_safe, m + jnp.log(l_safe)
+
+
 @functools.lru_cache(maxsize=None)
 def _make_ring_flash_op(axis_name: str, n: int, causal: bool):
     """Per-device ring-of-flash op on kernel-layout operands ``[BH, S/n, D]`` (f32),
@@ -334,8 +367,8 @@ def _make_ring_flash_op(axis_name: str, n: int, causal: bool):
     non-causal flash kernel, the causal flash kernel, or skips the block outright
     (future hops cost no kernel launch; their fetch already rode the ring). No
     per-offset masks enter the kernels. The naive ring order leaves device i with
-    ``i+1`` live hops of ``n`` — the inherent load imbalance of causal ring attention
-    (a zig-zag block schedule would level it; not implemented).
+    ``i+1`` live hops of ``n`` — the inherent load imbalance of causal ring attention;
+    ``zigzag_ring_flash_attention`` is the leveled schedule.
 
     Backward: the saved residuals are the inputs plus the MERGED ``(out, lse)`` only —
     O(S·D) per device, no score matrix. Each reverse hop recomputes the block's softmax
@@ -364,13 +397,10 @@ def _make_ring_flash_op(axis_name: str, n: int, causal: bool):
 
             def apply(flag):
                 def f(args):
-                    acc, m, l, kb, vb = args
-                    out3, lse = pa.flash_forward_with_lse(q3, kb, vb, causal=flag)
-                    lse_rows = jnp.transpose(lse, (0, 1, 3, 2)).reshape(bh, sq, 1)
-                    m_new = jnp.maximum(m, lse_rows)
-                    corr = jnp.exp(m - m_new)
-                    w = jnp.exp(lse_rows - m_new)
-                    return acc * corr + out3 * w, m_new, l * corr + w
+                    kb, vb = args[3], args[4]
+                    return _flash_merge(
+                        args[:3], *pa.flash_forward_with_lse(q3, kb, vb,
+                                                             causal=flag))
                 return f
 
             args = (acc, m, l, k_blk, v_blk)
@@ -393,11 +423,8 @@ def _make_ring_flash_op(axis_name: str, n: int, causal: bool):
             hop, (acc0, m0, l0, k3, v3), jnp.arange(n - 1))
         acc, m, l = fold((acc, m, l), k_last, v_last,
                          (my_index - (n - 1)) % n)
-        # Under causal masking the diagonal hop gives every query at least itself, so
-        # l > 0; the guard only protects pathological all-masked rows.
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        out3 = acc / l_safe
-        lse4 = (m + jnp.log(l_safe)).reshape(bh, nq, pa.BLOCK)[:, :, None, :]
+        out3, lse_rows = _flash_finish((acc, m, l))
+        lse4 = lse_rows.reshape(bh, nq, pa.BLOCK)[:, :, None, :]
         return out3, lse4
 
     @jax.custom_vjp
@@ -499,3 +526,187 @@ def ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *
                              (0, 2, 1, 3)).astype(ql.dtype)
 
     return _ring(q, k, v)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_zigzag_flash_op(axis_name: str, n: int):
+    """Per-device zig-zag ring-of-flash op on ``[BH, 2c, D]`` f32 chunk pairs, with a
+    custom VJP — the load-balanced causal schedule with Pallas flash kernels on every
+    live chunk pair.
+
+    Same structure as ``_make_ring_flash_op`` (separate online-softmax carries per
+    local chunk, global-lse blockwise backward, dk/dv riding the ring), with the
+    zig-zag case analysis of ``zigzag_ring_attention``: per hop the early-vs-late
+    pair is statically skipped, the late-vs-early pair always runs the non-causal
+    kernel, and the two same-parity pairs switch between skip / non-causal / causal
+    (the diagonal needs only the kernels' LOCAL blockwise causal masking, since a
+    chunk pair on the diagonal shares its global offset)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
+        pallas_attention as pa,
+    )
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def rot(x):
+        return lax.ppermute(x, axis_name, perm)
+
+    def _lse4(rows, nq):
+        """[BH, c] rows → the kernels' [BH, nq, 1, BLOCK] statistics layout."""
+        bh = rows.shape[0]
+        return rows.reshape(bh, nq, pa.BLOCK)[:, :, None, :]
+
+    def _forward(q3, k3, v3):
+        bh, s2, d = q3.shape
+        c = s2 // 2
+        my_index = lax.axis_index(axis_name)
+        qa, qb = q3[:, :c], q3[:, c:]
+
+        def merge(carry, qx, k_blk, v_blk, flag):
+            return _flash_merge(
+                carry, *pa.flash_forward_with_lse(qx, k_blk, v_blk, causal=flag))
+
+        def pair(carry, qx, k_blk, v_blk, q_chunk, k_chunk):
+            return lax.switch(
+                _case_index(k_chunk, q_chunk),
+                [lambda a: a[:3],
+                 lambda a: merge(a[:3], qx, a[3], a[4], False),
+                 lambda a: merge(a[:3], qx, a[3], a[4], True)],
+                (*carry, k_blk, v_blk))
+
+        def fold(ca, cb, k_cur, v_cur, o):
+            ko, k2 = k_cur[:, :c], k_cur[:, c:]
+            vo, v2 = v_cur[:, :c], v_cur[:, c:]
+            # Static pair outcomes as in zigzag_ring_attention: early-vs-late never
+            # fires; late-vs-early is always fully visible.
+            ca = pair(ca, qa, ko, vo, my_index, o)
+            cb = merge(cb, qb, ko, vo, False)
+            cb = pair(cb, qb, k2, v2, 2 * n - 1 - my_index, 2 * n - 1 - o)
+            return ca, cb
+
+        def hop(carry, t):
+            ca, cb, k_cur, v_cur = carry
+            ca, cb = fold(ca, cb, k_cur, v_cur, (my_index - t) % n)
+            return (ca, cb, rot(k_cur), rot(v_cur)), None
+
+        def init():
+            return (jnp.zeros((bh, c, d), jnp.float32),
+                    jnp.full((bh, c, 1), MASK_VALUE, jnp.float32),
+                    jnp.zeros((bh, c, 1), jnp.float32))
+
+        (ca, cb, k_last, v_last), _ = lax.scan(
+            hop, (init(), init(), k3, v3), jnp.arange(n - 1))
+        ca, cb = fold(ca, cb, k_last, v_last, (my_index - (n - 1)) % n)
+
+        out_a, lse_a = _flash_finish(ca)
+        out_b, lse_b = _flash_finish(cb)
+        lse_a, lse_b = lse_a[..., 0], lse_b[..., 0]              # rows [BH, c]
+        return (jnp.concatenate([out_a, out_b], axis=1),
+                jnp.concatenate([lse_a, lse_b], axis=1))         # lse rows [BH, 2c]
+
+    @jax.custom_vjp
+    def op(q3, k3, v3):
+        return _forward(q3, k3, v3)[0]
+
+    def fwd(q3, k3, v3):
+        out3, lse_rows = _forward(q3, k3, v3)
+        return out3, (q3, k3, v3, out3, lse_rows)
+
+    def bwd(res, g):
+        q3, k3, v3, out3, lse_rows = res
+        bh, s2, d = q3.shape
+        c = s2 // 2
+        nq = c // pa.BLOCK
+        my_index = lax.axis_index(axis_name)
+        g = g.astype(jnp.float32)
+        qa, qb = q3[:, :c], q3[:, c:]
+        ga, gb = g[:, :c], g[:, c:]
+        delta_rows = jnp.sum(g * out3, axis=-1)                  # [BH, 2c]
+        stats_a = (_lse4(lse_rows[:, :c], nq), _lse4(delta_rows[:, :c], nq))
+        stats_b = (_lse4(lse_rows[:, c:], nq), _lse4(delta_rows[:, c:], nq))
+
+        def contrib(qx, gx, stats, k_blk, v_blk, q_chunk, k_chunk):
+            args = (qx, k_blk, v_blk, gx, *stats)
+            return lax.switch(
+                _case_index(k_chunk, q_chunk),
+                [lambda a: (jnp.zeros_like(qx), jnp.zeros_like(a[1]),
+                            jnp.zeros_like(a[2])),
+                 lambda a: pa.flash_backward_blocks(*a, causal=False),
+                 lambda a: pa.flash_backward_blocks(*a, causal=True)], args)
+
+        def fold(dqa, dqb, dk_cur, dv_cur, k_cur, v_cur, o):
+            ko, k2 = k_cur[:, :c], k_cur[:, c:]
+            vo, v2 = v_cur[:, :c], v_cur[:, c:]
+            d1q, d1k, d1v = contrib(qa, ga, stats_a, ko, vo, my_index, o)
+            d2q, d2k, d2v = pa.flash_backward_blocks(qb, ko, vo, gb, *stats_b,
+                                                     causal=False)
+            d3q, d3k, d3v = contrib(qb, gb, stats_b, k2, v2,
+                                    2 * n - 1 - my_index, 2 * n - 1 - o)
+            dqa = dqa + d1q
+            dqb = dqb + d2q + d3q
+            dk_cur = dk_cur + jnp.concatenate([d1k + d2k, d3k], axis=1)
+            dv_cur = dv_cur + jnp.concatenate([d1v + d2v, d3v], axis=1)
+            return dqa, dqb, dk_cur, dv_cur
+
+        def hop(carry, t):
+            dqa, dqb, dk_cur, dv_cur, k_cur, v_cur = carry
+            dqa, dqb, dk_cur, dv_cur = fold(dqa, dqb, dk_cur, dv_cur,
+                                            k_cur, v_cur, (my_index - t) % n)
+            return (dqa, dqb, rot(dk_cur), rot(dv_cur),
+                    rot(k_cur), rot(v_cur)), None
+
+        init = (jnp.zeros_like(qa), jnp.zeros_like(qb),
+                jnp.zeros_like(k3), jnp.zeros_like(v3), k3, v3)
+        (dqa, dqb, dk_t, dv_t, k_last, v_last), _ = lax.scan(
+            hop, init, jnp.arange(n - 1))
+        dqa, dqb, dk_t, dv_t = fold(dqa, dqb, dk_t, dv_t, k_last, v_last,
+                                    (my_index - (n - 1)) % n)
+        # After n-1 rotations the traveling dk/dv sit one hop short of home.
+        return jnp.concatenate([dqa, dqb], axis=1), rot(dk_t), rot(dv_t)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def zigzag_ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array,
+                                v: jax.Array, *,
+                                axis_name: str = "seq") -> jax.Array:
+    """Zig-zag ring-of-flash: the full long-context causal training composition —
+    load-balanced zig-zag scheduling across chips (uniform per-hop work), Pallas
+    flash kernels within every live chunk pair (no score matrix anywhere), and a
+    custom VJP so it TRAINS. Causal-only, like the schedule itself.
+
+    Requires ``S % (2·shards·BLOCK) == 0`` (each zig-zag chunk must be flash-block
+    aligned). Drop-in for ``ring_flash_attention(..., causal=True)``; pinned to the
+    dense causal oracle — forward and gradients — in ``tests/test_ring_attention.py``.
+    """
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
+        pallas_attention as pa,
+    )
+
+    n = mesh.shape[axis_name]
+    b, s, h, d = q.shape
+    if s % (2 * n * pa.BLOCK):
+        raise ValueError(
+            f"zigzag ring-of-flash needs sequence length divisible by "
+            f"2·shards·BLOCK = 2·{n}·{pa.BLOCK}, got {s}")
+    c = s // (2 * n)
+    order, inv = _zigzag_order(n)
+    spec = _qkv_spec(mesh, q.shape, axis_name)
+    op = _make_zigzag_flash_op(axis_name, n)
+
+    def to_zigzag(x):
+        return x.reshape(b, 2 * n, c, h, d)[:, jnp.asarray(order)].reshape(
+            b, s, h, d)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+             check_vma=False)
+    def _ring(ql, kl, vl):
+        lb, ls, lh, ld = ql.shape
+        to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(
+            lb * lh, ls, ld).astype(jnp.float32)
+        out3 = op(to3(ql), to3(kl), to3(vl))
+        return jnp.transpose(out3.reshape(lb, lh, ls, ld),
+                             (0, 2, 1, 3)).astype(ql.dtype)
+
+    out = _ring(to_zigzag(q), to_zigzag(k), to_zigzag(v))
+    return out.reshape(b, 2 * n, c, h, d)[:, jnp.asarray(inv)].reshape(b, s, h, d)

@@ -170,18 +170,30 @@ def main(config: ComposedConfig = ComposedConfig(), *,
 
     attention_fn = None
     if config.zigzag_attention:
-        if config.flash_attention:
-            raise ValueError("--zigzag-attention and --flash-attention are mutually "
-                             "exclusive")
         if not config.causal:
             raise ValueError("--zigzag-attention is causal-only — add --causal")
         if "seq" not in mesh.shape:
             raise ValueError("--zigzag-attention needs a seq axis in --mesh")
-        if config.seq_len % (2 * max(seq_size, 1)):
-            raise ValueError(
-                f"--zigzag-attention needs seq_len divisible by 2·seq_axis = "
-                f"{2 * max(seq_size, 1)}, got {config.seq_len}")
-        attention_fn = make_ring_attention_fn(mesh, use_zigzag=True)
+        if config.flash_attention:
+            # Both flags: the full long-context causal composition — zig-zag load
+            # balance across chips, flash kernels within each live chunk pair.
+            from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
+                pallas_attention as pa,
+            )
+            chunk = 2 * max(seq_size, 1) * pa.BLOCK
+            if config.seq_len % chunk:
+                raise ValueError(
+                    f"--zigzag-attention --flash-attention needs seq_len divisible "
+                    f"by 2·seq_axis·BLOCK = {chunk}, got {config.seq_len} "
+                    f"(e.g. --seq-len {chunk})")
+            attention_fn = make_ring_attention_fn(mesh, use_flash=True,
+                                                  use_zigzag=True)
+        else:
+            if config.seq_len % (2 * max(seq_size, 1)):
+                raise ValueError(
+                    f"--zigzag-attention needs seq_len divisible by 2·seq_axis = "
+                    f"{2 * max(seq_size, 1)}, got {config.seq_len}")
+            attention_fn = make_ring_attention_fn(mesh, use_zigzag=True)
     elif config.flash_attention:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
             pallas_attention as pa,

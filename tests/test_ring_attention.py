@@ -200,6 +200,44 @@ def test_zigzag_matches_dense_causal(seq_mesh):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_zigzag_ring_of_flash_matches_dense_causal(seq_mesh):
+    """Zig-zag ring-OF-FLASH (load-balanced causal schedule + Pallas flash kernels on
+    every live chunk pair + custom VJP) equals the dense causal oracle, forward and
+    gradients — the complete long-context causal training composition."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_attention import (
+        zigzag_ring_flash_attention,
+    )
+
+    q, k, v = _qkv(b=1, s=2048, h=1, d=32, seed=12)
+    out = zigzag_ring_flash_attention(seq_mesh, q, k, v)
+    ref = ops.full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    def make_loss(attn):
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v)))
+
+    ref_grads = jax.grad(make_loss(
+        lambda q, k, v: ops.full_attention(q, k, v, causal=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    zz_grads = jax.grad(make_loss(
+        lambda q, k, v: zigzag_ring_flash_attention(seq_mesh, q, k, v)),
+        argnums=(0, 1, 2))(q, k, v)
+    for g_ref, g_zz in zip(ref_grads, zz_grads):
+        np.testing.assert_allclose(np.asarray(g_zz), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_zigzag_flash_divisibility_enforced(seq_mesh):
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_attention import (
+        zigzag_ring_flash_attention,
+    )
+
+    q, k, v = _qkv(b=1, s=1024, h=1, d=32, seed=13)  # 1024 % (2·8·128) != 0
+    with pytest.raises(ValueError, match="2·shards·BLOCK"):
+        zigzag_ring_flash_attention(seq_mesh, q, k, v)
+
+
 def test_zigzag_divisibility_enforced(seq_mesh):
     from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_attention import (
         zigzag_ring_attention,

@@ -205,10 +205,11 @@ def test_zigzag_guards(tiny_datasets):
         composed.main(ComposedConfig(mesh="data=2,seq=2", zigzag_attention=True,
                                      results_dir=""),
                       datasets=tiny_datasets)
-    with pytest.raises(ValueError, match="mutually exclusive"):
+    # both flags compose (zig-zag ring-of-flash) but need flash-aligned chunks
+    with pytest.raises(ValueError, match="2·seq_axis·BLOCK"):
         composed.main(ComposedConfig(mesh="data=2,seq=2", zigzag_attention=True,
                                      flash_attention=True, causal=True,
-                                     results_dir=""),
+                                     seq_len=16, results_dir=""),
                       datasets=tiny_datasets)
     with pytest.raises(ValueError, match="needs a seq axis"):
         composed.main(ComposedConfig(mesh="data=4", zigzag_attention=True,
