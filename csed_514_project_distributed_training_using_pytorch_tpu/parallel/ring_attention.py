@@ -332,6 +332,23 @@ def zigzag_ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, 
     return out.reshape(b, 2 * n, c, h, d)[:, jnp.asarray(inv)].reshape(b, s, h, d)
 
 
+def _apply_in_kernel_layout(op, ql, kl, vl):
+    """Run a ``[BH, S_local, D]`` kernel-layout op on ``[B, S, H, D]`` local shards.
+
+    Converts to the kernel layout ONCE and promotes to f32 at entry: the flash kernel
+    emits its output in the input dtype, and merging n bf16-rounded partials would
+    lose precision the f32 merge math cannot recover. K/V then ride the ring in 3-D
+    form (ppermute is shape-agnostic) — no per-hop relayout. Uses LOCAL (not global)
+    b/h sizes: the batch/head dims may be sharded over data/model (``_qkv_spec``).
+    Shared by both ring-of-flash shard_map bodies."""
+    lb, ls, lh, ld = ql.shape
+    to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(
+        lb * lh, ls, ld).astype(jnp.float32)
+    out3 = op(to3(ql), to3(kl), to3(vl))
+    return jnp.transpose(out3.reshape(lb, lh, ls, ld),
+                         (0, 2, 1, 3)).astype(ql.dtype)
+
+
 def _flash_merge(carry, out3, lse4):
     """Merge one flash-kernel partial — ``out3 [BH, S, D]`` plus its log-sum-exp in
     the kernels' ``[BH, S/BLOCK, 1, BLOCK]`` statistics layout — into the blockwise-
@@ -513,17 +530,7 @@ def ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
              check_vma=False)
     def _ring(ql, kl, vl):
-        lb, ls, lh, ld = ql.shape
-        # Convert to the kernel layout ONCE and promote to f32 at entry: the kernel
-        # emits its output in the input dtype, and merging n bf16-rounded partials
-        # would lose precision the f32 merge math cannot recover. K/V ride the ring in
-        # 3-D form (ppermute is shape-agnostic) — no per-hop relayout. Local (not
-        # global) b/h sizes: the batch/head dims may be sharded over data/model.
-        to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(
-            lb * lh, ls, ld).astype(jnp.float32)
-        out3 = op(to3(ql), to3(kl), to3(vl))
-        return jnp.transpose(out3.reshape(lb, lh, ls, ld),
-                             (0, 2, 1, 3)).astype(ql.dtype)
+        return _apply_in_kernel_layout(op, ql, kl, vl)
 
     return _ring(q, k, v)
 
@@ -701,12 +708,7 @@ def zigzag_ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array,
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
              check_vma=False)
     def _ring(ql, kl, vl):
-        lb, ls, lh, ld = ql.shape
-        to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(
-            lb * lh, ls, ld).astype(jnp.float32)
-        out3 = op(to3(ql), to3(kl), to3(vl))
-        return jnp.transpose(out3.reshape(lb, lh, ls, ld),
-                             (0, 2, 1, 3)).astype(ql.dtype)
+        return _apply_in_kernel_layout(op, ql, kl, vl)
 
     out = _ring(to_zigzag(q), to_zigzag(k), to_zigzag(v))
     return out.reshape(b, 2 * n, c, h, d)[:, jnp.asarray(inv)].reshape(b, s, h, d)
