@@ -47,6 +47,34 @@ class TestTorchDefaultInit:
         assert np.abs(b).max() <= 1.0 / np.sqrt(320)
 
 
+def test_restore_for_resume_warns_on_step_mismatch(tmp_path):
+    """The shared resume prologue flags a checkpoint whose step count is not a whole
+    number of THIS config's epochs — the tell-tale of a mid-epoch checkpoint or a
+    different batch size (previously a silent wrong-epoch resume)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        checkpoint,
+    )
+
+    state = create_train_state(Net(), jax.random.PRNGKey(0))
+    state = state._replace(step=jnp.asarray(62, jnp.int32))
+    path = str(tmp_path / "ckpt.msgpack")
+    checkpoint.save_train_state(path, state)
+    template = create_train_state(Net(), jax.random.PRNGKey(1))
+
+    restored, start_epoch, warning = checkpoint.restore_for_resume(
+        path, template, process_index=0, process_count=1, steps_per_epoch=31)
+    assert int(restored.step) == 62 and start_epoch == 2 and warning is None
+
+    restored, start_epoch, warning = checkpoint.restore_for_resume(
+        path, template, process_index=0, process_count=1, steps_per_epoch=16)
+    assert start_epoch == 3
+    assert warning is not None and "different batch size" in warning
+
+
 def test_maybe_profile_writes_trace(tmp_path):
     log_dir = str(tmp_path / "trace")
     with maybe_profile(True, log_dir):
