@@ -200,6 +200,23 @@ def test_zigzag_matches_dense_causal(seq_mesh):
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_ring_of_flash_bf16_inputs(seq_mesh):
+    """bfloat16 q/k/v (the --bf16 --flash-attention path): the ring promotes to f32
+    once at kernel-layout entry, merges partials in f32, and returns the input dtype
+    — so the result matches the f32 reference to bf16 resolution."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_attention import (
+        ring_flash_attention,
+    )
+
+    q, k, v = _qkv(b=1, s=1024, h=2, d=64, seed=14)
+    ref = ops.full_attention(q, k, v)
+    out = ring_flash_attention(seq_mesh, q.astype(jnp.bfloat16),
+                               k.astype(jnp.bfloat16), v.astype(jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+
+
 def test_zigzag_ring_of_flash_matches_dense_causal(seq_mesh):
     """Zig-zag ring-OF-FLASH (load-balanced causal schedule + Pallas flash kernels on
     every live chunk pair + custom VJP) equals the dense causal oracle, forward and
