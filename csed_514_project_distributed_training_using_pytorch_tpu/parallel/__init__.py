@@ -27,6 +27,10 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_atten
     zigzag_ring_attention,
     zigzag_ring_flash_attention,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ulysses import (
+    ulysses_attention,
+    make_ulysses_attention_fn,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel.tensor_parallel import (
     param_partition_specs,
     shard_train_state,
@@ -56,6 +60,8 @@ __all__ = [
     "make_ring_attention_fn",
     "zigzag_ring_attention",
     "zigzag_ring_flash_attention",
+    "ulysses_attention",
+    "make_ulysses_attention_fn",
     "param_partition_specs",
     "shard_train_state",
     "compile_step_tp",

@@ -5,7 +5,9 @@ Beyond-parity user surface (the reference's only distributed mode is DP —
 transformer family with any combination of
 
 - ``data``  — batch sharding + compiler-inserted gradient all-reduce (DP),
-- ``seq``   — ring attention over a sequence-sharded axis (SP, ``parallel/ring_attention.py``),
+- ``seq``   — sequence/context parallelism over a sequence-sharded axis: ring attention
+  (``parallel/ring_attention.py``, the default) or the head-scatter all-to-all schedule
+  (``--seq-impl ulysses``, ``parallel/ulysses.py``),
 - ``model`` — Megatron column/row weight sharding (TP, ``parallel/tensor_parallel.py``),
 - ``expert`` — Switch MoE blocks with expert-sharded weights (EP,
   ``parallel/expert_parallel.py``; the axis size sets the expert count, and the
@@ -46,6 +48,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     initialize_cluster,
     make_mesh,
     make_ring_attention_fn,
+    make_ulysses_attention_fn,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     data_parallel as dp,
@@ -169,7 +172,20 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                 f"{config.pipeline_microbatches} pipeline microbatches")
 
     attention_fn = None
-    if config.zigzag_attention:
+    if config.seq_impl not in ("ring", "ulysses"):
+        raise ValueError(
+            f"--seq-impl must be 'ring' or 'ulysses', got {config.seq_impl!r}")
+    if config.seq_impl == "ulysses" and config.zigzag_attention:
+        raise ValueError("--zigzag-attention is a ring schedule — it does not "
+                         "compose with --seq-impl ulysses")
+    if config.seq_impl == "ulysses" and seq_size > 1:
+        # Head-scatter all-to-all SP (parallel/ulysses.py); the wrapper enforces
+        # seq_len/head divisibility with actionable messages. --flash-attention
+        # selects the flash kernel as the full-sequence local op. Without a seq axis
+        # the impl choice is moot and the flash/dense chain below applies unchanged.
+        attention_fn = make_ulysses_attention_fn(
+            mesh, use_flash=config.flash_attention)
+    elif config.zigzag_attention:
         if not config.causal:
             raise ValueError("--zigzag-attention is causal-only — add --causal")
         if "seq" not in mesh.shape:

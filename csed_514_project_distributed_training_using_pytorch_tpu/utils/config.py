@@ -155,6 +155,13 @@ class ComposedConfig:
     zigzag_attention: bool = False      # load-balanced zig-zag causal ring schedule
                                         # (parallel.zigzag_ring_attention); requires
                                         # --causal and seq_len % (2*seq_axis) == 0
+    seq_impl: str = "ring"              # sequence-parallel schedule under a seq axis:
+                                        # 'ring' (K/V ppermute rotation) or 'ulysses'
+                                        # (head-scatter all-to-all,
+                                        # parallel.ulysses_attention — needs
+                                        # heads % (model_axis*seq_axis) == 0; composes
+                                        # with --flash-attention, not
+                                        # --zigzag-attention)
     resume_from: str = ""               # full-TrainState checkpoint to resume from;
                                         # checkpoints are layout-standard, so a run
                                         # resumes from ANY mesh's checkpoint (incl.

@@ -128,6 +128,60 @@ def test_flash_attention_mesh_invariant(tmp_path, tiny_datasets):
                                rtol=1e-4, atol=1e-6)
 
 
+def test_ulysses_mesh_invariant(tmp_path, tiny_datasets):
+    """--seq-impl ulysses with a seq axis trains through the head-scatter all-to-all
+    schedule (parallel/ulysses.py) and reproduces the plain-DP dense trajectory —
+    the all-to-all analog of the ring's mesh-invariance guarantee."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100,
+                  max_train_examples=256)
+    state_u, hist_u = composed.main(
+        ComposedConfig(mesh="data=2,seq=2", seq_impl="ulysses",
+                       results_dir=str(tmp_path / "uly"), **common),
+        datasets=tiny_datasets)
+    state_d, hist_d = composed.main(
+        ComposedConfig(mesh="data=4", results_dir=str(tmp_path / "uly_dense"),
+                       **common),
+        datasets=tiny_datasets)
+    np.testing.assert_allclose(hist_u.train_losses, hist_d.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(state_u.params["pos_embed"]),
+                               np.asarray(state_d.params["pos_embed"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_ulysses_flash_mesh_invariant(tmp_path, tiny_datasets):
+    """--seq-impl ulysses --flash-attention: the Pallas flash kernel as the
+    full-sequence local op behind the all-to-alls, matching the dense trajectory."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100, seq_len=256,
+                  max_train_examples=256)
+    state_u, hist_u = composed.main(
+        ComposedConfig(mesh="data=2,seq=2", seq_impl="ulysses",
+                       flash_attention=True,
+                       results_dir=str(tmp_path / "ulyf"), **common),
+        datasets=tiny_datasets)
+    state_d, hist_d = composed.main(
+        ComposedConfig(mesh="data=4", results_dir=str(tmp_path / "ulyf_dense"),
+                       **common),
+        datasets=tiny_datasets)
+    np.testing.assert_allclose(hist_u.train_losses, hist_d.train_losses,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_rejects_zigzag(tiny_datasets):
+    with pytest.raises(ValueError, match="ring schedule"):
+        composed.main(ComposedConfig(mesh="data=2,seq=2", seq_impl="ulysses",
+                                     zigzag_attention=True, causal=True,
+                                     results_dir=""),
+                      datasets=tiny_datasets)
+
+
+def test_unknown_seq_impl_rejected(tiny_datasets):
+    with pytest.raises(ValueError, match="seq-impl"):
+        composed.main(ComposedConfig(mesh="data=2,seq=2", seq_impl="ulyssess",
+                                     results_dir=""),
+                      datasets=tiny_datasets)
+
+
 def test_flash_attention_seq_len_guard(tiny_datasets):
     with pytest.raises(ValueError, match="flash-attention needs seq_len"):
         composed.main(ComposedConfig(mesh="data=2,seq=2", flash_attention=True,

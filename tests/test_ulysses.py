@@ -1,0 +1,140 @@
+"""All-to-all (Ulysses) sequence parallelism: parity against the dense oracle.
+
+The contract (``parallel/ulysses.py``): attention over a sequence sharded across a mesh
+axis — re-sharded head-wise by one all-to-all, computed locally over the full sequence,
+and re-sharded back — equals ``ops.full_attention`` to float32 round-off, forward AND
+reverse-mode, for both maskings, with either the dense einsum or the Pallas flash
+kernel as the local op. Runs on the 8-virtual-CPU-device platform (conftest), the same
+SPMD program a TPU slice executes with all-to-alls on ICI.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu import ops
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+    make_mesh,
+    make_ulysses_attention_fn,
+    ulysses_attention,
+)
+
+
+def _qkv(b=2, s=32, h=8, d=8, seed=0):
+    # h=8: the all-to-all scatters heads, so the head count must divide the axis size.
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+                 for _ in range(3))
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    return make_mesh(8, axis_names=("seq",))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense_forward(seq_mesh, causal):
+    q, k, v = _qkv()
+    ref = ops.full_attention(q, k, v, causal=causal)
+    out = ulysses_attention(seq_mesh, q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense_gradients(seq_mesh, causal):
+    q, k, v = _qkv(seed=1)
+
+    def make_loss(attn):
+        # sin keeps the cotangent non-trivial in every element.
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v, causal=causal)))
+
+    ref_grads = jax.grad(make_loss(ops.full_attention), argnums=(0, 1, 2))(q, k, v)
+    uly = make_ulysses_attention_fn(seq_mesh)
+    uly_grads = jax.grad(make_loss(uly), argnums=(0, 1, 2))(q, k, v)
+    for g_ref, g_uly in zip(ref_grads, uly_grads):
+        np.testing.assert_allclose(np.asarray(g_uly), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_under_jit(seq_mesh):
+    q, k, v = _qkv(seed=2)
+    jitted = jax.jit(lambda q, k, v: ulysses_attention(seq_mesh, q, k, v))
+    np.testing.assert_allclose(np.asarray(jitted(q, k, v)),
+                               np.asarray(ops.full_attention(q, k, v)),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_matches_dense(causal):
+    # Flash local op needs the full (post-gather) sequence BLOCK-aligned; a 2-way mesh
+    # keeps the interpret-mode kernel cost down.
+    mesh = make_mesh(2, axis_names=("seq",))
+    q, k, v = _qkv(b=1, s=256, h=4, d=8, seed=3)
+    ref = ops.full_attention(q, k, v, causal=causal)
+    out = ulysses_attention(mesh, q, k, v, causal=causal, use_flash=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_flash_matches_dense_gradients():
+    mesh = make_mesh(2, axis_names=("seq",))
+    q, k, v = _qkv(b=1, s=256, h=4, d=8, seed=4)
+
+    def make_loss(attn):
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v, causal=True)))
+
+    ref_grads = jax.grad(make_loss(ops.full_attention), argnums=(0, 1, 2))(q, k, v)
+    uly = make_ulysses_attention_fn(mesh, use_flash=True)
+    uly_grads = jax.grad(make_loss(uly), argnums=(0, 1, 2))(q, k, v)
+    for g_ref, g_uly in zip(ref_grads, uly_grads):
+        np.testing.assert_allclose(np.asarray(g_uly), np.asarray(g_ref),
+                                   rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense_on_composed_mesh(causal):
+    # data×seq×model: batch co-shards over data, heads over model, and the all-to-all
+    # scatters the model-sharded LOCAL head count (8 heads / model=2 → 4 local, /seq=2).
+    mesh = make_mesh(8, axis_names=("data", "seq", "model"), axis_shape=(2, 2, 2))
+    q, k, v = _qkv(b=4, s=32, h=8, d=8, seed=5)
+    ref = ops.full_attention(q, k, v, causal=causal)
+    out = ulysses_attention(mesh, q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_respects_sequence_sharding(seq_mesh):
+    # The op must consume/produce sequence-sharded activations without resharding the
+    # boundary: committing the inputs to the seq sharding and asking for the same
+    # sharding out must be a no-op layout-wise.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    q, k, v = _qkv(seed=6)
+    sh = NamedSharding(seq_mesh, P(None, "seq", None, None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = jax.jit(lambda a, b, c: ulysses_attention(seq_mesh, a, b, c),
+                  out_shardings=sh)(qs, ks, vs)
+    assert out.sharding.is_equivalent_to(sh, out.ndim)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(ops.full_attention(q, k, v)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_indivisible_sequence_rejected(seq_mesh):
+    q, k, v = _qkv(s=36)
+    with pytest.raises(ValueError, match="not divisible"):
+        ulysses_attention(seq_mesh, q, k, v)
+
+
+def test_indivisible_heads_rejected(seq_mesh):
+    q, k, v = _qkv(h=4)   # 4 heads cannot scatter over 8 devices
+    with pytest.raises(ValueError, match="head count"):
+        ulysses_attention(seq_mesh, q, k, v)
+
+
+def test_flash_block_alignment_rejected():
+    mesh = make_mesh(2, axis_names=("seq",))
+    q, k, v = _qkv(s=64, h=4)
+    with pytest.raises(ValueError, match="BLOCK"):
+        ulysses_attention(mesh, q, k, v, use_flash=True)

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Poll for a live TPU window; when one opens, run the serialized hardware
+# follow-ups (tools/hw_followups.sh). The tunnelled chip claim is exclusive and
+# a killed holder can wedge the lease for hours, so the probe is a short-leash
+# child that exits cleanly on success and is SIGTERM'd on timeout.
+#
+#   bash tools/chip_watch.sh [max_polls] [sleep_seconds]
+set -u
+cd "$(dirname "$0")/.."
+MAX_POLLS=${1:-40}
+SLEEP_S=${2:-600}
+OUT=${HW_OUT:-/tmp/hw_r3}
+mkdir -p "$OUT"
+
+for ((i = 1; i <= MAX_POLLS; i++)); do
+  echo "[chip_watch] poll $i/$MAX_POLLS $(date -u +%H:%M:%S)"
+  timeout --signal=TERM 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    > "$OUT/poll.out" 2>&1
+  rc=$?
+  if [ $rc -eq 0 ]; then
+    echo "[chip_watch] TPU LIVE — running hw_followups.sh"
+    HW_OUT="$OUT" bash tools/hw_followups.sh 2>&1 | tee "$OUT/followups.log"
+    frc=${PIPESTATUS[0]}
+    echo "[chip_watch] followups done rc=$frc"
+    exit "$frc"
+  fi
+  echo "[chip_watch] not reachable (rc=$rc)"
+  [ "$i" -lt "$MAX_POLLS" ] && sleep "$SLEEP_S"
+done
+echo "[chip_watch] gave up after $MAX_POLLS polls"
+exit 1
