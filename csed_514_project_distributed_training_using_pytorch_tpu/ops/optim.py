@@ -1,6 +1,6 @@
-"""SGD with momentum — the reference's only optimizer, as a pure pytree transform.
+"""Optimizers as pure pytree transforms: SGD-momentum (the parity surface) + AdamW.
 
-Reproduces ``torch.optim.SGD(lr, momentum)`` semantics exactly (reference
+SGD reproduces ``torch.optim.SGD(lr, momentum)`` semantics exactly (reference
 ``src/train.py:60-61`` lr=0.01 mom=0.5; ``src/train_dist.py:66`` lr=0.02 mom=0.5), i.e. the
 torch update with no dampening/nesterov/weight-decay:
 
@@ -9,14 +9,45 @@ torch update with no dampening/nesterov/weight-decay:
 
 (Torch initializes the buffer to the first gradient; starting from v=0 gives the identical
 sequence since ``momentum*0 + g == g``.) Implemented first-party rather than via optax to keep
-the update rule explicit and dependency-free; it is a drop-in ``(init_fn, update_fn)`` pair in
-the optax style, so an optax ``GradientTransformation`` can be substituted where desired.
+the update rule explicit and dependency-free.
+
+AdamW (beyond-parity — the reference's only optimizer is SGD) reproduces
+``torch.optim.AdamW`` semantics (decoupled weight decay, bias correction) and is pinned
+against real torch in ``tests/test_optim.py``:
+
+    t <- t + 1
+    m <- b1*m + (1-b1)*g          v <- b2*v + (1-b2)*g²
+    p <- p - lr*(m/(1-b1^t) / (sqrt(v/(1-b2^t)) + eps) + weight_decay*p)
+
+State-shape contract (what keeps every sharding/checkpoint path working unchanged):
+``TrainState.velocity`` holds the optimizer state. For SGD it is a params-congruent
+velocity tree (the historical layout — old checkpoints restore as-is). For AdamW it is
+``{"m": <params tree>, "v": <params tree>, "count": int32 scalar}`` — each moment subtree
+is params-congruent, so the path/shape-driven partition-spec rules (``tensor_parallel``,
+``fsdp``) derive the SAME shardings for the moments as for their parameters (ZeRO-style)
+without pairing against the params tree; only code that restructures the state wholesale
+(the pipeline stack/unstack bridge) needs ``map_param_trees`` below.
 """
 
 from __future__ import annotations
 
+from typing import Callable, NamedTuple
+
 import jax
 import jax.numpy as jnp
+
+
+class Optimizer(NamedTuple):
+    """``(init, update, name, hyperparams)``: ``init(params) -> opt_state``;
+    ``update(params, opt_state, grads) -> (new_params, new_opt_state)``.
+    ``hyperparams`` records the constructor knobs — consumers that re-implement the
+    update (the fused Pallas SGD kernel path) read them from here so they can never
+    diverge from what the ``update`` closure applies."""
+
+    init: Callable
+    update: Callable
+    name: str
+    hyperparams: dict
 
 
 def sgd_init(params):
@@ -31,3 +62,81 @@ def sgd_update(params, velocity, grads, *, learning_rate: float, momentum: float
     new_params = jax.tree_util.tree_map(
         lambda p, v: p - learning_rate * v, params, new_velocity)
     return new_params, new_velocity
+
+
+def sgd(learning_rate: float, momentum: float) -> Optimizer:
+    """The reference's optimizer as an ``Optimizer`` pair (state = velocity tree)."""
+
+    def update(params, velocity, grads):
+        return sgd_update(params, velocity, grads,
+                          learning_rate=learning_rate, momentum=momentum)
+
+    return Optimizer(init=sgd_init, update=update, name="sgd",
+                     hyperparams={"learning_rate": learning_rate,
+                                  "momentum": momentum})
+
+
+def adamw_init(params):
+    """Zero first/second moments + step count (torch ``state['step']`` analog)."""
+    zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+    return {"m": zeros(), "v": zeros(), "count": jnp.zeros((), jnp.int32)}
+
+
+def adamw(learning_rate: float, *, b1: float = 0.9, b2: float = 0.999,
+          eps: float = 1e-8, weight_decay: float = 0.0) -> Optimizer:
+    """AdamW with torch semantics (decoupled decay; bias-corrected moments)."""
+
+    def update(params, opt_state, grads):
+        count = opt_state["count"] + 1
+        c = count.astype(jnp.float32)
+        m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1.0 - b1) * g,
+                                   opt_state["m"], grads)
+        v = jax.tree_util.tree_map(lambda v_, g: b2 * v_ + (1.0 - b2) * g * g,
+                                   opt_state["v"], grads)
+        bc1 = 1.0 - jnp.power(b1, c)
+        bc2 = 1.0 - jnp.power(b2, c)
+
+        def leaf(p, m_, v_):
+            step_dir = (m_ / bc1) / (jnp.sqrt(v_ / bc2) + eps)
+            return p - learning_rate * (step_dir + weight_decay * p)
+
+        new_params = jax.tree_util.tree_map(leaf, params, m, v)
+        return new_params, {"m": m, "v": v, "count": count}
+
+    return Optimizer(init=adamw_init, update=update, name="adamw",
+                     hyperparams={"learning_rate": learning_rate, "b1": b1,
+                                  "b2": b2, "eps": eps,
+                                  "weight_decay": weight_decay})
+
+
+def make_optimizer(name: str, *, learning_rate: float, momentum: float,
+                   weight_decay: float = 0.0) -> Optimizer:
+    """CLI-name → ``Optimizer`` (the trainers' ``--optimizer`` surface)."""
+    if name == "sgd":
+        if weight_decay:
+            raise ValueError("--weight-decay is an AdamW knob — the reference-parity "
+                             "SGD has none (reference src/train.py:60-61)")
+        return sgd(learning_rate, momentum)
+    if name == "adamw":
+        return adamw(learning_rate, weight_decay=weight_decay)
+    raise ValueError(f"unknown optimizer {name!r} — choose 'sgd' or 'adamw'")
+
+
+def is_adam_state(opt_state) -> bool:
+    """True for the AdamW moment-state layout (see the module docstring contract)."""
+    return isinstance(opt_state, dict) and set(opt_state) == {"m", "v", "count"}
+
+
+def map_param_trees(opt_state, fn: Callable, scalar_fn: Callable | None = None):
+    """Apply ``fn`` to every params-congruent subtree of an optimizer state.
+
+    SGD state IS one params-congruent tree → ``fn(state)``. AdamW state maps ``fn``
+    over the two moment trees and ``scalar_fn`` (default: identity) over the count —
+    the single seam that lets structure-rewriting code (the pipeline stack/unstack
+    bridge, the stacked-layout shardings) stay optimizer-agnostic.
+    """
+    if is_adam_state(opt_state):
+        keep = scalar_fn if scalar_fn is not None else (lambda x: x)
+        return {"m": fn(opt_state["m"]), "v": fn(opt_state["v"]),
+                "count": keep(opt_state["count"])}
+    return fn(opt_state)

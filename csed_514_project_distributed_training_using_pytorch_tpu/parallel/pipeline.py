@@ -252,6 +252,10 @@ def stacked_state_shardings(mesh: Mesh, state, *, axis_name: str = "stage"):
     device stores only its stage's layers — everything else replicates."""
     from jax.sharding import NamedSharding
 
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.optim import (
+        map_param_trees,
+    )
+
     stage_sh = NamedSharding(mesh, P(axis_name))
     rep = NamedSharding(mesh, P())
 
@@ -260,5 +264,10 @@ def stacked_state_shardings(mesh: Mesh, state, *, axis_name: str = "stage"):
                 "rest": jax.tree_util.tree_map(lambda _: rep, tree["rest"])}
 
     import csed_514_project_distributed_training_using_pytorch_tpu.train.step as _step
-    return _step.TrainState(params=tree_sh(state.params),
-                            velocity=tree_sh(state.velocity), step=rep)
+    # The optimizer state holds one stacked {"blocks","rest"} layout per params-
+    # congruent subtree (AdamW: each moment; SGD: the velocity itself) — shard each
+    # like the params; the AdamW step count replicates.
+    return _step.TrainState(
+        params=tree_sh(state.params),
+        velocity=map_param_trees(state.velocity, tree_sh, scalar_fn=lambda _: rep),
+        step=rep)

@@ -56,6 +56,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     pipeline,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     tensor_parallel as tp,
 )
@@ -251,7 +252,12 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     if steps_per_epoch == 0:
         raise ValueError(f"batch {config.batch_size} larger than the train split "
                          f"({n_train} examples) — nothing to step")
-    base_state = create_train_state(model, jax.random.PRNGKey(config.seed))
+    optimizer = optim.make_optimizer(config.optimizer,
+                                     learning_rate=config.learning_rate,
+                                     momentum=config.momentum,
+                                     weight_decay=config.weight_decay)
+    base_state = create_train_state(model, jax.random.PRNGKey(config.seed),
+                                    optimizer=optimizer)
     start_epoch = 0
     if config.resume_from:
         # Checkpoints are always in the standard per-name layout, so a composed run
@@ -277,12 +283,16 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         engine = pipeline.PipelinedClassifier(
             model, mesh, num_microbatches=config.pipeline_microbatches,
             batch_axis="data" if data_size > 1 else None)
-        sp, rp = pipeline.stack_transformer_blocks(base_state.params,
-                                                   model.num_layers)
-        sv, rv = pipeline.stack_transformer_blocks(base_state.velocity,
-                                                   model.num_layers)
-        stacked_state = TrainState({"blocks": sp, "rest": rp},
-                                   {"blocks": sv, "rest": rv}, base_state.step)
+        def to_stacked(tree):
+            stacked, rest = pipeline.stack_transformer_blocks(tree, model.num_layers)
+            return {"blocks": stacked, "rest": rest}
+
+        # The optimizer state bridges per params-congruent subtree (AdamW stacks each
+        # moment tree like the params; SGD velocity IS one such tree).
+        stacked_state = TrainState(to_stacked(base_state.params),
+                                   optim.map_param_trees(base_state.velocity,
+                                                         to_stacked),
+                                   base_state.step)
         state_sh = pipeline.stacked_state_shardings(mesh, stacked_state)
         state = jax.device_put(stacked_state, state_sh)
         idx_sh = (jax.sharding.NamedSharding(mesh, P(None, "data"))
@@ -290,7 +300,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         epoch_fn = jax.jit(
             make_epoch_fn(engine, learning_rate=config.learning_rate,
                           momentum=config.momentum,
-                          grad_accum=config.grad_accum),
+                          grad_accum=config.grad_accum, optimizer=optimizer),
             in_shardings=(state_sh, rep, rep, idx_sh, rep),
             out_shardings=(state_sh, rep), donate_argnums=(0,))
         param_shardings = state_sh.params
@@ -304,7 +314,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         epoch_fn = tp.compile_epoch_tp(
             make_epoch_fn(model, learning_rate=config.learning_rate,
                           momentum=config.momentum,
-                          grad_accum=config.grad_accum),
+                          grad_accum=config.grad_accum, optimizer=optimizer),
             mesh, data_axis="data" if data_size > 1 else None)
         param_shardings = tp.state_shardings(mesh, state).params
         eval_model = model
@@ -336,11 +346,11 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         interchange contract with every other mesh — stage layouts bridge back)."""
         host_state = jax.device_get(gather(state))
         if stage_size > 1:
+            unstack = lambda t: pipeline.unstack_transformer_blocks(t["blocks"],
+                                                                    t["rest"])
             host_state = TrainState(
-                pipeline.unstack_transformer_blocks(host_state.params["blocks"],
-                                                    host_state.params["rest"]),
-                pipeline.unstack_transformer_blocks(host_state.velocity["blocks"],
-                                                    host_state.velocity["rest"]),
+                unstack(host_state.params),
+                optim.map_param_trees(host_state.velocity, unstack),
                 host_state.step)
         return host_state
 

@@ -57,6 +57,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.sampler im
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
     TrainState, create_train_state, make_epoch_fn, make_eval_fn,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
 from csed_514_project_distributed_training_using_pytorch_tpu.utils import checkpoint
 from csed_514_project_distributed_training_using_pytorch_tpu.utils import metrics as M
 from csed_514_project_distributed_training_using_pytorch_tpu.utils import plotting
@@ -140,7 +141,11 @@ def main(config: DistributedConfig = DistributedConfig(), *,
 
     model = build_model(config.model, bf16=config.bf16, remat=config.remat,
                         causal=config.causal)
-    state = create_train_state(model, init_rng)
+    optimizer = optim.make_optimizer(config.optimizer,
+                                     learning_rate=config.learning_rate,
+                                     momentum=config.momentum,
+                                     weight_decay=config.weight_decay)
+    state = create_train_state(model, init_rng, optimizer=optimizer)
     steps_per_epoch = samplers[0].num_samples // per_replica_batch
     start_epoch = 0
     if config.resume_from:                        # the resume path the reference lacks
@@ -166,7 +171,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         make_epoch_fn(model, learning_rate=config.learning_rate,
                       momentum=config.momentum,
                       unroll=config.scan_unroll, pregather=config.pregather,
-                      grad_accum=config.grad_accum), mesh)
+                      grad_accum=config.grad_accum, optimizer=optimizer), mesh)
     eval_fn = dp.compile_eval(
         make_eval_fn(model, batch_size=config.batch_size_test), mesh,
         shard=config.shard_eval)
@@ -178,7 +183,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         step_fn = dp.compile_step(
             make_train_step(model, learning_rate=config.learning_rate,
                             momentum=config.momentum,
-                            grad_accum=config.grad_accum), mesh)
+                            grad_accum=config.grad_accum,
+                            optimizer=optimizer), mesh)
         col_lo, col_hi = _host_local_columns(mesh, per_replica_batch)
         M.log(f"Host-local feed: this process feeds global-batch columns "
               f"[{col_lo}:{col_hi}]")

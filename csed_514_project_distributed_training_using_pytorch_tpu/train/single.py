@@ -33,6 +33,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.models import (
     build_model,
     validate_model_config,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
     TrainState, create_train_state, make_epoch_fn, make_eval_fn, make_train_step,
 )
@@ -100,7 +101,15 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
 
     model = build_model(config.model, bf16=config.bf16, remat=config.remat,
                         causal=config.causal)
-    state = create_train_state(model, init_rng)
+    optimizer = optim.make_optimizer(config.optimizer,
+                                     learning_rate=config.learning_rate,
+                                     momentum=config.momentum,
+                                     weight_decay=config.weight_decay)
+    if config.optimizer != "sgd" and (config.use_pallas_kernels
+                                      or config.experimental_fused_step):
+        raise ValueError("--use-pallas-kernels/--experimental-fused-step fuse the "
+                         "SGD-momentum update — they require --optimizer sgd")
+    state = create_train_state(model, init_rng, optimizer=optimizer)
     resume_from = resume_from or config.resume_from or None
     if resume_from:                             # the restore path the reference lacks
         state = checkpoint.restore_train_state(resume_from, state)
@@ -133,13 +142,13 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                           momentum=config.momentum,
                           use_pallas=config.use_pallas_kernels,
                           unroll=config.scan_unroll, pregather=config.pregather,
-                          grad_accum=config.grad_accum),
+                          grad_accum=config.grad_accum, optimizer=optimizer),
             donate_argnums=(0,))
         step_fn = jax.jit(
             make_train_step(model, learning_rate=config.learning_rate,
                             momentum=config.momentum,
                             use_pallas=config.use_pallas_kernels,
-                            grad_accum=config.grad_accum),
+                            grad_accum=config.grad_accum, optimizer=optimizer),
             donate_argnums=(0,))
     # The final partial batch (drop_last=False) is ragged and need not divide by
     # grad_accum; accumulation is a memory knob, so the tail just steps unaccumulated.
@@ -149,7 +158,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         tail_step_fn = jax.jit(
             make_train_step(model, learning_rate=config.learning_rate,
                             momentum=config.momentum,
-                            use_pallas=config.use_pallas_kernels),
+                            use_pallas=config.use_pallas_kernels,
+                            optimizer=optimizer),
             donate_argnums=(0,))
     eval_fn = jax.jit(make_eval_fn(model, batch_size=config.batch_size_test))
 

@@ -31,13 +31,18 @@ from jax import lax
 
 from csed_514_project_distributed_training_using_pytorch_tpu import ops
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.optim import (
+    Optimizer,
+    sgd,
     sgd_init,
-    sgd_update,
 )
 
 
 class TrainState(NamedTuple):
-    """Model + optimizer state as one pytree (params, SGD velocity, global step)."""
+    """Model + optimizer state as one pytree (params, optimizer state, global step).
+
+    ``velocity`` is the optimizer state: the SGD velocity tree historically (and for
+    ``--optimizer sgd`` today), or the AdamW moment state — see the state-shape
+    contract in ``ops/optim.py``. The field name stays for checkpoint compatibility."""
 
     params: dict
     velocity: dict
@@ -45,20 +50,23 @@ class TrainState(NamedTuple):
 
 
 def create_train_state(model, rng: jax.Array,
-                       sample_input_shape=(1, 28, 28, 1)) -> TrainState:
+                       sample_input_shape=(1, 28, 28, 1), *,
+                       optimizer: Optimizer | None = None) -> TrainState:
     """Initialize params (PyTorch-default distributions, see ``ops/initializers.py``) and
-    zero velocity. Under SPMD every process derives identical state from the same seed — the
-    replica-consistency analog of DDP's initial parameter broadcast
-    (reference ``src/train_dist.py:63``)."""
+    zero optimizer state (SGD velocity by default). Under SPMD every process derives
+    identical state from the same seed — the replica-consistency analog of DDP's initial
+    parameter broadcast (reference ``src/train_dist.py:63``)."""
     variables = model.init({"params": rng}, jnp.zeros(sample_input_shape))
     params = variables["params"]
-    return TrainState(params=params, velocity=sgd_init(params),
+    opt_init = optimizer.init if optimizer is not None else sgd_init
+    return TrainState(params=params, velocity=opt_init(params),
                       step=jnp.zeros((), jnp.int32))
 
 
 def make_train_step(model, *, learning_rate: float, momentum: float,
                     use_pallas: bool = False, grad_accum: int = 1,
-                    aux_loss_weight: float = 0.01) -> Callable:
+                    aux_loss_weight: float = 0.01,
+                    optimizer: Optimizer | None = None) -> Callable:
     """Build ``step(state, images, labels, rng) -> (state, loss)``.
 
     The loss is the canonical ``nll(log_probs)`` formulation (see
@@ -81,9 +89,19 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     transformer's load-balance term, ``models/transformer.py``) have their sum added to
     the objective scaled by ``aux_loss_weight``; for every other model the collection is
     empty and the term is exactly zero.
+
+    ``optimizer`` (an ``ops.optim.Optimizer``) swaps the update rule — e.g.
+    ``optim.adamw(...)``; ``None`` keeps the reference-parity SGD built from
+    ``learning_rate``/``momentum``. The state passed in must come from the matching
+    ``create_train_state(..., optimizer=...)``.
     """
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    if optimizer is None:
+        optimizer = sgd(learning_rate, momentum)
+    if use_pallas and optimizer.name != "sgd":
+        raise ValueError("use_pallas fuses the SGD-momentum update kernel — "
+                         f"optimizer {optimizer.name!r} is not supported there")
     if use_pallas:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
             pallas_kernels as pk,
@@ -102,12 +120,15 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
 
     def apply_update(state, grads, loss):
         if use_pallas:
+            # Hyperparams come from the Optimizer (not this function's kwargs) so an
+            # explicitly passed optim.sgd(...) can never silently diverge from what
+            # the kernel applies.
             params, velocity = pk.sgd_momentum_step(
                 state.params, state.velocity, grads,
-                learning_rate=learning_rate, momentum=momentum)
+                learning_rate=optimizer.hyperparams["learning_rate"],
+                momentum=optimizer.hyperparams["momentum"])
         else:
-            params, velocity = sgd_update(state.params, state.velocity, grads,
-                                          learning_rate=learning_rate, momentum=momentum)
+            params, velocity = optimizer.update(state.params, state.velocity, grads)
         return TrainState(params, velocity, state.step + 1), loss
 
     def step(state: TrainState, images, labels, rng) -> tuple[TrainState, jax.Array]:
@@ -147,7 +168,8 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
 
 def make_epoch_fn(model, *, learning_rate: float, momentum: float,
                   use_pallas: bool = False, unroll: int = 1,
-                  pregather: bool = False, grad_accum: int = 1) -> Callable:
+                  pregather: bool = False, grad_accum: int = 1,
+                  optimizer: Optimizer | None = None) -> Callable:
     """Build ``epoch(state, images, labels, idx_matrix, rng) -> (state, losses)``.
 
     ``images``/``labels`` are the full (device-resident) training split; ``idx_matrix`` is a
@@ -167,7 +189,8 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
     gather latency.
     """
     train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum,
-                                 use_pallas=use_pallas, grad_accum=grad_accum)
+                                 use_pallas=use_pallas, grad_accum=grad_accum,
+                                 optimizer=optimizer)
     return make_epoch_from_step(train_step, unroll=unroll, pregather=pregather)
 
 

@@ -27,6 +27,10 @@ class SingleProcessConfig:
     batch_size_test: int = 1000       # src/train.py:14
     learning_rate: float = 0.01       # src/train.py:15
     momentum: float = 0.5             # src/train.py:16
+    optimizer: str = "sgd"            # 'sgd' (reference parity, src/train.py:60-61) or
+                                      # 'adamw' (beyond-parity; torch.optim.AdamW
+                                      # semantics, ops/optim.py — momentum is then unused)
+    weight_decay: float = 0.0         # AdamW decoupled weight decay (adamw only)
     log_interval: int = 10            # src/train.py:17
     seed: int = 1                     # src/train.py:19 (torch.manual_seed(random_seed))
     data_dir: str = "files"           # src/train.py:26 ({CURR_PATH}/files/; one dir, not the
@@ -86,6 +90,9 @@ class DistributedConfig:
     batch_size_test: int = 1000       # src/train_dist.py:126
     learning_rate: float = 0.02       # src/train_dist.py:127
     momentum: float = 0.5             # src/train_dist.py:128
+    optimizer: str = "sgd"            # 'sgd' (reference parity) or 'adamw'
+                                      # (see SingleProcessConfig.optimizer)
+    weight_decay: float = 0.0         # AdamW decoupled weight decay (adamw only)
     log_interval: int = 10            # src/train_dist.py:129
     seed: int = 1                     # src/train_dist.py:135 (model/init seed)
     sampler_seed: int = 42            # src/train_dist.py:37 (DistributedSampler seed)
@@ -173,6 +180,11 @@ class ComposedConfig:
     batch_size_test: int = 1000
     learning_rate: float = 0.05
     momentum: float = 0.5
+    optimizer: str = "sgd"              # 'sgd' or 'adamw' (see
+                                        # SingleProcessConfig.optimizer); composes with
+                                        # every mesh incl. stage (moments bridge
+                                        # through the stacked layout)
+    weight_decay: float = 0.0           # AdamW decoupled weight decay (adamw only)
     dropout_rate: float = 0.0           # 0 keeps composed runs comparable across meshes
     seed: int = 1
     data_dir: str = "files"

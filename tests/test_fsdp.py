@@ -83,6 +83,42 @@ def test_fsdp_step_matches_single_device(mesh):
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_fsdp_adamw_moments_shard_and_match(mesh):
+    """AdamW under FSDP: both moment trees shard exactly like their parameters (the
+    per-leaf spec rules see params-congruent subtrees — ops/optim.py state contract)
+    and the sharded trajectory equals the unsharded AdamW step."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
+
+    model = TransformerClassifier(dropout_rate=0.0)
+    opt = optim.adamw(1e-3, weight_decay=0.01)
+    x, y = _batch(seed=3)
+
+    sharded = fsdp.shard_train_state(
+        mesh, create_train_state(model, jax.random.PRNGKey(0), optimizer=opt))
+    m_qkv = sharded.velocity["m"]["block_0"]["attn"]["qkv_kernel"]
+    assert m_qkv.addressable_shards[0].data.shape == (64, 24)   # ZeRO: same shards
+
+    ref_state = create_train_state(model, jax.random.PRNGKey(0), optimizer=opt)
+    ref_step = jax.jit(make_train_step(model, learning_rate=1e-3, momentum=0.0,
+                                       optimizer=opt))
+    step = fsdp.compile_step_fsdp(
+        make_train_step(model, learning_rate=1e-3, momentum=0.0, optimizer=opt),
+        mesh)
+    state = sharded
+    for _ in range(3):
+        ref_state, ref_loss = ref_step(ref_state, x, y, jax.random.PRNGKey(1))
+        state, loss = step(state, x, y, jax.random.PRNGKey(1))
+    assert abs(float(loss) - float(ref_loss)) < 1e-5
+    # Tolerance note: AdamW's normalized step m/(sqrt(v)+eps) has derivative ~1/eps in
+    # near-zero gradients, so the f32 reduction-order difference between the sharded
+    # (reduce-scatter) and unsharded gradient sums is amplified ~1e2× relative to the
+    # SGD tests above (measured max |Δp| ≈ 1e-5 after 3 steps, vs <1e-6 for SGD).
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(state.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(ref_state.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=5e-5)
+
+
 def test_fsdp_trajectory_with_donated_shards(mesh):
     """Five donated-buffer steps track the unsharded trajectory (shards update in
     place; the resharded output layout round-trips through donation)."""

@@ -1,0 +1,143 @@
+"""Optimizer surface: AdamW pinned against torch.optim.AdamW; the Optimizer pair +
+state-shape contract (``ops/optim.py``) wired through the trainers.
+
+The reference's only optimizer is SGD-momentum (reference ``src/train.py:60-61`` — its
+parity oracle lives in ``tests/test_torch_parity.py``); AdamW is beyond-parity surface,
+so its oracle is real ``torch.optim.AdamW`` run step-by-step on the same gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "dense": {"kernel": jnp.asarray(rng.normal(size=(7, 5)).astype(np.float32)),
+                  "bias": jnp.asarray(rng.normal(size=(5,)).astype(np.float32))},
+        "scale": jnp.asarray(rng.normal(size=(3,)).astype(np.float32)),
+    }
+
+
+def _grads(step, seed=100):
+    rng = np.random.default_rng(seed + step)
+    return jax.tree_util.tree_map(
+        lambda p: jnp.asarray(rng.normal(size=p.shape).astype(np.float32)), _tree())
+
+
+@pytest.mark.parametrize("weight_decay", [0.0, 0.01])
+def test_adamw_matches_torch(weight_decay):
+    torch = pytest.importorskip("torch")
+
+    lr = 1e-2
+    params = _tree()
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    t_params = [torch.nn.Parameter(torch.tensor(np.asarray(p))) for p in leaves]
+    opt_t = torch.optim.AdamW(t_params, lr=lr, betas=(0.9, 0.999), eps=1e-8,
+                              weight_decay=weight_decay)
+
+    opt = optim.adamw(lr, weight_decay=weight_decay)
+    state = opt.init(params)
+    for step in range(5):
+        grads = _grads(step)
+        g_leaves = jax.tree_util.tree_leaves(grads)
+        for tp, g in zip(t_params, g_leaves):
+            tp.grad = torch.tensor(np.asarray(g))
+        opt_t.step()
+        params, state = opt.update(params, state, grads)
+        for tp, p in zip(t_params, jax.tree_util.tree_leaves(params)):
+            np.testing.assert_allclose(np.asarray(p), tp.detach().numpy(),
+                                       rtol=1e-5, atol=1e-6)
+    assert int(state["count"]) == 5
+
+
+def test_sgd_factory_matches_explicit_update():
+    params = _tree(seed=1)
+    opt = optim.sgd(0.05, 0.5)
+    state = opt.init(params)
+    p_a, s_a = params, state
+    p_b, v_b = params, optim.sgd_init(params)
+    for step in range(3):
+        grads = _grads(step, seed=200)
+        p_a, s_a = opt.update(p_a, s_a, grads)
+        p_b, v_b = optim.sgd_update(p_b, v_b, grads, learning_rate=0.05, momentum=0.5)
+    for a, b in zip(jax.tree_util.tree_leaves(p_a), jax.tree_util.tree_leaves(p_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_make_optimizer_validation():
+    assert optim.make_optimizer("sgd", learning_rate=0.1, momentum=0.5).name == "sgd"
+    assert optim.make_optimizer("adamw", learning_rate=0.1, momentum=0.5,
+                                weight_decay=0.1).name == "adamw"
+    with pytest.raises(ValueError, match="weight-decay"):
+        optim.make_optimizer("sgd", learning_rate=0.1, momentum=0.5, weight_decay=0.1)
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        optim.make_optimizer("adagrad", learning_rate=0.1, momentum=0.5)
+
+
+def test_map_param_trees_contract():
+    params = _tree(seed=2)
+    tag = lambda t: jax.tree_util.tree_map(lambda x: x + 1.0, t)
+    # SGD state is one params-congruent tree: fn applies to the whole thing.
+    sgd_state = optim.sgd_init(params)
+    out = optim.map_param_trees(sgd_state, tag)
+    np.testing.assert_array_equal(np.asarray(out["scale"]),
+                                  np.asarray(sgd_state["scale"]) + 1.0)
+    # AdamW state maps fn over both moments and scalar_fn over the count.
+    adam_state = optim.adamw_init(params)
+    out = optim.map_param_trees(adam_state, tag, scalar_fn=lambda c: c + 7)
+    assert optim.is_adam_state(out)
+    np.testing.assert_array_equal(np.asarray(out["m"]["scale"]),
+                                  np.asarray(adam_state["m"]["scale"]) + 1.0)
+    np.testing.assert_array_equal(np.asarray(out["v"]["scale"]),
+                                  np.asarray(adam_state["v"]["scale"]) + 1.0)
+    assert int(out["count"]) == 7
+
+
+def test_pallas_step_rejects_non_sgd():
+    from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        make_train_step,
+    )
+
+    with pytest.raises(ValueError, match="use_pallas"):
+        make_train_step(Net(), learning_rate=0.01, momentum=0.5, use_pallas=True,
+                        optimizer=optim.adamw(0.01))
+
+
+def test_single_trainer_adamw_trains_and_resumes(tmp_path):
+    """--optimizer adamw end-to-end on the single-process trainer: the loss falls, the
+    checkpoint round-trips the moment state (same serialized format/path as SGD), and
+    a resumed run continues from the restored moments (step and count carry on)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
+        Dataset, _normalize, _synthesize_split,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train import single
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+        SingleProcessConfig,
+    )
+    import os
+
+    xs, ys = _synthesize_split(512, seed=300)
+    train = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+    xs, ys = _synthesize_split(200, seed=301)
+    test = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+
+    cfg = SingleProcessConfig(
+        n_epochs=1, batch_size_train=64, batch_size_test=100, log_interval=4,
+        optimizer="adamw", learning_rate=1e-3, weight_decay=0.01,
+        results_dir=str(tmp_path / "results"), images_dir=str(tmp_path / "images"))
+
+    state1, hist1 = single.main(cfg, datasets=(train, test))
+    assert optim.is_adam_state(state1.velocity)
+    assert int(state1.velocity["count"]) == int(state1.step)
+    assert hist1.test_losses[-1] < hist1.test_losses[0]
+
+    ckpt = os.path.join(cfg.results_dir, "model.ckpt")
+    state2, _ = single.main(cfg, datasets=(train, test), resume_from=ckpt)
+    assert int(state2.step) == 2 * int(state1.step)
+    assert int(state2.velocity["count"]) == int(state2.step)

@@ -128,6 +128,56 @@ def test_flash_attention_mesh_invariant(tmp_path, tiny_datasets):
                                rtol=1e-4, atol=1e-6)
 
 
+def test_adamw_mesh_invariant(tmp_path, tiny_datasets):
+    """--optimizer adamw under a composed data x seq x model mesh equals plain-DP
+    adamw: the moment trees shard per-leaf exactly like their parameters (ZeRO-style,
+    ops/optim.py state contract), so the mesh stays an execution layout."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100,
+                  optimizer="adamw", learning_rate=1e-3, weight_decay=0.01,
+                  max_train_examples=256)
+    state_3d, hist_3d = composed.main(
+        ComposedConfig(mesh="data=2,seq=2,model=2",
+                       results_dir=str(tmp_path / "adam3d"), **common),
+        datasets=tiny_datasets)
+    state_dp, hist_dp = composed.main(
+        ComposedConfig(mesh="data=8", results_dir=str(tmp_path / "adamdp"),
+                       **common),
+        datasets=tiny_datasets)
+    np.testing.assert_allclose(hist_3d.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(state_3d.params["pos_embed"]),
+                               np.asarray(state_dp.params["pos_embed"]),
+                               rtol=1e-4, atol=1e-6)
+    assert int(state_3d.velocity["count"]) == int(state_3d.step)
+
+
+def test_adamw_stage_axis_matches_dp(tmp_path, tiny_datasets):
+    """--optimizer adamw with a stage axis: each AdamW moment tree bridges through the
+    GPipe stacked layout (stack on entry, stage-sharded like its params, unstack at the
+    checkpoint boundary) and the trajectory equals plain-DP adamw."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100,
+                  optimizer="adamw", learning_rate=1e-3,
+                  max_train_examples=256)
+    state_pp, hist_pp = composed.main(
+        ComposedConfig(mesh="data=2,stage=2",
+                       results_dir=str(tmp_path / "adampp"), **common),
+        datasets=tiny_datasets)
+    state_dp, hist_dp = composed.main(
+        ComposedConfig(mesh="data=4", results_dir=str(tmp_path / "adampp_dp"),
+                       **common),
+        datasets=tiny_datasets)
+    np.testing.assert_allclose(hist_pp.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(state_pp.params["block_1"]["attn"]["qkv_kernel"]),
+        np.asarray(state_dp.params["block_1"]["attn"]["qkv_kernel"]),
+        rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(state_pp.velocity["m"]["block_1"]["attn"]["qkv_kernel"]),
+        np.asarray(state_dp.velocity["m"]["block_1"]["attn"]["qkv_kernel"]),
+        rtol=1e-4, atol=1e-6)
+
+
 def test_ulysses_mesh_invariant(tmp_path, tiny_datasets):
     """--seq-impl ulysses with a seq axis trains through the head-scatter all-to-all
     schedule (parallel/ulysses.py) and reproduces the plain-DP dense trajectory —
