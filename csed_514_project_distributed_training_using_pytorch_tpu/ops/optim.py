@@ -65,11 +65,16 @@ def sgd_update(params, velocity, grads, *, learning_rate: float, momentum: float
 
 
 def sgd(learning_rate: float, momentum: float) -> Optimizer:
-    """The reference's optimizer as an ``Optimizer`` pair (state = velocity tree)."""
+    """The reference's optimizer as an ``Optimizer`` pair (state = velocity tree).
 
-    def update(params, velocity, grads):
+    ``update(..., lr_scale=s)`` applies a step-dependent multiplier to the learning
+    rate only (torch ``lr_scheduler`` semantics: the velocity accumulates RAW
+    gradients; the rate applies at the parameter write)."""
+
+    def update(params, velocity, grads, *, lr_scale=1.0):
         return sgd_update(params, velocity, grads,
-                          learning_rate=learning_rate, momentum=momentum)
+                          learning_rate=learning_rate * lr_scale,
+                          momentum=momentum)
 
     return Optimizer(init=sgd_init, update=update, name="sgd",
                      hyperparams={"learning_rate": learning_rate,
@@ -86,7 +91,7 @@ def adamw(learning_rate: float, *, b1: float = 0.9, b2: float = 0.999,
           eps: float = 1e-8, weight_decay: float = 0.0) -> Optimizer:
     """AdamW with torch semantics (decoupled decay; bias-corrected moments)."""
 
-    def update(params, opt_state, grads):
+    def update(params, opt_state, grads, *, lr_scale=1.0):
         count = opt_state["count"] + 1
         c = count.astype(jnp.float32)
         m = jax.tree_util.tree_map(lambda m_, g: b1 * m_ + (1.0 - b1) * g,
@@ -98,7 +103,10 @@ def adamw(learning_rate: float, *, b1: float = 0.9, b2: float = 0.999,
 
         def leaf(p, m_, v_):
             step_dir = (m_ / bc1) / (jnp.sqrt(v_ / bc2) + eps)
-            return p - learning_rate * (step_dir + weight_decay * p)
+            # lr_scale multiplies the whole scheduled rate — including the decoupled
+            # decay term, matching torch AdamW under an lr_scheduler (decay is
+            # p -= lr_t * weight_decay * p there too).
+            return p - learning_rate * lr_scale * (step_dir + weight_decay * p)
 
         new_params = jax.tree_util.tree_map(leaf, params, m, v)
         return new_params, {"m": m, "v": v, "count": count}
@@ -120,6 +128,46 @@ def make_optimizer(name: str, *, learning_rate: float, momentum: float,
     if name == "adamw":
         return adamw(learning_rate, weight_decay=weight_decay)
     raise ValueError(f"unknown optimizer {name!r} — choose 'sgd' or 'adamw'")
+
+
+def make_lr_schedule(name: str, *, warmup_steps: int = 0,
+                     total_steps: int = 0) -> Callable | None:
+    """Step → learning-rate multiplier in (0, 1], traced inside the compiled step.
+
+    - ``"constant"``: 1.0, with an optional linear warmup ramp over the first
+      ``warmup_steps`` updates (scale ``(step+1)/warmup_steps``, so step 0 trains at
+      ``1/warmup_steps`` rather than 0 — torch LambdaLR convention for a ramp that
+      never multiplies by zero).
+    - ``"cosine"``: the warmup ramp, then cosine decay from 1 → 0 across the
+      remaining ``total_steps - warmup_steps`` updates (the standard half-period
+      schedule); requires ``total_steps > warmup_steps``.
+
+    Returns ``None`` for a warmup-free constant schedule so callers can skip the
+    multiply entirely (the hot-loop fast path stays untouched).
+    """
+    if warmup_steps < 0:
+        raise ValueError(f"warmup_steps must be >= 0, got {warmup_steps}")
+
+    def ramp(step):
+        s = step.astype(jnp.float32)
+        return jnp.minimum(1.0, (s + 1.0) / warmup_steps)
+
+    if name == "constant":
+        return ramp if warmup_steps > 0 else None
+    if name == "cosine":
+        if total_steps <= warmup_steps:
+            raise ValueError(
+                f"cosine schedule needs total_steps > warmup_steps, got "
+                f"{total_steps} <= {warmup_steps}")
+
+        def sched(step):
+            s = step.astype(jnp.float32)
+            t = jnp.clip((s - warmup_steps) / (total_steps - warmup_steps), 0.0, 1.0)
+            cos = 0.5 * (1.0 + jnp.cos(jnp.pi * t))
+            return (ramp(step) if warmup_steps > 0 else 1.0) * cos
+
+        return sched
+    raise ValueError(f"unknown lr schedule {name!r} — choose 'constant' or 'cosine'")
 
 
 def is_adam_state(opt_state) -> bool:

@@ -258,6 +258,9 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                                      weight_decay=config.weight_decay)
     base_state = create_train_state(model, jax.random.PRNGKey(config.seed),
                                     optimizer=optimizer)
+    lr_schedule = optim.make_lr_schedule(config.lr_schedule,
+                                         warmup_steps=config.warmup_steps,
+                                         total_steps=config.epochs * steps_per_epoch)
     start_epoch = 0
     if config.resume_from:
         # Checkpoints are always in the standard per-name layout, so a composed run
@@ -300,7 +303,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         epoch_fn = jax.jit(
             make_epoch_fn(engine, learning_rate=config.learning_rate,
                           momentum=config.momentum,
-                          grad_accum=config.grad_accum, optimizer=optimizer),
+                          grad_accum=config.grad_accum, optimizer=optimizer,
+                          lr_schedule=lr_schedule),
             in_shardings=(state_sh, rep, rep, idx_sh, rep),
             out_shardings=(state_sh, rep), donate_argnums=(0,))
         param_shardings = state_sh.params
@@ -314,7 +318,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         epoch_fn = tp.compile_epoch_tp(
             make_epoch_fn(model, learning_rate=config.learning_rate,
                           momentum=config.momentum,
-                          grad_accum=config.grad_accum, optimizer=optimizer),
+                          grad_accum=config.grad_accum, optimizer=optimizer,
+                          lr_schedule=lr_schedule),
             mesh, data_axis="data" if data_size > 1 else None)
         param_shardings = tp.state_shardings(mesh, state).params
         eval_model = model

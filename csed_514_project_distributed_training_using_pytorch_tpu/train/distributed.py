@@ -147,6 +147,9 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                                      weight_decay=config.weight_decay)
     state = create_train_state(model, init_rng, optimizer=optimizer)
     steps_per_epoch = samplers[0].num_samples // per_replica_batch
+    lr_schedule = optim.make_lr_schedule(config.lr_schedule,
+                                         warmup_steps=config.warmup_steps,
+                                         total_steps=config.epochs * steps_per_epoch)
     start_epoch = 0
     if config.resume_from:                        # the resume path the reference lacks
         state, start_epoch, warning = checkpoint.restore_for_resume(
@@ -171,7 +174,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         make_epoch_fn(model, learning_rate=config.learning_rate,
                       momentum=config.momentum,
                       unroll=config.scan_unroll, pregather=config.pregather,
-                      grad_accum=config.grad_accum, optimizer=optimizer), mesh)
+                      grad_accum=config.grad_accum, optimizer=optimizer,
+                      lr_schedule=lr_schedule), mesh)
     eval_fn = dp.compile_eval(
         make_eval_fn(model, batch_size=config.batch_size_test), mesh,
         shard=config.shard_eval)
@@ -184,7 +188,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
             make_train_step(model, learning_rate=config.learning_rate,
                             momentum=config.momentum,
                             grad_accum=config.grad_accum,
-                            optimizer=optimizer), mesh)
+                            optimizer=optimizer, lr_schedule=lr_schedule), mesh)
         col_lo, col_hi = _host_local_columns(mesh, per_replica_batch)
         M.log(f"Host-local feed: this process feeds global-batch columns "
               f"[{col_lo}:{col_hi}]")

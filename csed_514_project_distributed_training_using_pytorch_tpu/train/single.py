@@ -114,6 +114,22 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     if resume_from:                             # the restore path the reference lacks
         state = checkpoint.restore_train_state(resume_from, state)
         M.log(f"Resumed from {resume_from} at step {int(state.step)}")
+    # Schedule horizon = THIS invocation's planned end: the restored step plus
+    # n_epochs of updates (single-trainer resume means "train n_epochs MORE", unlike
+    # the distributed/composed trainers' skip-completed-epochs semantics). Anchoring
+    # past the restored step keeps a resumed cosine run decaying over its own span
+    # instead of evaluating beyond the original horizon at multiplier 0 (a silently
+    # frozen run). drop_last=False: the ragged tail batch is still one update.
+    total_steps = (int(state.step)
+                   + config.n_epochs * (-(-len(train_ds) // config.batch_size_train)))
+    lr_schedule = optim.make_lr_schedule(config.lr_schedule,
+                                         warmup_steps=config.warmup_steps,
+                                         total_steps=total_steps)
+    if lr_schedule is not None and (config.use_pallas_kernels
+                                    or config.experimental_fused_step):
+        raise ValueError("--use-pallas-kernels/--experimental-fused-step bake the "
+                         "learning rate into the fused kernel — use the default "
+                         "constant schedule without warmup")
 
     # Device-resident datasets: the one and only host->device transfer.
     train_x, train_y = jnp.asarray(train_ds.images), jnp.asarray(train_ds.labels)
@@ -142,13 +158,15 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                           momentum=config.momentum,
                           use_pallas=config.use_pallas_kernels,
                           unroll=config.scan_unroll, pregather=config.pregather,
-                          grad_accum=config.grad_accum, optimizer=optimizer),
+                          grad_accum=config.grad_accum, optimizer=optimizer,
+                          lr_schedule=lr_schedule),
             donate_argnums=(0,))
         step_fn = jax.jit(
             make_train_step(model, learning_rate=config.learning_rate,
                             momentum=config.momentum,
                             use_pallas=config.use_pallas_kernels,
-                            grad_accum=config.grad_accum, optimizer=optimizer),
+                            grad_accum=config.grad_accum, optimizer=optimizer,
+                            lr_schedule=lr_schedule),
             donate_argnums=(0,))
     # The final partial batch (drop_last=False) is ragged and need not divide by
     # grad_accum; accumulation is a memory knob, so the tail just steps unaccumulated.
@@ -159,7 +177,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
             make_train_step(model, learning_rate=config.learning_rate,
                             momentum=config.momentum,
                             use_pallas=config.use_pallas_kernels,
-                            optimizer=optimizer),
+                            optimizer=optimizer, lr_schedule=lr_schedule),
             donate_argnums=(0,))
     eval_fn = jax.jit(make_eval_fn(model, batch_size=config.batch_size_test))
 

@@ -66,7 +66,8 @@ def create_train_state(model, rng: jax.Array,
 def make_train_step(model, *, learning_rate: float, momentum: float,
                     use_pallas: bool = False, grad_accum: int = 1,
                     aux_loss_weight: float = 0.01,
-                    optimizer: Optimizer | None = None) -> Callable:
+                    optimizer: Optimizer | None = None,
+                    lr_schedule: Callable | None = None) -> Callable:
     """Build ``step(state, images, labels, rng) -> (state, loss)``.
 
     The loss is the canonical ``nll(log_probs)`` formulation (see
@@ -94,6 +95,10 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     ``optim.adamw(...)``; ``None`` keeps the reference-parity SGD built from
     ``learning_rate``/``momentum``. The state passed in must come from the matching
     ``create_train_state(..., optimizer=...)``.
+
+    ``lr_schedule`` (from ``optim.make_lr_schedule``) maps ``state.step`` to a
+    learning-rate multiplier inside the compiled step — warmup/cosine cost zero host
+    round-trips. Not supported with ``use_pallas`` (the fused kernel bakes the rate).
     """
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -102,6 +107,9 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     if use_pallas and optimizer.name != "sgd":
         raise ValueError("use_pallas fuses the SGD-momentum update kernel — "
                          f"optimizer {optimizer.name!r} is not supported there")
+    if use_pallas and lr_schedule is not None:
+        raise ValueError("use_pallas bakes the learning rate into the fused kernel — "
+                         "lr_schedule is not supported there")
     if use_pallas:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
             pallas_kernels as pk,
@@ -128,7 +136,9 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
                 learning_rate=optimizer.hyperparams["learning_rate"],
                 momentum=optimizer.hyperparams["momentum"])
         else:
-            params, velocity = optimizer.update(state.params, state.velocity, grads)
+            scale = lr_schedule(state.step) if lr_schedule is not None else 1.0
+            params, velocity = optimizer.update(state.params, state.velocity, grads,
+                                                lr_scale=scale)
         return TrainState(params, velocity, state.step + 1), loss
 
     def step(state: TrainState, images, labels, rng) -> tuple[TrainState, jax.Array]:
@@ -169,7 +179,8 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
 def make_epoch_fn(model, *, learning_rate: float, momentum: float,
                   use_pallas: bool = False, unroll: int = 1,
                   pregather: bool = False, grad_accum: int = 1,
-                  optimizer: Optimizer | None = None) -> Callable:
+                  optimizer: Optimizer | None = None,
+                  lr_schedule: Callable | None = None) -> Callable:
     """Build ``epoch(state, images, labels, idx_matrix, rng) -> (state, losses)``.
 
     ``images``/``labels`` are the full (device-resident) training split; ``idx_matrix`` is a
@@ -190,7 +201,7 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
     """
     train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum,
                                  use_pallas=use_pallas, grad_accum=grad_accum,
-                                 optimizer=optimizer)
+                                 optimizer=optimizer, lr_schedule=lr_schedule)
     return make_epoch_from_step(train_step, unroll=unroll, pregather=pregather)
 
 

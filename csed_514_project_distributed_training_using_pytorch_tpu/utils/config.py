@@ -31,6 +31,13 @@ class SingleProcessConfig:
                                       # 'adamw' (beyond-parity; torch.optim.AdamW
                                       # semantics, ops/optim.py — momentum is then unused)
     weight_decay: float = 0.0         # AdamW decoupled weight decay (adamw only)
+    lr_schedule: str = "constant"     # learning-rate schedule: 'constant' or 'cosine'
+                                      # (half-period decay over the whole run); applied
+                                      # inside the compiled step from state.step. This
+                                      # trainer's resume trains n_epochs MORE, so the
+                                      # cosine horizon anchors at the restored step
+                                      # (the resumed run decays over its own span)
+    warmup_steps: int = 0             # linear warmup ramp over the first N updates
     log_interval: int = 10            # src/train.py:17
     seed: int = 1                     # src/train.py:19 (torch.manual_seed(random_seed))
     data_dir: str = "files"           # src/train.py:26 ({CURR_PATH}/files/; one dir, not the
@@ -93,6 +100,9 @@ class DistributedConfig:
     optimizer: str = "sgd"            # 'sgd' (reference parity) or 'adamw'
                                       # (see SingleProcessConfig.optimizer)
     weight_decay: float = 0.0         # AdamW decoupled weight decay (adamw only)
+    lr_schedule: str = "constant"     # 'constant' or 'cosine' (see
+                                      # SingleProcessConfig.lr_schedule)
+    warmup_steps: int = 0             # linear warmup ramp over the first N updates
     log_interval: int = 10            # src/train_dist.py:129
     seed: int = 1                     # src/train_dist.py:135 (model/init seed)
     sampler_seed: int = 42            # src/train_dist.py:37 (DistributedSampler seed)
@@ -185,6 +195,9 @@ class ComposedConfig:
                                         # every mesh incl. stage (moments bridge
                                         # through the stacked layout)
     weight_decay: float = 0.0           # AdamW decoupled weight decay (adamw only)
+    lr_schedule: str = "constant"       # 'constant' or 'cosine' (see
+                                        # SingleProcessConfig.lr_schedule)
+    warmup_steps: int = 0               # linear warmup ramp over the first N updates
     dropout_rate: float = 0.0           # 0 keeps composed runs comparable across meshes
     seed: int = 1
     data_dir: str = "files"

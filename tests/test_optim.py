@@ -98,6 +98,95 @@ def test_map_param_trees_contract():
     assert int(out["count"]) == 7
 
 
+def test_lr_schedule_shapes():
+    import jax.numpy as jnp
+
+    # Warmup-free constant returns None: callers skip the multiply entirely.
+    assert optim.make_lr_schedule("constant") is None
+    ramp = optim.make_lr_schedule("constant", warmup_steps=4)
+    steps = jnp.arange(6)
+    np.testing.assert_allclose(np.asarray(jax.vmap(ramp)(steps)),
+                               [0.25, 0.5, 0.75, 1.0, 1.0, 1.0], rtol=1e-6)
+    cos = optim.make_lr_schedule("cosine", warmup_steps=2, total_steps=10)
+    vals = np.asarray(jax.vmap(cos)(jnp.arange(10)))
+    np.testing.assert_allclose(vals[0], 0.5, rtol=1e-6)      # ramp * cos(0)=1
+    assert np.all(np.diff(vals[2:]) < 0)                      # monotone decay after warmup
+    np.testing.assert_allclose(vals[-1],
+                               0.5 * (1 + np.cos(np.pi * 7 / 8)), rtol=1e-5)
+    with pytest.raises(ValueError, match="total_steps"):
+        optim.make_lr_schedule("cosine", warmup_steps=5, total_steps=5)
+    with pytest.raises(ValueError, match="unknown lr schedule"):
+        optim.make_lr_schedule("linear")
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adamw"])
+def test_scheduled_trajectory_matches_torch_lambdalr(opt_name):
+    """Cosine+warmup through our update == torch optimizer + LambdaLR with the same
+    multiplier — pins both the schedule indexing (scale(t) applies to update t) and
+    the rule that only the rate is scaled (SGD velocity accumulates raw gradients)."""
+    torch = pytest.importorskip("torch")
+
+    lr = 1e-2
+    sched = optim.make_lr_schedule("cosine", warmup_steps=2, total_steps=8)
+    params = _tree(seed=3)
+    leaves, _ = jax.tree_util.tree_flatten(params)
+    t_params = [torch.nn.Parameter(torch.tensor(np.asarray(p))) for p in leaves]
+    if opt_name == "sgd":
+        opt = optim.sgd(lr, 0.5)
+        opt_t = torch.optim.SGD(t_params, lr=lr, momentum=0.5)
+    else:
+        opt = optim.adamw(lr, weight_decay=0.01)
+        opt_t = torch.optim.AdamW(t_params, lr=lr, betas=(0.9, 0.999), eps=1e-8,
+                                  weight_decay=0.01)
+    lam = lambda t: float(sched(jnp.asarray(t, jnp.int32)))
+    sched_t = torch.optim.lr_scheduler.LambdaLR(opt_t, lam)
+    state = opt.init(params)
+    for step in range(8):
+        grads = _grads(step, seed=400)
+        for tp, g in zip(t_params, jax.tree_util.tree_leaves(grads)):
+            tp.grad = torch.tensor(np.asarray(g))
+        opt_t.step()
+        sched_t.step()
+        params, state = opt.update(params, state, grads,
+                                   lr_scale=sched(jnp.asarray(step, jnp.int32)))
+        for tp, p in zip(t_params, jax.tree_util.tree_leaves(params)):
+            np.testing.assert_allclose(np.asarray(p), tp.detach().numpy(),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_single_trainer_cosine_schedule_trains(tmp_path):
+    from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
+        Dataset, _normalize, _synthesize_split,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train import single
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+        SingleProcessConfig,
+    )
+
+    xs, ys = _synthesize_split(512, seed=310)
+    train = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+    xs, ys = _synthesize_split(200, seed=311)
+    test = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+    cfg = SingleProcessConfig(
+        n_epochs=2, batch_size_train=64, batch_size_test=100, log_interval=4,
+        lr_schedule="cosine", warmup_steps=3, learning_rate=0.05,
+        results_dir=str(tmp_path / "results"), images_dir=str(tmp_path / "images"))
+    state, hist = single.main(cfg, datasets=(train, test))
+    assert hist.test_losses[-1] < hist.test_losses[0]
+
+    # Resuming a COMPLETED cosine run must keep training (the horizon re-anchors at
+    # the restored step) — not freeze at the schedule end's 0 multiplier.
+    import os
+    state2, _ = single.main(
+        cfg, datasets=(train, test),
+        resume_from=os.path.join(cfg.results_dir, "model.ckpt"))
+    assert int(state2.step) == 2 * int(state.step)
+    deltas = [float(np.abs(np.asarray(a) - np.asarray(b)).max())
+              for a, b in zip(jax.tree_util.tree_leaves(state2.params),
+                              jax.tree_util.tree_leaves(state.params))]
+    assert max(deltas) > 0.0
+
+
 def test_pallas_step_rejects_non_sgd():
     from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
     from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
@@ -107,6 +196,10 @@ def test_pallas_step_rejects_non_sgd():
     with pytest.raises(ValueError, match="use_pallas"):
         make_train_step(Net(), learning_rate=0.01, momentum=0.5, use_pallas=True,
                         optimizer=optim.adamw(0.01))
+    with pytest.raises(ValueError, match="lr_schedule"):
+        make_train_step(Net(), learning_rate=0.01, momentum=0.5, use_pallas=True,
+                        lr_schedule=optim.make_lr_schedule("constant",
+                                                           warmup_steps=2))
 
 
 def test_single_trainer_adamw_trains_and_resumes(tmp_path):
