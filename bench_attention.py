@@ -17,6 +17,7 @@ Usage: ``python bench_attention.py [--out results.jsonl]`` — one JSON line per
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import sys
 import time
@@ -55,7 +56,15 @@ def main() -> int:
                              "small values make the tool drivable on CPU interpret mode")
     parser.add_argument("--plot", default=None,
                         help="also save the flash-vs-dense curve PNG here")
+    parser.add_argument("--block", type=int, default=None,
+                        help="flash kernel block rows (multiple of 128; default 128) "
+                             "— the r3 tuning knob for the S<=8k regime")
+    parser.add_argument("--block-sweep", type=int, nargs="+", default=None,
+                        help="measure flash at each of these block sizes per seq_len "
+                             "(dense measured once); finds the per-S best block")
     args = parser.parse_args()
+    if args.block is not None and args.block_sweep is not None:
+        parser.error("--block and --block-sweep are mutually exclusive")
 
     import jax
     import jax.numpy as jnp
@@ -72,11 +81,29 @@ def main() -> int:
         row = {"seq_len": s, "batch": B, "heads": H, "head_dim": D,
                "platform": platform, "device_kind": device_kind, "causal": True,
                "reps": REPS}
-        try:
-            row["flash_fwdbwd_s"] = _measure(ops.flash_attention, q, k, v)
-        except Exception as e:  # a memory/compile wall is a result, not a crash
-            row["flash_fwdbwd_s"] = None
-            row["flash_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        sweeping = args.block_sweep is not None
+        blocks = (args.block_sweep if sweeping
+                  else [args.block] if args.block is not None else [None])
+        best_block = None
+        row["flash_fwdbwd_s"] = None   # stays None if every block size fails
+        for blk in blocks:
+            # Sweep rows keep the per-block key schema even for one candidate, so
+            # partial re-measurements append cleanly to an existing tune JSONL.
+            key = f"flash_fwdbwd_s_b{blk}" if sweeping else "flash_fwdbwd_s"
+            flash = (ops.flash_attention if blk is None else
+                     functools.partial(ops.flash_attention, block=blk))
+            try:
+                # flash_attention validates blk itself (multiple of 128, divides S).
+                t = _measure(flash, q, k, v)
+            except Exception as e:  # a memory/compile wall is a result, not a crash
+                t = None
+                row[key.replace("fwdbwd_s", "error")] = (
+                    f"{type(e).__name__}: {str(e)[:200]}")
+            row[key] = t
+            if t is not None and (best_block is None or t < row["flash_fwdbwd_s"]):
+                best_block, row["flash_fwdbwd_s"] = (blk or 128), t
+        if sweeping:
+            row["flash_best_block"] = best_block
         if s <= DENSE_MAX_S:
             try:
                 row["dense_fwdbwd_s"] = _measure(ops.full_attention, q, k, v)

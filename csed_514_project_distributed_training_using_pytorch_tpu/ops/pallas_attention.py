@@ -28,15 +28,19 @@ Kernel layout (FlashAttention-2 style, in the canonical Pallas-TPU grid formulat
   fetch still pipelines (grids cannot skip steps) but they cost no FLOPs.
 
 All matmuls request ``preferred_element_type=float32`` (MXU accumulation), block shapes
-are lane-aligned (``BLOCK = 128``, head dim on the lane axis), masks use 2-D
-``broadcasted_iota``, and the only in-kernel reshapes drop/add leading unit dims — every
-construct from the probe-verified list in ``ops/pallas_fused.py``'s lowering notes.
+are lane-aligned (any multiple of 128 rows via the ``block`` parameter, default
+``BLOCK = 128``; head dim on the lane axis), masks use 2-D ``broadcasted_iota``, and the
+only in-kernel reshapes drop/add leading unit dims — every construct from the
+probe-verified list in ``ops/pallas_fused.py``'s lowering notes. ``block`` is a pure
+performance knob (numerics are block-invariant — pinned in tests): larger blocks
+amortize grid/pipeline overhead per step against more VMEM per block; tune with
+``bench_attention.py --block-sweep``.
 
 Like the other Pallas modules: compiled on TPU, interpret mode elsewhere (the CPU test
 platform), numerics pinned against ``ops.attention.full_attention`` in
 ``tests/test_pallas_attention.py`` (hardware-gated Mosaic re-check included). Sequences
-must divide by ``BLOCK`` (128); callers wanting odd lengths use the dense path (the
-transformer family's default).
+must divide by the chosen ``block``; callers wanting odd lengths use the dense path
+(the transformer family's default).
 """
 
 from __future__ import annotations
@@ -52,12 +56,25 @@ from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention impor
     MASK_VALUE as NEG,
 )
 
-BLOCK = 128            # query/key block rows (sublane-aligned for f32, MXU-shaped)
+BLOCK = 128            # default query/key block rows (lane-aligned, MXU-shaped);
+                       # every kernel accepts ``block`` (a multiple of 128) for tuning —
+                       # larger blocks amortize grid/pipeline overhead per step at the
+                       # cost of more VMEM per block (see bench_attention.py --block)
 
 
 def _interpret() -> bool:
     """Compiled on TPU; interpret mode on CPU/GPU (the test platforms)."""
     return jax.default_backend() != "tpu"
+
+
+def _check_block(s: int, block: int) -> None:
+    """Sequence/block compatibility: lane-aligned block, evenly tiled sequence."""
+    if block < 128 or block % 128:
+        raise ValueError(f"flash block must be a positive multiple of 128, got {block}")
+    if s % block:
+        raise ValueError(
+            f"flash attention requires sequence length divisible by block={block}, "
+            f"got {s} (use ops.full_attention for odd lengths)")
 
 
 def _causal_mask(iq, ik, bq, bk):
@@ -93,7 +110,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)        # [bq, bk]
         if causal:
-            visible = _causal_mask(iq, j, bq, BLOCK)
+            visible = _causal_mask(iq, j, bq, k_ref.shape[1])
             s = jnp.where(visible, s, NEG)
         m = m_ref[:]
         l = l_ref[:]
@@ -117,39 +134,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[:] = jnp.transpose(lse).reshape(1, 1, 1, bq)
 
 
-def _flash_forward(q3, k3, v3, *, causal: bool):
-    """q3/k3/v3: [BH, S, D] → (out [BH, S, D], lse [BH, S/BLOCK, 1, BLOCK])."""
+def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK):
+    """q3/k3/v3: [BH, S, D] → (out [BH, S, D], lse [BH, S/block, 1, block])."""
     bh, s, d = q3.shape
+    _check_block(s, block)
     scale = 1.0 / (d ** 0.5)
-    nq = s // BLOCK
+    nq = s // block
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, num_k=nq)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nq),
         in_specs=[
-            pl.BlockSpec((1, BLOCK, d), lambda b, i, j: (b, i, 0),
+            pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, BLOCK, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((1, block, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, BLOCK, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((1, block, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, BLOCK, d), lambda b, i, j: (b, i, 0),
+            pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            # lse rides as [BH, nq, 1, BLOCK]: the (1, BLOCK) trailing dims equal the
+            # lse rides as [BH, nq, 1, block]: the (1, block) trailing dims equal the
             # array's, satisfying Mosaic's last-two-dims block constraint.
-            pl.BlockSpec((1, 1, 1, BLOCK), lambda b, i, j: (b, i, 0, 0),
+            pl.BlockSpec((1, 1, 1, block), lambda b, i, j: (b, i, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, nq, 1, BLOCK), jnp.float32),
+            jax.ShapeDtypeStruct((bh, nq, 1, block), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((BLOCK, d), jnp.float32),    # acc
-            pltpu.VMEM((BLOCK, 1), jnp.float32),    # running max m
-            pltpu.VMEM((BLOCK, 1), jnp.float32),    # running normalizer l
+            pltpu.VMEM((block, d), jnp.float32),    # acc
+            pltpu.VMEM((block, 1), jnp.float32),    # running max m
+            pltpu.VMEM((block, 1), jnp.float32),    # running normalizer l
         ],
         interpret=_interpret(),
     )(q3, k3, v3)
@@ -182,7 +200,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            visible = _causal_mask(iq, j, bq, BLOCK)
+            visible = _causal_mask(iq, j, bq, k_ref.shape[1])
             s = jnp.where(visible, s, NEG)
         p = jnp.exp(s - lse)                                      # [bq, bk]
         if causal:
@@ -221,7 +239,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            visible = _causal_mask(i, ik, BLOCK, bk)
+            visible = _causal_mask(i, ik, q_ref.shape[1], bk)
             s = jnp.where(visible, s, NEG)
         p = jnp.exp(s - lse_blk)                                  # [bq, bk]
         if causal:
@@ -243,17 +261,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(res, g, *, causal: bool):
+def _flash_backward(res, g, *, causal: bool, block: int = BLOCK):
     q3, k3, v3, out, lse = res
     bh, s, d = q3.shape
-    nq = s // BLOCK
+    nq = s // block
     # Δ = rowsum(dout ∘ out), reshaped to the lse layout — XLA fuses this small pass.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(bh, nq, 1, BLOCK)
-    return flash_backward_blocks(q3, k3, v3, g, lse, delta, causal=causal)
+                    axis=-1).reshape(bh, nq, 1, block)
+    return flash_backward_blocks(q3, k3, v3, g, lse, delta, causal=causal,
+                                 block=block)
 
 
-def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool):
+def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
+                          block: int = BLOCK):
     """One flash-backward pass of a query-block set against a key/value-block set,
     given the GLOBAL softmax statistics: ``(dq, dk, dv)`` contributions.
 
@@ -271,8 +291,9 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool):
         raise ValueError(
             f"flash_backward_blocks needs equal q/k block sets, got {q3.shape} vs "
             f"{k3.shape}")
+    _check_block(s, block)
     scale = 1.0 / (d ** 0.5)
-    nq = s // BLOCK
+    nq = s // block
 
     def row_i(b, i, j):
         return (b, i, 0)
@@ -280,11 +301,11 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool):
     def row_j(b, i, j):
         return (b, j, 0)
 
-    row_i_spec = pl.BlockSpec((1, BLOCK, d), row_i, memory_space=pltpu.VMEM)
-    row_j_spec = pl.BlockSpec((1, BLOCK, d), row_j, memory_space=pltpu.VMEM)
-    lse_i_spec = pl.BlockSpec((1, 1, 1, BLOCK), lambda b, i, j: (b, i, 0, 0),
+    row_i_spec = pl.BlockSpec((1, block, d), row_i, memory_space=pltpu.VMEM)
+    row_j_spec = pl.BlockSpec((1, block, d), row_j, memory_space=pltpu.VMEM)
+    lse_i_spec = pl.BlockSpec((1, 1, 1, block), lambda b, i, j: (b, i, 0, 0),
                               memory_space=pltpu.VMEM)
-    lse_j_spec = pl.BlockSpec((1, 1, 1, BLOCK), lambda b, i, j: (b, j, 0, 0),
+    lse_j_spec = pl.BlockSpec((1, 1, 1, block), lambda b, i, j: (b, j, 0, 0),
                               memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
@@ -294,7 +315,7 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool):
                   lse_i_spec],
         out_specs=[row_i_spec],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q3.dtype)],
-        scratch_shapes=[pltpu.VMEM((BLOCK, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
         interpret=_interpret(),
     )(q3, k3, v3, g, lse, delta)[0]
 
@@ -307,8 +328,8 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool):
         out_specs=[row_i_spec, row_i_spec],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
                    jax.ShapeDtypeStruct((bh, s, d), v3.dtype)],
-        scratch_shapes=[pltpu.VMEM((BLOCK, d), jnp.float32),
-                        pltpu.VMEM((BLOCK, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
+                        pltpu.VMEM((block, d), jnp.float32)],
         interpret=_interpret(),
     )(q3, k3, v3, g, lse, delta)
     return dq, dk, dv
@@ -319,19 +340,19 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool):
 # =========================================================================================
 
 
-@functools.lru_cache(maxsize=2)
-def _make_op(causal: bool):
+@functools.lru_cache(maxsize=None)
+def _make_op(causal: bool, block: int = BLOCK):
     @jax.custom_vjp
     def op(q3, k3, v3):
-        out, _ = _flash_forward(q3, k3, v3, causal=causal)
+        out, _ = _flash_forward(q3, k3, v3, causal=causal, block=block)
         return out
 
     def fwd(q3, k3, v3):
-        out, lse = _flash_forward(q3, k3, v3, causal=causal)
+        out, lse = _flash_forward(q3, k3, v3, causal=causal, block=block)
         return out, (q3, k3, v3, out, lse)
 
     def bwd(res, g):
-        return _flash_backward(res, g, causal=causal)
+        return _flash_backward(res, g, causal=causal, block=block)
 
     op.defvjp(fwd, bwd)
     return op
@@ -344,23 +365,23 @@ def flash_forward_with_lse(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
 
     The lse rows are what blockwise/ring merges need to combine partial attention
     results exactly (``parallel.ring_attention.ring_flash_attention``). Not wrapped in
-    the custom VJP — differentiate through ``flash_attention`` instead.
+    the custom VJP — differentiate through ``flash_attention`` instead. Always the
+    default BLOCK: the ring merge layouts are written against it.
     """
     return _flash_forward(q3, k3, v3, causal=causal)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = False) -> jax.Array:
+                    causal: bool = False, block: int = BLOCK) -> jax.Array:
     """Drop-in for ``ops.full_attention``: ``[B, S, H, D]`` → ``[B, S, H, D]``.
 
-    Requires ``S % 128 == 0`` (lane-aligned blocks). Differentiable via the two-kernel
-    flash backward; usable as the transformer family's ``attention_fn``.
+    Requires ``S % block == 0`` with ``block`` a multiple of 128 (lane-aligned).
+    Differentiable via the two-kernel flash backward; usable as the transformer
+    family's ``attention_fn``. ``block`` is a pure performance knob (numerics are
+    block-invariant — pinned in tests); tune it with ``bench_attention.py --block``.
     """
     b, s, h, d = q.shape
-    if s % BLOCK:
-        raise ValueError(
-            f"flash_attention requires sequence length divisible by {BLOCK}, got {s} "
-            f"(use ops.full_attention for odd lengths)")
+    _check_block(s, block)
     to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
-    out3 = _make_op(bool(causal))(to3(q), to3(k), to3(v))
+    out3 = _make_op(bool(causal), int(block))(to3(q), to3(k), to3(v))
     return jnp.transpose(out3.reshape(b, h, s, d), (0, 2, 1, 3))

@@ -67,6 +67,36 @@ def test_multi_block_sequence():
         **_tol(1e-5, 1e-5))
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_block_size_is_numerics_invariant(causal):
+    """``block`` is a pure performance knob (r3 tuning surface): a 256-row block over
+    a 512-sequence — forward AND gradients — equals both the dense oracle and the
+    default-block kernel."""
+    q, k, v = _qkv(b=1, s=512, h=2, d=64, seed=4)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal=causal, block=256)),
+        np.asarray(full_attention(q, k, v, causal=causal)),
+        **_tol(1e-5, 1e-5))
+
+    def loss(attn):
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v, causal=causal)))
+
+    g_ref = jax.grad(loss(full_attention), argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss(lambda q, k, v, causal: flash_attention(
+        q, k, v, causal=causal, block=256)), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   err_msg=name, **_tol(1e-4, 2e-5))
+
+
+def test_block_validation():
+    q, k, v = _qkv(b=1, s=256, h=1, d=64, seed=5)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        flash_attention(q, k, v, block=64)
+    with pytest.raises(ValueError, match="divisible by block"):
+        flash_attention(q, k, v, block=384)
+
+
 def test_indivisible_sequence_rejected():
     q, k, v = _qkv(s=200)
     with pytest.raises(ValueError, match="divisible"):

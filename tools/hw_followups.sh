@@ -35,6 +35,13 @@ timeout --kill-after=60 --signal=TERM 2700 python bench_attention.py \
   --out "$OUT/bench_attention_tpu.jsonl" > /dev/null 2> "$OUT/bench_attention.err"
 echo "bench_attention rc=$? (rows: $OUT/bench_attention_tpu.jsonl)"
 
+echo "=== 2a. flash block-size tune for the S<=8k regime (r3: flash trailed dense by" \
+     "up to 4% at the default 128 block in the r2 capture) ==="
+timeout --kill-after=60 --signal=TERM 2700 python bench_attention.py \
+  --seq-lens 2048 4096 8192 --block-sweep 128 256 512 \
+  --out "$OUT/bench_attention_blocktune.jsonl" > /dev/null 2> "$OUT/blocktune.err"
+echo "block tune rc=$? (rows: $OUT/bench_attention_blocktune.jsonl)"
+
 echo "=== 2b. transformer MFU bench (MXU-shaped: d_model 256, seq 256, batch 64; r3) ==="
 timeout --kill-after=60 --signal=TERM 1800 python bench_transformer.py \
   > "$OUT/bench_transformer_tpu.json" 2> "$OUT/bench_transformer.err"
