@@ -304,7 +304,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             make_epoch_fn(engine, learning_rate=config.learning_rate,
                           momentum=config.momentum,
                           grad_accum=config.grad_accum, optimizer=optimizer,
-                          lr_schedule=lr_schedule),
+                          lr_schedule=lr_schedule,
+                          clip_grad_norm=config.clip_grad_norm),
             in_shardings=(state_sh, rep, rep, idx_sh, rep),
             out_shardings=(state_sh, rep), donate_argnums=(0,))
         param_shardings = state_sh.params
@@ -319,7 +320,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             make_epoch_fn(model, learning_rate=config.learning_rate,
                           momentum=config.momentum,
                           grad_accum=config.grad_accum, optimizer=optimizer,
-                          lr_schedule=lr_schedule),
+                          lr_schedule=lr_schedule,
+                          clip_grad_norm=config.clip_grad_norm),
             mesh, data_axis="data" if data_size > 1 else None)
         param_shardings = tp.state_shardings(mesh, state).params
         eval_model = model

@@ -175,7 +175,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                       momentum=config.momentum,
                       unroll=config.scan_unroll, pregather=config.pregather,
                       grad_accum=config.grad_accum, optimizer=optimizer,
-                      lr_schedule=lr_schedule), mesh)
+                      lr_schedule=lr_schedule,
+                      clip_grad_norm=config.clip_grad_norm), mesh)
     eval_fn = dp.compile_eval(
         make_eval_fn(model, batch_size=config.batch_size_test), mesh,
         shard=config.shard_eval)
@@ -188,7 +189,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
             make_train_step(model, learning_rate=config.learning_rate,
                             momentum=config.momentum,
                             grad_accum=config.grad_accum,
-                            optimizer=optimizer, lr_schedule=lr_schedule), mesh)
+                            optimizer=optimizer, lr_schedule=lr_schedule,
+                            clip_grad_norm=config.clip_grad_norm), mesh)
         col_lo, col_hi = _host_local_columns(mesh, per_replica_batch)
         M.log(f"Host-local feed: this process feeds global-batch columns "
               f"[{col_lo}:{col_hi}]")

@@ -130,6 +130,11 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         raise ValueError("--use-pallas-kernels/--experimental-fused-step bake the "
                          "learning rate into the fused kernel — use the default "
                          "constant schedule without warmup")
+    if config.clip_grad_norm and config.experimental_fused_step:
+        # (--use-pallas-kernels composes fine: the clip runs in XLA before the fused
+        # update kernel; the whole-model fused step bypasses make_train_step entirely.)
+        raise ValueError("--experimental-fused-step runs the whole step in one kernel "
+                         "— --clip-grad-norm is not applied there; drop one of them")
 
     # Device-resident datasets: the one and only host->device transfer.
     train_x, train_y = jnp.asarray(train_ds.images), jnp.asarray(train_ds.labels)
@@ -159,14 +164,16 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                           use_pallas=config.use_pallas_kernels,
                           unroll=config.scan_unroll, pregather=config.pregather,
                           grad_accum=config.grad_accum, optimizer=optimizer,
-                          lr_schedule=lr_schedule),
+                          lr_schedule=lr_schedule,
+                          clip_grad_norm=config.clip_grad_norm),
             donate_argnums=(0,))
         step_fn = jax.jit(
             make_train_step(model, learning_rate=config.learning_rate,
                             momentum=config.momentum,
                             use_pallas=config.use_pallas_kernels,
                             grad_accum=config.grad_accum, optimizer=optimizer,
-                            lr_schedule=lr_schedule),
+                            lr_schedule=lr_schedule,
+                            clip_grad_norm=config.clip_grad_norm),
             donate_argnums=(0,))
     # The final partial batch (drop_last=False) is ragged and need not divide by
     # grad_accum; accumulation is a memory knob, so the tail just steps unaccumulated.
@@ -177,7 +184,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
             make_train_step(model, learning_rate=config.learning_rate,
                             momentum=config.momentum,
                             use_pallas=config.use_pallas_kernels,
-                            optimizer=optimizer, lr_schedule=lr_schedule),
+                            optimizer=optimizer, lr_schedule=lr_schedule,
+                            clip_grad_norm=config.clip_grad_norm),
             donate_argnums=(0,))
     eval_fn = jax.jit(make_eval_fn(model, batch_size=config.batch_size_test))
 

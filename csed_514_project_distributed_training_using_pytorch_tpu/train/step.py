@@ -32,6 +32,7 @@ from jax import lax
 from csed_514_project_distributed_training_using_pytorch_tpu import ops
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.optim import (
     Optimizer,
+    clip_by_global_norm,
     sgd,
     sgd_init,
 )
@@ -67,7 +68,8 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
                     use_pallas: bool = False, grad_accum: int = 1,
                     aux_loss_weight: float = 0.01,
                     optimizer: Optimizer | None = None,
-                    lr_schedule: Callable | None = None) -> Callable:
+                    lr_schedule: Callable | None = None,
+                    clip_grad_norm: float = 0.0) -> Callable:
     """Build ``step(state, images, labels, rng) -> (state, loss)``.
 
     The loss is the canonical ``nll(log_probs)`` formulation (see
@@ -99,6 +101,11 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     ``lr_schedule`` (from ``optim.make_lr_schedule``) maps ``state.step`` to a
     learning-rate multiplier inside the compiled step — warmup/cosine cost zero host
     round-trips. Not supported with ``use_pallas`` (the fused kernel bakes the rate).
+
+    ``clip_grad_norm > 0`` clips the (microbatch-averaged) gradients to that global
+    norm before the update, with torch ``clip_grad_norm_`` semantics
+    (``optim.clip_by_global_norm``); 0 disables. Under SPMD the clip sees the
+    all-reduced global gradient, so every replica scales identically.
     """
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -127,6 +134,8 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
         return ops.nll_loss(log_probs, labels) + aux
 
     def apply_update(state, grads, loss):
+        if clip_grad_norm > 0.0:
+            grads, _ = clip_by_global_norm(grads, clip_grad_norm)
         if use_pallas:
             # Hyperparams come from the Optimizer (not this function's kwargs) so an
             # explicitly passed optim.sgd(...) can never silently diverge from what
@@ -180,7 +189,8 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
                   use_pallas: bool = False, unroll: int = 1,
                   pregather: bool = False, grad_accum: int = 1,
                   optimizer: Optimizer | None = None,
-                  lr_schedule: Callable | None = None) -> Callable:
+                  lr_schedule: Callable | None = None,
+                  clip_grad_norm: float = 0.0) -> Callable:
     """Build ``epoch(state, images, labels, idx_matrix, rng) -> (state, losses)``.
 
     ``images``/``labels`` are the full (device-resident) training split; ``idx_matrix`` is a
@@ -201,7 +211,8 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
     """
     train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum,
                                  use_pallas=use_pallas, grad_accum=grad_accum,
-                                 optimizer=optimizer, lr_schedule=lr_schedule)
+                                 optimizer=optimizer, lr_schedule=lr_schedule,
+                                 clip_grad_norm=clip_grad_norm)
     return make_epoch_from_step(train_step, unroll=unroll, pregather=pregather)
 
 

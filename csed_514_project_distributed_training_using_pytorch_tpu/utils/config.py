@@ -38,6 +38,8 @@ class SingleProcessConfig:
                                       # cosine horizon anchors at the restored step
                                       # (the resumed run decays over its own span)
     warmup_steps: int = 0             # linear warmup ramp over the first N updates
+    clip_grad_norm: float = 0.0       # clip gradients to this global norm before the
+                                      # update (torch clip_grad_norm_ semantics); 0 off
     log_interval: int = 10            # src/train.py:17
     seed: int = 1                     # src/train.py:19 (torch.manual_seed(random_seed))
     data_dir: str = "files"           # src/train.py:26 ({CURR_PATH}/files/; one dir, not the
@@ -103,6 +105,7 @@ class DistributedConfig:
     lr_schedule: str = "constant"     # 'constant' or 'cosine' (see
                                       # SingleProcessConfig.lr_schedule)
     warmup_steps: int = 0             # linear warmup ramp over the first N updates
+    clip_grad_norm: float = 0.0       # global-norm gradient clipping; 0 disables
     log_interval: int = 10            # src/train_dist.py:129
     seed: int = 1                     # src/train_dist.py:135 (model/init seed)
     sampler_seed: int = 42            # src/train_dist.py:37 (DistributedSampler seed)
@@ -198,6 +201,7 @@ class ComposedConfig:
     lr_schedule: str = "constant"       # 'constant' or 'cosine' (see
                                         # SingleProcessConfig.lr_schedule)
     warmup_steps: int = 0               # linear warmup ramp over the first N updates
+    clip_grad_norm: float = 0.0         # global-norm gradient clipping; 0 disables
     dropout_rate: float = 0.0           # 0 keeps composed runs comparable across meshes
     seed: int = 1
     data_dir: str = "files"

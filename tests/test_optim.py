@@ -98,6 +98,48 @@ def test_map_param_trees_contract():
     assert int(out["count"]) == 7
 
 
+def test_clip_by_global_norm_matches_torch():
+    torch = pytest.importorskip("torch")
+
+    grads = _grads(0, seed=500)
+    leaves = jax.tree_util.tree_leaves(grads)
+    for max_norm in (0.5, 1e6):   # one clipping case, one no-op case
+        t_params = [torch.nn.Parameter(torch.zeros(tuple(g.shape))) for g in leaves]
+        for tp_, g in zip(t_params, leaves):
+            tp_.grad = torch.tensor(np.asarray(g))
+        t_norm = torch.nn.utils.clip_grad_norm_(t_params, max_norm)
+        clipped, gnorm = optim.clip_by_global_norm(grads, max_norm)
+        np.testing.assert_allclose(float(gnorm), float(t_norm), rtol=1e-6)
+        for tp_, c in zip(t_params, jax.tree_util.tree_leaves(clipped)):
+            np.testing.assert_allclose(np.asarray(c), tp_.grad.numpy(),
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_train_step_clips_gradients():
+    """clip_grad_norm=tiny must shrink the applied update to (lr * tiny)-scale —
+    i.e. the clipped step differs from the unclipped one and has bounded movement."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state, make_train_step,
+    )
+
+    model = Net()
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(8, 28, 28, 1)).astype(np.float32))
+    y = jnp.asarray((np.arange(8) % 10).astype(np.int32))
+    s0 = create_train_state(model, jax.random.PRNGKey(0))
+    lr, clip = 0.1, 1e-3
+    clipped, _ = jax.jit(make_train_step(model, learning_rate=lr, momentum=0.0,
+                                         clip_grad_norm=clip))(
+        s0, x, y, jax.random.PRNGKey(1))
+    total_sq = sum(float(jnp.sum((a - b) ** 2)) for a, b in zip(
+        jax.tree_util.tree_leaves(clipped.params),
+        jax.tree_util.tree_leaves(s0.params)))
+    # ||Δp|| = lr * ||clipped g|| <= lr * clip (momentum 0, first step).
+    assert total_sq ** 0.5 <= lr * clip * 1.01
+    assert total_sq > 0.0
+
+
 def test_lr_schedule_shapes():
     import jax.numpy as jnp
 
