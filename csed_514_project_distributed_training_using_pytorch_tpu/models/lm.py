@@ -1,0 +1,239 @@
+"""Autoregressive pixel language model — the decoder family with KV-cache generation.
+
+The reference has one model, a feed-forward MNIST classifier (reference
+``src/model.py:4-22``); this module is beyond-parity surface that makes the framework's
+CAUSAL machinery (causal attention, zig-zag rings, causal ring-of-flash) serve a real
+autoregressive workload instead of an artificially-masked classifier:
+
+- ``TransformerLM``: a decoder-only transformer over quantized pixel tokens. An MNIST
+  image becomes a 784-token stream (``tokenize_images_to_ids``); training is standard
+  teacher-forced next-token prediction (shift-right with BOS); the blocks are the SAME
+  ``TransformerBlock`` as the classifier (same parameter layout, so the TP/FSDP/PP
+  partition rules and the checkpoint format apply unchanged) with ``causal=True``.
+- ``init_cache`` / ``decode_step`` / ``generate``: incremental decoding with per-layer
+  K/V caches — one token's projections per step, attention against the cached prefix,
+  cache append via ``lax.dynamic_update_slice``. The whole sampling loop is ONE
+  ``lax.scan`` under ``jit`` (compiler-friendly: static shapes, masked prefix instead
+  of dynamic slices), so generation runs on-device with no per-token Python dispatch.
+
+The decode path re-expresses the block math for a single position; its numerics are
+pinned against the full teacher-forced forward at every position in
+``tests/test_lm.py`` — the duplication is safe because the test fails if they drift.
+
+TPU-first choices mirror the classifier: MXU-shaped denses, f32 softmax/LN statistics
+under a ``dtype`` knob, pluggable ``attention_fn`` (ring/ulysses/flash cores drop in for
+long-context training — S=784 divides an 8-way mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as fnn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from csed_514_project_distributed_training_using_pytorch_tpu import ops
+from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
+    MASK_VALUE,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer import (
+    TransformerBlock,
+    _normal_init,
+    _ones_init,
+    _zeros_init,
+)
+
+# torchvision's MNIST normalization constants (reference src/train.py:28-30): the
+# datasets store (x/255 - MEAN) / STD; the tokenizer inverts this to bin raw
+# intensity. Imported from the data pipeline so the two can never drift.
+from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
+    MNIST_MEAN as _MNIST_MEAN,
+    MNIST_STD as _MNIST_STD,
+)
+
+
+def tokenize_images_to_ids(x: jax.Array, *, num_levels: int = 16) -> jax.Array:
+    """``[B, H, W, C]`` normalized images → ``[B, H·W·C]`` int32 token ids in
+    ``[0, num_levels)``: un-normalize to raw [0, 1] intensity, then quantize to
+    ``num_levels`` uniform gray levels (vocab ids ``0..num_levels-1``; the LM reserves
+    id ``num_levels`` for BOS)."""
+    b = x.shape[0]
+    raw = x * _MNIST_STD + _MNIST_MEAN
+    ids = jnp.clip(jnp.round(raw * (num_levels - 1)), 0, num_levels - 1)
+    return ids.reshape(b, -1).astype(jnp.int32)
+
+
+def ids_to_images(ids: jax.Array, *, num_levels: int = 16,
+                  shape=(28, 28, 1)) -> jax.Array:
+    """Invert ``tokenize_images_to_ids`` (up to quantization): token ids →
+    ``[B, H, W, C]`` raw [0, 1] intensity images (for saving sampled digits)."""
+    raw = ids.astype(jnp.float32) / (num_levels - 1)
+    return raw.reshape((ids.shape[0],) + tuple(shape))
+
+
+class TransformerLM(fnn.Module):
+    """Decoder-only LM over pixel tokens: ``[B, S]`` ids → ``[B, S, vocab]`` log-probs.
+
+    ``vocab_size`` counts the BOS id (``num_levels + 1`` for the pixel vocabulary).
+    The input is the shift-right stream (BOS first); position ``t``'s output predicts
+    the t-th target token. Blocks reuse ``TransformerBlock`` (``block_i`` naming), so
+    TP/FSDP partition specs and the PP stack/unstack bridge apply as-is.
+    """
+
+    vocab_size: int = 17        # 16 gray levels + BOS
+    seq_len: int = 784
+    embed_dim: int = 64
+    num_layers: int = 2
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.0
+    attention_fn: Callable = ops.full_attention
+    dtype: jnp.dtype = jnp.float32
+    remat: bool = False
+
+    @fnn.compact
+    def __call__(self, ids: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        b, s = ids.shape
+        if s != self.seq_len:
+            raise ValueError(f"expected seq_len {self.seq_len}, got {s}")
+        # Tolerate float zeros from shape-only init paths (train.step.create_train_state
+        # initializes with jnp.zeros(sample_input_shape)).
+        ids = ids.astype(jnp.int32)
+
+        tok = self.param("tok_embed", _normal_init(0.02),
+                         (self.vocab_size, self.embed_dim))
+        pos = self.param("pos_embed", _normal_init(0.02),
+                         (self.seq_len, self.embed_dim))
+        h = tok.astype(self.dtype)[ids] + pos.astype(self.dtype)[None]
+
+        block_cls = TransformerBlock
+        if self.remat:
+            block_cls = fnn.remat(TransformerBlock, static_argnums=(2,))
+        for i in range(self.num_layers):
+            h = block_cls(
+                num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+                dropout_rate=self.dropout_rate, attention_fn=self.attention_fn,
+                causal=True, dtype=self.dtype, name=f"block_{i}")(h, deterministic)
+
+        g = self.param("ln_f_scale", _ones_init, (self.embed_dim,))
+        beta = self.param("ln_f_bias", _zeros_init, (self.embed_dim,))
+        h = ops.layer_norm(h, g, beta)
+        w_head = self.param("head_kernel", _normal_init(0.02),
+                            (self.embed_dim, self.vocab_size))
+        b_head = self.param("head_bias", _zeros_init, (self.vocab_size,))
+        logits = ops.dense(h, w_head.astype(self.dtype), b_head.astype(self.dtype))
+        return ops.log_softmax(logits.astype(jnp.float32))
+
+    def shift_right(self, targets: jax.Array) -> jax.Array:
+        """Teacher-forcing input stream: ``[BOS, t_0, …, t_{S-2}]`` (BOS id =
+        ``vocab_size - 1``)."""
+        bos = jnp.full((targets.shape[0], 1), self.vocab_size - 1, targets.dtype)
+        return jnp.concatenate([bos, targets[:, :-1]], axis=1)
+
+
+def next_token_loss(model: TransformerLM, params, targets: jax.Array, rng,
+                    *, deterministic: bool = False) -> jax.Array:
+    """Mean next-token NLL over all ``B·S`` positions (the LM training objective)."""
+    kwargs = {"deterministic": True} if deterministic else {"deterministic": False}
+    rngs = {} if deterministic else {"dropout": rng}
+    log_probs = model.apply({"params": params}, model.shift_right(targets),
+                            rngs=rngs, **kwargs)
+    return -jnp.mean(jnp.take_along_axis(log_probs, targets[..., None],
+                                         axis=-1))
+
+
+# =========================================================================================
+# Incremental decoding (explicit functional KV cache)
+# =========================================================================================
+
+
+def init_cache(model: TransformerLM, batch: int) -> dict:
+    """Zeroed per-layer K/V caches ``[B, seq_len, H, Dh]`` (f32 — the merge math the
+    forward uses is f32 regardless of activation dtype)."""
+    head_dim = model.embed_dim // model.num_heads
+    shape = (batch, model.seq_len, model.num_heads, head_dim)
+    return {f"block_{i}": {"k": jnp.zeros(shape, jnp.float32),
+                           "v": jnp.zeros(shape, jnp.float32)}
+            for i in range(model.num_layers)}
+
+
+def decode_step(model: TransformerLM, params, cache: dict, ids_t: jax.Array,
+                t: jax.Array) -> tuple[dict, jax.Array]:
+    """One incremental step: token ids at position ``t`` → log-probs for position
+    ``t``'s prediction, with every layer's K/V appended to the cache.
+
+    ``ids_t: [B]``, ``t``: int32 scalar (traced). Re-expresses the block math for a
+    single position (pre-LN attn + MLP residuals) attending against the masked cached
+    prefix — pinned equal to the full forward at every position in tests.
+    """
+    b = ids_t.shape[0]
+    e, nh = model.embed_dim, model.num_heads
+    hd = e // nh
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+
+    h = (params["tok_embed"].astype(jnp.float32)[ids_t]
+         + params["pos_embed"].astype(jnp.float32)[t])            # [B, E]
+
+    for i in range(model.num_layers):
+        p = params[f"block_{i}"]
+        a = p["attn"]
+        x = ops.layer_norm(h, p["ln1_scale"], p["ln1_bias"])
+        qkv = ops.dense(x, a["qkv_kernel"], a["qkv_bias"])        # [B, 3E]
+        q, k, v = (qkv[:, :e].reshape(b, nh, hd),
+                   qkv[:, e:2 * e].reshape(b, nh, hd),
+                   qkv[:, 2 * e:].reshape(b, nh, hd))
+        layer = cache[f"block_{i}"]
+        k_cache = lax.dynamic_update_slice(layer["k"], k[:, None], (0, t, 0, 0))
+        v_cache = lax.dynamic_update_slice(layer["v"], v[:, None], (0, t, 0, 0))
+        cache = {**cache, f"block_{i}": {"k": k_cache, "v": v_cache}}
+        # Masked-prefix attention: full-length scores with positions > t masked out —
+        # static shapes (scan/jit-friendly) instead of a dynamic-length slice.
+        scores = jnp.einsum("bhd,bshd->bhs", q * scale, k_cache)  # [B, H, S]
+        visible = jnp.arange(model.seq_len)[None, None] <= t
+        scores = jnp.where(visible, scores, MASK_VALUE)
+        weights = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhs,bshd->bhd", weights, v_cache).reshape(b, e)
+        h = h + ops.dense(attn, a["out_kernel"], a["out_bias"])
+
+        x = ops.layer_norm(h, p["ln2_scale"], p["ln2_bias"])
+        up = ops.gelu(ops.dense(x, p["mlp_up_kernel"], p["mlp_up_bias"]))
+        h = h + ops.dense(up, p["mlp_down_kernel"], p["mlp_down_bias"])
+
+    h = ops.layer_norm(h, params["ln_f_scale"], params["ln_f_bias"])
+    logits = ops.dense(h, params["head_kernel"], params["head_bias"])
+    return cache, ops.log_softmax(logits.astype(jnp.float32))
+
+
+def generate(model: TransformerLM, params, rng: jax.Array, *, batch: int = 1,
+             temperature: float = 1.0) -> jax.Array:
+    """Sample ``[batch, seq_len]`` token streams from BOS, autoregressively.
+
+    ``temperature <= 0`` decodes greedily. The whole loop is one ``lax.scan`` (wrap in
+    ``jax.jit`` for repeated use); per-step work is the KV-cache ``decode_step``, so
+    cost is O(S²·E) total instead of the O(S³·E) of re-running the full forward per
+    position.
+    """
+    # Host (numpy) checkpoints decode too: numpy leaves can't be indexed by traced
+    # token ids inside the scan.
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    bos = jnp.full((batch,), model.vocab_size - 1, jnp.int32)
+
+    def step(carry, t):
+        cache, ids_t, key = carry
+        cache, log_probs = decode_step(model, params, cache, ids_t, t)
+        # BOS is an input-only symbol (the tokenizer never produces it): mask its
+        # logit so samples stay in the pixel vocabulary ids_to_images can invert.
+        log_probs = log_probs.at[:, model.vocab_size - 1].set(MASK_VALUE)
+        key, sub = jax.random.split(key)
+        if temperature > 0:
+            nxt = jax.random.categorical(sub, log_probs / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(log_probs, axis=-1)
+        return (cache, nxt.astype(jnp.int32), key), nxt.astype(jnp.int32)
+
+    (_, _, _), tokens = lax.scan(
+        step, (init_cache(model, batch), bos, rng),
+        jnp.arange(model.seq_len, dtype=jnp.int32))
+    return jnp.transpose(tokens)          # [S, B] -> [B, S]

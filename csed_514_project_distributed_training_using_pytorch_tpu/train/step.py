@@ -69,7 +69,8 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
                     aux_loss_weight: float = 0.01,
                     optimizer: Optimizer | None = None,
                     lr_schedule: Callable | None = None,
-                    clip_grad_norm: float = 0.0) -> Callable:
+                    clip_grad_norm: float = 0.0,
+                    loss_fn: Callable | None = None) -> Callable:
     """Build ``step(state, images, labels, rng) -> (state, loss)``.
 
     The loss is the canonical ``nll(log_probs)`` formulation (see
@@ -106,6 +107,11 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     norm before the update, with torch ``clip_grad_norm_`` semantics
     (``optim.clip_by_global_norm``); 0 disables. Under SPMD the clip sees the
     all-reduced global gradient, so every replica scales identically.
+
+    ``loss_fn(params, xs, ys, rng) -> scalar`` overrides the classification objective
+    entirely (e.g. the LM's next-token loss, ``train/lm.py``) while keeping every
+    other mechanism — grad-accum, clipping, schedules, optimizers — unchanged. Not
+    supported with ``use_pallas`` (the fused kernels implement the standard loss).
     """
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -117,12 +123,15 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     if use_pallas and lr_schedule is not None:
         raise ValueError("use_pallas bakes the learning rate into the fused kernel — "
                          "lr_schedule is not supported there")
+    if use_pallas and loss_fn is not None:
+        raise ValueError("use_pallas fuses the standard NLL loss kernel — a custom "
+                         "loss_fn is not supported there")
     if use_pallas:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
             pallas_kernels as pk,
         )
 
-    def loss_fn(params, images, labels, rng):
+    def default_loss_fn(params, images, labels, rng):
         log_probs, variables = model.apply(
             {"params": params}, images, deterministic=False,
             rngs={"dropout": rng}, mutable=["aux_loss"])
@@ -132,6 +141,9 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
             # log_softmax is idempotent: fused nll-from-logits on log-probs is identical.
             return pk.nll_from_logits(log_probs, labels) + aux
         return ops.nll_loss(log_probs, labels) + aux
+
+    if loss_fn is None:
+        loss_fn = default_loss_fn
 
     def apply_update(state, grads, loss):
         if clip_grad_norm > 0.0:

@@ -211,6 +211,41 @@ class ComposedConfig:
     max_test_examples: int = 0
 
 
+@dataclass(frozen=True)
+class LMConfig:
+    """Knobs of the autoregressive pixel-LM trainer (``train/lm.py`` — beyond-parity:
+    the reference has no language model or generation path to mirror)."""
+
+    epochs: int = 2
+    batch_size: int = 64                # global batch, sharded over the data axis
+    num_levels: int = 16                # gray-level vocabulary (BOS id = num_levels)
+    embed_dim: int = 64
+    num_layers: int = 2
+    num_heads: int = 4
+    dropout_rate: float = 0.0
+    learning_rate: float = 1e-3
+    momentum: float = 0.5               # sgd only (adamw is the LM default)
+    optimizer: str = "adamw"
+    weight_decay: float = 0.01
+    lr_schedule: str = "constant"
+    warmup_steps: int = 0
+    clip_grad_norm: float = 1.0         # LM training convention; 0 disables
+    grad_accum: int = 1
+    bf16: bool = False
+    remat: bool = False
+    eval_batch: int = 500               # test-perplexity scan batch (must divide split)
+    generate: int = 6                   # sample this many digits after training (0 off)
+    temperature: float = 1.0            # sampling temperature (<= 0 decodes greedily)
+    seed: int = 1
+    data_dir: str = "files"
+    download_data: bool = False
+    results_dir: str = "results"
+    images_dir: str = "images"
+    resume_from: str = ""               # per-epoch checkpoint to resume from
+    max_train_examples: int = 0
+    max_test_examples: int = 0
+
+
 def _add_args(parser: argparse.ArgumentParser, cfg) -> None:
     for f in dataclasses.fields(cfg):
         arg = "--" + f.name.replace("_", "-")

@@ -35,27 +35,45 @@ def _ensure_dir(path: str) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
 
+def _save_grid(images: np.ndarray, titles: list, path: str,
+               **imshow_kw) -> str | None:
+    """Shared digit-grid body: 3 columns, as many rows as the image count needs."""
+    if not (HAVE_MATPLOTLIB and is_logging_process()):
+        return None
+    _ensure_dir(path)
+    n = len(titles)
+    rows = -(-n // 3)
+    fig = plt.figure()
+    for i in range(n):
+        plt.subplot(rows, 3, i + 1)
+        plt.tight_layout()
+        plt.imshow(np.asarray(images[i, :, :, 0]), cmap="gray",
+                   interpolation="none", **imshow_kw)
+        plt.title(titles[i])
+        plt.xticks([])
+        plt.yticks([])
+    fig.savefig(path)
+    plt.close(fig)
+    return path
+
+
 def save_sample_grid(images: np.ndarray, labels: np.ndarray, path: str,
                      n: int = 6) -> str | None:
     """Grid of ``n`` example digits with their labels (≙ reference src/train.py:43-57).
 
     ``images`` are normalized NHWC; de-normalized for display.
     """
-    if not (HAVE_MATPLOTLIB and is_logging_process()):
-        return None
-    _ensure_dir(path)
-    fig = plt.figure()
-    for i in range(n):
-        plt.subplot(2, 3, i + 1)
-        plt.tight_layout()
-        img = np.asarray(images[i, :, :, 0]) * MNIST_STD + MNIST_MEAN
-        plt.imshow(img, cmap="gray", interpolation="none")
-        plt.title(f"Ground Truth: {int(labels[i])}")
-        plt.xticks([])
-        plt.yticks([])
-    fig.savefig(path)
-    plt.close(fig)
-    return path
+    imgs = np.asarray(images[:n]) * MNIST_STD + MNIST_MEAN
+    return _save_grid(imgs, [f"Ground Truth: {int(l)}" for l in labels[:n]], path)
+
+
+def save_generated_grid(images_raw: np.ndarray, path: str,
+                        n: int = 6) -> str | None:
+    """Grid of ``n`` model-generated digits (raw [0, 1] intensity NHWC — the pixel
+    LM's ``ids_to_images`` output; no ground-truth labels exist for samples)."""
+    n = min(n, len(images_raw))
+    return _save_grid(np.asarray(images_raw[:n]), [f"Sample {i}" for i in range(n)],
+                      path, vmin=0.0, vmax=1.0)
 
 
 def save_loss_curves(history: MetricsHistory, path: str) -> str | None:
