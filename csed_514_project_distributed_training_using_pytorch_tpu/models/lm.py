@@ -207,20 +207,39 @@ def decode_step(model: TransformerLM, params, cache: dict, ids_t: jax.Array,
 
 
 def generate(model: TransformerLM, params, rng: jax.Array, *, batch: int = 1,
-             temperature: float = 1.0) -> jax.Array:
+             temperature: float = 1.0, prompt: jax.Array | None = None,
+             prompt_len: int = 0) -> jax.Array:
     """Sample ``[batch, seq_len]`` token streams from BOS, autoregressively.
 
     ``temperature <= 0`` decodes greedily. The whole loop is one ``lax.scan`` (wrap in
     ``jax.jit`` for repeated use); per-step work is the KV-cache ``decode_step``, so
     cost is O(S²·E) total instead of the O(S³·E) of re-running the full forward per
     position.
+
+    ``prompt`` (``[batch, seq_len]`` token ids) with ``prompt_len = K`` conditions the
+    sample: the first ``K`` output positions are teacher-forced to the prompt (their
+    K/V still populate the cache), and positions ``K..S-1`` are sampled — e.g. digit
+    COMPLETION from the top rows of a real image. ``prompt_len`` must be a Python int
+    (it selects statically which scan steps force; the forced tokens themselves are
+    traced data).
     """
     # Host (numpy) checkpoints decode too: numpy leaves can't be indexed by traced
     # token ids inside the scan.
     params = jax.tree_util.tree_map(jnp.asarray, params)
+    if prompt is None:
+        prompt = jnp.zeros((batch, model.seq_len), jnp.int32)
+        prompt_len = 0
+    if not 0 <= prompt_len <= model.seq_len:
+        raise ValueError(f"prompt_len {prompt_len} outside [0, {model.seq_len}]")
+    if prompt.shape != (batch, model.seq_len):
+        # Explicit: a [1, S] prompt with batch > 1 would silently broadcast one
+        # forced prefix across the whole batch.
+        raise ValueError(f"prompt shape {prompt.shape} != (batch, seq_len) = "
+                         f"({batch}, {model.seq_len})")
     bos = jnp.full((batch,), model.vocab_size - 1, jnp.int32)
 
-    def step(carry, t):
+    def step(carry, scan_in):
+        t, prompt_t = scan_in
         cache, ids_t, key = carry
         cache, log_probs = decode_step(model, params, cache, ids_t, t)
         # BOS is an input-only symbol (the tokenizer never produces it): mask its
@@ -231,9 +250,14 @@ def generate(model: TransformerLM, params, rng: jax.Array, *, batch: int = 1,
             nxt = jax.random.categorical(sub, log_probs / temperature, axis=-1)
         else:
             nxt = jnp.argmax(log_probs, axis=-1)
-        return (cache, nxt.astype(jnp.int32), key), nxt.astype(jnp.int32)
+        # Teacher-force the prompt region. The forced token conditions later steps
+        # through the NEXT step's cache write (it becomes ids_t at t+1; decode_step
+        # at t cached the PREVIOUS position's token).
+        nxt = jnp.where(t < prompt_len, prompt_t, nxt).astype(jnp.int32)
+        return (cache, nxt, key), nxt
 
+    positions = jnp.arange(model.seq_len, dtype=jnp.int32)
     (_, _, _), tokens = lax.scan(
         step, (init_cache(model, batch), bos, rng),
-        jnp.arange(model.seq_len, dtype=jnp.int32))
+        (positions, jnp.transpose(prompt.astype(jnp.int32))))
     return jnp.transpose(tokens)          # [S, B] -> [B, S]

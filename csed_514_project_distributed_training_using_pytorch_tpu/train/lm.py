@@ -184,14 +184,25 @@ def main(config: LMConfig = LMConfig(), *,
     if ckpt_path:
         M.log(f"Saved {ckpt_path}")
     if config.generate > 0:
-        ids = jax.jit(lambda key: lm_mod.generate(
-            model, host_state.params, key, batch=config.generate,
-            temperature=config.temperature))(jax.random.PRNGKey(config.seed + 2))
-        path = os.path.join(config.images_dir, "lm_samples.png")
-        if plotting.save_generated_grid(
-                np.asarray(lm_mod.ids_to_images(ids, num_levels=config.num_levels)),
-                path, n=config.generate) is not None:
-            M.log(f"Saved {path}")
+        def sample_grid(filename: str, seed_offset: int, batch: int, **gen_kw):
+            ids = jax.jit(lambda key: lm_mod.generate(
+                model, host_state.params, key, batch=batch,
+                temperature=config.temperature, **gen_kw))(
+                    jax.random.PRNGKey(config.seed + seed_offset))
+            path = os.path.join(config.images_dir, filename)
+            if plotting.save_generated_grid(
+                    np.asarray(lm_mod.ids_to_images(ids,
+                                                    num_levels=config.num_levels)),
+                    path, n=batch) is not None:
+                M.log(f"Saved {path}")
+
+        sample_grid("lm_samples.png", 2, config.generate)
+        # Digit completion: teacher-force the top half of real test images, sample
+        # the bottom half — the prompt-conditioned generation surface.
+        n_c = min(config.generate, n_test)
+        sample_grid("lm_completions.png", 3, n_c,
+                    prompt=jnp.asarray(test_tokens[:n_c]),
+                    prompt_len=seq_len // 2)
     plotting.save_loss_curves(history,
                               os.path.join(config.images_dir, "lm_loss_curve.png"))
     return host_state, history
