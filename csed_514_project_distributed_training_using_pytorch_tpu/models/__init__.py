@@ -19,7 +19,8 @@ VALID_MODELS = ("cnn", "transformer")
 
 
 def validate_model_config(name: str, *, remat: bool = False,
-                          causal: bool = False) -> None:
+                          causal: bool = False,
+                          attention_window: int = 0) -> None:
     """Fail fast on a bad ``--model`` value or model/knob combination — callers run this
     before any data download, dataset load, or cluster rendezvous so typos cost
     milliseconds, not side effects (on a fleet: not a full rendezvous per host)."""
@@ -32,10 +33,15 @@ def validate_model_config(name: str, *, remat: bool = False,
     if causal and name == "cnn":
         raise ValueError("--causal applies to the transformer family only "
                          "(the CNN has no attention to mask)")
+    if attention_window and name == "cnn":
+        raise ValueError("--attention-window applies to the transformer family only "
+                         "(the CNN has no attention to window)")
+    if attention_window < 0:
+        raise ValueError(f"--attention-window must be >= 0, got {attention_window}")
 
 
 def build_model(name: str, *, bf16: bool = False, remat: bool = False,
-                causal: bool = False):
+                causal: bool = False, attention_window: int = 0):
     """Model factory behind the trainers' ``--model`` flag. Both families share the
     ``(x, *, deterministic)`` call contract on ``[B, 28, 28, 1]`` input, so every
     trainer/eval/checkpoint path works with either.
@@ -43,13 +49,23 @@ def build_model(name: str, *, bf16: bool = False, remat: bool = False,
     ``bf16`` runs activations in bfloat16 (the MXU's native dtype) with float32 master
     weights and float32 softmax/loss statistics. ``remat`` (transformer only) recomputes
     each block's activations on backward — the ``jax.checkpoint`` memory/FLOPs trade.
-    ``causal`` (transformer only) masks attention decoder-style.
+    ``causal`` (transformer only) masks attention decoder-style. ``attention_window``
+    (transformer only; 0 = full attention) restricts attention to a sliding window of
+    that width (``ops.full_attention``'s ``window`` semantics) — the local-attention
+    long-context knob.
     """
-    validate_model_config(name, remat=remat, causal=causal)
+    validate_model_config(name, remat=remat, causal=causal,
+                          attention_window=attention_window)
     dtype = jnp.bfloat16 if bf16 else jnp.float32
     if name == "cnn":
         return Net(dtype=dtype)
-    return TransformerClassifier(dtype=dtype, remat=remat, causal=causal)
+    kwargs = {}
+    if attention_window:
+        from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
+            windowed_attention_fn,
+        )
+        kwargs["attention_fn"] = windowed_attention_fn(attention_window)
+    return TransformerClassifier(dtype=dtype, remat=remat, causal=causal, **kwargs)
 
 
 __all__ = ["Net", "TransformerClassifier", "build_model", "validate_model_config",

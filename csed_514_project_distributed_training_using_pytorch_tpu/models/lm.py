@@ -90,8 +90,21 @@ class TransformerLM(fnn.Module):
     mlp_ratio: int = 4
     dropout_rate: float = 0.0
     attention_fn: Callable = ops.full_attention
+    attention_window: int = 0   # sliding-window causal attention over the pixel
+                                # stream (0 = full); composes with the DEFAULT dense
+                                # core only — the KV-cache decode path honors the
+                                # same window, keeping the decode-parity invariant
     dtype: jnp.dtype = jnp.float32
     remat: bool = False
+
+    def _attention_fn(self) -> Callable:
+        if not self.attention_window:
+            return self.attention_fn
+        if self.attention_fn is not ops.full_attention:
+            raise ValueError(
+                "attention_window composes with the default dense core only — "
+                "bake the window into your custom attention_fn instead")
+        return ops.attention.windowed_attention_fn(self.attention_window)
 
     @fnn.compact
     def __call__(self, ids: jax.Array, *, deterministic: bool = True) -> jax.Array:
@@ -111,10 +124,11 @@ class TransformerLM(fnn.Module):
         block_cls = TransformerBlock
         if self.remat:
             block_cls = fnn.remat(TransformerBlock, static_argnums=(2,))
+        attention_fn = self._attention_fn()
         for i in range(self.num_layers):
             h = block_cls(
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
-                dropout_rate=self.dropout_rate, attention_fn=self.attention_fn,
+                dropout_rate=self.dropout_rate, attention_fn=attention_fn,
                 causal=True, dtype=self.dtype, name=f"block_{i}")(h, deterministic)
 
         g = self.param("ln_f_scale", _ones_init, (self.embed_dim,))
@@ -189,9 +203,14 @@ def decode_step(model: TransformerLM, params, cache: dict, ids_t: jax.Array,
         v_cache = lax.dynamic_update_slice(layer["v"], v[:, None], (0, t, 0, 0))
         cache = {**cache, f"block_{i}": {"k": k_cache, "v": v_cache}}
         # Masked-prefix attention: full-length scores with positions > t masked out —
-        # static shapes (scan/jit-friendly) instead of a dynamic-length slice.
+        # static shapes (scan/jit-friendly) instead of a dynamic-length slice. A
+        # windowed model masks the same sliding band it trained with (the
+        # decode-parity invariant covers windowed configs too).
         scores = jnp.einsum("bhd,bshd->bhs", q * scale, k_cache)  # [B, H, S]
-        visible = jnp.arange(model.seq_len)[None, None] <= t
+        pos = jnp.arange(model.seq_len)[None, None]
+        visible = pos <= t
+        if model.attention_window:
+            visible &= t - pos < model.attention_window
         scores = jnp.where(visible, scores, MASK_VALUE)
         weights = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum("bhs,bshd->bhd", weights, v_cache).reshape(b, e)

@@ -22,21 +22,48 @@ import jax.numpy as jnp
 MASK_VALUE = -1e30
 
 
+def validate_window(window: int | None) -> None:
+    """Shared sliding-window validation (one owner for the error message — trainers
+    call it fail-fast before any data load or rendezvous)."""
+    if window is not None and window < 1:
+        raise ValueError(f"attention window must be >= 1, got {window}")
+
+
+def windowed_attention_fn(window: int):
+    """The dense core with a fixed sliding window, in the pluggable
+    ``(q, k, v, *, causal) -> out`` ``attention_fn`` contract — the single wiring
+    helper behind every trainer's ``--attention-window``."""
+    validate_window(window)
+    import functools
+
+    return functools.partial(full_attention, window=window)
+
+
 def full_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                   causal: bool = False) -> jax.Array:
+                   causal: bool = False,
+                   window: int | None = None) -> jax.Array:
     """Dense softmax attention. ``q, k, v: [B, S, H, D]`` → ``[B, S, H, D]``.
 
     ``causal=True`` masks key positions strictly after the query position (decoder-style
-    self-attention). Scores and the softmax run in float32; output is cast back to
-    ``q.dtype``.
+    self-attention). ``window=W`` additionally restricts each query to keys within
+    distance < W (sliding-window/local attention: causal → keys in ``(i-W, i]``;
+    bidirectional → ``|i-j| < W``; every query always sees at least itself). Scores and
+    the softmax run in float32; output is cast back to ``q.dtype``.
     """
+    validate_window(window)
     d = q.shape[-1]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
-    if causal:
+    if causal or window is not None:
         s_q, s_k = scores.shape[-2], scores.shape[-1]
-        mask = jnp.arange(s_q)[:, None] >= jnp.arange(s_k)[None, :]
+        i = jnp.arange(s_q)[:, None]
+        j = jnp.arange(s_k)[None, :]
+        mask = jnp.ones((s_q, s_k), bool)
+        if causal:
+            mask &= i >= j
+        if window is not None:
+            mask &= (i - j < window) & (j - i < window)
         scores = jnp.where(mask[None, None], scores, MASK_VALUE)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", weights, v.astype(jnp.float32))

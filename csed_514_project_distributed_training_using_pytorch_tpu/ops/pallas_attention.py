@@ -54,6 +54,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
     MASK_VALUE as NEG,
+    validate_window,
 )
 
 BLOCK = 128            # default query/key block rows (lane-aligned, MXU-shaped);
@@ -77,11 +78,33 @@ def _check_block(s: int, block: int) -> None:
             f"got {s} (use ops.full_attention for odd lengths)")
 
 
-def _causal_mask(iq, ik, bq, bk):
-    """[bq, bk] visibility mask for query block iq vs key block ik (global positions)."""
+def _visibility_mask(iq, ik, bq, bk, *, causal: bool, window: int = 0):
+    """[bq, bk] visibility mask for query block iq vs key block ik (global positions):
+    causal lower-triangle and/or the sliding-window band (distance < window)."""
     q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return q_pos >= k_pos
+    mask = jnp.ones((bq, bk), bool)
+    if causal:
+        mask &= q_pos >= k_pos
+    if window:
+        mask &= (q_pos - k_pos < window) & (k_pos - q_pos < window)
+    return mask
+
+
+def _block_live(iq, j, bq, bk, *, causal: bool, window: int = 0):
+    """Whether (query block iq, key block j) holds ANY visible pair — the grid-step
+    skip predicate (skipped blocks cost no FLOPs; their fetch still pipelines).
+    Same expression serves the dkv kernel with (i, ik) in the (iq, j) roles."""
+    live = jnp.bool_(True)
+    if causal:
+        live &= j <= iq                                   # not entirely future
+    if window:
+        # Not entirely older than the window: youngest key vs oldest query.
+        live &= iq * bq - (j * bk + bk - 1) < window
+        if not causal:
+            # Bidirectional band: not entirely newer either.
+            live &= j * bk - (iq * bq + bq - 1) < window
+    return live
 
 
 # =========================================================================================
@@ -90,7 +113,7 @@ def _causal_mask(iq, ik, bq, bk):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, num_k):
+                acc_ref, m_ref, l_ref, *, scale, causal, num_k, window=0):
     iq = pl.program_id(1)
     j = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -101,23 +124,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # Causal: key blocks strictly above the diagonal contribute nothing — no FLOPs
+    # Causal/banded: key blocks with no visible pair contribute nothing — no FLOPs
     # (their fetch still pipelines; grids cannot skip steps).
-    @pl.when(jnp.logical_or(jnp.logical_not(causal), j <= iq))
+    @pl.when(_block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window))
     def _():
         q = q_ref[0].astype(jnp.float32) * scale                           # [bq, D]
         k_blk = k_ref[0].astype(jnp.float32)                               # [bk, D]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)        # [bq, bk]
-        if causal:
-            visible = _causal_mask(iq, j, bq, k_ref.shape[1])
+        if causal or window:
+            visible = _visibility_mask(iq, j, bq, k_ref.shape[1],
+                                       causal=causal, window=window)
             s = jnp.where(visible, s, NEG)
         m = m_ref[:]
         l = l_ref[:]
         m_blk = jnp.max(s, axis=1, keepdims=True)                          # [bq, 1]
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new)
-        if causal:
+        if causal or window:
             p = jnp.where(visible, p, 0.0)
         corr = jnp.exp(m - m_new)
         v_blk = v_ref[0].astype(jnp.float32)
@@ -134,13 +158,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[:] = jnp.transpose(lse).reshape(1, 1, 1, bq)
 
 
-def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK):
+def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
+                   window: int = 0):
     """q3/k3/v3: [BH, S, D] → (out [BH, S, D], lse [BH, S/block, 1, block])."""
     bh, s, d = q3.shape
     _check_block(s, block)
     scale = 1.0 / (d ** 0.5)
     nq = s // block
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, num_k=nq)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, num_k=nq,
+                               window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nq),
@@ -180,7 +206,7 @@ def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc_ref, *, scale, causal, num_k):
+               dq_acc_ref, *, scale, causal, num_k, window=0):
     iq = pl.program_id(1)
     j = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -189,7 +215,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _():
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
-    @pl.when(jnp.logical_or(jnp.logical_not(causal), j <= iq))
+    @pl.when(_block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window))
     def _():
         q = q_ref[0].astype(jnp.float32)                          # [bq, D]
         do = do_ref[0].astype(jnp.float32)                        # [bq, D]
@@ -199,11 +225,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         v_blk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            visible = _causal_mask(iq, j, bq, k_ref.shape[1])
+        if causal or window:
+            visible = _visibility_mask(iq, j, bq, k_ref.shape[1],
+                                       causal=causal, window=window)
             s = jnp.where(visible, s, NEG)
         p = jnp.exp(s - lse)                                      # [bq, bk]
-        if causal:
+        if causal or window:
             p = jnp.where(visible, p, 0.0)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -217,7 +244,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_acc_ref, dv_acc_ref, *, scale, causal, num_q):
+                dk_acc_ref, dv_acc_ref, *, scale, causal, num_q, window=0):
     ik = pl.program_id(1)
     i = pl.program_id(2)
     bk = k_ref.shape[1]
@@ -227,8 +254,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    # Causal: query blocks strictly before this key block see none of it.
-    @pl.when(jnp.logical_or(jnp.logical_not(causal), i >= ik))
+    # Causal/banded: query blocks with no visible pair against this key block skip.
+    @pl.when(_block_live(i, ik, q_ref.shape[1], bk, causal=causal, window=window))
     def _():
         k = k_ref[0].astype(jnp.float32)                          # [bk, D]
         v = v_ref[0].astype(jnp.float32)                          # [bk, D]
@@ -238,11 +265,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         delta_blk = jnp.transpose(delta_ref[0, 0])                # [bq, 1]
         s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            visible = _causal_mask(i, ik, q_ref.shape[1], bk)
+        if causal or window:
+            visible = _visibility_mask(i, ik, q_ref.shape[1], bk,
+                                       causal=causal, window=window)
             s = jnp.where(visible, s, NEG)
         p = jnp.exp(s - lse_blk)                                  # [bq, bk]
-        if causal:
+        if causal or window:
             p = jnp.where(visible, p, 0.0)
         # dv += pᵀ · do ; dk += dsᵀ · q
         dv_acc_ref[:] = dv_acc_ref[:] + jax.lax.dot_general(
@@ -261,7 +289,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(res, g, *, causal: bool, block: int = BLOCK):
+def _flash_backward(res, g, *, causal: bool, block: int = BLOCK,
+                    window: int = 0):
     q3, k3, v3, out, lse = res
     bh, s, d = q3.shape
     nq = s // block
@@ -269,11 +298,11 @@ def _flash_backward(res, g, *, causal: bool, block: int = BLOCK):
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).reshape(bh, nq, 1, block)
     return flash_backward_blocks(q3, k3, v3, g, lse, delta, causal=causal,
-                                 block=block)
+                                 block=block, window=window)
 
 
 def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
-                          block: int = BLOCK):
+                          block: int = BLOCK, window: int = 0):
     """One flash-backward pass of a query-block set against a key/value-block set,
     given the GLOBAL softmax statistics: ``(dq, dk, dv)`` contributions.
 
@@ -309,7 +338,8 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
                               memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, num_k=nq),
+        functools.partial(_dq_kernel, scale=scale, causal=causal, num_k=nq,
+                          window=window),
         grid=(bh, nq, nq),
         in_specs=[row_i_spec, row_j_spec, row_j_spec, row_i_spec, lse_i_spec,
                   lse_i_spec],
@@ -321,7 +351,8 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
 
     # dkv grid: axis 1 = key block (accumulators persist), axis 2 = query block.
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, num_q=nq),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, num_q=nq,
+                          window=window),
         grid=(bh, nq, nq),
         in_specs=[row_j_spec, row_i_spec, row_i_spec, row_j_spec, lse_j_spec,
                   lse_j_spec],
@@ -341,18 +372,20 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
 
 
 @functools.lru_cache(maxsize=None)
-def _make_op(causal: bool, block: int = BLOCK):
+def _make_op(causal: bool, block: int = BLOCK, window: int = 0):
     @jax.custom_vjp
     def op(q3, k3, v3):
-        out, _ = _flash_forward(q3, k3, v3, causal=causal, block=block)
+        out, _ = _flash_forward(q3, k3, v3, causal=causal, block=block,
+                                window=window)
         return out
 
     def fwd(q3, k3, v3):
-        out, lse = _flash_forward(q3, k3, v3, causal=causal, block=block)
+        out, lse = _flash_forward(q3, k3, v3, causal=causal, block=block,
+                                  window=window)
         return out, (q3, k3, v3, out, lse)
 
     def bwd(res, g):
-        return _flash_backward(res, g, causal=causal, block=block)
+        return _flash_backward(res, g, causal=causal, block=block, window=window)
 
     op.defvjp(fwd, bwd)
     return op
@@ -372,16 +405,24 @@ def flash_forward_with_lse(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = False, block: int = BLOCK) -> jax.Array:
+                    causal: bool = False, block: int = BLOCK,
+                    window: int | None = None) -> jax.Array:
     """Drop-in for ``ops.full_attention``: ``[B, S, H, D]`` → ``[B, S, H, D]``.
 
     Requires ``S % block == 0`` with ``block`` a multiple of 128 (lane-aligned).
     Differentiable via the two-kernel flash backward; usable as the transformer
     family's ``attention_fn``. ``block`` is a pure performance knob (numerics are
     block-invariant — pinned in tests); tune it with ``bench_attention.py --block``.
+
+    ``window=W`` is sliding-window/local attention with ``full_attention``'s exact
+    semantics (distance < W; causal restricts to the past side) — and a BANDED grid:
+    key blocks entirely outside the window are skipped via ``@pl.when`` in forward
+    and both backward kernels, so compute is O(S·W·D) instead of O(S²·D).
     """
     b, s, h, d = q.shape
     _check_block(s, block)
+    validate_window(window)
     to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
-    out3 = _make_op(bool(causal), int(block))(to3(q), to3(k), to3(v))
+    out3 = _make_op(bool(causal), int(block),
+                    int(window or 0))(to3(q), to3(k), to3(v))
     return jnp.transpose(out3.reshape(b, h, s, d), (0, 2, 1, 3))

@@ -110,6 +110,19 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     """Run composed-mesh training; returns final (host-resident) state + history."""
     watch = M.Stopwatch()
     axis_names, axis_sizes = parse_mesh_spec(config.mesh)
+    # Fail fast (pre-data, pre-rendezvous): sliding windows compose with the
+    # single-chip dense/flash cores only.
+    if config.attention_window:
+        from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
+            validate_window,
+        )
+        validate_window(config.attention_window)
+        if (dict(zip(axis_names, axis_sizes)).get("seq", 1) > 1
+                or config.zigzag_attention):
+            raise ValueError(
+                "--attention-window applies to the single-chip dense/flash "
+                "attention cores — the ring/ulysses sequence-parallel schedules do "
+                "not window; drop the seq axis (or the window)")
     n_mesh_devices = int(np.prod(axis_sizes))
     info = initialize_cluster()   # no-op single-process; multi-host rendezvous otherwise
 
@@ -221,11 +234,22 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                 f"seq_axis·BLOCK = {max(seq_size, 1)}·{pa.BLOCK}, got "
                 f"{config.seq_len} (e.g. --seq-len {max(seq_size, 1) * pa.BLOCK})")
         # Ring-of-flash under a seq axis (flash kernels on every hop, trainable custom
-        # VJP); plain single-chip flash otherwise.
-        attention_fn = (make_ring_attention_fn(mesh, use_flash=True)
-                        if seq_size > 1 else pa.flash_attention)
+        # VJP); plain single-chip flash otherwise (windowed/banded when requested).
+        if seq_size > 1:
+            attention_fn = make_ring_attention_fn(mesh, use_flash=True)
+        elif config.attention_window:
+            import functools
+            attention_fn = functools.partial(
+                pa.flash_attention, window=config.attention_window)
+        else:
+            attention_fn = pa.flash_attention
     elif seq_size > 1:
         attention_fn = make_ring_attention_fn(mesh)
+    elif config.attention_window:
+        from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
+            windowed_attention_fn,
+        )
+        attention_fn = windowed_attention_fn(config.attention_window)
     model_kwargs = {"dropout_rate": config.dropout_rate,
                     "seq_len": config.seq_len,
                     "dtype": jnp.bfloat16 if config.bf16 else jnp.float32,

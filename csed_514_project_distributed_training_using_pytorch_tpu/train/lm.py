@@ -75,6 +75,12 @@ def main(config: LMConfig = LMConfig(), *,
     watch = M.Stopwatch()
     if config.grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
+    if config.attention_window:
+        # Fail fast, pre-data/rendezvous (one owner for the message).
+        from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
+            validate_window,
+        )
+        validate_window(config.attention_window)
     info = initialize_cluster()
     mesh = make_mesh()
     world = mesh.shape["data"]
@@ -99,6 +105,7 @@ def main(config: LMConfig = LMConfig(), *,
         vocab_size=config.num_levels + 1, seq_len=seq_len,
         embed_dim=config.embed_dim, num_layers=config.num_layers,
         num_heads=config.num_heads, dropout_rate=config.dropout_rate,
+        attention_window=config.attention_window,
         dtype=jnp.bfloat16 if config.bf16 else jnp.float32, remat=config.remat)
     M.log(f"LM training: {world} devices on {info.process_count} process(es), "
           f"batch {config.batch_size}, vocab {config.num_levels}+BOS, "

@@ -57,7 +57,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     notebooks); by default MNIST is loaded from ``config.data_dir``.
     """
     watch = M.Stopwatch()                       # ≙ t0, reference src/train.py:10
-    validate_model_config(config.model, remat=config.remat, causal=config.causal)  # fail fast, pre-side-effects
+    validate_model_config(config.model, remat=config.remat, causal=config.causal,
+                          attention_window=config.attention_window)  # fail fast, pre-side-effects
     if config.grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
     if config.grad_accum > 1 and config.batch_size_train % config.grad_accum:
@@ -100,7 +101,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                               os.path.join(config.images_dir, "train_images.png"))
 
     model = build_model(config.model, bf16=config.bf16, remat=config.remat,
-                        causal=config.causal)
+                        causal=config.causal,
+                        attention_window=config.attention_window)
     optimizer = optim.make_optimizer(config.optimizer,
                                      learning_rate=config.learning_rate,
                                      momentum=config.momentum,

@@ -62,6 +62,9 @@ class SingleProcessConfig:
                                       # (O(1)-blocks activation memory; transformer only)
     causal: bool = False              # decoder-style (causal) attention
                                       # (transformer only)
+    attention_window: int = 0         # sliding-window (local) attention width
+                                      # (transformer only; 0 = full attention; see
+                                      # ops.full_attention's window semantics)
     use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
                                       # (ops/pallas_kernels.py; single-device step path)
     experimental_fused_step: bool = False
@@ -129,6 +132,8 @@ class DistributedConfig:
                                       # SingleProcessConfig.remat)
     causal: bool = False              # decoder-style attention (see
                                       # SingleProcessConfig.causal)
+    attention_window: int = 0         # sliding-window attention width (see
+                                      # SingleProcessConfig.attention_window)
     host_local_feed: bool = False     # multi-host input pipeline: each process gathers and
                                       # feeds ONLY its addressable devices' shard of every
                                       # batch (SURVEY.md §7 hard part (d)) instead of the
@@ -172,6 +177,9 @@ class ComposedConfig:
                                         # (see SingleProcessConfig.grad_accum)
     causal: bool = False                # decoder-style (causal) attention over the
                                         # token sequence instead of bidirectional
+    attention_window: int = 0           # sliding-window attention width (dense or
+                                        # single-chip flash cores only — the ring/
+                                        # ulysses SP schedules do not window; 0 off)
     zigzag_attention: bool = False      # load-balanced zig-zag causal ring schedule
                                         # (parallel.zigzag_ring_attention); requires
                                         # --causal and seq_len % (2*seq_axis) == 0
@@ -223,6 +231,8 @@ class LMConfig:
     num_layers: int = 2
     num_heads: int = 4
     dropout_rate: float = 0.0
+    attention_window: int = 0           # sliding-window (local) causal attention
+                                        # width over the pixel stream (0 = full)
     learning_rate: float = 1e-3
     momentum: float = 0.5               # sgd only (adamw is the LM default)
     optimizer: str = "adamw"

@@ -86,10 +86,13 @@ def test_next_token_loss_decreases_under_sgd():
     assert float(loss) < first - 0.1
 
 
-def test_decode_matches_full_forward():
+@pytest.mark.parametrize("window", [0, 5])
+def test_decode_matches_full_forward(window):
     """Teacher-forced KV-cache decode reproduces the full forward's log-probs at EVERY
-    position — the contract that keeps the re-expressed per-token block math honest."""
-    model = _model()
+    position — the contract that keeps the re-expressed per-token block math honest.
+    Covers windowed configs too: a window-trained model must SAMPLE under the same
+    sliding band it trained with."""
+    model = _model(attention_window=window)
     params = _params(model, seed=1)
     targets = _targets(model, b=2, seed=3)
     inputs = model.shift_right(targets)

@@ -89,6 +89,58 @@ def test_block_size_is_numerics_invariant(causal):
                                    err_msg=name, **_tol(1e-4, 2e-5))
 
 
+def test_dense_window_matches_naive_mask():
+    """full_attention(window=W) equals an explicit numpy band mask — the windowed
+    semantics oracle (distance < W; causal restricts to the past side)."""
+    q, k, v = _qkv(b=1, s=64, h=2, d=16, seed=6)
+    w = 10
+    for causal in (False, True):
+        ref = np.asarray(full_attention(q, k, v, causal=causal, window=w))
+        i = np.arange(64)[:, None]
+        j = np.arange(64)[None, :]
+        mask = (np.abs(i - j) < w) & ((i >= j) if causal else True)
+        scores = np.einsum("bqhd,bkhd->bhqk", np.asarray(q),
+                           np.asarray(k)) / np.sqrt(16.0)
+        scores = np.where(mask[None, None], scores, -1e30)
+        weights = np.exp(scores - scores.max(-1, keepdims=True))
+        weights /= weights.sum(-1, keepdims=True)
+        naive = np.einsum("bhqk,bkhd->bqhd", weights, np.asarray(v))
+        np.testing.assert_allclose(ref, naive, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"causal={causal}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_window_matches_dense(causal):
+    """Banded flash (block-skip + in-kernel band mask) equals dense windowed attention
+    — forward AND gradients. window=160 straddles block boundaries (not a multiple of
+    128), exercising partial-band blocks on both sides."""
+    q, k, v = _qkv(b=1, s=512, h=2, d=64, seed=7)
+    w = 160
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal=causal, window=w)),
+        np.asarray(full_attention(q, k, v, causal=causal, window=w)),
+        **_tol(1e-5, 1e-5))
+
+    def loss(attn):
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v)))
+
+    g_ref = jax.grad(loss(lambda q, k, v: full_attention(
+        q, k, v, causal=causal, window=w)), argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, window=w)), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   err_msg=name, **_tol(1e-4, 2e-5))
+
+
+def test_window_validation():
+    q, k, v = _qkv(b=1, s=256, h=1, d=64, seed=8)
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, window=0)
+    with pytest.raises(ValueError, match="window"):
+        full_attention(q, k, v, window=-1)
+
+
 def test_block_validation():
     q, k, v = _qkv(b=1, s=256, h=1, d=64, seed=5)
     with pytest.raises(ValueError, match="multiple of 128"):

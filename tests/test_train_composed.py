@@ -225,6 +225,29 @@ def test_ulysses_rejects_zigzag(tiny_datasets):
                       datasets=tiny_datasets)
 
 
+def test_attention_window_rejects_seq_axis(tiny_datasets):
+    with pytest.raises(ValueError, match="attention-window"):
+        composed.main(ComposedConfig(mesh="data=2,seq=2", attention_window=4,
+                                     results_dir=""),
+                      datasets=tiny_datasets)
+
+
+def test_attention_window_trains_without_seq_axis(tmp_path, tiny_datasets):
+    """--attention-window with a dense core on a data-only mesh trains and differs
+    from the full-attention trajectory (the window actually bites)."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100,
+                  max_train_examples=256)
+    _, hist_w = composed.main(
+        ComposedConfig(mesh="data=4", attention_window=4,
+                       results_dir=str(tmp_path / "win"), **common),
+        datasets=tiny_datasets)
+    _, hist_f = composed.main(
+        ComposedConfig(mesh="data=4", results_dir=str(tmp_path / "win_full"),
+                       **common),
+        datasets=tiny_datasets)
+    assert hist_w.train_losses != hist_f.train_losses
+
+
 def test_unknown_seq_impl_rejected(tiny_datasets):
     with pytest.raises(ValueError, match="seq-impl"):
         composed.main(ComposedConfig(mesh="data=2,seq=2", seq_impl="ulyssess",

@@ -263,3 +263,25 @@ def test_moe_expert_weights_shard_over_expert_axis():
     assert vel.addressable_shards[0].data.shape == (1, 64, 256)
     router = state.params["block_0"]["router_kernel"]
     assert router.addressable_shards[0].data.shape == tuple(router.shape)  # replicated
+
+
+def test_attention_window_changes_output_and_validates():
+    """build_model(attention_window=W) plugs the sliding-window dense core: output
+    differs from full attention (the mask bites at seq_len 16 > W) while parameters
+    and checkpoints stay identical; the CNN rejects the knob."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        build_model, validate_model_config,
+    )
+
+    full = build_model("transformer")
+    local = build_model("transformer", attention_window=4)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(2, 28, 28, 1)).astype(np.float32))
+    params = full.init({"params": jax.random.PRNGKey(0)}, x)["params"]
+    out_full = full.apply({"params": params}, x)
+    out_local = local.apply({"params": params}, x)   # same params — pluggable core
+    assert not np.allclose(np.asarray(out_full), np.asarray(out_local))
+    with pytest.raises(ValueError, match="transformer family only"):
+        validate_model_config("cnn", attention_window=4)
+    with pytest.raises(ValueError, match=">= 0"):
+        validate_model_config("transformer", attention_window=-1)
