@@ -62,6 +62,10 @@ def main() -> int:
     parser.add_argument("--block-sweep", type=int, nargs="+", default=None,
                         help="measure flash at each of these block sizes per seq_len "
                              "(dense measured once); finds the per-S best block")
+    parser.add_argument("--window", type=int, default=None,
+                        help="sliding-window width: flash runs the BANDED grid "
+                             "(O(S*W) compute), dense applies the same band mask — "
+                             "the local-attention long-context comparison")
     args = parser.parse_args()
     if args.block is not None and args.block_sweep is not None:
         parser.error("--block and --block-sweep are mutually exclusive")
@@ -81,6 +85,8 @@ def main() -> int:
         row = {"seq_len": s, "batch": B, "heads": H, "head_dim": D,
                "platform": platform, "device_kind": device_kind, "causal": True,
                "reps": REPS}
+        if args.window is not None:
+            row["window"] = args.window
         sweeping = args.block_sweep is not None
         blocks = (args.block_sweep if sweeping
                   else [args.block] if args.block is not None else [None])
@@ -90,8 +96,13 @@ def main() -> int:
             # Sweep rows keep the per-block key schema even for one candidate, so
             # partial re-measurements append cleanly to an existing tune JSONL.
             key = f"flash_fwdbwd_s_b{blk}" if sweeping else "flash_fwdbwd_s"
-            flash = (ops.flash_attention if blk is None else
-                     functools.partial(ops.flash_attention, block=blk))
+            flash_kw = {}
+            if blk is not None:
+                flash_kw["block"] = blk
+            if args.window is not None:
+                flash_kw["window"] = args.window
+            flash = (ops.flash_attention if not flash_kw else
+                     functools.partial(ops.flash_attention, **flash_kw))
             try:
                 # flash_attention validates blk itself (multiple of 128, divides S).
                 t = _measure(flash, q, k, v)
@@ -106,7 +117,10 @@ def main() -> int:
             row["flash_best_block"] = best_block
         if s <= DENSE_MAX_S:
             try:
-                row["dense_fwdbwd_s"] = _measure(ops.full_attention, q, k, v)
+                dense = (ops.full_attention if args.window is None else
+                         functools.partial(ops.full_attention,
+                                           window=args.window))
+                row["dense_fwdbwd_s"] = _measure(dense, q, k, v)
                 if row["flash_fwdbwd_s"]:  # speedup needs a nonzero flash denominator
                     row["speedup_flash_vs_dense"] = round(
                         row["dense_fwdbwd_s"] / row["flash_fwdbwd_s"], 3)

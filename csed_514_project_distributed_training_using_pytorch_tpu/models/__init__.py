@@ -20,7 +20,8 @@ VALID_MODELS = ("cnn", "transformer")
 
 def validate_model_config(name: str, *, remat: bool = False,
                           causal: bool = False,
-                          attention_window: int = 0) -> None:
+                          attention_window: int = 0,
+                          kv_heads: int = 0) -> None:
     """Fail fast on a bad ``--model`` value or model/knob combination — callers run this
     before any data download, dataset load, or cluster rendezvous so typos cost
     milliseconds, not side effects (on a fleet: not a full rendezvous per host)."""
@@ -38,10 +39,20 @@ def validate_model_config(name: str, *, remat: bool = False,
                          "(the CNN has no attention to window)")
     if attention_window < 0:
         raise ValueError(f"--attention-window must be >= 0, got {attention_window}")
+    if kv_heads and name == "cnn":
+        raise ValueError("--kv-heads applies to the transformer family only "
+                         "(the CNN has no attention heads)")
+    if kv_heads < 0:
+        raise ValueError(f"--kv-heads must be >= 0, got {kv_heads}")
+    if kv_heads and TransformerClassifier.num_heads % kv_heads:
+        # The classifier's head count is fixed; reject non-divisors pre-side-effects.
+        raise ValueError(f"--kv-heads {kv_heads} must divide the transformer's "
+                         f"{TransformerClassifier.num_heads} heads")
 
 
 def build_model(name: str, *, bf16: bool = False, remat: bool = False,
-                causal: bool = False, attention_window: int = 0):
+                causal: bool = False, attention_window: int = 0,
+                kv_heads: int = 0):
     """Model factory behind the trainers' ``--model`` flag. Both families share the
     ``(x, *, deterministic)`` call contract on ``[B, 28, 28, 1]`` input, so every
     trainer/eval/checkpoint path works with either.
@@ -55,11 +66,13 @@ def build_model(name: str, *, bf16: bool = False, remat: bool = False,
     long-context knob.
     """
     validate_model_config(name, remat=remat, causal=causal,
-                          attention_window=attention_window)
+                          attention_window=attention_window, kv_heads=kv_heads)
     dtype = jnp.bfloat16 if bf16 else jnp.float32
     if name == "cnn":
         return Net(dtype=dtype)
     kwargs = {}
+    if kv_heads:
+        kwargs["num_kv_heads"] = kv_heads
     if attention_window:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
             windowed_attention_fn,

@@ -87,6 +87,8 @@ class TransformerLM(fnn.Module):
     embed_dim: int = 64
     num_layers: int = 2
     num_heads: int = 4
+    num_kv_heads: int | None = None  # < num_heads = GQA: smaller KV projection AND a
+                                     # proportionally smaller decode KV cache
     mlp_ratio: int = 4
     dropout_rate: float = 0.0
     attention_fn: Callable = ops.full_attention
@@ -127,7 +129,8 @@ class TransformerLM(fnn.Module):
         attention_fn = self._attention_fn()
         for i in range(self.num_layers):
             h = block_cls(
-                num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+                num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+                mlp_ratio=self.mlp_ratio,
                 dropout_rate=self.dropout_rate, attention_fn=attention_fn,
                 causal=True, dtype=self.dtype, name=f"block_{i}")(h, deterministic)
 
@@ -164,10 +167,11 @@ def next_token_loss(model: TransformerLM, params, targets: jax.Array, rng,
 
 
 def init_cache(model: TransformerLM, batch: int) -> dict:
-    """Zeroed per-layer K/V caches ``[B, seq_len, H, Dh]`` (f32 — the merge math the
-    forward uses is f32 regardless of activation dtype)."""
+    """Zeroed per-layer K/V caches ``[B, seq_len, KV_H, Dh]`` (f32 — the merge math
+    the forward uses is f32 regardless of activation dtype). Under GQA the cache
+    holds only the ``num_kv_heads`` K/V heads — the decode-memory win."""
     head_dim = model.embed_dim // model.num_heads
-    shape = (batch, model.seq_len, model.num_heads, head_dim)
+    shape = (batch, model.seq_len, model.num_kv_heads or model.num_heads, head_dim)
     return {f"block_{i}": {"k": jnp.zeros(shape, jnp.float32),
                            "v": jnp.zeros(shape, jnp.float32)}
             for i in range(model.num_layers)}
@@ -185,6 +189,8 @@ def decode_step(model: TransformerLM, params, cache: dict, ids_t: jax.Array,
     b = ids_t.shape[0]
     e, nh = model.embed_dim, model.num_heads
     hd = e // nh
+    kvh = model.num_kv_heads or nh
+    rep = nh // kvh
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
 
     h = (params["tok_embed"].astype(jnp.float32)[ids_t]
@@ -194,10 +200,15 @@ def decode_step(model: TransformerLM, params, cache: dict, ids_t: jax.Array,
         p = params[f"block_{i}"]
         a = p["attn"]
         x = ops.layer_norm(h, p["ln1_scale"], p["ln1_bias"])
-        qkv = ops.dense(x, a["qkv_kernel"], a["qkv_bias"])        # [B, 3E]
-        q, k, v = (qkv[:, :e].reshape(b, nh, hd),
-                   qkv[:, e:2 * e].reshape(b, nh, hd),
-                   qkv[:, 2 * e:].reshape(b, nh, hd))
+        if kvh == nh:
+            qkv = ops.dense(x, a["qkv_kernel"], a["qkv_bias"])    # [B, 3E]
+            q = qkv[:, :e].reshape(b, nh, hd)
+            k = qkv[:, e:2 * e].reshape(b, kvh, hd)
+            v = qkv[:, 2 * e:].reshape(b, kvh, hd)
+        else:  # GQA: split projections, kvh-head K/V (the smaller cache)
+            q = ops.dense(x, a["q_kernel"], a["q_bias"]).reshape(b, nh, hd)
+            kv = ops.dense(x, a["kv_kernel"], a["kv_bias"]).reshape(b, 2, kvh, hd)
+            k, v = kv[:, 0], kv[:, 1]
         layer = cache[f"block_{i}"]
         k_cache = lax.dynamic_update_slice(layer["k"], k[:, None], (0, t, 0, 0))
         v_cache = lax.dynamic_update_slice(layer["v"], v[:, None], (0, t, 0, 0))
@@ -205,15 +216,17 @@ def decode_step(model: TransformerLM, params, cache: dict, ids_t: jax.Array,
         # Masked-prefix attention: full-length scores with positions > t masked out —
         # static shapes (scan/jit-friendly) instead of a dynamic-length slice. A
         # windowed model masks the same sliding band it trained with (the
-        # decode-parity invariant covers windowed configs too).
-        scores = jnp.einsum("bhd,bshd->bhs", q * scale, k_cache)  # [B, H, S]
-        pos = jnp.arange(model.seq_len)[None, None]
+        # decode-parity invariant covers windowed configs too). Query heads group
+        # over their shared K/V head (GQA); rep == 1 degenerates to plain MHA.
+        qg = q.reshape(b, kvh, rep, hd)
+        scores = jnp.einsum("bgrd,bsgd->bgrs", qg * scale, k_cache)  # [B,G,R,S]
+        pos = jnp.arange(model.seq_len)[None, None, None]
         visible = pos <= t
         if model.attention_window:
             visible &= t - pos < model.attention_window
         scores = jnp.where(visible, scores, MASK_VALUE)
         weights = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bhs,bshd->bhd", weights, v_cache).reshape(b, e)
+        attn = jnp.einsum("bgrs,bsgd->bgrd", weights, v_cache).reshape(b, e)
         h = h + ops.dense(attn, a["out_kernel"], a["out_bias"])
 
         x = ops.layer_norm(h, p["ln2_scale"], p["ln2_bias"])

@@ -68,9 +68,18 @@ class MultiHeadSelfAttention(fnn.Module):
     ``attention_fn(q, k, v, *, causal) -> out`` operates on ``[B, S, H, D]``; the module
     owns only the projections, so swapping the dense core for the sequence-parallel ring
     core changes no parameters — the two variants share checkpoints bit-for-bit.
+
+    ``num_kv_heads < num_heads`` is grouped-query attention (GQA; ``== 1`` is MQA):
+    K/V project to only that many heads — a ``num_heads/num_kv_heads``× smaller KV
+    projection and, in the LM decode path, an equally smaller KV cache — and each K/V
+    head serves a contiguous group of query heads (broadcast before the core, so EVERY
+    pluggable core works unchanged). ``None`` keeps standard MHA with the historical
+    fused ``qkv_kernel`` parameter layout (old checkpoints restore as-is); GQA uses
+    split ``q_kernel``/``kv_kernel`` parameters.
     """
 
     num_heads: int
+    num_kv_heads: int | None = None
     attention_fn: Callable = ops.full_attention
     causal: bool = False
     dtype: jnp.dtype = jnp.float32
@@ -81,12 +90,33 @@ class MultiHeadSelfAttention(fnn.Module):
         if e % self.num_heads:
             raise ValueError(f"embed dim {e} not divisible by {self.num_heads} heads")
         head_dim = e // self.num_heads
+        kv_heads = self.num_kv_heads or self.num_heads
+        if kv_heads < 1 or self.num_heads % kv_heads:
+            raise ValueError(f"num_heads {self.num_heads} not divisible by "
+                             f"num_kv_heads {kv_heads} (need a positive divisor)")
 
-        wqkv = self.param("qkv_kernel", _normal_init(0.02), (e, 3 * e))
-        bqkv = self.param("qkv_bias", _zeros_init, (3 * e,))
-        qkv = ops.dense(x, wqkv.astype(self.dtype), bqkv.astype(self.dtype))
-        qkv = qkv.reshape(b, s, 3, self.num_heads, head_dim)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if kv_heads == self.num_heads:
+            wqkv = self.param("qkv_kernel", _normal_init(0.02), (e, 3 * e))
+            bqkv = self.param("qkv_bias", _zeros_init, (3 * e,))
+            qkv = ops.dense(x, wqkv.astype(self.dtype), bqkv.astype(self.dtype))
+            qkv = qkv.reshape(b, s, 3, self.num_heads, head_dim)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        else:
+            wq = self.param("q_kernel", _normal_init(0.02), (e, e))
+            bq = self.param("q_bias", _zeros_init, (e,))
+            wkv = self.param("kv_kernel", _normal_init(0.02),
+                             (e, 2 * kv_heads * head_dim))
+            bkv = self.param("kv_bias", _zeros_init, (2 * kv_heads * head_dim,))
+            q = ops.dense(x, wq.astype(self.dtype),
+                          bq.astype(self.dtype)).reshape(b, s, self.num_heads,
+                                                         head_dim)
+            kv = ops.dense(x, wkv.astype(self.dtype), bkv.astype(self.dtype))
+            kv = kv.reshape(b, s, 2, kv_heads, head_dim)
+            # Broadcast each K/V head over its query-head group so any pluggable
+            # core (dense/flash/ring/ulysses) sees matched head counts.
+            rep = self.num_heads // kv_heads
+            k = jnp.repeat(kv[:, :, 0], rep, axis=2)
+            v = jnp.repeat(kv[:, :, 1], rep, axis=2)
 
         out = self.attention_fn(q, k, v, causal=self.causal)
         out = out.reshape(b, s, e)
@@ -114,6 +144,7 @@ class TransformerBlock(fnn.Module):
     """
 
     num_heads: int
+    num_kv_heads: int | None = None
     mlp_ratio: int = 4
     dropout_rate: float = 0.1
     attention_fn: Callable = ops.full_attention
@@ -134,7 +165,8 @@ class TransformerBlock(fnn.Module):
         b1 = self.param("ln1_bias", _zeros_init, (e,))
         h = ops.layer_norm(x, g1, b1)
         h = MultiHeadSelfAttention(
-            num_heads=self.num_heads, attention_fn=self.attention_fn,
+            num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+            attention_fn=self.attention_fn,
             causal=self.causal, dtype=self.dtype, name="attn")(h)
         if not deterministic:
             h = ops.dropout(self.make_rng("dropout"), h, self.dropout_rate,
@@ -196,6 +228,7 @@ class TransformerClassifier(fnn.Module):
     embed_dim: int = 64
     num_layers: int = 2
     num_heads: int = 4
+    num_kv_heads: int | None = None  # < num_heads = grouped-query attention (GQA)
     mlp_ratio: int = 4
     dropout_rate: float = 0.1
     attention_fn: Callable = ops.full_attention
@@ -234,7 +267,8 @@ class TransformerClassifier(fnn.Module):
             block_cls = fnn.remat(TransformerBlock, static_argnums=(2,))
         for i in range(self.num_layers):
             h = block_cls(
-                num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+                num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+                mlp_ratio=self.mlp_ratio,
                 dropout_rate=self.dropout_rate, attention_fn=self.attention_fn,
                 causal=self.causal, dtype=self.dtype,
                 num_experts=self.num_experts,

@@ -36,9 +36,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.train.step import T
 
 # leaf parameter name → (column|row) parallel classification for the transformer family
 # (models/transformer.py). Names are module-local leaf names, stable across nesting depth.
-_COLUMN_PARALLEL = {"qkv_kernel", "mlp_up_kernel"}
+_COLUMN_PARALLEL = {"qkv_kernel", "q_kernel", "kv_kernel", "mlp_up_kernel"}
 _ROW_PARALLEL = {"out_kernel", "mlp_down_kernel"}
-_COLUMN_PARALLEL_BIAS = {"qkv_bias", "mlp_up_bias"}
+_COLUMN_PARALLEL_BIAS = {"qkv_bias", "q_bias", "kv_bias", "mlp_up_bias"}
 # MoE blocks (num_experts>0): expert-stacked weights shard their expert dim — the names
 # match parallel/expert_parallel's layout, so the same rules cover both the standalone
 # layer and the in-model blocks. The router replicates (every device routes every token).

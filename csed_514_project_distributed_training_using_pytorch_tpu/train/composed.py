@@ -110,6 +110,12 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     """Run composed-mesh training; returns final (host-resident) state + history."""
     watch = M.Stopwatch()
     axis_names, axis_sizes = parse_mesh_spec(config.mesh)
+    if config.kv_heads and (
+            config.kv_heads < 0
+            or TransformerClassifier.num_heads % config.kv_heads):
+        raise ValueError(f"--kv-heads {config.kv_heads} must be a positive divisor "
+                         f"of the transformer's {TransformerClassifier.num_heads} "
+                         f"heads")
     # Fail fast (pre-data, pre-rendezvous): sliding windows compose with the
     # single-chip dense/flash cores only.
     if config.attention_window:
@@ -255,6 +261,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                     "dtype": jnp.bfloat16 if config.bf16 else jnp.float32,
                     "remat": config.remat,
                     "causal": config.causal}
+    if config.kv_heads:
+        model_kwargs["num_kv_heads"] = config.kv_heads
     if attention_fn is not None:
         model_kwargs["attention_fn"] = attention_fn
     if expert_size > 1:

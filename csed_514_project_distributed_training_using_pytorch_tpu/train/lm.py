@@ -81,6 +81,10 @@ def main(config: LMConfig = LMConfig(), *,
             validate_window,
         )
         validate_window(config.attention_window)
+    if config.kv_heads and (config.kv_heads < 0
+                            or config.num_heads % config.kv_heads):
+        raise ValueError(f"--kv-heads {config.kv_heads} must be a positive divisor "
+                         f"of --num-heads {config.num_heads}")
     info = initialize_cluster()
     mesh = make_mesh()
     world = mesh.shape["data"]
@@ -105,6 +109,7 @@ def main(config: LMConfig = LMConfig(), *,
         vocab_size=config.num_levels + 1, seq_len=seq_len,
         embed_dim=config.embed_dim, num_layers=config.num_layers,
         num_heads=config.num_heads, dropout_rate=config.dropout_rate,
+        num_kv_heads=config.kv_heads or None,
         attention_window=config.attention_window,
         dtype=jnp.bfloat16 if config.bf16 else jnp.float32, remat=config.remat)
     M.log(f"LM training: {world} devices on {info.process_count} process(es), "

@@ -65,6 +65,9 @@ class SingleProcessConfig:
     attention_window: int = 0         # sliding-window (local) attention width
                                       # (transformer only; 0 = full attention; see
                                       # ops.full_attention's window semantics)
+    kv_heads: int = 0                 # grouped-query attention: number of K/V heads
+                                      # (transformer only; 0 = MHA; must divide
+                                      # num_heads — 1 = multi-query attention)
     use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
                                       # (ops/pallas_kernels.py; single-device step path)
     experimental_fused_step: bool = False
@@ -134,6 +137,8 @@ class DistributedConfig:
                                       # SingleProcessConfig.causal)
     attention_window: int = 0         # sliding-window attention width (see
                                       # SingleProcessConfig.attention_window)
+    kv_heads: int = 0                 # grouped-query attention K/V head count (see
+                                      # SingleProcessConfig.kv_heads)
     host_local_feed: bool = False     # multi-host input pipeline: each process gathers and
                                       # feeds ONLY its addressable devices' shard of every
                                       # batch (SURVEY.md §7 hard part (d)) instead of the
@@ -180,6 +185,8 @@ class ComposedConfig:
     attention_window: int = 0           # sliding-window attention width (dense or
                                         # single-chip flash cores only — the ring/
                                         # ulysses SP schedules do not window; 0 off)
+    kv_heads: int = 0                   # grouped-query attention K/V head count
+                                        # (0 = MHA; must divide the model's 4 heads)
     zigzag_attention: bool = False      # load-balanced zig-zag causal ring schedule
                                         # (parallel.zigzag_ring_attention); requires
                                         # --causal and seq_len % (2*seq_axis) == 0
@@ -233,6 +240,9 @@ class LMConfig:
     dropout_rate: float = 0.0
     attention_window: int = 0           # sliding-window (local) causal attention
                                         # width over the pixel stream (0 = full)
+    kv_heads: int = 0                   # grouped-query attention: K/V head count
+                                        # (0 = MHA; divides num_heads; shrinks the
+                                        # decode KV cache num_heads/kv_heads x)
     learning_rate: float = 1e-3
     momentum: float = 0.5               # sgd only (adamw is the LM default)
     optimizer: str = "adamw"

@@ -86,15 +86,18 @@ def test_next_token_loss_decreases_under_sgd():
     assert float(loss) < first - 0.1
 
 
-@pytest.mark.parametrize("window", [0, 5])
-def test_decode_matches_full_forward(window):
+@pytest.mark.parametrize("window,kv_heads", [(0, None), (5, None), (0, 2), (5, 1)])
+def test_decode_matches_full_forward(window, kv_heads):
     """Teacher-forced KV-cache decode reproduces the full forward's log-probs at EVERY
     position — the contract that keeps the re-expressed per-token block math honest.
-    Covers windowed configs too: a window-trained model must SAMPLE under the same
-    sliding band it trained with."""
-    model = _model(attention_window=window)
+    Covers windowed AND grouped-query/multi-query configs (the GQA cache holds only
+    the K/V heads — verified smaller — yet decode stays exact)."""
+    model = _model(attention_window=window, num_kv_heads=kv_heads)
     params = _params(model, seed=1)
     targets = _targets(model, b=2, seed=3)
+    if kv_heads:
+        cache_shape = lm.init_cache(model, batch=2)["block_0"]["k"].shape
+        assert cache_shape[2] == kv_heads          # the decode-memory win
     inputs = model.shift_right(targets)
     ref = model.apply({"params": params}, inputs)              # [B, S, V]
 

@@ -285,3 +285,59 @@ def test_attention_window_changes_output_and_validates():
         validate_model_config("cnn", attention_window=4)
     with pytest.raises(ValueError, match=">= 0"):
         validate_model_config("transformer", attention_window=-1)
+
+
+def test_gqa_matches_repeated_kv_oracle():
+    """GQA attention equals dense attention over explicitly group-broadcast K/V —
+    and its parameters are the split q/kv layout with the smaller KV projection."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer import (
+        MultiHeadSelfAttention,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu import ops
+
+    rng = np.random.default_rng(21)
+    x = jnp.asarray(rng.normal(size=(2, 8, 32)).astype(np.float32))
+    mod = MultiHeadSelfAttention(num_heads=4, num_kv_heads=2, causal=True)
+    params = mod.init({"params": jax.random.PRNGKey(0)}, x)["params"]
+    assert params["kv_kernel"].shape == (32, 2 * 2 * 8)   # 2 kv heads x 2 (k,v) x hd 8
+    assert "qkv_kernel" not in params
+    out = mod.apply({"params": params}, x)
+
+    # Oracle: same projections by hand, K/V repeated per group, dense core.
+    q = (x @ params["q_kernel"] + params["q_bias"]).reshape(2, 8, 4, 8)
+    kv = (x @ params["kv_kernel"] + params["kv_bias"]).reshape(2, 8, 2, 2, 8)
+    k = jnp.repeat(kv[:, :, 0], 2, axis=2)
+    v = jnp.repeat(kv[:, :, 1], 2, axis=2)
+    attn = ops.full_attention(q, k, v, causal=True).reshape(2, 8, 32)
+    ref = attn @ params["out_kernel"] + params["out_bias"]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gqa_head_divisibility_enforced():
+    from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer import (
+        MultiHeadSelfAttention,
+    )
+
+    x = jnp.zeros((1, 4, 32))
+    with pytest.raises(ValueError, match="not divisible by"):
+        MultiHeadSelfAttention(num_heads=4, num_kv_heads=3).init(
+            {"params": jax.random.PRNGKey(0)}, x)
+
+
+def test_gqa_params_shard_under_tp():
+    """The split q/kv projections column-shard like the fused qkv kernel did."""
+    from jax.sharding import PartitionSpec as P
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.tensor_parallel import (
+        param_partition_specs,
+    )
+
+    model = TransformerClassifier(num_kv_heads=2, dropout_rate=0.0)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    specs = param_partition_specs(params)
+    attn = specs["block_0"]["attn"]
+    assert attn["q_kernel"] == P(None, "model")
+    assert attn["kv_kernel"] == P(None, "model")
+    assert attn["kv_bias"] == P("model")

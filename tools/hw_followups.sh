@@ -50,6 +50,13 @@ timeout --kill-after=60 --signal=TERM 1800 python bench_transformer.py --flash \
   > "$OUT/bench_transformer_flash_tpu.json" 2> "$OUT/bench_transformer_flash.err"
 echo "bench_transformer --flash rc=$? ($OUT/bench_transformer_flash_tpu.json)"
 
+echo "=== 2c. banded (sliding-window) flash at long S (r3: O(S*W) compute — the" \
+     "local-attention regime where full attention is off the chart) ==="
+timeout --kill-after=60 --signal=TERM 1800 python bench_attention.py \
+  --seq-lens 16384 32768 65536 131072 --window 4096 \
+  --out "$OUT/bench_attention_window_tpu.jsonl" > /dev/null 2> "$OUT/window.err"
+echo "windowed bench rc=$? (rows: $OUT/bench_attention_window_tpu.jsonl)"
+
 echo "=== 3. headline bench at shipped defaults (also primes bench_results/.jax_cache) ==="
 BENCH_TPU_RETRY_SECONDS=300 BENCH_ATTEMPT_TIMEOUT_SECONDS=240 \
   timeout --kill-after=60 --signal=TERM 2700 python bench.py \
