@@ -21,7 +21,7 @@ VALID_MODELS = ("cnn", "transformer")
 def validate_model_config(name: str, *, remat: bool = False,
                           causal: bool = False,
                           attention_window: int = 0,
-                          kv_heads: int = 0) -> None:
+                          kv_heads: int = 0, rope: bool = False) -> None:
     """Fail fast on a bad ``--model`` value or model/knob combination — callers run this
     before any data download, dataset load, or cluster rendezvous so typos cost
     milliseconds, not side effects (on a fleet: not a full rendezvous per host)."""
@@ -42,6 +42,9 @@ def validate_model_config(name: str, *, remat: bool = False,
     if kv_heads and name == "cnn":
         raise ValueError("--kv-heads applies to the transformer family only "
                          "(the CNN has no attention heads)")
+    if rope and name == "cnn":
+        raise ValueError("--rope applies to the transformer family only "
+                         "(the CNN has no attention positions)")
     if kv_heads < 0:
         raise ValueError(f"--kv-heads must be >= 0, got {kv_heads}")
     if kv_heads and TransformerClassifier.num_heads % kv_heads:
@@ -52,7 +55,7 @@ def validate_model_config(name: str, *, remat: bool = False,
 
 def build_model(name: str, *, bf16: bool = False, remat: bool = False,
                 causal: bool = False, attention_window: int = 0,
-                kv_heads: int = 0):
+                kv_heads: int = 0, rope: bool = False):
     """Model factory behind the trainers' ``--model`` flag. Both families share the
     ``(x, *, deterministic)`` call contract on ``[B, 28, 28, 1]`` input, so every
     trainer/eval/checkpoint path works with either.
@@ -71,6 +74,8 @@ def build_model(name: str, *, bf16: bool = False, remat: bool = False,
     if name == "cnn":
         return Net(dtype=dtype)
     kwargs = {}
+    if rope:
+        kwargs["rope"] = True
     if kv_heads:
         kwargs["num_kv_heads"] = kv_heads
     if attention_window:

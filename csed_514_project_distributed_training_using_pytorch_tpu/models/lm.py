@@ -38,6 +38,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu import ops
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
     MASK_VALUE,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.ops.rotary import (
+    apply_rotary,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer import (
     TransformerBlock,
     _normal_init,
@@ -96,6 +99,10 @@ class TransformerLM(fnn.Module):
                                 # stream (0 = full); composes with the DEFAULT dense
                                 # core only — the KV-cache decode path honors the
                                 # same window, keeping the decode-parity invariant
+    rope: bool = False          # rotary position embeddings on q/k; when set, the
+                                # learned additive pos_embed is skipped (RoPE owns
+                                # position) — decode rotates its single position by
+                                # the same formula, keeping decode parity
     dtype: jnp.dtype = jnp.float32
     remat: bool = False
 
@@ -119,9 +126,11 @@ class TransformerLM(fnn.Module):
 
         tok = self.param("tok_embed", _normal_init(0.02),
                          (self.vocab_size, self.embed_dim))
-        pos = self.param("pos_embed", _normal_init(0.02),
-                         (self.seq_len, self.embed_dim))
-        h = tok.astype(self.dtype)[ids] + pos.astype(self.dtype)[None]
+        h = tok.astype(self.dtype)[ids]
+        if not self.rope:   # RoPE owns position; no additive embedding then
+            pos = self.param("pos_embed", _normal_init(0.02),
+                             (self.seq_len, self.embed_dim))
+            h = h + pos.astype(self.dtype)[None]
 
         block_cls = TransformerBlock
         if self.remat:
@@ -132,7 +141,8 @@ class TransformerLM(fnn.Module):
                 num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
                 mlp_ratio=self.mlp_ratio,
                 dropout_rate=self.dropout_rate, attention_fn=attention_fn,
-                causal=True, dtype=self.dtype, name=f"block_{i}")(h, deterministic)
+                causal=True, rope=self.rope, dtype=self.dtype,
+                name=f"block_{i}")(h, deterministic)
 
         g = self.param("ln_f_scale", _ones_init, (self.embed_dim,))
         beta = self.param("ln_f_bias", _zeros_init, (self.embed_dim,))
@@ -193,8 +203,9 @@ def decode_step(model: TransformerLM, params, cache: dict, ids_t: jax.Array,
     rep = nh // kvh
     scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
 
-    h = (params["tok_embed"].astype(jnp.float32)[ids_t]
-         + params["pos_embed"].astype(jnp.float32)[t])            # [B, E]
+    h = params["tok_embed"].astype(jnp.float32)[ids_t]           # [B, E]
+    if not model.rope:
+        h = h + params["pos_embed"].astype(jnp.float32)[t]
 
     for i in range(model.num_layers):
         p = params[f"block_{i}"]
@@ -209,6 +220,9 @@ def decode_step(model: TransformerLM, params, cache: dict, ids_t: jax.Array,
             q = ops.dense(x, a["q_kernel"], a["q_bias"]).reshape(b, nh, hd)
             kv = ops.dense(x, a["kv_kernel"], a["kv_bias"]).reshape(b, 2, kvh, hd)
             k, v = kv[:, 0], kv[:, 1]
+        if model.rope:
+            q = apply_rotary(q, t)
+            k = apply_rotary(k, t)
         layer = cache[f"block_{i}"]
         k_cache = lax.dynamic_update_slice(layer["k"], k[:, None], (0, t, 0, 0))
         v_cache = lax.dynamic_update_slice(layer["v"], v[:, None], (0, t, 0, 0))

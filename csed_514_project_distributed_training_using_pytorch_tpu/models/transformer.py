@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from csed_514_project_distributed_training_using_pytorch_tpu import ops
+from csed_514_project_distributed_training_using_pytorch_tpu.ops import rotary
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     expert_parallel as ep,  # submodule has no deps back into models/ (no cycle)
 )
@@ -82,6 +83,8 @@ class MultiHeadSelfAttention(fnn.Module):
     num_kv_heads: int | None = None
     attention_fn: Callable = ops.full_attention
     causal: bool = False
+    rope: bool = False          # rotary position embeddings on q/k (applied before
+                                # the core, so every pluggable core composes)
     dtype: jnp.dtype = jnp.float32
 
     @fnn.compact
@@ -112,11 +115,21 @@ class MultiHeadSelfAttention(fnn.Module):
                                                          head_dim)
             kv = ops.dense(x, wkv.astype(self.dtype), bkv.astype(self.dtype))
             kv = kv.reshape(b, s, 2, kv_heads, head_dim)
+            k, v = kv[:, :, 0], kv[:, :, 1]
+
+        if self.rope:
+            # Rotate BEFORE the GQA broadcast (rotation is head-independent): the
+            # narrow kv_heads-wide K costs rep× less VPU work — same order the
+            # decode path uses.
+            positions = jnp.arange(s)
+            q = rotary.apply_rotary(q, positions)
+            k = rotary.apply_rotary(k, positions)
+        if kv_heads != self.num_heads:
             # Broadcast each K/V head over its query-head group so any pluggable
             # core (dense/flash/ring/ulysses) sees matched head counts.
             rep = self.num_heads // kv_heads
-            k = jnp.repeat(kv[:, :, 0], rep, axis=2)
-            v = jnp.repeat(kv[:, :, 1], rep, axis=2)
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
 
         out = self.attention_fn(q, k, v, causal=self.causal)
         out = out.reshape(b, s, e)
@@ -149,6 +162,7 @@ class TransformerBlock(fnn.Module):
     dropout_rate: float = 0.1
     attention_fn: Callable = ops.full_attention
     causal: bool = False
+    rope: bool = False
     dtype: jnp.dtype = jnp.float32
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
@@ -167,7 +181,7 @@ class TransformerBlock(fnn.Module):
         h = MultiHeadSelfAttention(
             num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
             attention_fn=self.attention_fn,
-            causal=self.causal, dtype=self.dtype, name="attn")(h)
+            causal=self.causal, rope=self.rope, dtype=self.dtype, name="attn")(h)
         if not deterministic:
             h = ops.dropout(self.make_rng("dropout"), h, self.dropout_rate,
                             deterministic=False)
@@ -233,6 +247,9 @@ class TransformerClassifier(fnn.Module):
     dropout_rate: float = 0.1
     attention_fn: Callable = ops.full_attention
     causal: bool = False
+    rope: bool = False               # rotary q/k rotation in every block (the learned
+                                     # additive pos_embed remains — harmless, and the
+                                     # parameter layout stays checkpoint-stable)
     dtype: jnp.dtype = jnp.float32
     remat: bool = False         # rematerialize each block on backward (jax.checkpoint):
                                 # activation memory drops from O(layers) to O(1) blocks at
@@ -270,7 +287,7 @@ class TransformerClassifier(fnn.Module):
                 num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
                 mlp_ratio=self.mlp_ratio,
                 dropout_rate=self.dropout_rate, attention_fn=self.attention_fn,
-                causal=self.causal, dtype=self.dtype,
+                causal=self.causal, rope=self.rope, dtype=self.dtype,
                 num_experts=self.num_experts,
                 expert_capacity_factor=self.expert_capacity_factor,
                 expert_mesh=self.expert_mesh, name=f"block_{i}")(

@@ -197,10 +197,14 @@ class PipelinedClassifier:
         self.model = model
         self.layers_per_stage = model.num_layers // num_stages
         self.num_stages = num_stages
+        # Mirror EVERY attention-shaping field of the source model — a dropped field
+        # here silently trains a different function on stage meshes (num_kv_heads
+        # would at least fail loudly on param-tree mismatch; rope would not).
         block = TransformerBlock(
-            num_heads=model.num_heads, mlp_ratio=model.mlp_ratio,
+            num_heads=model.num_heads, num_kv_heads=model.num_kv_heads,
+            mlp_ratio=model.mlp_ratio,
             dropout_rate=0.0, attention_fn=model.attention_fn,
-            causal=model.causal, dtype=model.dtype)
+            causal=model.causal, rope=model.rope, dtype=model.dtype)
 
         def stage_fn(stage_params, x):
             # stage_params leaves: [layers_per_stage, ...] — apply in stack order.

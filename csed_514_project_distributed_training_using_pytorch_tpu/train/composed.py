@@ -263,6 +263,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                     "causal": config.causal}
     if config.kv_heads:
         model_kwargs["num_kv_heads"] = config.kv_heads
+    if config.rope:
+        model_kwargs["rope"] = True
     if attention_fn is not None:
         model_kwargs["attention_fn"] = attention_fn
     if expert_size > 1:

@@ -109,7 +109,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     watch = M.Stopwatch()                         # ≙ t0, reference src/train_dist.py:119
     validate_model_config(config.model, remat=config.remat, causal=config.causal,
                           attention_window=config.attention_window,
-                          kv_heads=config.kv_heads)  # fail fast, pre-rendezvous
+                          kv_heads=config.kv_heads, rope=config.rope)  # fail fast, pre-rendezvous
     if config.grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
     info = initialize_cluster()                   # ≙ init_process_group, :146
@@ -144,7 +144,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     model = build_model(config.model, bf16=config.bf16, remat=config.remat,
                         causal=config.causal,
                         attention_window=config.attention_window,
-                        kv_heads=config.kv_heads)
+                        kv_heads=config.kv_heads, rope=config.rope)
     optimizer = optim.make_optimizer(config.optimizer,
                                      learning_rate=config.learning_rate,
                                      momentum=config.momentum,

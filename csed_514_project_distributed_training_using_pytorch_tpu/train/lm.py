@@ -110,7 +110,7 @@ def main(config: LMConfig = LMConfig(), *,
         embed_dim=config.embed_dim, num_layers=config.num_layers,
         num_heads=config.num_heads, dropout_rate=config.dropout_rate,
         num_kv_heads=config.kv_heads or None,
-        attention_window=config.attention_window,
+        attention_window=config.attention_window, rope=config.rope,
         dtype=jnp.bfloat16 if config.bf16 else jnp.float32, remat=config.remat)
     M.log(f"LM training: {world} devices on {info.process_count} process(es), "
           f"batch {config.batch_size}, vocab {config.num_levels}+BOS, "

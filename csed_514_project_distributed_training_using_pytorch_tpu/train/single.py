@@ -59,7 +59,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     watch = M.Stopwatch()                       # ≙ t0, reference src/train.py:10
     validate_model_config(config.model, remat=config.remat, causal=config.causal,
                           attention_window=config.attention_window,
-                          kv_heads=config.kv_heads)  # fail fast, pre-side-effects
+                          kv_heads=config.kv_heads, rope=config.rope)  # fail fast, pre-side-effects
     if config.grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
     if config.grad_accum > 1 and config.batch_size_train % config.grad_accum:
@@ -104,7 +104,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     model = build_model(config.model, bf16=config.bf16, remat=config.remat,
                         causal=config.causal,
                         attention_window=config.attention_window,
-                        kv_heads=config.kv_heads)
+                        kv_heads=config.kv_heads, rope=config.rope)
     optimizer = optim.make_optimizer(config.optimizer,
                                      learning_rate=config.learning_rate,
                                      momentum=config.momentum,

@@ -68,6 +68,8 @@ class SingleProcessConfig:
     kv_heads: int = 0                 # grouped-query attention: number of K/V heads
                                       # (transformer only; 0 = MHA; must divide
                                       # num_heads — 1 = multi-query attention)
+    rope: bool = False                # rotary position embeddings on q/k
+                                      # (transformer only; composes with every core)
     use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
                                       # (ops/pallas_kernels.py; single-device step path)
     experimental_fused_step: bool = False
@@ -139,6 +141,8 @@ class DistributedConfig:
                                       # SingleProcessConfig.attention_window)
     kv_heads: int = 0                 # grouped-query attention K/V head count (see
                                       # SingleProcessConfig.kv_heads)
+    rope: bool = False                # rotary position embeddings (see
+                                      # SingleProcessConfig.rope)
     host_local_feed: bool = False     # multi-host input pipeline: each process gathers and
                                       # feeds ONLY its addressable devices' shard of every
                                       # batch (SURVEY.md §7 hard part (d)) instead of the
@@ -187,6 +191,7 @@ class ComposedConfig:
                                         # ulysses SP schedules do not window; 0 off)
     kv_heads: int = 0                   # grouped-query attention K/V head count
                                         # (0 = MHA; must divide the model's 4 heads)
+    rope: bool = False                  # rotary position embeddings on q/k
     zigzag_attention: bool = False      # load-balanced zig-zag causal ring schedule
                                         # (parallel.zigzag_ring_attention); requires
                                         # --causal and seq_len % (2*seq_axis) == 0
@@ -243,6 +248,9 @@ class LMConfig:
     kv_heads: int = 0                   # grouped-query attention: K/V head count
                                         # (0 = MHA; divides num_heads; shrinks the
                                         # decode KV cache num_heads/kv_heads x)
+    rope: bool = False                  # rotary position embeddings (replaces the
+                                        # learned pos_embed; decode rotates its
+                                        # position by the same formula)
     learning_rate: float = 1e-3
     momentum: float = 0.5               # sgd only (adamw is the LM default)
     optimizer: str = "adamw"

@@ -151,6 +151,24 @@ def test_adamw_mesh_invariant(tmp_path, tiny_datasets):
     assert int(state_3d.velocity["count"]) == int(state_3d.step)
 
 
+def test_rope_stage_axis_matches_dp(tmp_path, tiny_datasets):
+    """--rope on a stage mesh equals --rope on plain DP — the pipeline engine must
+    mirror every attention-shaping model field (a dropped rope field would silently
+    train a DIFFERENT function on stage meshes; regression for exactly that)."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100, rope=True,
+                  max_train_examples=256)
+    state_pp, hist_pp = composed.main(
+        ComposedConfig(mesh="data=2,stage=2",
+                       results_dir=str(tmp_path / "ropepp"), **common),
+        datasets=tiny_datasets)
+    state_dp, hist_dp = composed.main(
+        ComposedConfig(mesh="data=4", results_dir=str(tmp_path / "ropepp_dp"),
+                       **common),
+        datasets=tiny_datasets)
+    np.testing.assert_allclose(hist_pp.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_adamw_stage_axis_matches_dp(tmp_path, tiny_datasets):
     """--optimizer adamw with a stage axis: each AdamW moment tree bridges through the
     GPipe stacked layout (stack on entry, stage-sharded like its params, unstack at the
