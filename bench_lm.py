@@ -1,0 +1,146 @@
+"""Pixel-LM throughput microbench: training steps/s AND KV-cache decode tokens/s.
+
+Companion to ``bench_transformer.py`` for the decoder family (``models/lm.py``): the
+training half measures teacher-forced next-token steps/s (the same scanned-program
+protocol); the decode half measures the generation surface — ``lm.generate``'s
+jit-compiled KV-cache sampling loop — in tokens/s, the number a serving user asks
+first. GQA (``--kv-heads``) shrinks the decode cache ``heads/kv_heads``×; RoPE and
+sliding windows (``--rope``/``--window``) bench the same knobs the trainer exposes.
+
+Protocol: identical honest-sync discipline to the other benches (device→host fetch of
+a value data-dependent on the full computation; ``block_until_ready`` alone can
+resolve at enqueue-ack on tunnelled PJRT backends); one untimed warmup per program,
+median of 3 timed runs. Prints exactly ONE JSON line on stdout. CPU-drivable at tiny
+shapes (tests); run via ``tools/hw_followups.sh`` step 2b2 on hardware.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--vocab", type=int, default=16, help="gray levels (BOS is +1)")
+    p.add_argument("--seq", type=int, default=784)
+    p.add_argument("--batch", type=int, default=64, help="training batch")
+    p.add_argument("--gen-batch", type=int, default=8, help="decode batch")
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=0, help="GQA K/V heads (0 = MHA)")
+    p.add_argument("--rope", action=argparse.BooleanOptionalAction, default=False)
+    p.add_argument("--window", type=int, default=0,
+                   help="sliding-window attention width (0 = full)")
+    p.add_argument("--steps", type=int, default=20, help="training steps per run")
+    p.add_argument("--bf16", action=argparse.BooleanOptionalAction, default=True)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        enable_compile_cache,
+    )
+
+    enable_compile_cache(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_results", ".jax_cache"))
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        lm as lm_mod,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state, make_train_step,
+    )
+
+    model = lm_mod.TransformerLM(
+        vocab_size=args.vocab + 1, seq_len=args.seq, embed_dim=args.d_model,
+        num_layers=args.layers, num_heads=args.heads,
+        num_kv_heads=args.kv_heads or None, rope=args.rope,
+        attention_window=args.window or 0, dropout_rate=0.0,
+        dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+
+    rng = np.random.default_rng(0)
+    targets = jnp.asarray(rng.integers(
+        0, args.vocab, size=(args.batch, args.seq)).astype(np.int32))
+
+    state = create_train_state(model, jax.random.PRNGKey(1),
+                               sample_input_shape=(1, args.seq))
+
+    def lm_loss(params, xs, ys, rng_):
+        del ys
+        return lm_mod.next_token_loss(model, params, xs, None, deterministic=True)
+
+    step = make_train_step(model, learning_rate=1e-3, momentum=0.0,
+                           optimizer=None, loss_fn=lm_loss)
+    key = jax.random.PRNGKey(2)
+
+    @jax.jit
+    def run_train(state):
+        def body(st, _):
+            st, loss = step(st, targets, targets[:, 0], key)
+            return st, loss
+
+        return lax.scan(body, state, None, length=args.steps)
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        timed_state_run,
+    )
+
+    def timed_train(state):
+        return timed_state_run(run_train, state)   # honest sync (module docstring)
+
+    state, _, _ = timed_train(state)               # warmup
+    train_times, last_loss = [], None
+    for _ in range(3):
+        state, dt, last_loss = timed_train(state)
+        train_times.append(dt)
+    train_median = float(np.median(train_times))
+    steps_per_s = args.steps / train_median
+
+    gen = jax.jit(lambda params, k: lm_mod.generate(
+        model, params, k, batch=args.gen_batch, temperature=1.0))
+
+    def timed_gen(k):
+        t0 = time.perf_counter()
+        ids = gen(state.params, k)
+        jax.device_get(ids[:, -1])                 # depends on the whole scan
+        return time.perf_counter() - t0
+
+    timed_gen(jax.random.PRNGKey(3))               # warmup
+    gen_times = [timed_gen(jax.random.PRNGKey(4 + i)) for i in range(3)]
+    gen_median = float(np.median(gen_times))
+    decode_tokens_per_s = args.gen_batch * args.seq / gen_median
+
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": (f"pixel-LM train steps/s + decode tokens/s (L={args.layers}, "
+                   f"d_model={args.d_model}, seq={args.seq}, batch={args.batch}, "
+                   f"heads={args.heads}"
+                   f"{f', kv_heads={args.kv_heads}' if args.kv_heads else ''}"
+                   f"{', rope' if args.rope else ''}"
+                   f"{f', window={args.window}' if args.window else ''}, "
+                   f"{'bf16' if args.bf16 else 'f32'})"),
+        "value": round(steps_per_s, 2),
+        "unit": "steps/s",
+        "vs_baseline": None,       # beyond-parity surface: the reference has no LM
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "train_seconds_per_run_all": [round(t, 4) for t in train_times],
+        "train_tokens_per_s": round(steps_per_s * args.batch * args.seq),
+        "decode_seconds_all": [round(t, 4) for t in gen_times],
+        "decode_tokens_per_s": round(decode_tokens_per_s, 1),
+        "decode_batch": args.gen_batch,
+        "final_train_loss": round(last_loss, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

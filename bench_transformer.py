@@ -102,13 +102,12 @@ def main(argv=None) -> int:
 
         return lax.scan(body, state, None, length=args.steps)
 
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        timed_state_run,
+    )
+
     def timed(state):
-        t0 = time.perf_counter()
-        state, losses = run(state)
-        probe = losses[-1] + jax.tree_util.tree_leaves(state.params)[0].astype(
-            jnp.float32).ravel()[0]
-        jax.device_get(probe)                     # honest sync (see module docstring)
-        return state, time.perf_counter() - t0, float(jax.device_get(losses[-1]))
+        return timed_state_run(run, state)        # honest sync (see module docstring)
 
     state, _, _ = timed(state)                    # warmup: compile + fault-in
     times, last_loss = [], None

@@ -70,6 +70,23 @@ PEAK_FLOPS_BY_KIND = [
 ]
 
 
+def timed_state_run(run, state):
+    """Time ONE compiled ``state -> (state, losses)`` program with the honest-sync
+    protocol the microbenches share: the clock stops only after a device→host fetch
+    of a scalar data-dependent on the last loss AND a parameter leaf (on tunnelled
+    PJRT backends ``block_until_ready`` can resolve at enqueue-ack, under-reporting).
+    Returns ``(state, seconds, last_loss)``. One owner for the probe — a sync-protocol
+    fix lands in every bench at once."""
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    state, losses = run(state)
+    probe = losses[-1] + jax.tree_util.tree_leaves(state.params)[0].astype(
+        jnp.float32).ravel()[0]
+    jax.device_get(probe)
+    return state, time.perf_counter() - t0, float(jax.device_get(losses[-1]))
+
+
 def enable_compile_cache(default_dir: str) -> None:
     """Enable jax's persistent compilation cache (best-effort; never a failure mode).
 

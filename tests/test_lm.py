@@ -200,6 +200,32 @@ def test_prompt_conditioning_affects_distribution():
     assert not np.array_equal(t1, t2)
 
 
+def test_bench_lm_emits_one_json_line(tmp_path):
+    """bench_lm.py prints exactly one parseable JSON line with the contract keys
+    (driver-style artifact), at tiny CPU shapes."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "PYTHONPATH": repo, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench_lm.py"), "--seq", "32",
+         "--batch", "4", "--gen-batch", "2", "--d-model", "32", "--layers", "1",
+         "--heads", "2", "--steps", "2"],
+        env=env, capture_output=True, text=True, timeout=420, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l]
+    assert len(lines) == 1
+    row = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "decode_tokens_per_s",
+                "train_tokens_per_s", "platform"):
+        assert key in row
+    assert row["unit"] == "steps/s" and row["value"] > 0
+    assert row["decode_tokens_per_s"] > 0
+
+
 def test_generated_grid_handles_more_than_six(tmp_path):
     from csed_514_project_distributed_training_using_pytorch_tpu.utils import plotting
 

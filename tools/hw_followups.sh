@@ -50,6 +50,14 @@ timeout --kill-after=60 --signal=TERM 1800 python bench_transformer.py --flash \
   > "$OUT/bench_transformer_flash_tpu.json" 2> "$OUT/bench_transformer_flash.err"
 echo "bench_transformer --flash rc=$? ($OUT/bench_transformer_flash_tpu.json)"
 
+echo "=== 2b2. pixel-LM throughput: train steps/s + KV-cache decode tokens/s (r3) ==="
+timeout --kill-after=60 --signal=TERM 1800 python bench_lm.py \
+  > "$OUT/bench_lm_tpu.json" 2> "$OUT/bench_lm.err"
+echo "bench_lm rc=$? ($OUT/bench_lm_tpu.json)"
+timeout --kill-after=60 --signal=TERM 1800 python bench_lm.py --kv-heads 2 --rope \
+  > "$OUT/bench_lm_gqa_rope_tpu.json" 2> "$OUT/bench_lm_gqa.err"
+echo "bench_lm --kv-heads 2 --rope rc=$? ($OUT/bench_lm_gqa_rope_tpu.json)"
+
 echo "=== 2c. banded (sliding-window) flash at long S (r3: O(S*W) compute — the" \
      "local-attention regime where full attention is off the chart) ==="
 timeout --kill-after=60 --signal=TERM 1800 python bench_attention.py \
