@@ -166,6 +166,8 @@ class TransformerBlock(fnn.Module):
     dtype: jnp.dtype = jnp.float32
     num_experts: int = 0
     expert_capacity_factor: float = 1.25
+    expert_top_k: int = 1               # 1 = Switch top-1 routing; 2 = GShard top-2
+                                        # (renormalized pair gates)
     expert_mesh: object = None          # optional Mesh: pin dispatched tokens onto its
                                         # 'expert' axis (EP execution; numerics identical)
 
@@ -211,7 +213,7 @@ class TransformerBlock(fnn.Module):
             tokens = h.astype(self.dtype).reshape(b * s, e)
             routed, aux = ep.moe_apply(
                 moe_params, tokens, capacity_factor=self.expert_capacity_factor,
-                mesh=self.expert_mesh)
+                num_selected=self.expert_top_k, mesh=self.expert_mesh)
             self.sow("aux_loss", "load_balance", aux)
             h = routed.reshape(b, s, e)
         else:
@@ -256,10 +258,11 @@ class TransformerClassifier(fnn.Module):
                                 # ~1/3 extra FLOPs — the long-context memory knob the
                                 # brief's HBM math calls for; numerics unchanged
                                 # (pinned in tests/test_transformer.py)
-    num_experts: int = 0        # >0: every block's MLP becomes a Switch top-1 MoE with
+    num_experts: int = 0        # >0: every block's MLP becomes a routed MoE with
                                 # this many experts (see TransformerBlock docstring for
                                 # the sown load-balance aux loss)
     expert_capacity_factor: float = 1.25
+    expert_top_k: int = 1       # 1 = Switch; 2 = GShard top-2
     expert_mesh: object = None  # optional Mesh with an 'expert' axis → EP execution
 
     @fnn.compact
@@ -290,6 +293,7 @@ class TransformerClassifier(fnn.Module):
                 causal=self.causal, rope=self.rope, dtype=self.dtype,
                 num_experts=self.num_experts,
                 expert_capacity_factor=self.expert_capacity_factor,
+                expert_top_k=self.expert_top_k,
                 expert_mesh=self.expert_mesh, name=f"block_{i}")(
                     h, deterministic)
 

@@ -69,23 +69,52 @@ def shard_moe_params(mesh: Mesh, params: dict, *, axis_name: str = "expert") -> 
             for k, v in params.items()}
 
 
-def _route(params: dict, tokens: jax.Array, *, capacity: int):
-    """Top-1 routing to a ``[N, E, C]`` dispatch/combine layout (static shapes)."""
+def _route(params: dict, tokens: jax.Array, *, capacity: int,
+           num_selected: int = 1):
+    """Top-k routing to a ``[N, E, C]`` dispatch/combine layout (static shapes).
+
+    ``num_selected=1`` is Switch (raw top-1 probability as the gate);
+    ``num_selected=2`` is the GShard formulation — each token goes to its two
+    highest-probability experts with gates renormalized over the selected pair, and
+    each expert's capacity queue enqueues all first-choice assignments before any
+    second choices (first choices survive overflow preferentially, the standard
+    ordering). The load-balance auxiliary always uses the FIRST-choice assignment
+    fractions (Switch §2.2's formula — also GShard's convention).
+    """
     logits = tokens @ params["router_kernel"]              # [N, E]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert_index = jnp.argmax(probs, axis=-1)              # [N]
     num_experts = logits.shape[-1]
-    onehot = jax.nn.one_hot(expert_index, num_experts)     # [N, E]
-    gate = jnp.sum(probs * onehot, axis=-1)                # [N]
-    # Position of each token in its expert's queue; ≥capacity ⇒ dropped.
-    position = jnp.cumsum(onehot, axis=0) - onehot         # [N, E] (0-based, own slot)
-    position = jnp.sum(position * onehot, axis=-1).astype(jnp.int32)  # [N]
-    kept = position < capacity
-    dispatch = (onehot * kept[:, None])[:, :, None] * jax.nn.one_hot(
-        jnp.clip(position, 0, capacity - 1), capacity)[:, None, :]   # [N, E, C]
-    combine = dispatch * gate[:, None, None]
+
+    remaining = probs
+    onehots, raw_gates = [], []
+    for _ in range(num_selected):
+        onehot = jax.nn.one_hot(jnp.argmax(remaining, axis=-1), num_experts)
+        onehots.append(onehot)                             # [N, E]
+        raw_gates.append(jnp.sum(probs * onehot, axis=-1))  # [N]
+        remaining = remaining * (1.0 - onehot)
+    if num_selected > 1:
+        denom = sum(raw_gates) + 1e-9
+        gates = [g / denom for g in raw_gates]             # GShard renormalization
+    else:
+        gates = raw_gates                                  # Switch: raw probability
+
+    dispatch = jnp.zeros((tokens.shape[0], num_experts, capacity), jnp.float32)
+    combine = jnp.zeros_like(dispatch)
+    queued = jnp.zeros((num_experts,), jnp.float32)        # slots used by earlier choices
+    for onehot, gate in zip(onehots, gates):
+        # Position of each token in its expert's queue; ≥capacity ⇒ dropped. Later
+        # choices continue the queue after every earlier choice's assignments, so
+        # slots never collide across choices.
+        position = jnp.cumsum(onehot, axis=0) - onehot + queued[None]   # [N, E]
+        position = jnp.sum(position * onehot, axis=-1).astype(jnp.int32)  # [N]
+        kept = position < capacity
+        d = (onehot * kept[:, None])[:, :, None] * jax.nn.one_hot(
+            jnp.clip(position, 0, capacity - 1), capacity)[:, None, :]  # [N, E, C]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        queued = queued + jnp.sum(onehot, axis=0)
     # Switch load-balance auxiliary: num_experts * Σ_e frac_tokens_e * frac_probs_e.
-    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_tokens = jnp.mean(onehots[0], axis=0)
     frac_probs = jnp.mean(probs, axis=0)
     aux_loss = num_experts * jnp.sum(frac_tokens * frac_probs)
     return dispatch, combine, aux_loss
@@ -100,17 +129,24 @@ def _expert_mlp(params: dict, x_e: jax.Array) -> jax.Array:
 
 
 def moe_apply(params: dict, tokens: jax.Array, *, capacity_factor: float = 1.25,
+              num_selected: int = 1,
               mesh: Mesh | None = None, axis_name: str = "expert") -> tuple[jax.Array, jax.Array]:
     """Apply the MoE layer to ``tokens: [N, d]`` → ``(outputs [N, d], aux_loss)``.
 
     With ``mesh``, the dispatched activations are constrained onto the expert axis so the
     expert matmuls run where the (sharded) weights live; without it the same program runs
     on one device. Identical numerics either way (the EP oracle test).
+    ``num_selected=2`` selects the GShard top-2 router (see ``_route``); capacity
+    scales with the assignment count.
     """
+    if num_selected < 1 or num_selected > params["router_kernel"].shape[-1]:
+        raise ValueError(f"num_selected must be in [1, num_experts], got "
+                         f"{num_selected}")
     num_experts = params["router_kernel"].shape[-1]
     n = tokens.shape[0]
-    capacity = max(1, math.ceil(n / num_experts * capacity_factor))
-    dispatch, combine, aux_loss = _route(params, tokens, capacity=capacity)
+    capacity = max(1, math.ceil(num_selected * n / num_experts * capacity_factor))
+    dispatch, combine, aux_loss = _route(params, tokens, capacity=capacity,
+                                         num_selected=num_selected)
     x_e = jnp.einsum("nec,nd->ecd", dispatch, tokens)      # [E, C, d]
     if mesh is not None:
         x_e = jax.lax.with_sharding_constraint(
@@ -124,20 +160,32 @@ def moe_apply(params: dict, tokens: jax.Array, *, capacity_factor: float = 1.25,
 
 
 def moe_apply_dense_oracle(params: dict, tokens: jax.Array, *,
-                           capacity_factor: float = 1.25) -> tuple[jax.Array, jax.Array]:
+                           capacity_factor: float = 1.25,
+                           num_selected: int = 1) -> tuple[jax.Array, jax.Array]:
     """Reference semantics with no dispatch machinery: every expert computes every token,
-    then the routed/kept one is selected and gated. O(E·N·d·h) — test oracle only."""
+    then the kept assignments are selected and gated. O(E·N·d·h) — test oracle only."""
     num_experts = params["router_kernel"].shape[-1]
     n = tokens.shape[0]
-    capacity = max(1, math.ceil(n / num_experts * capacity_factor))
-    dispatch, _, aux_loss = _route(params, tokens, capacity=capacity)
-    kept_gate = jnp.sum(dispatch, axis=-1)                 # [N, E] ∈ {0,1}
-    logits = tokens @ params["router_kernel"]
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    capacity = max(1, math.ceil(num_selected * n / num_experts * capacity_factor))
+    # Keep masks come from _route (the capacity bookkeeping IS the shared machinery
+    # under test elsewhere), but the GATES are recomputed INDEPENDENTLY here so the
+    # parity test retains power over _route's gating math (selection order,
+    # renormalization set, probs-vs-remaining reads).
+    dispatch, _, aux_loss = _route(params, tokens, capacity=capacity,
+                                   num_selected=num_selected)
+    kept = jnp.sum(dispatch, axis=-1)                      # [N, E] ∈ {0, 1}
+    probs = jax.nn.softmax((tokens @ params["router_kernel"]).astype(jnp.float32),
+                           axis=-1)
+    top_probs, top_idx = jax.lax.top_k(probs, num_selected)   # [N, k] each
+    selected = jax.nn.one_hot(top_idx, num_experts)        # [N, k, E]
+    if num_selected > 1:
+        gates = top_probs / (jnp.sum(top_probs, axis=-1, keepdims=True) + 1e-9)
+    else:
+        gates = top_probs                                  # Switch: raw probability
+    weights = kept * jnp.einsum("nk,nke->ne", gates, selected)
     per_expert = jnp.einsum("nd,edh->neh", tokens, params["up_kernel"])
     per_expert = gelu(per_expert + params["up_bias"][None])
     per_expert = jnp.einsum("neh,ehd->ned", per_expert, params["down_kernel"])
     per_expert = per_expert + params["down_bias"][None]
-    weights = kept_gate * probs                            # gate only the kept top-1 slot
     out = jnp.einsum("ne,ned->nd", weights, per_expert)
     return out.astype(tokens.dtype), aux_loss

@@ -267,9 +267,13 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         model_kwargs["rope"] = True
     if attention_fn is not None:
         model_kwargs["attention_fn"] = attention_fn
+    if not 1 <= config.moe_top_k <= max(expert_size, 1):
+        raise ValueError(f"--moe-top-k must be in [1, expert axis size], got "
+                         f"{config.moe_top_k} with expert={expert_size}")
     if expert_size > 1:
         model_kwargs["num_experts"] = expert_size
         model_kwargs["expert_mesh"] = mesh
+        model_kwargs["expert_top_k"] = config.moe_top_k
     model = TransformerClassifier(**model_kwargs)
     if seq_size > 1 and model.seq_len % seq_size:
         raise ValueError(f"model seq_len {model.seq_len} not divisible by seq axis "

@@ -192,6 +192,8 @@ class ComposedConfig:
     kv_heads: int = 0                   # grouped-query attention K/V head count
                                         # (0 = MHA; must divide the model's 4 heads)
     rope: bool = False                  # rotary position embeddings on q/k
+    moe_top_k: int = 1                  # MoE router: 1 = Switch top-1, 2 = GShard
+                                        # top-2 (expert axis only)
     zigzag_attention: bool = False      # load-balanced zig-zag causal ring schedule
                                         # (parallel.zigzag_ring_attention); requires
                                         # --causal and seq_len % (2*seq_axis) == 0

@@ -151,6 +151,27 @@ def test_adamw_mesh_invariant(tmp_path, tiny_datasets):
     assert int(state_3d.velocity["count"]) == int(state_3d.step)
 
 
+def test_moe_top2_trains(tmp_path, tiny_datasets):
+    """--mesh data=2,expert=4 --moe-top-k 2: GShard top-2 routing trains through the
+    expert-sharded blocks and differs from the top-1 trajectory (two experts fire)."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100,
+                  max_train_examples=256)
+    _, hist2 = composed.main(
+        ComposedConfig(mesh="data=2,expert=4", moe_top_k=2,
+                       results_dir=str(tmp_path / "top2"), **common),
+        datasets=tiny_datasets)
+    _, hist1 = composed.main(
+        ComposedConfig(mesh="data=2,expert=4",
+                       results_dir=str(tmp_path / "top1"), **common),
+        datasets=tiny_datasets)
+    assert np.isfinite(hist2.train_losses).all()
+    assert hist2.train_losses != hist1.train_losses
+    with pytest.raises(ValueError, match="moe-top-k"):
+        composed.main(ComposedConfig(mesh="data=2,expert=4", moe_top_k=5,
+                                     results_dir=""),
+                      datasets=tiny_datasets)
+
+
 def test_rope_stage_axis_matches_dp(tmp_path, tiny_datasets):
     """--rope on a stage mesh equals --rope on plain DP — the pipeline engine must
     mirror every attention-shaping model field (a dropped rope field would silently
