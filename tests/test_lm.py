@@ -236,6 +236,26 @@ def test_generated_grid_handles_more_than_six(tmp_path):
     assert path is not None and (tmp_path / "g.png").exists()
 
 
+def test_lm_with_zigzag_ring_matches_dense():
+    """The LM through the load-balanced zig-zag causal ring (its natural long-context
+    schedule — the LM is always causal): equal to the dense forward on an 8-way seq
+    mesh (S=16 divides 2·8)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        make_mesh, make_ring_attention_fn,
+    )
+
+    mesh = make_mesh(8, axis_names=("seq",))
+    dense = _model()
+    zig = _model(attention_fn=make_ring_attention_fn(mesh, use_zigzag=True))
+    params = _params(dense, seed=12)
+    targets = _targets(dense, b=2, seed=13)
+    inputs = dense.shift_right(targets)
+    np.testing.assert_allclose(
+        np.asarray(zig.apply({"params": params}, inputs)),
+        np.asarray(dense.apply({"params": params}, inputs)),
+        rtol=1e-5, atol=1e-5)
+
+
 def test_lm_with_ring_attention_matches_dense():
     """The LM's pluggable attention core: ring attention over a seq mesh reproduces the
     dense forward — the long-context training path applies to the decoder family too."""
