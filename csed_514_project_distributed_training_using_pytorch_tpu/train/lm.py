@@ -86,10 +86,31 @@ def main(config: LMConfig = LMConfig(), *,
         raise ValueError(f"--kv-heads {config.kv_heads} must be a positive divisor "
                          f"of --num-heads {config.num_heads}")
     info = initialize_cluster()
-    mesh = make_mesh()
-    world = mesh.shape["data"]
+    if config.mesh:
+        # Optional named mesh: data (DP) x seq (context parallelism — ring or
+        # zig-zag causal attention over the sequence-sharded pixel stream).
+        from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import (
+            parse_mesh_spec,
+        )
+        axis_names, axis_sizes = parse_mesh_spec(config.mesh)
+        if any(n not in ("data", "seq") for n in axis_names) or "data" not in axis_names:
+            raise ValueError("the LM trainer's --mesh needs a data axis and supports "
+                             f"data and seq axes only, got {config.mesh!r} "
+                             f"(use data=1,seq=N for pure context parallelism)")
+        mesh = make_mesh(int(np.prod(axis_sizes)), axis_names=axis_names,
+                         axis_shape=axis_sizes)
+    else:
+        mesh = make_mesh()
+    world = mesh.shape.get("data", 1)
+    seq_size = mesh.shape.get("seq", 1)
+    if config.zigzag_attention and seq_size < 2:
+        raise ValueError("--zigzag-attention needs a seq axis in --mesh")
+    if config.attention_window and seq_size > 1:
+        raise ValueError("--attention-window does not compose with a seq axis "
+                         "(the ring schedules do not window)")
     if config.batch_size % world:
-        raise ValueError(f"batch {config.batch_size} not divisible by world {world}")
+        raise ValueError(f"batch {config.batch_size} not divisible by data axis "
+                         f"{world}")
 
     if config.download_data and datasets is None:
         download_mnist(config.data_dir)
@@ -105,14 +126,30 @@ def main(config: LMConfig = LMConfig(), *,
     n_train, n_test = len(train_tokens), len(test_tokens)
     seq_len = train_tokens.shape[1]
 
+    lm_kwargs = {}
+    if seq_size > 1:
+        # Context parallelism for the decoder: the ring (or zig-zag) causal core
+        # plugs in without touching parameters, so seq-mesh checkpoints interchange
+        # with DP runs (trajectory equality pinned in tests).
+        from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+            make_ring_attention_fn,
+        )
+        need = 2 * seq_size if config.zigzag_attention else seq_size
+        if seq_len % need:
+            raise ValueError(f"seq_len {seq_len} must divide by "
+                             f"{'2*seq axis' if config.zigzag_attention else 'the seq axis'}"
+                             f" = {need}")
+        lm_kwargs["attention_fn"] = make_ring_attention_fn(
+            mesh, use_zigzag=config.zigzag_attention)
     model = lm_mod.TransformerLM(
         vocab_size=config.num_levels + 1, seq_len=seq_len,
         embed_dim=config.embed_dim, num_layers=config.num_layers,
         num_heads=config.num_heads, dropout_rate=config.dropout_rate,
         num_kv_heads=config.kv_heads or None,
         attention_window=config.attention_window, rope=config.rope,
-        dtype=jnp.bfloat16 if config.bf16 else jnp.float32, remat=config.remat)
-    M.log(f"LM training: {world} devices on {info.process_count} process(es), "
+        dtype=jnp.bfloat16 if config.bf16 else jnp.float32, remat=config.remat,
+        **lm_kwargs)
+    M.log(f"LM training: mesh {dict(mesh.shape)} on {info.process_count} process(es), "
           f"batch {config.batch_size}, vocab {config.num_levels}+BOS, "
           f"seq {seq_len}, data source: {train_ds.source}")
 

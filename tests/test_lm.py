@@ -200,6 +200,43 @@ def test_prompt_conditioning_affects_distribution():
     assert not np.array_equal(t1, t2)
 
 
+def test_lm_trainer_seq_mesh_matches_dp(tmp_path):
+    """--mesh data=2,seq=2 (context-parallel LM training from the CLI) reproduces the
+    plain-DP trajectory — the ring causal core is an execution layout for the decoder
+    too; zig-zag ditto."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
+        Dataset,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+        lm as lm_train,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+        LMConfig,
+    )
+
+    xs, ys = _synthesize_split(128, seed=60)
+    train = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+    xs, ys = _synthesize_split(100, seed=61)
+    test = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+
+    def run(tag, **kw):
+        cfg = LMConfig(epochs=1, batch_size=64, eval_batch=100, embed_dim=32,
+                       num_layers=1, num_heads=2, generate=0,
+                       results_dir=str(tmp_path / tag),
+                       images_dir=str(tmp_path / tag / "img"), **kw)
+        return lm_train.main(cfg, datasets=(train, test))
+
+    _, hist_dp = run("dp", mesh="data=4")
+    _, hist_sp = run("sp", mesh="data=2,seq=2")
+    np.testing.assert_allclose(hist_sp.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    _, hist_zz = run("zz", mesh="data=2,seq=2", zigzag_attention=True)
+    np.testing.assert_allclose(hist_zz.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError, match="data and seq"):
+        run("bad", mesh="data=2,model=2")
+
+
 def test_bench_lm_emits_one_json_line(tmp_path):
     """bench_lm.py prints exactly one parseable JSON line with the contract keys
     (driver-style artifact), at tiny CPU shapes."""
