@@ -416,6 +416,9 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             checkpoint.save_train_state(ckpt_path, host_state)
     if ckpt_path:
         M.log(f"Saved {ckpt_path}")
+    if config.results_dir:
+        M.save_metrics_jsonl(history,
+                             os.path.join(config.results_dir, "metrics.jsonl"))
     return host_state, history
 
 

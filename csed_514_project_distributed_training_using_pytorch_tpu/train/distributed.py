@@ -253,6 +253,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
 
     plotting.save_loss_curves(
         history, os.path.join(config.images_dir, "train_test_curve_dist.png"))  # ≙ :161
+    M.save_metrics_jsonl(history, os.path.join(config.results_dir, "metrics.jsonl"))
     checkpoint.save_params(
         os.path.join(config.results_dir, "model_dist.msgpack"), state.params)   # ≙ :163-164
     return state, history

@@ -254,6 +254,9 @@ def main(config: LMConfig = LMConfig(), *,
                     prompt_len=seq_len // 2)
     plotting.save_loss_curves(history,
                               os.path.join(config.images_dir, "lm_loss_curve.png"))
+    if config.results_dir:
+        M.save_metrics_jsonl(history,
+                             os.path.join(config.results_dir, "metrics.jsonl"))
     return host_state, history
 
 

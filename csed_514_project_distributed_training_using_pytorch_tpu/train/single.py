@@ -271,6 +271,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
 
     plotting.save_loss_curves(history,
                               os.path.join(config.images_dir, "train_test_curve.png"))
+    M.save_metrics_jsonl(history, os.path.join(config.results_dir, "metrics.jsonl"))
     checkpoint.save_train_state(ckpt_path, state)
     return state, history
 

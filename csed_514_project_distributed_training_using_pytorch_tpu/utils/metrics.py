@@ -36,6 +36,37 @@ class MetricsHistory:
         self.test_losses.append(float(loss))
 
 
+def save_metrics_jsonl(history: MetricsHistory, path: str) -> str | None:
+    """Machine-readable companion to the loss-curve PNGs: one JSON line per recorded
+    metric point (``{"kind": "train"|"test", "examples_seen": N, "loss": L}``),
+    process-0 gated and written atomically (tmp + rename) like the checkpoints.
+    The stdout lines remain the reference-parity surface; this is the structured
+    artifact tooling can consume without parsing them."""
+    if not is_logging_process():
+        return None
+    import json
+    import math
+
+    # One atomic-write implementation for the whole codebase (perms/cleanup parity
+    # with the checkpoints); lazy import keeps module import order trivial.
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.checkpoint import (
+        _atomic_write,
+    )
+
+    def finite(l):
+        # Strict JSONL: bare NaN/Infinity tokens are invalid JSON and break
+        # consumers (jq, JSON.parse); a diverged run records null instead.
+        return l if math.isfinite(l) else None
+
+    rows = ([{"kind": "train", "examples_seen": e, "loss": finite(l)}
+             for e, l in zip(history.train_counter, history.train_losses)]
+            + [{"kind": "test", "examples_seen": e, "loss": finite(l)}
+               for e, l in zip(history.test_counter, history.test_losses)])
+    payload = "".join(json.dumps(row, allow_nan=False) + "\n" for row in rows)
+    _atomic_write(path, payload.encode())
+    return path
+
+
 class Stopwatch:
     """Wall-clock since construction (≙ ``t0 = time.time()`` reference src/train.py:10)."""
 

@@ -88,3 +88,32 @@ def test_save_attention_curve(tmp_path):
     path = str(tmp_path / "attention.png")
     assert save_attention_curve(rows, path) == path
     assert os.path.getsize(path) > 0
+
+
+def test_save_metrics_jsonl_round_trips(tmp_path):
+    """The structured metrics artifact: one JSON line per recorded point, train and
+    test kinds, atomic write."""
+    import json
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.metrics import (
+        MetricsHistory, save_metrics_jsonl,
+    )
+
+    h = MetricsHistory()
+    h.record_train(64, 2.3)
+    h.record_train(128, 1.9)
+    h.record_test(128, 2.1)
+    path = str(tmp_path / "results" / "metrics.jsonl")
+    assert save_metrics_jsonl(h, path) == path
+    rows = [json.loads(l) for l in open(path)]
+    assert rows == [
+        {"kind": "train", "examples_seen": 64, "loss": 2.3},
+        {"kind": "train", "examples_seen": 128, "loss": 1.9},
+        {"kind": "test", "examples_seen": 128, "loss": 2.1},
+    ]
+
+    # Non-finite losses serialize as null (strict JSONL, not a bare NaN token).
+    h.record_train(192, float("nan"))
+    save_metrics_jsonl(h, path)
+    rows = [json.loads(l) for l in open(path)]
+    assert rows[2] == {"kind": "train", "examples_seen": 192, "loss": None}
