@@ -47,6 +47,8 @@ def main(argv=None) -> int:
 
     from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
         enable_compile_cache,
+        peak_flops,
+        timed_state_run,
     )
 
     enable_compile_cache(os.path.join(
@@ -89,10 +91,6 @@ def main(argv=None) -> int:
 
         return lax.scan(body, state, None, length=args.steps)
 
-    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
-        timed_state_run,
-    )
-
     def timed_train(state):
         return timed_state_run(run_train, state)   # honest sync (module docstring)
 
@@ -118,7 +116,22 @@ def main(argv=None) -> int:
     gen_median = float(np.median(gen_times))
     decode_tokens_per_s = args.gen_batch * args.seq / gen_median
 
+    # Model-FLOPs accounting mirrors bench_transformer.py, adjusted for this bench's
+    # knobs: GQA narrows the KV projection (4e²·kvh/H instead of 4e²) and a sliding
+    # window caps the attended keys at W. The attention term charges the full causal
+    # scan (upper bound — required work averages s/2; the dense masked implementation
+    # executes the full s×s einsums either way), plus the vocab head (2·e·V);
+    # embedding gathers are negligible. Training ≈ 3× forward.
+    e = args.d_model
+    kvh = args.kv_heads or args.heads
+    proj_flops = (20 + 4 * kvh / args.heads) * e * e   # q/out/mlp 20e² + kv 4e²·kvh/H
+    s_att = min(args.window, args.seq) if args.window else args.seq
+    fwd_per_token = (args.layers * (proj_flops + 4 * s_att * e)
+                     + 2 * e * (args.vocab + 1))
+    train_flops_per_step = int(3 * fwd_per_token * args.seq * args.batch)
+    achieved = steps_per_s * train_flops_per_step
     dev = jax.devices()[0]
+    peak = peak_flops(getattr(dev, "device_kind", "")) if dev.platform == "tpu" else None
     print(json.dumps({
         "metric": (f"pixel-LM train steps/s + decode tokens/s (L={args.layers}, "
                    f"d_model={args.d_model}, seq={args.seq}, batch={args.batch}, "
@@ -137,6 +150,9 @@ def main(argv=None) -> int:
         "decode_seconds_all": [round(t, 4) for t in gen_times],
         "decode_tokens_per_s": round(decode_tokens_per_s, 1),
         "decode_batch": args.gen_batch,
+        "model_train_flops_per_step": train_flops_per_step,
+        "achieved_model_flops_per_s": round(achieved),
+        "mfu_vs_bf16_peak": round(achieved / peak, 6) if peak else None,
         "final_train_loss": round(last_loss, 4),
     }))
     return 0
