@@ -252,12 +252,46 @@ def decode_step(model: TransformerLM, params, cache: dict, ids_t: jax.Array,
     return cache, ops.log_softmax(logits.astype(jnp.float32))
 
 
+def filter_logits(log_probs: jax.Array, *, top_k: int = 0,
+                  top_p: float = 1.0) -> jax.Array:
+    """Mask ``[..., V]`` logits outside the top-k set and/or the top-p nucleus.
+
+    ``top_k = 0`` disables the k filter; ``top_p = 1.0`` disables the nucleus filter.
+    The nucleus is the smallest prefix of the probability-sorted vocabulary whose
+    mass reaches ``top_p`` (the argmax always survives). Filters compose — both masks
+    apply when both are set. Input need not be normalized (temperature-scaled
+    log-probs are fine); masked entries become ``MASK_VALUE`` so a downstream
+    ``jax.random.categorical`` renormalizes over the survivors.
+    """
+    if top_k:
+        kth = lax.top_k(log_probs, top_k)[0][..., -1:]
+        log_probs = jnp.where(log_probs < kth, MASK_VALUE, log_probs)
+    if top_p < 1.0:
+        sorted_lp = jnp.sort(log_probs, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_lp, axis=-1)
+        # Exclusive cumulative mass: position j is kept while the mass BEFORE it is
+        # still < top_p, i.e. it is needed to reach the target mass. j=0 (the
+        # argmax) is always kept.
+        before = jnp.cumsum(probs, axis=-1) - probs
+        kept = before < top_p
+        # Value threshold = smallest kept sorted logit; ties at the threshold all
+        # survive (harmless: they carry identical probability).
+        thresh = jnp.min(jnp.where(kept, sorted_lp, jnp.inf), axis=-1,
+                         keepdims=True)
+        log_probs = jnp.where(log_probs < thresh, MASK_VALUE, log_probs)
+    return log_probs
+
+
 def generate(model: TransformerLM, params, rng: jax.Array, *, batch: int = 1,
-             temperature: float = 1.0, prompt: jax.Array | None = None,
+             temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+             prompt: jax.Array | None = None,
              prompt_len: int = 0) -> jax.Array:
     """Sample ``[batch, seq_len]`` token streams from BOS, autoregressively.
 
-    ``temperature <= 0`` decodes greedily. The whole loop is one ``lax.scan`` (wrap in
+    ``temperature <= 0`` decodes greedily. ``top_k`` / ``top_p`` restrict sampling to
+    the k most likely tokens / the smallest nucleus with ``top_p`` probability mass
+    (applied AFTER temperature scaling, composing in that order — the common
+    convention). The whole loop is one ``lax.scan`` (wrap in
     ``jax.jit`` for repeated use); per-step work is the KV-cache ``decode_step``, so
     cost is O(S²·E) total instead of the O(S³·E) of re-running the full forward per
     position.
@@ -272,6 +306,10 @@ def generate(model: TransformerLM, params, rng: jax.Array, *, batch: int = 1,
     # Host (numpy) checkpoints decode too: numpy leaves can't be indexed by traced
     # token ids inside the scan.
     params = jax.tree_util.tree_map(jnp.asarray, params)
+    if not 0 <= top_k <= model.vocab_size:
+        raise ValueError(f"top_k {top_k} outside [0, {model.vocab_size}]")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p {top_p} outside (0, 1]")
     if prompt is None:
         prompt = jnp.zeros((batch, model.seq_len), jnp.int32)
         prompt_len = 0
@@ -293,7 +331,9 @@ def generate(model: TransformerLM, params, rng: jax.Array, *, batch: int = 1,
         log_probs = log_probs.at[:, model.vocab_size - 1].set(MASK_VALUE)
         key, sub = jax.random.split(key)
         if temperature > 0:
-            nxt = jax.random.categorical(sub, log_probs / temperature, axis=-1)
+            scaled = filter_logits(log_probs / temperature,
+                                   top_k=top_k, top_p=top_p)
+            nxt = jax.random.categorical(sub, scaled, axis=-1)
         else:
             nxt = jnp.argmax(log_probs, axis=-1)
         # Teacher-force the prompt region. The forced token conditions later steps

@@ -141,6 +141,12 @@ def main(config: LMConfig = LMConfig(), *,
                              f" = {need}")
         lm_kwargs["attention_fn"] = make_ring_attention_fn(
             mesh, use_zigzag=config.zigzag_attention)
+    # Fail fast on sampling knobs: generate() re-checks these, but its first call is
+    # AFTER the full training loop — a bad flag must not cost the whole run.
+    if not 0 <= config.top_k <= config.num_levels + 1:
+        raise ValueError(f"top_k {config.top_k} outside [0, {config.num_levels + 1}]")
+    if not 0.0 < config.top_p <= 1.0:
+        raise ValueError(f"top_p {config.top_p} outside (0, 1]")
     model = lm_mod.TransformerLM(
         vocab_size=config.num_levels + 1, seq_len=seq_len,
         embed_dim=config.embed_dim, num_layers=config.num_layers,
@@ -236,7 +242,8 @@ def main(config: LMConfig = LMConfig(), *,
         def sample_grid(filename: str, seed_offset: int, batch: int, **gen_kw):
             ids = jax.jit(lambda key: lm_mod.generate(
                 model, host_state.params, key, batch=batch,
-                temperature=config.temperature, **gen_kw))(
+                temperature=config.temperature, top_k=config.top_k,
+                top_p=config.top_p, **gen_kw))(
                     jax.random.PRNGKey(config.seed + seed_offset))
             path = os.path.join(config.images_dir, filename)
             if plotting.save_generated_grid(

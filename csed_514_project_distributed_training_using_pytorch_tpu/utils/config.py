@@ -275,6 +275,8 @@ class LMConfig:
     eval_batch: int = 500               # test-perplexity scan batch (must divide split)
     generate: int = 6                   # sample this many digits after training (0 off)
     temperature: float = 1.0            # sampling temperature (<= 0 decodes greedily)
+    top_k: int = 0                      # sample only the k most likely tokens (0 off)
+    top_p: float = 1.0                  # nucleus sampling mass cutoff (1.0 off)
     seed: int = 1
     data_dir: str = "files"
     download_data: bool = False

@@ -129,6 +129,56 @@ def test_generate_shapes_and_determinism():
     assert not np.array_equal(np.asarray(c), np.asarray(d))
 
 
+def test_filter_logits_top_k_and_top_p():
+    """Known 5-token distribution: the k/nucleus masks keep exactly the documented
+    sets (exclusive-mass rule: a token is kept while the mass BEFORE it is < top_p,
+    so the argmax always survives)."""
+    lp = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.07, 0.03]]))
+
+    def kept(out):
+        return list(np.asarray(out[0] == lp[0]))
+
+    assert kept(lm.filter_logits(lp, top_k=2)) == [True, True, False, False, False]
+    # Exclusive cumsum is [0, .5, .75, .9, .97]: top_p=0.7 keeps {0,1}; 0.76 → {0,1,2}.
+    assert kept(lm.filter_logits(lp, top_p=0.7)) == [True, True, False, False, False]
+    assert kept(lm.filter_logits(lp, top_p=0.76)) == [True, True, True, False, False]
+    # Composition: the intersection of both masks.
+    assert kept(lm.filter_logits(lp, top_k=4, top_p=0.7)) == \
+        [True, True, False, False, False]
+    # Disabled filters pass logits through untouched.
+    np.testing.assert_array_equal(np.asarray(lm.filter_logits(lp)), np.asarray(lp))
+    # Order invariance: filtering an unsorted layout masks the same tokens.
+    perm = jnp.asarray([3, 0, 4, 1, 2])
+    out = lm.filter_logits(lp[:, perm], top_k=2)
+    assert list(np.asarray(out[0] == lp[0, perm])) == \
+        [False, True, False, True, False]
+
+
+def test_generate_top_k_and_top_p():
+    model = _model()
+    params = _params(model, seed=2)
+    key = jax.random.PRNGKey(7)
+    greedy = jax.jit(lambda k: lm.generate(model, params, k, batch=3,
+                                           temperature=0.0))(key)
+    # top_k=1 and a vanishing nucleus both degenerate to greedy decoding.
+    for kw in (dict(top_k=1), dict(top_p=1e-6)):
+        out = jax.jit(lambda k: lm.generate(model, params, k, batch=3,
+                                            temperature=1.0, **kw))(key)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(greedy))
+    # Fully-open filters are a no-op: same draws as unfiltered sampling at the key.
+    plain = jax.jit(lambda k: lm.generate(model, params, k, batch=3,
+                                          temperature=1.0))(key)
+    open_f = jax.jit(lambda k: lm.generate(model, params, k, batch=3,
+                                           temperature=1.0,
+                                           top_k=model.vocab_size,
+                                           top_p=1.0))(key)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(open_f))
+    with pytest.raises(ValueError):
+        lm.generate(model, params, key, top_k=model.vocab_size + 1)
+    with pytest.raises(ValueError):
+        lm.generate(model, params, key, top_p=0.0)
+
+
 def test_lm_trainer_end_to_end(tmp_path):
     """The LM trainer CLI surface: loss falls, per-epoch checkpoint written, resume
     continues from the checkpoint, and generation writes the sample grid."""
