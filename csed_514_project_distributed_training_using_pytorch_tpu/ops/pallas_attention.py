@@ -107,18 +107,42 @@ def _block_live(iq, j, bq, bk, *, causal: bool, window: int = 0):
     return live
 
 
+def _band_reach(window: int, block: int) -> int:
+    """Max |query block − key block| with any in-window pair: the banded grid walks
+    key-block offsets ``[-reach, +reach]`` (``[-reach, 0]`` causal) instead of all
+    ``S/block`` key blocks, making grid overhead O(S·W/B²) rather than O((S/B)²) —
+    at S=128k, W=4k, B=128 that is 33 steps per query block instead of 1024."""
+    return (window + block - 2) // block
+
+
+def _banded(window: int, causal: bool, nq: int, block: int) -> bool:
+    """Use the band-compressed grid when it is actually narrower than the full walk."""
+    if not window:
+        return False
+    reach = _band_reach(window, block)
+    return (reach + 1 if causal else 2 * reach + 1) < nq
+
+
 # =========================================================================================
 # Forward
 # =========================================================================================
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, num_k, window=0):
+                acc_ref, m_ref, l_ref, *, scale, causal, num_steps, num_blocks,
+                band_base=None, window=0):
     iq = pl.program_id(1)
-    j = pl.program_id(2)
+    step = pl.program_id(2)
     bq = q_ref.shape[1]
+    # Band-compressed grid: the step axis walks key-block OFFSETS around the query
+    # block; out-of-range offsets (clamped to a real block by the index_map) are dead.
+    if band_base is None:
+        j, in_range = step, jnp.bool_(True)
+    else:
+        j = iq + step - band_base
+        in_range = (j >= 0) & (j < num_blocks)
 
-    @pl.when(j == 0)
+    @pl.when(step == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
         m_ref[:] = jnp.full_like(m_ref, NEG)
@@ -126,7 +150,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     # Causal/banded: key blocks with no visible pair contribute nothing — no FLOPs
     # (their fetch still pipelines; grids cannot skip steps).
-    @pl.when(_block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window))
+    @pl.when(in_range
+             & _block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window))
     def _():
         q = q_ref[0].astype(jnp.float32) * scale                           # [bq, D]
         k_blk = k_ref[0].astype(jnp.float32)                               # [bk, D]
@@ -150,7 +175,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[:] = m_new
         l_ref[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
 
-    @pl.when(j == num_k - 1)
+    @pl.when(step == num_steps - 1)
     def _():
         l_safe = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
@@ -165,18 +190,24 @@ def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
     _check_block(s, block)
     scale = 1.0 / (d ** 0.5)
     nq = s // block
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, num_k=nq,
+    if _banded(window, causal, nq, block):
+        base = _band_reach(window, block)
+        num_steps = base + 1 if causal else 2 * base + 1
+        key_map = lambda b, i, o: (b, jnp.clip(i + o - base, 0, nq - 1), 0)
+    else:
+        base, num_steps = None, nq
+        key_map = lambda b, i, j: (b, j, 0)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               num_steps=num_steps, num_blocks=nq, band_base=base,
                                window=window)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, nq, nq),
+        grid=(bh, nq, num_steps),
         in_specs=[
             pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, d), key_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block, d), key_map, memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0),
@@ -206,16 +237,23 @@ def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc_ref, *, scale, causal, num_k, window=0):
+               dq_acc_ref, *, scale, causal, num_steps, num_blocks,
+               band_base=None, window=0):
     iq = pl.program_id(1)
-    j = pl.program_id(2)
+    step = pl.program_id(2)
     bq = q_ref.shape[1]
+    if band_base is None:
+        j, in_range = step, jnp.bool_(True)
+    else:
+        j = iq + step - band_base
+        in_range = (j >= 0) & (j < num_blocks)
 
-    @pl.when(j == 0)
+    @pl.when(step == 0)
     def _():
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
-    @pl.when(_block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window))
+    @pl.when(in_range
+             & _block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window))
     def _():
         q = q_ref[0].astype(jnp.float32)                          # [bq, D]
         do = do_ref[0].astype(jnp.float32)                        # [bq, D]
@@ -238,24 +276,34 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc_ref[:] = dq_acc_ref[:] + jnp.dot(
             ds, k_blk, preferred_element_type=jnp.float32)
 
-    @pl.when(j == num_k - 1)
+    @pl.when(step == num_steps - 1)
     def _():
         dq_ref[0] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_acc_ref, dv_acc_ref, *, scale, causal, num_q, window=0):
+                dk_acc_ref, dv_acc_ref, *, scale, causal, num_steps, num_blocks,
+                band_base=None, window=0):
     ik = pl.program_id(1)
-    i = pl.program_id(2)
+    step = pl.program_id(2)
     bk = k_ref.shape[1]
+    # Banded: the step axis walks QUERY-block offsets around this key block
+    # (causal keys are only visible to queries at or after them, so offsets start
+    # at the diagonal: band_base == 0).
+    if band_base is None:
+        i, in_range = step, jnp.bool_(True)
+    else:
+        i = ik + step - band_base
+        in_range = (i >= 0) & (i < num_blocks)
 
-    @pl.when(i == 0)
+    @pl.when(step == 0)
     def _():
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
     # Causal/banded: query blocks with no visible pair against this key block skip.
-    @pl.when(_block_live(i, ik, q_ref.shape[1], bk, causal=causal, window=window))
+    @pl.when(in_range
+             & _block_live(i, ik, q_ref.shape[1], bk, causal=causal, window=window))
     def _():
         k = k_ref[0].astype(jnp.float32)                          # [bk, D]
         v = v_ref[0].astype(jnp.float32)                          # [bk, D]
@@ -283,7 +331,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(i == num_q - 1)
+    @pl.when(step == num_steps - 1)
     def _():
         dk_ref[0] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
@@ -323,25 +371,42 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
     _check_block(s, block)
     scale = 1.0 / (d ** 0.5)
     nq = s // block
+    if _banded(window, causal, nq, block):
+        reach = _band_reach(window, block)
+        # dq walks key blocks around the query block (causal: only the past side);
+        # dkv walks query blocks around the key block (causal: only the future side).
+        dq_base, dq_steps = reach, (reach + 1 if causal else 2 * reach + 1)
+        kv_base = 0 if causal else reach
+        kv_steps = reach + 1 if causal else 2 * reach + 1
+    else:
+        dq_base = kv_base = None
+        dq_steps = kv_steps = nq
 
     def row_i(b, i, j):
         return (b, i, 0)
 
-    def row_j(b, i, j):
-        return (b, j, 0)
+    def _banded_map(base):
+        if base is None:
+            return lambda b, i, j: (b, j, 0)
+        return lambda b, i, o: (b, jnp.clip(i + o - base, 0, nq - 1), 0)
+
+    def _banded_lse_map(base):
+        if base is None:
+            return lambda b, i, j: (b, j, 0, 0)
+        return lambda b, i, o: (b, jnp.clip(i + o - base, 0, nq - 1), 0, 0)
 
     row_i_spec = pl.BlockSpec((1, block, d), row_i, memory_space=pltpu.VMEM)
-    row_j_spec = pl.BlockSpec((1, block, d), row_j, memory_space=pltpu.VMEM)
     lse_i_spec = pl.BlockSpec((1, 1, 1, block), lambda b, i, j: (b, i, 0, 0),
                               memory_space=pltpu.VMEM)
-    lse_j_spec = pl.BlockSpec((1, 1, 1, block), lambda b, i, j: (b, j, 0, 0),
-                              memory_space=pltpu.VMEM)
 
+    dq_walk = pl.BlockSpec((1, block, d), _banded_map(dq_base),
+                           memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, num_k=nq,
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          num_steps=dq_steps, num_blocks=nq, band_base=dq_base,
                           window=window),
-        grid=(bh, nq, nq),
-        in_specs=[row_i_spec, row_j_spec, row_j_spec, row_i_spec, lse_i_spec,
+        grid=(bh, nq, dq_steps),
+        in_specs=[row_i_spec, dq_walk, dq_walk, row_i_spec, lse_i_spec,
                   lse_i_spec],
         out_specs=[row_i_spec],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q3.dtype)],
@@ -350,12 +415,17 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
     )(q3, k3, v3, g, lse, delta)[0]
 
     # dkv grid: axis 1 = key block (accumulators persist), axis 2 = query block.
+    kv_walk = pl.BlockSpec((1, block, d), _banded_map(kv_base),
+                           memory_space=pltpu.VMEM)
+    kv_lse_walk = pl.BlockSpec((1, 1, 1, block), _banded_lse_map(kv_base),
+                               memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, num_q=nq,
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          num_steps=kv_steps, num_blocks=nq, band_base=kv_base,
                           window=window),
-        grid=(bh, nq, nq),
-        in_specs=[row_j_spec, row_i_spec, row_i_spec, row_j_spec, lse_j_spec,
-                  lse_j_spec],
+        grid=(bh, nq, kv_steps),
+        in_specs=[kv_walk, row_i_spec, row_i_spec, kv_walk, kv_lse_walk,
+                  kv_lse_walk],
         out_specs=[row_i_spec, row_i_spec],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
                    jax.ShapeDtypeStruct((bh, s, d), v3.dtype)],
@@ -416,8 +486,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     ``window=W`` is sliding-window/local attention with ``full_attention``'s exact
     semantics (distance < W; causal restricts to the past side) — and a BANDED grid:
-    key blocks entirely outside the window are skipped via ``@pl.when`` in forward
-    and both backward kernels, so compute is O(S·W·D) instead of O(S²·D).
+    the step axis walks only key-block offsets within the band (``_band_reach``), so
+    both compute AND grid/pipeline overhead are O(S·W) rather than O(S²) — the r2
+    full-grid + ``@pl.when``-skip formulation still paid (S/B)² grid steps, which
+    dominated at S ≥ 64k. Out-of-band blocks cost nothing: they are never stepped.
     """
     b, s, h, d = q.shape
     _check_block(s, block)
