@@ -71,7 +71,9 @@ def state_shardings(mesh: Mesh, state: TrainState, *,
                                      min_leaf_size=min_leaf_size)
     vel_sh = jax.tree_util.tree_map(to_sh, vel_specs)
     return TrainState(params=param_sh, velocity=vel_sh,
-                      step=NamedSharding(mesh, P()))
+                      step=NamedSharding(mesh, P()),
+                      # The EMA tree mirrors params exactly — same shards.
+                      ema=param_sh if state.ema is not None else None)
 
 
 def shard_train_state(mesh: Mesh, state: TrainState, *,

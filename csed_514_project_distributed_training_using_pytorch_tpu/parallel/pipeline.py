@@ -274,4 +274,5 @@ def stacked_state_shardings(mesh: Mesh, state, *, axis_name: str = "stage"):
     return _step.TrainState(
         params=tree_sh(state.params),
         velocity=map_param_trees(state.velocity, tree_sh, scalar_fn=lambda _: rep),
-        step=rep)
+        step=rep,
+        ema=tree_sh(state.ema) if state.ema is not None else None)

@@ -108,7 +108,9 @@ def state_shardings(mesh: Mesh, state: TrainState, *,
     vel_sh = jax.tree_util.tree_map(to_sharding, vel_specs,
                                     is_leaf=lambda x: isinstance(x, P))
     return TrainState(params=param_sh, velocity=vel_sh,
-                      step=NamedSharding(mesh, P()))
+                      step=NamedSharding(mesh, P()),
+                      # The EMA tree mirrors params exactly — same shards.
+                      ema=param_sh if state.ema is not None else None)
 
 
 def shard_train_state(mesh: Mesh, state: TrainState, *,

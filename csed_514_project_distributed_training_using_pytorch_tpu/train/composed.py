@@ -149,6 +149,11 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             raise ValueError(
                 "--flash-attention/--zigzag-attention do not compose with a stage "
                 "axis (their shard_map cannot nest inside the pipeline's)")
+        if config.sharded_checkpoint:
+            raise ValueError(
+                "--sharded-checkpoint saves the device state's own layout, and the "
+                "stage axis trains in the stacked layout — its shard keys would not "
+                "interchange; use the default full-state checkpoint with stages")
         # The engine sees batch_size // grad_accum per call (the accumulation path
         # feeds microbatches), so the pipeline divisibility guards must use that.
         step_batch = config.batch_size // config.grad_accum
@@ -269,7 +274,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                                      momentum=config.momentum,
                                      weight_decay=config.weight_decay)
     base_state = create_train_state(model, jax.random.PRNGKey(config.seed),
-                                    optimizer=optimizer)
+                                    optimizer=optimizer,
+                                    ema=config.ema_decay > 0)
     lr_schedule = optim.make_lr_schedule(config.lr_schedule,
                                          warmup_steps=config.warmup_steps,
                                          total_steps=config.epochs * steps_per_epoch)
@@ -307,7 +313,9 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         stacked_state = TrainState(to_stacked(base_state.params),
                                    optim.map_param_trees(base_state.velocity,
                                                          to_stacked),
-                                   base_state.step)
+                                   base_state.step,
+                                   to_stacked(base_state.ema)
+                                   if base_state.ema is not None else None)
         state_sh = pipeline.stacked_state_shardings(mesh, stacked_state)
         state = jax.device_put(stacked_state, state_sh)
         idx_sh = (jax.sharding.NamedSharding(mesh, P(None, "data"))
@@ -317,7 +325,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                           momentum=config.momentum,
                           grad_accum=config.grad_accum, optimizer=optimizer,
                           lr_schedule=lr_schedule,
-                          clip_grad_norm=config.clip_grad_norm),
+                          clip_grad_norm=config.clip_grad_norm,
+                          ema_decay=config.ema_decay),
             in_shardings=(state_sh, rep, rep, idx_sh, rep),
             out_shardings=(state_sh, rep), donate_argnums=(0,))
         param_shardings = state_sh.params
@@ -333,7 +342,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                           momentum=config.momentum,
                           grad_accum=config.grad_accum, optimizer=optimizer,
                           lr_schedule=lr_schedule,
-                          clip_grad_norm=config.clip_grad_norm),
+                          clip_grad_norm=config.clip_grad_norm,
+                          ema_decay=config.ema_decay),
             mesh, data_axis="data" if data_size > 1 else None)
         param_shardings = tp.state_shardings(mesh, state).params
         eval_model = model
@@ -352,6 +362,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     test_x = dp.put_global(mesh, test_ds.images, P())
     test_y = dp.put_global(mesh, test_ds.labels, P())
     history = M.MetricsHistory()
+    saver = (checkpoint.AsyncCheckpointer() if config.async_checkpoint
+             else checkpoint)
     plan_spec = P(None, "data") if data_size > 1 else P()
     # One dropout key for the whole run, hoisted out of the loop (each step folds it
     # with state.step inside the compiled program — same per-step keys as before).
@@ -370,7 +382,9 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             host_state = TrainState(
                 unstack(host_state.params),
                 optim.map_param_trees(host_state.velocity, unstack),
-                host_state.step)
+                host_state.step,
+                unstack(host_state.ema)
+                if host_state.ema is not None else None)
         return host_state
 
     ckpt_path = (os.path.join(config.results_dir, "model_composed.ckpt")
@@ -394,7 +408,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             state, losses = epoch_fn(state, train_x, train_y, plan, dropout_rng)
             jax.block_until_ready(state.params)
             epoch_loss = float(np.asarray(jax.device_get(losses)).mean())
-            sum_nll, correct = jax.device_get(eval_fn(state.params, test_x, test_y))
+            eval_params = state.ema if state.ema is not None else state.params
+            sum_nll, correct = jax.device_get(eval_fn(eval_params, test_x, test_y))
             examples_trained = (epoch + 1) * steps_per_epoch * config.batch_size
             history.record_train(examples_trained, epoch_loss)
             history.record_test(examples_trained, float(sum_nll) / n_test)
@@ -407,18 +422,26 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             # final epoch's host copy doubles as the return value — no second
             # gather/save after the loop.
             if ckpt_path:
+                if config.sharded_checkpoint:
+                    # Distributed writer: every process saves only the shards it
+                    # addresses, straight from device — no all-gather, no host copy
+                    # of the full state on any single process.
+                    checkpoint.save_train_state_sharded(ckpt_path + ".sharded",
+                                                        state)
                 host_state = to_host_standard(state)
-                checkpoint.save_train_state(ckpt_path, host_state)
+                saver.save_train_state(ckpt_path, host_state)
 
     if host_state is None:      # no results_dir, or the resume skipped every epoch
         host_state = to_host_standard(state)
         if ckpt_path:           # zero-epoch resume must still leave a checkpoint
-            checkpoint.save_train_state(ckpt_path, host_state)
+            saver.save_train_state(ckpt_path, host_state)
     if ckpt_path:
         M.log(f"Saved {ckpt_path}")
     if config.results_dir:
         M.save_metrics_jsonl(history,
                              os.path.join(config.results_dir, "metrics.jsonl"))
+    if config.async_checkpoint:
+        saver.flush()
     return host_state, history
 
 

@@ -149,7 +149,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                                      learning_rate=config.learning_rate,
                                      momentum=config.momentum,
                                      weight_decay=config.weight_decay)
-    state = create_train_state(model, init_rng, optimizer=optimizer)
+    state = create_train_state(model, init_rng, optimizer=optimizer,
+                               ema=config.ema_decay > 0)
     steps_per_epoch = samplers[0].num_samples // per_replica_batch
     lr_schedule = optim.make_lr_schedule(config.lr_schedule,
                                          warmup_steps=config.warmup_steps,
@@ -180,7 +181,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                       unroll=config.scan_unroll, pregather=config.pregather,
                       grad_accum=config.grad_accum, optimizer=optimizer,
                       lr_schedule=lr_schedule,
-                      clip_grad_norm=config.clip_grad_norm), mesh)
+                      clip_grad_norm=config.clip_grad_norm,
+                      ema_decay=config.ema_decay), mesh)
     eval_fn = dp.compile_eval(
         make_eval_fn(model, batch_size=config.batch_size_test), mesh,
         shard=config.shard_eval)
@@ -194,7 +196,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                             momentum=config.momentum,
                             grad_accum=config.grad_accum,
                             optimizer=optimizer, lr_schedule=lr_schedule,
-                            clip_grad_norm=config.clip_grad_norm), mesh)
+                            clip_grad_norm=config.clip_grad_norm,
+                            ema_decay=config.ema_decay), mesh)
         col_lo, col_hi = _host_local_columns(mesh, per_replica_batch)
         M.log(f"Host-local feed: this process feeds global-batch columns "
               f"[{col_lo}:{col_hi}]")
@@ -222,6 +225,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         return state, jax.numpy.stack(losses)
 
     history = M.MetricsHistory()
+    saver = (checkpoint.AsyncCheckpointer() if config.async_checkpoint
+             else checkpoint)
 
     with maybe_profile(config.profile and M.is_logging_process(), config.profile_dir):
         for epoch in range(start_epoch, config.epochs):   # ≙ the epoch loop, :70
@@ -238,8 +243,9 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                 history.record_train(epoch * plan.size +
                                      i * config.log_interval * plan.shape[1], float(l))
 
+            eval_params = state.ema if state.ema is not None else state.params
             sum_nll, correct = jax.device_get(
-                eval_fn(state.params, test_x, test_y))   # ≙ eval loop, :92-109
+                eval_fn(eval_params, test_x, test_y))   # ≙ eval loop, :92-109
             val_loss = float(sum_nll) / n_test
             accuracy = float(correct) / n_test
             history.record_test(examples, val_loss)
@@ -247,15 +253,20 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                                             watch.elapsed()))  # ≙ :113-114
             # Per-epoch full-state checkpoint (process-0 gated, atomic) so a killed run
             # can resume with --resume-from; the reference only ever saves final params.
-            checkpoint.save_train_state(ckpt_path, state)
+            saver.save_train_state(ckpt_path, state)
 
     assert_replicas_synced(state.params)          # the desync "race detector" (SURVEY.md §5)
 
     plotting.save_loss_curves(
         history, os.path.join(config.images_dir, "train_test_curve_dist.png"))  # ≙ :161
     M.save_metrics_jsonl(history, os.path.join(config.results_dir, "metrics.jsonl"))
+    # The export must be the weights the reported metrics came from: the EMA tree
+    # when --ema-decay is set (eval consumes it above), the raw params otherwise.
     checkpoint.save_params(
-        os.path.join(config.results_dir, "model_dist.msgpack"), state.params)   # ≙ :163-164
+        os.path.join(config.results_dir, "model_dist.msgpack"),
+        state.ema if state.ema is not None else state.params)   # ≙ :163-164
+    if config.async_checkpoint:
+        saver.flush()
     return state, history
 
 

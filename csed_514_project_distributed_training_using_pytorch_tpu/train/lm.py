@@ -165,7 +165,7 @@ def main(config: LMConfig = LMConfig(), *,
                                      weight_decay=config.weight_decay)
     state = create_train_state(model, jax.random.PRNGKey(config.seed),
                                sample_input_shape=(1, seq_len),
-                               optimizer=optimizer)
+                               optimizer=optimizer, ema=config.ema_decay > 0)
     steps_per_epoch = n_train // config.batch_size
     if steps_per_epoch == 0:
         raise ValueError(f"batch {config.batch_size} larger than the train split "
@@ -195,7 +195,8 @@ def main(config: LMConfig = LMConfig(), *,
     step_fn = make_train_step(model, learning_rate=config.learning_rate,
                               momentum=config.momentum, grad_accum=config.grad_accum,
                               optimizer=optimizer, lr_schedule=lr_schedule,
-                              clip_grad_norm=config.clip_grad_norm, loss_fn=lm_loss)
+                              clip_grad_norm=config.clip_grad_norm,
+                              ema_decay=config.ema_decay, loss_fn=lm_loss)
     epoch_fn = dp.compile_epoch(make_epoch_from_step(step_fn), mesh)
     eval_fn = jax.jit(make_eval_nll_fn(model, batch_size=config.eval_batch))
 
@@ -206,6 +207,8 @@ def main(config: LMConfig = LMConfig(), *,
     test_d = dp.put_global(mesh, test_tokens, P())
     dropout_rng = jax.random.PRNGKey(config.seed + 1)
     history = M.MetricsHistory()
+    saver = (checkpoint.AsyncCheckpointer() if config.async_checkpoint
+             else checkpoint)
 
     ckpt_path = (os.path.join(config.results_dir, "model_lm.ckpt")
                  if config.results_dir else "")
@@ -224,7 +227,8 @@ def main(config: LMConfig = LMConfig(), *,
         state, losses = epoch_fn(state, tokens_d, zeros_d, plan, dropout_rng)
         jax.block_until_ready(state.params)
         train_loss = float(np.asarray(jax.device_get(losses)).mean())
-        sum_nll = float(jax.device_get(eval_fn(state.params, test_d)))
+        eval_params = state.ema if state.ema is not None else state.params
+        sum_nll = float(jax.device_get(eval_fn(eval_params, test_d)))
         val_nll = sum_nll / (n_test * seq_len)
         examples = (epoch + 1) * steps_per_epoch * config.batch_size
         history.record_train(examples, train_loss)
@@ -233,15 +237,17 @@ def main(config: LMConfig = LMConfig(), *,
               f"val_nll/token: {val_nll:.4f}, val_ppl: {float(np.exp(val_nll)):.3f}, "
               f"time_elapsed: {watch.elapsed():.2f}s")
         if ckpt_path:
-            checkpoint.save_train_state(ckpt_path, jax.device_get(state))
+            saver.save_train_state(ckpt_path, jax.device_get(state))
 
     host_state = jax.device_get(state)
     if ckpt_path:
         M.log(f"Saved {ckpt_path}")
     if config.generate > 0:
         def sample_grid(filename: str, seed_offset: int, batch: int, **gen_kw):
+            gen_params = (host_state.ema if host_state.ema is not None
+                          else host_state.params)
             ids = jax.jit(lambda key: lm_mod.generate(
-                model, host_state.params, key, batch=batch,
+                model, gen_params, key, batch=batch,
                 temperature=config.temperature, top_k=config.top_k,
                 top_p=config.top_p, **gen_kw))(
                     jax.random.PRNGKey(config.seed + seed_offset))
@@ -264,6 +270,8 @@ def main(config: LMConfig = LMConfig(), *,
     if config.results_dir:
         M.save_metrics_jsonl(history,
                              os.path.join(config.results_dir, "metrics.jsonl"))
+    if config.async_checkpoint:
+        saver.flush()
     return host_state, history
 
 

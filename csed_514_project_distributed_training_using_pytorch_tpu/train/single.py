@@ -113,7 +113,11 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                                       or config.experimental_fused_step):
         raise ValueError("--use-pallas-kernels/--experimental-fused-step fuse the "
                          "SGD-momentum update — they require --optimizer sgd")
-    state = create_train_state(model, init_rng, optimizer=optimizer)
+    if config.ema_decay and config.experimental_fused_step:
+        raise ValueError("--experimental-fused-step runs the whole update in one "
+                         "kernel — --ema-decay is not applied there; drop one")
+    state = create_train_state(model, init_rng, optimizer=optimizer,
+                               ema=config.ema_decay > 0)
     resume_from = resume_from or config.resume_from or None
     if resume_from:                             # the restore path the reference lacks
         state = checkpoint.restore_train_state(resume_from, state)
@@ -169,7 +173,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                           unroll=config.scan_unroll, pregather=config.pregather,
                           grad_accum=config.grad_accum, optimizer=optimizer,
                           lr_schedule=lr_schedule,
-                          clip_grad_norm=config.clip_grad_norm),
+                          clip_grad_norm=config.clip_grad_norm,
+                          ema_decay=config.ema_decay),
             donate_argnums=(0,))
         step_fn = jax.jit(
             make_train_step(model, learning_rate=config.learning_rate,
@@ -177,7 +182,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                             use_pallas=config.use_pallas_kernels,
                             grad_accum=config.grad_accum, optimizer=optimizer,
                             lr_schedule=lr_schedule,
-                            clip_grad_norm=config.clip_grad_norm),
+                            clip_grad_norm=config.clip_grad_norm,
+                            ema_decay=config.ema_decay),
             donate_argnums=(0,))
     # The final partial batch (drop_last=False) is ragged and need not divide by
     # grad_accum; accumulation is a memory knob, so the tail just steps unaccumulated.
@@ -189,16 +195,22 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                             momentum=config.momentum,
                             use_pallas=config.use_pallas_kernels,
                             optimizer=optimizer, lr_schedule=lr_schedule,
-                            clip_grad_norm=config.clip_grad_norm),
+                            clip_grad_norm=config.clip_grad_norm,
+                            ema_decay=config.ema_decay),
             donate_argnums=(0,))
     eval_fn = jax.jit(make_eval_fn(model, batch_size=config.batch_size_test))
 
     history = M.MetricsHistory()
     n_train, n_test = len(train_ds), len(test_ds)
     ckpt_path = os.path.join(config.results_dir, "model.ckpt")
+    # Module-level checkpoint API and the async writer share the call signature.
+    saver = (checkpoint.AsyncCheckpointer() if config.async_checkpoint
+             else checkpoint)
 
     def evaluate(state: TrainState, examples_seen: int) -> None:
-        sum_nll, correct = jax.device_get(eval_fn(state.params, test_x, test_y))
+        # EMA-enabled runs evaluate the averaged weights (the reason to keep an EMA).
+        eval_params = state.ema if state.ema is not None else state.params
+        sum_nll, correct = jax.device_get(eval_fn(eval_params, test_x, test_y))
         avg = float(sum_nll) / n_test           # ≙ sum-then-divide, src/train.py:94-97
         history.record_test(examples_seen, avg)
         M.log(M.test_summary_line(avg, int(correct), n_test, watch.elapsed()))
@@ -228,7 +240,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                                         n_train, last_loss))
             history.record_train(examples_seen, last_loss)
             # every-log-tick overwrite checkpoint (≙ reference src/train.py:84-85)
-            checkpoint.save_train_state(ckpt_path, state)
+            saver.save_train_state(ckpt_path, state)
 
         # final partial batch (drop_last=False, ≙ torch DataLoader default)
         tail = indices[full_steps * config.batch_size_train:]
@@ -251,7 +263,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                 M.log(M.train_progress_line(epoch, b * config.batch_size_train,
                                             n_train, float(loss)))
                 history.record_train(examples_seen, float(loss))
-                checkpoint.save_train_state(ckpt_path, state)
+                saver.save_train_state(ckpt_path, state)
         tail = train_loader.sampler.epoch_indices(epoch)[
             full_steps * config.batch_size_train:]
         if len(tail):
@@ -272,7 +284,9 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     plotting.save_loss_curves(history,
                               os.path.join(config.images_dir, "train_test_curve.png"))
     M.save_metrics_jsonl(history, os.path.join(config.results_dir, "metrics.jsonl"))
-    checkpoint.save_train_state(ckpt_path, state)
+    saver.save_train_state(ckpt_path, state)
+    if config.async_checkpoint:
+        saver.flush()
     return state, history
 
 

@@ -43,25 +43,37 @@ class TrainState(NamedTuple):
 
     ``velocity`` is the optimizer state: the SGD velocity tree historically (and for
     ``--optimizer sgd`` today), or the AdamW moment state — see the state-shape
-    contract in ``ops/optim.py``. The field name stays for checkpoint compatibility."""
+    contract in ``ops/optim.py``. The field name stays for checkpoint compatibility.
+
+    ``ema`` is the optional params-shaped exponential-moving-average tree
+    (``--ema-decay``); ``None`` (the default, and the reference-parity surface) keeps
+    the pytree free of it. It shards exactly like ``params`` under every layout, and
+    ``utils.checkpoint.restore_train_state`` reconciles checkpoints written on either
+    side of the flag."""
 
     params: dict
     velocity: dict
     step: jax.Array  # int32 scalar
+    ema: dict | None = None
 
 
 def create_train_state(model, rng: jax.Array,
                        sample_input_shape=(1, 28, 28, 1), *,
-                       optimizer: Optimizer | None = None) -> TrainState:
+                       optimizer: Optimizer | None = None,
+                       ema: bool = False) -> TrainState:
     """Initialize params (PyTorch-default distributions, see ``ops/initializers.py``) and
     zero optimizer state (SGD velocity by default). Under SPMD every process derives
     identical state from the same seed — the replica-consistency analog of DDP's initial
-    parameter broadcast (reference ``src/train_dist.py:63``)."""
+    parameter broadcast (reference ``src/train_dist.py:63``).
+
+    ``ema=True`` seeds the EMA tree as a copy of the initial params (torch
+    ``swa_utils.AveragedModel``'s construction-time copy)."""
     variables = model.init({"params": rng}, jnp.zeros(sample_input_shape))
     params = variables["params"]
     opt_init = optimizer.init if optimizer is not None else sgd_init
     return TrainState(params=params, velocity=opt_init(params),
-                      step=jnp.zeros((), jnp.int32))
+                      step=jnp.zeros((), jnp.int32),
+                      ema=jax.tree_util.tree_map(jnp.array, params) if ema else None)
 
 
 def make_train_step(model, *, learning_rate: float, momentum: float,
@@ -70,6 +82,7 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
                     optimizer: Optimizer | None = None,
                     lr_schedule: Callable | None = None,
                     clip_grad_norm: float = 0.0,
+                    ema_decay: float = 0.0,
                     loss_fn: Callable | None = None) -> Callable:
     """Build ``step(state, images, labels, rng) -> (state, loss)``.
 
@@ -107,6 +120,13 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     norm before the update, with torch ``clip_grad_norm_`` semantics
     (``optim.clip_by_global_norm``); 0 disables. Under SPMD the clip sees the
     all-reduced global gradient, so every replica scales identically.
+
+    ``ema_decay > 0`` maintains ``state.ema`` — an exponential moving average of the
+    params updated INSIDE the compiled step after each optimizer update, with torch
+    ``swa_utils.AveragedModel(avg_fn=get_ema_multi_avg_fn(decay))`` semantics (pinned
+    against real torch in ``tests/test_optim.py``): the first update copies the fresh
+    params, later updates apply ``ema ← decay·ema + (1−decay)·params``. The state must
+    come from ``create_train_state(..., ema=True)``.
 
     ``loss_fn(params, xs, ys, rng) -> scalar`` overrides the classification objective
     entirely (e.g. the LM's next-token loss, ``train/lm.py``) while keeping every
@@ -160,7 +180,19 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
             scale = lr_schedule(state.step) if lr_schedule is not None else 1.0
             params, velocity = optimizer.update(state.params, state.velocity, grads,
                                                 lr_scale=scale)
-        return TrainState(params, velocity, state.step + 1), loss
+        ema = state.ema
+        if ema_decay > 0.0:
+            if ema is None:
+                raise ValueError("ema_decay needs create_train_state(..., ema=True)")
+            # torch AveragedModel.update_parameters: the first call (n_averaged == 0)
+            # copies the params; later calls apply the EMA rule. state.step is the
+            # pre-increment counter, so it doubles as n_averaged.
+            first = state.step == 0
+            ema = jax.tree_util.tree_map(
+                lambda e, p: jnp.where(first, p,
+                                       ema_decay * e + (1.0 - ema_decay) * p),
+                ema, params)
+        return TrainState(params, velocity, state.step + 1, ema), loss
 
     def step(state: TrainState, images, labels, rng) -> tuple[TrainState, jax.Array]:
         step_rng = jax.random.fold_in(rng, state.step)
@@ -202,7 +234,8 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
                   pregather: bool = False, grad_accum: int = 1,
                   optimizer: Optimizer | None = None,
                   lr_schedule: Callable | None = None,
-                  clip_grad_norm: float = 0.0) -> Callable:
+                  clip_grad_norm: float = 0.0,
+                  ema_decay: float = 0.0) -> Callable:
     """Build ``epoch(state, images, labels, idx_matrix, rng) -> (state, losses)``.
 
     ``images``/``labels`` are the full (device-resident) training split; ``idx_matrix`` is a
@@ -224,7 +257,7 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
     train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum,
                                  use_pallas=use_pallas, grad_accum=grad_accum,
                                  optimizer=optimizer, lr_schedule=lr_schedule,
-                                 clip_grad_norm=clip_grad_norm)
+                                 clip_grad_norm=clip_grad_norm, ema_decay=ema_decay)
     return make_epoch_from_step(train_step, unroll=unroll, pregather=pregather)
 
 

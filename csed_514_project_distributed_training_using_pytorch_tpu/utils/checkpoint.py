@@ -14,6 +14,8 @@ resume.
 from __future__ import annotations
 
 import os
+import queue
+import threading
 
 import jax
 from flax import serialization
@@ -40,9 +42,22 @@ def save_train_state(path: str, state: TrainState) -> None:
 
 def restore_train_state(path: str, reference_state: TrainState) -> TrainState:
     """The resume path the reference is missing. ``reference_state`` supplies the pytree
-    structure/shapes (e.g. a freshly-initialized state)."""
+    structure/shapes (e.g. a freshly-initialized state).
+
+    The optional ``ema`` field reconciles across the ``--ema-decay`` flag: a
+    checkpoint written without EMA restores into an EMA-enabled reference by seeding
+    the EMA tree from the checkpoint's params (exactly what the first
+    ``AveragedModel`` update would do); a checkpoint carrying EMA restores into a
+    plain reference by dropping the tree."""
     with open(path, "rb") as f:
-        restored = serialization.from_bytes(reference_state._asdict(), f.read())
+        raw = serialization.msgpack_restore(f.read())
+    ref = reference_state._asdict()
+    if ref.get("ema") is not None and raw.get("ema") is None:
+        raw["ema"] = raw["params"]
+    elif ref.get("ema") is None:
+        raw.pop("ema", None)
+    raw.setdefault("ema", None)
+    restored = serialization.from_state_dict(ref, raw)
     return TrainState(**restored)
 
 
@@ -57,8 +72,15 @@ def restore_for_resume(path: str, reference_state: TrainState, *,
     Returns ``(state, start_epoch, warning)`` where ``warning`` is a log-worthy
     message when the checkpoint's step count is not a whole number of THIS config's
     epochs — the tell-tale of a mid-epoch checkpoint or a checkpoint written under a
-    different batch size (the step counter is the only progress metadata stored)."""
+    different batch size (the step counter is the only progress metadata stored).
+
+    ``path`` may also be a ``save_train_state_sharded`` DIRECTORY: every process
+    then re-assembles it from the shard files directly (deterministic, shared-FS
+    contract) — no process-0 gating and no broadcast needed."""
     state = reference_state
+    if os.path.isdir(path):
+        return _derive_resume_epoch(
+            restore_train_state_sharded(path, reference_state), steps_per_epoch)
     if process_index == 0:
         state = restore_train_state(path, state)
     if process_count > 1:
@@ -66,6 +88,10 @@ def restore_for_resume(path: str, reference_state: TrainState, *,
         from jax.experimental import multihost_utils
         state = jax.tree_util.tree_map(
             np.asarray, multihost_utils.broadcast_one_to_all(state))
+    return _derive_resume_epoch(state, steps_per_epoch)
+
+
+def _derive_resume_epoch(state: TrainState, steps_per_epoch: int):
     spe = max(steps_per_epoch, 1)
     start_epoch = int(state.step) // spe
     warning = None
@@ -75,6 +101,216 @@ def restore_for_resume(path: str, reference_state: TrainState, *,
                    f"under a different batch size; resuming at epoch {start_epoch} "
                    f"replays the partial epoch")
     return state, start_epoch, warning
+
+
+# =========================================================================================
+# Sharded (per-process) distributed checkpoints
+# =========================================================================================
+
+
+def _flatten_state_dict(tree):
+    """Nested state dict → flat ``{"a/b/c": leaf}`` (msgpack-friendly key paths).
+    ``None`` subtrees survive as leaves (flax's flatten_dict drops/levels them
+    differently per version, and the format needs them recorded explicitly)."""
+    from flax import traverse_util
+
+    return traverse_util.flatten_dict(tree, sep="/",
+                                      is_leaf=lambda _, v: not isinstance(v, dict))
+
+
+def _unflatten_state_dict(flat):
+    from flax import traverse_util
+
+    return traverse_util.unflatten_dict(flat, sep="/")
+
+
+def save_train_state_sharded(dir_path: str, state: TrainState) -> None:
+    """Distributed checkpoint: EVERY process writes exactly the shards it addresses
+    (first replica only), in parallel — no process gathers the full state, so the
+    host-memory and IO cost per process is its own shard set, not the model size.
+    This is the multi-host-scalable alternative to the process-0 full-state
+    ``save_train_state`` (which must all-gather sharded leaves to host 0 first).
+
+    Layout: ``dir_path/meta.msgpack`` (process 0: global shapes/dtypes + step) and one
+    ``shards_p{i}.msgpack`` per process, each mapping flat leaf paths to a list of
+    ``{start, data}`` blocks (global offsets + the local block). All writes are
+    atomic; restore re-assembles from whatever layout the state was sharded in, so
+    sharded checkpoints interchange across mesh layouts like full-state ones."""
+    import numpy as np
+
+    flat = _flatten_state_dict(serialization.to_state_dict(state._asdict()))
+    shards: dict[str, list] = {}
+    meta: dict[str, dict] = {}
+    for key, leaf in flat.items():
+        if leaf is None:                    # optional subtree absent (e.g. no EMA)
+            meta[key] = {"none": True}
+        elif isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+            meta[key] = {"shape": list(leaf.shape), "dtype": leaf.dtype.name}
+            blocks = []
+            for sh in leaf.addressable_shards:
+                if sh.replica_id != 0:     # exactly one owner per global block
+                    continue
+                starts = [0 if s.start is None else int(s.start) for s in sh.index]
+                blocks.append({"start": np.asarray(starts, np.int64),
+                               "data": np.asarray(sh.data)})
+            if blocks:
+                shards[key] = blocks
+        else:                               # host leaf (numpy/python): process 0 owns it
+            arr = np.asarray(leaf)
+            meta[key] = {"shape": list(arr.shape), "dtype": arr.dtype.name}
+            if jax.process_index() == 0:
+                shards[key] = [{"start": np.zeros(arr.ndim, np.int64), "data": arr}]
+    os.makedirs(dir_path, exist_ok=True)
+    if jax.process_index() == 0:
+        _atomic_write(os.path.join(dir_path, "meta.msgpack"),
+                      serialization.msgpack_serialize(
+                          {"meta": meta, "process_count": jax.process_count()}))
+        # Drop stale shard files a previous larger-fleet run may have left in an
+        # overwritten checkpoint dir — restore reads exactly process_count files.
+        import glob
+        for old in glob.glob(os.path.join(dir_path, "shards_p*.msgpack")):
+            idx = os.path.basename(old)[len("shards_p"):-len(".msgpack")]
+            if idx.isdigit() and int(idx) >= jax.process_count():
+                os.remove(old)
+    _atomic_write(os.path.join(dir_path, f"shards_p{jax.process_index()}.msgpack"),
+                  serialization.msgpack_serialize(shards))
+
+
+def restore_train_state_sharded(dir_path: str, reference_state: TrainState,
+                                *, shardings=None) -> TrainState:
+    """Re-assemble a ``save_train_state_sharded`` checkpoint (any source layout) into
+    host arrays shaped by ``reference_state``, optionally ``jax.device_put`` onto
+    ``shardings`` (a ``TrainState``-shaped sharding pytree for the CURRENT mesh).
+    Needs every writing process's ``shards_p*.msgpack`` visible (shared filesystem,
+    the usual distributed-checkpoint contract); the file set is pinned by the
+    recorded ``process_count``, so stale files from an older, larger fleet in an
+    overwritten directory are never merged in. The optional ``ema`` field reconciles
+    across ``--ema-decay`` exactly like ``restore_train_state``."""
+    import numpy as np
+
+    with open(os.path.join(dir_path, "meta.msgpack"), "rb") as f:
+        raw_meta = serialization.msgpack_restore(f.read())
+    meta, process_count = raw_meta["meta"], int(raw_meta["process_count"])
+    none_keys = {key for key, m in meta.items() if m.get("none")}
+    meta = {key: m for key, m in meta.items() if key not in none_keys}
+    full = {key: np.zeros(m["shape"], np.dtype(m["dtype"]))
+            for key, m in meta.items()}
+    files = [os.path.join(dir_path, f"shards_p{i}.msgpack")
+             for i in range(process_count)]
+    missing = [f for f in files if not os.path.exists(f)]
+    if missing:
+        raise FileNotFoundError(
+            f"sharded checkpoint {dir_path} was written by {process_count} "
+            f"process(es) but {len(missing)} shard file(s) are absent "
+            f"(e.g. {os.path.basename(missing[0])}) — shared filesystem required")
+    covered = {key: 0 for key in full}
+    for path in files:
+        with open(path, "rb") as f:
+            shards = serialization.msgpack_restore(f.read())
+        for key, blocks in shards.items():
+            for blk in blocks:
+                start, data = blk["start"], blk["data"]
+                idx = tuple(slice(int(s), int(s) + n)
+                            for s, n in zip(start, data.shape))
+                full[key][idx] = data
+                covered[key] += int(np.prod(data.shape, dtype=np.int64))
+    short = [k for k, n in covered.items()
+             if n < int(np.prod(meta[k]["shape"], dtype=np.int64))]
+    if short:
+        raise ValueError(
+            f"sharded checkpoint {dir_path} is missing blocks for {short[:3]}"
+            f"{'...' if len(short) > 3 else ''} — were all processes' shard files "
+            f"written and visible?")
+    # EMA reconciliation across the --ema-decay flag (mirrors restore_train_state):
+    # a pre-EMA checkpoint seeds the reference's EMA tree from its params; an EMA
+    # checkpoint restoring into a plain reference drops the tree.
+    if reference_state.ema is not None and "ema" in none_keys:
+        for k in [k for k in full if k.startswith("params/")]:
+            full["ema/" + k[len("params/"):]] = full[k]
+        none_keys.discard("ema")
+    elif reference_state.ema is None:
+        for k in [k for k in full if k.startswith("ema/")]:
+            del full[k]
+        none_keys.add("ema")
+    for key in none_keys:
+        full[key] = None
+    restored = serialization.from_state_dict(reference_state._asdict(),
+                                             _unflatten_state_dict(full))
+    state = TrainState(**restored)
+    if shardings is not None:
+        state = jax.device_put(state, shardings)
+    return state
+
+
+class AsyncCheckpointer:
+    """Write-behind checkpointing: serialization + disk IO run on a background
+    thread so the train loop only pays the device→host fetch (which a synchronous
+    ``save_train_state`` pays anyway — the copy must happen before the next donated
+    step invalidates the buffers).
+
+    Semantics match the reference's overwrite-in-place policy (reference
+    ``src/train.py:84-85``): writes to the SAME path coalesce — while one write is in
+    flight, newer states replace the queued one instead of piling up (an epoch can
+    outpace the disk; only the newest state matters when the file is an overwrite
+    target). Distinct paths never coalesce. Writes stay atomic (tmp + rename) and
+    process-0 gated; ``flush()`` drains the queue and re-raises the first background
+    error. Usable as a context manager (``with AsyncCheckpointer() as ck: ...`` —
+    exit flushes)."""
+
+    def __init__(self):
+        self._pending: dict[str, object] = {}        # path -> newest host state
+        self._lock = threading.Lock()
+        self._work = queue.Queue()                   # paths with pending data
+        self._error: BaseException | None = None
+        self._thread: threading.Thread | None = None
+
+    def _worker(self) -> None:
+        while True:
+            path = self._work.get()
+            if path is None:
+                return
+            with self._lock:
+                state = self._pending.pop(path, None)
+            if state is None:                        # coalesced away
+                continue
+            try:
+                _atomic_write(path, serialization.to_bytes(state))
+            except BaseException as e:               # surfaced on flush()
+                with self._lock:
+                    if self._error is None:
+                        self._error = e
+
+    def save_train_state(self, path: str, state: TrainState) -> None:
+        """Drop-in for module-level ``save_train_state``, minus the disk wait."""
+        if jax.process_index() != 0:
+            return
+        state_h = jax.device_get(state)              # the only on-thread cost
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._worker, daemon=True,
+                                            name="async-checkpoint")
+            self._thread.start()
+        with self._lock:
+            coalesced = path in self._pending
+            self._pending[path] = state_h._asdict()
+        if not coalesced:
+            self._work.put(path)
+
+    def flush(self) -> None:
+        """Block until every queued write is durable; re-raise background errors."""
+        if self._thread is not None:
+            self._work.put(None)
+            self._thread.join()
+            self._thread = None
+        err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.flush()
+        return False
 
 
 def save_params(path: str, params) -> None:

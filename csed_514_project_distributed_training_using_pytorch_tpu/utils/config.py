@@ -40,6 +40,12 @@ class SingleProcessConfig:
     warmup_steps: int = 0             # linear warmup ramp over the first N updates
     clip_grad_norm: float = 0.0       # clip gradients to this global norm before the
                                       # update (torch clip_grad_norm_ semantics); 0 off
+    ema_decay: float = 0.0            # maintain an EMA of the params in the compiled
+                                      # step (torch swa_utils semantics); eval and the
+                                      # final export use the EMA weights; 0 disables
+    async_checkpoint: bool = False    # write checkpoints on a background thread
+                                      # (serialization+IO off the hot loop; atomic,
+                                      # coalescing overwrites; flushed at exit)
     log_interval: int = 10            # src/train.py:17
     seed: int = 1                     # src/train.py:19 (torch.manual_seed(random_seed))
     data_dir: str = "files"           # src/train.py:26 ({CURR_PATH}/files/; one dir, not the
@@ -114,6 +120,9 @@ class DistributedConfig:
                                       # SingleProcessConfig.lr_schedule)
     warmup_steps: int = 0             # linear warmup ramp over the first N updates
     clip_grad_norm: float = 0.0       # global-norm gradient clipping; 0 disables
+    ema_decay: float = 0.0            # params EMA in the compiled step (torch
+                                      # swa_utils semantics); eval uses EMA weights
+    async_checkpoint: bool = False    # background-thread checkpoint writes
     log_interval: int = 10            # src/train_dist.py:129
     seed: int = 1                     # src/train_dist.py:135 (model/init seed)
     sampler_seed: int = 42            # src/train_dist.py:37 (DistributedSampler seed)
@@ -224,6 +233,14 @@ class ComposedConfig:
                                         # SingleProcessConfig.lr_schedule)
     warmup_steps: int = 0               # linear warmup ramp over the first N updates
     clip_grad_norm: float = 0.0         # global-norm gradient clipping; 0 disables
+    ema_decay: float = 0.0              # params EMA in the compiled step (torch
+                                        # swa_utils semantics); eval uses EMA weights
+    async_checkpoint: bool = False      # background-thread checkpoint writes
+    sharded_checkpoint: bool = False    # ALSO write a per-process distributed
+                                        # checkpoint each epoch (<ckpt>.sharded/:
+                                        # every process saves only the shards it
+                                        # addresses, no gather); --resume-from
+                                        # accepts the directory (not with stage=)
     dropout_rate: float = 0.0           # 0 keeps composed runs comparable across meshes
     seed: int = 1
     data_dir: str = "files"
@@ -269,6 +286,10 @@ class LMConfig:
     lr_schedule: str = "constant"
     warmup_steps: int = 0
     clip_grad_norm: float = 1.0         # LM training convention; 0 disables
+    ema_decay: float = 0.0              # params EMA in the compiled step (torch
+                                        # swa_utils semantics); eval/generation use
+                                        # the EMA weights
+    async_checkpoint: bool = False      # background-thread checkpoint writes
     grad_accum: int = 1
     bf16: bool = False
     remat: bool = False

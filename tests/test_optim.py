@@ -276,3 +276,68 @@ def test_single_trainer_adamw_trains_and_resumes(tmp_path):
     state2, _ = single.main(cfg, datasets=(train, test), resume_from=ckpt)
     assert int(state2.step) == 2 * int(state1.step)
     assert int(state2.velocity["count"]) == int(state2.step)
+
+
+def test_ema_matches_torch_swa_utils():
+    """``ema_decay`` follows torch ``AveragedModel(multi_avg_fn=get_ema_multi_avg_fn)``
+    semantics: feed torch's averager the SAME params sequence our compiled steps
+    produce, position by position, and the EMA trees must agree — including the
+    first-update copy (n_averaged == 0) special case."""
+    torch = pytest.importorskip("torch")
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state, make_train_step,
+    )
+
+    decay = 0.9
+    model = Net()
+    state = create_train_state(model, jax.random.PRNGKey(0), ema=True)
+    # Construction seeds ema = initial params (AveragedModel's construction copy).
+    for e, p in zip(jax.tree_util.tree_leaves(state.ema),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(e), np.asarray(p))
+
+    step = jax.jit(make_train_step(model, learning_rate=0.05, momentum=0.5,
+                                   ema_decay=decay))
+    rng = np.random.default_rng(7)
+    param_seq = []
+    for i in range(4):
+        x = jnp.asarray(rng.normal(size=(8, 28, 28, 1)).astype(np.float32))
+        y = jnp.asarray(rng.integers(0, 10, size=8).astype(np.int32))
+        state, _ = step(state, x, y, jax.random.PRNGKey(i))
+        param_seq.append(jax.device_get(state.params))
+
+    # Torch oracle: a parameter container updated to each params_t, averaged by
+    # AveragedModel with the EMA multi-avg fn.
+    leaves0 = jax.tree_util.tree_leaves(param_seq[0])
+    module = torch.nn.ParameterList(
+        [torch.nn.Parameter(torch.tensor(np.asarray(p))) for p in leaves0])
+    averaged = torch.optim.swa_utils.AveragedModel(
+        module, multi_avg_fn=torch.optim.swa_utils.get_ema_multi_avg_fn(decay))
+    for params_t in param_seq:
+        with torch.no_grad():
+            for tp, p in zip(module.parameters(),
+                             jax.tree_util.tree_leaves(params_t)):
+                tp.copy_(torch.tensor(np.asarray(p)))
+        averaged.update_parameters(module)
+
+    for ours, theirs in zip(jax.tree_util.tree_leaves(jax.device_get(state.ema)),
+                            averaged.module.parameters()):
+        np.testing.assert_allclose(np.asarray(ours), theirs.detach().numpy(),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_ema_requires_ema_state():
+    from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state, make_train_step,
+    )
+
+    model = Net()
+    state = create_train_state(model, jax.random.PRNGKey(0))       # no ema tree
+    step = make_train_step(model, learning_rate=0.05, momentum=0.5, ema_decay=0.9)
+    x = jnp.zeros((4, 28, 28, 1), jnp.float32)
+    y = jnp.zeros((4,), jnp.int32)
+    with pytest.raises(ValueError, match="ema=True"):
+        step(state, x, y, jax.random.PRNGKey(0))

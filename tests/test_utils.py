@@ -129,3 +129,117 @@ class TestReplicaSyncCheck:
         monkeypatch.setattr(multihost_utils, "process_allgather",
                             lambda x: np.asarray([[mine], [mine]]))
         D.assert_replicas_synced(params)               # identical fingerprints: fine
+
+
+class TestEmaCheckpointReconciliation:
+    """``restore_train_state`` bridges checkpoints across the ``--ema-decay`` flag:
+    pre-EMA checkpoints seed the EMA tree from their params; EMA checkpoints restore
+    into plain references by dropping the tree."""
+
+    def _state(self, ema: bool):
+        from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import (
+            Net,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+            create_train_state,
+        )
+
+        return create_train_state(Net(), jax.random.PRNGKey(3), ema=ema)
+
+    def test_round_trip_with_ema(self, tmp_path):
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint,
+        )
+
+        state = self._state(ema=True)
+        path = str(tmp_path / "s.ckpt")
+        checkpoint.save_train_state(path, state)
+        restored = checkpoint.restore_train_state(path, self._state(ema=True))
+        for a, b in zip(jax.tree_util.tree_leaves(restored.ema),
+                        jax.tree_util.tree_leaves(state.ema)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_plain_checkpoint_into_ema_reference_seeds_from_params(self, tmp_path):
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint,
+        )
+
+        plain = self._state(ema=False)
+        path = str(tmp_path / "s.ckpt")
+        checkpoint.save_train_state(path, plain)
+        restored = checkpoint.restore_train_state(path, self._state(ema=True))
+        assert restored.ema is not None
+        for e, p in zip(jax.tree_util.tree_leaves(restored.ema),
+                        jax.tree_util.tree_leaves(plain.params)):
+            np.testing.assert_array_equal(np.asarray(e), np.asarray(p))
+
+    def test_ema_checkpoint_into_plain_reference_drops_tree(self, tmp_path):
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint,
+        )
+
+        state = self._state(ema=True)
+        path = str(tmp_path / "s.ckpt")
+        checkpoint.save_train_state(path, state)
+        restored = checkpoint.restore_train_state(path, self._state(ema=False))
+        assert restored.ema is None
+        for a, b in zip(jax.tree_util.tree_leaves(restored.params),
+                        jax.tree_util.tree_leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestAsyncCheckpointer:
+    def _state(self):
+        from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import (
+            Net,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+            create_train_state,
+        )
+
+        return create_train_state(Net(), jax.random.PRNGKey(5))
+
+    def test_async_write_matches_sync_bytes(self, tmp_path):
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint,
+        )
+
+        state = self._state()
+        sync_path = str(tmp_path / "sync.ckpt")
+        async_path = str(tmp_path / "async.ckpt")
+        checkpoint.save_train_state(sync_path, state)
+        with checkpoint.AsyncCheckpointer() as ck:
+            ck.save_train_state(async_path, state)
+        assert open(async_path, "rb").read() == open(sync_path, "rb").read()
+
+    def test_overwrites_coalesce_to_newest(self, tmp_path):
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint,
+        )
+
+        state = self._state()
+        path = str(tmp_path / "s.ckpt")
+        with checkpoint.AsyncCheckpointer() as ck:
+            for i in range(20):
+                ck.save_train_state(path, state._replace(
+                    step=jnp.asarray(i, jnp.int32)))
+        restored = checkpoint.restore_train_state(path, self._state())
+        assert int(restored.step) == 19
+
+    def test_flush_reraises_background_error(self, tmp_path):
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint,
+        )
+
+        ck = checkpoint.AsyncCheckpointer()
+        # A directory path makes the atomic rename fail in the worker.
+        bad = str(tmp_path / "dir.ckpt")
+        os.makedirs(bad)
+        ck.save_train_state(bad, self._state())
+        with pytest.raises(OSError):
+            ck.flush()
+        # The checkpointer is reusable after an error surfaced.
+        good = str(tmp_path / "ok.ckpt")
+        ck.save_train_state(good, self._state())
+        ck.flush()
+        assert os.path.exists(good)
