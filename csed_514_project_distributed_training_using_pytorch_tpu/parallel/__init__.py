@@ -12,6 +12,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.sampler im
     ShardedSampler,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import (
+    make_hybrid_mesh,
     make_mesh,
     initialize_cluster,
     process_info,
@@ -50,6 +51,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel import fsd
 
 __all__ = [
     "ShardedSampler",
+    "make_hybrid_mesh",
     "make_mesh",
     "initialize_cluster",
     "process_info",

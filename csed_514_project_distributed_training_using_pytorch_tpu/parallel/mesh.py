@@ -160,3 +160,84 @@ def make_mesh(num_devices: int | None = None,
     if int(np.prod(axis_shape)) != len(devices):
         raise ValueError(f"axis_shape {axis_shape} != {len(devices)} devices")
     return Mesh(np.asarray(devices).reshape(axis_shape), axis_names)
+
+
+def make_hybrid_mesh(axis_names: tuple[str, ...], axis_shape: tuple[int, ...],
+                     *, dcn_axis: str = "data", num_slices: int | None = None,
+                     devices=None) -> Mesh:
+    """Device mesh for multi-slice (ICI × DCN) topologies: ``dcn_axis``'s LEADING
+    factor strides across slices — the only axis whose collectives cross the
+    data-center network — while its within-slice remainder and every other axis stay
+    inside a slice and ride ICI.
+
+    This is the scaling-book recipe for multi-pod training: put (the outer factor
+    of) data parallelism on DCN, where one gradient all-reduce per step amortizes
+    the slow links, and keep model/seq/expert sharding — whose collectives fire per
+    layer — on ICI. The device arrangement is what
+    ``jax.experimental.mesh_utils.create_hybrid_device_mesh`` produces for the same
+    split (slice-major along ``dcn_axis``); first-party here so the slice
+    granule can also be VIRTUAL (``num_slices`` on a single-slice or CPU platform),
+    which is how the multi-slice layout is exercised without multi-slice hardware —
+    the same trick the virtual 8-device CPU mesh plays for multi-chip.
+
+    Slice membership comes from ``device.slice_index`` (multi-slice TPU), else
+    process index (one granule per host), else an explicit ``num_slices``
+    partitioning the topology-ordered device list into equal contiguous granules.
+    """
+    if dcn_axis not in axis_names:
+        raise ValueError(f"dcn_axis {dcn_axis!r} not in axis_names {axis_names}")
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if int(np.prod(axis_shape)) != n:
+        raise ValueError(f"axis_shape {axis_shape} != {n} devices")
+    if num_slices is not None and (num_slices < 1 or n % num_slices):
+        raise ValueError(f"num_slices {num_slices} must be >= 1 and divide the "
+                         f"{n} devices")
+
+    # Natural granules first: real slice boundaries (multi-slice TPU), else host
+    # boundaries (multi-process). A SINGLE natural granule carries no topology
+    # information (e.g. single-slice backends report slice_index=0 on every
+    # device), so it falls through to the virtual num_slices partitioning rather
+    # than shadowing it.
+    if {getattr(d, "slice_index", None) for d in devices} != {None}:
+        natural = lambda d: d.slice_index
+    elif len({d.process_index for d in devices}) > 1:
+        natural = lambda d: d.process_index
+    else:
+        natural = lambda d: 0
+    granules: dict = {}
+    for d in devices:
+        granules.setdefault(natural(d), []).append(d)
+    if len(granules) == 1:
+        if num_slices is None:
+            raise ValueError(
+                "single-slice single-process platform: pass num_slices to "
+                "partition devices into virtual slices (or use make_mesh — "
+                "there is no DCN here)")
+        per = n // num_slices
+        granules = {s: list(devices[s * per:(s + 1) * per])
+                    for s in range(num_slices)}
+    slice_ids = sorted(granules)
+    if num_slices is not None and len(slice_ids) != num_slices:
+        raise ValueError(
+            f"num_slices {num_slices} != the platform's {len(slice_ids)} "
+            f"natural granules (slices/hosts) — the real topology wins; drop "
+            f"or match the override")
+    sizes = {len(v) for v in granules.values()}
+    if len(sizes) != 1:
+        raise ValueError(f"uneven slices: {sorted(sizes)} devices per granule")
+
+    pos = axis_names.index(dcn_axis)
+    n_slices = len(slice_ids)
+    if axis_shape[pos] % n_slices:
+        raise ValueError(
+            f"{dcn_axis} axis size {axis_shape[pos]} must divide by the "
+            f"{n_slices} slices (its leading factor is the DCN dimension)")
+    inner = axis_shape[pos] // n_slices
+    per_slice_shape = axis_shape[:pos] + (inner,) + axis_shape[pos + 1:]
+    if int(np.prod(per_slice_shape)) != sizes.pop():
+        raise ValueError(f"per-slice shape {per_slice_shape} != slice device count")
+    stacked = np.stack([np.asarray(granules[s]).reshape(per_slice_shape)
+                        for s in slice_ids], axis=pos)
+    return Mesh(stacked.reshape(axis_shape), axis_names)

@@ -112,7 +112,23 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     train_ds = mnist.truncate(train_ds, config.max_train_examples)
     test_ds = mnist.truncate(test_ds, config.max_test_examples)
 
-    mesh = make_mesh(n_mesh_devices, axis_names=axis_names, axis_shape=axis_sizes)
+    if config.dcn_data:
+        # Multi-slice layout: the data axis's leading factor (one per slice/granule)
+        # is the ONLY mesh dimension whose collectives cross DCN; everything else
+        # rides ICI. Virtual granules let this compile/run on single-slice or CPU
+        # platforms (the dryrun exercises it at 8 virtual devices).
+        from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+            make_hybrid_mesh,
+        )
+        if "data" not in axis_names:
+            raise ValueError("--dcn-data needs a data axis in --mesh (it is the "
+                             "axis whose leading factor spans slices)")
+        mesh = make_hybrid_mesh(axis_names, axis_sizes, dcn_axis="data",
+                                num_slices=config.dcn_data,
+                                devices=jax.devices()[:n_mesh_devices])
+    else:
+        mesh = make_mesh(n_mesh_devices, axis_names=axis_names,
+                         axis_shape=axis_sizes)
     data_size = mesh.shape.get("data", 1)
     seq_size = mesh.shape.get("seq", 1)
     model_size = mesh.shape.get("model", 1)

@@ -236,6 +236,10 @@ class ComposedConfig:
     ema_decay: float = 0.0              # params EMA in the compiled step (torch
                                         # swa_utils semantics); eval uses EMA weights
     async_checkpoint: bool = False      # background-thread checkpoint writes
+    dcn_data: int = 0                   # multi-slice: the data axis's leading
+                                        # factor spans this many slices/granules
+                                        # over DCN (0 = flat single-network mesh);
+                                        # all other axes stay on ICI
     sharded_checkpoint: bool = False    # ALSO write a per-process distributed
                                         # checkpoint each epoch (<ckpt>.sharded/:
                                         # every process saves only the shards it

@@ -139,3 +139,88 @@ def test_global_batch_from_host_local(mesh8):
     gx, gy = dp.global_batch_from_host_local(mesh8, x, y)
     assert gx.shape == (16, 2) and gy.shape == (16,)
     np.testing.assert_array_equal(np.asarray(gx), x)
+
+
+class TestHybridMesh:
+    """Multi-slice ICI×DCN mesh arrangement: the dcn axis's leading factor strides
+    across slice granules (slice-major), every other axis stays within a granule."""
+
+    def _devices(self):
+        return jax.devices()[:8]
+
+    def test_data_axis_slice_major(self):
+        from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+            make_hybrid_mesh,
+        )
+
+        devs = self._devices()
+        mesh = make_hybrid_mesh(("data",), (8,), num_slices=2, devices=devs)
+        ids = [d.id for d in mesh.devices.reshape(-1)]
+        # Virtual granules are contiguous in topology order: slice 0 = devices 0-3.
+        assert ids == [d.id for d in devs]
+        # First half of the data axis is entirely granule 0.
+        assert ids[:4] == [d.id for d in devs[:4]]
+
+    def test_inner_axes_stay_within_slice(self):
+        from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+            make_hybrid_mesh,
+        )
+
+        devs = self._devices()
+        mesh = make_hybrid_mesh(("data", "model"), (4, 2), num_slices=2,
+                                devices=devs)
+        arr = mesh.devices                       # [data=4, model=2]
+        granule = {d.id: i // 4 for i, d in enumerate(devs)}
+        # data coordinates 0-1 (slice 0's rows) hold only granule-0 devices; their
+        # model neighbors are in the same granule (TP rides ICI).
+        for di in range(4):
+            expected = 0 if di < 2 else 1
+            for mi in range(2):
+                assert granule[arr[di, mi].id] == expected, (di, mi)
+
+    def test_validation(self):
+        from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+            make_hybrid_mesh,
+        )
+
+        devs = self._devices()
+        with pytest.raises(ValueError, match="not in axis_names"):
+            make_hybrid_mesh(("model",), (8,), num_slices=2, devices=devs)
+        with pytest.raises(ValueError, match="must divide"):
+            make_hybrid_mesh(("data", "model"), (2, 4), num_slices=4, devices=devs)
+        with pytest.raises(ValueError, match="divide"):
+            make_hybrid_mesh(("data",), (8,), num_slices=3, devices=devs)
+        with pytest.raises(ValueError, match="divide"):
+            make_hybrid_mesh(("data",), (8,), num_slices=16, devices=devs)
+        with pytest.raises(ValueError, match=">= 1"):
+            make_hybrid_mesh(("data",), (8,), num_slices=-1, devices=devs)
+        with pytest.raises(ValueError, match="pass num_slices"):
+            make_hybrid_mesh(("data",), (8,), devices=devs)
+
+    def test_composed_trainer_dcn_data_matches_flat_mesh(self, tmp_path):
+        """--dcn-data is placement-only: same trajectory as the flat mesh."""
+        from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
+            Dataset, _normalize, _synthesize_split,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+            composed,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+            ComposedConfig,
+        )
+
+        xs, ys = _synthesize_split(512, seed=100)
+        train = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+        xs, ys = _synthesize_split(200, seed=101)
+        test = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+        common = dict(mesh="data=4,model=2", epochs=1, batch_size=64,
+                      batch_size_test=100)
+        _, hist_flat = composed.main(
+            ComposedConfig(results_dir=str(tmp_path / "flat"), **common),
+            datasets=(train, test))
+        _, hist_dcn = composed.main(
+            ComposedConfig(results_dir=str(tmp_path / "dcn"), dcn_data=2,
+                           **common),
+            datasets=(train, test))
+        np.testing.assert_allclose(hist_dcn.train_losses, hist_flat.train_losses,
+                                   rtol=1e-5, atol=1e-6)
