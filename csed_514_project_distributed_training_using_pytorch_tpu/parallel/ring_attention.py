@@ -79,10 +79,14 @@ def _case_index(origin, my_index):
 
 def _ring_attention_local(ql: jax.Array, kl: jax.Array, vl: jax.Array, *,
                           axis_name: str, num_shards: int,
-                          causal: bool) -> jax.Array:
+                          causal: bool, window: int = 0) -> jax.Array:
     """Per-device body: local Q block stays put; K/V blocks arrive via the ring.
 
     ``ql, kl, vl: [B, S/n, H, D]`` (this device's shard). Runs inside ``shard_map``.
+    ``window=W`` restricts attention to the sliding band (``full_attention``'s
+    semantics: distance < W; causal keeps the past side) — hops whose block lies
+    entirely outside the band skip the einsums, so per-device work is O(W·C) once
+    W ≲ a few chunks, regardless of the total ring length.
     """
     b, s_q, h, d = ql.shape
     s_k = kl.shape[1]
@@ -96,19 +100,36 @@ def _ring_attention_local(ql: jax.Array, kl: jax.Array, vl: jax.Array, *,
     q_pos = my_index * s_q + jnp.arange(s_q)  # global query positions [S/n]
 
     def update(carry, k_blk, v_blk, origin, masked: bool):
-        """One block fold; ``masked`` is static — only the diagonal hop applies the
-        causal mask (see ``fold``), built from global positions."""
+        """One block fold; ``masked`` is static — the diagonal hop (causal) and every
+        live hop (windowed) apply a mask built from global positions."""
         visible = None
         if masked:
             k_pos = origin * s_k + jnp.arange(s_k)
-            visible = q_pos[:, None] >= k_pos[None, :]  # [Sq,Sk]
+            rel = q_pos[:, None] - k_pos[None, :]       # [Sq,Sk] signed distance
+            visible = rel >= 0 if causal else jnp.ones_like(rel, bool)
+            if window:
+                visible &= (rel < window) & (rel > -window)
         return _online_softmax_update(carry, qf, k_blk, v_blk, visible)
 
     def fold(carry, k_blk, v_blk, origin):
         """One hop's block math. Causal hops decompose by the block's position
         relative to the local queries (equal shards arrive whole): entirely past →
         unmasked math, diagonal → masked math, entirely future → skipped outright
-        (r3: previously every hop paid full einsums plus masking)."""
+        (r3: previously every hop paid full einsums plus masking). Windowed hops
+        additionally skip blocks entirely outside the band; live windowed blocks
+        always take the masked path (the band may cut anywhere inside them)."""
+        if window:
+            # Block live iff its closest pair is inside the band: min distance
+            # between distinct blocks delta apart is (delta-1)·C + 1.
+            delta = jnp.abs(my_index - origin)
+            live = (delta - 1) * s_k + 1 < window
+            if causal:
+                live &= origin <= my_index
+            return lax.cond(
+                live,
+                lambda c, kb, vb, o: update(c, kb, vb, o, masked=True),
+                lambda c, kb, vb, o: c,
+                carry, k_blk, v_blk, origin)
         if not causal:
             return update(carry, k_blk, v_blk, origin, masked=False)
         return lax.switch(
@@ -164,32 +185,39 @@ def _qkv_spec(mesh: Mesh, shape: tuple, axis_name: str) -> P:
 
 
 def ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
-                   axis_name: str = "seq", causal: bool = False) -> jax.Array:
+                   axis_name: str = "seq", causal: bool = False,
+                   window: int = 0) -> jax.Array:
     """Sequence-parallel attention: ``[B, S, H, D]`` with S sharded over ``axis_name``.
 
     Drop-in equivalent of ``ops.full_attention`` (same signature modulo the mesh);
     callable under ``jax.jit`` (the mesh is static). The sequence length must divide by
     the mesh axis size. On a composed mesh the batch/head dims co-shard over the
-    ``data``/``model`` axes (see ``_qkv_spec``).
+    ``data``/``model`` axes (see ``_qkv_spec``). ``window=W`` is sliding-window
+    attention over the sharded sequence (``full_attention``'s band semantics):
+    out-of-band hops skip their einsums, so long-context local attention scales as
+    O(W·C) per device instead of O(S·C).
     """
     n = mesh.shape[axis_name]
     if q.shape[1] % n:
         raise ValueError(
             f"sequence length {q.shape[1]} not divisible by mesh axis "
             f"{axis_name!r} size {n} — ring attention shards the sequence evenly")
+    if window < 0:
+        raise ValueError(f"window must be >= 0 (0 = full attention), got {window}")
     spec = _qkv_spec(mesh, q.shape, axis_name)
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
              check_vma=False)
     def _ring(ql, kl, vl):
         return _ring_attention_local(ql, kl, vl, axis_name=axis_name,
-                                     num_shards=n, causal=causal)
+                                     num_shards=n, causal=causal, window=window)
 
     return _ring(q, k, v)
 
 
 def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq",
-                           use_flash: bool = False, use_zigzag: bool = False):
+                           use_flash: bool = False, use_zigzag: bool = False,
+                           window: int = 0):
     """Bind a mesh into a ``(q, k, v, *, causal) -> out`` callable with
     ``ops.full_attention``'s exact signature — the injection point for
     ``models/transformer.py``'s pluggable ``attention_fn``.
@@ -199,7 +227,15 @@ def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq",
     shard must then divide by the flash ``BLOCK`` (128). ``use_zigzag=True`` uses the
     load-balanced zig-zag causal schedule (``zigzag_ring_attention``; causal-only).
     Both together select ``zigzag_ring_flash_attention`` — the full long-context
-    causal training composition."""
+    causal training composition. ``window=W`` binds sliding-window masking into the
+    einsum ring (out-of-band hops skipped); it does not compose with the zig-zag
+    schedule (a split chunk pair straddles the band) or the flash rings (the kernels'
+    band masking assumes a shared global origin, which off-diagonal hops lack)."""
+    if window and (use_flash or use_zigzag):
+        raise ValueError(
+            "window composes with the plain einsum ring only — the zig-zag "
+            "schedule's split chunk pairs and the flash kernels' local-origin band "
+            "masks do not carry hop offsets")
 
     def attention_fn(q, k, v, *, causal: bool = False):
         if use_zigzag:
@@ -213,7 +249,8 @@ def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq",
         if use_flash:
             return ring_flash_attention(mesh, q, k, v, axis_name=axis_name,
                                         causal=causal)
-        return ring_attention(mesh, q, k, v, axis_name=axis_name, causal=causal)
+        return ring_attention(mesh, q, k, v, axis_name=axis_name, causal=causal,
+                              window=window)
 
     return attention_fn
 

@@ -91,18 +91,24 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                          f"of the transformer's {TransformerClassifier.num_heads} "
                          f"heads")
     # Fail fast (pre-data, pre-rendezvous): sliding windows compose with the
-    # single-chip dense/flash cores only.
+    # single-chip dense/flash cores AND the plain einsum ring (r3 — windowed
+    # context parallelism: out-of-band hops skip their einsums), but not with the
+    # zig-zag/flash ring schedules or ulysses.
     if config.attention_window:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
             validate_window,
         )
         validate_window(config.attention_window)
-        if (dict(zip(axis_names, axis_sizes)).get("seq", 1) > 1
-                or config.zigzag_attention):
+        seq_gt1 = dict(zip(axis_names, axis_sizes)).get("seq", 1) > 1
+        if config.zigzag_attention or (
+                seq_gt1 and (config.flash_attention
+                             or config.seq_impl == "ulysses")):
             raise ValueError(
-                "--attention-window applies to the single-chip dense/flash "
-                "attention cores — the ring/ulysses sequence-parallel schedules do "
-                "not window; drop the seq axis (or the window)")
+                "--attention-window composes with the single-chip dense/flash "
+                "cores and the plain einsum ring (a seq axis WITHOUT "
+                "--flash-attention/--zigzag-attention/--seq-impl ulysses) — the "
+                "zig-zag schedule's split chunk pairs and the flash/ulysses "
+                "local ops do not carry hop-offset band masks")
     n_mesh_devices = int(np.prod(axis_sizes))
     info = initialize_cluster()   # no-op single-process; multi-host rendezvous otherwise
 
@@ -245,7 +251,10 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         else:
             attention_fn = pa.flash_attention
     elif seq_size > 1:
-        attention_fn = make_ring_attention_fn(mesh)
+        # Plain einsum ring; --attention-window binds the sliding band into the
+        # hop schedule (windowed context parallelism — out-of-band hops skip).
+        attention_fn = make_ring_attention_fn(mesh,
+                                              window=config.attention_window)
     elif config.attention_window:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
             windowed_attention_fn,

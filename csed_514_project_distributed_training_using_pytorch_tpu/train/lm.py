@@ -105,9 +105,10 @@ def main(config: LMConfig = LMConfig(), *,
     seq_size = mesh.shape.get("seq", 1)
     if config.zigzag_attention and seq_size < 2:
         raise ValueError("--zigzag-attention needs a seq axis in --mesh")
-    if config.attention_window and seq_size > 1:
-        raise ValueError("--attention-window does not compose with a seq axis "
-                         "(the ring schedules do not window)")
+    if config.attention_window and seq_size > 1 and config.zigzag_attention:
+        raise ValueError("--attention-window composes with the plain einsum ring "
+                         "only — the zig-zag schedule's split chunk pairs do not "
+                         "carry hop-offset band masks; drop --zigzag-attention")
     if config.batch_size % world:
         raise ValueError(f"batch {config.batch_size} not divisible by data axis "
                          f"{world}")
@@ -139,8 +140,13 @@ def main(config: LMConfig = LMConfig(), *,
             raise ValueError(f"seq_len {seq_len} must divide by "
                              f"{'2*seq axis' if config.zigzag_attention else 'the seq axis'}"
                              f" = {need}")
+        # --attention-window binds the sliding band into the ring schedule itself
+        # (windowed context parallelism, r3: out-of-band hops skip their einsums);
+        # the model's own attention_window field must then stay 0 — the decode
+        # clone below re-adds it for the KV-cache mask.
         lm_kwargs["attention_fn"] = make_ring_attention_fn(
-            mesh, use_zigzag=config.zigzag_attention)
+            mesh, use_zigzag=config.zigzag_attention,
+            window=config.attention_window)
     # Fail fast on sampling knobs: generate() re-checks these, but its first call is
     # AFTER the full training loop — a bad flag must not cost the whole run.
     if not 0 <= config.top_k <= config.num_levels + 1:
@@ -152,9 +158,18 @@ def main(config: LMConfig = LMConfig(), *,
         embed_dim=config.embed_dim, num_layers=config.num_layers,
         num_heads=config.num_heads, dropout_rate=config.dropout_rate,
         num_kv_heads=config.kv_heads or None,
-        attention_window=config.attention_window, rope=config.rope,
+        attention_window=(0 if seq_size > 1 else config.attention_window),
+        rope=config.rope,
         dtype=jnp.bfloat16 if config.bf16 else jnp.float32, remat=config.remat,
         **lm_kwargs)
+    # Decoding is single-chip (host params): restore the default core, and the
+    # window as a model field so the KV-cache decode mask applies the same band the
+    # (possibly ring-windowed) training attention did — decode parity holds across
+    # the mesh choice because attention has no window-dependent parameters.
+    from csed_514_project_distributed_training_using_pytorch_tpu import ops as _ops
+    decode_model = (model.clone(attention_fn=_ops.full_attention,
+                                attention_window=config.attention_window)
+                    if seq_size > 1 else model)
     M.log(f"LM training: mesh {dict(mesh.shape)} on {info.process_count} process(es), "
           f"batch {config.batch_size}, vocab {config.num_levels}+BOS, "
           f"seq {seq_len}, data source: {train_ds.source}")
@@ -247,7 +262,7 @@ def main(config: LMConfig = LMConfig(), *,
             gen_params = (host_state.ema if host_state.ema is not None
                           else host_state.params)
             ids = jax.jit(lambda key: lm_mod.generate(
-                model, gen_params, key, batch=batch,
+                decode_model, gen_params, key, batch=batch,
                 temperature=config.temperature, top_k=config.top_k,
                 top_p=config.top_p, **gen_kw))(
                     jax.random.PRNGKey(config.seed + seed_offset))

@@ -360,3 +360,67 @@ def test_lm_with_ring_attention_matches_dense():
         np.asarray(ring.apply({"params": params}, inputs)),
         np.asarray(dense.apply({"params": params}, inputs)),
         rtol=1e-5, atol=1e-5)
+
+
+def test_lm_windowed_context_parallel_matches_dp(tmp_path):
+    """--attention-window over an LM seq axis (r3, windowed context parallelism):
+    the band rides the ring schedule, the trajectory equals the plain-DP windowed
+    run, and GENERATION matches too — the decode clone re-applies the window to the
+    KV-cache mask, so the sampled digits are identical across mesh choices."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
+        Dataset,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+        lm as lm_train,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+        LMConfig,
+    )
+
+    xs, ys = _synthesize_split(128, seed=70)
+    train = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+    xs, ys = _synthesize_split(100, seed=71)
+    test = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+
+    def run(tag, **kw):
+        cfg = LMConfig(epochs=1, batch_size=64, eval_batch=100, embed_dim=32,
+                       num_layers=1, num_heads=2, generate=2, temperature=0.0,
+                       attention_window=100,
+                       results_dir=str(tmp_path / tag),
+                       images_dir=str(tmp_path / tag / "img"), **kw)
+        return lm_train.main(cfg, datasets=(train, test))
+
+    state_dp, hist_dp = run("dp", mesh="data=4")
+    state_sp, hist_sp = run("sp", mesh="data=2,seq=2")
+    np.testing.assert_allclose(hist_sp.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hist_sp.test_losses, hist_dp.test_losses,
+                               rtol=1e-4, atol=1e-5)
+    # Both runs produced sample grids (the generation path ran on the CP model).
+    assert (tmp_path / "dp" / "img" / "lm_samples.png").exists()
+    assert (tmp_path / "sp" / "img" / "lm_samples.png").exists()
+    # Decode-window parity from the CP-trained params: greedy generation through
+    # the trainer's decode layout (default core + window FIELD, what the decode
+    # clone uses) equals generation through the windowed dense CORE — same params,
+    # deterministic, exact. A missing window in either layout changes the tokens.
+    from csed_514_project_distributed_training_using_pytorch_tpu import ops as _ops
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
+        windowed_attention_fn,
+    )
+    base = dict(vocab_size=17, seq_len=784, embed_dim=32, num_layers=1,
+                num_heads=2)
+    decode_layout = lm.TransformerLM(**base, attention_window=100)
+    core_layout = lm.TransformerLM(**base,
+                                   attention_fn=windowed_attention_fn(100))
+    key = jax.random.PRNGKey(5)
+    ids_a = jax.jit(lambda k: lm.generate(decode_layout, state_sp.params, k,
+                                          batch=2, temperature=0.0))(key)
+    # The windowed-core layout has no decode path of its own; its teacher-forced
+    # forward on ids_a must reproduce the decode run's implied log-probs — i.e.
+    # re-scoring the generated stream position-by-position gives the same argmax.
+    lp = core_layout.apply({"params": state_sp.params},
+                           decode_layout.shift_right(ids_a))
+    relisted = jnp.argmax(lp.at[:, :, 16].set(-1e30), axis=-1)
+    np.testing.assert_array_equal(np.asarray(relisted), np.asarray(ids_a))
+    with pytest.raises(ValueError, match="zigzag"):
+        run("zzw", mesh="data=2,seq=2", zigzag_attention=True)

@@ -264,11 +264,34 @@ def test_ulysses_rejects_zigzag(tiny_datasets):
                       datasets=tiny_datasets)
 
 
-def test_attention_window_rejects_seq_axis(tiny_datasets):
-    with pytest.raises(ValueError, match="attention-window"):
-        composed.main(ComposedConfig(mesh="data=2,seq=2", attention_window=4,
-                                     results_dir=""),
-                      datasets=tiny_datasets)
+def test_attention_window_rejects_nonring_seq_schedules(tiny_datasets):
+    """The window composes with the plain einsum ring (r3); the flash/ulysses/
+    zig-zag schedules still reject it."""
+    for kw in (dict(flash_attention=True), dict(seq_impl="ulysses"),
+               dict(zigzag_attention=True, causal=True)):
+        with pytest.raises(ValueError, match="attention-window"):
+            composed.main(ComposedConfig(mesh="data=2,seq=2", attention_window=4,
+                                         results_dir="", **kw),
+                          datasets=tiny_datasets)
+
+
+def test_attention_window_on_seq_axis_matches_single_chip(tmp_path, tiny_datasets):
+    """Windowed context parallelism from the CLI: --attention-window over a seq
+    axis (einsum ring with band-skipping hops) reproduces the plain-DP windowed
+    trajectory."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100, seq_len=28,
+                  attention_window=9, causal=True, max_train_examples=256)
+    _, hist_ring = composed.main(
+        ComposedConfig(mesh="data=2,seq=2", results_dir=str(tmp_path / "ring"),
+                       **common),
+        datasets=tiny_datasets)
+    _, hist_dp = composed.main(
+        ComposedConfig(mesh="data=4", results_dir=str(tmp_path / "dp"), **common),
+        datasets=tiny_datasets)
+    np.testing.assert_allclose(hist_ring.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hist_ring.test_losses, hist_dp.test_losses,
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_attention_window_trains_without_seq_axis(tmp_path, tiny_datasets):
