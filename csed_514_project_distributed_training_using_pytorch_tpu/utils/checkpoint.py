@@ -31,13 +31,23 @@ def _atomic_write(path: str, data: bytes) -> None:
     os.replace(tmp, path)
 
 
+def _state_dict_for_save(state: TrainState) -> dict:
+    """Serialization form: absent optional fields are OMITTED (not stored as None),
+    so EMA-off checkpoints stay byte-identical to the pre-EMA format — and raw
+    msgpack consumers never see a None leaf."""
+    d = state._asdict()
+    if d.get("ema") is None:
+        d.pop("ema", None)
+    return d
+
+
 def save_train_state(path: str, state: TrainState) -> None:
     """Full model+optimizer checkpoint (≙ the reference's model.pth + optimizer.pth pair,
     src/train.py:84-85, as one file). Process-0 gated; no-op elsewhere."""
     if jax.process_index() != 0:
         return
     state = jax.device_get(state)
-    _atomic_write(path, serialization.to_bytes(state._asdict()))
+    _atomic_write(path, serialization.to_bytes(_state_dict_for_save(state)))
 
 
 def restore_train_state(path: str, reference_state: TrainState) -> TrainState:
@@ -291,7 +301,7 @@ class AsyncCheckpointer:
             self._thread.start()
         with self._lock:
             coalesced = path in self._pending
-            self._pending[path] = state_h._asdict()
+            self._pending[path] = _state_dict_for_save(state_h)
         if not coalesced:
             self._work.put(path)
 
