@@ -10,6 +10,7 @@ same call contract, so every trainer accepts either.
 from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import Net
 from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer import (
     TransformerClassifier,
+    validate_remat_policy,
 )
 
 
@@ -21,7 +22,8 @@ VALID_MODELS = ("cnn", "transformer")
 def validate_model_config(name: str, *, remat: bool = False,
                           causal: bool = False,
                           attention_window: int = 0,
-                          kv_heads: int = 0, rope: bool = False) -> None:
+                          kv_heads: int = 0, rope: bool = False,
+                          remat_policy: str = "") -> None:
     """Fail fast on a bad ``--model`` value or model/knob combination — callers run this
     before any data download, dataset load, or cluster rendezvous so typos cost
     milliseconds, not side effects (on a fleet: not a full rendezvous per host)."""
@@ -31,6 +33,7 @@ def validate_model_config(name: str, *, remat: bool = False,
     if remat and name == "cnn":
         raise ValueError("--remat applies to the transformer family only "
                          "(the CNN's activations are a few hundred KB)")
+    validate_remat_policy(remat, remat_policy)
     if causal and name == "cnn":
         raise ValueError("--causal applies to the transformer family only "
                          "(the CNN has no attention to mask)")
@@ -55,7 +58,8 @@ def validate_model_config(name: str, *, remat: bool = False,
 
 def build_model(name: str, *, bf16: bool = False, remat: bool = False,
                 causal: bool = False, attention_window: int = 0,
-                kv_heads: int = 0, rope: bool = False):
+                kv_heads: int = 0, rope: bool = False,
+                remat_policy: str = ""):
     """Model factory behind the trainers' ``--model`` flag. Both families share the
     ``(x, *, deterministic)`` call contract on ``[B, 28, 28, 1]`` input, so every
     trainer/eval/checkpoint path works with either.
@@ -69,7 +73,8 @@ def build_model(name: str, *, bf16: bool = False, remat: bool = False,
     long-context knob.
     """
     validate_model_config(name, remat=remat, causal=causal,
-                          attention_window=attention_window, kv_heads=kv_heads)
+                          attention_window=attention_window, kv_heads=kv_heads,
+                          remat_policy=remat_policy)
     dtype = jnp.bfloat16 if bf16 else jnp.float32
     if name == "cnn":
         return Net(dtype=dtype)
@@ -83,8 +88,9 @@ def build_model(name: str, *, bf16: bool = False, remat: bool = False,
             windowed_attention_fn,
         )
         kwargs["attention_fn"] = windowed_attention_fn(attention_window)
-    return TransformerClassifier(dtype=dtype, remat=remat, causal=causal, **kwargs)
+    return TransformerClassifier(dtype=dtype, remat=remat, causal=causal,
+                                 remat_policy=remat_policy, **kwargs)
 
 
-__all__ = ["Net", "TransformerClassifier", "build_model", "validate_model_config",
+__all__ = ["Net", "TransformerClassifier", "build_model", "validate_model_config", "validate_remat_policy",
            "VALID_MODELS"]

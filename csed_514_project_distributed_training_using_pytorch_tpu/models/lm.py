@@ -46,6 +46,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer 
     _normal_init,
     _ones_init,
     _zeros_init,
+    remat_policy_fn,
 )
 
 # torchvision's MNIST normalization constants (reference src/train.py:28-30): the
@@ -105,6 +106,7 @@ class TransformerLM(fnn.Module):
                                 # the same formula, keeping decode parity
     dtype: jnp.dtype = jnp.float32
     remat: bool = False
+    remat_policy: str = ""      # see models.transformer.remat_policy_fn
 
     def _attention_fn(self) -> Callable:
         if not self.attention_window:
@@ -134,7 +136,8 @@ class TransformerLM(fnn.Module):
 
         block_cls = TransformerBlock
         if self.remat:
-            block_cls = fnn.remat(TransformerBlock, static_argnums=(2,))
+            block_cls = fnn.remat(TransformerBlock, static_argnums=(2,),
+                                  policy=remat_policy_fn(self.remat_policy))
         attention_fn = self._attention_fn()
         for i in range(self.num_layers):
             h = block_cls(
@@ -161,14 +164,20 @@ class TransformerLM(fnn.Module):
 
 
 def next_token_loss(model: TransformerLM, params, targets: jax.Array, rng,
-                    *, deterministic: bool = False) -> jax.Array:
-    """Mean next-token NLL over all ``B·S`` positions (the LM training objective)."""
+                    *, deterministic: bool = False,
+                    label_smoothing: float = 0.0) -> jax.Array:
+    """Mean next-token NLL over all ``B·S`` positions (the LM training objective).
+    ``label_smoothing`` follows torch ``CrossEntropyLoss`` semantics (the smoothed
+    target ``(1−s)·onehot + s/V`` over the vocabulary)."""
     kwargs = {"deterministic": True} if deterministic else {"deterministic": False}
     rngs = {} if deterministic else {"dropout": rng}
     log_probs = model.apply({"params": params}, model.shift_right(targets),
                             rngs=rngs, **kwargs)
-    return -jnp.mean(jnp.take_along_axis(log_probs, targets[..., None],
-                                         axis=-1))
+    picked = jnp.take_along_axis(log_probs, targets[..., None], axis=-1)[..., 0]
+    if label_smoothing:
+        smooth = jnp.mean(log_probs, axis=-1)
+        picked = (1.0 - label_smoothing) * picked + label_smoothing * smooth
+    return -jnp.mean(picked)
 
 
 # =========================================================================================

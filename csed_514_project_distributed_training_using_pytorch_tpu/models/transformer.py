@@ -139,6 +139,32 @@ class MultiHeadSelfAttention(fnn.Module):
         return ops.dense(out, wo.astype(self.dtype), bo.astype(self.dtype))
 
 
+
+def remat_policy_fn(name: str):
+    """Map a ``--remat-policy`` name to a ``jax.checkpoint`` policy.
+
+    ``"recompute-all"`` (the default) saves nothing — maximum memory savings,
+    ~1/3 extra FLOPs. ``"save-dots"`` (``jax.checkpoint_policies.dots_saveable``)
+    keeps matmul outputs and recomputes only the cheap elementwise work between
+    them — the TPU-recommended middle ground: the MXU results that are expensive
+    to recompute stay resident, the VPU work replays. Policies change ONLY what
+    is saved; the trajectory is bit-identical (pinned in tests)."""
+    if name in ("", "recompute-all"):
+        return None
+    if name == "save-dots":
+        return jax.checkpoint_policies.dots_saveable
+    raise ValueError(f"unknown remat policy {name!r} — choose "
+                     f"'recompute-all' or 'save-dots'")
+
+
+def validate_remat_policy(remat: bool, remat_policy: str) -> None:
+    """Shared fail-fast for every ``--remat-policy`` surface: the policy modifies
+    ``--remat`` (alone it does nothing), and the name must be known."""
+    if remat_policy:
+        if not remat:
+            raise ValueError("--remat-policy modifies --remat; add --remat")
+        remat_policy_fn(remat_policy)   # raises on unknown names
+
 class TransformerBlock(fnn.Module):
     """Pre-LN encoder block: ``x + MHA(LN(x))`` then ``x + FFN(LN(x))``.
 
@@ -258,6 +284,9 @@ class TransformerClassifier(fnn.Module):
                                 # ~1/3 extra FLOPs — the long-context memory knob the
                                 # brief's HBM math calls for; numerics unchanged
                                 # (pinned in tests/test_transformer.py)
+    remat_policy: str = ""      # what remat SAVES: '' / 'recompute-all' (nothing)
+                                # or 'save-dots' (keep matmul outputs, replay the
+                                # elementwise work) — see remat_policy_fn
     num_experts: int = 0        # >0: every block's MLP becomes a routed MoE with
                                 # this many experts (see TransformerBlock docstring for
                                 # the sown load-balance aux loss)
@@ -284,7 +313,8 @@ class TransformerClassifier(fnn.Module):
         if self.remat:
             # Recompute the block's activations during backward instead of storing them;
             # `deterministic` is a static argument (two traces, not a traced branch).
-            block_cls = fnn.remat(TransformerBlock, static_argnums=(2,))
+            block_cls = fnn.remat(TransformerBlock, static_argnums=(2,),
+                                  policy=remat_policy_fn(self.remat_policy))
         for i in range(self.num_layers):
             h = block_cls(
                 num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,

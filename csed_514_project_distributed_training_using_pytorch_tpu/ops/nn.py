@@ -77,14 +77,23 @@ def log_softmax(x: jax.Array, axis: int = -1) -> jax.Array:
     return shifted - jnp.log(jnp.sum(jnp.exp(shifted), axis=axis, keepdims=True))
 
 
-def nll_loss(log_probs: jax.Array, labels: jax.Array, *, reduction: str = "mean") -> jax.Array:
+def nll_loss(log_probs: jax.Array, labels: jax.Array, *, reduction: str = "mean",
+             label_smoothing: float = 0.0) -> jax.Array:
     """Negative log-likelihood of integer labels under ``log_probs``.
 
     Equivalent of ``F.nll_loss`` (reference ``src/train.py:74``) and of its deprecated
     ``size_average=False`` sum-reduction form (reference ``src/train.py:94``) via
     ``reduction="sum"``.
+
+    ``label_smoothing=s`` trains against the smoothed target distribution
+    ``(1−s)·onehot + s/C`` — torch ``CrossEntropyLoss(label_smoothing=s)`` semantics
+    (pinned against real torch in ``tests/test_ops.py``); per-example loss becomes
+    ``(1−s)·nll + s·mean_c(−log_probs)``.
     """
     picked = jnp.take_along_axis(log_probs, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    if label_smoothing:
+        smooth = jnp.mean(log_probs, axis=-1)
+        picked = (1.0 - label_smoothing) * picked + label_smoothing * smooth
     if reduction == "mean":
         return -jnp.mean(picked)
     if reduction == "sum":

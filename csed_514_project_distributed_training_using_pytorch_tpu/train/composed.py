@@ -42,6 +42,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.data import (
     download_mnist, load_mnist, mnist,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+    validate_remat_policy,
     TransformerClassifier,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import (
@@ -145,6 +146,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                          f"{data_size}")
     if config.grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
+    validate_remat_policy(config.remat, config.remat_policy)
     if config.batch_size % config.grad_accum:
         raise ValueError(f"batch {config.batch_size} not divisible by grad_accum "
                          f"{config.grad_accum}")
@@ -264,6 +266,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                     "seq_len": config.seq_len,
                     "dtype": jnp.bfloat16 if config.bf16 else jnp.float32,
                     "remat": config.remat,
+                    "remat_policy": config.remat_policy,
                     "causal": config.causal}
     if config.kv_heads:
         model_kwargs["num_kv_heads"] = config.kv_heads
@@ -351,7 +354,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                           grad_accum=config.grad_accum, optimizer=optimizer,
                           lr_schedule=lr_schedule,
                           clip_grad_norm=config.clip_grad_norm,
-                          ema_decay=config.ema_decay),
+                          ema_decay=config.ema_decay,
+                          label_smoothing=config.label_smoothing),
             in_shardings=(state_sh, rep, rep, idx_sh, rep),
             out_shardings=(state_sh, rep), donate_argnums=(0,))
         param_shardings = state_sh.params
@@ -368,7 +372,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                           grad_accum=config.grad_accum, optimizer=optimizer,
                           lr_schedule=lr_schedule,
                           clip_grad_norm=config.clip_grad_norm,
-                          ema_decay=config.ema_decay),
+                          ema_decay=config.ema_decay,
+                          label_smoothing=config.label_smoothing),
             mesh, data_axis="data" if data_size > 1 else None)
         param_shardings = tp.state_shardings(mesh, state).params
         eval_model = model

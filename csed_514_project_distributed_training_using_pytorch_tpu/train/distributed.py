@@ -107,7 +107,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     """Run distributed training over all (or ``num_devices``) addressable devices; every host
     in a multi-host fleet runs this same function."""
     watch = M.Stopwatch()                         # ≙ t0, reference src/train_dist.py:119
-    validate_model_config(config.model, remat=config.remat, causal=config.causal,
+    validate_model_config(config.model, remat=config.remat,
+                          remat_policy=config.remat_policy, causal=config.causal,
                           attention_window=config.attention_window,
                           kv_heads=config.kv_heads, rope=config.rope)  # fail fast, pre-rendezvous
     if config.grad_accum < 1:
@@ -142,6 +143,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                                seed=config.sampler_seed) for r in range(world)]
 
     model = build_model(config.model, bf16=config.bf16, remat=config.remat,
+                        remat_policy=config.remat_policy,
                         causal=config.causal,
                         attention_window=config.attention_window,
                         kv_heads=config.kv_heads, rope=config.rope)
@@ -182,7 +184,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                       grad_accum=config.grad_accum, optimizer=optimizer,
                       lr_schedule=lr_schedule,
                       clip_grad_norm=config.clip_grad_norm,
-                      ema_decay=config.ema_decay), mesh)
+                      ema_decay=config.ema_decay,
+                      label_smoothing=config.label_smoothing), mesh)
     eval_fn = dp.compile_eval(
         make_eval_fn(model, batch_size=config.batch_size_test), mesh,
         shard=config.shard_eval)
@@ -197,7 +200,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                             grad_accum=config.grad_accum,
                             optimizer=optimizer, lr_schedule=lr_schedule,
                             clip_grad_norm=config.clip_grad_norm,
-                            ema_decay=config.ema_decay), mesh)
+                            ema_decay=config.ema_decay,
+                            label_smoothing=config.label_smoothing), mesh)
         col_lo, col_hi = _host_local_columns(mesh, per_replica_batch)
         M.log(f"Host-local feed: this process feeds global-batch columns "
               f"[{col_lo}:{col_hi}]")

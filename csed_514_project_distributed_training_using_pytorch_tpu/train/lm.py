@@ -28,6 +28,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.data import (
     download_mnist, load_mnist, mnist,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.models import lm as lm_mod
+from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+    validate_remat_policy,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     data_parallel as dp,
@@ -75,6 +78,7 @@ def main(config: LMConfig = LMConfig(), *,
     watch = M.Stopwatch()
     if config.grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
+    validate_remat_policy(config.remat, config.remat_policy)
     if config.attention_window:
         # Fail fast, pre-data/rendezvous (one owner for the message).
         from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
@@ -161,6 +165,7 @@ def main(config: LMConfig = LMConfig(), *,
         attention_window=(0 if seq_size > 1 else config.attention_window),
         rope=config.rope,
         dtype=jnp.bfloat16 if config.bf16 else jnp.float32, remat=config.remat,
+        remat_policy=config.remat_policy,
         **lm_kwargs)
     # Decoding is single-chip (host params): restore the default core, and the
     # window as a model field so the KV-cache decode mask applies the same band the
@@ -205,7 +210,8 @@ def main(config: LMConfig = LMConfig(), *,
     def lm_loss(params, xs, ys, rng):
         del ys  # the target stream IS the input stream, shifted inside the loss
         return lm_mod.next_token_loss(model, params, xs, rng,
-                                      deterministic=deterministic)
+                                      deterministic=deterministic,
+                                      label_smoothing=config.label_smoothing)
 
     step_fn = make_train_step(model, learning_rate=config.learning_rate,
                               momentum=config.momentum, grad_accum=config.grad_accum,

@@ -57,7 +57,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     notebooks); by default MNIST is loaded from ``config.data_dir``.
     """
     watch = M.Stopwatch()                       # ≙ t0, reference src/train.py:10
-    validate_model_config(config.model, remat=config.remat, causal=config.causal,
+    validate_model_config(config.model, remat=config.remat,
+                          remat_policy=config.remat_policy, causal=config.causal,
                           attention_window=config.attention_window,
                           kv_heads=config.kv_heads, rope=config.rope)  # fail fast, pre-side-effects
     if config.grad_accum < 1:
@@ -102,6 +103,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                               os.path.join(config.images_dir, "train_images.png"))
 
     model = build_model(config.model, bf16=config.bf16, remat=config.remat,
+                        remat_policy=config.remat_policy,
                         causal=config.causal,
                         attention_window=config.attention_window,
                         kv_heads=config.kv_heads, rope=config.rope)
@@ -174,7 +176,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                           grad_accum=config.grad_accum, optimizer=optimizer,
                           lr_schedule=lr_schedule,
                           clip_grad_norm=config.clip_grad_norm,
-                          ema_decay=config.ema_decay),
+                          ema_decay=config.ema_decay,
+                          label_smoothing=config.label_smoothing),
             donate_argnums=(0,))
         step_fn = jax.jit(
             make_train_step(model, learning_rate=config.learning_rate,
@@ -183,7 +186,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                             grad_accum=config.grad_accum, optimizer=optimizer,
                             lr_schedule=lr_schedule,
                             clip_grad_norm=config.clip_grad_norm,
-                            ema_decay=config.ema_decay),
+                            ema_decay=config.ema_decay,
+                            label_smoothing=config.label_smoothing),
             donate_argnums=(0,))
     # The final partial batch (drop_last=False) is ragged and need not divide by
     # grad_accum; accumulation is a memory knob, so the tail just steps unaccumulated.
@@ -196,7 +200,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                             use_pallas=config.use_pallas_kernels,
                             optimizer=optimizer, lr_schedule=lr_schedule,
                             clip_grad_norm=config.clip_grad_norm,
-                            ema_decay=config.ema_decay),
+                            ema_decay=config.ema_decay,
+                            label_smoothing=config.label_smoothing),
             donate_argnums=(0,))
     eval_fn = jax.jit(make_eval_fn(model, batch_size=config.batch_size_test))
 

@@ -83,6 +83,7 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
                     lr_schedule: Callable | None = None,
                     clip_grad_norm: float = 0.0,
                     ema_decay: float = 0.0,
+                    label_smoothing: float = 0.0,
                     loss_fn: Callable | None = None) -> Callable:
     """Build ``step(state, images, labels, rng) -> (state, loss)``.
 
@@ -143,6 +144,9 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     if use_pallas and lr_schedule is not None:
         raise ValueError("use_pallas bakes the learning rate into the fused kernel — "
                          "lr_schedule is not supported there")
+    if use_pallas and label_smoothing:
+        raise ValueError("use_pallas fuses the plain NLL loss kernel — "
+                         "label_smoothing is not supported there")
     if use_pallas and loss_fn is not None:
         raise ValueError("use_pallas fuses the standard NLL loss kernel — a custom "
                          "loss_fn is not supported there")
@@ -160,7 +164,8 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
         if use_pallas:
             # log_softmax is idempotent: fused nll-from-logits on log-probs is identical.
             return pk.nll_from_logits(log_probs, labels) + aux
-        return ops.nll_loss(log_probs, labels) + aux
+        return ops.nll_loss(log_probs, labels,
+                            label_smoothing=label_smoothing) + aux
 
     if loss_fn is None:
         loss_fn = default_loss_fn
@@ -235,7 +240,8 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
                   optimizer: Optimizer | None = None,
                   lr_schedule: Callable | None = None,
                   clip_grad_norm: float = 0.0,
-                  ema_decay: float = 0.0) -> Callable:
+                  ema_decay: float = 0.0,
+                  label_smoothing: float = 0.0) -> Callable:
     """Build ``epoch(state, images, labels, idx_matrix, rng) -> (state, losses)``.
 
     ``images``/``labels`` are the full (device-resident) training split; ``idx_matrix`` is a
@@ -257,7 +263,8 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
     train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum,
                                  use_pallas=use_pallas, grad_accum=grad_accum,
                                  optimizer=optimizer, lr_schedule=lr_schedule,
-                                 clip_grad_norm=clip_grad_norm, ema_decay=ema_decay)
+                                 clip_grad_norm=clip_grad_norm, ema_decay=ema_decay,
+                                 label_smoothing=label_smoothing)
     return make_epoch_from_step(train_step, unroll=unroll, pregather=pregather)
 
 

@@ -40,6 +40,8 @@ class SingleProcessConfig:
     warmup_steps: int = 0             # linear warmup ramp over the first N updates
     clip_grad_norm: float = 0.0       # clip gradients to this global norm before the
                                       # update (torch clip_grad_norm_ semantics); 0 off
+    label_smoothing: float = 0.0      # torch CrossEntropyLoss(label_smoothing=s)
+                                      # semantics: smoothed target (1-s)*onehot + s/C
     ema_decay: float = 0.0            # maintain an EMA of the params in the compiled
                                       # step (torch swa_utils semantics); eval and the
                                       # final export use the EMA weights; 0 disables
@@ -66,6 +68,8 @@ class SingleProcessConfig:
                                       # softmax/loss statistics — the MXU-native dtype)
     remat: bool = False               # jax.checkpoint each transformer block on backward
                                       # (O(1)-blocks activation memory; transformer only)
+    remat_policy: str = ""            # what remat saves: 'recompute-all' (default) or
+                                      # 'save-dots' (keep MXU outputs, replay VPU work)
     causal: bool = False              # decoder-style (causal) attention
                                       # (transformer only)
     attention_window: int = 0         # sliding-window (local) attention width
@@ -120,6 +124,7 @@ class DistributedConfig:
                                       # SingleProcessConfig.lr_schedule)
     warmup_steps: int = 0             # linear warmup ramp over the first N updates
     clip_grad_norm: float = 0.0       # global-norm gradient clipping; 0 disables
+    label_smoothing: float = 0.0      # torch label-smoothing semantics
     ema_decay: float = 0.0            # params EMA in the compiled step (torch
                                       # swa_utils semantics); eval uses EMA weights
     async_checkpoint: bool = False    # background-thread checkpoint writes
@@ -144,6 +149,7 @@ class DistributedConfig:
     bf16: bool = False                # bfloat16 activations (see SingleProcessConfig.bf16)
     remat: bool = False               # jax.checkpoint transformer blocks (see
                                       # SingleProcessConfig.remat)
+    remat_policy: str = ""            # see SingleProcessConfig.remat_policy
     causal: bool = False              # decoder-style attention (see
                                       # SingleProcessConfig.causal)
     attention_window: int = 0         # sliding-window attention width (see
@@ -188,6 +194,7 @@ class ComposedConfig:
                                         # microbatch by the data axis
     bf16: bool = False                  # bfloat16 activations (f32 master weights;
                                         # see SingleProcessConfig.bf16)
+    remat_policy: str = ""              # see SingleProcessConfig.remat_policy
     remat: bool = False                 # jax.checkpoint each block on backward (not
                                         # with a stage axis — the pipeline engine
                                         # applies blocks itself)
@@ -233,6 +240,7 @@ class ComposedConfig:
                                         # SingleProcessConfig.lr_schedule)
     warmup_steps: int = 0               # linear warmup ramp over the first N updates
     clip_grad_norm: float = 0.0         # global-norm gradient clipping; 0 disables
+    label_smoothing: float = 0.0        # torch label-smoothing semantics
     ema_decay: float = 0.0              # params EMA in the compiled step (torch
                                         # swa_utils semantics); eval uses EMA weights
     async_checkpoint: bool = False      # background-thread checkpoint writes
@@ -290,6 +298,7 @@ class LMConfig:
     lr_schedule: str = "constant"
     warmup_steps: int = 0
     clip_grad_norm: float = 1.0         # LM training convention; 0 disables
+    label_smoothing: float = 0.0        # torch label-smoothing semantics
     ema_decay: float = 0.0              # params EMA in the compiled step (torch
                                         # swa_utils semantics); eval/generation use
                                         # the EMA weights
@@ -297,6 +306,7 @@ class LMConfig:
     grad_accum: int = 1
     bf16: bool = False
     remat: bool = False
+    remat_policy: str = ""              # see SingleProcessConfig.remat_policy
     eval_batch: int = 500               # test-perplexity scan batch (must divide split)
     generate: int = 6                   # sample this many digits after training (0 off)
     temperature: float = 1.0            # sampling temperature (<= 0 decodes greedily)

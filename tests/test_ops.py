@@ -5,6 +5,7 @@ src/train_dist.py:67) and the double-log-softmax quirk (SURVEY.md §2d.1)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from csed_514_project_distributed_training_using_pytorch_tpu import ops
 
@@ -100,3 +101,46 @@ def test_conv2d_matches_manual():
         for j in range(4):
             ref[i, j] = (xn[i:i + 3, j:j + 3] * wn).sum()
     np.testing.assert_allclose(out[0, :, :, 0], ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1, 0.3])
+def test_label_smoothing_matches_torch(smoothing):
+    """nll_loss(label_smoothing=s) reproduces torch CrossEntropyLoss(label_smoothing=s)
+    on the same logits (our canonical path applies nll to log_softmax output)."""
+    torch = pytest.importorskip("torch")
+
+    rng = np.random.default_rng(11)
+    logits = rng.normal(size=(16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=16).astype(np.int64)
+    want = torch.nn.CrossEntropyLoss(label_smoothing=smoothing)(
+        torch.tensor(logits), torch.tensor(labels)).item()
+    got = float(ops.nll_loss(ops.log_softmax(jnp.asarray(logits)),
+                             jnp.asarray(labels.astype(np.int32)),
+                             label_smoothing=smoothing))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+    # All reductions honor the smoothing.
+    per = ops.nll_loss(ops.log_softmax(jnp.asarray(logits)),
+                       jnp.asarray(labels.astype(np.int32)),
+                       label_smoothing=smoothing, reduction="none")
+    np.testing.assert_allclose(float(jnp.mean(per)), want, rtol=1e-6, atol=1e-7)
+
+
+def test_lm_label_smoothing_matches_torch():
+    torch = pytest.importorskip("torch")
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        lm as lm_mod,
+    )
+
+    model = lm_mod.TransformerLM(vocab_size=9, seq_len=16, embed_dim=32,
+                                 num_layers=1, num_heads=2)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, 16), jnp.int32))["params"]
+    rng = np.random.default_rng(3)
+    targets = jnp.asarray(rng.integers(0, 8, size=(2, 16)).astype(np.int32))
+    got = float(lm_mod.next_token_loss(model, params, targets, None,
+                                       deterministic=True, label_smoothing=0.2))
+    log_probs = model.apply({"params": params}, model.shift_right(targets))
+    want = torch.nn.CrossEntropyLoss(label_smoothing=0.2)(
+        torch.tensor(np.asarray(log_probs)).reshape(-1, 9),
+        torch.tensor(np.asarray(targets).astype(np.int64)).reshape(-1)).item()
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)

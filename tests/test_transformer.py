@@ -140,11 +140,15 @@ def test_causal_variant_forward():
     assert bool(jnp.all(jnp.isfinite(log_probs)))
 
 
-def test_remat_is_numerically_identical():
+@pytest.mark.parametrize("policy", ["", "save-dots"])
+def test_remat_is_numerically_identical(policy):
     """remat=True (jax.checkpoint per block) is a memory knob only: forward, loss, and
-    one optimizer step are bit-identical, on both the deterministic and dropout paths."""
+    one optimizer step are bit-identical, on both the deterministic and dropout paths
+    — under the default recompute-all policy AND the save-dots policy (which keeps
+    MXU outputs and replays only elementwise work)."""
     base = TransformerClassifier(dropout_rate=0.1)
-    remat = TransformerClassifier(dropout_rate=0.1, remat=True)
+    remat = TransformerClassifier(dropout_rate=0.1, remat=True,
+                                  remat_policy=policy)
     s0 = create_train_state(base, jax.random.PRNGKey(0))
     images, labels = _batch(seed=8)
 
@@ -341,3 +345,22 @@ def test_gqa_params_shard_under_tp():
     assert attn["q_kernel"] == P(None, "model")
     assert attn["kv_kernel"] == P(None, "model")
     assert attn["kv_bias"] == P("model")
+
+
+def test_remat_policy_validation():
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        build_model, validate_model_config,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer import (
+        remat_policy_fn,
+    )
+
+    assert remat_policy_fn("") is None
+    assert remat_policy_fn("recompute-all") is None
+    assert remat_policy_fn("save-dots") is not None
+    with pytest.raises(ValueError, match="unknown remat policy"):
+        remat_policy_fn("everything")
+    with pytest.raises(ValueError, match="add --remat"):
+        validate_model_config("transformer", remat_policy="save-dots")
+    m = build_model("transformer", remat=True, remat_policy="save-dots")
+    assert m.remat_policy == "save-dots"
