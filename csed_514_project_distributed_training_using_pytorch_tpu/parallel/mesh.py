@@ -162,6 +162,59 @@ def make_mesh(num_devices: int | None = None,
     return Mesh(np.asarray(devices).reshape(axis_shape), axis_names)
 
 
+def _slice_granules(devices, num_slices: int | None) -> dict:
+    """DCN granule membership for ``make_hybrid_mesh``: a dict of granule id →
+    topology-ordered device list.
+
+    Natural granules first: real slice boundaries (multi-slice TPU), else host
+    boundaries (multi-process). A SINGLE natural granule carries no topology
+    information (e.g. single-slice backends report slice_index=0 on every device),
+    so it falls through to the virtual ``num_slices`` partitioning rather than
+    shadowing it. When ``num_slices`` names FEWER granules than the platform's H
+    natural HOST granules and divides H (hosts-per-slice > 1 without the
+    multi-slice ``slice_index`` attribute), contiguous host granules merge — in
+    topology order, so intra-super-granule links stay as local as the enumeration
+    allows. Real ``slice_index`` granules never merge (their boundaries ARE the
+    DCN; grouping them would put per-layer collectives on it), and any other
+    mismatch errors: the real topology wins."""
+    n = len(devices)
+    if {getattr(d, "slice_index", None) for d in devices} != {None}:
+        natural, mergeable = (lambda d: d.slice_index), False
+    elif len({d.process_index for d in devices}) > 1:
+        # Host granules are a PROXY for slice membership — hosts-per-slice > 1 is
+        # a legitimate layout, so these (unlike real slice_index granules, whose
+        # boundaries ARE the DCN) may merge under a smaller num_slices below.
+        natural, mergeable = (lambda d: d.process_index), True
+    else:
+        natural, mergeable = (lambda d: 0), False
+    granules: dict = {}
+    for d in devices:
+        granules.setdefault(natural(d), []).append(d)
+    if len(granules) == 1:
+        if num_slices is None:
+            raise ValueError(
+                "single-slice single-process platform: pass num_slices to "
+                "partition devices into virtual slices (or use make_mesh — "
+                "there is no DCN here)")
+        per = n // num_slices
+        return {s: list(devices[s * per:(s + 1) * per])
+                for s in range(num_slices)}
+    slice_ids = sorted(granules)
+    if num_slices is not None and len(slice_ids) != num_slices:
+        if (mergeable and num_slices < len(slice_ids)
+                and len(slice_ids) % num_slices == 0):
+            per_super = len(slice_ids) // num_slices
+            return {s: [d for g in slice_ids[s * per_super:(s + 1) * per_super]
+                        for d in granules[g]]
+                    for s in range(num_slices)}
+        raise ValueError(
+            f"num_slices {num_slices} != the platform's {len(slice_ids)} "
+            f"natural granules (slices/hosts)"
+            + (" and does not divide them" if mergeable else "")
+            + " — the real topology wins; drop or match the override")
+    return granules
+
+
 def make_hybrid_mesh(axis_names: tuple[str, ...], axis_shape: tuple[int, ...],
                      *, dcn_axis: str = "data", num_slices: int | None = None,
                      devices=None) -> Mesh:
@@ -195,35 +248,8 @@ def make_hybrid_mesh(axis_names: tuple[str, ...], axis_shape: tuple[int, ...],
         raise ValueError(f"num_slices {num_slices} must be >= 1 and divide the "
                          f"{n} devices")
 
-    # Natural granules first: real slice boundaries (multi-slice TPU), else host
-    # boundaries (multi-process). A SINGLE natural granule carries no topology
-    # information (e.g. single-slice backends report slice_index=0 on every
-    # device), so it falls through to the virtual num_slices partitioning rather
-    # than shadowing it.
-    if {getattr(d, "slice_index", None) for d in devices} != {None}:
-        natural = lambda d: d.slice_index
-    elif len({d.process_index for d in devices}) > 1:
-        natural = lambda d: d.process_index
-    else:
-        natural = lambda d: 0
-    granules: dict = {}
-    for d in devices:
-        granules.setdefault(natural(d), []).append(d)
-    if len(granules) == 1:
-        if num_slices is None:
-            raise ValueError(
-                "single-slice single-process platform: pass num_slices to "
-                "partition devices into virtual slices (or use make_mesh — "
-                "there is no DCN here)")
-        per = n // num_slices
-        granules = {s: list(devices[s * per:(s + 1) * per])
-                    for s in range(num_slices)}
+    granules = _slice_granules(devices, num_slices)
     slice_ids = sorted(granules)
-    if num_slices is not None and len(slice_ids) != num_slices:
-        raise ValueError(
-            f"num_slices {num_slices} != the platform's {len(slice_ids)} "
-            f"natural granules (slices/hosts) — the real topology wins; drop "
-            f"or match the override")
     sizes = {len(v) for v in granules.values()}
     if len(sizes) != 1:
         raise ValueError(f"uneven slices: {sorted(sizes)} devices per granule")

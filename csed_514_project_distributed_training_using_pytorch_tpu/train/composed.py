@@ -243,15 +243,17 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                 f"seq_axis·BLOCK = {max(seq_size, 1)}·{pa.BLOCK}, got "
                 f"{config.seq_len} (e.g. --seq-len {max(seq_size, 1) * pa.BLOCK})")
         # Ring-of-flash under a seq axis (flash kernels on every hop, trainable custom
-        # VJP); plain single-chip flash otherwise (windowed/banded when requested).
+        # VJP); the measured-crossover dispatcher otherwise (dense below
+        # FLASH_MIN_SEQ, flash at and above — the flag can never regress throughput;
+        # windowed/banded when requested).
         if seq_size > 1:
             attention_fn = make_ring_attention_fn(mesh, use_flash=True)
         elif config.attention_window:
             import functools
             attention_fn = functools.partial(
-                pa.flash_attention, window=config.attention_window)
+                pa.dispatch_attention, window=config.attention_window)
         else:
-            attention_fn = pa.flash_attention
+            attention_fn = pa.dispatch_attention
     elif seq_size > 1:
         # Plain einsum ring; --attention-window binds the sliding band into the
         # hop schedule (windowed context parallelism — out-of-band hops skip).
@@ -422,6 +424,30 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     if ckpt_path:
         os.makedirs(config.results_dir, exist_ok=True)
 
+    try:
+        host_state = _run_epochs(
+            config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x,
+            test_y, dropout_rng, plan_spec, n_train, n_test, steps_per_epoch,
+            start_epoch, history, watch, saver, ckpt_path, to_host_standard)
+    finally:
+        # Drain the write-behind queue even on an exception/signal mid-run — the
+        # queued per-epoch checkpoint is the resume artifact a killed run needs,
+        # and flush() re-raises deferred background IO errors.
+        if config.async_checkpoint:
+            saver.flush()
+    if ckpt_path:
+        M.log(f"Saved {ckpt_path}")
+    if config.results_dir:
+        M.save_metrics_jsonl(history,
+                             os.path.join(config.results_dir, "metrics.jsonl"))
+    return host_state, history
+
+
+def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x,
+                test_y, dropout_rng, plan_spec, n_train, n_test, steps_per_epoch,
+                start_epoch, history, watch, saver, ckpt_path, to_host_standard):
+    """The composed trainer's epoch loop, split out so the caller can guarantee the
+    async-checkpoint flush in a ``finally`` regardless of where the loop fails."""
     host_state = None
     with maybe_profile(config.profile and M.is_logging_process(),
                        config.profile_dir):
@@ -465,14 +491,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         host_state = to_host_standard(state)
         if ckpt_path:           # zero-epoch resume must still leave a checkpoint
             saver.save_train_state(ckpt_path, host_state)
-    if ckpt_path:
-        M.log(f"Saved {ckpt_path}")
-    if config.results_dir:
-        M.save_metrics_jsonl(history,
-                             os.path.join(config.results_dir, "metrics.jsonl"))
-    if config.async_checkpoint:
-        saver.flush()
-    return host_state, history
+    return host_state
 
 
 if __name__ == "__main__":

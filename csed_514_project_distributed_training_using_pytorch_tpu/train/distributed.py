@@ -232,45 +232,52 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     saver = (checkpoint.AsyncCheckpointer() if config.async_checkpoint
              else checkpoint)
 
-    with maybe_profile(config.profile and M.is_logging_process(), config.profile_dir):
-        for epoch in range(start_epoch, config.epochs):   # ≙ the epoch loop, :70
-            plan = epoch_index_plan(samplers, epoch, per_replica_batch)  # ≙ set_epoch, :72
-            if config.host_local_feed:
-                state, losses = run_epoch_host_local(state, plan)
-            else:
-                state, losses = run_epoch_device_resident(state, plan)
+    try:
+        with maybe_profile(config.profile and M.is_logging_process(),
+                           config.profile_dir):
+            for epoch in range(start_epoch, config.epochs):   # ≙ the epoch loop, :70
+                plan = epoch_index_plan(samplers, epoch, per_replica_batch)  # ≙ set_epoch, :72
+                if config.host_local_feed:
+                    state, losses = run_epoch_host_local(state, plan)
+                else:
+                    state, losses = run_epoch_device_resident(state, plan)
 
-            losses = np.asarray(jax.device_get(losses))
-            train_loss = float(losses.mean())     # per-epoch mean of per-step global means
-            examples = (epoch + 1) * plan.size
-            for i, l in enumerate(losses[::config.log_interval]):
-                history.record_train(epoch * plan.size +
-                                     i * config.log_interval * plan.shape[1], float(l))
+                losses = np.asarray(jax.device_get(losses))
+                train_loss = float(losses.mean())     # per-epoch mean of per-step global means
+                examples = (epoch + 1) * plan.size
+                for i, l in enumerate(losses[::config.log_interval]):
+                    history.record_train(epoch * plan.size +
+                                         i * config.log_interval * plan.shape[1],
+                                         float(l))
 
-            eval_params = state.ema if state.ema is not None else state.params
-            sum_nll, correct = jax.device_get(
-                eval_fn(eval_params, test_x, test_y))   # ≙ eval loop, :92-109
-            val_loss = float(sum_nll) / n_test
-            accuracy = float(correct) / n_test
-            history.record_test(examples, val_loss)
-            M.log(M.dist_epoch_summary_line(epoch, train_loss, val_loss, accuracy,
-                                            watch.elapsed()))  # ≙ :113-114
-            # Per-epoch full-state checkpoint (process-0 gated, atomic) so a killed run
-            # can resume with --resume-from; the reference only ever saves final params.
-            saver.save_train_state(ckpt_path, state)
+                eval_params = state.ema if state.ema is not None else state.params
+                sum_nll, correct = jax.device_get(
+                    eval_fn(eval_params, test_x, test_y))   # ≙ eval loop, :92-109
+                val_loss = float(sum_nll) / n_test
+                accuracy = float(correct) / n_test
+                history.record_test(examples, val_loss)
+                M.log(M.dist_epoch_summary_line(epoch, train_loss, val_loss, accuracy,
+                                                watch.elapsed()))  # ≙ :113-114
+                # Per-epoch full-state checkpoint (process-0 gated, atomic) so a killed run
+                # can resume with --resume-from; the reference only ever saves final params.
+                saver.save_train_state(ckpt_path, state)
 
-    assert_replicas_synced(state.params)          # the desync "race detector" (SURVEY.md §5)
+        assert_replicas_synced(state.params)      # the desync "race detector" (SURVEY.md §5)
 
-    plotting.save_loss_curves(
-        history, os.path.join(config.images_dir, "train_test_curve_dist.png"))  # ≙ :161
-    M.save_metrics_jsonl(history, os.path.join(config.results_dir, "metrics.jsonl"))
-    # The export must be the weights the reported metrics came from: the EMA tree
-    # when --ema-decay is set (eval consumes it above), the raw params otherwise.
-    checkpoint.save_params(
-        os.path.join(config.results_dir, "model_dist.msgpack"),
-        state.ema if state.ema is not None else state.params)   # ≙ :163-164
-    if config.async_checkpoint:
-        saver.flush()
+        plotting.save_loss_curves(
+            history, os.path.join(config.images_dir, "train_test_curve_dist.png"))  # ≙ :161
+        M.save_metrics_jsonl(history, os.path.join(config.results_dir, "metrics.jsonl"))
+        # The export must be the weights the reported metrics came from: the EMA tree
+        # when --ema-decay is set (eval consumes it above), the raw params otherwise.
+        checkpoint.save_params(
+            os.path.join(config.results_dir, "model_dist.msgpack"),
+            state.ema if state.ema is not None else state.params)   # ≙ :163-164
+    finally:
+        # Drain the write-behind queue even on an exception/signal mid-run — the
+        # queued per-epoch checkpoint is the resume artifact a killed run needs,
+        # and flush() re-raises deferred background IO errors.
+        if config.async_checkpoint:
+            saver.flush()
     return state, history
 
 

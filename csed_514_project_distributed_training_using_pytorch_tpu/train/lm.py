@@ -236,29 +236,17 @@ def main(config: LMConfig = LMConfig(), *,
     if ckpt_path:
         os.makedirs(config.results_dir, exist_ok=True)
 
-    for epoch in range(start_epoch, config.epochs):
-        # (seed, epoch)-keyed permutation — the parallel/sampler contract, so resumed
-        # runs replay exactly the epochs they missed.
-        perm = np.random.default_rng(
-            np.random.SeedSequence([config.seed, epoch])).permutation(n_train)
-        plan = dp.put_global(
-            mesh,
-            perm[:steps_per_epoch * config.batch_size].astype(np.int32)
-            .reshape(steps_per_epoch, config.batch_size), P(None, "data"))
-        state, losses = epoch_fn(state, tokens_d, zeros_d, plan, dropout_rng)
-        jax.block_until_ready(state.params)
-        train_loss = float(np.asarray(jax.device_get(losses)).mean())
-        eval_params = state.ema if state.ema is not None else state.params
-        sum_nll = float(jax.device_get(eval_fn(eval_params, test_d)))
-        val_nll = sum_nll / (n_test * seq_len)
-        examples = (epoch + 1) * steps_per_epoch * config.batch_size
-        history.record_train(examples, train_loss)
-        history.record_test(examples, val_nll)
-        M.log(f"Epoch {epoch}: train_loss: {train_loss:.4f}, "
-              f"val_nll/token: {val_nll:.4f}, val_ppl: {float(np.exp(val_nll)):.3f}, "
-              f"time_elapsed: {watch.elapsed():.2f}s")
-        if ckpt_path:
-            saver.save_train_state(ckpt_path, jax.device_get(state))
+    try:
+        state = _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d,
+                            zeros_d, test_d, dropout_rng, n_train, n_test, seq_len,
+                            steps_per_epoch, start_epoch, history, watch, saver,
+                            ckpt_path)
+    finally:
+        # Drain the write-behind queue even on an exception/signal mid-run — the
+        # queued per-epoch checkpoint is the resume artifact a killed run needs,
+        # and flush() re-raises deferred background IO errors.
+        if config.async_checkpoint:
+            saver.flush()
 
     host_state = jax.device_get(state)
     if ckpt_path:
@@ -291,9 +279,38 @@ def main(config: LMConfig = LMConfig(), *,
     if config.results_dir:
         M.save_metrics_jsonl(history,
                              os.path.join(config.results_dir, "metrics.jsonl"))
-    if config.async_checkpoint:
-        saver.flush()
     return host_state, history
+
+
+def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_d,
+                dropout_rng, n_train, n_test, seq_len, steps_per_epoch, start_epoch,
+                history, watch, saver, ckpt_path):
+    """The LM trainer's epoch loop, split out so the caller can guarantee the
+    async-checkpoint flush in a ``finally`` regardless of where the loop fails."""
+    for epoch in range(start_epoch, config.epochs):
+        # (seed, epoch)-keyed permutation — the parallel/sampler contract, so resumed
+        # runs replay exactly the epochs they missed.
+        perm = np.random.default_rng(
+            np.random.SeedSequence([config.seed, epoch])).permutation(n_train)
+        plan = dp.put_global(
+            mesh,
+            perm[:steps_per_epoch * config.batch_size].astype(np.int32)
+            .reshape(steps_per_epoch, config.batch_size), P(None, "data"))
+        state, losses = epoch_fn(state, tokens_d, zeros_d, plan, dropout_rng)
+        jax.block_until_ready(state.params)
+        train_loss = float(np.asarray(jax.device_get(losses)).mean())
+        eval_params = state.ema if state.ema is not None else state.params
+        sum_nll = float(jax.device_get(eval_fn(eval_params, test_d)))
+        val_nll = sum_nll / (n_test * seq_len)
+        examples = (epoch + 1) * steps_per_epoch * config.batch_size
+        history.record_train(examples, train_loss)
+        history.record_test(examples, val_nll)
+        M.log(f"Epoch {epoch}: train_loss: {train_loss:.4f}, "
+              f"val_nll/token: {val_nll:.4f}, val_ppl: {float(np.exp(val_nll)):.3f}, "
+              f"time_elapsed: {watch.elapsed():.2f}s")
+        if ckpt_path:
+            saver.save_train_state(ckpt_path, jax.device_get(state))
+    return state
 
 
 if __name__ == "__main__":

@@ -279,19 +279,24 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     if config.use_host_pipeline:
         train_epoch = train_epoch_host_pipeline
 
-    with maybe_profile(config.profile, config.profile_dir):
-        evaluate(state, 0)                      # baseline eval, ≙ src/train.py:106
-        for epoch in range(1, config.n_epochs + 1):
-            state = train_epoch(state, epoch)
-            jax.block_until_ready(state.params)  # honest wall-clock (SURVEY.md §7c)
-            evaluate(state, epoch * n_train)
+    try:
+        with maybe_profile(config.profile, config.profile_dir):
+            evaluate(state, 0)                  # baseline eval, ≙ src/train.py:106
+            for epoch in range(1, config.n_epochs + 1):
+                state = train_epoch(state, epoch)
+                jax.block_until_ready(state.params)  # honest wall-clock (SURVEY.md §7c)
+                evaluate(state, epoch * n_train)
 
-    plotting.save_loss_curves(history,
-                              os.path.join(config.images_dir, "train_test_curve.png"))
-    M.save_metrics_jsonl(history, os.path.join(config.results_dir, "metrics.jsonl"))
-    saver.save_train_state(ckpt_path, state)
-    if config.async_checkpoint:
-        saver.flush()
+        plotting.save_loss_curves(
+            history, os.path.join(config.images_dir, "train_test_curve.png"))
+        M.save_metrics_jsonl(history, os.path.join(config.results_dir, "metrics.jsonl"))
+        saver.save_train_state(ckpt_path, state)
+    finally:
+        # Drain the write-behind queue even when the loop raises or is signalled —
+        # the queued checkpoint is exactly the killed-run artifact the per-tick
+        # policy exists for, and flush() re-raises deferred background IO errors.
+        if config.async_checkpoint:
+            saver.flush()
     return state, history
 
 

@@ -213,7 +213,10 @@ def restore_train_state_sharded(dir_path: str, reference_state: TrainState,
             f"sharded checkpoint {dir_path} was written by {process_count} "
             f"process(es) but {len(missing)} shard file(s) are absent "
             f"(e.g. {os.path.basename(missing[0])}) — shared filesystem required")
-    covered = {key: 0 for key in full}
+    # Per-element coverage masks, not a volumetric count: overlapping blocks (a
+    # writer bug, a hand-edited checkpoint) must not double-count and mask a
+    # genuinely missing region that would silently restore zeros.
+    covered = {key: np.zeros(m["shape"], bool) for key, m in meta.items()}
     for path in files:
         with open(path, "rb") as f:
             shards = serialization.msgpack_restore(f.read())
@@ -223,9 +226,8 @@ def restore_train_state_sharded(dir_path: str, reference_state: TrainState,
                 idx = tuple(slice(int(s), int(s) + n)
                             for s, n in zip(start, data.shape))
                 full[key][idx] = data
-                covered[key] += int(np.prod(data.shape, dtype=np.int64))
-    short = [k for k, n in covered.items()
-             if n < int(np.prod(meta[k]["shape"], dtype=np.int64))]
+                covered[key][idx] = True
+    short = [k for k, mask in covered.items() if not mask.all()]
     if short:
         raise ValueError(
             f"sharded checkpoint {dir_path} is missing blocks for {short[:3]}"

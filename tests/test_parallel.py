@@ -197,6 +197,29 @@ class TestHybridMesh:
         with pytest.raises(ValueError, match="pass num_slices"):
             make_hybrid_mesh(("data",), (8,), devices=devs)
 
+    def test_super_granule_merge_of_host_granules(self):
+        """num_slices < the platform's natural host granules is valid when it
+        divides them: contiguous hosts merge into DCN super-granules (hosts-per-
+        slice > 1 without the multi-slice slice_index attribute)."""
+        from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import (
+            _slice_granules,
+        )
+
+        class Dev:
+            def __init__(self, i, p):
+                self.id, self.process_index = i, p
+
+        devs = [Dev(i, i // 2) for i in range(8)]        # 4 hosts × 2 devices
+        g = _slice_granules(devs, 2)                     # 2 slices of 2 hosts each
+        assert sorted(g) == [0, 1]
+        assert [d.id for d in g[0]] == [0, 1, 2, 3]
+        assert [d.id for d in g[1]] == [4, 5, 6, 7]
+        # The natural count itself still works, and a non-divisor still errors.
+        assert sorted(len(v) for v in _slice_granules(devs, 4).values()) == [2] * 4
+        with pytest.raises(ValueError, match="topology wins"):
+            _slice_granules(devs, 3)
+
+    @pytest.mark.slow
     def test_composed_trainer_dcn_data_matches_flat_mesh(self, tmp_path):
         """--dcn-data is placement-only: same trajectory as the flat mesh."""
         from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
