@@ -6,9 +6,14 @@ the flash kernels (``ops/pallas_attention.py``) stream K/V blocks through VMEM (
 so it keeps scaling after the dense path exhausts memory — the single-chip half of the
 framework's long-context story (the cross-chip half is ``parallel/ring_attention.py``).
 
-Honest timing: each measurement fetches a scalar data-dependent on the full
-forward+backward before the clock stops (same protocol as ``utils/benchmarks.py`` —
-``block_until_ready`` alone under-reports on tunnelled PJRT backends).
+Honest timing: this backend can sit behind a tunnelled PJRT transport whose fixed
+dispatch+host-sync latency is ~70 ms — larger than a whole fwd+bwd at S ≤ 8k, so a
+one-dispatch-per-rep protocol measures the tunnel, not the kernel (the r3 capture's
+flat ~0.08 s rows at 1k-4k were exactly that). Each measurement therefore runs the
+op N times CHAINED inside one compiled ``lax.scan`` (each iteration's inputs nudged
+by the previous grads, so nothing can be hoisted or dead-code-eliminated), fetches a
+scalar data-dependent on the final iteration, and reports the two-point difference
+``(t(N2) − t(N1)) / (N2 − N1)`` — the constant dispatch+sync cost cancels exactly.
 
 Usage: ``python bench_attention.py [--out results.jsonl]`` — one JSON line per
 (impl, seq_len); dense rows appear up to the longest S that fits/compiles.
@@ -28,24 +33,54 @@ B, H, D = 1, 8, 64
 SEQ_LENS = (1024, 2048, 4096, 8192, 16384)
 DENSE_MAX_S = 8192      # [H, S, S] f32 residuals: 8k → 2 GiB of score-matrix traffic
 WARMUP, REPS = 1, 3
+MIN_DELTA = 0.25        # seconds of chained work the N2 run must add over N1
 
 
 def _measure(fn, q, k, v):
     import jax
     import jax.numpy as jnp
 
-    grad_fn = jax.jit(jax.grad(
-        lambda q, k, v: jnp.sum(fn(q, k, v, causal=True) ** 2), argnums=(0, 1, 2)))
-    for _ in range(WARMUP):
-        g = grad_fn(q, k, v)
-        float(jnp.sum(g[0][0, 0, 0]))  # device→host sync on a grad-dependent scalar
-    times = []
-    for _ in range(REPS):
-        t0 = time.perf_counter()
-        g = grad_fn(q, k, v)
-        float(jnp.sum(g[0][0, 0, 0]))
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+    grad_fn = jax.grad(
+        lambda q, k, v: jnp.sum(fn(q, k, v, causal=True) ** 2), argnums=(0, 1, 2))
+    # 1e-20 is representable in bf16's 8-bit exponent; the nudge rounds away in the
+    # add (values stay fixed) but the compiler cannot prove that, so every
+    # iteration's fwd+bwd stays live and serialized on the previous one.
+    eps = jnp.asarray(1e-20, q.dtype)
+
+    def chain(n):
+        def body(carry, _):
+            q, k, v = carry
+            gq, gk, gv = grad_fn(q, k, v)
+            return (q + eps * gq, k + eps * gk, v + eps * gv), ()
+
+        def run(q, k, v):
+            (q, _, _), _ = jax.lax.scan(body, (q, k, v), None, length=n)
+            return q
+
+        return jax.jit(run)
+
+    def timed(compiled):
+        for _ in range(WARMUP):
+            float(jnp.sum(compiled(q, k, v)[0, 0, 0]))  # grad-dependent host sync
+        times = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            float(jnp.sum(compiled(q, k, v)[0, 0, 0]))
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times))
+
+    # Grow N2 until the chained work dominates the tunnel's per-dispatch jitter
+    # (~ms): a delta below MIN_DELTA seconds would put the noise, not the kernel,
+    # in the difference.
+    n1 = 2
+    t1 = timed(chain(n1))
+    n2, t2 = n1, t1
+    while n2 < 4096:
+        n2 = min(n2 * 8, 4096)
+        t2 = timed(chain(n2))
+        if t2 - t1 >= MIN_DELTA:
+            break
+    return max((t2 - t1) / (n2 - n1), 1e-9)     # dispatch+sync cancels in the diff
 
 
 def main() -> int:
@@ -66,6 +101,10 @@ def main() -> int:
                         help="sliding-window width: flash runs the BANDED grid "
                              "(O(S*W) compute), dense applies the same band mask — "
                              "the local-attention long-context comparison")
+    parser.add_argument("--dtype", choices=("float32", "bfloat16"),
+                        default="float32",
+                        help="q/k/v dtype; bfloat16 is the training dtype and runs "
+                             "the kernels' matmuls at the MXU's native rate")
     args = parser.parse_args()
     if args.block is not None and args.block_sweep is not None:
         parser.error("--block and --block-sweep are mutually exclusive")
@@ -80,11 +119,12 @@ def main() -> int:
     all_rows = []
     for s in args.seq_lens:
         rng = np.random.default_rng(s)
-        q, k, v = (jnp.asarray(rng.normal(size=(B, s, H, D)).astype(np.float32))
+        q, k, v = (jnp.asarray(rng.normal(size=(B, s, H, D)).astype(np.float32),
+                               dtype=args.dtype)
                    for _ in range(3))
         row = {"seq_len": s, "batch": B, "heads": H, "head_dim": D,
                "platform": platform, "device_kind": device_kind, "causal": True,
-               "reps": REPS}
+               "dtype": args.dtype, "reps": REPS}
         if args.window is not None:
             row["window"] = args.window
         sweeping = args.block_sweep is not None

@@ -23,6 +23,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention impor
     full_attention,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+    dispatch_attention,
     flash_attention,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.initializers import (
@@ -44,6 +45,7 @@ __all__ = [
     "gelu",
     "full_attention",
     "flash_attention",
+    "dispatch_attention",
     "torch_kaiming_uniform",
     "torch_fan_in_uniform",
 ]

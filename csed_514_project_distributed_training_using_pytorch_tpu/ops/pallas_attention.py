@@ -54,13 +54,42 @@ from jax.experimental.pallas import tpu as pltpu
 
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
     MASK_VALUE as NEG,
+    full_attention,
     validate_window,
 )
 
-BLOCK = 128            # default query/key block rows (lane-aligned, MXU-shaped);
-                       # every kernel accepts ``block`` (a multiple of 128) for tuning —
-                       # larger blocks amortize grid/pipeline overhead per step at the
-                       # cost of more VMEM per block (see bench_attention.py --block)
+BLOCK = 128            # base block rows (lane-aligned, MXU-shaped): the layout unit the
+                       # ring merges are written against; every kernel accepts ``block``
+                       # (a multiple of 128) for tuning — larger blocks amortize
+                       # grid/pipeline overhead per step at the cost of more VMEM per
+                       # block (see bench_attention.py --block)
+
+MAX_AUTO_BLOCK = 1024  # r4 v5e sweep (bench_results/hw_r4/bench_attention_blocktune
+                       # .jsonl): per-op time falls monotonically 128→1024 at every
+                       # S >= 1024 (3.3× at S=2048), and 2048 hits the Mosaic
+                       # VMEM/compile wall — 1024 is the measured sweet spot
+
+MAX_AUTO_BLOCK_WINDOWED = 512  # banded grids do O(S·(W+block)) work, so oversize
+                               # blocks defeat the band: b512 beats b1024 1.6× at
+                               # S=8192 W=256 on v5e (same r4 capture)
+
+FLASH_MIN_SEQ = 2048   # measured flash/dense crossover on TPU v5e (same capture),
+                       # windowed and not: dense wins 1.5-5× below (XLA keeps the
+                       # whole score tile on-chip), flash wins 4.1-6.9× at and
+                       # above (21× banded at S=8192 W=256)
+
+
+def auto_block(s: int, window: int = 0) -> int:
+    """Largest lane-aligned block ≤ the measured per-regime cap that tiles ``s``
+    evenly — the measured-fastest choice per shape (see ``MAX_AUTO_BLOCK`` /
+    ``MAX_AUTO_BLOCK_WINDOWED``)."""
+    cap = MAX_AUTO_BLOCK_WINDOWED if window else MAX_AUTO_BLOCK
+    for b in (1024, 512, 256, 128):
+        if b <= min(s, cap) and s % b == 0:
+            return b
+    raise ValueError(
+        f"flash attention requires sequence length divisible by 128, got {s} "
+        f"(use ops.full_attention for odd lengths)")
 
 
 def _interpret() -> bool:
@@ -153,10 +182,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(in_range
              & _block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window))
     def _():
-        q = q_ref[0].astype(jnp.float32) * scale                           # [bq, D]
-        k_blk = k_ref[0].astype(jnp.float32)                               # [bk, D]
+        # Matmul operands keep the INPUT dtype (bf16 runs at the MXU's native
+        # rate; f32 inputs behave as before) with f32 accumulation; the softmax
+        # scale is applied to the f32 product, not the narrow operand.
+        q = q_ref[0]                                                       # [bq, D]
+        k_blk = k_ref[0]                                                   # [bk, D]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)        # [bq, bk]
+                                preferred_element_type=jnp.float32) * scale
         if causal or window:
             visible = _visibility_mask(iq, j, bq, k_ref.shape[1],
                                        causal=causal, window=window)
@@ -169,9 +201,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         if causal or window:
             p = jnp.where(visible, p, 0.0)
         corr = jnp.exp(m - m_new)
-        v_blk = v_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0]
         acc_ref[:] = (acc_ref[:] * corr
-                      + jnp.dot(p, v_blk, preferred_element_type=jnp.float32))
+                      + jnp.dot(p.astype(v_blk.dtype), v_blk,
+                                preferred_element_type=jnp.float32))
         m_ref[:] = m_new
         l_ref[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
 
@@ -255,12 +288,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     @pl.when(in_range
              & _block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window))
     def _():
-        q = q_ref[0].astype(jnp.float32)                          # [bq, D]
-        do = do_ref[0].astype(jnp.float32)                        # [bq, D]
+        # Matmul operands keep the INPUT dtype (bf16 at the MXU's native rate),
+        # f32 accumulation; softmax statistics and ds stay f32, narrowed only at
+        # the matmul boundary (the standard TPU flash-backward precision split).
+        q = q_ref[0]                                              # [bq, D]
+        do = do_ref[0]                                            # [bq, D]
         lse = jnp.transpose(lse_ref[0, 0])                        # [1,bq] -> [bq, 1]
         delta = jnp.transpose(delta_ref[0, 0])                    # [1,bq] -> [bq, 1]
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal or window:
@@ -274,7 +310,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dq_acc_ref[:] = dq_acc_ref[:] + jnp.dot(
-            ds, k_blk, preferred_element_type=jnp.float32)
+            ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32)
 
     @pl.when(step == num_steps - 1)
     def _():
@@ -305,10 +341,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     @pl.when(in_range
              & _block_live(i, ik, q_ref.shape[1], bk, causal=causal, window=window))
     def _():
-        k = k_ref[0].astype(jnp.float32)                          # [bk, D]
-        v = v_ref[0].astype(jnp.float32)                          # [bk, D]
-        q_blk = q_ref[0].astype(jnp.float32)                      # [bq, D]
-        do_blk = do_ref[0].astype(jnp.float32)
+        # Same precision split as the dq kernel: operands in the input dtype,
+        # f32 accumulation, p/ds narrowed only at the matmul boundary.
+        k = k_ref[0]                                              # [bk, D]
+        v = v_ref[0]                                              # [bk, D]
+        q_blk = q_ref[0]                                          # [bq, D]
+        do_blk = do_ref[0]
         lse_blk = jnp.transpose(lse_ref[0, 0])                    # [bq, 1]
         delta_blk = jnp.transpose(delta_ref[0, 0])                # [bq, 1]
         s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
@@ -322,13 +360,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             p = jnp.where(visible, p, 0.0)
         # dv += pᵀ · do ; dk += dsᵀ · q
         dv_acc_ref[:] = dv_acc_ref[:] + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                   # [bk, D]
         dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_blk)
         dk_acc_ref[:] = dk_acc_ref[:] + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(step == num_steps - 1)
@@ -475,14 +513,16 @@ def flash_forward_with_lse(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    causal: bool = False, block: int = BLOCK,
+                    causal: bool = False, block: int | None = None,
                     window: int | None = None) -> jax.Array:
     """Drop-in for ``ops.full_attention``: ``[B, S, H, D]`` → ``[B, S, H, D]``.
 
-    Requires ``S % block == 0`` with ``block`` a multiple of 128 (lane-aligned).
-    Differentiable via the two-kernel flash backward; usable as the transformer
-    family's ``attention_fn``. ``block`` is a pure performance knob (numerics are
-    block-invariant — pinned in tests); tune it with ``bench_attention.py --block``.
+    Requires ``S % block == 0`` with ``block`` a multiple of 128 (lane-aligned);
+    ``block=None`` (the default) picks the measured-fastest size for the shape via
+    ``auto_block``. Differentiable via the two-kernel flash backward; usable as the
+    transformer family's ``attention_fn``. ``block`` is a pure performance knob
+    (numerics are block-invariant — pinned in tests); tune it with
+    ``bench_attention.py --block``.
 
     ``window=W`` is sliding-window/local attention with ``full_attention``'s exact
     semantics (distance < W; causal restricts to the past side) — and a BANDED grid:
@@ -492,9 +532,34 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     dominated at S ≥ 64k. Out-of-band blocks cost nothing: they are never stepped.
     """
     b, s, h, d = q.shape
+    if block is None:
+        block = auto_block(s, int(window or 0))
     _check_block(s, block)
     validate_window(window)
     to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
     out3 = _make_op(bool(causal), int(block),
                     int(window or 0))(to3(q), to3(k), to3(v))
     return jnp.transpose(out3.reshape(b, h, s, d), (0, 2, 1, 3))
+
+
+def dispatch_uses_flash(s: int) -> bool:
+    """The routing predicate behind ``dispatch_attention`` — exported so callers
+    labelling measurements (bench_transformer.py) can't desync from the dispatch."""
+    return s >= FLASH_MIN_SEQ and s % 128 == 0
+
+
+def dispatch_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                       causal: bool = False,
+                       window: int | None = None) -> jax.Array:
+    """``full_attention``-compatible attention that picks the measured-faster
+    implementation per shape: XLA's dense path below ``FLASH_MIN_SEQ`` (where the
+    whole score tile stays on-chip and dense wins 1.5-5× on v5e), the flash
+    kernels at and above it (4.7-6.9× the other way; the crossover was measured
+    windowed too — 4.1× at S=2048 W=256) — so enabling ``--flash-attention`` can
+    never regress throughput the way the r3 trainer capture did (45.96 vs 86.09
+    steps/s at S=256, ``bench_results/hw_r3/bench_transformer_flash_tpu.json``).
+    Shapes the kernels cannot tile (S not a multiple of 128) also take the dense
+    path."""
+    if not dispatch_uses_flash(q.shape[1]):
+        return full_attention(q, k, v, causal=causal, window=window)
+    return flash_attention(q, k, v, causal=causal, window=window)

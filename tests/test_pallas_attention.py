@@ -163,6 +163,69 @@ def test_indivisible_sequence_rejected():
         flash_attention(q, k, v)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_bf16_forward_and_gradients_match_f32_dense(causal):
+    """The r4 kernels keep matmul operands in the INPUT dtype (bf16 on the MXU's
+    native path) with f32 accumulation — so the bf16 path must be pinned against
+    the f32 dense oracle at bf16-resolution tolerance, not just exercised as the
+    identity-astype f32 case the other tests cover."""
+    q, k, v = _qkv(seed=11)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ref = full_attention(qb.astype(jnp.float32), kb.astype(jnp.float32),
+                         vb.astype(jnp.float32), causal=causal)
+    out = flash_attention(qb, kb, vb, causal=causal)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=0.05, atol=0.03)
+
+    def loss(attn, cast):
+        return lambda q, k, v: jnp.sum(
+            jnp.sin(attn(cast(q), cast(k), cast(v), causal=causal)
+                    .astype(jnp.float32)))
+
+    g_ref = jax.grad(loss(full_attention, lambda x: x.astype(jnp.float32)),
+                     argnums=(0, 1, 2))(qb, kb, vb)
+    g_flash = jax.grad(loss(flash_attention, lambda x: x),
+                       argnums=(0, 1, 2))(qb, kb, vb)
+    for name, a, b in zip("qkv", g_ref, g_flash):
+        np.testing.assert_allclose(np.asarray(b, np.float32),
+                                   np.asarray(a, np.float32),
+                                   err_msg=name, rtol=0.1, atol=0.05)
+
+
+def test_auto_block_selection():
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+        auto_block,
+    )
+
+    assert auto_block(256) == 256
+    assert auto_block(1024) == 1024
+    assert auto_block(8192) == 1024      # capped at the measured sweet spot
+    assert auto_block(1280) == 256       # largest divisor under the cap
+    with pytest.raises(ValueError, match="divisible by 128"):
+        auto_block(200)
+
+
+def test_dispatch_attention_routes_by_crossover(monkeypatch):
+    """Below FLASH_MIN_SEQ (and for unaligned S) dispatch is exactly the dense
+    path; at and above it, the flash kernels (checked by matching each impl's own
+    output bit-for-bit, which also pins the routing)."""
+    import csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention as pa
+
+    q, k, v = _qkv(s=256, seed=7)
+    np.testing.assert_array_equal(
+        np.asarray(pa.dispatch_attention(q, k, v, causal=True)),
+        np.asarray(full_attention(q, k, v, causal=True)))
+    qo, ko, vo = _qkv(s=200, seed=8)     # unaligned: must fall to dense, not raise
+    np.testing.assert_array_equal(
+        np.asarray(pa.dispatch_attention(qo, ko, vo)),
+        np.asarray(full_attention(qo, ko, vo)))
+    monkeypatch.setattr(pa, "FLASH_MIN_SEQ", 256)
+    np.testing.assert_array_equal(
+        np.asarray(pa.dispatch_attention(q, k, v, causal=True)),
+        np.asarray(flash_attention(q, k, v, causal=True)))
+
+
 def test_as_transformer_attention_core():
     """flash_attention plugs into the transformer family as attention_fn; one optimizer
     step from shared init matches the dense-core step."""
