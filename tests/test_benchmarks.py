@@ -31,6 +31,7 @@ def test_time_epochs_protocol(tiny_ds):
     assert np.isfinite(result.final_train_loss)
 
 
+@pytest.mark.slow
 def test_time_epochs_trains():
     """Several epochs on 512 learnable synthetic digits must pull the loss well below the
     uniform-prediction level (ln 10 ≈ 2.30)."""

@@ -16,6 +16,10 @@ from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.models import lm
 
+# Heavyweight end-to-end/equivalence tests: full-suite runs only; deselect with
+# -m "not slow" for the fast single-core signal (README).
+pytestmark = pytest.mark.slow
+
 
 SMALL = dict(vocab_size=9, seq_len=16, embed_dim=32, num_layers=2, num_heads=4)
 

@@ -17,6 +17,10 @@ import pytest
 
 from csed_514_project_distributed_training_using_pytorch_tpu.train.launch import launch
 
+# Heavyweight end-to-end/equivalence tests: full-suite runs only; deselect with
+# -m "not slow" for the fast single-core signal (README).
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = "csed_514_project_distributed_training_using_pytorch_tpu"
 

@@ -100,6 +100,7 @@ def test_full_step_matches_unfused_with_dropout_off(setup):
                                    err_msg=f"velocity mismatch after step: {ka}")
 
 
+@pytest.mark.slow
 def test_batch_block_independence(setup):
     """Grid accumulation: results must not depend on the batch-block size."""
     state, x, y = setup
@@ -128,6 +129,7 @@ def test_indivisible_batch_rejected(setup):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_epoch_trajectory_pinned_to_unfused(setup):
     """One full scanned epoch (16 steps), fused kernel vs the standard flax/XLA path, with
     dropout rates 0 so both see identical math: every parameter and the velocity must track
@@ -162,6 +164,7 @@ def test_epoch_trajectory_pinned_to_unfused(setup):
                                    rtol=1e-4, atol=1e-6, err_msg=f"velocity: {k}")
 
 
+@pytest.mark.slow
 def test_trainer_with_fused_step_trains(tmp_path):
     """End-to-end single trainer with --experimental-fused-step: the whole-model kernel drives real
     epochs and the loss drops on a learnable task.  Settings (lr=0.1, 4 epochs) are chosen
@@ -190,6 +193,7 @@ def test_trainer_with_fused_step_trains(tmp_path):
     assert history.test_losses[-1] < history.test_losses[0] - 0.3
 
 
+@pytest.mark.slow
 def test_compile_probe_and_fallback(monkeypatch):
     """The probe must pass on every backend where the suite runs (interpret mode off-TPU,
     Mosaic on TPU), and the fallback path must produce a working unfused step when the

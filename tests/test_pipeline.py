@@ -15,8 +15,13 @@ from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer 
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import make_mesh
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+
     pipeline as pp,
 )
+
+# Heavyweight end-to-end/equivalence tests: full-suite runs only; deselect with
+# -m "not slow" for the fast single-core signal (README).
+pytestmark = pytest.mark.slow
 
 NUM_STAGES = 4
 

@@ -114,6 +114,7 @@ def test_dp_tp_step_matches_single_device(model, reference):
     _assert_params_match(new_state.params, ref_state.params)
 
 
+@pytest.mark.slow
 def test_three_axis_dp_sp_tp_matches_single_device(reference):
     """The headline composition: batch over 'data', sequence ring over 'seq', weights
     over 'model' — one mesh, one jitted step, same numbers."""

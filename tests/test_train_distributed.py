@@ -15,8 +15,13 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.sampler im
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.train import distributed, smoke
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+
     DistributedConfig,
 )
+
+# Heavyweight end-to-end/equivalence tests: full-suite runs only; deselect with
+# -m "not slow" for the fast single-core signal (README).
+pytestmark = pytest.mark.slow
 
 
 @pytest.fixture(scope="module")

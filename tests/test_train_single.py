@@ -12,8 +12,13 @@ from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.train import single
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+
     SingleProcessConfig,
 )
+
+# Heavyweight end-to-end/equivalence tests: full-suite runs only; deselect with
+# -m "not slow" for the fast single-core signal (README).
+pytestmark = pytest.mark.slow
 
 
 @pytest.fixture(scope="module")

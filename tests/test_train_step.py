@@ -17,6 +17,10 @@ from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.utils import checkpoint
 
+# Heavyweight end-to-end/equivalence tests: full-suite runs only; deselect with
+# -m "not slow" for the fast single-core signal (README).
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def model_state():

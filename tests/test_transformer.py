@@ -22,10 +22,15 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     make_ring_attention_fn,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+
     create_train_state,
     make_eval_fn,
     make_train_step,
 )
+
+# Heavyweight end-to-end/equivalence tests: full-suite runs only; deselect with
+# -m "not slow" for the fast single-core signal (README).
+pytestmark = pytest.mark.slow
 
 
 @pytest.fixture(scope="module")

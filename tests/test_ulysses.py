@@ -15,10 +15,15 @@ import pytest
 
 from csed_514_project_distributed_training_using_pytorch_tpu import ops
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+
     make_mesh,
     make_ulysses_attention_fn,
     ulysses_attention,
 )
+
+# Heavyweight end-to-end/equivalence tests: full-suite runs only; deselect with
+# -m "not slow" for the fast single-core signal (README).
+pytestmark = pytest.mark.slow
 
 
 def _qkv(b=2, s=32, h=8, d=8, seed=0):
