@@ -25,7 +25,6 @@ import argparse
 import functools
 import json
 import sys
-import time
 
 import numpy as np
 
@@ -59,28 +58,17 @@ def _measure(fn, q, k, v):
 
         return jax.jit(run)
 
-    def timed(compiled):
-        for _ in range(WARMUP):
-            float(jnp.sum(compiled(q, k, v)[0, 0, 0]))  # grad-dependent host sync
-        times = []
-        for _ in range(REPS):
-            t0 = time.perf_counter()
-            float(jnp.sum(compiled(q, k, v)[0, 0, 0]))
-            times.append(time.perf_counter() - t0)
-        return float(np.median(times))
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        chained_diff_time,
+    )
 
-    # Grow N2 until the chained work dominates the tunnel's per-dispatch jitter
-    # (~ms): a delta below MIN_DELTA seconds would put the noise, not the kernel,
-    # in the difference.
-    n1 = 2
-    t1 = timed(chain(n1))
-    n2, t2 = n1, t1
-    while n2 < 4096:
-        n2 = min(n2 * 8, 4096)
-        t2 = timed(chain(n2))
-        if t2 - t1 >= MIN_DELTA:
-            break
-    return max((t2 - t1) / (n2 - n1), 1e-9)     # dispatch+sync cancels in the diff
+    def synced_chain(n):
+        compiled = chain(n)
+        return lambda: float(jnp.sum(compiled(q, k, v)[0, 0, 0]))  # grad-dep sync
+
+    per_iter, _, _ = chained_diff_time(synced_chain, min_delta=MIN_DELTA,
+                                       reps=REPS, warmup=WARMUP)
+    return per_iter
 
 
 def main() -> int:

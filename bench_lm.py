@@ -20,7 +20,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 
 def main(argv=None) -> int:
@@ -46,8 +45,10 @@ def main(argv=None) -> int:
     from jax import lax
 
     from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        chained_diff_time,
         enable_compile_cache,
         peak_flops,
+        peak_hbm_bytes,
         timed_state_run,
     )
 
@@ -102,18 +103,35 @@ def main(argv=None) -> int:
     train_median = float(np.median(train_times))
     steps_per_s = args.steps / train_median
 
-    gen = jax.jit(lambda params, k: lm_mod.generate(
-        model, params, k, batch=args.gen_batch, temperature=1.0))
+    # Decode weights in the activation dtype: serving reads bf16 weights, and the
+    # weight read is the term batch amortizes (master f32 stays in the train state).
+    gen_params = jax.tree_util.tree_map(
+        lambda x: x.astype(model.dtype) if jnp.issubdtype(x.dtype, jnp.floating)
+        else x, state.params)
 
-    def timed_gen(k):
-        t0 = time.perf_counter()
-        ids = gen(state.params, k)
-        jax.device_get(ids[:, -1])                 # depends on the whole scan
-        return time.perf_counter() - t0
+    # Tunnelled PJRT dispatch+sync costs ~70 ms — comparable to a whole 784-step
+    # decode — so one-dispatch-per-rep measures the tunnel (the r3 capture's 60.4k
+    # tokens/s was mostly that). Chain R generates in one compiled scan (each
+    # fold_in's the previous tokens, so none can be elided) and report the
+    # two-point difference, exactly like bench_attention.py.
+    def gen_chain(n):
+        def body(k, _):
+            ids = lm_mod.generate(model, gen_params, k, batch=args.gen_batch,
+                                  temperature=1.0)
+            return jax.random.fold_in(k, jnp.sum(ids)), ()
 
-    timed_gen(jax.random.PRNGKey(3))               # warmup
-    gen_times = [timed_gen(jax.random.PRNGKey(4 + i)) for i in range(3)]
-    gen_median = float(np.median(gen_times))
+        def run(k):
+            return lax.scan(body, k, None, length=n)[0]
+
+        return jax.jit(run)
+
+    def synced_gen_chain(n):
+        compiled = gen_chain(n)
+        return lambda: jax.device_get(compiled(jax.random.PRNGKey(3)))
+
+    gen_median, (n1, t1), (n2, t2) = chained_diff_time(
+        synced_gen_chain, n1=1, grow=4, max_n=256)
+    gen_times = [t1, t2]
     decode_tokens_per_s = args.gen_batch * args.seq / gen_median
 
     # Model-FLOPs accounting mirrors bench_transformer.py, adjusted for this bench's
@@ -132,6 +150,23 @@ def main(argv=None) -> int:
     achieved = steps_per_s * train_flops_per_step
     dev = jax.devices()[0]
     peak = peak_flops(getattr(dev, "device_kind", "")) if dev.platform == "tpu" else None
+
+    # Decode HBM roofline: each step re-reads every layer's cached K+V prefix (the
+    # segmented scan bounds it at ceil((t+1)/SEG)·SEG rows) and the decode weights
+    # (amortized over the batch). Activations/cache-writes are negligible.
+    hd = e // args.heads
+    cache_itemsize = jnp.dtype(model.dtype).itemsize
+    # generate()'s segmented scan reads a static prefix of ceil((t+1)/SEG)·SEG cache
+    # rows at step t — average that exactly rather than charging the full length.
+    seg = lm_mod.DECODE_SEGMENT
+    avg_prefix = sum(min((t // seg + 1) * seg, args.seq)
+                     for t in range(args.seq)) / args.seq
+    cache_row_bytes = 2 * args.layers * avg_prefix * kvh * hd * cache_itemsize
+    param_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(gen_params))
+    decode_bytes_per_token = cache_row_bytes + param_bytes / args.gen_batch
+    achieved_hbm = decode_tokens_per_s * decode_bytes_per_token
+    hbm_peak = (peak_hbm_bytes(getattr(dev, "device_kind", ""))
+                if dev.platform == "tpu" else None)
     print(json.dumps({
         "metric": (f"pixel-LM train steps/s + decode tokens/s (L={args.layers}, "
                    f"d_model={args.d_model}, seq={args.seq}, batch={args.batch}, "
@@ -148,8 +183,13 @@ def main(argv=None) -> int:
         "train_seconds_per_run_all": [round(t, 4) for t in train_times],
         "train_tokens_per_s": round(steps_per_s * args.batch * args.seq),
         "decode_seconds_all": [round(t, 4) for t in gen_times],
+        "decode_chain_lengths": [n1, n2],
         "decode_tokens_per_s": round(decode_tokens_per_s, 1),
         "decode_batch": args.gen_batch,
+        "decode_bytes_per_token": round(decode_bytes_per_token),
+        "decode_achieved_hbm_bytes_per_s": round(achieved_hbm),
+        "decode_hbm_roofline_frac": (round(achieved_hbm / hbm_peak, 4)
+                                     if hbm_peak else None),
         "model_train_flops_per_step": train_flops_per_step,
         "achieved_model_flops_per_s": round(achieved),
         "mfu_vs_bf16_peak": round(achieved / peak, 6) if peak else None,

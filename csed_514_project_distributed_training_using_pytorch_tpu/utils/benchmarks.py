@@ -69,6 +69,51 @@ PEAK_FLOPS_BY_KIND = [
     ("v3", 123e12), ("v2", 45e12),
 ]
 
+# Published per-chip HBM bandwidth — the roofline batched KV-cache decode is judged
+# against (decode is bandwidth-bound: every step re-reads the cache + weights).
+PEAK_HBM_BYTES_BY_KIND = [
+    ("v6", 1640e9), ("v5p", 2765e9), ("v5", 819e9), ("v4", 1228e9),
+    ("v3", 900e9), ("v2", 700e9),
+]
+
+
+def peak_hbm_bytes(device_kind: str) -> float | None:
+    """Peak HBM bytes/s for a TPU ``device_kind`` string, or None if unknown."""
+    kind = device_kind.lower()
+    return next((peak for key, peak in PEAK_HBM_BYTES_BY_KIND if key in kind), None)
+
+
+def chained_diff_time(chain, *, n1=2, grow=8, max_n=4096, min_delta=0.25,
+                      reps=3, warmup=1):
+    """Per-iteration time of a chained computation via the two-point difference
+    ``(t(N2) − t(N1)) / (N2 − N1)`` — the honest protocol for tunnelled PJRT
+    backends, whose fixed ~70 ms dispatch+host-sync latency swamps a
+    one-dispatch-per-rep measurement of sub-100 ms ops (it cancels exactly in the
+    difference). ``chain(n)`` returns a zero-arg callable that runs the n-long
+    chained program AND blocks on a data-dependent fetch. N2 grows geometrically
+    (``grow``× per probe, capped at ``max_n``) until the chained work adds
+    ``min_delta`` seconds over N1, so per-dispatch jitter (~ms) cannot dominate the
+    difference. Returns ``(per_iter_seconds, (n1, t1), (n2, t2))``. One owner for
+    the protocol — a fix lands in every bench at once (bench_attention, bench_lm)."""
+    def timed(run):
+        for _ in range(warmup):
+            run()
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run()
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    t1 = timed(chain(n1))
+    n2, t2 = n1, t1
+    while n2 < max_n:
+        n2 = min(n2 * grow, max_n)
+        t2 = timed(chain(n2))
+        if t2 - t1 >= min_delta:
+            break
+    return max((t2 - t1) / (n2 - n1), 1e-9), (n1, t1), (n2, t2)
+
 
 def timed_state_run(run, state):
     """Time ONE compiled ``state -> (state, losses)`` program with the honest-sync
