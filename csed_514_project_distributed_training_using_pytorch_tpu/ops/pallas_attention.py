@@ -107,10 +107,24 @@ def _check_block(s: int, block: int) -> None:
             f"got {s} (use ops.full_attention for odd lengths)")
 
 
-def _visibility_mask(iq, ik, bq, bk, *, causal: bool, window: int = 0):
+def _check_offset(q_offset: int, block: int) -> None:
+    """Hop offsets must be block-quantized: the banded grids shift whole blocks
+    (ring shard lengths are multiples of BLOCK, so this holds by construction)."""
+    if q_offset % block:
+        raise ValueError(
+            f"q_offset must be a multiple of block={block}, got {q_offset}")
+
+
+def _visibility_mask(iq, ik, bq, bk, *, causal: bool, window: int = 0,
+                     q_offset: int = 0):
     """[bq, bk] visibility mask for query block iq vs key block ik (global positions):
-    causal lower-triangle and/or the sliding-window band (distance < window)."""
-    q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    causal lower-triangle and/or the sliding-window band (distance < window).
+
+    ``q_offset`` (static) shifts the QUERY positions by a global amount relative to
+    the keys — the ring hop offset: a ring caller whose local K/V block originated
+    ``delta`` shards away passes ``q_offset = delta · shard_len`` so the band/causal
+    masks act on true global positions while both operands index locally."""
+    q_pos = q_offset + iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     mask = jnp.ones((bq, bk), bool)
     if causal:
@@ -120,19 +134,21 @@ def _visibility_mask(iq, ik, bq, bk, *, causal: bool, window: int = 0):
     return mask
 
 
-def _block_live(iq, j, bq, bk, *, causal: bool, window: int = 0):
+def _block_live(iq, j, bq, bk, *, causal: bool, window: int = 0,
+                q_offset: int = 0):
     """Whether (query block iq, key block j) holds ANY visible pair — the grid-step
     skip predicate (skipped blocks cost no FLOPs; their fetch still pipelines).
-    Same expression serves the dkv kernel with (i, ik) in the (iq, j) roles."""
+    Same expression serves the dkv kernel with (i, ik) in the (iq, j) roles.
+    ``q_offset`` shifts query positions globally (see ``_visibility_mask``)."""
     live = jnp.bool_(True)
     if causal:
-        live &= j <= iq                                   # not entirely future
+        live &= j * bk <= q_offset + iq * bq + bq - 1     # not entirely future
     if window:
         # Not entirely older than the window: youngest key vs oldest query.
-        live &= iq * bq - (j * bk + bk - 1) < window
+        live &= q_offset + iq * bq - (j * bk + bk - 1) < window
         if not causal:
             # Bidirectional band: not entirely newer either.
-            live &= j * bk - (iq * bq + bq - 1) < window
+            live &= j * bk - (q_offset + iq * bq + bq - 1) < window
     return live
 
 
@@ -159,16 +175,18 @@ def _banded(window: int, causal: bool, nq: int, block: int) -> bool:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, causal, num_steps, num_blocks,
-                band_base=None, window=0):
+                band_base=None, window=0, q_offset=0):
     iq = pl.program_id(1)
     step = pl.program_id(2)
     bq = q_ref.shape[1]
     # Band-compressed grid: the step axis walks key-block OFFSETS around the query
-    # block; out-of-range offsets (clamped to a real block by the index_map) are dead.
+    # block (shifted by the hop offset when the caller's queries live q_offset
+    # positions past the keys); out-of-range offsets (clamped to a real block by
+    # the index_map) are dead.
     if band_base is None:
         j, in_range = step, jnp.bool_(True)
     else:
-        j = iq + step - band_base
+        j = iq + q_offset // bq + step - band_base
         in_range = (j >= 0) & (j < num_blocks)
 
     @pl.when(step == 0)
@@ -180,7 +198,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     # Causal/banded: key blocks with no visible pair contribute nothing — no FLOPs
     # (their fetch still pipelines; grids cannot skip steps).
     @pl.when(in_range
-             & _block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window))
+             & _block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window,
+                           q_offset=q_offset))
     def _():
         # Matmul operands keep the INPUT dtype (bf16 runs at the MXU's native
         # rate; f32 inputs behave as before) with f32 accumulation; the softmax
@@ -191,7 +210,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                                 preferred_element_type=jnp.float32) * scale
         if causal or window:
             visible = _visibility_mask(iq, j, bq, k_ref.shape[1],
-                                       causal=causal, window=window)
+                                       causal=causal, window=window,
+                                       q_offset=q_offset)
             s = jnp.where(visible, s, NEG)
         m = m_ref[:]
         l = l_ref[:]
@@ -217,22 +237,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
-                   window: int = 0):
-    """q3/k3/v3: [BH, S, D] → (out [BH, S, D], lse [BH, S/block, 1, block])."""
+                   window: int = 0, q_offset: int = 0):
+    """q3/k3/v3: [BH, S, D] → (out [BH, S, D], lse [BH, S/block, 1, block]).
+    ``q_offset`` (static, a multiple of ``block``) shifts query positions globally
+    relative to the keys — the ring hop offset (see ``_visibility_mask``)."""
     bh, s, d = q3.shape
     _check_block(s, block)
+    _check_offset(q_offset, block)
     scale = 1.0 / (d ** 0.5)
     nq = s // block
-    if _banded(window, causal, nq, block):
+    off_blocks = q_offset // block
+    if _banded(window, causal and not q_offset, nq, block):
         base = _band_reach(window, block)
-        num_steps = base + 1 if causal else 2 * base + 1
-        key_map = lambda b, i, o: (b, jnp.clip(i + o - base, 0, nq - 1), 0)
+        # A nonzero hop offset can put the whole band on one side of the local
+        # diagonal, so the causal one-sided walk applies only at offset 0.
+        num_steps = base + 1 if causal and not q_offset else 2 * base + 1
+        key_map = lambda b, i, o: (b, jnp.clip(i + off_blocks + o - base,
+                                               0, nq - 1), 0)
     else:
         base, num_steps = None, nq
         key_map = lambda b, i, j: (b, j, 0)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_steps=num_steps, num_blocks=nq, band_base=base,
-                               window=window)
+                               window=window, q_offset=q_offset)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, num_steps),
@@ -271,14 +298,14 @@ def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_acc_ref, *, scale, causal, num_steps, num_blocks,
-               band_base=None, window=0):
+               band_base=None, window=0, q_offset=0):
     iq = pl.program_id(1)
     step = pl.program_id(2)
     bq = q_ref.shape[1]
     if band_base is None:
         j, in_range = step, jnp.bool_(True)
     else:
-        j = iq + step - band_base
+        j = iq + q_offset // bq + step - band_base
         in_range = (j >= 0) & (j < num_blocks)
 
     @pl.when(step == 0)
@@ -286,7 +313,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
     @pl.when(in_range
-             & _block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window))
+             & _block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window,
+                           q_offset=q_offset))
     def _():
         # Matmul operands keep the INPUT dtype (bf16 at the MXU's native rate),
         # f32 accumulation; softmax statistics and ds stay f32, narrowed only at
@@ -301,7 +329,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                 preferred_element_type=jnp.float32) * scale
         if causal or window:
             visible = _visibility_mask(iq, j, bq, k_ref.shape[1],
-                                       causal=causal, window=window)
+                                       causal=causal, window=window,
+                                       q_offset=q_offset)
             s = jnp.where(visible, s, NEG)
         p = jnp.exp(s - lse)                                      # [bq, bk]
         if causal or window:
@@ -319,17 +348,19 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                 dk_acc_ref, dv_acc_ref, *, scale, causal, num_steps, num_blocks,
-                band_base=None, window=0):
+                band_base=None, window=0, q_offset=0):
     ik = pl.program_id(1)
     step = pl.program_id(2)
     bk = k_ref.shape[1]
     # Banded: the step axis walks QUERY-block offsets around this key block
     # (causal keys are only visible to queries at or after them, so offsets start
-    # at the diagonal: band_base == 0).
+    # at the diagonal: band_base == 0). A hop offset shifts the visible query
+    # range the OPPOSITE way: queries near global key position sit off_blocks
+    # EARLIER in their local index space.
     if band_base is None:
         i, in_range = step, jnp.bool_(True)
     else:
-        i = ik + step - band_base
+        i = ik - q_offset // bk + step - band_base
         in_range = (i >= 0) & (i < num_blocks)
 
     @pl.when(step == 0)
@@ -339,7 +370,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
     # Causal/banded: query blocks with no visible pair against this key block skip.
     @pl.when(in_range
-             & _block_live(i, ik, q_ref.shape[1], bk, causal=causal, window=window))
+             & _block_live(i, ik, q_ref.shape[1], bk, causal=causal, window=window,
+                           q_offset=q_offset))
     def _():
         # Same precision split as the dq kernel: operands in the input dtype,
         # f32 accumulation, p/ds narrowed only at the matmul boundary.
@@ -353,7 +385,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                                 preferred_element_type=jnp.float32) * scale
         if causal or window:
             visible = _visibility_mask(i, ik, q_ref.shape[1], bk,
-                                       causal=causal, window=window)
+                                       causal=causal, window=window,
+                                       q_offset=q_offset)
             s = jnp.where(visible, s, NEG)
         p = jnp.exp(s - lse_blk)                                  # [bq, bk]
         if causal or window:
@@ -388,7 +421,8 @@ def _flash_backward(res, g, *, causal: bool, block: int = BLOCK,
 
 
 def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
-                          block: int = BLOCK, window: int = 0):
+                          block: int = BLOCK, window: int = 0,
+                          q_offset: int = 0):
     """One flash-backward pass of a query-block set against a key/value-block set,
     given the GLOBAL softmax statistics: ``(dq, dk, dv)`` contributions.
 
@@ -407,15 +441,20 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
             f"flash_backward_blocks needs equal q/k block sets, got {q3.shape} vs "
             f"{k3.shape}")
     _check_block(s, block)
+    _check_offset(q_offset, block)
     scale = 1.0 / (d ** 0.5)
     nq = s // block
-    if _banded(window, causal, nq, block):
+    off_blocks = q_offset // block
+    one_sided = causal and not q_offset
+    if _banded(window, one_sided, nq, block):
         reach = _band_reach(window, block)
         # dq walks key blocks around the query block (causal: only the past side);
-        # dkv walks query blocks around the key block (causal: only the future side).
-        dq_base, dq_steps = reach, (reach + 1 if causal else 2 * reach + 1)
-        kv_base = 0 if causal else reach
-        kv_steps = reach + 1 if causal else 2 * reach + 1
+        # dkv walks query blocks around the key block (causal: only the future
+        # side). A hop offset shifts the dq walk's center forward and the dkv
+        # walk's center backward in local index space.
+        dq_base, dq_steps = reach, (reach + 1 if one_sided else 2 * reach + 1)
+        kv_base = 0 if one_sided else reach
+        kv_steps = reach + 1 if one_sided else 2 * reach + 1
     else:
         dq_base = kv_base = None
         dq_steps = kv_steps = nq
@@ -423,26 +462,28 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
     def row_i(b, i, j):
         return (b, i, 0)
 
-    def _banded_map(base):
+    def _banded_map(base, center_off=0):
         if base is None:
             return lambda b, i, j: (b, j, 0)
-        return lambda b, i, o: (b, jnp.clip(i + o - base, 0, nq - 1), 0)
+        return lambda b, i, o: (b, jnp.clip(i + center_off + o - base,
+                                            0, nq - 1), 0)
 
-    def _banded_lse_map(base):
+    def _banded_lse_map(base, center_off=0):
         if base is None:
             return lambda b, i, j: (b, j, 0, 0)
-        return lambda b, i, o: (b, jnp.clip(i + o - base, 0, nq - 1), 0, 0)
+        return lambda b, i, o: (b, jnp.clip(i + center_off + o - base,
+                                            0, nq - 1), 0, 0)
 
     row_i_spec = pl.BlockSpec((1, block, d), row_i, memory_space=pltpu.VMEM)
     lse_i_spec = pl.BlockSpec((1, 1, 1, block), lambda b, i, j: (b, i, 0, 0),
                               memory_space=pltpu.VMEM)
 
-    dq_walk = pl.BlockSpec((1, block, d), _banded_map(dq_base),
+    dq_walk = pl.BlockSpec((1, block, d), _banded_map(dq_base, off_blocks),
                            memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           num_steps=dq_steps, num_blocks=nq, band_base=dq_base,
-                          window=window),
+                          window=window, q_offset=q_offset),
         grid=(bh, nq, dq_steps),
         in_specs=[row_i_spec, dq_walk, dq_walk, row_i_spec, lse_i_spec,
                   lse_i_spec],
@@ -453,14 +494,15 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
     )(q3, k3, v3, g, lse, delta)[0]
 
     # dkv grid: axis 1 = key block (accumulators persist), axis 2 = query block.
-    kv_walk = pl.BlockSpec((1, block, d), _banded_map(kv_base),
+    kv_walk = pl.BlockSpec((1, block, d), _banded_map(kv_base, -off_blocks),
                            memory_space=pltpu.VMEM)
-    kv_lse_walk = pl.BlockSpec((1, 1, 1, block), _banded_lse_map(kv_base),
+    kv_lse_walk = pl.BlockSpec((1, 1, 1, block),
+                               _banded_lse_map(kv_base, -off_blocks),
                                memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           num_steps=kv_steps, num_blocks=nq, band_base=kv_base,
-                          window=window),
+                          window=window, q_offset=q_offset),
         grid=(bh, nq, kv_steps),
         in_specs=[kv_walk, row_i_spec, row_i_spec, kv_walk, kv_lse_walk,
                   kv_lse_walk],
@@ -500,16 +542,20 @@ def _make_op(causal: bool, block: int = BLOCK, window: int = 0):
 
 
 def flash_forward_with_lse(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
-                           causal: bool = False):
+                           causal: bool = False, window: int = 0,
+                           q_offset: int = 0):
     """Forward-only flash attention that also returns the per-row log-sum-exp:
     ``[BH, S, D]³ → (out [BH, S, D], lse [BH, S/BLOCK, 1, BLOCK])``.
 
     The lse rows are what blockwise/ring merges need to combine partial attention
     results exactly (``parallel.ring_attention.ring_flash_attention``). Not wrapped in
     the custom VJP — differentiate through ``flash_attention`` instead. Always the
-    default BLOCK: the ring merge layouts are written against it.
+    default BLOCK: the ring merge layouts are written against it. ``window`` /
+    ``q_offset`` bind the sliding band and the ring hop offset into the kernels'
+    masks (``_visibility_mask``) — the windowed ring-of-flash building block.
     """
-    return _flash_forward(q3, k3, v3, causal=causal)
+    return _flash_forward(q3, k3, v3, causal=causal, window=window,
+                          q_offset=q_offset)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,

@@ -73,9 +73,13 @@ def unstack_transformer_blocks(stacked, rest) -> dict:
     return out
 
 
+SCHEDULES = ("gpipe", "1f1b")
+
+
 def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params,
                    microbatches: jax.Array, *, axis_name: str = "stage",
-                   batch_axis: str | None = None) -> jax.Array:
+                   batch_axis: str | None = None,
+                   schedule: str = "gpipe") -> jax.Array:
     """Run ``microbatches`` through the stage pipeline.
 
     ``stage_fn(stage_params, x) -> y`` is one stage's computation with ``y.shape ==
@@ -87,6 +91,30 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params,
     dim (dim 1) over that mesh axis: each data coordinate streams its own batch slice
     through the same stage ring — PP × DP as one program, no cross-talk (every
     collective here names only ``axis_name``).
+
+    ``schedule`` selects the backward formulation (forward numerics are identical —
+    pinned in tests):
+
+    - ``"gpipe"``: reverse-mode rides the transposed scan. Simple, but autodiff banks
+      EVERY intra-stage residual of every tick — activation memory
+      O(M · layers_per_stage · per-layer residuals) per device.
+    - ``"1f1b"``: a custom VJP runs the 1F1B BACKWARD ordering — a counter-rotating
+      gradient ring where stage ``s`` applies microbatch ``u``'s backward at tick
+      ``u + (S-1-s)``, one microbatch in backward flight per device per tick, with
+      only the per-microbatch STAGE INPUT saved and intra-stage activations
+      rematerialized inside the tick's ``jax.vjp`` — activation memory
+      O(M · stage-input) regardless of stage depth. Under XLA's two-phase autodiff
+      the forward and backward are separate programs, so what 1F1B contributes here
+      is its backward schedule and its memory bound, not wall-clock overlap of
+      F and B ticks of different microbatches (that would need the loss computed
+      inside the pipelined program — the interleaved "steady state" of the paper
+      schedule).
+
+    Bubble accounting (both schedules): each phase runs ``M + S − 1`` ticks of which
+    ``S − 1`` are fill/drain on any given device — bubble fraction
+    ``(S−1)/(M+S−1)`` per phase, amortized by ``M ≫ S``. 1F1B's paper win over
+    GPipe is the memory bound above, not the bubble (identical for the
+    non-interleaved schedule).
     """
     num_stages = mesh.shape[axis_name]
     if jax.tree_util.tree_leaves(stacked_params)[0].shape[0] != num_stages:
@@ -94,6 +122,9 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params,
             f"stacked params leading dim "
             f"{jax.tree_util.tree_leaves(stacked_params)[0].shape[0]} != mesh axis "
             f"{axis_name!r} size {num_stages}")
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown pipeline schedule {schedule!r} — "
+                         f"one of {SCHEDULES}")
     num_micro = microbatches.shape[0]
     x_spec = P(*((None, batch_axis) + (None,) * (microbatches.ndim - 2)))
 
@@ -105,34 +136,124 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params,
         params = jax.tree_util.tree_map(lambda p: p[0], params_stacked)
         stage = lax.axis_index(axis_name)
         perm = [(j, (j + 1) % num_stages) for j in range(num_stages)]
+        perm_rev = [(j, (j - 1) % num_stages) for j in range(num_stages)]
 
-        def tick(carry, t):
-            x_cur, banked = carry
-            # Stage 0 ingests microbatch t (clip keeps the gather in range during drain;
-            # the value is discarded by the stage-0 select on those ticks anyway).
-            feed = xs[jnp.clip(t, 0, num_micro - 1)]
-            x_in = jnp.where(stage == 0, feed, x_cur)
-            y = stage_fn(params, x_in)
-            # The last stage banks finished microbatch t-(S-1) once the pipe has filled.
-            w = t - (num_stages - 1)
-            w_clipped = jnp.clip(w, 0, num_micro - 1)
-            do_bank = jnp.logical_and(stage == num_stages - 1, w >= 0)
-            banked = lax.dynamic_update_index_in_dim(
-                banked,
-                jnp.where(do_bank, y, lax.dynamic_index_in_dim(
-                    banked, w_clipped, 0, keepdims=False)),
-                w_clipped, 0)
-            x_next = lax.ppermute(y, axis_name, perm)
-            return (x_next, banked), None
+        def replicate_banked(banked):
+            """Only the last stage holds real outputs; the masked psum replicates.
+            Lives OUTSIDE the 1f1b custom-VJP op so shard_map's own collective
+            transpose conventions apply to it identically in both schedules."""
+            return lax.psum(
+                jnp.where(stage == num_stages - 1, banked, jnp.zeros_like(banked)),
+                axis_name)
 
-        banked0 = jnp.zeros_like(xs)
-        (_, banked), _ = lax.scan(
-            tick, (jnp.zeros_like(xs[0]), banked0),
-            jnp.arange(num_micro + num_stages - 1))
-        # Only the last stage holds real outputs; the masked psum replicates them.
-        return lax.psum(
-            jnp.where(stage == num_stages - 1, banked, jnp.zeros_like(banked)),
-            axis_name)
+        def fwd_ticks(params, xs, *, bank_inputs: bool):
+            """The forward schedule → this device's LOCAL banked outputs (real on
+            the last stage only); optionally banks each device's per-microbatch
+            STAGE INPUT (the 1F1B backward's only residual)."""
+
+            def tick(carry, t):
+                # The xin_bank slot exists only when banking (a dead xs-sized
+                # carry would otherwise ride every gpipe tick).
+                x_cur, banked = carry[:2]
+                # Stage 0 ingests microbatch t (clip keeps the gather in range during
+                # drain; the value is discarded by the stage-0 select then anyway).
+                feed = xs[jnp.clip(t, 0, num_micro - 1)]
+                x_in = jnp.where(stage == 0, feed, x_cur)
+                if bank_inputs:
+                    xin_bank = carry[2]
+                    # This device processes microbatch t - stage at tick t.
+                    w_in = t - stage
+                    w_in_c = jnp.clip(w_in, 0, num_micro - 1)
+                    keep = (w_in >= 0) & (w_in < num_micro)
+                    xin_bank = lax.dynamic_update_index_in_dim(
+                        xin_bank,
+                        jnp.where(keep, x_in, lax.dynamic_index_in_dim(
+                            xin_bank, w_in_c, 0, keepdims=False)),
+                        w_in_c, 0)
+                y = stage_fn(params, x_in)
+                # The last stage banks finished microbatch t-(S-1) once the pipe fills.
+                w = t - (num_stages - 1)
+                w_clipped = jnp.clip(w, 0, num_micro - 1)
+                do_bank = jnp.logical_and(stage == num_stages - 1, w >= 0)
+                banked = lax.dynamic_update_index_in_dim(
+                    banked,
+                    jnp.where(do_bank, y, lax.dynamic_index_in_dim(
+                        banked, w_clipped, 0, keepdims=False)),
+                    w_clipped, 0)
+                x_next = lax.ppermute(y, axis_name, perm)
+                out = (x_next, banked) + ((xin_bank,) if bank_inputs else ())
+                return out, None
+
+            banked0 = jnp.zeros_like(xs)
+            carry0 = ((jnp.zeros_like(xs[0]), banked0)
+                      + ((banked0,) if bank_inputs else ()))
+            final, _ = lax.scan(tick, carry0,
+                                jnp.arange(num_micro + num_stages - 1))
+            return final[1], (final[2] if bank_inputs else None)
+
+        if schedule == "gpipe":
+            return replicate_banked(fwd_ticks(params, xs, bank_inputs=False)[0])
+
+        @jax.custom_vjp
+        def op(params, xs):
+            return fwd_ticks(params, xs, bank_inputs=False)[0]
+
+        def op_fwd(params, xs):
+            banked, xin_bank = fwd_ticks(params, xs, bank_inputs=True)
+            return banked, (params, xin_bank)
+
+        def op_bwd(res, dys):
+            # ``dys`` is the cotangent of this device's LOCAL banked outputs: real
+            # on the last stage (the masked psum outside the op routes the true
+            # output grads there), zeros elsewhere — exactly the feed the reverse
+            # ring wants.
+            params, xin_bank = res
+            # Recomputed here, NOT closed over: the backward traces in its own
+            # context (e.g. inside the jitted epoch's grad), where the forward
+            # trace's axis_index tracer would be a leak.
+            stage = lax.axis_index(axis_name)
+            zero_params = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+            def tick(carry, u):
+                g_cur, dparams, dxs = carry
+                # The last stage ingests microbatch u's output grad at tick u;
+                # stage s applies microbatch w = u - (S-1-s)'s backward.
+                feed = dys[jnp.clip(u, 0, num_micro - 1)]
+                g_in = jnp.where(stage == num_stages - 1, feed, g_cur)
+                w = u - (num_stages - 1 - stage)
+                w_c = jnp.clip(w, 0, num_micro - 1)
+                active = (w >= 0) & (w < num_micro)
+                x_in = lax.dynamic_index_in_dim(xin_bank, w_c, 0, keepdims=False)
+                # Rematerialize the stage at its saved input — per-layer residuals
+                # live only inside this tick.
+                _, vjp_fn = jax.vjp(stage_fn, params, x_in)
+                dp_h, dx = vjp_fn(g_in)
+                dparams = jax.tree_util.tree_map(
+                    lambda a, b: a + jnp.where(active, b, jnp.zeros_like(b)),
+                    dparams, dp_h)
+                # Stage 0's dx is the pipeline-input grad for microbatch w.
+                do_bank = jnp.logical_and(stage == 0, active)
+                dxs = lax.dynamic_update_index_in_dim(
+                    dxs,
+                    jnp.where(do_bank, dx, lax.dynamic_index_in_dim(
+                        dxs, w_c, 0, keepdims=False)),
+                    w_c, 0)
+                g_next = lax.ppermute(dx, axis_name, perm_rev)
+                return (g_next, dparams, dxs), None
+
+            (_, dparams, dxs), _ = lax.scan(
+                tick, (jnp.zeros_like(dys[0]), zero_params, jnp.zeros_like(dys)),
+                jnp.arange(num_micro + num_stages - 1))
+            # Per-DEVICE cotangent contributions, exactly as autodiff of the gpipe
+            # body would produce them: dparams is this stage's local shard; dxs is
+            # real on stage 0 only (the only stage whose x_in select consumes xs) —
+            # the outer shard_map transpose combines them the same way for both
+            # schedules.
+            dxs = jnp.where(stage == 0, dxs, jnp.zeros_like(dxs))
+            return dparams, dxs
+
+        op.defvjp(op_fwd, op_bwd)
+        return replicate_banked(op(params, xs))
 
     return run(stacked_params, microbatches)
 
@@ -140,10 +261,12 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params,
 def make_pipelined_blocks_fn(mesh: Mesh, stage_fn: Callable, *,
                              axis_name: str = "stage",
                              num_microbatches: int = 8,
-                             batch_axis: str | None = None) -> Callable:
+                             batch_axis: str | None = None,
+                             schedule: str = "gpipe") -> Callable:
     """Bind a mesh/microbatch count into ``f(stacked_params, x) -> y`` over a flat
     ``[B, ...]`` batch: splits B into microbatches, pipelines them, and re-flattens.
-    ``B`` must divide by ``num_microbatches``."""
+    ``B`` must divide by ``num_microbatches``. ``schedule`` as in
+    ``pipeline_apply``."""
 
     def apply(stacked_params, x):
         b = x.shape[0]
@@ -151,7 +274,7 @@ def make_pipelined_blocks_fn(mesh: Mesh, stage_fn: Callable, *,
             raise ValueError(f"batch {b} not divisible by {num_microbatches} microbatches")
         xs = x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
         ys = pipeline_apply(mesh, stage_fn, stacked_params, xs, axis_name=axis_name,
-                            batch_axis=batch_axis)
+                            batch_axis=batch_axis, schedule=schedule)
         return ys.reshape(x.shape)
 
     return apply
@@ -177,7 +300,8 @@ class PipelinedClassifier:
     """
 
     def __init__(self, model, mesh: Mesh, *, axis_name: str = "stage",
-                 num_microbatches: int = 4, batch_axis: str | None = None):
+                 num_microbatches: int = 4, batch_axis: str | None = None,
+                 schedule: str = "gpipe"):
         from csed_514_project_distributed_training_using_pytorch_tpu.models.transformer import (
             TransformerBlock,  # lazy: models.transformer imports parallel/ at load
         )
@@ -216,7 +340,8 @@ class PipelinedClassifier:
 
         self._blocks_fn = make_pipelined_blocks_fn(
             mesh, stage_fn, axis_name=axis_name,
-            num_microbatches=num_microbatches, batch_axis=batch_axis)
+            num_microbatches=num_microbatches, batch_axis=batch_axis,
+            schedule=schedule)
 
     def apply(self, variables, x, deterministic: bool = True, rngs=None,
               mutable=None):

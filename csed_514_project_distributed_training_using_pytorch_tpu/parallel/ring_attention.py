@@ -227,15 +227,18 @@ def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq",
     shard must then divide by the flash ``BLOCK`` (128). ``use_zigzag=True`` uses the
     load-balanced zig-zag causal schedule (``zigzag_ring_attention``; causal-only).
     Both together select ``zigzag_ring_flash_attention`` — the full long-context
-    causal training composition. ``window=W`` binds sliding-window masking into the
-    einsum ring (out-of-band hops skipped); it does not compose with the zig-zag
-    schedule (a split chunk pair straddles the band) or the flash rings (the kernels'
-    band masking assumes a shared global origin, which off-diagonal hops lack)."""
-    if window and (use_flash or use_zigzag):
+    causal training composition. ``window=W`` (r4) binds sliding-window masking into
+    every schedule but the flash zig-zag: the einsum ring and the ring-of-flash skip
+    out-of-band hops (the flash ring truncates its rotations to the band's reach),
+    and the einsum zig-zag band-masks each chunk pair from global positions. The
+    remaining gap is window + zigzag + flash together — the split chunk pairs'
+    offsets are device-dependent (traced), which the kernels' static band masks
+    cannot carry; that combination raises."""
+    if window and use_flash and use_zigzag:
         raise ValueError(
-            "window composes with the plain einsum ring only — the zig-zag "
-            "schedule's split chunk pairs and the flash kernels' local-origin band "
-            "masks do not carry hop offsets")
+            "window composes with the einsum ring, the ring-of-flash, and the "
+            "einsum zig-zag — not the flash zig-zag (its chunk-pair offsets are "
+            "traced; the kernels' band masks are static). Drop one flag.")
 
     def attention_fn(q, k, v, *, causal: bool = False):
         if use_zigzag:
@@ -245,10 +248,11 @@ def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq",
             if use_flash:
                 return zigzag_ring_flash_attention(mesh, q, k, v,
                                                    axis_name=axis_name)
-            return zigzag_ring_attention(mesh, q, k, v, axis_name=axis_name)
+            return zigzag_ring_attention(mesh, q, k, v, axis_name=axis_name,
+                                         window=window)
         if use_flash:
             return ring_flash_attention(mesh, q, k, v, axis_name=axis_name,
-                                        causal=causal)
+                                        causal=causal, window=window)
         return ring_attention(mesh, q, k, v, axis_name=axis_name, causal=causal,
                               window=window)
 
@@ -268,7 +272,7 @@ def _zigzag_order(n: int) -> tuple[list, list]:
 
 
 def zigzag_ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
-                          axis_name: str = "seq") -> jax.Array:
+                          axis_name: str = "seq", window: int = 0) -> jax.Array:
     """Load-balanced CAUSAL ring attention via zig-zag chunk pairing.
 
     The naive causal ring leaves device ``i`` with ``i+1`` live hops out of ``n`` —
@@ -291,6 +295,12 @@ def zigzag_ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, 
     amortize by keeping activations in the zig-zag layout between layers.
     ``S % (2n) == 0`` required. Differentiable through scan/switch/ppermute — no
     custom VJP needed (einsum formulation).
+
+    ``window=W`` (r4) binds the sliding causal band: every chunk-pair combination
+    masks with GLOBAL positions rebuilt from the (traced) chunk ids, and pairs whose
+    closest elements sit outside the band skip their einsums via ``lax.cond`` — the
+    windowed-context-parallelism hop-skipping, applied per chunk pair (a device's
+    work falls to the O(W) live pairs once W ≲ a few chunks).
     """
     n = mesh.shape[axis_name]
     b, s, h, d = q.shape
@@ -320,7 +330,21 @@ def zigzag_ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, 
 
         def pair_fold(carry, qx, k_blk, v_blk, q_chunk, k_chunk):
             """Fold one (query-chunk, key-chunk) pair whose case varies by hop:
-            future → skip, past → unmasked, equal → within-chunk diagonal mask."""
+            future → skip, past → unmasked, equal → within-chunk diagonal mask.
+            Windowed: global positions rebuilt from the chunk ids drive the band
+            mask, and band-dead pairs skip their einsums via ``lax.cond``."""
+            if window:
+                rel = ((q_chunk * c + jnp.arange(c))[:, None]
+                       - (k_chunk * c + jnp.arange(c))[None, :])
+                visible = (rel >= 0) & (rel < window)
+                delta = q_chunk - k_chunk
+                live = (delta >= 0) & ((delta - 1) * c + 1 < window)
+                return lax.cond(
+                    live,
+                    lambda a: _online_softmax_update(a[:3], qx, a[3], a[4],
+                                                     visible),
+                    lambda a: a[:3],
+                    (*carry, k_blk, v_blk))
             return lax.switch(
                 _case_index(k_chunk, q_chunk),
                 [lambda a: a[:3],
@@ -336,10 +360,13 @@ def zigzag_ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, 
             # Of the 4 chunk-pair combinations, two are statically decided: the early
             # query chunk never sees the late key chunk (my ≤ n-1 < n ≤ 2n-1-o —
             # skipped outright, no switch), and the late query chunk always sees the
-            # early key chunk in full (2n-1-my ≥ n > o). Only the early-vs-early and
-            # late-vs-late pairs vary by hop.
+            # early key chunk in full (2n-1-my ≥ n > o) — unless a window bands it,
+            # in which case it routes through pair_fold like the varying pairs.
             ca = pair_fold(ca, qa, ko, vo, my_index, o)
-            cb = _online_softmax_update(cb, qb, ko, vo, None)
+            if window:
+                cb = pair_fold(cb, qb, ko, vo, 2 * n - 1 - my_index, o)
+            else:
+                cb = _online_softmax_update(cb, qb, ko, vo, None)
             cb = pair_fold(cb, qb, k2, v2, 2 * n - 1 - my_index, 2 * n - 1 - o)
             return (ca, cb, lax.ppermute(k_cur, axis_name, perm),
                     lax.ppermute(v_cur, axis_name, perm)), None
@@ -355,7 +382,10 @@ def zigzag_ring_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, 
         ko, k2 = k_last[:, :c], k_last[:, c:]
         vo, v2 = v_last[:, :c], v_last[:, c:]
         ca = pair_fold(ca, qa, ko, vo, my_index, o)
-        cb = _online_softmax_update(cb, qb, ko, vo, None)
+        if window:
+            cb = pair_fold(cb, qb, ko, vo, 2 * n - 1 - my_index, o)
+        else:
+            cb = _online_softmax_update(cb, qb, ko, vo, None)
         cb = pair_fold(cb, qb, k2, v2, 2 * n - 1 - my_index, 2 * n - 1 - o)
 
         def finish(carry):
@@ -408,6 +438,160 @@ def _flash_finish(carry):
     acc, m, l = carry
     l_safe = jnp.where(l == 0.0, 1.0, l)
     return acc / l_safe, m + jnp.log(l_safe)
+
+
+def _window_hop_reach(window: int, shard_len: int) -> int:
+    """Max |shard delta| with any in-band pair: blocks ``delta`` shards apart have
+    closest-pair distance ``(delta-1)·C + 1``, so the ring only needs
+    ``min(reach, n-1)`` hops per direction — compute AND communication are O(W·C)."""
+    if window <= 1:
+        return 0
+    return (window - 2) // shard_len + 1
+
+
+@functools.lru_cache(maxsize=None)
+def _make_windowed_ring_flash_op(axis_name: str, n: int, causal: bool,
+                                 window: int, shard_len: int):
+    """Per-device WINDOWED ring-of-flash op on ``[BH, C, D]`` (f32) operands, with a
+    custom VJP — sliding-band attention over a sequence sharded across the ring.
+
+    Each hop's K/V block originated a STATIC shard delta away (the hop loop is
+    unrolled — ``n`` is static), so its global offset ``delta·C`` enters the flash
+    kernels' band masks as the static ``q_offset`` (``ops.pallas_attention``), and
+    band-dead deltas are skipped at trace time. The ring is TRUNCATED to the band's
+    hop reach and runs BIDIRECTIONALLY for non-causal windows (forward hops cover
+    past-side blocks, reverse hops future-side), so both compute and ICI traffic
+    are O(W·C) per device instead of O(S·C) — the flash counterpart of the einsum
+    ring's windowed hop-skipping. Per-device wraparound (a hop whose block sits on
+    the sequence's other end) switches to the wrapped delta's offset via
+    ``lax.cond``; under a causal window wrapped forward blocks are future and skip.
+
+    Backward mirrors the truncated schedule: per live hop the blockwise backward
+    runs with the same static offset, dk/dv accumulators ride with their K/V
+    blocks, and after the truncated walk they rotate straight home (``reach``
+    reverse hops) instead of completing the full circle.
+    """
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
+        pallas_attention as pa,
+    )
+
+    fwd_perm = [(j, (j + 1) % n) for j in range(n)]
+    rev_perm = [(j, (j - 1) % n) for j in range(n)]
+    reach = _window_hop_reach(window, shard_len)
+    hops_fwd = min(reach, n - 1)
+    hops_rev = 0 if causal else min(reach, n - 1 - hops_fwd)
+
+    def _live(delta: int) -> bool:
+        return delta == 0 or (abs(delta) - 1) * shard_len + 1 < window
+
+    def _hop_deltas(t: int, reverse: bool):
+        """(no-wrap delta, wrap delta) for hop t in the given direction."""
+        return (-t, n - t) if reverse else (t, t - n)
+
+    def _forward(q3, k3, v3):
+        bh, sq, d = q3.shape
+        nq = sq // pa.BLOCK
+        my_index = lax.axis_index(axis_name)
+
+        def merge(carry, k_blk, v_blk, *, flag, off):
+            return _flash_merge(carry, *pa.flash_forward_with_lse(
+                q3, k_blk, v_blk, causal=flag, window=window,
+                q_offset=off * shard_len))
+
+        def fold(carry, k_blk, v_blk, t: int, reverse: bool):
+            d_nw, d_w = _hop_deltas(t, reverse)
+            live_nw = _live(d_nw) and not (causal and d_nw < 0)
+            live_w = _live(d_w) and not (causal and d_w < 0)
+            br_nw = ((lambda c, kb, vb: merge(c, kb, vb, flag=False, off=d_nw))
+                     if live_nw else (lambda c, kb, vb: c))
+            br_w = ((lambda c, kb, vb: merge(c, kb, vb, flag=False, off=d_w))
+                    if live_w else (lambda c, kb, vb: c))
+            wrapped = (my_index + t >= n) if reverse else (my_index < t)
+            return lax.cond(wrapped, br_w, br_nw, carry, k_blk, v_blk)
+
+        acc0 = jnp.zeros((bh, sq, d), jnp.float32)
+        m0 = jnp.full((bh, sq, 1), MASK_VALUE, jnp.float32)
+        l0 = jnp.zeros((bh, sq, 1), jnp.float32)
+        # Diagonal block: local origin, ordinary causal/band masking.
+        carry = _flash_merge((acc0, m0, l0), *pa.flash_forward_with_lse(
+            q3, k3, v3, causal=causal, window=window))
+        k_cur, v_cur = k3, v3
+        for t in range(1, hops_fwd + 1):       # unrolled: offsets are static
+            k_cur = lax.ppermute(k_cur, axis_name, fwd_perm)
+            v_cur = lax.ppermute(v_cur, axis_name, fwd_perm)
+            carry = fold(carry, k_cur, v_cur, t, reverse=False)
+        k_cur, v_cur = k3, v3
+        for t in range(1, hops_rev + 1):
+            k_cur = lax.ppermute(k_cur, axis_name, rev_perm)
+            v_cur = lax.ppermute(v_cur, axis_name, rev_perm)
+            carry = fold(carry, k_cur, v_cur, t, reverse=True)
+        out3, lse_rows = _flash_finish(carry)
+        return out3, lse_rows.reshape(bh, nq, pa.BLOCK)[:, :, None, :]
+
+    @jax.custom_vjp
+    def op(q3, k3, v3):
+        return _forward(q3, k3, v3)[0]
+
+    def fwd(q3, k3, v3):
+        out3, lse4 = _forward(q3, k3, v3)
+        return out3, (q3, k3, v3, out3, lse4)
+
+    def bwd(res, g):
+        q3, k3, v3, out3, lse4 = res
+        bh, sq, d = q3.shape
+        nq = sq // pa.BLOCK
+        my_index = lax.axis_index(axis_name)
+        g = g.astype(jnp.float32)
+        delta4 = jnp.sum(g * out3, axis=-1).reshape(bh, nq, pa.BLOCK)[:, :, None, :]
+
+        def contrib(k_blk, v_blk, *, flag, off):
+            return pa.flash_backward_blocks(
+                q3, k_blk, v_blk, g, lse4, delta4, causal=flag, window=window,
+                q_offset=off * shard_len)
+
+        zeros3 = lambda a: (jnp.zeros_like(q3), jnp.zeros_like(a),
+                            jnp.zeros_like(a))
+
+        def hop_contrib(k_blk, v_blk, t: int, reverse: bool):
+            d_nw, d_w = _hop_deltas(t, reverse)
+            live_nw = _live(d_nw) and not (causal and d_nw < 0)
+            live_w = _live(d_w) and not (causal and d_w < 0)
+            br_nw = ((lambda kb, vb: contrib(kb, vb, flag=False, off=d_nw))
+                     if live_nw else (lambda kb, vb: zeros3(kb)))
+            br_w = ((lambda kb, vb: contrib(kb, vb, flag=False, off=d_w))
+                    if live_w else (lambda kb, vb: zeros3(kb)))
+            wrapped = (my_index + t >= n) if reverse else (my_index < t)
+            return lax.cond(wrapped, br_w, br_nw, k_blk, v_blk)
+
+        # Diagonal.
+        dq, dk_d, dv_d = pa.flash_backward_blocks(
+            q3, k3, v3, g, lse4, delta4, causal=causal, window=window)
+
+        def walk(perm_out, perm_home, hops, reverse):
+            """One direction's truncated walk: K/V and their dk/dv accumulators
+            rotate together; after the walk the accumulators rotate straight home."""
+            nonlocal dq
+            k_cur, v_cur = k3, v3
+            dk_t = jnp.zeros_like(k3)
+            dv_t = jnp.zeros_like(v3)
+            for t in range(1, hops + 1):
+                k_cur = lax.ppermute(k_cur, axis_name, perm_out)
+                v_cur = lax.ppermute(v_cur, axis_name, perm_out)
+                dk_t = lax.ppermute(dk_t, axis_name, perm_out)
+                dv_t = lax.ppermute(dv_t, axis_name, perm_out)
+                dq_h, dk_h, dv_h = hop_contrib(k_cur, v_cur, t, reverse)
+                dq, dk_t, dv_t = dq + dq_h, dk_t + dk_h, dv_t + dv_h
+            for _ in range(hops):
+                dk_t = lax.ppermute(dk_t, axis_name, perm_home)
+                dv_t = lax.ppermute(dv_t, axis_name, perm_home)
+            return dk_t, dv_t
+
+        dk_f, dv_f = walk(fwd_perm, rev_perm, hops_fwd, reverse=False)
+        dk_r, dv_r = walk(rev_perm, fwd_perm, hops_rev, reverse=True)
+        return dq, dk_d + dk_f + dk_r, dv_d + dv_f + dv_r
+
+    op.defvjp(fwd, bwd)
+    return op
 
 
 @functools.lru_cache(maxsize=None)
@@ -528,7 +712,8 @@ def _make_ring_flash_op(axis_name: str, n: int, causal: bool):
 
 
 def ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
-                         axis_name: str = "seq", causal: bool = False) -> jax.Array:
+                         axis_name: str = "seq", causal: bool = False,
+                         window: int = 0) -> jax.Array:
     """Ring-of-flash: sequence-parallel attention whose per-hop block math runs through
     the Pallas flash kernels (``ops/pallas_attention.py``) instead of dense einsums.
 
@@ -550,6 +735,11 @@ def ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *
     training composes with sequence parallelism. Per-device sequence shard must divide
     by the flash BLOCK (128), i.e. ``S % (shards · 128) == 0``. On a composed mesh the
     batch/head dims co-shard over ``data``/``model`` (``_qkv_spec``).
+
+    ``window=W`` (r4) selects the WINDOWED ring-of-flash: each hop's static shard
+    offset enters the kernels' band masks (``q_offset``), and the ring truncates to
+    the band's hop reach — bidirectional for non-causal windows — so compute and
+    ICI traffic are O(W·C) per device (``_make_windowed_ring_flash_op``).
     """
     from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
         pallas_attention as pa,
@@ -561,8 +751,14 @@ def ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *
         raise ValueError(
             f"ring_flash_attention needs sequence length divisible by "
             f"shards·BLOCK = {n}·{pa.BLOCK}, got {s}")
+    if window < 0:
+        raise ValueError(f"window must be >= 0 (0 = full attention), got {window}")
     spec = _qkv_spec(mesh, q.shape, axis_name)
-    op = _make_ring_flash_op(axis_name, n, bool(causal))
+    if window:
+        op = _make_windowed_ring_flash_op(axis_name, n, bool(causal),
+                                          int(window), s // n)
+    else:
+        op = _make_ring_flash_op(axis_name, n, bool(causal))
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
              check_vma=False)

@@ -55,7 +55,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_atten
 
 def ulysses_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
                       axis_name: str = "seq", causal: bool = False,
-                      use_flash: bool = False) -> jax.Array:
+                      use_flash: bool = False, window: int = 0) -> jax.Array:
     """Sequence-parallel attention via head-scatter all-to-all.
 
     ``q, k, v: [B, S, H, D]`` with S sharded over ``axis_name``; drop-in equivalent of
@@ -68,6 +68,11 @@ def ulysses_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
     On a composed mesh the batch/head dims co-shard over ``data``/``model``
     (``_qkv_spec``, shared with the ring family) — the head-divisibility requirement
     then applies to the model-sharded local head count ``H / model_axis``.
+
+    ``window=W`` (r4) is sliding-window attention: the device holds the full
+    sequence after the first all-to-all, so the band needs no hop-offset plumbing —
+    it binds straight into the local op (the banded flash grid or the dense band
+    mask), same semantics as ``ops.full_attention(window=W)``.
     """
     n = mesh.shape[axis_name]
     b, s, h, d = q.shape
@@ -94,6 +99,8 @@ def ulysses_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
         local_op = pa.flash_attention
     else:
         local_op = ops.full_attention
+    if window:
+        local_op = partial(local_op, window=window)
 
     @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
              check_vma=False)
@@ -114,14 +121,16 @@ def ulysses_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *,
 
 
 def make_ulysses_attention_fn(mesh: Mesh, *, axis_name: str = "seq",
-                              use_flash: bool = False):
+                              use_flash: bool = False, window: int = 0):
     """Bind a mesh into a ``(q, k, v, *, causal) -> out`` callable with
     ``ops.full_attention``'s exact signature — the injection point for
     ``models/transformer.py``'s pluggable ``attention_fn``, mirroring
-    ``make_ring_attention_fn``."""
+    ``make_ring_attention_fn``. ``window`` binds sliding-window masking into the
+    local op (see ``ulysses_attention``)."""
 
     def attention_fn(q, k, v, *, causal: bool = False):
         return ulysses_attention(mesh, q, k, v, axis_name=axis_name,
-                                 causal=causal, use_flash=use_flash)
+                                 causal=causal, use_flash=use_flash,
+                                 window=window)
 
     return attention_fn

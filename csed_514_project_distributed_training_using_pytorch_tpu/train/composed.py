@@ -91,25 +91,25 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         raise ValueError(f"--kv-heads {config.kv_heads} must be a positive divisor "
                          f"of the transformer's {TransformerClassifier.num_heads} "
                          f"heads")
-    # Fail fast (pre-data, pre-rendezvous): sliding windows compose with the
-    # single-chip dense/flash cores AND the plain einsum ring (r3 — windowed
-    # context parallelism: out-of-band hops skip their einsums), but not with the
-    # zig-zag/flash ring schedules or ulysses.
+    # Fail fast (pre-data, pre-rendezvous): sliding windows compose with every
+    # attention schedule except the flash zig-zag (r4 — see the guard below).
     if config.attention_window:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
             validate_window,
         )
         validate_window(config.attention_window)
-        seq_gt1 = dict(zip(axis_names, axis_sizes)).get("seq", 1) > 1
-        if config.zigzag_attention or (
-                seq_gt1 and (config.flash_attention
-                             or config.seq_impl == "ulysses")):
+        if config.zigzag_attention and config.flash_attention:
+            # r4: the window composes with every other schedule — the einsum ring,
+            # the ring-of-flash (static hop offsets in the kernels' band masks,
+            # truncated ring), the einsum zig-zag (global-position chunk masks),
+            # and ulysses (full sequence local). Only the flash zig-zag remains:
+            # its chunk-pair offsets are traced, which the kernels' static band
+            # masks cannot carry.
             raise ValueError(
-                "--attention-window composes with the single-chip dense/flash "
-                "cores and the plain einsum ring (a seq axis WITHOUT "
-                "--flash-attention/--zigzag-attention/--seq-impl ulysses) — the "
-                "zig-zag schedule's split chunk pairs and the flash/ulysses "
-                "local ops do not carry hop-offset band masks")
+                "--attention-window composes with every schedule except "
+                "--zigzag-attention --flash-attention together (the flash "
+                "zig-zag's chunk-pair offsets are traced; the kernels' band "
+                "masks are static) — drop one of the two flags")
     n_mesh_devices = int(np.prod(axis_sizes))
     info = initialize_cluster()   # no-op single-process; multi-host rendezvous otherwise
 
@@ -207,7 +207,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         # selects the flash kernel as the full-sequence local op. Without a seq axis
         # the impl choice is moot and the flash/dense chain below applies unchanged.
         attention_fn = make_ulysses_attention_fn(
-            mesh, use_flash=config.flash_attention)
+            mesh, use_flash=config.flash_attention,
+            window=config.attention_window)
     elif config.zigzag_attention:
         if not config.causal:
             raise ValueError("--zigzag-attention is causal-only — add --causal")
@@ -232,7 +233,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                 raise ValueError(
                     f"--zigzag-attention needs seq_len divisible by 2·seq_axis = "
                     f"{2 * max(seq_size, 1)}, got {config.seq_len}")
-            attention_fn = make_ring_attention_fn(mesh, use_zigzag=True)
+            attention_fn = make_ring_attention_fn(
+                mesh, use_zigzag=True, window=config.attention_window)
     elif config.flash_attention:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
             pallas_attention as pa,
@@ -247,7 +249,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         # FLASH_MIN_SEQ, flash at and above — the flag can never regress throughput;
         # windowed/banded when requested).
         if seq_size > 1:
-            attention_fn = make_ring_attention_fn(mesh, use_flash=True)
+            attention_fn = make_ring_attention_fn(
+                mesh, use_flash=True, window=config.attention_window)
         elif config.attention_window:
             import functools
             attention_fn = functools.partial(
@@ -333,7 +336,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         # standard per-name layout at the end.
         engine = pipeline.PipelinedClassifier(
             model, mesh, num_microbatches=config.pipeline_microbatches,
-            batch_axis="data" if data_size > 1 else None)
+            batch_axis="data" if data_size > 1 else None,
+            schedule=config.pipeline_schedule)
         def to_stacked(tree):
             stacked, rest = pipeline.stack_transformer_blocks(tree, model.num_layers)
             return {"blocks": stacked, "rest": rest}
@@ -365,7 +369,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         # semantics), so the eval engine pipelines without data-sharded microbatches.
         eval_model = pipeline.PipelinedClassifier(
             model, mesh, num_microbatches=config.pipeline_microbatches,
-            batch_axis=None)
+            batch_axis=None, schedule=config.pipeline_schedule)
     else:
         state = tp.shard_train_state(mesh, base_state)
         epoch_fn = tp.compile_epoch_tp(

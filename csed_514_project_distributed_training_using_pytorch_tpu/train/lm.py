@@ -109,10 +109,8 @@ def main(config: LMConfig = LMConfig(), *,
     seq_size = mesh.shape.get("seq", 1)
     if config.zigzag_attention and seq_size < 2:
         raise ValueError("--zigzag-attention needs a seq axis in --mesh")
-    if config.attention_window and seq_size > 1 and config.zigzag_attention:
-        raise ValueError("--attention-window composes with the plain einsum ring "
-                         "only — the zig-zag schedule's split chunk pairs do not "
-                         "carry hop-offset band masks; drop --zigzag-attention")
+    # r4: --attention-window composes with the zig-zag schedule too (global-
+    # position chunk-pair band masks in zigzag_ring_attention) — no guard needed.
     if config.batch_size % world:
         raise ValueError(f"batch {config.batch_size} not divisible by data axis "
                          f"{world}")

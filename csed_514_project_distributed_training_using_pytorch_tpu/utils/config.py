@@ -192,6 +192,11 @@ class ComposedConfig:
                                         # axis (bubble fraction (S-1)/(M+S-1));
                                         # batch_size must divide by it, and the
                                         # microbatch by the data axis
+    pipeline_schedule: str = "gpipe"    # backward formulation under a stage axis:
+                                        # 'gpipe' (autodiff through the scan) or
+                                        # '1f1b' (custom-VJP reverse ring, stage-
+                                        # input-only residuals + in-tick remat —
+                                        # parallel/pipeline.py docstring)
     bf16: bool = False                  # bfloat16 activations (f32 master weights;
                                         # see SingleProcessConfig.bf16)
     remat_policy: str = ""              # see SingleProcessConfig.remat_policy

@@ -193,6 +193,49 @@ def test_bf16_forward_and_gradients_match_f32_dense(causal):
                                    err_msg=name, rtol=0.1, atol=0.05)
 
 
+@pytest.mark.parametrize("q_offset", [256, -256])
+@pytest.mark.parametrize("window", [100, 300])
+def test_q_offset_block_pair_matches_manual(q_offset, window):
+    """The ring hop building block: a q-block set attending a k-block set whose
+    global positions differ by a static q_offset must equal the manually-masked
+    dense computation on the same band (rows with no visible key normalize to 0 —
+    the ring merge never consumes them). Exercises the offset-shifted band masks
+    and the banded grid's shifted center in one shot."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+        flash_forward_with_lse,
+    )
+
+    bh, s, d = 2, 256, 32
+    rng = np.random.default_rng(23)
+    q3, k3, v3 = (jnp.asarray(rng.normal(size=(bh, s, d)).astype(np.float32))
+                  for _ in range(3))
+    out, _ = flash_forward_with_lse(q3, k3, v3, causal=False, window=window,
+                                    q_offset=q_offset)
+
+    rel = (q_offset + np.arange(s))[:, None] - np.arange(s)[None, :]
+    visible = np.abs(rel) < window
+    scores = np.einsum("bqd,bkd->bqk", np.asarray(q3),
+                       np.asarray(k3)) / np.sqrt(d)
+    scores = np.where(visible, scores, -np.inf)
+    with np.errstate(invalid="ignore", over="ignore"):
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p = np.nan_to_num(p, nan=0.0)
+        denom = p.sum(-1, keepdims=True)
+        ref = np.einsum("bqk,bkd->bqd", p / np.where(denom == 0, 1, denom),
+                        np.asarray(v3))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_q_offset_validation():
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+        flash_forward_with_lse,
+    )
+
+    q3 = jnp.zeros((1, 256, 32))
+    with pytest.raises(ValueError, match="multiple of block"):
+        flash_forward_with_lse(q3, q3, q3, window=64, q_offset=100)
+
+
 def test_auto_block_selection():
     from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
         auto_block,

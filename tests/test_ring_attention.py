@@ -308,9 +308,88 @@ def test_windowed_ring_matches_dense(seq_mesh, causal, window):
 
 def test_windowed_ring_guards(seq_mesh):
     q, k, v = _qkv(seed=9)
-    with pytest.raises(ValueError, match="einsum ring only"):
-        make_ring_attention_fn(seq_mesh, window=5, use_flash=True)
-    with pytest.raises(ValueError, match="einsum ring only"):
-        make_ring_attention_fn(seq_mesh, window=5, use_zigzag=True)
+    # r4: the window composes with the ring-of-flash and the einsum zig-zag; only
+    # the flash zig-zag (traced chunk-pair offsets) remains out.
+    with pytest.raises(ValueError, match="flash zig-zag"):
+        make_ring_attention_fn(seq_mesh, window=5, use_flash=True,
+                               use_zigzag=True)
     with pytest.raises(ValueError, match="window"):
         ring_attention(seq_mesh, q, k, v, window=-1)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("window", [100, 300])
+def test_windowed_ring_of_flash_matches_dense(causal, window):
+    """Windowed ring-of-flash (r4): each hop's static shard offset rides into the
+    flash kernels' band masks (``q_offset``) and the ring truncates to the band's
+    hop reach (bidirectional when non-causal) — forward AND gradients equal the
+    dense windowed oracle. s=512 over 4 shards → chunk=128: window=100 keeps only
+    neighbor hops live (the truncation path), window=300 spans several hops with
+    partial bands (offset masks cutting inside blocks)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        ring_flash_attention,
+    )
+
+    mesh = make_mesh(4, axis_names=("seq",))
+    q, k, v = _qkv(s=4 * 128, h=2, d=8, seed=13)
+    ref = ops.full_attention(q, k, v, causal=causal, window=window)
+    out = ring_flash_attention(mesh, q, k, v, causal=causal, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    def make_loss(attn):
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v)))
+
+    ref_grads = jax.grad(make_loss(lambda q, k, v: ops.full_attention(
+        q, k, v, causal=causal, window=window)), argnums=(0, 1, 2))(q, k, v)
+    got_grads = jax.grad(make_loss(lambda q, k, v: ring_flash_attention(
+        mesh, q, k, v, causal=causal, window=window)), argnums=(0, 1, 2))(q, k, v)
+    for name, g_ref, g_got in zip("qkv", ref_grads, got_grads):
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                                   err_msg=name, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [3, 9, 21])
+def test_windowed_zigzag_matches_dense(seq_mesh, window):
+    """Windowed einsum zig-zag (r4): chunk-pair band masks from global positions
+    plus band-liveness skipping equal the dense windowed causal oracle — forward
+    AND gradients. s=32 over 8 shards → chunk pairs of 2: window=3 exercises
+    band-dead pairs, 9 partial bands, 21 nearly-full visibility."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        zigzag_ring_attention,
+    )
+
+    q, k, v = _qkv(seed=17)
+    ref = ops.full_attention(q, k, v, causal=True, window=window)
+    out = zigzag_ring_attention(seq_mesh, q, k, v, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    def make_loss(attn):
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v)))
+
+    ref_grads = jax.grad(make_loss(lambda q, k, v: ops.full_attention(
+        q, k, v, causal=True, window=window)), argnums=(0, 1, 2))(q, k, v)
+    got_grads = jax.grad(make_loss(lambda q, k, v: zigzag_ring_attention(
+        seq_mesh, q, k, v, window=window)), argnums=(0, 1, 2))(q, k, v)
+    for name, g_ref, g_got in zip("qkv", ref_grads, got_grads):
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                                   err_msg=name, rtol=1e-4, atol=1e-5)
+
+
+def test_windowed_attention_fn_routes_all_schedules(seq_mesh):
+    """make_ring_attention_fn(window=W) returns a working attention_fn for the
+    einsum ring, the ring-of-flash, and the einsum zig-zag — all matching the same
+    dense windowed oracle (the trainer's flag-combination surface)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        make_mesh as mk,
+    )
+
+    mesh = mk(4, axis_names=("seq",))
+    q, k, v = _qkv(s=4 * 128, h=2, d=8, seed=19)
+    ref = ops.full_attention(q, k, v, causal=True, window=200)
+    for kwargs in ({}, {"use_flash": True}, {"use_zigzag": True}):
+        fn = make_ring_attention_fn(mesh, window=200, **kwargs)
+        np.testing.assert_allclose(
+            np.asarray(fn(q, k, v, causal=True)), np.asarray(ref),
+            rtol=1e-5, atol=1e-5, err_msg=str(kwargs))

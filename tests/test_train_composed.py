@@ -269,15 +269,38 @@ def test_ulysses_rejects_zigzag(tiny_datasets):
                       datasets=tiny_datasets)
 
 
-def test_attention_window_rejects_nonring_seq_schedules(tiny_datasets):
-    """The window composes with the plain einsum ring (r3); the flash/ulysses/
-    zig-zag schedules still reject it."""
-    for kw in (dict(flash_attention=True), dict(seq_impl="ulysses"),
-               dict(zigzag_attention=True, causal=True)):
-        with pytest.raises(ValueError, match="attention-window"):
-            composed.main(ComposedConfig(mesh="data=2,seq=2", attention_window=4,
-                                         results_dir="", **kw),
-                          datasets=tiny_datasets)
+def test_attention_window_rejects_flash_zigzag_only(tiny_datasets):
+    """r4: the window composes with every schedule except the flash zig-zag
+    (traced chunk-pair offsets vs the kernels' static band masks)."""
+    with pytest.raises(ValueError, match="attention-window"):
+        composed.main(ComposedConfig(mesh="data=2,seq=2", attention_window=4,
+                                     flash_attention=True, zigzag_attention=True,
+                                     causal=True, results_dir=""),
+                      datasets=tiny_datasets)
+
+
+def test_attention_window_seq_schedules_match_dp(tmp_path, tiny_datasets):
+    """r4: --attention-window over a seq axis with the ring-of-flash, the einsum
+    zig-zag, and ulysses all reproduce the plain-DP windowed trajectory (the same
+    oracle the einsum ring is pinned to)."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100, seq_len=256,
+                  attention_window=100, causal=True, max_train_examples=128,
+                  max_test_examples=100)
+    _, hist_dp = composed.main(
+        ComposedConfig(mesh="data=4", results_dir=str(tmp_path / "dp"), **common),
+        datasets=tiny_datasets)
+    variants = {
+        "flash-ring": dict(flash_attention=True),
+        "zigzag": dict(zigzag_attention=True),
+        "ulysses": dict(seq_impl="ulysses"),
+    }
+    for name, kw in variants.items():
+        _, hist = composed.main(
+            ComposedConfig(mesh="data=2,seq=2",
+                           results_dir=str(tmp_path / name), **common, **kw),
+            datasets=tiny_datasets)
+        np.testing.assert_allclose(hist.train_losses, hist_dp.train_losses,
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
 
 
 def test_attention_window_on_seq_axis_matches_single_chip(tmp_path, tiny_datasets):
@@ -577,3 +600,22 @@ def test_sharded_checkpoint_rejects_stage_axis(tiny_datasets):
             ComposedConfig(mesh="data=2,stage=2", sharded_checkpoint=True,
                            results_dir=""),
             datasets=tiny_datasets)
+
+
+def test_1f1b_schedule_matches_dp(tmp_path, tiny_datasets):
+    """--pipeline-schedule 1f1b on a stage mesh reproduces the plain-DP trajectory
+    (the same oracle the GPipe stage runs are pinned to)."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100,
+                  max_train_examples=256)
+    _, hist_pp = composed.main(
+        ComposedConfig(mesh="data=2,stage=2", pipeline_schedule="1f1b",
+                       results_dir=str(tmp_path / "pp1f1b"), **common),
+        datasets=tiny_datasets)
+    _, hist_dp = composed.main(
+        ComposedConfig(mesh="data=4", results_dir=str(tmp_path / "pp1f1b_dp"),
+                       **common),
+        datasets=tiny_datasets)
+    np.testing.assert_allclose(hist_pp.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hist_pp.test_losses, hist_dp.test_losses,
+                               rtol=1e-4, atol=1e-5)

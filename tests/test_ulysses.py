@@ -143,3 +143,31 @@ def test_flash_block_alignment_rejected():
     q, k, v = _qkv(s=64, h=4)
     with pytest.raises(ValueError, match="BLOCK"):
         ulysses_attention(mesh, q, k, v, use_flash=True)
+
+
+@pytest.mark.parametrize("window", [5, 21])
+def test_ulysses_windowed_matches_dense(seq_mesh, window):
+    """Windowed ulysses (r4): the device holds the full sequence after the head
+    scatter, so the band binds straight into the local op — forward AND gradients
+    equal the dense windowed oracle."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        make_ulysses_attention_fn,
+    )
+
+    q, k, v = _qkv(seed=11)
+    ref = ops.full_attention(q, k, v, causal=True, window=window)
+    fn = make_ulysses_attention_fn(seq_mesh, window=window)
+    np.testing.assert_allclose(np.asarray(fn(q, k, v, causal=True)),
+                               np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def make_loss(attn):
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v, causal=True)))
+
+    ref_grads = jax.grad(
+        make_loss(lambda q, k, v, *, causal: ops.full_attention(
+            q, k, v, causal=causal, window=window)),
+        argnums=(0, 1, 2))(q, k, v)
+    got_grads = jax.grad(make_loss(fn), argnums=(0, 1, 2))(q, k, v)
+    for name, g_ref, g_got in zip("qkv", ref_grads, got_grads):
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                                   err_msg=name, rtol=1e-4, atol=1e-5)
