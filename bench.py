@@ -26,9 +26,12 @@ examples/s, achieved model FLOP/s, and an MFU estimate against the chip's bf16 p
 model runs f32, so the estimate is conservative). Model FLOPs/step are computed statically
 from the flagship architecture (SURVEY.md §3.4).
 
-Measurement protocol (warmup + median of 3 timed epochs, each closed by a host fetch of a
-scalar data-dependent on the epoch's final *parameter update* — not ``block_until_ready``,
-which can resolve at enqueue-ack on tunnelled PJRT backends): ``utils/benchmarks.py``.
+Measurement protocol (warmup + median of 7 timed epochs — r4: the first timed epoch runs
+~40% slow, and 3-sample medians straddling it made the r3 captures diverge; min and all
+samples are reported beside the median — each epoch closed by a host fetch of a scalar
+data-dependent on its final *parameter update*, not ``block_until_ready``, which can
+resolve at enqueue-ack on tunnelled PJRT backends): ``utils/benchmarks.py``;
+``BENCH_TIMED_EPOCHS`` overrides the count.
 
 Prints exactly ONE JSON line on stdout.
 """
@@ -92,9 +95,16 @@ def measure() -> dict:
     pregather = (os.environ.get("BENCH_PREGATHER", "on").strip().lower()
                  in ("1", "true", "yes", "on"))
 
+    # 7 timed epochs (r4): the first timed epoch is consistently ~40% slower than
+    # the rest (residual warm-up the single warmup epoch doesn't absorb), and the r3
+    # driver/builder captures diverged (0.1973 vs 0.2516 s) purely on 3-sample
+    # medians straddling it; a 7-sample median sits firmly in the steady state, and
+    # min/median are both reported so the spread is visible in the artifact.
+    timed = max(1, int(os.environ.get("BENCH_TIMED_EPOCHS", "7")))
     result = time_epochs(mesh, train_ds, global_batch=GLOBAL_BATCH,
                          learning_rate=LEARNING_RATE, momentum=MOMENTUM,
-                         seed=1, timed_epochs=3, unroll=unroll, pregather=pregather)
+                         seed=1, timed_epochs=timed, unroll=unroll,
+                         pregather=pregather)
 
     eval_fn = dp.compile_eval(make_eval_fn(Net(), batch_size=1000), mesh)
     test_x = dp.put_global(mesh, test_ds.images, jax.sharding.PartitionSpec())
@@ -133,9 +143,11 @@ def measure() -> dict:
         "mfu_vs_bf16_peak": (round(achieved_flops / (peak * result.devices), 8)
                              if peak else None),
         "epoch_seconds_all": [round(t, 4) for t in result.epoch_seconds],
+        "min_epoch_seconds": round(min(result.epoch_seconds), 4),
         "final_train_loss": round(result.final_train_loss, 4),
-        "test_nll_after_4_epochs": round(float(sum_nll) / len(test_ds), 4),
-        "test_accuracy_after_4_epochs": round(float(correct) / len(test_ds), 4),
+        "epochs_trained": 1 + timed,        # warmup + timed, all real training
+        "test_nll_after_run": round(float(sum_nll) / len(test_ds), 4),
+        "test_accuracy_after_run": round(float(correct) / len(test_ds), 4),
         "data_source": train_ds.source,
     }
 
