@@ -26,9 +26,9 @@ examples/s, achieved model FLOP/s, and an MFU estimate against the chip's bf16 p
 model runs f32, so the estimate is conservative). Model FLOPs/step are computed statically
 from the flagship architecture (SURVEY.md §3.4).
 
-Measurement protocol (warmup + median of 7 timed epochs — r4: the first timed epoch runs
-~40% slow, and 3-sample medians straddling it made the r3 captures diverge; min and all
-samples are reported beside the median — each epoch closed by a host fetch of a scalar
+Measurement protocol (warmup + median of 7 timed epochs — r4: in the r3 captures the
+first timed epoch ran ~40-50% slow, and 3-sample medians straddling it made those
+captures diverge; min and all samples now ride beside the median — each epoch closed by a host fetch of a scalar
 data-dependent on its final *parameter update*, not ``block_until_ready``, which can
 resolve at enqueue-ack on tunnelled PJRT backends): ``utils/benchmarks.py``;
 ``BENCH_TIMED_EPOCHS`` overrides the count.
@@ -95,8 +95,8 @@ def measure() -> dict:
     pregather = (os.environ.get("BENCH_PREGATHER", "on").strip().lower()
                  in ("1", "true", "yes", "on"))
 
-    # 7 timed epochs (r4): the first timed epoch is consistently ~40% slower than
-    # the rest (residual warm-up the single warmup epoch doesn't absorb), and the r3
+    # 7 timed epochs (r4): in the r3 captures the first timed epoch ran ~40-50%
+    # slow (residual warm-up the single warmup epoch didn't absorb), and the r3
     # driver/builder captures diverged (0.1973 vs 0.2516 s) purely on 3-sample
     # medians straddling it; a 7-sample median sits firmly in the steady state, and
     # min/median are both reported so the spread is visible in the artifact.

@@ -222,10 +222,16 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         ``src/train_dist.py:43-45``): workers gather step s+1's shard while step s runs
         on device."""
         losses = []
-        for bx, by in iter_plan_batches(train_ds, plan[:, col_lo:col_hi]):
-            gi, gl = dp.global_batch_from_host_local(mesh, bx, by)
-            state, loss = step_fn(state, gi, gl, dropout_rng)
-            losses.append(loss)
+        # Live per-batch bar (≙ the reference's tqdm, src/train_dist.py:76) — only
+        # on this host-fed path, where a per-step dispatch already exists; the bar
+        # never forces a device sync (no per-step loss fetch), and it renders only
+        # on a process-0 tty.
+        with M.ProgressBar(plan.shape[0], desc="train ") as bar:
+            for bx, by in iter_plan_batches(train_ds, plan[:, col_lo:col_hi]):
+                gi, gl = dp.global_batch_from_host_local(mesh, bx, by)
+                state, loss = step_fn(state, gi, gl, dropout_rng)
+                losses.append(loss)
+                bar.update(1)
         return state, jax.numpy.stack(losses)
 
     history = M.MetricsHistory()

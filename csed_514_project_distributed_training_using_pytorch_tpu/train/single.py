@@ -261,14 +261,26 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         path — only the feeding mechanism differs."""
         train_loader.set_epoch(epoch)
         full_steps = train_loader.epoch_index_matrix(epoch, allow_empty=True).shape[0]
-        for b, (bx, by) in enumerate(train_loader.prefetch_iter(epoch), start=1):
-            state, loss = step_fn(state, jnp.asarray(bx), jnp.asarray(by), dropout_rng)
-            if b % config.log_interval == 0 or b == full_steps:
-                examples_seen = (epoch - 1) * n_train + b * config.batch_size_train
-                M.log(M.train_progress_line(epoch, b * config.batch_size_train,
-                                            n_train, float(loss)))
-                history.record_train(examples_seen, float(loss))
-                saver.save_train_state(ckpt_path, state)
+        # Live per-batch bar (≙ the reference's tqdm, src/train_dist.py:76) — only
+        # here, where a per-step dispatch already exists; tty/process-0 gated.
+        with M.ProgressBar(full_steps, desc=f"Epoch {epoch} ") as bar:
+            for b, (bx, by) in enumerate(train_loader.prefetch_iter(epoch),
+                                         start=1):
+                state, loss = step_fn(state, jnp.asarray(bx), jnp.asarray(by),
+                                      dropout_rng)
+                if b % config.log_interval == 0 or b == full_steps:
+                    # The log line and the in-place bar share the terminal: finish
+                    # the bar's line first (float(loss) syncs here anyway — the bar
+                    # itself never forces a per-batch device sync).
+                    bar.close()
+                    examples_seen = ((epoch - 1) * n_train
+                                     + b * config.batch_size_train)
+                    M.log(M.train_progress_line(epoch,
+                                                b * config.batch_size_train,
+                                                n_train, float(loss)))
+                    history.record_train(examples_seen, float(loss))
+                    saver.save_train_state(ckpt_path, state)
+                bar.update(1)
         tail = train_loader.sampler.epoch_indices(epoch)[
             full_steps * config.batch_size_train:]
         if len(tail):

@@ -12,6 +12,7 @@ time-vs-machines scaling plot (BASELINE.md), so it is measured identically here 
 
 from __future__ import annotations
 
+import sys
 import time
 from dataclasses import dataclass, field
 
@@ -86,6 +87,70 @@ def is_logging_process() -> bool:
 def log(msg: str) -> None:
     if is_logging_process():
         print(msg, flush=True)
+
+
+class ProgressBar:
+    """Live per-batch progress display — the reference's tqdm bars
+    (``src/train_dist.py:76,96``) as a first-party, dependency-free analog.
+
+    TPU-first constraints shape it: the compiled-epoch fast paths never see it (a
+    per-batch host sync would throttle the chip — the reference's per-step
+    ``.item()`` sync, SURVEY.md §3.2, is exactly what the scanned paths delete), so
+    only the HOST-FED loops (``--use-host-pipeline``, ``--host-local-feed``) drive
+    it, where a per-step dispatch already exists. Rendering is rate-limited
+    (``min_interval_s``), process-0 gated, and tty-gated — piped/CI output gets
+    nothing, so logs and tests stay byte-stable.
+    """
+
+    def __init__(self, total: int, desc: str = "", *, stream=None,
+                 min_interval_s: float = 0.1, width: int = 24):
+        self.total = max(1, int(total))
+        self.desc = desc
+        self.n = 0
+        self._stream = sys.stderr if stream is None else stream
+        self._min_interval = min_interval_s
+        self._width = width
+        self._last_render = 0.0
+        self._t0 = time.time()
+        self._enabled = (is_logging_process()
+                         and bool(getattr(self._stream, "isatty", lambda: False)()))
+        self._open_line = False
+        self._last_len = 0
+
+    def update(self, n: int = 1, loss: float | None = None) -> None:
+        self.n += n
+        if not self._enabled:
+            return
+        now = time.time()
+        if self.n < self.total and now - self._last_render < self._min_interval:
+            return
+        self._last_render = now
+        filled = self._width * self.n // self.total
+        bar = "#" * filled + "-" * (self._width - filled)
+        rate = self.n / max(now - self._t0, 1e-9)
+        extra = f" loss={loss:.4f}" if loss is not None else ""
+        line = (f"{self.desc}[{bar}] {self.n}/{self.total} "
+                f"{rate:.1f}it/s{extra}")
+        # Pad to the previous render's length: a shrinking line (rate settling,
+        # loss dropping off) must not leave stale tail characters on the tty.
+        pad = " " * max(0, self._last_len - len(line))
+        self._last_len = len(line)
+        self._stream.write(f"\r{line}{pad}")
+        self._stream.flush()
+        self._open_line = True
+
+    def close(self) -> None:
+        """Finish the in-place line so the next log starts clean."""
+        if self._enabled and self._open_line:
+            self._stream.write("\n")
+            self._stream.flush()
+            self._open_line = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def train_progress_line(epoch: int, examples_seen: int, dataset_size: int,

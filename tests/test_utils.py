@@ -243,3 +243,50 @@ class TestAsyncCheckpointer:
         ck.save_train_state(good, self._state())
         ck.flush()
         assert os.path.exists(good)
+
+
+class _FakeTty:
+    def __init__(self):
+        self.buf = []
+
+    def isatty(self):
+        return True
+
+    def write(self, s):
+        self.buf.append(s)
+
+    def flush(self):
+        pass
+
+
+def test_progress_bar_renders_on_tty():
+    """The tqdm-analog bar (reference src/train_dist.py:76,96): in-place \\r line
+    with counts and rate, final state full, close() terminates the line."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        metrics as M,
+    )
+
+    stream = _FakeTty()
+    bar = M.ProgressBar(4, desc="ep1 ", stream=stream, min_interval_s=0.0)
+    for _ in range(4):
+        bar.update(1, loss=1.25)
+    bar.close()
+    text = "".join(stream.buf)
+    assert "\r" in text and "ep1 [" in text
+    assert "4/4" in text and "loss=1.2500" in text
+    assert text.endswith("\n")
+
+
+def test_progress_bar_silent_when_not_a_tty():
+    """Piped/CI output must stay byte-stable: a non-tty stream gets nothing."""
+    import io
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        metrics as M,
+    )
+
+    stream = io.StringIO()          # isatty() -> False
+    bar = M.ProgressBar(4, stream=stream, min_interval_s=0.0)
+    bar.update(4, loss=0.5)
+    bar.close()
+    assert stream.getvalue() == ""
