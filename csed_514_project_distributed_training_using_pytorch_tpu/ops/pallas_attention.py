@@ -173,9 +173,17 @@ def _banded(window: int, causal: bool, nq: int, block: int) -> bool:
 # =========================================================================================
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, num_steps, num_blocks,
-                band_base=None, window=0, q_offset=0):
+def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
+                band_base=None, window=0, q_offset=0, dyn_offset=False):
+    # ``dyn_offset``: the hop offset arrives as a TRACED int32 scalar in SMEM (the
+    # first operand) instead of the static ``q_offset`` — the zig-zag schedules'
+    # chunk-pair offsets are device-dependent. Banding requires a static offset,
+    # so dynamic callers always use the full walk (``band_base is None``).
+    if dyn_offset:
+        off_ref, refs = refs[0], refs[1:]
+        q_offset = off_ref[0]
+        assert band_base is None
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     iq = pl.program_id(1)
     step = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -237,17 +245,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
-                   window: int = 0, q_offset: int = 0):
+                   window: int = 0, q_offset: int = 0, q_offset_dyn=None):
     """q3/k3/v3: [BH, S, D] → (out [BH, S, D], lse [BH, S/block, 1, block]).
     ``q_offset`` (static, a multiple of ``block``) shifts query positions globally
-    relative to the keys — the ring hop offset (see ``_visibility_mask``)."""
+    relative to the keys — the ring hop offset (see ``_visibility_mask``).
+    ``q_offset_dyn`` (a traced int32 scalar, mutually exclusive with a nonzero
+    ``q_offset``) carries a DEVICE-DEPENDENT offset into the kernels via SMEM —
+    the zig-zag schedules' chunk-pair offsets; banding is unavailable there (the
+    grid is static), so the full walk runs with offset-shifted masks."""
     bh, s, d = q3.shape
     _check_block(s, block)
     _check_offset(q_offset, block)
+    dyn = q_offset_dyn is not None
+    if dyn and q_offset:
+        raise ValueError("q_offset and q_offset_dyn are mutually exclusive")
     scale = 1.0 / (d ** 0.5)
     nq = s // block
     off_blocks = q_offset // block
-    if _banded(window, causal and not q_offset, nq, block):
+    if not dyn and _banded(window, causal and not q_offset, nq, block):
         base = _band_reach(window, block)
         # A nonzero hop offset can put the whole band on one side of the local
         # diagonal, so the causal one-sided walk applies only at offset 0.
@@ -259,11 +274,13 @@ def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
         key_map = lambda b, i, j: (b, j, 0)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_steps=num_steps, num_blocks=nq, band_base=base,
-                               window=window, q_offset=q_offset)
+                               window=window, q_offset=q_offset, dyn_offset=dyn)
+    dyn_specs = ([pl.BlockSpec(memory_space=pltpu.SMEM)] if dyn else [])
+    dyn_args = ((jnp.asarray(q_offset_dyn, jnp.int32).reshape(1),) if dyn else ())
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, num_steps),
-        in_specs=[
+        in_specs=dyn_specs + [
             pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block, d), key_map, memory_space=pltpu.VMEM),
@@ -287,7 +304,7 @@ def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
             pltpu.VMEM((block, 1), jnp.float32),    # running normalizer l
         ],
         interpret=_interpret(),
-    )(q3, k3, v3)
+    )(*dyn_args, q3, k3, v3)
     return out, lse
 
 
@@ -296,9 +313,14 @@ def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
 # =========================================================================================
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc_ref, *, scale, causal, num_steps, num_blocks,
-               band_base=None, window=0, q_offset=0):
+def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
+               band_base=None, window=0, q_offset=0, dyn_offset=False):
+    if dyn_offset:                      # traced hop offset in SMEM (see _fwd_kernel)
+        off_ref, refs = refs[0], refs[1:]
+        q_offset = off_ref[0]
+        assert band_base is None
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+     dq_acc_ref) = refs
     iq = pl.program_id(1)
     step = pl.program_id(2)
     bq = q_ref.shape[1]
@@ -346,9 +368,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_acc_ref, dv_acc_ref, *, scale, causal, num_steps, num_blocks,
-                band_base=None, window=0, q_offset=0):
+def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
+                band_base=None, window=0, q_offset=0, dyn_offset=False):
+    if dyn_offset:                      # traced hop offset in SMEM (see _fwd_kernel)
+        off_ref, refs = refs[0], refs[1:]
+        q_offset = off_ref[0]
+        assert band_base is None
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+     dk_acc_ref, dv_acc_ref) = refs
     ik = pl.program_id(1)
     step = pl.program_id(2)
     bk = k_ref.shape[1]
@@ -422,7 +449,7 @@ def _flash_backward(res, g, *, causal: bool, block: int = BLOCK,
 
 def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
                           block: int = BLOCK, window: int = 0,
-                          q_offset: int = 0):
+                          q_offset: int = 0, q_offset_dyn=None):
     """One flash-backward pass of a query-block set against a key/value-block set,
     given the GLOBAL softmax statistics: ``(dq, dk, dv)`` contributions.
 
@@ -442,11 +469,14 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
             f"{k3.shape}")
     _check_block(s, block)
     _check_offset(q_offset, block)
+    dyn = q_offset_dyn is not None
+    if dyn and q_offset:
+        raise ValueError("q_offset and q_offset_dyn are mutually exclusive")
     scale = 1.0 / (d ** 0.5)
     nq = s // block
     off_blocks = q_offset // block
     one_sided = causal and not q_offset
-    if _banded(window, one_sided, nq, block):
+    if not dyn and _banded(window, one_sided, nq, block):
         reach = _band_reach(window, block)
         # dq walks key blocks around the query block (causal: only the past side);
         # dkv walks query blocks around the key block (causal: only the future
@@ -478,20 +508,22 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
     lse_i_spec = pl.BlockSpec((1, 1, 1, block), lambda b, i, j: (b, i, 0, 0),
                               memory_space=pltpu.VMEM)
 
+    dyn_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] if dyn else []
+    dyn_args = ((jnp.asarray(q_offset_dyn, jnp.int32).reshape(1),) if dyn else ())
     dq_walk = pl.BlockSpec((1, block, d), _banded_map(dq_base, off_blocks),
                            memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           num_steps=dq_steps, num_blocks=nq, band_base=dq_base,
-                          window=window, q_offset=q_offset),
+                          window=window, q_offset=q_offset, dyn_offset=dyn),
         grid=(bh, nq, dq_steps),
-        in_specs=[row_i_spec, dq_walk, dq_walk, row_i_spec, lse_i_spec,
-                  lse_i_spec],
+        in_specs=dyn_specs + [row_i_spec, dq_walk, dq_walk, row_i_spec, lse_i_spec,
+                              lse_i_spec],
         out_specs=[row_i_spec],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), q3.dtype)],
         scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
         interpret=_interpret(),
-    )(q3, k3, v3, g, lse, delta)[0]
+    )(*dyn_args, q3, k3, v3, g, lse, delta)[0]
 
     # dkv grid: axis 1 = key block (accumulators persist), axis 2 = query block.
     kv_walk = pl.BlockSpec((1, block, d), _banded_map(kv_base, -off_blocks),
@@ -502,17 +534,17 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           num_steps=kv_steps, num_blocks=nq, band_base=kv_base,
-                          window=window, q_offset=q_offset),
+                          window=window, q_offset=q_offset, dyn_offset=dyn),
         grid=(bh, nq, kv_steps),
-        in_specs=[kv_walk, row_i_spec, row_i_spec, kv_walk, kv_lse_walk,
-                  kv_lse_walk],
+        in_specs=dyn_specs + [kv_walk, row_i_spec, row_i_spec, kv_walk,
+                              kv_lse_walk, kv_lse_walk],
         out_specs=[row_i_spec, row_i_spec],
         out_shape=[jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
                    jax.ShapeDtypeStruct((bh, s, d), v3.dtype)],
         scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
                         pltpu.VMEM((block, d), jnp.float32)],
         interpret=_interpret(),
-    )(q3, k3, v3, g, lse, delta)
+    )(*dyn_args, q3, k3, v3, g, lse, delta)
     return dq, dk, dv
 
 
@@ -543,7 +575,7 @@ def _make_op(causal: bool, block: int = BLOCK, window: int = 0):
 
 def flash_forward_with_lse(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
                            causal: bool = False, window: int = 0,
-                           q_offset: int = 0):
+                           q_offset: int = 0, q_offset_dyn=None):
     """Forward-only flash attention that also returns the per-row log-sum-exp:
     ``[BH, S, D]³ → (out [BH, S, D], lse [BH, S/BLOCK, 1, BLOCK])``.
 
@@ -555,7 +587,7 @@ def flash_forward_with_lse(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
     masks (``_visibility_mask``) — the windowed ring-of-flash building block.
     """
     return _flash_forward(q3, k3, v3, causal=causal, window=window,
-                          q_offset=q_offset)
+                          q_offset=q_offset, q_offset_dyn=q_offset_dyn)
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,

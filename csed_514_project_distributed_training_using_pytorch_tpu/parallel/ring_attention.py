@@ -77,6 +77,18 @@ def _case_index(origin, my_index):
                      jnp.where(origin < my_index, 1, 0))
 
 
+def _zigzag_case(q_chunk, k_chunk, c, window):
+    """Chunk-pair classification for the zig-zag flash schedule, same branch
+    encoding as ``_case_index`` with the key chunk in the ``origin`` role —
+    plus band liveness when windowed: a past pair whose CLOSEST elements sit
+    ``(delta−1)·c + 1 ≥ W`` apart is dead (branch 0)."""
+    if not window:
+        return _case_index(k_chunk, q_chunk)
+    delta = q_chunk - k_chunk
+    live_past = (delta > 0) & ((delta - 1) * c + 1 < window)
+    return jnp.where(delta == 0, 2, jnp.where(live_past, 1, 0))
+
+
 def _ring_attention_local(ql: jax.Array, kl: jax.Array, vl: jax.Array, *,
                           axis_name: str, num_shards: int,
                           causal: bool, window: int = 0) -> jax.Array:
@@ -228,17 +240,11 @@ def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq",
     load-balanced zig-zag causal schedule (``zigzag_ring_attention``; causal-only).
     Both together select ``zigzag_ring_flash_attention`` — the full long-context
     causal training composition. ``window=W`` (r4) binds sliding-window masking into
-    every schedule but the flash zig-zag: the einsum ring and the ring-of-flash skip
-    out-of-band hops (the flash ring truncates its rotations to the band's reach),
-    and the einsum zig-zag band-masks each chunk pair from global positions. The
-    remaining gap is window + zigzag + flash together — the split chunk pairs'
-    offsets are device-dependent (traced), which the kernels' static band masks
-    cannot carry; that combination raises."""
-    if window and use_flash and use_zigzag:
-        raise ValueError(
-            "window composes with the einsum ring, the ring-of-flash, and the "
-            "einsum zig-zag — not the flash zig-zag (its chunk-pair offsets are "
-            "traced; the kernels' band masks are static). Drop one flag.")
+    EVERY schedule: the einsum ring and the ring-of-flash skip out-of-band hops
+    (the flash ring truncates its rotations to the band's reach), the einsum
+    zig-zag band-masks each chunk pair from global positions, and the flash
+    zig-zag carries its device-dependent chunk-pair offsets into the kernels as
+    traced SMEM scalars (``q_offset_dyn``)."""
 
     def attention_fn(q, k, v, *, causal: bool = False):
         if use_zigzag:
@@ -247,7 +253,8 @@ def make_ring_attention_fn(mesh: Mesh, *, axis_name: str = "seq",
                                  "ring_attention for bidirectional attention")
             if use_flash:
                 return zigzag_ring_flash_attention(mesh, q, k, v,
-                                                   axis_name=axis_name)
+                                                   axis_name=axis_name,
+                                                   window=window)
             return zigzag_ring_attention(mesh, q, k, v, axis_name=axis_name,
                                          window=window)
         if use_flash:
@@ -769,7 +776,7 @@ def ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array, v: jax.Array, *
 
 
 @functools.lru_cache(maxsize=None)
-def _make_zigzag_flash_op(axis_name: str, n: int):
+def _make_zigzag_flash_op(axis_name: str, n: int, window: int = 0):
     """Per-device zig-zag ring-of-flash op on ``[BH, 2c, D]`` f32 chunk pairs, with a
     custom VJP — the load-balanced causal schedule with Pallas flash kernels on every
     live chunk pair.
@@ -780,7 +787,18 @@ def _make_zigzag_flash_op(axis_name: str, n: int):
     pair is statically skipped, the late-vs-early pair always runs the non-causal
     kernel, and the two same-parity pairs switch between skip / non-causal / causal
     (the diagonal needs only the kernels' LOCAL blockwise causal masking, since a
-    chunk pair on the diagonal shares its global offset)."""
+    chunk pair on the diagonal shares its global offset).
+
+    ``window=W`` (r4 — the final cell of the schedule × masking matrix): the
+    chunk-pair offsets are DEVICE-DEPENDENT (``(q_chunk − k_chunk)·c`` with traced
+    chunk ids), so live past pairs route through the flash kernels' dynamic-offset
+    path (``q_offset_dyn`` — the offset rides into the kernels as an SMEM scalar,
+    verified bit-equal to the static path on-chip), the diagonal keeps the static
+    causal+window kernel, band-dead pairs (closest elements ≥ W apart) skip at the
+    switch — including the late-vs-early pair, which is always live without a
+    window. A past pair needs no causal term: its minimum distance is ≥ 1, so the
+    symmetric band mask is exact. ONE factory owns the delicate ring bookkeeping
+    for both maskings."""
     from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
         pallas_attention as pa,
     )
@@ -801,25 +819,34 @@ def _make_zigzag_flash_op(axis_name: str, n: int):
         my_index = lax.axis_index(axis_name)
         qa, qb = q3[:, :c], q3[:, c:]
 
-        def merge(carry, qx, k_blk, v_blk, flag):
-            return _flash_merge(
-                carry, *pa.flash_forward_with_lse(qx, k_blk, v_blk, causal=flag))
-
         def pair(carry, qx, k_blk, v_blk, q_chunk, k_chunk):
-            return lax.switch(
-                _case_index(k_chunk, q_chunk),
-                [lambda a: a[:3],
-                 lambda a: merge(a[:3], qx, a[3], a[4], False),
-                 lambda a: merge(a[:3], qx, a[3], a[4], True)],
-                (*carry, k_blk, v_blk))
+            off = (q_chunk - k_chunk) * c
+
+            def past(a):
+                return _flash_merge(a[:3], *pa.flash_forward_with_lse(
+                    qx, a[3], a[4], causal=False, window=window,
+                    q_offset_dyn=off if window else None))
+
+            def diag(a):
+                return _flash_merge(a[:3], *pa.flash_forward_with_lse(
+                    qx, a[3], a[4], causal=True, window=window))
+
+            return lax.switch(_zigzag_case(q_chunk, k_chunk, c, window),
+                              [lambda a: a[:3], past, diag],
+                              (*carry, k_blk, v_blk))
 
         def fold(ca, cb, k_cur, v_cur, o):
             ko, k2 = k_cur[:, :c], k_cur[:, c:]
             vo, v2 = v_cur[:, :c], v_cur[:, c:]
             # Static pair outcomes as in zigzag_ring_attention: early-vs-late never
-            # fires; late-vs-early is always fully visible.
+            # fires; late-vs-early is always fully visible WITHOUT a window (the
+            # band can kill it, so windowed runs route it through the switch too).
             ca = pair(ca, qa, ko, vo, my_index, o)
-            cb = merge(cb, qb, ko, vo, False)
+            if window:
+                cb = pair(cb, qb, ko, vo, 2 * n - 1 - my_index, o)
+            else:
+                cb = _flash_merge(cb, *pa.flash_forward_with_lse(
+                    qb, ko, vo, causal=False))
             cb = pair(cb, qb, k2, v2, 2 * n - 1 - my_index, 2 * n - 1 - o)
             return ca, cb
 
@@ -865,20 +892,28 @@ def _make_zigzag_flash_op(axis_name: str, n: int):
         stats_b = (_lse4(lse_rows[:, c:], nq), _lse4(delta_rows[:, c:], nq))
 
         def contrib(qx, gx, stats, k_blk, v_blk, q_chunk, k_chunk):
+            off = (q_chunk - k_chunk) * c
             args = (qx, k_blk, v_blk, gx, *stats)
             return lax.switch(
-                _case_index(k_chunk, q_chunk),
+                _zigzag_case(q_chunk, k_chunk, c, window),
                 [lambda a: (jnp.zeros_like(qx), jnp.zeros_like(a[1]),
                             jnp.zeros_like(a[2])),
-                 lambda a: pa.flash_backward_blocks(*a, causal=False),
-                 lambda a: pa.flash_backward_blocks(*a, causal=True)], args)
+                 lambda a: pa.flash_backward_blocks(
+                     *a, causal=False, window=window,
+                     q_offset_dyn=off if window else None),
+                 lambda a: pa.flash_backward_blocks(*a, causal=True,
+                                                    window=window)], args)
 
         def fold(dqa, dqb, dk_cur, dv_cur, k_cur, v_cur, o):
             ko, k2 = k_cur[:, :c], k_cur[:, c:]
             vo, v2 = v_cur[:, :c], v_cur[:, c:]
             d1q, d1k, d1v = contrib(qa, ga, stats_a, ko, vo, my_index, o)
-            d2q, d2k, d2v = pa.flash_backward_blocks(qb, ko, vo, gb, *stats_b,
-                                                     causal=False)
+            if window:
+                d2q, d2k, d2v = contrib(qb, gb, stats_b, ko, vo,
+                                        2 * n - 1 - my_index, o)
+            else:
+                d2q, d2k, d2v = pa.flash_backward_blocks(qb, ko, vo, gb,
+                                                         *stats_b, causal=False)
             d3q, d3k, d3v = contrib(qb, gb, stats_b, k2, v2,
                                     2 * n - 1 - my_index, 2 * n - 1 - o)
             dqa = dqa + d1q
@@ -909,7 +944,8 @@ def _make_zigzag_flash_op(axis_name: str, n: int):
 
 def zigzag_ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array,
                                 v: jax.Array, *,
-                                axis_name: str = "seq") -> jax.Array:
+                                axis_name: str = "seq",
+                                window: int = 0) -> jax.Array:
     """Zig-zag ring-of-flash: the full long-context causal training composition —
     load-balanced zig-zag scheduling across chips (uniform per-hop work), Pallas
     flash kernels within every live chunk pair (no score matrix anywhere), and a
@@ -918,6 +954,10 @@ def zigzag_ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array,
     Requires ``S % (2·shards·BLOCK) == 0`` (each zig-zag chunk must be flash-block
     aligned). Drop-in for ``ring_flash_attention(..., causal=True)``; pinned to the
     dense causal oracle — forward and gradients — in ``tests/test_ring_attention.py``.
+
+    ``window=W`` (r4) selects the WINDOWED variant: chunk-pair offsets ride into
+    the flash kernels as traced SMEM scalars (``q_offset_dyn``) and band-dead
+    pairs skip — see ``_make_zigzag_windowed_flash_op``.
     """
     from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
         pallas_attention as pa,
@@ -929,10 +969,12 @@ def zigzag_ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array,
         raise ValueError(
             f"zigzag ring-of-flash needs sequence length divisible by "
             f"2·shards·BLOCK = 2·{n}·{pa.BLOCK}, got {s}")
+    if window < 0:
+        raise ValueError(f"window must be >= 0 (0 = full attention), got {window}")
     c = s // (2 * n)
     order, inv = _zigzag_order(n)
     spec = _qkv_spec(mesh, q.shape, axis_name)
-    op = _make_zigzag_flash_op(axis_name, n)
+    op = _make_zigzag_flash_op(axis_name, n, int(window))
 
     def to_zigzag(x):
         return x.reshape(b, 2 * n, c, h, d)[:, jnp.asarray(order)].reshape(

@@ -91,25 +91,15 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         raise ValueError(f"--kv-heads {config.kv_heads} must be a positive divisor "
                          f"of the transformer's {TransformerClassifier.num_heads} "
                          f"heads")
-    # Fail fast (pre-data, pre-rendezvous): sliding windows compose with every
-    # attention schedule except the flash zig-zag (r4 — see the guard below).
+    # r4: sliding windows compose with EVERY attention schedule — einsum ring,
+    # ring-of-flash (static hop offsets, truncated ring), einsum zig-zag
+    # (global-position chunk masks), flash zig-zag (traced SMEM-scalar offsets),
+    # and ulysses (full sequence local). Only the width itself needs validating.
     if config.attention_window:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
             validate_window,
         )
         validate_window(config.attention_window)
-        if config.zigzag_attention and config.flash_attention:
-            # r4: the window composes with every other schedule — the einsum ring,
-            # the ring-of-flash (static hop offsets in the kernels' band masks,
-            # truncated ring), the einsum zig-zag (global-position chunk masks),
-            # and ulysses (full sequence local). Only the flash zig-zag remains:
-            # its chunk-pair offsets are traced, which the kernels' static band
-            # masks cannot carry.
-            raise ValueError(
-                "--attention-window composes with every schedule except "
-                "--zigzag-attention --flash-attention together (the flash "
-                "zig-zag's chunk-pair offsets are traced; the kernels' band "
-                "masks are static) — drop one of the two flags")
     n_mesh_devices = int(np.prod(axis_sizes))
     info = initialize_cluster()   # no-op single-process; multi-host rendezvous otherwise
 
@@ -226,8 +216,9 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                     f"--zigzag-attention --flash-attention needs seq_len divisible "
                     f"by 2·seq_axis·BLOCK = {chunk}, got {config.seq_len} "
                     f"(e.g. --seq-len {chunk})")
-            attention_fn = make_ring_attention_fn(mesh, use_flash=True,
-                                                  use_zigzag=True)
+            attention_fn = make_ring_attention_fn(
+                mesh, use_flash=True, use_zigzag=True,
+                window=config.attention_window)
         else:
             if config.seq_len % (2 * max(seq_size, 1)):
                 raise ValueError(

@@ -308,13 +308,37 @@ def test_windowed_ring_matches_dense(seq_mesh, causal, window):
 
 def test_windowed_ring_guards(seq_mesh):
     q, k, v = _qkv(seed=9)
-    # r4: the window composes with the ring-of-flash and the einsum zig-zag; only
-    # the flash zig-zag (traced chunk-pair offsets) remains out.
-    with pytest.raises(ValueError, match="flash zig-zag"):
-        make_ring_attention_fn(seq_mesh, window=5, use_flash=True,
-                               use_zigzag=True)
     with pytest.raises(ValueError, match="window"):
         ring_attention(seq_mesh, q, k, v, window=-1)
+
+
+@pytest.mark.parametrize("window", [100, 400])
+def test_windowed_zigzag_ring_of_flash_matches_dense(window):
+    """Windowed flash zig-zag (r4 — the final cell of the schedule × masking
+    matrix): device-dependent chunk-pair offsets ride into the flash kernels as
+    traced SMEM scalars (``q_offset_dyn``), band-dead pairs skip — forward AND
+    gradients equal the dense windowed causal oracle."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        zigzag_ring_flash_attention,
+    )
+
+    mesh = make_mesh(4, axis_names=("seq",))
+    q, k, v = _qkv(s=2 * 4 * 128, h=2, d=8, seed=29)
+    ref = ops.full_attention(q, k, v, causal=True, window=window)
+    out = zigzag_ring_flash_attention(mesh, q, k, v, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    def make_loss(attn):
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v)))
+
+    ref_grads = jax.grad(make_loss(lambda q, k, v: ops.full_attention(
+        q, k, v, causal=True, window=window)), argnums=(0, 1, 2))(q, k, v)
+    got_grads = jax.grad(make_loss(lambda q, k, v: zigzag_ring_flash_attention(
+        mesh, q, k, v, window=window)), argnums=(0, 1, 2))(q, k, v)
+    for name, g_ref, g_got in zip("qkv", ref_grads, got_grads):
+        np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                                   err_msg=name, rtol=1e-4, atol=1e-5)
 
 
 @pytest.mark.parametrize("causal", [False, True])

@@ -269,14 +269,24 @@ def test_ulysses_rejects_zigzag(tiny_datasets):
                       datasets=tiny_datasets)
 
 
-def test_attention_window_rejects_flash_zigzag_only(tiny_datasets):
-    """r4: the window composes with every schedule except the flash zig-zag
-    (traced chunk-pair offsets vs the kernels' static band masks)."""
-    with pytest.raises(ValueError, match="attention-window"):
-        composed.main(ComposedConfig(mesh="data=2,seq=2", attention_window=4,
-                                     flash_attention=True, zigzag_attention=True,
-                                     causal=True, results_dir=""),
-                      datasets=tiny_datasets)
+def test_attention_window_flash_zigzag_matches_dp(tmp_path, tiny_datasets):
+    """r4: the window composes with the flash zig-zag too (traced SMEM-scalar
+    chunk-pair offsets) — trajectory equal to the plain-DP windowed run. seq_len
+    512 = 2·seq_axis·BLOCK (the flash zig-zag's chunk alignment)."""
+    common = dict(epochs=1, batch_size=64, batch_size_test=100, seq_len=512,
+                  attention_window=150, causal=True, max_train_examples=128,
+                  max_test_examples=100)
+    _, hist_zz = composed.main(
+        ComposedConfig(mesh="data=2,seq=2", flash_attention=True,
+                       zigzag_attention=True,
+                       results_dir=str(tmp_path / "zzfw"), **common),
+        datasets=tiny_datasets)
+    _, hist_dp = composed.main(
+        ComposedConfig(mesh="data=4", results_dir=str(tmp_path / "zzfw_dp"),
+                       **common),
+        datasets=tiny_datasets)
+    np.testing.assert_allclose(hist_zz.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_attention_window_seq_schedules_match_dp(tmp_path, tiny_datasets):
