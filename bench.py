@@ -225,16 +225,23 @@ def _latest_hardware_capture() -> dict | None:
     import glob
     import re
     root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_results")
-    candidates = [p for p in glob.glob(os.path.join(root, "bench_r*_tpu*.json"))
+    candidates = [p for p in (glob.glob(os.path.join(root, "bench_r*_tpu*.json"))
+                              + glob.glob(os.path.join(root, "hw_r*",
+                                                       "bench_defaults*.json")))
                   if os.path.isfile(p)]
     if not candidates:
         return None
 
-    # Newest by ROUND NUMBER in the filename, not mtime — on a fresh clone every file
-    # shares the checkout mtime. Within a round, prefer the curated "*best*" capture.
+    # Newest by ROUND NUMBER in the path, not mtime — on a fresh clone every file
+    # shares the checkout mtime. Within a round, prefer the curated "*best*"/plain
+    # defaults capture over numbered retries.
     def rank(p: str) -> tuple:
-        m = re.search(r"bench_r(\d+)_tpu", os.path.basename(p))
-        return (int(m.group(1)) if m else -1, "best" in os.path.basename(p))
+        # Match within bench_results/ only — a clone path containing 'hw_rN'
+        # must not corrupt the round ranking.
+        m = re.search(r"(?:bench|hw)_r(\d+)", os.path.relpath(p, root))
+        name = os.path.basename(p)
+        return (int(m.group(1)) if m else -1,
+                "best" in name or name == "bench_defaults.json")
 
     path = max(candidates, key=rank)
     try:

@@ -957,7 +957,7 @@ def zigzag_ring_flash_attention(mesh: Mesh, q: jax.Array, k: jax.Array,
 
     ``window=W`` (r4) selects the WINDOWED variant: chunk-pair offsets ride into
     the flash kernels as traced SMEM scalars (``q_offset_dyn``) and band-dead
-    pairs skip — see ``_make_zigzag_windowed_flash_op``.
+    pairs skip — see ``_make_zigzag_flash_op``.
     """
     from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
         pallas_attention as pa,
