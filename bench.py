@@ -15,7 +15,12 @@ TPU claim wedges the lease). r2 hardening: every measurement attempt is preceded
 chip-claim PROBE child (seconds when healthy, ~90 s cap when wedged), so a wedged lease
 burns probes, not 600-s attempts; the child enables a persistent XLA compilation cache under
 ``bench_results/.jax_cache`` so a claim that succeeds after priming costs seconds, not a
-full compile. On exhausting the retry budget (``BENCH_TPU_RETRY_SECONDS``, default 900) the
+full compile. r5 hardening (after r4's 9/9 probe timeouts against a stale claim): two
+consecutive probe TIMEOUTS are treated as the stale-lease signature, after which the loop
+queues ONE PATIENT probe for the rest of the budget instead of probe-and-abandon cycling —
+the relay grants the claim to whoever is queued when the stale lease expires, so a single
+long-lived claimant converts any mid-round lease expiry into a measurement, where the old
+cadence could only win if expiry landed between probes. On exhausting the retry budget (``BENCH_TPU_RETRY_SECONDS``, default 900) the
 parent re-runs the child on the CPU backend so the round still records a real, parseable
 measurement — clearly labeled ``"platform": "cpu"`` with the TPU failure in
 ``fallback_reason`` and the newest committed hardware capture embedded as
@@ -200,16 +205,20 @@ def _probe_chip(timeout_s: float) -> tuple[str, str]:
     of the retry budget. This child only claims the backend, prints the platform, and
     exits cleanly — detectable in seconds when healthy, and cheap to give up on when
     not. Returns (status, detail) with status one of:
-      'tpu'   — chip claimed, measure now;
-      'other' — backend init SUCCEEDED but resolved to a non-TPU platform — a
-                deterministic condition (no plugin / JAX_PLATFORMS override), so the
-                caller should fall back immediately instead of burning the budget;
-      'retry' — transient/unknown failure or a timeout (claim likely wedged)."""
+      'tpu'     — chip claimed, measure now;
+      'other'   — backend init SUCCEEDED but resolved to a non-TPU platform — a
+                  deterministic condition (no plugin / JAX_PLATFORMS override), so the
+                  caller should fall back immediately instead of burning the budget;
+      'timeout' — the probe child HUNG past its deadline (the stale-lease wedge
+                  signature — a distinct status, not a substring of the detail text,
+                  so a fast-failing error that merely *mentions* a timeout can't
+                  masquerade as one);
+      'retry'   — transient/unknown failure worth ordinary retry cadence."""
     code = ("import jax, json; d = jax.devices(); "
             "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))")
     rc, out, err = _run_child({}, timeout_s, argv=[sys.executable, "-c", code])
     if rc is None:
-        return "retry", f"probe timed out after {timeout_s:.0f}s (claim likely wedged)"
+        return "timeout", f"probe timed out after {timeout_s:.0f}s (claim likely wedged)"
     info = _parse_child_json(out or "")
     if rc == 0 and info and info.get("platform") == "tpu":
         return "tpu", f"tpu x{info.get('n')}"
@@ -262,18 +271,56 @@ def main() -> int:
     retry_budget = float(os.environ.get("BENCH_TPU_RETRY_SECONDS", "900"))
     attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_SECONDS", "600"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_SECONDS", "90"))
+    # Consecutive probe TIMEOUTS before the loop treats the claim as stale-wedged
+    # and commits its one patient probe (r4 verdict item 1).
+    wedge_quick_probes = int(os.environ.get("BENCH_WEDGE_QUICK_PROBES", "2"))
     deadline = time.monotonic() + retry_budget
 
     # Probe-first (r2 verdict item 1b): only commit a full measurement attempt after a
     # cheap probe child proves the chip claim is obtainable. A wedged claim burns a
     # ~90-s probe instead of a 600-s attempt, leaving budget for many retries.
+    #
+    # Stale-lease handling (r4 verdict item 1): in r4 all 9 quick probes timed out
+    # against an exclusive claim some long-dead client still held — the retry loop's
+    # cadence could only win if the stale lease happened to expire *between* probes.
+    # The relay grants the claim to whoever is queued when the lease finally expires,
+    # and an abandoned probe child (SIGTERM lands only after the C++ claim wait
+    # returns) stays in that queue — so every extra quick probe lengthens the
+    # grant cascade the eventual winner must wait behind. After
+    # ``wedge_quick_probes`` consecutive timeouts the loop therefore stops
+    # probing-and-abandoning and commits ONE PATIENT probe that stays queued for
+    # the rest of the budget (minus a reserve for the measurement attempt): if the
+    # lease TTLs out any time in that window, the patient claimant is granted
+    # within seconds of expiry and the measurement still runs this round.
     attempts, probes, last_error = 0, 0, ""
+    wedge_timeouts = 0
+    probe_log: list = []     # [deadline_s, status] per probe — diagnosis artifact
     while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             break
+        is_patient = wedge_timeouts >= wedge_quick_probes
+        if is_patient:
+            # Clamped to the remaining budget: a wedge signature that trips late
+            # must not queue a probe that outlives the configured deadline.
+            attempt_reserve = max(60.0, min(attempt_timeout, 300.0))
+            this_probe = min(remaining, max(probe_timeout,
+                                            remaining - attempt_reserve))
+            print(f"bench: wedge signature ({wedge_timeouts} consecutive probe "
+                  f"timeouts); queueing one patient probe for {this_probe:.0f}s",
+                  file=sys.stderr)
+        else:
+            this_probe = min(probe_timeout, max(10.0, remaining))
         probes += 1
-        status, detail = _probe_chip(min(probe_timeout, max(10.0, remaining)))
+        status, detail = _probe_chip(this_probe)
+        probe_log.append([round(this_probe), status])
+        if is_patient and status == "timeout":
+            # The one patient claimant was abandoned at its deadline; anything left
+            # of the budget is shorter than what patience just failed to win — go
+            # straight to the fallback (no backoff sleep: it buys no retry).
+            last_error = detail
+            print(f"bench probe {probes} failed: {detail}", file=sys.stderr)
+            break
         if status == "other":
             # Deterministic: this interpreter will never see a TPU. Don't burn the
             # retry budget re-discovering it — go straight to the labeled fallback.
@@ -283,9 +330,16 @@ def main() -> int:
             break
         if status != "tpu":
             last_error = detail
+            # Only a hang is the wedge signature; a probe that exits quickly with
+            # an error is a transient init failure worth ordinary retries (and a
+            # fast-failing PATIENT probe resets the signature too — the claim
+            # answered, so the lease isn't stale, and patience stays available for
+            # a genuine wedge later in the budget).
+            wedge_timeouts = wedge_timeouts + 1 if status == "timeout" else 0
             print(f"bench probe {probes} failed: {detail}", file=sys.stderr)
             time.sleep(min(20.0, max(1.0, deadline - time.monotonic())))
             continue
+        wedge_timeouts = 0
         print(f"bench probe {probes}: chip alive ({detail}); measuring",
               file=sys.stderr)
         attempts += 1
@@ -300,6 +354,7 @@ def main() -> int:
             else:
                 payload["attempts"] = attempts
                 payload["probes"] = probes
+                payload["probe_log"] = probe_log
                 print(json.dumps(payload))
                 return 0
         else:
@@ -343,6 +398,7 @@ def main() -> int:
         if payload is not None:
             payload["attempts"] = attempts
             payload["probes"] = probes
+            payload["probe_log"] = probe_log
             payload["fallback_reason"] = f"tpu unavailable: {last_error}"
             if capture is not None:
                 payload["last_hardware_capture"] = capture
@@ -356,7 +412,7 @@ def main() -> int:
         "value": None, "unit": "s", "vs_baseline": None,
         "error": last_error,
         "cpu_fallback_error": (err or out).strip().splitlines()[-1:],
-        "attempts": attempts, "probes": probes,
+        "attempts": attempts, "probes": probes, "probe_log": probe_log,
         **({"last_hardware_capture": capture} if capture is not None else {}),
     }))
     return 1

@@ -1,0 +1,31 @@
+#!/bin/bash
+# TPU health watcher — ONE PATIENT CLAIMANT, not timeout-probe cycling.
+#
+# The axon TPU claim is exclusive and granted FIFO when the current lease ends. A
+# watcher that probes with `timeout N python -c ...` every minute (a) can't reliably
+# kill a probe whose SIGTERM is deferred inside the C++ claim wait, and (b) piles
+# abandoned claimants into the grant queue, lengthening the cascade the eventual
+# winner waits behind. Instead: run a single python child that BLOCKS on the claim
+# for as long as it takes; when the stale lease expires, it is granted within
+# seconds, logs HEALTHY, releases, and the loop exits. A child that errors out
+# quickly (transient init failure) is retried after a pause.
+set -o pipefail
+LOG=/root/repo/bench_results/hw_r5/tpu_watch.log
+echo "$(date -u +%H:%M:%S) patient claimant queued" >> "$LOG"
+while true; do
+  OUT=$(python - <<'PY' 2>/dev/null | tail -1
+import time; t0 = time.time()
+import jax
+d = jax.devices()
+import jax.numpy as jnp
+y = (jnp.ones((8, 8)) + 1).block_until_ready()
+print('HEALTHY %.1fs %s' % (time.time() - t0, d[0].device_kind))
+PY
+)
+  RC=$?
+  TS=$(date -u +%H:%M:%S)
+  case "$OUT" in
+    "HEALTHY "*) echo "$TS $OUT" >> "$LOG"; break;;
+    *) echo "$TS claimant exited rc=$RC: ${OUT:-<no output>}" >> "$LOG"; sleep 60;;
+  esac
+done
