@@ -302,8 +302,11 @@ def main() -> int:
         is_patient = wedge_timeouts >= wedge_quick_probes
         if is_patient:
             # Clamped to the remaining budget: a wedge signature that trips late
-            # must not queue a probe that outlives the configured deadline.
-            attempt_reserve = max(60.0, min(attempt_timeout, 300.0))
+            # must not queue a probe that outlives the configured deadline. The
+            # reserve splits what's left evenly with the measurement attempt (capped
+            # at the attempt's own timeout) — a patient win near the end of its
+            # window must still leave the attempt a usable share of the budget.
+            attempt_reserve = max(60.0, min(attempt_timeout, remaining / 2))
             this_probe = min(remaining, max(probe_timeout,
                                             remaining - attempt_reserve))
             print(f"bench: wedge signature ({wedge_timeouts} consecutive probe "

@@ -66,9 +66,29 @@ def _measure(fn, q, k, v):
         compiled = chain(n)
         return lambda: float(jnp.sum(compiled(q, k, v)[0, 0, 0]))  # grad-dep sync
 
-    per_iter, _, _ = chained_diff_time(synced_chain, min_delta=MIN_DELTA,
-                                       reps=REPS, warmup=WARMUP)
-    return per_iter
+    per_iter, _, _, converged = chained_diff_time(synced_chain, min_delta=MIN_DELTA,
+                                                  reps=REPS, warmup=WARMUP)
+    return per_iter, converged
+
+
+def _attended_pairs(s: int, window: int | None) -> int:
+    """Number of (query, key) pairs a CAUSAL attention over length ``s`` must score —
+    query i attends ``min(i+1, W)`` keys under a sliding window of W (all i+1
+    without one). The roofline below charges only these required pairs: the dense
+    path executes the full S×S square anyway and the flash kernels skip
+    above-diagonal/out-of-band blocks, but both are judged against the same
+    model-required work (the MFU convention the trainer benches use)."""
+    w = min(window or s, s)
+    return w * (w + 1) // 2 + (s - w) * w
+
+
+def _fwdbwd_model_flops(s: int, window: int | None) -> int:
+    """Required fwd+bwd FLOPs of causal MHA at B,H,D: 2 matmul FLOPs per attended
+    pair per D for each of QKᵀ and PV forward (4·B·H·D·pairs), backward's four
+    matmuls (dV, dP, dQ, dK) ≈ 2× forward; flash's in-backward forward recompute is
+    real work but NOT credited — MFU counts model FLOPs, not implementation FLOPs.
+    Softmax/mask flops are O(pairs) without the D factor and are omitted (<1%)."""
+    return 3 * 4 * B * H * D * _attended_pairs(s, window)
 
 
 def main() -> int:
@@ -102,8 +122,15 @@ def main() -> int:
 
     from csed_514_project_distributed_training_using_pytorch_tpu import ops
 
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        peak_flops,
+    )
+
     platform = jax.default_backend()
     device_kind = jax.devices()[0].device_kind
+    # Roofline denominator (r4 verdict item 2): the chip's bf16 peak — conservative
+    # for f32 runs, exact for --dtype bfloat16, None off-TPU.
+    peak = peak_flops(device_kind) if platform == "tpu" else None
     all_rows = []
     for s in args.seq_lens:
         rng = np.random.default_rng(s)
@@ -133,22 +160,41 @@ def main() -> int:
                      functools.partial(ops.flash_attention, **flash_kw))
             try:
                 # flash_attention validates blk itself (multiple of 128, divides S).
-                t = _measure(flash, q, k, v)
+                t, conv = _measure(flash, q, k, v)
             except Exception as e:  # a memory/compile wall is a result, not a crash
-                t = None
+                t, conv = None, None
                 row[key.replace("fwdbwd_s", "error")] = (
                     f"{type(e).__name__}: {str(e)[:200]}")
             row[key] = t
+            if sweeping and conv is not None:
+                row[key.replace("fwdbwd_s", "converged")] = conv
             if t is not None and (best_block is None or t < row["flash_fwdbwd_s"]):
                 best_block, row["flash_fwdbwd_s"] = (blk or 128), t
+                row["flash_converged"] = conv
         if sweeping:
             row["flash_best_block"] = best_block
+        # Roofline accounting (r4 verdict item 2): required causal fwd+bwd FLOPs over
+        # measured seconds, judged against the chip's bf16 peak — the same discipline
+        # the trainer benches carry, extended to where the kernels live.
+        model_flops = _fwdbwd_model_flops(s, args.window)
+        row["fwdbwd_model_flops"] = model_flops
+
+        def roofline(impl: str) -> None:
+            achieved = model_flops / row[f"{impl}_fwdbwd_s"]
+            row[f"{impl}_achieved_flops_per_s"] = round(achieved)
+            row[f"{impl}_pct_of_bf16_peak"] = (round(100 * achieved / peak, 2)
+                                               if peak else None)
+
+        if row["flash_fwdbwd_s"]:
+            roofline("flash")
         if s <= DENSE_MAX_S:
             try:
                 dense = (ops.full_attention if args.window is None else
                          functools.partial(ops.full_attention,
                                            window=args.window))
-                row["dense_fwdbwd_s"] = _measure(dense, q, k, v)
+                row["dense_fwdbwd_s"], row["dense_converged"] = _measure(dense, q,
+                                                                         k, v)
+                roofline("dense")
                 if row["flash_fwdbwd_s"]:  # speedup needs a nonzero flash denominator
                     row["speedup_flash_vs_dense"] = round(
                         row["dense_fwdbwd_s"] / row["flash_fwdbwd_s"], 3)

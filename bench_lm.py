@@ -129,7 +129,7 @@ def main(argv=None) -> int:
         compiled = gen_chain(n)
         return lambda: jax.device_get(compiled(jax.random.PRNGKey(3)))
 
-    gen_median, (n1, t1), (n2, t2) = chained_diff_time(
+    gen_median, (n1, t1), (n2, t2), gen_converged = chained_diff_time(
         synced_gen_chain, n1=1, grow=4, max_n=256)
     gen_times = [t1, t2]
     decode_tokens_per_s = args.gen_batch * args.seq / gen_median
@@ -184,6 +184,9 @@ def main(argv=None) -> int:
         "train_tokens_per_s": round(steps_per_s * args.batch * args.seq),
         "decode_seconds_all": [round(t, 4) for t in gen_times],
         "decode_chain_lengths": [n1, n2],
+        # False ⇒ max_n exhausted before the chain added min_delta seconds: the
+        # two-point difference is still jitter-dominated (r4 advisor finding).
+        "decode_chain_converged": gen_converged,
         "decode_tokens_per_s": round(decode_tokens_per_s, 1),
         "decode_batch": args.gen_batch,
         "decode_bytes_per_token": round(decode_bytes_per_token),

@@ -93,8 +93,12 @@ def chained_diff_time(chain, *, n1=2, grow=8, max_n=4096, min_delta=0.25,
     chained program AND blocks on a data-dependent fetch. N2 grows geometrically
     (``grow``× per probe, capped at ``max_n``) until the chained work adds
     ``min_delta`` seconds over N1, so per-dispatch jitter (~ms) cannot dominate the
-    difference. Returns ``(per_iter_seconds, (n1, t1), (n2, t2))``. One owner for
-    the protocol — a fix lands in every bench at once (bench_attention, bench_lm)."""
+    difference. Returns ``(per_iter_seconds, (n1, t1), (n2, t2), converged)`` —
+    ``converged`` is False when ``max_n`` was exhausted before the chain ever added
+    ``min_delta`` seconds, i.e. the two-point difference is still jitter-dominated
+    and callers should mark the row as such in their artifacts (r4 advisor
+    finding). One owner for the protocol — a fix lands in every bench at once
+    (bench_attention, bench_lm)."""
     def timed(run):
         for _ in range(warmup):
             run()
@@ -112,7 +116,8 @@ def chained_diff_time(chain, *, n1=2, grow=8, max_n=4096, min_delta=0.25,
         t2 = timed(chain(n2))
         if t2 - t1 >= min_delta:
             break
-    return max((t2 - t1) / (n2 - n1), 1e-9), (n1, t1), (n2, t2)
+    return (max((t2 - t1) / (n2 - n1), 1e-9), (n1, t1), (n2, t2),
+            t2 - t1 >= min_delta)
 
 
 def timed_state_run(run, state):

@@ -42,6 +42,34 @@ def test_time_epochs_trains():
     assert result.final_train_loss < 1.5
 
 
+def test_chained_diff_time_converged_flag(monkeypatch):
+    """The two-point protocol must SAY when it never reached min_delta of chained
+    work (r4 advisor finding): a fast fake chain that scales with n converges; one
+    whose time never grows exhausts max_n with converged=False."""
+    import csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks as B
+
+    clock = [0.0]
+    monkeypatch.setattr(B.time, "perf_counter", lambda: clock[0])
+
+    def scaling_chain(n):          # 1 ms per iteration: converges once n2 is large
+        def run():
+            clock[0] += 0.001 * n
+        return run
+
+    per_iter, (n1, _), (n2, _), conv = B.chained_diff_time(
+        scaling_chain, n1=2, grow=8, max_n=4096, min_delta=0.25, reps=1, warmup=0)
+    assert conv and per_iter == pytest.approx(0.001)
+
+    def flat_chain(n):             # pure dispatch tax: never adds delta
+        def run():
+            clock[0] += 0.070
+        return run
+
+    per_iter, _, (n2, _), conv = B.chained_diff_time(
+        flat_chain, n1=2, grow=8, max_n=4096, min_delta=0.25, reps=1, warmup=0)
+    assert not conv and n2 == 4096
+
+
 def test_indivisible_batch_rejected(tiny_ds):
     with pytest.raises(ValueError, match="not divisible"):
         time_epochs(make_mesh(3), tiny_ds, global_batch=64)

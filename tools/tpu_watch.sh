@@ -11,9 +11,10 @@
 # quickly (transient init failure) is retried after a pause.
 set -o pipefail
 LOG=/root/repo/bench_results/hw_r5/tpu_watch.log
+ERR=/tmp/tpu_watch_stderr.txt
 echo "$(date -u +%H:%M:%S) patient claimant queued" >> "$LOG"
 while true; do
-  OUT=$(python - <<'PY' 2>/dev/null | tail -1
+  OUT=$(python - <<'PY' 2>"$ERR" | tail -1
 import time; t0 = time.time()
 import jax
 d = jax.devices()
@@ -26,6 +27,7 @@ PY
   TS=$(date -u +%H:%M:%S)
   case "$OUT" in
     "HEALTHY "*) echo "$TS $OUT" >> "$LOG"; break;;
-    *) echo "$TS claimant exited rc=$RC: ${OUT:-<no output>}" >> "$LOG"; sleep 60;;
+    *) echo "$TS claimant exited rc=$RC: ${OUT:-$(tail -1 "$ERR")}" >> "$LOG"
+       sleep 60;;
   esac
 done
