@@ -18,7 +18,8 @@ Kernel layout (FlashAttention-2 style, in the canonical Pallas-TPU grid formulat
   HBM-bound: an earlier full-K/V-in-VMEM variant hit the 16 MB scoped-vmem wall at
   S=16k, and a hand-rolled in-kernel DMA variant (``run_scoped`` + ``make_async_copy``
   double buffering) wedged this environment's AOT Mosaic compile helper the same way the
-  whole-model fused kernel does — the grid formulation compiles in seconds.
+  (since-retired) whole-model fused CNN kernel did — the grid formulation compiles in
+  seconds.
 - **Backward**: the standard two-kernel recompute formulation — no O(S²) residuals, only
   ``(out, lse = m + log l)``. A ``dq`` kernel re-walks K/V blocks per query block; a
   ``dk/dv`` kernel walks query/dout blocks per key block; both recompute
@@ -31,7 +32,7 @@ All matmuls request ``preferred_element_type=float32`` (MXU accumulation), block
 are lane-aligned (any multiple of 128 rows via the ``block`` parameter, default
 ``BLOCK = 128``; head dim on the lane axis), masks use 2-D ``broadcasted_iota``, and the
 only in-kernel reshapes drop/add leading unit dims — every construct from the
-probe-verified list in ``ops/pallas_fused.py``'s lowering notes. ``block`` is a pure
+v5e-probe-verified Mosaic lowering list (DESIGN.md §9). ``block`` is a pure
 performance knob (numerics are block-invariant — pinned in tests): larger blocks
 amortize grid/pipeline overhead per step against more VMEM per block; tune with
 ``bench_attention.py --block-sweep``.

@@ -23,8 +23,12 @@ unsharded trainers — pinned in tests), same metric lines.
   (each device holds only its stages' layers) and the checkpoint bridge
   (``stack_transformer_blocks``/``unstack_transformer_blocks``) converts to/from the
   standard per-name layout at the boundary, so PP checkpoints interchange with every
-  other mesh. Composes with ``data`` (``--mesh data=2,stage=2``); ``seq``/``model``/
-  ``expert`` with ``stage`` would need nested shard_maps and are rejected up front.
+  other mesh. Composes with ``data`` (``--mesh data=2,stage=2``) and with ``model``
+  (``--mesh data=2,stage=2,model=2`` — the pipeline keeps stage/data manual and the
+  model axis AUTO, so Megatron TP annotations still apply inside each stage) and
+  with ``--flash-attention`` (the dispatcher's pallas kernel traces inside the
+  pipeline body); ``seq``/``expert`` with ``stage`` would need nested shard_maps
+  and are rejected up front.
 
 This is deliberately a thin composition of the parallel/ primitives: the entire
 "strategy" is the mesh declaration plus sharding rules; XLA inserts every collective.
@@ -149,20 +153,30 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             f"(batch/grad_accum) not divisible by data axis {data_size} — each "
             f"microbatch must still shard evenly")
     if stage_size > 1:
-        if seq_size > 1 or model_size > 1 or expert_size > 1:
+        # r5: ``model`` composes with ``stage`` — the pipeline's shard_map keeps
+        # only stage/data manual and leaves the model axis AUTO, so the Megatron
+        # annotations still drive compiler-inserted TP collectives inside each
+        # stage (parallel/pipeline.py). seq/expert stay rejected: their schedules
+        # are shard_maps of their own and genuinely would need nesting.
+        if seq_size > 1 or expert_size > 1:
             raise ValueError(
-                "a stage axis composes with data only — seq/model/expert inside a "
-                "pipeline stage would need nested shard_maps")
+                "a stage axis composes with data and model only — seq/expert "
+                "inside a pipeline stage would need nested shard_maps")
         if config.dropout_rate:
             raise ValueError("stage pipelining requires dropout_rate == 0 "
                              "(microbatch ticks do not thread dropout keys)")
         if config.remat:
             raise ValueError("--remat has no effect under a stage axis (the pipeline "
                              "engine applies blocks itself) — drop it")
-        if config.flash_attention or config.zigzag_attention:
+        if config.zigzag_attention:
             raise ValueError(
-                "--flash-attention/--zigzag-attention do not compose with a stage "
-                "axis (their shard_map cannot nest inside the pipeline's)")
+                "--zigzag-attention needs a seq axis, which does not compose with "
+                "a stage axis")
+        if config.flash_attention and model_size > 1:
+            raise ValueError(
+                "--flash-attention under stage x model is unsupported: the flash "
+                "pallas_call cannot be partitioned by the AUTO model axis inside "
+                "the pipeline body (drop model or flash)")
         if config.sharded_checkpoint:
             raise ValueError(
                 "--sharded-checkpoint saves the device state's own layout, and the "

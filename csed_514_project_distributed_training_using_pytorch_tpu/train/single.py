@@ -66,31 +66,11 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     if config.grad_accum > 1 and config.batch_size_train % config.grad_accum:
         raise ValueError(f"batch_size_train {config.batch_size_train} not divisible "
                          f"by grad_accum {config.grad_accum}")
-    if config.experimental_fused_step and (config.model != "cnn" or config.bf16
-                                  or config.grad_accum > 1):
-        raise ValueError("--experimental-fused-step is specialized to the flagship CNN's f32 "
-                         "single-microbatch step (ops/pallas_fused.py); drop it, or "
-                         "use --model cnn without --bf16/--grad-accum")
-
     if config.download_data and datasets is None:
         download_mnist(config.data_dir)   # ≙ torchvision download=True, src/train.py:26-31
     train_ds, test_ds = datasets if datasets is not None else load_mnist(config.data_dir)
     train_ds = mnist.truncate(train_ds, config.max_train_examples)
     test_ds = mnist.truncate(test_ds, config.max_test_examples)
-
-    # The fused-step compile probe runs in a child interpreter and must happen BEFORE this
-    # process's first jax operation — even M.log claims the backend (jax.process_index),
-    # and the TPU claim is exclusive, so once we hold it a probing child could only block
-    # (see probe_compiles_subprocess). Probe every batch size this run will step at (main
-    # batches + the drop_last=False tail) — Mosaic failures can be block-shape dependent.
-    fused_probe_result = None
-    if config.experimental_fused_step:
-        from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_fused import (
-            probe_compiles_subprocess,
-        )
-        tail = len(train_ds) % config.batch_size_train
-        fused_probe_result = probe_compiles_subprocess(tuple(dict.fromkeys(
-            b for b in (config.batch_size_train, tail) if b)))
 
     M.log(f"Loaded MNIST ({train_ds.source}): {len(train_ds)} train / {len(test_ds)} test")
     root = jax.random.PRNGKey(config.seed)      # ≙ torch.manual_seed, src/train.py:19-21
@@ -111,13 +91,9 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                                      learning_rate=config.learning_rate,
                                      momentum=config.momentum,
                                      weight_decay=config.weight_decay)
-    if config.optimizer != "sgd" and (config.use_pallas_kernels
-                                      or config.experimental_fused_step):
-        raise ValueError("--use-pallas-kernels/--experimental-fused-step fuse the "
-                         "SGD-momentum update — they require --optimizer sgd")
-    if config.ema_decay and config.experimental_fused_step:
-        raise ValueError("--experimental-fused-step runs the whole update in one "
-                         "kernel — --ema-decay is not applied there; drop one")
+    if config.optimizer != "sgd" and config.use_pallas_kernels:
+        raise ValueError("--use-pallas-kernels fuses the SGD-momentum update — it "
+                         "requires --optimizer sgd")
     state = create_train_state(model, init_rng, optimizer=optimizer,
                                ema=config.ema_decay > 0)
     resume_from = resume_from or config.resume_from or None
@@ -135,63 +111,39 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     lr_schedule = optim.make_lr_schedule(config.lr_schedule,
                                          warmup_steps=config.warmup_steps,
                                          total_steps=total_steps)
-    if lr_schedule is not None and (config.use_pallas_kernels
-                                    or config.experimental_fused_step):
-        raise ValueError("--use-pallas-kernels/--experimental-fused-step bake the "
-                         "learning rate into the fused kernel — use the default "
-                         "constant schedule without warmup")
-    if config.clip_grad_norm and config.experimental_fused_step:
-        # (--use-pallas-kernels composes fine: the clip runs in XLA before the fused
-        # update kernel; the whole-model fused step bypasses make_train_step entirely.)
-        raise ValueError("--experimental-fused-step runs the whole step in one kernel "
-                         "— --clip-grad-norm is not applied there; drop one of them")
+    if lr_schedule is not None and config.use_pallas_kernels:
+        raise ValueError("--use-pallas-kernels bakes the learning rate into the "
+                         "fused update kernel — use the default constant schedule "
+                         "without warmup")
 
     # Device-resident datasets: the one and only host->device transfer.
     train_x, train_y = jnp.asarray(train_ds.images), jnp.asarray(train_ds.labels)
     test_x, test_y = jnp.asarray(test_ds.images), jnp.asarray(test_ds.labels)
 
-    if config.experimental_fused_step:
-        from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_fused import (
-            make_fused_train_step,
-        )
-        from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
-            make_epoch_from_step,
-        )
-        # probe_result always supplied -> the uncancellable in-process probe never runs.
-        raw_step = make_fused_train_step(
-            learning_rate=config.learning_rate, momentum=config.momentum,
-            fallback_on_compile_error=True,
-            probe_result=fused_probe_result)
-        segment_fn = jax.jit(
-            make_epoch_from_step(raw_step, unroll=config.scan_unroll,
-                                 pregather=config.pregather),
-            donate_argnums=(0,))
-        step_fn = jax.jit(raw_step, donate_argnums=(0,))
-    else:
-        segment_fn = jax.jit(
-            make_epoch_fn(model, learning_rate=config.learning_rate,
-                          momentum=config.momentum,
-                          use_pallas=config.use_pallas_kernels,
-                          unroll=config.scan_unroll, pregather=config.pregather,
-                          grad_accum=config.grad_accum, optimizer=optimizer,
-                          lr_schedule=lr_schedule,
-                          clip_grad_norm=config.clip_grad_norm,
-                          ema_decay=config.ema_decay,
-                          label_smoothing=config.label_smoothing),
-            donate_argnums=(0,))
-        step_fn = jax.jit(
-            make_train_step(model, learning_rate=config.learning_rate,
-                            momentum=config.momentum,
-                            use_pallas=config.use_pallas_kernels,
-                            grad_accum=config.grad_accum, optimizer=optimizer,
-                            lr_schedule=lr_schedule,
-                            clip_grad_norm=config.clip_grad_norm,
-                            ema_decay=config.ema_decay,
-                            label_smoothing=config.label_smoothing),
-            donate_argnums=(0,))
+    segment_fn = jax.jit(
+        make_epoch_fn(model, learning_rate=config.learning_rate,
+                      momentum=config.momentum,
+                      use_pallas=config.use_pallas_kernels,
+                      unroll=config.scan_unroll, pregather=config.pregather,
+                      grad_accum=config.grad_accum, optimizer=optimizer,
+                      lr_schedule=lr_schedule,
+                      clip_grad_norm=config.clip_grad_norm,
+                      ema_decay=config.ema_decay,
+                      label_smoothing=config.label_smoothing),
+        donate_argnums=(0,))
+    step_fn = jax.jit(
+        make_train_step(model, learning_rate=config.learning_rate,
+                        momentum=config.momentum,
+                        use_pallas=config.use_pallas_kernels,
+                        grad_accum=config.grad_accum, optimizer=optimizer,
+                        lr_schedule=lr_schedule,
+                        clip_grad_norm=config.clip_grad_norm,
+                        ema_decay=config.ema_decay,
+                        label_smoothing=config.label_smoothing),
+        donate_argnums=(0,))
     # The final partial batch (drop_last=False) is ragged and need not divide by
     # grad_accum; accumulation is a memory knob, so the tail just steps unaccumulated.
-    if config.experimental_fused_step or config.grad_accum == 1:
+    if config.grad_accum == 1:
         tail_step_fn = step_fn
     else:
         tail_step_fn = jax.jit(

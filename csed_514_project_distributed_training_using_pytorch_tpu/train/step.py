@@ -271,8 +271,8 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
 def make_epoch_from_step(train_step: Callable, *, unroll: int = 1,
                          pregather: bool = False) -> Callable:
     """Wrap any ``step(state, images, labels, rng)`` into the scanned epoch program
-    (same contract as ``make_epoch_fn`` — used for alternative step implementations such
-    as the fused Pallas step, ``ops/pallas_fused.py``)."""
+    (same contract as ``make_epoch_fn`` — used for alternative step implementations,
+    e.g. the LM trainer's next-token step, ``train/lm.py``)."""
 
     def epoch(state: TrainState, images, labels, idx_matrix, rng):
         if pregather:

@@ -186,6 +186,30 @@ def save_train_state_sharded(dir_path: str, state: TrainState) -> None:
                   serialization.msgpack_serialize(shards))
 
 
+def _box_subtract(box: tuple, cut: tuple) -> list:
+    """Axis-aligned box difference ``box \\ cut`` as a list of disjoint boxes.
+
+    Boxes are tuples of per-dimension ``(lo, hi)`` half-open ranges (a 0-d box —
+    the empty tuple — is a scalar and is removed by any cut). The standard guillotine
+    split: clip ``cut`` to ``box``; if they are disjoint the box survives whole,
+    otherwise slice off the below/above-the-cut slabs dimension by dimension,
+    shrinking toward the intersection, which is the (discarded) covered part."""
+    inter = [(max(lo, clo), min(hi, chi))
+             for (lo, hi), (clo, chi) in zip(box, cut)]
+    if any(lo >= hi for lo, hi in inter):
+        return [box]
+    pieces = []
+    cur = list(box)
+    for d, (ilo, ihi) in enumerate(inter):
+        lo, hi = cur[d]
+        if lo < ilo:
+            pieces.append(tuple(cur[:d]) + ((lo, ilo),) + tuple(cur[d + 1:]))
+        if ihi < hi:
+            pieces.append(tuple(cur[:d]) + ((ihi, hi),) + tuple(cur[d + 1:]))
+        cur[d] = (ilo, ihi)
+    return pieces
+
+
 def restore_train_state_sharded(dir_path: str, reference_state: TrainState,
                                 *, shardings=None) -> TrainState:
     """Re-assemble a ``save_train_state_sharded`` checkpoint (any source layout) into
@@ -213,10 +237,18 @@ def restore_train_state_sharded(dir_path: str, reference_state: TrainState,
             f"sharded checkpoint {dir_path} was written by {process_count} "
             f"process(es) but {len(missing)} shard file(s) are absent "
             f"(e.g. {os.path.basename(missing[0])}) — shared filesystem required")
-    # Per-element coverage masks, not a volumetric count: overlapping blocks (a
-    # writer bug, a hand-edited checkpoint) must not double-count and mask a
-    # genuinely missing region that would silently restore zeros.
-    covered = {key: np.zeros(m["shape"], bool) for key, m in meta.items()}
+    # Exact per-REGION coverage via box subtraction, not a volumetric count:
+    # overlapping blocks (a writer bug, a hand-edited checkpoint) must not
+    # double-count and mask a genuinely missing region that would silently restore
+    # zeros — and unlike the earlier per-element bool masks this costs O(#blocks)
+    # boxes, not one host byte per parameter element on top of the full restore
+    # buffers (r4 advisor finding: ~25% extra peak memory at large checkpoints).
+    # Zero-size keys start fully covered; each block subtracts its slab from the
+    # remaining-uncovered set (subtracting an already-covered region is a no-op,
+    # which is what makes overlap exact).
+    uncovered = {key: ([] if 0 in m["shape"]
+                       else [tuple((0, n) for n in m["shape"])])
+                 for key, m in meta.items()}
     for path in files:
         with open(path, "rb") as f:
             shards = serialization.msgpack_restore(f.read())
@@ -226,8 +258,11 @@ def restore_train_state_sharded(dir_path: str, reference_state: TrainState,
                 idx = tuple(slice(int(s), int(s) + n)
                             for s, n in zip(start, data.shape))
                 full[key][idx] = data
-                covered[key][idx] = True
-    short = [k for k, mask in covered.items() if not mask.all()]
+                cut = tuple((int(s), int(s) + n)
+                            for s, n in zip(start, data.shape))
+                uncovered[key] = [piece for box in uncovered[key]
+                                  for piece in _box_subtract(box, cut)]
+    short = [k for k, boxes in uncovered.items() if boxes]
     if short:
         raise ValueError(
             f"sharded checkpoint {dir_path} is missing blocks for {short[:3]}"

@@ -82,16 +82,6 @@ class SingleProcessConfig:
                                       # (transformer only; composes with every core)
     use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
                                       # (ops/pallas_kernels.py; single-device step path)
-    experimental_fused_step: bool = False
-                                      # EXPERIMENTAL (off the documented surface): run the
-                                      # ENTIRE train step (fwd+bwd+update) through the
-                                      # whole-model Pallas kernel (ops/pallas_fused.py;
-                                      # single-device path, flagship model only). Every
-                                      # construct lowers through Mosaic on v5e, but the
-                                      # full-kernel compile has exceeded 30-min deadlines
-                                      # on tunnelled hardware; a startup compile probe in a
-                                      # child interpreter gates it and falls back to the
-                                      # unfused step on timeout/rejection (SETUP.md §5).
     use_host_pipeline: bool = False   # feed batches through the native C++ threaded
                                       # prefetcher (the DataLoader num_workers=4 analog,
                                       # src/train_dist.py:43-45) instead of the device-

@@ -13,8 +13,8 @@ initialized, which keeps the (exclusive, possibly tunnelled) TPU unclaimed while
 import os
 
 # Opt-in hardware mode: ``FRAMEWORK_TEST_PLATFORM=tpu pytest tests/ -k tpu`` leaves the
-# real backend alone so the TPU-gated smokes (e.g. the Mosaic compile path in
-# test_pallas_fused.py) actually run when a chip is reachable. Default remains the
+# real backend alone so the TPU-gated smokes (e.g. the Mosaic compile paths in
+# test_pallas_attention.py) actually run when a chip is reachable. Default remains the
 # 8-virtual-device CPU platform — the suite must never claim the (exclusive, tunnelled)
 # TPU by accident.
 _platform = os.environ.get("FRAMEWORK_TEST_PLATFORM", "cpu").strip().lower()

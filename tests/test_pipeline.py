@@ -232,3 +232,80 @@ def test_unknown_schedule_rejected(mesh, block, stage_params):
     with pytest.raises(ValueError, match="schedule"):
         pp.pipeline_apply(mesh, _stage_fn(block), stacked,
                           _x().reshape(4, 4, 8, 64), schedule="2f2b")
+
+
+def test_pipeline_composes_with_auto_model_axis():
+    """PP x TP in one program (r4 verdict item 4): on a stage x model mesh the
+    pipeline keeps only 'stage' manual and the Megatron-sharded stacked params
+    (stacked_state_shardings' column/row rules) ride the AUTO model axis — forward
+    and gradients must still match the sequential oracle bit-close."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh2 = make_mesh(8, axis_names=("stage", "model"), axis_shape=(4, 2))
+    block = TransformerBlock(num_heads=4, dropout_rate=0.0)
+    x0 = jnp.zeros((1, 8, 64), jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(11), NUM_STAGES)
+    stage_params = [block.init({"params": k}, x0)["params"] for k in keys]
+    stacked = pp.stack_stage_params(stage_params)
+
+    # Megatron placement, one dim right of the stack dim (as stacked_state_shardings
+    # computes it) — column kernels [S, E, F] over (stage, -, model), row kernels
+    # over (stage, model, -).
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        tensor_parallel as tp,
+    )
+
+    def place(path, leaf):
+        name = tp._leaf_name(path)
+        if name in tp._COLUMN_PARALLEL and leaf.ndim == 3:
+            return jax.device_put(leaf, NamedSharding(mesh2, P("stage", None, "model")))
+        if name in tp._ROW_PARALLEL and leaf.ndim == 3:
+            return jax.device_put(leaf, NamedSharding(mesh2, P("stage", "model", None)))
+        if name in tp._COLUMN_PARALLEL_BIAS and leaf.ndim == 2:
+            return jax.device_put(leaf, NamedSharding(mesh2, P("stage", "model")))
+        return jax.device_put(leaf, NamedSharding(mesh2, P("stage")))
+
+    stacked_tp = jax.tree_util.tree_map_with_path(place, stacked)
+    x = _x(seed=7)
+    f = jax.jit(pp.make_pipelined_blocks_fn(mesh2, _stage_fn(block),
+                                            num_microbatches=4))
+    np.testing.assert_allclose(np.asarray(f(stacked_tp, x)),
+                               np.asarray(_sequential(block, stage_params, x)),
+                               rtol=1e-5, atol=1e-5)
+
+    g_pipe = jax.grad(lambda sp: jnp.sum(jnp.sin(f(sp, x))))(stacked_tp)
+    g_seq = pp.stack_stage_params(jax.grad(
+        lambda ps: jnp.sum(jnp.sin(_sequential(block, ps, x))))(stage_params))
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_flash_kernel_traces_inside_pipeline_body(mesh):
+    """The flash pallas kernel PROPER (not the crossover dispatcher, which picks
+    dense at short S) runs inside the pipeline's shard_map body and matches the
+    same model evaluated sequentially — the kernel-level half of r4 verdict item 4's
+    flash-in-stage ask."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        TransformerClassifier,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
+        pallas_attention as pa,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state,
+    )
+
+    model = TransformerClassifier(num_layers=NUM_STAGES, dropout_rate=0.0,
+                                  seq_len=256, attention_fn=pa.flash_attention)
+    params = create_train_state(model, jax.random.PRNGKey(13)).params
+    stacked, rest = pp.stack_transformer_blocks(params, model.num_layers)
+    engine = pp.PipelinedClassifier(model, mesh, num_microbatches=4)
+
+    images = jnp.asarray(
+        np.random.default_rng(14).normal(size=(8, 28, 28, 1)).astype(np.float32))
+    ref = model.apply({"params": params}, images)
+    out = engine.apply({"params": {"blocks": stacked, "rest": rest}}, images)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)

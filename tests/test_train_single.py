@@ -142,24 +142,6 @@ def test_single_trainer_causal_transformer(tmp_path, tiny_datasets):
                     datasets=tiny_datasets)
 
 
-def test_fused_step_rejects_non_cnn_model(tmp_path, tiny_datasets):
-    cfg = SingleProcessConfig(
-        n_epochs=1, model="transformer", experimental_fused_step=True,
-        results_dir=str(tmp_path / "results"), images_dir=str(tmp_path / "images"))
-    with pytest.raises(ValueError, match="flagship CNN"):
-        single.main(cfg, datasets=tiny_datasets)
-
-
-def test_fused_step_rejects_bf16(tmp_path, tiny_datasets):
-    """The fused kernel is an f32 step; silently training f32 while evaluating bf16
-    would misreport — rejected up front."""
-    cfg = SingleProcessConfig(
-        n_epochs=1, model="cnn", bf16=True, experimental_fused_step=True,
-        results_dir=str(tmp_path / "results"), images_dir=str(tmp_path / "images"))
-    with pytest.raises(ValueError, match="without --bf16"):
-        single.main(cfg, datasets=tiny_datasets)
-
-
 def test_unknown_model_rejected(tmp_path, tiny_datasets):
     cfg = SingleProcessConfig(
         n_epochs=1, model="mlp",
