@@ -153,6 +153,72 @@ def _block_live(iq, j, bq, bk, *, causal: bool, window: int = 0,
     return live
 
 
+def _block_interior(iq, j, bq, bk, *, causal: bool, window: int = 0,
+                    q_offset: int = 0):
+    """Whether EVERY pair of (query block iq, key block j) is visible — such
+    blocks skip the mask's iota/compare/select chain entirely (r5: the VPU work
+    per element of that chain rivals the softmax exp, and at large S interior
+    blocks dominate). Extreme-position arithmetic mirrors ``_visibility_mask``."""
+    interior = jnp.bool_(True)
+    if causal:
+        interior &= q_offset + iq * bq >= j * bk + bk - 1   # oldest q ≥ youngest k
+    if window:
+        interior &= q_offset + iq * bq + bq - 1 - j * bk < window
+        interior &= j * bk + bk - 1 - (q_offset + iq * bq) < window
+    return interior
+
+
+def _elided_walk(nq: int, off_blocks: int, reach, *, causal: bool,
+                 trailing: tuple = (0,)):
+    """Key-walk index map for the FULL (non-banded) grid that aliases DEAD steps
+    onto the nearest live block: Pallas skips the HBM→VMEM copy when consecutive
+    grid steps request the same block, so the upper-triangle (causal) / out-of-band
+    (windowed) fetches that previously still streamed now cost nothing (r5 — at
+    S ≥ 8k causal the dead fetches made the kernels HBM-bound). Dead steps remain
+    grid iterations; ``@pl.when`` already skips their FLOPs. The clamp is the
+    identity for every LIVE step, so numerics are untouched."""
+
+    def index_map(b, i, j):
+        lo = i + off_blocks - reach if reach is not None else 0
+        hi = i + off_blocks if causal else (
+            i + off_blocks + reach if reach is not None else nq - 1)
+        return (b, jnp.clip(jnp.clip(j, lo, hi), 0, nq - 1)) + trailing
+
+    return index_map
+
+
+def _elided_walk_kv(nq: int, off_blocks: int, reach, *, causal: bool,
+                    trailing: tuple = (0,)):
+    """``_elided_walk``'s mirror for the dkv kernel, whose step axis walks QUERY
+    blocks around key block ``i``: causal bounds queries from BELOW (only queries
+    at/after the key see it), the window from above."""
+
+    def index_map(b, i, j):
+        lo = i - off_blocks if causal else (
+            i - off_blocks - reach if reach is not None else 0)
+        hi = i - off_blocks + reach if reach is not None else nq - 1
+        return (b, jnp.clip(jnp.clip(j, lo, hi), 0, nq - 1)) + trailing
+
+    return index_map
+
+
+def _dispatch_block(body, qi, ki, bq, bk, in_range, *, causal: bool,
+                    window: int, q_offset):
+    """Shared liveness/interior gating for all three kernels (fwd/dq/dkv):
+    ``body(masked)`` runs only for live blocks, and fully-visible interior blocks
+    take the mask-free specialization. One owner — an edit to the gating cannot
+    desynchronize forward and backward masking."""
+    live = in_range & _block_live(qi, ki, bq, bk, causal=causal, window=window,
+                                  q_offset=q_offset)
+    if causal or window:
+        interior = _block_interior(qi, ki, bq, bk, causal=causal, window=window,
+                                   q_offset=q_offset)
+        pl.when(live & interior)(lambda: body(False))
+        pl.when(live & ~interior)(lambda: body(True))
+    else:
+        pl.when(live)(lambda: body(False))
+
+
 def _band_reach(window: int, block: int) -> int:
     """Max |query block − key block| with any in-window pair: the banded grid walks
     key-block offsets ``[-reach, +reach]`` (``[-reach, 0]`` causal) instead of all
@@ -204,12 +270,7 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
         m_ref[:] = jnp.full_like(m_ref, NEG)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # Causal/banded: key blocks with no visible pair contribute nothing — no FLOPs
-    # (their fetch still pipelines; grids cannot skip steps).
-    @pl.when(in_range
-             & _block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window,
-                           q_offset=q_offset))
-    def _():
+    def body(masked: bool):
         # Matmul operands keep the INPUT dtype (bf16 runs at the MXU's native
         # rate; f32 inputs behave as before) with f32 accumulation; the softmax
         # scale is applied to the f32 product, not the narrow operand.
@@ -217,7 +278,7 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
         k_blk = k_ref[0]                                                   # [bk, D]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal or window:
+        if masked:
             visible = _visibility_mask(iq, j, bq, k_ref.shape[1],
                                        causal=causal, window=window,
                                        q_offset=q_offset)
@@ -227,7 +288,7 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
         m_blk = jnp.max(s, axis=1, keepdims=True)                          # [bq, 1]
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new)
-        if causal or window:
+        if masked:
             p = jnp.where(visible, p, 0.0)
         corr = jnp.exp(m - m_new)
         v_blk = v_ref[0]
@@ -236,6 +297,13 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
                                 preferred_element_type=jnp.float32))
         m_ref[:] = m_new
         l_ref[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
+
+    # Causal/banded: key blocks with no visible pair contribute nothing — no FLOPs
+    # (and with the elided walks, no fetch either). Fully-visible INTERIOR blocks
+    # skip the mask chain — per element it costs iota+compare+2 selects of VPU
+    # work, which rivals the softmax exp (r5).
+    _dispatch_block(body, iq, j, bq, k_ref.shape[1], in_range, causal=causal,
+                    window=window, q_offset=q_offset)
 
     @pl.when(step == num_steps - 1)
     def _():
@@ -272,7 +340,15 @@ def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
                                                0, nq - 1), 0)
     else:
         base, num_steps = None, nq
-        key_map = lambda b, i, j: (b, j, 0)
+        if not dyn and (causal or window):
+            # Full walk with dead-step fetch elision (see _elided_walk). Dynamic
+            # (traced) offsets cannot steer index maps without scalar prefetch,
+            # so they keep the plain walk.
+            key_map = _elided_walk(nq, off_blocks,
+                                   _band_reach(window, block) if window else None,
+                                   causal=causal)
+        else:
+            key_map = lambda b, i, j: (b, j, 0)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_steps=num_steps, num_blocks=nq, band_base=base,
                                window=window, q_offset=q_offset, dyn_offset=dyn)
@@ -335,10 +411,7 @@ def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
     def _():
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
-    @pl.when(in_range
-             & _block_live(iq, j, bq, k_ref.shape[1], causal=causal, window=window,
-                           q_offset=q_offset))
-    def _():
+    def body(masked: bool):
         # Matmul operands keep the INPUT dtype (bf16 at the MXU's native rate),
         # f32 accumulation; softmax statistics and ds stay f32, narrowed only at
         # the matmul boundary (the standard TPU flash-backward precision split).
@@ -350,19 +423,22 @@ def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
         v_blk = v_ref[0]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal or window:
+        if masked:
             visible = _visibility_mask(iq, j, bq, k_ref.shape[1],
                                        causal=causal, window=window,
                                        q_offset=q_offset)
             s = jnp.where(visible, s, NEG)
         p = jnp.exp(s - lse)                                      # [bq, bk]
-        if causal or window:
+        if masked:
             p = jnp.where(visible, p, 0.0)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dq_acc_ref[:] = dq_acc_ref[:] + jnp.dot(
             ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32)
+
+    _dispatch_block(body, iq, j, bq, k_ref.shape[1], in_range, causal=causal,
+                    window=window, q_offset=q_offset)
 
     @pl.when(step == num_steps - 1)
     def _():
@@ -396,11 +472,7 @@ def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    # Causal/banded: query blocks with no visible pair against this key block skip.
-    @pl.when(in_range
-             & _block_live(i, ik, q_ref.shape[1], bk, causal=causal, window=window,
-                           q_offset=q_offset))
-    def _():
+    def body(masked: bool):
         # Same precision split as the dq kernel: operands in the input dtype,
         # f32 accumulation, p/ds narrowed only at the matmul boundary.
         k = k_ref[0]                                              # [bk, D]
@@ -411,13 +483,13 @@ def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
         delta_blk = jnp.transpose(delta_ref[0, 0])                # [bq, 1]
         s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal or window:
+        if masked:
             visible = _visibility_mask(i, ik, q_ref.shape[1], bk,
                                        causal=causal, window=window,
                                        q_offset=q_offset)
             s = jnp.where(visible, s, NEG)
         p = jnp.exp(s - lse_blk)                                  # [bq, bk]
-        if causal or window:
+        if masked:
             p = jnp.where(visible, p, 0.0)
         # dv += pᵀ · do ; dk += dsᵀ · q
         dv_acc_ref[:] = dv_acc_ref[:] + jax.lax.dot_general(
@@ -429,6 +501,11 @@ def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
         dk_acc_ref[:] = dk_acc_ref[:] + jax.lax.dot_general(
             ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    # Causal/banded: query blocks with no visible pair against this key block skip;
+    # fully-visible interior blocks skip the mask chain (see _fwd_kernel).
+    _dispatch_block(body, i, ik, q_ref.shape[1], bk, in_range, causal=causal,
+                    window=window, q_offset=q_offset)
 
     @pl.when(step == num_steps - 1)
     def _():
@@ -493,14 +570,26 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
     def row_i(b, i, j):
         return (b, i, 0)
 
-    def _banded_map(base, center_off=0):
+    # Full (non-banded) walks elide dead-step fetches by aliasing onto the nearest
+    # live block (see _elided_walk); traced offsets keep the plain walk.
+    full_reach = _band_reach(window, block) if window else None
+    elide = not dyn and (causal or window)
+
+    def _banded_map(base, center_off=0, kv=False):
         if base is None:
+            if elide:
+                walk = _elided_walk_kv if kv else _elided_walk
+                return walk(nq, off_blocks, full_reach, causal=causal)
             return lambda b, i, j: (b, j, 0)
         return lambda b, i, o: (b, jnp.clip(i + center_off + o - base,
                                             0, nq - 1), 0)
 
-    def _banded_lse_map(base, center_off=0):
+    def _banded_lse_map(base, center_off=0, kv=False):
         if base is None:
+            if elide:
+                walk = _elided_walk_kv if kv else _elided_walk
+                return walk(nq, off_blocks, full_reach, causal=causal,
+                            trailing=(0, 0))
             return lambda b, i, j: (b, j, 0, 0)
         return lambda b, i, o: (b, jnp.clip(i + center_off + o - base,
                                             0, nq - 1), 0, 0)
@@ -527,10 +616,11 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
     )(*dyn_args, q3, k3, v3, g, lse, delta)[0]
 
     # dkv grid: axis 1 = key block (accumulators persist), axis 2 = query block.
-    kv_walk = pl.BlockSpec((1, block, d), _banded_map(kv_base, -off_blocks),
+    kv_walk = pl.BlockSpec((1, block, d),
+                           _banded_map(kv_base, -off_blocks, kv=True),
                            memory_space=pltpu.VMEM)
     kv_lse_walk = pl.BlockSpec((1, 1, 1, block),
-                               _banded_lse_map(kv_base, -off_blocks),
+                               _banded_lse_map(kv_base, -off_blocks, kv=True),
                                memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
