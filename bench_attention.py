@@ -113,6 +113,10 @@ def main() -> int:
                         default="float32",
                         help="q/k/v dtype; bfloat16 is the training dtype and runs "
                              "the kernels' matmuls at the MXU's native rate")
+    parser.add_argument("--native-layout", action="store_true",
+                        help="feed the kernels the model's [B,S,H,D] layout "
+                             "directly (no transpose repacks) — r5 measurement "
+                             "knob; rows carry native_layout: true")
     args = parser.parse_args()
     if args.block is not None and args.block_sweep is not None:
         parser.error("--block and --block-sweep are mutually exclusive")
@@ -142,6 +146,8 @@ def main() -> int:
                "dtype": args.dtype, "reps": REPS}
         if args.window is not None:
             row["window"] = args.window
+        if args.native_layout:
+            row["native_layout"] = True
         sweeping = args.block_sweep is not None
         blocks = (args.block_sweep if sweeping
                   else [args.block] if args.block is not None else [None])
@@ -156,6 +162,8 @@ def main() -> int:
                 flash_kw["block"] = blk
             if args.window is not None:
                 flash_kw["window"] = args.window
+            if args.native_layout:
+                flash_kw["native_layout"] = True
             flash = (ops.flash_attention if not flash_kw else
                      functools.partial(ops.flash_attention, **flash_kw))
             try:

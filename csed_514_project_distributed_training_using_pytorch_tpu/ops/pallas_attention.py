@@ -47,6 +47,7 @@ must divide by the chosen ``block``; callers wanting odd lengths use the dense p
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -168,38 +169,106 @@ def _block_interior(iq, j, bq, bk, *, causal: bool, window: int = 0,
     return interior
 
 
-def _elided_walk(nq: int, off_blocks: int, reach, *, causal: bool,
-                 trailing: tuple = (0,)):
-    """Key-walk index map for the FULL (non-banded) grid that aliases DEAD steps
-    onto the nearest live block: Pallas skips the HBM→VMEM copy when consecutive
-    grid steps request the same block, so the upper-triangle (causal) / out-of-band
-    (windowed) fetches that previously still streamed now cost nothing (r5 — at
-    S ≥ 8k causal the dead fetches made the kernels HBM-bound). Dead steps remain
-    grid iterations; ``@pl.when`` already skips their FLOPs. The clamp is the
-    identity for every LIVE step, so numerics are untouched."""
+def _elided_key_idx(nq: int, off_blocks: int, reach, *, causal: bool):
+    """Key-walk block index ``idx(i, j)`` for the FULL (non-banded) grid that
+    aliases DEAD steps onto the nearest live block: Pallas skips the HBM→VMEM copy
+    when consecutive grid steps request the same block, so the upper-triangle
+    (causal) / out-of-band (windowed) fetches that previously still streamed now
+    cost nothing (r5 — at S ≥ 8k causal the dead fetches made the kernels
+    HBM-bound). Dead steps remain grid iterations; ``@pl.when`` already skips
+    their FLOPs. The clamp is the identity for every LIVE step, so numerics are
+    untouched."""
 
-    def index_map(b, i, j):
+    def idx(i, j):
         lo = i + off_blocks - reach if reach is not None else 0
         hi = i + off_blocks if causal else (
             i + off_blocks + reach if reach is not None else nq - 1)
-        return (b, jnp.clip(jnp.clip(j, lo, hi), 0, nq - 1)) + trailing
+        return jnp.clip(jnp.clip(j, lo, hi), 0, nq - 1)
 
-    return index_map
+    return idx
 
 
-def _elided_walk_kv(nq: int, off_blocks: int, reach, *, causal: bool,
-                    trailing: tuple = (0,)):
-    """``_elided_walk``'s mirror for the dkv kernel, whose step axis walks QUERY
+def _elided_query_idx(nq: int, off_blocks: int, reach, *, causal: bool):
+    """``_elided_key_idx``'s mirror for the dkv kernel, whose step axis walks QUERY
     blocks around key block ``i``: causal bounds queries from BELOW (only queries
     at/after the key see it), the window from above."""
 
-    def index_map(b, i, j):
+    def idx(i, j):
         lo = i - off_blocks if causal else (
             i - off_blocks - reach if reach is not None else 0)
         hi = i - off_blocks + reach if reach is not None else nq - 1
-        return (b, jnp.clip(jnp.clip(j, lo, hi), 0, nq - 1)) + trailing
+        return jnp.clip(jnp.clip(j, lo, hi), 0, nq - 1)
 
-    return index_map
+    return idx
+
+
+class _GridLayout:
+    """Grid/spec factory shared by the fwd/dq/dkv ``pallas_call``s for the two
+    operand layouts:
+
+    - packed ``[BH, S, D]`` — grid ``(bh, nq, steps)`` — the ring schedules'
+      shard layout;
+    - native ``[B, S, H, D]`` — grid ``(b, h, nq, steps)`` with the B and H block
+      dims ``None``-squeezed — the MODEL's layout, fed with no transpose repacks
+      (r5: the ``[B,S,H,D] ↔ [BH,S,D]`` copies around the custom calls were 11%
+      of the large-transformer step, ``bench_results/hw_r4/profile_large``).
+
+    Kernel bodies are identical either way (q/k/v/o refs ``[block, D]``, lse refs
+    ``[1, 1, block]``); only grids, specs, and the kernels' ``pid_base`` differ.
+    """
+
+    def __init__(self, shape, block: int):
+        self.four = len(shape) == 4
+        self.block, self.d = block, shape[-1]
+        if self.four:
+            g, s, hh, _ = shape
+            self.prefix, self.pid_base = (g, hh), 2
+        else:
+            bh, s, _ = shape
+            self.prefix, self.pid_base = (bh,), 1
+        self.s = s
+
+    def grid(self, nq: int, steps: int) -> tuple:
+        return self.prefix + (nq, steps)
+
+    def _spec(self, idx_fn):
+        if self.four:
+            return pl.BlockSpec((None, self.block, None, self.d),
+                                lambda g, h, i, j: (g, idx_fn(i, j), h, 0),
+                                memory_space=pltpu.VMEM)
+        return pl.BlockSpec((None, self.block, self.d),
+                            lambda b, i, j: (b, idx_fn(i, j), 0),
+                            memory_space=pltpu.VMEM)
+
+    def row_spec(self):
+        return self._spec(lambda i, j: i)
+
+    def walk_spec(self, idx_fn):
+        return self._spec(idx_fn)
+
+    def _lse_spec(self, idx_fn):
+        if self.four:
+            return pl.BlockSpec((None, None, 1, 1, self.block),
+                                lambda g, h, i, j: (g, h, idx_fn(i, j), 0, 0),
+                                memory_space=pltpu.VMEM)
+        return pl.BlockSpec((None, 1, 1, self.block),
+                            lambda b, i, j: (b, idx_fn(i, j), 0, 0),
+                            memory_space=pltpu.VMEM)
+
+    def lse_row_spec(self):
+        return self._lse_spec(lambda i, j: i)
+
+    def lse_walk_spec(self, idx_fn):
+        return self._lse_spec(idx_fn)
+
+    def lse_shape(self, nq: int) -> tuple:
+        return self.prefix + (nq, 1, self.block)
+
+    def out_shape(self, dtype):
+        if self.four:
+            g, hh = self.prefix
+            return jax.ShapeDtypeStruct((g, self.s, hh, self.d), dtype)
+        return jax.ShapeDtypeStruct((self.prefix[0], self.s, self.d), dtype)
 
 
 def _dispatch_block(body, qi, ki, bq, bk, in_range, *, causal: bool,
@@ -241,19 +310,25 @@ def _banded(window: int, causal: bool, nq: int, block: int) -> bool:
 
 
 def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
-                band_base=None, window=0, q_offset=0, dyn_offset=False):
+                band_base=None, window=0, q_offset=0, dyn_offset=False,
+                pid_base=1):
     # ``dyn_offset``: the hop offset arrives as a TRACED int32 scalar in SMEM (the
     # first operand) instead of the static ``q_offset`` — the zig-zag schedules'
     # chunk-pair offsets are device-dependent. Banding requires a static offset,
     # so dynamic callers always use the full walk (``band_base is None``).
+    # ``pid_base``: grid position of the query-block axis — 1 for the packed
+    # [BH, S, D] layout's (bh, nq, steps) grid, 2 for the native [B, S, H, D]
+    # layout's (b, h, nq, steps) grid (r5). Block dims not in the ref are
+    # None-squeezed by the specs, so the kernel body is layout-agnostic:
+    # q/k/v/o refs are [block, D], lse refs [1, 1, block].
     if dyn_offset:
         off_ref, refs = refs[0], refs[1:]
         q_offset = off_ref[0]
         assert band_base is None
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
-    iq = pl.program_id(1)
-    step = pl.program_id(2)
-    bq = q_ref.shape[1]
+    iq = pl.program_id(pid_base)
+    step = pl.program_id(pid_base + 1)
+    bq = q_ref.shape[0]
     # Band-compressed grid: the step axis walks key-block OFFSETS around the query
     # block (shifted by the hop offset when the caller's queries live q_offset
     # positions past the keys); out-of-range offsets (clamped to a real block by
@@ -274,12 +349,12 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
         # Matmul operands keep the INPUT dtype (bf16 runs at the MXU's native
         # rate; f32 inputs behave as before) with f32 accumulation; the softmax
         # scale is applied to the f32 product, not the narrow operand.
-        q = q_ref[0]                                                       # [bq, D]
-        k_blk = k_ref[0]                                                   # [bk, D]
+        q = q_ref[:]                                                       # [bq, D]
+        k_blk = k_ref[:]                                                   # [bk, D]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if masked:
-            visible = _visibility_mask(iq, j, bq, k_ref.shape[1],
+            visible = _visibility_mask(iq, j, bq, k_ref.shape[0],
                                        causal=causal, window=window,
                                        q_offset=q_offset)
             s = jnp.where(visible, s, NEG)
@@ -291,7 +366,7 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
         if masked:
             p = jnp.where(visible, p, 0.0)
         corr = jnp.exp(m - m_new)
-        v_blk = v_ref[0]
+        v_blk = v_ref[:]
         acc_ref[:] = (acc_ref[:] * corr
                       + jnp.dot(p.astype(v_blk.dtype), v_blk,
                                 preferred_element_type=jnp.float32))
@@ -302,27 +377,30 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
     # (and with the elided walks, no fetch either). Fully-visible INTERIOR blocks
     # skip the mask chain — per element it costs iota+compare+2 selects of VPU
     # work, which rivals the softmax exp (r5).
-    _dispatch_block(body, iq, j, bq, k_ref.shape[1], in_range, causal=causal,
+    _dispatch_block(body, iq, j, bq, k_ref.shape[0], in_range, causal=causal,
                     window=window, q_offset=q_offset)
 
     @pl.when(step == num_steps - 1)
     def _():
         l_safe = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
-        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        o_ref[:] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
         lse = m_ref[:] + jnp.log(l_safe)                                   # [bq, 1]
-        lse_ref[:] = jnp.transpose(lse).reshape(1, 1, 1, bq)
+        lse_ref[:] = jnp.transpose(lse).reshape(1, 1, bq)
 
 
-def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
+def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
                    window: int = 0, q_offset: int = 0, q_offset_dyn=None):
-    """q3/k3/v3: [BH, S, D] → (out [BH, S, D], lse [BH, S/block, 1, block]).
+    """Packed [BH, S, D]³ → (out [BH, S, D], lse [BH, S/block, 1, block]), or
+    native [B, S, H, D]³ → (out [B, S, H, D], lse [B, H, S/block, 1, block]) —
+    the layout is read off the operand rank (``_GridLayout``).
     ``q_offset`` (static, a multiple of ``block``) shifts query positions globally
     relative to the keys — the ring hop offset (see ``_visibility_mask``).
     ``q_offset_dyn`` (a traced int32 scalar, mutually exclusive with a nonzero
     ``q_offset``) carries a DEVICE-DEPENDENT offset into the kernels via SMEM —
     the zig-zag schedules' chunk-pair offsets; banding is unavailable there (the
     grid is static), so the full walk runs with offset-shifted masks."""
-    bh, s, d = q3.shape
+    s, d = qx.shape[1], qx.shape[-1]
+    lay = _GridLayout(qx.shape, block)
     _check_block(s, block)
     _check_offset(q_offset, block)
     dyn = q_offset_dyn is not None
@@ -336,44 +414,41 @@ def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
         # A nonzero hop offset can put the whole band on one side of the local
         # diagonal, so the causal one-sided walk applies only at offset 0.
         num_steps = base + 1 if causal and not q_offset else 2 * base + 1
-        key_map = lambda b, i, o: (b, jnp.clip(i + off_blocks + o - base,
-                                               0, nq - 1), 0)
+        key_idx = lambda i, o: jnp.clip(i + off_blocks + o - base, 0, nq - 1)
     else:
         base, num_steps = None, nq
         if not dyn and (causal or window):
-            # Full walk with dead-step fetch elision (see _elided_walk). Dynamic
-            # (traced) offsets cannot steer index maps without scalar prefetch,
-            # so they keep the plain walk.
-            key_map = _elided_walk(nq, off_blocks,
-                                   _band_reach(window, block) if window else None,
-                                   causal=causal)
+            # Full walk with dead-step fetch elision (see _elided_key_idx).
+            # Dynamic (traced) offsets cannot steer index maps without scalar
+            # prefetch, so they keep the plain walk.
+            key_idx = _elided_key_idx(
+                nq, off_blocks, _band_reach(window, block) if window else None,
+                causal=causal)
         else:
-            key_map = lambda b, i, j: (b, j, 0)
+            key_idx = lambda i, j: j
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_steps=num_steps, num_blocks=nq, band_base=base,
-                               window=window, q_offset=q_offset, dyn_offset=dyn)
+                               window=window, q_offset=q_offset, dyn_offset=dyn,
+                               pid_base=lay.pid_base)
     dyn_specs = ([pl.BlockSpec(memory_space=pltpu.SMEM)] if dyn else [])
     dyn_args = ((jnp.asarray(q_offset_dyn, jnp.int32).reshape(1),) if dyn else ())
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, nq, num_steps),
+        grid=lay.grid(nq, num_steps),
         in_specs=dyn_specs + [
-            pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block, d), key_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block, d), key_map, memory_space=pltpu.VMEM),
+            lay.row_spec(),
+            lay.walk_spec(key_idx),
+            lay.walk_spec(key_idx),
         ],
         out_specs=[
-            pl.BlockSpec((1, block, d), lambda b, i, j: (b, i, 0),
-                         memory_space=pltpu.VMEM),
-            # lse rides as [BH, nq, 1, block]: the (1, block) trailing dims equal the
-            # array's, satisfying Mosaic's last-two-dims block constraint.
-            pl.BlockSpec((1, 1, 1, block), lambda b, i, j: (b, i, 0, 0),
-                         memory_space=pltpu.VMEM),
+            lay.row_spec(),
+            # lse rides with (1, block) trailing dims equal to the array's,
+            # satisfying Mosaic's last-two-dims block constraint.
+            lay.lse_row_spec(),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, nq, 1, block), jnp.float32),
+            lay.out_shape(qx.dtype),
+            jax.ShapeDtypeStruct(lay.lse_shape(nq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block, d), jnp.float32),    # acc
@@ -381,7 +456,7 @@ def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
             pltpu.VMEM((block, 1), jnp.float32),    # running normalizer l
         ],
         interpret=_interpret(),
-    )(*dyn_args, q3, k3, v3)
+    )(*dyn_args, qx, kx, vx)
     return out, lse
 
 
@@ -391,16 +466,17 @@ def _flash_forward(q3, k3, v3, *, causal: bool, block: int = BLOCK,
 
 
 def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
-               band_base=None, window=0, q_offset=0, dyn_offset=False):
+               band_base=None, window=0, q_offset=0, dyn_offset=False,
+               pid_base=1):
     if dyn_offset:                      # traced hop offset in SMEM (see _fwd_kernel)
         off_ref, refs = refs[0], refs[1:]
         q_offset = off_ref[0]
         assert band_base is None
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
      dq_acc_ref) = refs
-    iq = pl.program_id(1)
-    step = pl.program_id(2)
-    bq = q_ref.shape[1]
+    iq = pl.program_id(pid_base)
+    step = pl.program_id(pid_base + 1)
+    bq = q_ref.shape[0]
     if band_base is None:
         j, in_range = step, jnp.bool_(True)
     else:
@@ -415,16 +491,16 @@ def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
         # Matmul operands keep the INPUT dtype (bf16 at the MXU's native rate),
         # f32 accumulation; softmax statistics and ds stay f32, narrowed only at
         # the matmul boundary (the standard TPU flash-backward precision split).
-        q = q_ref[0]                                              # [bq, D]
-        do = do_ref[0]                                            # [bq, D]
-        lse = jnp.transpose(lse_ref[0, 0])                        # [1,bq] -> [bq, 1]
-        delta = jnp.transpose(delta_ref[0, 0])                    # [1,bq] -> [bq, 1]
-        k_blk = k_ref[0]
-        v_blk = v_ref[0]
+        q = q_ref[:]                                              # [bq, D]
+        do = do_ref[:]                                            # [bq, D]
+        lse = jnp.transpose(lse_ref[0])                           # [1,bq] -> [bq, 1]
+        delta = jnp.transpose(delta_ref[0])                       # [1,bq] -> [bq, 1]
+        k_blk = k_ref[:]
+        v_blk = v_ref[:]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if masked:
-            visible = _visibility_mask(iq, j, bq, k_ref.shape[1],
+            visible = _visibility_mask(iq, j, bq, k_ref.shape[0],
                                        causal=causal, window=window,
                                        q_offset=q_offset)
             s = jnp.where(visible, s, NEG)
@@ -437,25 +513,26 @@ def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
         dq_acc_ref[:] = dq_acc_ref[:] + jnp.dot(
             ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32)
 
-    _dispatch_block(body, iq, j, bq, k_ref.shape[1], in_range, causal=causal,
+    _dispatch_block(body, iq, j, bq, k_ref.shape[0], in_range, causal=causal,
                     window=window, q_offset=q_offset)
 
     @pl.when(step == num_steps - 1)
     def _():
-        dq_ref[0] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
+        dq_ref[:] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
-                band_base=None, window=0, q_offset=0, dyn_offset=False):
+                band_base=None, window=0, q_offset=0, dyn_offset=False,
+                pid_base=1):
     if dyn_offset:                      # traced hop offset in SMEM (see _fwd_kernel)
         off_ref, refs = refs[0], refs[1:]
         q_offset = off_ref[0]
         assert band_base is None
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
      dk_acc_ref, dv_acc_ref) = refs
-    ik = pl.program_id(1)
-    step = pl.program_id(2)
-    bk = k_ref.shape[1]
+    ik = pl.program_id(pid_base)
+    step = pl.program_id(pid_base + 1)
+    bk = k_ref.shape[0]
     # Banded: the step axis walks QUERY-block offsets around this key block
     # (causal keys are only visible to queries at or after them, so offsets start
     # at the diagonal: band_base == 0). A hop offset shifts the visible query
@@ -475,16 +552,16 @@ def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
     def body(masked: bool):
         # Same precision split as the dq kernel: operands in the input dtype,
         # f32 accumulation, p/ds narrowed only at the matmul boundary.
-        k = k_ref[0]                                              # [bk, D]
-        v = v_ref[0]                                              # [bk, D]
-        q_blk = q_ref[0]                                          # [bq, D]
-        do_blk = do_ref[0]
-        lse_blk = jnp.transpose(lse_ref[0, 0])                    # [bq, 1]
-        delta_blk = jnp.transpose(delta_ref[0, 0])                # [bq, 1]
+        k = k_ref[:]                                              # [bk, D]
+        v = v_ref[:]                                              # [bk, D]
+        q_blk = q_ref[:]                                          # [bq, D]
+        do_blk = do_ref[:]
+        lse_blk = jnp.transpose(lse_ref[0])                       # [bq, 1]
+        delta_blk = jnp.transpose(delta_ref[0])                   # [bq, 1]
         s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if masked:
-            visible = _visibility_mask(i, ik, q_ref.shape[1], bk,
+            visible = _visibility_mask(i, ik, q_ref.shape[0], bk,
                                        causal=causal, window=window,
                                        q_offset=q_offset)
             s = jnp.where(visible, s, NEG)
@@ -504,47 +581,57 @@ def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
 
     # Causal/banded: query blocks with no visible pair against this key block skip;
     # fully-visible interior blocks skip the mask chain (see _fwd_kernel).
-    _dispatch_block(body, i, ik, q_ref.shape[1], bk, in_range, causal=causal,
+    _dispatch_block(body, i, ik, q_ref.shape[0], bk, in_range, causal=causal,
                     window=window, q_offset=q_offset)
 
     @pl.when(step == num_steps - 1)
     def _():
-        dk_ref[0] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+        dk_ref[:] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
 def _flash_backward(res, g, *, causal: bool, block: int = BLOCK,
                     window: int = 0):
-    q3, k3, v3, out, lse = res
-    bh, s, d = q3.shape
+    qx, kx, vx, out, lse = res
+    s = qx.shape[1]
     nq = s // block
-    # Δ = rowsum(dout ∘ out), reshaped to the lse layout — XLA fuses this small pass.
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1).reshape(bh, nq, 1, block)
-    return flash_backward_blocks(q3, k3, v3, g, lse, delta, causal=causal,
+    # Δ = rowsum(dout ∘ out), reshaped to the lse layout — XLA fuses this small
+    # pass (and in the native layout the [G,S,H]→[G,H,S] permute is D-free, so it
+    # is ~1/D the size of the operand repacks the layout removed).
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if qx.ndim == 4:
+        gsz, _, hh, _ = qx.shape
+        delta = jnp.transpose(delta, (0, 2, 1)).reshape(gsz, hh, nq, 1, block)
+    else:
+        delta = delta.reshape(qx.shape[0], nq, 1, block)
+    return flash_backward_blocks(qx, kx, vx, g, lse, delta, causal=causal,
                                  block=block, window=window)
 
 
-def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
+def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
                           block: int = BLOCK, window: int = 0,
                           q_offset: int = 0, q_offset_dyn=None):
     """One flash-backward pass of a query-block set against a key/value-block set,
     given the GLOBAL softmax statistics: ``(dq, dk, dv)`` contributions.
 
-    ``q3/g: [BH, Sq, D]``, ``k3/v3: [BH, Sk, D]`` with ``Sq == Sk``; ``lse/delta:
-    [BH, Sq/BLOCK, 1, BLOCK]`` are the log-sum-exp and ``rowsum(dout ∘ out)`` of the
-    FULL attention row (all keys, not just this block set). Because
-    ``p = exp(q·kᵀ·scale − lse)`` then yields the true softmax coefficients restricted
-    to these keys, the returned contributions sum exactly over block sets — this is the
-    per-hop building block of the trainable ring-of-flash
-    (``parallel.ring_attention.ring_flash_attention``), where dk/dv ride the ring with
-    their K/V blocks. ``causal=True`` masks with LOCAL block indices, i.e. it assumes
-    q and k share a global origin — ring callers use it only for the diagonal hop."""
-    bh, s, d = q3.shape
-    if k3.shape != (bh, s, d):
+    Packed layout (the ring schedules' shard form): ``qx/g: [BH, Sq, D]``,
+    ``kx/vx: [BH, Sk, D]`` with ``Sq == Sk``, ``lse/delta: [BH, Sq/BLOCK, 1,
+    BLOCK]``. Native layout (the model form, no transpose repacks):
+    ``[B, S, H, D]`` operands with ``lse/delta: [B, H, S/BLOCK, 1, BLOCK]`` —
+    selected by operand rank. The statistics are of the FULL attention row (all
+    keys, not just this block set): ``p = exp(q·kᵀ·scale − lse)`` then yields the
+    true softmax coefficients restricted to these keys, so the returned
+    contributions sum exactly over block sets — the per-hop building block of the
+    trainable ring-of-flash (``parallel.ring_attention.ring_flash_attention``),
+    where dk/dv ride the ring with their K/V blocks. ``causal=True`` masks with
+    LOCAL block indices, i.e. it assumes q and k share a global origin — ring
+    callers use it only for the diagonal hop."""
+    s, d = qx.shape[1], qx.shape[-1]
+    if kx.shape != qx.shape:
         raise ValueError(
-            f"flash_backward_blocks needs equal q/k block sets, got {q3.shape} vs "
-            f"{k3.shape}")
+            f"flash_backward_blocks needs equal q/k block sets, got {qx.shape} vs "
+            f"{kx.shape}")
+    lay = _GridLayout(qx.shape, block)
     _check_block(s, block)
     _check_offset(q_offset, block)
     dyn = q_offset_dyn is not None
@@ -567,75 +654,55 @@ def flash_backward_blocks(q3, k3, v3, g, lse, delta, *, causal: bool,
         dq_base = kv_base = None
         dq_steps = kv_steps = nq
 
-    def row_i(b, i, j):
-        return (b, i, 0)
-
     # Full (non-banded) walks elide dead-step fetches by aliasing onto the nearest
-    # live block (see _elided_walk); traced offsets keep the plain walk.
+    # live block (see _elided_key_idx); traced offsets keep the plain walk.
     full_reach = _band_reach(window, block) if window else None
     elide = not dyn and (causal or window)
 
-    def _banded_map(base, center_off=0, kv=False):
+    def _walk_idx(base, center_off=0, kv=False):
         if base is None:
             if elide:
-                walk = _elided_walk_kv if kv else _elided_walk
-                return walk(nq, off_blocks, full_reach, causal=causal)
-            return lambda b, i, j: (b, j, 0)
-        return lambda b, i, o: (b, jnp.clip(i + center_off + o - base,
-                                            0, nq - 1), 0)
+                mk = _elided_query_idx if kv else _elided_key_idx
+                return mk(nq, off_blocks, full_reach, causal=causal)
+            return lambda i, j: j
+        return lambda i, o: jnp.clip(i + center_off + o - base, 0, nq - 1)
 
-    def _banded_lse_map(base, center_off=0, kv=False):
-        if base is None:
-            if elide:
-                walk = _elided_walk_kv if kv else _elided_walk
-                return walk(nq, off_blocks, full_reach, causal=causal,
-                            trailing=(0, 0))
-            return lambda b, i, j: (b, j, 0, 0)
-        return lambda b, i, o: (b, jnp.clip(i + center_off + o - base,
-                                            0, nq - 1), 0, 0)
-
-    row_i_spec = pl.BlockSpec((1, block, d), row_i, memory_space=pltpu.VMEM)
-    lse_i_spec = pl.BlockSpec((1, 1, 1, block), lambda b, i, j: (b, i, 0, 0),
-                              memory_space=pltpu.VMEM)
-
+    row_spec, lse_row_spec = lay.row_spec(), lay.lse_row_spec()
     dyn_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] if dyn else []
     dyn_args = ((jnp.asarray(q_offset_dyn, jnp.int32).reshape(1),) if dyn else ())
-    dq_walk = pl.BlockSpec((1, block, d), _banded_map(dq_base, off_blocks),
-                           memory_space=pltpu.VMEM)
+    dq_walk = lay.walk_spec(_walk_idx(dq_base, off_blocks))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           num_steps=dq_steps, num_blocks=nq, band_base=dq_base,
-                          window=window, q_offset=q_offset, dyn_offset=dyn),
-        grid=(bh, nq, dq_steps),
-        in_specs=dyn_specs + [row_i_spec, dq_walk, dq_walk, row_i_spec, lse_i_spec,
-                              lse_i_spec],
-        out_specs=[row_i_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q3.dtype)],
+                          window=window, q_offset=q_offset, dyn_offset=dyn,
+                          pid_base=lay.pid_base),
+        grid=lay.grid(nq, dq_steps),
+        in_specs=dyn_specs + [row_spec, dq_walk, dq_walk, row_spec, lse_row_spec,
+                              lse_row_spec],
+        out_specs=[row_spec],
+        out_shape=[lay.out_shape(qx.dtype)],
         scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
         interpret=_interpret(),
-    )(*dyn_args, q3, k3, v3, g, lse, delta)[0]
+    )(*dyn_args, qx, kx, vx, g, lse, delta)[0]
 
-    # dkv grid: axis 1 = key block (accumulators persist), axis 2 = query block.
-    kv_walk = pl.BlockSpec((1, block, d),
-                           _banded_map(kv_base, -off_blocks, kv=True),
-                           memory_space=pltpu.VMEM)
-    kv_lse_walk = pl.BlockSpec((1, 1, 1, block),
-                               _banded_lse_map(kv_base, -off_blocks, kv=True),
-                               memory_space=pltpu.VMEM)
+    # dkv grid: the query-block axis walks (accumulators persist per key block).
+    kv_idx = _walk_idx(kv_base, -off_blocks, kv=True)
+    kv_walk = lay.walk_spec(kv_idx)
+    kv_lse_walk = lay.lse_walk_spec(kv_idx)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           num_steps=kv_steps, num_blocks=nq, band_base=kv_base,
-                          window=window, q_offset=q_offset, dyn_offset=dyn),
-        grid=(bh, nq, kv_steps),
-        in_specs=dyn_specs + [kv_walk, row_i_spec, row_i_spec, kv_walk,
+                          window=window, q_offset=q_offset, dyn_offset=dyn,
+                          pid_base=lay.pid_base),
+        grid=lay.grid(nq, kv_steps),
+        in_specs=dyn_specs + [kv_walk, row_spec, row_spec, kv_walk,
                               kv_lse_walk, kv_lse_walk],
-        out_specs=[row_i_spec, row_i_spec],
-        out_shape=[jax.ShapeDtypeStruct((bh, s, d), k3.dtype),
-                   jax.ShapeDtypeStruct((bh, s, d), v3.dtype)],
+        out_specs=[row_spec, row_spec],
+        out_shape=[lay.out_shape(kx.dtype), lay.out_shape(vx.dtype)],
         scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
                         pltpu.VMEM((block, d), jnp.float32)],
         interpret=_interpret(),
-    )(*dyn_args, q3, k3, v3, g, lse, delta)
+    )(*dyn_args, qx, kx, vx, g, lse, delta)
     return dq, dk, dv
 
 
@@ -681,9 +748,21 @@ def flash_forward_with_lse(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
                           q_offset=q_offset, q_offset_dyn=q_offset_dyn)
 
 
+def _native_layout_default() -> bool:
+    """Whether ``flash_attention`` feeds the kernels the model's [B, S, H, D]
+    layout directly (no transpose repacks) instead of packing to [BH, S, D].
+    Opt-in via ``FLASH_NATIVE_LAYOUT=1`` until a hardware capture picks the
+    winner: the native path deletes the repack copies (11% of the r4 large
+    transformer step) but its H-strided block DMA interacts with Mosaic's
+    last-two-dims tiling in ways only the chip can price."""
+    return os.environ.get("FLASH_NATIVE_LAYOUT", "0").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False, block: int | None = None,
-                    window: int | None = None) -> jax.Array:
+                    window: int | None = None,
+                    native_layout: bool | None = None) -> jax.Array:
     """Drop-in for ``ops.full_attention``: ``[B, S, H, D]`` → ``[B, S, H, D]``.
 
     Requires ``S % block == 0`` with ``block`` a multiple of 128 (lane-aligned);
@@ -691,7 +770,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     ``auto_block``. Differentiable via the two-kernel flash backward; usable as the
     transformer family's ``attention_fn``. ``block`` is a pure performance knob
     (numerics are block-invariant — pinned in tests); tune it with
-    ``bench_attention.py --block``.
+    ``bench_attention.py --block``. ``native_layout`` (default: the
+    ``FLASH_NATIVE_LAYOUT`` env knob) skips the [B,S,H,D]↔[BH,S,D] repacks and
+    grids over heads instead (``_GridLayout``).
 
     ``window=W`` is sliding-window/local attention with ``full_attention``'s exact
     semantics (distance < W; causal restricts to the past side) — and a BANDED grid:
@@ -705,9 +786,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         block = auto_block(s, int(window or 0))
     _check_block(s, block)
     validate_window(window)
+    op = _make_op(bool(causal), int(block), int(window or 0))
+    if native_layout is None:
+        native_layout = _native_layout_default()
+    if native_layout:
+        return op(q, k, v)
     to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
-    out3 = _make_op(bool(causal), int(block),
-                    int(window or 0))(to3(q), to3(k), to3(v))
+    out3 = op(to3(q), to3(k), to3(v))
     return jnp.transpose(out3.reshape(b, h, s, d), (0, 2, 1, 3))
 
 
