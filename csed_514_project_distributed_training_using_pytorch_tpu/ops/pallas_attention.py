@@ -231,35 +231,57 @@ class _GridLayout:
     def grid(self, nq: int, steps: int) -> tuple:
         return self.prefix + (nq, steps)
 
-    def _spec(self, idx_fn):
+    def _spec(self, idx_fn, prefetch: bool):
+        """``idx_fn(i, j, *scalars)`` → S-block index. With ``prefetch`` the maps
+        take the scalar-prefetch ref as a trailing arg (the
+        ``PrefetchScalarGridSpec`` convention) — how a TRACED hop offset steers
+        a banded walk (r5; previously dynamic offsets forced the full walk)."""
         if self.four:
+            if prefetch:
+                return pl.BlockSpec(
+                    (None, self.block, None, self.d),
+                    lambda g, h, i, j, off: (g, idx_fn(i, j, off), h, 0),
+                    memory_space=pltpu.VMEM)
             return pl.BlockSpec((None, self.block, None, self.d),
                                 lambda g, h, i, j: (g, idx_fn(i, j), h, 0),
+                                memory_space=pltpu.VMEM)
+        if prefetch:
+            return pl.BlockSpec((None, self.block, self.d),
+                                lambda b, i, j, off: (b, idx_fn(i, j, off), 0),
                                 memory_space=pltpu.VMEM)
         return pl.BlockSpec((None, self.block, self.d),
                             lambda b, i, j: (b, idx_fn(i, j), 0),
                             memory_space=pltpu.VMEM)
 
-    def row_spec(self):
-        return self._spec(lambda i, j: i)
+    def row_spec(self, prefetch: bool = False):
+        return self._spec(lambda i, j, *_: i, prefetch)
 
-    def walk_spec(self, idx_fn):
-        return self._spec(idx_fn)
+    def walk_spec(self, idx_fn, prefetch: bool = False):
+        return self._spec(idx_fn, prefetch)
 
-    def _lse_spec(self, idx_fn):
+    def _lse_spec(self, idx_fn, prefetch: bool):
         if self.four:
+            if prefetch:
+                return pl.BlockSpec(
+                    (None, None, 1, 1, self.block),
+                    lambda g, h, i, j, off: (g, h, idx_fn(i, j, off), 0, 0),
+                    memory_space=pltpu.VMEM)
             return pl.BlockSpec((None, None, 1, 1, self.block),
                                 lambda g, h, i, j: (g, h, idx_fn(i, j), 0, 0),
+                                memory_space=pltpu.VMEM)
+        if prefetch:
+            return pl.BlockSpec((None, 1, 1, self.block),
+                                lambda b, i, j, off: (b, idx_fn(i, j, off), 0, 0),
                                 memory_space=pltpu.VMEM)
         return pl.BlockSpec((None, 1, 1, self.block),
                             lambda b, i, j: (b, idx_fn(i, j), 0, 0),
                             memory_space=pltpu.VMEM)
 
-    def lse_row_spec(self):
-        return self._lse_spec(lambda i, j: i)
+    def lse_row_spec(self, prefetch: bool = False):
+        return self._lse_spec(lambda i, j, *_: i, prefetch)
 
-    def lse_walk_spec(self, idx_fn):
-        return self._lse_spec(idx_fn)
+    def lse_walk_spec(self, idx_fn, prefetch: bool = False):
+        return self._lse_spec(idx_fn, prefetch)
 
     def lse_shape(self, nq: int) -> tuple:
         return self.prefix + (nq, 1, self.block)
@@ -269,6 +291,41 @@ class _GridLayout:
             g, hh = self.prefix
             return jax.ShapeDtypeStruct((g, self.s, hh, self.d), dtype)
         return jax.ShapeDtypeStruct((self.prefix[0], self.s, self.d), dtype)
+
+
+def _dyn_band_reach(window: int, block: int) -> int:
+    """Band reach for TRACED offsets: one block wider than the static reach, so
+    the steered band stays correct for ANY offset value — the index maps steer by
+    ``off // block``, and the discarded sub-block remainder can push visible
+    pairs one block outside the quantized band. (In-repo zig-zag callers pass
+    block-quantized offsets, but the kernels' correctness must not depend on
+    that.)"""
+    return _band_reach(window, block) + 1
+
+
+def _dyn_banded(window: int, nq: int, block: int) -> bool:
+    """Whether the traced-offset banded walk is narrower than the full walk."""
+    return bool(window) and 2 * _dyn_band_reach(window, block) + 1 < nq
+
+
+def _pallas_dispatch(kernel, lay, nq: int, steps: int, in_specs, out_specs,
+                     out_shape, scratch_shapes, dyn: bool):
+    """One owner for the dyn/static ``pallas_call`` shape (fwd, dq, and dkv all
+    dispatch through here): traced offsets ride scalar prefetch
+    (``PrefetchScalarGridSpec`` — the scalar is the first operand and reaches the
+    index maps as their trailing arg), static paths use the plain grid."""
+    if dyn:
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=lay.grid(nq, steps),
+                in_specs=in_specs, out_specs=out_specs,
+                scratch_shapes=scratch_shapes),
+            out_shape=out_shape, interpret=_interpret())
+    return pl.pallas_call(
+        kernel, grid=lay.grid(nq, steps), in_specs=in_specs,
+        out_specs=out_specs, out_shape=out_shape, scratch_shapes=scratch_shapes,
+        interpret=_interpret())
 
 
 def _dispatch_block(body, qi, ki, bq, bk, in_range, *, causal: bool,
@@ -312,10 +369,12 @@ def _banded(window: int, causal: bool, nq: int, block: int) -> bool:
 def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
                 band_base=None, window=0, q_offset=0, dyn_offset=False,
                 pid_base=1):
-    # ``dyn_offset``: the hop offset arrives as a TRACED int32 scalar in SMEM (the
-    # first operand) instead of the static ``q_offset`` — the zig-zag schedules'
-    # chunk-pair offsets are device-dependent. Banding requires a static offset,
-    # so dynamic callers always use the full walk (``band_base is None``).
+    # ``dyn_offset``: the hop offset arrives as a TRACED int32 scalar via scalar
+    # prefetch (the first operand) instead of the static ``q_offset`` — the
+    # zig-zag schedules' chunk-pair offsets are device-dependent. r5: scalar-
+    # prefetch index maps let the SAME traced offset steer a banded walk
+    # (``band_base`` set), so dynamic windowed callers no longer pay the full
+    # O((S/block)²) grid.
     # ``pid_base``: grid position of the query-block axis — 1 for the packed
     # [BH, S, D] layout's (bh, nq, steps) grid, 2 for the native [B, S, H, D]
     # layout's (b, h, nq, steps) grid (r5). Block dims not in the ref are
@@ -324,7 +383,6 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
     if dyn_offset:
         off_ref, refs = refs[0], refs[1:]
         q_offset = off_ref[0]
-        assert band_base is None
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     iq = pl.program_id(pid_base)
     step = pl.program_id(pid_base + 1)
@@ -396,9 +454,14 @@ def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
     ``q_offset`` (static, a multiple of ``block``) shifts query positions globally
     relative to the keys — the ring hop offset (see ``_visibility_mask``).
     ``q_offset_dyn`` (a traced int32 scalar, mutually exclusive with a nonzero
-    ``q_offset``) carries a DEVICE-DEPENDENT offset into the kernels via SMEM —
-    the zig-zag schedules' chunk-pair offsets; banding is unavailable there (the
-    grid is static), so the full walk runs with offset-shifted masks."""
+    ``q_offset``) carries a DEVICE-DEPENDENT offset into the kernels via scalar
+    prefetch — the zig-zag schedules' chunk-pair offsets. r5: the traced offset
+    also STEERS the banded walk through scalar-prefetch index maps, so windowed
+    dynamic callers pay O(S·W/block²) grid steps like the static path instead of
+    the full O((S/block)²) walk. Unlike the static ``q_offset``, the traced
+    offset need NOT be block-quantized: the dynamic band is one block wider
+    (``_dyn_band_reach``) to absorb the sub-block remainder its floor-division
+    steering discards."""
     s, d = qx.shape[1], qx.shape[-1]
     lay = _GridLayout(qx.shape, block)
     _check_block(s, block)
@@ -409,54 +472,56 @@ def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
     scale = 1.0 / (d ** 0.5)
     nq = s // block
     off_blocks = q_offset // block
+    # The dynamic-offset banded walk is bidirectional only: the causal one-sided
+    # narrowing needs offset 0, and the zig-zag's dynamic pairs are non-causal.
     if not dyn and _banded(window, causal and not q_offset, nq, block):
         base = _band_reach(window, block)
         # A nonzero hop offset can put the whole band on one side of the local
         # diagonal, so the causal one-sided walk applies only at offset 0.
         num_steps = base + 1 if causal and not q_offset else 2 * base + 1
         key_idx = lambda i, o: jnp.clip(i + off_blocks + o - base, 0, nq - 1)
+    elif dyn and not causal and _dyn_banded(window, nq, block):
+        base = _dyn_band_reach(window, block)
+        num_steps = 2 * base + 1
+        key_idx = lambda i, o, off: jnp.clip(i + off[0] // block + o - base,
+                                             0, nq - 1)
     else:
         base, num_steps = None, nq
         if not dyn and (causal or window):
             # Full walk with dead-step fetch elision (see _elided_key_idx).
-            # Dynamic (traced) offsets cannot steer index maps without scalar
-            # prefetch, so they keep the plain walk.
             key_idx = _elided_key_idx(
                 nq, off_blocks, _band_reach(window, block) if window else None,
                 causal=causal)
         else:
-            key_idx = lambda i, j: j
+            key_idx = lambda i, j, *_: j
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_steps=num_steps, num_blocks=nq, band_base=base,
                                window=window, q_offset=q_offset, dyn_offset=dyn,
                                pid_base=lay.pid_base)
-    dyn_specs = ([pl.BlockSpec(memory_space=pltpu.SMEM)] if dyn else [])
+    in_specs = [
+        lay.row_spec(prefetch=dyn),
+        lay.walk_spec(key_idx, prefetch=dyn),
+        lay.walk_spec(key_idx, prefetch=dyn),
+    ]
+    out_specs = [
+        lay.row_spec(prefetch=dyn),
+        # lse rides with (1, block) trailing dims equal to the array's,
+        # satisfying Mosaic's last-two-dims block constraint.
+        lay.lse_row_spec(prefetch=dyn),
+    ]
+    out_shape = [
+        lay.out_shape(qx.dtype),
+        jax.ShapeDtypeStruct(lay.lse_shape(nq), jnp.float32),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((block, d), jnp.float32),    # acc
+        pltpu.VMEM((block, 1), jnp.float32),    # running max m
+        pltpu.VMEM((block, 1), jnp.float32),    # running normalizer l
+    ]
     dyn_args = ((jnp.asarray(q_offset_dyn, jnp.int32).reshape(1),) if dyn else ())
-    out, lse = pl.pallas_call(
-        kernel,
-        grid=lay.grid(nq, num_steps),
-        in_specs=dyn_specs + [
-            lay.row_spec(),
-            lay.walk_spec(key_idx),
-            lay.walk_spec(key_idx),
-        ],
-        out_specs=[
-            lay.row_spec(),
-            # lse rides with (1, block) trailing dims equal to the array's,
-            # satisfying Mosaic's last-two-dims block constraint.
-            lay.lse_row_spec(),
-        ],
-        out_shape=[
-            lay.out_shape(qx.dtype),
-            jax.ShapeDtypeStruct(lay.lse_shape(nq), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block, d), jnp.float32),    # acc
-            pltpu.VMEM((block, 1), jnp.float32),    # running max m
-            pltpu.VMEM((block, 1), jnp.float32),    # running normalizer l
-        ],
-        interpret=_interpret(),
-    )(*dyn_args, qx, kx, vx)
+    out, lse = _pallas_dispatch(kernel, lay, nq, num_steps, in_specs, out_specs,
+                                out_shape, scratch_shapes, dyn)(
+        *dyn_args, qx, kx, vx)
     return out, lse
 
 
@@ -468,10 +533,9 @@ def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
 def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
                band_base=None, window=0, q_offset=0, dyn_offset=False,
                pid_base=1):
-    if dyn_offset:                      # traced hop offset in SMEM (see _fwd_kernel)
+    if dyn_offset:                      # traced hop offset (see _fwd_kernel)
         off_ref, refs = refs[0], refs[1:]
         q_offset = off_ref[0]
-        assert band_base is None
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
      dq_acc_ref) = refs
     iq = pl.program_id(pid_base)
@@ -524,10 +588,9 @@ def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
 def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
                 band_base=None, window=0, q_offset=0, dyn_offset=False,
                 pid_base=1):
-    if dyn_offset:                      # traced hop offset in SMEM (see _fwd_kernel)
+    if dyn_offset:                      # traced hop offset (see _fwd_kernel)
         off_ref, refs = refs[0], refs[1:]
         q_offset = off_ref[0]
-        assert band_base is None
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
      dk_acc_ref, dv_acc_ref) = refs
     ik = pl.program_id(pid_base)
@@ -641,6 +704,9 @@ def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
     nq = s // block
     off_blocks = q_offset // block
     one_sided = causal and not q_offset
+    # The dynamic-offset banded walk (r5, scalar-prefetch index maps) is
+    # bidirectional only, like the forward's.
+    dyn_banded = dyn and not causal and _dyn_banded(window, nq, block)
     if not dyn and _banded(window, one_sided, nq, block):
         reach = _band_reach(window, block)
         # dq walks key blocks around the query block (causal: only the past side);
@@ -650,12 +716,17 @@ def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
         dq_base, dq_steps = reach, (reach + 1 if one_sided else 2 * reach + 1)
         kv_base = 0 if one_sided else reach
         kv_steps = reach + 1 if one_sided else 2 * reach + 1
+    elif dyn_banded:
+        reach = _dyn_band_reach(window, block)
+        dq_base = kv_base = reach
+        dq_steps = kv_steps = 2 * reach + 1
     else:
         dq_base = kv_base = None
         dq_steps = kv_steps = nq
 
     # Full (non-banded) walks elide dead-step fetches by aliasing onto the nearest
-    # live block (see _elided_key_idx); traced offsets keep the plain walk.
+    # live block (see _elided_key_idx); traced offsets steer banded walks through
+    # scalar prefetch when a window permits, else take the plain walk.
     full_reach = _band_reach(window, block) if window else None
     elide = not dyn and (causal or window)
 
@@ -664,45 +735,43 @@ def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
             if elide:
                 mk = _elided_query_idx if kv else _elided_key_idx
                 return mk(nq, off_blocks, full_reach, causal=causal)
-            return lambda i, j: j
+            return lambda i, j, *_: j
+        if dyn:
+            sign = -1 if kv else 1
+            return lambda i, o, off: jnp.clip(
+                i + sign * (off[0] // block) + o - base, 0, nq - 1)
         return lambda i, o: jnp.clip(i + center_off + o - base, 0, nq - 1)
 
-    row_spec, lse_row_spec = lay.row_spec(), lay.lse_row_spec()
-    dyn_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] if dyn else []
+    row_spec = lay.row_spec(prefetch=dyn)
+    lse_row_spec = lay.lse_row_spec(prefetch=dyn)
     dyn_args = ((jnp.asarray(q_offset_dyn, jnp.int32).reshape(1),) if dyn else ())
-    dq_walk = lay.walk_spec(_walk_idx(dq_base, off_blocks))
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          num_steps=dq_steps, num_blocks=nq, band_base=dq_base,
-                          window=window, q_offset=q_offset, dyn_offset=dyn,
-                          pid_base=lay.pid_base),
-        grid=lay.grid(nq, dq_steps),
-        in_specs=dyn_specs + [row_spec, dq_walk, dq_walk, row_spec, lse_row_spec,
-                              lse_row_spec],
-        out_specs=[row_spec],
-        out_shape=[lay.out_shape(qx.dtype)],
-        scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
-        interpret=_interpret(),
-    )(*dyn_args, qx, kx, vx, g, lse, delta)[0]
+
+    def call(kernel_fn, base, steps, in_specs, out_specs, out_shape, scratch):
+        kernel = functools.partial(kernel_fn, scale=scale, causal=causal,
+                                   num_steps=steps, num_blocks=nq, band_base=base,
+                                   window=window, q_offset=q_offset,
+                                   dyn_offset=dyn, pid_base=lay.pid_base)
+        return _pallas_dispatch(kernel, lay, nq, steps, in_specs, out_specs,
+                                out_shape, scratch, dyn)(
+            *dyn_args, qx, kx, vx, g, lse, delta)
+
+    dq_walk = lay.walk_spec(_walk_idx(dq_base, off_blocks), prefetch=dyn)
+    dq = call(_dq_kernel, dq_base, dq_steps,
+              [row_spec, dq_walk, dq_walk, row_spec, lse_row_spec, lse_row_spec],
+              [row_spec], [lay.out_shape(qx.dtype)],
+              [pltpu.VMEM((block, d), jnp.float32)])[0]
 
     # dkv grid: the query-block axis walks (accumulators persist per key block).
     kv_idx = _walk_idx(kv_base, -off_blocks, kv=True)
-    kv_walk = lay.walk_spec(kv_idx)
-    kv_lse_walk = lay.lse_walk_spec(kv_idx)
-    dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          num_steps=kv_steps, num_blocks=nq, band_base=kv_base,
-                          window=window, q_offset=q_offset, dyn_offset=dyn,
-                          pid_base=lay.pid_base),
-        grid=lay.grid(nq, kv_steps),
-        in_specs=dyn_specs + [kv_walk, row_spec, row_spec, kv_walk,
-                              kv_lse_walk, kv_lse_walk],
-        out_specs=[row_spec, row_spec],
-        out_shape=[lay.out_shape(kx.dtype), lay.out_shape(vx.dtype)],
-        scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
-                        pltpu.VMEM((block, d), jnp.float32)],
-        interpret=_interpret(),
-    )(*dyn_args, qx, kx, vx, g, lse, delta)
+    kv_walk = lay.walk_spec(kv_idx, prefetch=dyn)
+    kv_lse_walk = lay.lse_walk_spec(kv_idx, prefetch=dyn)
+    dk, dv = call(_dkv_kernel, kv_base, kv_steps,
+                  [kv_walk, row_spec, row_spec, kv_walk, kv_lse_walk,
+                   kv_lse_walk],
+                  [row_spec, row_spec],
+                  [lay.out_shape(kx.dtype), lay.out_shape(vx.dtype)],
+                  [pltpu.VMEM((block, d), jnp.float32),
+                   pltpu.VMEM((block, d), jnp.float32)])
     return dq, dk, dv
 
 

@@ -353,3 +353,98 @@ def test_native_layout_is_numerics_invariant(causal, window):
     for name, a, b in zip("qkv", g_ref, g_nat):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    err_msg=name, **_tol(2e-4, 2e-5))
+
+
+@pytest.mark.parametrize("q_offset", [0, 256, -256])
+def test_dyn_offset_banded_grid_matches_static(q_offset):
+    """r5: a TRACED hop offset steers the banded walk through scalar-prefetch
+    index maps — at sizes where banding engages (nq > 2*reach+1), the dynamic
+    path's forward AND blockwise backward must equal the static-offset banded
+    path exactly (same math, different grid steering)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+        _band_reach, _banded, flash_backward_blocks, flash_forward_with_lse,
+    )
+
+    bh, s, d, window = 2, 1024, 32, 160
+    assert _banded(window, False, s // 128, 128)   # the banded path is engaged
+    rng = np.random.default_rng(31)
+    q3, k3, v3, g = (jnp.asarray(rng.normal(size=(bh, s, d)).astype(np.float32))
+                     for _ in range(4))
+
+    out_s, lse_s = flash_forward_with_lse(q3, k3, v3, causal=False,
+                                          window=window, q_offset=q_offset)
+    out_d, lse_d = jax.jit(lambda off: flash_forward_with_lse(
+        q3, k3, v3, causal=False, window=window, q_offset_dyn=off))(
+        jnp.int32(q_offset))
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_s),
+                               **_tol(1e-6, 1e-6))
+    np.testing.assert_allclose(np.asarray(lse_d), np.asarray(lse_s),
+                               **_tol(1e-6, 1e-6))
+
+    delta = jnp.sum(g * out_s, axis=-1).reshape(bh, s // 128, 1, 128)
+    grads_s = flash_backward_blocks(q3, k3, v3, g, lse_s, delta, causal=False,
+                                    window=window, q_offset=q_offset)
+    grads_d = jax.jit(lambda off: flash_backward_blocks(
+        q3, k3, v3, g, lse_s, delta, causal=False, window=window,
+        q_offset_dyn=off))(jnp.int32(q_offset))
+    for name, a, b in zip("q k v".split(), grads_s, grads_d):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   err_msg=name, **_tol(1e-6, 1e-6))
+
+
+def test_dyn_offset_needs_no_block_quantization():
+    """Unlike the static q_offset (rejected unless a block multiple), a TRACED
+    offset may be arbitrary: the dynamic band is one block wider to absorb the
+    sub-block remainder the floor-division steering discards. Pinned against the
+    manual numpy band oracle at off=+100/-100 with banding engaged."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+        _dyn_banded, flash_forward_with_lse,
+    )
+
+    bh, s, d, window = 2, 1024, 32, 160
+    assert _dyn_banded(window, s // 128, 128)
+    rng = np.random.default_rng(37)
+    q3, k3, v3 = (jnp.asarray(rng.normal(size=(bh, s, d)).astype(np.float32))
+                  for _ in range(3))
+    for q_offset in (100, -100):
+        out, _ = jax.jit(lambda off: flash_forward_with_lse(
+            q3, k3, v3, causal=False, window=window, q_offset_dyn=off))(
+            jnp.int32(q_offset))
+        rel = (q_offset + np.arange(s))[:, None] - np.arange(s)[None, :]
+        visible = np.abs(rel) < window
+        scores = np.einsum("bqd,bkd->bqk", np.asarray(q3),
+                           np.asarray(k3)) / np.sqrt(d)
+        scores = np.where(visible, scores, -np.inf)
+        with np.errstate(invalid="ignore", over="ignore"):
+            p = np.exp(scores - scores.max(-1, keepdims=True))
+            p = np.nan_to_num(p, nan=0.0)
+            denom = p.sum(-1, keepdims=True)
+            ref = np.einsum("bqk,bkd->bqd", p / np.where(denom == 0, 1, denom),
+                            np.asarray(v3))
+        np.testing.assert_allclose(np.asarray(out), ref, err_msg=str(q_offset),
+                                   **_tol(1e-5, 1e-5))
+
+
+def test_dyn_offset_native_layout_forward():
+    """The 4-d (native-layout) specs compose with scalar prefetch too: a traced
+    offset over [B, S, H, D] operands equals the packed dynamic path."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+        _flash_forward,
+    )
+
+    b, s, h, d, window = 2, 1024, 2, 32, 160
+    rng = np.random.default_rng(41)
+    q4, k4, v4 = (jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+                  for _ in range(3))
+    pack = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+    out4, lse5 = jax.jit(lambda off: _flash_forward(
+        q4, k4, v4, causal=False, window=window, q_offset_dyn=off))(
+        jnp.int32(256))
+    out3, lse4 = jax.jit(lambda off: _flash_forward(
+        pack(q4), pack(k4), pack(v4), causal=False, window=window,
+        q_offset_dyn=off))(jnp.int32(256))
+    np.testing.assert_allclose(np.asarray(pack(out4)), np.asarray(out3),
+                               **_tol(1e-6, 1e-6))
+    np.testing.assert_allclose(
+        np.asarray(lse5.reshape(b * h, *lse4.shape[1:])), np.asarray(lse4),
+        **_tol(1e-6, 1e-6))
