@@ -8,25 +8,31 @@ exists. This is the intra-chip complement of the cross-chip ring attention in
 
 Kernel layout (FlashAttention-2 style, in the canonical Pallas-TPU grid formulation):
 
-- **Forward**: grid ``(B·H, S/BLOCK, S/BLOCK)`` — the innermost (fastest-varying) axis
-  walks K/V blocks while the query block and the online-softmax accumulators
-  ``(acc, m, l)`` persist in **VMEM scratch** across those steps (``@pl.when`` on the
-  first/last K/V step initializes/finalizes them). Streaming and double-buffering come
-  from Pallas's automatic grid pipelining — each operand's ``index_map`` names the block
-  the step needs and the next block's copy overlaps the current block's math. VMEM
-  residency is a handful of ``[128, D]`` blocks regardless of S, so sequence length is
-  HBM-bound: an earlier full-K/V-in-VMEM variant hit the 16 MB scoped-vmem wall at
-  S=16k, and a hand-rolled in-kernel DMA variant (``run_scoped`` + ``make_async_copy``
-  double buffering) wedged this environment's AOT Mosaic compile helper the same way the
-  (since-retired) whole-model fused CNN kernel did — the grid formulation compiles in
-  seconds.
+- **Forward**: grid ``(B·H, S/BLOCK, S/BLOCK)`` in the packed ``[BH, S, D]`` layout, or
+  ``(B, H, S/BLOCK, S/BLOCK)`` in the native ``[B, S, H, D]`` layout (``_GridLayout``,
+  r5 — feeds the model's layout with no transpose repacks) — the innermost
+  (fastest-varying) axis walks K/V blocks while the query block and the online-softmax
+  accumulators ``(acc, m, l)`` persist in **VMEM scratch** across those steps
+  (``@pl.when`` on the first/last K/V step initializes/finalizes them). Streaming and
+  double-buffering come from Pallas's automatic grid pipelining — each operand's
+  ``index_map`` names the block the step needs and the next block's copy overlaps the
+  current block's math. VMEM residency is a handful of ``[128, D]`` blocks regardless
+  of S, so sequence length is HBM-bound: an earlier full-K/V-in-VMEM variant hit the
+  16 MB scoped-vmem wall at S=16k, and a hand-rolled in-kernel DMA variant
+  (``run_scoped`` + ``make_async_copy`` double buffering) wedged this environment's AOT
+  Mosaic compile helper the same way the (since-retired) whole-model fused CNN kernel
+  did — the grid formulation compiles in seconds.
 - **Backward**: the standard two-kernel recompute formulation — no O(S²) residuals, only
   ``(out, lse = m + log l)``. A ``dq`` kernel re-walks K/V blocks per query block; a
   ``dk/dv`` kernel walks query/dout blocks per key block; both recompute
   ``p = exp(q·kᵀ·scale − lse)`` blockwise and apply ``ds = p ∘ (dout·vᵀ − Δ)`` with
   ``Δ = rowsum(dout ∘ out)`` computed once outside the kernels (XLA fuses it).
-- **Causal**: blocks strictly above the diagonal are skipped via ``@pl.when`` — their
-  fetch still pipelines (grids cannot skip steps) but they cost no FLOPs.
+- **Causal/banded dead blocks** cost no FLOPs (``@pl.when`` skip) and — r5 — no fetch
+  either: the full walks clamp their index maps onto the nearest live block
+  (``_elided_key_idx``), and Pallas skips the copy when consecutive steps request the
+  same block; fully-visible interior blocks also skip the mask's iota/select chain
+  (``_block_interior``). Static offsets get band-compressed grids; TRACED (zig-zag)
+  offsets steer the band through scalar-prefetch index maps (``_dyn_band_reach``).
 
 All matmuls request ``preferred_element_type=float32`` (MXU accumulation), block shapes
 are lane-aligned (any multiple of 128 rows via the ``block`` parameter, default
