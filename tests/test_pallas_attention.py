@@ -355,6 +355,7 @@ def test_native_layout_is_numerics_invariant(causal, window):
                                    err_msg=name, **_tol(2e-4, 2e-5))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("q_offset", [0, 256, -256])
 def test_dyn_offset_banded_grid_matches_static(q_offset):
     """r5: a TRACED hop offset steers the banded walk through scalar-prefetch
@@ -392,6 +393,7 @@ def test_dyn_offset_banded_grid_matches_static(q_offset):
                                    err_msg=name, **_tol(1e-6, 1e-6))
 
 
+@pytest.mark.slow
 def test_dyn_offset_needs_no_block_quantization():
     """Unlike the static q_offset (rejected unless a block multiple), a TRACED
     offset may be arbitrary: the dynamic band is one block wider to absorb the
@@ -425,6 +427,7 @@ def test_dyn_offset_needs_no_block_quantization():
                                    **_tol(1e-5, 1e-5))
 
 
+@pytest.mark.slow
 def test_dyn_offset_native_layout_forward():
     """The 4-d (native-layout) specs compose with scalar prefetch too: a traced
     offset over [B, S, H, D] operands equals the packed dynamic path."""
@@ -450,6 +453,7 @@ def test_dyn_offset_native_layout_forward():
         **_tol(1e-6, 1e-6))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_native_layout_banded_grid_matches_dense(causal):
     """Native [B,S,H,D] layout × the band-compressed grid (s large enough that
