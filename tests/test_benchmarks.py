@@ -88,6 +88,7 @@ def test_flops_constants_and_peak_lookup():
     assert B.peak_flops("warp drive") is None
 
 
+@pytest.mark.slow
 def test_batch_sweep_functional(tmp_path, monkeypatch):
     """run_batch_sweep on tiny data: one row per admissible batch size, skip markers for
     inadmissible ones, throughput fields populated, and the plot artifact written."""

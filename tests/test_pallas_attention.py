@@ -271,6 +271,7 @@ def test_dispatch_attention_routes_by_crossover(monkeypatch):
         np.asarray(flash_attention(q, k, v, causal=True)))
 
 
+@pytest.mark.slow
 def test_as_transformer_attention_core():
     """flash_attention plugs into the transformer family as attention_fn; one optimizer
     step from shared init matches the dense-core step."""

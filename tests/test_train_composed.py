@@ -526,7 +526,9 @@ def test_grad_accum_must_divide_batch(tiny_datasets):
 
 
 def test_attention_overrides_rejected_with_stage(tiny_datasets):
-    with pytest.raises(ValueError, match="do not compose with a stage axis"):
+    # r5: --flash-attention now composes with a stage axis; zig-zag still cannot
+    # (it needs a seq axis, which a stage mesh rejects).
+    with pytest.raises(ValueError, match="does not compose with a stage axis"):
         composed.main(ComposedConfig(mesh="stage=2,seq=1", causal=True,
                                      zigzag_attention=True, results_dir=""),
                       datasets=tiny_datasets)
