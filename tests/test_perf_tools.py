@@ -1,0 +1,64 @@
+"""Functional coverage of the r5 measurement tools (tools/bench_pipeline_bubble.py,
+tools/bench_decode_analysis.py): tiny shapes, one JSON document each, the fields the
+committed artifacts are read by. Timing values are only sanity-bounded — these are
+measurement tools, not benchmarks, under test."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+# Heavyweight end-to-end runs: full-suite only.
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _run_tool(script, *args):
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", script), *args],
+        capture_output=True, text=True, env=env, timeout=560, cwd=_REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_pipeline_bubble_tool(tmp_path):
+    doc = _run_tool("bench_pipeline_bubble.py",
+                    "--microbatch-counts", "2", "8",
+                    "--out", str(tmp_path / "bubble.json"))
+    assert doc["stages"] == 4 and doc["schedule"] == "gpipe"
+    assert doc["per_tick_s"] > 0
+    rows = doc["rows"]
+    assert [r["microbatches"] for r in rows] == [2, 8]
+    for r in rows:
+        assert r["ticks"] == r["microbatches"] + 3
+        assert r["predicted_bubble_fraction"] == pytest.approx(
+            3 / r["ticks"], abs=1e-3)
+        assert 0 < r["measured_bubble_fraction"] < 1
+    assert (tmp_path / "bubble.json").exists()
+
+
+def test_pipeline_bubble_tool_rejects_single_count():
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "bench_pipeline_bubble.py"),
+         "--microbatch-counts", "8"],
+        capture_output=True, text=True, env=env, timeout=120, cwd=_REPO)
+    assert out.returncode != 0 and "distinct" in out.stderr
+
+
+def test_decode_analysis_tool(tmp_path):
+    doc = _run_tool("bench_decode_analysis.py",
+                    "--d-model", "64", "--layers", "2", "--heads", "4",
+                    "--seq", "256", "--gen-batch", "2",
+                    "--out", str(tmp_path / "decode.json"))
+    assert doc["ops_per_token"] > 0
+    assert doc["op_kinds"] and sum(doc["op_kinds"].values()) == doc["ops_per_token"]
+    assert doc["t_token_s"] > 0 and doc["tokens_per_s"] > 0
+    # CPU run: no HBM roofline — the decomposition fields stay explicit nulls.
+    assert doc["t_roofline_s"] is None and doc["per_op_overhead_us"] is None
+    assert (tmp_path / "decode.json").exists()
