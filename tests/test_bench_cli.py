@@ -7,6 +7,7 @@ error — is pinned without real TPU (or even real child) processes."""
 import importlib.util
 import json
 import os
+import sys
 import time
 import types
 
@@ -266,3 +267,54 @@ def test_latest_hardware_capture_prefers_highest_round_best(bench):
     m = re.search(r"(?:bench|hw)_r(\d+)", cap["file"])
     assert m and int(m.group(1)) == max(rounds)
     assert cap["payload"]["platform"] == "tpu"
+
+
+def test_bench_attention_row_schema(monkeypatch, capsys, tmp_path):
+    """The attention bench's row contract (r4 verdict item 2): roofline fields
+    per impl, causal-aware model FLOPs, converged flags, speedup — pinned with
+    the measurement faked so the schema test costs milliseconds."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_attention_under_test",
+        os.path.join(os.path.dirname(__file__), os.pardir, "bench_attention.py"))
+    ba = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ba)
+
+    monkeypatch.setattr(ba, "_measure", lambda fn, q, k, v: (0.5, True))
+    monkeypatch.setattr(sys, "argv",
+                        ["bench_attention.py", "--seq-lens", "256",
+                         "--out", str(tmp_path / "rows.jsonl")])
+    assert ba.main() == 0
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    s = 256
+    pairs = s * (s + 1) // 2                      # causal attended pairs
+    assert row["fwdbwd_model_flops"] == 3 * 4 * ba.B * ba.H * ba.D * pairs
+    assert row["flash_fwdbwd_s"] == 0.5 and row["dense_fwdbwd_s"] == 0.5
+    assert row["flash_converged"] is True and row["dense_converged"] is True
+    assert row["flash_achieved_flops_per_s"] == round(
+        row["fwdbwd_model_flops"] / 0.5)
+    assert row["dense_achieved_flops_per_s"] == row["flash_achieved_flops_per_s"]
+    # CPU run: no bf16 peak — explicit nulls, not missing keys.
+    assert row["flash_pct_of_bf16_peak"] is None
+    assert row["dense_pct_of_bf16_peak"] is None
+    assert row["speedup_flash_vs_dense"] == 1.0
+    assert (tmp_path / "rows.jsonl").exists()
+
+
+def test_bench_attention_windowed_flops_accounting():
+    """_attended_pairs: the causal+window closed form equals brute-force counting."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_attention_under_test2",
+        os.path.join(os.path.dirname(__file__), os.pardir, "bench_attention.py"))
+    ba = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ba)
+
+    import numpy as np
+    for s, w in ((8, None), (8, 3), (16, 16), (16, 40), (5, 1)):
+        q = np.arange(s)[:, None]
+        k = np.arange(s)[None, :]
+        visible = (q >= k) & ((q - k) < (w or s))
+        assert ba._attended_pairs(s, w) == int(visible.sum()), (s, w)
