@@ -17,6 +17,7 @@ plan exactly like the classifier trainers gather images (zero per-step host traf
 
 from __future__ import annotations
 
+import functools
 import os
 
 import jax
@@ -92,14 +93,17 @@ def main(config: LMConfig = LMConfig(), *,
     info = initialize_cluster()
     if config.mesh:
         # Optional named mesh: data (DP) x seq (context parallelism — ring or
-        # zig-zag causal attention over the sequence-sharded pixel stream).
+        # zig-zag causal attention over the sequence-sharded pixel stream) x
+        # model (Megatron TP over the blocks' column/row kernels — r5; the ring
+        # spec already shards the head dim over `model`, so seq x model composes).
         from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import (
             parse_mesh_spec,
         )
         axis_names, axis_sizes = parse_mesh_spec(config.mesh)
-        if any(n not in ("data", "seq") for n in axis_names) or "data" not in axis_names:
+        if (any(n not in ("data", "seq", "model") for n in axis_names)
+                or "data" not in axis_names):
             raise ValueError("the LM trainer's --mesh needs a data axis and supports "
-                             f"data and seq axes only, got {config.mesh!r} "
+                             f"data, seq, and model axes only, got {config.mesh!r} "
                              f"(use data=1,seq=N for pure context parallelism)")
         mesh = make_mesh(int(np.prod(axis_sizes)), axis_names=axis_names,
                          axis_shape=axis_sizes)
@@ -107,6 +111,7 @@ def main(config: LMConfig = LMConfig(), *,
         mesh = make_mesh()
     world = mesh.shape.get("data", 1)
     seq_size = mesh.shape.get("seq", 1)
+    model_size = mesh.shape.get("model", 1)
     if config.zigzag_attention and seq_size < 2:
         raise ValueError("--zigzag-attention needs a seq axis in --mesh")
     # r4: --attention-window composes with the zig-zag schedule too (global-
@@ -201,7 +206,24 @@ def main(config: LMConfig = LMConfig(), *,
             M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(state.step)} "
               f"(starting epoch {start_epoch})")
-    state = jax.device_put(state, dp.replicated(mesh))
+    if model_size > 1:
+        # Megatron TP (r5): column/row kernels shard over `model` (the LM blocks
+        # reuse TransformerBlock's leaf names, so the classifier's partition rules
+        # apply as-is); embeddings/head/LNs replicate. One block owns BOTH the
+        # placement and the matching epoch compiler so they cannot diverge.
+        from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+            tensor_parallel as tp,
+        )
+        state = tp.shard_train_state(mesh, state)
+        compile_lm_epoch = functools.partial(tp.compile_epoch_tp, mesh=mesh,
+                                             data_axis="data")
+    else:
+        state = jax.device_put(state, dp.replicated(mesh))
+        compile_lm_epoch = functools.partial(dp.compile_epoch, mesh=mesh)
+    # Host fetches must replicate ON DEVICE first (all-gather) — device_get on a
+    # TP-sharded array would fail on a multi-host fleet where no process
+    # addresses every shard (same pattern as train/composed.py).
+    gather = jax.jit(lambda s: s, out_shardings=dp.replicated(mesh))
 
     deterministic = config.dropout_rate == 0.0
 
@@ -216,7 +238,7 @@ def main(config: LMConfig = LMConfig(), *,
                               optimizer=optimizer, lr_schedule=lr_schedule,
                               clip_grad_norm=config.clip_grad_norm,
                               ema_decay=config.ema_decay, loss_fn=lm_loss)
-    epoch_fn = dp.compile_epoch(make_epoch_from_step(step_fn), mesh)
+    epoch_fn = compile_lm_epoch(make_epoch_from_step(step_fn))
     eval_fn = jax.jit(make_eval_nll_fn(model, batch_size=config.eval_batch))
 
     tokens_d = dp.put_global(mesh, train_tokens, P())
@@ -238,7 +260,7 @@ def main(config: LMConfig = LMConfig(), *,
         state = _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d,
                             zeros_d, test_d, dropout_rng, n_train, n_test, seq_len,
                             steps_per_epoch, start_epoch, history, watch, saver,
-                            ckpt_path)
+                            ckpt_path, gather)
     finally:
         # Drain the write-behind queue even on an exception/signal mid-run — the
         # queued per-epoch checkpoint is the resume artifact a killed run needs,
@@ -246,7 +268,7 @@ def main(config: LMConfig = LMConfig(), *,
         if config.async_checkpoint:
             saver.flush()
 
-    host_state = jax.device_get(state)
+    host_state = jax.device_get(gather(state))
     if ckpt_path:
         M.log(f"Saved {ckpt_path}")
     if config.generate > 0:
@@ -282,7 +304,7 @@ def main(config: LMConfig = LMConfig(), *,
 
 def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_d,
                 dropout_rng, n_train, n_test, seq_len, steps_per_epoch, start_epoch,
-                history, watch, saver, ckpt_path):
+                history, watch, saver, ckpt_path, gather):
     """The LM trainer's epoch loop, split out so the caller can guarantee the
     async-checkpoint flush in a ``finally`` regardless of where the loop fails."""
     for epoch in range(start_epoch, config.epochs):
@@ -307,7 +329,7 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_
               f"val_nll/token: {val_nll:.4f}, val_ppl: {float(np.exp(val_nll)):.3f}, "
               f"time_elapsed: {watch.elapsed():.2f}s")
         if ckpt_path:
-            saver.save_train_state(ckpt_path, jax.device_get(state))
+            saver.save_train_state(ckpt_path, jax.device_get(gather(state)))
     return state
 
 

@@ -274,11 +274,14 @@ class LMConfig:
     kv_heads: int = 0                   # grouped-query attention: K/V head count
                                         # (0 = MHA; divides num_heads; shrinks the
                                         # decode KV cache num_heads/kv_heads x)
-    mesh: str = ""                      # optional named mesh, e.g. "data=2,seq=4":
-                                        # data shards the batch (DP), seq runs ring
-                                        # attention over the pixel stream (context
-                                        # parallelism — the LM is causal, so a seq
-                                        # axis trains decoder-style long context).
+    mesh: str = ""                      # optional named mesh, e.g. "data=2,seq=4"
+                                        # or "data=2,model=2": data shards the
+                                        # batch (DP), seq runs ring attention over
+                                        # the pixel stream (context parallelism —
+                                        # the LM is causal, so a seq axis trains
+                                        # decoder-style long context), model
+                                        # Megatron-shards the block kernels (TP,
+                                        # r5; composes with data and seq).
                                         # Empty = all devices on one data axis.
     zigzag_attention: bool = False      # use the load-balanced zig-zag causal ring
                                         # schedule on the seq axis (uniform per-hop

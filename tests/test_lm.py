@@ -287,8 +287,15 @@ def test_lm_trainer_seq_mesh_matches_dp(tmp_path):
     _, hist_zz = run("zz", mesh="data=2,seq=2", zigzag_attention=True)
     np.testing.assert_allclose(hist_zz.train_losses, hist_dp.train_losses,
                                rtol=1e-4, atol=1e-5)
-    with pytest.raises(ValueError, match="data and seq"):
-        run("bad", mesh="data=2,model=2")
+    # r5: the LM trains under Megatron TP too — alone and composed with seq.
+    _, hist_tp = run("tp", mesh="data=2,model=2")
+    np.testing.assert_allclose(hist_tp.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    _, hist_3d = run("threed", mesh="data=2,seq=2,model=2")
+    np.testing.assert_allclose(hist_3d.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError, match="data, seq, and model"):
+        run("bad", mesh="data=2,expert=2")
 
 
 def test_bench_lm_emits_one_json_line(tmp_path):
