@@ -35,6 +35,44 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def gather_replicated(mesh: Mesh) -> Callable:
+    """On-device all-gather to replicated layout: ``gather(tree)`` returns the tree
+    with every leaf replicated. The step before ANY host fetch of possibly-sharded
+    state (TP/FSDP trainers) — ``jax.device_get`` on a sharded array fails on a
+    multi-host fleet where no process addresses every shard. One owner for the
+    pattern shared by the composed, LM, and distributed trainers (r5 review)."""
+    return jax.jit(lambda tree: tree, out_shardings=replicated(mesh))
+
+
+def cached_sharded_compile(fn: Callable, mesh: Mesh, state_shardings_fn: Callable,
+                           other_in_shardings: tuple, *,
+                           shape_key: bool = False) -> Callable:
+    """The shared compile-with-state-dependent-shardings scaffold behind
+    ``tensor_parallel.compile_{step,epoch}_tp`` and ``fsdp.compile_{step,epoch}_fsdp``
+    (r5 review: previously four near-verbatim copies). jit's ``in_shardings`` must
+    be stated eagerly but the state's shardings depend on its pytree (TP: leaf
+    names; FSDP: leaf SHAPES — set ``shape_key``), so the jitted program is built
+    from the first call's state and cached per structure(+shapes). State is donated
+    and returned with the same shardings; the second output replicates."""
+    compiled = {}
+
+    def wrapper(state, *args):
+        key = jax.tree_util.tree_structure(state)
+        if shape_key:
+            key = (key, tuple(leaf.shape
+                              for leaf in jax.tree_util.tree_leaves(state)))
+        if key not in compiled:
+            state_sh = state_shardings_fn(state)
+            compiled[key] = jax.jit(
+                fn,
+                in_shardings=(state_sh,) + tuple(other_in_shardings),
+                out_shardings=(state_sh, replicated(mesh)),
+                donate_argnums=(0,))
+        return compiled[key](state, *args)
+
+    return wrapper
+
+
 def compile_step(step_fn: Callable, mesh: Mesh, *, axis_name: str = "data") -> Callable:
     """Compile ``step(state, images, labels, rng)`` over ``mesh`` with DP shardings.
 

@@ -128,22 +128,16 @@ def compile_epoch_tp(epoch_fn: Callable, mesh: Mesh, *, data_axis: str = "data",
     whole-epoch scanned program generalized to a TP/composed mesh (the composed
     trainer's hot path; per-step Python dispatch dominates at this model size,
     SURVEY.md §7e)."""
-    compiled = {}
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.data_parallel import (
+        cached_sharded_compile,
+    )
 
-    def wrapper(state, images, labels, idx_matrix, rng):
-        key = jax.tree_util.tree_structure(state)
-        if key not in compiled:
-            state_sh = state_shardings(mesh, state, axis_name=model_axis)
-            rep = replicated(mesh)
-            idx_sh = (NamedSharding(mesh, P(None, data_axis)) if data_axis else rep)
-            compiled[key] = jax.jit(
-                epoch_fn,
-                in_shardings=(state_sh, rep, rep, idx_sh, rep),
-                out_shardings=(state_sh, rep),
-                donate_argnums=(0,))
-        return compiled[key](state, images, labels, idx_matrix, rng)
-
-    return wrapper
+    rep = replicated(mesh)
+    idx_sh = (NamedSharding(mesh, P(None, data_axis)) if data_axis else rep)
+    return cached_sharded_compile(
+        epoch_fn, mesh,
+        lambda state: state_shardings(mesh, state, axis_name=model_axis),
+        (rep, rep, idx_sh, rep))
 
 
 def compile_step_tp(step_fn: Callable, mesh: Mesh, *, data_axis: str = "data",
@@ -155,22 +149,13 @@ def compile_step_tp(step_fn: Callable, mesh: Mesh, *, data_axis: str = "data",
     all-reduce over the data axis, and the scatter back onto the weight shards. State is
     donated, so sharded buffers update in place.
     """
-    # jit's in_shardings must be stated eagerly, but the TP specs depend on the params
-    # tree — so resolve them from the first call's state structure and cache per structure.
-    compiled = {}
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.data_parallel import (
+        cached_sharded_compile,
+    )
 
-    def wrapper(state, images, labels, rng):
-        key = jax.tree_util.tree_structure(state)
-        if key not in compiled:
-            state_sh = state_shardings(mesh, state, axis_name=model_axis)
-            batch_sh = (batch_sharding(mesh, data_axis) if data_axis
-                        else replicated(mesh))
-            rep = replicated(mesh)
-            compiled[key] = jax.jit(
-                step_fn,
-                in_shardings=(state_sh, batch_sh, batch_sh, rep),
-                out_shardings=(state_sh, rep),
-                donate_argnums=(0,))
-        return compiled[key](state, images, labels, rng)
-
-    return wrapper
+    rep = replicated(mesh)
+    batch_sh = batch_sharding(mesh, data_axis) if data_axis else rep
+    return cached_sharded_compile(
+        step_fn, mesh,
+        lambda state: state_shardings(mesh, state, axis_name=model_axis),
+        (batch_sh, batch_sh, rep))

@@ -411,7 +411,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     dropout_rng = jax.random.PRNGKey(config.seed + 1)
     # Replicate shards on device (all-gather), then fetch — device_get on a sharded
     # array would fail on a multi-host fleet where no process addresses every shard.
-    gather = jax.jit(lambda s: s, out_shardings=rep)
+    gather = dp.gather_replicated(mesh)
 
     def to_host_standard(state) -> TrainState:
         """Gathered host copy in the standard per-name checkpoint layout (the

@@ -167,7 +167,19 @@ def main(config: DistributedConfig = DistributedConfig(), *,
             M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(state.step)} "
               f"(starting epoch {start_epoch})")
-    state = jax.device_put(state, dp.replicated(mesh))
+    if config.fsdp:
+        # ZeRO/FSDP mode (r5): params + SGD/AdamW state shard over the data axis;
+        # XLA inserts the per-use all-gathers and gradient reduce-scatters from
+        # the annotations (parallel/fsdp.py). Same trajectory as plain DP.
+        from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+            fsdp,
+        )
+        state = fsdp.shard_train_state(mesh, state)
+    else:
+        state = jax.device_put(state, dp.replicated(mesh))
+    # Host fetches replicate ON DEVICE first — device_get on an FSDP-sharded array
+    # would fail on a multi-host fleet where no process addresses every shard.
+    gather = dp.gather_replicated(mesh)
     ckpt_path = os.path.join(config.results_dir, "model_dist.ckpt")
 
     if not config.host_local_feed:
@@ -177,15 +189,19 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     test_x = dp.put_global(mesh, test_ds.images, eval_spec)
     test_y = dp.put_global(mesh, test_ds.labels, eval_spec)
 
-    epoch_fn = dp.compile_epoch(
-        make_epoch_fn(model, learning_rate=config.learning_rate,
-                      momentum=config.momentum,
-                      unroll=config.scan_unroll, pregather=config.pregather,
-                      grad_accum=config.grad_accum, optimizer=optimizer,
-                      lr_schedule=lr_schedule,
-                      clip_grad_norm=config.clip_grad_norm,
-                      ema_decay=config.ema_decay,
-                      label_smoothing=config.label_smoothing), mesh)
+    epoch_body = make_epoch_fn(model, learning_rate=config.learning_rate,
+                               momentum=config.momentum,
+                               unroll=config.scan_unroll,
+                               pregather=config.pregather,
+                               grad_accum=config.grad_accum, optimizer=optimizer,
+                               lr_schedule=lr_schedule,
+                               clip_grad_norm=config.clip_grad_norm,
+                               ema_decay=config.ema_decay,
+                               label_smoothing=config.label_smoothing)
+    if config.fsdp:
+        epoch_fn = fsdp.compile_epoch_fsdp(epoch_body, mesh)
+    else:
+        epoch_fn = dp.compile_epoch(epoch_body, mesh)
     eval_fn = dp.compile_eval(
         make_eval_fn(model, batch_size=config.batch_size_test), mesh,
         shard=config.shard_eval)
@@ -194,14 +210,15 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
             make_train_step,
         )
-        step_fn = dp.compile_step(
-            make_train_step(model, learning_rate=config.learning_rate,
-                            momentum=config.momentum,
-                            grad_accum=config.grad_accum,
-                            optimizer=optimizer, lr_schedule=lr_schedule,
-                            clip_grad_norm=config.clip_grad_norm,
-                            ema_decay=config.ema_decay,
-                            label_smoothing=config.label_smoothing), mesh)
+        step_body = make_train_step(model, learning_rate=config.learning_rate,
+                                    momentum=config.momentum,
+                                    grad_accum=config.grad_accum,
+                                    optimizer=optimizer, lr_schedule=lr_schedule,
+                                    clip_grad_norm=config.clip_grad_norm,
+                                    ema_decay=config.ema_decay,
+                                    label_smoothing=config.label_smoothing)
+        step_fn = (fsdp.compile_step_fsdp(step_body, mesh) if config.fsdp
+                   else dp.compile_step(step_body, mesh))
         col_lo, col_hi = _host_local_columns(mesh, per_replica_batch)
         M.log(f"Host-local feed: this process feeds global-batch columns "
               f"[{col_lo}:{col_hi}]")
@@ -257,6 +274,10 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                                          float(l))
 
                 eval_params = state.ema if state.ema is not None else state.params
+                if config.fsdp:
+                    # compile_eval pins replicated param shardings; jit rejects a
+                    # mismatched committed layout, so gather the shards on device.
+                    eval_params = gather(eval_params)
                 sum_nll, correct = jax.device_get(
                     eval_fn(eval_params, test_x, test_y))   # ≙ eval loop, :92-109
                 val_loss = float(sum_nll) / n_test
@@ -266,18 +287,27 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                                                 watch.elapsed()))  # ≙ :113-114
                 # Per-epoch full-state checkpoint (process-0 gated, atomic) so a killed run
                 # can resume with --resume-from; the reference only ever saves final params.
-                saver.save_train_state(ckpt_path, state)
+                # Device-resident gathered state: the saver is process-0 gated and
+                # device_gets internally — non-0 processes must not pay a host fetch.
+                saver.save_train_state(ckpt_path, gather(state))
 
-        assert_replicas_synced(state.params)      # the desync "race detector" (SURVEY.md §5)
+        if not config.fsdp:
+            # The desync "race detector" (SURVEY.md §5). Under FSDP the replica-sync
+            # invariant it guards does not apply: sharded leaves hold DIFFERENT
+            # slices by design, and gathered copies are replicated-by-construction
+            # (the check would be vacuous, not reassuring).
+            assert_replicas_synced(state.params)
 
         plotting.save_loss_curves(
             history, os.path.join(config.images_dir, "train_test_curve_dist.png"))  # ≙ :161
         M.save_metrics_jsonl(history, os.path.join(config.results_dir, "metrics.jsonl"))
         # The export must be the weights the reported metrics came from: the EMA tree
         # when --ema-decay is set (eval consumes it above), the raw params otherwise.
+        export_state = gather(state)    # on device; save_params is process-0 gated
         checkpoint.save_params(
             os.path.join(config.results_dir, "model_dist.msgpack"),
-            state.ema if state.ema is not None else state.params)   # ≙ :163-164
+            export_state.ema if export_state.ema is not None
+            else export_state.params)   # ≙ :163-164
     finally:
         # Drain the write-behind queue even on an exception/signal mid-run — the
         # queued per-epoch checkpoint is the resume artifact a killed run needs,

@@ -222,8 +222,8 @@ def main(config: LMConfig = LMConfig(), *,
         compile_lm_epoch = functools.partial(dp.compile_epoch, mesh=mesh)
     # Host fetches must replicate ON DEVICE first (all-gather) — device_get on a
     # TP-sharded array would fail on a multi-host fleet where no process
-    # addresses every shard (same pattern as train/composed.py).
-    gather = jax.jit(lambda s: s, out_shardings=dp.replicated(mesh))
+    # addresses every shard.
+    gather = dp.gather_replicated(mesh)
 
     deterministic = config.dropout_rate == 0.0
 
@@ -329,7 +329,9 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_
               f"val_nll/token: {val_nll:.4f}, val_ppl: {float(np.exp(val_nll)):.3f}, "
               f"time_elapsed: {watch.elapsed():.2f}s")
         if ckpt_path:
-            saver.save_train_state(ckpt_path, jax.device_get(gather(state)))
+            # Device-resident gathered state: the saver is process-0 gated and
+            # device_gets internally — non-0 processes must not pay a host fetch.
+            saver.save_train_state(ckpt_path, gather(state))
     return state
 
 

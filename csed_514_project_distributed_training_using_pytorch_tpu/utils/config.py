@@ -130,6 +130,12 @@ class DistributedConfig:
     shard_eval: bool = False          # False reproduces the reference's every-rank-evaluates-
                                       # the-full-test-set behavior (src/train_dist.py:21-24,
                                       # §2d.7); True shards eval + psums the sums.
+    fsdp: bool = False                # ZeRO/FSDP (r5): shard params + optimizer
+                                      # state over the SAME data axis the batch is
+                                      # sharded on (parallel/fsdp.py) — per-device
+                                      # weight+optimizer memory divides by the
+                                      # worker count; trajectory identical to
+                                      # plain DP (pinned in tests)
     resume_from: str = ""             # full-TrainState checkpoint to resume from (the
                                       # restore path the reference lacks; the distributed
                                       # trainer writes one per epoch to
