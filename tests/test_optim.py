@@ -196,6 +196,7 @@ def test_scheduled_trajectory_matches_torch_lambdalr(opt_name):
                                        rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_single_trainer_cosine_schedule_trains(tmp_path):
     from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
         Dataset, _normalize, _synthesize_split,
@@ -244,6 +245,7 @@ def test_pallas_step_rejects_non_sgd():
                                                            warmup_steps=2))
 
 
+@pytest.mark.slow
 def test_single_trainer_adamw_trains_and_resumes(tmp_path):
     """--optimizer adamw end-to-end on the single-process trainer: the loss falls, the
     checkpoint round-trips the moment state (same serialized format/path as SGD), and
