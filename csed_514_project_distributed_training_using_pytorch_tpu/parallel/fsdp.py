@@ -38,19 +38,30 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.data_paral
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import TrainState
 
 
+def _zero_dim(leaf, axis_size: int, min_leaf_size: int,
+              taken: tuple = ()) -> int | None:
+    """THE ZeRO dim-selection rule (one owner for plain and hybrid FSDP): the
+    largest ``axis_size``-divisible dim not in ``taken``, or None for leaves too
+    small (sharding overhead beats the memory win) or indivisible."""
+    if leaf.size < min_leaf_size:
+        return None
+    divisible = [d for d in range(leaf.ndim)
+                 if d not in taken and leaf.shape[d] % axis_size == 0
+                 and leaf.shape[d] >= axis_size]
+    if not divisible:
+        return None
+    return max(divisible, key=lambda d: leaf.shape[d])
+
+
 def fsdp_partition_specs(params, axis_size: int, *, axis_name: str = "data",
                          min_leaf_size: int = 2048):
-    """Per-leaf specs: shard the largest ``axis_size``-divisible dimension; replicate
-    leaves that are small (sharding overhead beats the memory win) or indivisible."""
+    """Per-leaf specs: shard the largest ``axis_size``-divisible dimension
+    (``_zero_dim``); replicate small or indivisible leaves."""
 
     def spec_for(leaf):
-        if leaf.size < min_leaf_size:
+        best = _zero_dim(leaf, axis_size, min_leaf_size)
+        if best is None:
             return P()
-        divisible = [d for d in range(leaf.ndim) if leaf.shape[d] % axis_size == 0
-                     and leaf.shape[d] >= axis_size]
-        if not divisible:
-            return P()
-        best = max(divisible, key=lambda d: leaf.shape[d])
         spec = [None] * leaf.ndim
         spec[best] = axis_name
         return P(*spec)
@@ -118,4 +129,71 @@ def compile_epoch_fsdp(epoch_fn: Callable, mesh: Mesh, *,
     return cached_sharded_compile(
         epoch_fn, mesh,
         lambda state: state_shardings(mesh, state, axis_name=axis_name),
+        (rep, rep, idx_sh, rep), shape_key=True)
+
+
+def hybrid_state_shardings(mesh: Mesh, state: TrainState, *,
+                           data_axis: str = "data", model_axis: str = "model",
+                           min_leaf_size: int = 2048) -> TrainState:
+    """ZeRO × TP hybrid shardings (r5): start from ``tensor_parallel``'s name-based
+    column/row/expert specs, then additionally shard each leaf's largest
+    ``data_axis``-divisible FREE dim over the data axis — per-device weight and
+    optimizer memory divides by data_size × model_size, the
+    DeepSpeed-ZeRO-plus-Megatron layout. Leaves too small (or with no free
+    divisible dim) keep their TP spec; the rules degrade to plain FSDP on a mesh
+    without ``model_axis`` and to plain TP when ``data_axis`` is size 1."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        tensor_parallel as tp,
+    )
+
+    data_size = mesh.shape.get(data_axis, 1)
+
+    def add_data(spec: P, leaf) -> P:
+        entries = list(spec) + [None] * (leaf.ndim - len(spec))
+        if data_size <= 1:
+            return P(*entries)
+        taken = tuple(d for d, e in enumerate(entries) if e is not None)
+        best = _zero_dim(leaf, data_size, min_leaf_size, taken)
+        if best is not None:
+            entries[best] = data_axis
+        return P(*entries)
+
+    def tree_sh(tree):
+        specs = tp._filter_to_mesh(
+            tp.param_partition_specs(tree, axis_name=model_axis), mesh)
+        return jax.tree_util.tree_map(
+            lambda spec, leaf: NamedSharding(mesh, add_data(spec, leaf)),
+            specs, tree, is_leaf=lambda x: isinstance(x, P))
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.optim import (
+        map_param_trees,
+    )
+
+    rep = NamedSharding(mesh, P())
+    param_sh = tree_sh(state.params)
+    return TrainState(
+        params=param_sh,
+        velocity=map_param_trees(state.velocity, tree_sh,
+                                 scalar_fn=lambda _: rep),
+        step=rep,
+        # The EMA tree mirrors params exactly — same shards.
+        ema=param_sh if state.ema is not None else None)
+
+
+def compile_epoch_hybrid(epoch_fn: Callable, mesh: Mesh, *,
+                         data_axis: str | None = "data",
+                         model_axis: str = "model") -> Callable:
+    """``compile_epoch_fsdp`` with the ZeRO × TP hybrid shardings
+    (``hybrid_state_shardings``) — the composed trainer's ``--fsdp`` epoch
+    program. ``data_axis=None`` replicates the index plan (pure-TP mesh)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.data_parallel import (
+        cached_sharded_compile,
+    )
+
+    rep = replicated(mesh)
+    idx_sh = (NamedSharding(mesh, P(None, data_axis)) if data_axis else rep)
+    return cached_sharded_compile(
+        epoch_fn, mesh,
+        lambda state: hybrid_state_shardings(mesh, state,
+                                             model_axis=model_axis),
         (rep, rep, idx_sh, rep), shape_key=True)

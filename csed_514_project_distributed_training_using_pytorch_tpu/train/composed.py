@@ -172,6 +172,11 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             raise ValueError(
                 "--zigzag-attention needs a seq axis, which does not compose with "
                 "a stage axis")
+        if config.fsdp:
+            raise ValueError(
+                "--fsdp does not compose with a stage axis: the pipeline's "
+                "shard_map keeps the data axis MANUAL, which conflicts with "
+                "ZeRO's data-axis weight sharding")
         if config.flash_attention and model_size > 1:
             raise ValueError(
                 "--flash-attention under stage x model is unsupported: the flash "
@@ -376,17 +381,31 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             model, mesh, num_microbatches=config.pipeline_microbatches,
             batch_axis=None, schedule=config.pipeline_schedule)
     else:
-        state = tp.shard_train_state(mesh, base_state)
-        epoch_fn = tp.compile_epoch_tp(
-            make_epoch_fn(model, learning_rate=config.learning_rate,
-                          momentum=config.momentum,
-                          grad_accum=config.grad_accum, optimizer=optimizer,
-                          lr_schedule=lr_schedule,
-                          clip_grad_norm=config.clip_grad_norm,
-                          ema_decay=config.ema_decay,
-                          label_smoothing=config.label_smoothing),
-            mesh, data_axis="data" if data_size > 1 else None)
-        param_shardings = tp.state_shardings(mesh, state).params
+        epoch_body = make_epoch_fn(model, learning_rate=config.learning_rate,
+                                   momentum=config.momentum,
+                                   grad_accum=config.grad_accum,
+                                   optimizer=optimizer,
+                                   lr_schedule=lr_schedule,
+                                   clip_grad_norm=config.clip_grad_norm,
+                                   ema_decay=config.ema_decay,
+                                   label_smoothing=config.label_smoothing)
+        if config.fsdp:
+            # ZeRO x TP hybrid (r5): params + optimizer state shard over BOTH the
+            # data axis (largest free dim) and the Megatron model axis — memory
+            # divides by data_size x model_size (parallel/fsdp.py).
+            from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+                fsdp,
+            )
+            state_sh = fsdp.hybrid_state_shardings(mesh, base_state)
+            state = jax.device_put(base_state, state_sh)
+            epoch_fn = fsdp.compile_epoch_hybrid(
+                epoch_body, mesh, data_axis="data" if data_size > 1 else None)
+            param_shardings = state_sh.params
+        else:
+            state = tp.shard_train_state(mesh, base_state)
+            epoch_fn = tp.compile_epoch_tp(
+                epoch_body, mesh, data_axis="data" if data_size > 1 else None)
+            param_shardings = tp.state_shardings(mesh, state).params
         eval_model = model
     # Eval consumes the sharded params in place (no host gather — multi-host safe);
     # sums/counts come back replicated, which every process can read.

@@ -245,6 +245,13 @@ class ComposedConfig:
     ema_decay: float = 0.0              # params EMA in the compiled step (torch
                                         # swa_utils semantics); eval uses EMA weights
     async_checkpoint: bool = False      # background-thread checkpoint writes
+    fsdp: bool = False                  # ZeRO x TP hybrid (r5): params + optimizer
+                                        # state additionally shard over the data
+                                        # axis on each leaf's largest free dim
+                                        # (parallel/fsdp.py::hybrid_state_shardings)
+                                        # — memory divides by data x model size;
+                                        # trajectory identical (pinned in tests);
+                                        # rejected with a stage axis
     dcn_data: int = 0                   # multi-slice: the data axis's leading
                                         # factor spans this many slices/granules
                                         # over DCN (0 = flat single-network mesh);

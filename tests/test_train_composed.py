@@ -678,3 +678,37 @@ def test_1f1b_schedule_matches_dp(tmp_path, tiny_datasets):
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(hist_pp.test_losses, hist_dp.test_losses,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_fsdp_hybrid_matches_dp(tmp_path, tiny_datasets):
+    """--fsdp on the composed trainer (r5): ZeRO x TP hybrid sharding — params +
+    optimizer state shard over the data axis on dims the Megatron rules leave
+    free — must reproduce the plain-DP trajectory exactly, composed with TP and
+    with seq."""
+    state_h, hist_h = composed.main(
+        ComposedConfig(mesh="data=2,model=2", fsdp=True, epochs=2, batch_size=64,
+                       batch_size_test=100, results_dir=str(tmp_path / "hybrid")),
+        datasets=tiny_datasets)
+    state_dp, hist_dp = _run(tmp_path, tiny_datasets, "data=4", "dp_oracle3")
+    np.testing.assert_allclose(hist_h.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hist_h.test_losses, hist_dp.test_losses,
+                               rtol=1e-4, atol=1e-5)
+    for name in ("qkv_kernel", "out_kernel"):
+        np.testing.assert_allclose(
+            np.asarray(state_h.params["block_1"]["attn"][name]),
+            np.asarray(state_dp.params["block_1"]["attn"][name]),
+            rtol=1e-4, atol=1e-6)
+
+    state_3d, hist_3d = composed.main(
+        ComposedConfig(mesh="data=2,seq=2,model=2", fsdp=True, epochs=2,
+                       batch_size=64, batch_size_test=100,
+                       results_dir=str(tmp_path / "hybrid3d")),
+        datasets=tiny_datasets)
+    np.testing.assert_allclose(hist_3d.train_losses, hist_dp.train_losses,
+                               rtol=1e-4, atol=1e-5)
+
+    with pytest.raises(ValueError, match="fsdp does not compose"):
+        composed.main(ComposedConfig(mesh="data=2,stage=2", fsdp=True,
+                                     results_dir=""),
+                      datasets=tiny_datasets)
