@@ -150,12 +150,13 @@ def hybrid_state_shardings(mesh: Mesh, state: TrainState, *,
 
     def add_data(spec: P, leaf) -> P:
         entries = list(spec) + [None] * (leaf.ndim - len(spec))
-        if data_size <= 1:
-            return P(*entries)
-        taken = tuple(d for d, e in enumerate(entries) if e is not None)
-        best = _zero_dim(leaf, data_size, min_leaf_size, taken)
-        if best is not None:
-            entries[best] = data_axis
+        if data_size > 1:
+            taken = tuple(d for d, e in enumerate(entries) if e is not None)
+            best = _zero_dim(leaf, data_size, min_leaf_size, taken)
+            if best is not None:
+                entries[best] = data_axis
+        while entries and entries[-1] is None:   # canonical form: P() == replicated
+            entries.pop()
         return P(*entries)
 
     def tree_sh(tree):

@@ -134,3 +134,28 @@ def test_fsdp_trajectory_with_donated_shards(mesh):
         ref_state, ref_loss = ref_step(ref_state, x, y, jax.random.PRNGKey(1))
         state, loss = step(state, x, y, jax.random.PRNGKey(1))
     assert abs(float(loss) - float(ref_loss)) < 1e-5
+
+
+def test_hybrid_specs_compose_zero_with_megatron():
+    """hybrid_state_shardings (r5, composed --fsdp): column/row kernels keep their
+    Megatron model-axis dim AND gain a data-axis dim on the largest free one;
+    small leaves keep only their TP spec; the velocity mirrors its params."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        TransformerClassifier,
+    )
+
+    zmesh = make_mesh(4, axis_names=("data", "model"), axis_shape=(2, 2))
+    state = create_train_state(TransformerClassifier(dropout_rate=0.0),
+                               jax.random.PRNGKey(0))
+    sh = fsdp.hybrid_state_shardings(zmesh, state)
+    attn = sh.params["block_0"]["attn"]
+    mlp = sh.params["block_0"]["mlp"] if "mlp" in sh.params["block_0"] else None
+    # Column-parallel qkv kernel [E, 3HD]: model on dim 1 (Megatron), data on dim 0.
+    assert attn["qkv_kernel"].spec == P("data", "model")
+    # Row-parallel out kernel [HD, E]: model on dim 0, data on the free dim 1.
+    assert attn["out_kernel"].spec == P("model", "data")
+    # Small biases keep the TP-only layout (min_leaf_size gate).
+    assert attn["out_bias"].spec == P()
+    # Velocity mirrors params (the ZeRO invariant).
+    vel_attn = jax.tree_util.tree_leaves_with_path(sh.velocity)
+    assert sh.velocity["block_0"]["attn"]["qkv_kernel"].spec == P("data", "model")
