@@ -136,6 +136,7 @@ def test_fsdp_trajectory_with_donated_shards(mesh):
     assert abs(float(loss) - float(ref_loss)) < 1e-5
 
 
+@pytest.mark.slow
 def test_hybrid_specs_compose_zero_with_megatron():
     """hybrid_state_shardings (r5, composed --fsdp): column/row kernels keep their
     Megatron model-axis dim AND gain a data-axis dim on the largest free one;
