@@ -712,3 +712,18 @@ def test_fsdp_hybrid_matches_dp(tmp_path, tiny_datasets):
         composed.main(ComposedConfig(mesh="data=2,stage=2", fsdp=True,
                                      results_dir=""),
                       datasets=tiny_datasets)
+
+    # MoE too: expert-stacked weights keep their expert-axis dim and gain a
+    # data-axis dim — sharding-only change, identical trajectory.
+    common = dict(epochs=1, batch_size=64, batch_size_test=100,
+                  max_train_examples=256)
+    _, hist_moe_h = composed.main(
+        ComposedConfig(mesh="data=2,expert=2", fsdp=True,
+                       results_dir=str(tmp_path / "moe_h"), **common),
+        datasets=tiny_datasets)
+    _, hist_moe = composed.main(
+        ComposedConfig(mesh="data=2,expert=2",
+                       results_dir=str(tmp_path / "moe_p"), **common),
+        datasets=tiny_datasets)
+    np.testing.assert_allclose(hist_moe_h.train_losses, hist_moe.train_losses,
+                               rtol=1e-4, atol=1e-5)
