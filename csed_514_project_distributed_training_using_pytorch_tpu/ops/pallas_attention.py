@@ -9,8 +9,10 @@ exists. This is the intra-chip complement of the cross-chip ring attention in
 Kernel layout (FlashAttention-2 style, in the canonical Pallas-TPU grid formulation):
 
 - **Forward**: grid ``(B·H, S/BLOCK, S/BLOCK)`` in the packed ``[BH, S, D]`` layout, or
-  ``(B, H, S/BLOCK, S/BLOCK)`` in the native ``[B, S, H, D]`` layout (``_GridLayout``,
-  r5 — feeds the model's layout with no transpose repacks) — the innermost
+  ``(B, S/BLOCK, S/BLOCK)`` with all-heads blocks ``[BLOCK, H, D]`` and a static head
+  unroll in the native ``[B, S, H, D]`` layout (``_GridLayout``, r5 — feeds the model's
+  layout with no transpose repacks; Mosaic's last-two-dims tiling rules out a per-head
+  grid axis, so the head dim rides whole inside the block) — the innermost
   (fastest-varying) axis walks K/V blocks while the query block and the online-softmax
   accumulators ``(acc, m, l)`` persist in **VMEM scratch** across those steps
   (``@pl.when`` on the first/last K/V step initializes/finalizes them). Streaming and
@@ -87,11 +89,24 @@ FLASH_MIN_SEQ = 2048   # measured flash/dense crossover on TPU v5e (same capture
                        # above (21× banded at S=8192 W=256)
 
 
-def auto_block(s: int, window: int = 0) -> int:
+NATIVE_BLOCK_ROWS = 4096  # native-layout block·H cap: every native block holds
+                          # ALL H heads ([block, H, D] refs), so VMEM per
+                          # operand scales with block·H where the packed path's
+                          # measured 1024-row ceiling scaled with block alone —
+                          # capping the product keeps the native working set
+                          # within ~4× of the packed sweet spot and well clear
+                          # of the ~16 MB scoped-vmem wall (H=16 → block 256,
+                          # H≤4 → the full 1024)
+
+
+def auto_block(s: int, window: int = 0, heads: int | None = None) -> int:
     """Largest lane-aligned block ≤ the measured per-regime cap that tiles ``s``
     evenly — the measured-fastest choice per shape (see ``MAX_AUTO_BLOCK`` /
-    ``MAX_AUTO_BLOCK_WINDOWED``)."""
+    ``MAX_AUTO_BLOCK_WINDOWED``). ``heads`` caps the native layout's block·H
+    VMEM product (``NATIVE_BLOCK_ROWS``); packed callers leave it ``None``."""
     cap = MAX_AUTO_BLOCK_WINDOWED if window else MAX_AUTO_BLOCK
+    if heads is not None:
+        cap = min(cap, max(128, NATIVE_BLOCK_ROWS // heads))
     for b in (1024, 512, 256, 128):
         if b <= min(s, cap) and s % b == 0:
             return b
@@ -212,26 +227,35 @@ class _GridLayout:
     """Grid/spec factory shared by the fwd/dq/dkv ``pallas_call``s for the two
     operand layouts:
 
-    - packed ``[BH, S, D]`` — grid ``(bh, nq, steps)`` — the ring schedules'
-      shard layout;
-    - native ``[B, S, H, D]`` — grid ``(b, h, nq, steps)`` with the B and H block
-      dims ``None``-squeezed — the MODEL's layout, fed with no transpose repacks
-      (r5: the ``[B,S,H,D] ↔ [BH,S,D]`` copies around the custom calls were 11%
-      of the large-transformer step, ``bench_results/hw_r4/profile_large``).
+    - packed ``[BH, S, D]`` — grid ``(bh, nq, steps)``, refs ``[block, D]`` —
+      the ring schedules' shard layout;
+    - native ``[B, S, H, D]`` — grid ``(b, nq, steps)``, refs ``[block, H, D]``
+      with the FULL head dim in every block — the MODEL's layout, fed with no
+      transpose repacks (r5: the ``[B,S,H,D] ↔ [BH,S,D]`` copies around the
+      custom calls were 11% of the large-transformer step,
+      ``bench_results/hw_r4/profile_large``). The head dim must ride whole
+      inside the block: Mosaic tiles the LAST TWO dims of every block, so a
+      per-head grid axis would put a size-1 block on the sublane (H) dim —
+      which only lowers when it equals the array dim or divides by 8 (the r5
+      chip run rejected exactly that; interpret mode never enforces it).
+      Kernels unroll a static head loop instead (``_ref_heads``), with per-head
+      running state in head-LEADING scratch (leading-dim slices are
+      relayout-free).
 
-    Kernel bodies are identical either way (q/k/v/o refs ``[block, D]``, lse refs
-    ``[1, 1, block]``); only grids, specs, and the kernels' ``pid_base`` differ.
-    """
+    Either way the grid is ``(prefix, nq, steps)`` — query-block axis at
+    program_id(1), K/V-walk axis at program_id(2) — and the lse rides with
+    ``(1, block)`` trailing dims equal to the array's (tiling-legal by
+    equality)."""
 
     def __init__(self, shape, block: int):
         self.four = len(shape) == 4
         self.block, self.d = block, shape[-1]
         if self.four:
             g, s, hh, _ = shape
-            self.prefix, self.pid_base = (g, hh), 2
+            self.prefix, self.h = (g,), hh
         else:
             bh, s, _ = shape
-            self.prefix, self.pid_base = (bh,), 1
+            self.prefix, self.h = (bh,), None
         self.s = s
 
     def grid(self, nq: int, steps: int) -> tuple:
@@ -245,11 +269,11 @@ class _GridLayout:
         if self.four:
             if prefetch:
                 return pl.BlockSpec(
-                    (None, self.block, None, self.d),
-                    lambda g, h, i, j, off: (g, idx_fn(i, j, off), h, 0),
+                    (None, self.block, self.h, self.d),
+                    lambda g, i, j, off: (g, idx_fn(i, j, off), 0, 0),
                     memory_space=pltpu.VMEM)
-            return pl.BlockSpec((None, self.block, None, self.d),
-                                lambda g, h, i, j: (g, idx_fn(i, j), h, 0),
+            return pl.BlockSpec((None, self.block, self.h, self.d),
+                                lambda g, i, j: (g, idx_fn(i, j), 0, 0),
                                 memory_space=pltpu.VMEM)
         if prefetch:
             return pl.BlockSpec((None, self.block, self.d),
@@ -269,11 +293,11 @@ class _GridLayout:
         if self.four:
             if prefetch:
                 return pl.BlockSpec(
-                    (None, None, 1, 1, self.block),
-                    lambda g, h, i, j, off: (g, h, idx_fn(i, j, off), 0, 0),
+                    (None, self.h, 1, 1, self.block),
+                    lambda g, i, j, off: (g, 0, idx_fn(i, j, off), 0, 0),
                     memory_space=pltpu.VMEM)
-            return pl.BlockSpec((None, None, 1, 1, self.block),
-                                lambda g, h, i, j: (g, h, idx_fn(i, j), 0, 0),
+            return pl.BlockSpec((None, self.h, 1, 1, self.block),
+                                lambda g, i, j: (g, 0, idx_fn(i, j), 0, 0),
                                 memory_space=pltpu.VMEM)
         if prefetch:
             return pl.BlockSpec((None, 1, 1, self.block),
@@ -290,13 +314,44 @@ class _GridLayout:
         return self._lse_spec(idx_fn, prefetch)
 
     def lse_shape(self, nq: int) -> tuple:
+        if self.four:
+            return self.prefix + (self.h, nq, 1, self.block)
         return self.prefix + (nq, 1, self.block)
 
     def out_shape(self, dtype):
         if self.four:
-            g, hh = self.prefix
-            return jax.ShapeDtypeStruct((g, self.s, hh, self.d), dtype)
+            return jax.ShapeDtypeStruct((self.prefix[0], self.s, self.h, self.d),
+                                        dtype)
         return jax.ShapeDtypeStruct((self.prefix[0], self.s, self.d), dtype)
+
+    def acc(self, width: int):
+        """f32 VMEM scratch for a per-row accumulator of ``width`` columns:
+        ``[block, width]`` packed, head-leading ``[H, block, width]`` native (so
+        the kernels' per-head state slices never cross the tiled trailing
+        dims)."""
+        if self.four:
+            return pltpu.VMEM((self.h, self.block, width), jnp.float32)
+        return pltpu.VMEM((self.block, width), jnp.float32)
+
+
+def _ref_heads(ref):
+    """Static head unroll for a q/k/v/o/do kernel ref: packed ``[block, D]``
+    refs run the body once on the whole ref (``h is None``); native
+    ``[block, H, D]`` refs run it per head slice. The loop is a Python loop
+    over a STATIC bound — it unrolls at trace time, which Mosaic requires."""
+    return range(ref.shape[1]) if ref.ndim == 3 else (None,)
+
+
+def _hslice(ref, h):
+    """Per-head ``[block, D]`` view of an operand ref (identity when packed)."""
+    return ref[:] if h is None else ref[:, h, :]
+
+
+def _stat_col(ref, h):
+    """``[bq, 1]`` statistics column from an lse/delta ref (``[1, 1, block]``
+    packed, ``[H, 1, 1, block]`` native)."""
+    row = ref[0] if h is None else ref[h, 0]
+    return jnp.transpose(row)
 
 
 def _dyn_band_reach(window: int, block: int) -> int:
@@ -373,25 +428,24 @@ def _banded(window: int, causal: bool, nq: int, block: int) -> bool:
 
 
 def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
-                band_base=None, window=0, q_offset=0, dyn_offset=False,
-                pid_base=1):
+                band_base=None, window=0, q_offset=0, dyn_offset=False):
     # ``dyn_offset``: the hop offset arrives as a TRACED int32 scalar via scalar
     # prefetch (the first operand) instead of the static ``q_offset`` — the
     # zig-zag schedules' chunk-pair offsets are device-dependent. r5: scalar-
     # prefetch index maps let the SAME traced offset steer a banded walk
     # (``band_base`` set), so dynamic windowed callers no longer pay the full
     # O((S/block)²) grid.
-    # ``pid_base``: grid position of the query-block axis — 1 for the packed
-    # [BH, S, D] layout's (bh, nq, steps) grid, 2 for the native [B, S, H, D]
-    # layout's (b, h, nq, steps) grid (r5). Block dims not in the ref are
-    # None-squeezed by the specs, so the kernel body is layout-agnostic:
-    # q/k/v/o refs are [block, D], lse refs [1, 1, block].
+    # Layouts: packed refs are [block, D] with [block, ...] scratch; native refs
+    # are [block, H, D] with head-LEADING [H, block, ...] scratch, and the body
+    # unrolls a static head loop (``_ref_heads``). The visibility mask depends
+    # only on (query block, key block) positions, so it is hoisted out of the
+    # head loop.
     if dyn_offset:
         off_ref, refs = refs[0], refs[1:]
         q_offset = off_ref[0]
     q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
-    iq = pl.program_id(pid_base)
-    step = pl.program_id(pid_base + 1)
+    iq = pl.program_id(1)
+    step = pl.program_id(2)
     bq = q_ref.shape[0]
     # Band-compressed grid: the step axis walks key-block OFFSETS around the query
     # block (shifted by the hop offset when the caller's queries live q_offset
@@ -413,29 +467,33 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
         # Matmul operands keep the INPUT dtype (bf16 runs at the MXU's native
         # rate; f32 inputs behave as before) with f32 accumulation; the softmax
         # scale is applied to the f32 product, not the narrow operand.
-        q = q_ref[:]                                                       # [bq, D]
-        k_blk = k_ref[:]                                                   # [bk, D]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if masked:
-            visible = _visibility_mask(iq, j, bq, k_ref.shape[0],
-                                       causal=causal, window=window,
-                                       q_offset=q_offset)
-            s = jnp.where(visible, s, NEG)
-        m = m_ref[:]
-        l = l_ref[:]
-        m_blk = jnp.max(s, axis=1, keepdims=True)                          # [bq, 1]
-        m_new = jnp.maximum(m, m_blk)
-        p = jnp.exp(s - m_new)
-        if masked:
-            p = jnp.where(visible, p, 0.0)
-        corr = jnp.exp(m - m_new)
-        v_blk = v_ref[:]
-        acc_ref[:] = (acc_ref[:] * corr
-                      + jnp.dot(p.astype(v_blk.dtype), v_blk,
-                                preferred_element_type=jnp.float32))
-        m_ref[:] = m_new
-        l_ref[:] = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        visible = (_visibility_mask(iq, j, bq, k_ref.shape[0], causal=causal,
+                                    window=window, q_offset=q_offset)
+                   if masked else None)
+        for h in _ref_heads(q_ref):
+            q = _hslice(q_ref, h)                                          # [bq, D]
+            k_blk = _hslice(k_ref, h)                                      # [bk, D]
+            s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if masked:
+                s = jnp.where(visible, s, NEG)
+            m = m_ref[:] if h is None else m_ref[h]
+            l = l_ref[:] if h is None else l_ref[h]
+            m_blk = jnp.max(s, axis=1, keepdims=True)                      # [bq, 1]
+            m_new = jnp.maximum(m, m_blk)
+            p = jnp.exp(s - m_new)
+            if masked:
+                p = jnp.where(visible, p, 0.0)
+            corr = jnp.exp(m - m_new)
+            v_blk = _hslice(v_ref, h)
+            acc = acc_ref[:] if h is None else acc_ref[h]
+            acc_new = acc * corr + jnp.dot(p.astype(v_blk.dtype), v_blk,
+                                           preferred_element_type=jnp.float32)
+            l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+            if h is None:
+                acc_ref[:], m_ref[:], l_ref[:] = acc_new, m_new, l_new
+            else:
+                acc_ref[h], m_ref[h], l_ref[h] = acc_new, m_new, l_new
 
     # Causal/banded: key blocks with no visible pair contribute nothing — no FLOPs
     # (and with the elided walks, no fetch either). Fully-visible INTERIOR blocks
@@ -446,10 +504,18 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
 
     @pl.when(step == num_steps - 1)
     def _():
-        l_safe = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
-        o_ref[:] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse = m_ref[:] + jnp.log(l_safe)                                   # [bq, 1]
-        lse_ref[:] = jnp.transpose(lse).reshape(1, 1, bq)
+        for h in _ref_heads(q_ref):
+            l_cur = l_ref[:] if h is None else l_ref[h]
+            l_safe = jnp.where(l_cur == 0.0, 1.0, l_cur)
+            acc = acc_ref[:] if h is None else acc_ref[h]
+            m_cur = m_ref[:] if h is None else m_ref[h]
+            lse = jnp.transpose(m_cur + jnp.log(l_safe))               # [1, bq]
+            if h is None:
+                o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
+                lse_ref[:] = lse.reshape(1, 1, bq)
+            else:
+                o_ref[:, h, :] = (acc / l_safe).astype(o_ref.dtype)
+                lse_ref[h] = lse.reshape(1, 1, bq)
 
 
 def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
@@ -502,8 +568,7 @@ def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
             key_idx = lambda i, j, *_: j
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_steps=num_steps, num_blocks=nq, band_base=base,
-                               window=window, q_offset=q_offset, dyn_offset=dyn,
-                               pid_base=lay.pid_base)
+                               window=window, q_offset=q_offset, dyn_offset=dyn)
     in_specs = [
         lay.row_spec(prefetch=dyn),
         lay.walk_spec(key_idx, prefetch=dyn),
@@ -520,9 +585,9 @@ def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
         jax.ShapeDtypeStruct(lay.lse_shape(nq), jnp.float32),
     ]
     scratch_shapes = [
-        pltpu.VMEM((block, d), jnp.float32),    # acc
-        pltpu.VMEM((block, 1), jnp.float32),    # running max m
-        pltpu.VMEM((block, 1), jnp.float32),    # running normalizer l
+        lay.acc(d),    # acc
+        lay.acc(1),    # running max m
+        lay.acc(1),    # running normalizer l
     ]
     dyn_args = ((jnp.asarray(q_offset_dyn, jnp.int32).reshape(1),) if dyn else ())
     out, lse = _pallas_dispatch(kernel, lay, nq, num_steps, in_specs, out_specs,
@@ -537,15 +602,14 @@ def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
 
 
 def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
-               band_base=None, window=0, q_offset=0, dyn_offset=False,
-               pid_base=1):
+               band_base=None, window=0, q_offset=0, dyn_offset=False):
     if dyn_offset:                      # traced hop offset (see _fwd_kernel)
         off_ref, refs = refs[0], refs[1:]
         q_offset = off_ref[0]
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
      dq_acc_ref) = refs
-    iq = pl.program_id(pid_base)
-    step = pl.program_id(pid_base + 1)
+    iq = pl.program_id(1)
+    step = pl.program_id(2)
     bq = q_ref.shape[0]
     if band_base is None:
         j, in_range = step, jnp.bool_(True)
@@ -561,46 +625,54 @@ def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
         # Matmul operands keep the INPUT dtype (bf16 at the MXU's native rate),
         # f32 accumulation; softmax statistics and ds stay f32, narrowed only at
         # the matmul boundary (the standard TPU flash-backward precision split).
-        q = q_ref[:]                                              # [bq, D]
-        do = do_ref[:]                                            # [bq, D]
-        lse = jnp.transpose(lse_ref[0])                           # [1,bq] -> [bq, 1]
-        delta = jnp.transpose(delta_ref[0])                       # [1,bq] -> [bq, 1]
-        k_blk = k_ref[:]
-        v_blk = v_ref[:]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if masked:
-            visible = _visibility_mask(iq, j, bq, k_ref.shape[0],
-                                       causal=causal, window=window,
-                                       q_offset=q_offset)
-            s = jnp.where(visible, s, NEG)
-        p = jnp.exp(s - lse)                                      # [bq, bk]
-        if masked:
-            p = jnp.where(visible, p, 0.0)
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dq_acc_ref[:] = dq_acc_ref[:] + jnp.dot(
-            ds.astype(k_blk.dtype), k_blk, preferred_element_type=jnp.float32)
+        visible = (_visibility_mask(iq, j, bq, k_ref.shape[0], causal=causal,
+                                    window=window, q_offset=q_offset)
+                   if masked else None)
+        for h in _ref_heads(q_ref):
+            q = _hslice(q_ref, h)                                 # [bq, D]
+            do = _hslice(do_ref, h)                               # [bq, D]
+            lse = _stat_col(lse_ref, h)                           # [bq, 1]
+            delta = _stat_col(delta_ref, h)                       # [bq, 1]
+            k_blk = _hslice(k_ref, h)
+            v_blk = _hslice(v_ref, h)
+            s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if masked:
+                s = jnp.where(visible, s, NEG)
+            p = jnp.exp(s - lse)                                  # [bq, bk]
+            if masked:
+                p = jnp.where(visible, p, 0.0)
+            dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta)
+            upd = jnp.dot(ds.astype(k_blk.dtype), k_blk,
+                          preferred_element_type=jnp.float32)
+            if h is None:
+                dq_acc_ref[:] = dq_acc_ref[:] + upd
+            else:
+                dq_acc_ref[h] = dq_acc_ref[h] + upd
 
     _dispatch_block(body, iq, j, bq, k_ref.shape[0], in_range, causal=causal,
                     window=window, q_offset=q_offset)
 
     @pl.when(step == num_steps - 1)
     def _():
-        dq_ref[:] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
+        for h in _ref_heads(q_ref):
+            if h is None:
+                dq_ref[:] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
+            else:
+                dq_ref[:, h, :] = (dq_acc_ref[h] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
-                band_base=None, window=0, q_offset=0, dyn_offset=False,
-                pid_base=1):
+                band_base=None, window=0, q_offset=0, dyn_offset=False):
     if dyn_offset:                      # traced hop offset (see _fwd_kernel)
         off_ref, refs = refs[0], refs[1:]
         q_offset = off_ref[0]
     (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
      dk_acc_ref, dv_acc_ref) = refs
-    ik = pl.program_id(pid_base)
-    step = pl.program_id(pid_base + 1)
+    ik = pl.program_id(1)
+    step = pl.program_id(2)
     bk = k_ref.shape[0]
     # Banded: the step axis walks QUERY-block offsets around this key block
     # (causal keys are only visible to queries at or after them, so offsets start
@@ -621,32 +693,39 @@ def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
     def body(masked: bool):
         # Same precision split as the dq kernel: operands in the input dtype,
         # f32 accumulation, p/ds narrowed only at the matmul boundary.
-        k = k_ref[:]                                              # [bk, D]
-        v = v_ref[:]                                              # [bk, D]
-        q_blk = q_ref[:]                                          # [bq, D]
-        do_blk = do_ref[:]
-        lse_blk = jnp.transpose(lse_ref[0])                       # [bq, 1]
-        delta_blk = jnp.transpose(delta_ref[0])                   # [bq, 1]
-        s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if masked:
-            visible = _visibility_mask(i, ik, q_ref.shape[0], bk,
-                                       causal=causal, window=window,
-                                       q_offset=q_offset)
-            s = jnp.where(visible, s, NEG)
-        p = jnp.exp(s - lse_blk)                                  # [bq, bk]
-        if masked:
-            p = jnp.where(visible, p, 0.0)
-        # dv += pᵀ · do ; dk += dsᵀ · q
-        dv_acc_ref[:] = dv_acc_ref[:] + jax.lax.dot_general(
-            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)                   # [bk, D]
-        dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk)
-        dk_acc_ref[:] = dk_acc_ref[:] + jax.lax.dot_general(
-            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        visible = (_visibility_mask(i, ik, q_ref.shape[0], bk, causal=causal,
+                                    window=window, q_offset=q_offset)
+                   if masked else None)
+        for h in _ref_heads(q_ref):
+            k = _hslice(k_ref, h)                                 # [bk, D]
+            v = _hslice(v_ref, h)                                 # [bk, D]
+            q_blk = _hslice(q_ref, h)                             # [bq, D]
+            do_blk = _hslice(do_ref, h)
+            lse_blk = _stat_col(lse_ref, h)                       # [bq, 1]
+            delta_blk = _stat_col(delta_ref, h)                   # [bq, 1]
+            s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if masked:
+                s = jnp.where(visible, s, NEG)
+            p = jnp.exp(s - lse_blk)                              # [bq, bk]
+            if masked:
+                p = jnp.where(visible, p, 0.0)
+            # dv += pᵀ · do ; dk += dsᵀ · q
+            dv_upd = jax.lax.dot_general(
+                p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)               # [bk, D]
+            dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta_blk)
+            dk_upd = jax.lax.dot_general(
+                ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if h is None:
+                dv_acc_ref[:] = dv_acc_ref[:] + dv_upd
+                dk_acc_ref[:] = dk_acc_ref[:] + dk_upd
+            else:
+                dv_acc_ref[h] = dv_acc_ref[h] + dv_upd
+                dk_acc_ref[h] = dk_acc_ref[h] + dk_upd
 
     # Causal/banded: query blocks with no visible pair against this key block skip;
     # fully-visible interior blocks skip the mask chain (see _fwd_kernel).
@@ -655,8 +734,13 @@ def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
 
     @pl.when(step == num_steps - 1)
     def _():
-        dk_ref[:] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
-        dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
+        for h in _ref_heads(q_ref):
+            if h is None:
+                dk_ref[:] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
+                dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
+            else:
+                dk_ref[:, h, :] = (dk_acc_ref[h] * scale).astype(dk_ref.dtype)
+                dv_ref[:, h, :] = dv_acc_ref[h].astype(dv_ref.dtype)
 
 
 def _flash_backward(res, g, *, causal: bool, block: int = BLOCK,
@@ -756,7 +840,7 @@ def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
         kernel = functools.partial(kernel_fn, scale=scale, causal=causal,
                                    num_steps=steps, num_blocks=nq, band_base=base,
                                    window=window, q_offset=q_offset,
-                                   dyn_offset=dyn, pid_base=lay.pid_base)
+                                   dyn_offset=dyn)
         return _pallas_dispatch(kernel, lay, nq, steps, in_specs, out_specs,
                                 out_shape, scratch, dyn)(
             *dyn_args, qx, kx, vx, g, lse, delta)
@@ -765,7 +849,7 @@ def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
     dq = call(_dq_kernel, dq_base, dq_steps,
               [row_spec, dq_walk, dq_walk, row_spec, lse_row_spec, lse_row_spec],
               [row_spec], [lay.out_shape(qx.dtype)],
-              [pltpu.VMEM((block, d), jnp.float32)])[0]
+              [lay.acc(d)])[0]
 
     # dkv grid: the query-block axis walks (accumulators persist per key block).
     kv_idx = _walk_idx(kv_base, -off_blocks, kv=True)
@@ -776,8 +860,7 @@ def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
                    kv_lse_walk],
                   [row_spec, row_spec],
                   [lay.out_shape(kx.dtype), lay.out_shape(vx.dtype)],
-                  [pltpu.VMEM((block, d), jnp.float32),
-                   pltpu.VMEM((block, d), jnp.float32)])
+                  [lay.acc(d), lay.acc(d)])
     return dq, dk, dv
 
 
@@ -828,8 +911,8 @@ def _native_layout_default() -> bool:
     layout directly (no transpose repacks) instead of packing to [BH, S, D].
     Opt-in via ``FLASH_NATIVE_LAYOUT=1`` until a hardware capture picks the
     winner: the native path deletes the repack copies (11% of the r4 large
-    transformer step) but its H-strided block DMA interacts with Mosaic's
-    last-two-dims tiling in ways only the chip can price."""
+    transformer step) but its in-kernel per-head slices of ``[block, H, D]``
+    refs cost sublane relayouts only the chip can price."""
     return os.environ.get("FLASH_NATIVE_LAYOUT", "0").strip().lower() in (
         "1", "true", "yes", "on")
 
@@ -846,8 +929,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     transformer family's ``attention_fn``. ``block`` is a pure performance knob
     (numerics are block-invariant — pinned in tests); tune it with
     ``bench_attention.py --block``. ``native_layout`` (default: the
-    ``FLASH_NATIVE_LAYOUT`` env knob) skips the [B,S,H,D]↔[BH,S,D] repacks and
-    grids over heads instead (``_GridLayout``).
+    ``FLASH_NATIVE_LAYOUT`` env knob) skips the [B,S,H,D]↔[BH,S,D] repacks,
+    feeding the kernels all-heads blocks with a static head unroll
+    (``_GridLayout``); its auto-block caps block·H (``NATIVE_BLOCK_ROWS``).
 
     ``window=W`` is sliding-window/local attention with ``full_attention``'s exact
     semantics (distance < W; causal restricts to the past side) — and a BANDED grid:
@@ -857,13 +941,23 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     dominated at S ≥ 64k. Out-of-band blocks cost nothing: they are never stepped.
     """
     b, s, h, d = q.shape
+    if native_layout is None:
+        native_layout = _native_layout_default()
     if block is None:
-        block = auto_block(s, int(window or 0))
+        block = auto_block(s, int(window or 0),
+                           heads=h if native_layout else None)
+    elif native_layout and block * h > NATIVE_BLOCK_ROWS:
+        # Explicit blocks get the same VMEM envelope the auto path respects:
+        # native blocks hold all H heads, so block·H is the real working-set
+        # knob and oversizing it is a Mosaic scoped-vmem compile failure on
+        # chip, not a perf tradeoff.
+        raise ValueError(
+            f"native-layout flash needs block*heads <= {NATIVE_BLOCK_ROWS} "
+            f"(got block={block} * heads={h} = {block * h}); pass a smaller "
+            f"block or use the packed layout")
     _check_block(s, block)
     validate_window(window)
     op = _make_op(bool(causal), int(block), int(window or 0))
-    if native_layout is None:
-        native_layout = _native_layout_default()
     if native_layout:
         return op(q, k, v)
     to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)

@@ -27,8 +27,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
 import sys
+
+# Script-mode import path: ``python tools/bench_decode_analysis.py`` puts tools/
+# on sys.path, not the repo root the package lives in.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:

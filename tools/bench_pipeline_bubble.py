@@ -26,9 +26,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
+
+# Script-mode import path: ``python tools/bench_pipeline_bubble.py`` puts tools/
+# on sys.path, not the repo root the package lives in.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 MB, SEQ, EMBED = 8, 8, 64      # microbatch size / tokens / width per tick (fixed)
 
