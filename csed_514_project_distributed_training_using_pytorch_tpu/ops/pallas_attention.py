@@ -9,10 +9,11 @@ exists. This is the intra-chip complement of the cross-chip ring attention in
 Kernel layout (FlashAttention-2 style, in the canonical Pallas-TPU grid formulation):
 
 - **Forward**: grid ``(B·H, S/BLOCK, S/BLOCK)`` in the packed ``[BH, S, D]`` layout, or
-  ``(B, S/BLOCK, S/BLOCK)`` with all-heads blocks ``[BLOCK, H, D]`` and a static head
-  unroll in the native ``[B, S, H, D]`` layout (``_GridLayout``, r5 — feeds the model's
-  layout with no transpose repacks; Mosaic's last-two-dims tiling rules out a per-head
-  grid axis, so the head dim rides whole inside the block) — the innermost
+  ``(B, S/BLOCK, S/BLOCK)`` with all-heads blocks ``[BLOCK, H·D]`` and a static head
+  unroll over per-head LANE slices in the native-flat layout (``_GridLayout``, r5 —
+  the model's ``[B, S, H, D]`` viewed flat, a free reshape, no transpose repacks;
+  Mosaic's last-two-dims tiling rules out a per-head grid axis, and sublane-sliced
+  bf16 operands crash its ``dot`` lowering, so heads ride the lane dim) — the innermost
   (fastest-varying) axis walks K/V blocks while the query block and the online-softmax
   accumulators ``(acc, m, l)`` persist in **VMEM scratch** across those steps
   (``@pl.when`` on the first/last K/V step initializes/finalizes them). Streaming and
@@ -89,24 +90,31 @@ FLASH_MIN_SEQ = 2048   # measured flash/dense crossover on TPU v5e (same capture
                        # above (21× banded at S=8192 W=256)
 
 
-NATIVE_BLOCK_ROWS = 4096  # native-layout block·H cap: every native block holds
-                          # ALL H heads ([block, H, D] refs), so VMEM per
-                          # operand scales with block·H where the packed path's
-                          # measured 1024-row ceiling scaled with block alone —
-                          # capping the product keeps the native working set
-                          # within ~4× of the packed sweet spot and well clear
-                          # of the ~16 MB scoped-vmem wall (H=16 → block 256,
-                          # H≤4 → the full 1024)
+NATIVE_BLOCK_ELEMS = 262144  # native-layout block·H·D cap (elements per operand
+                             # block): native-flat blocks hold ALL H heads
+                             # ([block, H·D] refs), so the VMEM working set
+                             # scales with the product. Measured v5e envelope
+                             # (r5): 512·8·64 and 256·8·128 compile; 512·8·128
+                             # (524288) exceeds the 16 MB scoped-vmem limit by
+                             # 740 KB in the fwd kernel's AOT stack allocation
 
 
-def auto_block(s: int, window: int = 0, heads: int | None = None) -> int:
+def auto_block(s: int, window: int = 0, native_hd: int | None = None) -> int:
     """Largest lane-aligned block ≤ the measured per-regime cap that tiles ``s``
     evenly — the measured-fastest choice per shape (see ``MAX_AUTO_BLOCK`` /
-    ``MAX_AUTO_BLOCK_WINDOWED``). ``heads`` caps the native layout's block·H
-    VMEM product (``NATIVE_BLOCK_ROWS``); packed callers leave it ``None``."""
+    ``MAX_AUTO_BLOCK_WINDOWED``). ``native_hd`` (= H·D, the flat row width)
+    caps the native layout's block·H·D VMEM product (``NATIVE_BLOCK_ELEMS``);
+    packed callers leave it ``None``."""
     cap = MAX_AUTO_BLOCK_WINDOWED if window else MAX_AUTO_BLOCK
-    if heads is not None:
-        cap = min(cap, max(128, NATIVE_BLOCK_ROWS // heads))
+    if native_hd is not None:
+        if 128 * native_hd > NATIVE_BLOCK_ELEMS:
+            # Even the smallest legal block would bust the measured scoped-vmem
+            # envelope — same failure the explicit-block check rejects.
+            raise ValueError(
+                f"native-layout flash cannot tile heads*head_dim={native_hd}: "
+                f"128*{native_hd} exceeds the {NATIVE_BLOCK_ELEMS}-element "
+                f"VMEM envelope; use the packed layout for this shape")
+        cap = min(cap, NATIVE_BLOCK_ELEMS // native_hd)
     for b in (1024, 512, 256, 128):
         if b <= min(s, cap) and s % b == 0:
             return b
@@ -227,36 +235,31 @@ class _GridLayout:
     """Grid/spec factory shared by the fwd/dq/dkv ``pallas_call``s for the two
     operand layouts:
 
-    - packed ``[BH, S, D]`` — grid ``(bh, nq, steps)``, refs ``[block, D]`` —
-      the ring schedules' shard layout;
-    - native ``[B, S, H, D]`` — grid ``(b, nq, steps)``, refs ``[block, H, D]``
-      with the FULL head dim in every block — the MODEL's layout, fed with no
-      transpose repacks (r5: the ``[B,S,H,D] ↔ [BH,S,D]`` copies around the
-      custom calls were 11% of the large-transformer step,
-      ``bench_results/hw_r4/profile_large``). The head dim must ride whole
-      inside the block: Mosaic tiles the LAST TWO dims of every block, so a
-      per-head grid axis would put a size-1 block on the sublane (H) dim —
-      which only lowers when it equals the array dim or divides by 8 (the r5
-      chip run rejected exactly that; interpret mode never enforces it).
-      Kernels unroll a static head loop instead (``_ref_heads``), with per-head
-      running state in head-LEADING scratch (leading-dim slices are
-      relayout-free).
+    - packed ``[BH, S, D]`` (``heads=None``) — refs ``[block, D]`` — the ring
+      schedules' shard layout;
+    - native-flat ``[B, S, H·D]`` (``heads=H``) — refs ``[block, H·D]`` with
+      per-head LANE slices — the model's ``[B, S, H, D]`` viewed flat, which is
+      a free contiguous reshape, NOT the ``[B,S,H,D] ↔ [BH,S,D]`` transpose
+      repacks this layout exists to delete (11% of the r4 large-transformer
+      step, ``bench_results/hw_r4/profile_large``).
 
-    Either way the grid is ``(prefix, nq, steps)`` — query-block axis at
-    program_id(1), K/V-walk axis at program_id(2) — and the lse rides with
-    ``(1, block)`` trailing dims equal to the array's (tiling-legal by
-    equality)."""
+    The flat form is forced by two Mosaic constraints the r5 chip runs hit
+    (interpret mode enforces neither): a per-head grid axis puts a size-1
+    block on the sublane (H) dim of a rank-4 block, which fails the
+    last-two-dims tiling rule; and keeping H as a ref dim makes the per-head
+    slice a SUBLANE slice, whose product feeding an MXU ``dot`` crashes the
+    bf16 Mosaic compile outright. Lane slices at D-granularity compile and
+    run for both dtypes. So both layouts share the rank-3 spec machinery —
+    grid ``(prefix, nq, steps)``, query-block axis at program_id(1), K/V-walk
+    axis at program_id(2) — and differ only in the kernels' static head unroll
+    (``_ref_heads``) and the lse spec, whose ``(1, block)`` trailing block dims
+    equal the array's (tiling-legal by equality)."""
 
-    def __init__(self, shape, block: int):
-        self.four = len(shape) == 4
-        self.block, self.d = block, shape[-1]
-        if self.four:
-            g, s, hh, _ = shape
-            self.prefix, self.h = (g,), hh
-        else:
-            bh, s, _ = shape
-            self.prefix, self.h = (bh,), None
-        self.s = s
+    def __init__(self, shape, block: int, heads: int | None = None):
+        bh, s, last = shape
+        self.block, self.s, self.heads = block, s, heads
+        self.prefix = (bh,)
+        self.hd = last                       # D packed, H·D native-flat
 
     def grid(self, nq: int, steps: int) -> tuple:
         return self.prefix + (nq, steps)
@@ -266,20 +269,11 @@ class _GridLayout:
         take the scalar-prefetch ref as a trailing arg (the
         ``PrefetchScalarGridSpec`` convention) — how a TRACED hop offset steers
         a banded walk (r5; previously dynamic offsets forced the full walk)."""
-        if self.four:
-            if prefetch:
-                return pl.BlockSpec(
-                    (None, self.block, self.h, self.d),
-                    lambda g, i, j, off: (g, idx_fn(i, j, off), 0, 0),
-                    memory_space=pltpu.VMEM)
-            return pl.BlockSpec((None, self.block, self.h, self.d),
-                                lambda g, i, j: (g, idx_fn(i, j), 0, 0),
-                                memory_space=pltpu.VMEM)
         if prefetch:
-            return pl.BlockSpec((None, self.block, self.d),
+            return pl.BlockSpec((None, self.block, self.hd),
                                 lambda b, i, j, off: (b, idx_fn(i, j, off), 0),
                                 memory_space=pltpu.VMEM)
-        return pl.BlockSpec((None, self.block, self.d),
+        return pl.BlockSpec((None, self.block, self.hd),
                             lambda b, i, j: (b, idx_fn(i, j), 0),
                             memory_space=pltpu.VMEM)
 
@@ -290,13 +284,13 @@ class _GridLayout:
         return self._spec(idx_fn, prefetch)
 
     def _lse_spec(self, idx_fn, prefetch: bool):
-        if self.four:
+        if self.heads:
             if prefetch:
                 return pl.BlockSpec(
-                    (None, self.h, 1, 1, self.block),
+                    (None, self.heads, 1, 1, self.block),
                     lambda g, i, j, off: (g, 0, idx_fn(i, j, off), 0, 0),
                     memory_space=pltpu.VMEM)
-            return pl.BlockSpec((None, self.h, 1, 1, self.block),
+            return pl.BlockSpec((None, self.heads, 1, 1, self.block),
                                 lambda g, i, j: (g, 0, idx_fn(i, j), 0, 0),
                                 memory_space=pltpu.VMEM)
         if prefetch:
@@ -314,42 +308,40 @@ class _GridLayout:
         return self._lse_spec(idx_fn, prefetch)
 
     def lse_shape(self, nq: int) -> tuple:
-        if self.four:
-            return self.prefix + (self.h, nq, 1, self.block)
+        if self.heads:
+            return self.prefix + (self.heads, nq, 1, self.block)
         return self.prefix + (nq, 1, self.block)
 
     def out_shape(self, dtype):
-        if self.four:
-            return jax.ShapeDtypeStruct((self.prefix[0], self.s, self.h, self.d),
-                                        dtype)
-        return jax.ShapeDtypeStruct((self.prefix[0], self.s, self.d), dtype)
+        return jax.ShapeDtypeStruct((self.prefix[0], self.s, self.hd), dtype)
 
     def acc(self, width: int):
         """f32 VMEM scratch for a per-row accumulator of ``width`` columns:
-        ``[block, width]`` packed, head-leading ``[H, block, width]`` native (so
-        the kernels' per-head state slices never cross the tiled trailing
+        ``[block, width]`` packed, head-leading ``[H, block, width]``
+        native-flat (leading-dim slices never cross the tiled trailing
         dims)."""
-        if self.four:
-            return pltpu.VMEM((self.h, self.block, width), jnp.float32)
+        if self.heads:
+            return pltpu.VMEM((self.heads, self.block, width), jnp.float32)
         return pltpu.VMEM((self.block, width), jnp.float32)
 
 
-def _ref_heads(ref):
-    """Static head unroll for a q/k/v/o/do kernel ref: packed ``[block, D]``
-    refs run the body once on the whole ref (``h is None``); native
-    ``[block, H, D]`` refs run it per head slice. The loop is a Python loop
-    over a STATIC bound — it unrolls at trace time, which Mosaic requires."""
-    return range(ref.shape[1]) if ref.ndim == 3 else (None,)
+def _ref_heads(heads):
+    """Static head unroll: packed kernels (``heads=None``) run the body once on
+    the whole ref (``h is None``); native-flat kernels run it per head. A
+    Python loop over a STATIC bound — it unrolls at trace time, which Mosaic
+    requires."""
+    return range(heads) if heads else (None,)
 
 
-def _hslice(ref, h):
-    """Per-head ``[block, D]`` view of an operand ref (identity when packed)."""
-    return ref[:] if h is None else ref[:, h, :]
+def _hslice(ref, h, d):
+    """Per-head ``[block, D]`` LANE slice of a ``[block, H·D]`` operand ref
+    (identity when packed)."""
+    return ref[:] if h is None else ref[:, h * d:(h + 1) * d]
 
 
 def _stat_col(ref, h):
     """``[bq, 1]`` statistics column from an lse/delta ref (``[1, 1, block]``
-    packed, ``[H, 1, 1, block]`` native)."""
+    packed, ``[H, 1, 1, block]`` native-flat)."""
     row = ref[0] if h is None else ref[h, 0]
     return jnp.transpose(row)
 
@@ -428,18 +420,20 @@ def _banded(window: int, causal: bool, nq: int, block: int) -> bool:
 
 
 def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
-                band_base=None, window=0, q_offset=0, dyn_offset=False):
+                band_base=None, window=0, q_offset=0, dyn_offset=False,
+                heads=None, head_dim=None):
     # ``dyn_offset``: the hop offset arrives as a TRACED int32 scalar via scalar
     # prefetch (the first operand) instead of the static ``q_offset`` — the
     # zig-zag schedules' chunk-pair offsets are device-dependent. r5: scalar-
     # prefetch index maps let the SAME traced offset steer a banded walk
     # (``band_base`` set), so dynamic windowed callers no longer pay the full
     # O((S/block)²) grid.
-    # Layouts: packed refs are [block, D] with [block, ...] scratch; native refs
-    # are [block, H, D] with head-LEADING [H, block, ...] scratch, and the body
-    # unrolls a static head loop (``_ref_heads``). The visibility mask depends
-    # only on (query block, key block) positions, so it is hoisted out of the
-    # head loop.
+    # Layouts: packed refs are [block, D] with [block, ...] scratch; native-flat
+    # refs are [block, H·D] with head-LEADING [H, block, ...] scratch, and the
+    # body unrolls a static head loop over per-head LANE slices (``_ref_heads``
+    # / ``_hslice``; ``heads``/``head_dim`` are static partial args). The
+    # visibility mask depends only on (query block, key block) positions, so it
+    # is hoisted out of the head loop.
     if dyn_offset:
         off_ref, refs = refs[0], refs[1:]
         q_offset = off_ref[0]
@@ -470,9 +464,9 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
         visible = (_visibility_mask(iq, j, bq, k_ref.shape[0], causal=causal,
                                     window=window, q_offset=q_offset)
                    if masked else None)
-        for h in _ref_heads(q_ref):
-            q = _hslice(q_ref, h)                                          # [bq, D]
-            k_blk = _hslice(k_ref, h)                                      # [bk, D]
+        for h in _ref_heads(heads):
+            q = _hslice(q_ref, h, head_dim)                                # [bq, D]
+            k_blk = _hslice(k_ref, h, head_dim)                            # [bk, D]
             s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
             if masked:
@@ -485,7 +479,7 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
             if masked:
                 p = jnp.where(visible, p, 0.0)
             corr = jnp.exp(m - m_new)
-            v_blk = _hslice(v_ref, h)
+            v_blk = _hslice(v_ref, h, head_dim)
             acc = acc_ref[:] if h is None else acc_ref[h]
             acc_new = acc * corr + jnp.dot(p.astype(v_blk.dtype), v_blk,
                                            preferred_element_type=jnp.float32)
@@ -504,7 +498,7 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
 
     @pl.when(step == num_steps - 1)
     def _():
-        for h in _ref_heads(q_ref):
+        for h in _ref_heads(heads):
             l_cur = l_ref[:] if h is None else l_ref[h]
             l_safe = jnp.where(l_cur == 0.0, 1.0, l_cur)
             acc = acc_ref[:] if h is None else acc_ref[h]
@@ -514,15 +508,17 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
                 o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
                 lse_ref[:] = lse.reshape(1, 1, bq)
             else:
-                o_ref[:, h, :] = (acc / l_safe).astype(o_ref.dtype)
+                o_ref[:, h * head_dim:(h + 1) * head_dim] = (
+                    acc / l_safe).astype(o_ref.dtype)
                 lse_ref[h] = lse.reshape(1, 1, bq)
 
 
 def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
-                   window: int = 0, q_offset: int = 0, q_offset_dyn=None):
-    """Packed [BH, S, D]³ → (out [BH, S, D], lse [BH, S/block, 1, block]), or
-    native [B, S, H, D]³ → (out [B, S, H, D], lse [B, H, S/block, 1, block]) —
-    the layout is read off the operand rank (``_GridLayout``).
+                   window: int = 0, q_offset: int = 0, q_offset_dyn=None,
+                   heads: int | None = None):
+    """Packed [BH, S, D]³ → (out [BH, S, D], lse [BH, S/block, 1, block]), or —
+    with ``heads=H`` — native-flat [B, S, H·D]³ → (out [B, S, H·D],
+    lse [B, H, S/block, 1, block]) (``_GridLayout``).
     ``q_offset`` (static, a multiple of ``block``) shifts query positions globally
     relative to the keys — the ring hop offset (see ``_visibility_mask``).
     ``q_offset_dyn`` (a traced int32 scalar, mutually exclusive with a nonzero
@@ -534,8 +530,13 @@ def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
     offset need NOT be block-quantized: the dynamic band is one block wider
     (``_dyn_band_reach``) to absorb the sub-block remainder its floor-division
     steering discards."""
-    s, d = qx.shape[1], qx.shape[-1]
-    lay = _GridLayout(qx.shape, block)
+    s = qx.shape[1]
+    if heads and qx.shape[-1] % heads:
+        raise ValueError(
+            f"native-flat operands need last dim divisible by heads, got "
+            f"{qx.shape[-1]} % {heads}")
+    d = qx.shape[-1] // (heads or 1)       # per-head width sets the softmax scale
+    lay = _GridLayout(qx.shape, block, heads)
     _check_block(s, block)
     _check_offset(q_offset, block)
     dyn = q_offset_dyn is not None
@@ -568,7 +569,8 @@ def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
             key_idx = lambda i, j, *_: j
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_steps=num_steps, num_blocks=nq, band_base=base,
-                               window=window, q_offset=q_offset, dyn_offset=dyn)
+                               window=window, q_offset=q_offset, dyn_offset=dyn,
+                               heads=heads, head_dim=d)
     in_specs = [
         lay.row_spec(prefetch=dyn),
         lay.walk_spec(key_idx, prefetch=dyn),
@@ -602,7 +604,8 @@ def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
 
 
 def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
-               band_base=None, window=0, q_offset=0, dyn_offset=False):
+               band_base=None, window=0, q_offset=0, dyn_offset=False,
+               heads=None, head_dim=None):
     if dyn_offset:                      # traced hop offset (see _fwd_kernel)
         off_ref, refs = refs[0], refs[1:]
         q_offset = off_ref[0]
@@ -628,13 +631,13 @@ def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
         visible = (_visibility_mask(iq, j, bq, k_ref.shape[0], causal=causal,
                                     window=window, q_offset=q_offset)
                    if masked else None)
-        for h in _ref_heads(q_ref):
-            q = _hslice(q_ref, h)                                 # [bq, D]
-            do = _hslice(do_ref, h)                               # [bq, D]
+        for h in _ref_heads(heads):
+            q = _hslice(q_ref, h, head_dim)                       # [bq, D]
+            do = _hslice(do_ref, h, head_dim)                     # [bq, D]
             lse = _stat_col(lse_ref, h)                           # [bq, 1]
             delta = _stat_col(delta_ref, h)                       # [bq, 1]
-            k_blk = _hslice(k_ref, h)
-            v_blk = _hslice(v_ref, h)
+            k_blk = _hslice(k_ref, h, head_dim)
+            v_blk = _hslice(v_ref, h, head_dim)
             s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
             if masked:
@@ -657,15 +660,17 @@ def _dq_kernel(*refs, scale, causal, num_steps, num_blocks,
 
     @pl.when(step == num_steps - 1)
     def _():
-        for h in _ref_heads(q_ref):
+        for h in _ref_heads(heads):
             if h is None:
                 dq_ref[:] = (dq_acc_ref[:] * scale).astype(dq_ref.dtype)
             else:
-                dq_ref[:, h, :] = (dq_acc_ref[h] * scale).astype(dq_ref.dtype)
+                dq_ref[:, h * head_dim:(h + 1) * head_dim] = (
+                    dq_acc_ref[h] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
-                band_base=None, window=0, q_offset=0, dyn_offset=False):
+                band_base=None, window=0, q_offset=0, dyn_offset=False,
+                heads=None, head_dim=None):
     if dyn_offset:                      # traced hop offset (see _fwd_kernel)
         off_ref, refs = refs[0], refs[1:]
         q_offset = off_ref[0]
@@ -696,11 +701,11 @@ def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
         visible = (_visibility_mask(i, ik, q_ref.shape[0], bk, causal=causal,
                                     window=window, q_offset=q_offset)
                    if masked else None)
-        for h in _ref_heads(q_ref):
-            k = _hslice(k_ref, h)                                 # [bk, D]
-            v = _hslice(v_ref, h)                                 # [bk, D]
-            q_blk = _hslice(q_ref, h)                             # [bq, D]
-            do_blk = _hslice(do_ref, h)
+        for h in _ref_heads(heads):
+            k = _hslice(k_ref, h, head_dim)                       # [bk, D]
+            v = _hslice(v_ref, h, head_dim)                       # [bk, D]
+            q_blk = _hslice(q_ref, h, head_dim)                   # [bq, D]
+            do_blk = _hslice(do_ref, h, head_dim)
             lse_blk = _stat_col(lse_ref, h)                       # [bq, 1]
             delta_blk = _stat_col(delta_ref, h)                   # [bq, 1]
             s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
@@ -734,44 +739,47 @@ def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
 
     @pl.when(step == num_steps - 1)
     def _():
-        for h in _ref_heads(q_ref):
+        for h in _ref_heads(heads):
             if h is None:
                 dk_ref[:] = (dk_acc_ref[:] * scale).astype(dk_ref.dtype)
                 dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
             else:
-                dk_ref[:, h, :] = (dk_acc_ref[h] * scale).astype(dk_ref.dtype)
-                dv_ref[:, h, :] = dv_acc_ref[h].astype(dv_ref.dtype)
+                sl = slice(h * head_dim, (h + 1) * head_dim)
+                dk_ref[:, sl] = (dk_acc_ref[h] * scale).astype(dk_ref.dtype)
+                dv_ref[:, sl] = dv_acc_ref[h].astype(dv_ref.dtype)
 
 
 def _flash_backward(res, g, *, causal: bool, block: int = BLOCK,
-                    window: int = 0):
+                    window: int = 0, heads: int | None = None):
     qx, kx, vx, out, lse = res
-    s = qx.shape[1]
+    gsz, s = qx.shape[0], qx.shape[1]
     nq = s // block
-    # Δ = rowsum(dout ∘ out), reshaped to the lse layout — XLA fuses this small
-    # pass (and in the native layout the [G,S,H]→[G,H,S] permute is D-free, so it
-    # is ~1/D the size of the operand repacks the layout removed).
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    if qx.ndim == 4:
-        gsz, _, hh, _ = qx.shape
-        delta = jnp.transpose(delta, (0, 2, 1)).reshape(gsz, hh, nq, 1, block)
+    # Δ = rowsum(dout ∘ out) PER HEAD, reshaped to the lse layout — XLA fuses
+    # this small pass (and in the native-flat layout the [G,S,H]→[G,H,S]
+    # permute is D-free, so it is ~1/D the size of the operand repacks the
+    # layout removed).
+    prod = g.astype(jnp.float32) * out.astype(jnp.float32)
+    if heads:
+        delta = jnp.sum(prod.reshape(gsz, s, heads, -1), axis=-1)  # [G, S, H]
+        delta = jnp.transpose(delta, (0, 2, 1)).reshape(gsz, heads, nq, 1, block)
     else:
-        delta = delta.reshape(qx.shape[0], nq, 1, block)
+        delta = jnp.sum(prod, axis=-1).reshape(gsz, nq, 1, block)
     return flash_backward_blocks(qx, kx, vx, g, lse, delta, causal=causal,
-                                 block=block, window=window)
+                                 block=block, window=window, heads=heads)
 
 
 def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
                           block: int = BLOCK, window: int = 0,
-                          q_offset: int = 0, q_offset_dyn=None):
+                          q_offset: int = 0, q_offset_dyn=None,
+                          heads: int | None = None):
     """One flash-backward pass of a query-block set against a key/value-block set,
     given the GLOBAL softmax statistics: ``(dq, dk, dv)`` contributions.
 
     Packed layout (the ring schedules' shard form): ``qx/g: [BH, Sq, D]``,
     ``kx/vx: [BH, Sk, D]`` with ``Sq == Sk``, ``lse/delta: [BH, Sq/BLOCK, 1,
-    BLOCK]``. Native layout (the model form, no transpose repacks):
-    ``[B, S, H, D]`` operands with ``lse/delta: [B, H, S/BLOCK, 1, BLOCK]`` —
-    selected by operand rank. The statistics are of the FULL attention row (all
+    BLOCK]``. Native-flat layout (the model form viewed ``[B, S, H·D]``, no
+    transpose repacks — ``heads=H``): ``lse/delta: [B, H, S/BLOCK, 1, BLOCK]``.
+    The statistics are of the FULL attention row (all
     keys, not just this block set): ``p = exp(q·kᵀ·scale − lse)`` then yields the
     true softmax coefficients restricted to these keys, so the returned
     contributions sum exactly over block sets — the per-hop building block of the
@@ -779,12 +787,17 @@ def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
     where dk/dv ride the ring with their K/V blocks. ``causal=True`` masks with
     LOCAL block indices, i.e. it assumes q and k share a global origin — ring
     callers use it only for the diagonal hop."""
-    s, d = qx.shape[1], qx.shape[-1]
+    s = qx.shape[1]
+    if heads and qx.shape[-1] % heads:
+        raise ValueError(
+            f"native-flat operands need last dim divisible by heads, got "
+            f"{qx.shape[-1]} % {heads}")
+    d = qx.shape[-1] // (heads or 1)       # per-head width sets the softmax scale
     if kx.shape != qx.shape:
         raise ValueError(
             f"flash_backward_blocks needs equal q/k block sets, got {qx.shape} vs "
             f"{kx.shape}")
-    lay = _GridLayout(qx.shape, block)
+    lay = _GridLayout(qx.shape, block, heads)
     _check_block(s, block)
     _check_offset(q_offset, block)
     dyn = q_offset_dyn is not None
@@ -840,7 +853,7 @@ def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
         kernel = functools.partial(kernel_fn, scale=scale, causal=causal,
                                    num_steps=steps, num_blocks=nq, band_base=base,
                                    window=window, q_offset=q_offset,
-                                   dyn_offset=dyn)
+                                   dyn_offset=dyn, heads=heads, head_dim=d)
         return _pallas_dispatch(kernel, lay, nq, steps, in_specs, out_specs,
                                 out_shape, scratch, dyn)(
             *dyn_args, qx, kx, vx, g, lse, delta)
@@ -870,20 +883,22 @@ def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
 
 
 @functools.lru_cache(maxsize=None)
-def _make_op(causal: bool, block: int = BLOCK, window: int = 0):
+def _make_op(causal: bool, block: int = BLOCK, window: int = 0,
+             heads: int | None = None):
     @jax.custom_vjp
     def op(q3, k3, v3):
         out, _ = _flash_forward(q3, k3, v3, causal=causal, block=block,
-                                window=window)
+                                window=window, heads=heads)
         return out
 
     def fwd(q3, k3, v3):
         out, lse = _flash_forward(q3, k3, v3, causal=causal, block=block,
-                                  window=window)
+                                  window=window, heads=heads)
         return out, (q3, k3, v3, out, lse)
 
     def bwd(res, g):
-        return _flash_backward(res, g, causal=causal, block=block, window=window)
+        return _flash_backward(res, g, causal=causal, block=block,
+                               window=window, heads=heads)
 
     op.defvjp(fwd, bwd)
     return op
@@ -911,8 +926,8 @@ def _native_layout_default() -> bool:
     layout directly (no transpose repacks) instead of packing to [BH, S, D].
     Opt-in via ``FLASH_NATIVE_LAYOUT=1`` until a hardware capture picks the
     winner: the native path deletes the repack copies (11% of the r4 large
-    transformer step) but its in-kernel per-head slices of ``[block, H, D]``
-    refs cost sublane relayouts only the chip can price."""
+    transformer step) but its in-kernel per-head lane slices of
+    ``[block, H·D]`` refs cost lane relayouts only the chip can price."""
     return os.environ.get("FLASH_NATIVE_LAYOUT", "0").strip().lower() in (
         "1", "true", "yes", "on")
 
@@ -930,8 +945,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     (numerics are block-invariant — pinned in tests); tune it with
     ``bench_attention.py --block``. ``native_layout`` (default: the
     ``FLASH_NATIVE_LAYOUT`` env knob) skips the [B,S,H,D]↔[BH,S,D] repacks,
-    feeding the kernels all-heads blocks with a static head unroll
-    (``_GridLayout``); its auto-block caps block·H (``NATIVE_BLOCK_ROWS``).
+    feeding the kernels the flat [B,S,H·D] view with a static head unroll over
+    lane slices (``_GridLayout``); its auto-block caps block·H·D
+    (``NATIVE_BLOCK_ELEMS``).
 
     ``window=W`` is sliding-window/local attention with ``full_attention``'s exact
     semantics (distance < W; causal restricts to the past side) — and a BANDED grid:
@@ -945,21 +961,25 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         native_layout = _native_layout_default()
     if block is None:
         block = auto_block(s, int(window or 0),
-                           heads=h if native_layout else None)
-    elif native_layout and block * h > NATIVE_BLOCK_ROWS:
+                           native_hd=h * d if native_layout else None)
+    elif native_layout and block * h * d > NATIVE_BLOCK_ELEMS:
         # Explicit blocks get the same VMEM envelope the auto path respects:
-        # native blocks hold all H heads, so block·H is the real working-set
-        # knob and oversizing it is a Mosaic scoped-vmem compile failure on
-        # chip, not a perf tradeoff.
+        # native-flat blocks hold all H heads, so block·H·D is the real
+        # working-set knob and oversizing it is a Mosaic scoped-vmem compile
+        # failure on chip, not a perf tradeoff.
         raise ValueError(
-            f"native-layout flash needs block*heads <= {NATIVE_BLOCK_ROWS} "
-            f"(got block={block} * heads={h} = {block * h}); pass a smaller "
-            f"block or use the packed layout")
+            f"native-layout flash needs block*heads*head_dim <= "
+            f"{NATIVE_BLOCK_ELEMS} (got {block}*{h}*{d} = {block * h * d}); "
+            f"pass a smaller block or use the packed layout")
     _check_block(s, block)
     validate_window(window)
-    op = _make_op(bool(causal), int(block), int(window or 0))
     if native_layout:
-        return op(q, k, v)
+        # [B, S, H, D] → [B, S, H·D] is a free contiguous view (the repack the
+        # packed path pays is the S↔H transpose below, not this reshape).
+        op = _make_op(bool(causal), int(block), int(window or 0), heads=h)
+        return op(q.reshape(b, s, h * d), k.reshape(b, s, h * d),
+                  v.reshape(b, s, h * d)).reshape(b, s, h, d)
+    op = _make_op(bool(causal), int(block), int(window or 0))
     to3 = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
     out3 = op(to3(q), to3(k), to3(v))
     return jnp.transpose(out3.reshape(b, h, s, d), (0, 2, 1, 3))

@@ -430,8 +430,8 @@ def test_dyn_offset_needs_no_block_quantization():
 
 @pytest.mark.slow
 def test_dyn_offset_native_layout_forward():
-    """The 4-d (native-layout) specs compose with scalar prefetch too: a traced
-    offset over [B, S, H, D] operands equals the packed dynamic path."""
+    """The native-flat specs compose with scalar prefetch too: a traced offset
+    over the [B, S, H·D] view (``heads=h``) equals the packed dynamic path."""
     from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
         _flash_forward,
     )
@@ -441,14 +441,16 @@ def test_dyn_offset_native_layout_forward():
     q4, k4, v4 = (jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
                   for _ in range(3))
     pack = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
-    out4, lse5 = jax.jit(lambda off: _flash_forward(
-        q4, k4, v4, causal=False, window=window, q_offset_dyn=off))(
-        jnp.int32(256))
+    flat = lambda x: x.reshape(b, s, h * d)
+    outf, lse5 = jax.jit(lambda off: _flash_forward(
+        flat(q4), flat(k4), flat(v4), causal=False, window=window,
+        q_offset_dyn=off, heads=h))(jnp.int32(256))
     out3, lse4 = jax.jit(lambda off: _flash_forward(
         pack(q4), pack(k4), pack(v4), causal=False, window=window,
         q_offset_dyn=off))(jnp.int32(256))
-    np.testing.assert_allclose(np.asarray(pack(out4)), np.asarray(out3),
-                               **_tol(1e-6, 1e-6))
+    np.testing.assert_allclose(
+        np.asarray(pack(outf.reshape(b, s, h, d))), np.asarray(out3),
+        **_tol(1e-6, 1e-6))
     np.testing.assert_allclose(
         np.asarray(lse5.reshape(b * h, *lse4.shape[1:])), np.asarray(lse4),
         **_tol(1e-6, 1e-6))
