@@ -8,12 +8,15 @@ exists. This is the intra-chip complement of the cross-chip ring attention in
 
 Kernel layout (FlashAttention-2 style, in the canonical Pallas-TPU grid formulation):
 
-- **Forward**: grid ``(B·H, S/BLOCK, S/BLOCK)`` in the packed ``[BH, S, D]`` layout, or
-  ``(B, S/BLOCK, S/BLOCK)`` with all-heads blocks ``[BLOCK, H·D]`` and a static head
-  unroll over per-head LANE slices in the native-flat layout (``_GridLayout``, r5 —
-  the model's ``[B, S, H, D]`` viewed flat, a free reshape, no transpose repacks;
-  Mosaic's last-two-dims tiling rules out a per-head grid axis, and sublane-sliced
-  bf16 operands crash its ``dot`` lowering, so heads ride the lane dim) — the innermost
+- **Forward**: grid ``(B·H, S/BLOCK, S/BLOCK)`` in the packed ``[BH, S, D]`` layout; or,
+  for the native layouts that feed the model's ``[B, S, H, D]`` viewed flat (a free
+  reshape, no transpose repacks — ``_GridLayout``, r5): native-STRIDED at D%128==0
+  (the same packed grid and kernel bodies, with D-wide LANE-BLOCK index maps
+  ``(g//H, walk, g%H)`` addressing the flat operands) or native-UNROLL otherwise
+  (grid ``(B, S/BLOCK, S/BLOCK)``, all-heads blocks ``[BLOCK, H·D]``, a static head
+  unroll over per-head lane slices — Mosaic's last-two-dims tiling rules out a
+  per-head grid axis on rank-4 blocks, and sublane-sliced bf16 operands crash its
+  ``dot`` lowering, so heads ride the lane dim) — the innermost
   (fastest-varying) axis walks K/V blocks while the query block and the online-softmax
   accumulators ``(acc, m, l)`` persist in **VMEM scratch** across those steps
   (``@pl.when`` on the first/last K/V step initializes/finalizes them). Streaming and
@@ -253,13 +256,39 @@ class _GridLayout:
     grid ``(prefix, nq, steps)``, query-block axis at program_id(1), K/V-walk
     axis at program_id(2) — and differ only in the kernels' static head unroll
     (``_ref_heads``) and the lse spec, whose ``(1, block)`` trailing block dims
-    equal the array's (tiling-legal by equality)."""
+    equal the array's (tiling-legal by equality).
 
-    def __init__(self, shape, block: int, heads: int | None = None):
+    When the head width is a whole number of 128-lane registers
+    (``D % 128 == 0``), ``per_head_grid=True`` selects a third form —
+    native-STRIDED: the same
+    flat ``[B, S, H·D]`` operands, but D-wide LANE BLOCKS addressed by index
+    maps ``(g // H, walk, g % H)`` on the packed ``(B·H, nq, steps)`` grid.
+    Kernels run their packed bodies (``heads=None`` — no unroll), refs are
+    ``[block, D]``, the lse keeps the packed ``[B·H, nq, 1, block]`` shape,
+    and VMEM per block matches the packed path — so the full measured
+    ``MAX_AUTO_BLOCK`` applies, not the all-heads ``NATIVE_BLOCK_ELEMS``
+    envelope. Zero repacks at packed-kernel efficiency; the price is a
+    D-strided HBM access pattern the grid pipeline overlaps."""
+
+    def __init__(self, shape, block: int, heads: int | None = None,
+                 per_head_grid: bool = False):
         bh, s, last = shape
-        self.block, self.s, self.heads = block, s, heads
-        self.prefix = (bh,)
-        self.hd = last                       # D packed, H·D native-flat
+        self.block, self.s = block, s
+        self.per_head_grid = per_head_grid
+        if per_head_grid:
+            if not heads or last % heads:
+                raise ValueError(
+                    f"per_head_grid needs heads dividing the flat width, got "
+                    f"{heads} over {last}")
+            self.heads = None              # kernels run their packed bodies
+            self.gh = heads                # grid-folded head count
+            self.prefix = (bh * heads,)
+            self.hd = last // heads        # per-head lane-block width
+        else:
+            self.heads = heads
+            self.gh = None
+            self.prefix = (bh,)
+            self.hd = last                 # D packed, H·D native-flat
 
     def grid(self, nq: int, steps: int) -> tuple:
         return self.prefix + (nq, steps)
@@ -268,7 +297,19 @@ class _GridLayout:
         """``idx_fn(i, j, *scalars)`` → S-block index. With ``prefetch`` the maps
         take the scalar-prefetch ref as a trailing arg (the
         ``PrefetchScalarGridSpec`` convention) — how a TRACED hop offset steers
-        a banded walk (r5; previously dynamic offsets forced the full walk)."""
+        a banded walk (r5; previously dynamic offsets forced the full walk).
+        Strided form: the grid's bh axis decomposes as (batch, head), and the
+        head picks the D-wide lane block of the flat operand."""
+        if self.per_head_grid:
+            gh = self.gh
+            if prefetch:
+                return pl.BlockSpec(
+                    (None, self.block, self.hd),
+                    lambda g, i, j, off: (g // gh, idx_fn(i, j, off), g % gh),
+                    memory_space=pltpu.VMEM)
+            return pl.BlockSpec((None, self.block, self.hd),
+                                lambda g, i, j: (g // gh, idx_fn(i, j), g % gh),
+                                memory_space=pltpu.VMEM)
         if prefetch:
             return pl.BlockSpec((None, self.block, self.hd),
                                 lambda b, i, j, off: (b, idx_fn(i, j, off), 0),
@@ -313,6 +354,9 @@ class _GridLayout:
         return self.prefix + (nq, 1, self.block)
 
     def out_shape(self, dtype):
+        if self.per_head_grid:        # the array stays flat [B, S, H·D]
+            return jax.ShapeDtypeStruct(
+                (self.prefix[0] // self.gh, self.s, self.hd * self.gh), dtype)
         return jax.ShapeDtypeStruct((self.prefix[0], self.s, self.hd), dtype)
 
     def acc(self, width: int):
@@ -515,10 +559,12 @@ def _fwd_kernel(*refs, scale, causal, num_steps, num_blocks,
 
 def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
                    window: int = 0, q_offset: int = 0, q_offset_dyn=None,
-                   heads: int | None = None):
+                   heads: int | None = None, per_head_grid: bool = False):
     """Packed [BH, S, D]³ → (out [BH, S, D], lse [BH, S/block, 1, block]), or —
     with ``heads=H`` — native-flat [B, S, H·D]³ → (out [B, S, H·D],
-    lse [B, H, S/block, 1, block]) (``_GridLayout``).
+    lse [B, H, S/block, 1, block]); ``per_head_grid`` selects the
+    native-STRIDED form (packed grid + lane blocks over the flat operands,
+    packed-shape lse [B·H, S/block, 1, block]) (``_GridLayout``).
     ``q_offset`` (static, a multiple of ``block``) shifts query positions globally
     relative to the keys — the ring hop offset (see ``_visibility_mask``).
     ``q_offset_dyn`` (a traced int32 scalar, mutually exclusive with a nonzero
@@ -536,7 +582,8 @@ def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
             f"native-flat operands need last dim divisible by heads, got "
             f"{qx.shape[-1]} % {heads}")
     d = qx.shape[-1] // (heads or 1)       # per-head width sets the softmax scale
-    lay = _GridLayout(qx.shape, block, heads)
+    lay = _GridLayout(qx.shape, block, heads, per_head_grid=per_head_grid)
+    unroll_heads = None if per_head_grid else heads
     _check_block(s, block)
     _check_offset(q_offset, block)
     dyn = q_offset_dyn is not None
@@ -570,7 +617,7 @@ def _flash_forward(qx, kx, vx, *, causal: bool, block: int = BLOCK,
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                num_steps=num_steps, num_blocks=nq, band_base=base,
                                window=window, q_offset=q_offset, dyn_offset=dyn,
-                               heads=heads, head_dim=d)
+                               heads=unroll_heads, head_dim=d)
     in_specs = [
         lay.row_spec(prefetch=dyn),
         lay.walk_spec(key_idx, prefetch=dyn),
@@ -750,28 +797,35 @@ def _dkv_kernel(*refs, scale, causal, num_steps, num_blocks,
 
 
 def _flash_backward(res, g, *, causal: bool, block: int = BLOCK,
-                    window: int = 0, heads: int | None = None):
+                    window: int = 0, heads: int | None = None,
+                    per_head_grid: bool = False):
     qx, kx, vx, out, lse = res
     gsz, s = qx.shape[0], qx.shape[1]
     nq = s // block
     # Δ = rowsum(dout ∘ out) PER HEAD, reshaped to the lse layout — XLA fuses
-    # this small pass (and in the native-flat layout the [G,S,H]→[G,H,S]
-    # permute is D-free, so it is ~1/D the size of the operand repacks the
-    # layout removed).
+    # this small pass (and in the native layouts the [G,S,H]→[G,H,S] permute is
+    # D-free, so it is ~1/D the size of the operand repacks the layouts
+    # removed).
     prod = g.astype(jnp.float32) * out.astype(jnp.float32)
     if heads:
         delta = jnp.sum(prod.reshape(gsz, s, heads, -1), axis=-1)  # [G, S, H]
-        delta = jnp.transpose(delta, (0, 2, 1)).reshape(gsz, heads, nq, 1, block)
+        delta = jnp.transpose(delta, (0, 2, 1))                    # [G, H, S]
+        if per_head_grid:   # packed-shape statistics on the folded (B·H) axis
+            delta = delta.reshape(gsz * heads, nq, 1, block)
+        else:
+            delta = delta.reshape(gsz, heads, nq, 1, block)
     else:
         delta = jnp.sum(prod, axis=-1).reshape(gsz, nq, 1, block)
     return flash_backward_blocks(qx, kx, vx, g, lse, delta, causal=causal,
-                                 block=block, window=window, heads=heads)
+                                 block=block, window=window, heads=heads,
+                                 per_head_grid=per_head_grid)
 
 
 def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
                           block: int = BLOCK, window: int = 0,
                           q_offset: int = 0, q_offset_dyn=None,
-                          heads: int | None = None):
+                          heads: int | None = None,
+                          per_head_grid: bool = False):
     """One flash-backward pass of a query-block set against a key/value-block set,
     given the GLOBAL softmax statistics: ``(dq, dk, dv)`` contributions.
 
@@ -797,7 +851,8 @@ def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
         raise ValueError(
             f"flash_backward_blocks needs equal q/k block sets, got {qx.shape} vs "
             f"{kx.shape}")
-    lay = _GridLayout(qx.shape, block, heads)
+    lay = _GridLayout(qx.shape, block, heads, per_head_grid=per_head_grid)
+    unroll_heads = None if per_head_grid else heads
     _check_block(s, block)
     _check_offset(q_offset, block)
     dyn = q_offset_dyn is not None
@@ -853,7 +908,8 @@ def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
         kernel = functools.partial(kernel_fn, scale=scale, causal=causal,
                                    num_steps=steps, num_blocks=nq, band_base=base,
                                    window=window, q_offset=q_offset,
-                                   dyn_offset=dyn, heads=heads, head_dim=d)
+                                   dyn_offset=dyn, heads=unroll_heads,
+                                   head_dim=d)
         return _pallas_dispatch(kernel, lay, nq, steps, in_specs, out_specs,
                                 out_shape, scratch, dyn)(
             *dyn_args, qx, kx, vx, g, lse, delta)
@@ -884,21 +940,24 @@ def flash_backward_blocks(qx, kx, vx, g, lse, delta, *, causal: bool,
 
 @functools.lru_cache(maxsize=None)
 def _make_op(causal: bool, block: int = BLOCK, window: int = 0,
-             heads: int | None = None):
+             heads: int | None = None, per_head_grid: bool = False):
     @jax.custom_vjp
     def op(q3, k3, v3):
         out, _ = _flash_forward(q3, k3, v3, causal=causal, block=block,
-                                window=window, heads=heads)
+                                window=window, heads=heads,
+                                per_head_grid=per_head_grid)
         return out
 
     def fwd(q3, k3, v3):
         out, lse = _flash_forward(q3, k3, v3, causal=causal, block=block,
-                                  window=window, heads=heads)
+                                  window=window, heads=heads,
+                                  per_head_grid=per_head_grid)
         return out, (q3, k3, v3, out, lse)
 
     def bwd(res, g):
         return _flash_backward(res, g, causal=causal, block=block,
-                               window=window, heads=heads)
+                               window=window, heads=heads,
+                               per_head_grid=per_head_grid)
 
     op.defvjp(fwd, bwd)
     return op
@@ -919,6 +978,20 @@ def flash_forward_with_lse(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
     """
     return _flash_forward(q3, k3, v3, causal=causal, window=window,
                           q_offset=q_offset, q_offset_dyn=q_offset_dyn)
+
+
+def native_mode(head_dim: int) -> str:
+    """Which native-layout form a given head width gets: ``"strided"`` (packed
+    grid + D-wide lane blocks over the flat operands — packed-kernel
+    efficiency, zero repacks) when D is a whole number of 128-lane registers
+    (``D % 128 == 0``), else ``"unroll"`` (all-heads blocks + static head
+    unroll, the only form Mosaic accepts at sub-register head widths).
+    ``FLASH_NATIVE_MODE=unroll`` forces the unroll form everywhere — a
+    measurement knob for pricing the two."""
+    if head_dim % 128 == 0 and os.environ.get(
+            "FLASH_NATIVE_MODE", "").strip().lower() != "unroll":
+        return "strided"
+    return "unroll"
 
 
 def _native_layout_default() -> bool:
@@ -959,10 +1032,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     b, s, h, d = q.shape
     if native_layout is None:
         native_layout = _native_layout_default()
+    strided = native_layout and native_mode(d) == "strided"
     if block is None:
+        # The strided form keeps packed-size [block, D] refs, so it takes the
+        # packed caps; only the all-heads unroll form pays the block·H·D
+        # envelope.
         block = auto_block(s, int(window or 0),
-                           native_hd=h * d if native_layout else None)
-    elif native_layout and block * h * d > NATIVE_BLOCK_ELEMS:
+                           native_hd=h * d if native_layout and not strided
+                           else None)
+    elif native_layout and not strided and block * h * d > NATIVE_BLOCK_ELEMS:
         # Explicit blocks get the same VMEM envelope the auto path respects:
         # native-flat blocks hold all H heads, so block·H·D is the real
         # working-set knob and oversizing it is a Mosaic scoped-vmem compile
@@ -976,7 +1054,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if native_layout:
         # [B, S, H, D] → [B, S, H·D] is a free contiguous view (the repack the
         # packed path pays is the S↔H transpose below, not this reshape).
-        op = _make_op(bool(causal), int(block), int(window or 0), heads=h)
+        op = _make_op(bool(causal), int(block), int(window or 0), heads=h,
+                      per_head_grid=strided)
         return op(q.reshape(b, s, h * d), k.reshape(b, s, h * d),
                   v.reshape(b, s, h * d)).reshape(b, s, h, d)
     op = _make_op(bool(causal), int(block), int(window or 0))

@@ -377,6 +377,67 @@ def test_native_layout_is_numerics_invariant(causal, window):
                                    err_msg=name, **_tol(2e-4, 2e-5))
 
 
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="hardware Mosaic-compile smoke (FRAMEWORK_TEST_PLATFORM=tpu)")
+def test_native_strided_on_tpu_matches_dense():
+    """Compiled-through-Mosaic parity for the STRIDED native form at the
+    trainer geometry (D=128, bf16): lane-block index maps (g//H, walk, g%H)
+    over the flat operands are chip-only constructs, and bf16 is the dtype
+    whose layout bugs interpret mode has twice failed to catch."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in _qkv(b=1, s=512, h=4, d=128,
+                                                    seed=17))
+    ref = full_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                         v.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal=True,
+                                   native_layout=True)).astype(np.float32),
+        np.asarray(ref), rtol=2e-2, atol=2e-2)
+    g = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(flash_attention(
+        q, k, v, causal=True, native_layout=True).astype(jnp.float32))),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(jnp.sin(full_attention(
+        q, k, v, causal=True))), argnums=(0, 1, 2))(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32))
+    for a, b in zip(g_ref, g):
+        np.testing.assert_allclose(np.asarray(b).astype(np.float32),
+                                   np.asarray(a), rtol=2e-2, atol=5e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("window", [None, 160])
+def test_native_strided_mode_matches_dense(causal, window):
+    """At D % 128 == 0 the native layout takes the STRIDED form — packed grid,
+    D-wide lane blocks over the flat [B, S, H·D] operands, no head unroll
+    (``native_mode``): forward AND gradients equal the dense oracle's, the
+    banded (windowed) walk index maps compose with the strided decomposition,
+    and the mode predicate picks the form exactly when the head width
+    permits."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+        native_mode,
+    )
+
+    assert native_mode(128) == "strided"
+    assert native_mode(64) == "unroll"
+    q, k, v = _qkv(b=2, s=256, h=3, d=128, seed=13)
+    ref = full_attention(q, k, v, causal=causal, window=window)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal=causal, window=window,
+                                   block=128, native_layout=True)),
+        np.asarray(ref), **_tol(2e-5, 2e-5))
+
+    def loss(attn):
+        return lambda q, k, v: jnp.sum(jnp.sin(attn(q, k, v)))
+
+    g_ref = jax.grad(loss(lambda q, k, v: full_attention(
+        q, k, v, causal=causal, window=window)), argnums=(0, 1, 2))(q, k, v)
+    g_nat = jax.grad(loss(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, window=window, block=128, native_layout=True)),
+        argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", g_ref, g_nat):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   err_msg=name, **_tol(2e-4, 2e-5))
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("q_offset", [0, 256, -256])
 def test_dyn_offset_banded_grid_matches_static(q_offset):
