@@ -158,7 +158,13 @@ def main() -> int:
         if args.window is not None:
             row["window"] = args.window
         if args.native_layout:
+            from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+                native_mode,
+            )
             row["native_layout"] = True
+            # Which native form the env knobs actually select at this head
+            # width — a capture file's name can't misstate what it timed.
+            row["native_mode"] = native_mode(d_hd)
         sweeping = args.block_sweep is not None
         blocks = (args.block_sweep if sweeping
                   else [args.block] if args.block is not None else [None])

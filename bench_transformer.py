@@ -88,14 +88,19 @@ def main(argv=None) -> int:
                         dropout_rate=0.0,
                         dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     attn_impl = "dense"
+    flash_layout = None
     if args.flash:
         from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
-            dispatch_attention, dispatch_uses_flash,
+            _native_layout_default, dispatch_attention, dispatch_uses_flash,
+            native_mode,
         )
         model_kwargs["attention_fn"] = dispatch_attention
         # Record what the dispatcher actually runs at this shape — a row labelled
-        # "flash" must not have timed the dense path.
+        # "flash" must not have timed the dense path — and which LAYOUT the env
+        # knobs select, so a capture file's name can't misstate what it timed.
         attn_impl = "flash" if dispatch_uses_flash(s) else "dense"
+        flash_layout = (f"native-{native_mode(e // args.heads)}"
+                        if _native_layout_default() else "packed")
     model = TransformerClassifier(**model_kwargs)
 
     rng = np.random.default_rng(0)
@@ -165,6 +170,7 @@ def main(argv=None) -> int:
         },
         "achieved_model_flops_per_s": round(achieved),
         "mfu_vs_bf16_peak": round(achieved / peak, 6) if peak else None,
+        "flash_layout": flash_layout,
         "final_train_loss": round(last_loss, 4),
     }))
     return 0

@@ -997,10 +997,12 @@ def native_mode(head_dim: int) -> str:
 def _native_layout_default() -> bool:
     """Whether ``flash_attention`` feeds the kernels the model's [B, S, H, D]
     layout directly (no transpose repacks) instead of packing to [BH, S, D].
-    Opt-in via ``FLASH_NATIVE_LAYOUT=1`` until a hardware capture picks the
-    winner: the native path deletes the repack copies (11% of the r4 large
-    transformer step) but its in-kernel per-head lane slices of
-    ``[block, H·D]`` refs cost lane relayouts only the chip can price."""
+    Opt-in via ``FLASH_NATIVE_LAYOUT=1``; the r5 chip captures settled the
+    default AGAINST it: deleting the repack copies (11% of the r4 large
+    transformer step) buys less than the native forms' direct access patterns
+    cost — 57.8% (strided) / 47.2% (unroll) vs packed's 59.5% MFU
+    (``bench_results/hw_r5/``). The knob stays for geometries where the
+    tradeoff may differ and for re-pricing on future hardware."""
     return os.environ.get("FLASH_NATIVE_LAYOUT", "0").strip().lower() in (
         "1", "true", "yes", "on")
 
@@ -1018,9 +1020,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     (numerics are block-invariant — pinned in tests); tune it with
     ``bench_attention.py --block``. ``native_layout`` (default: the
     ``FLASH_NATIVE_LAYOUT`` env knob) skips the [B,S,H,D]↔[BH,S,D] repacks,
-    feeding the kernels the flat [B,S,H·D] view with a static head unroll over
-    lane slices (``_GridLayout``); its auto-block caps block·H·D
-    (``NATIVE_BLOCK_ELEMS``).
+    feeding the kernels the flat [B,S,H·D] view in the form ``native_mode``
+    picks for the head width: STRIDED at D%128==0 (packed grid and caps, lane-
+    block index maps) or UNROLL otherwise (static head unroll over lane
+    slices; auto-block caps block·H·D at ``NATIVE_BLOCK_ELEMS``). Measured on
+    v5e: packed 59.5% MFU vs strided 57.8% vs unroll 47.2% at the large-
+    transformer config — the repacks are cheaper than either direct access
+    pattern, so packed stays the default (``bench_results/hw_r5/``).
 
     ``window=W`` is sliding-window/local attention with ``full_attention``'s exact
     semantics (distance < W; causal restricts to the past side) — and a BANDED grid:

@@ -125,6 +125,8 @@ def test_label_smoothing_matches_torch(smoothing):
     np.testing.assert_allclose(float(jnp.mean(per)), want, rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow  # ~10 s: torch cross-check over a full LM loss surface; the
+                   # fast tier keeps the exact-value smoothing unit pin above
 def test_lm_label_smoothing_matches_torch():
     torch = pytest.importorskip("torch")
     from csed_514_project_distributed_training_using_pytorch_tpu.models import (

@@ -405,7 +405,7 @@ def test_native_strided_on_tpu_matches_dense():
 
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("window", [None, 160])
-def test_native_strided_mode_matches_dense(causal, window):
+def test_native_strided_mode_matches_dense(causal, window, monkeypatch):
     """At D % 128 == 0 the native layout takes the STRIDED form — packed grid,
     D-wide lane blocks over the flat [B, S, H·D] operands, no head unroll
     (``native_mode``): forward AND gradients equal the dense oracle's, the
@@ -416,6 +416,9 @@ def test_native_strided_mode_matches_dense(causal, window):
         native_mode,
     )
 
+    # Self-contained against the documented measurement knob: a stray
+    # FLASH_NATIVE_MODE=unroll in the shell must not flip which form this pins.
+    monkeypatch.delenv("FLASH_NATIVE_MODE", raising=False)
     assert native_mode(128) == "strided"
     assert native_mode(64) == "unroll"
     q, k, v = _qkv(b=2, s=256, h=3, d=128, seed=13)

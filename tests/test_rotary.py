@@ -61,6 +61,8 @@ def test_rope_changes_classifier_output_same_params():
     assert not np.allclose(np.asarray(out_plain), np.asarray(out_roped))
 
 
+@pytest.mark.slow  # ~13 s: full train + KV-cache decode; the fast tier keeps
+                   # the rotation-math and cache-parity unit pins
 def test_lm_rope_decode_matches_full_forward():
     """The decode-parity invariant under RoPE (+GQA): the KV-cache path rotates its
     single position by the same formula as the teacher-forced forward."""
