@@ -84,8 +84,19 @@ MAX_AUTO_BLOCK = 1024  # r4 v5e sweep (bench_results/hw_r4/bench_attention_block
                        # VMEM/compile wall — 1024 is the measured sweet spot
 
 MAX_AUTO_BLOCK_WINDOWED = 512  # banded grids do O(S·(W+block)) work, so oversize
-                               # blocks defeat the band: b512 beats b1024 1.6× at
-                               # S=8192 W=256 on v5e (same r4 capture)
+                               # blocks defeat NARROW bands: b512 beats b1024
+                               # 1.6× at S=8192 W=256 on v5e (r4 capture). WIDE
+                               # bands amortize like the full walk — b1024 beats
+                               # b512 12-13% at W=4096, S=8192/32768 under the
+                               # r5 elision kernels (hw_r5/bench_attention_
+                               # windowtune.jsonl) — so the cap is W-dependent
+                               # (WIDE_WINDOW below)
+
+WIDE_WINDOW = 4096             # smallest window the full MAX_AUTO_BLOCK cap is
+                               # MEASURED to win at; narrower windows keep the
+                               # windowed cap (the crossover lies somewhere in
+                               # (256, 4096) — untested widths take the
+                               # conservative side)
 
 FLASH_MIN_SEQ = 2048   # measured flash/dense crossover on TPU v5e (same capture),
                        # windowed and not: dense wins 1.5-5× below (XLA keeps the
@@ -108,7 +119,8 @@ def auto_block(s: int, window: int = 0, native_hd: int | None = None) -> int:
     ``MAX_AUTO_BLOCK_WINDOWED``). ``native_hd`` (= H·D, the flat row width)
     caps the native layout's block·H·D VMEM product (``NATIVE_BLOCK_ELEMS``);
     packed callers leave it ``None``."""
-    cap = MAX_AUTO_BLOCK_WINDOWED if window else MAX_AUTO_BLOCK
+    cap = (MAX_AUTO_BLOCK_WINDOWED if 0 < window < WIDE_WINDOW
+           else MAX_AUTO_BLOCK)
     if native_hd is not None:
         if 128 * native_hd > NATIVE_BLOCK_ELEMS:
             # Even the smallest legal block would bust the measured scoped-vmem

@@ -247,6 +247,10 @@ def test_auto_block_selection():
     assert auto_block(1024) == 1024
     assert auto_block(8192) == 1024      # capped at the measured sweet spot
     assert auto_block(1280) == 256       # largest divisor under the cap
+    # Windowed cap is W-dependent (r5 hw sweeps): narrow bands keep the 512
+    # windowed cap; wide bands (W >= WIDE_WINDOW) amortize like the full walk.
+    assert auto_block(8192, window=256) == 512
+    assert auto_block(8192, window=4096) == 1024
     with pytest.raises(ValueError, match="divisible by 128"):
         auto_block(200)
 
