@@ -124,6 +124,10 @@ def measure() -> dict:
     peak = peak_flops(getattr(dev, "device_kind", "")) if dev.platform == "tpu" else None
 
     return {
+        # Telemetry event typing: the bench artifact is one "bench" event in the
+        # utils/telemetry.py schema, so tools/telemetry_report.py compares bench
+        # runs against training runs through the same reader.
+        "event": "bench",
         # A truncated functional run is labeled as such and never compared against the
         # reference's FULL-epoch time — a 16-step "epoch" beating 7.6 s means nothing.
         "metric": ("MNIST 1-epoch wall-clock (60k examples, global batch 64)"
@@ -155,6 +159,45 @@ def measure() -> dict:
         "test_accuracy_after_run": round(float(correct) / len(test_ds), 4),
         "data_source": train_ds.source,
     }
+
+
+def _sanitize_json(obj):
+    """Strict-JSONL rule (utils/telemetry.py's, duplicated because this parent
+    entry point stays jax-import-free): non-finite floats become None."""
+    import math
+
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _sanitize_json(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize_json(v) for v in obj]
+    return obj
+
+
+def _emit(payload: dict, telemetry_path: str | None) -> None:
+    """Print the one bench JSON line and (``--telemetry PATH``) append it as a
+    telemetry event — the same ``"event": "bench"`` schema the trainers' telemetry
+    files use, so ``tools/telemetry_report.py`` compares bench and training runs.
+    A diverged run's NaN serializes as null (strict JSONL), never a bare NaN token."""
+    payload.setdefault("event", "bench")
+    line = json.dumps(_sanitize_json(payload), allow_nan=False)
+    print(line)
+    if telemetry_path:
+        os.makedirs(os.path.dirname(telemetry_path) or ".", exist_ok=True)
+        with open(telemetry_path, "a") as f:
+            f.write(line + "\n")
+
+
+def _telemetry_path() -> str | None:
+    """The optional ``--telemetry PATH`` argv pair (parsed by hand: this parent
+    entry point deliberately stays argparse- and jax-import-free)."""
+    argv = sys.argv
+    if "--telemetry" in argv:
+        i = argv.index("--telemetry")
+        if i + 1 < len(argv):
+            return argv[i + 1]
+    return None
 
 
 def _parse_child_json(out: str) -> dict | None:
@@ -268,6 +311,7 @@ def _latest_hardware_capture() -> dict | None:
 
 
 def main() -> int:
+    telemetry_path = _telemetry_path()
     retry_budget = float(os.environ.get("BENCH_TPU_RETRY_SECONDS", "900"))
     attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_SECONDS", "600"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_SECONDS", "90"))
@@ -358,7 +402,7 @@ def main() -> int:
                 payload["attempts"] = attempts
                 payload["probes"] = probes
                 payload["probe_log"] = probe_log
-                print(json.dumps(payload))
+                _emit(payload, telemetry_path)
                 return 0
         else:
             tail = (err or out).strip().splitlines()
@@ -405,19 +449,20 @@ def main() -> int:
             payload["fallback_reason"] = f"tpu unavailable: {last_error}"
             if capture is not None:
                 payload["last_hardware_capture"] = capture
-            print(json.dumps(payload))
+            _emit(payload, telemetry_path)
             return 0
         err = f"unparseable CPU-fallback stdout: {out[-300:]!r}"
 
     # Even the CPU fallback failed: emit a structured, parseable error line.
-    print(json.dumps({
+    _emit({
+        "event": "bench",
         "metric": "MNIST 1-epoch wall-clock (60k examples, global batch 64)",
         "value": None, "unit": "s", "vs_baseline": None,
         "error": last_error,
         "cpu_fallback_error": (err or out).strip().splitlines()[-1:],
         "attempts": attempts, "probes": probes, "probe_log": probe_log,
         **({"last_hardware_capture": capture} if capture is not None else {}),
-    }))
+    }, telemetry_path)
     return 1
 
 

@@ -130,15 +130,23 @@ def make_optimizer(name: str, *, learning_rate: float, momentum: float,
     raise ValueError(f"unknown optimizer {name!r} — choose 'sgd' or 'adamw'")
 
 
+def global_l2_norm(tree) -> jax.Array:
+    """Global L2 norm of a pytree in f32 (torch ``clip_grad_norm_``'s norm). The ONE
+    owner of the formula — the clip below, the health-stats grad norm
+    (``train/step.py``), and the telemetry param norm (``utils/telemetry.py``) all
+    reduce through it, so they can never drift apart."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves))
+
+
 def clip_by_global_norm(grads, max_norm: float, *, eps: float = 1e-6):
     """Global-norm gradient clipping with ``torch.nn.utils.clip_grad_norm_``'s exact
     semantics (including its ``eps`` in the denominator): returns
     ``(clipped_grads, global_norm)``. Grads are scaled by
     ``min(1, max_norm / (norm + eps))`` — a no-op whenever the norm is within bounds.
     Pinned against real torch in ``tests/test_optim.py``."""
-    leaves = jax.tree_util.tree_leaves(grads)
-    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                         for g in leaves))
+    gnorm = global_l2_norm(grads)
     scale = jnp.minimum(1.0, max_norm / (gnorm + eps))
     return jax.tree_util.tree_map(lambda g: g * scale, grads), gnorm
 

@@ -1,0 +1,41 @@
+"""jax version-compatibility shims for the parallel layer.
+
+``shard_map`` moved twice across the jax versions this framework meets in the
+wild: new releases export ``jax.shard_map`` with ``check_vma=`` and
+``axis_names=`` (partial-manual axes), while the 0.4.x line ships it as
+``jax.experimental.shard_map.shard_map`` with the older ``check_rep=`` /
+``auto=`` spelling of the same two knobs. Every shard_map user in this package
+imports the one wrapper below, written against the NEW surface, so the rest of
+the codebase stays on the current idiom and version drift is handled in exactly
+one place.
+"""
+
+from __future__ import annotations
+
+try:                                    # new surface: jax.shard_map
+    from jax import shard_map as _shard_map
+    _NEW_API = True
+except ImportError:                     # jax 0.4.x: experimental, check_rep/auto
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _NEW_API = False
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              axis_names=None):
+    """``jax.shard_map`` with the new keyword surface on every supported jax.
+
+    ``axis_names`` (the manual-axis subset; None = all mesh axes manual) maps to
+    the legacy ``auto=`` complement on 0.4.x; ``check_vma`` maps to the legacy
+    ``check_rep``.
+    """
+    if _NEW_API:
+        kwargs = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs,
+                  "check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return _shard_map(f, **kwargs)
+    kwargs = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs,
+              "check_rep": check_vma}
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _shard_map(f, **kwargs)

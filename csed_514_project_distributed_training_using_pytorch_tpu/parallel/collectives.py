@@ -17,7 +17,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel._compat import (
+    shard_map,
+)
 
 
 def ring_pass(mesh: Mesh, values: jax.Array, *, axis_name: str = "data",

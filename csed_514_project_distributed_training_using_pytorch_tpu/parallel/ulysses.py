@@ -45,7 +45,9 @@ from functools import partial
 import jax
 from jax import lax
 from jax.sharding import Mesh
-from jax import shard_map
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel._compat import (
+    shard_map,
+)
 
 from csed_514_project_distributed_training_using_pytorch_tpu import ops
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel.ring_attention import (

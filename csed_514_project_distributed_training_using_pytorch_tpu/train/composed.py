@@ -37,6 +37,7 @@ This is deliberately a thin composition of the parallel/ primitives: the entire
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -82,6 +83,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.profiling import (
     maybe_profile,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+    telemetry as T,
 )
 
 def main(config: ComposedConfig = ComposedConfig(), *,
@@ -130,6 +134,11 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     else:
         mesh = make_mesh(n_mesh_devices, axis_names=axis_names,
                          axis_shape=axis_sizes)
+    if config.health_stats and not config.telemetry:
+        raise ValueError("--health-stats emits telemetry 'health' events and has no "
+                         "other output — pass --telemetry PATH too")
+    tele = T.TelemetryWriter(config.telemetry)
+    tele.emit(T.manifest_event(config, mesh=mesh, run_type="composed"))
     data_size = mesh.shape.get("data", 1)
     seq_size = mesh.shape.get("seq", 1)
     model_size = mesh.shape.get("model", 1)
@@ -371,7 +380,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                           lr_schedule=lr_schedule,
                           clip_grad_norm=config.clip_grad_norm,
                           ema_decay=config.ema_decay,
-                          label_smoothing=config.label_smoothing),
+                          label_smoothing=config.label_smoothing,
+                          health=config.health_stats),
             in_shardings=(state_sh, rep, rep, idx_sh, rep),
             out_shardings=(state_sh, rep), donate_argnums=(0,))
         param_shardings = state_sh.params
@@ -388,7 +398,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                                    lr_schedule=lr_schedule,
                                    clip_grad_norm=config.clip_grad_norm,
                                    ema_decay=config.ema_decay,
-                                   label_smoothing=config.label_smoothing)
+                                   label_smoothing=config.label_smoothing,
+                                   health=config.health_stats)
         if config.fsdp:
             # ZeRO x TP hybrid (r5): params + optimizer state shard over BOTH the
             # data axis (largest free dim) and the Megatron model axis — memory
@@ -452,11 +463,31 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     if ckpt_path:
         os.makedirs(config.results_dir, exist_ok=True)
 
+    # Compile/execute split (telemetry): AOT-compile + FLOP-price the epoch program
+    # (stage/jit path; the TP/FSDP cached-sharding wrappers have no .lower —
+    # compile_s stays null and folds into the first epoch's wall clock).
+    # Gated on the CONFIG flag, not tele.enabled: every process must take the same
+    # compile path (AOT-compiled vs jit) on a multi-host fleet.
+    compile_s = flops_per_step = None
+    if config.telemetry:
+        plan_struct = jax.ShapeDtypeStruct(
+            (steps_per_epoch, config.batch_size), np.int32)
+        compiled, aot = T.aot_compile(epoch_fn, state, train_x, train_y,
+                                      plan_struct, dropout_rng)
+        if compiled is not None:
+            epoch_fn = compiled
+            compile_s = aot["lower_s"] + aot["compile_s"]
+            if aot["flops"]:
+                flops_per_step = aot["flops"] / steps_per_epoch
+            tele.emit(T.compile_event("epoch", aot,
+                                      steps_per_call=steps_per_epoch))
+
     try:
         host_state = _run_epochs(
             config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x,
             test_y, dropout_rng, plan_spec, n_train, n_test, steps_per_epoch,
-            start_epoch, history, watch, saver, ckpt_path, to_host_standard)
+            start_epoch, history, watch, saver, ckpt_path, to_host_standard,
+            tele, compile_s, flops_per_step)
     finally:
         # Drain the write-behind queue even on an exception/signal mid-run — the
         # queued per-epoch checkpoint is the resume artifact a killed run needs,
@@ -473,13 +504,15 @@ def main(config: ComposedConfig = ComposedConfig(), *,
 
 def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x,
                 test_y, dropout_rng, plan_spec, n_train, n_test, steps_per_epoch,
-                start_epoch, history, watch, saver, ckpt_path, to_host_standard):
+                start_epoch, history, watch, saver, ckpt_path, to_host_standard,
+                tele, compile_s, flops_per_step):
     """The composed trainer's epoch loop, split out so the caller can guarantee the
     async-checkpoint flush in a ``finally`` regardless of where the loop fails."""
     host_state = None
-    with maybe_profile(config.profile and M.is_logging_process(),
-                       config.profile_dir):
+    best_step_s = None
+    with maybe_profile(config.profile, config.profile_dir):
         for epoch in range(start_epoch, config.epochs):
+            t_epoch = time.perf_counter()
             # (seed, epoch)-keyed permutation — a pure function, so a resumed run
             # replays exactly the epochs it missed (same contract as
             # parallel/sampler.py's global_permutation).
@@ -489,11 +522,17 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x
                 mesh,
                 perm[:steps_per_epoch * config.batch_size].astype(np.int32)
                 .reshape(steps_per_epoch, config.batch_size), plan_spec)
-            state, losses = epoch_fn(state, train_x, train_y, plan, dropout_rng)
+            data_s = time.perf_counter() - t_epoch
+            t_exec = time.perf_counter()
+            state, out = epoch_fn(state, train_x, train_y, plan, dropout_rng)
+            losses, epoch_health = (out if config.health_stats else (out, None))
             jax.block_until_ready(state.params)
             epoch_loss = float(np.asarray(jax.device_get(losses)).mean())
+            execute_s = time.perf_counter() - t_exec
+            t_eval = time.perf_counter()
             eval_params = state.ema if state.ema is not None else state.params
             sum_nll, correct = jax.device_get(eval_fn(eval_params, test_x, test_y))
+            eval_s = time.perf_counter() - t_eval
             examples_trained = (epoch + 1) * steps_per_epoch * config.batch_size
             history.record_train(examples_trained, epoch_loss)
             history.record_test(examples_trained, float(sum_nll) / n_test)
@@ -501,6 +540,26 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x
                   f"val_loss: {float(sum_nll) / n_test:.4f}, "
                   f"accuracy: {int(correct) / n_test:.4f}, "
                   f"time_elapsed: {watch.elapsed():.2f}s")
+            if epoch_health is not None:
+                # SPMD-entered by every process (the norm program would deadlock
+                # a fleet if only process 0 ran it); emission below stays
+                # process-0 gated.
+                health_host = jax.device_get(epoch_health)
+                param_norm = T.global_l2_norm(state.params)
+            if tele.enabled:
+                step_s = execute_s / steps_per_epoch if steps_per_epoch else None
+                if step_s and (best_step_s is None or step_s < best_step_s):
+                    best_step_s = step_s
+                tele.emit(T.epoch_event(
+                    epoch, examples=steps_per_epoch * config.batch_size,
+                    steps=steps_per_epoch, wall_s=time.perf_counter() - t_epoch,
+                    execute_s=execute_s, eval_s=eval_s, data_s=data_s,
+                    compile_s=compile_s, flops_per_step=flops_per_step,
+                    train_loss=epoch_loss, val_loss=float(sum_nll) / n_test,
+                    mfu=T.estimate_mfu(flops_per_step, step_s)["mfu"]))
+                if epoch_health is not None:
+                    tele.emit(T.health_event(epoch, health_host, steps_per_epoch,
+                                             param_norm=param_norm))
             # Per-epoch full-state checkpoint (standard layout, process-0 gated,
             # atomic) so a killed run resumes with --resume-from on ANY mesh. The
             # final epoch's host copy doubles as the return value — no second
@@ -515,6 +574,8 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x
                 host_state = to_host_standard(state)
                 saver.save_train_state(ckpt_path, host_state)
 
+    if tele.enabled and best_step_s is not None:
+        tele.emit(T.mfu_event(flops_per_step, best_step_s))
     if host_state is None:      # no results_dir, or the resume skipped every epoch
         host_state = to_host_standard(state)
         if ckpt_path:           # zero-epoch resume must still leave a checkpoint

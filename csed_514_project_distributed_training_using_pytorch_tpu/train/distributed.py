@@ -30,6 +30,7 @@ division of labor exactly. The final sub-global-batch remainder of each epoch is
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import numpy as np
@@ -69,6 +70,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.utils.determinism i
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.profiling import (
     maybe_profile,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+    telemetry as T,
 )
 
 
@@ -113,8 +117,17 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                           kv_heads=config.kv_heads, rope=config.rope)  # fail fast, pre-rendezvous
     if config.grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
+    if config.health_stats and config.host_local_feed:
+        raise ValueError("--health-stats rides the compiled scan carry "
+                         "(train/step.py::HealthStats) — it is not available on the "
+                         "per-batch --host-local-feed path")
+    if config.health_stats and not config.telemetry:
+        raise ValueError("--health-stats emits telemetry 'health' events and has no "
+                         "other output — pass --telemetry PATH too")
     info = initialize_cluster()                   # ≙ init_process_group, :146
     mesh = make_mesh(num_devices)
+    tele = T.TelemetryWriter(config.telemetry)
+    tele.emit(T.manifest_event(config, mesh=mesh, run_type="distributed"))
     world = mesh.shape["data"]                    # ≙ world_size, :131 — but discovered
     if config.global_batch_size % world:
         raise ValueError(f"global batch {config.global_batch_size} not divisible by "
@@ -189,6 +202,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     test_x = dp.put_global(mesh, test_ds.images, eval_spec)
     test_y = dp.put_global(mesh, test_ds.labels, eval_spec)
 
+    health = config.health_stats
     epoch_body = make_epoch_fn(model, learning_rate=config.learning_rate,
                                momentum=config.momentum,
                                unroll=config.scan_unroll,
@@ -197,11 +211,33 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                                lr_schedule=lr_schedule,
                                clip_grad_norm=config.clip_grad_norm,
                                ema_decay=config.ema_decay,
-                               label_smoothing=config.label_smoothing)
+                               label_smoothing=config.label_smoothing,
+                               health=health)
     if config.fsdp:
         epoch_fn = fsdp.compile_epoch_fsdp(epoch_body, mesh)
     else:
         epoch_fn = dp.compile_epoch(epoch_body, mesh)
+    # Compile/execute split (telemetry): AOT-compile the whole-epoch program and
+    # price its FLOPs; the compiled program replaces the jit path so nothing
+    # compiles twice. The FSDP wrapper resolves shardings from the first call's
+    # state and has no .lower — aot_compile then returns None and compile time
+    # folds into the first epoch's wall clock (compile_s stays null).
+    # Gated on the CONFIG flag, not tele.enabled: every process must take the same
+    # compile path (AOT-compiled vs jit) on a multi-host fleet; only emission is
+    # process-0 gated.
+    compile_s = flops_per_step = None
+    if config.telemetry and not config.host_local_feed:
+        plan_struct = jax.ShapeDtypeStruct(
+            (steps_per_epoch, config.global_batch_size), np.int32)
+        compiled, aot = T.aot_compile(epoch_fn, state, train_x, train_y,
+                                      plan_struct, dropout_rng)
+        if compiled is not None:
+            epoch_fn = compiled
+            compile_s = aot["lower_s"] + aot["compile_s"]
+            if aot["flops"]:
+                flops_per_step = aot["flops"] / steps_per_epoch
+            tele.emit(T.compile_event("epoch", aot,
+                                      steps_per_call=steps_per_epoch))
     eval_fn = dp.compile_eval(
         make_eval_fn(model, batch_size=config.batch_size_test), mesh,
         shard=config.shard_eval)
@@ -256,16 +292,21 @@ def main(config: DistributedConfig = DistributedConfig(), *,
              else checkpoint)
 
     try:
-        with maybe_profile(config.profile and M.is_logging_process(),
-                           config.profile_dir):
+        with maybe_profile(config.profile, config.profile_dir):
+            best_step_s = None
             for epoch in range(start_epoch, config.epochs):   # ≙ the epoch loop, :70
+                t_epoch = time.perf_counter()
                 plan = epoch_index_plan(samplers, epoch, per_replica_batch)  # ≙ set_epoch, :72
+                data_s = time.perf_counter() - t_epoch
+                t_exec = time.perf_counter()
                 if config.host_local_feed:
                     state, losses = run_epoch_host_local(state, plan)
                 else:
-                    state, losses = run_epoch_device_resident(state, plan)
+                    state, out = run_epoch_device_resident(state, plan)
+                    losses, epoch_health = out if health else (out, None)
 
-                losses = np.asarray(jax.device_get(losses))
+                losses = np.asarray(jax.device_get(losses))  # the honest sync point
+                execute_s = time.perf_counter() - t_exec
                 train_loss = float(losses.mean())     # per-epoch mean of per-step global means
                 examples = (epoch + 1) * plan.size
                 for i, l in enumerate(losses[::config.log_interval]):
@@ -273,6 +314,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                                          i * config.log_interval * plan.shape[1],
                                          float(l))
 
+                t_eval = time.perf_counter()
                 eval_params = state.ema if state.ema is not None else state.params
                 if config.fsdp:
                     # compile_eval pins replicated param shardings; jit rejects a
@@ -280,16 +322,40 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                     eval_params = gather(eval_params)
                 sum_nll, correct = jax.device_get(
                     eval_fn(eval_params, test_x, test_y))   # ≙ eval loop, :92-109
+                eval_s = time.perf_counter() - t_eval
                 val_loss = float(sum_nll) / n_test
                 accuracy = float(correct) / n_test
                 history.record_test(examples, val_loss)
                 M.log(M.dist_epoch_summary_line(epoch, train_loss, val_loss, accuracy,
                                                 watch.elapsed()))  # ≙ :113-114
+                if health:
+                    # SPMD-entered by every process (the norm program would
+                    # deadlock a fleet if only process 0 ran it); emission below
+                    # stays process-0 gated.
+                    health_host = jax.device_get(epoch_health)
+                    param_norm = T.global_l2_norm(state.params)
+                if tele.enabled:
+                    steps = int(losses.shape[0])
+                    step_s = execute_s / steps if steps else None
+                    if step_s and (best_step_s is None or step_s < best_step_s):
+                        best_step_s = step_s
+                    tele.emit(T.epoch_event(
+                        epoch, examples=plan.size, steps=steps,
+                        wall_s=time.perf_counter() - t_epoch,
+                        execute_s=execute_s, eval_s=eval_s, data_s=data_s,
+                        compile_s=compile_s, flops_per_step=flops_per_step,
+                        train_loss=train_loss, val_loss=val_loss,
+                        mfu=T.estimate_mfu(flops_per_step, step_s)["mfu"]))
+                    if health:
+                        tele.emit(T.health_event(epoch, health_host, steps,
+                                                 param_norm=param_norm))
                 # Per-epoch full-state checkpoint (process-0 gated, atomic) so a killed run
                 # can resume with --resume-from; the reference only ever saves final params.
                 # Device-resident gathered state: the saver is process-0 gated and
                 # device_gets internally — non-0 processes must not pay a host fetch.
                 saver.save_train_state(ckpt_path, gather(state))
+            if tele.enabled and best_step_s is not None:
+                tele.emit(T.mfu_event(flops_per_step, best_step_s))
 
         if not config.fsdp:
             # The desync "race detector" (SURVEY.md §5). Under FSDP the replica-sync

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +48,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.utils import metric
 from csed_514_project_distributed_training_using_pytorch_tpu.utils import plotting
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
     LMConfig, parse_config,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+    telemetry as T,
 )
 
 
@@ -79,6 +83,9 @@ def main(config: LMConfig = LMConfig(), *,
     watch = M.Stopwatch()
     if config.grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {config.grad_accum}")
+    if config.health_stats and not config.telemetry:
+        raise ValueError("--health-stats emits telemetry 'health' events and has no "
+                         "other output — pass --telemetry PATH too")
     validate_remat_policy(config.remat, config.remat_policy)
     if config.attention_window:
         # Fail fast, pre-data/rendezvous (one owner for the message).
@@ -233,12 +240,14 @@ def main(config: LMConfig = LMConfig(), *,
                                       deterministic=deterministic,
                                       label_smoothing=config.label_smoothing)
 
+    health = config.health_stats
     step_fn = make_train_step(model, learning_rate=config.learning_rate,
                               momentum=config.momentum, grad_accum=config.grad_accum,
                               optimizer=optimizer, lr_schedule=lr_schedule,
                               clip_grad_norm=config.clip_grad_norm,
-                              ema_decay=config.ema_decay, loss_fn=lm_loss)
-    epoch_fn = compile_lm_epoch(make_epoch_from_step(step_fn))
+                              ema_decay=config.ema_decay, loss_fn=lm_loss,
+                              with_metrics=health)
+    epoch_fn = compile_lm_epoch(make_epoch_from_step(step_fn, health=health))
     eval_fn = jax.jit(make_eval_nll_fn(model, batch_size=config.eval_batch))
 
     tokens_d = dp.put_global(mesh, train_tokens, P())
@@ -247,6 +256,26 @@ def main(config: LMConfig = LMConfig(), *,
     zeros_d = dp.put_global(mesh, np.zeros(n_train, np.int32), P())
     test_d = dp.put_global(mesh, test_tokens, P())
     dropout_rng = jax.random.PRNGKey(config.seed + 1)
+    tele = T.TelemetryWriter(config.telemetry)
+    tele.emit(T.manifest_event(config, mesh=mesh, run_type="lm"))
+    # Compile/execute split (telemetry): AOT-compile + FLOP-price the epoch program
+    # (DP path; the TP cached-sharding wrapper has no .lower — compile_s stays null
+    # and folds into the first epoch).
+    # Gated on the CONFIG flag, not tele.enabled: every process must take the same
+    # compile path (AOT-compiled vs jit) on a multi-host fleet.
+    compile_s = flops_per_step = None
+    if config.telemetry:
+        plan_struct = jax.ShapeDtypeStruct(
+            (steps_per_epoch, config.batch_size), np.int32)
+        compiled, aot = T.aot_compile(epoch_fn, state, tokens_d, zeros_d,
+                                      plan_struct, dropout_rng)
+        if compiled is not None:
+            epoch_fn = compiled
+            compile_s = aot["lower_s"] + aot["compile_s"]
+            if aot["flops"]:
+                flops_per_step = aot["flops"] / steps_per_epoch
+            tele.emit(T.compile_event("epoch", aot,
+                                      steps_per_call=steps_per_epoch))
     history = M.MetricsHistory()
     saver = (checkpoint.AsyncCheckpointer() if config.async_checkpoint
              else checkpoint)
@@ -260,7 +289,7 @@ def main(config: LMConfig = LMConfig(), *,
         state = _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d,
                             zeros_d, test_d, dropout_rng, n_train, n_test, seq_len,
                             steps_per_epoch, start_epoch, history, watch, saver,
-                            ckpt_path, gather)
+                            ckpt_path, gather, tele, compile_s, flops_per_step)
     finally:
         # Drain the write-behind queue even on an exception/signal mid-run — the
         # queued per-epoch checkpoint is the resume artifact a killed run needs,
@@ -304,10 +333,13 @@ def main(config: LMConfig = LMConfig(), *,
 
 def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_d,
                 dropout_rng, n_train, n_test, seq_len, steps_per_epoch, start_epoch,
-                history, watch, saver, ckpt_path, gather):
+                history, watch, saver, ckpt_path, gather, tele, compile_s,
+                flops_per_step):
     """The LM trainer's epoch loop, split out so the caller can guarantee the
     async-checkpoint flush in a ``finally`` regardless of where the loop fails."""
+    best_step_s = None
     for epoch in range(start_epoch, config.epochs):
+        t_epoch = time.perf_counter()
         # (seed, epoch)-keyed permutation — the parallel/sampler contract, so resumed
         # runs replay exactly the epochs they missed.
         perm = np.random.default_rng(
@@ -316,11 +348,17 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_
             mesh,
             perm[:steps_per_epoch * config.batch_size].astype(np.int32)
             .reshape(steps_per_epoch, config.batch_size), P(None, "data"))
-        state, losses = epoch_fn(state, tokens_d, zeros_d, plan, dropout_rng)
+        data_s = time.perf_counter() - t_epoch
+        t_exec = time.perf_counter()
+        state, out = epoch_fn(state, tokens_d, zeros_d, plan, dropout_rng)
+        losses, epoch_health = out if config.health_stats else (out, None)
         jax.block_until_ready(state.params)
         train_loss = float(np.asarray(jax.device_get(losses)).mean())
+        execute_s = time.perf_counter() - t_exec
+        t_eval = time.perf_counter()
         eval_params = state.ema if state.ema is not None else state.params
         sum_nll = float(jax.device_get(eval_fn(eval_params, test_d)))
+        eval_s = time.perf_counter() - t_eval
         val_nll = sum_nll / (n_test * seq_len)
         examples = (epoch + 1) * steps_per_epoch * config.batch_size
         history.record_train(examples, train_loss)
@@ -328,10 +366,31 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_
         M.log(f"Epoch {epoch}: train_loss: {train_loss:.4f}, "
               f"val_nll/token: {val_nll:.4f}, val_ppl: {float(np.exp(val_nll)):.3f}, "
               f"time_elapsed: {watch.elapsed():.2f}s")
+        if epoch_health is not None:
+            # SPMD-entered by every process (the norm program would deadlock a
+            # fleet if only process 0 ran it); emission below stays process-0 gated.
+            health_host = jax.device_get(epoch_health)
+            param_norm = T.global_l2_norm(state.params)
+        if tele.enabled:
+            step_s = execute_s / steps_per_epoch if steps_per_epoch else None
+            if step_s and (best_step_s is None or step_s < best_step_s):
+                best_step_s = step_s
+            tele.emit(T.epoch_event(
+                epoch, examples=steps_per_epoch * config.batch_size,
+                steps=steps_per_epoch, wall_s=time.perf_counter() - t_epoch,
+                execute_s=execute_s, eval_s=eval_s, data_s=data_s,
+                compile_s=compile_s, flops_per_step=flops_per_step,
+                train_loss=train_loss, val_loss=val_nll,
+                mfu=T.estimate_mfu(flops_per_step, step_s)["mfu"]))
+            if epoch_health is not None:
+                tele.emit(T.health_event(epoch, health_host, steps_per_epoch,
+                                         param_norm=param_norm))
         if ckpt_path:
             # Device-resident gathered state: the saver is process-0 gated and
             # device_gets internally — non-0 processes must not pay a host fetch.
             saver.save_train_state(ckpt_path, gather(state))
+    if tele.enabled and best_step_s is not None:
+        tele.emit(T.mfu_event(flops_per_step, best_step_s))
     return state
 
 

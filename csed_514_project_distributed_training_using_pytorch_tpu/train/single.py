@@ -21,6 +21,7 @@ TPU-first differences:
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +36,8 @@ from csed_514_project_distributed_training_using_pytorch_tpu.models import (
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
-    TrainState, create_train_state, make_epoch_fn, make_eval_fn, make_train_step,
+    TrainState, create_train_state, init_health, make_epoch_fn, make_eval_fn,
+    make_train_step, merge_health, update_health,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.utils import checkpoint
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
@@ -44,7 +46,11 @@ from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import
 from csed_514_project_distributed_training_using_pytorch_tpu.utils import metrics as M
 from csed_514_project_distributed_training_using_pytorch_tpu.utils import plotting
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.profiling import (
+    annotate,
     maybe_profile,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+    telemetry as T,
 )
 
 
@@ -66,6 +72,15 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     if config.grad_accum > 1 and config.batch_size_train % config.grad_accum:
         raise ValueError(f"batch_size_train {config.batch_size_train} not divisible "
                          f"by grad_accum {config.grad_accum}")
+    if config.health_stats and config.use_host_pipeline:
+        raise ValueError("--health-stats rides the compiled scan carry "
+                         "(train/step.py::HealthStats) — it is not available on the "
+                         "per-batch --use-host-pipeline path")
+    if config.health_stats and not config.telemetry:
+        raise ValueError("--health-stats emits telemetry 'health' events and has no "
+                         "other output — pass --telemetry PATH too")
+    tele = T.TelemetryWriter(config.telemetry)
+    tele.emit(T.manifest_event(config, run_type="single"))
     if config.download_data and datasets is None:
         download_mnist(config.data_dir)   # ≙ torchvision download=True, src/train.py:26-31
     train_ds, test_ds = datasets if datasets is not None else load_mnist(config.data_dir)
@@ -120,6 +135,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     train_x, train_y = jnp.asarray(train_ds.images), jnp.asarray(train_ds.labels)
     test_x, test_y = jnp.asarray(test_ds.images), jnp.asarray(test_ds.labels)
 
+    health = config.health_stats
     segment_fn = jax.jit(
         make_epoch_fn(model, learning_rate=config.learning_rate,
                       momentum=config.momentum,
@@ -129,7 +145,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                       lr_schedule=lr_schedule,
                       clip_grad_norm=config.clip_grad_norm,
                       ema_decay=config.ema_decay,
-                      label_smoothing=config.label_smoothing),
+                      label_smoothing=config.label_smoothing,
+                      health=health),
         donate_argnums=(0,))
     step_fn = jax.jit(
         make_train_step(model, learning_rate=config.learning_rate,
@@ -139,7 +156,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                         lr_schedule=lr_schedule,
                         clip_grad_norm=config.clip_grad_norm,
                         ema_decay=config.ema_decay,
-                        label_smoothing=config.label_smoothing),
+                        label_smoothing=config.label_smoothing,
+                        with_metrics=health),
         donate_argnums=(0,))
     # The final partial batch (drop_last=False) is ragged and need not divide by
     # grad_accum; accumulation is a memory knob, so the tail just steps unaccumulated.
@@ -153,9 +171,30 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                             optimizer=optimizer, lr_schedule=lr_schedule,
                             clip_grad_norm=config.clip_grad_norm,
                             ema_decay=config.ema_decay,
-                            label_smoothing=config.label_smoothing),
+                            label_smoothing=config.label_smoothing,
+                            with_metrics=health),
             donate_argnums=(0,))
     eval_fn = jax.jit(make_eval_fn(model, batch_size=config.batch_size_test))
+
+    # Compile/execute split (telemetry): AOT-compile the epoch-segment program via
+    # jit(...).lower().compile() so first-epoch wall time decomposes into compile_s
+    # (here) + execute_s (the loop's honest-synced device time), and so XLA's
+    # cost_analysis() prices the step for the MFU estimate. The compiled program is
+    # then what the loop invokes — the jit cache never pays a second compile.
+    segment_call = segment_fn
+    compile_s = flops_per_step = None
+    if config.telemetry and not config.use_host_pipeline:
+        idx_struct = jax.ShapeDtypeStruct(
+            (config.log_interval, config.batch_size_train), jnp.int32)
+        compiled, aot = T.aot_compile(segment_fn, state, train_x, train_y,
+                                      idx_struct, dropout_rng)
+        if compiled is not None:
+            segment_call = compiled
+            compile_s = aot["lower_s"] + aot["compile_s"]
+            if aot["flops"]:
+                flops_per_step = aot["flops"] / config.log_interval
+            tele.emit(T.compile_event("epoch_segment", aot,
+                                      steps_per_call=config.log_interval))
 
     history = M.MetricsHistory()
     n_train, n_test = len(train_ds), len(test_ds)
@@ -172,25 +211,48 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         history.record_test(examples_seen, avg)
         M.log(M.test_summary_line(avg, int(correct), n_test, watch.elapsed()))
 
-    def train_epoch(state: TrainState, epoch: int) -> TrainState:
+    def train_epoch(state: TrainState, epoch: int):
+        times = {"execute": 0.0, "data": 0.0, "loss_sum": 0.0, "loss_steps": 0}
+        t_data = time.perf_counter()
         train_loader.set_epoch(epoch)
         indices = train_loader.sampler.epoch_indices(epoch)
         idx_full = train_loader.epoch_index_matrix(epoch, allow_empty=True)
+        times["data"] = time.perf_counter() - t_data
         full_steps = idx_full.shape[0]
+        epoch_health = init_health() if health else None
 
         # log_interval-sized jit'd scan segments, then the ragged tail.
         li = config.log_interval
         for seg_start in range(0, full_steps, li):
             seg = idx_full[seg_start:seg_start + li]
+            t_exec = time.perf_counter()
             if len(seg) == li:
-                state, losses = segment_fn(state, train_x, train_y,
-                                           jnp.asarray(seg), dropout_rng)
-                last_loss = float(losses[-1])
+                state, out = segment_call(state, train_x, train_y,
+                                          jnp.asarray(seg), dropout_rng)
+                if health:
+                    losses, seg_health = out
+                    epoch_health = merge_health(epoch_health, seg_health)
+                else:
+                    losses = out
+                seg_losses = np.asarray(jax.device_get(losses))
             else:  # tail of < log_interval full batches — stepwise (same compiled step)
+                step_losses = []
                 for row in seg:
-                    state, loss = step_fn(state, train_x[jnp.asarray(row)],
-                                          train_y[jnp.asarray(row)], dropout_rng)
-                last_loss = float(loss)
+                    state, out = step_fn(state, train_x[jnp.asarray(row)],
+                                         train_y[jnp.asarray(row)], dropout_rng)
+                    if health:
+                        loss, gnorm = out
+                        epoch_health = update_health(epoch_health, loss, gnorm)
+                    else:
+                        loss = out
+                    step_losses.append(loss)    # device scalars — ONE fetch below
+                seg_losses = np.asarray(jax.device_get(step_losses))
+            last_loss = float(seg_losses[-1])   # the tick's host sync, as before
+            # Epoch-mean accumulation (telemetry): same per-epoch train_loss
+            # definition as the distributed/LM/composed epoch events.
+            times["loss_sum"] += float(seg_losses.sum())
+            times["loss_steps"] += seg_losses.size
+            times["execute"] += time.perf_counter() - t_exec  # closed by the fetch above
             batches_done = min(seg_start + li, full_steps)
             examples_seen = (epoch - 1) * n_train + batches_done * config.batch_size_train
             M.log(M.train_progress_line(epoch, batches_done * config.batch_size_train,
@@ -202,17 +264,29 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         # final partial batch (drop_last=False, ≙ torch DataLoader default)
         tail = indices[full_steps * config.batch_size_train:]
         if len(tail):
-            state, _ = tail_step_fn(state, train_x[jnp.asarray(tail)],
-                                    train_y[jnp.asarray(tail)], dropout_rng)
-        return state
+            t_exec = time.perf_counter()
+            state, out = tail_step_fn(state, train_x[jnp.asarray(tail)],
+                                      train_y[jnp.asarray(tail)], dropout_rng)
+            if health:
+                epoch_health = update_health(epoch_health, *out)
+                tail_loss = out[0]
+            else:
+                tail_loss = out
+            times["loss_sum"] += float(tail_loss)
+            times["loss_steps"] += 1
+            times["execute"] += time.perf_counter() - t_exec
+        return state, epoch_health, times
 
-    def train_epoch_host_pipeline(state: TrainState, epoch: int) -> TrainState:
+    def train_epoch_host_pipeline(state: TrainState, epoch: int):
         """The reference-shaped loop: host batches through the native C++ threaded
         prefetcher (the DataLoader worker-pool analog), one device dispatch per batch.
         Identical step sequence (same index plan, same per-step RNG fold) to the scan fast
-        path — only the feeding mechanism differs."""
+        path — only the feeding mechanism differs. (--health-stats is rejected up
+        front on this path — the accumulators ride the scan carry.)"""
+        t_epoch = time.perf_counter()
         train_loader.set_epoch(epoch)
         full_steps = train_loader.epoch_index_matrix(epoch, allow_empty=True).shape[0]
+        step_losses = []      # device scalars — fetched ONCE at epoch end
         # Live per-batch bar (≙ the reference's tqdm, src/train_dist.py:76) — only
         # here, where a per-step dispatch already exists; tty/process-0 gated.
         with M.ProgressBar(full_steps, desc=f"Epoch {epoch} ") as bar:
@@ -220,6 +294,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                                          start=1):
                 state, loss = step_fn(state, jnp.asarray(bx), jnp.asarray(by),
                                       dropout_rng)
+                step_losses.append(loss)
                 if b % config.log_interval == 0 or b == full_steps:
                     # The log line and the in-place bar share the terminal: finish
                     # the bar's line first (float(loss) syncs here anyway — the bar
@@ -236,20 +311,61 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         tail = train_loader.sampler.epoch_indices(epoch)[
             full_steps * config.batch_size_train:]
         if len(tail):
-            state, _ = tail_step_fn(state, jnp.asarray(train_ds.images[tail]),
-                                    jnp.asarray(train_ds.labels[tail]), dropout_rng)
-        return state
+            state, tail_loss = tail_step_fn(state, jnp.asarray(train_ds.images[tail]),
+                                            jnp.asarray(train_ds.labels[tail]),
+                                            dropout_rng)
+            step_losses.append(tail_loss)
+        losses = np.asarray(jax.device_get(step_losses)) if step_losses else np.zeros(0)
+        # Per-batch host dispatch: device execution overlaps the feed, so the
+        # compile/execute split doesn't decompose here — report the loop as execute.
+        return state, None, {"execute": time.perf_counter() - t_epoch, "data": 0.0,
+                             "loss_sum": float(losses.sum()),
+                             "loss_steps": int(losses.size)}
 
     if config.use_host_pipeline:
         train_epoch = train_epoch_host_pipeline
 
     try:
         with maybe_profile(config.profile, config.profile_dir):
-            evaluate(state, 0)                  # baseline eval, ≙ src/train.py:106
+            with annotate("eval"):
+                evaluate(state, 0)              # baseline eval, ≙ src/train.py:106
+            best_step_s = None
             for epoch in range(1, config.n_epochs + 1):
-                state = train_epoch(state, epoch)
+                step_before = int(state.step)
+                t_epoch = time.perf_counter()
+                with annotate(f"train_epoch_{epoch}"):
+                    state, epoch_health, times = train_epoch(state, epoch)
                 jax.block_until_ready(state.params)  # honest wall-clock (SURVEY.md §7c)
-                evaluate(state, epoch * n_train)
+                wall_s = time.perf_counter() - t_epoch
+                t_eval = time.perf_counter()
+                with annotate("eval"):
+                    evaluate(state, epoch * n_train)
+                if epoch_health is not None:
+                    # SPMD-entered by every process (the norm program would
+                    # deadlock a fleet if only process 0 ran it); emission below
+                    # stays process-0 gated.
+                    health_host = jax.device_get(epoch_health)
+                    param_norm = T.global_l2_norm(state.params)
+                if tele.enabled:
+                    eval_s = time.perf_counter() - t_eval
+                    steps = int(state.step) - step_before
+                    step_s = times["execute"] / steps if steps else None
+                    if step_s and (best_step_s is None or step_s < best_step_s):
+                        best_step_s = step_s
+                    tele.emit(T.epoch_event(
+                        epoch, examples=n_train, steps=steps, wall_s=wall_s,
+                        execute_s=times["execute"], eval_s=eval_s,
+                        data_s=times["data"], compile_s=compile_s,
+                        flops_per_step=flops_per_step,
+                        train_loss=times["loss_sum"] / times["loss_steps"]
+                        if times["loss_steps"] else None,
+                        val_loss=history.test_losses[-1],
+                        mfu=T.estimate_mfu(flops_per_step, step_s)["mfu"]))
+                    if epoch_health is not None:
+                        tele.emit(T.health_event(epoch, health_host, steps,
+                                                 param_norm=param_norm))
+            if tele.enabled and best_step_s is not None:
+                tele.emit(T.mfu_event(flops_per_step, best_step_s))
 
         plotting.save_loss_curves(
             history, os.path.join(config.images_dir, "train_test_curve.png"))

@@ -33,9 +33,56 @@ from csed_514_project_distributed_training_using_pytorch_tpu import ops
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.optim import (
     Optimizer,
     clip_by_global_norm,
+    global_l2_norm,
     sgd,
     sgd_init,
 )
+
+
+class HealthStats(NamedTuple):
+    """Training-health accumulators that ride the epoch scan's CARRY.
+
+    The compiled-``lax.scan`` epoch (DESIGN.md §1) makes per-step host logging
+    impossible by construction — so the health signal is accumulated *inside* the
+    compiled program (five f32 scalars threaded through the carry) and fetched
+    ONCE at epoch end with the losses array: zero extra host syncs on the hot
+    path. Gradient norms are measured PRE-clip — the explosion detector must see
+    what clipping would otherwise hide. ``utils.telemetry.health_event`` turns one
+    of these into the ``health`` JSONL event."""
+
+    loss_min: jax.Array
+    loss_max: jax.Array
+    loss_sum: jax.Array
+    grad_norm_sum: jax.Array
+    grad_norm_max: jax.Array
+
+
+def init_health() -> HealthStats:
+    """Identity element for ``update_health`` (min over inf, max over -inf, sums over 0)."""
+    inf = jnp.asarray(jnp.inf, jnp.float32)
+    zero = jnp.zeros((), jnp.float32)
+    return HealthStats(inf, -inf, zero, zero, zero)
+
+
+def update_health(h: HealthStats, loss, grad_norm) -> HealthStats:
+    """Fold one step's (loss, pre-clip global grad norm) into the accumulators."""
+    loss = loss.astype(jnp.float32)
+    grad_norm = grad_norm.astype(jnp.float32)
+    return HealthStats(jnp.minimum(h.loss_min, loss),
+                       jnp.maximum(h.loss_max, loss),
+                       h.loss_sum + loss,
+                       h.grad_norm_sum + grad_norm,
+                       jnp.maximum(h.grad_norm_max, grad_norm))
+
+
+def merge_health(a: HealthStats, b: HealthStats) -> HealthStats:
+    """Combine accumulators from two scan segments of the same epoch (the
+    single-process trainer runs an epoch as log-interval-sized segments)."""
+    return HealthStats(jnp.minimum(a.loss_min, b.loss_min),
+                       jnp.maximum(a.loss_max, b.loss_max),
+                       a.loss_sum + b.loss_sum,
+                       a.grad_norm_sum + b.grad_norm_sum,
+                       jnp.maximum(a.grad_norm_max, b.grad_norm_max))
 
 
 class TrainState(NamedTuple):
@@ -84,7 +131,8 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
                     clip_grad_norm: float = 0.0,
                     ema_decay: float = 0.0,
                     label_smoothing: float = 0.0,
-                    loss_fn: Callable | None = None) -> Callable:
+                    loss_fn: Callable | None = None,
+                    with_metrics: bool = False) -> Callable:
     """Build ``step(state, images, labels, rng) -> (state, loss)``.
 
     The loss is the canonical ``nll(log_probs)`` formulation (see
@@ -133,6 +181,14 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     entirely (e.g. the LM's next-token loss, ``train/lm.py``) while keeping every
     other mechanism — grad-accum, clipping, schedules, optimizers — unchanged. Not
     supported with ``use_pallas`` (the fused kernels implement the standard loss).
+
+    ``with_metrics=True`` changes the return to ``(state, (loss, grad_norm))``,
+    where ``grad_norm`` is the PRE-clip global L2 norm of the (microbatch-averaged)
+    gradients — the ``--health-stats`` signal accumulated by the scanned epoch
+    (``HealthStats``). The flag-off path is byte-for-byte the unmetered step: no
+    new ops enter the compiled program (pinned in ``tests/test_telemetry.py``),
+    and the update math is identical either way (the norm only READS the grads),
+    so metered and unmetered training produce bitwise-identical params.
     """
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -171,8 +227,14 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
         loss_fn = default_loss_fn
 
     def apply_update(state, grads, loss):
+        # The health-stats grad norm is PRE-clip (clipping must not hide an
+        # explosion) — which is exactly the norm the clip computes and returns, so
+        # the metered clipped step measures it once.
+        gnorm = None
         if clip_grad_norm > 0.0:
-            grads, _ = clip_by_global_norm(grads, clip_grad_norm)
+            grads, gnorm = clip_by_global_norm(grads, clip_grad_norm)
+        elif with_metrics:
+            gnorm = global_l2_norm(grads)
         if use_pallas:
             # Hyperparams come from the Optimizer (not this function's kwargs) so an
             # explicitly passed optim.sgd(...) can never silently diverge from what
@@ -197,7 +259,10 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
                 lambda e, p: jnp.where(first, p,
                                        ema_decay * e + (1.0 - ema_decay) * p),
                 ema, params)
-        return TrainState(params, velocity, state.step + 1, ema), loss
+        new_state = TrainState(params, velocity, state.step + 1, ema)
+        if with_metrics:
+            return new_state, (loss, gnorm)
+        return new_state, loss
 
     def step(state: TrainState, images, labels, rng) -> tuple[TrainState, jax.Array]:
         step_rng = jax.random.fold_in(rng, state.step)
@@ -241,7 +306,8 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
                   lr_schedule: Callable | None = None,
                   clip_grad_norm: float = 0.0,
                   ema_decay: float = 0.0,
-                  label_smoothing: float = 0.0) -> Callable:
+                  label_smoothing: float = 0.0,
+                  health: bool = False) -> Callable:
     """Build ``epoch(state, images, labels, idx_matrix, rng) -> (state, losses)``.
 
     ``images``/``labels`` are the full (device-resident) training split; ``idx_matrix`` is a
@@ -259,38 +325,63 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
     scan — one big take instead of one small gather per step — and scans over the
     pre-batched arrays; trades HBM (one epoch-sized copy of the split) for per-step
     gather latency.
+
+    ``health=True`` builds the step with ``with_metrics`` and threads
+    ``HealthStats`` accumulators through the scan carry; the epoch then returns
+    ``(state, (losses, health))`` — same program otherwise, bitwise-identical
+    params (pinned in ``tests/test_telemetry.py``).
     """
     train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum,
                                  use_pallas=use_pallas, grad_accum=grad_accum,
                                  optimizer=optimizer, lr_schedule=lr_schedule,
                                  clip_grad_norm=clip_grad_norm, ema_decay=ema_decay,
-                                 label_smoothing=label_smoothing)
-    return make_epoch_from_step(train_step, unroll=unroll, pregather=pregather)
+                                 label_smoothing=label_smoothing,
+                                 with_metrics=health)
+    return make_epoch_from_step(train_step, unroll=unroll, pregather=pregather,
+                                health=health)
 
 
 def make_epoch_from_step(train_step: Callable, *, unroll: int = 1,
-                         pregather: bool = False) -> Callable:
+                         pregather: bool = False, health: bool = False) -> Callable:
     """Wrap any ``step(state, images, labels, rng)`` into the scanned epoch program
     (same contract as ``make_epoch_fn`` — used for alternative step implementations,
-    e.g. the LM trainer's next-token step, ``train/lm.py``)."""
+    e.g. the LM trainer's next-token step, ``train/lm.py``).
+
+    ``health=True`` expects a step built with ``with_metrics=True`` (returning
+    ``(state, (loss, grad_norm))``), carries ``HealthStats`` through the scan, and
+    returns ``(state, (losses, health))``."""
 
     def epoch(state: TrainState, images, labels, idx_matrix, rng):
+        def apply(carry, x, y):
+            if not health:
+                return train_step(carry, x, y, rng)
+            st, h = carry
+            st, (loss, gnorm) = train_step(st, x, y, rng)
+            return (st, update_health(h, loss, gnorm)), loss
+
+        init = (state, init_health()) if health else state
+
         if pregather:
-            def body(state, batch):
+            def body(carry, batch):
                 x, y = batch
-                return train_step(state, x, y, rng)
+                return apply(carry, x, y)
 
             xs = (jnp.take(images, idx_matrix.reshape(-1), axis=0)
                   .reshape(idx_matrix.shape + images.shape[1:]))
             ys = jnp.take(labels, idx_matrix.reshape(-1),
                           axis=0).reshape(idx_matrix.shape)
-            return lax.scan(body, state, (xs, ys), unroll=unroll)
+            out, losses = lax.scan(body, init, (xs, ys), unroll=unroll)
+        else:
+            def body(carry, idx):
+                return apply(carry, jnp.take(images, idx, axis=0),
+                             jnp.take(labels, idx, axis=0))
 
-        def body(state, idx):
-            return train_step(state, jnp.take(images, idx, axis=0),
-                              jnp.take(labels, idx, axis=0), rng)
+            out, losses = lax.scan(body, init, idx_matrix, unroll=unroll)
 
-        return lax.scan(body, state, idx_matrix, unroll=unroll)
+        if health:
+            st, h = out
+            return st, (losses, h)
+        return out, losses
 
     return epoch
 

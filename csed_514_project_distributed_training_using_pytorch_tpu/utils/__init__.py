@@ -1,1 +1,2 @@
-"""Support subsystems: config, checkpointing, metrics/plots, profiling, determinism checks."""
+"""Support subsystems: config, checkpointing, metrics/plots, profiling, telemetry,
+determinism checks."""

@@ -59,6 +59,15 @@ class SingleProcessConfig:
     images_dir: str = "images"        # src/train.py:57,117 plot target
     profile: bool = False             # optional jax.profiler capture (reference has none, §5)
     profile_dir: str = "results/profile"
+    telemetry: str = ""               # write structured run telemetry (manifest /
+                                      # compile / epoch / health / mfu JSONL events,
+                                      # utils/telemetry.py) to this path; "" off.
+                                      # Render with tools/telemetry_report.py
+    health_stats: bool = False        # accumulate grad-norm/param-norm/loss-range
+                                      # health stats INSIDE the compiled epoch scan
+                                      # (zero extra host syncs; bitwise-identical
+                                      # training — train/step.py::HealthStats) and
+                                      # emit them as telemetry 'health' events
     resume_from: str = ""             # checkpoint path to resume from (the restore path the
                                       # reference lacks, SURVEY.md §5 "checkpoint/resume")
     model: str = "cnn"                # model family: 'cnn' (the reference's Net) or
@@ -166,6 +175,10 @@ class DistributedConfig:
                                       # SingleProcessConfig.grad_accum)
     profile: bool = False
     profile_dir: str = "results/profile"
+    telemetry: str = ""               # structured run-telemetry JSONL path (see
+                                      # SingleProcessConfig.telemetry); "" off
+    health_stats: bool = False        # in-scan training-health accumulators (see
+                                      # SingleProcessConfig.health_stats)
     max_train_examples: int = 0       # 0 = full split; >0 truncates (dev/CI shortening —
     max_test_examples: int = 0        # no reference analog; the reference always trains full)
 
@@ -227,6 +240,10 @@ class ComposedConfig:
                                         # across stage layouts via the bridge)
     profile: bool = False               # jax.profiler capture around the epoch loop
     profile_dir: str = "results/profile"
+    telemetry: str = ""                 # structured run-telemetry JSONL path (see
+                                        # SingleProcessConfig.telemetry); "" off
+    health_stats: bool = False          # in-scan training-health accumulators (see
+                                        # SingleProcessConfig.health_stats)
     epochs: int = 2
     batch_size: int = 64
     batch_size_test: int = 1000
@@ -329,6 +346,10 @@ class LMConfig:
     results_dir: str = "results"
     images_dir: str = "images"
     resume_from: str = ""               # per-epoch checkpoint to resume from
+    telemetry: str = ""                 # structured run-telemetry JSONL path (see
+                                        # SingleProcessConfig.telemetry); "" off
+    health_stats: bool = False          # in-scan training-health accumulators (see
+                                        # SingleProcessConfig.health_stats)
     max_train_examples: int = 0
     max_test_examples: int = 0
 

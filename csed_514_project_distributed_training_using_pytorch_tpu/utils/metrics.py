@@ -68,6 +68,25 @@ def save_metrics_jsonl(history: MetricsHistory, path: str) -> str | None:
     return path
 
 
+def load_metrics_jsonl(path: str) -> list[dict]:
+    """Read-side inverse of ``save_metrics_jsonl``: one dict per non-blank line.
+
+    This is the ONE JSONL reader — loss-curve metrics and the telemetry event
+    stream (``utils/telemetry.py``) share it, so ``tools/telemetry_report.py``
+    consumes both file kinds through the same code path. Strict JSON: the writers'
+    NaN→null rule means a diverged run loads as ``None`` losses, never a parse
+    error."""
+    import json
+
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
 class Stopwatch:
     """Wall-clock since construction (≙ ``t0 = time.time()`` reference src/train.py:10)."""
 

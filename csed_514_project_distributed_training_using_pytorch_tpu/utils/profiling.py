@@ -4,27 +4,39 @@ The reference's only instrument is coarse wall-clock (``t0 = time.time()``, refe
 ``src/train.py:10,99``; SURVEY.md §5 "tracing/profiling") — kept, in ``utils.metrics.Stopwatch``,
 because it *is* the baseline metric. This module adds what the reference lacks: an opt-in
 ``jax.profiler`` device trace (TPU timeline incl. ICI collectives, viewable in
-TensorBoard/Perfetto) behind a flag, costing nothing when disabled.
+TensorBoard/Perfetto) behind a flag, costing nothing when disabled. The structured
+(always-parseable, per-run) counterpart is ``utils/telemetry.py`` — the trace is for
+timeline forensics, telemetry for the numbers.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 
 import jax
+
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import metrics
 
 
 @contextlib.contextmanager
 def maybe_profile(enabled: bool, log_dir: str):
-    """Capture a jax.profiler trace of the enclosed block when ``enabled``."""
-    if not enabled:
+    """Capture a jax.profiler trace of the enclosed block when ``enabled``.
+
+    Process-0 gated INTERNALLY (one trace per fleet, not one per host — every rank
+    tracing would multiply IO and clobber nothing useful), creates ``log_dir`` if
+    missing, and logs the trace path so a run's stdout says where its timeline went.
+    """
+    if not enabled or not metrics.is_logging_process():
         yield
         return
+    os.makedirs(log_dir, exist_ok=True)
     jax.profiler.start_trace(log_dir)
     try:
         yield
     finally:
         jax.profiler.stop_trace()
+        metrics.log(f"Saved profiler trace to {log_dir}")
 
 
 @contextlib.contextmanager

@@ -1,0 +1,308 @@
+"""Structured run telemetry: machine-readable record of WHAT ran and WHERE the time went.
+
+The reference's entire observability surface is ``t0 = time.time()`` plus print lines
+(SURVEY.md §5), faithfully reproduced in ``utils/metrics.py`` — which means nothing
+downstream can answer "what mesh was that run on", "how much of epoch 1 was XLA
+compile", or "was training healthy" without parsing stdout. This module is the
+structured layer every perf PR proves its numbers through:
+
+- **events** — one JSON object per line (strict JSONL: non-finite floats become
+  ``null``), each typed by an ``"event"`` key. The types and their producers:
+
+  =============  =====================================================================
+  ``manifest``   once per run: config snapshot, mesh axes/shape, device kind+count,
+                 process count, jax/jaxlib/python versions, precision flags
+  ``compile``    AOT compile timing of the epoch program (``jit(...).lower().compile()``)
+                 plus its ``cost_analysis()`` FLOPs
+  ``epoch``      per epoch: wall/execute/eval/data-feed seconds, examples/s,
+                 compile_s, flops_per_step, train/val loss
+  ``health``     per epoch when ``--health-stats`` is on: grad-norm mean/max, loss
+                 min/max/mean, param norm — accumulated INSIDE the compiled scan
+                 (see ``train/step.py``), zero extra host syncs on the hot path
+  ``mfu``        steady-state throughput: measured step seconds vs compiled FLOPs vs
+                 the chip's published peak (``utils/benchmarks.py``)
+  ``bench``      one line per ``bench*.py`` measurement (same schema, comparable to
+                 training runs in ``tools/telemetry_report.py``)
+  =============  =====================================================================
+
+- **writer** — ``TelemetryWriter`` is process-0 gated (a fleet writes ONE file) and
+  atomic: every emit rewrites the file via tmp+rename (the checkpoint writer's
+  ``_atomic_write``), so a reader never observes a torn line and a killed run keeps
+  every event emitted before the kill. Event volume is O(epochs), not O(steps) —
+  rewriting is cheap by construction, because anything per-step would be a host sync
+  the compiled-epoch design exists to delete.
+
+Read side: ``utils.metrics.load_metrics_jsonl`` (shared with the loss-curve JSONL);
+renderer: ``tools/telemetry_report.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import platform
+import time
+
+import jax
+
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import metrics as M
+
+SCHEMA_VERSION = 1
+
+
+def _finite(x):
+    """Strict-JSONL rule (same as ``metrics.save_metrics_jsonl``): non-finite → None."""
+    if x is None:
+        return None
+    x = float(x)
+    return x if math.isfinite(x) else None
+
+
+def _sanitize(obj):
+    """Deep-copy ``obj`` with every non-finite float mapped to None — a diverged run
+    (NaN loss, inf grad norm) must still serialize as valid JSON."""
+    if isinstance(obj, float):
+        return _finite(obj)
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return obj
+
+
+class TelemetryWriter:
+    """Append-only event stream as atomically-(re)written JSONL; process-0 gated.
+
+    ``path`` empty/None disables everything — every ``emit`` is then a no-op, so
+    trainers call unconditionally and the off path costs a truthiness check.
+    """
+
+    def __init__(self, path: str | None):
+        self.path = path or ""
+        self._events: list[dict] = []
+        self._t0 = time.time()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.path) and M.is_logging_process()
+
+    def emit(self, event: dict) -> None:
+        """Record one typed event and rewrite the JSONL file atomically."""
+        if not self.enabled:
+            return
+        if "event" not in event:
+            raise ValueError(f"telemetry event missing its 'event' type key: {event}")
+        import json
+
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils.checkpoint import (
+            _atomic_write,
+        )
+
+        row = dict(event)
+        row.setdefault("t_s", round(time.time() - self._t0, 6))
+        self._events.append(_sanitize(row))
+        payload = "".join(json.dumps(e, allow_nan=False) + "\n" for e in self._events)
+        _atomic_write(self.path, payload.encode())
+
+
+def manifest_event(config=None, *, mesh=None, run_type: str = "") -> dict:
+    """The once-per-run provenance record: config, topology, software versions.
+
+    ``config`` is any of the frozen config dataclasses (snapshotted field-by-field);
+    ``mesh`` the jax Mesh when the trainer has one (axis names + sizes).
+    """
+    try:
+        import jaxlib
+        jaxlib_version = jaxlib.__version__
+    except Exception:  # pragma: no cover - jaxlib always ships with jax
+        jaxlib_version = None
+    devs = jax.devices()
+    ev = {
+        "event": "manifest",
+        "schema_version": SCHEMA_VERSION,
+        "run_type": run_type or (type(config).__name__ if config is not None else ""),
+        "unix_time": time.time(),
+        "platform": devs[0].platform,
+        "device_kind": getattr(devs[0], "device_kind", devs[0].platform),
+        "device_count": len(devs),
+        "local_device_count": jax.local_device_count(),
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib_version,
+        "python_version": platform.python_version(),
+    }
+    if mesh is not None:
+        ev["mesh"] = {"axis_names": list(mesh.axis_names),
+                      "shape": {str(k): int(v) for k, v in mesh.shape.items()}}
+    if config is not None and dataclasses.is_dataclass(config):
+        cfg = dataclasses.asdict(config)
+        ev["config"] = cfg
+        ev["precision"] = {"bf16": bool(cfg.get("bf16", False)),
+                           "jax_enable_x64": bool(jax.config.jax_enable_x64)}
+    return ev
+
+
+def compiled_flops(compiled) -> float | None:
+    """Total FLOPs of ONE invocation of an AOT-compiled program, from XLA's
+    ``cost_analysis()`` — None when the backend doesn't report them."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):      # older jax: one dict per partition
+        cost = cost[0] if cost else {}
+    try:
+        flops = cost.get("flops")
+    except AttributeError:
+        return None
+    return float(flops) if flops and flops > 0 else None
+
+
+def aot_compile(jit_fn, *args) -> tuple[object | None, dict | None]:
+    """Time ``jit_fn.lower(*args).compile()`` — the compile/execute split.
+
+    Returns ``(compiled, {"lower_s", "compile_s", "flops"})``; the caller should
+    invoke ``compiled`` directly (the AOT program does not populate ``jit_fn``'s
+    cache, so calling the jit object afterwards would compile twice). ``args`` may
+    mix concrete arrays and ``jax.ShapeDtypeStruct``s. ``(None, None)`` when the
+    callee has no ``.lower`` (the cached-sharding compile wrappers) or lowering
+    fails — callers then fall back to the ordinary jit path with compile time
+    folded into the first epoch.
+    """
+    try:
+        t0 = time.perf_counter()
+        lowered = jit_fn.lower(*args)
+        lower_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t0
+    except Exception:
+        return None, None
+    return compiled, {"lower_s": lower_s, "compile_s": compile_s,
+                      "flops": compiled_flops(compiled)}
+
+
+def compile_event(fn_name: str, aot: dict, *, steps_per_call: int | None = None) -> dict:
+    """The ``compile`` event for one AOT-timed program."""
+    flops = aot.get("flops")
+    return {
+        "event": "compile",
+        "fn": fn_name,
+        "lower_s": _finite(aot.get("lower_s")),
+        "compile_s": _finite(aot.get("compile_s")),
+        "flops_per_call": _finite(flops),
+        "steps_per_call": steps_per_call,
+        "flops_per_step": _finite(flops / steps_per_call
+                                  if flops and steps_per_call else None),
+    }
+
+
+def epoch_event(epoch: int, *, examples: int, steps: int | None = None,
+                wall_s: float | None = None, execute_s: float | None = None,
+                eval_s: float | None = None, data_s: float | None = None,
+                compile_s: float | None = None, flops_per_step: float | None = None,
+                train_loss: float | None = None, val_loss: float | None = None,
+                mfu: float | None = None) -> dict:
+    """Per-epoch phase-timing record. ``execute_s`` is device execution of the epoch
+    program (closed by a host fetch, SURVEY.md §7c); ``wall_s`` the whole epoch
+    including host work; ``data_s`` index-plan/feed construction; ``compile_s`` the
+    AOT epoch-program compile (constant per run, repeated per event so each line is
+    self-contained)."""
+    ex = _finite(execute_s)
+    return {
+        "event": "epoch",
+        "epoch": int(epoch),
+        "examples": int(examples),
+        "steps": int(steps) if steps is not None else None,
+        "wall_s": _finite(wall_s),
+        "execute_s": ex,
+        "eval_s": _finite(eval_s),
+        "data_s": _finite(data_s),
+        "compile_s": _finite(compile_s),
+        "examples_per_s": _finite(examples / ex if ex else None),
+        "steps_per_s": _finite(steps / ex if ex and steps else None),
+        "flops_per_step": _finite(flops_per_step),
+        "train_loss": _finite(train_loss),
+        "val_loss": _finite(val_loss),
+        "mfu": _finite(mfu),
+    }
+
+
+def health_event(epoch: int, health, steps: int, *,
+                 param_norm: float | None = None) -> dict:
+    """The ``health`` event from a ``train.step.HealthStats`` carry (host-fetched
+    once per epoch). ``grad_norm`` is the per-step mean — the headline trajectory;
+    min/max bound the epoch."""
+    steps = max(int(steps), 1)
+    return {
+        "event": "health",
+        "epoch": int(epoch),
+        "steps": steps,
+        "grad_norm": _finite(float(health.grad_norm_sum) / steps),
+        "grad_norm_max": _finite(float(health.grad_norm_max)),
+        "loss_min": _finite(float(health.loss_min)),
+        "loss_max": _finite(float(health.loss_max)),
+        "loss_mean": _finite(float(health.loss_sum) / steps),
+        "param_norm": _finite(param_norm),
+    }
+
+
+def _l2_norm_program(tree):
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.optim import (
+        global_l2_norm as _norm,
+    )
+
+    return _norm(tree)
+
+
+_l2_norm_jit = jax.jit(_l2_norm_program)
+
+
+def global_l2_norm(tree) -> float:
+    """Global L2 norm of a pytree (param-norm for the health event; called once per
+    epoch, off the hot path; the formula is ``ops.optim.global_l2_norm`` — one
+    owner with the clip and the grad-norm accumulator). Runs as one jitted program
+    so sharded leaves (TP/FSDP states) reduce via compiler-inserted collectives —
+    eager ops on non-fully-addressable arrays would fail on a multi-host fleet.
+
+    On a multi-host fleet this IS an SPMD computation: every process must enter it.
+    The trainers therefore compute it whenever ``--health-stats`` is on — outside
+    the process-0 emission gate — and only process 0 emits the event."""
+    return float(jax.device_get(_l2_norm_jit(tree)))
+
+
+def estimate_mfu(flops_per_step: float | None, step_s: float | None) -> dict:
+    """Model-FLOP-utilization against the chip's published bf16 peak.
+
+    ``flops_per_step`` comes from ``compiled.cost_analysis()``, which prices the
+    post-SPMD-partitioning PER-DEVICE module — each device's share of the step —
+    so ``mfu`` divides the per-device achieved rate by ONE chip's peak. That is
+    the same quantity ``bench.py`` reports (global analytic FLOPs over
+    ``peak * devices``): the two conventions agree when work divides evenly, so
+    A-vs-B comparisons across telemetry and bench files compare like with like.
+    Uses ``utils.benchmarks.peak_flops`` (the committed spec-sheet table); ``mfu``
+    is None off-TPU or on an unknown device kind — never a guess.
+    """
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        peak_flops,
+    )
+
+    devs = jax.devices()
+    device_kind = getattr(devs[0], "device_kind", devs[0].platform)
+    achieved = (flops_per_step / step_s if flops_per_step and step_s else None)
+    peak = peak_flops(device_kind) if devs[0].platform == "tpu" else None
+    return {
+        "flops_per_step": _finite(flops_per_step),
+        "step_s": _finite(step_s),
+        "achieved_flops_per_s_per_device": _finite(achieved),
+        "device_kind": device_kind,
+        "devices": len(devs),
+        "peak_flops_per_s_per_device": _finite(peak),
+        "mfu": _finite(achieved / peak if achieved and peak else None),
+    }
+
+
+def mfu_event(flops_per_step: float | None, step_s: float | None) -> dict:
+    """The steady-state ``mfu`` event (emit once, with the best measured step time)."""
+    return {"event": "mfu", **estimate_mfu(flops_per_step, step_s)}

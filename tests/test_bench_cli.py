@@ -69,6 +69,22 @@ def test_transient_failure_then_success(bench, monkeypatch, capsys):
     assert "fallback_reason" not in payload
 
 
+def test_bench_emits_typed_telemetry_event(bench, monkeypatch, capsys, tmp_path):
+    """The bench artifact is one `"event": "bench"` line in the utils/telemetry.py
+    schema, and --telemetry PATH appends the same line to a JSONL file so
+    tools/telemetry_report.py can compare bench runs against training runs."""
+    _chip_alive(monkeypatch, bench)
+    good = json.dumps({"metric": "m", "value": 1.5, "unit": "s"})
+    _scripted(monkeypatch, bench, [(0, good + "\n", "")])
+    tele = tmp_path / "tele.jsonl"
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--telemetry", str(tele)])
+    assert bench.main() == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["event"] == "bench" and payload["value"] == 1.5
+    rows = [json.loads(l) for l in open(tele)]
+    assert rows == [payload]
+
+
 def test_hung_attempt_goes_straight_to_fallback(bench, monkeypatch, capsys):
     """A hung measurement child is abandoned still holding (or queued on) the exclusive
     TPU claim, so no further probe can succeed — the loop must skip the rest of the

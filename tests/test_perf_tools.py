@@ -51,6 +51,43 @@ def test_pipeline_bubble_tool_rejects_single_count():
     assert out.returncode != 0 and "distinct" in out.stderr
 
 
+def test_telemetry_report_on_real_trainer_output(tmp_path):
+    """End-to-end: a real single-trainer --telemetry file (produced in-process on a
+    tiny synthetic split) renders through the report CLI with the headline fields.
+    Schema-level coverage is tier-1 (tests/test_telemetry.py); this pins the tool
+    against ACTUAL trainer output, not a hand-written fixture."""
+    import numpy as np
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
+        Dataset, _normalize, _synthesize_split,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train import single
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+        SingleProcessConfig,
+    )
+
+    xs, ys = _synthesize_split(256, seed=500)
+    train = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+    xs, ys = _synthesize_split(100, seed=501)
+    test = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+    path = str(tmp_path / "run.jsonl")
+    cfg = SingleProcessConfig(
+        n_epochs=1, batch_size_train=64, batch_size_test=100, log_interval=2,
+        telemetry=path, health_stats=True,
+        results_dir=str(tmp_path / "results"), images_dir=str(tmp_path / "images"))
+    single.main(cfg, datasets=(train, test))
+
+    env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "telemetry_report.py"),
+         path, path],
+        capture_output=True, text=True, env=env, timeout=180, cwd=_REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "single run on" in out.stdout
+    assert "grad_norm" in out.stdout
+    assert "B/A" in out.stdout          # two files -> the comparison table renders
+
+
 def test_decode_analysis_tool(tmp_path):
     doc = _run_tool("bench_decode_analysis.py",
                     "--d-model", "64", "--layers", "2", "--heads", "4",

@@ -117,3 +117,30 @@ def test_save_metrics_jsonl_round_trips(tmp_path):
     save_metrics_jsonl(h, path)
     rows = [json.loads(l) for l in open(path)]
     assert rows[2] == {"kind": "train", "examples_seen": 192, "loss": None}
+
+
+def test_load_metrics_jsonl_is_the_save_inverse(tmp_path):
+    """The shared JSONL reader (metrics + telemetry files): loading what
+    save_metrics_jsonl wrote reproduces every row, including the NaN→null rule
+    (a diverged run loads as None losses, never a parse error)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.metrics import (
+        MetricsHistory, load_metrics_jsonl, save_metrics_jsonl,
+    )
+
+    h = MetricsHistory()
+    h.record_train(64, 2.3)
+    h.record_train(128, float("nan"))
+    h.record_test(128, 2.1)
+    path = str(tmp_path / "metrics.jsonl")
+    save_metrics_jsonl(h, path)
+
+    rows = load_metrics_jsonl(path)
+    assert rows == [
+        {"kind": "train", "examples_seen": 64, "loss": 2.3},
+        {"kind": "train", "examples_seen": 128, "loss": None},
+        {"kind": "test", "examples_seen": 128, "loss": 2.1},
+    ]
+    # Blank lines (hand-edited files) are tolerated; content rows are preserved.
+    with open(path, "a") as f:
+        f.write("\n")
+    assert load_metrics_jsonl(path) == rows

@@ -90,6 +90,30 @@ def test_maybe_profile_disabled_is_noop(tmp_path):
     assert not os.path.exists(log_dir)
 
 
+def test_maybe_profile_creates_log_dir_and_logs_path(tmp_path, capsys):
+    """The flag must work on a fresh results tree (log_dir created if missing) and
+    say where the trace went (metrics.log line)."""
+    log_dir = str(tmp_path / "fresh" / "nested" / "trace")
+    with maybe_profile(True, log_dir):
+        jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.ones(8)))
+    assert os.path.isdir(log_dir)
+    assert f"Saved profiler trace to {log_dir}" in capsys.readouterr().out
+
+
+def test_maybe_profile_gates_to_process_zero(tmp_path, monkeypatch):
+    """Every process tracing would write world-size duplicate traces; non-zero
+    processes must no-op (internal gating — call sites pass the bare flag)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        metrics as M,
+    )
+
+    monkeypatch.setattr(M, "is_logging_process", lambda: False)
+    log_dir = str(tmp_path / "trace")
+    with maybe_profile(True, log_dir):
+        pass
+    assert not os.path.exists(log_dir)
+
+
 class TestReplicaSyncCheck:
     """utils/determinism.py — the desync 'race detector' the reference lacks. The happy
     path runs in every 2-process fleet test; the failure branch is faked here (a real
@@ -290,3 +314,58 @@ def test_progress_bar_silent_when_not_a_tty():
     bar.update(4, loss=0.5)
     bar.close()
     assert stream.getvalue() == ""
+
+
+def test_progress_bar_silent_on_non_zero_process(monkeypatch):
+    """Only process 0 renders — a fleet must not draw world-size duplicate bars."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        metrics as M,
+    )
+
+    monkeypatch.setattr(M, "is_logging_process", lambda: False)
+    stream = _FakeTty()
+    bar = M.ProgressBar(4, stream=stream, min_interval_s=0.0)
+    bar.update(4, loss=0.5)
+    bar.close()
+    assert stream.buf == []
+
+
+def test_progress_bar_rate_limits_renders():
+    """Intermediate updates inside min_interval_s are dropped; the first update and
+    the final (n == total) one always render — the bar can never finish stale."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        metrics as M,
+    )
+
+    stream = _FakeTty()
+    bar = M.ProgressBar(100, stream=stream, min_interval_s=3600.0)
+    for _ in range(99):
+        bar.update(1)
+    assert len(stream.buf) == 1          # only the first update rendered
+    assert "1/100" in stream.buf[0]
+    bar.update(1)                        # n == total bypasses the rate limit
+    assert len(stream.buf) == 2
+    assert "100/100" in stream.buf[1]
+    bar.close()
+
+
+def test_progress_bar_pads_stale_tail():
+    """A shrinking line (loss dropping off, rate settling) must overwrite the
+    previous render completely: each \\r frame is padded to the prior length."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        metrics as M,
+    )
+
+    stream = _FakeTty()
+    bar = M.ProgressBar(3, stream=stream, min_interval_s=0.0)
+    bar.update(1, loss=123456.75)        # long line
+    bar.update(1)                        # shorter line: no loss field
+    bar.update(1)
+    frames = [f for f in stream.buf if f.startswith("\r")]
+    assert len(frames) == 3
+    assert "loss=123456.7500" in frames[0]
+    # The shorter second frame is padded out to the first frame's full width, so
+    # the stale loss tail is blanked rather than left behind on the tty.
+    assert len(frames[1]) == len(frames[0])
+    assert frames[1].endswith(" ")
+    assert "loss" not in frames[1]

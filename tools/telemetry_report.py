@@ -1,0 +1,188 @@
+"""Render telemetry JSONL (utils/telemetry.py) as a run summary or A-vs-B comparison.
+
+Input files are whatever the trainers' ``--telemetry PATH`` wrote (manifest /
+compile / epoch / health / mfu events), ``bench*.py --telemetry`` output (bench
+events), or the loss-curve ``metrics.jsonl`` companions (``kind`` rows) — all read
+through the one shared reader, ``utils.metrics.load_metrics_jsonl``.
+
+Usage::
+
+    python tools/telemetry_report.py results/run.jsonl            # one-run summary
+    python tools/telemetry_report.py a.jsonl b.jsonl              # A-vs-B table
+
+One run prints its manifest line, phase-timing/throughput summary, grad-norm
+trajectory, and any bench rows; two or more runs additionally print a side-by-side
+comparison table (compile_s, execute_s/epoch, examples/s, MFU, final losses) with
+the ratio of the last run against the first.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# Script-mode import path: ``python tools/telemetry_report.py`` puts tools/ on
+# sys.path, not the repo root the package lives in.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.metrics import (  # noqa: E402
+    load_metrics_jsonl,
+)
+
+
+def _median(xs: list) -> float | None:
+    xs = sorted(x for x in xs if x is not None)
+    if not xs:
+        return None
+    mid = len(xs) // 2
+    return xs[mid] if len(xs) % 2 else 0.5 * (xs[mid - 1] + xs[mid])
+
+
+def _fmt(x, digits: int = 4) -> str:
+    if x is None:
+        return "-"
+    if isinstance(x, float):
+        if x != 0 and (abs(x) >= 10000 or abs(x) < 0.001):
+            return f"{x:.3g}"
+        return f"{x:.{digits}g}" if abs(x) >= 1 else f"{x:.4f}"
+    return str(x)
+
+
+def summarize(path: str) -> dict:
+    """Reduce one telemetry/metrics JSONL file to the report's summary fields."""
+    rows = load_metrics_jsonl(path)
+    by_event: dict[str, list] = {}
+    for r in rows:
+        by_event.setdefault(r.get("event", r.get("kind", "?")), []).append(r)
+
+    s: dict = {"path": path, "label": os.path.basename(path), "events": len(rows)}
+
+    man = (by_event.get("manifest") or [None])[0]
+    if man:
+        mesh = man.get("mesh")
+        s["run"] = man.get("run_type") or "?"
+        s["device"] = f"{man.get('device_kind')} x{man.get('device_count')}"
+        s["processes"] = man.get("process_count")
+        s["mesh"] = (",".join(f"{k}={v}" for k, v in mesh["shape"].items())
+                     if mesh else None)
+        s["jax"] = man.get("jax_version")
+
+    epochs = by_event.get("epoch", [])
+    if epochs:
+        s["epochs"] = len(epochs)
+        s["compile_s"] = next((e.get("compile_s") for e in epochs
+                               if e.get("compile_s") is not None), None)
+        s["execute_s_per_epoch"] = _median([e.get("execute_s") for e in epochs])
+        s["examples_per_s"] = _median([e.get("examples_per_s") for e in epochs])
+        s["flops_per_step"] = next((e.get("flops_per_step") for e in epochs
+                                    if e.get("flops_per_step") is not None), None)
+        s["final_train_loss"] = epochs[-1].get("train_loss")
+        s["final_val_loss"] = epochs[-1].get("val_loss")
+    compiles = by_event.get("compile", [])
+    if compiles and s.get("compile_s") is None:
+        c = compiles[0]
+        if c.get("lower_s") is not None and c.get("compile_s") is not None:
+            s["compile_s"] = c["lower_s"] + c["compile_s"]
+        s.setdefault("flops_per_step", c.get("flops_per_step"))
+
+    mfus = by_event.get("mfu", [])
+    s["mfu"] = next((m.get("mfu") for m in reversed(mfus)
+                     if m.get("mfu") is not None),
+                    next((e.get("mfu") for e in reversed(epochs)
+                          if e.get("mfu") is not None), None))
+
+    health = by_event.get("health", [])
+    if health:
+        s["grad_norm_trajectory"] = [h.get("grad_norm") for h in health]
+        s["grad_norm_max"] = max((h.get("grad_norm_max") for h in health
+                                  if h.get("grad_norm_max") is not None),
+                                 default=None)
+        s["param_norm"] = health[-1].get("param_norm")
+
+    s["bench"] = [{"metric": b.get("metric"), "value": b.get("value"),
+                   "unit": b.get("unit"), "examples_per_s": b.get("examples_per_s"),
+                   "mfu": b.get("mfu_vs_bf16_peak")}
+                  for b in by_event.get("bench", [])]
+
+    # Loss-curve metrics.jsonl rows (the companion artifact) — final losses.
+    for kind, key in (("train", "final_train_loss"), ("test", "final_val_loss")):
+        pts = [r for r in by_event.get(kind, []) if "loss" in r]
+        if pts and s.get(key) is None:
+            s[key] = pts[-1]["loss"]
+    return s
+
+
+def print_summary(s: dict) -> None:
+    print(f"== {s['label']} ({s['events']} events)")
+    if s.get("run"):
+        mesh = f", mesh {s['mesh']}" if s.get("mesh") else ""
+        print(f"   {s['run']} run on {s['device']}{mesh}, "
+              f"{s['processes']} process(es), jax {s['jax']}")
+    if s.get("epochs"):
+        print(f"   epochs {s['epochs']}  compile_s {_fmt(s.get('compile_s'))}  "
+              f"execute_s/epoch {_fmt(s.get('execute_s_per_epoch'))}  "
+              f"examples/s {_fmt(s.get('examples_per_s'))}")
+        print(f"   flops/step {_fmt(s.get('flops_per_step'))}  "
+              f"mfu {_fmt(s.get('mfu'))}  "
+              f"train_loss {_fmt(s.get('final_train_loss'))}  "
+              f"val_loss {_fmt(s.get('final_val_loss'))}")
+    traj = s.get("grad_norm_trajectory")
+    if traj:
+        shown = " -> ".join(_fmt(g) for g in (traj if len(traj) <= 6
+                                              else traj[:3] + traj[-3:]))
+        print(f"   grad_norm {shown}  (max {_fmt(s.get('grad_norm_max'))}, "
+              f"param_norm {_fmt(s.get('param_norm'))})")
+    for b in s.get("bench", []):
+        extra = "".join(f"  {k} {_fmt(b[k])}" for k in ("examples_per_s", "mfu")
+                        if b.get(k) is not None)
+        print(f"   bench: {b['metric']}: {_fmt(b['value'])} {b['unit'] or ''}{extra}")
+    print()
+
+
+COMPARE_ROWS = [
+    ("compile_s", "compile_s"),
+    ("execute_s/epoch", "execute_s_per_epoch"),
+    ("examples/s", "examples_per_s"),
+    ("flops/step", "flops_per_step"),
+    ("mfu", "mfu"),
+    ("train_loss", "final_train_loss"),
+    ("val_loss", "final_val_loss"),
+]
+
+
+def print_comparison(summaries: list[dict]) -> None:
+    labels = [s["label"] for s in summaries]
+    width = max(12, *(len(l) for l in labels)) + 2
+    head = "metric".ljust(18) + "".join(l.rjust(width) for l in labels)
+    ratio = len(summaries) == 2
+    if ratio:
+        head += "B/A".rjust(10)
+    print(head)
+    print("-" * len(head))
+    for name, key in COMPARE_ROWS:
+        vals = [s.get(key) for s in summaries]
+        if all(v is None for v in vals):
+            continue
+        line = name.ljust(18) + "".join(_fmt(v).rjust(width) for v in vals)
+        if ratio and vals[0] and vals[1] is not None:
+            line += f"{vals[1] / vals[0]:.3f}x".rjust(10)
+        print(line)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("files", nargs="+", help="telemetry/metrics JSONL file(s)")
+    args = p.parse_args(argv)
+
+    summaries = [summarize(f) for f in args.files]
+    for s in summaries:
+        print_summary(s)
+    if len(summaries) > 1:
+        print_comparison(summaries)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
