@@ -19,6 +19,8 @@ gather ever matters. Shuffling follows the reference's two modes:
 
 from __future__ import annotations
 
+import queue as queue_mod
+import threading
 from typing import Iterator
 
 import numpy as np
@@ -49,23 +51,76 @@ def iter_plan_batches(dataset: Dataset, plan: np.ndarray, *,
         yield from pf
 
 
+def _device_prefetch_iter(base: Iterator, depth: int) -> Iterator:
+    """Double-buffered device feed: a daemon thread stages up to ``depth`` batches
+    ahead — host gather plus ``jax.device_put`` — while the consumer's current
+    batch is in flight, overlapping H2D transfer with compute (``depth=2`` is
+    classic double buffering). Order and values are exactly the base iterator's
+    (pinned in ``tests/test_data.py``); worker exceptions re-raise at the
+    consumer's next pull; abandoning the iterator early unblocks and stops the
+    worker."""
+    import jax
+
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for batch in base:
+                if not put(("item", tuple(jax.device_put(b) for b in batch))):
+                    return
+            put(("done", None))
+        except BaseException as e:               # re-raised by the consumer
+            put(("error", e))
+
+    thread = threading.Thread(target=worker, daemon=True, name="loader-prefetch")
+    thread.start()
+    try:
+        while True:
+            kind, payload = q.get()
+            if kind == "done":
+                return
+            if kind == "error":
+                raise payload
+            yield payload
+    finally:
+        stop.set()
+
+
 class BatchLoader:
     """Iterates (images, labels) numpy batches in a sampler-defined order.
 
     ``set_epoch`` mirrors ``train_loader.sampler.set_epoch(i)`` (reference
     ``src/train_dist.py:72``); for the single-process shuffle case the same mechanism provides
     the per-epoch reshuffle.
+
+    ``prefetch=N`` (0 = off, the default) inserts the double-buffered device
+    pipeline: batches arrive as device-resident ``jax.Array``s, gathered and
+    ``device_put`` N deep on a background thread while the consumer's batch is in
+    flight. Batch order and values are unchanged — only residency and overlap.
     """
 
     def __init__(self, dataset: Dataset, batch_size: int, *,
                  sampler: ShardedSampler | None = None, shuffle: bool = False,
-                 seed: int = 0, drop_last: bool = False):
+                 seed: int = 0, drop_last: bool = False, prefetch: int = 0):
         if sampler is not None and shuffle:
             raise ValueError("shuffle must be False when a sampler is given "
                              "(reference src/train_dist.py:41-42)")
+        if prefetch < 0:
+            raise ValueError(f"prefetch must be >= 0, got {prefetch}")
         self.dataset = dataset
         self.batch_size = batch_size
         self.drop_last = drop_last
+        self.prefetch = int(prefetch)
         self.sampler = sampler or ShardedSampler(
             len(dataset), num_replicas=1, rank=0, shuffle=shuffle, seed=seed)
         self._epoch = 0
@@ -78,6 +133,11 @@ class BatchLoader:
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        if self.prefetch:
+            return _device_prefetch_iter(self._host_iter(), self.prefetch)
+        return self._host_iter()
+
+    def _host_iter(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         from csed_514_project_distributed_training_using_pytorch_tpu.data import native
         if native.available():
             # Threads only pay off once a batch is memcpy-heavy; below that the native

@@ -11,7 +11,9 @@ autoregressive workload instead of an artificially-masked classifier:
   ``TransformerBlock`` as the classifier (same parameter layout, so the TP/FSDP/PP
   partition rules and the checkpoint format apply unchanged) with ``causal=True``.
 - ``init_cache`` / ``decode_step`` / ``generate``: incremental decoding with per-layer
-  K/V caches — one token's projections per step, attention against the cached prefix,
+  K/V caches — plus ``decode_step_slots`` / ``reset_slots``, the PER-SLOT-position
+  variant the continuous-batching serving engine (``serving/``) compiles exactly once
+  and drives forever — one token's projections per step, attention against the cached prefix,
   cache append via ``lax.dynamic_update_slice``. The sampling loop is a handful of
   ``lax.scan`` segments under ``jit`` (compiler-friendly: static shapes, each segment
   attending over a static prefix that grows by ``DECODE_SEGMENT`` — masked prefix
@@ -286,6 +288,93 @@ def decode_step(model: TransformerLM, params, cache: dict, ids_t: jax.Array,
     h = ops.layer_norm(h, params["ln_f_scale"], params["ln_f_bias"])
     logits = ops.dense(h, params["head_kernel"], params["head_bias"])
     return cache, ops.log_softmax(logits.astype(jnp.float32))
+
+
+def decode_step_slots(model: TransformerLM, params, cache: dict,
+                      ids_t: jax.Array, t: jax.Array
+                      ) -> tuple[dict, jax.Array]:
+    """One incremental step at PER-SLOT positions: ``ids_t: [B]``, ``t: [B]`` int32.
+
+    The serving engine's decode program (``serving/engine.py``): batch row ``b`` is
+    an independent decode SLOT at its own position ``t[b]``, so one fixed-shape
+    program advances every in-flight request one token regardless of their mix of
+    prompt/output lengths — the zero-retracing requirement of continuous batching.
+    Same per-position math as ``decode_step`` (pinned token-identical to sequential
+    ``generate`` in ``tests/test_serving.py``): each slot's K/V row is written at
+    its own position via a vmapped ``lax.dynamic_update_index_in_dim``, the causal
+    (and sliding-window) mask is per-slot ``pos <= t[b]``, and RoPE rotates each
+    slot by its own position. No ``prefix_len`` narrowing: slots sit at arbitrary
+    positions, so every step reads the full ``[B, S]`` cache — the serving cache
+    re-read is O(S) per token by design (fixed shapes beat a per-mix recompile).
+    """
+    b = ids_t.shape[0]
+    e, nh = model.embed_dim, model.num_heads
+    hd = e // nh
+    kvh = model.num_kv_heads or nh
+    rep = nh // kvh
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+
+    h = params["tok_embed"].astype(jnp.float32)[ids_t]           # [B, E]
+    if not model.rope:
+        h = h + params["pos_embed"].astype(jnp.float32)[t]       # gather per slot
+
+    # [S, KV, Dh] cache, [KV, Dh] row, scalar position — batched over slots.
+    write_row = jax.vmap(
+        lambda c, row, pos: lax.dynamic_update_index_in_dim(c, row, pos, 0))
+    pos = jnp.arange(model.seq_len)[None]                        # [1, S]
+    tb = t[:, None]                                              # [B, 1]
+    visible = pos <= tb
+    if model.attention_window:
+        visible &= tb - pos < model.attention_window
+    visible = visible[:, None, None, :]                          # [B, 1, 1, S]
+
+    for i in range(model.num_layers):
+        p = params[f"block_{i}"]
+        a = p["attn"]
+        x = ops.layer_norm(h, p["ln1_scale"], p["ln1_bias"])
+        if kvh == nh:
+            qkv = ops.dense(x, a["qkv_kernel"], a["qkv_bias"])    # [B, 3E]
+            q = qkv[:, :e].reshape(b, nh, hd)
+            k = qkv[:, e:2 * e].reshape(b, kvh, hd)
+            v = qkv[:, 2 * e:].reshape(b, kvh, hd)
+        else:  # GQA: split projections, kvh-head K/V (the smaller cache)
+            q = ops.dense(x, a["q_kernel"], a["q_bias"]).reshape(b, nh, hd)
+            kv = ops.dense(x, a["kv_kernel"], a["kv_bias"]).reshape(b, 2, kvh, hd)
+            k, v = kv[:, 0], kv[:, 1]
+        if model.rope:
+            # positions [B] on [B, H, D]: the batch dim takes apply_rotary's
+            # sequence slot, giving each slot its own rotation angle.
+            q = apply_rotary(q, t)
+            k = apply_rotary(k, t)
+        layer = cache[f"block_{i}"]
+        k_cache = write_row(layer["k"], k.astype(layer["k"].dtype), t)
+        v_cache = write_row(layer["v"], v.astype(layer["v"].dtype), t)
+        cache = {**cache, f"block_{i}": {"k": k_cache, "v": v_cache}}
+        qg = q.reshape(b, kvh, rep, hd)
+        scores = jnp.einsum("bgrd,bsgd->bgrs", qg * scale, k_cache)  # [B,G,R,S]
+        scores = jnp.where(visible, scores, MASK_VALUE)
+        weights = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bgrs,bsgd->bgrd", weights, v_cache).reshape(b, e)
+        h = h + ops.dense(attn, a["out_kernel"], a["out_bias"])
+
+        x = ops.layer_norm(h, p["ln2_scale"], p["ln2_bias"])
+        up = ops.gelu(ops.dense(x, p["mlp_up_kernel"], p["mlp_up_bias"]))
+        h = h + ops.dense(up, p["mlp_down_kernel"], p["mlp_down_bias"])
+
+    h = ops.layer_norm(h, params["ln_f_scale"], params["ln_f_bias"])
+    logits = ops.dense(h, params["head_kernel"], params["head_bias"])
+    return cache, ops.log_softmax(logits.astype(jnp.float32))
+
+
+def reset_slots(cache: dict, fresh: jax.Array) -> dict:
+    """Zero the K/V rows of the slots where ``fresh`` (``[B]`` bool) is set — slot
+    recycling for the serving engine. Correctness never depends on it (the per-slot
+    ``pos <= t`` mask already hides rows beyond a slot's position), but wiping a
+    recycled slot keeps its cache bit-identical to a freshly ``init_cache``'d one,
+    so the decode-parity invariant is checkable slot-by-slot at any time."""
+    def wipe(x):
+        return jnp.where(fresh[:, None, None, None], jnp.zeros((), x.dtype), x)
+    return jax.tree_util.tree_map(wipe, cache)
 
 
 def filter_logits(log_probs: jax.Array, *, top_k: int = 0,

@@ -1,0 +1,41 @@
+"""Serving: slot-based continuous batching over the KV-cache decoder.
+
+The training side of this repo compiles ONE program per epoch and never retraces;
+this package applies the same fixed-shape discipline to inference (DESIGN.md §11):
+
+- ``engine``     the continuous-batching core — one jitted decode program over a
+                 fixed ``[num_slots]`` batch, per-slot positions/caches/sampling
+                 params, requests admitted into freed slots between steps with
+                 zero retracing
+- ``scheduler``  thread-safe bounded request queue: backpressure (``QueueFull``),
+                 per-request deadlines enforced while queued
+- ``server``     the in-process front end: ``submit() -> Future``, a background
+                 decode loop, graceful drain on ``stop()``, and per-request
+                 TTFT/TPOT/queue-wait telemetry (``"event": "serve"`` JSONL)
+
+Load generator: ``tools/serve_loadgen.py``; report: ``tools/telemetry_report.py``.
+"""
+
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
+    Completion,
+    ContinuousBatchingEngine,
+    Request,
+    SamplingParams,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
+    QueueFull,
+    RequestQueue,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.server import (
+    Server,
+)
+
+__all__ = [
+    "Completion",
+    "ContinuousBatchingEngine",
+    "QueueFull",
+    "Request",
+    "RequestQueue",
+    "SamplingParams",
+    "Server",
+]

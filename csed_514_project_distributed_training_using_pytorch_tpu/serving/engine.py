@@ -1,0 +1,347 @@
+"""Continuous-batching decode engine: N requests through a fixed ``[num_slots]`` batch.
+
+The engine is the serving analog of the compiled-epoch trainers: exactly ONE jitted
+decode program, traced once, driven forever. Every source of per-request variation is
+DATA, never shape:
+
+- per-slot KV caches ``[num_slots, S, KV_H, Dh]`` written at each slot's own position
+  (``models.lm.decode_step_slots`` — a vmapped ``lax.dynamic_update_index_in_dim``);
+- per-slot position indices, prompt buffers, and length bounds;
+- per-request sampling params (greedy/temperature/top_k/top_p) as ``[num_slots]``
+  arrays — ``filter_logits_per_slot`` is the data-driven counterpart of
+  ``models.lm.filter_logits`` (whose k is a static Python int);
+- a done-mask: finished slots are freed host-side and refilled from the queue
+  between steps, so a mixed stream of lengths never changes a single shape.
+
+The host loop syncs once per step (the emitted ``[num_slots]`` token vector) — the
+admission decision between steps needs host control anyway, and that one fetch is the
+entire per-token host traffic. ``trace_count`` counts traces of the decode program;
+tests assert it stays at 1 across an arbitrary request mix (the zero-retracing
+contract, acceptance criterion of the serving PR).
+
+Prompts are teacher-forced through the same decode loop (prefill-as-decode, one
+token per step): position ``t < prompt_len`` emits the prompt token and still writes
+its K/V — exactly ``generate``'s prompt semantics, which is what makes the engine
+token-identical to sequential ``generate`` (the greedy-parity test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from csed_514_project_distributed_training_using_pytorch_tpu.models import lm as lm_mod
+from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
+    MASK_VALUE,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode policy. ``temperature <= 0`` decodes greedily; ``top_k = 0``
+    / ``top_p = 1.0`` disable those filters (``models.lm.filter_logits`` semantics,
+    applied after temperature scaling in the same compose order)."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+    def validate(self, vocab_size: int) -> None:
+        if not 0 <= self.top_k <= vocab_size:
+            raise ValueError(f"top_k {self.top_k} outside [0, {vocab_size}]")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p {self.top_p} outside (0, 1]")
+
+
+@dataclasses.dataclass
+class Request:
+    """One decode request. ``prompt`` is a ``[P]`` int32 slice of the TARGETS stream
+    (``generate``'s prompt convention: output positions ``0..P-1`` are forced to it,
+    its K/V populating the cache); ``max_new_tokens`` bounds the sampled suffix.
+    ``deadline_s``/``arrival_s`` are ``time.monotonic()`` stamps (absolute), set by
+    the server front end; both optional for direct engine use."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    sampling: SamplingParams = SamplingParams()
+    request_id: int = 0
+    deadline_s: float | None = None
+    arrival_s: float | None = None
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request: the emitted token stream (prompt prefix + generated
+    suffix) and its latency accounting, ready to serialize as one ``"serve"``
+    telemetry event. ``finish`` is ``"ok"`` or ``"timeout"`` (deadline hit — for a
+    mid-decode timeout ``tokens`` holds the partial stream)."""
+
+    request: Request
+    tokens: np.ndarray
+    finish: str
+    prompt_len: int
+    new_tokens: int
+    queue_wait_s: float | None = None
+    ttft_s: float | None = None       # arrival -> first GENERATED token
+    tpot_s: float | None = None       # mean inter-token time after the first
+    e2e_s: float | None = None        # arrival -> completion
+
+    @property
+    def ok(self) -> bool:
+        return self.finish == "ok"
+
+
+def filter_logits_per_slot(log_probs: jax.Array, top_k: jax.Array,
+                           top_p: jax.Array) -> jax.Array:
+    """Per-ROW top-k/top-p masking: ``top_k``/``top_p`` are ``[B]`` arrays, so one
+    compiled program serves any mix of sampling policies (``models.lm.filter_logits``
+    bakes k into the trace as a static int — fine for ``generate``, a retrace per
+    policy mix for a serving batch).
+
+    Same value-threshold semantics AND the same compose order as the static
+    version: the nucleus is computed over the top-k-MASKED (renormalized)
+    distribution, so row ``b`` keeps entries ``>=`` its k-th largest
+    (``top_k[b] = 0`` keeps all) and, of those, ``>=`` the smallest member of the
+    renormalized top-p nucleus (``top_p[b] = 1.0`` keeps every survivor carrying
+    probability mass; zero-mass entries may be masked, which cannot change a
+    categorical draw). Masked entries become ``MASK_VALUE``; row-by-row agreement
+    with ``filter_logits`` is pinned in ``tests/test_serving.py``.
+    """
+    v = log_probs.shape[-1]
+    sorted_lp = jnp.sort(log_probs, axis=-1)[..., ::-1]          # descending
+    k = jnp.where(top_k > 0, top_k, v)
+    kth = jnp.take_along_axis(sorted_lp, jnp.clip(k[:, None] - 1, 0, v - 1),
+                              axis=-1)
+    out = jnp.where(log_probs < kth, MASK_VALUE, log_probs)
+    # Nucleus over the top-k survivors (masked entries sort last with ~0 mass) —
+    # filter_logits applies its filters sequentially, and so must this.
+    sorted_masked = jnp.sort(out, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_masked, axis=-1)
+    before = jnp.cumsum(probs, axis=-1) - probs                  # exclusive mass
+    kept = before < top_p[:, None]                               # argmax always kept
+    thresh = jnp.min(jnp.where(kept, sorted_masked, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(out < thresh, MASK_VALUE, out)
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching over ``models.lm``'s KV-cache decoder.
+
+    Per-slot scalars (positions, lengths, sampling params, the active mask) live
+    host-side as numpy rows and are passed into the jitted step each call — O(B)
+    H2D per step, the control plane. The two [.., seq_len]-sized tensors — KV
+    cache and prompt buffer — live on DEVICE across steps (the cache donated
+    through the step, the prompt scatter-updated on admission), so per-token H2D
+    traffic never scales with seq_len. Admission is a few host writes plus one
+    [S]-row scatter; never a retrace of the decode program.
+
+    Single-threaded by design: the ``serving.server.Server`` front end serializes
+    all engine access on its loop thread; tests drive ``run()`` directly.
+    """
+
+    def __init__(self, model: lm_mod.TransformerLM, params, *, num_slots: int,
+                 seed: int = 0):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.model = model
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self.num_slots = int(num_slots)
+        self.trace_count = 0          # traces of the decode program (tests pin == 1)
+        self.steps = 0                # decode steps executed
+        self.slot_steps = 0           # sum of active slots over steps (occupancy)
+        self._key = jax.random.PRNGKey(seed)
+        self._cache = lm_mod.init_cache(model, self.num_slots)
+        b, s = self.num_slots, model.seq_len
+        self._ids = np.full((b,), model.vocab_size - 1, np.int32)   # BOS
+        self._t = np.zeros((b,), np.int32)
+        self._active = np.zeros((b,), bool)
+        # The prompt buffer is DEVICE-resident like the cache: it is [B, S] (the
+        # one per-slot tensor that scales with seq_len), so re-transferring it
+        # every step would put O(B*S) H2D on the per-token path. Admission
+        # scatters just the admitted slot's [S] row via a small jitted update
+        # (a separate program from the decode step — trace_count counts decode).
+        self._prompt = jnp.zeros((b, s), jnp.int32)
+        self._set_prompt_row = jax.jit(
+            lambda buf, slot, row: buf.at[slot].set(row), donate_argnums=(0,))
+        self._prompt_len = np.zeros((b,), np.int32)
+        self._total_len = np.zeros((b,), np.int32)
+        self._temp = np.zeros((b,), np.float32)
+        self._top_k = np.zeros((b,), np.int32)
+        self._top_p = np.ones((b,), np.float32)
+        self._requests: list[Request | None] = [None] * b
+        self._out: list[list[int]] = [[] for _ in range(b)]
+        self._admit_s = np.zeros((b,), np.float64)
+        self._first_tok_s: list[float | None] = [None] * b
+        # The cache (arg 1 after params) is donated: each step's updated cache
+        # reuses the previous buffer instead of allocating a second full copy —
+        # on the serving path the KV cache IS the memory footprint.
+        self._step_jit = jax.jit(self._step_program, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------ program
+
+    def _step_program(self, params, cache, ids, t, fresh, prompt, prompt_len,
+                      temp, top_k, top_p, key):
+        """THE decode program: advance all ``num_slots`` slots one position.
+
+        Every argument is fixed-shape, so this traces exactly once per engine
+        (``trace_count`` is the proof). Freed-then-reused slots (``fresh``) are
+        wiped first; sampling is per-slot data; prompt positions are forced.
+        """
+        self.trace_count += 1         # Python side effect: fires per TRACE only
+        model = self.model
+        # Wipe recycled slots only on admission steps: a lax.cond keeps the wipe
+        # INSIDE the one compiled program (both branches trace once — trace_count
+        # stays 1) while steady-state steps skip the O(cache) where() entirely.
+        cache = jax.lax.cond(jnp.any(fresh),
+                             lambda c: lm_mod.reset_slots(c, fresh),
+                             lambda c: c, cache)
+        cache, log_probs = lm_mod.decode_step_slots(model, params, cache, ids, t)
+        # BOS is input-only, exactly as in generate() — mask it before any rule.
+        log_probs = log_probs.at[:, model.vocab_size - 1].set(MASK_VALUE)
+        safe_temp = jnp.where(temp > 0.0, temp, 1.0)
+        scaled = filter_logits_per_slot(log_probs / safe_temp[:, None],
+                                        top_k, top_p)
+        sampled = jax.random.categorical(key, scaled, axis=-1)
+        greedy = jnp.argmax(log_probs, axis=-1)
+        tok = jnp.where(temp > 0.0, sampled, greedy)
+        forced = jnp.take_along_axis(
+            prompt, jnp.clip(t, 0, model.seq_len - 1)[:, None], axis=1)[:, 0]
+        return cache, jnp.where(t < prompt_len, forced, tok).astype(jnp.int32)
+
+    # ------------------------------------------------------------------ slots
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self._requests)
+
+    def free_slots(self) -> list[int]:
+        return [i for i in range(self.num_slots) if self._requests[i] is None]
+
+    def validate(self, request: Request) -> int:
+        """Admission-control check (shared with the server's submit path so callers
+        fail fast, before queueing). Returns the request's total stream length."""
+        request.sampling.validate(self.model.vocab_size)
+        p = len(request.prompt)
+        if p >= self.model.seq_len:
+            raise ValueError(f"prompt length {p} fills the model's seq_len "
+                             f"{self.model.seq_len} — nothing left to generate")
+        if request.max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {request.max_new_tokens}")
+        return min(p + request.max_new_tokens, self.model.seq_len)
+
+    def admit(self, slot: int, request: Request, *,
+              now: float | None = None) -> None:
+        """Bind ``request`` to a free slot: host array writes only (no recompile,
+        no device traffic — the cache wipe rides the next step's ``fresh`` mask)."""
+        if self._requests[slot] is not None:
+            raise ValueError(f"slot {slot} is occupied")
+        total = self.validate(request)
+        now = time.monotonic() if now is None else now
+        p = len(request.prompt)
+        self._requests[slot] = request
+        self._active[slot] = True
+        self._ids[slot] = self.model.vocab_size - 1              # BOS restart
+        self._t[slot] = 0
+        row = np.zeros((self.model.seq_len,), np.int32)
+        if p:
+            row[:p] = np.asarray(request.prompt, np.int32)
+        self._prompt = self._set_prompt_row(self._prompt, np.int32(slot), row)
+        self._prompt_len[slot] = p
+        self._total_len[slot] = total
+        self._temp[slot] = request.sampling.temperature
+        self._top_k[slot] = request.sampling.top_k
+        self._top_p[slot] = request.sampling.top_p
+        self._out[slot] = []
+        self._admit_s[slot] = now
+        self._first_tok_s[slot] = None
+        if request.arrival_s is None:
+            request.arrival_s = now
+
+    def _finish(self, slot: int, finish: str, now: float) -> Completion:
+        req = self._requests[slot]
+        tokens = np.asarray(self._out[slot], np.int32)
+        plen = int(self._prompt_len[slot])
+        new = max(len(tokens) - plen, 0)
+        arrival = req.arrival_s if req.arrival_s is not None else self._admit_s[slot]
+        first = self._first_tok_s[slot]
+        comp = Completion(
+            request=req, tokens=tokens, finish=finish,
+            prompt_len=plen, new_tokens=new,
+            queue_wait_s=self._admit_s[slot] - arrival,
+            ttft_s=None if first is None else first - arrival,
+            tpot_s=(now - first) / (new - 1)
+            if first is not None and new > 1 else None,
+            e2e_s=now - arrival)
+        self._requests[slot] = None
+        self._active[slot] = False
+        self._out[slot] = []
+        self._first_tok_s[slot] = None
+        return comp
+
+    # ------------------------------------------------------------------ stepping
+
+    def step(self) -> list[Completion]:
+        """Advance every in-flight slot one token; return the requests that
+        finished this step. One host sync (the ``[num_slots]`` token fetch)."""
+        if self.num_active == 0:
+            return []
+        self._key, sub = jax.random.split(self._key)
+        fresh = self._active & (self._t == 0)
+        self._cache, tok = self._step_jit(
+            self.params, self._cache, self._ids, self._t, fresh, self._prompt,
+            self._prompt_len, self._temp, self._top_k, self._top_p, sub)
+        tok = np.asarray(tok)                        # the per-step host sync
+        now = time.monotonic()
+        self.steps += 1
+        self.slot_steps += self.num_active
+        done: list[Completion] = []
+        for i in range(self.num_slots):
+            if not self._active[i]:
+                continue
+            self._out[i].append(int(tok[i]))
+            if self._first_tok_s[i] is None and self._t[i] >= self._prompt_len[i]:
+                self._first_tok_s[i] = now
+            self._t[i] += 1
+            self._ids[i] = tok[i]
+            if self._t[i] >= self._total_len[i]:
+                done.append(self._finish(i, "ok", now))
+        return done
+
+    def expire(self, now: float | None = None) -> list[Completion]:
+        """Force-finish in-flight requests whose deadline passed
+        (``finish="timeout"``, partial tokens) — the mid-decode half of the
+        per-request timeout contract (queued expiry lives in the scheduler)."""
+        now = time.monotonic() if now is None else now
+        return [self._finish(i, "timeout", now)
+                for i, req in enumerate(self._requests)
+                if req is not None and req.deadline_s is not None
+                and now > req.deadline_s]
+
+    @property
+    def slot_occupancy(self) -> float | None:
+        """Mean fraction of slots active per executed step (batching efficiency)."""
+        return self.slot_steps / (self.steps * self.num_slots) if self.steps else None
+
+    def run(self, requests: list[Request], *,
+            max_steps: int | None = None) -> list[Completion]:
+        """Serve ``requests`` FIFO to completion — the minimal drive loop (tests,
+        offline batch decode). The threaded front end is ``serving.server.Server``."""
+        pending = list(requests)
+        out: list[Completion] = []
+        budget = max_steps
+        while pending or self.num_active:
+            for slot in self.free_slots():
+                if not pending:
+                    break
+                self.admit(slot, pending.pop(0))
+            out.extend(self.step())
+            if budget is not None:
+                budget -= 1
+                if budget <= 0 and (pending or self.num_active):
+                    raise RuntimeError(f"engine did not drain in {max_steps} steps")
+        return out

@@ -1,0 +1,101 @@
+"""Thread-safe request admission: a bounded FIFO queue with deadlines and backpressure.
+
+The scheduler is deliberately small — slot placement is trivial (any free slot; all
+slots are identical because shapes are fixed), so the scheduling problem reduces to
+the queue discipline:
+
+- **backpressure** — ``submit`` on a full queue raises ``QueueFull`` immediately
+  (the caller sheds load or retries with its own policy; the serving loop never
+  buffers unboundedly);
+- **deadlines** — each request may carry an absolute ``deadline_s``
+  (``time.monotonic()`` clock); requests that expire while QUEUED are surfaced by
+  ``take`` as rejects without ever touching a slot (mid-decode expiry is the
+  engine's ``expire``);
+- **drain** — ``close()`` refuses new work while ``take`` keeps handing out what
+  was already accepted, which is exactly the graceful-shutdown contract the server
+  builds on.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
+    Request,
+)
+
+
+class QueueFull(RuntimeError):
+    """Backpressure signal: the bounded request queue is at capacity."""
+
+
+class RequestQueue:
+    """FIFO of pending ``Request``s shared between submitter threads and the
+    serving loop. ``max_pending = 0`` means unbounded (no backpressure)."""
+
+    def __init__(self, max_pending: int = 0):
+        if max_pending < 0:
+            raise ValueError(f"max_pending must be >= 0, got {max_pending}")
+        self.max_pending = int(max_pending)
+        self._dq: collections.deque[Request] = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._dq)
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    def submit(self, request: Request) -> None:
+        """Enqueue or refuse — never blocks. Raises ``QueueFull`` (backpressure)
+        or ``RuntimeError`` after ``close()`` (drain in progress)."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("queue is closed (server draining)")
+            if self.max_pending and len(self._dq) >= self.max_pending:
+                raise QueueFull(
+                    f"request queue at capacity ({self.max_pending} pending)")
+            self._dq.append(request)
+            self._cond.notify_all()
+
+    def take(self, now: float, max_n: int) -> tuple[list[Request], list[Request]]:
+        """Pop up to ``max_n`` admittable requests, FIFO. Returns
+        ``(admitted, expired)`` — ``expired`` are requests whose deadline passed
+        while queued (they consume no slot and no decode step; the caller owns
+        rejecting them to their submitters)."""
+        admitted: list[Request] = []
+        expired: list[Request] = []
+        with self._cond:
+            while self._dq and len(admitted) < max_n:
+                req = self._dq.popleft()
+                if req.deadline_s is not None and now > req.deadline_s:
+                    expired.append(req)
+                else:
+                    admitted.append(req)
+        return admitted, expired
+
+    def force_deadline(self, deadline_s: float) -> None:
+        """Clamp every queued request's deadline (the server's ``drain=False``
+        shutdown: a past-dated deadline turns the drain into an expiry sweep)."""
+        with self._cond:
+            for req in self._dq:
+                req.deadline_s = (deadline_s if req.deadline_s is None
+                                  else min(req.deadline_s, deadline_s))
+
+    def close(self) -> None:
+        """Stop accepting new requests; queued ones still drain via ``take``."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def wait_for_work(self, timeout: float) -> bool:
+        """Block until the queue is non-empty or closed (the serving loop's idle
+        wait); returns True if there is queued work."""
+        with self._cond:
+            self._cond.wait_for(lambda: self._dq or self._closed, timeout=timeout)
+            return bool(self._dq)

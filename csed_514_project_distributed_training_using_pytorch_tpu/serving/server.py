@@ -1,0 +1,235 @@
+"""In-process serving front end: thread-safe ``submit() -> Future`` over the engine.
+
+One background thread owns the engine (single-writer — no locking inside the decode
+loop); submitter threads only touch the scheduler queue and their futures. The loop:
+
+1. reject requests that expired while queued (scheduler ``take``) and in-flight
+   requests past their deadline (engine ``expire``) — both resolve their futures
+   with ``finish="timeout"`` completions;
+2. admit queued requests into freed slots (host array writes, zero retracing);
+3. run one engine step when any slot is live, else block on the queue's condition;
+4. on ``stop()`` (graceful drain): the queue closes — new ``submit``s fail fast —
+   while everything already accepted decodes to completion, then the loop emits the
+   ``serve_summary`` aggregate and exits.
+
+Telemetry: one ``"event": "serve"`` JSONL line per finished request (TTFT/TPOT,
+queue wait, e2e, tokens/s) plus a final ``"event": "serve_summary"`` with
+p50/p95/p99 percentiles and aggregate throughput — PR 1's schema, written in the
+writer's STREAM mode (per-request volume is O(requests); the atomic-rewrite mode is
+O(epochs) by design and would go quadratic here).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+import time
+
+import numpy as np
+
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
+    Completion,
+    ContinuousBatchingEngine,
+    Request,
+    SamplingParams,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
+    RequestQueue,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+    telemetry as T,
+)
+
+
+class Server:
+    """Continuous-batching serving loop around a ``ContinuousBatchingEngine``.
+
+    ``telemetry`` is a JSONL path (a stream-mode ``TelemetryWriter`` is created)
+    or an existing writer; empty/None disables emission. ``default_timeout_s``
+    applies to requests submitted without an explicit ``timeout_s``.
+    """
+
+    def __init__(self, engine: ContinuousBatchingEngine, *, max_pending: int = 0,
+                 default_timeout_s: float | None = None,
+                 telemetry: str | T.TelemetryWriter | None = None,
+                 idle_wait_s: float = 0.05):
+        self.engine = engine
+        self.queue = RequestQueue(max_pending)
+        self._default_timeout_s = default_timeout_s
+        self._writer = (telemetry if isinstance(telemetry, T.TelemetryWriter)
+                        else T.TelemetryWriter(telemetry, stream=True))
+        self._idle_wait_s = idle_wait_s
+        self._futures: dict[int, concurrent.futures.Future] = {}
+        self._futures_lock = threading.Lock()
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._started_s: float | None = None
+        self._abort = False           # stop(drain=False): loop-owned expiry sweep
+        self._error: BaseException | None = None
+        # Running aggregates only — a long-lived server must not retain per-request
+        # Completions (token arrays) for the drain-time summary. The four latency
+        # series are float lists (the percentile inputs), everything else scalars.
+        self._counts = {"requests": 0, "ok": 0, "timeout": 0, "new_tokens": 0}
+        self._series: dict[str, list] = {"ttft_s": [], "tpot_s": [],
+                                         "e2e_s": [], "queue_wait_s": []}
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def start(self) -> "Server":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._started_s = time.monotonic()
+        self._writer.emit(T.manifest_event(run_type="serve"))
+        self._writer.emit({
+            "event": "serve_config",
+            "num_slots": self.engine.num_slots,
+            "seq_len": self.engine.model.seq_len,
+            "vocab_size": self.engine.model.vocab_size,
+            "max_pending": self.queue.max_pending,
+            "default_timeout_s": self._default_timeout_s,
+        })
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-loop")
+        self._thread.start()
+        return self
+
+    def stop(self, *, drain: bool = True, timeout: float | None = None) -> None:
+        """Graceful shutdown: refuse new requests, then (``drain=True``) decode
+        everything already accepted to completion before the loop exits.
+        ``drain=False`` additionally expires all queued + in-flight requests at
+        the next loop pass (their futures resolve as timeouts, partial tokens)."""
+        if not drain:
+            # The LOOP thread performs the expiry sweep (it owns the engine):
+            # setting the flag from here would race the admission path.
+            self._abort = True
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("serving loop did not drain in time")
+            self._thread = None
+        if self._error is not None:
+            raise RuntimeError("serving loop died") from self._error
+
+    def __enter__(self) -> "Server":
+        return self.start() if self._thread is None else self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------ submit
+
+    def submit(self, prompt, *, max_new_tokens: int,
+               sampling: SamplingParams = SamplingParams(),
+               timeout_s: float | None = None) -> concurrent.futures.Future:
+        """Thread-safe enqueue. Returns a Future resolving to a ``Completion``
+        (``finish`` tells ok from timeout). Raises ``QueueFull`` (backpressure)
+        or ``ValueError`` (admission control: oversized prompt, bad sampling
+        params) immediately, in the caller's thread."""
+        now = time.monotonic()
+        timeout_s = self._default_timeout_s if timeout_s is None else timeout_s
+        with self._id_lock:
+            rid = self._next_id
+            self._next_id += 1
+        req = Request(
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=int(max_new_tokens), sampling=sampling,
+            request_id=rid, arrival_s=now,
+            deadline_s=None if timeout_s is None else now + timeout_s)
+        self.engine.validate(req)                # fail fast, before queueing
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self._futures_lock:
+            self._futures[rid] = fut
+        try:
+            self.queue.submit(req)
+        except BaseException:
+            with self._futures_lock:
+                self._futures.pop(rid, None)
+            raise
+        return fut
+
+    # ------------------------------------------------------------------ loop
+
+    def _resolve(self, comp: Completion) -> None:
+        self._counts["requests"] += 1
+        self._counts["ok"] += comp.ok
+        self._counts["timeout"] += comp.finish == "timeout"
+        self._counts["new_tokens"] += comp.new_tokens
+        for name in self._series:
+            self._series[name].append(getattr(comp, name))
+        self._writer.emit(T.serve_event(
+            request_id=comp.request.request_id, prompt_len=comp.prompt_len,
+            new_tokens=comp.new_tokens, finish=comp.finish,
+            queue_wait_s=comp.queue_wait_s, ttft_s=comp.ttft_s,
+            tpot_s=comp.tpot_s, e2e_s=comp.e2e_s))
+        with self._futures_lock:
+            fut = self._futures.pop(comp.request.request_id, None)
+        if fut is not None:
+            fut.set_result(comp)
+
+    def _reject_expired(self, req: Request, now: float) -> None:
+        self._resolve(Completion(
+            request=req, tokens=np.zeros((0,), np.int32), finish="timeout",
+            prompt_len=len(req.prompt), new_tokens=0,
+            queue_wait_s=now - req.arrival_s if req.arrival_s else None,
+            e2e_s=now - req.arrival_s if req.arrival_s else None))
+
+    def _loop(self) -> None:
+        try:
+            self._loop_body()
+        except BaseException as e:
+            # The loop thread must never die silently: outstanding futures would
+            # hang their waiters forever. Fail them all, refuse new work, record
+            # the error for stop() to re-raise.
+            self._error = e
+            self.queue.close()
+            now = time.monotonic()
+            _, expired = self.queue.take(now, 1 << 30)
+            with self._futures_lock:
+                futures = list(self._futures.values())
+                self._futures.clear()
+            for fut in futures:
+                if not fut.done():
+                    fut.set_exception(e)
+        finally:
+            try:
+                self._emit_summary()
+            finally:
+                self._writer.close()
+
+    def _loop_body(self) -> None:
+        eng = self.engine
+        while True:
+            now = time.monotonic()
+            if self._abort:
+                # stop(drain=False): loop-owned sweep — past-date every accepted
+                # request (in-flight AND queued); re-run each pass so nothing
+                # admitted in between escapes it.
+                for req in eng._requests:
+                    if req is not None:
+                        req.deadline_s = now - 1.0
+                self.queue.force_deadline(now - 1.0)
+            for comp in eng.expire(now):
+                self._resolve(comp)
+            admitted, expired = self.queue.take(now, len(eng.free_slots()))
+            for req in expired:
+                self._reject_expired(req, now)
+            for slot, req in zip(eng.free_slots(), admitted):
+                eng.admit(slot, req, now=now)
+            if eng.num_active:
+                for comp in eng.step():
+                    self._resolve(comp)
+            elif len(self.queue) == 0 and self.queue.closed:
+                break
+            else:
+                self.queue.wait_for_work(self._idle_wait_s)
+
+    def _emit_summary(self) -> None:
+        wall_s = (time.monotonic() - self._started_s
+                  if self._started_s is not None else None)
+        self._writer.emit(T.serve_summary_event(
+            **self._counts, wall_s=wall_s,
+            steps=self.engine.steps,
+            slot_occupancy=self.engine.slot_occupancy,
+            **self._series))

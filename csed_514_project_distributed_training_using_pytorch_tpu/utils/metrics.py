@@ -71,19 +71,34 @@ def save_metrics_jsonl(history: MetricsHistory, path: str) -> str | None:
 def load_metrics_jsonl(path: str) -> list[dict]:
     """Read-side inverse of ``save_metrics_jsonl``: one dict per non-blank line.
 
-    This is the ONE JSONL reader — loss-curve metrics and the telemetry event
-    stream (``utils/telemetry.py``) share it, so ``tools/telemetry_report.py``
-    consumes both file kinds through the same code path. Strict JSON: the writers'
-    NaN→null rule means a diverged run loads as ``None`` losses, never a parse
-    error."""
+    This is the ONE JSONL reader — loss-curve metrics, the training telemetry
+    stream, and the serving logs (``utils/telemetry.py``) all share it, so
+    ``tools/telemetry_report.py`` consumes every file kind through the same code
+    path. Two deliberate tolerances keep that sharing honest:
+
+    - **unknown event types pass through untouched** — the reader never filters or
+      interprets the ``event``/``kind`` keys, so a serve log, a training log, or a
+      future event type all load as plain dicts and consumers pick what they know;
+    - **a torn FINAL line is skipped** — the stream-mode writer
+      (``TelemetryWriter(path, stream=True)``) appends per event, so a killed
+      serving process can leave a partial trailing line; everything before it
+      still loads. A malformed line anywhere EARLIER is still an error (atomic
+      writers can't produce one — that file is corrupt, not torn).
+    """
     import json
 
     rows = []
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                rows.append(json.loads(line))
+        lines = [l.strip() for l in f]
+    for i, line in enumerate(lines):
+        if not line:
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break
+            raise
     return rows
 
 

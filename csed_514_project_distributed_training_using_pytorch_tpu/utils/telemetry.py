@@ -23,6 +23,10 @@ structured layer every perf PR proves its numbers through:
                  the chip's published peak (``utils/benchmarks.py``)
   ``bench``      one line per ``bench*.py`` measurement (same schema, comparable to
                  training runs in ``tools/telemetry_report.py``)
+  ``serve``      one line per served request (``serving/server.py``): TTFT/TPOT,
+                 queue wait, e2e latency, tokens/s, finish reason
+  ``serve_summary``  once per serving run at drain: request counts, aggregate
+                 tokens/s, slot occupancy, p50/p95/p99 latency percentiles
   =============  =====================================================================
 
 - **writer** — ``TelemetryWriter`` is process-0 gated (a fleet writes ONE file) and
@@ -30,7 +34,10 @@ structured layer every perf PR proves its numbers through:
   ``_atomic_write``), so a reader never observes a torn line and a killed run keeps
   every event emitted before the kill. Event volume is O(epochs), not O(steps) —
   rewriting is cheap by construction, because anything per-step would be a host sync
-  the compiled-epoch design exists to delete.
+  the compiled-epoch design exists to delete. The serving path is the exception:
+  its volume is O(requests), so ``TelemetryWriter(path, stream=True)`` appends one
+  flushed line per emit instead of rewriting — a kill can tear at most the final
+  line, which ``metrics.load_metrics_jsonl`` tolerates (torn-tail rule).
 
 Read side: ``utils.metrics.load_metrics_jsonl`` (shared with the loss-curve JSONL);
 renderer: ``tools/telemetry_report.py``.
@@ -75,10 +82,19 @@ class TelemetryWriter:
 
     ``path`` empty/None disables everything — every ``emit`` is then a no-op, so
     trainers call unconditionally and the off path costs a truthiness check.
+
+    ``stream=True`` switches to append-per-emit (one flushed line each event, file
+    truncated at the first emit): the serving path's mode, where event volume is
+    O(requests) and the atomic full rewrite would go quadratic. A kill can tear at
+    most the trailing line; the shared reader skips exactly that.
     """
 
-    def __init__(self, path: str | None):
+    def __init__(self, path: str | None, *, stream: bool = False):
         self.path = path or ""
+        self.stream = bool(stream)
+        self._fh = None
+        self._truncated = False       # stream mode: first open truncates, later
+                                      # reopens (emit after close) append
         self._events: list[dict] = []
         self._t0 = time.time()
 
@@ -87,12 +103,14 @@ class TelemetryWriter:
         return bool(self.path) and M.is_logging_process()
 
     def emit(self, event: dict) -> None:
-        """Record one typed event and rewrite the JSONL file atomically."""
+        """Record one typed event; rewrite the JSONL atomically (default) or
+        append+flush the one line (``stream=True``)."""
         if not self.enabled:
             return
         if "event" not in event:
             raise ValueError(f"telemetry event missing its 'event' type key: {event}")
         import json
+        import os
 
         from csed_514_project_distributed_training_using_pytorch_tpu.utils.checkpoint import (
             _atomic_write,
@@ -100,9 +118,34 @@ class TelemetryWriter:
 
         row = dict(event)
         row.setdefault("t_s", round(time.time() - self._t0, 6))
-        self._events.append(_sanitize(row))
+        row = _sanitize(row)
+        if self.stream:
+            # No in-memory event log here: stream mode exists for O(requests)
+            # volume, and the disk line IS the record. Reopening after close()
+            # appends — a writer shared across serving runs must never truncate
+            # lines it already flushed.
+            if self._fh is None:
+                os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+                self._fh = open(self.path, "a" if self._truncated else "w")
+                self._truncated = True
+            self._fh.write(json.dumps(row, allow_nan=False) + "\n")
+            self._fh.flush()
+            return
+        self._events.append(row)
         payload = "".join(json.dumps(e, allow_nan=False) + "\n" for e in self._events)
         _atomic_write(self.path, payload.encode())
+
+    def close(self) -> None:
+        """Release the stream-mode file handle (no-op otherwise)."""
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def manifest_event(config=None, *, mesh=None, run_type: str = "") -> dict:
@@ -306,3 +349,63 @@ def estimate_mfu(flops_per_step: float | None, step_s: float | None) -> dict:
 def mfu_event(flops_per_step: float | None, step_s: float | None) -> dict:
     """The steady-state ``mfu`` event (emit once, with the best measured step time)."""
     return {"event": "mfu", **estimate_mfu(flops_per_step, step_s)}
+
+
+def percentiles(xs, qs=(50, 95, 99)) -> dict | None:
+    """Nearest-rank percentiles of the non-None values, as ``{"p50": ..., ...}`` —
+    the serving events' latency-summary convention (shared with the report CLI so
+    both sides agree on the estimator). None when no values survive."""
+    xs = sorted(x for x in xs if x is not None)
+    if not xs:
+        return None
+    return {f"p{q}": _finite(xs[max(0, math.ceil(q / 100 * len(xs)) - 1)])
+            for q in qs}
+
+
+def serve_event(*, request_id: int, prompt_len: int, new_tokens: int, finish: str,
+                queue_wait_s: float | None = None, ttft_s: float | None = None,
+                tpot_s: float | None = None, e2e_s: float | None = None) -> dict:
+    """One served request (``serving/server.py``): the per-request latency record.
+    ``tokens_per_s`` is request-local decode throughput — generated tokens over the
+    time since admission (e2e minus queue wait)."""
+    decode_s = (e2e_s - queue_wait_s
+                if e2e_s is not None and queue_wait_s is not None else None)
+    return {
+        "event": "serve",
+        "request_id": int(request_id),
+        "prompt_len": int(prompt_len),
+        "new_tokens": int(new_tokens),
+        "finish": finish,
+        "queue_wait_s": _finite(queue_wait_s),
+        "ttft_s": _finite(ttft_s),
+        "tpot_s": _finite(tpot_s),
+        "e2e_s": _finite(e2e_s),
+        "tokens_per_s": _finite(new_tokens / decode_s
+                                if new_tokens and decode_s else None),
+    }
+
+
+def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int,
+                        wall_s: float | None, steps: int | None = None,
+                        slot_occupancy: float | None = None,
+                        ttft_s=(), tpot_s=(), e2e_s=(), queue_wait_s=()) -> dict:
+    """The once-per-run serving aggregate, emitted at drain: counts, aggregate
+    tokens/s over the server's whole wall clock, slot occupancy, and p50/p95/p99
+    of each latency series (the per-request ``serve`` lines remain the raw data —
+    the summary is what survives a truncated log and what A-vs-B compares)."""
+    return {
+        "event": "serve_summary",
+        "requests": int(requests),
+        "ok": int(ok),
+        "timeout": int(timeout),
+        "new_tokens": int(new_tokens),
+        "wall_s": _finite(wall_s),
+        "tokens_per_s": _finite(new_tokens / wall_s
+                                if new_tokens and wall_s else None),
+        "steps": int(steps) if steps is not None else None,
+        "slot_occupancy": _finite(slot_occupancy),
+        "ttft_s": percentiles(ttft_s),
+        "tpot_s": percentiles(tpot_s),
+        "e2e_s": percentiles(e2e_s),
+        "queue_wait_s": percentiles(queue_wait_s),
+    }

@@ -334,3 +334,76 @@ def test_report_cli_reads_loss_curve_metrics_jsonl(tmp_path):
     M.save_metrics_jsonl(h, path)
     out = _run_report(path)
     assert "metrics.jsonl (3 events)" in out
+
+
+# -----------------------------------------------------------------------------------------
+# Shared-reader tolerances + the serving stream mode (serving PR satellites)
+# -----------------------------------------------------------------------------------------
+
+
+def test_load_metrics_jsonl_passes_unknown_event_types_through(tmp_path):
+    """Serve logs and training logs share one reader: event types the reader has
+    never heard of load as plain dicts, untouched and in order."""
+    path = str(tmp_path / "mixed.jsonl")
+    rows = [{"event": "epoch", "epoch": 0, "wall_s": 1.0},
+            {"event": "some_future_event", "payload": {"x": [1, 2]}},
+            {"event": "serve", "request_id": 0, "finish": "ok"}]
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    assert M.load_metrics_jsonl(path) == rows
+
+
+def test_load_metrics_jsonl_skips_torn_final_line_only(tmp_path):
+    """Stream-mode writers (the serving path) append per event, so a kill can
+    tear the trailing line: everything before it still loads. A malformed line
+    anywhere EARLIER means corruption and still raises."""
+    torn = str(tmp_path / "torn.jsonl")
+    with open(torn, "w") as f:
+        f.write('{"event": "serve", "request_id": 0}\n')
+        f.write('{"event": "serve", "request_')          # killed mid-write
+    assert M.load_metrics_jsonl(torn) == [{"event": "serve", "request_id": 0}]
+
+    corrupt = str(tmp_path / "corrupt.jsonl")
+    with open(corrupt, "w") as f:
+        f.write('not json at all\n')
+        f.write('{"event": "serve", "request_id": 0}\n')
+    with pytest.raises(json.JSONDecodeError):
+        M.load_metrics_jsonl(corrupt)
+
+
+def test_stream_writer_appends_per_emit_and_round_trips(tmp_path):
+    """TelemetryWriter(stream=True): one flushed line per emit (no rewrite), the
+    same sanitize rule (NaN -> null), process-0 gating, close() releases."""
+    path = str(tmp_path / "serve.jsonl")
+    with T.TelemetryWriter(path, stream=True) as w:
+        w.emit({"event": "serve", "request_id": 0, "ttft_s": 0.5})
+        first_size = os.path.getsize(path)
+        w.emit({"event": "serve", "request_id": 1, "ttft_s": float("nan")})
+        assert os.path.getsize(path) > first_size        # appended, not rewritten
+    rows = M.load_metrics_jsonl(path)
+    assert [r["request_id"] for r in rows] == [0, 1]
+    assert rows[1]["ttft_s"] is None
+
+
+def test_stream_writer_gates_to_process_zero(tmp_path, monkeypatch):
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    path = str(tmp_path / "gated.jsonl")
+    w = T.TelemetryWriter(path, stream=True)
+    w.emit({"event": "serve"})
+    w.close()
+    assert not os.path.exists(path)
+
+
+def test_serve_event_and_summary_schema():
+    ev = T.serve_event(request_id=3, prompt_len=4, new_tokens=8, finish="ok",
+                       queue_wait_s=0.1, ttft_s=0.2, tpot_s=0.01, e2e_s=0.5)
+    assert ev["event"] == "serve" and ev["finish"] == "ok"
+    assert ev["tokens_per_s"] == pytest.approx(8 / 0.4)  # e2e minus queue wait
+    summ = T.serve_summary_event(
+        requests=4, ok=3, timeout=1, new_tokens=30, wall_s=2.0, steps=40,
+        slot_occupancy=0.75, ttft_s=[0.1, 0.2, 0.3, None],
+        tpot_s=[0.01] * 4, e2e_s=[0.5] * 4, queue_wait_s=[0.0] * 4)
+    assert summ["tokens_per_s"] == pytest.approx(15.0)
+    assert summ["ttft_s"] == {"p50": 0.2, "p95": 0.3, "p99": 0.3}
+    assert T.percentiles([]) is None

@@ -1,0 +1,239 @@
+"""Load generator for the in-process serving engine: open/closed loop, Poisson arrivals.
+
+Drives ``serving.Server`` (slot-based continuous batching over the KV-cache decoder)
+with a reproducible synthetic workload and leaves a serve-telemetry JSONL behind for
+``tools/telemetry_report.py``:
+
+- **open loop** (``--mode open``): requests arrive on a Poisson process at
+  ``--rate`` req/s regardless of completions — the latency-under-load probe (an
+  overloaded server shows up as queue-wait/TTFT growth, and past ``--max-pending``
+  as rejected requests, i.e. backpressure);
+- **closed loop** (``--mode closed``): ``--concurrency`` clients each keep exactly
+  one request in flight — the throughput probe (tokens/s at a fixed offered
+  parallelism).
+
+The prompt/length mix is sampled per request from ``--prompt-lens`` and
+``[1, --max-new-tokens]`` under a seeded RNG, so an A-vs-B pair of runs offers
+byte-identical workloads. Params come from a training checkpoint
+(``--checkpoint results/model_lm.ckpt`` — either a full TrainState or a
+params-only export) or a seeded random init when omitted (pure perf mode).
+
+Usage::
+
+    python tools/serve_loadgen.py --requests 32 --mode open --rate 16 \\
+        --num-slots 8 --telemetry results/serve.jsonl
+    python tools/serve_loadgen.py --requests 32 --mode closed --concurrency 8 \\
+        --checkpoint results/model_lm.ckpt --telemetry results/serve.jsonl
+    python tools/telemetry_report.py results/serve.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+# Script-mode import path: ``python tools/serve_loadgen.py`` puts tools/ on
+# sys.path, not the repo root the package lives in.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def build_model_and_params(args):
+    """The decode model under test + its params (checkpoint or seeded init)."""
+    import jax
+    import jax.numpy as jnp
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import lm
+
+    model = lm.TransformerLM(
+        vocab_size=args.num_levels + 1, seq_len=args.seq_len,
+        embed_dim=args.embed_dim, num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        num_kv_heads=args.kv_heads or None,
+        attention_window=args.attention_window, rope=args.rope)
+    ref = model.init({"params": jax.random.PRNGKey(args.seed)},
+                     jnp.zeros((1, model.seq_len), jnp.int32))["params"]
+    if not args.checkpoint:
+        return model, ref
+    from flax import serialization
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        checkpoint,
+    )
+
+    with open(args.checkpoint, "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    if isinstance(raw, dict) and "params" in raw:     # full TrainState checkpoint
+        return model, serialization.from_state_dict(jax.device_get(ref),
+                                                    raw["params"])
+    # params-only export: the one checkpoint reader the repo already has
+    return model, checkpoint.load_params(args.checkpoint, jax.device_get(ref))
+
+
+def make_workload(args, vocab_size):
+    """The seeded request mix: ``[(prompt, max_new, sampling), ...]``."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        SamplingParams,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    lens = [int(x) for x in args.prompt_lens.split(",") if x != ""]
+    bad = [l for l in lens if not 0 <= l < args.seq_len]
+    if bad:
+        raise SystemExit(f"--prompt-lens entries outside [0, seq_len): {bad}")
+    sampling = SamplingParams(temperature=args.temperature, top_k=args.top_k,
+                              top_p=args.top_p)
+    specs = []
+    for _ in range(args.requests):
+        p = int(rng.choice(lens))
+        prompt = rng.integers(0, vocab_size - 1, size=p).astype(np.int32)
+        new = int(rng.integers(1, args.max_new_tokens + 1))
+        specs.append((prompt, new, sampling))
+    return specs
+
+
+def run_open_loop(server, specs, rate, rng):
+    """Poisson arrivals at ``rate`` req/s; returns (futures, rejected_count)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        QueueFull,
+    )
+
+    futures, rejected = [], 0
+    for prompt, new, sampling in specs:
+        time.sleep(float(rng.exponential(1.0 / rate)))
+        try:
+            futures.append(server.submit(prompt, max_new_tokens=new,
+                                         sampling=sampling))
+        except QueueFull:
+            rejected += 1                       # backpressure: load is shed, not queued
+    return futures, rejected
+
+
+def run_closed_loop(server, specs, concurrency):
+    """``concurrency`` clients, each one request in flight; returns
+    ``(futures, rejected_count)`` — backpressure sheds the request, the client
+    moves on (mirrors the open loop's accounting)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        QueueFull,
+    )
+
+    it = iter(specs)
+    lock = threading.Lock()
+    futures: list = []
+    rejected = [0]
+
+    def client():
+        while True:
+            with lock:
+                spec = next(it, None)
+            if spec is None:
+                return
+            prompt, new, sampling = spec
+            try:
+                fut = server.submit(prompt, max_new_tokens=new, sampling=sampling)
+            except QueueFull:
+                with lock:
+                    rejected[0] += 1
+                continue
+            with lock:
+                futures.append(fut)
+            fut.result()                        # keep exactly one in flight
+
+    threads = [threading.Thread(target=client, name=f"loadgen-{i}")
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return futures, rejected[0]
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    m = p.add_argument_group("model")
+    m.add_argument("--checkpoint", default="",
+                   help="TrainState or params msgpack from train.lm (default: "
+                        "seeded random init — pure perf mode)")
+    m.add_argument("--seq-len", type=int, default=784)
+    m.add_argument("--num-levels", type=int, default=16)
+    m.add_argument("--embed-dim", type=int, default=64)
+    m.add_argument("--num-layers", type=int, default=2)
+    m.add_argument("--num-heads", type=int, default=4)
+    m.add_argument("--kv-heads", type=int, default=0)
+    m.add_argument("--attention-window", type=int, default=0)
+    m.add_argument("--rope", action="store_true")
+    e = p.add_argument_group("engine/server")
+    e.add_argument("--num-slots", type=int, default=8)
+    e.add_argument("--max-pending", type=int, default=128)
+    e.add_argument("--timeout-s", type=float, default=0.0,
+                   help="per-request deadline, 0 = none")
+    g = p.add_argument_group("load")
+    g.add_argument("--mode", choices=("open", "closed"), default="open")
+    g.add_argument("--rate", type=float, default=8.0,
+                   help="open loop: Poisson arrival rate, req/s")
+    g.add_argument("--concurrency", type=int, default=4,
+                   help="closed loop: clients with one request in flight each")
+    g.add_argument("--requests", type=int, default=32)
+    g.add_argument("--prompt-lens", default="0,16,64",
+                   help="comma list; each request draws uniformly from it")
+    g.add_argument("--max-new-tokens", type=int, default=32,
+                   help="each request draws its length from [1, this]")
+    g.add_argument("--temperature", type=float, default=0.0)
+    g.add_argument("--top-k", type=int, default=0)
+    g.add_argument("--top-p", type=float, default=1.0)
+    g.add_argument("--seed", type=int, default=0)
+    p.add_argument("--telemetry", default="",
+                   help="serve JSONL path (render with tools/telemetry_report.py)")
+    args = p.parse_args(argv)
+    if args.mode == "open" and args.rate <= 0:
+        raise SystemExit("--rate must be > 0 in open-loop mode")
+    if args.mode == "closed" and args.concurrency < 1:
+        raise SystemExit("--concurrency must be >= 1 in closed-loop mode")
+    if args.max_new_tokens < 1:
+        raise SystemExit("--max-new-tokens must be >= 1")
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        ContinuousBatchingEngine,
+        Server,
+    )
+
+    model, params = build_model_and_params(args)
+    specs = make_workload(args, model.vocab_size)
+    engine = ContinuousBatchingEngine(model, params, num_slots=args.num_slots,
+                                      seed=args.seed)
+    server = Server(engine, max_pending=args.max_pending,
+                    default_timeout_s=args.timeout_s or None,
+                    telemetry=args.telemetry)
+    server.start()
+    t0 = time.monotonic()
+    if args.mode == "open":
+        futures, rejected = run_open_loop(server, specs, args.rate,
+                                          np.random.default_rng(args.seed + 1))
+    else:
+        futures, rejected = run_closed_loop(server, specs, args.concurrency)
+    comps = [f.result() for f in futures]
+    server.stop()                               # graceful drain (a no-op by now)
+    wall = time.monotonic() - t0
+
+    ok = sum(c.ok for c in comps)
+    timeouts = sum(c.finish == "timeout" for c in comps)
+    new_tokens = sum(c.new_tokens for c in comps)
+    print(f"{args.mode}-loop: {len(comps)} completed ({ok} ok, {timeouts} timeout, "
+          f"{rejected} rejected) in {wall:.2f}s")
+    occ = engine.slot_occupancy                 # None when no step ever ran
+    print(f"generated {new_tokens} tokens, {new_tokens / wall:.1f} tokens/s, "
+          f"slot occupancy {'-' if occ is None else f'{occ:.2f}'}, "
+          f"decode compilations {engine.trace_count}")
+    if args.telemetry:
+        print(f"serve telemetry -> {args.telemetry} "
+              f"(render: python tools/telemetry_report.py {args.telemetry})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

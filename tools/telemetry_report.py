@@ -2,8 +2,11 @@
 
 Input files are whatever the trainers' ``--telemetry PATH`` wrote (manifest /
 compile / epoch / health / mfu events), ``bench*.py --telemetry`` output (bench
-events), or the loss-curve ``metrics.jsonl`` companions (``kind`` rows) — all read
-through the one shared reader, ``utils.metrics.load_metrics_jsonl``.
+events), serving logs from ``serving/server.py`` / ``tools/serve_loadgen.py``
+(serve / serve_summary events — rendered as a TTFT/TPOT/e2e latency-percentile
+table plus aggregate tokens/s), or the loss-curve ``metrics.jsonl`` companions
+(``kind`` rows) — all read through the one shared reader,
+``utils.metrics.load_metrics_jsonl``, which passes unknown event types through.
 
 Usage::
 
@@ -29,6 +32,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.metrics import (  # noqa: E402
     load_metrics_jsonl,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.telemetry import (  # noqa: E402
+    percentiles as _percentiles,
+)
+
+
+SERVE_SERIES = ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s")
+SERVE_QS = (50, 95, 99)
 
 
 def _median(xs: list) -> float | None:
@@ -105,6 +115,40 @@ def summarize(path: str) -> dict:
                    "mfu": b.get("mfu_vs_bf16_peak")}
                   for b in by_event.get("bench", [])]
 
+    # Serving runs: per-request percentiles from the raw serve lines; aggregate
+    # throughput/occupancy from the drain-time summary when present (a truncated
+    # log still renders from whatever serve lines survived).
+    serves = by_event.get("serve", [])
+    summary = (by_event.get("serve_summary") or [None])[-1]
+    if serves:
+        s["serve_requests"] = len(serves)
+        s["serve_ok"] = sum(r.get("finish") == "ok" for r in serves)
+        s["serve_timeout"] = sum(r.get("finish") == "timeout" for r in serves)
+        for name in SERVE_SERIES:
+            # The one estimator (utils.telemetry.percentiles): report-side
+            # percentiles from raw serve lines agree with the summary event's.
+            pcts = _percentiles([r.get(name) for r in serves], qs=SERVE_QS) or {}
+            for q in SERVE_QS:
+                s[f"serve_{name}_p{q}"] = pcts.get(f"p{q}")
+    if summary:
+        s.setdefault("serve_requests", summary.get("requests"))
+        s.setdefault("serve_ok", summary.get("ok"))
+        s.setdefault("serve_timeout", summary.get("timeout"))
+        s["serve_tokens_per_s"] = summary.get("tokens_per_s")
+        s["serve_occupancy"] = summary.get("slot_occupancy")
+        for name in SERVE_SERIES:          # summary percentiles fill any gaps
+            pcts = summary.get(name) or {}
+            for q in SERVE_QS:
+                s.setdefault(f"serve_{name}_p{q}", pcts.get(f"p{q}"))
+    elif serves:
+        # No summary (killed run): aggregate tokens/s over the serve lines' span.
+        toks = sum(r.get("new_tokens") or 0 for r in serves)
+        ts = [r.get("t_s") for r in serves if r.get("t_s") is not None]
+        starts = [r["t_s"] - r["e2e_s"] for r in serves
+                  if r.get("t_s") is not None and r.get("e2e_s") is not None]
+        span = max(ts) - min(starts) if ts and starts else None
+        s["serve_tokens_per_s"] = toks / span if toks and span else None
+
     # Loss-curve metrics.jsonl rows (the companion artifact) — final losses.
     for kind, key in (("train", "final_train_loss"), ("test", "final_val_loss")):
         pts = [r for r in by_event.get(kind, []) if "loss" in r]
@@ -137,6 +181,20 @@ def print_summary(s: dict) -> None:
         extra = "".join(f"  {k} {_fmt(b[k])}" for k in ("examples_per_s", "mfu")
                         if b.get(k) is not None)
         print(f"   bench: {b['metric']}: {_fmt(b['value'])} {b['unit'] or ''}{extra}")
+    if s.get("serve_requests"):
+        occ = (f"  occupancy {_fmt(s['serve_occupancy'])}"
+               if s.get("serve_occupancy") is not None else "")
+        print(f"   serve: {s['serve_requests']} requests "
+              f"({_fmt(s.get('serve_ok'))} ok, {_fmt(s.get('serve_timeout'))} "
+              f"timeout)  tokens/s {_fmt(s.get('serve_tokens_per_s'))}{occ}")
+        head = "   " + "".ljust(14) + "".join(f"p{q}".rjust(12) for q in SERVE_QS)
+        print(head)
+        for name in SERVE_SERIES:
+            vals = [s.get(f"serve_{name}_p{q}") for q in SERVE_QS]
+            if all(v is None for v in vals):
+                continue
+            print("   " + name.ljust(14)
+                  + "".join(_fmt(v).rjust(12) for v in vals))
     print()
 
 
@@ -148,6 +206,12 @@ COMPARE_ROWS = [
     ("mfu", "mfu"),
     ("train_loss", "final_train_loss"),
     ("val_loss", "final_val_loss"),
+    ("serve tokens/s", "serve_tokens_per_s"),
+    ("ttft_s p50", "serve_ttft_s_p50"),
+    ("ttft_s p99", "serve_ttft_s_p99"),
+    ("tpot_s p50", "serve_tpot_s_p50"),
+    ("e2e_s p95", "serve_e2e_s_p95"),
+    ("queue_wait p95", "serve_queue_wait_s_p95"),
 ]
 
 
