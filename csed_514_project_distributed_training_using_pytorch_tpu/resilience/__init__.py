@@ -1,0 +1,84 @@
+"""Fault tolerance: supervised fleets, heartbeats, preemption, fault injection.
+
+The layer that turns launch-and-pray into supervised checkpoint-restart training
+(SURVEY.md §5's missing half): ``supervisor`` watches a fleet and restarts it from the
+newest *valid* checkpoint; ``heartbeat`` is the liveness signal that tells slow from
+hung; ``preemption`` converts SIGTERM into a cooperative stop with a durable checkpoint
+and a distinct resumable exit status; ``faults`` injects every one of those failure
+modes deterministically so the whole story is testable on localhost.
+
+``RunHooks`` is the trainers' four-line wiring surface: flag-gated, host-side only
+(the compiled epoch program is untouched — same discipline as ``--health-stats``), and
+zero-cost when every flag is off (the hooks then never even read ``state.step``, so no
+device sync is added)."""
+
+from __future__ import annotations
+
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (  # noqa: F401
+    faults,
+    heartbeat,
+    preemption,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience.preemption import (  # noqa: F401
+    EXIT_PREEMPTED,
+    Preempted,
+)
+
+
+class RunHooks:
+    """Per-trainer resilience wiring: heartbeat ticks, fault ticks, preemption checks.
+
+    Everything is host-side epoch-boundary code. With ``heartbeat_dir`` empty,
+    ``handle_preemption`` off, and no ``RESILIENCE_FAULTS`` armed, every method is a
+    couple of attribute checks — in particular ``state.step`` is never fetched, so
+    the flag-off trainer performs the identical host and device work as before."""
+
+    def __init__(self, *, heartbeat_dir: str = "", handle_preemption: bool = False,
+                 process_index: int = 0):
+        self.heartbeat = (heartbeat.HeartbeatWriter(heartbeat_dir,
+                                                    process_index=process_index)
+                          if heartbeat_dir else None)
+        self.preemption = preemption.install() if handle_preemption else None
+
+    @property
+    def active(self) -> bool:
+        return self.heartbeat is not None or faults.active()
+
+    def uninstall(self) -> None:
+        """Restore the signal handlers (trainers call this from their teardown
+        ``finally``) — an in-process caller's SIGTERM/SIGINT semantics must not
+        outlive the run that installed the latch."""
+        if self.preemption is not None:
+            self.preemption.uninstall()
+
+    def epoch_tick(self, state, epoch: int) -> None:
+        """Call at the top of each epoch: beat the heartbeat, apply armed faults.
+        No-op (without touching ``state``) unless a heartbeat or fault is armed."""
+        if not self.active:
+            return
+        step = int(state.step)                  # host sync — epoch-boundary only
+        faults.on_tick(step=step, epoch=epoch)
+        if self.heartbeat is not None and not faults.heartbeat_frozen(step=step,
+                                                                      epoch=epoch):
+            self.heartbeat.beat(step=step, epoch=epoch)
+
+    def check_preempt(self, *, epoch: int, state, checkpoint: str = "",
+                      tele=None, save=None) -> None:
+        """Honor a pending preemption request at an epoch boundary: run ``save`` (for
+        trainers whose per-epoch checkpoint is not already durable at this point),
+        emit the telemetry ``preempt`` event, leave a final ``status=preempted``
+        heartbeat, and raise :class:`Preempted`. No-op when nothing was requested."""
+        if self.preemption is None or not self.preemption.requested:
+            return
+        step = int(state.step)
+        if save is not None:
+            save()
+        if tele is not None and tele.enabled:
+            from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+                telemetry as T,
+            )
+            tele.emit(T.preempt_event(epoch=epoch, step=step, checkpoint=checkpoint))
+        if self.heartbeat is not None:
+            self.heartbeat.beat(step=step, epoch=epoch,
+                                status=heartbeat.STATUS_PREEMPTED)
+        raise Preempted(step, checkpoint)

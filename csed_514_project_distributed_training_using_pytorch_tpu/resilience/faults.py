@@ -1,0 +1,182 @@
+"""Deterministic fault injection — the failure modes of SURVEY.md §5, on demand.
+
+The reference's failure story is untestable by construction: a dead VM simply hangs the
+gloo world forever, so "what happens when a worker dies" can only be answered by
+unplugging a machine. Here every failure mode the resilience layer claims to survive is
+injectable, deterministically, from the environment — which is exactly what an OS-level
+fault needs to be, because the faulting process is a *different process* from the test
+that arranged it (the launcher's children inherit the environment, so one env var
+reaches the whole fleet).
+
+``RESILIENCE_FAULTS`` holds ``;``-separated specs, each ``kind:key=value[,key=value...]``::
+
+    RESILIENCE_FAULTS="kill:proc=1,step=8,flag=/tmp/f;torn:match=ckpt_00000008"
+
+Kinds (all host-side — faults never touch the compiled program):
+
+``kill``
+    ``os._exit(exit)`` at the first resilience tick where the trigger holds — a hard
+    crash mid-run (no atexit, no flushes: the honest SIGKILL/OOM analog).
+``preempt``
+    ``SIGTERM`` to the ticking process itself — a deterministic stand-in for the cloud
+    scheduler's preemption notice (the cooperative-stop path, resilience/preemption.py).
+``freeze``
+    heartbeat emission stops while the process keeps running — the "hung, not slow"
+    case the supervisor's staleness detector exists for.
+``torn``
+    checkpoint bytes are truncated to half on write (hooked into the checkpoint
+    writer's ``_atomic_write``) — the torn-write artifact the manifest's checksum
+    validation must refuse to resume from.
+
+Trigger keys: ``proc`` (``JAX_PROCESS_ID`` to match; default: every process), ``step`` /
+``epoch`` (tick-path kinds only — fire when the tick's value is >= the threshold;
+unset = immediately; rejected on ``torn``, whose write path has no tick to compare),
+``match`` (path substring, ``torn`` only — required there), ``exit`` (``kill``'s exit
+code, default 41),
+``flag`` (a marker-file path: the fault fires at most ONCE per process — the marker is
+created on firing with a per-process suffix, so a restarted run that replays the same
+step does not re-fire; without ``flag`` the fault fires every time the trigger holds).
+
+Everything here is env-gated: with ``RESILIENCE_FAULTS`` unset, ``active()`` is one dict
+lookup and every hook is a no-op — production code paths pay nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import signal
+import sys
+
+ENV_VAR = "RESILIENCE_FAULTS"
+
+KINDS = ("kill", "preempt", "freeze", "torn")
+DEFAULT_KILL_EXIT = 41
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    kind: str
+    proc: int | None = None     # None: any process
+    step: int | None = None     # fire when tick step >= this
+    epoch: int | None = None    # fire when tick epoch >= this
+    flag: str = ""              # marker file: fire at most once per process
+    exit: int = DEFAULT_KILL_EXIT
+    match: str = ""             # path substring (torn)
+
+
+def active() -> bool:
+    """True iff fault injection is armed (the zero-cost gate every hook checks)."""
+    return bool(os.environ.get(ENV_VAR))
+
+
+@functools.lru_cache(maxsize=8)
+def _parse(spec: str) -> tuple[Fault, ...]:
+    faults = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, rest = part.partition(":")
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} in {ENV_VAR} "
+                             f"(known: {', '.join(KINDS)})")
+        kwargs: dict = {"kind": kind}
+        for kv in filter(None, rest.split(",")):
+            key, _, value = kv.partition("=")
+            if key in ("proc", "step", "epoch", "exit"):
+                kwargs[key] = int(value)
+            elif key in ("flag", "match"):
+                kwargs[key] = value
+            else:
+                raise ValueError(f"unknown fault key {key!r} in {ENV_VAR} spec {part!r}")
+        fault = Fault(**kwargs)
+        if fault.kind == "torn":
+            # Torn faults fire on the WRITE path, which has no tick step/epoch to
+            # compare against — a step/epoch key would silently never trigger.
+            if fault.step is not None or fault.epoch is not None:
+                raise ValueError(f"torn faults trigger by path match, not by tick "
+                                 f"— drop step/epoch from {part!r}")
+            if not fault.match:
+                raise ValueError(f"torn fault needs a match= path substring: {part!r}")
+        faults.append(fault)
+    return tuple(faults)
+
+
+def get_faults() -> tuple[Fault, ...]:
+    return _parse(os.environ.get(ENV_VAR, ""))
+
+
+def _proc_index() -> int:
+    """This process's fleet rank, from the launcher's env contract (train/launch.py);
+    a single-process run is process 0."""
+    return int(os.environ.get("JAX_PROCESS_ID", "0") or 0)
+
+
+def _triggered(f: Fault, *, step: int | None, epoch: int | None) -> bool:
+    if f.proc is not None and f.proc != _proc_index():
+        return False
+    if f.step is not None and (step is None or step < f.step):
+        return False
+    if f.epoch is not None and (epoch is None or epoch < f.epoch):
+        return False
+    return True
+
+
+def _claim_once(f: Fault) -> bool:
+    """True iff this firing is allowed. A ``flag`` marker file (suffixed per process,
+    so fleet peers fire independently) is claimed exclusively — a restart that replays
+    the trigger sees the marker and stays quiet."""
+    if not f.flag:
+        return True
+    path = f"{f.flag}.p{_proc_index()}"
+    try:
+        with open(path, "x") as fh:
+            fh.write(f"{f.kind} fired (pid {os.getpid()})\n")
+        return True
+    except FileExistsError:
+        return False
+
+
+def on_tick(*, step: int | None = None, epoch: int | None = None) -> None:
+    """The trainers' per-epoch resilience tick: apply any armed kill/preempt fault."""
+    if not active():
+        return
+    for f in get_faults():
+        if not _triggered(f, step=step, epoch=epoch):
+            continue
+        if f.kind == "kill" and _claim_once(f):
+            print(f"[faults] kill: process {_proc_index()} exiting {f.exit} "
+                  f"at step {step}", file=sys.stderr, flush=True)
+            sys.stderr.flush()
+            os._exit(f.exit)        # a hard crash: no atexit, no flushes
+        elif f.kind == "preempt" and _claim_once(f):
+            print(f"[faults] preempt: SIGTERM to process {_proc_index()} "
+                  f"at step {step}", file=sys.stderr, flush=True)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+def heartbeat_frozen(*, step: int | None = None, epoch: int | None = None) -> bool:
+    """True while a ``freeze`` fault holds — the heartbeat writer then skips its beat
+    (the process is alive but looks dead to the supervisor, by design)."""
+    if not active():
+        return False
+    return any(f.kind == "freeze" and _triggered(f, step=step, epoch=epoch)
+               for f in get_faults())
+
+
+def mangle_write(path: str, data: bytes) -> bytes:
+    """Apply any armed ``torn`` fault to a pending write: matching paths get their
+    payload truncated to half (the torn-write artifact checksum validation must catch).
+    Called by the checkpoint writer's ``_atomic_write`` only when ``active()``."""
+    if not active():
+        return data
+    for f in get_faults():
+        if (f.kind == "torn" and f.match and f.match in path
+                and _triggered(f, step=None, epoch=None) and _claim_once(f)):
+            print(f"[faults] torn: truncating write to {path} "
+                  f"({len(data)} -> {len(data) // 2} bytes)",
+                  file=sys.stderr, flush=True)
+            return data[:len(data) // 2]
+    return data

@@ -1,0 +1,103 @@
+"""Per-process liveness files — how the supervisor tells "slow" from "hung".
+
+The reference's gloo fleet has no liveness signal at all: a hung peer and a busy peer
+look identical until the collective timeout fires (SURVEY.md §5). Here every trainer
+process with ``--heartbeat-dir`` writes one tiny JSON file per epoch tick —
+``heartbeat_p{i}.json`` holding its step, epoch, pid, and a wall-clock timestamp —
+atomically (tmp + rename, so a reader never sees a torn beat). The supervisor
+(resilience/supervisor.py) polls the directory: a process whose last beat (or, before
+its first beat, the fleet's start time) is older than the staleness timeout is *hung*,
+and the whole fleet is torn down and restarted from the newest valid checkpoint. A slow
+process keeps beating and is left alone — progress, not speed, is the liveness signal.
+
+Deliberately jax-free: the reader runs inside the supervisor, which must never touch
+(or even import machinery that could claim) the accelerator the fleet is using.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+
+STATUS_RUNNING = "running"
+STATUS_PREEMPTED = "preempted"
+
+
+def heartbeat_path(dir_path: str, process_index: int) -> str:
+    return os.path.join(dir_path, f"heartbeat_p{process_index}.json")
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    # Local copy of the checkpoint writer's tmp+rename discipline — importing
+    # utils.checkpoint here would pull jax into the (jax-free) supervisor.
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+class HeartbeatWriter:
+    """One process's beat emitter. Construct once (per-process, NOT process-0 gated —
+    every fleet member's liveness matters), call :meth:`beat` from the epoch loop."""
+
+    def __init__(self, dir_path: str, *, process_index: int = 0):
+        self.dir_path = dir_path
+        self.process_index = int(process_index)
+        self.path = heartbeat_path(dir_path, self.process_index)
+
+    def beat(self, *, step: int, epoch: int, status: str = STATUS_RUNNING) -> None:
+        _atomic_write_text(self.path, json.dumps({
+            "process_index": self.process_index,
+            "pid": os.getpid(),
+            "step": int(step),
+            "epoch": int(epoch),
+            "status": status,
+            "time": time.time(),
+        }))
+
+
+def read_heartbeats(dir_path: str) -> dict[int, dict]:
+    """All readable beats in ``dir_path``, keyed by process index. Torn/absent files
+    are skipped (atomic writes make torn reads a non-event, but a dying writer can
+    leave a stale ``.tmp`` behind — never counted)."""
+    beats: dict[int, dict] = {}
+    for path in glob.glob(os.path.join(dir_path, "heartbeat_p*.json")):
+        try:
+            with open(path) as f:
+                b = json.load(f)
+            beats[int(b["process_index"])] = b
+        except (OSError, ValueError, KeyError):
+            continue
+    return beats
+
+
+def stale_processes(dir_path: str, *, num_processes: int, timeout_s: float,
+                    since: float, now: float | None = None) -> list[int]:
+    """Process indices whose liveness signal is older than ``timeout_s``.
+
+    ``since`` is the fleet's start wall-clock time (``time.time()`` domain — beats
+    carry wall time, not the monotonic clock): a process that has not beaten *yet* is
+    measured from fleet start, so slow startup gets the same grace as a slow epoch,
+    and beats left by a previous attempt (cleared by the supervisor anyway) can never
+    vouch for the current one."""
+    now = time.time() if now is None else now
+    beats = read_heartbeats(dir_path)
+    stale = []
+    for i in range(num_processes):
+        t = beats[i]["time"] if i in beats and beats[i]["time"] >= since else since
+        if now - t > timeout_s:
+            stale.append(i)
+    return stale
+
+
+def clear(dir_path: str) -> None:
+    """Drop every beat (and stray tmp) file — the supervisor calls this at attempt
+    start so a restarted fleet is judged only on its own signals."""
+    for path in glob.glob(os.path.join(dir_path, "heartbeat_p*.json*")):
+        try:
+            os.remove(path)
+        except OSError:
+            pass

@@ -1,0 +1,256 @@
+"""Supervised fault-tolerant fleets: crash detection, checkpoint-restart, backoff.
+
+The reference's failure model is all-or-nothing: one dead VM hangs the whole gloo world
+until a human notices (SURVEY.md §5), and ``train/launch.py`` reproduced that contract
+minus the hang. This module closes the loop — it is the retry harness that makes
+time-to-train on preemptible fleets a property of *recovery*, not luck:
+
+1. **spawn** the fleet (``train.launch.Fleet`` — same rendezvous env contract);
+2. **watch** it: first nonzero child exit tears the fleet down immediately
+   (fail-fast — peers blocked on a dead partner's collective are killed, not waited
+   out), and heartbeat staleness (resilience/heartbeat.py) catches the hang that has
+   no exit code at all;
+3. **classify**: exit 0 → done; ``EXIT_PREEMPTED`` (75) → a cooperative stop with a
+   durable checkpoint — *resumable*, returned to the caller without burning a retry
+   (the outer scheduler re-runs when capacity returns); anything else → crash;
+4. **restart** a crashed/hung fleet from the newest *valid* checkpoint
+   (``utils.checkpoint.newest_valid_checkpoint`` — checksum-verified against the
+   manifest, so the torn write the crash itself may have produced is skipped, never
+   loaded), appending ``--resume-from`` to the child command, with bounded retries
+   and exponential backoff.
+
+Restart-from-checkpoint (not in-place recovery) is the whole design: the trainers'
+sharded checkpoints already interchange across process counts and mesh layouts
+(DESIGN.md §12), so a restarted fleet need not even be the same shape as the dead one.
+
+The supervisor stays jax-free: it must never initialize (or race the children for) the
+accelerator. Its telemetry is therefore a plain append-JSONL writer emitting the same
+``{"event": "restart", ...}`` schema the trainers' telemetry uses — readable by the
+shared reader and rendered by ``tools/telemetry_report.py``. (The one lazy import of
+``utils.checkpoint`` for manifest scans loads jax the library, but never initializes a
+backend — no device is claimed.)
+
+CLI: ``tools/fleet_supervise.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import time
+
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+    heartbeat as hb,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience.preemption import (
+    EXIT_PREEMPTED, PreemptionHandler,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.train.launch import Fleet
+
+#: SuperviseResult.exit_code when the fleet was torn down by the supervisor itself
+#: (hang / attempt timeout): 128+SIGTERM, the shell's convention for a terminated
+#: process — the children had no exit code of their own to report.
+EXIT_TORN_DOWN = 143
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    """Supervision knobs (the fleet-shape fields mirror ``train.launch``)."""
+
+    num_processes: int = 2
+    platform: str | None = None       # e.g. "cpu" for emulated fleets
+    devices_per_process: int = 1
+    port: int | None = None
+    max_restarts: int = 3             # restarts, not attempts: N+1 runs max
+    backoff_s: float = 1.0            # exponential: backoff_s * 2**restart, capped
+    backoff_max_s: float = 30.0
+    checkpoint_dir: str = ""          # versioned store (utils.checkpoint manifest) to
+    #                                   resume from; "" = restart from scratch
+    heartbeat_dir: str = ""           # fleet liveness files; auto-appended to the
+    #                                   child command when set ("" = no hang watch)
+    heartbeat_timeout_s: float = 0.0  # beat staleness that counts as hung; 0 off
+    attempt_timeout_s: float = 0.0    # wall-clock bound per attempt; 0 = unbounded
+    preempt_grace_s: float = 120.0    # drain window after a preemption before the
+    #                                   teardown SIGKILL escalation: latched peers
+    #                                   are finishing an epoch + final checkpoint,
+    #                                   which dwarfs the crash-straggler grace
+    telemetry: str = ""               # supervisor JSONL (restart events); "" off
+    poll_s: float = 0.05
+
+
+@dataclasses.dataclass
+class SuperviseResult:
+    status: str                       # "ok" | "preempted" | "failed"
+    exit_code: int                    # 0 | EXIT_PREEMPTED | child rc | EXIT_TORN_DOWN
+    attempts: int
+    restarts: int
+    resume_history: list              # checkpoint path (or None) each attempt resumed from
+
+
+class _JsonlWriter:
+    """Append-per-emit JSONL, flushed per line — the supervisor's telemetry.
+
+    Not ``utils.telemetry.TelemetryWriter``: that writer's process-0 gate calls
+    ``jax.process_index()``, which would initialize a jax backend inside the
+    supervisor. Same line schema; the shared reader and report CLI consume both."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        # Append: a preempted run is re-run with the same command later, and its
+        # restart history must survive into the resumed run's report.
+        self._fh = open(path, "a")
+        self._t0 = time.time()
+
+    def emit(self, event: dict) -> None:
+        event.setdefault("t_s", round(time.time() - self._t0, 6))
+        self._fh.write(json.dumps(event) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def _newest_valid(checkpoint_dir: str) -> str | None:
+    if not checkpoint_dir:
+        return None
+    # Lazy: utils.checkpoint imports jax/flax; the supervisor only pays that (import,
+    # never backend init) when it actually has a checkpoint store to scan.
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.checkpoint import (
+        newest_valid_checkpoint,
+    )
+    return newest_valid_checkpoint(checkpoint_dir)
+
+
+def _sleep_interruptible(seconds: float, handler: PreemptionHandler) -> None:
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline and not handler.requested:
+        time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+
+
+def supervise(command: list[str], cfg: SupervisorConfig = SupervisorConfig(), *,
+              env: dict | None = None) -> SuperviseResult:
+    """Run ``python <command>`` as a supervised ``cfg.num_processes``-wide fleet until
+    it completes, is preempted, or exhausts its restart budget.
+
+    The supervisor latches SIGTERM/SIGINT itself and forwards SIGTERM to the fleet:
+    preempting the supervisor preempts the run (children with ``--handle-preemption``
+    stop at their next epoch boundary and exit 75)."""
+    tele = _JsonlWriter(cfg.telemetry) if cfg.telemetry else None
+    handler = PreemptionHandler().install()
+    attempts = restarts = 0
+    resume_history: list = []
+    status, exit_code = "failed", 1
+    scanned_resume: str | None = None     # restart path pre-scans for its log line;
+    have_scanned = False                  # the next attempt reuses it (the store
+    #                                       cannot change while the fleet is dead)
+    try:
+        while True:
+            attempts += 1
+            resume = (scanned_resume if have_scanned
+                      else _newest_valid(cfg.checkpoint_dir))
+            have_scanned = False
+            resume_history.append(resume)
+            cmd = list(command)
+            if resume:
+                cmd += ["--resume-from", resume]     # last occurrence wins in argparse
+            if cfg.heartbeat_dir:
+                hb.clear(cfg.heartbeat_dir)
+                if "--heartbeat-dir" not in cmd:
+                    cmd += ["--heartbeat-dir", cfg.heartbeat_dir]
+            started_mono, started_wall = time.monotonic(), time.time()
+            fleet = Fleet(cmd, num_processes=cfg.num_processes, platform=cfg.platform,
+                          devices_per_process=cfg.devices_per_process, port=cfg.port,
+                          env=env)
+            reason: str | None = None
+            rc = 0
+            forwarded = False
+            # Staleness checks glob + JSON-parse every beat file — throttle them to
+            # a fraction of the timeout instead of every poll_s iteration.
+            hb_interval = max(1.0, cfg.heartbeat_timeout_s / 10)
+            next_hb_check = started_mono
+            try:
+                while True:
+                    first_rc = fleet.poll()
+                    if handler.requested and not forwarded:
+                        fleet.send_signal(signal.SIGTERM)
+                        forwarded = True
+                    if first_rc is not None:
+                        rc = first_rc
+                        reason = "preempted" if rc == EXIT_PREEMPTED else "crash"
+                        if reason == "preempted":
+                            # Peers are latched and still finishing their epoch +
+                            # final checkpoint; drain before teardown's SIGKILL
+                            # escalation can cost them the durable checkpoint.
+                            drain = time.monotonic() + cfg.preempt_grace_s
+                            while fleet.running and time.monotonic() < drain:
+                                time.sleep(cfg.poll_s)
+                        break
+                    if not fleet.running:
+                        # Re-poll before declaring success: exits can land between
+                        # the poll above and the running check (e.g. every worker
+                        # crashing at the same fault step).
+                        final_rc = fleet.poll()
+                        if final_rc is not None:
+                            rc = final_rc
+                            reason = ("preempted" if rc == EXIT_PREEMPTED
+                                      else "crash")
+                        else:
+                            reason = "ok"
+                        break
+                    if (cfg.heartbeat_timeout_s > 0 and cfg.heartbeat_dir
+                            and time.monotonic() >= next_hb_check):
+                        next_hb_check = time.monotonic() + hb_interval
+                        stale = hb.stale_processes(
+                            cfg.heartbeat_dir, num_processes=cfg.num_processes,
+                            timeout_s=cfg.heartbeat_timeout_s, since=started_wall)
+                        if stale:
+                            rc, reason = EXIT_TORN_DOWN, "hung"
+                            break
+                    if (cfg.attempt_timeout_s > 0
+                            and time.monotonic() - started_mono > cfg.attempt_timeout_s):
+                        rc, reason = EXIT_TORN_DOWN, "timeout"
+                        break
+                    time.sleep(cfg.poll_s)
+            finally:
+                fleet.terminate()     # fail-fast teardown: never leave peers hanging
+            if reason == "ok":
+                status, exit_code = "ok", 0
+                break
+            if reason == "preempted" or (handler.requested and reason == "crash"):
+                # A preemption signal can also surface as teardown collateral on
+                # peers; the supervisor's own latch disambiguates.
+                status, exit_code = "preempted", EXIT_PREEMPTED
+                break
+            if restarts >= cfg.max_restarts:
+                status, exit_code = "failed", rc
+                break
+            backoff = (min(cfg.backoff_s * (2 ** restarts), cfg.backoff_max_s)
+                       if cfg.backoff_s > 0 else 0.0)
+            restarts += 1
+            next_resume = _newest_valid(cfg.checkpoint_dir)
+            scanned_resume, have_scanned = next_resume, True
+            if tele:
+                tele.emit({"event": "restart", "attempt": attempts,
+                           "restart": restarts, "reason": reason, "exit_code": rc,
+                           "resume_from": next_resume or "",
+                           "backoff_s": backoff, "unix_time": time.time()})
+            print(f"[supervisor] attempt {attempts} {reason} (exit {rc}); "
+                  f"restart {restarts}/{cfg.max_restarts} in {backoff:.1f}s"
+                  + (f" from {next_resume}" if next_resume else " from scratch"),
+                  flush=True)
+            _sleep_interruptible(backoff, handler)
+            if handler.requested:
+                status, exit_code = "preempted", EXIT_PREEMPTED
+                break
+    finally:
+        handler.uninstall()
+        if tele:
+            tele.emit({"event": "supervise_summary", "status": status,
+                       "exit_code": exit_code, "attempts": attempts,
+                       "restarts": restarts, "unix_time": time.time()})
+            tele.close()
+    return SuperviseResult(status=status, exit_code=exit_code, attempts=attempts,
+                           restarts=restarts, resume_history=resume_history)

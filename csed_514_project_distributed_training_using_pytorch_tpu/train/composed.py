@@ -65,6 +65,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     pipeline,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu import resilience
 from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     tensor_parallel as tp,
@@ -139,6 +140,10 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                          "other output — pass --telemetry PATH too")
     tele = T.TelemetryWriter(config.telemetry)
     tele.emit(T.manifest_event(config, mesh=mesh, run_type="composed"))
+    # Resilience wiring (flag-gated, host-side only — zero-cost when off).
+    rt = resilience.RunHooks(heartbeat_dir=config.heartbeat_dir,
+                             handle_preemption=config.handle_preemption,
+                             process_index=info.process_index)
     data_size = mesh.shape.get("data", 1)
     seq_size = mesh.shape.get("seq", 1)
     model_size = mesh.shape.get("model", 1)
@@ -339,7 +344,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
         base_state, start_epoch, warning = checkpoint.restore_for_resume(
             config.resume_from, base_state,
             process_index=info.process_index, process_count=info.process_count,
-            steps_per_epoch=steps_per_epoch)
+            steps_per_epoch=steps_per_epoch, tele=tele)
         if warning:
             M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(base_state.step)} "
@@ -433,8 +438,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     test_x = dp.put_global(mesh, test_ds.images, P())
     test_y = dp.put_global(mesh, test_ds.labels, P())
     history = M.MetricsHistory()
-    saver = (checkpoint.AsyncCheckpointer() if config.async_checkpoint
-             else checkpoint)
+    saver = checkpoint.make_saver(config.async_checkpoint, tele=tele)
     plan_spec = P(None, "data") if data_size > 1 else P()
     # One dropout key for the whole run, hoisted out of the loop (each step folds it
     # with state.step inside the compiled program — same per-step keys as before).
@@ -487,13 +491,15 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x,
             test_y, dropout_rng, plan_spec, n_train, n_test, steps_per_epoch,
             start_epoch, history, watch, saver, ckpt_path, to_host_standard,
-            tele, compile_s, flops_per_step)
+            tele, compile_s, flops_per_step, rt)
     finally:
-        # Drain the write-behind queue even on an exception/signal mid-run — the
-        # queued per-epoch checkpoint is the resume artifact a killed run needs,
-        # and flush() re-raises deferred background IO errors.
-        if config.async_checkpoint:
-            saver.flush()
+        # Drain the write-behind queue even on an exception/signal/preemption
+        # mid-run — the queued per-epoch checkpoint is the resume artifact a killed
+        # run needs, and flush() re-raises deferred background IO errors. The
+        # preemption latch is uninstalled so in-process callers get their signal
+        # semantics back.
+        rt.uninstall()
+        saver.flush()
     if ckpt_path:
         M.log(f"Saved {ckpt_path}")
     if config.results_dir:
@@ -505,13 +511,16 @@ def main(config: ComposedConfig = ComposedConfig(), *,
 def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x,
                 test_y, dropout_rng, plan_spec, n_train, n_test, steps_per_epoch,
                 start_epoch, history, watch, saver, ckpt_path, to_host_standard,
-                tele, compile_s, flops_per_step):
+                tele, compile_s, flops_per_step, rt):
     """The composed trainer's epoch loop, split out so the caller can guarantee the
     async-checkpoint flush in a ``finally`` regardless of where the loop fails."""
     host_state = None
     best_step_s = None
+    ckpt_store = (os.path.join(config.results_dir, "checkpoints")
+                  if config.results_dir else "")
     with maybe_profile(config.profile, config.profile_dir):
         for epoch in range(start_epoch, config.epochs):
+            rt.epoch_tick(state, epoch)     # heartbeat + armed faults; no-op off
             t_epoch = time.perf_counter()
             # (seed, epoch)-keyed permutation — a pure function, so a resumed run
             # replays exactly the epochs it missed (same contract as
@@ -573,6 +582,16 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x
                                                         state)
                 host_state = to_host_standard(state)
                 saver.save_train_state(ckpt_path, host_state)
+                if ckpt_store and config.keep_checkpoints:
+                    # Versioned store (manifest + checksums + keep-last-N GC) for
+                    # the supervisor's newest-VALID resume scan.
+                    checkpoint.save_versioned(ckpt_store, host_state,
+                                              keep=config.keep_checkpoints,
+                                              tele=tele)
+            # Cooperative preemption at the epoch boundary, with this epoch's
+            # checkpoint durable (raises Preempted; __main__ exits 75).
+            rt.check_preempt(epoch=epoch, state=state, checkpoint=ckpt_path,
+                             tele=tele)
 
     if tele.enabled and best_step_s is not None:
         tele.emit(T.mfu_event(flops_per_step, best_step_s))
@@ -584,4 +603,9 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x
 
 
 if __name__ == "__main__":
-    main(parse_config(ComposedConfig))
+    try:
+        main(parse_config(ComposedConfig))
+    except resilience.Preempted as e:
+        M.log(f"preempted at step {e.step} (checkpoint {e.checkpoint or 'n/a'}); "
+              f"exiting {resilience.EXIT_PREEMPTED} — resume with --resume-from")
+        raise SystemExit(resilience.EXIT_PREEMPTED)

@@ -55,6 +55,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh impor
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel.sampler import (
     ShardedSampler,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu import resilience
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
     TrainState, create_train_state, make_epoch_fn, make_eval_fn,
 )
@@ -128,6 +129,11 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     mesh = make_mesh(num_devices)
     tele = T.TelemetryWriter(config.telemetry)
     tele.emit(T.manifest_event(config, mesh=mesh, run_type="distributed"))
+    # Resilience wiring (flag-gated, host-side only — the compiled epoch program is
+    # untouched, and with both flags off no step fetch or syscall is added).
+    rt = resilience.RunHooks(heartbeat_dir=config.heartbeat_dir,
+                             handle_preemption=config.handle_preemption,
+                             process_index=info.process_index)
     world = mesh.shape["data"]                    # ≙ world_size, :131 — but discovered
     if config.global_batch_size % world:
         raise ValueError(f"global batch {config.global_batch_size} not divisible by "
@@ -175,7 +181,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         state, start_epoch, warning = checkpoint.restore_for_resume(
             config.resume_from, state,
             process_index=info.process_index, process_count=info.process_count,
-            steps_per_epoch=steps_per_epoch)
+            steps_per_epoch=steps_per_epoch, tele=tele)
         if warning:
             M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(state.step)} "
@@ -288,13 +294,14 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         return state, jax.numpy.stack(losses)
 
     history = M.MetricsHistory()
-    saver = (checkpoint.AsyncCheckpointer() if config.async_checkpoint
-             else checkpoint)
+    saver = checkpoint.make_saver(config.async_checkpoint, tele=tele)
+    ckpt_store = os.path.join(config.results_dir, "checkpoints")
 
     try:
         with maybe_profile(config.profile, config.profile_dir):
             best_step_s = None
             for epoch in range(start_epoch, config.epochs):   # ≙ the epoch loop, :70
+                rt.epoch_tick(state, epoch)       # heartbeat + armed faults; no-op off
                 t_epoch = time.perf_counter()
                 plan = epoch_index_plan(samplers, epoch, per_replica_batch)  # ≙ set_epoch, :72
                 data_s = time.perf_counter() - t_epoch
@@ -353,7 +360,18 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                 # can resume with --resume-from; the reference only ever saves final params.
                 # Device-resident gathered state: the saver is process-0 gated and
                 # device_gets internally — non-0 processes must not pay a host fetch.
-                saver.save_train_state(ckpt_path, gather(state))
+                ck_state = gather(state)
+                saver.save_train_state(ckpt_path, ck_state)
+                if config.keep_checkpoints:
+                    # Versioned store (manifest + checksums + keep-last-N GC): what
+                    # the fleet supervisor's newest-VALID resume scan reads.
+                    checkpoint.save_versioned(ckpt_store, ck_state,
+                                              keep=config.keep_checkpoints,
+                                              tele=tele)
+                # Cooperative preemption: honor a pending SIGTERM now, with this
+                # epoch's checkpoint durable (raises Preempted; __main__ exits 75).
+                rt.check_preempt(epoch=epoch, state=state, checkpoint=ckpt_path,
+                                 tele=tele)
             if tele.enabled and best_step_s is not None:
                 tele.emit(T.mfu_event(flops_per_step, best_step_s))
 
@@ -375,13 +393,20 @@ def main(config: DistributedConfig = DistributedConfig(), *,
             export_state.ema if export_state.ema is not None
             else export_state.params)   # ≙ :163-164
     finally:
-        # Drain the write-behind queue even on an exception/signal mid-run — the
-        # queued per-epoch checkpoint is the resume artifact a killed run needs,
-        # and flush() re-raises deferred background IO errors.
-        if config.async_checkpoint:
-            saver.flush()
+        # Drain the write-behind queue even on an exception/signal/preemption
+        # mid-run — the queued per-epoch checkpoint is the resume artifact a killed
+        # run needs, and flush() re-raises deferred background IO errors. The
+        # preemption latch is uninstalled so in-process callers get their signal
+        # semantics back.
+        rt.uninstall()
+        saver.flush()
     return state, history
 
 
 if __name__ == "__main__":
-    main(parse_config(DistributedConfig))
+    try:
+        main(parse_config(DistributedConfig))
+    except resilience.Preempted as e:
+        M.log(f"preempted at step {e.step} (checkpoint {e.checkpoint or 'n/a'}); "
+              f"exiting {resilience.EXIT_PREEMPTED} — resume with --resume-from")
+        raise SystemExit(resilience.EXIT_PREEMPTED)

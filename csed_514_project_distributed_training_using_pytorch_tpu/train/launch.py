@@ -18,8 +18,14 @@ Usage (≙ running run1.py and run2.py on two VMs, but one command, no editing):
         -m csed_514_project_distributed_training_using_pytorch_tpu.train.smoke
 
 Everything after ``--`` is passed to ``python`` in each process. Exit status is 0 iff every
-process exits 0 (a failed peer also causes the others to fail their collectives — the same
-all-or-nothing failure model as the reference's gloo world, SURVEY.md §5 "failure detection").
+process exits 0. Under ``--fail-fast`` (the default) the first nonzero child exit SIGTERMs
+the rest of the fleet immediately — peers blocked on a dead partner's rendezvous or
+collective are torn down, not waited out (the clean-abort behavior the reference's
+all-or-nothing gloo world lacks, SURVEY.md §5 "failure detection"); ``--no-fail-fast``
+restores let-them-finish semantics (every child runs to its own exit; the first nonzero
+code is still reported). The :class:`Fleet` handle this module is built on is also the
+unit ``resilience/supervisor.py`` watches and restarts — this file stays jax-free so
+supervisors importing it never touch the accelerator.
 """
 
 from __future__ import annotations
@@ -57,51 +63,102 @@ def _child_env(base: dict, *, port: int, num_processes: int, process_id: int,
     return env
 
 
-def launch(command: list[str], *, num_processes: int, platform: str | None = None,
-           devices_per_process: int = 1, port: int | None = None,
-           timeout: float | None = None) -> int:
-    """Spawn ``python <command>`` ``num_processes`` times with rendezvous env; returns the
-    first nonzero child exit code, else 0. Output streams through inherited stdout/stderr
-    (process-0 gating in ``utils.metrics.log`` keeps it single-voiced)."""
-    port = port or _free_port()
-    procs = [
-        subprocess.Popen(
-            [sys.executable, *command],
-            env=_child_env(os.environ, port=port, num_processes=num_processes,
-                           process_id=i, platform=platform,
-                           devices_per_process=devices_per_process),
-        )
-        for i in range(num_processes)
-    ]
-    # Poll all children together: the first nonzero exit wins immediately (peers blocked on
-    # a dead partner's rendezvous/collective get terminated rather than waited out), and a
-    # shared deadline bounds total wall time instead of letting each child consume its own.
-    deadline = None if timeout is None else time.monotonic() + timeout
-    result: int | None = None
-    try:
-        live = list(procs)
-        while live and result is None:
-            for p in list(live):
-                if p.poll() is not None:
-                    live.remove(p)
-                    if p.returncode != 0:
-                        result = p.returncode
-                        break
-            if result is None and live:
-                if deadline is not None and time.monotonic() > deadline:
-                    result = 124        # timeout convention of coreutils `timeout`
-                    break
-                time.sleep(0.05)
-    finally:
-        for p in procs:          # a hung or failed peer must not leave zombies behind
+class Fleet:
+    """A running fleet as one supervisable unit: spawn, poll, signal, teardown.
+
+    ``launch()`` drives one for the simple run-to-completion case; the resilience
+    supervisor holds one across its watch loop (heartbeat staleness checks, SIGTERM
+    forwarding) — both get identical spawn env and teardown semantics because there
+    is exactly one implementation of each."""
+
+    def __init__(self, command: list[str], *, num_processes: int,
+                 platform: str | None = None, devices_per_process: int = 1,
+                 port: int | None = None, env: dict | None = None):
+        self.port = port or _free_port()
+        base = dict(os.environ if env is None else env)
+        self.procs = [
+            subprocess.Popen(
+                [sys.executable, *command],
+                env=_child_env(base, port=self.port, num_processes=num_processes,
+                               process_id=i, platform=platform,
+                               devices_per_process=devices_per_process),
+            )
+            for i in range(num_processes)
+        ]
+        self._first_failure: int | None = None
+
+    def poll(self) -> int | None:
+        """Reap finished children; return the first nonzero exit code observed so far
+        (sticky), or None while none has failed."""
+        for p in self.procs:
+            rc = p.poll()
+            if rc is not None and rc != 0 and self._first_failure is None:
+                self._first_failure = rc
+        return self._first_failure
+
+    @property
+    def running(self) -> bool:
+        return any(p.poll() is None for p in self.procs)
+
+    @property
+    def exit_codes(self) -> list[int | None]:
+        return [p.poll() for p in self.procs]
+
+    def send_signal(self, sig) -> None:
+        """Deliver ``sig`` to every live child (e.g. forwarding a preemption SIGTERM)."""
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except (ProcessLookupError, OSError):
+                    pass
+
+    def terminate(self, grace: float = 10.0) -> None:
+        """SIGTERM every live child, give the fleet ``grace`` seconds collectively to
+        exit (a cooperative preemption stop may need it), then SIGKILL stragglers and
+        reap everything — a hung or failed peer must not leave zombies behind."""
+        for p in self.procs:
             if p.poll() is None:
                 p.terminate()
-        for p in procs:          # reap everything; escalate if SIGTERM is ignored
+        deadline = time.monotonic() + grace
+        for p in self.procs:
             try:
-                p.wait(timeout=10)
+                p.wait(timeout=max(0.01, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 p.kill()
                 p.wait()
+
+
+def launch(command: list[str], *, num_processes: int, platform: str | None = None,
+           devices_per_process: int = 1, port: int | None = None,
+           timeout: float | None = None, fail_fast: bool = True) -> int:
+    """Spawn ``python <command>`` ``num_processes`` times with rendezvous env; returns the
+    first nonzero child exit code, else 0. Output streams through inherited stdout/stderr
+    (process-0 gating in ``utils.metrics.log`` keeps it single-voiced).
+
+    ``fail_fast`` (default): the first nonzero exit tears the fleet down immediately —
+    peers blocked on a dead partner's rendezvous/collective get terminated rather than
+    waited out. ``fail_fast=False`` lets every child run to its own exit first. Either
+    way a shared ``timeout`` deadline bounds total wall time (exit 124, the coreutils
+    ``timeout`` convention)."""
+    fleet = Fleet(command, num_processes=num_processes, platform=platform,
+                  devices_per_process=devices_per_process, port=port)
+    deadline = None if timeout is None else time.monotonic() + timeout
+    result: int | None = None
+    try:
+        while fleet.running:
+            rc = fleet.poll()
+            if rc is not None and fail_fast:
+                result = rc
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                result = 124
+                break
+            time.sleep(0.05)
+        if result is None:       # clean drain, or --no-fail-fast ran everyone to exit
+            result = fleet.poll()
+    finally:
+        fleet.terminate()
     return result or 0
 
 
@@ -119,6 +176,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--timeout", type=float, default=None,
                         help="wall-clock seconds before the whole fleet is killed "
                              "(exit 124); default: wait forever")
+    parser.add_argument("--fail-fast", action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help="SIGTERM the rest of the fleet the moment any child "
+                             "exits nonzero (peers hung on dead collectives are torn "
+                             "down, not waited out); --no-fail-fast lets every child "
+                             "run to its own exit")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="everything after -- is run as: python <command>")
     args = parser.parse_args(argv)
@@ -127,7 +190,7 @@ def main(argv: list[str] | None = None) -> int:
         parser.error("no command given — pass e.g. `-- -m <module> [args]`")
     return launch(command, num_processes=args.num_processes, platform=args.platform,
                   devices_per_process=args.devices_per_process, port=args.port,
-                  timeout=args.timeout)
+                  timeout=args.timeout, fail_fast=args.fail_fast)
 
 
 if __name__ == "__main__":

@@ -33,6 +33,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.models import lm as
 from csed_514_project_distributed_training_using_pytorch_tpu.models import (
     validate_remat_policy,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu import resilience
 from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     data_parallel as dp,
@@ -188,6 +189,13 @@ def main(config: LMConfig = LMConfig(), *,
     M.log(f"LM training: mesh {dict(mesh.shape)} on {info.process_count} process(es), "
           f"batch {config.batch_size}, vocab {config.num_levels}+BOS, "
           f"seq {seq_len}, data source: {train_ds.source}")
+    # Telemetry + resilience wiring live ABOVE the resume so the restore is recorded;
+    # resilience hooks are flag-gated, host-side only (zero-cost when off).
+    tele = T.TelemetryWriter(config.telemetry)
+    tele.emit(T.manifest_event(config, mesh=mesh, run_type="lm"))
+    rt = resilience.RunHooks(heartbeat_dir=config.heartbeat_dir,
+                             handle_preemption=config.handle_preemption,
+                             process_index=info.process_index)
 
     optimizer = optim.make_optimizer(config.optimizer,
                                      learning_rate=config.learning_rate,
@@ -208,7 +216,7 @@ def main(config: LMConfig = LMConfig(), *,
         state, start_epoch, warning = checkpoint.restore_for_resume(
             config.resume_from, state,
             process_index=info.process_index, process_count=info.process_count,
-            steps_per_epoch=steps_per_epoch)
+            steps_per_epoch=steps_per_epoch, tele=tele)
         if warning:
             M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(state.step)} "
@@ -256,8 +264,6 @@ def main(config: LMConfig = LMConfig(), *,
     zeros_d = dp.put_global(mesh, np.zeros(n_train, np.int32), P())
     test_d = dp.put_global(mesh, test_tokens, P())
     dropout_rng = jax.random.PRNGKey(config.seed + 1)
-    tele = T.TelemetryWriter(config.telemetry)
-    tele.emit(T.manifest_event(config, mesh=mesh, run_type="lm"))
     # Compile/execute split (telemetry): AOT-compile + FLOP-price the epoch program
     # (DP path; the TP cached-sharding wrapper has no .lower — compile_s stays null
     # and folds into the first epoch).
@@ -277,8 +283,7 @@ def main(config: LMConfig = LMConfig(), *,
             tele.emit(T.compile_event("epoch", aot,
                                       steps_per_call=steps_per_epoch))
     history = M.MetricsHistory()
-    saver = (checkpoint.AsyncCheckpointer() if config.async_checkpoint
-             else checkpoint)
+    saver = checkpoint.make_saver(config.async_checkpoint, tele=tele)
 
     ckpt_path = (os.path.join(config.results_dir, "model_lm.ckpt")
                  if config.results_dir else "")
@@ -289,13 +294,15 @@ def main(config: LMConfig = LMConfig(), *,
         state = _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d,
                             zeros_d, test_d, dropout_rng, n_train, n_test, seq_len,
                             steps_per_epoch, start_epoch, history, watch, saver,
-                            ckpt_path, gather, tele, compile_s, flops_per_step)
+                            ckpt_path, gather, tele, compile_s, flops_per_step, rt)
     finally:
-        # Drain the write-behind queue even on an exception/signal mid-run — the
-        # queued per-epoch checkpoint is the resume artifact a killed run needs,
-        # and flush() re-raises deferred background IO errors.
-        if config.async_checkpoint:
-            saver.flush()
+        # Drain the write-behind queue even on an exception/signal/preemption
+        # mid-run — the queued per-epoch checkpoint is the resume artifact a killed
+        # run needs, and flush() re-raises deferred background IO errors. The
+        # preemption latch is uninstalled so in-process callers get their signal
+        # semantics back.
+        rt.uninstall()
+        saver.flush()
 
     host_state = jax.device_get(gather(state))
     if ckpt_path:
@@ -334,11 +341,14 @@ def main(config: LMConfig = LMConfig(), *,
 def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_d,
                 dropout_rng, n_train, n_test, seq_len, steps_per_epoch, start_epoch,
                 history, watch, saver, ckpt_path, gather, tele, compile_s,
-                flops_per_step):
+                flops_per_step, rt):
     """The LM trainer's epoch loop, split out so the caller can guarantee the
     async-checkpoint flush in a ``finally`` regardless of where the loop fails."""
     best_step_s = None
+    ckpt_store = (os.path.join(config.results_dir, "checkpoints")
+                  if config.results_dir else "")
     for epoch in range(start_epoch, config.epochs):
+        rt.epoch_tick(state, epoch)         # heartbeat + armed faults; no-op off
         t_epoch = time.perf_counter()
         # (seed, epoch)-keyed permutation — the parallel/sampler contract, so resumed
         # runs replay exactly the epochs they missed.
@@ -388,11 +398,25 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_
         if ckpt_path:
             # Device-resident gathered state: the saver is process-0 gated and
             # device_gets internally — non-0 processes must not pay a host fetch.
-            saver.save_train_state(ckpt_path, gather(state))
+            ck_state = gather(state)
+            saver.save_train_state(ckpt_path, ck_state)
+            if ckpt_store and config.keep_checkpoints:
+                # Versioned store (manifest + checksums + keep-last-N GC) for the
+                # supervisor's newest-VALID resume scan.
+                checkpoint.save_versioned(ckpt_store, ck_state,
+                                          keep=config.keep_checkpoints, tele=tele)
+        # Cooperative preemption at the epoch boundary, with this epoch's
+        # checkpoint durable (raises Preempted; __main__ exits 75).
+        rt.check_preempt(epoch=epoch, state=state, checkpoint=ckpt_path, tele=tele)
     if tele.enabled and best_step_s is not None:
         tele.emit(T.mfu_event(flops_per_step, best_step_s))
     return state
 
 
 if __name__ == "__main__":
-    main(parse_config(LMConfig))
+    try:
+        main(parse_config(LMConfig))
+    except resilience.Preempted as e:
+        M.log(f"preempted at step {e.step} (checkpoint {e.checkpoint or 'n/a'}); "
+              f"exiting {resilience.EXIT_PREEMPTED} — resume with --resume-from")
+        raise SystemExit(resilience.EXIT_PREEMPTED)

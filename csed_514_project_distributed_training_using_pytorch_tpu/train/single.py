@@ -34,6 +34,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.models import (
     build_model,
     validate_model_config,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu import resilience
 from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
     TrainState, create_train_state, init_health, make_epoch_fn, make_eval_fn,
@@ -81,6 +82,10 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                          "other output — pass --telemetry PATH too")
     tele = T.TelemetryWriter(config.telemetry)
     tele.emit(T.manifest_event(config, run_type="single"))
+    # Resilience wiring (flag-gated, host-side only; with both flags off no step
+    # fetch or syscall is added — same zero-cost discipline as --health-stats).
+    rt = resilience.RunHooks(heartbeat_dir=config.heartbeat_dir,
+                             handle_preemption=config.handle_preemption)
     if config.download_data and datasets is None:
         download_mnist(config.data_dir)   # ≙ torchvision download=True, src/train.py:26-31
     train_ds, test_ds = datasets if datasets is not None else load_mnist(config.data_dir)
@@ -113,7 +118,13 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                                ema=config.ema_decay > 0)
     resume_from = resume_from or config.resume_from or None
     if resume_from:                             # the restore path the reference lacks
+        t_restore = time.perf_counter()
         state = checkpoint.restore_train_state(resume_from, state)
+        if tele.enabled:
+            tele.emit(T.checkpoint_event(
+                op="restore", path=resume_from, kind="full",
+                nbytes=os.path.getsize(resume_from),
+                wall_s=time.perf_counter() - t_restore, step=int(state.step)))
         M.log(f"Resumed from {resume_from} at step {int(state.step)}")
     # Schedule horizon = THIS invocation's planned end: the restored step plus
     # n_epochs of updates (single-trainer resume means "train n_epochs MORE", unlike
@@ -199,9 +210,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     history = M.MetricsHistory()
     n_train, n_test = len(train_ds), len(test_ds)
     ckpt_path = os.path.join(config.results_dir, "model.ckpt")
-    # Module-level checkpoint API and the async writer share the call signature.
-    saver = (checkpoint.AsyncCheckpointer() if config.async_checkpoint
-             else checkpoint)
+    ckpt_store = os.path.join(config.results_dir, "checkpoints")
+    saver = checkpoint.make_saver(config.async_checkpoint, tele=tele)
 
     def evaluate(state: TrainState, examples_seen: int) -> None:
         # EMA-enabled runs evaluate the averaged weights (the reason to keep an EMA).
@@ -331,6 +341,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                 evaluate(state, 0)              # baseline eval, ≙ src/train.py:106
             best_step_s = None
             for epoch in range(1, config.n_epochs + 1):
+                rt.epoch_tick(state, epoch)     # heartbeat + armed faults; no-op off
                 step_before = int(state.step)
                 t_epoch = time.perf_counter()
                 with annotate(f"train_epoch_{epoch}"):
@@ -364,6 +375,18 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                     if epoch_health is not None:
                         tele.emit(T.health_event(epoch, health_host, steps,
                                                  param_norm=param_norm))
+                if config.keep_checkpoints:
+                    # Versioned store (manifest + checksums + keep-last-N GC) for
+                    # the supervisor's newest-VALID resume scan.
+                    checkpoint.save_versioned(ckpt_store, state,
+                                              keep=config.keep_checkpoints,
+                                              tele=tele)
+                # Cooperative preemption at the epoch boundary. The per-tick
+                # overwrite checkpoint lags the tail batch, so save explicitly
+                # before raising (raises Preempted; __main__ exits 75).
+                rt.check_preempt(
+                    epoch=epoch, state=state, checkpoint=ckpt_path, tele=tele,
+                    save=lambda: saver.save_train_state(ckpt_path, state))
             if tele.enabled and best_step_s is not None:
                 tele.emit(T.mfu_event(flops_per_step, best_step_s))
 
@@ -375,10 +398,17 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         # Drain the write-behind queue even when the loop raises or is signalled —
         # the queued checkpoint is exactly the killed-run artifact the per-tick
         # policy exists for, and flush() re-raises deferred background IO errors.
-        if config.async_checkpoint:
-            saver.flush()
+        # The preemption latch is uninstalled so in-process callers get their
+        # signal semantics back.
+        rt.uninstall()
+        saver.flush()
     return state, history
 
 
 if __name__ == "__main__":
-    main(parse_config(SingleProcessConfig))
+    try:
+        main(parse_config(SingleProcessConfig))
+    except resilience.Preempted as e:
+        M.log(f"preempted at step {e.step} (checkpoint {e.checkpoint or 'n/a'}); "
+              f"exiting {resilience.EXIT_PREEMPTED} — resume with --resume-from")
+        raise SystemExit(resilience.EXIT_PREEMPTED)

@@ -13,9 +13,12 @@ resume.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import queue
 import threading
+import time
 
 import jax
 from flax import serialization
@@ -23,12 +26,42 @@ from flax import serialization
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import TrainState
 
 
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint file exists but its bytes do not decode (or do not match their
+    recorded checksum) — the torn-write signature, as opposed to a missing file or a
+    structurally different (wrong-format) pytree. The supervisor's newest-valid scan
+    and humans both need the distinction: a torn write means "fall back one
+    checkpoint", not "your code is loading the wrong thing"."""
+
+
 def _atomic_write(path: str, data: bytes) -> None:
+    if os.environ.get("RESILIENCE_FAULTS"):
+        # Fault-injection hook (resilience/faults.py): an armed `torn` fault truncates
+        # matching payloads, simulating the non-atomic write this tmp+rename dance
+        # exists to prevent. Env-gated: the unarmed path costs one dict lookup.
+        from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+            faults,
+        )
+        data = faults.mangle_write(path, data)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
     os.replace(tmp, path)
+
+
+def _decode_msgpack(path: str):
+    """Read + msgpack-decode ``path``, wrapping raw decoder errors in a crisp
+    :class:`CheckpointCorrupt` that names the file."""
+    with open(path, "rb") as f:
+        data = f.read()
+    try:
+        return serialization.msgpack_restore(data)
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is corrupt — {len(data)} bytes failed to decode "
+            f"({type(e).__name__}: {e}); likely a torn/partial write, not a format "
+            f"mismatch") from e
 
 
 def _state_dict_for_save(state: TrainState) -> dict:
@@ -58,9 +91,11 @@ def restore_train_state(path: str, reference_state: TrainState) -> TrainState:
     checkpoint written without EMA restores into an EMA-enabled reference by seeding
     the EMA tree from the checkpoint's params (exactly what the first
     ``AveragedModel`` update would do); a checkpoint carrying EMA restores into a
-    plain reference by dropping the tree."""
-    with open(path, "rb") as f:
-        raw = serialization.msgpack_restore(f.read())
+    plain reference by dropping the tree.
+
+    Raises :class:`CheckpointCorrupt` (naming the path) when the bytes do not decode
+    — a truncated file surfaces as a torn write, not a raw msgpack stack trace."""
+    raw = _decode_msgpack(path)
     ref = reference_state._asdict()
     if ref.get("ema") is not None and raw.get("ema") is None:
         raw["ema"] = raw["params"]
@@ -73,7 +108,7 @@ def restore_train_state(path: str, reference_state: TrainState) -> TrainState:
 
 def restore_for_resume(path: str, reference_state: TrainState, *,
                        process_index: int, process_count: int,
-                       steps_per_epoch: int):
+                       steps_per_epoch: int, tele=None):
     """Shared resume prologue of the distributed and composed trainers: process-0
     restore, full-state broadcast to the fleet (the resume analog of DDP's initial
     param broadcast — checkpoints are process-0-gated writes, so on a fleet without a
@@ -86,11 +121,18 @@ def restore_for_resume(path: str, reference_state: TrainState, *,
 
     ``path`` may also be a ``save_train_state_sharded`` DIRECTORY: every process
     then re-assembles it from the shard files directly (deterministic, shared-FS
-    contract) — no process-0 gating and no broadcast needed."""
+    contract) — no process-0 gating and no broadcast needed.
+
+    ``tele`` (a ``TelemetryWriter``) records the restore as a ``checkpoint`` event
+    (op=restore, kind, bytes, wall seconds); emission is process-0 gated by the
+    writer itself."""
+    t0 = time.perf_counter()
     state = reference_state
     if os.path.isdir(path):
-        return _derive_resume_epoch(
+        result = _derive_resume_epoch(
             restore_train_state_sharded(path, reference_state), steps_per_epoch)
+        _emit_restore_event(tele, path, "sharded", t0, result[0])
+        return result
     if process_index == 0:
         state = restore_train_state(path, state)
     if process_count > 1:
@@ -98,7 +140,38 @@ def restore_for_resume(path: str, reference_state: TrainState, *,
         from jax.experimental import multihost_utils
         state = jax.tree_util.tree_map(
             np.asarray, multihost_utils.broadcast_one_to_all(state))
-    return _derive_resume_epoch(state, steps_per_epoch)
+    result = _derive_resume_epoch(state, steps_per_epoch)
+    _emit_restore_event(tele, path, "full", t0, result[0])
+    return result
+
+
+def _path_bytes(path: str) -> int | None:
+    try:
+        if os.path.isdir(path):
+            return sum(os.path.getsize(os.path.join(path, f))
+                       for f in os.listdir(path))
+        return os.path.getsize(path)
+    except OSError:
+        return None
+
+
+def _emit_checkpoint_event(tele, **kw) -> None:
+    """The one owner of the enabled-gate + lazy-import emit dance every save and
+    restore site shares (the lazy import keeps checkpoint->telemetry one-way at
+    module-load time)."""
+    if tele is None or not tele.enabled:
+        return
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        telemetry as T,
+    )
+    tele.emit(T.checkpoint_event(**kw))
+
+
+def _emit_restore_event(tele, path: str, kind: str, t0: float, state) -> None:
+    _emit_checkpoint_event(tele, op="restore", path=path, kind=kind,
+                           nbytes=_path_bytes(path),
+                           wall_s=time.perf_counter() - t0,
+                           step=int(state.step))
 
 
 def _derive_resume_epoch(state: TrainState, steps_per_epoch: int):
@@ -222,8 +295,7 @@ def restore_train_state_sharded(dir_path: str, reference_state: TrainState,
     across ``--ema-decay`` exactly like ``restore_train_state``."""
     import numpy as np
 
-    with open(os.path.join(dir_path, "meta.msgpack"), "rb") as f:
-        raw_meta = serialization.msgpack_restore(f.read())
+    raw_meta = _decode_msgpack(os.path.join(dir_path, "meta.msgpack"))
     meta, process_count = raw_meta["meta"], int(raw_meta["process_count"])
     none_keys = {key for key, m in meta.items() if m.get("none")}
     meta = {key: m for key, m in meta.items() if key not in none_keys}
@@ -250,8 +322,7 @@ def restore_train_state_sharded(dir_path: str, reference_state: TrainState,
                        else [tuple((0, n) for n in m["shape"])])
                  for key, m in meta.items()}
     for path in files:
-        with open(path, "rb") as f:
-            shards = serialization.msgpack_restore(f.read())
+        shards = _decode_msgpack(path)
         for key, blocks in shards.items():
             for blk in blocks:
                 start, data = blk["start"], blk["data"]
@@ -289,6 +360,118 @@ def restore_train_state_sharded(dir_path: str, reference_state: TrainState,
     return state
 
 
+# =========================================================================================
+# Versioned checkpoint store: manifest + retention + newest-valid selection
+# =========================================================================================
+#
+# The overwrite-in-place policy above reproduces the reference; it is also exactly one
+# torn write away from having NO resume artifact. The versioned store is the supervisor's
+# (resilience/supervisor.py) substrate: per-epoch files named by step, a checksummed
+# manifest, keep-last-N GC, and a newest-VALID scan that skips the torn write the crash
+# it is recovering from may have produced.
+
+MANIFEST_NAME = "manifest.json"
+_VERSIONED_PREFIX, _VERSIONED_SUFFIX = "ckpt_", ".msgpack"
+
+
+def versioned_name(step: int) -> str:
+    return f"{_VERSIONED_PREFIX}{int(step):08d}{_VERSIONED_SUFFIX}"
+
+
+def load_manifest(dir_path: str) -> dict:
+    """The store's manifest (``{"version": 1, "entries": [...]}``; each entry:
+    ``file``/``step``/``sha256``/``bytes``/``unix_time``). Missing or unreadable →
+    empty manifest (the scan then falls back to decode-validation)."""
+    try:
+        with open(os.path.join(dir_path, MANIFEST_NAME)) as f:
+            man = json.load(f)
+        if isinstance(man.get("entries"), list):
+            return man
+    except (OSError, ValueError):
+        pass
+    return {"version": 1, "entries": []}
+
+
+def save_versioned(dir_path: str, state: TrainState, *, keep: int = 3,
+                   tele=None) -> str | None:
+    """Write ``state`` as ``ckpt_{step:08d}.msgpack`` into the versioned store:
+    atomic file write, then an atomic manifest update (file, step, sha256, bytes),
+    then GC of everything beyond the newest ``keep`` steps. Process-0 gated (returns
+    None elsewhere and for ``keep``-0 stores). The checksum is computed from the
+    in-memory payload BEFORE the write — a torn write therefore mismatches its own
+    manifest entry and is skipped by :func:`newest_valid_checkpoint`, which is the
+    entire point of recording it.
+
+    Synchronous BY DESIGN, even next to ``--async-checkpoint``: this store is the
+    supervisor's resume substrate and the preemption contract's "checkpoint already
+    durable at the boundary" — a write-behind versioned save would make the
+    cooperative-stop exit racy against its own artifact. The cost is one extra
+    serialize+hash per epoch on top of the overwrite checkpoint."""
+    if jax.process_index() != 0:
+        return None
+    keep = max(int(keep), 1)
+    t0 = time.perf_counter()
+    state = jax.device_get(state)
+    data = serialization.to_bytes(_state_dict_for_save(state))
+    step = int(state.step)
+    name = versioned_name(step)
+    path = os.path.join(dir_path, name)
+    _atomic_write(path, data)
+    manifest = load_manifest(dir_path)
+    entries = [e for e in manifest["entries"] if e.get("file") != name]
+    entries.append({"file": name, "step": step,
+                    "sha256": hashlib.sha256(data).hexdigest(),
+                    "bytes": len(data), "unix_time": time.time()})
+    entries.sort(key=lambda e: e["step"])
+    dropped, entries = entries[:-keep], entries[-keep:]
+    _atomic_write(os.path.join(dir_path, MANIFEST_NAME),
+                  json.dumps({"version": 1, "entries": entries},
+                             indent=1).encode())
+    for e in dropped:                     # GC strictly after the manifest stops
+        try:                              # naming them — a reader never sees a
+            os.remove(os.path.join(dir_path, e["file"]))   # manifest-listed hole
+        except OSError:
+            pass
+    _emit_checkpoint_event(tele, op="save", path=path, kind="full",
+                           nbytes=len(data), wall_s=time.perf_counter() - t0,
+                           step=step)
+    return path
+
+
+def newest_valid_checkpoint(dir_path: str) -> str | None:
+    """Newest-first scan of a versioned store, returning the first checkpoint whose
+    bytes verify — against the manifest's sha256 when the store has one, by msgpack
+    decode-validation otherwise (a hand-assembled directory of ``ckpt_*.msgpack``
+    still resolves). Torn/missing files are skipped, not raised: the caller is a
+    restart path and wants the best surviving artifact, or None."""
+    if not os.path.isdir(dir_path):
+        return None
+    entries = sorted(load_manifest(dir_path)["entries"],
+                     key=lambda e: e["step"], reverse=True)
+    if entries:
+        for e in entries:
+            path = os.path.join(dir_path, e["file"])
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue
+            if hashlib.sha256(data).hexdigest() == e.get("sha256"):
+                return path
+        return None
+    candidates = sorted((f for f in os.listdir(dir_path)
+                         if f.startswith(_VERSIONED_PREFIX)
+                         and f.endswith(_VERSIONED_SUFFIX)), reverse=True)
+    for name in candidates:
+        path = os.path.join(dir_path, name)
+        try:
+            _decode_msgpack(path)
+            return path
+        except (CheckpointCorrupt, OSError):
+            continue
+    return None
+
+
 class AsyncCheckpointer:
     """Write-behind checkpointing: serialization + disk IO run on a background
     thread so the train loop only pays the device→host fetch (which a synchronous
@@ -302,14 +485,21 @@ class AsyncCheckpointer:
     target). Distinct paths never coalesce. Writes stay atomic (tmp + rename) and
     process-0 gated; ``flush()`` drains the queue and re-raises the first background
     error. Usable as a context manager (``with AsyncCheckpointer() as ck: ...`` —
-    exit flushes)."""
+    exit flushes).
 
-    def __init__(self):
+    ``tele`` (a ``TelemetryWriter``) makes each completed background write emit a
+    ``checkpoint`` event carrying bytes, write seconds, and how many queued states
+    the write coalesced away — the async-policy number nothing else can observe.
+    Emission happens on the worker thread; the writer is thread-safe."""
+
+    def __init__(self, tele=None):
         self._pending: dict[str, object] = {}        # path -> newest host state
+        self._coalesced: dict[str, int] = {}         # path -> overwrites since last write
         self._lock = threading.Lock()
         self._work = queue.Queue()                   # paths with pending data
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
+        self._tele = tele
 
     def _worker(self) -> None:
         while True:
@@ -318,10 +508,18 @@ class AsyncCheckpointer:
                 return
             with self._lock:
                 state = self._pending.pop(path, None)
+                coalesced = self._coalesced.pop(path, 0)
             if state is None:                        # coalesced away
                 continue
             try:
-                _atomic_write(path, serialization.to_bytes(state))
+                t0 = time.perf_counter()
+                data = serialization.to_bytes(state)
+                _atomic_write(path, data)
+                _emit_checkpoint_event(
+                    self._tele, op="save", path=path, kind="full",
+                    nbytes=len(data), wall_s=time.perf_counter() - t0,
+                    step=int(state["step"]), background=True,
+                    coalesced=coalesced)
             except BaseException as e:               # surfaced on flush()
                 with self._lock:
                     if self._error is None:
@@ -338,6 +536,8 @@ class AsyncCheckpointer:
             self._thread.start()
         with self._lock:
             coalesced = path in self._pending
+            if coalesced:
+                self._coalesced[path] = self._coalesced.get(path, 0) + 1
             self._pending[path] = _state_dict_for_save(state_h)
         if not coalesced:
             self._work.put(path)
@@ -358,6 +558,33 @@ class AsyncCheckpointer:
     def __exit__(self, *exc):
         self.flush()
         return False
+
+
+class SyncSaver:
+    """Synchronous saver with the AsyncCheckpointer's call surface (save + flush),
+    so the trainers hold ONE saver object either way — plus per-save ``checkpoint``
+    telemetry (bytes + wall seconds) the bare module function cannot emit."""
+
+    def __init__(self, tele=None):
+        self._tele = tele
+
+    def save_train_state(self, path: str, state: TrainState) -> None:
+        t0 = time.perf_counter()
+        save_train_state(path, state)
+        if self._tele is not None and self._tele.enabled:
+            _emit_checkpoint_event(self._tele, op="save", path=path, kind="full",
+                                   nbytes=_path_bytes(path),
+                                   wall_s=time.perf_counter() - t0,
+                                   step=int(state.step))
+
+    def flush(self) -> None:
+        """Writes are already durable — parity no-op with the async surface."""
+
+
+def make_saver(async_: bool = False, tele=None):
+    """The trainers' one saver factory: write-behind or synchronous, both emitting
+    ``checkpoint`` telemetry events through ``tele`` and both flush()-able."""
+    return AsyncCheckpointer(tele=tele) if async_ else SyncSaver(tele=tele)
 
 
 def save_params(path: str, params) -> None:

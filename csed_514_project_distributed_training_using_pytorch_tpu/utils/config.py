@@ -91,6 +91,19 @@ class SingleProcessConfig:
                                       # (transformer only; composes with every core)
     use_pallas_kernels: bool = False  # fused Pallas loss/optimizer kernels
                                       # (ops/pallas_kernels.py; single-device step path)
+    heartbeat_dir: str = ""           # write a per-process liveness file (step +
+                                      # timestamp, atomic) each epoch for the fleet
+                                      # supervisor's hang detection
+                                      # (resilience/heartbeat.py); "" off
+    handle_preemption: bool = False   # SIGTERM/SIGINT request a cooperative stop at
+                                      # the next epoch boundary: final checkpoint +
+                                      # telemetry flush, then exit 75 ("preempted",
+                                      # resumable — resilience/preemption.py)
+    keep_checkpoints: int = 0         # ALSO keep the last N per-epoch checkpoints
+                                      # under results_dir/checkpoints/ with a
+                                      # checksummed manifest + GC — the versioned
+                                      # store the supervisor's newest-VALID resume
+                                      # scan reads (utils/checkpoint.py); 0 off
     use_host_pipeline: bool = False   # feed batches through the native C++ threaded
                                       # prefetcher (the DataLoader num_workers=4 analog,
                                       # src/train_dist.py:43-45) instead of the device-
@@ -163,6 +176,13 @@ class DistributedConfig:
                                       # SingleProcessConfig.kv_heads)
     rope: bool = False                # rotary position embeddings (see
                                       # SingleProcessConfig.rope)
+    heartbeat_dir: str = ""           # per-process liveness files for the fleet
+                                      # supervisor (see SingleProcessConfig); "" off
+    handle_preemption: bool = False   # cooperative SIGTERM stop at the next epoch
+                                      # boundary, exit 75 (see SingleProcessConfig)
+    keep_checkpoints: int = 0         # keep-last-N versioned checkpoint store with
+                                      # manifest under results_dir/checkpoints/
+                                      # (see SingleProcessConfig); 0 off
     host_local_feed: bool = False     # multi-host input pipeline: each process gathers and
                                       # feeds ONLY its addressable devices' shard of every
                                       # batch (SURVEY.md §7 hard part (d)) instead of the
@@ -278,6 +298,12 @@ class ComposedConfig:
                                         # every process saves only the shards it
                                         # addresses, no gather); --resume-from
                                         # accepts the directory (not with stage=)
+    heartbeat_dir: str = ""             # per-process liveness files for the fleet
+                                        # supervisor (see SingleProcessConfig)
+    handle_preemption: bool = False     # cooperative SIGTERM stop at the next epoch
+                                        # boundary, exit 75 (see SingleProcessConfig)
+    keep_checkpoints: int = 0           # keep-last-N versioned checkpoint store with
+                                        # manifest (see SingleProcessConfig); 0 off
     dropout_rate: float = 0.0           # 0 keeps composed runs comparable across meshes
     seed: int = 1
     data_dir: str = "files"
@@ -346,6 +372,12 @@ class LMConfig:
     results_dir: str = "results"
     images_dir: str = "images"
     resume_from: str = ""               # per-epoch checkpoint to resume from
+    heartbeat_dir: str = ""             # per-process liveness files for the fleet
+                                        # supervisor (see SingleProcessConfig)
+    handle_preemption: bool = False     # cooperative SIGTERM stop at the next epoch
+                                        # boundary, exit 75 (see SingleProcessConfig)
+    keep_checkpoints: int = 0           # keep-last-N versioned checkpoint store with
+                                        # manifest (see SingleProcessConfig); 0 off
     telemetry: str = ""                 # structured run-telemetry JSONL path (see
                                         # SingleProcessConfig.telemetry); "" off
     health_stats: bool = False          # in-scan training-health accumulators (see

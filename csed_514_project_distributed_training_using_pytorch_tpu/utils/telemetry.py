@@ -27,6 +27,17 @@ structured layer every perf PR proves its numbers through:
                  queue wait, e2e latency, tokens/s, finish reason
   ``serve_summary``  once per serving run at drain: request counts, aggregate
                  tokens/s, slot occupancy, p50/p95/p99 latency percentiles
+  ``checkpoint`` one line per checkpoint save/restore (``utils/checkpoint.py``
+                 savers + ``restore_for_resume``): op, path, full/sharded kind,
+                 bytes, wall seconds, step, and — for the write-behind saver —
+                 how many queued states the write coalesced away
+  ``preempt``    once, when a ``--handle-preemption`` trainer honors SIGTERM at an
+                 epoch boundary: the stop epoch/step and the durable checkpoint
+                 (the run then exits 75 — resilience/preemption.py)
+  ``restart``    written by the fleet supervisor (``resilience/supervisor.py``,
+                 via its own jax-free writer — same schema, same reader): attempt,
+                 crash/hung/timeout reason, exit code, the checkpoint the next
+                 attempt resumes from, backoff seconds
   =============  =====================================================================
 
 - **writer** — ``TelemetryWriter`` is process-0 gated (a fleet writes ONE file) and
@@ -48,6 +59,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import platform
+import threading
 import time
 
 import jax
@@ -97,6 +109,10 @@ class TelemetryWriter:
                                       # reopens (emit after close) append
         self._events: list[dict] = []
         self._t0 = time.time()
+        # emit() must be thread-safe: the write-behind checkpointer reports its
+        # completed writes from its worker thread while the trainer keeps emitting
+        # epoch events from the main one.
+        self._emit_lock = threading.Lock()
 
     @property
     def enabled(self) -> bool:
@@ -119,21 +135,23 @@ class TelemetryWriter:
         row = dict(event)
         row.setdefault("t_s", round(time.time() - self._t0, 6))
         row = _sanitize(row)
-        if self.stream:
-            # No in-memory event log here: stream mode exists for O(requests)
-            # volume, and the disk line IS the record. Reopening after close()
-            # appends — a writer shared across serving runs must never truncate
-            # lines it already flushed.
-            if self._fh is None:
-                os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-                self._fh = open(self.path, "a" if self._truncated else "w")
-                self._truncated = True
-            self._fh.write(json.dumps(row, allow_nan=False) + "\n")
-            self._fh.flush()
-            return
-        self._events.append(row)
-        payload = "".join(json.dumps(e, allow_nan=False) + "\n" for e in self._events)
-        _atomic_write(self.path, payload.encode())
+        with self._emit_lock:
+            if self.stream:
+                # No in-memory event log here: stream mode exists for O(requests)
+                # volume, and the disk line IS the record. Reopening after close()
+                # appends — a writer shared across serving runs must never truncate
+                # lines it already flushed.
+                if self._fh is None:
+                    os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+                    self._fh = open(self.path, "a" if self._truncated else "w")
+                    self._truncated = True
+                self._fh.write(json.dumps(row, allow_nan=False) + "\n")
+                self._fh.flush()
+                return
+            self._events.append(row)
+            payload = "".join(json.dumps(e, allow_nan=False) + "\n"
+                              for e in self._events)
+            _atomic_write(self.path, payload.encode())
 
     def close(self) -> None:
         """Release the stream-mode file handle (no-op otherwise)."""
@@ -288,6 +306,38 @@ def health_event(epoch: int, health, steps: int, *,
         "loss_max": _finite(float(health.loss_max)),
         "loss_mean": _finite(float(health.loss_sum) / steps),
         "param_norm": _finite(param_norm),
+    }
+
+
+def checkpoint_event(*, op: str, path: str, kind: str = "full",
+                     nbytes: int | None = None, wall_s: float | None = None,
+                     step: int | None = None, coalesced: int | None = None,
+                     background: bool = False) -> dict:
+    """One checkpoint save/restore (``utils/checkpoint.py``). ``op`` is ``"save"``
+    or ``"restore"``; ``kind`` ``"full"`` (one msgpack file) or ``"sharded"``
+    (per-process directory). ``coalesced`` counts the queued states a write-behind
+    save absorbed before this write hit disk (async saver only)."""
+    return {
+        "event": "checkpoint",
+        "op": op,
+        "path": path,
+        "kind": kind,
+        "bytes": int(nbytes) if nbytes is not None else None,
+        "wall_s": _finite(wall_s),
+        "step": int(step) if step is not None else None,
+        "background": bool(background),
+        "coalesced": int(coalesced) if coalesced is not None else None,
+    }
+
+
+def preempt_event(*, epoch: int, step: int, checkpoint: str = "") -> dict:
+    """A cooperative preemption stop (resilience/preemption.py): where the run
+    halted and which checkpoint that progress is durable in."""
+    return {
+        "event": "preempt",
+        "epoch": int(epoch),
+        "step": int(step),
+        "checkpoint": checkpoint,
     }
 
 

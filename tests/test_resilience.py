@@ -1,0 +1,522 @@
+"""Unit tests for the resilience layer: fault-spec parsing, heartbeats, preemption,
+the supervisor's retry/classify loop (against tiny jax-free child processes), the
+versioned checkpoint store's manifest/retention/newest-valid selection, and the
+checkpoint-corruption edges the supervisor depends on. The real 2-process fleet
+integration lives in test_resilience_fleet.py."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import types
+
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu import resilience
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+    faults, heartbeat, preemption,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+    supervisor as sup,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.train.launch import launch
+from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+    TrainState,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import checkpoint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "csed_514_project_distributed_training_using_pytorch_tpu"
+
+
+def make_state(step: int = 4) -> TrainState:
+    return TrainState(params={"w": np.arange(4, dtype=np.float32) + step},
+                      velocity={"w": np.zeros(4, dtype=np.float32)},
+                      step=np.int32(step), ema=None)
+
+
+# =========================================================================================
+# faults: spec parsing + triggers
+# =========================================================================================
+
+
+class TestFaults:
+    def test_parse_spec(self):
+        fs = faults._parse("kill:proc=1,step=8,exit=9,flag=/tmp/f;"
+                           "torn:match=ckpt_;freeze:epoch=2;preempt:")
+        assert [f.kind for f in fs] == ["kill", "torn", "freeze", "preempt"]
+        assert fs[0].proc == 1 and fs[0].step == 8 and fs[0].exit == 9
+        assert fs[1].match == "ckpt_" and fs[2].epoch == 2
+
+    def test_parse_rejects_unknown(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults._parse("explode:step=1")
+        with pytest.raises(ValueError, match="unknown fault key"):
+            faults._parse("kill:when=later")
+
+    def test_parse_rejects_untriggerable_torn_specs(self):
+        # step/epoch keys never fire on the write path — fail loudly at parse time
+        # instead of letting a test arranged that way pass vacuously.
+        with pytest.raises(ValueError, match="torn faults trigger by path match"):
+            faults._parse("torn:match=ckpt,step=8")
+        with pytest.raises(ValueError, match="needs a match"):
+            faults._parse("torn:flag=/tmp/f")
+
+    def test_inactive_without_env(self, monkeypatch):
+        monkeypatch.delenv(faults.ENV_VAR, raising=False)
+        assert not faults.active()
+        faults.on_tick(step=100, epoch=100)        # must be a no-op, not a crash
+        assert not faults.heartbeat_frozen(step=100, epoch=100)
+        assert faults.mangle_write("ckpt", b"data") == b"data"
+
+    def test_freeze_trigger_thresholds(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_VAR, "freeze:step=10")
+        assert not faults.heartbeat_frozen(step=9, epoch=0)
+        assert faults.heartbeat_frozen(step=10, epoch=0)
+        monkeypatch.setenv(faults.ENV_VAR, "freeze:proc=3,step=0")
+        assert not faults.heartbeat_frozen(step=5, epoch=0)   # we are proc 0
+
+    def test_torn_truncates_matching_write_once(self, monkeypatch, tmp_path):
+        flag = tmp_path / "torn"
+        monkeypatch.setenv(faults.ENV_VAR, f"torn:match=target,flag={flag}")
+        assert faults.mangle_write("/x/other.msgpack", b"12345678") == b"12345678"
+        assert faults.mangle_write("/x/target.msgpack", b"12345678") == b"1234"
+        # flag claimed: the same write path is clean on the next (restarted) try
+        assert faults.mangle_write("/x/target.msgpack", b"12345678") == b"12345678"
+
+    def test_kill_fault_fires_once_across_processes(self, monkeypatch, tmp_path):
+        """The kill fault hard-exits the process, so probe it in a child; the flag
+        marker must keep a second (restarted) child alive at the same step."""
+        flag = tmp_path / "killflag"
+        env = dict(os.environ,
+                   RESILIENCE_FAULTS=f"kill:proc=0,step=5,exit=9,flag={flag}")
+        prog = (f"from {PKG}.resilience import faults\n"
+                "faults.on_tick(step=4, epoch=0)\n"     # below threshold: no fire
+                "faults.on_tick(step=5, epoch=0)\n")
+        p = subprocess.run([sys.executable, "-c", prog], env=env, cwd=REPO,
+                           timeout=60)
+        assert p.returncode == 9
+        assert flag.with_name(flag.name + ".p0").exists()
+        p = subprocess.run([sys.executable, "-c", prog], env=env, cwd=REPO,
+                           timeout=60)
+        assert p.returncode == 0                         # marker: fired once, ever
+
+    def test_preempt_fault_sets_handler_latch(self, monkeypatch, tmp_path):
+        monkeypatch.setenv(faults.ENV_VAR, f"preempt:step=3,flag={tmp_path / 'f'}")
+        with preemption.PreemptionHandler() as h:
+            faults.on_tick(step=2, epoch=0)
+            assert not h.requested
+            faults.on_tick(step=3, epoch=0)
+            time.sleep(0.05)                             # let the signal deliver
+            assert h.requested and h.signum == signal.SIGTERM
+
+
+# =========================================================================================
+# heartbeat: beats, staleness, clearing
+# =========================================================================================
+
+
+class TestHeartbeat:
+    def test_beat_roundtrip(self, tmp_path):
+        hb = heartbeat.HeartbeatWriter(str(tmp_path), process_index=2)
+        hb.beat(step=7, epoch=1)
+        beats = heartbeat.read_heartbeats(str(tmp_path))
+        assert beats[2]["step"] == 7 and beats[2]["epoch"] == 1
+        assert beats[2]["status"] == heartbeat.STATUS_RUNNING
+        assert abs(beats[2]["time"] - time.time()) < 5
+
+    def test_staleness_uses_fleet_start_before_first_beat(self, tmp_path):
+        # Process 0 beat just now; process 1 never did — its silence is measured
+        # from fleet start (``since``), so an old fleet is stale but a young one
+        # still has its startup grace.
+        heartbeat.HeartbeatWriter(str(tmp_path), process_index=0).beat(step=1,
+                                                                       epoch=0)
+        now = time.time()
+        assert heartbeat.stale_processes(str(tmp_path), num_processes=2,
+                                         timeout_s=30, since=now - 50,
+                                         now=now + 1) == [1]
+        assert heartbeat.stale_processes(str(tmp_path), num_processes=2,
+                                         timeout_s=30, since=now - 20,
+                                         now=now + 1) == []
+
+    def test_old_attempts_beats_never_vouch(self, tmp_path):
+        old = time.time() - 100
+        heartbeat.HeartbeatWriter(str(tmp_path), process_index=0).beat(step=9,
+                                                                       epoch=2)
+        # A beat written BEFORE this attempt started is clamped to fleet start.
+        now = time.time()
+        assert heartbeat.stale_processes(str(tmp_path), num_processes=1,
+                                         timeout_s=5, since=now + 50,
+                                         now=now + 60) == [0]
+        del old
+
+    def test_clear(self, tmp_path):
+        heartbeat.HeartbeatWriter(str(tmp_path), process_index=0).beat(step=1,
+                                                                       epoch=0)
+        heartbeat.clear(str(tmp_path))
+        assert heartbeat.read_heartbeats(str(tmp_path)) == {}
+
+
+# =========================================================================================
+# preemption: handler latch + Preempted
+# =========================================================================================
+
+
+class TestPreemption:
+    def test_handler_latches_and_restores(self):
+        before = signal.getsignal(signal.SIGTERM)
+        with preemption.PreemptionHandler() as h:
+            assert not h.requested
+            signal.raise_signal(signal.SIGTERM)
+            assert h.requested and h.signum == signal.SIGTERM
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_preempted_carries_step_and_checkpoint(self):
+        e = preemption.Preempted(12, "results/model.ckpt")
+        assert e.step == 12 and e.checkpoint == "results/model.ckpt"
+        assert "12" in str(e) and preemption.EXIT_PREEMPTED == 75
+
+
+# =========================================================================================
+# RunHooks: the trainers' wiring surface
+# =========================================================================================
+
+
+class TestRunHooks:
+    def test_inactive_hooks_never_touch_state(self, monkeypatch):
+        monkeypatch.delenv(faults.ENV_VAR, raising=False)
+        rt = resilience.RunHooks()
+
+        class Untouchable:
+            @property
+            def step(self):                     # zero-cost-off contract: no sync
+                raise AssertionError("flag-off tick read state.step")
+
+        rt.epoch_tick(Untouchable(), 0)
+        rt.check_preempt(epoch=0, state=Untouchable())   # no handler: no-op
+
+    def test_tick_beats_and_freeze_fault_suppresses(self, monkeypatch, tmp_path):
+        rt = resilience.RunHooks(heartbeat_dir=str(tmp_path), process_index=0)
+        state = types.SimpleNamespace(step=np.int32(3))
+        rt.epoch_tick(state, epoch=0)
+        assert heartbeat.read_heartbeats(str(tmp_path))[0]["step"] == 3
+        monkeypatch.setenv(faults.ENV_VAR, "freeze:step=4")
+        rt.epoch_tick(types.SimpleNamespace(step=np.int32(4)), epoch=1)
+        assert heartbeat.read_heartbeats(str(tmp_path))[0]["step"] == 3  # frozen
+
+    def test_check_preempt_saves_emits_and_raises(self, tmp_path):
+        rt = resilience.RunHooks(heartbeat_dir=str(tmp_path),
+                                 handle_preemption=True)
+        try:
+            saved = []
+            signal.raise_signal(signal.SIGTERM)
+            with pytest.raises(resilience.Preempted) as ei:
+                rt.check_preempt(epoch=2, state=types.SimpleNamespace(step=8),
+                                 checkpoint="ck", save=lambda: saved.append(1))
+            assert saved == [1]
+            assert ei.value.step == 8 and ei.value.checkpoint == "ck"
+            beats = heartbeat.read_heartbeats(str(tmp_path))
+            assert beats[0]["status"] == heartbeat.STATUS_PREEMPTED
+        finally:
+            rt.preemption.uninstall()
+
+
+# =========================================================================================
+# versioned checkpoint store: manifest, GC, newest-valid
+# =========================================================================================
+
+
+class TestVersionedStore:
+    def test_retention_gc(self, tmp_path):
+        store = str(tmp_path / "store")
+        for step in (4, 8, 12):
+            checkpoint.save_versioned(store, make_state(step), keep=2)
+        files = sorted(f for f in os.listdir(store) if f.startswith("ckpt_"))
+        assert files == ["ckpt_00000008.msgpack", "ckpt_00000012.msgpack"]
+        entries = checkpoint.load_manifest(store)["entries"]
+        assert [e["step"] for e in entries] == [8, 12]
+        assert all(e["sha256"] and e["bytes"] > 0 for e in entries)
+
+    def test_newest_valid_skips_torn_write(self, tmp_path):
+        store = str(tmp_path / "store")
+        for step in (4, 8):
+            checkpoint.save_versioned(store, make_state(step), keep=3)
+        newest = os.path.join(store, checkpoint.versioned_name(8))
+        data = open(newest, "rb").read()
+        with open(newest, "wb") as f:                  # torn write, manifest intact
+            f.write(data[:len(data) // 2])
+        picked = checkpoint.newest_valid_checkpoint(store)
+        assert picked == os.path.join(store, checkpoint.versioned_name(4))
+        # the survivor actually restores
+        restored = checkpoint.restore_train_state(picked, make_state(0))
+        assert int(restored.step) == 4
+
+    def test_newest_valid_none_when_all_torn(self, tmp_path):
+        store = str(tmp_path / "store")
+        checkpoint.save_versioned(store, make_state(4), keep=3)
+        path = os.path.join(store, checkpoint.versioned_name(4))
+        with open(path, "wb") as f:
+            f.write(b"xx")
+        assert checkpoint.newest_valid_checkpoint(store) is None
+        assert checkpoint.newest_valid_checkpoint(str(tmp_path / "absent")) is None
+
+    def test_manifestless_dir_falls_back_to_decode_validation(self, tmp_path):
+        store = str(tmp_path / "store")
+        checkpoint.save_versioned(store, make_state(4), keep=3)
+        checkpoint.save_versioned(store, make_state(8), keep=3)
+        os.remove(os.path.join(store, checkpoint.MANIFEST_NAME))
+        with open(os.path.join(store, checkpoint.versioned_name(8)), "wb") as f:
+            f.write(b"torn")
+        assert checkpoint.newest_valid_checkpoint(store) == os.path.join(
+            store, checkpoint.versioned_name(4))
+
+    def test_torn_fault_is_caught_by_manifest_scan(self, monkeypatch, tmp_path):
+        """End-to-end inside one process: an armed torn fault corrupts the write,
+        but the manifest checksum (computed pre-write) refuses it on scan."""
+        store = str(tmp_path / "store")
+        checkpoint.save_versioned(store, make_state(4), keep=3)
+        monkeypatch.setenv(faults.ENV_VAR, "torn:match=ckpt_00000008")
+        checkpoint.save_versioned(store, make_state(8), keep=3)
+        monkeypatch.delenv(faults.ENV_VAR)
+        assert [e["step"] for e in checkpoint.load_manifest(store)["entries"]] \
+            == [4, 8]
+        assert checkpoint.newest_valid_checkpoint(store) == os.path.join(
+            store, checkpoint.versioned_name(4))
+
+
+# =========================================================================================
+# checkpoint corruption + resume edges (satellites)
+# =========================================================================================
+
+
+class TestCheckpointEdges:
+    def test_restore_corrupt_full_checkpoint_is_crisp(self, tmp_path):
+        path = str(tmp_path / "model.ckpt")
+        checkpoint.save_train_state(path, make_state(4))
+        data = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(data[:len(data) // 2])
+        with pytest.raises(checkpoint.CheckpointCorrupt, match="model.ckpt"):
+            checkpoint.restore_train_state(path, make_state(0))
+
+    def test_restore_corrupt_sharded_checkpoint_is_crisp(self, tmp_path):
+        import jax
+        d = str(tmp_path / "sharded")
+        state = TrainState(params={"w": jax.numpy.arange(4, dtype=np.float32)},
+                           velocity={"w": jax.numpy.zeros(4)},
+                           step=jax.numpy.int32(4), ema=None)
+        checkpoint.save_train_state_sharded(d, state)
+        shard = os.path.join(d, "shards_p0.msgpack")
+        data = open(shard, "rb").read()
+        with open(shard, "wb") as f:
+            f.write(data[:len(data) // 2])
+        with pytest.raises(checkpoint.CheckpointCorrupt, match="shards_p0"):
+            checkpoint.restore_train_state_sharded(d, state)
+
+    def test_restore_for_resume_mid_epoch_warning(self, tmp_path):
+        path = str(tmp_path / "model.ckpt")
+        checkpoint.save_train_state(path, make_state(5))
+        state, start_epoch, warning = checkpoint.restore_for_resume(
+            path, make_state(0), process_index=0, process_count=1,
+            steps_per_epoch=4)
+        assert int(state.step) == 5 and start_epoch == 1
+        assert warning is not None and "mid-epoch" in warning
+        # whole-epoch checkpoints resume silently
+        checkpoint.save_train_state(path, make_state(8))
+        _, start_epoch, warning = checkpoint.restore_for_resume(
+            path, make_state(0), process_index=0, process_count=1,
+            steps_per_epoch=4)
+        assert start_epoch == 2 and warning is None
+
+    def test_box_subtract_degenerate_and_overlap(self):
+        bs = checkpoint._box_subtract
+        assert bs((), ()) == []                        # 0-d scalar: any cut removes
+        box = ((0, 4), (0, 4))
+        assert bs(box, ((4, 8), (0, 4))) == [box]      # disjoint: survives whole
+        assert bs(box, ((0, 4), (0, 4))) == []         # exact cover
+        assert bs(box, ((0, 4), (2, 2))) == [box]      # empty cut: no-op
+        pieces = bs(box, ((1, 3), (1, 3)))             # interior cut: ring of 4
+        assert len(pieces) == 4
+        covered = np.zeros((4, 4), bool)
+        for p in pieces:
+            region = tuple(slice(lo, hi) for lo, hi in p)
+            assert not covered[region].any()           # disjointness
+            covered[region] = True
+        covered[1:3, 1:3] = True
+        assert covered.all()                           # exact complement
+
+    def test_overlapping_cuts_do_not_double_remove(self):
+        bs = checkpoint._box_subtract
+        boxes = [((0, 8),)]
+        for cut in [((0, 5),), ((3, 8),)]:             # overlapping cuts
+            boxes = [p for b in boxes for p in bs(b, cut)]
+        assert boxes == []                             # covered exactly once-ish
+
+
+# =========================================================================================
+# supervisor: classify + restart against tiny jax-free children
+# =========================================================================================
+
+
+def _read_events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+class TestSupervisor:
+    def test_restarts_until_success(self, tmp_path):
+        cnt = tmp_path / "attempts"
+        script = (f"import os, sys; p = {str(cnt)!r}\n"
+                  "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+                  "open(p, 'w').write(str(n + 1))\n"
+                  "sys.exit(0 if n >= 2 else 7)\n")
+        cfg = sup.SupervisorConfig(num_processes=1, max_restarts=5, backoff_s=0.0,
+                                   poll_s=0.01,
+                                   telemetry=str(tmp_path / "sup.jsonl"))
+        res = sup.supervise(["-c", script], cfg)
+        assert (res.status, res.exit_code) == ("ok", 0)
+        assert res.attempts == 3 and res.restarts == 2
+        events = _read_events(tmp_path / "sup.jsonl")
+        restarts = [e for e in events if e["event"] == "restart"]
+        assert len(restarts) == 2
+        assert all(e["reason"] == "crash" and e["exit_code"] == 7 for e in restarts)
+        assert events[-1]["event"] == "supervise_summary"
+        assert events[-1]["status"] == "ok"
+
+    def test_all_workers_crashing_is_never_ok(self, tmp_path):
+        """Both workers dying (even between supervisor polls) must classify as a
+        crash, not slip through the drained-fleet path as success."""
+        cfg = sup.SupervisorConfig(num_processes=2, max_restarts=1, backoff_s=0.0,
+                                   poll_s=0.01)
+        res = sup.supervise(["-c", "import sys; sys.exit(7)"], cfg)
+        assert (res.status, res.exit_code) == ("failed", 7)
+        assert res.attempts == 2
+
+    def test_retry_budget_exhausted(self, tmp_path):
+        cfg = sup.SupervisorConfig(num_processes=1, max_restarts=1, backoff_s=0.0,
+                                   poll_s=0.01)
+        res = sup.supervise(["-c", "import sys; sys.exit(5)"], cfg)
+        assert (res.status, res.exit_code) == ("failed", 5)
+        assert res.attempts == 2 and res.restarts == 1
+
+    def test_preempted_child_is_resumable_not_failed(self, tmp_path):
+        cfg = sup.SupervisorConfig(num_processes=1, max_restarts=3, backoff_s=0.0,
+                                   poll_s=0.01)
+        res = sup.supervise(
+            ["-c", f"import sys; sys.exit({preemption.EXIT_PREEMPTED})"], cfg)
+        assert (res.status, res.exit_code) == ("preempted", 75)
+        assert res.restarts == 0                      # no retry burned
+
+    def test_hung_fleet_detected_by_heartbeat_staleness(self, tmp_path):
+        hb_dir = tmp_path / "hb"
+        cfg = sup.SupervisorConfig(num_processes=1, max_restarts=1, backoff_s=0.0,
+                                   poll_s=0.05, heartbeat_dir=str(hb_dir),
+                                   heartbeat_timeout_s=1.0,
+                                   telemetry=str(tmp_path / "sup.jsonl"))
+        t0 = time.monotonic()
+        res = sup.supervise(["-c", "import time; time.sleep(120)"], cfg)
+        assert res.status == "failed"
+        assert res.exit_code == sup.EXIT_TORN_DOWN
+        assert time.monotonic() - t0 < 60             # detected, not waited out
+        restarts = [e for e in _read_events(tmp_path / "sup.jsonl")
+                    if e["event"] == "restart"]
+        assert len(restarts) == 1 and restarts[0]["reason"] == "hung"
+
+    def test_resumes_from_newest_valid_checkpoint(self, tmp_path):
+        store = tmp_path / "store"
+        checkpoint.save_versioned(str(store), make_state(4), keep=3)
+        checkpoint.save_versioned(str(store), make_state(8), keep=3)
+        newest = store / checkpoint.versioned_name(8)
+        data = newest.read_bytes()
+        newest.write_bytes(data[:len(data) // 2])     # torn: must be skipped
+        out = tmp_path / "argv.json"
+        script = (f"import json, sys; json.dump(sys.argv[1:], open({str(out)!r}, 'w'))")
+        cfg = sup.SupervisorConfig(num_processes=1, max_restarts=0,
+                                   checkpoint_dir=str(store), poll_s=0.01)
+        res = sup.supervise(["-c", script], cfg)
+        assert res.status == "ok"
+        argv = json.load(open(out))
+        assert argv[-2:] == ["--resume-from",
+                             str(store / checkpoint.versioned_name(4))]
+        assert res.resume_history == [str(store / checkpoint.versioned_name(4))]
+
+
+# =========================================================================================
+# launcher: fail-fast flag (satellite) + CLI smokes (satellite)
+# =========================================================================================
+
+
+class TestFailFast:
+    CMD = ["-c",
+           "import os, sys, time\n"
+           "sys.exit(3) if os.environ['JAX_PROCESS_ID'] == '0' else time.sleep(120)\n"]
+
+    def test_fail_fast_tears_down_peers_promptly(self):
+        t0 = time.monotonic()
+        assert launch(self.CMD, num_processes=2, timeout=60) == 3
+        assert time.monotonic() - t0 < 30
+
+    def test_no_fail_fast_waits_for_all(self):
+        cmd = ["-c",
+               "import os, sys, time\n"
+               "if os.environ['JAX_PROCESS_ID'] == '0':\n"
+               "    sys.exit(3)\n"
+               "time.sleep(1.0)\n"]
+        t0 = time.monotonic()
+        assert launch(cmd, num_processes=2, timeout=60, fail_fast=False) == 3
+        assert time.monotonic() - t0 >= 1.0           # peer ran to its own exit
+
+    def test_cli_flag_passthrough(self, monkeypatch):
+        from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+            launch as L,
+        )
+        seen = {}
+        monkeypatch.setattr(L, "launch",
+                            lambda command, **kw: seen.update(kw) or 0)
+        L.main(["--num-processes", "2", "--no-fail-fast", "--", "-m", "x"])
+        assert seen["fail_fast"] is False
+        L.main(["--num-processes", "2", "--", "-m", "x"])
+        assert seen["fail_fast"] is True
+
+
+def test_cli_help_smokes():
+    """train.launch --help and tools/fleet_supervise.py --help exit 0 (satellite)."""
+    for cmd in ([sys.executable, "-m", f"{PKG}.train.launch", "--help"],
+                [sys.executable, os.path.join(REPO, "tools", "fleet_supervise.py"),
+                 "--help"]):
+        p = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                           timeout=120)
+        assert p.returncode == 0, p.stderr
+        assert "usage" in p.stdout.lower()
+
+
+def test_report_renders_resilience_events(tmp_path, capsys):
+    """telemetry_report summarizes checkpoint/restart/preempt events (satellite)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+    rows = [
+        {"event": "checkpoint", "op": "save", "path": "a", "kind": "full",
+         "bytes": 1000, "wall_s": 0.01, "step": 4, "coalesced": 2,
+         "background": True},
+        {"event": "checkpoint", "op": "restore", "path": "a", "kind": "full",
+         "bytes": 1000, "wall_s": 0.02, "step": 4},
+        {"event": "restart", "attempt": 1, "reason": "crash", "exit_code": 41,
+         "resume_from": "a", "backoff_s": 0.0},
+        {"event": "preempt", "epoch": 1, "step": 8, "checkpoint": "a"},
+    ]
+    path = tmp_path / "t.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    s = telemetry_report.summarize(str(path))
+    assert s["ckpt_saves"] == 1 and s["ckpt_coalesced"] == 2
+    assert s["ckpt_restores"] == 1
+    assert s["restarts"] == 1 and s["restart_reasons"] == ["crash"]
+    assert s["preempted_step"] == 8
+    telemetry_report.print_summary(s)
+    out = capsys.readouterr().out
+    assert "restarts: 1 (crash)" in out and "preempted at step 8" in out

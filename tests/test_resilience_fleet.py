@@ -1,0 +1,211 @@
+"""Fault-injection integration tests: real multi-process CPU fleets under the
+supervisor (tier-1 by design — these are the acceptance gates of the resilience
+layer, not heavyweight equivalence sweeps).
+
+- a 2-process fleet with a worker hard-killed mid-run is torn down, restarted from
+  the newest VALID checkpoint (the torn write the fault produced is skipped), and
+  completes with the same final step as an uninterrupted run;
+- a preemption signal makes the fleet stop cooperatively at the next epoch boundary,
+  exit with the distinct "preempted" status (75), and leave a checkpoint that a
+  fresh run resumes to completion;
+- the resilience flags are behaviorally zero-cost: flag-on training is bitwise
+  identical to flag-off (the hooks are host-side only — same discipline as
+  ``--health-stats``).
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+from flax import serialization
+
+from csed_514_project_distributed_training_using_pytorch_tpu.data.mnist import (
+    Dataset, _normalize, _synthesize_split,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+    heartbeat, preemption, supervisor as sup,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.train.launch import launch
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import checkpoint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "csed_514_project_distributed_training_using_pytorch_tpu"
+
+# 256 examples / 2 replicas / per-replica batch 32 -> 4 steps per epoch; 3 epochs
+# -> an uninterrupted run ends at step 12 with versioned checkpoints at 4, 8, 12.
+STEPS_PER_EPOCH, EPOCHS = 4, 3
+TRAIN = [
+    "-m", f"{PKG}.train.distributed",
+    "--epochs", str(EPOCHS), "--global-batch-size", "64",
+    "--batch-size-test", "256",
+    "--max-train-examples", "256", "--max-test-examples", "256",
+    "--keep-checkpoints", "3", "--handle-preemption",
+]
+
+
+@pytest.fixture(autouse=True)
+def _child_pythonpath(monkeypatch):
+    """Children must find the package no matter their cwd."""
+    existing = os.environ.get("PYTHONPATH", "")
+    monkeypatch.setenv("PYTHONPATH", f"{REPO}:{existing}" if existing else REPO)
+
+
+def _step_of(ckpt_path: str) -> int:
+    with open(ckpt_path, "rb") as f:
+        return int(serialization.msgpack_restore(f.read())["step"])
+
+
+def test_supervisor_restarts_killed_fleet_skipping_torn_checkpoint(tmp_path,
+                                                                   monkeypatch):
+    """Kill worker 1 at the epoch-2 tick AND tear the epoch-1 checkpoint write: the
+    supervisor must fall back to the epoch-0 checkpoint (never the torn one),
+    restart the fleet, and finish with an uninterrupted run's final step."""
+    work = tmp_path / "supervised"
+    work.mkdir()
+    monkeypatch.chdir(work)
+    store = str(work / "results" / "checkpoints")
+    flags = tmp_path / "flags"
+    flags.mkdir()
+    monkeypatch.setenv("RESILIENCE_FAULTS",
+                       f"torn:match=ckpt_00000008,flag={flags / 'torn'};"
+                       f"kill:proc=1,step=8,exit=41,flag={flags / 'kill'}")
+    cfg = sup.SupervisorConfig(num_processes=2, platform="cpu",
+                               devices_per_process=1, max_restarts=2,
+                               backoff_s=0.0, checkpoint_dir=store,
+                               attempt_timeout_s=300,
+                               telemetry=str(work / "supervisor.jsonl"))
+    res = sup.supervise(TRAIN, cfg)
+    assert (res.status, res.exit_code) == ("ok", 0)
+    assert res.attempts == 2 and res.restarts == 1
+    ckpt4 = os.path.join(store, checkpoint.versioned_name(4))
+    # The torn step-8 checkpoint was never selected: attempt 2 resumed from step 4.
+    assert res.resume_history == [None, ckpt4]
+    with open(work / "supervisor.jsonl") as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    restarts = [e for e in events if e["event"] == "restart"]
+    assert len(restarts) == 1
+    assert restarts[0]["reason"] == "crash" and restarts[0]["exit_code"] == 41
+    assert restarts[0]["resume_from"] == ckpt4
+
+    # Uninterrupted reference run: same command, no faults, plain launch.
+    monkeypatch.delenv("RESILIENCE_FAULTS")
+    ref = tmp_path / "uninterrupted"
+    ref.mkdir()
+    monkeypatch.chdir(ref)
+    assert launch(TRAIN, num_processes=2, platform="cpu", devices_per_process=1,
+                  timeout=300) == 0
+    ref_store = str(ref / "results" / "checkpoints")
+    ref_final = checkpoint.newest_valid_checkpoint(ref_store)
+    supervised_final = checkpoint.newest_valid_checkpoint(store)
+    assert _step_of(supervised_final) == _step_of(ref_final) \
+        == EPOCHS * STEPS_PER_EPOCH
+
+
+def test_preempted_fleet_exits_75_with_resumable_checkpoint(tmp_path, monkeypatch):
+    """A SIGTERM'd (fault-delivered, so deterministic) fleet finishes its epoch,
+    checkpoints, emits the preempt event, and exits 75; a fresh run resumes the
+    checkpoint to the full step count."""
+    monkeypatch.chdir(tmp_path)
+    hb_dir = str(tmp_path / "hb")
+    args = TRAIN + ["--heartbeat-dir", hb_dir,
+                    "--telemetry", str(tmp_path / "run.jsonl")]
+    # Both processes SIGTERM themselves at the epoch-1 tick (step 4): the run must
+    # complete epoch 1, checkpoint at step 8, and stop cooperatively.
+    monkeypatch.setenv("RESILIENCE_FAULTS", "preempt:step=4")
+    code = launch(args, num_processes=2, platform="cpu", devices_per_process=1,
+                  timeout=300)
+    assert code == preemption.EXIT_PREEMPTED
+    ckpt = tmp_path / "results" / "model_dist.ckpt"
+    assert ckpt.exists() and _step_of(str(ckpt)) == 2 * STEPS_PER_EPOCH
+    with open(tmp_path / "run.jsonl") as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    preempts = [e for e in events if e["event"] == "preempt"]
+    assert len(preempts) == 1
+    assert preempts[0]["step"] == 2 * STEPS_PER_EPOCH
+    assert preempts[0]["checkpoint"].endswith("model_dist.ckpt")
+    beats = heartbeat.read_heartbeats(hb_dir)
+    assert beats and all(b["status"] == heartbeat.STATUS_PREEMPTED
+                         for b in beats.values())
+
+    # The preempted checkpoint resumes to completion once capacity returns.
+    monkeypatch.delenv("RESILIENCE_FAULTS")
+    assert launch(args + ["--resume-from", str(ckpt)], num_processes=2,
+                  platform="cpu", devices_per_process=1, timeout=300) == 0
+    assert _step_of(str(ckpt)) == EPOCHS * STEPS_PER_EPOCH
+
+
+@pytest.fixture()
+def tiny_datasets():
+    xs, ys = _synthesize_split(256, seed=300)
+    train = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+    xs, ys = _synthesize_split(100, seed=301)
+    test = Dataset(_normalize(xs), ys.astype(np.int32), "synthetic")
+    return train, test
+
+
+def test_resilience_flags_are_bitwise_zero_cost(tmp_path, tiny_datasets):
+    """Heartbeat + preemption wiring on (but unsignalled) trains bitwise-identically
+    to flags off — the hooks are host-side only, the compiled program is untouched
+    (the --health-stats discipline, acceptance criterion)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.train import single
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+        SingleProcessConfig,
+    )
+
+    results = {}
+    try:
+        for name, extra in [("off", {}),
+                            ("on", {"heartbeat_dir": str(tmp_path / "hb"),
+                                    "handle_preemption": True,
+                                    "keep_checkpoints": 2})]:
+            cfg = SingleProcessConfig(
+                n_epochs=1, batch_size_train=64, batch_size_test=100,
+                results_dir=str(tmp_path / name / "results"),
+                images_dir=str(tmp_path / name / "images"), **extra)
+            state, _ = single.main(cfg, datasets=tiny_datasets)
+            results[name] = state
+    finally:
+        # single.main installs the SIGTERM/SIGINT latch in-process; restore.
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        signal.signal(signal.SIGINT, signal.default_int_handler)
+
+    import jax
+    leaves_off = jax.tree_util.tree_leaves(results["off"].params)
+    leaves_on = jax.tree_util.tree_leaves(results["on"].params)
+    for a, b in zip(leaves_off, leaves_on):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ...and the flag-on run actually produced its artifacts.
+    beats = heartbeat.read_heartbeats(str(tmp_path / "hb"))
+    assert beats[0]["epoch"] == 1
+    store = str(tmp_path / "on" / "results" / "checkpoints")
+    assert checkpoint.newest_valid_checkpoint(store) is not None
+
+
+def test_single_trainer_preempts_cooperatively_in_process(tmp_path, monkeypatch,
+                                                          tiny_datasets):
+    """In-process flavor of the preemption contract: the fault-delivered SIGTERM
+    surfaces as Preempted at the epoch boundary with the checkpoint durable."""
+    from csed_514_project_distributed_training_using_pytorch_tpu import resilience
+    from csed_514_project_distributed_training_using_pytorch_tpu.train import single
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+        SingleProcessConfig,
+    )
+
+    monkeypatch.setenv("RESILIENCE_FAULTS", "preempt:epoch=1")
+    cfg = SingleProcessConfig(
+        n_epochs=3, batch_size_train=64, batch_size_test=100,
+        handle_preemption=True, heartbeat_dir=str(tmp_path / "hb"),
+        results_dir=str(tmp_path / "results"), images_dir=str(tmp_path / "images"))
+    try:
+        with pytest.raises(resilience.Preempted) as ei:
+            single.main(cfg, datasets=tiny_datasets)
+    finally:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        signal.signal(signal.SIGINT, signal.default_int_handler)
+    ckpt = tmp_path / "results" / "model.ckpt"
+    assert ckpt.exists()
+    assert ei.value.step == _step_of(str(ckpt)) > 0
+    beats = heartbeat.read_heartbeats(str(tmp_path / "hb"))
+    assert beats[0]["status"] == heartbeat.STATUS_PREEMPTED

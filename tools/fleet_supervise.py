@@ -1,0 +1,91 @@
+"""Run a training fleet under the resilience supervisor: crash → restart from the
+newest valid checkpoint, hang → teardown + restart, SIGTERM → cooperative preemption.
+
+The command after ``--`` is what each fleet process runs (same contract as
+``train.launch``: every process gets the same command plus rendezvous env). Give the
+trainer the resilience flags and the supervisor the matching dirs::
+
+    python tools/fleet_supervise.py --num-processes 2 --platform cpu \\
+        --max-restarts 3 --heartbeat-timeout 300 \\
+        --checkpoint-dir results/checkpoints --heartbeat-dir results/heartbeats \\
+        --telemetry results/supervisor.jsonl -- \\
+        -m csed_514_project_distributed_training_using_pytorch_tpu.train.distributed \\
+        --epochs 6 --keep-checkpoints 3 --handle-preemption
+
+Kill a worker mid-run (``kill -9 <pid>``, or arm ``RESILIENCE_FAULTS`` — see
+``resilience/faults.py``) and watch the supervisor tear the fleet down and resume it
+from the last checkpoint whose checksum verifies. SIGTERM the supervisor itself to
+preempt the whole run: it forwards the signal, the trainers stop at the next epoch
+boundary with a durable checkpoint, and everything exits 75 ("preempted, resumable").
+
+Exit status: 0 on success, 75 when preempted, otherwise the fleet's failing exit code.
+Render the supervisor's telemetry (restart events) with ``tools/telemetry_report.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# Script-mode import path: ``python tools/fleet_supervise.py`` puts tools/ on
+# sys.path, not the repo root the package lives in.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience.supervisor import (  # noqa: E402
+    SupervisorConfig,
+    supervise,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__.split("\n", 1)[0],
+        usage="python tools/fleet_supervise.py [options] -- <python args>")
+    p.add_argument("--num-processes", type=int, default=2)
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform in children (e.g. cpu for emulation)")
+    p.add_argument("--devices-per-process", type=int, default=1)
+    p.add_argument("--port", type=int, default=None,
+                   help="coordinator port (default: a free one per attempt)")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="restart budget (attempts = restarts + 1)")
+    p.add_argument("--backoff", type=float, default=1.0,
+                   help="restart backoff seconds (doubles per restart)")
+    p.add_argument("--backoff-max", type=float, default=30.0)
+    p.add_argument("--checkpoint-dir", default="",
+                   help="versioned checkpoint store (trainer --keep-checkpoints) to "
+                        "resume from; newest VALID checkpoint wins, torn writes are "
+                        "skipped")
+    p.add_argument("--heartbeat-dir", default="",
+                   help="fleet liveness dir; auto-appended to the child command")
+    p.add_argument("--heartbeat-timeout", type=float, default=0.0,
+                   help="seconds of beat staleness that counts as hung (0 off); "
+                        "set comfortably above one epoch's wall time")
+    p.add_argument("--attempt-timeout", type=float, default=0.0,
+                   help="wall-clock bound per attempt (0 = unbounded)")
+    p.add_argument("--telemetry", default="",
+                   help="supervisor JSONL (restart events) path")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="everything after -- runs as: python <command>")
+    args = p.parse_args(argv)
+    command = args.command[1:] if args.command[:1] == ["--"] else args.command
+    if not command:
+        p.error("no command given — pass e.g. `-- -m <module> [args]`")
+
+    cfg = SupervisorConfig(
+        num_processes=args.num_processes, platform=args.platform,
+        devices_per_process=args.devices_per_process, port=args.port,
+        max_restarts=args.max_restarts, backoff_s=args.backoff,
+        backoff_max_s=args.backoff_max, checkpoint_dir=args.checkpoint_dir,
+        heartbeat_dir=args.heartbeat_dir,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        attempt_timeout_s=args.attempt_timeout, telemetry=args.telemetry)
+    result = supervise(command, cfg)
+    print(f"[supervisor] {result.status}: exit {result.exit_code}, "
+          f"{result.attempts} attempt(s), {result.restarts} restart(s)")
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

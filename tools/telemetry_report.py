@@ -1,10 +1,12 @@
 """Render telemetry JSONL (utils/telemetry.py) as a run summary or A-vs-B comparison.
 
 Input files are whatever the trainers' ``--telemetry PATH`` wrote (manifest /
-compile / epoch / health / mfu events), ``bench*.py --telemetry`` output (bench
-events), serving logs from ``serving/server.py`` / ``tools/serve_loadgen.py``
-(serve / serve_summary events — rendered as a TTFT/TPOT/e2e latency-percentile
-table plus aggregate tokens/s), or the loss-curve ``metrics.jsonl`` companions
+compile / epoch / health / mfu / checkpoint / preempt events), ``bench*.py
+--telemetry`` output (bench events), serving logs from ``serving/server.py`` /
+``tools/serve_loadgen.py`` (serve / serve_summary events — rendered as a
+TTFT/TPOT/e2e latency-percentile table plus aggregate tokens/s), supervisor logs
+from ``tools/fleet_supervise.py`` (restart events — rendered as a restart count
+with reasons), or the loss-curve ``metrics.jsonl`` companions
 (``kind`` rows) — all read through the one shared reader,
 ``utils.metrics.load_metrics_jsonl``, which passes unknown event types through.
 
@@ -149,6 +151,32 @@ def summarize(path: str) -> dict:
         span = max(ts) - min(starts) if ts and starts else None
         s["serve_tokens_per_s"] = toks / span if toks and span else None
 
+    # Checkpoint traffic (utils/checkpoint.py savers + restores): how much resume
+    # insurance the run paid for, and what it cost in wall time.
+    ckpts = by_event.get("checkpoint", [])
+    saves = [c for c in ckpts if c.get("op") == "save"]
+    if saves:
+        s["ckpt_saves"] = len(saves)
+        s["ckpt_save_s"] = _median([c.get("wall_s") for c in saves])
+        s["ckpt_bytes"] = next((c.get("bytes") for c in reversed(saves)
+                                if c.get("bytes")), None)
+        s["ckpt_coalesced"] = sum(c.get("coalesced") or 0 for c in saves)
+    restores = [c for c in ckpts if c.get("op") == "restore"]
+    if restores:
+        s["ckpt_restores"] = len(restores)
+        s["ckpt_restore_s"] = _median([c.get("wall_s") for c in restores])
+
+    # Resilience events: supervisor restarts (resilience/supervisor.py telemetry)
+    # and cooperative preemption stops.
+    restarts = by_event.get("restart", [])
+    if restarts:
+        s["restarts"] = len(restarts)
+        s["restart_reasons"] = [r.get("reason") for r in restarts]
+    preempts = by_event.get("preempt", [])
+    if preempts:
+        s["preempted_step"] = preempts[-1].get("step")
+        s["preempted_ckpt"] = preempts[-1].get("checkpoint")
+
     # Loss-curve metrics.jsonl rows (the companion artifact) — final losses.
     for kind, key in (("train", "final_train_loss"), ("test", "final_val_loss")):
         pts = [r for r in by_event.get(kind, []) if "loss" in r]
@@ -177,6 +205,23 @@ def print_summary(s: dict) -> None:
                                               else traj[:3] + traj[-3:]))
         print(f"   grad_norm {shown}  (max {_fmt(s.get('grad_norm_max'))}, "
               f"param_norm {_fmt(s.get('param_norm'))})")
+    if s.get("ckpt_saves") or s.get("ckpt_restores"):
+        parts = []
+        if s.get("ckpt_saves"):
+            co = (f", {s['ckpt_coalesced']} coalesced" if s.get("ckpt_coalesced")
+                  else "")
+            parts.append(f"{s['ckpt_saves']} save(s) "
+                         f"(median {_fmt(s.get('ckpt_save_s'))}s, "
+                         f"{_fmt(s.get('ckpt_bytes'))} bytes{co})")
+        if s.get("ckpt_restores"):
+            parts.append(f"{s['ckpt_restores']} restore(s) "
+                         f"(median {_fmt(s.get('ckpt_restore_s'))}s)")
+        print(f"   checkpoint: {', '.join(parts)}")
+    if s.get("restarts"):
+        print(f"   restarts: {s['restarts']} ({', '.join(s['restart_reasons'])})")
+    if s.get("preempted_step") is not None:
+        ck = f" -> {s['preempted_ckpt']}" if s.get("preempted_ckpt") else ""
+        print(f"   preempted at step {s['preempted_step']}{ck}")
     for b in s.get("bench", []):
         extra = "".join(f"  {k} {_fmt(b[k])}" for k in ("examples_per_s", "mfu")
                         if b.get(k) is not None)
@@ -206,6 +251,8 @@ COMPARE_ROWS = [
     ("mfu", "mfu"),
     ("train_loss", "final_train_loss"),
     ("val_loss", "final_val_loss"),
+    ("ckpt_save_s", "ckpt_save_s"),
+    ("restarts", "restarts"),
     ("serve tokens/s", "serve_tokens_per_s"),
     ("ttft_s p50", "serve_ttft_s_p50"),
     ("ttft_s p99", "serve_ttft_s_p99"),
