@@ -39,8 +39,29 @@ def device_counts(available: int) -> list[int]:
     return counts
 
 
+def _plan_prediction(n: int, steps_per_epoch: int | None = None) -> dict:
+    """The planner's view of one device count (``plan/``): rank the legal
+    layouts for the reference CNN protocol at ``n`` chips and return the pick's
+    predicted step/epoch seconds — the analytical curve the measured one is
+    judged against (``--plan``)."""
+    import dataclasses
+
+    from csed_514_project_distributed_training_using_pytorch_tpu import plan as plan_mod
+
+    topo = dataclasses.replace(plan_mod.Topology.detect(), num_devices=n)
+    scenario = plan_mod.scenarios.for_cnn(GLOBAL_BATCH, topo)
+    best = plan_mod.search(scenario)[0]
+    out = {"planned_mesh": best.candidate.mesh_spec(),
+           "predicted_step_s": round(best.costs.step_s, 8)}
+    if steps_per_epoch:
+        out["predicted_epoch_seconds"] = round(
+            best.costs.step_s * steps_per_epoch, 4)
+    return out
+
+
 def run(max_train_examples: int = 0, timed_epochs: int = 3,
-        unroll: int = 1, pregather: bool = False) -> list[dict]:
+        unroll: int = 1, pregather: bool = False,
+        with_plan: bool = False) -> list[dict]:
     available = len(jax.devices())
     platform = jax.devices()[0].platform
     train_ds, _ = load_mnist("files")
@@ -61,18 +82,33 @@ def run(max_train_examples: int = 0, timed_epochs: int = 3,
             "pregather": pregather,
             "data_source": train_ds.source,
         })
+        if with_plan:
+            # Planner validation: the analytical pick + its predicted epoch
+            # time ride in the same JSON row as the measurement, so the
+            # predicted-vs-measured delta (and whether the planner's layout
+            # ordering matches the measured curve's) is one jq away.
+            rows[-1].update(_plan_prediction(n, result.steps_per_epoch))
+            rows[-1]["predicted_vs_measured"] = round(
+                rows[-1]["predicted_epoch_seconds"] / rows[-1]["epoch_seconds"],
+                3)
         print(json.dumps(rows[-1]), flush=True)
 
     base = rows[0]["epoch_seconds"]
     for row in rows:
         row["speedup"] = round(base / row["epoch_seconds"], 2)
         row["efficiency"] = round(row["speedup"] / row["devices"], 2)
-    print(json.dumps({
+    summary = {
         "metric": "1-epoch wall-clock scaling (fixed global batch 64)",
         "reference_speedups": {"1": 1.0, "2": 1.55, "4": 2.30, "8": 3.5},
         "measured": [{k: r[k] for k in ("devices", "epoch_seconds", "speedup",
                                         "efficiency")} for r in rows],
-    }), flush=True)
+    }
+    if with_plan:
+        summary["planner"] = [
+            {k: r[k] for k in ("devices", "planned_mesh",
+                               "predicted_epoch_seconds",
+                               "predicted_vs_measured")} for r in rows]
+    print(json.dumps(summary), flush=True)
 
     plotting.save_scaling_curve([r["devices"] for r in rows],
                                 [r["epoch_seconds"] for r in rows],
@@ -158,10 +194,15 @@ if __name__ == "__main__":
                         metavar="B",
                         help="run the global-batch sweep instead of the device sweep "
                              "(default sizes 256 1024 4096 when given no values)")
+    parser.add_argument("--plan", action="store_true",
+                        help="also run the parallelism planner (plan/) per device "
+                             "count and emit its pick + predicted epoch seconds "
+                             "next to each measurement — the predicted-vs-"
+                             "measured validation of the cost model")
     args = parser.parse_args()
     if args.sweep_global_batch is not None:
         run_batch_sweep(args.sweep_global_batch or [256, 1024, 4096],
                         args.max_train_examples, args.timed_epochs)
     else:
         run(args.max_train_examples, args.timed_epochs, args.unroll,
-            args.pregather)
+            args.pregather, with_plan=args.plan)

@@ -60,6 +60,7 @@ from __future__ import annotations
 
 import functools
 import os
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -999,9 +1000,15 @@ def native_mode(head_dim: int) -> str:
     (``D % 128 == 0``), else ``"unroll"`` (all-heads blocks + static head
     unroll, the only form Mosaic accepts at sub-register head widths).
     ``FLASH_NATIVE_MODE=unroll`` forces the unroll form everywhere — a
-    measurement knob for pricing the two."""
-    if head_dim % 128 == 0 and os.environ.get(
-            "FLASH_NATIVE_MODE", "").strip().lower() != "unroll":
+    measurement knob for pricing the two. Anything else is rejected loudly:
+    a typo'd mode silently timing the default form would poison exactly the
+    measurements the knob exists for."""
+    mode = os.environ.get("FLASH_NATIVE_MODE", "").strip().lower()
+    if mode not in ("", "unroll"):
+        raise ValueError(
+            f"FLASH_NATIVE_MODE must be '' (auto: strided at D%128==0, else "
+            f"unroll) or 'unroll', got {mode!r}")
+    if head_dim % 128 == 0 and mode != "unroll":
         return "strided"
     return "unroll"
 
@@ -1054,7 +1061,20 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if block is None:
         # The strided form keeps packed-size [block, D] refs, so it takes the
         # packed caps; only the all-heads unroll form pays the block·H·D
-        # envelope.
+        # envelope. A geometry whose SMALLEST legal block (128·H·D) already
+        # busts that envelope can't run native-unroll at any block — for the
+        # auto path that is a layout preference, not a user contract, so fall
+        # back to the packed layout (same math, repacks paid) with a warning
+        # rather than dying at trace time; explicitly requested blocks below
+        # keep the hard error.
+        if (native_layout and not strided
+                and 128 * h * d > NATIVE_BLOCK_ELEMS):
+            warnings.warn(
+                f"native-layout flash cannot tile heads*head_dim={h * d} "
+                f"(128*{h * d} exceeds the {NATIVE_BLOCK_ELEMS}-element VMEM "
+                f"envelope); falling back to the packed layout for this shape",
+                stacklevel=2)
+            native_layout = False
         block = auto_block(s, int(window or 0),
                            native_hd=h * d if native_layout and not strided
                            else None)

@@ -238,6 +238,77 @@ def _slice_granules(devices, num_slices: int | None) -> dict:
     return granules
 
 
+# Nominal per-device budget when neither the runtime nor the spec table knows the
+# chip (CPU test platforms, unknown kinds) — deterministic rather than a guess
+# per machine; override with PLAN_HBM_BYTES.
+DEFAULT_DEVICE_MEMORY = 16 << 30
+
+
+def device_memory_budget(device=None) -> tuple[int, str]:
+    """Usable accelerator-memory bytes for one device, with provenance.
+
+    Returns ``(bytes, source)`` where source is ``"env"`` (the ``PLAN_HBM_BYTES``
+    override), ``"runtime"`` (the PJRT ``memory_stats()['bytes_limit']`` this
+    process actually got), ``"spec"`` (the committed per-kind capacity table —
+    ``utils.benchmarks.HBM_CAPACITY_BY_KIND``, next to its bandwidth/FLOPs
+    siblings), or ``"nominal"`` (unknown device — the deterministic default).
+    The planner's memory pruning (``plan/search.py``) treats only the first two
+    as hard facts; the table is what a pod the process can't see yet is judged
+    by."""
+    # Lazy: utils.benchmarks pulls the trainer stack, which imports this module.
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        HBM_CAPACITY_BY_KIND, lookup_by_kind,
+    )
+
+    if os.environ.get("PLAN_HBM_BYTES"):
+        return int(os.environ["PLAN_HBM_BYTES"]), "env"
+    if device is None:
+        device = jax.devices()[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if stats and stats.get("bytes_limit"):
+        return int(stats["bytes_limit"]), "runtime"
+    kind = str(getattr(device, "device_kind", device.platform))
+    cap = lookup_by_kind(HBM_CAPACITY_BY_KIND, kind)
+    if cap is not None:
+        return int(cap), "spec"
+    return int(DEFAULT_DEVICE_MEMORY), "nominal"
+
+
+def num_granules(devices=None) -> int:
+    """How many DCN granules (slices, else hosts) the device set spans — the
+    count whose boundaries collectives must cross the data-center network to
+    pass. 1 means everything rides ICI (single slice, single host)."""
+    if devices is None:
+        devices = jax.devices()
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if slice_ids != {None}:
+        return len(slice_ids)
+    return max(len({d.process_index for d in devices}), 1)
+
+
+def topology_summary(devices=None) -> dict:
+    """One-call snapshot of the physical topology the planner costs layouts
+    against: device count/kind/platform, per-chip memory budget (+ provenance),
+    and the DCN granule count. Pure introspection — no backend mutation, safe
+    before or after ``initialize_cluster``."""
+    if devices is None:
+        devices = jax.devices()
+    budget, source = device_memory_budget(devices[0])
+    return {
+        "platform": devices[0].platform,
+        "device_kind": str(getattr(devices[0], "device_kind",
+                                   devices[0].platform)),
+        "device_count": len(devices),
+        "process_count": jax.process_count(),
+        "hbm_bytes": budget,
+        "hbm_source": source,
+        "num_granules": num_granules(devices),
+    }
+
+
 def make_hybrid_mesh(axis_names: tuple[str, ...], axis_shape: tuple[int, ...],
                      *, dcn_axis: str = "data", num_slices: int | None = None,
                      devices=None) -> Mesh:

@@ -1,0 +1,149 @@
+"""Automatic parallelism planning: pick the mesh layout instead of knowing it.
+
+The reference paper's result is a hand-made scaling curve; this repo grew six
+parallel strategies a user composes by hand. ``plan/`` turns that choice into a
+subsystem:
+
+- ``costs.py``      — analytical per-step cost model (memory / FLOPs / per-axis
+  collective bytes over ICI/DCN) for a model on an axis-shaped mesh;
+- ``search.py``     — enumerate legal DP×FSDP×TP×PP factorizations of the
+  device count, prune by per-chip HBM, rank by predicted step time;
+- ``autotune.py``   — optional empirical re-rank: AOT-compile + short-trial the
+  top-K candidates on the live devices;
+- ``scenarios.py``  — per-trainer scenario builders + the trial harness;
+- ``artifact.py``   — the serializable ``Plan`` JSON (inspect with
+  ``tools/plan_report.py``, replay with ``--plan path.json``).
+
+Trainer surface (``train/composed.py``, ``train/lm.py``)::
+
+    --plan auto         # analytical pick
+    --plan tune         # analytical top-K, re-ranked by measured step time
+    --plan plan.json    # replay a saved/edited plan verbatim
+
+``--plan`` omitted leaves the trainers bitwise-identical to before the planner
+existed (pinned in ``tests/test_plan.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from csed_514_project_distributed_training_using_pytorch_tpu.plan.artifact import (
+    Plan,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.plan.costs import (
+    Candidate, CostBreakdown, ModelStats, Topology, predict,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.plan.search import (
+    Ranked, Scenario, enumerate_candidates, search,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.plan import (
+    autotune, scenarios,
+)
+
+__all__ = [
+    "Plan", "Candidate", "CostBreakdown", "ModelStats", "Topology", "Ranked",
+    "Scenario", "predict", "enumerate_candidates", "search", "autotune",
+    "scenarios", "resolve", "apply_plan", "AUTOTUNE_TOP_K",
+]
+
+AUTOTUNE_TOP_K = 3
+
+
+def _plan_from_ranked(scenario: Scenario, ranked: list[Ranked],
+                      source: str) -> Plan:
+    best = ranked[0]
+    c = best.candidate
+    return Plan(
+        run_type=scenario.run_type, device_count=c.num_devices,
+        mesh=c.mesh_spec(), axes=c.axes(), fsdp=c.fsdp,
+        grad_accum=c.grad_accum, pipeline_microbatches=c.microbatches,
+        source=source, predicted=best.costs.to_dict(),
+        measured_step_s=best.measured_step_s,
+        topology=scenario.topo.to_dict(), model=scenario.stats.to_dict(),
+        global_batch=scenario.global_batch,
+        candidates=[r.to_dict() for r in ranked])
+
+
+def resolve(spec: str, scenario: Scenario, *, emit=None) -> Plan:
+    """``--plan`` value → ``Plan``: ``"auto"`` searches the analytical model,
+    ``"tune"`` additionally measures the top-K (degrading to ``auto`` on a
+    multi-process fleet, where per-process wall clocks could rank differently
+    on different hosts and desynchronize the SPMD mesh choice), anything else
+    is a path to a saved artifact — validated against the live device count
+    before the trainer builds a mesh from it."""
+    if spec in ("auto", "tune"):
+        ranked = search(scenario)
+        source = spec
+        if spec == "tune" and jax.process_count() > 1:
+            from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+                metrics as M,
+            )
+
+            M.log("WARNING: --plan tune on a multi-process fleet would rank by "
+                  "per-host wall clocks; degrading to the analytical 'auto' "
+                  "ranking (identical on every process)")
+            source = "auto"
+        elif spec == "tune":
+            ranked = autotune.refine(scenario, ranked, top_k=AUTOTUNE_TOP_K,
+                                     emit=emit)
+        return _plan_from_ranked(scenario, ranked, source)
+    plan = Plan.load(spec)
+    if plan.run_type != scenario.run_type:
+        raise ValueError(
+            f"plan {spec!r} was made for the {plan.run_type!r} trainer, not "
+            f"{scenario.run_type!r} — regenerate with --plan auto")
+    avail = scenario.topo.num_devices
+    if plan.device_count > avail:
+        raise ValueError(
+            f"plan {spec!r} targets {plan.device_count} devices but only "
+            f"{avail} are addressable — regenerate with --plan auto")
+    return dataclasses.replace(plan, source="file")
+
+
+def apply_plan(config, run_type: str, *, topo: Topology | None = None,
+               emit=None):
+    """Resolve ``config.plan`` and fold the pick back into the (frozen) trainer
+    config. Returns ``(new_config, Plan)``; with ``config.plan`` empty the
+    config object is returned untouched (the bitwise-identity contract).
+
+    The plan artifact is saved to ``<results_dir>/plan_<run_type>.json``
+    (process-0 gated, atomic) whenever it was computed here rather than loaded,
+    so every ``--plan auto|tune`` run leaves a replayable record."""
+    import os
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        metrics as M,
+    )
+
+    if not config.plan:
+        return config, None
+    if run_type == "composed":
+        scenario = scenarios.for_composed(config, topo)
+    elif run_type == "lm":
+        scenario = scenarios.for_lm(config, topo)
+    else:
+        raise ValueError(f"no planning scenario for run_type {run_type!r}")
+    plan = resolve(config.plan, scenario, emit=emit)
+    if plan.source != "file" and config.results_dir and M.is_logging_process():
+        path = os.path.join(config.results_dir, f"plan_{run_type}.json")
+        plan.save(path)
+        M.log(f"Saved {path}")
+    repl = {"mesh": plan.mesh, "grad_accum": plan.grad_accum}
+    if run_type == "composed":
+        repl["fsdp"] = plan.fsdp
+        if plan.axes.get("stage", 1) > 1:
+            repl["pipeline_microbatches"] = plan.pipeline_microbatches
+    M.log(f"Plan ({plan.source}): mesh {plan.mesh}"
+          + (", fsdp" if plan.fsdp else "")
+          + f", grad_accum {plan.grad_accum}"
+          + (f", microbatches {plan.pipeline_microbatches}"
+             if plan.axes.get("stage", 1) > 1 else "")
+          + f" — predicted step "
+          + (f"{plan.predicted.get('step_s', 0) * 1e3:.3f} ms"
+             if plan.predicted else "n/a")
+          + (f", measured {plan.measured_step_s * 1e3:.3f} ms"
+             if plan.measured_step_s else ""))
+    return dataclasses.replace(config, **repl), plan

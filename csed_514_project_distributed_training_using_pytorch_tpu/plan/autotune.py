@@ -1,0 +1,70 @@
+"""Empirical refinement: measure the top-K analytical candidates, re-rank by fact.
+
+The analytical model (``plan/costs.py``) is built to RANK; its absolute numbers
+inherit every nominal bandwidth in the tables. ``--plan tune`` closes the loop:
+the top-K candidates from the analytical ranking are each AOT-compiled
+(``jit(...).lower().compile()`` + ``cost_analysis()`` — the PR-1 telemetry path,
+so compile seconds and compiled FLOPs ride along) and short-trialed for a few
+steps on the live devices, and the final ranking sorts by MEASURED step time.
+Costs are bounded by construction: K is small, trials are a handful of steps on
+synthetic batches, and the compile cache is warm for whichever candidate the
+real run then picks.
+
+The trial harness itself lives with the scenario builders
+(``plan/scenarios.py``) because what "one step of this trainer" means is
+per-run-type; this module only orchestrates. Candidates the harness can't build
+(stage layouts — the pipeline engine's trial would duplicate half the composed
+trainer) keep their analytical estimate and remain in the ranking, flagged
+unmeasured.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Callable
+
+from csed_514_project_distributed_training_using_pytorch_tpu.plan.search import (
+    Ranked, Scenario,
+)
+
+
+def refine(scenario: Scenario, ranked: list[Ranked], *, top_k: int = 3,
+           emit: Callable | None = None) -> list[Ranked]:
+    """Measure the first ``top_k`` rows with the scenario's trial harness and
+    re-rank: measured rows by measured step seconds, unmeasured rows after them
+    by their analytical estimate (a measured fact always outranks a prediction
+    — an unmeasured stage candidate predicted faster than every measured row
+    stays behind them rather than winning on an untested number).
+
+    ``emit`` (optional) receives one ``plan.telemetry``-style dict per trialed
+    candidate — the trainers pass ``TelemetryWriter.emit`` with
+    ``utils.telemetry.autotune_event`` applied; tests pass a list appender."""
+    if scenario.trial is None:
+        return ranked
+    out = []
+    for rank, row in enumerate(ranked):
+        if rank < top_k and row.costs.fits:
+            trial = scenario.trial(row.candidate)
+            if trial is not None:
+                row = replace(row,
+                              measured_step_s=trial.get("step_s"),
+                              compile_s=trial.get("compile_s"),
+                              measured_flops_per_step=trial.get("flops_per_step"))
+            if emit is not None:
+                from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+                    telemetry as T,
+                )
+
+                emit(T.autotune_event(
+                    mesh=row.candidate.mesh_spec(), fsdp=row.candidate.fsdp,
+                    grad_accum=row.candidate.grad_accum,
+                    microbatches=row.candidate.microbatches, rank=rank,
+                    predicted_step_s=row.costs.step_s,
+                    measured_step_s=row.measured_step_s,
+                    compile_s=row.compile_s,
+                    flops_per_step=row.measured_flops_per_step))
+        out.append(row)
+    measured = [r for r in out if r.measured_step_s is not None]
+    unmeasured = [r for r in out if r.measured_step_s is None]
+    measured.sort(key=lambda r: r.measured_step_s)
+    return measured + unmeasured

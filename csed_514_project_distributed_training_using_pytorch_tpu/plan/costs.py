@@ -1,0 +1,297 @@
+"""Analytical per-step cost model: one parallel layout → predicted time + memory.
+
+The reference picked its parallel layout by hand and measured the result (the
+time-vs-machines curve is the paper's whole finding); our repo grew six
+strategies a user composes by hand per model and chip count. This module is the
+arithmetic that replaces that tribal knowledge: given a model's static stats, a
+topology, and one candidate DP×FSDP×TP×PP factorization, it prices
+
+- **memory** — param / optimizer / gradient / activation bytes per chip under the
+  candidate's sharding (the HBM-feasibility gate ``plan/search.py`` prunes by);
+- **compute** — train FLOPs per optimizer step over the chips' aggregate peak,
+  inflated by the GPipe bubble ``(M+S-1)/M`` when a stage axis is present;
+- **collectives** — per-axis bytes over per-link bandwidths: the once-per-step DP
+  gradient ring all-reduce, Megatron TP's per-layer activation all-reduces, PP's
+  stage-boundary sends — each routed over ICI or DCN by whether the axis spans
+  granules (``Topology.num_slices``).
+
+Everything is a closed-form estimate of a DELIBERATELY simple machine model
+(no compute/comm overlap, ring collectives at ``2(n-1)/n`` efficiency, uniform
+per-link bandwidth); DESIGN.md §13 states the assumptions and when to trust the
+analytical ranking vs the ``plan/autotune.py`` empirical refinement. The model's
+job is ranking candidates, not forecasting wall clocks — predicted-vs-measured
+deltas are first-class output (``tools/plan_report.py``) precisely so the model
+is falsifiable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+# Nominal per-link, one-direction interconnect bandwidths (bytes/s) by
+# device_kind substring — public-spec-order-of-magnitude values, NOT
+# measurements (first match wins; more specific kinds precede their prefixes).
+# They only ever rank layouts against each other; `tools/plan_report.py` renders
+# predicted-vs-measured deltas so a wrong entry is visible, and `--plan tune`
+# re-ranks by measurement.
+ICI_BYTES_BY_KIND = [
+    ("v6", 9.0e10), ("v5p", 9.0e10), ("v5", 4.5e10), ("v4", 4.5e10),
+    ("v3", 7.0e10), ("v2", 4.0e10),
+]
+DEFAULT_ICI_BYTES = 1.0e10    # unknown kind / CPU test platform: deterministic
+DEFAULT_DCN_BYTES = 3.125e9   # ~25 Gbit/s per chip across slices/hosts
+
+# Per-pass host/dispatch overhead (seconds) charged to every extra microbatch
+# (grad-accum pass or pipeline tick). Small by design: its role is to break
+# ties AGAINST gratuitous microbatching when memory doesn't demand it, not to
+# model real dispatch cost.
+MICROBATCH_OVERHEAD_S = 50e-6
+
+
+def ici_bytes_per_s(device_kind: str) -> float:
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        lookup_by_kind,
+    )
+
+    return lookup_by_kind(ICI_BYTES_BY_KIND, device_kind, DEFAULT_ICI_BYTES)
+
+
+@dataclass(frozen=True)
+class Topology:
+    """The hardware facts one candidate is priced against. Constructed from the
+    live runtime via ``detect()`` or stubbed outright in tests/synthetic
+    scenarios — every field is plain data, nothing touches jax after
+    construction."""
+
+    num_devices: int
+    device_kind: str = "cpu"
+    hbm_bytes: float = 16 << 30        # usable accelerator memory per chip
+    hbm_source: str = "nominal"        # env | runtime | spec | nominal
+    peak_flops: float = 1e12           # per chip (bf16 peak on TPU)
+    ici_bytes: float = DEFAULT_ICI_BYTES   # per-link one-way bytes/s
+    dcn_bytes: float = DEFAULT_DCN_BYTES   # per-chip cross-granule bytes/s
+    num_slices: int = 1                # DCN granules (slices, else hosts)
+
+    @classmethod
+    def detect(cls, devices=None) -> "Topology":
+        """Snapshot the live platform (``parallel.mesh.topology_summary``) plus
+        the committed per-kind bandwidth/peak tables."""
+        from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import (
+            topology_summary,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+            peak_flops,
+        )
+
+        t = topology_summary(devices)
+        return cls(
+            num_devices=t["device_count"],
+            device_kind=t["device_kind"],
+            hbm_bytes=float(t["hbm_bytes"]),
+            hbm_source=t["hbm_source"],
+            peak_flops=peak_flops(t["device_kind"]) or 1e12,
+            ici_bytes=ici_bytes_per_s(t["device_kind"]),
+            dcn_bytes=DEFAULT_DCN_BYTES,
+            num_slices=t["num_granules"],
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclass(frozen=True)
+class ModelStats:
+    """Static per-model quantities the cost model consumes.
+
+    ``flops_per_example`` is TRAIN FLOPs (fwd + backward ≈ 3× fwd).
+    ``act_bytes_per_layer_per_example`` is the resident activation footprint of
+    one layer for one example (the remat knob halves what must persist — callers
+    bake that in); ``score_bytes_per_example`` the dense-attention ``[H, S, S]``
+    score tile (0 when a flash/streaming core is used). ``shardable_fraction``
+    is the fraction of parameter bytes Megatron TP actually splits (block
+    kernels; embeddings/LN/head replicate)."""
+
+    name: str
+    param_bytes: float
+    flops_per_example: float
+    num_layers: int = 1
+    num_heads: int = 1
+    seq_len: int = 1
+    embed_dim: int = 1
+    dtype_bytes: int = 4
+    act_bytes_per_layer_per_example: float = 0.0
+    score_bytes_per_example: float = 0.0
+    optimizer_mult: float = 1.0        # extra state as a multiple of params
+                                       # (SGD velocity 1, AdamW 2; +1 with EMA)
+    shardable_fraction: float = 1.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One point of the DP×FSDP×TP×PP search space: mesh axis sizes plus the
+    microbatch split. ``data·model·stage`` must equal the device count the
+    search ran at; ``microbatches`` is the GPipe split (stage>1 only) and
+    ``grad_accum`` the gradient-accumulation split (activation-memory knob)."""
+
+    data: int = 1
+    model: int = 1
+    stage: int = 1
+    fsdp: bool = False
+    grad_accum: int = 1
+    microbatches: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.model * self.stage
+
+    def mesh_spec(self) -> str:
+        """The trainer-facing ``--mesh`` string. The data axis always appears
+        (every trainer accepts ``data=1``, and the LM trainer requires the axis
+        to exist); model/stage axes of size 1 are elided."""
+        parts = [("data", self.data)] + [
+            (n, s) for n, s in (("model", self.model), ("stage", self.stage))
+            if s > 1]
+        return ",".join(f"{n}={s}" for n, s in parts)
+
+    def axes(self) -> dict:
+        return {"data": self.data, "model": self.model, "stage": self.stage}
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclass(frozen=True)
+class CostBreakdown:
+    """The priced candidate: per-phase seconds, per-chip bytes, feasibility."""
+
+    compute_s: float
+    bubble_s: float
+    dp_comm_s: float
+    tp_comm_s: float
+    pp_comm_s: float
+    overhead_s: float
+    step_s: float                  # the ranking key: sum of the above
+    param_bytes_per_chip: float
+    opt_bytes_per_chip: float
+    grad_bytes_per_chip: float
+    act_bytes_per_chip: float
+    total_bytes_per_chip: float
+    hbm_budget_bytes: float
+    fits: bool
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _ring_time(nbytes: float, participants: int, link_bytes_per_s: float) -> float:
+    """Ring all-reduce wall time for ``nbytes`` of payload per participant:
+    ``2(n-1)/n`` traversals of the payload over one link's bandwidth (the
+    standard bandwidth-optimal schedule; latency terms ignored)."""
+    if participants <= 1 or nbytes <= 0:
+        return 0.0
+    return 2.0 * (participants - 1) / participants * nbytes / link_bytes_per_s
+
+
+def _split_axis_over_dcn(axis_size: int, num_slices: int) -> tuple[int, int]:
+    """Decompose an axis that spans granules into (dcn_factor, ici_factor) —
+    the hybrid-mesh layout (``make_hybrid_mesh``): the LEADING factor strides
+    slices, the remainder stays inside one. Axes that don't divide the granule
+    count keep everything on the slower network (conservative)."""
+    if num_slices <= 1:
+        return 1, axis_size
+    if axis_size % num_slices == 0:
+        return num_slices, axis_size // num_slices
+    return axis_size, 1
+
+
+def predict(stats: ModelStats, topo: Topology, cand: Candidate, *,
+            global_batch: int, hbm_fraction: float = 0.9) -> CostBreakdown:
+    """Price one candidate layout: per-step seconds by phase + per-chip bytes.
+
+    Machine-model assumptions (DESIGN.md §13): no compute/comm overlap (phases
+    sum), ring collectives at ``2(n-1)/n``, the data axis is the one that spans
+    DCN granules when granules exist (the hybrid-mesh recipe — model/stage
+    crossing DCN is priced at DCN bandwidth as a deliberate penalty), gradients
+    materialize one full shard alongside params, and TP shards activations and
+    the dense score tile evenly."""
+    d, m, s = cand.data, cand.model, cand.stage
+    n = cand.num_devices
+
+    # ---- memory (bytes per chip) -------------------------------------------
+    # TP only splits the shardable fraction; PP/FSDP split everything they see.
+    tp_sharded = (stats.param_bytes * stats.shardable_fraction / m
+                  + stats.param_bytes * (1.0 - stats.shardable_fraction))
+    param_pc = tp_sharded / (s * (d if cand.fsdp else 1))
+    opt_pc = param_pc * stats.optimizer_mult
+    grad_pc = param_pc                       # one transient grad shard
+    micro = global_batch / (cand.grad_accum * cand.microbatches)
+    # GPipe keeps EVERY microbatch's forward activations resident until its
+    # backward — all M are in flight through the fill — so a stage split does
+    # not shrink activation memory with M (only grad_accum does); modeling one
+    # microbatch would let the bubble term steer the pick toward high-M
+    # layouts the feasibility gate then under-counts 16×.
+    inflight = cand.microbatches if s > 1 else 1
+    micro_pc = micro * inflight / d          # examples resident per chip
+    layers_pc = max(stats.num_layers / s, 1.0)
+    act_pc = (micro_pc * layers_pc * stats.act_bytes_per_layer_per_example / m
+              + micro_pc * stats.score_bytes_per_example / m)
+    total_pc = param_pc + opt_pc + grad_pc + act_pc
+    # The budget keeps ``1 - hbm_fraction`` headroom for what the model doesn't
+    # count (compiler scratch, the replicated dataset, fragmentation).
+    budget = topo.hbm_bytes * hbm_fraction
+
+    # ---- compute ------------------------------------------------------------
+    flops_step = stats.flops_per_example * global_batch
+    compute_s = flops_step / (n * topo.peak_flops)
+    bubble_s = 0.0
+    if s > 1:
+        # GPipe fill/drain: the stage pipeline runs M+S-1 ticks for M microbatch
+        # ticks of useful work — charged per accumulation pass.
+        bubble_s = compute_s * (s - 1) / cand.microbatches
+
+    # ---- collectives --------------------------------------------------------
+    # DP gradient all-reduce: once per step, one grad shard's bytes, split
+    # hierarchically when the data axis spans DCN granules.
+    grad_bytes = tp_sharded / s
+    dcn_d, ici_d = _split_axis_over_dcn(d, topo.num_slices)
+    dp_comm_s = (_ring_time(grad_bytes, ici_d, topo.ici_bytes)
+                 + _ring_time(grad_bytes / max(ici_d, 1), dcn_d, topo.dcn_bytes))
+    if cand.fsdp:
+        # ZeRO adds a params all-gather per accumulation pass on top of the
+        # grad reduce-scatter+all-gather (≙ the all-reduce above): same ring
+        # volume again, times the extra passes.
+        dp_comm_s *= 1.0 + 0.5 * cand.grad_accum
+
+    # TP: Megatron inserts ~4 activation all-reduces per layer per pass
+    # (fwd row-parallel + its backward, ×2 for attention + MLP); total volume
+    # over the step covers the full batch regardless of the accum split. Any
+    # model/stage axis is assumed inside one granule (ICI); if granules exist
+    # and data can't absorb them, these axes pay DCN bandwidth.
+    intra_bw = (topo.ici_bytes if topo.num_slices <= 1
+                or _split_axis_over_dcn(d, topo.num_slices)[0] == topo.num_slices
+                else topo.dcn_bytes)
+    act_bytes_step = (global_batch / d) * stats.seq_len * stats.embed_dim \
+        * stats.dtype_bytes
+    tp_comm_s = (4 * stats.num_layers * _ring_time(act_bytes_step, m, intra_bw)
+                 if m > 1 else 0.0)
+
+    # PP: each microbatch's activations cross S-1 stage boundaries forward and
+    # backward — point-to-point, one payload traversal each.
+    pp_comm_s = (2 * (s - 1) * act_bytes_step / intra_bw if s > 1 else 0.0)
+
+    overhead_s = MICROBATCH_OVERHEAD_S * (
+        cand.grad_accum * cand.microbatches - 1)
+
+    step_s = compute_s + bubble_s + dp_comm_s + tp_comm_s + pp_comm_s + overhead_s
+    return CostBreakdown(
+        compute_s=compute_s, bubble_s=bubble_s, dp_comm_s=dp_comm_s,
+        tp_comm_s=tp_comm_s, pp_comm_s=pp_comm_s, overhead_s=overhead_s,
+        step_s=step_s,
+        param_bytes_per_chip=param_pc, opt_bytes_per_chip=opt_pc,
+        grad_bytes_per_chip=grad_pc, act_bytes_per_chip=act_pc,
+        total_bytes_per_chip=total_pc, hbm_budget_bytes=budget,
+        fits=total_pc <= budget)

@@ -1,0 +1,336 @@
+"""Scenario builders: one per trainer surface, plus the empirical trial harness.
+
+A ``Scenario`` (``plan/search.py``) is the bridge between a trainer's config and
+the abstract cost model: it pins the model's static stats (param bytes counted
+EXACTLY via ``jax.eval_shape`` — no hand-maintained formulas to drift; the TP
+shardable fraction comes from ``tensor_parallel.param_partition_specs`` itself,
+so the planner and the trainer can never disagree about what TP splits), the
+live topology, the batch, and which axes the trainer can legally execute.
+
+The trial harness (``--plan tune``) builds, per candidate, the SAME scanned
+epoch program shape the trainer runs — ``make_epoch_fn`` under the candidate's
+TP/FSDP shardings on a real mesh — over a synthetic two-step index plan,
+AOT-compiles it through ``utils.telemetry.aot_compile`` (compile seconds +
+``cost_analysis`` FLOPs ride along), and times the steps closed by a
+data-dependent host fetch of the final loss (the honest-sync protocol of
+``utils/benchmarks.py``). Stage candidates return None (analytical estimate
+retained): a pipeline trial would duplicate half the composed trainer for a
+layout the cost model already prices conservatively via the bubble term.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from csed_514_project_distributed_training_using_pytorch_tpu.plan.costs import (
+    Candidate, ModelStats, Topology,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.plan.search import (
+    Scenario,
+)
+
+TRIAL_STEPS = 2          # steps per trial program (one scan)
+TRIAL_REPS = 2           # timed invocations; the minimum is reported
+
+# MNIST geometry the trainers are hard-wired to (data/mnist.py).
+_IMAGE_SHAPE = (28, 28, 1)
+_LM_SEQ_LEN = 28 * 28
+
+
+def _param_bytes(model, sample, *init_extra) -> tuple[float, float]:
+    """(total param bytes, TP-shardable bytes) from abstract init shapes —
+    no FLOPs spent, and the shardable set comes from the one owner of the TP
+    rules (``parallel.tensor_parallel.param_partition_specs``)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        tensor_parallel as tp,
+    )
+
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0), sample,
+                            *init_extra)["params"]
+    specs = tp.param_partition_specs(shapes)
+    total = sharded = 0.0
+    for leaf, spec in zip(jax.tree_util.tree_leaves(shapes),
+                          jax.tree_util.tree_leaves(
+                              specs, is_leaf=lambda x: isinstance(x, P))):
+        nbytes = float(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        total += nbytes
+        if any(e is not None for e in spec):
+            sharded += nbytes
+    return total, (sharded / total if total else 0.0)
+
+
+def _optimizer_mult(optimizer: str, ema: bool) -> float:
+    return (2.0 if optimizer == "adamw" else 1.0) + (1.0 if ema else 0.0)
+
+
+def _transformer_stats(name, model, sample, *, seq_len, embed_dim, num_layers,
+                       num_heads, mlp_ratio, dtype_bytes, remat, flash,
+                       optimizer_mult) -> ModelStats:
+    param_bytes, shardable = _param_bytes(model, sample)
+    # Train FLOPs per example: the 6·P·S matmul rule plus the attention
+    # score/value einsums (4·S²·E fwd), tripled for backward.
+    fwd = 2.0 * param_bytes / 4 * seq_len + 4.0 * num_layers * seq_len ** 2 \
+        * embed_dim
+    # Resident activations per layer per example: the block's intermediate
+    # streams (~attn qkv/out + the mlp_ratio-wide MLP) — an order-of-magnitude
+    # constant, halved to block inputs under remat.
+    act = seq_len * embed_dim * dtype_bytes * (2 if remat
+                                               else 10 + 2 * mlp_ratio)
+    score = 0.0 if flash else num_heads * seq_len ** 2 * 4.0
+    return ModelStats(
+        name=name, param_bytes=param_bytes, flops_per_example=3.0 * fwd,
+        num_layers=num_layers, num_heads=num_heads, seq_len=seq_len,
+        embed_dim=embed_dim, dtype_bytes=dtype_bytes,
+        act_bytes_per_layer_per_example=act, score_bytes_per_example=score,
+        optimizer_mult=optimizer_mult, shardable_fraction=shardable)
+
+
+# --------------------------------------------------------------- trial harness
+
+
+def _mesh_for(cand: Candidate):
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    names = ["data"] + [n for n, s in (("model", cand.model),
+                                       ("stage", cand.stage)) if s > 1]
+    sizes = [cand.data] + [s for s in (cand.model, cand.stage) if s > 1]
+    return make_mesh(cand.num_devices, axis_names=tuple(names),
+                     axis_shape=tuple(sizes))
+
+
+def _time_epoch_program(cand: Candidate, mesh, state, epoch_body, xs, ys,
+                        global_batch: int) -> dict | None:
+    """AOT-compile the candidate's epoch program under its shardings and time
+    ``TRIAL_STEPS`` scanned steps, closed by a host fetch of the loss vector
+    (data-dependent on the final parameter update — the sync rule
+    ``utils/benchmarks.py`` documents)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        data_parallel as dp,
+        fsdp,
+        tensor_parallel as tp,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        telemetry as T,
+    )
+
+    rep = dp.replicated(mesh)
+    state_sh = (fsdp.hybrid_state_shardings(mesh, state) if cand.fsdp
+                else tp.state_shardings(mesh, state))
+    idx_sh = (NamedSharding(mesh, P(None, "data")) if cand.data > 1 else rep)
+    jfn = jax.jit(epoch_body,
+                  in_shardings=(state_sh, rep, rep, idx_sh, rep),
+                  out_shardings=(state_sh, rep), donate_argnums=(0,))
+    dstate = jax.device_put(state, state_sh)
+    xs_d = dp.put_global(mesh, xs, P())
+    ys_d = dp.put_global(mesh, ys, P())
+    plan = dp.put_global(
+        mesh, np.zeros((TRIAL_STEPS, global_batch), np.int32),
+        P(None, "data") if cand.data > 1 else P())
+    rng = jax.random.PRNGKey(0)
+    compiled, aot = T.aot_compile(jfn, dstate, xs_d, ys_d, plan, rng)
+    if compiled is None:
+        return None
+    # Warmup (fault-in, cache), then time TRIAL_REPS invocations threading the
+    # donated state; the min absorbs host jitter on a 2-step program.
+    dstate, losses = compiled(dstate, xs_d, ys_d, plan, rng)
+    float(np.asarray(jax.device_get(losses)).mean())
+    best = None
+    for _ in range(TRIAL_REPS):
+        t0 = time.perf_counter()
+        dstate, losses = compiled(dstate, xs_d, ys_d, plan, rng)
+        float(np.asarray(jax.device_get(losses)).mean())
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    flops = aot["flops"] / TRIAL_STEPS if aot.get("flops") else None
+    return {"step_s": best / TRIAL_STEPS,
+            "compile_s": aot["lower_s"] + aot["compile_s"],
+            "flops_per_step": flops}
+
+
+def _classifier_trial(config):
+    """Trial builder for the composed trainer's (non-stage) candidates."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        TransformerClassifier,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state, make_epoch_fn,
+    )
+
+    def trial(cand: Candidate) -> dict | None:
+        if cand.stage > 1:
+            return None          # analytical estimate retained (module doc)
+        mesh = _mesh_for(cand)
+        model = TransformerClassifier(
+            seq_len=config.seq_len, dropout_rate=0.0, causal=config.causal,
+            dtype=jnp.bfloat16 if config.bf16 else jnp.float32,
+            remat=config.remat, remat_policy=config.remat_policy)
+        optimizer = optim.make_optimizer(config.optimizer,
+                                         learning_rate=config.learning_rate,
+                                         momentum=config.momentum,
+                                         weight_decay=config.weight_decay)
+        state = create_train_state(model, jax.random.PRNGKey(0),
+                                   optimizer=optimizer,
+                                   ema=config.ema_decay > 0)
+        epoch_body = make_epoch_fn(model, learning_rate=config.learning_rate,
+                                   momentum=config.momentum,
+                                   grad_accum=cand.grad_accum,
+                                   optimizer=optimizer,
+                                   ema_decay=config.ema_decay)
+        xs = np.zeros((config.batch_size,) + _IMAGE_SHAPE, np.float32)
+        ys = np.zeros(config.batch_size, np.int32)
+        return _time_epoch_program(cand, mesh, state, epoch_body, xs, ys,
+                                   config.batch_size)
+
+    return trial
+
+
+def _lm_trial(config):
+    """Trial builder for the LM trainer's candidates (data × model axes)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        lm as lm_mod,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state, make_epoch_from_step, make_train_step,
+    )
+
+    def trial(cand: Candidate) -> dict | None:
+        if cand.stage > 1 or cand.fsdp:
+            return None
+        mesh = _mesh_for(cand)
+        model = lm_mod.TransformerLM(
+            vocab_size=config.num_levels + 1, seq_len=_LM_SEQ_LEN,
+            embed_dim=config.embed_dim, num_layers=config.num_layers,
+            num_heads=config.num_heads, dropout_rate=0.0,
+            num_kv_heads=config.kv_heads or None, rope=config.rope,
+            dtype=jnp.bfloat16 if config.bf16 else jnp.float32,
+            remat=config.remat, remat_policy=config.remat_policy)
+        optimizer = optim.make_optimizer(config.optimizer,
+                                         learning_rate=config.learning_rate,
+                                         momentum=config.momentum,
+                                         weight_decay=config.weight_decay)
+        state = create_train_state(model, jax.random.PRNGKey(0),
+                                   sample_input_shape=(1, _LM_SEQ_LEN),
+                                   optimizer=optimizer,
+                                   ema=config.ema_decay > 0)
+
+        def lm_loss(params, xs, ys, rng):
+            del ys
+            return lm_mod.next_token_loss(model, params, xs, rng,
+                                          deterministic=True)
+
+        step_fn = make_train_step(model, learning_rate=config.learning_rate,
+                                  momentum=config.momentum,
+                                  grad_accum=cand.grad_accum,
+                                  optimizer=optimizer,
+                                  ema_decay=config.ema_decay, loss_fn=lm_loss)
+        epoch_body = make_epoch_from_step(step_fn)
+        xs = np.zeros((config.batch_size, _LM_SEQ_LEN), np.int32)
+        ys = np.zeros(config.batch_size, np.int32)
+        return _time_epoch_program(cand, mesh, state, epoch_body, xs, ys,
+                                   config.batch_size)
+
+    return trial
+
+
+# ------------------------------------------------------------------- builders
+
+
+def for_composed(config, topo: Topology | None = None) -> Scenario:
+    """Scenario for ``train/composed.py``: DP × FSDP × TP × PP over the fixed
+    ``TransformerClassifier`` architecture. The stage axis is only offered when
+    the config composes with it (the trainer rejects stage + remat/dropout/
+    flash/zigzag/sharded-checkpoint up front — an emitted plan must pass those
+    same guards)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        TransformerClassifier,
+    )
+
+    if topo is None:
+        topo = Topology.detect()
+    model = TransformerClassifier(seq_len=config.seq_len, dropout_rate=0.0)
+    stats = _transformer_stats(
+        "transformer_classifier", model,
+        jnp.zeros((1,) + _IMAGE_SHAPE, jnp.float32),
+        seq_len=config.seq_len, embed_dim=model.embed_dim,
+        num_layers=model.num_layers, num_heads=model.num_heads,
+        mlp_ratio=model.mlp_ratio,
+        dtype_bytes=2 if config.bf16 else 4, remat=config.remat,
+        flash=config.flash_attention,
+        optimizer_mult=_optimizer_mult(config.optimizer,
+                                       config.ema_decay > 0))
+    axes = ["data", "model"]
+    if not (config.remat or config.dropout_rate or config.zigzag_attention
+            or config.flash_attention or config.sharded_checkpoint):
+        axes.append("stage")
+    return Scenario(run_type="composed", stats=stats, topo=topo,
+                    global_batch=config.batch_size, axes=tuple(axes),
+                    allow_fsdp=True, allow_grad_accum=True,
+                    fixed_grad_accum=config.grad_accum,
+                    test_batch=config.batch_size_test,
+                    trial=_classifier_trial(config))
+
+
+def for_lm(config, topo: Topology | None = None) -> Scenario:
+    """Scenario for ``train/lm.py``: DP × TP over the configured
+    ``TransformerLM`` (the LM trainer's mesh supports data/seq/model; the
+    planner searches data/model — a seq axis is a context-length decision, not
+    a throughput one)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        lm as lm_mod,
+    )
+
+    if topo is None:
+        topo = Topology.detect()
+    model = lm_mod.TransformerLM(
+        vocab_size=config.num_levels + 1, seq_len=_LM_SEQ_LEN,
+        embed_dim=config.embed_dim, num_layers=config.num_layers,
+        num_heads=config.num_heads, dropout_rate=0.0,
+        num_kv_heads=config.kv_heads or None)
+    stats = _transformer_stats(
+        "transformer_lm", model,
+        jnp.zeros((1, _LM_SEQ_LEN), jnp.int32),
+        seq_len=_LM_SEQ_LEN, embed_dim=config.embed_dim,
+        num_layers=config.num_layers, num_heads=config.num_heads, mlp_ratio=4,
+        dtype_bytes=2 if config.bf16 else 4, remat=config.remat, flash=False,
+        optimizer_mult=_optimizer_mult(config.optimizer,
+                                       config.ema_decay > 0))
+    return Scenario(run_type="lm", stats=stats, topo=topo,
+                    global_batch=config.batch_size, axes=("data", "model"),
+                    allow_fsdp=False, allow_grad_accum=True,
+                    fixed_grad_accum=config.grad_accum, trial=_lm_trial(config))
+
+
+def for_cnn(global_batch: int, topo: Topology | None = None) -> Scenario:
+    """Scenario for the reference CNN under plain DP — what ``bench_scaling.py
+    --plan`` validates the cost model's predictions against (the paper's own
+    time-vs-machines protocol)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models.cnn import (
+        Net,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        TRAIN_FLOPS_PER_EXAMPLE,
+    )
+
+    if topo is None:
+        topo = Topology.detect()
+    param_bytes, _ = _param_bytes(
+        Net(), jnp.zeros((1,) + _IMAGE_SHAPE, jnp.float32))
+    # Conv feature maps per example (f32): 24·24·10 + 12·12·10 + 8·8·20 + 4·4·20
+    # + the dense tails — ~36 KB; one "layer" since the planner can't split it.
+    stats = ModelStats(
+        name="mnist_cnn", param_bytes=param_bytes,
+        flops_per_example=float(TRAIN_FLOPS_PER_EXAMPLE), num_layers=1,
+        act_bytes_per_layer_per_example=36e3, optimizer_mult=1.0,
+        shardable_fraction=0.0)
+    return Scenario(run_type="cnn", stats=stats, topo=topo,
+                    global_batch=global_batch, axes=("data",),
+                    allow_fsdp=False, allow_grad_accum=False)

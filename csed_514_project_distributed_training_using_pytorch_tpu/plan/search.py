@@ -1,0 +1,174 @@
+"""Layout search: enumerate legal DP×FSDP×TP×PP factorizations, prune, rank.
+
+The search space is small by construction — factor triples of the device count
+times a few microbatch splits — so the "search" is exhaustive enumeration plus
+a deterministic sort: no heuristics whose ranking could silently diverge from
+the cost model it serves (pinned in ``tests/test_plan.py`` by comparing the
+ranked output against brute-force evaluation of ``plan.costs.predict`` over the
+same candidate set). What earns its keep here is the LEGALITY filter: every
+divisibility and composition rule the trainers enforce at runtime
+(``train/composed.py``'s guard block) is applied up front, so an emitted plan
+never dies in the trainer's own validation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from csed_514_project_distributed_training_using_pytorch_tpu.plan.costs import (
+    Candidate, CostBreakdown, ModelStats, Topology, predict,
+)
+
+MAX_GRAD_ACCUM = 8       # accumulation splits tried when the scenario allows
+MAX_MICROBATCHES = 16    # GPipe splits tried per stage candidate
+
+
+@dataclass
+class Scenario:
+    """Everything one planning run needs: the model's stats, the topology, the
+    batch, and which parts of the space the target trainer can execute
+    (``axes``/``allow_fsdp`` mirror the trainer's own composition rules)."""
+
+    run_type: str                       # 'composed' | 'lm' | 'cnn'
+    stats: ModelStats
+    topo: Topology
+    global_batch: int
+    axes: tuple = ("data", "model", "stage")
+    allow_fsdp: bool = True
+    allow_grad_accum: bool = False
+    fixed_grad_accum: int = 1
+    test_batch: int = 0      # eval batch a stage split must also divide
+                             # (composed: batch_size_test % microbatches); 0 off
+    hbm_fraction: float = 0.9
+    # Optional empirical trial: candidate -> measured step seconds (None =
+    # unmeasurable, e.g. a stage layout the trial harness doesn't build).
+    # Installed by plan/scenarios.py; consumed by plan/autotune.py only.
+    trial: Callable | None = field(default=None, repr=False)
+
+
+@dataclass(frozen=True)
+class Ranked:
+    """One search result row: the candidate, its predicted costs, and (after
+    ``plan.autotune.refine``) its measured step time + compile stats."""
+
+    candidate: Candidate
+    costs: CostBreakdown
+    measured_step_s: float | None = None
+    compile_s: float | None = None
+    measured_flops_per_step: float | None = None
+
+    @property
+    def best_step_s(self) -> float:
+        return (self.measured_step_s if self.measured_step_s is not None
+                else self.costs.step_s)
+
+    def to_dict(self) -> dict:
+        return {"candidate": self.candidate.to_dict(),
+                "costs": self.costs.to_dict(),
+                "measured_step_s": self.measured_step_s,
+                "compile_s": self.compile_s,
+                "measured_flops_per_step": self.measured_flops_per_step}
+
+
+def _factor_pairs(n: int):
+    for a in range(1, n + 1):
+        if n % a == 0:
+            yield a, n // a
+
+
+def _pow2_divisors(n: int, cap: int):
+    d = 1
+    while d <= min(n, cap):
+        if n % d == 0:
+            yield d
+        d *= 2
+
+
+def enumerate_candidates(scenario: Scenario) -> list[Candidate]:
+    """Every LEGAL candidate for the scenario — the brute-force ground set.
+
+    Legality mirrors the trainers' own guards: the global batch (and each
+    accumulation microbatch) shards evenly over ``data``; ``model`` divides the
+    attention heads and the embedding width (Megatron column/row splits);
+    ``stage`` divides the layer stack, composes with data/model only, and
+    carries a microbatch split the per-call batch divides by; ``fsdp`` never
+    composes with a stage axis. Candidates are deduplicated and deterministic
+    in order."""
+    st, n = scenario.stats, scenario.topo.num_devices
+    out: list[Candidate] = []
+    accums = ([scenario.fixed_grad_accum] if not scenario.allow_grad_accum
+              else sorted({scenario.fixed_grad_accum}
+                          | set(_pow2_divisors(scenario.global_batch,
+                                               MAX_GRAD_ACCUM))))
+    for d, rest in _factor_pairs(n):
+        if "data" not in scenario.axes and d > 1:
+            continue
+        if scenario.global_batch % d:
+            continue
+        for m, s in _factor_pairs(rest):
+            if m > 1 and ("model" not in scenario.axes
+                          or st.num_heads % m or st.embed_dim % m):
+                continue
+            if s > 1 and ("stage" not in scenario.axes
+                          or st.num_layers % s):
+                continue
+            for accum in accums:
+                step_batch = scenario.global_batch // accum
+                if step_batch % d or (step_batch // d) == 0:
+                    continue
+                if s == 1:
+                    out.append(Candidate(data=d, model=m, stage=s,
+                                         grad_accum=accum))
+                    if scenario.allow_fsdp and d > 1:
+                        out.append(Candidate(data=d, model=m, stage=s,
+                                             fsdp=True, grad_accum=accum))
+                    continue
+                for mb in _pow2_divisors(step_batch, MAX_MICROBATCHES):
+                    if (step_batch // mb) % d:
+                        continue
+                    if scenario.test_batch and scenario.test_batch % mb:
+                        # The composed trainer's eval engine pipelines the
+                        # SAME microbatch split over the test batch — a plan
+                        # that fails that guard must never be emitted.
+                        continue
+                    out.append(Candidate(data=d, model=m, stage=s,
+                                         grad_accum=accum, microbatches=mb))
+    return out
+
+
+def _sort_key(row: Ranked):
+    """Deterministic ranking: feasible first, then predicted step time, then a
+    simplicity preference (fewer mesh axes, no FSDP, less microbatching, more
+    data parallelism) so cost-model ties never flap between runs."""
+    c = row.candidate
+    axes_used = (c.model > 1) + (c.stage > 1)
+    return (not row.costs.fits, row.costs.step_s, axes_used, c.fsdp,
+            c.grad_accum * c.microbatches, -c.data, c.model, c.stage)
+
+
+def search(scenario: Scenario, *, top: int = 10) -> list[Ranked]:
+    """Enumerate, price, and rank the scenario's layouts; the head of the list
+    is the planner's pick. Returns at most ``top`` rows, feasible-first; raises
+    when NO candidate fits the memory budget (an infeasible plan must never be
+    silently emitted — the error names the smallest observed footprint so the
+    user can grow accum/devices or shrink the model)."""
+    cands = enumerate_candidates(scenario)
+    if not cands:
+        raise ValueError(
+            f"no legal parallel layout for {scenario.topo.num_devices} devices "
+            f"at global batch {scenario.global_batch} (model "
+            f"{scenario.stats.name!r})")
+    rows = [Ranked(c, predict(scenario.stats, scenario.topo, c,
+                              global_batch=scenario.global_batch,
+                              hbm_fraction=scenario.hbm_fraction))
+            for c in cands]
+    rows.sort(key=_sort_key)
+    if not rows[0].costs.fits:
+        tightest = min(r.costs.total_bytes_per_chip for r in rows)
+        raise ValueError(
+            f"no layout fits the per-chip memory budget "
+            f"({rows[0].costs.hbm_budget_bytes / 2**30:.2f} GiB usable): the "
+            f"smallest candidate footprint is {tightest / 2**30:.2f} GiB — "
+            f"add devices, enable grad accumulation, or shrink the model")
+    return rows[:top]

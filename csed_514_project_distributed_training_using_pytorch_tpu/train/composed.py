@@ -93,6 +93,18 @@ def main(config: ComposedConfig = ComposedConfig(), *,
          datasets=None) -> tuple[TrainState, M.MetricsHistory]:
     """Run composed-mesh training; returns final (host-resident) state + history."""
     watch = M.Stopwatch()
+    run_plan, plan_events = None, []
+    if config.plan:
+        # Resolve BEFORE the mesh spec is read: the plan rewrites mesh/fsdp/
+        # grad_accum/pipeline_microbatches on the (frozen) config. Deterministic
+        # across processes for auto/file; tune degrades to auto on a fleet.
+        # Autotune trial events buffer until the telemetry writer exists below.
+        from csed_514_project_distributed_training_using_pytorch_tpu import (
+            plan as plan_mod,
+        )
+        initialize_cluster()     # idempotent; planning needs the global topology
+        config, run_plan = plan_mod.apply_plan(config, "composed",
+                                               emit=plan_events.append)
     axis_names, axis_sizes = parse_mesh_spec(config.mesh)
     if config.kv_heads and (
             config.kv_heads < 0
@@ -140,6 +152,10 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                          "other output — pass --telemetry PATH too")
     tele = T.TelemetryWriter(config.telemetry)
     tele.emit(T.manifest_event(config, mesh=mesh, run_type="composed"))
+    if run_plan is not None:
+        tele.emit(T.plan_event(run_plan))
+        for ev in plan_events:
+            tele.emit(ev)
     # Resilience wiring (flag-gated, host-side only — zero-cost when off).
     rt = resilience.RunHooks(heartbeat_dir=config.heartbeat_dir,
                              handle_preemption=config.handle_preemption,

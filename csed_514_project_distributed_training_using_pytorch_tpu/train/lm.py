@@ -99,6 +99,16 @@ def main(config: LMConfig = LMConfig(), *,
         raise ValueError(f"--kv-heads {config.kv_heads} must be a positive divisor "
                          f"of --num-heads {config.num_heads}")
     info = initialize_cluster()
+    run_plan, plan_events = None, []
+    if config.plan:
+        # Resolve BEFORE the mesh spec is read: the plan rewrites mesh/
+        # grad_accum on the (frozen) config (data x model search — plan/).
+        # Autotune trial events buffer until the telemetry writer exists below.
+        from csed_514_project_distributed_training_using_pytorch_tpu import (
+            plan as plan_mod,
+        )
+        config, run_plan = plan_mod.apply_plan(config, "lm",
+                                               emit=plan_events.append)
     if config.mesh:
         # Optional named mesh: data (DP) x seq (context parallelism — ring or
         # zig-zag causal attention over the sequence-sharded pixel stream) x
@@ -193,6 +203,10 @@ def main(config: LMConfig = LMConfig(), *,
     # resilience hooks are flag-gated, host-side only (zero-cost when off).
     tele = T.TelemetryWriter(config.telemetry)
     tele.emit(T.manifest_event(config, mesh=mesh, run_type="lm"))
+    if run_plan is not None:
+        tele.emit(T.plan_event(run_plan))
+        for ev in plan_events:
+            tele.emit(ev)
     rt = resilience.RunHooks(heartbeat_dir=config.heartbeat_dir,
                              handle_preemption=config.handle_preemption,
                              process_index=info.process_index)

@@ -76,11 +76,28 @@ PEAK_HBM_BYTES_BY_KIND = [
     ("v3", 900e9), ("v2", 700e9),
 ]
 
+# Published per-chip HBM CAPACITY (spec sheets) — what a chip the process can't
+# introspect yet is judged by (``parallel.mesh.device_memory_budget``'s fallback
+# when the runtime reports no limit).
+HBM_CAPACITY_BY_KIND = [
+    ("v6", 32 << 30), ("v5p", 95 << 30), ("v5", 16 << 30), ("v4", 32 << 30),
+    ("v3", 16 << 30), ("v2", 8 << 30),
+]
+
+
+def lookup_by_kind(table, device_kind: str, default=None):
+    """First-match substring lookup over a device-kind spec table — the ONE
+    matcher behind every per-kind table here (peak FLOPs, HBM bandwidth/
+    capacity) and the planner's interconnect table (``plan.costs``). Tables are
+    ordered most-specific-first ('v5p' before 'v5'); adding a chip generation
+    means adding rows, never another matcher."""
+    kind = device_kind.lower()
+    return next((val for key, val in table if key in kind), default)
+
 
 def peak_hbm_bytes(device_kind: str) -> float | None:
     """Peak HBM bytes/s for a TPU ``device_kind`` string, or None if unknown."""
-    kind = device_kind.lower()
-    return next((peak for key, peak in PEAK_HBM_BYTES_BY_KIND if key in kind), None)
+    return lookup_by_kind(PEAK_HBM_BYTES_BY_KIND, device_kind)
 
 
 def chained_diff_time(chain, *, n1=2, grow=8, max_n=4096, min_delta=0.25,
@@ -160,8 +177,7 @@ def enable_compile_cache(default_dir: str) -> None:
 
 def peak_flops(device_kind: str) -> float | None:
     """bf16 peak FLOP/s for a TPU ``device_kind`` string, or None if unknown."""
-    kind = device_kind.lower()
-    return next((peak for key, peak in PEAK_FLOPS_BY_KIND if key in kind), None)
+    return lookup_by_kind(PEAK_FLOPS_BY_KIND, device_kind)
 
 
 @dataclass(frozen=True)

@@ -210,6 +210,14 @@ class ComposedConfig:
 
     mesh: str = "data=2,seq=2,model=2"  # named axes: data (DP), seq (ring attention),
                                         # model (Megatron TP); product = device count
+    plan: str = ""                      # automatic parallelism planning (plan/):
+                                        # 'auto' picks mesh/fsdp/microbatch split
+                                        # from the analytical cost model, 'tune'
+                                        # re-ranks the top candidates by measured
+                                        # step time, a path replays a saved plan
+                                        # JSON; overrides --mesh/--fsdp/
+                                        # --grad-accum/--pipeline-microbatches.
+                                        # "" (default) changes nothing
     seq_len: int = 16                   # tokens per image (a seq mesh axis must divide
                                         # it; indivisible 784/seq_len zero-pads the
                                         # pixel stream — see TransformerClassifier)
@@ -339,6 +347,10 @@ class LMConfig:
                                         # Megatron-shards the block kernels (TP,
                                         # r5; composes with data and seq).
                                         # Empty = all devices on one data axis.
+    plan: str = ""                      # automatic parallelism planning (plan/):
+                                        # 'auto' | 'tune' | a saved plan JSON
+                                        # path; overrides --mesh/--grad-accum
+                                        # (data x model search). "" off
     zigzag_attention: bool = False      # use the load-balanced zig-zag causal ring
                                         # schedule on the seq axis (uniform per-hop
                                         # work; needs seq_len % (2*seq_axis) == 0)

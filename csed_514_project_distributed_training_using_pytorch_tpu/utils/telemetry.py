@@ -38,6 +38,12 @@ structured layer every perf PR proves its numbers through:
                  via its own jax-free writer — same schema, same reader): attempt,
                  crash/hung/timeout reason, exit code, the checkpoint the next
                  attempt resumes from, backoff seconds
+  ``plan``       once per ``--plan`` run (``plan/``): the chosen mesh/microbatch
+                 split, its source (auto/tune/file), predicted step seconds +
+                 per-chip bytes, and how many candidates were ranked
+  ``autotune``   one line per empirically trialed candidate (``--plan tune``,
+                 ``plan/autotune.py``): mesh, analytical rank, predicted vs
+                 measured step seconds, AOT compile seconds, compiled FLOPs
   =============  =====================================================================
 
 - **writer** — ``TelemetryWriter`` is process-0 gated (a fleet writes ONE file) and
@@ -338,6 +344,54 @@ def preempt_event(*, epoch: int, step: int, checkpoint: str = "") -> dict:
         "epoch": int(epoch),
         "step": int(step),
         "checkpoint": checkpoint,
+    }
+
+
+def plan_event(plan, *, candidates: int | None = None) -> dict:
+    """The once-per-run ``plan`` record (``plan.apply_plan``): which layout the
+    planner picked, from which source, at what predicted/measured cost.
+    ``plan`` is a ``plan.artifact.Plan``; the full candidate table lives in the
+    saved plan JSON — this line carries the decision, not the search."""
+    predicted = plan.predicted or {}
+    return {
+        "event": "plan",
+        "run_type": plan.run_type,
+        "source": plan.source,
+        "mesh": plan.mesh,
+        "axes": dict(plan.axes),
+        "fsdp": bool(plan.fsdp),
+        "grad_accum": int(plan.grad_accum),
+        "pipeline_microbatches": int(plan.pipeline_microbatches),
+        "device_count": int(plan.device_count),
+        "global_batch": int(plan.global_batch),
+        "predicted_step_s": _finite(predicted.get("step_s")),
+        "predicted_bytes_per_chip": _finite(predicted.get("total_bytes_per_chip")),
+        "measured_step_s": _finite(plan.measured_step_s),
+        "candidates": (int(candidates) if candidates is not None
+                       else len(plan.candidates)),
+    }
+
+
+def autotune_event(*, mesh: str, fsdp: bool, grad_accum: int, microbatches: int,
+                   rank: int, predicted_step_s: float | None,
+                   measured_step_s: float | None = None,
+                   compile_s: float | None = None,
+                   flops_per_step: float | None = None) -> dict:
+    """One empirically trialed candidate (``plan/autotune.py``): the analytical
+    prediction next to the measured fact, so the cost model is auditable from
+    the telemetry alone. ``measured_step_s`` None = the trial harness could not
+    build this layout (analytical estimate retained in the ranking)."""
+    return {
+        "event": "autotune",
+        "mesh": mesh,
+        "fsdp": bool(fsdp),
+        "grad_accum": int(grad_accum),
+        "microbatches": int(microbatches),
+        "rank": int(rank),
+        "predicted_step_s": _finite(predicted_step_s),
+        "measured_step_s": _finite(measured_step_s),
+        "compile_s": _finite(compile_s),
+        "flops_per_step": _finite(flops_per_step),
     }
 
 

@@ -545,6 +545,80 @@ def test_dyn_offset_native_layout_forward():
         **_tol(1e-6, 1e-6))
 
 
+def test_dyn_offset_native_strided_forward():
+    """The native-STRIDED form (``per_head_grid=True``: packed ``(B·H, nq,
+    steps)`` grid, D-wide lane blocks over the flat operands) composes with
+    scalar prefetch too — the strided dyn-offset index maps ``(g//H, idx(i, j,
+    off), g%H)`` equal the packed dynamic path. Mirrors
+    ``test_dyn_offset_native_layout_forward`` at a register-width head dim
+    (D % 128 == 0, the shape that selects this form)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+        _flash_forward,
+    )
+
+    b, s, h, d, window = 2, 1024, 2, 128, 160
+    rng = np.random.default_rng(42)
+    q4, k4, v4 = (jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+                  for _ in range(3))
+    pack = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, s, d)
+    flat = lambda x: x.reshape(b, s, h * d)
+    outf, lse_strided = jax.jit(lambda off: _flash_forward(
+        flat(q4), flat(k4), flat(v4), causal=False, window=window,
+        q_offset_dyn=off, heads=h, per_head_grid=True))(jnp.int32(256))
+    out3, lse4 = jax.jit(lambda off: _flash_forward(
+        pack(q4), pack(k4), pack(v4), causal=False, window=window,
+        q_offset_dyn=off))(jnp.int32(256))
+    np.testing.assert_allclose(
+        np.asarray(pack(outf.reshape(b, s, h, d))), np.asarray(out3),
+        **_tol(1e-6, 1e-6))
+    # The strided form keeps the packed lse shape — directly comparable.
+    np.testing.assert_allclose(np.asarray(lse_strided), np.asarray(lse4),
+                               **_tol(1e-6, 1e-6))
+
+
+def test_native_unroll_auto_block_envelope_falls_back_to_packed():
+    """A geometry whose smallest legal native-unroll block (128·H·D) exceeds
+    the VMEM envelope must not die at trace time when the block is AUTO-chosen:
+    ``flash_attention`` warns and falls back to the packed layout (same math);
+    an EXPLICIT block keeps the hard error — the user asked for something the
+    chip cannot compile."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+        NATIVE_BLOCK_ELEMS,
+    )
+
+    b, s, h, d = 1, 128, 32, 80            # D % 128 != 0 -> unroll form
+    assert 128 * h * d > NATIVE_BLOCK_ELEMS
+    q, k, v = _qkv(b=b, s=s, h=h, d=d, seed=7)
+    with pytest.warns(UserWarning, match="falling back to the packed layout"):
+        out = flash_attention(q, k, v, native_layout=True)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(full_attention(q, k, v)),
+                               **_tol(1e-5, 1e-5))
+    with pytest.raises(ValueError, match="block\\*heads\\*head_dim"):
+        flash_attention(q, k, v, native_layout=True, block=128)
+
+
+def test_native_mode_rejects_unknown_env(monkeypatch):
+    """``FLASH_NATIVE_MODE`` is a measurement knob: a typo'd value silently
+    timing the default form would poison the comparison it exists for —
+    validate against {'', 'unroll'} and raise on anything else."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops.pallas_attention import (
+        native_mode,
+    )
+
+    monkeypatch.setenv("FLASH_NATIVE_MODE", "unroll")
+    assert native_mode(128) == "unroll"
+    monkeypatch.setenv("FLASH_NATIVE_MODE", "")
+    assert native_mode(128) == "strided"
+    assert native_mode(64) == "unroll"
+    monkeypatch.setenv("FLASH_NATIVE_MODE", "strided")   # not a valid FORCE
+    with pytest.raises(ValueError, match="FLASH_NATIVE_MODE"):
+        native_mode(128)
+    monkeypatch.setenv("FLASH_NATIVE_MODE", "unrol")
+    with pytest.raises(ValueError, match="got 'unrol'"):
+        native_mode(64)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_native_layout_banded_grid_matches_dense(causal):
