@@ -13,7 +13,9 @@ autoregressive workload instead of an artificially-masked classifier:
 - ``init_cache`` / ``decode_step`` / ``generate``: incremental decoding with per-layer
   K/V caches — plus ``decode_step_slots`` / ``reset_slots``, the PER-SLOT-position
   variant the continuous-batching serving engine (``serving/``) compiles exactly once
-  and drives forever — one token's projections per step, attention against the cached prefix,
+  and drives forever, and ``prefill_chunk``, the batched prefill that fills one
+  slot's cache ``chunk`` prompt positions at a time (the engine's admission path;
+  one compile per size in ``PREFILL_CHUNK_SIZES``) — one token's projections per step, attention against the cached prefix,
   cache append via ``lax.dynamic_update_slice``. The sampling loop is a handful of
   ``lax.scan`` segments under ``jit`` (compiler-friendly: static shapes, each segment
   attending over a static prefix that grows by ``DECODE_SEGMENT`` — masked prefix
@@ -364,6 +366,115 @@ def decode_step_slots(model: TransformerLM, params, cache: dict,
     h = ops.layer_norm(h, params["ln_f_scale"], params["ln_f_bias"])
     logits = ops.dense(h, params["head_kernel"], params["head_bias"])
     return cache, ops.log_softmax(logits.astype(jnp.float32))
+
+
+PREFILL_CHUNK_SIZES = (32, 128, 512)   # the serving engine's default static chunk
+                                       # set: admission of ANY prompt length
+                                       # compiles at most one program per size
+
+
+def prefill_chunk(model: TransformerLM, params, cache: dict, prompt: jax.Array,
+                  slot: jax.Array, start: jax.Array, length: jax.Array,
+                  fresh: jax.Array, *, chunk: int) -> dict:
+    """Batched prefill: write ``length`` prompt positions of ONE slot's KV cache in
+    a single ``[chunk]``-wide causal forward.
+
+    The serving engine's answer to the one-token-per-step prompt tax: where
+    prefill-as-decode pays one ``decode_step_slots`` invocation per prompt token,
+    this runs full-sequence causal attention for ``chunk`` positions at once —
+    MXU-shaped ``[chunk, E]`` matmuls instead of ``[B, E]`` single-token ones — and
+    bulk-writes the chunk's K/V rows, so a length-P prompt costs
+    ``ceil(P / chunk)`` program invocations. ``chunk`` is STATIC (one compile per
+    size in the engine's small chunk set); everything else is data:
+
+    - ``prompt``: the engine's device-resident ``[num_slots, S]`` prompt buffer;
+    - ``slot``, ``start``, ``length``: traced int32 scalars — which slot, the first
+      position of the chunk, and how many of the ``chunk`` rows are real (the tail
+      chunk of a prompt pads up; padded rows' K/V writes are DROPPED, not clamped,
+      so a partial chunk never clobbers live rows);
+    - ``fresh``: traced bool — wipe the slot's planes first (recycled-slot hygiene,
+      same contract as ``reset_slots``; False when a prefix-cache hit installed
+      rows that must survive).
+
+    Token-identity with the per-token path is by construction, not luck: the chunk
+    writes its K/V into the slot's FULL ``[S]`` plane first and then attends
+    against that plane under the same ``pos <= t`` (and sliding-window) mask and
+    the same einsum/reduction structure as ``decode_step_slots`` — position ``t``
+    reads exactly the rows (cached prefix + in-chunk causal) it would have seen
+    one token at a time, at the same cache dtype rounding. No logits: prompt
+    tokens are forced, so prefill only has to leave the cache behind.
+    """
+    s = model.seq_len
+    e, nh = model.embed_dim, model.num_heads
+    hd = e // nh
+    kvh = model.num_kv_heads or nh
+    rep = nh // kvh
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    if not 0 < chunk <= s:
+        raise ValueError(f"chunk {chunk} outside (0, {s}]")
+
+    positions = start + jnp.arange(chunk, dtype=jnp.int32)       # [C]
+    valid = jnp.arange(chunk) < length
+    # Padded rows may run past seq_len: every gather clips, every write drops.
+    safe_pos = jnp.clip(positions, 0, s - 1)
+    write_pos = jnp.where(valid, safe_pos, s)                    # s = dropped
+    row = prompt[slot]                                           # [S]
+    # Shift-right input stream: position 0 reads BOS, position p reads prompt[p-1].
+    prev = row[jnp.clip(positions - 1, 0, s - 1)]
+    inp = jnp.where(positions == 0, model.vocab_size - 1, prev)
+
+    h = params["tok_embed"].astype(jnp.float32)[inp]             # [C, E]
+    if not model.rope:
+        h = h + params["pos_embed"].astype(jnp.float32)[safe_pos]
+
+    pos_s = jnp.arange(s)[None]                                  # [1, S]
+    visible = pos_s <= positions[:, None]
+    if model.attention_window:
+        visible &= positions[:, None] - pos_s < model.attention_window
+    visible = visible[:, None, None, :]                          # [C, 1, 1, S]
+
+    for i in range(model.num_layers):
+        p = params[f"block_{i}"]
+        a = p["attn"]
+        x = ops.layer_norm(h, p["ln1_scale"], p["ln1_bias"])
+        if kvh == nh:
+            qkv = ops.dense(x, a["qkv_kernel"], a["qkv_bias"])    # [C, 3E]
+            q = qkv[:, :e].reshape(chunk, nh, hd)
+            k = qkv[:, e:2 * e].reshape(chunk, kvh, hd)
+            v = qkv[:, 2 * e:].reshape(chunk, kvh, hd)
+        else:  # GQA: split projections, kvh-head K/V (the smaller cache)
+            q = ops.dense(x, a["q_kernel"], a["q_bias"]).reshape(chunk, nh, hd)
+            kv = ops.dense(x, a["kv_kernel"],
+                           a["kv_bias"]).reshape(chunk, 2, kvh, hd)
+            k, v = kv[:, 0], kv[:, 1]
+        if model.rope:
+            q = apply_rotary(q, positions)
+            k = apply_rotary(k, positions)
+        layer = cache[f"block_{i}"]
+        plane_k, plane_v = layer["k"][slot], layer["v"][slot]    # [S, KV, Dh]
+        # Wipe-then-write keeps a recycled slot bit-identical to a fresh one
+        # (reset_slots' contract; fresh is False mid-plan and on prefix hits).
+        zero = jnp.zeros((), plane_k.dtype)
+        plane_k = jnp.where(fresh, zero, plane_k)
+        plane_v = jnp.where(fresh, zero, plane_v)
+        plane_k = plane_k.at[write_pos].set(k.astype(plane_k.dtype), mode="drop")
+        plane_v = plane_v.at[write_pos].set(v.astype(plane_v.dtype), mode="drop")
+        cache = {**cache, f"block_{i}": {
+            "k": lax.dynamic_update_index_in_dim(layer["k"], plane_k, slot, 0),
+            "v": lax.dynamic_update_index_in_dim(layer["v"], plane_v, slot, 0)}}
+        # Attend against the full written plane under the per-position mask —
+        # decode_step_slots' exact score/value structure, batched over the chunk.
+        qg = q.reshape(chunk, kvh, rep, hd)
+        scores = jnp.einsum("cgrd,sgd->cgrs", qg * scale, plane_k)   # [C,G,R,S]
+        scores = jnp.where(visible, scores, MASK_VALUE)
+        weights = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("cgrs,sgd->cgrd", weights, plane_v).reshape(chunk, e)
+        h = h + ops.dense(attn, a["out_kernel"], a["out_bias"])
+
+        x = ops.layer_norm(h, p["ln2_scale"], p["ln2_bias"])
+        up = ops.gelu(ops.dense(x, p["mlp_up_kernel"], p["mlp_up_bias"]))
+        h = h + ops.dense(up, p["mlp_down_kernel"], p["mlp_down_bias"])
+    return cache
 
 
 def reset_slots(cache: dict, fresh: jax.Array) -> dict:

@@ -3,15 +3,21 @@
 The training side of this repo compiles ONE program per epoch and never retraces;
 this package applies the same fixed-shape discipline to inference (DESIGN.md §11):
 
-- ``engine``     the continuous-batching core — one jitted decode program over a
-                 fixed ``[num_slots]`` batch, per-slot positions/caches/sampling
-                 params, requests admitted into freed slots between steps with
-                 zero retracing
-- ``scheduler``  thread-safe bounded request queue: backpressure (``QueueFull``),
-                 per-request deadlines enforced while queued
-- ``server``     the in-process front end: ``submit() -> Future``, a background
-                 decode loop, graceful drain on ``stop()``, and per-request
-                 TTFT/TPOT/queue-wait telemetry (``"event": "serve"`` JSONL)
+- ``engine``       the continuous-batching core — one jitted decode program over
+                   a fixed ``[num_slots]`` batch, per-slot positions/caches/
+                   sampling params, requests admitted into freed slots between
+                   steps with zero retracing; prompts enter via CHUNKED BATCHED
+                   PREFILL (``models.lm.prefill_chunk``, a small static chunk-size
+                   set compiled once each) interleaved with decode under a
+                   per-step chunk budget
+- ``prefix_cache`` host-side LRU of prefilled K/V planes keyed by prompt tokens —
+                   repeated prompt prefixes (system prompts) skip prefill
+- ``scheduler``    thread-safe bounded request queue: backpressure
+                   (``QueueFull``), per-request deadlines enforced while queued
+- ``server``       the in-process front end: ``submit() -> Future``, a background
+                   decode loop, graceful drain on ``stop()``, and per-request
+                   TTFT/TPOT/queue-wait telemetry (``"event": "serve"`` JSONL)
+                   plus per-prompt ``"prefill"`` events
 
 Load generator: ``tools/serve_loadgen.py``; report: ``tools/telemetry_report.py``.
 """
@@ -21,6 +27,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine impo
     ContinuousBatchingEngine,
     Request,
     SamplingParams,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.prefix_cache import (
+    PrefixCache,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
     QueueFull,
@@ -33,6 +42,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.server impo
 __all__ = [
     "Completion",
     "ContinuousBatchingEngine",
+    "PrefixCache",
     "QueueFull",
     "Request",
     "RequestQueue",

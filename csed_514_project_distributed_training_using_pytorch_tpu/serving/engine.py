@@ -19,15 +19,26 @@ entire per-token host traffic. ``trace_count`` counts traces of the decode progr
 tests assert it stays at 1 across an arbitrary request mix (the zero-retracing
 contract, acceptance criterion of the serving PR).
 
-Prompts are teacher-forced through the same decode loop (prefill-as-decode, one
-token per step): position ``t < prompt_len`` emits the prompt token and still writes
-its K/V — exactly ``generate``'s prompt semantics, which is what makes the engine
-token-identical to sequential ``generate`` (the greedy-parity test).
+Prompts no longer pay the one-token-per-step tax: admission runs **chunked batched
+prefill** (``models.lm.prefill_chunk``) — a length-P prompt fills its slot's KV
+cache in ``ceil(P / chunk)`` wide causal forwards drawn from a small STATIC chunk
+set (``prefill_chunk_sizes``, one compile per size, ``prefill_trace_counts``
+asserted), interleaved with decode steps under a per-step chunk budget so long
+prompts can't starve active decodes. A host-side prefix LRU
+(``serving.prefix_cache``) lets repeated prompt prefixes skip prefill entirely by
+copying already-computed K/V planes into the fresh slot. The legacy
+prefill-as-decode path (``prefill_chunk_sizes=()``) teacher-forces prompts through
+the decode loop one token per step — position ``t < prompt_len`` emits the prompt
+token and still writes its K/V, exactly ``generate``'s prompt semantics. Both
+paths are pinned token-identical to sequential ``generate`` (the greedy-parity
+tests): chunked prefill is a schedule change, not a math change.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import functools
 import time
 
 import jax
@@ -37,6 +48,9 @@ import numpy as np
 from csed_514_project_distributed_training_using_pytorch_tpu.models import lm as lm_mod
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
     MASK_VALUE,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.prefix_cache import (
+    PrefixCache,
 )
 
 
@@ -136,15 +150,23 @@ class ContinuousBatchingEngine:
     H2D per step, the control plane. The two [.., seq_len]-sized tensors — KV
     cache and prompt buffer — live on DEVICE across steps (the cache donated
     through the step, the prompt scatter-updated on admission), so per-token H2D
-    traffic never scales with seq_len. Admission is a few host writes plus one
-    [S]-row scatter; never a retrace of the decode program.
+    traffic never scales with seq_len. Admission is a few host writes plus ONE
+    padded prompt-row scatter for the whole batch; never a retrace of the decode
+    program. Prompts are prefilled in chunked batched forwards (a small static
+    chunk-size set, one compile each) interleaved with decode under
+    ``prefill_chunk_budget``, with an optional host-side prefix KV cache
+    (``prefix_cache_entries``) that lets repeated prompt prefixes skip prefill;
+    ``prefill_chunk_sizes=()`` falls back to prefill-as-decode.
 
     Single-threaded by design: the ``serving.server.Server`` front end serializes
     all engine access on its loop thread; tests drive ``run()`` directly.
     """
 
     def __init__(self, model: lm_mod.TransformerLM, params, *, num_slots: int,
-                 seed: int = 0):
+                 seed: int = 0,
+                 prefill_chunk_sizes: tuple[int, ...] = lm_mod.PREFILL_CHUNK_SIZES,
+                 prefill_chunk_budget: int = 1,
+                 prefix_cache_entries: int = 0):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.model = model
@@ -152,7 +174,7 @@ class ContinuousBatchingEngine:
         self.num_slots = int(num_slots)
         self.trace_count = 0          # traces of the decode program (tests pin == 1)
         self.steps = 0                # decode steps executed
-        self.slot_steps = 0           # sum of active slots over steps (occupancy)
+        self.slot_steps = 0           # sum of occupied slots over steps (occupancy)
         self._key = jax.random.PRNGKey(seed)
         self._cache = lm_mod.init_cache(model, self.num_slots)
         b, s = self.num_slots, model.seq_len
@@ -162,11 +184,12 @@ class ContinuousBatchingEngine:
         # The prompt buffer is DEVICE-resident like the cache: it is [B, S] (the
         # one per-slot tensor that scales with seq_len), so re-transferring it
         # every step would put O(B*S) H2D on the per-token path. Admission
-        # scatters just the admitted slot's [S] row via a small jitted update
-        # (a separate program from the decode step — trace_count counts decode).
+        # scatters ALL newly admitted rows in one padded jitted update (a
+        # separate program from the decode step — trace_count counts decode).
         self._prompt = jnp.zeros((b, s), jnp.int32)
-        self._set_prompt_row = jax.jit(
-            lambda buf, slot, row: buf.at[slot].set(row), donate_argnums=(0,))
+        self.admit_trace_count = 0    # traces of the admission scatter (pin == 1)
+        self._set_prompt_rows = jax.jit(self._prompt_scatter_program,
+                                        donate_argnums=(0,))
         self._prompt_len = np.zeros((b,), np.int32)
         self._total_len = np.zeros((b,), np.int32)
         self._temp = np.zeros((b,), np.float32)
@@ -176,6 +199,47 @@ class ContinuousBatchingEngine:
         self._out: list[list[int]] = [[] for _ in range(b)]
         self._admit_s = np.zeros((b,), np.float64)
         self._first_tok_s: list[float | None] = [None] * b
+        # --- chunked batched prefill state -----------------------------------
+        # Chunk sizes are clipped to seq_len and deduped: a tiny test model with
+        # seq_len 16 turns the default (32, 128, 512) into a single 16-chunk.
+        sizes = {min(int(c), s) for c in (prefill_chunk_sizes or ())}
+        if any(c < 1 for c in sizes):
+            raise ValueError(f"prefill chunk sizes must be >= 1, "
+                             f"got {prefill_chunk_sizes}")
+        self.prefill_chunk_sizes = tuple(sorted(sizes))
+        if prefill_chunk_budget < 1:
+            raise ValueError(f"prefill_chunk_budget must be >= 1, "
+                             f"got {prefill_chunk_budget}")
+        self.prefill_chunk_budget = int(prefill_chunk_budget)
+        if prefix_cache_entries and not self.prefill_chunk_sizes:
+            raise ValueError("the prefix cache rides the chunked-prefill path — "
+                             "enable prefill_chunk_sizes to use it")
+        self.prefix_cache = (PrefixCache(prefix_cache_entries)
+                             if prefix_cache_entries else None)
+        self.prefill_invocations = 0  # chunk-program executions
+        self.prefill_tokens = 0       # prompt tokens prefilled (cache hits excluded)
+        self.prefill_wall_s = 0.0     # host wall across completed prefills
+        self.prefill_trace_counts: dict[int, int] = {}   # per-size (pin <= 1 each)
+        self._prefill_jits = {
+            c: jax.jit(functools.partial(self._prefill_program, c),
+                       donate_argnums=(1,))
+            for c in self.prefill_chunk_sizes}
+        self._pending_chunks: list[list[tuple[int, int, int]]] = \
+            [[] for _ in range(b)]
+        self._prefill_fifo: collections.deque[int] = collections.deque()
+        self._prefill_t0 = np.zeros((b,), np.float64)
+        # Per-slot host wall spent INSIDE this prompt's chunk invocations (plus
+        # its completion fence) — the throughput denominator. Admission-to-ready
+        # latency (which also counts waiting behind other prompts' chunks and
+        # interleaved decode steps under the budget) is reported separately, so
+        # concurrency can't deflate prefill tokens/s.
+        self._chunk_wall = np.zeros((b,), np.float64)
+        self._hit_len = np.zeros((b,), np.int32)
+        self._chunks_done = np.zeros((b,), np.int32)
+        self._prefill_records: list[dict] = []
+        self._install_jit = jax.jit(self._install_program, donate_argnums=(0,))
+        self._snapshot_jit = jax.jit(
+            lambda cache, slot: jax.tree_util.tree_map(lambda c: c[slot], cache))
         # The cache (arg 1 after params) is donated: each step's updated cache
         # reuses the previous buffer instead of allocating a second full copy —
         # on the serving path the KV cache IS the memory footprint.
@@ -212,6 +276,34 @@ class ContinuousBatchingEngine:
             prompt, jnp.clip(t, 0, model.seq_len - 1)[:, None], axis=1)[:, 0]
         return cache, jnp.where(t < prompt_len, forced, tok).astype(jnp.int32)
 
+    def _prefill_program(self, chunk, params, cache, prompt, slot, start, length,
+                         fresh):
+        """One chunked-prefill invocation (``models.lm.prefill_chunk``): fill
+        ``length <= chunk`` prompt positions of ``slot``'s KV cache. ``chunk`` is
+        the only static argument — slot/start/length/fresh are data, so each size
+        in ``prefill_chunk_sizes`` traces at most once (``prefill_trace_counts``)
+        no matter how prompts mix."""
+        self.prefill_trace_counts[chunk] = \
+            self.prefill_trace_counts.get(chunk, 0) + 1
+        return lm_mod.prefill_chunk(self.model, params, cache, prompt, slot,
+                                    start, length, fresh, chunk=chunk)
+
+    def _prompt_scatter_program(self, buf, slots, rows):
+        """Batched admission: scatter up to ``num_slots`` prompt rows in ONE
+        dispatch. Both inputs are padded to ``[num_slots]`` (pad index =
+        ``num_slots``, out of range, ``mode="drop"``) so any admission count
+        reuses the same compiled program."""
+        self.admit_trace_count += 1
+        return buf.at[slots].set(rows, mode="drop")
+
+    def _install_program(self, cache, planes, slot):
+        """Prefix-cache hit: copy a stored slot's full K/V planes into ``slot``
+        (one fixed-shape program — rows past the hit length are donor garbage,
+        hidden by the position mask until prefill/decode overwrites them)."""
+        return jax.tree_util.tree_map(
+            lambda c, pl: jax.lax.dynamic_update_index_in_dim(c, pl, slot, 0),
+            cache, planes)
+
     # ------------------------------------------------------------------ slots
 
     @property
@@ -234,37 +326,180 @@ class ContinuousBatchingEngine:
                              f"got {request.max_new_tokens}")
         return min(p + request.max_new_tokens, self.model.seq_len)
 
+    def plan_prefill(self, start: int, end: int) -> list[tuple[int, int, int]]:
+        """``(start, length, chunk_size)`` triples covering prompt positions
+        ``[start, end)``: greedily the biggest configured chunk that fits, then
+        the smallest chunk PADDED for the tail (padded rows' writes are dropped,
+        never clamped) — so a single configured size ``c`` costs exactly
+        ``ceil((end - start) / c)`` invocations."""
+        plan = []
+        while start < end:
+            rem = end - start
+            fit = [c for c in self.prefill_chunk_sizes if c <= rem]
+            size = max(fit) if fit else self.prefill_chunk_sizes[0]
+            length = min(rem, size)
+            plan.append((start, length, size))
+            start += length
+        return plan
+
     def admit(self, slot: int, request: Request, *,
               now: float | None = None) -> None:
-        """Bind ``request`` to a free slot: host array writes only (no recompile,
-        no device traffic — the cache wipe rides the next step's ``fresh`` mask)."""
-        if self._requests[slot] is not None:
-            raise ValueError(f"slot {slot} is occupied")
-        total = self.validate(request)
+        """Bind ``request`` to a free slot (single-request convenience over
+        ``admit_many``)."""
+        self.admit_many([(slot, request)], now=now)
+
+    def admit_many(self, admissions: list[tuple[int, Request]], *,
+                   now: float | None = None) -> None:
+        """Bind a batch of requests to free slots: host array writes plus ONE
+        prompt-row scatter dispatch for the whole batch (no recompile — the
+        scatter is padded to ``num_slots``, so any admission count reuses one
+        program). Each prompt is then either chunk-prefilled (interleaved with
+        decode by ``step``), satisfied from the prefix cache, or — with prefill
+        disabled — teacher-forced through the decode loop as before."""
+        if not admissions:
+            return
         now = time.monotonic() if now is None else now
+        seen: set[int] = set()
+        totals: list[int] = []
+        for slot, request in admissions:
+            if self._requests[slot] is not None or slot in seen:
+                raise ValueError(f"slot {slot} is occupied")
+            seen.add(slot)
+            totals.append(self.validate(request))
+        b, s = self.num_slots, self.model.seq_len
+        if len(admissions) > b:
+            raise ValueError(f"{len(admissions)} admissions > {b} slots")
+        slot_idx = np.full((b,), b, np.int32)        # b is out of range: dropped
+        rows = np.zeros((b, s), np.int32)
+        for j, (slot, request) in enumerate(admissions):
+            slot_idx[j] = slot
+            p = len(request.prompt)
+            if p:
+                rows[j, :p] = np.asarray(request.prompt, np.int32)
+        self._prompt = self._set_prompt_rows(self._prompt, slot_idx, rows)
+        for (slot, request), total in zip(admissions, totals):
+            self._admit_one(slot, request, total, now)
+
+    def _admit_one(self, slot: int, request: Request, total: int,
+                   now: float) -> None:
         p = len(request.prompt)
         self._requests[slot] = request
-        self._active[slot] = True
-        self._ids[slot] = self.model.vocab_size - 1              # BOS restart
-        self._t[slot] = 0
-        row = np.zeros((self.model.seq_len,), np.int32)
-        if p:
-            row[:p] = np.asarray(request.prompt, np.int32)
-        self._prompt = self._set_prompt_row(self._prompt, np.int32(slot), row)
         self._prompt_len[slot] = p
         self._total_len[slot] = total
         self._temp[slot] = request.sampling.temperature
         self._top_k[slot] = request.sampling.top_k
         self._top_p[slot] = request.sampling.top_p
-        self._out[slot] = []
         self._admit_s[slot] = now
         self._first_tok_s[slot] = None
+        self._chunks_done[slot] = 0
         if request.arrival_s is None:
             request.arrival_s = now
+        prompt_np = np.asarray(request.prompt, np.int32).reshape(-1)
+        hit_len = 0
+        if self.prefix_cache is not None and p:
+            hit_len, planes = self.prefix_cache.lookup(
+                prompt_np, min_len=min(self.prefill_chunk_sizes))
+            if hit_len:
+                self._cache = self._install_jit(self._cache, planes,
+                                                np.int32(slot))
+        self._hit_len[slot] = hit_len
+        if not self.prefill_chunk_sizes or p == 0:
+            # Legacy prefill-as-decode (or nothing to prefill): the slot joins
+            # the decode program at t=0; the next step's ``fresh`` mask wipes it.
+            self._active[slot] = True
+            self._ids[slot] = self.model.vocab_size - 1          # BOS restart
+            self._t[slot] = 0
+            self._out[slot] = []
+        elif hit_len == p:
+            # Full prefix hit: the installed planes ARE the prefill — the slot
+            # joins decode at position p with zero chunk invocations.
+            self._activate_prefilled(slot)
+            self._record_prefill(slot, wall_s=0.0, latency_s=0.0)
+        else:
+            # Chunked prefill over [hit_len, p): the slot stays out of the
+            # decode batch until its plan drains. Its ``t`` parks at seq_len-1
+            # so the decode program's unconditional per-slot cache write lands
+            # on a row that is rewritten before it can ever become visible —
+            # never on the rows prefill is filling.
+            self._pending_chunks[slot] = self.plan_prefill(hit_len, p)
+            self._prefill_fifo.append(slot)
+            self._prefill_t0[slot] = now
+            self._chunk_wall[slot] = 0.0
+            self._active[slot] = False
+            self._t[slot] = self.model.seq_len - 1
+            self._out[slot] = []    # built once at activation (or, on a
+                                    # mid-prefill expiry, sliced from the plan)
+
+    def _activate_prefilled(self, slot: int) -> None:
+        """Promote a slot whose cache holds its full prompt into the decode
+        batch: the emitted stream so far is the teacher-forced prompt, and the
+        next decode step samples the first generated token at position P."""
+        req = self._requests[slot]
+        p = int(self._prompt_len[slot])
+        self._ids[slot] = int(req.prompt[p - 1])
+        self._t[slot] = p
+        self._out[slot] = [int(x) for x in np.asarray(req.prompt, np.int32)]
+        self._active[slot] = True
+
+    def _record_prefill(self, slot: int, *, wall_s: float,
+                        latency_s: float) -> None:
+        """``wall_s`` is the host wall attributable to THIS prompt's chunk
+        programs (the throughput denominator); ``latency_s`` is admission to
+        decode-ready, which also counts waiting behind other prompts under the
+        chunk budget."""
+        req = self._requests[slot]
+        self.prefill_wall_s += wall_s
+        self._prefill_records.append({
+            "request_id": req.request_id,
+            "prompt_len": int(self._prompt_len[slot]),
+            "chunks": int(self._chunks_done[slot]),
+            "tokens": int(self._prompt_len[slot]) - int(self._hit_len[slot]),
+            "cache_hit_len": int(self._hit_len[slot]),
+            "wall_s": wall_s,
+            "latency_s": latency_s,
+        })
+
+    def reset_stats(self) -> None:
+        """Zero the perf counters and prefix-cache CONTENTS (never the compiled
+        programs or trace counts): benchmark hygiene — warm the programs up,
+        then measure from a clean ledger. Only valid while no request is in
+        flight (counters mid-request would go inconsistent)."""
+        if self.num_active:
+            raise RuntimeError("reset_stats with requests in flight")
+        self.steps = 0
+        self.slot_steps = 0
+        self.prefill_invocations = 0
+        self.prefill_tokens = 0
+        self.prefill_wall_s = 0.0
+        self._prefill_records = []
+        if self.prefix_cache is not None:
+            self.prefix_cache = PrefixCache(self.prefix_cache.capacity)
+
+    def take_prefill_records(self) -> list[dict]:
+        """Drain the completed-prefill telemetry records (one dict per prompt:
+        chunks, tokens, cache_hit_len, wall_s) accumulated since the last call —
+        the server emits them as ``"prefill"`` events."""
+        records, self._prefill_records = self._prefill_records, []
+        return records
 
     def _finish(self, slot: int, finish: str, now: float) -> Completion:
         req = self._requests[slot]
-        tokens = np.asarray(self._out[slot], np.int32)
+        if self._pending_chunks[slot]:
+            # Mid-prefill expiry: the emitted stream is the teacher-forced
+            # prompt prefix covered so far — the next pending chunk's start.
+            # The chunk wall already spent joins the aggregate (its tokens are
+            # in prefill_tokens, so its time belongs in prefill_wall_s — else
+            # expiries would inflate reported prefill throughput), and the
+            # abandoned plan is dropped; the slot's next occupant wipes or
+            # overwrites whatever the partial prefill left.
+            tokens = np.asarray(req.prompt[:self._pending_chunks[slot][0][0]],
+                                np.int32)
+            self.prefill_wall_s += float(self._chunk_wall[slot])
+            self._chunk_wall[slot] = 0.0
+            self._pending_chunks[slot] = []
+            self._prefill_fifo.remove(slot)
+        else:
+            tokens = np.asarray(self._out[slot], np.int32)
         plen = int(self._prompt_len[slot])
         new = max(len(tokens) - plen, 0)
         arrival = req.arrival_s if req.arrival_s is not None else self._admit_s[slot]
@@ -281,14 +516,63 @@ class ContinuousBatchingEngine:
         self._active[slot] = False
         self._out[slot] = []
         self._first_tok_s[slot] = None
+        self._hit_len[slot] = 0
         return comp
 
     # ------------------------------------------------------------------ stepping
 
+    @property
+    def num_prefilling(self) -> int:
+        """Slots whose prompt prefill plan has not drained yet."""
+        return len(self._prefill_fifo)
+
+    def _run_prefill(self) -> None:
+        """Run up to ``prefill_chunk_budget`` chunk invocations, oldest admitted
+        slot first (FIFO — best TTFT fairness), finishing slots mid-budget. The
+        budget is what keeps a burst of long prompts from starving the decode
+        step that follows: prefill and decode interleave at chunk granularity."""
+        budget = self.prefill_chunk_budget
+        while budget > 0 and self._prefill_fifo:
+            slot = self._prefill_fifo[0]
+            start, length, size = self._pending_chunks[slot].pop(0)
+            fresh = self._chunks_done[slot] == 0 and self._hit_len[slot] == 0
+            t0 = time.monotonic()
+            self._cache = self._prefill_jits[size](
+                self.params, self._cache, self._prompt, np.int32(slot),
+                np.int32(start), np.int32(length), np.asarray(bool(fresh)))
+            self._chunk_wall[slot] += time.monotonic() - t0
+            self.prefill_invocations += 1
+            self.prefill_tokens += length
+            self._chunks_done[slot] += 1
+            budget -= 1
+            if not self._pending_chunks[slot]:
+                self._finish_prefill(slot)
+
+    def _finish_prefill(self, slot: int) -> None:
+        self._prefill_fifo.popleft()          # chunks only run at the FIFO head
+        # One fence per PROMPT (decode pays one per token): makes the recorded
+        # prefill wall honest and the snapshot below read settled rows.
+        t0 = time.monotonic()
+        jax.tree_util.tree_leaves(self._cache)[0].block_until_ready()
+        self._chunk_wall[slot] += time.monotonic() - t0
+        if self.prefix_cache is not None:
+            req = self._requests[slot]
+            self.prefix_cache.insert(np.asarray(req.prompt, np.int32),
+                                     self._snapshot_jit(self._cache,
+                                                        np.int32(slot)))
+        self._activate_prefilled(slot)
+        self._record_prefill(
+            slot, wall_s=float(self._chunk_wall[slot]),
+            latency_s=float(time.monotonic() - self._prefill_t0[slot]))
+
     def step(self) -> list[Completion]:
-        """Advance every in-flight slot one token; return the requests that
-        finished this step. One host sync (the ``[num_slots]`` token fetch)."""
+        """Advance the engine: up to ``prefill_chunk_budget`` prefill chunks,
+        then one decode step over every decode-ready slot; returns the requests
+        that finished. One host sync (the ``[num_slots]`` token fetch)."""
         if self.num_active == 0:
+            return []
+        self._run_prefill()
+        if not self._active.any():            # everything in flight is prefilling
             return []
         self._key, sub = jax.random.split(self._key)
         fresh = self._active & (self._t == 0)
@@ -335,10 +619,12 @@ class ContinuousBatchingEngine:
         out: list[Completion] = []
         budget = max_steps
         while pending or self.num_active:
+            batch = []
             for slot in self.free_slots():
                 if not pending:
                     break
-                self.admit(slot, pending.pop(0))
+                batch.append((slot, pending.pop(0)))
+            self.admit_many(batch)
             out.extend(self.step())
             if budget is not None:
                 budget -= 1

@@ -1,0 +1,124 @@
+"""Host-side prefix KV reuse: an LRU of prefilled prompt caches keyed by tokens.
+
+Repeated prompt prefixes (the system-prompt pattern) pay the prefill tax once:
+after the engine finishes prefilling a prompt, it snapshots the slot's full
+``[S, KV_H, Dh]`` K/V planes (per layer) into this cache; a later admission whose
+prompt shares a token prefix gets those planes copied into its fresh slot and only
+chunk-prefills the remainder — a full-prefix hit skips prefill entirely.
+
+Why a token-prefix match is sufficient: cache row ``p`` holds the K/V of the
+shift-right input at position ``p`` (BOS at 0, ``prompt[p-1]`` after), computed
+from hidden states that depend only on positions ``<= p`` — i.e. rows ``[0, M)``
+are a pure function of ``prompt[:M-1]`` (and the params). So if a stored entry's
+tokens and a new prompt agree on their first ``M`` tokens, the entry's first ``M``
+rows are byte-for-byte the rows the new prompt's prefill would have produced, at
+ANY ``M`` up to the common prefix — no chunk-boundary alignment required. Rows
+beyond ``M`` in the installed planes are the donor's leftovers; they are
+invisible (the per-slot ``pos <= t`` mask) until the chunk/decode path overwrites
+them, the same garbage-tolerance the engine's slot recycling already relies on.
+
+The structure is deliberately host-simple: an ``OrderedDict`` LRU over whole-slot
+snapshots (entries are device arrays — eviction just drops the reference), exact
+``np.ndarray`` token comparison (no hash-collision exposure), O(entries ·
+prefix_len) lookup. Capacity is counted in entries; each entry costs one slot's
+full cache (``layers · 2 · S · KV_H · Dh`` elements).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class PrefixEntry:
+    """One stored prefill: the prompt tokens whose rows the planes hold, and the
+    per-layer ``{"k": [S, KV_H, Dh], "v": ...}`` device planes (rows
+    ``[0, len(tokens))`` valid, the rest donor garbage)."""
+
+    tokens: np.ndarray
+    planes: dict
+
+
+class PrefixCache:
+    """LRU of ``PrefixEntry``s. ``capacity`` is the max entry count (>= 1)."""
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._entries: collections.OrderedDict[int, PrefixEntry] = \
+            collections.OrderedDict()
+        self._next_key = 0
+        self.queries = 0
+        self.hits = 0
+        self.hit_tokens = 0
+        self.insertions = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @staticmethod
+    def _common_prefix(a: np.ndarray, b: np.ndarray) -> int:
+        n = min(len(a), len(b))
+        if n == 0:
+            return 0
+        neq = np.nonzero(a[:n] != b[:n])[0]
+        return int(neq[0]) if len(neq) else n
+
+    def lookup(self, prompt: np.ndarray, *,
+               min_len: int = 1) -> tuple[int, dict | None]:
+        """Longest-common-prefix match against the stored entries: returns
+        ``(hit_len, planes)`` for the best entry (``(0, None)`` on a miss) and
+        refreshes its LRU position. ``hit_len`` may be any length up to
+        ``len(prompt)`` — the caller chunk-prefills ``[hit_len, P)``.
+
+        ``min_len`` floors a PARTIAL hit's useful length (the engine passes its
+        smallest chunk size): installing a whole plane to save fewer prompt
+        tokens than one chunk costs more than it saves, so coincidental 1-token
+        overlaps between random prompts don't trigger copies. A full-prompt hit
+        always qualifies — it skips prefill entirely."""
+        self.queries += 1
+        prompt = np.asarray(prompt, np.int32)
+        best_key, best_len = None, 0
+        for key, entry in self._entries.items():
+            m = self._common_prefix(entry.tokens, prompt)
+            if m > best_len and (m == len(prompt) or m >= min_len):
+                best_key, best_len = key, m
+        if best_key is None:
+            return 0, None
+        self._entries.move_to_end(best_key)
+        self.hits += 1
+        self.hit_tokens += best_len
+        return best_len, self._entries[best_key].planes
+
+    def insert(self, tokens: np.ndarray, planes: dict) -> None:
+        """Store a finished prefill (and drop any entry the new one strictly
+        covers — same tokens as a prefix of the new entry's, so every future
+        lookup the old entry could win, the new one wins longer)."""
+        tokens = np.asarray(tokens, np.int32).copy()
+        covered = [k for k, e in self._entries.items()
+                   if len(e.tokens) <= len(tokens)
+                   and self._common_prefix(e.tokens, tokens) == len(e.tokens)]
+        for k in covered:
+            del self._entries[k]
+        self._entries[self._next_key] = PrefixEntry(tokens=tokens, planes=planes)
+        self._next_key += 1
+        self.insertions += 1
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "capacity": self.capacity,
+            "queries": self.queries,
+            "hits": self.hits,
+            "hit_tokens": self.hit_tokens,
+            "insertions": self.insertions,
+            "evictions": self.evictions,
+        }

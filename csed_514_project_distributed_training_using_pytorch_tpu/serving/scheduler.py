@@ -2,7 +2,11 @@
 
 The scheduler is deliberately small — slot placement is trivial (any free slot; all
 slots are identical because shapes are fixed), so the scheduling problem reduces to
-the queue discipline:
+the queue discipline. FIFO order carries further than it used to: it is also the
+engine's PREFILL order (admitted prompts chunk-prefill oldest-first under the
+per-step chunk budget, so a long prompt ahead of you delays your first chunk but
+never your decode — decode slots always get their step), which keeps TTFT
+fairness aligned with arrival order:
 
 - **backpressure** — ``submit`` on a full queue raises ``QueueFull`` immediately
   (the caller sheds load or retries with its own policy; the serving loop never

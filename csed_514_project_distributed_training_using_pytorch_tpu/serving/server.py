@@ -6,8 +6,12 @@ loop); submitter threads only touch the scheduler queue and their futures. The l
 1. reject requests that expired while queued (scheduler ``take``) and in-flight
    requests past their deadline (engine ``expire``) — both resolve their futures
    with ``finish="timeout"`` completions;
-2. admit queued requests into freed slots (host array writes, zero retracing);
-3. run one engine step when any slot is live, else block on the queue's condition;
+2. admit queued requests into freed slots (host array writes plus one batched
+   prompt-row scatter, zero retracing) — admission kicks off chunked prefill
+   (and prefix-cache lookups) inside the engine;
+3. run one engine step when any slot is live (budgeted prefill chunks, then the
+   decode step), emitting a ``"prefill"`` telemetry event per completed prompt;
+   else block on the queue's condition;
 4. on ``stop()`` (graceful drain): the queue closes — new ``submit``s fail fast —
    while everything already accepted decodes to completion, then the loop emits the
    ``serve_summary`` aggregate and exits.
@@ -88,6 +92,10 @@ class Server:
             "vocab_size": self.engine.model.vocab_size,
             "max_pending": self.queue.max_pending,
             "default_timeout_s": self._default_timeout_s,
+            "prefill_chunk_sizes": list(self.engine.prefill_chunk_sizes),
+            "prefill_chunk_budget": self.engine.prefill_chunk_budget,
+            "prefix_cache_entries": (self.engine.prefix_cache.capacity
+                                     if self.engine.prefix_cache else 0),
         })
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-loop")
@@ -215,11 +223,15 @@ class Server:
             admitted, expired = self.queue.take(now, len(eng.free_slots()))
             for req in expired:
                 self._reject_expired(req, now)
-            for slot, req in zip(eng.free_slots(), admitted):
-                eng.admit(slot, req, now=now)
+            # One padded scatter dispatch admits the whole batch of freed slots.
+            eng.admit_many(list(zip(eng.free_slots(), admitted)), now=now)
             if eng.num_active:
+                # step() interleaves prefill chunks (budgeted) with the decode
+                # step, so a burst of long prompts can't starve active decodes.
                 for comp in eng.step():
                     self._resolve(comp)
+                for rec in eng.take_prefill_records():
+                    self._writer.emit(T.prefill_event(**rec))
             elif len(self.queue) == 0 and self.queue.closed:
                 break
             else:
@@ -228,8 +240,14 @@ class Server:
     def _emit_summary(self) -> None:
         wall_s = (time.monotonic() - self._started_s
                   if self._started_s is not None else None)
+        eng = self.engine
         self._writer.emit(T.serve_summary_event(
             **self._counts, wall_s=wall_s,
-            steps=self.engine.steps,
-            slot_occupancy=self.engine.slot_occupancy,
+            steps=eng.steps,
+            slot_occupancy=eng.slot_occupancy,
+            prefill_tokens=eng.prefill_tokens,
+            prefill_chunks=eng.prefill_invocations,
+            prefill_wall_s=eng.prefill_wall_s,
+            prefix_cache=(eng.prefix_cache.stats()
+                          if eng.prefix_cache else None),
             **self._series))

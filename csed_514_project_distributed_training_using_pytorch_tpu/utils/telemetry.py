@@ -489,9 +489,36 @@ def serve_event(*, request_id: int, prompt_len: int, new_tokens: int, finish: st
     }
 
 
+def prefill_event(*, request_id: int, prompt_len: int, chunks: int, tokens: int,
+                  cache_hit_len: int, wall_s: float | None,
+                  latency_s: float | None = None) -> dict:
+    """One completed prompt prefill (``serving/engine.py`` chunked path):
+    ``chunks`` program invocations covered ``tokens`` prompt positions
+    (``cache_hit_len`` more came free from the prefix cache; a full hit is
+    ``chunks == 0``). ``wall_s`` is the host wall spent in THIS prompt's chunk
+    programs — so ``tokens_per_s`` is true prefill throughput, not deflated by
+    queueing; ``latency_s`` is admission to decode-ready (includes waiting
+    behind other prompts under the chunk budget)."""
+    return {
+        "event": "prefill",
+        "request_id": int(request_id),
+        "prompt_len": int(prompt_len),
+        "chunks": int(chunks),
+        "tokens": int(tokens),
+        "cache_hit_len": int(cache_hit_len),
+        "wall_s": _finite(wall_s),
+        "latency_s": _finite(latency_s),
+        "tokens_per_s": _finite(tokens / wall_s if tokens and wall_s else None),
+    }
+
+
 def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int,
                         wall_s: float | None, steps: int | None = None,
                         slot_occupancy: float | None = None,
+                        prefill_tokens: int | None = None,
+                        prefill_chunks: int | None = None,
+                        prefill_wall_s: float | None = None,
+                        prefix_cache: dict | None = None,
                         ttft_s=(), tpot_s=(), e2e_s=(), queue_wait_s=()) -> dict:
     """The once-per-run serving aggregate, emitted at drain: counts, aggregate
     tokens/s over the server's whole wall clock, slot occupancy, and p50/p95/p99
@@ -508,6 +535,15 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
                                 if new_tokens and wall_s else None),
         "steps": int(steps) if steps is not None else None,
         "slot_occupancy": _finite(slot_occupancy),
+        "prefill_tokens": int(prefill_tokens) if prefill_tokens is not None
+        else None,
+        "prefill_chunks": int(prefill_chunks) if prefill_chunks is not None
+        else None,
+        "prefill_wall_s": _finite(prefill_wall_s),
+        "prefill_tokens_per_s": _finite(
+            prefill_tokens / prefill_wall_s
+            if prefill_tokens and prefill_wall_s else None),
+        "prefix_cache": prefix_cache,
         "ttft_s": percentiles(ttft_s),
         "tpot_s": percentiles(tpot_s),
         "e2e_s": percentiles(e2e_s),

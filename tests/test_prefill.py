@@ -1,0 +1,357 @@
+"""Chunked batched prefill + prefix KV reuse: the serving admission fast path.
+
+The contracts pinned here (tier-1, tiny models, deterministic seeds):
+
+1. **Token identity** — chunked prefill (and the prefix-cache hit path on top of
+   it) is a SCHEDULE change, not a math change: the engine's output is
+   token-identical to sequential ``models.lm.generate`` and to the legacy
+   prefill-as-decode path, across MHA/GQA/windowed/RoPE configs, mixed prompt
+   lengths, recycled slots, and repeated prompts.
+2. **Bounded compiles** — a length-P prompt prefills in ``ceil(P / chunk)``
+   program invocations for a single configured chunk size; each size in the
+   chunk set traces AT MOST once regardless of the prompt mix
+   (``prefill_trace_counts``), the decode program still traces exactly once,
+   and batched multi-request admission is one scatter program.
+3. **Lifecycle** — mid-prefill ``expire`` frees the slot with the partial
+   teacher-forced prompt as its stream; prefix-cache hit/miss/eviction behave
+   as an LRU keyed by longest common token prefix.
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.models import lm
+from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+    ContinuousBatchingEngine,
+    PrefixCache,
+    Request,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.metrics import (
+    load_metrics_jsonl,
+)
+
+_REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+
+SMALL = dict(vocab_size=9, seq_len=16, embed_dim=32, num_layers=2, num_heads=4)
+
+
+def _model(**kw):
+    return lm.TransformerLM(**{**SMALL, **kw})
+
+
+def _params(model, seed=0):
+    ids = jnp.zeros((1, model.seq_len), jnp.int32)
+    return model.init({"params": jax.random.PRNGKey(seed)}, ids)["params"]
+
+
+def _mixed_requests(model, n, seed=0):
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        plen = int(rng.integers(0, model.seq_len - 1))
+        reqs.append(Request(
+            prompt=rng.integers(0, model.vocab_size - 1,
+                                size=plen).astype(np.int32),
+            max_new_tokens=int(rng.integers(1, model.seq_len)),
+            request_id=i))
+    return reqs
+
+
+def _sequential_reference(model, params, req):
+    p = len(req.prompt)
+    total = min(p + req.max_new_tokens, model.seq_len)
+    padded = np.zeros((1, model.seq_len), np.int32)
+    padded[0, :p] = req.prompt
+    out = lm.generate(model, params, jax.random.PRNGKey(0), batch=1,
+                      temperature=0.0, prompt=jnp.asarray(padded), prompt_len=p)
+    return np.asarray(out)[0, :total]
+
+
+# -----------------------------------------------------------------------------------------
+# Token identity across model variants (chunked prefill + prefix reuse on)
+# -----------------------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cfg", [
+    dict(),                                  # MHA
+    dict(num_kv_heads=2),                    # GQA (smaller K/V planes)
+    dict(attention_window=5),                # sliding-window prefill mask
+    dict(rope=True),                         # per-position rotary in the chunk
+], ids=["mha", "gqa", "window", "rope"])
+def test_chunked_prefill_token_identity_with_generate(cfg):
+    """Acceptance: chunked prefill + prefix KV reuse through recycled slots is
+    token-identical to sequential ``generate`` — and every chunk size compiled
+    at most once, with the decode program still compiling exactly once."""
+    model = _model(**cfg)
+    params = _params(model)
+    reqs = _mixed_requests(model, 6, seed=7)
+    # Repeat request 0's prompt verbatim -> the second pass is a full prefix hit.
+    reqs.append(Request(prompt=reqs[0].prompt, max_new_tokens=4, request_id=6))
+    engine = ContinuousBatchingEngine(
+        model, params, num_slots=2, prefill_chunk_sizes=(4, 8),
+        prefix_cache_entries=4)
+    comps = {c.request.request_id: c for c in engine.run(reqs)}
+    assert engine.trace_count == 1
+    assert engine.admit_trace_count == 1
+    assert all(n == 1 for n in engine.prefill_trace_counts.values())
+    assert set(engine.prefill_trace_counts) <= {4, 8}
+    for req in reqs:
+        ref = _sequential_reference(model, params, req)
+        np.testing.assert_array_equal(comps[req.request_id].tokens, ref)
+        np.testing.assert_array_equal(
+            comps[req.request_id].tokens[:len(req.prompt)], req.prompt)
+
+
+def test_chunked_matches_legacy_prefill_as_decode():
+    """The A/B pin: prefill on vs off emit byte-identical streams."""
+    model = _model()
+    params = _params(model)
+    reqs = _mixed_requests(model, 6, seed=11)
+    on = ContinuousBatchingEngine(model, params, num_slots=3,
+                                  prefill_chunk_sizes=(4,))
+    off = ContinuousBatchingEngine(model, params, num_slots=3,
+                                   prefill_chunk_sizes=())
+    got_on = {c.request.request_id: c.tokens for c in on.run(list(reqs))}
+    got_off = {c.request.request_id: c.tokens for c in off.run(list(reqs))}
+    assert off.prefill_invocations == 0 and on.prefill_invocations > 0
+    for rid in got_off:
+        np.testing.assert_array_equal(got_on[rid], got_off[rid])
+
+
+# -----------------------------------------------------------------------------------------
+# Invocation counts: ceil(P/chunk), greedy multi-size plans
+# -----------------------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("p_len,chunk", [(12, 4), (13, 4), (15, 8), (1, 4)])
+def test_prefill_invocation_count_is_ceil(p_len, chunk):
+    model = _model()
+    params = _params(model)
+    engine = ContinuousBatchingEngine(model, params, num_slots=1,
+                                      prefill_chunk_sizes=(chunk,))
+    prompt = np.arange(p_len, dtype=np.int32) % (model.vocab_size - 1)
+    comps = engine.run([Request(prompt=prompt, max_new_tokens=2)])
+    assert comps[0].ok
+    assert engine.prefill_invocations == -(-p_len // chunk)
+    assert engine.prefill_tokens == p_len
+    # The decode loop only ran the generated suffix: total decode steps == new
+    # tokens, not prompt_len + new (that was the prefill-as-decode tax).
+    assert engine.steps == comps[0].new_tokens
+
+
+def test_plan_prefill_greedy_and_padded_tail():
+    model = _model()
+    engine = ContinuousBatchingEngine(model, _params(model), num_slots=1,
+                                      prefill_chunk_sizes=(4, 8))
+    assert engine.plan_prefill(0, 15) == [(0, 8, 8), (8, 4, 4), (12, 3, 4)]
+    assert engine.plan_prefill(5, 9) == [(5, 4, 4)]
+    assert engine.plan_prefill(0, 3) == [(0, 3, 4)]     # padded, writes dropped
+    assert engine.plan_prefill(7, 7) == []
+    # Clipping: sizes larger than seq_len collapse onto seq_len.
+    clipped = ContinuousBatchingEngine(model, _params(model), num_slots=1,
+                                       prefill_chunk_sizes=(32, 128, 512))
+    assert clipped.prefill_chunk_sizes == (16,)
+
+
+def test_prefill_interleaves_with_decode_under_chunk_budget():
+    """A long prompt admitted next to an active decode never stalls it: each
+    engine step runs at most ``prefill_chunk_budget`` chunks AND the decode
+    step, so the decoding slot advances one token per step throughout."""
+    model = _model()
+    params = _params(model)
+    engine = ContinuousBatchingEngine(model, params, num_slots=2,
+                                      prefill_chunk_sizes=(2,),
+                                      prefill_chunk_budget=1)
+    engine.admit(0, Request(prompt=np.zeros(0, np.int32), max_new_tokens=10,
+                            request_id=0))
+    engine.admit(1, Request(prompt=np.ones(8, np.int32), max_new_tokens=2,
+                            request_id=1))
+    assert engine.num_prefilling == 1
+    for i in range(4):                      # 4 chunks of 2 cover the 8-prompt
+        engine.step()
+    assert engine.num_prefilling == 0
+    assert engine.steps == 4                # decode never skipped a beat
+    comps = {c.request.request_id: c for c in engine.run([])}
+    for rid, req in ((0, None), (1, None)):
+        assert comps[rid].ok
+
+
+# -----------------------------------------------------------------------------------------
+# Mid-prefill expire + slot recycling
+# -----------------------------------------------------------------------------------------
+
+
+def test_mid_prefill_expire_frees_slot_with_partial_prompt():
+    model = _model()
+    params = _params(model)
+    engine = ContinuousBatchingEngine(model, params, num_slots=1,
+                                      prefill_chunk_sizes=(4,))
+    req = Request(prompt=np.arange(12, dtype=np.int32) % 8, max_new_tokens=3,
+                  request_id=0, deadline_s=1e9)
+    engine.admit(0, req)
+    engine.step()                           # one 4-token chunk lands
+    assert engine.num_prefilling == 1
+    [comp] = engine.expire(now=2e9)
+    assert comp.finish == "timeout" and comp.new_tokens == 0
+    np.testing.assert_array_equal(comp.tokens, req.prompt[:4])
+    assert engine.num_prefilling == 0 and engine.free_slots() == [0]
+    # The recycled slot serves the next request bit-identically to a fresh one.
+    follow = Request(prompt=np.asarray([3, 1, 4], np.int32), max_new_tokens=5,
+                     request_id=1)
+    got = engine.run([follow])[0]
+    np.testing.assert_array_equal(
+        got.tokens, _sequential_reference(model, params, follow))
+
+
+# -----------------------------------------------------------------------------------------
+# Prefix cache: hit / partial hit / miss / eviction
+# -----------------------------------------------------------------------------------------
+
+
+def test_prefix_cache_unit_lru_and_longest_prefix():
+    cache = PrefixCache(capacity=2)
+    a = np.asarray([1, 2, 3, 4], np.int32)
+    cache.insert(a, {"planes": "A"})
+    hit, planes = cache.lookup(np.asarray([1, 2, 3, 4, 5, 6], np.int32))
+    assert hit == 4 and planes == {"planes": "A"}
+    hit, _ = cache.lookup(np.asarray([1, 2, 9], np.int32))
+    assert hit == 2                               # partial common prefix
+    assert cache.lookup(np.asarray([7, 8], np.int32)) == (0, None)
+    # Insertion covering an existing entry replaces it (same token prefix).
+    cache.insert(np.asarray([1, 2, 3, 4, 5], np.int32), {"planes": "A+"})
+    assert len(cache) == 1
+    cache.insert(np.asarray([9, 9], np.int32), {"planes": "B"})
+    cache.insert(np.asarray([8, 8], np.int32), {"planes": "C"})  # evicts LRU
+    assert len(cache) == 2 and cache.evictions == 1
+    assert cache.lookup(np.asarray([1, 2, 3], np.int32)) == (0, None)  # evicted
+    with pytest.raises(ValueError, match="capacity"):
+        PrefixCache(0)
+
+
+def test_engine_prefix_hit_partial_hit_and_eviction():
+    model = _model()
+    params = _params(model)
+    engine = ContinuousBatchingEngine(model, params, num_slots=1,
+                                      prefill_chunk_sizes=(4,),
+                                      prefix_cache_entries=1)
+    base = np.asarray([1, 2, 3, 4, 5, 6, 7, 0], np.int32)
+    r0 = Request(prompt=base, max_new_tokens=3, request_id=0)
+    r1 = Request(prompt=base, max_new_tokens=3, request_id=1)       # full hit
+    ext = np.concatenate([base, np.asarray([2, 4], np.int32)])
+    r2 = Request(prompt=ext, max_new_tokens=3, request_id=2)        # partial hit
+    other = np.asarray([5, 5, 5, 5], np.int32)
+    r3 = Request(prompt=other, max_new_tokens=3, request_id=3)      # miss+evict
+    r4 = Request(prompt=base, max_new_tokens=3, request_id=4)       # miss again
+    comps = {c.request.request_id: c for c in engine.run([r0, r1, r2, r3, r4])}
+    recs = {r["request_id"]: r for r in engine.take_prefill_records()}
+    assert recs[0]["cache_hit_len"] == 0 and recs[0]["chunks"] == 2
+    assert recs[1]["cache_hit_len"] == 8 and recs[1]["chunks"] == 0
+    assert recs[2]["cache_hit_len"] == 8 and recs[2]["tokens"] == 2
+    assert recs[3]["cache_hit_len"] == 0
+    assert recs[4]["cache_hit_len"] == 0          # r0's entry was evicted by r3
+    assert engine.prefix_cache.evictions >= 1
+    for req in (r0, r1, r2, r3, r4):
+        np.testing.assert_array_equal(
+            comps[req.request_id].tokens,
+            _sequential_reference(model, params, req))
+
+
+def test_prefix_cache_requires_prefill_path():
+    model = _model()
+    with pytest.raises(ValueError, match="prefix cache"):
+        ContinuousBatchingEngine(model, _params(model), num_slots=1,
+                                 prefill_chunk_sizes=(),
+                                 prefix_cache_entries=2)
+
+
+# -----------------------------------------------------------------------------------------
+# Batched admission: one scatter program for any admission count
+# -----------------------------------------------------------------------------------------
+
+
+def test_admit_many_single_scatter_program_and_occupancy_checks():
+    model = _model()
+    params = _params(model)
+    engine = ContinuousBatchingEngine(model, params, num_slots=4)
+    reqs = _mixed_requests(model, 4, seed=3)
+    engine.admit_many(list(zip([0, 1, 2], reqs[:3])))
+    assert engine.admit_trace_count == 1
+    engine.admit_many([(3, reqs[3])])             # different count, same program
+    assert engine.admit_trace_count == 1
+    with pytest.raises(ValueError, match="occupied"):
+        engine.admit_many([(0, _mixed_requests(model, 1, seed=9)[0])])
+    comps = engine.run([])
+    assert len(comps) == 4 and all(c.ok for c in comps)
+    for req in reqs:
+        got = next(c for c in comps if c.request.request_id == req.request_id)
+        np.testing.assert_array_equal(
+            got.tokens, _sequential_reference(model, params, req))
+
+
+# -----------------------------------------------------------------------------------------
+# Telemetry + loadgen: the long-prompt benchmark path end to end
+# -----------------------------------------------------------------------------------------
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadgen_long_prompt_dist_with_prefix_cache(tmp_path, capsys):
+    """Acceptance walkthrough: a long-prompt loadgen run with prefill + prefix
+    cache emits "prefill" telemetry the report CLI renders, prints prefill-token
+    throughput, and writes the summary-JSON artifact with TTFT percentiles."""
+    loadgen = _load_tool("serve_loadgen")
+    report = _load_tool("telemetry_report")
+    path = str(tmp_path / "serve.jsonl")
+    summary = str(tmp_path / "summary.json")
+    rc = loadgen.main([
+        "--requests", "6", "--mode", "closed", "--concurrency", "2",
+        "--num-slots", "2", "--seq-len", "16", "--embed-dim", "16",
+        "--num-layers", "1", "--num-heads", "2", "--num-levels", "8",
+        "--max-new-tokens", "4", "--seed", "0",
+        "--prompt-dist", "long", "--shared-prefix-len", "6",
+        "--prefill-chunks", "4", "--prefix-cache", "4",
+        "--telemetry", path, "--summary-json", summary])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "6 completed (6 ok" in out and "decode compilations 1" in out
+    assert "prefilled" in out and "prefix hits" in out
+    rows = load_metrics_jsonl(path)
+    prefill = [r for r in rows if r["event"] == "prefill"]
+    assert len(prefill) == 6
+    assert all(r["chunks"] >= 0 and r["prompt_len"] >= 8 for r in prefill)
+    assert any(r["cache_hit_len"] > 0 for r in prefill)
+    smry = [r for r in rows if r["event"] == "serve_summary"][0]
+    assert smry["prefill_tokens"] > 0 and smry["prefix_cache"]["queries"] == 6
+    doc = json.load(open(summary))
+    assert doc["prefill_chunk_sizes"] == [4]
+    assert doc["prefill_tokens"] > 0 and doc["ttft_s"]["p50"] >= 0
+    assert doc["prefill_compilations"] == {"4": 1}
+    rc = report.main([path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "prefill:" in out and "prefix hits" in out
+
+
+def test_loadgen_legacy_prefill_off_still_runs(tmp_path, capsys):
+    loadgen = _load_tool("serve_loadgen")
+    rc = loadgen.main([
+        "--requests", "4", "--mode", "closed", "--concurrency", "2",
+        "--num-slots", "2", "--seq-len", "16", "--embed-dim", "16",
+        "--num-layers", "1", "--num-heads", "2", "--num-levels", "8",
+        "--max-new-tokens", "4", "--seed", "0", "--prompt-lens", "0,6,10",
+        "--prefill-chunks", ""])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "prefilled 0 prompt tokens in 0 chunks" in out

@@ -18,6 +18,13 @@ the residual is the DEVICE's per-op launch floor at a model size whose math is
 microseconds — an op-count problem (fusing the step), not a bandwidth or tunnel
 problem. The committed artifact makes that attribution explicit.
 
+``--ttft-curve`` adds the serving-side decomposition this tool exists to make
+explicit post-prefill: the TTFT-vs-prompt-length curve of the continuous-batching
+engine with chunked batched prefill ON vs OFF (prefill-as-decode), plus the
+prefill-vs-decode wall-clock split of the ON path. Off pays P sequential decode
+invocations before the first generated token; on pays ``ceil(P/chunk)`` wide
+forwards — the curve is the before/after record of that schedule change.
+
 Usage: ``python tools/bench_decode_analysis.py [--d-model 256 ...]`` — ONE JSON
 line; CPU-drivable at tiny shapes (the op count is platform-specific, so the
 committed artifact must come from a TPU run).
@@ -36,6 +43,72 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def ttft_curve(model, params, args) -> list[dict]:
+    """TTFT vs prompt length, chunked prefill ON vs OFF, one row per length.
+
+    Each mode reuses ONE engine across the whole curve (slot recycling), with a
+    max-length warmup request first, so every chunk size and the decode program
+    are compiled before anything is timed — the curve measures the schedule, not
+    XLA. The ON rows also split the request wall into prefill (chunk programs)
+    vs decode (token steps)."""
+    import time as _time
+
+    import numpy as np
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        ContinuousBatchingEngine, Request,
+    )
+
+    lens = [int(x) for x in args.curve_prompt_lens.split(",") if x]
+    lens = [l for l in lens if 0 < l < args.seq] or [args.seq // 2]
+    chunks = tuple(int(x) for x in args.curve_chunks.split(",") if x)
+    rng = np.random.default_rng(0)
+    prompts = {p_len: rng.integers(0, args.vocab, size=p_len).astype(np.int32)
+               for p_len in lens}
+    warm = rng.integers(0, args.vocab, size=max(lens)).astype(np.int32)
+
+    def measure(chunk_sizes):
+        eng = ContinuousBatchingEngine(model, params, num_slots=1,
+                                       prefill_chunk_sizes=chunk_sizes)
+        # Warm ONE request per configured size (a length-c prompt plans as
+        # exactly one c-chunk) plus a full-length one — a single max-length
+        # warmup would never compile the sizes its greedy plan skips, and the
+        # first short measured row would then time XLA instead of the schedule.
+        for c in eng.prefill_chunk_sizes:
+            eng.run([Request(prompt=warm[:min(c, args.seq - 1)],
+                             max_new_tokens=1)])
+        eng.run([Request(prompt=warm, max_new_tokens=2)])
+        eng.reset_stats()
+        rows = {}
+        for p_len in lens:
+            pre0, inv0 = eng.prefill_wall_s, eng.prefill_invocations
+            t0 = _time.monotonic()
+            comp = eng.run([Request(prompt=prompts[p_len],
+                                    max_new_tokens=args.curve_new_tokens)])[0]
+            wall = _time.monotonic() - t0
+            prefill_s = eng.prefill_wall_s - pre0
+            rows[p_len] = {
+                "ttft_s": comp.ttft_s, "wall_s": wall,
+                "prefill_wall_s": prefill_s,
+                "decode_wall_s": wall - prefill_s,
+                "prefill_invocations": eng.prefill_invocations - inv0,
+            }
+        return rows
+
+    on, off = measure(chunks), measure(())
+    return [{
+        "prompt_len": p_len,
+        "ttft_prefill_s": on[p_len]["ttft_s"],
+        "ttft_decode_s": off[p_len]["ttft_s"],
+        "ttft_speedup": (off[p_len]["ttft_s"] / on[p_len]["ttft_s"]
+                         if on[p_len]["ttft_s"] else None),
+        "prefill_invocations": on[p_len]["prefill_invocations"],
+        "on_prefill_wall_s": on[p_len]["prefill_wall_s"],
+        "on_decode_wall_s": on[p_len]["decode_wall_s"],
+        "off_wall_s": off[p_len]["wall_s"],
+    } for p_len in lens]
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--vocab", type=int, default=16)
@@ -45,6 +118,15 @@ def main() -> int:
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--bf16", action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--ttft-curve", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="add the serving TTFT-vs-prompt-length curve, chunked "
+                        "prefill on vs off, with the prefill/decode wall split")
+    p.add_argument("--curve-prompt-lens", default="64,256,512,768",
+                   help="prompt lengths for --ttft-curve (clipped to < --seq)")
+    p.add_argument("--curve-chunks", default="32,128,512",
+                   help="prefill chunk-size set for the ON side of the curve")
+    p.add_argument("--curve-new-tokens", type=int, default=8)
     p.add_argument("--out", default=None)
     args = p.parse_args()
 
@@ -144,6 +226,8 @@ def main() -> int:
                         "floor; the tunnel's ~70 ms host tax is cancelled by the "
                         "chained two-point protocol"),
     }
+    if args.ttft_curve:
+        doc["ttft_curve"] = ttft_curve(model, params, args)
     print(json.dumps(doc))
     if args.out:
         with open(args.out, "w") as f:

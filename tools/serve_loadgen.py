@@ -14,9 +14,20 @@ with a reproducible synthetic workload and leaves a serve-telemetry JSONL behind
 
 The prompt/length mix is sampled per request from ``--prompt-lens`` and
 ``[1, --max-new-tokens]`` under a seeded RNG, so an A-vs-B pair of runs offers
-byte-identical workloads. Params come from a training checkpoint
-(``--checkpoint results/model_lm.ckpt`` — either a full TrainState or a
-params-only export) or a seeded random init when omitted (pure perf mode).
+byte-identical workloads. ``--prompt-dist long`` swaps in a long-prompt mixture
+(half to three-quarters of ``seq_len``) that actually exercises the chunked
+prefill path, and ``--shared-prefix-len N`` gives every prompt the same first
+``N`` tokens (the system-prompt pattern the prefix KV cache exists for). Params
+come from a training checkpoint (``--checkpoint results/model_lm.ckpt`` — either
+a full TrainState or a params-only export) or a seeded random init when omitted
+(pure perf mode).
+
+Prefill knobs mirror the engine's: ``--prefill-chunks 32,128,512`` (empty string
+= legacy prefill-as-decode — the A/B switch), ``--prefill-budget`` chunks per
+engine step, ``--prefix-cache N`` LRU entries. The run summary reports prefill
+token throughput and prefix-cache hits alongside decode tokens/s, and
+``--summary-json PATH`` writes the whole summary (TTFT/e2e percentiles included)
+as one JSON document for committed A-vs-B artifacts.
 
 Usage::
 
@@ -24,6 +35,8 @@ Usage::
         --num-slots 8 --telemetry results/serve.jsonl
     python tools/serve_loadgen.py --requests 32 --mode closed --concurrency 8 \\
         --checkpoint results/model_lm.ckpt --telemetry results/serve.jsonl
+    python tools/serve_loadgen.py --prompt-dist long --prefix-cache 8 \\
+        --shared-prefix-len 256 --summary-json results/prefill_on.json
     python tools/telemetry_report.py results/serve.jsonl
 """
 
@@ -74,23 +87,43 @@ def build_model_and_params(args):
     return model, checkpoint.load_params(args.checkpoint, jax.device_get(ref))
 
 
+def prompt_len_mix(args) -> list[int]:
+    """The prompt-length mixture: ``--prompt-lens`` verbatim, or the ``long``
+    preset — seq_len/2 .. 3·seq_len/4, the prompt-heavy regime where TTFT is
+    dominated by prefill (the benchmark the chunked-prefill path exists for)."""
+    if args.prompt_dist == "long":
+        s = args.seq_len
+        lens = sorted({max(1, s // 2), max(1, (5 * s) // 8),
+                       max(1, min(s - 2, (3 * s) // 4))})
+    else:
+        lens = [int(x) for x in args.prompt_lens.split(",") if x != ""]
+    bad = [l for l in lens if not 0 <= l < args.seq_len]
+    if bad:
+        raise SystemExit(f"prompt lengths outside [0, seq_len): {bad}")
+    return lens
+
+
 def make_workload(args, vocab_size):
-    """The seeded request mix: ``[(prompt, max_new, sampling), ...]``."""
+    """The seeded request mix: ``[(prompt, max_new, sampling), ...]``.
+    ``--shared-prefix-len N`` forces one common first-N-token prefix across all
+    prompts (truncated for shorter ones) so repeated-prefix reuse is testable."""
     from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
         SamplingParams,
     )
 
     rng = np.random.default_rng(args.seed)
-    lens = [int(x) for x in args.prompt_lens.split(",") if x != ""]
-    bad = [l for l in lens if not 0 <= l < args.seq_len]
-    if bad:
-        raise SystemExit(f"--prompt-lens entries outside [0, seq_len): {bad}")
+    lens = prompt_len_mix(args)
+    shared = rng.integers(0, vocab_size - 1,
+                          size=max(args.shared_prefix_len, 0)).astype(np.int32)
     sampling = SamplingParams(temperature=args.temperature, top_k=args.top_k,
                               top_p=args.top_p)
     specs = []
     for _ in range(args.requests):
         p = int(rng.choice(lens))
         prompt = rng.integers(0, vocab_size - 1, size=p).astype(np.int32)
+        k = min(len(shared), p)
+        if k:
+            prompt[:k] = shared[:k]
         new = int(rng.integers(1, args.max_new_tokens + 1))
         specs.append((prompt, new, sampling))
     return specs
@@ -172,6 +205,19 @@ def main(argv: list[str] | None = None) -> int:
     e.add_argument("--max-pending", type=int, default=128)
     e.add_argument("--timeout-s", type=float, default=0.0,
                    help="per-request deadline, 0 = none")
+    e.add_argument("--prefill-chunks", default="32,128,512",
+                   help="static chunk-size set for batched prefill; empty = "
+                        "legacy prefill-as-decode (the A/B switch)")
+    e.add_argument("--prefill-budget", type=int, default=1,
+                   help="prefill chunk invocations per engine step (decode "
+                        "interleaving)")
+    e.add_argument("--prefix-cache", type=int, default=0,
+                   help="prefix KV cache LRU entries, 0 = off")
+    e.add_argument("--warmup", type=int, default=1,
+                   help="pre-measurement warmup rounds: compile the decode, "
+                        "every prefill chunk size, and the prefix-cache install "
+                        "path, then reset the engine's counters — so latency "
+                        "percentiles measure the schedule, not XLA (0 = off)")
     g = p.add_argument_group("load")
     g.add_argument("--mode", choices=("open", "closed"), default="open")
     g.add_argument("--rate", type=float, default=8.0,
@@ -179,8 +225,14 @@ def main(argv: list[str] | None = None) -> int:
     g.add_argument("--concurrency", type=int, default=4,
                    help="closed loop: clients with one request in flight each")
     g.add_argument("--requests", type=int, default=32)
+    g.add_argument("--prompt-dist", choices=("custom", "long"), default="custom",
+                   help="'long' = prompt-heavy mixture (seq_len/2..3/4) that "
+                        "exercises prefill; 'custom' uses --prompt-lens")
     g.add_argument("--prompt-lens", default="0,16,64",
                    help="comma list; each request draws uniformly from it")
+    g.add_argument("--shared-prefix-len", type=int, default=0,
+                   help="force a common first-N-token prefix across prompts "
+                        "(exercises the prefix KV cache)")
     g.add_argument("--max-new-tokens", type=int, default=32,
                    help="each request draws its length from [1, this]")
     g.add_argument("--temperature", type=float, default=0.0)
@@ -189,6 +241,9 @@ def main(argv: list[str] | None = None) -> int:
     g.add_argument("--seed", type=int, default=0)
     p.add_argument("--telemetry", default="",
                    help="serve JSONL path (render with tools/telemetry_report.py)")
+    p.add_argument("--summary-json", default="",
+                   help="write the run summary (percentiles + prefill stats) "
+                        "as one JSON document — the committed-artifact format")
     args = p.parse_args(argv)
     if args.mode == "open" and args.rate <= 0:
         raise SystemExit("--rate must be > 0 in open-loop mode")
@@ -199,13 +254,35 @@ def main(argv: list[str] | None = None) -> int:
 
     from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
         ContinuousBatchingEngine,
+        Request,
         Server,
     )
 
     model, params = build_model_and_params(args)
     specs = make_workload(args, model.vocab_size)
+    chunk_sizes = tuple(int(x) for x in args.prefill_chunks.split(",") if x)
     engine = ContinuousBatchingEngine(model, params, num_slots=args.num_slots,
-                                      seed=args.seed)
+                                      seed=args.seed,
+                                      prefill_chunk_sizes=chunk_sizes,
+                                      prefill_chunk_budget=args.prefill_budget,
+                                      prefix_cache_entries=args.prefix_cache)
+    if args.warmup:
+        warm_rng = np.random.default_rng(args.seed + 17)
+        for _ in range(args.warmup):
+            # One request per chunk size (each plan = exactly that size), one
+            # prompt-less decode, and a repeated prompt when the prefix cache is
+            # on (compiles the hit-install path). reset_stats() wipes the
+            # ledger — including warmup prefix entries — before measurement.
+            for size in engine.prefill_chunk_sizes:
+                wp = warm_rng.integers(
+                    0, model.vocab_size - 1,
+                    size=min(size, args.seq_len - 1)).astype(np.int32)
+                engine.run([Request(prompt=wp, max_new_tokens=1)])
+                if engine.prefix_cache is not None:
+                    engine.run([Request(prompt=wp, max_new_tokens=1)])
+            engine.run([Request(prompt=np.zeros(0, np.int32),
+                                max_new_tokens=2)])
+        engine.reset_stats()
     server = Server(engine, max_pending=args.max_pending,
                     default_timeout_s=args.timeout_s or None,
                     telemetry=args.telemetry)
@@ -229,9 +306,52 @@ def main(argv: list[str] | None = None) -> int:
     print(f"generated {new_tokens} tokens, {new_tokens / wall:.1f} tokens/s, "
           f"slot occupancy {'-' if occ is None else f'{occ:.2f}'}, "
           f"decode compilations {engine.trace_count}")
+    prefill_rate = (engine.prefill_tokens / engine.prefill_wall_s
+                    if engine.prefill_wall_s else None)
+    hits = engine.prefix_cache.stats() if engine.prefix_cache else None
+    print(f"prefilled {engine.prefill_tokens} prompt tokens in "
+          f"{engine.prefill_invocations} chunks "
+          f"({'-' if prefill_rate is None else f'{prefill_rate:.1f}'} tokens/s, "
+          f"sizes {list(engine.prefill_chunk_sizes) or 'off'})"
+          + (f", prefix hits {hits['hits']}/{hits['queries']} "
+             f"({hits['hit_tokens']} tokens reused)" if hits else ""))
     if args.telemetry:
         print(f"serve telemetry -> {args.telemetry} "
               f"(render: python tools/telemetry_report.py {args.telemetry})")
+    if args.summary_json:
+        import json
+
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils.telemetry import (
+            percentiles,
+        )
+
+        doc = {
+            "mode": args.mode,
+            "requests": len(comps), "ok": ok, "timeout": timeouts,
+            "rejected": rejected, "wall_s": wall,
+            "prompt_dist": args.prompt_dist,
+            "prompt_lens": prompt_len_mix(args),
+            "shared_prefix_len": args.shared_prefix_len,
+            "num_slots": args.num_slots,
+            "prefill_chunk_sizes": list(engine.prefill_chunk_sizes),
+            "prefill_chunk_budget": args.prefill_budget,
+            "prefix_cache_entries": args.prefix_cache,
+            "new_tokens": new_tokens,
+            "tokens_per_s": new_tokens / wall if wall else None,
+            "prefill_tokens": engine.prefill_tokens,
+            "prefill_chunks": engine.prefill_invocations,
+            "prefill_wall_s": engine.prefill_wall_s,
+            "prefill_tokens_per_s": prefill_rate,
+            "prefix_cache": hits,
+            "decode_compilations": engine.trace_count,
+            "prefill_compilations": dict(engine.prefill_trace_counts),
+            "ttft_s": percentiles([c.ttft_s for c in comps]),
+            "e2e_s": percentiles([c.e2e_s for c in comps]),
+            "queue_wait_s": percentiles([c.queue_wait_s for c in comps]),
+        }
+        with open(args.summary_json, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"summary json -> {args.summary_json}")
     return 0
 
 

@@ -3,8 +3,9 @@
 Input files are whatever the trainers' ``--telemetry PATH`` wrote (manifest /
 compile / epoch / health / mfu / checkpoint / preempt events), ``bench*.py
 --telemetry`` output (bench events), serving logs from ``serving/server.py`` /
-``tools/serve_loadgen.py`` (serve / serve_summary events — rendered as a
-TTFT/TPOT/e2e latency-percentile table plus aggregate tokens/s), supervisor logs
+``tools/serve_loadgen.py`` (serve / prefill / serve_summary events — rendered as
+a TTFT/TPOT/e2e latency-percentile table plus aggregate decode AND prefill
+tokens/s with prefix-cache hit rates), supervisor logs
 from ``tools/fleet_supervise.py`` (restart events — rendered as a restart count
 with reasons), or the loss-curve ``metrics.jsonl`` companions
 (``kind`` rows) — all read through the one shared reader,
@@ -132,12 +133,40 @@ def summarize(path: str) -> dict:
             pcts = _percentiles([r.get(name) for r in serves], qs=SERVE_QS) or {}
             for q in SERVE_QS:
                 s[f"serve_{name}_p{q}"] = pcts.get(f"p{q}")
+    # Chunked-prefill telemetry: per-prompt "prefill" events (chunks, tokens,
+    # cache_hit_len, wall_s) aggregated; the serve_summary's engine-level
+    # counters fill any gaps (e.g. a truncated per-event stream).
+    prefills = by_event.get("prefill", [])
+    if prefills:
+        s["prefill_prompts"] = len(prefills)
+        s["prefill_tokens"] = sum(r.get("tokens") or 0 for r in prefills)
+        s["prefill_chunks"] = sum(r.get("chunks") or 0 for r in prefills)
+        wall = sum(r.get("wall_s") or 0 for r in prefills)
+        s["prefill_tokens_per_s"] = (s["prefill_tokens"] / wall
+                                     if s["prefill_tokens"] and wall else None)
+        hits = [r for r in prefills if (r.get("cache_hit_len") or 0) > 0]
+        s["prefix_hits"] = len(hits)
+        s["prefix_hit_tokens"] = sum(r.get("cache_hit_len") or 0
+                                     for r in prefills)
+        s["prefix_hit_rate"] = len(hits) / len(prefills)
     if summary:
         s.setdefault("serve_requests", summary.get("requests"))
         s.setdefault("serve_ok", summary.get("ok"))
         s.setdefault("serve_timeout", summary.get("timeout"))
         s["serve_tokens_per_s"] = summary.get("tokens_per_s")
         s["serve_occupancy"] = summary.get("slot_occupancy")
+        # The drain-time summary is the ENGINE's ledger (it also counts prompts
+        # expired mid-prefill, which never emit a "prefill" event), so where it
+        # exists it OVERRIDES the per-event estimates — both sides of an A-vs-B
+        # row then use the same definitions (hit rate = hits / queries).
+        for key in ("prefill_tokens", "prefill_chunks", "prefill_tokens_per_s"):
+            if summary.get(key) is not None:
+                s[key] = summary[key]
+        pc = summary.get("prefix_cache") or {}
+        if pc.get("queries"):
+            s["prefix_hits"] = pc.get("hits")
+            s["prefix_hit_tokens"] = pc.get("hit_tokens")
+            s["prefix_hit_rate"] = pc["hits"] / pc["queries"]
         for name in SERVE_SERIES:          # summary percentiles fill any gaps
             pcts = summary.get(name) or {}
             for q in SERVE_QS:
@@ -232,6 +261,15 @@ def print_summary(s: dict) -> None:
         print(f"   serve: {s['serve_requests']} requests "
               f"({_fmt(s.get('serve_ok'))} ok, {_fmt(s.get('serve_timeout'))} "
               f"timeout)  tokens/s {_fmt(s.get('serve_tokens_per_s'))}{occ}")
+        if s.get("prefill_tokens") is not None:
+            hit = ""
+            if s.get("prefix_hit_rate") is not None:
+                hit = (f"  prefix hits {_fmt(s.get('prefix_hits'))} "
+                       f"(rate {_fmt(s['prefix_hit_rate'])}, "
+                       f"{_fmt(s.get('prefix_hit_tokens'))} tokens reused)")
+            print(f"   prefill: {_fmt(s['prefill_tokens'])} tokens in "
+                  f"{_fmt(s.get('prefill_chunks'))} chunks  "
+                  f"tokens/s {_fmt(s.get('prefill_tokens_per_s'))}{hit}")
         head = "   " + "".ljust(14) + "".join(f"p{q}".rjust(12) for q in SERVE_QS)
         print(head)
         for name in SERVE_SERIES:
@@ -254,6 +292,8 @@ COMPARE_ROWS = [
     ("ckpt_save_s", "ckpt_save_s"),
     ("restarts", "restarts"),
     ("serve tokens/s", "serve_tokens_per_s"),
+    ("prefill tok/s", "prefill_tokens_per_s"),
+    ("prefix hit rate", "prefix_hit_rate"),
     ("ttft_s p50", "serve_ttft_s_p50"),
     ("ttft_s p99", "serve_ttft_s_p99"),
     ("tpot_s p50", "serve_tpot_s_p50"),
