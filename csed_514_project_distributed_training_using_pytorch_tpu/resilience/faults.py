@@ -27,12 +27,25 @@ Kinds (all host-side — faults never touch the compiled program):
     checkpoint bytes are truncated to half on write (hooked into the checkpoint
     writer's ``_atomic_write``) — the torn-write artifact the manifest's checksum
     validation must refuse to resume from.
+``stall``
+    the ticking thread sleeps ``secs`` seconds (default 5) inside the tick — a
+    wedged host step. On the serve path (``serving/replica.py`` points the
+    engine's per-step hook here) this freezes a replica mid-decode without
+    killing it; combined with ``freeze`` it is the full "hung, not dead" replica
+    the router's heartbeat-staleness drain exists for.
+
+The serve path ticks too: a replica worker wires ``on_tick(step=engine.steps)``
+into the engine's per-step hook, so ``step=N`` on the serving side means "after N
+DECODE steps" — kill/preempt/stall a replica mid-decode, deterministically, with
+requests in flight. ``proc`` matches the replica index there (the router spawns
+each replica with ``JAX_PROCESS_ID`` = its replica id via
+``train.launch.Fleet(process_id_base=...)``).
 
 Trigger keys: ``proc`` (``JAX_PROCESS_ID`` to match; default: every process), ``step`` /
 ``epoch`` (tick-path kinds only — fire when the tick's value is >= the threshold;
 unset = immediately; rejected on ``torn``, whose write path has no tick to compare),
 ``match`` (path substring, ``torn`` only — required there), ``exit`` (``kill``'s exit
-code, default 41),
+code, default 41), ``secs`` (``stall``'s sleep, default 5),
 ``flag`` (a marker-file path: the fault fires at most ONCE per process — the marker is
 created on firing with a per-process suffix, so a restarted run that replays the same
 step does not re-fire; without ``flag`` the fault fires every time the trigger holds).
@@ -48,11 +61,13 @@ import functools
 import os
 import signal
 import sys
+import time
 
 ENV_VAR = "RESILIENCE_FAULTS"
 
-KINDS = ("kill", "preempt", "freeze", "torn")
+KINDS = ("kill", "preempt", "freeze", "torn", "stall")
 DEFAULT_KILL_EXIT = 41
+DEFAULT_STALL_SECS = 5.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,6 +79,7 @@ class Fault:
     flag: str = ""              # marker file: fire at most once per process
     exit: int = DEFAULT_KILL_EXIT
     match: str = ""             # path substring (torn)
+    secs: float = DEFAULT_STALL_SECS   # stall sleep length
 
 
 def active() -> bool:
@@ -87,6 +103,8 @@ def _parse(spec: str) -> tuple[Fault, ...]:
             key, _, value = kv.partition("=")
             if key in ("proc", "step", "epoch", "exit"):
                 kwargs[key] = int(value)
+            elif key == "secs":
+                kwargs[key] = float(value)
             elif key in ("flag", "match"):
                 kwargs[key] = value
             else:
@@ -155,6 +173,10 @@ def on_tick(*, step: int | None = None, epoch: int | None = None) -> None:
             print(f"[faults] preempt: SIGTERM to process {_proc_index()} "
                   f"at step {step}", file=sys.stderr, flush=True)
             os.kill(os.getpid(), signal.SIGTERM)
+        elif f.kind == "stall" and _claim_once(f):
+            print(f"[faults] stall: process {_proc_index()} sleeping "
+                  f"{f.secs:.1f}s at step {step}", file=sys.stderr, flush=True)
+            time.sleep(f.secs)
 
 
 def heartbeat_frozen(*, step: int | None = None, epoch: int | None = None) -> bool:

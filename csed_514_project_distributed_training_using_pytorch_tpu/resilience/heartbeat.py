@@ -93,10 +93,15 @@ def stale_processes(dir_path: str, *, num_processes: int, timeout_s: float,
     return stale
 
 
-def clear(dir_path: str) -> None:
-    """Drop every beat (and stray tmp) file — the supervisor calls this at attempt
-    start so a restarted fleet is judged only on its own signals."""
-    for path in glob.glob(os.path.join(dir_path, "heartbeat_p*.json*")):
+def clear(dir_path: str, process_index: int | None = None) -> None:
+    """Drop beat (and stray tmp) files — the supervisor calls this at attempt
+    start so a restarted fleet is judged only on its own signals.
+    ``process_index`` restricts the sweep to ONE process's files: the serving
+    router restarts replicas individually, and wiping a healthy peer's beat
+    would make it look newborn (or, worse, hung) to the next staleness check."""
+    pattern = (f"heartbeat_p{process_index}.json*" if process_index is not None
+               else "heartbeat_p*.json*")
+    for path in glob.glob(os.path.join(dir_path, pattern)):
         try:
             os.remove(path)
         except OSError:

@@ -36,8 +36,6 @@ CLI: ``tools/fleet_supervise.py``.
 from __future__ import annotations
 
 import dataclasses
-import json
-import os
 import signal
 import time
 
@@ -48,6 +46,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.resilience.preempti
     EXIT_PREEMPTED, PreemptionHandler,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.train.launch import Fleet
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
+    JsonlWriter,
+)
 
 #: SuperviseResult.exit_code when the fleet was torn down by the supervisor itself
 #: (hang / attempt timeout): 128+SIGTERM, the shell's convention for a terminated
@@ -89,28 +90,12 @@ class SuperviseResult:
     resume_history: list              # checkpoint path (or None) each attempt resumed from
 
 
-class _JsonlWriter:
-    """Append-per-emit JSONL, flushed per line — the supervisor's telemetry.
-
-    Not ``utils.telemetry.TelemetryWriter``: that writer's process-0 gate calls
-    ``jax.process_index()``, which would initialize a jax backend inside the
-    supervisor. Same line schema; the shared reader and report CLI consume both."""
-
-    def __init__(self, path: str):
-        self.path = path
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        # Append: a preempted run is re-run with the same command later, and its
-        # restart history must survive into the resumed run's report.
-        self._fh = open(path, "a")
-        self._t0 = time.time()
-
-    def emit(self, event: dict) -> None:
-        event.setdefault("t_s", round(time.time() - self._t0, 6))
-        self._fh.write(json.dumps(event) + "\n")
-        self._fh.flush()
-
-    def close(self) -> None:
-        self._fh.close()
+# The supervisor's telemetry writer is the shared jax-free JSONL appender —
+# NOT utils.telemetry.TelemetryWriter, whose process-0 gate calls
+# jax.process_index() and would initialize a jax backend inside the supervisor.
+# Same line schema; the shared reader and report CLI consume both. (The serving
+# router reuses the same writer for the same reason — utils/jsonl.py.)
+_JsonlWriter = JsonlWriter
 
 
 def _newest_valid(checkpoint_dir: str) -> str | None:

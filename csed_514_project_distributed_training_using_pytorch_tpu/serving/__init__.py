@@ -1,4 +1,4 @@
-"""Serving: slot-based continuous batching over the KV-cache decoder.
+"""Serving: slot-based continuous batching over the KV-cache decoder, fleet-scalable.
 
 The training side of this repo compiles ONE program per epoch and never retraces;
 this package applies the same fixed-shape discipline to inference (DESIGN.md §11):
@@ -12,40 +12,62 @@ this package applies the same fixed-shape discipline to inference (DESIGN.md §1
                    per-step chunk budget
 - ``prefix_cache`` host-side LRU of prefilled K/V planes keyed by prompt tokens —
                    repeated prompt prefixes (system prompts) skip prefill
-- ``scheduler``    thread-safe bounded request queue: backpressure
-                   (``QueueFull``), per-request deadlines enforced while queued
+- ``scheduler``    thread-safe bounded request queue (no jax work; home of the
+                   shared ``Request``/``SamplingParams`` types): backpressure
+                   (``QueueFull``), per-request deadlines enforced while queued,
+                   ``snapshot()`` health signal, front-of-queue ``requeue`` for
+                   the router's redispatch path
 - ``server``       the in-process front end: ``submit() -> Future``, a background
-                   decode loop, graceful drain on ``stop()``, and per-request
+                   decode loop, graceful drain on ``stop()`` (drain-timeout fails
+                   pending futures with ``ServerStopped``), and per-request
                    TTFT/TPOT/queue-wait telemetry (``"event": "serve"`` JSONL)
                    plus per-prompt ``"prefill"`` events
+- ``replica``      one engine+server behind a newline-JSON line protocol on a
+                   local TCP port — the process-per-replica worker the router
+                   spawns (``python -m ...serving.replica``)
+- ``router``       the fleet front door (never initializes a jax backend,
+                   DESIGN.md §15): shards traffic
+                   across N replica processes with prefix-affinity routing,
+                   per-replica admission backpressure, heartbeat/crash detection,
+                   at-least-once drain-and-redispatch, and bounded-backoff
+                   replica restart
 
-Load generator: ``tools/serve_loadgen.py``; report: ``tools/telemetry_report.py``.
+Load generator: ``tools/serve_loadgen.py`` (``--replicas N`` drives the router
+fleet, ``--scenario chat`` the multi-turn workload); report:
+``tools/telemetry_report.py``.
+
+Imports are lazy (PEP 562): ``from ...serving import Server`` works as before,
+but merely importing the package — which the backend-free router and scheduler
+modules trigger as their parent — never pulls in the jit-building engine.
 """
 
-from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
-    Completion,
-    ContinuousBatchingEngine,
-    Request,
-    SamplingParams,
-)
-from csed_514_project_distributed_training_using_pytorch_tpu.serving.prefix_cache import (
-    PrefixCache,
-)
-from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
-    QueueFull,
-    RequestQueue,
-)
-from csed_514_project_distributed_training_using_pytorch_tpu.serving.server import (
-    Server,
-)
+_EXPORTS = {
+    "Completion": "engine",
+    "ContinuousBatchingEngine": "engine",
+    "PrefixCache": "prefix_cache",
+    "QueueFull": "scheduler",
+    "Request": "scheduler",
+    "RequestQueue": "scheduler",
+    "Router": "router",
+    "RouterCompletion": "router",
+    "SamplingParams": "scheduler",
+    "Server": "server",
+    "ServerStopped": "scheduler",
+}
 
-__all__ = [
-    "Completion",
-    "ContinuousBatchingEngine",
-    "PrefixCache",
-    "QueueFull",
-    "Request",
-    "RequestQueue",
-    "SamplingParams",
-    "Server",
-]
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name not in _EXPORTS:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(f"{__name__}.{_EXPORTS[name]}")
+    value = getattr(mod, name)
+    globals()[name] = value          # cache: subsequent lookups skip __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -53,38 +53,13 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.prefix_cach
     PrefixCache,
 )
 
-
-@dataclasses.dataclass(frozen=True)
-class SamplingParams:
-    """Per-request decode policy. ``temperature <= 0`` decodes greedily; ``top_k = 0``
-    / ``top_p = 1.0`` disable those filters (``models.lm.filter_logits`` semantics,
-    applied after temperature scaling in the same compose order)."""
-
-    temperature: float = 0.0
-    top_k: int = 0
-    top_p: float = 1.0
-
-    def validate(self, vocab_size: int) -> None:
-        if not 0 <= self.top_k <= vocab_size:
-            raise ValueError(f"top_k {self.top_k} outside [0, {vocab_size}]")
-        if not 0.0 < self.top_p <= 1.0:
-            raise ValueError(f"top_p {self.top_p} outside (0, 1]")
-
-
-@dataclasses.dataclass
-class Request:
-    """One decode request. ``prompt`` is a ``[P]`` int32 slice of the TARGETS stream
-    (``generate``'s prompt convention: output positions ``0..P-1`` are forced to it,
-    its K/V populating the cache); ``max_new_tokens`` bounds the sampled suffix.
-    ``deadline_s``/``arrival_s`` are ``time.monotonic()`` stamps (absolute), set by
-    the server front end; both optional for direct engine use."""
-
-    prompt: np.ndarray
-    max_new_tokens: int
-    sampling: SamplingParams = SamplingParams()
-    request_id: int = 0
-    deadline_s: float | None = None
-    arrival_s: float | None = None
+# The shared request types live in the jax-free scheduler module (the fleet
+# router needs them without importing jax); re-exported here because the engine
+# is their historical home and every engine caller already imports them from it.
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (  # noqa: F401
+    Request,
+    SamplingParams,
+)
 
 
 @dataclasses.dataclass
@@ -172,6 +147,11 @@ class ContinuousBatchingEngine:
         self.model = model
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
         self.num_slots = int(num_slots)
+        # Host-side per-step hook, called with the running step count at the top
+        # of every step() — the serve path's resilience tick (a replica worker
+        # points it at resilience.faults.on_tick so kill/preempt faults fire
+        # mid-decode, deterministically). None = zero-cost.
+        self.on_step = None
         self.trace_count = 0          # traces of the decode program (tests pin == 1)
         self.steps = 0                # decode steps executed
         self.slot_steps = 0           # sum of occupied slots over steps (occupancy)
@@ -571,6 +551,8 @@ class ContinuousBatchingEngine:
         that finished. One host sync (the ``[num_slots]`` token fetch)."""
         if self.num_active == 0:
             return []
+        if self.on_step is not None:
+            self.on_step(self.steps)
         self._run_prefill()
         if not self._active.any():            # everything in flight is prefilling
             return []

@@ -32,6 +32,18 @@ import dataclasses
 import numpy as np
 
 
+def common_prefix_len(a: np.ndarray, b: np.ndarray) -> int:
+    """Length of the longest common token prefix — THE matching rule, shared
+    by this cache and the router's affinity index (one owner: the router's
+    'route to the replica whose cache holds it' guarantee only holds while
+    both sides match identically)."""
+    n = min(len(a), len(b))
+    if n == 0:
+        return 0
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    return int(neq[0]) if len(neq) else n
+
+
 @dataclasses.dataclass
 class PrefixEntry:
     """One stored prefill: the prompt tokens whose rows the planes hold, and the
@@ -61,13 +73,7 @@ class PrefixCache:
     def __len__(self) -> int:
         return len(self._entries)
 
-    @staticmethod
-    def _common_prefix(a: np.ndarray, b: np.ndarray) -> int:
-        n = min(len(a), len(b))
-        if n == 0:
-            return 0
-        neq = np.nonzero(a[:n] != b[:n])[0]
-        return int(neq[0]) if len(neq) else n
+    _common_prefix = staticmethod(common_prefix_len)
 
     def lookup(self, prompt: np.ndarray, *,
                min_len: int = 1) -> tuple[int, dict | None]:

@@ -1,0 +1,421 @@
+"""One serving replica behind a newline-JSON line protocol on a local TCP port.
+
+This is the process the fleet router (``serving/router.py``) spawns — one per
+replica, via ``train.launch.Fleet(num_processes=1, process_id_base=<replica>)``
+— and the serve-path analog of a supervised trainer process:
+
+- it runs the existing single-engine stack unchanged (``ContinuousBatchingEngine``
+  behind ``Server``): the router composes replicas, it never reimplements them;
+- it writes **heartbeats** (``resilience/heartbeat.py``, process index = replica
+  id) from a ticker thread, so the router can tell a hung replica from a busy
+  one the same way the training supervisor does;
+- it ticks **fault injection** (``resilience/faults.py``) from the engine's
+  per-step hook — ``kill``/``preempt``/``stall`` faults fire after N *decode
+  steps*, i.e. mid-decode with requests in flight, which is exactly the moment
+  at-least-once redispatch must survive;
+- it honors **preemption** (SIGTERM latch → exit 75, deliberately *without*
+  resolving in-flight work — those requests must look undelivered so the
+  router's exit-75 classification drains and redispatches them rather than
+  settling client-visible timeouts), surfacing as a classified exit, not a hang.
+
+Line protocol (one JSON object per line, both directions):
+
+====================  =============================================================
+router → replica
+--------------------  -------------------------------------------------------------
+``submit``            ``{"op", "id", "prompt", "max_new_tokens", "temperature",
+                      "top_k", "top_p", "timeout_s"}`` — enqueue one request
+``stats``             ``{"op", "id"}`` — request the engine/queue counters
+``stop``              graceful drain: finish accepted work, then exit 0
+--------------------  -------------------------------------------------------------
+replica → router
+--------------------  -------------------------------------------------------------
+``hello``             first line after accept: replica id + capacity
+                      (``num_slots``, ``max_pending``) — the router's
+                      backpressure cap comes from the replica itself
+``done``              one completed request: tokens + finish + latency fields
+``error``             ``queue_full`` (backpressure — the router re-queues) or
+                      ``invalid`` (admission rejection — the router fails the
+                      future; replays would fail identically)
+``stats``             engine counters (steps, prefill, prefix-cache stats) and
+                      the request queue's ``snapshot()``
+====================  =============================================================
+
+Greedy decode makes replays **token-identical** (argmax consults no RNG), which
+is what makes the router's at-least-once delivery safe; see DESIGN.md §15.
+
+``--echo`` mode serves deterministic tokens without importing jax — the router's
+own tests use it to exercise crash/hang/redispatch logic in milliseconds-cheap
+processes; everything outside the engine (protocol, heartbeats, faults,
+preemption) is the same code path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+    faults,
+    heartbeat as hb,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience.preemption import (
+    EXIT_PREEMPTED,
+    PreemptionHandler,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
+    QueueFull,
+    SamplingParams,
+)
+
+
+def build_engine_server(args):
+    """The jax-backed engine + server from an argparse namespace (model,
+    engine, and server flags as declared in :func:`main` — ``tools/
+    serve_loadgen.py`` mirrors them 1:1 and calls this for its in-process
+    mode, so the single-engine baseline and every fleet replica are built by
+    the same code path: same checkpoint-format fallback, same warmup recipe).
+    Imports jax lazily: ``--echo`` never pays."""
+    import jax
+    import jax.numpy as jnp
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import lm
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
+        ContinuousBatchingEngine,
+        Request,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.server import (
+        Server,
+    )
+
+    model = lm.TransformerLM(
+        vocab_size=args.num_levels + 1, seq_len=args.seq_len,
+        embed_dim=args.embed_dim, num_layers=args.num_layers,
+        num_heads=args.num_heads, num_kv_heads=args.kv_heads or None,
+        attention_window=args.attention_window, rope=args.rope)
+    params = model.init({"params": jax.random.PRNGKey(args.seed)},
+                        jnp.zeros((1, model.seq_len), jnp.int32))["params"]
+    if args.checkpoint:
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint,
+        )
+        from flax import serialization
+
+        with open(args.checkpoint, "rb") as f:
+            raw = serialization.msgpack_restore(f.read())
+        if isinstance(raw, dict) and "params" in raw:
+            params = serialization.from_state_dict(jax.device_get(params),
+                                                   raw["params"])
+        else:
+            params = checkpoint.load_params(args.checkpoint,
+                                            jax.device_get(params))
+    chunk_sizes = tuple(int(x) for x in args.prefill_chunks.split(",") if x)
+    engine = ContinuousBatchingEngine(
+        model, params, num_slots=args.num_slots, seed=args.seed,
+        prefill_chunk_sizes=chunk_sizes,
+        prefill_chunk_budget=args.prefill_budget,
+        prefix_cache_entries=args.prefix_cache)
+    # The serve-path resilience tick: kill/preempt/stall faults fire between
+    # decode dispatches — mid-decode, with requests in flight.
+    engine.on_step = lambda step: faults.on_tick(step=step)
+    if args.warmup:
+        # Compile the decode program, every chunk size, and (prefix cache on)
+        # the hit-install path BEFORE accepting traffic, then wipe the ledger:
+        # the router's connect timeout should cover jax import + compile, not
+        # race the first real request against XLA — and latency percentiles
+        # should measure the schedule, not XLA.
+        rng = np.random.default_rng(args.seed + 17)
+        for _ in range(args.warmup):
+            for size in engine.prefill_chunk_sizes:
+                wp = rng.integers(
+                    0, model.vocab_size - 1,
+                    size=min(size, args.seq_len - 1)).astype(np.int32)
+                engine.run([Request(prompt=wp, max_new_tokens=1)])
+                if engine.prefix_cache is not None:
+                    engine.run([Request(prompt=wp, max_new_tokens=1)])
+            engine.run([Request(prompt=np.zeros(0, np.int32), max_new_tokens=2)])
+        engine.reset_stats()
+    server = Server(engine, max_pending=args.max_pending,
+                    default_timeout_s=args.timeout_s or None,
+                    telemetry=args.telemetry)
+    return engine, server
+
+
+class _EchoServer:
+    """Jax-free stand-in for ``Server``: deterministic tokens, same protocol.
+
+    The reply for a prompt is the prompt followed by ``(sum(prompt) + i) % vocab``
+    — a pure function of the request, so a redispatched replay is token-identical
+    exactly like greedy decode. ``delay_s`` stretches each request so faults can
+    land with work genuinely in flight."""
+
+    def __init__(self, args):
+        self.vocab = args.num_levels + 1
+        self.seq_len = args.seq_len
+        self.delay_s = args.echo_delay_s
+        self.steps = 0               # protocol parity with engine.steps
+        self._lock = threading.Lock()
+
+    def complete(self, prompt: np.ndarray, max_new: int) -> np.ndarray:
+        p = len(prompt)
+        total = min(p + max_new, self.seq_len)
+        base = int(prompt.sum()) if p else 0
+        out = list(prompt) + [(base + i) % (self.vocab - 1)
+                              for i in range(total - p)]
+        for _ in range(total - p):
+            faults.on_tick(step=self.steps)
+            with self._lock:
+                self.steps += 1
+            if self.delay_s:
+                time.sleep(self.delay_s)
+        return np.asarray(out, np.int32)
+
+
+def _send(wfile, wlock, obj: dict) -> None:
+    line = json.dumps(obj) + "\n"
+    with wlock:
+        wfile.write(line.encode())
+        wfile.flush()
+
+
+def _handle_submit(msg, server, wfile, wlock):
+    prompt = np.asarray(msg.get("prompt") or [], np.int32)
+    rid = msg["id"]
+    sampling = SamplingParams(temperature=msg.get("temperature", 0.0),
+                              top_k=msg.get("top_k", 0),
+                              top_p=msg.get("top_p", 1.0))
+    try:
+        fut = server.submit(prompt, max_new_tokens=msg["max_new_tokens"],
+                            sampling=sampling, timeout_s=msg.get("timeout_s"))
+    except QueueFull:
+        _send(wfile, wlock, {"op": "error", "id": rid, "error": "queue_full",
+                             "message": "replica queue at capacity"})
+        return
+    except ValueError as e:
+        _send(wfile, wlock, {"op": "error", "id": rid, "error": "invalid",
+                             "message": str(e)})
+        return
+
+    def _done(f, rid=rid):
+        try:
+            comp = f.result()
+        except BaseException as e:           # server died mid-request
+            try:
+                _send(wfile, wlock, {"op": "error", "id": rid,
+                                     "error": "failed", "message": str(e)})
+            except OSError:
+                pass
+            return
+        try:
+            _send(wfile, wlock, {
+                "op": "done", "id": rid,
+                "tokens": [int(t) for t in comp.tokens],
+                "finish": comp.finish, "prompt_len": comp.prompt_len,
+                "new_tokens": comp.new_tokens,
+                "queue_wait_s": comp.queue_wait_s, "ttft_s": comp.ttft_s,
+                "tpot_s": comp.tpot_s, "e2e_s": comp.e2e_s,
+            })
+        except OSError:
+            pass                             # router gone; it will redispatch
+
+    fut.add_done_callback(_done)
+
+
+def _stats_payload(engine, server) -> dict:
+    eng: dict = {"steps": engine.steps}
+    for name in ("prefill_tokens", "prefill_invocations", "prefill_wall_s",
+                 "trace_count", "slot_occupancy"):
+        if hasattr(engine, name):
+            eng[name] = getattr(engine, name)
+    cache = getattr(engine, "prefix_cache", None)
+    eng["prefix_cache"] = cache.stats() if cache is not None else None
+    return {"engine": eng,
+            "queue": (server.queue.snapshot()
+                      if hasattr(server, "queue") else None)}
+
+
+def serve_forever(args) -> int:
+    replica_id = args.replica_id
+    os.environ.setdefault("JAX_PROCESS_ID", str(replica_id))
+    handler = PreemptionHandler().install()
+
+    if args.echo:
+        engine = server = _EchoServer(args)
+    else:
+        engine, server = build_engine_server(args)
+        server.start()
+
+    beat = hb.HeartbeatWriter(args.heartbeat_dir,
+                              process_index=replica_id) if args.heartbeat_dir \
+        else None
+    stop_flag = threading.Event()
+
+    def _ticker():
+        # Liveness + preemption watch. A `freeze` fault silences the beat while
+        # the process keeps running — the "hung, not slow" replica the router's
+        # staleness drain exists for.
+        while not stop_flag.is_set():
+            if not args.echo and getattr(server, "_error", None) is not None:
+                # The serving loop died (engine raised): its accepted futures
+                # were already failed and the queue closed, but the PROCESS
+                # would otherwise live on — fresh heartbeats, open connection —
+                # an undetectable zombie that bounces every new dispatch.
+                # Exit nonzero so the router classifies a crash, drains the
+                # ledger, and restarts a working replica.
+                print(f"[replica {replica_id}] serving loop died: "
+                      f"{server._error!r}; exiting for restart", flush=True)
+                os._exit(1)
+            step = int(engine.steps)
+            if beat is not None and not faults.heartbeat_frozen(step=step):
+                beat.beat(step=step, epoch=0)
+            if handler.requested:
+                # Preemption exits WITHOUT resolving in-flight work: expiring
+                # it here would flush client-visible finish="timeout" done
+                # lines, which the router settles for good BEFORE it ever sees
+                # the exit code — preempted requests would surface as timeouts
+                # instead of being drained and replayed. Leaving the ledger
+                # untouched makes preempt behave like any other death: the
+                # work looks undelivered, the router's exit-75 classification
+                # requeues it, and greedy replay is token-identical.
+                os._exit(EXIT_PREEMPTED)
+            time.sleep(args.heartbeat_interval_s)
+
+    threading.Thread(target=_ticker, daemon=True, name="replica-tick").start()
+
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", args.port))
+    lsock.listen(1)
+    # Every blocking point in the MAIN thread carries a short timeout: a signal
+    # raised from a worker thread (the preempt fault's os.kill-to-self) only
+    # runs its Python-level handler when the main thread executes bytecode, and
+    # a main thread parked forever in accept()/recv() never does — the
+    # preemption latch would sit unprocessed until the next message arrived.
+    lsock.settimeout(0.5)
+    print(f"[replica {replica_id}] listening on 127.0.0.1:{args.port} "
+          f"(pid {os.getpid()}, echo={bool(args.echo)})", flush=True)
+
+    def _handle(msg, wfile, wlock) -> bool:
+        """One protocol line; returns False when the replica should stop."""
+        op = msg.get("op")
+        if op == "submit":
+            if args.echo:
+                def _echo_job(m=msg):
+                    prompt = np.asarray(m.get("prompt") or [], np.int32)
+                    t0 = time.monotonic()
+                    tokens = server.complete(prompt, m["max_new_tokens"])
+                    try:
+                        _send(wfile, wlock, {
+                            "op": "done", "id": m["id"],
+                            "tokens": [int(t) for t in tokens],
+                            "finish": "ok", "prompt_len": len(prompt),
+                            "new_tokens": len(tokens) - len(prompt),
+                            "e2e_s": time.monotonic() - t0,
+                        })
+                    except OSError:
+                        pass
+                threading.Thread(target=_echo_job, daemon=True).start()
+            else:
+                _handle_submit(msg, server, wfile, wlock)
+        elif op == "stats":
+            _send(wfile, wlock, {"op": "stats", "id": msg.get("id"),
+                                 **_stats_payload(engine, server)})
+        elif op == "stop":
+            return False
+        return True
+
+    while True:
+        try:
+            conn, _ = lsock.accept()
+        except socket.timeout:
+            continue                # wakeup: pending signal handlers run here
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(0.5)
+        # Writes ride a dup'd blocking handle: the read timeout above must not
+        # turn a momentarily full send buffer into a dropped completion.
+        wsock = conn.dup()
+        wsock.settimeout(None)
+        wfile = wsock.makefile("wb")
+        wlock = threading.Lock()
+        _send(wfile, wlock, {"op": "hello", "replica": replica_id,
+                             "num_slots": args.num_slots,
+                             "max_pending": args.max_pending,
+                             "pid": os.getpid()})
+        buf = b""
+        try:
+            while True:
+                try:
+                    chunk = conn.recv(1 << 16)
+                except socket.timeout:
+                    continue        # wakeup: pending signal handlers run here
+                if not chunk:
+                    break           # router disconnected
+                buf += chunk
+                while True:
+                    line, sep, buf = buf.partition(b"\n")
+                    if not sep:
+                        buf = line
+                        break
+                    if line and not _handle(json.loads(line), wfile, wlock):
+                        stop_flag.set()
+                        if not args.echo:
+                            server.stop(drain=True)
+                        return 0
+        except (OSError, ValueError, json.JSONDecodeError):
+            pass
+        finally:
+            for f in (wfile, wsock, conn):
+                try:
+                    f.close()
+                except OSError:
+                    pass
+        # Router disconnected (e.g. it restarted): keep serving — accepted work
+        # drains, and the next accept() hands the fresh router a hello.
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--replica-id", type=int, default=0)
+    p.add_argument("--heartbeat-dir", default="")
+    p.add_argument("--heartbeat-interval-s", type=float, default=0.2)
+    p.add_argument("--echo", action="store_true",
+                   help="deterministic tokens, no jax — the router's own tests")
+    p.add_argument("--echo-delay-s", type=float, default=0.0,
+                   help="echo mode: per-token sleep, keeps work in flight")
+    m = p.add_argument_group("model (mirrors tools/serve_loadgen.py)")
+    m.add_argument("--checkpoint", default="")
+    m.add_argument("--seq-len", type=int, default=784)
+    m.add_argument("--num-levels", type=int, default=16)
+    m.add_argument("--embed-dim", type=int, default=64)
+    m.add_argument("--num-layers", type=int, default=2)
+    m.add_argument("--num-heads", type=int, default=4)
+    m.add_argument("--kv-heads", type=int, default=0)
+    m.add_argument("--attention-window", type=int, default=0)
+    m.add_argument("--rope", action="store_true")
+    m.add_argument("--seed", type=int, default=0)
+    e = p.add_argument_group("engine/server")
+    e.add_argument("--num-slots", type=int, default=8)
+    e.add_argument("--max-pending", type=int, default=128)
+    e.add_argument("--timeout-s", type=float, default=0.0)
+    e.add_argument("--prefill-chunks", default="32,128,512")
+    e.add_argument("--prefill-budget", type=int, default=1)
+    e.add_argument("--prefix-cache", type=int, default=0)
+    e.add_argument("--warmup", type=int, default=1,
+                   help="compile the decode/prefill/install programs before "
+                        "accepting traffic (0 = off)")
+    p.add_argument("--telemetry", default="",
+                   help="this replica's own serve JSONL (optional)")
+    args = p.parse_args(argv)
+    return serve_forever(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

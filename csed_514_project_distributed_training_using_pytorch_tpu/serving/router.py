@@ -1,0 +1,944 @@
+"""Fleet front door: shard traffic across N replica processes, and survive them.
+
+``Server`` is one engine on one chip; this router is the "millions of users"
+shape (ROADMAP open item 1): N independent ``serving/replica.py`` processes —
+each a whole engine+server, spawned and supervised through
+``train.launch.Fleet(num_processes=1, process_id_base=i)`` so replicas crash and
+restart *individually* — behind one ``submit() -> Future`` door. DESIGN.md §12's
+"failure is an input" doctrine, applied to the serve path (§15):
+
+- **at-least-once delivery** — every dispatched request stays in the router's
+  per-replica in-flight ledger until its completion line arrives. A replica
+  crash (process exit), preemption (exit 75), or hang (heartbeat staleness,
+  ``resilience/heartbeat.py``) drains that ledger back into the FRONT of the
+  router queue and redispatches elsewhere. Safe because greedy decode is
+  idempotent: replay on a fresh engine is token-identical (argmax consults no
+  RNG — pinned in tests). A "dead" replica that was merely slow may still
+  deliver; the first completion wins, later duplicates are counted and dropped.
+- **prefix-affinity routing** — requests sharing a prompt prefix are routed to
+  the replica whose ``prefix_cache`` already holds it (longest-common-prefix
+  over a bounded LRU of recently dispatched prompts, the same matching rule as
+  the cache itself), with load-based spill-over: a hot prefix never starves —
+  when the affine replica is at capacity the request goes to the least-loaded
+  one instead, and the index learns the new home.
+- **admission backpressure** — each replica's capacity (``num_slots +
+  max_pending``, from its hello line) caps the router's in-flight count for it:
+  the router never blind-fires into a ``QueueFull`` replica. The router's own
+  bounded queue raises ``QueueFull`` to submitters, and its ``snapshot()``
+  (depth / oldest-age / rejected) is the fleet's load signal.
+- **bounded-backoff restart** — a failed replica is restarted
+  supervisor-style (exponential backoff, capped attempts). When every replica
+  has exhausted its budget, outstanding work fails with ``ServerStopped``
+  instead of hanging.
+
+The router performs no jax work and never initializes a backend (the
+``resilience/supervisor.py`` doctrine): it supervises processes that own
+accelerators and must never claim a device itself — which is also why its
+telemetry goes through ``utils.jsonl.JsonlWriter`` (the full ``TelemetryWriter``
+gate calls ``jax.process_index()``, a backend init) — ``route``
+(per request), ``replica`` (lifecycle), ``router_summary`` (drain aggregate) —
+same JSONL schema, same reader, rendered by ``tools/telemetry_report.py``.
+Load generator: ``tools/serve_loadgen.py --replicas N`` (``--scenario chat`` is
+the workload where affinity pays).
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import dataclasses
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+    heartbeat as hb,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience.preemption import (
+    EXIT_PREEMPTED,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.prefix_cache import (
+    common_prefix_len,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
+    RequestQueue,
+    SamplingParams,
+    ServerStopped,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.train.launch import (
+    Fleet,
+    _free_port,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
+    JsonlWriter,
+    percentiles,
+)
+
+
+@dataclasses.dataclass
+class RouterRequest:
+    """One request in the router's custody. Carries the same ``arrival_s`` /
+    ``deadline_s`` contract as the engine's ``Request`` so ``RequestQueue``
+    queues it verbatim; ``redispatches`` counts replays after replica failures."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    sampling: SamplingParams
+    request_id: int
+    future: concurrent.futures.Future
+    arrival_s: float
+    deadline_s: float | None = None
+    redispatches: int = 0
+    dispatch_s: float | None = None     # last dispatch time (queue-wait split)
+    affinity_hit: bool = False          # last dispatch landed on the affine replica
+
+
+@dataclasses.dataclass
+class RouterCompletion:
+    """A finished request as the router saw it: the replica's token stream plus
+    fleet-level accounting. Attribute-compatible with the engine's
+    ``Completion`` where the load generator cares (``ok``/``finish``/``tokens``/
+    ``new_tokens``/latency fields)."""
+
+    request_id: int
+    tokens: np.ndarray
+    finish: str                         # "ok" | "timeout"
+    prompt_len: int
+    new_tokens: int
+    replica: int
+    redispatches: int = 0
+    affinity_hit: bool = False
+    queue_wait_s: float | None = None   # router queue + replica queue
+    ttft_s: float | None = None
+    tpot_s: float | None = None
+    e2e_s: float | None = None          # router arrival -> resolution
+
+    @property
+    def ok(self) -> bool:
+        return self.finish == "ok"
+
+
+class _AffinityIndex:
+    """Bounded LRU of (prompt tokens -> replica) with longest-common-prefix
+    lookup — the router-side mirror of the engine's ``PrefixCache`` matching
+    rule (any common prefix length is reusable; ``min_tokens`` floors a useful
+    hit). Entries for a failed replica are dropped: its cache died with it."""
+
+    def __init__(self, capacity: int = 128, max_tokens: int = 1024):
+        self.capacity = int(capacity)
+        self.max_tokens = int(max_tokens)
+        self._entries: collections.OrderedDict[int, tuple[np.ndarray, int]] = \
+            collections.OrderedDict()
+        self._next = 0
+
+    # THE matching rule is the cache's own (one owner — drift here would break
+    # the routes-to-warm-cache guarantee silently).
+    _common = staticmethod(common_prefix_len)
+
+    def lookup(self, prompt: np.ndarray, min_tokens: int) -> int | None:
+        best_key, best_len = None, 0
+        for key, (tokens, _) in self._entries.items():
+            m = self._common(tokens, prompt)
+            if m > best_len and (m >= min_tokens or m == len(prompt) > 0):
+                best_key, best_len = key, m
+        if best_key is None:
+            return None
+        self._entries.move_to_end(best_key)
+        return self._entries[best_key][1]
+
+    def insert(self, prompt: np.ndarray, replica: int) -> None:
+        if len(prompt) == 0:
+            return
+        tokens = np.asarray(prompt[:self.max_tokens], np.int32).copy()
+        # Covered-entry dedup, same as PrefixCache.insert: a stored prefix of
+        # the new prompt can never out-match it.
+        covered = [k for k, (t, _) in self._entries.items()
+                   if len(t) <= len(tokens) and self._common(t, tokens) == len(t)]
+        for k in covered:
+            del self._entries[k]
+        self._entries[self._next] = (tokens, int(replica))
+        self._next += 1
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+
+    def drop_replica(self, replica: int) -> None:
+        for k in [k for k, (_, r) in self._entries.items() if r == replica]:
+            del self._entries[k]
+
+
+class _Replica:
+    """Per-replica state: process handle, connection, in-flight ledger."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.state = "starting"       # starting | up | restarting | dead
+        self.generation = 0
+        self.fleet: Fleet | None = None
+        self.port = 0
+        self.sock: socket.socket | None = None
+        self.wfile = None
+        self.wlock = threading.Lock()
+        self.capacity: int | None = None
+        self.inflight: dict[int, RouterRequest] = {}
+        self.started_wall = 0.0
+        self.started_mono = 0.0
+        self.restart_due = 0.0
+        self.restarts = 0
+        self.dispatched = 0
+        self.completed = 0
+        self.exit_code: int | None = None
+        self.stats: dict | None = None
+
+    def room(self) -> bool:
+        return (self.state == "up"
+                and (self.capacity is None or len(self.inflight) < self.capacity))
+
+
+class Router:
+    """The fleet serving front door. ``replica_command`` is the python argv for
+    ``serving/replica.py`` WITHOUT ``--port``/``--replica-id``/
+    ``--heartbeat-dir`` (the router appends those per replica per attempt).
+
+    ``affinity=False`` degrades routing to least-loaded (the A/B baseline);
+    everything else — backpressure, redispatch, restart — is identical.
+    """
+
+    def __init__(self, replica_command: list[str], *, num_replicas: int,
+                 platform: str | None = "cpu",
+                 max_pending: int = 0, default_timeout_s: float | None = None,
+                 affinity: bool = True, affinity_min_tokens: int = 8,
+                 affinity_entries: int = 128,
+                 heartbeat_dir: str = "", heartbeat_timeout_s: float = 0.0,
+                 max_restarts: int = 3, backoff_s: float = 0.5,
+                 backoff_max_s: float = 10.0, connect_timeout_s: float = 240.0,
+                 telemetry: str = "", poll_s: float = 0.05,
+                 env: dict | None = None):
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+        self._command = list(replica_command)
+        self._platform = platform
+        self._env = env
+        self.queue = RequestQueue(max_pending)
+        self._default_timeout_s = default_timeout_s
+        self._affinity_on = bool(affinity)
+        self._affinity_min = int(affinity_min_tokens)
+        self._affinity = _AffinityIndex(affinity_entries)
+        self._hb_dir = heartbeat_dir
+        self._hb_timeout_s = heartbeat_timeout_s
+        self._max_restarts = int(max_restarts)
+        self._backoff_s = backoff_s
+        self._backoff_max_s = backoff_max_s
+        self._connect_timeout_s = connect_timeout_s
+        self._poll_s = poll_s
+        self._writer = JsonlWriter(telemetry)
+        self.replicas = [_Replica(i) for i in range(num_replicas)]
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._next_id = 0
+        # The one request the dispatch thread may hold between queue.take()
+        # and a replica ledger: _drained() and the stop/abort sweeps must see
+        # it, or a submit racing a shutdown could strand its future.
+        self._in_transit: RouterRequest | None = None
+        self._rr = 0                  # round-robin tiebreak cursor
+        self._stopping = False
+        self._aborted = False
+        self._threads: list[threading.Thread] = []
+        self._started_s: float | None = None
+        # Serving wall starts at readiness/first dispatch, NOT at start():
+        # replica cold-start (jax import + compile) can dwarf the measured
+        # run, and the single-engine serve_summary this gets A/B'd against
+        # starts its clock on an already-built engine.
+        self._served_from_s: float | None = None
+        # Aggregates for router_summary (scalars + small float lists only).
+        self._counts = {"requests": 0, "ok": 0, "timeout": 0, "failed": 0,
+                        "redispatches": 0, "redispatched_requests": 0,
+                        "duplicates": 0, "affinity_hits": 0, "new_tokens": 0}
+        self._series: dict[str, list] = {"ttft_s": [], "e2e_s": [],
+                                         "queue_wait_s": []}
+        self.last_summary: dict | None = None
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def start(self) -> "Router":
+        if self._started_s is not None:
+            raise RuntimeError("router already started")
+        self._started_s = time.monotonic()
+        self._writer.emit({
+            "event": "router_config", "replicas": len(self.replicas),
+            "affinity": self._affinity_on, "max_pending": self.queue.max_pending,
+            "heartbeat_timeout_s": self._hb_timeout_s,
+            "max_restarts": self._max_restarts, "backoff_s": self._backoff_s,
+        })
+        with self._lock:
+            for rep in self.replicas:
+                self._spawn(rep)
+        for name, target in (("router-dispatch", self._dispatch_loop),
+                             ("router-monitor", self._monitor_loop)):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def wait_ready(self, timeout: float | None = None) -> bool:
+        """Block until every replica is connected and serving (or ``timeout``).
+        Load generators call this before offering measured load: replicas cold
+        -start at different speeds (jax import + compile), and measuring — or
+        A/B-comparing routing policies — against a half-up fleet would skew
+        everything toward whichever replica won the race. Returns False
+        immediately if the fleet aborts first (every replica crash-looped its
+        restart budget away — e.g. a broken replica command)."""
+        with self._cond:
+            self._cond.wait_for(
+                lambda: self._aborted
+                or all(r.state == "up" for r in self.replicas),
+                timeout=timeout)
+            ready = (not self._aborted
+                     and all(r.state == "up" for r in self.replicas))
+            if ready and self._served_from_s is None:
+                self._served_from_s = time.monotonic()
+            return ready
+
+    def __enter__(self) -> "Router":
+        return self.start() if self._started_s is None else self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------ submit
+
+    def submit(self, prompt, *, max_new_tokens: int,
+               sampling: SamplingParams | None = None,
+               timeout_s: float | None = None) -> concurrent.futures.Future:
+        """Thread-safe enqueue; returns a Future resolving to a
+        ``RouterCompletion``. Raises ``QueueFull`` (router backpressure)
+        immediately in the caller's thread. Deep validation (prompt vs seq_len,
+        sampling bounds) happens replica-side — an ``invalid`` reply fails the
+        future with ``ValueError`` (replays would fail identically, so it is
+        never redispatched)."""
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if self._aborted:
+            raise ServerStopped("router aborted: every replica is dead")
+        now = time.monotonic()
+        timeout_s = self._default_timeout_s if timeout_s is None else timeout_s
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+        req = RouterRequest(
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new_tokens=int(max_new_tokens),
+            sampling=sampling or SamplingParams(),
+            request_id=rid, future=concurrent.futures.Future(),
+            arrival_s=now,
+            deadline_s=None if timeout_s is None else now + timeout_s)
+        self.queue.submit(req)           # may raise QueueFull / closed
+        return req.future
+
+    # ------------------------------------------------------------------ spawn/io
+
+    def _spawn(self, rep: _Replica) -> None:
+        """(Re)launch one replica as its own single-process Fleet. Caller holds
+        the lock."""
+        rep.generation += 1
+        rep.port = _free_port()
+        rep.capacity = None
+        rep.stats = None
+        rep.exit_code = None
+        cmd = list(self._command) + ["--port", str(rep.port),
+                                     "--replica-id", str(rep.index)]
+        if self._hb_dir:
+            hb.clear(self._hb_dir, rep.index)
+            cmd += ["--heartbeat-dir", self._hb_dir]
+        rep.fleet = Fleet(cmd, num_processes=1, platform=self._platform,
+                          process_id_base=rep.index, env=self._env)
+        rep.started_wall = time.time()
+        rep.started_mono = time.monotonic()
+        rep.state = "starting"
+        t = threading.Thread(target=self._io_loop, args=(rep, rep.generation),
+                             daemon=True, name=f"router-io-{rep.index}")
+        t.start()
+        self._threads.append(t)
+
+    def _io_loop(self, rep: _Replica, gen: int) -> None:
+        """Connect to one replica generation, read its hello, then pump its
+        reply lines until disconnect or the generation is superseded."""
+        while True:
+            with self._lock:
+                if self._stopping or rep.generation != gen:
+                    return
+                port, fleet = rep.port, rep.fleet
+            if not fleet.running:
+                return                      # monitor classifies the exit
+            try:
+                sock = socket.create_connection(("127.0.0.1", port), timeout=1.0)
+            except OSError:
+                time.sleep(0.1)
+                continue
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            rfile = sock.makefile("rb")
+            try:
+                hello = json.loads(rfile.readline() or b"null")
+                if not hello or hello.get("op") != "hello":
+                    raise OSError("bad hello")
+            except (OSError, ValueError):
+                sock.close()
+                time.sleep(0.1)
+                continue
+            # The connect/hello timeout must NOT outlive the handshake: reply
+            # gaps are unbounded (a long decode, an idle fleet), and a read
+            # timeout here would masquerade as a lost connection — tearing
+            # down a healthy replica's ledger every quiet second. Teardown is
+            # signalled by the socket being closed (stop/_fail_replica), EOF,
+            # or the process dying — never by silence.
+            sock.settimeout(None)
+            with self._cond:
+                if self._stopping or rep.generation != gen:
+                    sock.close()
+                    return
+                rep.sock = sock
+                rep.wfile = sock.makefile("wb")
+                slots = int(hello.get("num_slots", 1))
+                pending = int(hello.get("max_pending", 0))
+                rep.capacity = slots + pending if pending else None
+                rep.state = "up"
+                self._cond.notify_all()
+            self._writer.emit({"event": "replica", "replica": rep.index,
+                               "action": "up", "restarts": rep.restarts,
+                               "capacity": rep.capacity})
+            try:
+                for raw in rfile:
+                    self._handle_line(rep, gen, json.loads(raw))
+            except (OSError, ValueError, KeyError, TypeError):
+                pass                  # torn/garbage line or dead socket
+            # EOF usually means the PROCESS died (its exit closed the socket a
+            # few ms before the monitor can observe the reaped child). Give
+            # that classification a moment: a crash must flow through
+            # _fail_replica — one owner for drain + restart accounting — and
+            # only a genuine live-process connection loss is handled here.
+            grace = time.monotonic() + 0.5
+            while fleet.running and time.monotonic() < grace:
+                time.sleep(0.02)
+            if not fleet.running:
+                return                # monitor classifies, drains, restarts
+            with self._cond:
+                if rep.generation == gen:
+                    rep.sock = None
+                    rep.wfile = None
+                    if not self._stopping and rep.state == "up":
+                        # Connection lost but generation current (process still
+                        # alive): reconnect — but first drain the ledger. The
+                        # replica's completion callbacks hold the DEAD socket's
+                        # write file, so replies for these requests can never
+                        # reach us; without redispatch they would strand their
+                        # futures while heartbeats stay fresh.
+                        self._drain_ledger(rep, time.monotonic())
+                        rep.state = "starting"
+                        rep.started_mono = time.monotonic()
+                        self._cond.notify_all()
+                        continue
+            return
+
+    # ------------------------------------------------------------------ replies
+
+    def _handle_line(self, rep: _Replica, gen: int, msg: dict) -> None:
+        op = msg.get("op")
+        if op == "done":
+            self._handle_done(rep, msg)
+        elif op == "error":
+            self._handle_error(rep, msg)
+        elif op == "stats":
+            with self._cond:
+                rep.stats = {"engine": msg.get("engine"),
+                             "queue": msg.get("queue")}
+                self._cond.notify_all()
+
+    def _handle_done(self, rep: _Replica, msg: dict) -> None:
+        now = time.monotonic()
+        if msg.get("id") is None:         # torn line: nothing to attribute it to
+            return
+        with self._cond:
+            req = rep.inflight.pop(msg["id"], None)
+            if req is None:
+                # A drained-and-redispatched request completing on the replica
+                # we gave up on — at-least-once's harmless tail.
+                self._counts["duplicates"] += 1
+                return
+            rep.completed += 1
+            self._cond.notify_all()
+        if req.future.done():
+            # Resolved elsewhere (an earlier attempt completed, or it expired):
+            # this is a replayed duplicate — drop it, never double-count.
+            with self._lock:
+                self._counts["duplicates"] += 1
+            return
+        router_wait = (req.dispatch_s - req.arrival_s
+                       if req.dispatch_s is not None else 0.0)
+        queue_wait = router_wait + (msg.get("queue_wait_s") or 0.0)
+        ttft = msg.get("ttft_s")
+        comp = RouterCompletion(
+            request_id=req.request_id,
+            tokens=np.asarray(msg.get("tokens") or [], np.int32),
+            finish=msg.get("finish", "ok"),
+            prompt_len=int(msg.get("prompt_len", len(req.prompt))),
+            new_tokens=int(msg.get("new_tokens", 0)),
+            replica=rep.index, redispatches=req.redispatches,
+            affinity_hit=req.affinity_hit,
+            queue_wait_s=queue_wait,
+            ttft_s=None if ttft is None else ttft + router_wait,
+            tpot_s=msg.get("tpot_s"),
+            e2e_s=now - req.arrival_s)
+        try:
+            req.future.set_result(comp)
+        except concurrent.futures.InvalidStateError:
+            # Lost a resolve race (the same id was legitimately in flight
+            # twice — a drain and a failed-send both requeued it): this copy
+            # is the duplicate, and it must not poison the io thread.
+            with self._lock:
+                self._counts["duplicates"] += 1
+            return
+        self._record(comp)
+
+    def _handle_error(self, rep: _Replica, msg: dict) -> None:
+        if msg.get("id") is None:
+            return
+        with self._cond:
+            req = rep.inflight.pop(msg["id"], None)
+            if req is None:
+                return
+            self._cond.notify_all()
+        kind = msg.get("error")
+        if kind == "queue_full":
+            # Router/replica capacity accounting drifted (e.g. a replica
+            # restarted thinner): bounce back to the queue front, try elsewhere.
+            self.queue.requeue(req)
+            return
+        err = (ValueError if kind == "invalid" else RuntimeError)(
+            msg.get("message", kind or "replica error"))
+        try:
+            req.future.set_exception(err)
+        except concurrent.futures.InvalidStateError:
+            return                        # lost a resolve race: already settled
+        with self._lock:
+            self._counts["failed"] += 1
+
+    def _record(self, comp: RouterCompletion) -> None:
+        with self._lock:
+            self._counts["requests"] += 1
+            self._counts["ok"] += comp.ok
+            self._counts["timeout"] += comp.finish == "timeout"
+            self._counts["new_tokens"] += comp.new_tokens
+            self._counts["affinity_hits"] += comp.affinity_hit
+            self._counts["redispatched_requests"] += comp.redispatches > 0
+            for name in self._series:
+                self._series[name].append(getattr(comp, name))
+        self._writer.emit({
+            "event": "route", "request_id": comp.request_id,
+            "replica": comp.replica, "affinity_hit": comp.affinity_hit,
+            "redispatches": comp.redispatches, "finish": comp.finish,
+            "prompt_len": comp.prompt_len, "new_tokens": comp.new_tokens,
+            "queue_wait_s": comp.queue_wait_s, "ttft_s": comp.ttft_s,
+            "tpot_s": comp.tpot_s, "e2e_s": comp.e2e_s,
+        })
+
+    # ------------------------------------------------------------------ dispatch
+
+    def _choose(self, prompt: np.ndarray) -> tuple[_Replica | None, bool]:
+        """Pick the dispatch target (caller holds the lock): the affine replica
+        when it has room, else the least-loaded replica with room (spill-over),
+        else None (everyone is at capacity — backpressure holds the request)."""
+        if self._affinity_on:
+            idx = self._affinity.lookup(prompt, self._affinity_min)
+            if idx is not None and self.replicas[idx].room():
+                return self.replicas[idx], True
+        ups = [r for r in self.replicas if r.room()]
+        if not ups:
+            return None, False
+        self._rr += 1
+        rep = min(ups, key=lambda r: (len(r.inflight),
+                                      (r.index - self._rr) % len(self.replicas)))
+        return rep, False
+
+    def _dispatch_one(self, req: RouterRequest) -> bool:
+        """Send one request to a chosen replica; False when everyone is full."""
+        now = time.monotonic()
+        with self._cond:
+            rep, hit = self._choose(req.prompt)
+            if rep is None:
+                return False
+            # Stamp the LAST dispatch: the client's first token comes from the
+            # attempt that succeeds, so a redispatched request's ttft/queue
+            # wait must include the failed attempt + detection + backoff time
+            # it sat through, not just its first hop.
+            req.dispatch_s = now
+            if self._served_from_s is None:
+                self._served_from_s = now
+            req.affinity_hit = hit
+            rep.inflight[req.request_id] = req
+            rep.dispatched += 1
+            if self._in_transit is req:   # visible in the ledger from here on
+                self._in_transit = None
+            if self._affinity_on:
+                self._affinity.insert(req.prompt, rep.index)
+            wfile, wlock = rep.wfile, rep.wlock
+        msg = {"op": "submit", "id": req.request_id,
+               "prompt": [int(t) for t in req.prompt],
+               "max_new_tokens": req.max_new_tokens,
+               "temperature": req.sampling.temperature,
+               "top_k": req.sampling.top_k, "top_p": req.sampling.top_p,
+               "timeout_s": (None if req.deadline_s is None
+                             else max(0.001, req.deadline_s - now))}
+        try:
+            with wlock:
+                wfile.write((json.dumps(msg) + "\n").encode())
+                wfile.flush()
+        except (OSError, AttributeError):
+            # Connection died under us: pull the request back; the monitor will
+            # classify the replica. (AttributeError: wfile already cleared.)
+            with self._cond:
+                rep.inflight.pop(req.request_id, None)
+            self.queue.requeue(req)
+        return True
+
+    def _expire(self, req: RouterRequest, now: float) -> None:
+        if req.future.done():
+            return
+        comp = RouterCompletion(
+            request_id=req.request_id, tokens=np.zeros((0,), np.int32),
+            finish="timeout", prompt_len=len(req.prompt), new_tokens=0,
+            replica=-1, redispatches=req.redispatches,
+            queue_wait_s=now - req.arrival_s, e2e_s=now - req.arrival_s)
+        try:
+            req.future.set_result(comp)
+        except concurrent.futures.InvalidStateError:
+            return                        # lost a resolve race: already settled
+        self._record(comp)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopping:
+                    return
+            now = time.monotonic()
+            with self._cond:
+                # take-and-mark is one transaction: a request must never be in
+                # neither the queue nor anywhere a shutdown sweep looks.
+                admitted, expired = self.queue.take(now, 1)
+                if admitted:
+                    self._in_transit = admitted[0]
+            for req in expired:
+                self._expire(req, now)
+            if not admitted:
+                # wait_for_work returns immediately once the queue is closed
+                # (drain in progress); don't turn that into a hot spin.
+                if not self.queue.wait_for_work(self._poll_s) and self.queue.closed:
+                    time.sleep(self._poll_s)
+                continue
+            req = admitted[0]
+            if req.future.done():             # resolved while queued (expiry race)
+                with self._cond:
+                    self._in_transit = None
+                    self._cond.notify_all()
+                continue
+            if not self._dispatch_one(req):
+                # Everyone at capacity (or restarting): the request goes BACK
+                # into the queue — it must stay visible to stop()'s drain wait
+                # and to deadline expiry — and we wait for room.
+                with self._cond:
+                    self.queue.requeue(req)
+                    self._in_transit = None
+                    self._cond.wait(self._poll_s)
+
+    def _drained(self) -> bool:
+        with self._lock:
+            return (len(self.queue) == 0
+                    and self._in_transit is None
+                    and all(not r.inflight for r in self.replicas))
+
+    # ------------------------------------------------------------------ monitor
+
+    def _drain_ledger(self, rep: _Replica, now: float) -> int:
+        """Move a dead/unreachable replica's in-flight work back into the queue
+        FRONT (caller holds the lock): FIFO order preserved, already-settled
+        requests skipped, past-deadline requests resolved as timeouts instead
+        of being replayed. The ONE owner of redispatch accounting — both the
+        failure path and the live-process reconnect path go through here.
+        Returns how many entries the ledger held."""
+        drained = list(rep.inflight.values())
+        rep.inflight.clear()
+        for req in reversed(drained):         # appendleft x N keeps FIFO order
+            if req.future.done():
+                continue                      # already resolved: nothing to replay
+            if req.deadline_s is not None and now > req.deadline_s:
+                self._expire(req, now)        # past deadline: expired, NOT a
+            else:                             # redispatch — don't count one
+                req.redispatches += 1
+                self._counts["redispatches"] += 1
+                self.queue.requeue(req)
+        return len(drained)
+
+    def _fail_replica(self, rep: _Replica, reason: str,
+                      exit_code: int | None = None) -> None:
+        """Drain a failed replica's in-flight ledger back into the queue front
+        and schedule (or refuse) its restart."""
+        with self._cond:
+            if rep.state in ("dead", "restarting"):
+                return
+            rep.generation += 1               # io thread for old gen stands down
+            sock, rep.sock, rep.wfile = rep.sock, None, None
+            rep.exit_code = exit_code
+            self._affinity.drop_replica(rep.index)
+            now = time.monotonic()
+            drained = self._drain_ledger(rep, now)
+            if rep.restarts >= self._max_restarts:
+                rep.state = "dead"
+            else:
+                rep.restarts += 1
+                backoff = min(self._backoff_s * (2 ** (rep.restarts - 1)),
+                              self._backoff_max_s) if self._backoff_s > 0 else 0.0
+                rep.restart_due = now + backoff
+                rep.state = "restarting"
+            state, backoff_s = rep.state, (rep.restart_due - now
+                                           if rep.state == "restarting" else None)
+            # Emit INSIDE the transaction: the moment another thread can see
+            # the bumped restart count (a test, stop()'s summary), the event
+            # must already be on disk — the blocking teardown below can lose a
+            # race against stop() closing the writer.
+            self._writer.emit({"event": "replica", "replica": rep.index,
+                               "action": "dead" if state == "dead" else "fail",
+                               "reason": reason, "exit_code": exit_code,
+                               "restarts": rep.restarts,
+                               "drained": drained, "backoff_s": backoff_s})
+            self._cond.notify_all()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if rep.fleet is not None:
+            rep.fleet.terminate(grace=2.0)
+        print(f"[router] replica {rep.index} {reason}"
+              + (f" (exit {exit_code})" if exit_code is not None else "")
+              + f"; drained {drained} in-flight; "
+              + ("giving up (restart budget exhausted)" if state == "dead"
+                 else f"restart {rep.restarts}/{self._max_restarts} "
+                      f"in {backoff_s:.2f}s"), flush=True)
+        if state == "dead":
+            with self._lock:
+                all_dead = all(r.state == "dead" for r in self.replicas)
+            if all_dead:
+                self._abort_all()
+
+    def _abort_all(self) -> None:
+        """Every replica exhausted its restart budget: fail all outstanding
+        work with the typed error instead of hanging submitters."""
+        err = ServerStopped("router aborted: every replica is dead")
+        self.queue.close()
+        now = time.monotonic()
+        leftovers, expired = self.queue.take(now, 1 << 30)
+        for req in expired:         # past-deadline: resolve as timeouts — NEVER
+            self._expire(req, now)        # drop them with their futures pending
+        with self._cond:
+            self._aborted = True
+            if self._in_transit is not None:
+                leftovers.append(self._in_transit)
+            for rep in self.replicas:
+                leftovers.extend(rep.inflight.values())
+                rep.inflight.clear()
+            self._cond.notify_all()
+        for req in leftovers:
+            try:
+                if not req.future.done():
+                    req.future.set_exception(err)
+            except concurrent.futures.InvalidStateError:
+                pass      # lost a resolve race — must not kill the monitor thread
+
+    def _stale(self, rep: _Replica) -> bool:
+        if not (self._hb_dir and self._hb_timeout_s > 0 and rep.state == "up"):
+            return False
+        beat = hb.read_heartbeats(self._hb_dir).get(rep.index)
+        t = (beat["time"] if beat and beat["time"] >= rep.started_wall
+             else rep.started_wall)
+        return time.time() - t > self._hb_timeout_s
+
+    def _monitor_loop(self) -> None:
+        next_hb = 0.0
+        while True:
+            with self._lock:
+                if self._stopping:
+                    return
+                reps = list(self.replicas)
+            now = time.monotonic()
+            check_hb = now >= next_hb
+            if check_hb:
+                next_hb = now + max(self._poll_s,
+                                    self._hb_timeout_s / 10 or self._poll_s)
+            for rep in reps:
+                if rep.state in ("starting", "up"):
+                    if not rep.fleet.running:
+                        rc = rep.fleet.poll()
+                        reason = ("preempted" if rc == EXIT_PREEMPTED
+                                  else "crash")
+                        self._fail_replica(rep, reason, exit_code=rc)
+                        continue
+                    if rep.state == "up" and check_hb and self._stale(rep):
+                        self._fail_replica(rep, "hung")
+                        continue
+                    if (rep.state == "starting"
+                            and now - rep.started_mono > self._connect_timeout_s):
+                        self._fail_replica(rep, "connect_timeout")
+                        continue
+                elif rep.state == "restarting" and now >= rep.restart_due:
+                    self._writer.emit({"event": "replica", "replica": rep.index,
+                                       "action": "restart",
+                                       "restarts": rep.restarts})
+                    with self._lock:
+                        self._spawn(rep)
+            time.sleep(self._poll_s)
+
+    # ------------------------------------------------------------------ stop
+
+    def _collect_stats(self, wait_s: float = 3.0) -> None:
+        """Ask every live replica for its engine/queue counters (best effort —
+        a replica that died mid-run reports nothing; its pre-crash counters died
+        with it, which the summary notes via per-replica restart counts)."""
+        asked = []
+        with self._lock:
+            for rep in self.replicas:
+                if rep.state == "up" and rep.wfile is not None:
+                    try:
+                        with rep.wlock:
+                            rep.wfile.write(
+                                (json.dumps({"op": "stats", "id": -1}) + "\n")
+                                .encode())
+                            rep.wfile.flush()
+                        asked.append(rep)
+                    except OSError:
+                        pass
+        deadline = time.monotonic() + wait_s
+        with self._cond:
+            self._cond.wait_for(
+                lambda: all(r.stats is not None for r in asked),
+                timeout=max(0.0, deadline - time.monotonic()))
+
+    def stop(self, *, drain: bool = True, timeout: float | None = None) -> dict:
+        """Drain (``drain=True``) or abandon outstanding work, collect replica
+        stats, stop the fleet, emit ``router_summary``. Returns the summary
+        dict (also kept as ``last_summary``). A drain that outlives ``timeout``
+        fails the leftovers with ``ServerStopped`` and raises it — same
+        contract as ``Server.stop``."""
+        self.queue.close()
+        leftover: list[RouterRequest] = []
+        if drain and not self._aborted:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            with self._cond:
+                self._cond.wait_for(
+                    self._drained,
+                    timeout=None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+        if not self._drained():
+            now = time.monotonic()
+            taken, expired = self.queue.take(now, 1 << 30)
+            for req in expired:     # past-deadline: resolve as timeouts — NEVER
+                self._expire(req, now)    # drop them with their futures pending
+            leftover.extend(taken)
+            with self._cond:
+                if self._in_transit is not None:
+                    leftover.append(self._in_transit)
+                    self._in_transit = None
+                for rep in self.replicas:
+                    leftover.extend(rep.inflight.values())
+                    rep.inflight.clear()
+        if leftover and not drain:
+            # Abandoning work on purpose: resolve as timeouts (partial-free),
+            # mirroring Server.stop(drain=False)'s expiry-sweep semantics.
+            now = time.monotonic()
+            for req in leftover:
+                self._expire(req, now)
+            leftover = []
+        # Service ends HERE: stats collection and fleet teardown below can take
+        # whole seconds of zero-token wall, which must not land in the
+        # denominator of the summary's tokens_per_s (the value the report CLI
+        # A/B-compares — and serve_loadgen deliberately computes its own wall
+        # before calling stop() for the same reason).
+        served_until_s = time.monotonic()
+        self._collect_stats()
+        with self._lock:
+            self._stopping = True
+            self._cond.notify_all()
+            reps = list(self.replicas)
+        for rep in reps:                      # graceful stop, then hard teardown
+            if rep.wfile is not None:
+                try:
+                    with rep.wlock:
+                        rep.wfile.write(b'{"op": "stop"}\n')
+                        rep.wfile.flush()
+                except OSError:
+                    pass
+        stop_deadline = time.monotonic() + 5.0
+        for rep in reps:
+            while (rep.fleet is not None and rep.fleet.running
+                   and time.monotonic() < stop_deadline):
+                time.sleep(0.02)
+            if rep.fleet is not None:
+                rep.fleet.terminate(grace=1.0)
+        err = None
+        leftover = [r for r in leftover if not r.future.done()]
+        if leftover:
+            err = ServerStopped(
+                f"router stopped with {len(leftover)} request(s) unfinished")
+            for req in leftover:
+                try:
+                    if not req.future.done():
+                        req.future.set_exception(err)
+                except concurrent.futures.InvalidStateError:
+                    pass          # lost a resolve race: already settled elsewhere
+        self.last_summary = self._summary(end_s=served_until_s)
+        self._writer.emit(dict(self.last_summary))
+        self._writer.close()
+        if err is not None:
+            raise err
+        return self.last_summary
+
+    def _summary(self, end_s: float | None = None) -> dict:
+        t0 = self._served_from_s or self._started_s
+        end = time.monotonic() if end_s is None else end_s
+        wall = end - t0 if t0 is not None else None
+        with self._lock:
+            counts = dict(self._counts)
+            per_replica = [{
+                "replica": r.index, "state": r.state, "restarts": r.restarts,
+                "dispatched": r.dispatched, "completed": r.completed,
+                "exit_code": r.exit_code,
+                "stats": r.stats,
+            } for r in self.replicas]
+            series = {k: list(v) for k, v in self._series.items()}
+        cache = {"queries": 0, "hits": 0, "hit_tokens": 0}
+        have_cache = False
+        for row in per_replica:
+            pc = ((row["stats"] or {}).get("engine") or {}).get("prefix_cache")
+            if pc:
+                have_cache = True
+                for k in cache:
+                    cache[k] += pc.get(k) or 0
+        routed = counts["requests"]
+        return {
+            "event": "router_summary",
+            "replicas": len(self.replicas),
+            "affinity": self._affinity_on,
+            "wall_s": wall,
+            **counts,
+            "tokens_per_s": (counts["new_tokens"] / wall
+                             if counts["new_tokens"] and wall else None),
+            "affinity_rate": (counts["affinity_hits"] / routed
+                              if routed else None),
+            "replica_restarts": sum(r["restarts"] for r in per_replica),
+            "per_replica": per_replica,
+            "prefix_cache": cache if have_cache else None,
+            "queue": self.queue.snapshot(),
+            "ttft_s": percentiles(series["ttft_s"]),
+            "e2e_s": percentiles(series["e2e_s"]),
+            "queue_wait_s": percentiles(series["queue_wait_s"]),
+        }

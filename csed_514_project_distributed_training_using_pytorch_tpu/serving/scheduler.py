@@ -10,41 +10,96 @@ fairness aligned with arrival order:
 
 - **backpressure** — ``submit`` on a full queue raises ``QueueFull`` immediately
   (the caller sheds load or retries with its own policy; the serving loop never
-  buffers unboundedly);
+  buffers unboundedly); every refusal is counted (``snapshot()['rejected']``);
 - **deadlines** — each request may carry an absolute ``deadline_s``
   (``time.monotonic()`` clock); requests that expire while QUEUED are surfaced by
   ``take`` as rejects without ever touching a slot (mid-decode expiry is the
   engine's ``expire``);
 - **drain** — ``close()`` refuses new work while ``take`` keeps handing out what
   was already accepted, which is exactly the graceful-shutdown contract the server
-  builds on.
+  builds on;
+- **redispatch** — ``requeue`` re-admits an ALREADY-ACCEPTED request at the
+  front, closed or not (the router's at-least-once path: a replica died with the
+  request in flight; refusing it here would turn a replica crash into a lost
+  request);
+- **observability** — ``snapshot()`` is the queue's health signal (depth,
+  oldest-age, rejected count): the server surfaces it in ``serve_summary`` and
+  the router reads the same shape off each replica as its backpressure input.
+
+This module (home of the shared ``Request``/``SamplingParams`` types) performs
+no jax work and never initializes a backend: the fleet router drives replicas
+that own the accelerator and must never claim a device itself — the same
+doctrine as ``resilience/supervisor.py``.
 """
 
 from __future__ import annotations
 
 import collections
+import dataclasses
 import threading
+import time
 
-from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
-    Request,
-)
+import numpy as np
 
 
 class QueueFull(RuntimeError):
     """Backpressure signal: the bounded request queue is at capacity."""
 
 
+class ServerStopped(TimeoutError):
+    """A serving front end (``Server`` or ``Router``) was stopped before this
+    request could complete: pending futures are failed with this instead of
+    hanging their waiters forever. Subclasses ``TimeoutError`` because the
+    drain-timeout path is where it historically surfaced."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode policy. ``temperature <= 0`` decodes greedily; ``top_k = 0``
+    / ``top_p = 1.0`` disable those filters (``models.lm.filter_logits`` semantics,
+    applied after temperature scaling in the same compose order)."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+    def validate(self, vocab_size: int) -> None:
+        if not 0 <= self.top_k <= vocab_size:
+            raise ValueError(f"top_k {self.top_k} outside [0, {vocab_size}]")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p {self.top_p} outside (0, 1]")
+
+
+@dataclasses.dataclass
+class Request:
+    """One decode request. ``prompt`` is a ``[P]`` int32 slice of the TARGETS stream
+    (``generate``'s prompt convention: output positions ``0..P-1`` are forced to it,
+    its K/V populating the cache); ``max_new_tokens`` bounds the sampled suffix.
+    ``deadline_s``/``arrival_s`` are ``time.monotonic()`` stamps (absolute), set by
+    the server front end; both optional for direct engine use."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    sampling: SamplingParams = SamplingParams()
+    request_id: int = 0
+    deadline_s: float | None = None
+    arrival_s: float | None = None
+
+
 class RequestQueue:
     """FIFO of pending ``Request``s shared between submitter threads and the
-    serving loop. ``max_pending = 0`` means unbounded (no backpressure)."""
+    serving loop. ``max_pending = 0`` means unbounded (no backpressure). The
+    router reuses it verbatim — anything with ``arrival_s``/``deadline_s``
+    attributes queues."""
 
     def __init__(self, max_pending: int = 0):
         if max_pending < 0:
             raise ValueError(f"max_pending must be >= 0, got {max_pending}")
         self.max_pending = int(max_pending)
-        self._dq: collections.deque[Request] = collections.deque()
+        self._dq: collections.deque = collections.deque()
         self._cond = threading.Condition()
         self._closed = False
+        self._rejected = 0
 
     def __len__(self) -> int:
         with self._cond:
@@ -55,25 +110,36 @@ class RequestQueue:
         with self._cond:
             return self._closed
 
-    def submit(self, request: Request) -> None:
+    def submit(self, request) -> None:
         """Enqueue or refuse — never blocks. Raises ``QueueFull`` (backpressure)
         or ``RuntimeError`` after ``close()`` (drain in progress)."""
         with self._cond:
             if self._closed:
                 raise RuntimeError("queue is closed (server draining)")
             if self.max_pending and len(self._dq) >= self.max_pending:
+                self._rejected += 1
                 raise QueueFull(
                     f"request queue at capacity ({self.max_pending} pending)")
             self._dq.append(request)
             self._cond.notify_all()
 
-    def take(self, now: float, max_n: int) -> tuple[list[Request], list[Request]]:
+    def requeue(self, request) -> None:
+        """Re-admit an already-accepted request at the FRONT of the queue — the
+        redispatch path. Deliberately ignores both ``close()`` (a drain must
+        still replay what a dead replica dropped) and ``max_pending`` (the
+        request was admitted once; counting it against capacity twice would turn
+        a replica crash into load shedding)."""
+        with self._cond:
+            self._dq.appendleft(request)
+            self._cond.notify_all()
+
+    def take(self, now: float, max_n: int) -> tuple[list, list]:
         """Pop up to ``max_n`` admittable requests, FIFO. Returns
         ``(admitted, expired)`` — ``expired`` are requests whose deadline passed
         while queued (they consume no slot and no decode step; the caller owns
         rejecting them to their submitters)."""
-        admitted: list[Request] = []
-        expired: list[Request] = []
+        admitted: list = []
+        expired: list = []
         with self._cond:
             while self._dq and len(admitted) < max_n:
                 req = self._dq.popleft()
@@ -82,6 +148,28 @@ class RequestQueue:
                 else:
                     admitted.append(req)
         return admitted, expired
+
+    def snapshot(self, now: float | None = None) -> dict:
+        """The queue's health/backpressure signal, as one JSON-ready dict:
+        ``depth`` (queued now), ``oldest_age_s`` (how long the head has waited —
+        the leading indicator of an overloaded consumer), ``rejected``
+        (cumulative ``QueueFull`` refusals), plus capacity and drain state.
+        This is what ``serve_summary`` reports and what the router reads off
+        each replica before dispatching more work."""
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            oldest = None
+            if self._dq:
+                head = self._dq[0]
+                if getattr(head, "arrival_s", None) is not None:
+                    oldest = max(0.0, now - head.arrival_s)
+            return {
+                "depth": len(self._dq),
+                "oldest_age_s": oldest,
+                "rejected": self._rejected,
+                "max_pending": self.max_pending,
+                "closed": self._closed,
+            }
 
     def force_deadline(self, deadline_s: float) -> None:
         """Clamp every queued request's deadline (the server's ``drain=False``

@@ -39,6 +39,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine impo
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
     RequestQueue,
+    ServerStopped,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
     telemetry as T,
@@ -106,7 +107,13 @@ class Server:
         """Graceful shutdown: refuse new requests, then (``drain=True``) decode
         everything already accepted to completion before the loop exits.
         ``drain=False`` additionally expires all queued + in-flight requests at
-        the next loop pass (their futures resolve as timeouts, partial tokens)."""
+        the next loop pass (their futures resolve as timeouts, partial tokens).
+
+        A drain that outlives ``timeout`` raises ``ServerStopped`` — and FIRST
+        fails every still-pending future with that same typed error, so no
+        caller is left hung on ``Future.result()`` for work the server will
+        never finish. The remaining drain is converted into an expiry sweep
+        (bounded: one more loop pass) before the thread is reaped."""
         if not drain:
             # The LOOP thread performs the expiry sweep (it owns the engine):
             # setting the flag from here would race the admission path.
@@ -115,7 +122,30 @@ class Server:
         if self._thread is not None:
             self._thread.join(timeout)
             if self._thread.is_alive():
-                raise TimeoutError("serving loop did not drain in time")
+                err = ServerStopped(
+                    f"serving loop did not drain within {timeout}s; "
+                    f"pending requests failed with ServerStopped")
+                with self._futures_lock:
+                    futures = list(self._futures.values())
+                    self._futures.clear()
+                for fut in futures:
+                    try:
+                        if not fut.done():
+                            fut.set_exception(err)
+                    except concurrent.futures.InvalidStateError:
+                        pass              # caller cancelled between check and set
+                # Past-date everything still in flight so the loop exits after
+                # at most one more pass (their completions find no future and
+                # only land in telemetry as timeouts). That pass still needs
+                # the CURRENT engine step to return, so the reap is bounded
+                # grace, not a promise — a loop wedged inside the backend (a
+                # stall fault, a hung device) stays a daemon thread rather
+                # than blocking stop() forever.
+                self._abort = True
+                self._thread.join(timeout=10.0)
+                if not self._thread.is_alive():
+                    self._thread = None
+                raise err
             self._thread = None
         if self._error is not None:
             raise RuntimeError("serving loop died") from self._error
@@ -174,7 +204,10 @@ class Server:
         with self._futures_lock:
             fut = self._futures.pop(comp.request.request_id, None)
         if fut is not None:
-            fut.set_result(comp)
+            try:
+                fut.set_result(comp)
+            except concurrent.futures.InvalidStateError:
+                pass                      # caller cancelled: must not kill the loop
 
     def _reject_expired(self, req: Request, now: float) -> None:
         self._resolve(Completion(
@@ -198,8 +231,11 @@ class Server:
                 futures = list(self._futures.values())
                 self._futures.clear()
             for fut in futures:
-                if not fut.done():
-                    fut.set_exception(e)
+                try:
+                    if not fut.done():
+                        fut.set_exception(e)
+                except concurrent.futures.InvalidStateError:
+                    pass                  # caller cancelled between check and set
         finally:
             try:
                 self._emit_summary()
@@ -250,4 +286,5 @@ class Server:
             prefill_wall_s=eng.prefill_wall_s,
             prefix_cache=(eng.prefix_cache.stats()
                           if eng.prefix_cache else None),
+            queue=self.queue.snapshot(),
             **self._series))

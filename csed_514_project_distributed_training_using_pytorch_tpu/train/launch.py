@@ -73,14 +73,22 @@ class Fleet:
 
     def __init__(self, command: list[str], *, num_processes: int,
                  platform: str | None = None, devices_per_process: int = 1,
-                 port: int | None = None, env: dict | None = None):
+                 port: int | None = None, env: dict | None = None,
+                 process_id_base: int = 0):
+        """``process_id_base`` offsets the children's ``JAX_PROCESS_ID``: the
+        serving router runs one single-process Fleet PER replica (so replicas
+        crash, restart, and get supervised independently), and the offset keeps
+        each replica's fleet-wide identity — heartbeat file index, fault-spec
+        ``proc=`` matching — intact even though every such fleet is size 1.
+        Rendezvous'd multi-process fleets keep the default 0 (a nonzero base
+        would break ``initialize_cluster``'s contiguous-rank contract)."""
         self.port = port or _free_port()
         base = dict(os.environ if env is None else env)
         self.procs = [
             subprocess.Popen(
                 [sys.executable, *command],
                 env=_child_env(base, port=self.port, num_processes=num_processes,
-                               process_id=i, platform=platform,
+                               process_id=process_id_base + i, platform=platform,
                                devices_per_process=devices_per_process),
             )
             for i in range(num_processes)

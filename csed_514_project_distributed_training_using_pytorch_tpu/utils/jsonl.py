@@ -1,0 +1,82 @@
+"""Backend-free JSONL emission + the shared percentile estimator.
+
+Two pieces of telemetry plumbing live here because their consumers must never
+initialize a jax backend (the supervisor doctrine: a process that supervises
+accelerator-owning children must never claim a device itself):
+
+- :class:`JsonlWriter` — append-per-emit, flushed-per-line JSONL. The full
+  ``utils.telemetry.TelemetryWriter`` is process-0 gated via
+  ``jax.process_index()``, which initializes a jax backend on first use; the
+  fleet-side processes (``resilience/supervisor.py``, ``serving/router.py``)
+  therefore use this writer instead. Same line schema, same shared reader
+  (``utils.metrics.load_metrics_jsonl``), same report CLI.
+- :func:`percentiles` — nearest-rank percentiles, the one estimator every
+  serving summary and the report CLI agree on. ``utils.telemetry`` re-exports
+  it; the backend-free router imports it from here directly.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+
+
+def _finite(x):
+    """Strict-JSONL rule (same as ``metrics.save_metrics_jsonl``): non-finite → None."""
+    if x is None:
+        return None
+    x = float(x)
+    return x if math.isfinite(x) else None
+
+
+def percentiles(xs, qs=(50, 95, 99)) -> dict | None:
+    """Nearest-rank percentiles of the non-None values, as ``{"p50": ..., ...}`` —
+    the serving events' latency-summary convention (shared with the report CLI so
+    both sides agree on the estimator). None when no values survive."""
+    xs = sorted(x for x in xs if x is not None)
+    if not xs:
+        return None
+    return {f"p{q}": _finite(xs[max(0, math.ceil(q / 100 * len(xs)) - 1)])
+            for q in qs}
+
+
+class JsonlWriter:
+    """Append-per-emit JSONL, flushed per line — fleet-side telemetry.
+
+    Append (never truncate): a preempted/restarted run re-runs with the same
+    telemetry path later, and its event history must survive into the resumed
+    run's report. ``path`` empty disables everything (emit is a no-op)."""
+
+    def __init__(self, path: str):
+        self.path = path or ""
+        self._fh = None
+        if self.path:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._fh = open(self.path, "a")
+        self._t0 = time.time()
+        # The router emits from N replica io threads plus its dispatch/monitor
+        # threads concurrently; interleaved write() fragments would corrupt the
+        # JSONL, so every emit is serialized.
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self._fh is not None
+
+    def emit(self, event: dict) -> None:
+        event.setdefault("t_s", round(time.time() - self._t0, 6))
+        line = json.dumps(event) + "\n"
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(line)
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

@@ -26,7 +26,17 @@ structured layer every perf PR proves its numbers through:
   ``serve``      one line per served request (``serving/server.py``): TTFT/TPOT,
                  queue wait, e2e latency, tokens/s, finish reason
   ``serve_summary``  once per serving run at drain: request counts, aggregate
-                 tokens/s, slot occupancy, p50/p95/p99 latency percentiles
+                 tokens/s, slot occupancy, p50/p95/p99 latency percentiles, and
+                 the admission queue's snapshot (depth/oldest-age/rejected)
+  ``route``      written by the fleet router (``serving/router.py``, via the
+                 jax-free ``utils.jsonl.JsonlWriter`` — same schema, same
+                 reader): one line per routed request — replica, affinity hit,
+                 redispatch count, finish, latencies
+  ``replica``    router lifecycle record: a replica start/fail/restart/dead
+                 transition with reason (crash/hung), exit code, backoff
+  ``router_summary``  once per router run at drain: fleet-wide counts,
+                 redispatch/duplicate totals, affinity hit rate, per-replica
+                 dispatch table, aggregated replica prefix-cache stats
   ``checkpoint`` one line per checkpoint save/restore (``utils/checkpoint.py``
                  savers + ``restore_for_resume``): op, path, full/sharded kind,
                  bytes, wall seconds, step, and — for the write-behind saver —
@@ -455,15 +465,12 @@ def mfu_event(flops_per_step: float | None, step_s: float | None) -> dict:
     return {"event": "mfu", **estimate_mfu(flops_per_step, step_s)}
 
 
-def percentiles(xs, qs=(50, 95, 99)) -> dict | None:
-    """Nearest-rank percentiles of the non-None values, as ``{"p50": ..., ...}`` —
-    the serving events' latency-summary convention (shared with the report CLI so
-    both sides agree on the estimator). None when no values survive."""
-    xs = sorted(x for x in xs if x is not None)
-    if not xs:
-        return None
-    return {f"p{q}": _finite(xs[max(0, math.ceil(q / 100 * len(xs)) - 1)])
-            for q in qs}
+# Nearest-rank percentiles — the one estimator all serving summaries and the
+# report CLI share. Owned by the jax-free utils.jsonl (the router needs it
+# without importing jax); re-exported here, its historical home.
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (  # noqa: E402
+    percentiles,
+)
 
 
 def serve_event(*, request_id: int, prompt_len: int, new_tokens: int, finish: str,
@@ -519,11 +526,14 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
                         prefill_chunks: int | None = None,
                         prefill_wall_s: float | None = None,
                         prefix_cache: dict | None = None,
+                        queue: dict | None = None,
                         ttft_s=(), tpot_s=(), e2e_s=(), queue_wait_s=()) -> dict:
     """The once-per-run serving aggregate, emitted at drain: counts, aggregate
     tokens/s over the server's whole wall clock, slot occupancy, and p50/p95/p99
     of each latency series (the per-request ``serve`` lines remain the raw data —
-    the summary is what survives a truncated log and what A-vs-B compares)."""
+    the summary is what survives a truncated log and what A-vs-B compares).
+    ``queue`` is the admission queue's ``RequestQueue.snapshot()`` (depth /
+    oldest-age / rejected count) — the backpressure ledger."""
     return {
         "event": "serve_summary",
         "requests": int(requests),
@@ -544,6 +554,7 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
             prefill_tokens / prefill_wall_s
             if prefill_tokens and prefill_wall_s else None),
         "prefix_cache": prefix_cache,
+        "queue": queue,
         "ttft_s": percentiles(ttft_s),
         "tpot_s": percentiles(tpot_s),
         "e2e_s": percentiles(e2e_s),

@@ -34,6 +34,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
     RequestQueue,
     SamplingParams,
     Server,
+    ServerStopped,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
     filter_logits_per_slot,
@@ -222,6 +223,33 @@ def test_request_queue_backpressure_and_deadline_expiry():
         q.submit(r(3))
 
 
+def test_request_queue_snapshot_and_requeue():
+    """The snapshot is the backpressure/health signal (depth, oldest-age,
+    cumulative rejects), and requeue is the router's redispatch door: front of
+    the line, allowed even after close (the request was already accepted)."""
+    q = RequestQueue(max_pending=2)
+    r = lambda i, arr=None: Request(prompt=np.zeros(0, np.int32),
+                                    max_new_tokens=1, request_id=i,
+                                    arrival_s=arr)
+    snap = q.snapshot()
+    assert (snap["depth"], snap["rejected"], snap["oldest_age_s"]) == (0, 0, None)
+    now = time.monotonic()
+    q.submit(r(0, arr=now - 2.0))
+    q.submit(r(1, arr=now))
+    for _ in range(3):
+        with pytest.raises(QueueFull):
+            q.submit(r(9))
+    snap = q.snapshot(now=now)
+    assert snap["depth"] == 2 and snap["rejected"] == 3
+    assert snap["oldest_age_s"] == pytest.approx(2.0)      # head waited longest
+    assert snap["max_pending"] == 2 and not snap["closed"]
+    q.close()
+    q.requeue(r(7))                    # redispatch beats both close and capacity
+    admitted, _ = q.take(now=now, max_n=1)
+    assert admitted[0].request_id == 7                     # front of the line
+    assert q.snapshot()["closed"]
+
+
 # -----------------------------------------------------------------------------------------
 # Server: concurrency, timeouts, drain, telemetry
 # -----------------------------------------------------------------------------------------
@@ -339,6 +367,65 @@ def test_server_stop_without_drain_expires_outstanding_work():
     comps = [f.result(timeout=120) for f in futures]
     assert all(c.finish in ("ok", "timeout") for c in comps)
     assert any(c.finish == "timeout" for c in comps)
+
+
+def test_server_drain_timeout_fails_pending_futures_with_server_stopped(tmp_path):
+    """Regression (PR 6 satellite): stop(drain=True, timeout=...) on a drain
+    that cannot finish in time must fail the still-pending futures with the
+    typed ServerStopped error — never leave callers hung on Future.result()."""
+    server = _tiny_server(tmp_path, num_slots=1)
+    server.start()
+    rng = np.random.default_rng(5)
+    futures = [server.submit(rng.integers(0, 8, size=3).astype(np.int32),
+                             max_new_tokens=10) for _ in range(12)]
+    with pytest.raises(ServerStopped):
+        # A 12-request drain through one slot cannot finish in 1e-4 s.
+        server.stop(timeout=1e-4)
+    # Every future is resolved NOW (result or typed failure), no hung waiters.
+    stopped = 0
+    for f in futures:
+        assert f.done()
+        try:
+            f.result(timeout=0)
+        except ServerStopped:
+            stopped += 1
+    assert stopped >= 1
+    # ServerStopped subclasses TimeoutError: pre-existing catch sites still work.
+    assert issubclass(ServerStopped, TimeoutError)
+    # The loop thread was reaped and the drain-time summary still written.
+    assert server._thread is None
+    rows = load_metrics_jsonl(str(tmp_path / "serve.jsonl"))
+    summaries = [r for r in rows if r["event"] == "serve_summary"]
+    assert len(summaries) == 1
+    # Satellite: the summary carries the admission queue's snapshot.
+    assert summaries[0]["queue"]["rejected"] == 0
+    assert "depth" in summaries[0]["queue"]
+
+
+def test_redispatch_replay_on_fresh_engine_is_token_identical():
+    """The correctness keystone of at-least-once redispatch (PR 6): a greedy
+    request that died mid-decode on one engine and is replayed from scratch on
+    a FRESH engine yields a token-identical stream — greedy decode consults no
+    RNG and no cross-request state, so replay is idempotent."""
+    model = _model()
+    params = _params(model)
+    req = Request(prompt=np.arange(1, 6, dtype=np.int32), max_new_tokens=8,
+                  request_id=0)
+    ref = _sequential_reference(model, params, req)
+
+    # Engine A: admit and decode PARTWAY (strictly between prompt end and
+    # completion), then abandon — the crash-mid-decode analog.
+    crashed = ContinuousBatchingEngine(model, params, num_slots=2)
+    crashed.admit(0, req)
+    for _ in range(3):
+        assert not crashed.step()           # mid-flight: nothing finished yet
+    # Engine B: a fresh engine (what a restarted replica is) replays fully.
+    fresh = ContinuousBatchingEngine(model, params, num_slots=2, seed=123)
+    replay = Request(prompt=req.prompt, max_new_tokens=req.max_new_tokens,
+                     request_id=0)
+    comps = fresh.run([replay])
+    assert comps[0].ok
+    np.testing.assert_array_equal(comps[0].tokens, ref)
 
 
 # -----------------------------------------------------------------------------------------

@@ -1,7 +1,8 @@
-"""Load generator for the in-process serving engine: open/closed loop, Poisson arrivals.
+"""Load generator for the serving stack: open/closed loop, chat sessions, fleets.
 
-Drives ``serving.Server`` (slot-based continuous batching over the KV-cache decoder)
-with a reproducible synthetic workload and leaves a serve-telemetry JSONL behind for
+Drives ``serving.Server`` (one in-process engine) or — with ``--replicas N`` —
+``serving.Router`` (a process-per-replica fleet over ``serving/replica.py``)
+with a reproducible synthetic workload and leaves a telemetry JSONL behind for
 ``tools/telemetry_report.py``:
 
 - **open loop** (``--mode open``): requests arrive on a Poisson process at
@@ -10,7 +11,14 @@ with a reproducible synthetic workload and leaves a serve-telemetry JSONL behind
   as rejected requests, i.e. backpressure);
 - **closed loop** (``--mode closed``): ``--concurrency`` clients each keep exactly
   one request in flight — the throughput probe (tokens/s at a fixed offered
-  parallelism).
+  parallelism);
+- **chat** (``--scenario chat``): ``--sessions`` concurrent multi-turn sessions,
+  each turn resubmitting the prior context plus the model's reply plus a few
+  fresh "user" tokens — the workload where prefix reuse actually pays, because
+  every turn's prompt extends the previous one. With ``--replicas N`` this is
+  the prefix-affinity A/B: ``--affinity on`` routes a session's turns to the
+  replica whose ``prefix_cache`` holds its history, ``--affinity off`` is the
+  least-loaded baseline (compare the summaries' prefix-cache hit rates).
 
 The prompt/length mix is sampled per request from ``--prompt-lens`` and
 ``[1, --max-new-tokens]`` under a seeded RNG, so an A-vs-B pair of runs offers
@@ -37,6 +45,9 @@ Usage::
         --checkpoint results/model_lm.ckpt --telemetry results/serve.jsonl
     python tools/serve_loadgen.py --prompt-dist long --prefix-cache 8 \\
         --shared-prefix-len 256 --summary-json results/prefill_on.json
+    python tools/serve_loadgen.py --replicas 2 --scenario chat --sessions 8 \\
+        --turns 4 --prefix-cache 8 --affinity on --telemetry results/router.jsonl \\
+        --summary-json results/chat_affinity_on.json
     python tools/telemetry_report.py results/serve.jsonl
 """
 
@@ -53,38 +64,6 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
-
-
-def build_model_and_params(args):
-    """The decode model under test + its params (checkpoint or seeded init)."""
-    import jax
-    import jax.numpy as jnp
-
-    from csed_514_project_distributed_training_using_pytorch_tpu.models import lm
-
-    model = lm.TransformerLM(
-        vocab_size=args.num_levels + 1, seq_len=args.seq_len,
-        embed_dim=args.embed_dim, num_layers=args.num_layers,
-        num_heads=args.num_heads,
-        num_kv_heads=args.kv_heads or None,
-        attention_window=args.attention_window, rope=args.rope)
-    ref = model.init({"params": jax.random.PRNGKey(args.seed)},
-                     jnp.zeros((1, model.seq_len), jnp.int32))["params"]
-    if not args.checkpoint:
-        return model, ref
-    from flax import serialization
-
-    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
-        checkpoint,
-    )
-
-    with open(args.checkpoint, "rb") as f:
-        raw = serialization.msgpack_restore(f.read())
-    if isinstance(raw, dict) and "params" in raw:     # full TrainState checkpoint
-        return model, serialization.from_state_dict(jax.device_get(ref),
-                                                    raw["params"])
-    # params-only export: the one checkpoint reader the repo already has
-    return model, checkpoint.load_params(args.checkpoint, jax.device_get(ref))
 
 
 def prompt_len_mix(args) -> list[int]:
@@ -185,6 +164,103 @@ def run_closed_loop(server, specs, concurrency):
     return futures, rejected[0]
 
 
+def run_chat(front, args, vocab_size):
+    """``--sessions`` concurrent multi-turn sessions against ``front`` (Server
+    or Router — same ``submit`` surface). Each session thread keeps one request
+    in flight: turn t's prompt is the full emitted stream of turn t-1 (context +
+    reply) plus ``--turn-user-tokens`` fresh tokens. Greedy decode makes the
+    whole workload deterministic given the params, so an A-vs-B pair of runs
+    (e.g. affinity on/off) offers byte-identical traffic.
+
+    Returns ``(completions, rejected, sessions_done)`` — a session counts done
+    when it ran all its turns (or cleanly hit the seq_len ceiling)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
+        QueueFull,
+        SamplingParams,
+    )
+
+    sampling = SamplingParams(temperature=args.temperature, top_k=args.top_k,
+                              top_p=args.top_p)
+    lens = [l for l in prompt_len_mix(args) if l > 0] or [1]
+    lock = threading.Lock()
+    comps: list = []
+    rejected = [0]
+    done_sessions = [0]
+    errors: list = []
+
+    def session(sid: int):
+        rng = np.random.default_rng(args.seed + 1000 * (sid + 1))
+        prompt = rng.integers(0, vocab_size - 1,
+                              size=int(rng.choice(lens))).astype(np.int32)
+        for _ in range(args.turns):
+            new = int(rng.integers(1, args.max_new_tokens + 1))
+            if len(prompt) + new >= args.seq_len:
+                break                      # context window full: session over
+            try:
+                fut = front.submit(prompt, max_new_tokens=new, sampling=sampling)
+            except QueueFull:
+                with lock:
+                    rejected[0] += 1
+                return                     # overloaded: the session gives up
+            comp = fut.result()
+            with lock:
+                comps.append(comp)
+            if not comp.ok:
+                return
+            user = rng.integers(0, vocab_size - 1,
+                                size=args.turn_user_tokens).astype(np.int32)
+            prompt = np.concatenate([np.asarray(comp.tokens, np.int32), user])
+        with lock:
+            done_sessions[0] += 1
+
+    def guarded(sid: int):
+        # A failed front end (e.g. ServerStopped after every replica died)
+        # must surface as a loadgen failure, not as a silently shorter run.
+        try:
+            session(sid)
+        except BaseException as e:         # noqa: BLE001 — recorded, re-raised
+            with lock:
+                errors.append((sid, e))
+
+    threads = [threading.Thread(target=guarded, args=(i,), name=f"chat-{i}")
+               for i in range(args.sessions)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        sid, first = errors[0]
+        raise RuntimeError(
+            f"{len(errors)}/{args.sessions} chat sessions died "
+            f"(first: session {sid}: {type(first).__name__}: {first})") from first
+    return comps, rejected[0], done_sessions[0]
+
+
+def build_replica_command(args) -> list[str]:
+    """The ``serving/replica.py`` argv mirroring this run's model/engine flags
+    (the router appends --port/--replica-id/--heartbeat-dir per replica)."""
+    pkg = "csed_514_project_distributed_training_using_pytorch_tpu"
+    cmd = ["-m", f"{pkg}.serving.replica",
+           "--seq-len", str(args.seq_len), "--num-levels", str(args.num_levels),
+           "--embed-dim", str(args.embed_dim),
+           "--num-layers", str(args.num_layers),
+           "--num-heads", str(args.num_heads), "--kv-heads", str(args.kv_heads),
+           "--attention-window", str(args.attention_window),
+           "--seed", str(args.seed),
+           "--num-slots", str(args.num_slots),
+           "--max-pending", str(args.max_pending),
+           "--timeout-s", str(args.timeout_s),
+           "--prefill-chunks", args.prefill_chunks,
+           "--prefill-budget", str(args.prefill_budget),
+           "--prefix-cache", str(args.prefix_cache),
+           "--warmup", str(args.warmup)]
+    if args.rope:
+        cmd.append("--rope")
+    if args.checkpoint:
+        cmd += ["--checkpoint", args.checkpoint]
+    return cmd
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -218,7 +294,38 @@ def main(argv: list[str] | None = None) -> int:
                         "every prefill chunk size, and the prefix-cache install "
                         "path, then reset the engine's counters — so latency "
                         "percentiles measure the schedule, not XLA (0 = off)")
+    f = p.add_argument_group("fleet (0 replicas = the in-process server)")
+    f.add_argument("--replicas", type=int, default=0,
+                   help="run a serving.Router fleet of N replica PROCESSES "
+                        "(serving/replica.py) instead of the in-process server")
+    f.add_argument("--affinity", choices=("on", "off"), default="on",
+                   help="prefix-affinity routing vs least-loaded baseline "
+                        "(the router A/B switch)")
+    f.add_argument("--replica-platform", default="cpu",
+                   help="JAX_PLATFORMS for replica processes; '' = inherit "
+                        "the environment (e.g. to put each replica's engine "
+                        "on the accelerator)")
+    f.add_argument("--router-max-pending", type=int, default=0,
+                   help="router admission queue bound (0 = unbounded)")
+    f.add_argument("--heartbeat-dir", default="",
+                   help="replica liveness dir (default: a temp dir)")
+    f.add_argument("--heartbeat-timeout-s", type=float, default=20.0,
+                   help="beat staleness that counts a replica as hung")
+    f.add_argument("--max-restarts", type=int, default=3,
+                   help="per-replica restart budget")
+    f.add_argument("--backoff-s", type=float, default=0.5,
+                   help="restart backoff base (exponential, capped)")
     g = p.add_argument_group("load")
+    g.add_argument("--scenario", choices=("batch", "chat"), default="batch",
+                   help="'batch' = independent requests (open/closed loop); "
+                        "'chat' = multi-turn sessions, each turn resubmitting "
+                        "prior context + reply (the prefix-affinity workload)")
+    g.add_argument("--sessions", type=int, default=8,
+                   help="chat: concurrent sessions")
+    g.add_argument("--turns", type=int, default=4,
+                   help="chat: turns per session")
+    g.add_argument("--turn-user-tokens", type=int, default=4,
+                   help="chat: fresh 'user' tokens appended between turns")
     g.add_argument("--mode", choices=("open", "closed"), default="open")
     g.add_argument("--rate", type=float, default=8.0,
                    help="open loop: Poisson arrival rate, req/s")
@@ -245,110 +352,190 @@ def main(argv: list[str] | None = None) -> int:
                    help="write the run summary (percentiles + prefill stats) "
                         "as one JSON document — the committed-artifact format")
     args = p.parse_args(argv)
-    if args.mode == "open" and args.rate <= 0:
-        raise SystemExit("--rate must be > 0 in open-loop mode")
-    if args.mode == "closed" and args.concurrency < 1:
-        raise SystemExit("--concurrency must be >= 1 in closed-loop mode")
+    if args.scenario == "batch":
+        if args.mode == "open" and args.rate <= 0:
+            raise SystemExit("--rate must be > 0 in open-loop mode")
+        if args.mode == "closed" and args.concurrency < 1:
+            raise SystemExit("--concurrency must be >= 1 in closed-loop mode")
+    elif args.sessions < 1 or args.turns < 1:
+        raise SystemExit("--sessions and --turns must be >= 1 in chat mode")
     if args.max_new_tokens < 1:
         raise SystemExit("--max-new-tokens must be >= 1")
 
-    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
-        ContinuousBatchingEngine,
-        Request,
-        Server,
-    )
+    vocab_size = args.num_levels + 1
+    engine = server = router = None
+    if args.replicas > 0:
+        # Fleet mode: the model lives in the replica processes; this process
+        # stays backend-free (the router supervises accelerator owners).
+        import tempfile
 
-    model, params = build_model_and_params(args)
-    specs = make_workload(args, model.vocab_size)
-    chunk_sizes = tuple(int(x) for x in args.prefill_chunks.split(",") if x)
-    engine = ContinuousBatchingEngine(model, params, num_slots=args.num_slots,
-                                      seed=args.seed,
-                                      prefill_chunk_sizes=chunk_sizes,
-                                      prefill_chunk_budget=args.prefill_budget,
-                                      prefix_cache_entries=args.prefix_cache)
-    if args.warmup:
-        warm_rng = np.random.default_rng(args.seed + 17)
-        for _ in range(args.warmup):
-            # One request per chunk size (each plan = exactly that size), one
-            # prompt-less decode, and a repeated prompt when the prefix cache is
-            # on (compiles the hit-install path). reset_stats() wipes the
-            # ledger — including warmup prefix entries — before measurement.
-            for size in engine.prefill_chunk_sizes:
-                wp = warm_rng.integers(
-                    0, model.vocab_size - 1,
-                    size=min(size, args.seq_len - 1)).astype(np.int32)
-                engine.run([Request(prompt=wp, max_new_tokens=1)])
-                if engine.prefix_cache is not None:
-                    engine.run([Request(prompt=wp, max_new_tokens=1)])
-            engine.run([Request(prompt=np.zeros(0, np.int32),
-                                max_new_tokens=2)])
-        engine.reset_stats()
-    server = Server(engine, max_pending=args.max_pending,
-                    default_timeout_s=args.timeout_s or None,
-                    telemetry=args.telemetry)
-    server.start()
-    t0 = time.monotonic()
-    if args.mode == "open":
-        futures, rejected = run_open_loop(server, specs, args.rate,
-                                          np.random.default_rng(args.seed + 1))
+        from csed_514_project_distributed_training_using_pytorch_tpu.serving.router import (
+            Router,
+        )
+
+        # Replica processes must import this package no matter the caller's
+        # cwd — ship the repo root (already first on OUR sys.path, line 53)
+        # through their PYTHONPATH.
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (f"{repo_root}:{env['PYTHONPATH']}"
+                             if env.get("PYTHONPATH") else repo_root)
+        router = Router(
+            build_replica_command(args), num_replicas=args.replicas,
+            platform=args.replica_platform or None,
+            max_pending=args.router_max_pending,
+            default_timeout_s=args.timeout_s or None,
+            affinity=args.affinity == "on",
+            heartbeat_dir=args.heartbeat_dir or tempfile.mkdtemp(
+                prefix="serve_hb_"),
+            heartbeat_timeout_s=args.heartbeat_timeout_s,
+            max_restarts=args.max_restarts, backoff_s=args.backoff_s,
+            telemetry=args.telemetry, env=env)
+        front = router.start()
+        if not router.wait_ready(timeout=600):
+            router.stop(drain=False)
+            raise SystemExit("fleet did not come up within 600s "
+                             "(or crash-looped its restart budget away — "
+                             "check the replica command/stderr)")
     else:
-        futures, rejected = run_closed_loop(server, specs, args.concurrency)
-    comps = [f.result() for f in futures]
-    server.stop()                               # graceful drain (a no-op by now)
+        # The in-process baseline is built by the SAME code path as a fleet
+        # replica (model construction, checkpoint-format fallback, warmup
+        # recipe) — one owner, so the single-engine and fleet sides of an A/B
+        # can never drift apart.
+        from csed_514_project_distributed_training_using_pytorch_tpu.serving.replica import (
+            build_engine_server,
+        )
+
+        engine, server = build_engine_server(args)
+        front = server.start()
+
+    t0 = time.monotonic()
+    sessions_done = None
+    try:
+        if args.scenario == "chat":
+            comps, rejected, sessions_done = run_chat(front, args, vocab_size)
+        else:
+            specs = make_workload(args, vocab_size)
+            if args.mode == "open":
+                futures, rejected = run_open_loop(
+                    front, specs, args.rate, np.random.default_rng(args.seed + 1))
+            else:
+                futures, rejected = run_closed_loop(front, specs,
+                                                    args.concurrency)
+            comps = [f.result() for f in futures]
+    except BaseException:
+        # Never orphan replica processes on a failed run.
+        try:
+            front.stop(drain=False)
+        except Exception:
+            pass
+        raise
+    # Wall stops when the last completion is in hand: stop() below pays stats
+    # collection + replica teardown, which served no tokens and must not
+    # deflate the committed tokens_per_s.
     wall = time.monotonic() - t0
+    router_summary = None
+    if router is not None:
+        router_summary = router.stop(timeout=600)   # graceful drain + stats
+    else:
+        server.stop()                               # graceful drain (a no-op by now)
 
     ok = sum(c.ok for c in comps)
     timeouts = sum(c.finish == "timeout" for c in comps)
     new_tokens = sum(c.new_tokens for c in comps)
-    print(f"{args.mode}-loop: {len(comps)} completed ({ok} ok, {timeouts} timeout, "
-          f"{rejected} rejected) in {wall:.2f}s")
-    occ = engine.slot_occupancy                 # None when no step ever ran
-    print(f"generated {new_tokens} tokens, {new_tokens / wall:.1f} tokens/s, "
-          f"slot occupancy {'-' if occ is None else f'{occ:.2f}'}, "
-          f"decode compilations {engine.trace_count}")
-    prefill_rate = (engine.prefill_tokens / engine.prefill_wall_s
-                    if engine.prefill_wall_s else None)
-    hits = engine.prefix_cache.stats() if engine.prefix_cache else None
-    print(f"prefilled {engine.prefill_tokens} prompt tokens in "
-          f"{engine.prefill_invocations} chunks "
-          f"({'-' if prefill_rate is None else f'{prefill_rate:.1f}'} tokens/s, "
-          f"sizes {list(engine.prefill_chunk_sizes) or 'off'})"
-          + (f", prefix hits {hits['hits']}/{hits['queries']} "
-             f"({hits['hit_tokens']} tokens reused)" if hits else ""))
+    label = (f"chat ({args.sessions} sessions x {args.turns} turns)"
+             if args.scenario == "chat" else f"{args.mode}-loop")
+    print(f"{label}: {len(comps)} completed ({ok} ok, {timeouts} timeout, "
+          f"{rejected} rejected) in {wall:.2f}s"
+          + (f", {sessions_done}/{args.sessions} sessions ran to completion"
+             if sessions_done is not None else ""))
+    if router is not None:
+        rs = router_summary
+        pc = rs.get("prefix_cache") or {}
+        hit_rate = (pc["hits"] / pc["queries"] if pc.get("queries") else None)
+        aff = rs["affinity_rate"]
+        print(f"fleet: {args.replicas} replicas, affinity {args.affinity}: "
+              f"{new_tokens} tokens, {new_tokens / wall:.1f} tokens/s, "
+              f"affinity rate {'-' if aff is None else f'{aff:.2f}'}, "
+              f"prefix hit rate {'-' if hit_rate is None else f'{hit_rate:.2f}'}")
+        print(f"resilience: {rs['redispatches']} redispatches "
+              f"({rs['redispatched_requests']} requests), "
+              f"{rs['replica_restarts']} replica restart(s), "
+              f"{rs['duplicates']} duplicate completion(s)")
+    else:
+        occ = engine.slot_occupancy             # None when no step ever ran
+        print(f"generated {new_tokens} tokens, {new_tokens / wall:.1f} tokens/s, "
+              f"slot occupancy {'-' if occ is None else f'{occ:.2f}'}, "
+              f"decode compilations {engine.trace_count}")
+        prefill_rate = (engine.prefill_tokens / engine.prefill_wall_s
+                        if engine.prefill_wall_s else None)
+        hits = engine.prefix_cache.stats() if engine.prefix_cache else None
+        print(f"prefilled {engine.prefill_tokens} prompt tokens in "
+              f"{engine.prefill_invocations} chunks "
+              f"({'-' if prefill_rate is None else f'{prefill_rate:.1f}'} tokens/s, "
+              f"sizes {list(engine.prefill_chunk_sizes) or 'off'})"
+              + (f", prefix hits {hits['hits']}/{hits['queries']} "
+                 f"({hits['hit_tokens']} tokens reused)" if hits else ""))
     if args.telemetry:
         print(f"serve telemetry -> {args.telemetry} "
               f"(render: python tools/telemetry_report.py {args.telemetry})")
     if args.summary_json:
         import json
 
-        from csed_514_project_distributed_training_using_pytorch_tpu.utils.telemetry import (
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
             percentiles,
         )
 
         doc = {
-            "mode": args.mode,
+            "scenario": args.scenario,
+            "mode": args.mode if args.scenario == "batch" else None,
             "requests": len(comps), "ok": ok, "timeout": timeouts,
             "rejected": rejected, "wall_s": wall,
             "prompt_dist": args.prompt_dist,
             "prompt_lens": prompt_len_mix(args),
             "shared_prefix_len": args.shared_prefix_len,
             "num_slots": args.num_slots,
-            "prefill_chunk_sizes": list(engine.prefill_chunk_sizes),
             "prefill_chunk_budget": args.prefill_budget,
             "prefix_cache_entries": args.prefix_cache,
             "new_tokens": new_tokens,
             "tokens_per_s": new_tokens / wall if wall else None,
-            "prefill_tokens": engine.prefill_tokens,
-            "prefill_chunks": engine.prefill_invocations,
-            "prefill_wall_s": engine.prefill_wall_s,
-            "prefill_tokens_per_s": prefill_rate,
-            "prefix_cache": hits,
-            "decode_compilations": engine.trace_count,
-            "prefill_compilations": dict(engine.prefill_trace_counts),
             "ttft_s": percentiles([c.ttft_s for c in comps]),
             "e2e_s": percentiles([c.e2e_s for c in comps]),
             "queue_wait_s": percentiles([c.queue_wait_s for c in comps]),
         }
+        if args.scenario == "chat":
+            doc.update(sessions=args.sessions, turns=args.turns,
+                       turn_user_tokens=args.turn_user_tokens,
+                       sessions_done=sessions_done)
+        if router is not None:
+            rs = router_summary
+            pc = rs.get("prefix_cache") or {}
+            doc.update(
+                replicas=args.replicas, affinity=args.affinity,
+                affinity_rate=rs["affinity_rate"],
+                redispatches=rs["redispatches"],
+                redispatched_requests=rs["redispatched_requests"],
+                duplicate_completions=rs["duplicates"],
+                replica_restarts=rs["replica_restarts"],
+                prefix_cache=rs.get("prefix_cache"),
+                prefix_hit_rate=(pc["hits"] / pc["queries"]
+                                 if pc.get("queries") else None),
+                per_replica=[{k: r[k] for k in ("replica", "state", "restarts",
+                                                "dispatched", "completed")}
+                             for r in rs["per_replica"]],
+                router_queue=rs.get("queue"))
+        else:
+            doc.update(
+                prefill_chunk_sizes=list(engine.prefill_chunk_sizes),
+                prefill_tokens=engine.prefill_tokens,
+                prefill_chunks=engine.prefill_invocations,
+                prefill_wall_s=engine.prefill_wall_s,
+                prefill_tokens_per_s=prefill_rate,
+                prefix_cache=hits,
+                prefix_hit_rate=(hits["hits"] / hits["queries"]
+                                 if hits and hits["queries"] else None),
+                decode_compilations=engine.trace_count,
+                prefill_compilations=dict(engine.prefill_trace_counts))
         with open(args.summary_json, "w") as f:
             json.dump(doc, f, indent=1)
         print(f"summary json -> {args.summary_json}")

@@ -5,7 +5,11 @@ compile / epoch / health / mfu / checkpoint / preempt events), ``bench*.py
 --telemetry`` output (bench events), serving logs from ``serving/server.py`` /
 ``tools/serve_loadgen.py`` (serve / prefill / serve_summary events — rendered as
 a TTFT/TPOT/e2e latency-percentile table plus aggregate decode AND prefill
-tokens/s with prefix-cache hit rates), supervisor logs
+tokens/s with prefix-cache hit rates), fleet-router logs from
+``serving/router.py`` (route / replica / router_summary events — rendered as a
+per-replica request/token table with affinity hit rate, redispatch and restart
+counts; ``affinity hit rate``/``redispatches`` become A-vs-B rows for the
+affinity on/off comparison), supervisor logs
 from ``tools/fleet_supervise.py`` (restart events — rendered as a restart count
 with reasons), or the loss-curve ``metrics.jsonl`` companions
 (``kind`` rows) — all read through the one shared reader,
@@ -180,6 +184,56 @@ def summarize(path: str) -> dict:
         span = max(ts) - min(starts) if ts and starts else None
         s["serve_tokens_per_s"] = toks / span if toks and span else None
 
+    # Fleet-router runs (serving/router.py): per-request "route" lines give the
+    # latency percentiles (reusing the serve table), the drain-time
+    # router_summary the per-replica table, affinity hit rate, and redispatch/
+    # restart counts; replica lifecycle events fill restart reasons when the
+    # summary is missing (killed run).
+    routes = by_event.get("route", [])
+    rsum = (by_event.get("router_summary") or [None])[-1]
+    if routes:
+        s.setdefault("serve_requests", len(routes))
+        s.setdefault("serve_ok", sum(r.get("finish") == "ok" for r in routes))
+        s.setdefault("serve_timeout",
+                     sum(r.get("finish") == "timeout" for r in routes))
+        s["redispatches"] = sum(r.get("redispatches") or 0 for r in routes)
+        hits = sum(bool(r.get("affinity_hit")) for r in routes)
+        s["affinity_rate"] = hits / len(routes)
+        for name in SERVE_SERIES:
+            pcts = _percentiles([r.get(name) for r in routes], qs=SERVE_QS) or {}
+            for q in SERVE_QS:
+                s.setdefault(f"serve_{name}_p{q}", pcts.get(f"p{q}"))
+    replica_evs = by_event.get("replica", [])
+    if replica_evs:
+        fails = [r for r in replica_evs if r.get("action") in ("fail", "dead")]
+        s["replica_restarts"] = sum(r.get("action") == "restart"
+                                    for r in replica_evs)
+        s["replica_fail_reasons"] = [r.get("reason") for r in fails]
+    if rsum:
+        s.setdefault("serve_requests", rsum.get("requests"))
+        s.setdefault("serve_ok", rsum.get("ok"))
+        s.setdefault("serve_timeout", rsum.get("timeout"))
+        s["serve_tokens_per_s"] = rsum.get("tokens_per_s")
+        s["router_replicas"] = rsum.get("replicas")
+        s["affinity_rate"] = rsum.get("affinity_rate")
+        s["redispatches"] = rsum.get("redispatches")
+        s["duplicate_completions"] = rsum.get("duplicates")
+        s["replica_restarts"] = rsum.get("replica_restarts")
+        s["replica_table"] = [
+            {"replica": r.get("replica"), "state": r.get("state"),
+             "restarts": r.get("restarts"), "dispatched": r.get("dispatched"),
+             "completed": r.get("completed")}
+            for r in rsum.get("per_replica") or []]
+        pc = rsum.get("prefix_cache") or {}
+        if pc.get("queries"):
+            s["prefix_hits"] = pc.get("hits")
+            s["prefix_hit_tokens"] = pc.get("hit_tokens")
+            s["prefix_hit_rate"] = pc["hits"] / pc["queries"]
+        for name in SERVE_SERIES:
+            pcts = rsum.get(name) or {}
+            for q in SERVE_QS:
+                s.setdefault(f"serve_{name}_p{q}", pcts.get(f"p{q}"))
+
     # Checkpoint traffic (utils/checkpoint.py savers + restores): how much resume
     # insurance the run paid for, and what it cost in wall time.
     ckpts = by_event.get("checkpoint", [])
@@ -261,6 +315,19 @@ def print_summary(s: dict) -> None:
         print(f"   serve: {s['serve_requests']} requests "
               f"({_fmt(s.get('serve_ok'))} ok, {_fmt(s.get('serve_timeout'))} "
               f"timeout)  tokens/s {_fmt(s.get('serve_tokens_per_s'))}{occ}")
+        if s.get("router_replicas"):
+            reasons = s.get("replica_fail_reasons") or []
+            print(f"   router: {s['router_replicas']} replicas  "
+                  f"affinity rate {_fmt(s.get('affinity_rate'))}  "
+                  f"redispatches {_fmt(s.get('redispatches'))}  "
+                  f"restarts {_fmt(s.get('replica_restarts'))}"
+                  + (f" ({', '.join(reasons)})" if reasons else ""))
+            for r in s.get("replica_table") or []:
+                print(f"     replica {r['replica']}: "
+                      f"{_fmt(r.get('dispatched'))} dispatched, "
+                      f"{_fmt(r.get('completed'))} completed, "
+                      f"{_fmt(r.get('restarts'))} restart(s), "
+                      f"{r.get('state')}")
         if s.get("prefill_tokens") is not None:
             hit = ""
             if s.get("prefix_hit_rate") is not None:
@@ -294,6 +361,9 @@ COMPARE_ROWS = [
     ("serve tokens/s", "serve_tokens_per_s"),
     ("prefill tok/s", "prefill_tokens_per_s"),
     ("prefix hit rate", "prefix_hit_rate"),
+    ("affinity hit rate", "affinity_rate"),
+    ("redispatches", "redispatches"),
+    ("replica restarts", "replica_restarts"),
     ("ttft_s p50", "serve_ttft_s_p50"),
     ("ttft_s p99", "serve_ttft_s_p99"),
     ("tpot_s p50", "serve_tpot_s_p50"),
