@@ -42,6 +42,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from csed_514_project_distributed_training_using_pytorch_tpu import ops
+from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
+    quant as quant_ops,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
     MASK_VALUE,
 )
@@ -198,18 +201,36 @@ DECODE_SEGMENT = 128   # generate()'s static-prefix growth unit: segment j atten
                        # of per-segment scan bodies compile in seconds
 
 
-def init_cache(model: TransformerLM, batch: int) -> dict:
+def init_cache(model: TransformerLM, batch: int, *,
+               kv_dtype: str | None = None) -> dict:
     """Zeroed per-layer K/V caches ``[B, seq_len, KV_H, Dh]`` in the model's
     activation dtype — a bf16 model decodes against a bf16 cache, halving the HBM
     read that dominates batched decode (the score/value einsums still accumulate
     in f32: mixed-dtype promotion upcasts on-chip, after the narrow HBM read).
     f32 models keep an f32 cache and bit-exact decode parity. Under GQA the cache
-    holds only the ``num_kv_heads`` K/V heads — the decode-memory win."""
+    holds only the ``num_kv_heads`` K/V heads — the decode-memory win.
+
+    ``kv_dtype`` (an ``ops.quant.KV_DTYPES`` spec; ``None`` == ``"model"``, the
+    bitwise-unchanged default) selects the plane dtype. ``"fp32"``/``"bf16"``
+    are plain-cast planes. ``"int8"``/``"fp8"`` are QUANTIZE-ON-WRITE planes:
+    every written row carries one symmetric scale per KV head, stored in
+    ``k_scale``/``v_scale`` planes ``[B, seq_len, KV_H]`` (f32) alongside the
+    narrow planes — the decode/prefill paths quantize rows as they write and
+    dequantize inside the attention einsums, so HBM streams ~quarter the bytes
+    while the scale adds 4 bytes per head per position."""
     head_dim = model.embed_dim // model.num_heads
-    shape = (batch, model.seq_len, model.num_kv_heads or model.num_heads, head_dim)
-    return {f"block_{i}": {"k": jnp.zeros(shape, model.dtype),
-                           "v": jnp.zeros(shape, model.dtype)}
-            for i in range(model.num_layers)}
+    kvh = model.num_kv_heads or model.num_heads
+    shape = (batch, model.seq_len, kvh, head_dim)
+    dtype, scaled = quant_ops.resolve_kv_dtype(kv_dtype or "model", model.dtype)
+
+    def layer():
+        planes = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        if scaled:
+            planes["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+            planes["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        return planes
+
+    return {f"block_{i}": layer() for i in range(model.num_layers)}
 
 
 def decode_step(model: TransformerLM, params, cache: dict, ids_t: jax.Array,
@@ -229,6 +250,15 @@ def decode_step(model: TransformerLM, params, cache: dict, ids_t: jax.Array,
     O(seq_len) to O(t) amortized, with every shape still static. Positions beyond
     ``t`` inside the prefix are masked exactly as before, so the math is unchanged.
     """
+    if "k_scale" in cache.get("block_0", {}):
+        # Quantized (int8/fp8) planes are a serving-path feature: the slot entry
+        # points quantize-on-write and dequantize-in-kernel. This path would
+        # astype raw values into the narrow dtype (no scale) and attend against
+        # the codes — garbage, silently.
+        raise ValueError(
+            "decode_step reads raw K/V planes only — use decode_step_slots/"
+            "prefill_chunk for a quantized cache, or init_cache() without "
+            "kv_dtype")
     b = ids_t.shape[0]
     e, nh = model.embed_dim, model.num_heads
     hd = e // nh
@@ -308,6 +338,16 @@ def decode_step_slots(model: TransformerLM, params, cache: dict,
     slot by its own position. No ``prefix_len`` narrowing: slots sit at arbitrary
     positions, so every step reads the full ``[B, S]`` cache — the serving cache
     re-read is O(S) per token by design (fixed shapes beat a per-mix recompile).
+
+    A QUANTIZED cache (``init_cache(..., kv_dtype="int8"/"fp8")`` — detected by
+    its ``k_scale`` planes) changes only the plane I/O, never the program count:
+    the freshly projected K/V rows are quantized on write (one scale per KV
+    head, written by the same vmapped row scatter), and the score/value einsums
+    read the dequantized planes — an on-chip upcast fused into the einsum, so
+    the per-step HBM read is the NARROW plane plus the scale vector. Params may
+    likewise hold ``ops.quant.QuantizedTensor`` kernels (``quantize_params``);
+    plain arrays take the exact ``ops.dense`` path, so the unquantized trace is
+    bitwise identical to the pre-quantization code.
     """
     b = ids_t.shape[0]
     e, nh = model.embed_dim, model.num_heads
@@ -335,13 +375,14 @@ def decode_step_slots(model: TransformerLM, params, cache: dict,
         a = p["attn"]
         x = ops.layer_norm(h, p["ln1_scale"], p["ln1_bias"])
         if kvh == nh:
-            qkv = ops.dense(x, a["qkv_kernel"], a["qkv_bias"])    # [B, 3E]
+            qkv = quant_ops.dense_any(x, a["qkv_kernel"], a["qkv_bias"])  # [B, 3E]
             q = qkv[:, :e].reshape(b, nh, hd)
             k = qkv[:, e:2 * e].reshape(b, kvh, hd)
             v = qkv[:, 2 * e:].reshape(b, kvh, hd)
         else:  # GQA: split projections, kvh-head K/V (the smaller cache)
-            q = ops.dense(x, a["q_kernel"], a["q_bias"]).reshape(b, nh, hd)
-            kv = ops.dense(x, a["kv_kernel"], a["kv_bias"]).reshape(b, 2, kvh, hd)
+            q = quant_ops.dense_any(x, a["q_kernel"], a["q_bias"]).reshape(b, nh, hd)
+            kv = quant_ops.dense_any(x, a["kv_kernel"],
+                                     a["kv_bias"]).reshape(b, 2, kvh, hd)
             k, v = kv[:, 0], kv[:, 1]
         if model.rope:
             # positions [B] on [B, H, D]: the batch dim takes apply_rotary's
@@ -349,23 +390,71 @@ def decode_step_slots(model: TransformerLM, params, cache: dict,
             q = apply_rotary(q, t)
             k = apply_rotary(k, t)
         layer = cache[f"block_{i}"]
-        k_cache = write_row(layer["k"], k.astype(layer["k"].dtype), t)
-        v_cache = write_row(layer["v"], v.astype(layer["v"].dtype), t)
-        cache = {**cache, f"block_{i}": {"k": k_cache, "v": v_cache}}
+        if "k_scale" in layer:   # quantize-on-write planes with per-head scales
+            kq, ks = quant_ops.quantize_rows(k, layer["k"].dtype)
+            vq, vs = quant_ops.quantize_rows(v, layer["v"].dtype)
+            k_cache = write_row(layer["k"], kq, t)
+            v_cache = write_row(layer["v"], vq, t)
+            ks_cache = write_row(layer["k_scale"], ks, t)
+            vs_cache = write_row(layer["v_scale"], vs, t)
+            cache = {**cache, f"block_{i}": {
+                "k": k_cache, "v": v_cache,
+                "k_scale": ks_cache, "v_scale": vs_cache}}
+            # Dequantize-in-kernel: the upcast/rescale fuses into the einsum
+            # that consumes it — HBM streamed the narrow plane.
+            k_read = quant_ops.dequantize_rows(k_cache, ks_cache)
+            v_read = quant_ops.dequantize_rows(v_cache, vs_cache)
+        else:
+            k_cache = write_row(layer["k"], k.astype(layer["k"].dtype), t)
+            v_cache = write_row(layer["v"], v.astype(layer["v"].dtype), t)
+            cache = {**cache, f"block_{i}": {"k": k_cache, "v": v_cache}}
+            k_read, v_read = k_cache, v_cache
         qg = q.reshape(b, kvh, rep, hd)
-        scores = jnp.einsum("bgrd,bsgd->bgrs", qg * scale, k_cache)  # [B,G,R,S]
+        scores = jnp.einsum("bgrd,bsgd->bgrs", qg * scale, k_read)   # [B,G,R,S]
         scores = jnp.where(visible, scores, MASK_VALUE)
         weights = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bgrs,bsgd->bgrd", weights, v_cache).reshape(b, e)
-        h = h + ops.dense(attn, a["out_kernel"], a["out_bias"])
+        attn = jnp.einsum("bgrs,bsgd->bgrd", weights, v_read).reshape(b, e)
+        h = h + quant_ops.dense_any(attn, a["out_kernel"], a["out_bias"])
 
         x = ops.layer_norm(h, p["ln2_scale"], p["ln2_bias"])
-        up = ops.gelu(ops.dense(x, p["mlp_up_kernel"], p["mlp_up_bias"]))
-        h = h + ops.dense(up, p["mlp_down_kernel"], p["mlp_down_bias"])
+        up = ops.gelu(quant_ops.dense_any(x, p["mlp_up_kernel"],
+                                          p["mlp_up_bias"]))
+        h = h + quant_ops.dense_any(up, p["mlp_down_kernel"],
+                                    p["mlp_down_bias"])
 
     h = ops.layer_norm(h, params["ln_f_scale"], params["ln_f_bias"])
-    logits = ops.dense(h, params["head_kernel"], params["head_bias"])
+    logits = quant_ops.dense_any(h, params["head_kernel"], params["head_bias"])
     return cache, ops.log_softmax(logits.astype(jnp.float32))
+
+
+def decode_nll(model: TransformerLM, params, targets: jax.Array, *,
+               kv_dtype: str | None = None) -> jax.Array:
+    """Teacher-forced mean next-token NLL scored through the SERVING decode
+    path (``decode_step_slots``) — the accuracy-budget probe for quantized
+    execution: run it with ``kv_dtype=None`` for the fp32 oracle and with
+    ``kv_dtype="int8"`` (and/or quantized ``params``) for the policy under
+    test, and the difference is the NLL cost of the policy, measured through
+    the exact kernels the engine serves with (quantize-on-write rounding on
+    every cached row included). ``targets``: ``[B, seq_len]`` token ids; wrap
+    in ``jax.jit`` for repeated use — the scan traces once."""
+    b, s = targets.shape
+    if s != model.seq_len:
+        raise ValueError(f"expected seq_len {model.seq_len}, got {s}")
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    targets = targets.astype(jnp.int32)
+    cache = init_cache(model, b, kv_dtype=kv_dtype)
+    inputs = jnp.transpose(model.shift_right(targets))        # [S, B]
+    target_cols = jnp.transpose(targets)                      # [S, B]
+
+    def step(cache, xs):
+        t, ids_t, tgt_t = xs
+        cache, logp = decode_step_slots(model, params, cache, ids_t,
+                                        jnp.full((b,), t, jnp.int32))
+        return cache, jnp.take_along_axis(logp, tgt_t[:, None], axis=-1)[:, 0]
+
+    positions = jnp.arange(s, dtype=jnp.int32)
+    _, picked = lax.scan(step, cache, (positions, inputs, target_cols))
+    return -jnp.mean(picked)
 
 
 PREFILL_CHUNK_SIZES = (32, 128, 512)   # the serving engine's default static chunk
@@ -401,8 +490,11 @@ def prefill_chunk(model: TransformerLM, params, cache: dict, prompt: jax.Array,
     against that plane under the same ``pos <= t`` (and sliding-window) mask and
     the same einsum/reduction structure as ``decode_step_slots`` — position ``t``
     reads exactly the rows (cached prefix + in-chunk causal) it would have seen
-    one token at a time, at the same cache dtype rounding. No logits: prompt
-    tokens are forced, so prefill only has to leave the cache behind.
+    one token at a time, at the same cache dtype rounding — including under a
+    QUANTIZED cache (``k_scale`` planes present), where the chunk's rows are
+    quantized on write with the identical per-head scale math as
+    ``decode_step_slots`` and attention reads the dequantized plane. No logits:
+    prompt tokens are forced, so prefill only has to leave the cache behind.
     """
     s = model.seq_len
     e, nh = model.embed_dim, model.num_heads
@@ -438,19 +530,27 @@ def prefill_chunk(model: TransformerLM, params, cache: dict, prompt: jax.Array,
         a = p["attn"]
         x = ops.layer_norm(h, p["ln1_scale"], p["ln1_bias"])
         if kvh == nh:
-            qkv = ops.dense(x, a["qkv_kernel"], a["qkv_bias"])    # [C, 3E]
+            qkv = quant_ops.dense_any(x, a["qkv_kernel"], a["qkv_bias"])  # [C, 3E]
             q = qkv[:, :e].reshape(chunk, nh, hd)
             k = qkv[:, e:2 * e].reshape(chunk, kvh, hd)
             v = qkv[:, 2 * e:].reshape(chunk, kvh, hd)
         else:  # GQA: split projections, kvh-head K/V (the smaller cache)
-            q = ops.dense(x, a["q_kernel"], a["q_bias"]).reshape(chunk, nh, hd)
-            kv = ops.dense(x, a["kv_kernel"],
-                           a["kv_bias"]).reshape(chunk, 2, kvh, hd)
+            q = quant_ops.dense_any(x, a["q_kernel"],
+                                    a["q_bias"]).reshape(chunk, nh, hd)
+            kv = quant_ops.dense_any(x, a["kv_kernel"],
+                                     a["kv_bias"]).reshape(chunk, 2, kvh, hd)
             k, v = kv[:, 0], kv[:, 1]
         if model.rope:
             q = apply_rotary(q, positions)
             k = apply_rotary(k, positions)
         layer = cache[f"block_{i}"]
+        quantized = "k_scale" in layer
+        if quantized:
+            # Same quantize-on-write as decode_step_slots — a chunk-prefilled
+            # row is bit-identical to the row the per-token path would have
+            # cached, so the decode-parity argument carries over unchanged.
+            k, ks = quant_ops.quantize_rows(k, layer["k"].dtype)
+            v, vs = quant_ops.quantize_rows(v, layer["v"].dtype)
         plane_k, plane_v = layer["k"][slot], layer["v"][slot]    # [S, KV, Dh]
         # Wipe-then-write keeps a recycled slot bit-identical to a fresh one
         # (reset_slots' contract; fresh is False mid-plan and on prefix hits).
@@ -459,21 +559,39 @@ def prefill_chunk(model: TransformerLM, params, cache: dict, prompt: jax.Array,
         plane_v = jnp.where(fresh, zero, plane_v)
         plane_k = plane_k.at[write_pos].set(k.astype(plane_k.dtype), mode="drop")
         plane_v = plane_v.at[write_pos].set(v.astype(plane_v.dtype), mode="drop")
-        cache = {**cache, f"block_{i}": {
+        new_layer = {
             "k": lax.dynamic_update_index_in_dim(layer["k"], plane_k, slot, 0),
-            "v": lax.dynamic_update_index_in_dim(layer["v"], plane_v, slot, 0)}}
+            "v": lax.dynamic_update_index_in_dim(layer["v"], plane_v, slot, 0)}
+        if quantized:
+            plane_ks = jnp.where(fresh, jnp.zeros((), jnp.float32),
+                                 layer["k_scale"][slot])         # [S, KV]
+            plane_vs = jnp.where(fresh, jnp.zeros((), jnp.float32),
+                                 layer["v_scale"][slot])
+            plane_ks = plane_ks.at[write_pos].set(ks, mode="drop")
+            plane_vs = plane_vs.at[write_pos].set(vs, mode="drop")
+            new_layer["k_scale"] = lax.dynamic_update_index_in_dim(
+                layer["k_scale"], plane_ks, slot, 0)
+            new_layer["v_scale"] = lax.dynamic_update_index_in_dim(
+                layer["v_scale"], plane_vs, slot, 0)
+            k_read = quant_ops.dequantize_rows(plane_k, plane_ks)
+            v_read = quant_ops.dequantize_rows(plane_v, plane_vs)
+        else:
+            k_read, v_read = plane_k, plane_v
+        cache = {**cache, f"block_{i}": new_layer}
         # Attend against the full written plane under the per-position mask —
         # decode_step_slots' exact score/value structure, batched over the chunk.
         qg = q.reshape(chunk, kvh, rep, hd)
-        scores = jnp.einsum("cgrd,sgd->cgrs", qg * scale, plane_k)   # [C,G,R,S]
+        scores = jnp.einsum("cgrd,sgd->cgrs", qg * scale, k_read)    # [C,G,R,S]
         scores = jnp.where(visible, scores, MASK_VALUE)
         weights = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("cgrs,sgd->cgrd", weights, plane_v).reshape(chunk, e)
-        h = h + ops.dense(attn, a["out_kernel"], a["out_bias"])
+        attn = jnp.einsum("cgrs,sgd->cgrd", weights, v_read).reshape(chunk, e)
+        h = h + quant_ops.dense_any(attn, a["out_kernel"], a["out_bias"])
 
         x = ops.layer_norm(h, p["ln2_scale"], p["ln2_bias"])
-        up = ops.gelu(ops.dense(x, p["mlp_up_kernel"], p["mlp_up_bias"]))
-        h = h + ops.dense(up, p["mlp_down_kernel"], p["mlp_down_bias"])
+        up = ops.gelu(quant_ops.dense_any(x, p["mlp_up_kernel"],
+                                          p["mlp_up_bias"]))
+        h = h + quant_ops.dense_any(up, p["mlp_down_kernel"],
+                                    p["mlp_down_bias"])
     return cache
 
 
@@ -482,9 +600,12 @@ def reset_slots(cache: dict, fresh: jax.Array) -> dict:
     recycling for the serving engine. Correctness never depends on it (the per-slot
     ``pos <= t`` mask already hides rows beyond a slot's position), but wiping a
     recycled slot keeps its cache bit-identical to a freshly ``init_cache``'d one,
-    so the decode-parity invariant is checkable slot-by-slot at any time."""
+    so the decode-parity invariant is checkable slot-by-slot at any time. The
+    wipe is rank-generic so a quantized cache's ``[B, S, KV_H]`` scale planes
+    are wiped exactly like the ``[B, S, KV_H, Dh]`` K/V planes."""
     def wipe(x):
-        return jnp.where(fresh[:, None, None, None], jnp.zeros((), x.dtype), x)
+        mask = fresh.reshape(fresh.shape + (1,) * (x.ndim - 1))
+        return jnp.where(mask, jnp.zeros((), x.dtype), x)
     return jax.tree_util.tree_map(wipe, cache)
 
 

@@ -1,0 +1,306 @@
+"""Quantized execution: the dtype/scale policy and every piece of scale math.
+
+One module owns quantization so the three consumers — the serving engine's KV
+cache (``models/lm.py``), the quantized-weight matmul paths, and the byte-true
+accounting the roofline/telemetry layer reports — can never disagree about what
+a scale means.
+
+Two independent knobs make up a :class:`QuantPolicy`:
+
+- ``kv_dtype`` — the serving KV-cache plane dtype. ``"model"`` (default) keeps
+  today's behavior: planes in the model's activation dtype, bitwise-identical
+  code path, no scales. ``"fp32"``/``"bf16"`` are plain-cast planes (no scales;
+  bf16 halves cache bytes at bf16 rounding). ``"int8"`` (and ``"fp8"`` where the
+  jax build has ``float8_e4m3fn``) are **quantize-on-write** planes: each
+  written K/V row ``[KV_H, Dh]`` stores one symmetric scale per head alongside
+  the narrow row (scale planes ``[..., S, KV_H]`` in f32), and attention
+  **dequantizes in-kernel** — the narrow plane is what HBM streams; the upcast
+  happens on-chip, fused into the score/value einsums. Per-head-per-position
+  granularity is the finest the row-write layout gives for free, and it keeps
+  the decode program count at one: scales are data written by the same
+  fixed-shape row scatter as the planes.
+
+- ``weights`` — ``"off"`` (fp32 kernels, untouched), ``"w8"`` (int8 kernels +
+  per-output-channel scales, f32 activations: the weight-HBM-halving serving
+  mode), or ``"w8a8"`` (int8 kernels AND dynamically int8-quantized
+  activations: the int8-MXU matmul path, ``int8 x int8 -> int32`` accumulate —
+  the form whose higher matmul peak the training MFU denominator cites).
+  :func:`quantize_params` rewrites only 2-D ``*_kernel`` leaves into
+  :class:`QuantizedTensor` pytree nodes; embeddings, LayerNorm params and
+  biases stay exact. :func:`dense_any` dispatches on the leaf type, so code
+  paths shared with the unquantized engine stay bitwise identical when the
+  policy is off (a plain array takes the exact ``ops.dense`` call).
+
+Accounting is **byte-true by construction**: :func:`tree_bytes` sums the real
+``size * itemsize`` of live buffers (quantized planes, scale planes, int8
+kernels, their f32 scales — everything), so a reported bytes/token is what HBM
+actually moves, never a dtype-naive estimate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections.abc import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from csed_514_project_distributed_training_using_pytorch_tpu.ops import nn as ops_nn
+
+# Symmetric-quantization ranges. int8 uses +/-127 (not -128: symmetric, so a
+# row and its negation quantize to negations — no bias toward either sign).
+# fp8 (e4m3fn) has its own hardware rounding; the scale maps a row's amax to
+# the format's max normal so the whole row lands in range.
+INT8_QMAX = 127.0
+FP8_QMAX = 448.0          # float8_e4m3fn max normal
+
+KV_DTYPES = ("model", "fp32", "bf16", "int8", "fp8")
+WEIGHT_POLICIES = ("off", "w8", "w8a8")
+
+
+def fp8_dtype():
+    """The fp8 storage dtype, or None when this jax build lacks it."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantPolicy:
+    """The dtype/scale policy threaded through engine construction.
+
+    ``kv_dtype``: one of :data:`KV_DTYPES`; ``weights``: one of
+    :data:`WEIGHT_POLICIES`. The default policy is a no-op — every path it
+    touches stays bitwise identical to the unquantized code."""
+
+    kv_dtype: str = "model"
+    weights: str = "off"
+
+    def __post_init__(self):
+        if self.kv_dtype not in KV_DTYPES:
+            raise ValueError(f"kv_dtype {self.kv_dtype!r} not in {KV_DTYPES}")
+        if self.weights not in WEIGHT_POLICIES:
+            raise ValueError(f"weights {self.weights!r} not in "
+                             f"{WEIGHT_POLICIES}")
+        if self.kv_dtype == "fp8" and fp8_dtype() is None:
+            raise ValueError("kv_dtype 'fp8' needs a jax build with "
+                             "float8_e4m3fn")
+
+    @property
+    def off(self) -> bool:
+        return self.kv_dtype == "model" and self.weights == "off"
+
+
+def resolve_kv_dtype(spec: str, model_dtype) -> tuple[object, bool]:
+    """``(plane_dtype, scaled)`` for a kv_dtype spec: ``scaled`` marks the
+    quantize-on-write formats that carry per-head scale planes."""
+    if spec == "model":
+        return model_dtype, False
+    if spec == "fp32":
+        return jnp.float32, False
+    if spec == "bf16":
+        return jnp.bfloat16, False
+    if spec == "int8":
+        return jnp.int8, True
+    if spec == "fp8":
+        f8 = fp8_dtype()
+        if f8 is None:
+            raise ValueError("this jax build has no float8_e4m3fn")
+        return f8, True
+    raise ValueError(f"unknown kv_dtype {spec!r} (choices: {KV_DTYPES})")
+
+
+def _qmax(qdtype) -> float:
+    return INT8_QMAX if jnp.dtype(qdtype) == jnp.int8 else FP8_QMAX
+
+
+# ---------------------------------------------------------------------------
+# Row (KV-cache) quantization: one symmetric scale per last-axis vector
+# ---------------------------------------------------------------------------
+
+
+def quantize_rows(x: jax.Array, qdtype) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-row quantization over the LAST axis: ``[..., D]`` f32 ->
+    (``[..., D]`` in ``qdtype``, ``[...]`` f32 scales).
+
+    For a K/V row ``[KV_H, Dh]`` this is one scale per head — the granularity
+    the KV cache stores. ``scale = amax / qmax`` (1.0 for an all-zero row, so
+    dequant still returns exact zeros); int8 rounds-to-nearest and clips, fp8
+    uses the format's own cast rounding."""
+    x = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    scale = jnp.where(amax > 0, amax / _qmax(qdtype), 1.0)
+    q = x / scale[..., None]
+    if jnp.dtype(qdtype) == jnp.int8:
+        q = jnp.clip(jnp.round(q), -INT8_QMAX, INT8_QMAX)
+    return q.astype(qdtype), scale
+
+
+def dequantize_rows(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Invert :func:`quantize_rows`: ``[..., D]`` narrow + ``[...]`` scales ->
+    f32. Inside an attention kernel this is the in-kernel upcast — XLA fuses
+    the cast/multiply into the einsum that consumes it, so HBM only ever
+    streams the narrow plane."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+# ---------------------------------------------------------------------------
+# Weight quantization: per-output-channel int8 kernels + quantized matmuls
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """An int8 kernel + its per-output-channel f32 scales, as ONE pytree node.
+
+    Drops into a flax params tree where the plain ``[in, out]`` kernel array
+    sat, so checkpoint/device-put/tree_map plumbing is untouched; ``mode``
+    (``"w8"`` / ``"w8a8"``) rides in the static treedef — it selects the
+    matmul path at trace time, never at run time."""
+
+    def __init__(self, q: jax.Array, scale: jax.Array, mode: str = "w8"):
+        if mode not in ("w8", "w8a8"):
+            raise ValueError(f"mode {mode!r} not in ('w8', 'w8a8')")
+        self.q = q
+        self.scale = scale
+        self.mode = mode
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.q.size) * jnp.dtype(self.q.dtype).itemsize + \
+            int(self.scale.size) * jnp.dtype(self.scale.dtype).itemsize
+
+    def dequantize(self) -> jax.Array:
+        return self.q.astype(jnp.float32) * self.scale
+
+    def tree_flatten(self):
+        return (self.q, self.scale), self.mode
+
+    @classmethod
+    def tree_unflatten(cls, mode, children):
+        return cls(*children, mode=mode)
+
+    def __repr__(self):
+        return (f"QuantizedTensor(shape={tuple(self.q.shape)}, "
+                f"mode={self.mode!r})")
+
+
+def quantize_tensor(w: jax.Array, mode: str = "w8") -> QuantizedTensor:
+    """Per-output-channel symmetric int8: ``[in, out]`` f32 -> int8 kernel +
+    ``[out]`` scales (each output column scaled by its own amax)."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=0)
+    scale = jnp.where(amax > 0, amax / INT8_QMAX, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    return QuantizedTensor(q, scale, mode=mode)
+
+
+def int8_matmul(x: jax.Array, w: QuantizedTensor) -> jax.Array:
+    """The quantized matmul paths, selected by ``w.mode``:
+
+    - ``w8`` (weight-only): f32 activations against the int8 kernel; the
+      kernel's upcast fuses into the matmul (weight HBM is the win), the
+      per-channel scale is applied to the f32 product — exact, since each
+      output column shares one scale.
+    - ``w8a8``: activations dynamically quantized per row (one scale per
+      ``[..., in]`` vector), then ``int8 x int8 -> int32`` via ``dot_general``
+      with an int32 accumulator — the MXU/VPU integer path whose higher matmul
+      peak quantized-training MFU quotes — and one f32 rescale at the end.
+    """
+    if w.mode == "w8a8":
+        xq, xscale = quantize_rows(x, jnp.int8)
+        acc = jax.lax.dot_general(
+            xq, w.q, (((xq.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        return acc.astype(jnp.float32) * xscale[..., None] * w.scale
+    out = jnp.matmul(x.astype(jnp.float32), w.q.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out * w.scale
+
+
+def dense_any(x: jax.Array, w, b: jax.Array | None = None) -> jax.Array:
+    """``ops.dense`` that tolerates a quantized kernel: a plain array takes
+    the EXACT ``ops.dense`` call (bitwise-identical path — the policy-off
+    pin), a :class:`QuantizedTensor` takes its quantized matmul."""
+    if isinstance(w, QuantizedTensor):
+        out = int8_matmul(x, w).astype(x.dtype)
+        return out if b is None else out + b
+    return ops_nn.dense(x, w, b)
+
+
+def quantize_params(params, policy: QuantPolicy):
+    """Rewrite a params tree for the policy: every 2-D ``*_kernel`` leaf
+    becomes a :class:`QuantizedTensor` (mode = ``policy.weights``); everything
+    else — embeddings, LayerNorm scales/biases, biases — is returned as-is
+    (exact). ``weights="off"`` returns the tree untouched (the same object:
+    not a copy, so the policy-off engine's params are bit-identical)."""
+    if policy.weights == "off":
+        return params
+    mode = policy.weights
+    rewritten = 0
+
+    def walk(node):
+        nonlocal rewritten
+        if not isinstance(node, Mapping):
+            return node
+        out = {}
+        for name, leaf in node.items():
+            if isinstance(leaf, Mapping):
+                out[name] = walk(leaf)
+            elif name.endswith("_kernel") and getattr(leaf, "ndim", 0) == 2:
+                out[name] = quantize_tensor(leaf, mode=mode)
+                rewritten += 1
+            else:
+                out[name] = leaf
+        return out
+
+    quantized = walk(params)
+    if rewritten == 0:
+        # A weights-on policy that quantized nothing would silently serve fp32
+        # kernels while every ledger reports the policy as on.
+        raise ValueError("quantize_params found no 2-D *_kernel leaves to "
+                         "quantize — unexpected params tree for policy "
+                         f"weights={mode!r}")
+    return quantized
+
+
+# ---------------------------------------------------------------------------
+# Byte-true accounting
+# ---------------------------------------------------------------------------
+
+
+def tree_bytes(tree) -> int:
+    """Actual bytes of every array leaf in a pytree — ``size * itemsize`` of
+    the REAL buffers (int8 planes count 1 byte/elem, their f32 scale planes
+    count too), so downstream roofline math can never quietly assume a dtype
+    the cache doesn't hold. QuantizedTensor leaves flatten to (q, scale) and
+    are counted exactly."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        size = getattr(leaf, "size", None)
+        dt = getattr(leaf, "dtype", None)
+        if size is None or dt is None:
+            continue
+        total += int(size) * int(np.dtype(dt).itemsize)
+    return total
+
+
+def cache_layout(cache: dict) -> str:
+    """Canonical signature of a KV cache's plane layout: leaf names, dtypes,
+    and per-slot shapes of one layer (all layers are identical). This is the
+    compatibility key the prefix cache stores with every snapshot — planes
+    written under one layout must never install into an engine running
+    another (an fp32 snapshot is garbage to an int8 engine's dequantizing
+    attention kernel)."""
+    if not cache:
+        return "empty"
+    layer = cache[sorted(cache)[0]]
+    parts = []
+    for name in sorted(layer):
+        leaf = layer[name]
+        shape = tuple(int(d) for d in leaf.shape[1:])   # drop the slot axis
+        parts.append(f"{name}:{jnp.dtype(leaf.dtype).name}{list(shape)}")
+    return f"layers={len(cache)};" + ",".join(parts)

@@ -46,6 +46,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from csed_514_project_distributed_training_using_pytorch_tpu.models import lm as lm_mod
+from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
+    quant as quant_ops,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
     MASK_VALUE,
 )
@@ -133,6 +136,15 @@ class ContinuousBatchingEngine:
     (``prefix_cache_entries``) that lets repeated prompt prefixes skip prefill;
     ``prefill_chunk_sizes=()`` falls back to prefill-as-decode.
 
+    Quantized execution rides the same one-program contract: ``kv_dtype``
+    selects the KV-cache plane format (``"int8"``/``"fp8"`` = quantize-on-write
+    planes with per-head scales — roughly quarter/half the decode HBM read and
+    2-4x the slots per HBM budget) and ``quant_policy`` the weight-matmul path
+    (``"w8"``/``"w8a8"`` int8 kernels). Scales are DATA written by the same
+    fixed-shape row scatter as the planes, so ``trace_count`` stays 1 and
+    ``prefill_trace_counts`` stay <= 1 per size with the policy on;
+    ``byte_accounting()`` reports what the live buffers actually cost.
+
     Single-threaded by design: the ``serving.server.Server`` front end serializes
     all engine access on its loop thread; tests drive ``run()`` directly.
     """
@@ -141,11 +153,21 @@ class ContinuousBatchingEngine:
                  seed: int = 0,
                  prefill_chunk_sizes: tuple[int, ...] = lm_mod.PREFILL_CHUNK_SIZES,
                  prefill_chunk_budget: int = 1,
-                 prefix_cache_entries: int = 0):
+                 prefix_cache_entries: int = 0,
+                 kv_dtype: str = "model",
+                 quant_policy: str = "off"):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.model = model
-        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        # The dtype/scale policy: kv_dtype picks the KV-cache plane format
+        # (quantize-on-write for int8/fp8), quant_policy the weight-matmul
+        # path ("off" | "w8" | "w8a8" — ops.quant.WEIGHT_POLICIES). Both off
+        # is the bitwise-pinned legacy path: quantize_params returns the tree
+        # untouched and init_cache builds the exact planes it always built.
+        self.quant = quant_ops.QuantPolicy(kv_dtype=kv_dtype,
+                                           weights=quant_policy)
+        self.params = quant_ops.quantize_params(
+            jax.tree_util.tree_map(jnp.asarray, params), self.quant)
         self.num_slots = int(num_slots)
         # Host-side per-step hook, called with the running step count at the top
         # of every step() — the serve path's resilience tick (a replica worker
@@ -156,7 +178,12 @@ class ContinuousBatchingEngine:
         self.steps = 0                # decode steps executed
         self.slot_steps = 0           # sum of occupied slots over steps (occupancy)
         self._key = jax.random.PRNGKey(seed)
-        self._cache = lm_mod.init_cache(model, self.num_slots)
+        self._cache = lm_mod.init_cache(model, self.num_slots,
+                                        kv_dtype=self.quant.kv_dtype)
+        # The plane-layout signature (dtypes + scale-plane structure): stamped
+        # on every prefix-cache snapshot and checked on every lookup, so planes
+        # written under a different dtype policy can never install here.
+        self.plane_layout = quant_ops.cache_layout(self._cache)
         b, s = self.num_slots, model.seq_len
         self._ids = np.full((b,), model.vocab_size - 1, np.int32)   # BOS
         self._t = np.zeros((b,), np.int32)
@@ -194,7 +221,8 @@ class ContinuousBatchingEngine:
         if prefix_cache_entries and not self.prefill_chunk_sizes:
             raise ValueError("the prefix cache rides the chunked-prefill path — "
                              "enable prefill_chunk_sizes to use it")
-        self.prefix_cache = (PrefixCache(prefix_cache_entries)
+        self.prefix_cache = (PrefixCache(prefix_cache_entries,
+                                         layout=self.plane_layout)
                              if prefix_cache_entries else None)
         self.prefill_invocations = 0  # chunk-program executions
         self.prefill_tokens = 0       # prompt tokens prefilled (cache hits excluded)
@@ -377,8 +405,11 @@ class ContinuousBatchingEngine:
         prompt_np = np.asarray(request.prompt, np.int32).reshape(-1)
         hit_len = 0
         if self.prefix_cache is not None and p:
+            # layout passed explicitly: a foreign cache object (written by an
+            # engine with another dtype policy) must miss, never install.
             hit_len, planes = self.prefix_cache.lookup(
-                prompt_np, min_len=min(self.prefill_chunk_sizes))
+                prompt_np, min_len=min(self.prefill_chunk_sizes),
+                layout=self.plane_layout)
             if hit_len:
                 self._cache = self._install_jit(self._cache, planes,
                                                 np.int32(slot))
@@ -453,7 +484,52 @@ class ContinuousBatchingEngine:
         self.prefill_wall_s = 0.0
         self._prefill_records = []
         if self.prefix_cache is not None:
-            self.prefix_cache = PrefixCache(self.prefix_cache.capacity)
+            self.prefix_cache = PrefixCache(self.prefix_cache.capacity,
+                                            layout=self.plane_layout)
+
+    # Reference HBM budget for the slots-per-chip figure: 1 GiB is small enough
+    # to be meaningful for the tiny CPU models AND scales linearly, so the A/B
+    # RATIO (the committed number) is budget-independent past the param floor.
+    HBM_BUDGET_BYTES = 1 << 30
+
+    def byte_accounting(self, *, hbm_budget_bytes: int | None = None) -> dict:
+        """Byte-TRUE accounting of the decode working set, from the live
+        buffers (``size * itemsize`` of every cache/param/prompt leaf — int8
+        planes count 1 byte, their f32 scale planes count too), never from a
+        dtype assumption:
+
+        - ``decode_bytes_per_step``: what one decode step streams from HBM —
+          the full KV cache (every step reads all ``[B, S]`` rows by design),
+          the params, and the prompt buffer;
+        - ``decode_bytes_per_token``: that over ``num_slots`` (each step emits
+          one token per slot at full occupancy) — the roofline numerator;
+        - ``kv_bytes_per_slot``: one slot's resident K/V (+scale) planes;
+        - ``slots_at_budget``: how many slots fit a reference HBM budget after
+          the params — the capacity half of the quantization win (int8 planes
+          ⇒ ~2x the slots of bf16, ~4x fp32, under the same budget).
+        """
+        budget = self.HBM_BUDGET_BYTES if hbm_budget_bytes is None \
+            else int(hbm_budget_bytes)
+        params_bytes = quant_ops.tree_bytes(self.params)
+        kv_bytes = quant_ops.tree_bytes(self._cache)
+        prompt_bytes = int(self._prompt.size) * self._prompt.dtype.itemsize
+        per_slot = kv_bytes // self.num_slots
+        per_step = kv_bytes + params_bytes + prompt_bytes
+        return {
+            "kv_dtype": self.quant.kv_dtype,
+            "quant_policy": self.quant.weights,
+            "plane_layout": self.plane_layout,
+            "params_bytes": params_bytes,
+            "kv_bytes_resident": kv_bytes,
+            "kv_bytes_per_slot": per_slot,
+            "prompt_bytes": prompt_bytes,
+            "decode_bytes_per_step": per_step,
+            "decode_bytes_per_token": per_step / self.num_slots,
+            "hbm_budget_bytes": budget,
+            "slots_at_budget": max(
+                (budget - params_bytes) // (per_slot + prompt_bytes
+                                            // self.num_slots), 0),
+        }
 
     def take_prefill_records(self) -> list[dict]:
         """Drain the completed-prefill telemetry records (one dict per prompt:
@@ -539,7 +615,8 @@ class ContinuousBatchingEngine:
             req = self._requests[slot]
             self.prefix_cache.insert(np.asarray(req.prompt, np.int32),
                                      self._snapshot_jit(self._cache,
-                                                        np.int32(slot)))
+                                                        np.int32(slot)),
+                                     layout=self.plane_layout)
         self._activate_prefilled(slot)
         self._record_prefill(
             slot, wall_s=float(self._chunk_wall[slot]),

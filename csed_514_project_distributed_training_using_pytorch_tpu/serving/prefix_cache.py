@@ -46,21 +46,33 @@ def common_prefix_len(a: np.ndarray, b: np.ndarray) -> int:
 
 @dataclasses.dataclass
 class PrefixEntry:
-    """One stored prefill: the prompt tokens whose rows the planes hold, and the
+    """One stored prefill: the prompt tokens whose rows the planes hold, the
     per-layer ``{"k": [S, KV_H, Dh], "v": ...}`` device planes (rows
-    ``[0, len(tokens))`` valid, the rest donor garbage)."""
+    ``[0, len(tokens))`` valid, the rest donor garbage), and the plane
+    ``layout`` signature (``ops.quant.cache_layout``) the planes were written
+    under — dtype + scale-plane structure, the compatibility key."""
 
     tokens: np.ndarray
     planes: dict
+    layout: str | None = None
 
 
 class PrefixCache:
-    """LRU of ``PrefixEntry``s. ``capacity`` is the max entry count (>= 1)."""
+    """LRU of ``PrefixEntry``s. ``capacity`` is the max entry count (>= 1).
 
-    def __init__(self, capacity: int):
+    ``layout`` is the owning engine's plane-layout signature: every insert is
+    stamped with it and every lookup filters on it, so a snapshot written
+    under one dtype/scale layout (say fp32 planes) can never silently install
+    into an engine running another (int8 planes + per-head scales) — the
+    bytes would be reinterpreted garbage. Mismatches are counted in
+    ``layout_rejects`` rather than raised: a foreign-layout entry is simply
+    not a hit (the regression case is a cache object handed across engines)."""
+
+    def __init__(self, capacity: int, *, layout: str | None = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
+        self.layout = layout
         self._entries: collections.OrderedDict[int, PrefixEntry] = \
             collections.OrderedDict()
         self._next_key = 0
@@ -69,14 +81,15 @@ class PrefixCache:
         self.hit_tokens = 0
         self.insertions = 0
         self.evictions = 0
+        self.layout_rejects = 0
 
     def __len__(self) -> int:
         return len(self._entries)
 
     _common_prefix = staticmethod(common_prefix_len)
 
-    def lookup(self, prompt: np.ndarray, *,
-               min_len: int = 1) -> tuple[int, dict | None]:
+    def lookup(self, prompt: np.ndarray, *, min_len: int = 1,
+               layout: str | None = None) -> tuple[int, dict | None]:
         """Longest-common-prefix match against the stored entries: returns
         ``(hit_len, planes)`` for the best entry (``(0, None)`` on a miss) and
         refreshes its LRU position. ``hit_len`` may be any length up to
@@ -86,14 +99,25 @@ class PrefixCache:
         smallest chunk size): installing a whole plane to save fewer prompt
         tokens than one chunk costs more than it saves, so coincidental 1-token
         overlaps between random prompts don't trigger copies. A full-prompt hit
-        always qualifies — it skips prefill entirely."""
+        always qualifies — it skips prefill entirely.
+
+        ``layout`` (default: the cache's own) must match an entry's recorded
+        plane layout for it to hit — the dtype/scale compatibility guard."""
         self.queries += 1
+        want = self.layout if layout is None else layout
         prompt = np.asarray(prompt, np.int32)
-        best_key, best_len = None, 0
+        best_key, best_len, rejected = None, 0, False
         for key, entry in self._entries.items():
+            if entry.layout != want:
+                rejected = True
+                continue
             m = self._common_prefix(entry.tokens, prompt)
             if m > best_len and (m == len(prompt) or m >= min_len):
                 best_key, best_len = key, m
+        # At most one reject per LOOKUP: the counter answers "how many lookups
+        # saw a layout-incompatible entry", not "entry comparisons".
+        if rejected:
+            self.layout_rejects += 1
         if best_key is None:
             return 0, None
         self._entries.move_to_end(best_key)
@@ -101,17 +125,22 @@ class PrefixCache:
         self.hit_tokens += best_len
         return best_len, self._entries[best_key].planes
 
-    def insert(self, tokens: np.ndarray, planes: dict) -> None:
+    def insert(self, tokens: np.ndarray, planes: dict, *,
+               layout: str | None = None) -> None:
         """Store a finished prefill (and drop any entry the new one strictly
-        covers — same tokens as a prefix of the new entry's, so every future
-        lookup the old entry could win, the new one wins longer)."""
+        covers — same tokens as a prefix of the new entry's AND the same plane
+        layout, so every future lookup the old entry could win, the new one
+        wins longer). The entry is stamped with ``layout`` (default: the
+        cache's own) — the key :meth:`lookup` filters on."""
+        layout = self.layout if layout is None else layout
         tokens = np.asarray(tokens, np.int32).copy()
         covered = [k for k, e in self._entries.items()
-                   if len(e.tokens) <= len(tokens)
+                   if e.layout == layout and len(e.tokens) <= len(tokens)
                    and self._common_prefix(e.tokens, tokens) == len(e.tokens)]
         for k in covered:
             del self._entries[k]
-        self._entries[self._next_key] = PrefixEntry(tokens=tokens, planes=planes)
+        self._entries[self._next_key] = PrefixEntry(tokens=tokens, planes=planes,
+                                                    layout=layout)
         self._next_key += 1
         self.insertions += 1
         while len(self._entries) > self.capacity:
@@ -127,4 +156,5 @@ class PrefixCache:
             "hit_tokens": self.hit_tokens,
             "insertions": self.insertions,
             "evictions": self.evictions,
+            "layout_rejects": self.layout_rejects,
         }

@@ -106,22 +106,16 @@ def build_engine_server(args):
         from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
             checkpoint,
         )
-        from flax import serialization
 
-        with open(args.checkpoint, "rb") as f:
-            raw = serialization.msgpack_restore(f.read())
-        if isinstance(raw, dict) and "params" in raw:
-            params = serialization.from_state_dict(jax.device_get(params),
-                                                   raw["params"])
-        else:
-            params = checkpoint.load_params(args.checkpoint,
-                                            jax.device_get(params))
+        params = checkpoint.load_params_or_state(args.checkpoint, params)
     chunk_sizes = tuple(int(x) for x in args.prefill_chunks.split(",") if x)
     engine = ContinuousBatchingEngine(
         model, params, num_slots=args.num_slots, seed=args.seed,
         prefill_chunk_sizes=chunk_sizes,
         prefill_chunk_budget=args.prefill_budget,
-        prefix_cache_entries=args.prefix_cache)
+        prefix_cache_entries=args.prefix_cache,
+        kv_dtype=getattr(args, "kv_dtype", "model"),
+        quant_policy=getattr(args, "quant_policy", "off"))
     # The serve-path resilience tick: kill/preempt/stall faults fire between
     # decode dispatches — mid-decode, with requests in flight.
     engine.on_step = lambda step: faults.on_tick(step=step)
@@ -408,6 +402,10 @@ def main(argv: list[str] | None = None) -> int:
     e.add_argument("--prefill-chunks", default="32,128,512")
     e.add_argument("--prefill-budget", type=int, default=1)
     e.add_argument("--prefix-cache", type=int, default=0)
+    e.add_argument("--kv-dtype", default="model",
+                   choices=("model", "fp32", "bf16", "int8", "fp8"))
+    e.add_argument("--quant-policy", default="off",
+                   choices=("off", "w8", "w8a8"))
     e.add_argument("--warmup", type=int, default=1,
                    help="compile the decode/prefill/install programs before "
                         "accepting traffic (0 = off)")

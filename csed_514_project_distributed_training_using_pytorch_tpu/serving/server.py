@@ -97,6 +97,8 @@ class Server:
             "prefill_chunk_budget": self.engine.prefill_chunk_budget,
             "prefix_cache_entries": (self.engine.prefix_cache.capacity
                                      if self.engine.prefix_cache else 0),
+            "kv_dtype": self.engine.quant.kv_dtype,
+            "quant_policy": self.engine.quant.weights,
         })
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-loop")
@@ -287,4 +289,5 @@ class Server:
             prefix_cache=(eng.prefix_cache.stats()
                           if eng.prefix_cache else None),
             queue=self.queue.snapshot(),
+            byte_accounting=eng.byte_accounting(),
             **self._series))

@@ -283,7 +283,7 @@ def main(config: LMConfig = LMConfig(), *,
     # and folds into the first epoch).
     # Gated on the CONFIG flag, not tele.enabled: every process must take the same
     # compile path (AOT-compiled vs jit) on a multi-host fleet.
-    compile_s = flops_per_step = None
+    compile_s = flops_per_step = bytes_per_step = None
     if config.telemetry:
         plan_struct = jax.ShapeDtypeStruct(
             (steps_per_epoch, config.batch_size), np.int32)
@@ -294,6 +294,8 @@ def main(config: LMConfig = LMConfig(), *,
             compile_s = aot["lower_s"] + aot["compile_s"]
             if aot["flops"]:
                 flops_per_step = aot["flops"] / steps_per_epoch
+            if aot.get("bytes_accessed"):
+                bytes_per_step = aot["bytes_accessed"] / steps_per_epoch
             tele.emit(T.compile_event("epoch", aot,
                                       steps_per_call=steps_per_epoch))
     history = M.MetricsHistory()
@@ -308,7 +310,8 @@ def main(config: LMConfig = LMConfig(), *,
         state = _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d,
                             zeros_d, test_d, dropout_rng, n_train, n_test, seq_len,
                             steps_per_epoch, start_epoch, history, watch, saver,
-                            ckpt_path, gather, tele, compile_s, flops_per_step, rt)
+                            ckpt_path, gather, tele, compile_s, flops_per_step,
+                            rt, bytes_per_step)
     finally:
         # Drain the write-behind queue even on an exception/signal/preemption
         # mid-run — the queued per-epoch checkpoint is the resume artifact a killed
@@ -355,7 +358,7 @@ def main(config: LMConfig = LMConfig(), *,
 def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_d,
                 dropout_rng, n_train, n_test, seq_len, steps_per_epoch, start_epoch,
                 history, watch, saver, ckpt_path, gather, tele, compile_s,
-                flops_per_step, rt):
+                flops_per_step, rt, bytes_per_step=None):
     """The LM trainer's epoch loop, split out so the caller can guarantee the
     async-checkpoint flush in a ``finally`` regardless of where the loop fails."""
     best_step_s = None
@@ -423,7 +426,10 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_
         # checkpoint durable (raises Preempted; __main__ exits 75).
         rt.check_preempt(epoch=epoch, state=state, checkpoint=ckpt_path, tele=tele)
     if tele.enabled and best_step_s is not None:
-        tele.emit(T.mfu_event(flops_per_step, best_step_s))
+        # bytes_per_step is XLA's own bytes-accessed count for the compiled
+        # step (byte-true under quantized dtypes): the mfu event carries the
+        # bandwidth roofline side alongside the FLOP side.
+        tele.emit(T.mfu_event(flops_per_step, best_step_s, bytes_per_step))
     return state
 
 

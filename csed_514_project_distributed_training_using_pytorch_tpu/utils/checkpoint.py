@@ -598,3 +598,16 @@ def save_params(path: str, params) -> None:
 def load_params(path: str, reference_params):
     with open(path, "rb") as f:
         return serialization.from_bytes(reference_params, f.read())
+
+
+def load_params_or_state(path: str, reference_params):
+    """Load model params from ``path``, accepting either a full TrainState
+    msgpack (train.lm's ``model_lm.ckpt``) or a params-only export
+    (:func:`save_params`). The one loader behind every serving/bench surface
+    that takes a ``--checkpoint`` — new checkpoint layouts are taught here,
+    not per caller."""
+    reference_params = jax.device_get(reference_params)
+    raw = _decode_msgpack(path)
+    if isinstance(raw, dict) and "params" in raw:
+        return serialization.from_state_dict(reference_params, raw["params"])
+    return serialization.from_state_dict(reference_params, raw)

@@ -220,9 +220,9 @@ def manifest_event(config=None, *, mesh=None, run_type: str = "") -> dict:
     return ev
 
 
-def compiled_flops(compiled) -> float | None:
-    """Total FLOPs of ONE invocation of an AOT-compiled program, from XLA's
-    ``cost_analysis()`` — None when the backend doesn't report them."""
+def _compiled_cost_value(compiled, key: str) -> float | None:
+    """One positive value out of an AOT program's ``cost_analysis()`` dict —
+    None when the backend doesn't report it."""
     try:
         cost = compiled.cost_analysis()
     except Exception:
@@ -230,10 +230,25 @@ def compiled_flops(compiled) -> float | None:
     if isinstance(cost, (list, tuple)):      # older jax: one dict per partition
         cost = cost[0] if cost else {}
     try:
-        flops = cost.get("flops")
+        value = cost.get(key)
     except AttributeError:
         return None
-    return float(flops) if flops and flops > 0 else None
+    return float(value) if value and value > 0 else None
+
+
+def compiled_flops(compiled) -> float | None:
+    """Total FLOPs of ONE invocation of an AOT-compiled program, from XLA's
+    ``cost_analysis()`` — None when the backend doesn't report them."""
+    return _compiled_cost_value(compiled, "flops")
+
+
+def compiled_bytes_accessed(compiled) -> float | None:
+    """Total HBM bytes one invocation actually touches, from XLA's
+    ``cost_analysis()`` ``bytes accessed`` — the BYTE-TRUE traffic of the
+    compiled program (int8 operands priced at one byte, fusions not
+    double-counted), as opposed to a dtype-naive estimate from tensor shapes.
+    None when the backend doesn't report it."""
+    return _compiled_cost_value(compiled, "bytes accessed")
 
 
 def aot_compile(jit_fn, *args) -> tuple[object | None, dict | None]:
@@ -257,7 +272,8 @@ def aot_compile(jit_fn, *args) -> tuple[object | None, dict | None]:
     except Exception:
         return None, None
     return compiled, {"lower_s": lower_s, "compile_s": compile_s,
-                      "flops": compiled_flops(compiled)}
+                      "flops": compiled_flops(compiled),
+                      "bytes_accessed": compiled_bytes_accessed(compiled)}
 
 
 def compile_event(fn_name: str, aot: dict, *, steps_per_call: int | None = None) -> dict:
@@ -272,6 +288,10 @@ def compile_event(fn_name: str, aot: dict, *, steps_per_call: int | None = None)
         "steps_per_call": steps_per_call,
         "flops_per_step": _finite(flops / steps_per_call
                                   if flops and steps_per_call else None),
+        "bytes_accessed_per_call": _finite(aot.get("bytes_accessed")),
+        "bytes_accessed_per_step": _finite(
+            aot["bytes_accessed"] / steps_per_call
+            if aot.get("bytes_accessed") and steps_per_call else None),
     }
 
 
@@ -429,7 +449,8 @@ def global_l2_norm(tree) -> float:
     return float(jax.device_get(_l2_norm_jit(tree)))
 
 
-def estimate_mfu(flops_per_step: float | None, step_s: float | None) -> dict:
+def estimate_mfu(flops_per_step: float | None, step_s: float | None,
+                 bytes_per_step: float | None = None) -> dict:
     """Model-FLOP-utilization against the chip's published bf16 peak.
 
     ``flops_per_step`` comes from ``compiled.cost_analysis()``, which prices the
@@ -440,15 +461,25 @@ def estimate_mfu(flops_per_step: float | None, step_s: float | None) -> dict:
     A-vs-B comparisons across telemetry and bench files compare like with like.
     Uses ``utils.benchmarks.peak_flops`` (the committed spec-sheet table); ``mfu``
     is None off-TPU or on an unknown device kind — never a guess.
-    """
+
+    ``bytes_per_step`` (``compiled_bytes_accessed`` / steps — XLA's own count
+    of the bytes the compiled step ACTUALLY touches, so an int8 operand is
+    priced at one byte) adds the bandwidth side: achieved bytes/s and the HBM
+    roofline fraction ``hbm_frac``. Quantization moves this number, which is
+    why it must be measured, not derived from a parameter count at an assumed
+    dtype."""
     from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
         peak_flops,
+        peak_hbm_bytes,
     )
 
     devs = jax.devices()
     device_kind = getattr(devs[0], "device_kind", devs[0].platform)
     achieved = (flops_per_step / step_s if flops_per_step and step_s else None)
-    peak = peak_flops(device_kind) if devs[0].platform == "tpu" else None
+    on_tpu = devs[0].platform == "tpu"
+    peak = peak_flops(device_kind) if on_tpu else None
+    bw = (bytes_per_step / step_s if bytes_per_step and step_s else None)
+    peak_bw = peak_hbm_bytes(device_kind) if on_tpu else None
     return {
         "flops_per_step": _finite(flops_per_step),
         "step_s": _finite(step_s),
@@ -457,12 +488,18 @@ def estimate_mfu(flops_per_step: float | None, step_s: float | None) -> dict:
         "devices": len(devs),
         "peak_flops_per_s_per_device": _finite(peak),
         "mfu": _finite(achieved / peak if achieved and peak else None),
+        "bytes_accessed_per_step": _finite(bytes_per_step),
+        "achieved_bytes_per_s_per_device": _finite(bw),
+        "peak_hbm_bytes_per_s": _finite(peak_bw),
+        "hbm_frac": _finite(bw / peak_bw if bw and peak_bw else None),
     }
 
 
-def mfu_event(flops_per_step: float | None, step_s: float | None) -> dict:
+def mfu_event(flops_per_step: float | None, step_s: float | None,
+              bytes_per_step: float | None = None) -> dict:
     """The steady-state ``mfu`` event (emit once, with the best measured step time)."""
-    return {"event": "mfu", **estimate_mfu(flops_per_step, step_s)}
+    return {"event": "mfu", **estimate_mfu(flops_per_step, step_s,
+                                           bytes_per_step)}
 
 
 # Nearest-rank percentiles — the one estimator all serving summaries and the
@@ -527,13 +564,17 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
                         prefill_wall_s: float | None = None,
                         prefix_cache: dict | None = None,
                         queue: dict | None = None,
+                        byte_accounting: dict | None = None,
                         ttft_s=(), tpot_s=(), e2e_s=(), queue_wait_s=()) -> dict:
     """The once-per-run serving aggregate, emitted at drain: counts, aggregate
     tokens/s over the server's whole wall clock, slot occupancy, and p50/p95/p99
     of each latency series (the per-request ``serve`` lines remain the raw data —
     the summary is what survives a truncated log and what A-vs-B compares).
     ``queue`` is the admission queue's ``RequestQueue.snapshot()`` (depth /
-    oldest-age / rejected count) — the backpressure ledger."""
+    oldest-age / rejected count) — the backpressure ledger. ``byte_accounting``
+    (emitted as ``"bytes"``) is the engine's byte-TRUE decode working set
+    (``ContinuousBatchingEngine.byte_accounting()`` — decode bytes/token, KV
+    bytes/slot, slots-at-budget, kv_dtype), the quantization A/B ledger."""
     return {
         "event": "serve_summary",
         "requests": int(requests),
@@ -555,6 +596,7 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
             if prefill_tokens and prefill_wall_s else None),
         "prefix_cache": prefix_cache,
         "queue": queue,
+        "bytes": byte_accounting,
         "ttft_s": percentiles(ttft_s),
         "tpot_s": percentiles(tpot_s),
         "e2e_s": percentiles(e2e_s),

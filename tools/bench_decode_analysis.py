@@ -25,6 +25,22 @@ prefill-vs-decode wall-clock split of the ON path. Off pays P sequential decode
 invocations before the first generated token; on pays ``ceil(P/chunk)`` wide
 forwards — the curve is the before/after record of that schedule change.
 
+``--quant-ab`` runs the quantized-execution A/B this tool's roofline accounting
+exists to verify: the SAME greedy workload through a fp32-oracle engine (A) and
+a quantized engine (B: ``--ab-kv-dtype``/``--ab-quant-policy``), reporting (1)
+**measured** decode bytes/token and KV bytes/slot from the live buffers of each
+engine (``byte_accounting()`` — int8 planes and their scale planes priced at
+their real itemsize, never a dtype assumption), and slots under the same HBM
+budget; (2) the ACCURACY BUDGET: greedy token-match rate vs the fp32 oracle and
+the teacher-forced NLL delta through the serving decode path (``--checkpoint``
+for real weights); (3) the compile pins: the quantized engine must still trace
+exactly one decode program and <= 1 prefill program per chunk size. The output
+JSON is the committed ``bench_results/`` artifact format.
+
+All byte accounting in this tool is **byte-true**: cache and weight bytes are
+summed from the actual arrays a run holds (``ops.quant.tree_bytes``), so a
+quantized run's roofline denominator shrinks exactly as far as its buffers did.
+
 Usage: ``python tools/bench_decode_analysis.py [--d-model 256 ...]`` — ONE JSON
 line; CPU-drivable at tiny shapes (the op count is platform-specific, so the
 committed artifact must come from a TPU run).
@@ -109,6 +125,123 @@ def ttft_curve(model, params, args) -> list[dict]:
     } for p_len in lens]
 
 
+def quant_ab(model, params, args) -> dict:
+    """The quantization A/B: one seeded greedy workload through a fp32-oracle
+    engine and a quantized engine, returning measured bytes, the accuracy
+    budget, and the compile pins — the committed-artifact document.
+
+    Both sides run on an fp32 base model regardless of ``--bf16`` (the main
+    decomposition bench keeps its own dtype): "nll_fp32" and the byte-reduction
+    ratios measure quantization alone against a true fp32 oracle, not a
+    baseline whose meaning shifts with an unrelated flag."""
+    import numpy as np
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        lm as lm_mod,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        ContinuousBatchingEngine, Request,
+    )
+
+    import jax
+    import jax.numpy as jnp
+
+    if model.dtype != jnp.float32:
+        model = model.clone(dtype=jnp.float32)
+
+    s = args.seq
+    rng = np.random.default_rng(11)
+    # Prompt-heavy mix (prefill exercised) + short prompts (decode exercised).
+    lens = sorted({s // 8, s // 4, s // 2, (3 * s) // 4})
+    specs = []
+    for i in range(args.ab_requests):
+        p_len = int(rng.choice(lens))
+        prompt = rng.integers(0, args.vocab, size=p_len).astype(np.int32)
+        new = int(rng.integers(args.ab_new_tokens // 2, args.ab_new_tokens + 1))
+        specs.append((prompt, new))
+
+    def run_engine(kv_dtype, quant_policy):
+        eng = ContinuousBatchingEngine(
+            model, params, num_slots=args.ab_slots,
+            prefill_chunk_sizes=tuple(
+                int(x) for x in args.curve_chunks.split(",") if x),
+            kv_dtype=kv_dtype, quant_policy=quant_policy)
+        comps = eng.run([Request(prompt=p, max_new_tokens=n, request_id=i)
+                         for i, (p, n) in enumerate(specs)])
+        return eng, {c.request.request_id: np.asarray(c.tokens) for c in comps}
+
+    eng_a, toks_a = run_engine("model", "off")
+    eng_b, toks_b = run_engine(args.ab_kv_dtype, args.ab_quant_policy)
+
+    # Greedy token-match rate vs the fp32 oracle, over GENERATED positions
+    # only (the prompt prefix is teacher-forced on both sides). Positionwise
+    # agreement; prefix_match additionally reports agreement up to the first
+    # divergence (after which conditioning differs by construction).
+    agree = total = prefix_agree = 0
+    for i, (p, _) in enumerate(specs):
+        a, b = toks_a[i][len(p):], toks_b[i][len(p):]
+        n = min(len(a), len(b))
+        eq = a[:n] == b[:n]
+        agree += int(eq.sum())
+        total += n
+        div = np.nonzero(~eq)[0]
+        prefix_agree += int(div[0]) if len(div) else n
+    token_match_rate = agree / total if total else None
+    prefix_match_rate = prefix_agree / total if total else None
+
+    # NLL delta through the serving decode path, teacher-forced on oracle
+    # greedy streams (real model traffic, not random tokens).
+    targets = lm_mod.generate(model, params, jax.random.PRNGKey(2),
+                              batch=args.ab_nll_batch, temperature=0.0)
+    nll_a = float(lm_mod.decode_nll(model, eng_a.params,
+                                    jnp.asarray(targets)))
+    nll_b = float(lm_mod.decode_nll(model, eng_b.params, jnp.asarray(targets),
+                                    kv_dtype=args.ab_kv_dtype))
+    acct_a, acct_b = eng_a.byte_accounting(), eng_b.byte_accounting()
+    doc = {
+        "metric": "quantized-execution A/B (kv %s, weights %s)"
+                  % (args.ab_kv_dtype, args.ab_quant_policy),
+        "model_dtype": "float32",  # the oracle is pinned fp32 (see docstring)
+        "requests": len(specs),
+        "prompt_lens": lens,
+        "a": {"kv_dtype": "model", "quant_policy": "off", "bytes": acct_a,
+              "trace_count": eng_a.trace_count,
+              "prefill_trace_counts": dict(eng_a.prefill_trace_counts)},
+        "b": {"kv_dtype": args.ab_kv_dtype,
+              "quant_policy": args.ab_quant_policy, "bytes": acct_b,
+              "trace_count": eng_b.trace_count,
+              "prefill_trace_counts": dict(eng_b.prefill_trace_counts)},
+        # The two committed ratios: measured decode bytes/token reduction and
+        # the slots-per-chip multiplier under the same HBM budget.
+        "decode_bytes_per_token_reduction":
+            acct_a["decode_bytes_per_token"] / acct_b["decode_bytes_per_token"],
+        "kv_bytes_per_slot_reduction":
+            acct_a["kv_bytes_per_slot"] / acct_b["kv_bytes_per_slot"],
+        "slots_at_budget_ratio":
+            (acct_b["slots_at_budget"] / acct_a["slots_at_budget"]
+             if acct_a["slots_at_budget"] else None),
+        # The accuracy budget, pinned with explicit bounds.
+        "token_match_rate": token_match_rate,
+        "prefix_match_rate": prefix_match_rate,
+        "token_match_bound": args.ab_match_bound,
+        "nll_fp32": nll_a,
+        "nll_quant": nll_b,
+        "nll_delta": nll_b - nll_a,
+        "nll_delta_bound": args.ab_nll_bound,
+        "one_program_pins": {
+            "decode_trace_count_ok":
+                eng_a.trace_count == 1 and eng_b.trace_count == 1,
+            "prefill_trace_counts_ok": all(
+                v <= 1 for e in (eng_a, eng_b)
+                for v in e.prefill_trace_counts.values()),
+        },
+        "accuracy_ok": (token_match_rate is not None
+                        and token_match_rate >= args.ab_match_bound
+                        and abs(nll_b - nll_a) <= args.ab_nll_bound),
+    }
+    return doc
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--vocab", type=int, default=16)
@@ -127,6 +260,28 @@ def main() -> int:
     p.add_argument("--curve-chunks", default="32,128,512",
                    help="prefill chunk-size set for the ON side of the curve")
     p.add_argument("--curve-new-tokens", type=int, default=8)
+    p.add_argument("--checkpoint", default="",
+                   help="TrainState or params msgpack from train.lm — real "
+                        "weights for the accuracy-budget side of --quant-ab "
+                        "(default: seeded random init)")
+    p.add_argument("--quant-ab", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="run the quantized-execution A/B (fp32 oracle vs "
+                        "--ab-kv-dtype/--ab-quant-policy engine): measured "
+                        "bytes, accuracy budget, compile pins")
+    p.add_argument("--ab-kv-dtype", default="int8",
+                   choices=("fp32", "bf16", "int8", "fp8"))
+    p.add_argument("--ab-quant-policy", default="w8",
+                   choices=("off", "w8", "w8a8"))
+    p.add_argument("--ab-requests", type=int, default=8)
+    p.add_argument("--ab-new-tokens", type=int, default=16)
+    p.add_argument("--ab-slots", type=int, default=4)
+    p.add_argument("--ab-nll-batch", type=int, default=4)
+    p.add_argument("--ab-match-bound", type=float, default=0.98,
+                   help="min greedy token-match rate vs the fp32 oracle "
+                        "(the documented accuracy budget)")
+    p.add_argument("--ab-nll-bound", type=float, default=0.05,
+                   help="max |NLL delta| through the quantized decode path")
     p.add_argument("--out", default=None)
     args = p.parse_args()
 
@@ -145,6 +300,11 @@ def main() -> int:
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
     params = model.init({"params": jax.random.PRNGKey(0)},
                         jnp.zeros((1, args.seq), jnp.int32))["params"]
+    if args.checkpoint:
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint as ckpt_mod,
+        )
+        params = ckpt_mod.load_params_or_state(args.checkpoint, params)
 
     # --- 3. ops per token: the optimized HLO of ONE decode step ---------------
     cache = lm_mod.init_cache(model, args.gen_batch)
@@ -190,16 +350,25 @@ def main() -> int:
         synced, n1=1, grow=4, max_n=64)
     t_token = per_gen / args.seq
 
-    # --- 2. HBM roofline per token (bench_lm's accounting) --------------------
-    e, s = args.d_model, args.seq
-    hd = e // args.heads
-    itemsize = jnp.dtype(model.dtype).itemsize
+    # --- 2. HBM roofline per token (byte-TRUE accounting) ---------------------
+    # Bytes come from the ACTUAL buffers, not closed-form dtype assumptions:
+    # one cached position's bytes = the real per-slot cache (planes AND any
+    # scale planes, at their real itemsize) over seq_len; weights = the real
+    # params tree. A quantized run's roofline denominator therefore shrinks
+    # exactly as far as its buffers did — the accounting rule the quantized
+    # A/B below relies on.
+    from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
+        quant as quant_ops,
+    )
+
+    s = args.seq
     # average static prefix read per step under the segmented scan
     seg = lm_mod.DECODE_SEGMENT
     nseg = -(-s // seg)
     avg_prefix = sum(min((j + 1) * seg, s) * seg for j in range(nseg)) / s
-    cache_bytes = args.layers * 2 * args.heads * hd * itemsize * avg_prefix
-    weight_bytes = (args.layers * 12 * e * e + 2 * e * (args.vocab + 1)) * itemsize
+    row_bytes = quant_ops.tree_bytes(lm_mod.init_cache(model, 1)) / s
+    cache_bytes = row_bytes * avg_prefix
+    weight_bytes = quant_ops.tree_bytes(params)
     bytes_per_token = cache_bytes + weight_bytes / args.gen_batch
     dev = jax.devices()[0]
     hbm = (peak_hbm_bytes(getattr(dev, "device_kind", ""))
@@ -225,9 +394,12 @@ def main() -> int:
         "attribution": ("residual / ops_per_token is the device's per-op launch "
                         "floor; the tunnel's ~70 ms host tax is cancelled by the "
                         "chained two-point protocol"),
+        "accounting": "byte-true: cache/weight bytes summed from live buffers",
     }
     if args.ttft_curve:
         doc["ttft_curve"] = ttft_curve(model, params, args)
+    if args.quant_ab:
+        doc["quant_ab"] = quant_ab(model, params, args)
     print(json.dumps(doc))
     if args.out:
         with open(args.out, "w") as f:

@@ -253,6 +253,8 @@ def build_replica_command(args) -> list[str]:
            "--prefill-chunks", args.prefill_chunks,
            "--prefill-budget", str(args.prefill_budget),
            "--prefix-cache", str(args.prefix_cache),
+           "--kv-dtype", args.kv_dtype,
+           "--quant-policy", args.quant_policy,
            "--warmup", str(args.warmup)]
     if args.rope:
         cmd.append("--rope")
@@ -289,6 +291,17 @@ def main(argv: list[str] | None = None) -> int:
                         "interleaving)")
     e.add_argument("--prefix-cache", type=int, default=0,
                    help="prefix KV cache LRU entries, 0 = off")
+    e.add_argument("--kv-dtype", default="model",
+                   choices=("model", "fp32", "bf16", "int8", "fp8"),
+                   help="KV-cache plane dtype: int8/fp8 = quantize-on-write "
+                        "planes with per-head scales (~half/quarter decode "
+                        "bytes, ~2-4x slots per HBM budget) — the quant A/B "
+                        "switch; 'model' keeps the bitwise-pinned fp32 path")
+    e.add_argument("--quant-policy", default="off",
+                   choices=("off", "w8", "w8a8"),
+                   help="weight-matmul path: w8 = int8 kernels + per-channel "
+                        "scales (f32 activations), w8a8 = int8 activations "
+                        "too (int8 x int8 -> int32 matmul)")
     e.add_argument("--warmup", type=int, default=1,
                    help="pre-measurement warmup rounds: compile the decode, "
                         "every prefill chunk size, and the prefix-cache install "
@@ -476,6 +489,12 @@ def main(argv: list[str] | None = None) -> int:
               f"sizes {list(engine.prefill_chunk_sizes) or 'off'})"
               + (f", prefix hits {hits['hits']}/{hits['queries']} "
                  f"({hits['hit_tokens']} tokens reused)" if hits else ""))
+        acct = engine.byte_accounting()
+        print(f"bytes (measured): kv {acct['kv_dtype']} / weights "
+              f"{acct['quant_policy']}, {acct['kv_bytes_per_slot']} B/slot, "
+              f"{acct['decode_bytes_per_token']:.0f} B decode read/token, "
+              f"{acct['slots_at_budget']} slots per "
+              f"{acct['hbm_budget_bytes'] >> 30} GiB budget")
     if args.telemetry:
         print(f"serve telemetry -> {args.telemetry} "
               f"(render: python tools/telemetry_report.py {args.telemetry})")
@@ -497,6 +516,8 @@ def main(argv: list[str] | None = None) -> int:
             "num_slots": args.num_slots,
             "prefill_chunk_budget": args.prefill_budget,
             "prefix_cache_entries": args.prefix_cache,
+            "kv_dtype": args.kv_dtype,
+            "quant_policy": args.quant_policy,
             "new_tokens": new_tokens,
             "tokens_per_s": new_tokens / wall if wall else None,
             "ttft_s": percentiles([c.ttft_s for c in comps]),
@@ -526,6 +547,7 @@ def main(argv: list[str] | None = None) -> int:
                 router_queue=rs.get("queue"))
         else:
             doc.update(
+                bytes=engine.byte_accounting(),
                 prefill_chunk_sizes=list(engine.prefill_chunk_sizes),
                 prefill_tokens=engine.prefill_tokens,
                 prefill_chunks=engine.prefill_invocations,

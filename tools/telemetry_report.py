@@ -171,6 +171,15 @@ def summarize(path: str) -> dict:
             s["prefix_hits"] = pc.get("hits")
             s["prefix_hit_tokens"] = pc.get("hit_tokens")
             s["prefix_hit_rate"] = pc["hits"] / pc["queries"]
+        # Byte-true quantization ledger (engine.byte_accounting()): the A-vs-B
+        # rows that prove a kv-dtype change moved fewer bytes and bought slots.
+        by = summary.get("bytes") or {}
+        if by:
+            s["kv_dtype"] = by.get("kv_dtype")
+            s["quant_policy"] = by.get("quant_policy")
+            s["decode_bytes_per_token"] = by.get("decode_bytes_per_token")
+            s["kv_bytes_per_slot"] = by.get("kv_bytes_per_slot")
+            s["slots_at_budget"] = by.get("slots_at_budget")
         for name in SERVE_SERIES:          # summary percentiles fill any gaps
             pcts = summary.get(name) or {}
             for q in SERVE_QS:
@@ -337,6 +346,12 @@ def print_summary(s: dict) -> None:
             print(f"   prefill: {_fmt(s['prefill_tokens'])} tokens in "
                   f"{_fmt(s.get('prefill_chunks'))} chunks  "
                   f"tokens/s {_fmt(s.get('prefill_tokens_per_s'))}{hit}")
+        if s.get("decode_bytes_per_token") is not None:
+            print(f"   bytes: kv {s.get('kv_dtype')} / weights "
+                  f"{s.get('quant_policy')}  "
+                  f"decode/token {_fmt(s['decode_bytes_per_token'])}  "
+                  f"kv/slot {_fmt(s.get('kv_bytes_per_slot'))}  "
+                  f"slots@budget {_fmt(s.get('slots_at_budget'))}")
         head = "   " + "".ljust(14) + "".join(f"p{q}".rjust(12) for q in SERVE_QS)
         print(head)
         for name in SERVE_SERIES:
@@ -360,6 +375,9 @@ COMPARE_ROWS = [
     ("restarts", "restarts"),
     ("serve tokens/s", "serve_tokens_per_s"),
     ("prefill tok/s", "prefill_tokens_per_s"),
+    ("decode bytes/tok", "decode_bytes_per_token"),
+    ("kv bytes/slot", "kv_bytes_per_slot"),
+    ("slots @ budget", "slots_at_budget"),
     ("prefix hit rate", "prefix_hit_rate"),
     ("affinity hit rate", "affinity_rate"),
     ("redispatches", "redispatches"),
