@@ -174,6 +174,11 @@ class ContinuousBatchingEngine:
         # points it at resilience.faults.on_tick so kill/preempt faults fire
         # mid-decode, deterministically). None = zero-cost.
         self.on_step = None
+        # Distributed-tracing hook (``utils.trace.Tracer``), set by the server
+        # front end: requests carrying a trace_id get queue_wait / per-chunk
+        # prefill / decode spans. None (the default) = zero-cost — no span is
+        # ever emitted, no stamp beyond what the latency fields already take.
+        self.tracer = None
         self.trace_count = 0          # traces of the decode program (tests pin == 1)
         self.steps = 0                # decode steps executed
         self.slot_steps = 0           # sum of occupied slots over steps (occupancy)
@@ -206,6 +211,10 @@ class ContinuousBatchingEngine:
         self._out: list[list[int]] = [[] for _ in range(b)]
         self._admit_s = np.zeros((b,), np.float64)
         self._first_tok_s: list[float | None] = [None] * b
+        # When this slot's occupant became decode-READY (prompt fully in the
+        # cache): the decode span's start, and the boundary between prefill
+        # latency and decode time in the critical-path breakdown.
+        self._ready_s = np.zeros((b,), np.float64)
         # --- chunked batched prefill state -----------------------------------
         # Chunk sizes are clipped to seq_len and deduped: a tiny test model with
         # seq_len 16 turns the default (32, 128, 512) into a single 16-chunk.
@@ -402,6 +411,12 @@ class ContinuousBatchingEngine:
         self._chunks_done[slot] = 0
         if request.arrival_s is None:
             request.arrival_s = now
+        if self.tracer is not None:
+            # Replica-side queue wait: front-end arrival -> slot admission.
+            self.tracer.span("queue_wait", request.trace_id,
+                             request.arrival_s, now,
+                             request_id=request.request_id, slot=slot)
+        self._ready_s[slot] = now
         prompt_np = np.asarray(request.prompt, np.int32).reshape(-1)
         hit_len = 0
         if self.prefix_cache is not None and p:
@@ -451,6 +466,7 @@ class ContinuousBatchingEngine:
         self._t[slot] = p
         self._out[slot] = [int(x) for x in np.asarray(req.prompt, np.int32)]
         self._active[slot] = True
+        self._ready_s[slot] = time.monotonic()
 
     def _record_prefill(self, slot: int, *, wall_s: float,
                         latency_s: float) -> None:
@@ -540,7 +556,23 @@ class ContinuousBatchingEngine:
 
     def _finish(self, slot: int, finish: str, now: float) -> Completion:
         req = self._requests[slot]
-        if self._pending_chunks[slot]:
+        mid_prefill = bool(self._pending_chunks[slot])
+        if self.tracer is not None and not mid_prefill:
+            # The decode span: decode-ready -> done, with the first-token split
+            # (``first_token_s`` = offset into the span, for the critical-path
+            # decode_first/decode_tail segments; ``first_token_ts`` = absolute
+            # stamp, anchored by the tracer — the span-derived TTFT endpoint).
+            first = self._first_tok_s[slot]
+            ready = float(self._ready_s[slot])
+            self.tracer.span(
+                "decode", req.trace_id, ready, now,
+                request_id=req.request_id, slot=slot, finish=finish,
+                new_tokens=max(len(self._out[slot]) - int(self._prompt_len[slot]),
+                               0),
+                first_token_s=(None if first is None
+                               else round(max(0.0, first - ready), 6)),
+                first_token_ts=first)
+        if mid_prefill:
             # Mid-prefill expiry: the emitted stream is the teacher-forced
             # prompt prefix covered so far — the next pending chunk's start.
             # The chunk wall already spent joins the aggregate (its tokens are
@@ -582,6 +614,13 @@ class ContinuousBatchingEngine:
         """Slots whose prompt prefill plan has not drained yet."""
         return len(self._prefill_fifo)
 
+    @property
+    def prefill_backlog(self) -> int:
+        """Prompt chunks still pending across every prefilling slot — the
+        fleet_snapshot load signal: a backlog growing under a fixed chunk
+        budget means prompts are arriving faster than prefill drains them."""
+        return sum(len(c) for c in self._pending_chunks)
+
     def _run_prefill(self) -> None:
         """Run up to ``prefill_chunk_budget`` chunk invocations, oldest admitted
         slot first (FIFO — best TTFT fairness), finishing slots mid-budget. The
@@ -596,7 +635,14 @@ class ContinuousBatchingEngine:
             self._cache = self._prefill_jits[size](
                 self.params, self._cache, self._prompt, np.int32(slot),
                 np.int32(start), np.int32(length), np.asarray(bool(fresh)))
-            self._chunk_wall[slot] += time.monotonic() - t0
+            t1 = time.monotonic()
+            self._chunk_wall[slot] += t1 - t0
+            if self.tracer is not None:
+                req = self._requests[slot]
+                self.tracer.span("prefill", req.trace_id, t0, t1,
+                                 request_id=req.request_id, slot=slot,
+                                 chunk=size, start=start, length=length,
+                                 cache_hit_len=int(self._hit_len[slot]))
             self.prefill_invocations += 1
             self.prefill_tokens += length
             self._chunks_done[slot] += 1

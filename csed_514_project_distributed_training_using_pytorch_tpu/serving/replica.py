@@ -24,7 +24,9 @@ Line protocol (one JSON object per line, both directions):
 router → replica
 --------------------  -------------------------------------------------------------
 ``submit``            ``{"op", "id", "prompt", "max_new_tokens", "temperature",
-                      "top_k", "top_p", "timeout_s"}`` — enqueue one request
+                      "top_k", "top_p", "timeout_s"}`` — enqueue one request;
+                      ``trace_id`` appears ONLY on traced requests (tracing
+                      off keeps the line byte-identical — pinned)
 ``stats``             ``{"op", "id"}`` — request the engine/queue counters
 ``stop``              graceful drain: finish accepted work, then exit 0
 --------------------  -------------------------------------------------------------
@@ -74,15 +76,20 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler i
     QueueFull,
     SamplingParams,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.trace import (
+    Tracer,
+)
 
 
-def build_engine_server(args):
+def build_engine_server(args, trace: Tracer | str | None = None):
     """The jax-backed engine + server from an argparse namespace (model,
     engine, and server flags as declared in :func:`main` — ``tools/
     serve_loadgen.py`` mirrors them 1:1 and calls this for its in-process
     mode, so the single-engine baseline and every fleet replica are built by
     the same code path: same checkpoint-format fallback, same warmup recipe).
-    Imports jax lazily: ``--echo`` never pays."""
+    ``trace`` is the distributed-tracing sink (a ``utils.trace.Tracer`` or a
+    span-JSONL path) handed to the ``Server``; None falls back to
+    ``args.trace`` when present. Imports jax lazily: ``--echo`` never pays."""
     import jax
     import jax.numpy as jnp
 
@@ -138,7 +145,9 @@ def build_engine_server(args):
         engine.reset_stats()
     server = Server(engine, max_pending=args.max_pending,
                     default_timeout_s=args.timeout_s or None,
-                    telemetry=args.telemetry)
+                    telemetry=args.telemetry,
+                    trace=trace if trace is not None
+                    else getattr(args, "trace", ""))
     return engine, server
 
 
@@ -148,27 +157,45 @@ class _EchoServer:
     The reply for a prompt is the prompt followed by ``(sum(prompt) + i) % vocab``
     — a pure function of the request, so a redispatched replay is token-identical
     exactly like greedy decode. ``delay_s`` stretches each request so faults can
-    land with work genuinely in flight."""
+    land with work genuinely in flight. With tracing on it emits the same
+    ``decode`` span shape as the real engine (first-token split included), so
+    the router's span-tree tests exercise cross-process trace assembly without
+    jax."""
 
-    def __init__(self, args):
+    def __init__(self, args, tracer: Tracer | None = None):
         self.vocab = args.num_levels + 1
         self.seq_len = args.seq_len
         self.delay_s = args.echo_delay_s
         self.steps = 0               # protocol parity with engine.steps
+        self.tracer = tracer
         self._lock = threading.Lock()
 
-    def complete(self, prompt: np.ndarray, max_new: int) -> np.ndarray:
+    def complete(self, prompt: np.ndarray, max_new: int, *,
+                 trace_id: str | None = None,
+                 request_id: int | None = None) -> np.ndarray:
         p = len(prompt)
         total = min(p + max_new, self.seq_len)
         base = int(prompt.sum()) if p else 0
         out = list(prompt) + [(base + i) % (self.vocab - 1)
                               for i in range(total - p)]
-        for _ in range(total - p):
+        t0 = time.monotonic()
+        first = None
+        for i in range(total - p):
             faults.on_tick(step=self.steps)
             with self._lock:
                 self.steps += 1
             if self.delay_s:
                 time.sleep(self.delay_s)
+            if i == 0:
+                first = time.monotonic()
+        if self.tracer is not None:
+            now = time.monotonic()
+            self.tracer.span(
+                "decode", trace_id, t0, now, request_id=request_id,
+                finish="ok", new_tokens=total - p,
+                first_token_s=(None if first is None
+                               else round(first - t0, 6)),
+                first_token_ts=first)
         return np.asarray(out, np.int32)
 
 
@@ -186,8 +213,11 @@ def _handle_submit(msg, server, wfile, wlock):
                               top_k=msg.get("top_k", 0),
                               top_p=msg.get("top_p", 1.0))
     try:
+        # trace_id rides the wire verbatim (present only when the router side
+        # traces): the replica's spans join the fleet-wide trace by id alone.
         fut = server.submit(prompt, max_new_tokens=msg["max_new_tokens"],
-                            sampling=sampling, timeout_s=msg.get("timeout_s"))
+                            sampling=sampling, timeout_s=msg.get("timeout_s"),
+                            trace_id=msg.get("trace_id"))
     except QueueFull:
         _send(wfile, wlock, {"op": "error", "id": rid, "error": "queue_full",
                              "message": "replica queue at capacity"})
@@ -225,11 +255,14 @@ def _handle_submit(msg, server, wfile, wlock):
 def _stats_payload(engine, server) -> dict:
     eng: dict = {"steps": engine.steps}
     for name in ("prefill_tokens", "prefill_invocations", "prefill_wall_s",
-                 "trace_count", "slot_occupancy"):
+                 "trace_count", "slot_occupancy", "prefill_backlog"):
         if hasattr(engine, name):
             eng[name] = getattr(engine, name)
     cache = getattr(engine, "prefix_cache", None)
     eng["prefix_cache"] = cache.stats() if cache is not None else None
+    if hasattr(engine, "byte_accounting"):
+        # Measured bytes/token for the router's fleet_snapshot timeline.
+        eng["bytes"] = engine.byte_accounting()
     return {"engine": eng,
             "queue": (server.queue.snapshot()
                       if hasattr(server, "queue") else None)}
@@ -240,10 +273,14 @@ def serve_forever(args) -> int:
     os.environ.setdefault("JAX_PROCESS_ID", str(replica_id))
     handler = PreemptionHandler().install()
 
+    # This process's span track (``--trace`` empty = everything below is a
+    # no-op): one file per replica, appended across restarts — a crashed
+    # generation's spans survive it, tearing at most its own final line.
+    tracer = Tracer(args.trace, proc=f"replica{replica_id}")
     if args.echo:
-        engine = server = _EchoServer(args)
+        engine = server = _EchoServer(args, tracer if tracer.enabled else None)
     else:
-        engine, server = build_engine_server(args)
+        engine, server = build_engine_server(args, trace=tracer)
         server.start()
 
     beat = hb.HeartbeatWriter(args.heartbeat_dir,
@@ -304,7 +341,9 @@ def serve_forever(args) -> int:
                 def _echo_job(m=msg):
                     prompt = np.asarray(m.get("prompt") or [], np.int32)
                     t0 = time.monotonic()
-                    tokens = server.complete(prompt, m["max_new_tokens"])
+                    tokens = server.complete(prompt, m["max_new_tokens"],
+                                             trace_id=m.get("trace_id"),
+                                             request_id=m["id"])
                     try:
                         _send(wfile, wlock, {
                             "op": "done", "id": m["id"],
@@ -360,7 +399,9 @@ def serve_forever(args) -> int:
                     if line and not _handle(json.loads(line), wfile, wlock):
                         stop_flag.set()
                         if not args.echo:
-                            server.stop(drain=True)
+                            server.stop(drain=True)   # loop closes the tracer
+                        else:
+                            tracer.close()
                         return 0
         except (OSError, ValueError, json.JSONDecodeError):
             pass
@@ -411,6 +452,10 @@ def main(argv: list[str] | None = None) -> int:
                         "accepting traffic (0 = off)")
     p.add_argument("--telemetry", default="",
                    help="this replica's own serve JSONL (optional)")
+    p.add_argument("--trace", default="",
+                   help="distributed-tracing span JSONL for THIS replica "
+                        "(the router appends one per replica under its "
+                        "--trace-dir); empty = tracing off")
     args = p.parse_args(argv)
     return serve_forever(args)
 

@@ -48,6 +48,7 @@ import collections
 import concurrent.futures
 import dataclasses
 import json
+import os
 import socket
 import threading
 import time
@@ -76,6 +77,10 @@ from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import 
     JsonlWriter,
     percentiles,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.trace import (
+    Tracer,
+    new_trace_id,
+)
 
 
 @dataclasses.dataclass
@@ -94,6 +99,9 @@ class RouterRequest:
     redispatches: int = 0
     dispatch_s: float | None = None     # last dispatch time (queue-wait split)
     affinity_hit: bool = False          # last dispatch landed on the affine replica
+    trace_id: str | None = None         # distributed-tracing id (None = untraced)
+    enqueued_s: float = 0.0             # last (re)entry into the router queue —
+                                        # the current queue_wait span's start
 
 
 @dataclasses.dataclass
@@ -215,6 +223,7 @@ class Router:
                  max_restarts: int = 3, backoff_s: float = 0.5,
                  backoff_max_s: float = 10.0, connect_timeout_s: float = 240.0,
                  telemetry: str = "", poll_s: float = 0.05,
+                 trace_dir: str = "", snapshot_interval_s: float = 0.0,
                  env: dict | None = None):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
@@ -234,6 +243,20 @@ class Router:
         self._connect_timeout_s = connect_timeout_s
         self._poll_s = poll_s
         self._writer = JsonlWriter(telemetry)
+        # Distributed tracing (utils/trace.py): trace_dir holds one span JSONL
+        # per process — the router writes router.jsonl, each replica gets
+        # ``--trace <dir>/replica<i>.jsonl`` appended to its argv. Empty = off:
+        # no Tracer file, no --trace flag, and the wire protocol stays
+        # byte-identical (``_submit_msg`` adds trace_id only when present).
+        self._trace_dir = trace_dir
+        self.tracer = Tracer(os.path.join(trace_dir, "router.jsonl")
+                             if trace_dir else "", proc="router")
+        # Metrics timeline: every ``snapshot_interval_s`` the router emits one
+        # ``fleet_snapshot`` event — queue depth/oldest-age vs per-replica
+        # occupancy/pending/capacity, prefill backlog, prefix/affinity hit
+        # rates, restarts, bytes/token — the load signal elastic scale-up/down
+        # (ROADMAP open item 1) will consume. 0 = off.
+        self._snapshot_interval_s = float(snapshot_interval_s)
         self.replicas = [_Replica(i) for i in range(num_replicas)]
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
@@ -275,8 +298,11 @@ class Router:
         with self._lock:
             for rep in self.replicas:
                 self._spawn(rep)
-        for name, target in (("router-dispatch", self._dispatch_loop),
-                             ("router-monitor", self._monitor_loop)):
+        loops = [("router-dispatch", self._dispatch_loop),
+                 ("router-monitor", self._monitor_loop)]
+        if self._snapshot_interval_s > 0 and self._writer.enabled:
+            loops.append(("router-snapshot", self._snapshot_loop))
+        for name, target in loops:
             t = threading.Thread(target=target, daemon=True, name=name)
             t.start()
             self._threads.append(t)
@@ -311,13 +337,16 @@ class Router:
 
     def submit(self, prompt, *, max_new_tokens: int,
                sampling: SamplingParams | None = None,
-               timeout_s: float | None = None) -> concurrent.futures.Future:
+               timeout_s: float | None = None,
+               trace_id: str | None = None) -> concurrent.futures.Future:
         """Thread-safe enqueue; returns a Future resolving to a
         ``RouterCompletion``. Raises ``QueueFull`` (router backpressure)
         immediately in the caller's thread. Deep validation (prompt vs seq_len,
         sampling bounds) happens replica-side — an ``invalid`` reply fails the
         future with ``ValueError`` (replays would fail identically, so it is
-        never redispatched)."""
+        never redispatched). ``trace_id`` joins this request to an existing
+        distributed trace; with tracing on and no id given, this submit is the
+        trace origin and assigns one."""
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if self._aborted:
@@ -327,13 +356,16 @@ class Router:
         with self._lock:
             rid = self._next_id
             self._next_id += 1
+        if trace_id is None and self.tracer.enabled:
+            trace_id = new_trace_id()
         req = RouterRequest(
             prompt=np.asarray(prompt, np.int32).reshape(-1),
             max_new_tokens=int(max_new_tokens),
             sampling=sampling or SamplingParams(),
             request_id=rid, future=concurrent.futures.Future(),
             arrival_s=now,
-            deadline_s=None if timeout_s is None else now + timeout_s)
+            deadline_s=None if timeout_s is None else now + timeout_s,
+            trace_id=trace_id, enqueued_s=now)
         self.queue.submit(req)           # may raise QueueFull / closed
         return req.future
 
@@ -352,6 +384,12 @@ class Router:
         if self._hb_dir:
             hb.clear(self._hb_dir, rep.index)
             cmd += ["--heartbeat-dir", self._hb_dir]
+        if self._trace_dir:
+            # One span file per replica, appended across restarts: a crashed
+            # generation's history survives, and it tears at most its own
+            # final line (which the shared guarded reader tolerates).
+            cmd += ["--trace",
+                    os.path.join(self._trace_dir, f"replica{rep.index}.jsonl")]
         rep.fleet = Fleet(cmd, num_processes=1, platform=self._platform,
                           process_id_base=rep.index, env=self._env)
         rep.started_wall = time.time()
@@ -499,6 +537,17 @@ class Router:
             with self._lock:
                 self._counts["duplicates"] += 1
             return
+        # The winning hop's dispatch span (send -> completion line) plus the
+        # terminal resolve span (completion line -> future resolved). ok
+        # dispatches OVERLAP the replica's own spans, so the critical-path
+        # breakdown charges only drained ones — see utils.trace.SEGMENTS.
+        self.tracer.span("dispatch", req.trace_id, req.dispatch_s, now,
+                         request_id=req.request_id, replica=rep.index,
+                         outcome="ok", hop=req.redispatches)
+        self.tracer.span("resolve", req.trace_id, now, time.monotonic(),
+                         request_id=req.request_id, replica=rep.index,
+                         finish=comp.finish, new_tokens=comp.new_tokens,
+                         redispatches=req.redispatches)
         self._record(comp)
 
     def _handle_error(self, rep: _Replica, msg: dict) -> None:
@@ -509,10 +558,15 @@ class Router:
             if req is None:
                 return
             self._cond.notify_all()
+        now = time.monotonic()
         kind = msg.get("error")
         if kind == "queue_full":
             # Router/replica capacity accounting drifted (e.g. a replica
             # restarted thinner): bounce back to the queue front, try elsewhere.
+            self.tracer.span("dispatch", req.trace_id, req.dispatch_s, now,
+                             request_id=req.request_id, replica=rep.index,
+                             outcome="bounced", hop=req.redispatches)
+            req.enqueued_s = now
             self.queue.requeue(req)
             return
         err = (ValueError if kind == "invalid" else RuntimeError)(
@@ -521,6 +575,12 @@ class Router:
             req.future.set_exception(err)
         except concurrent.futures.InvalidStateError:
             return                        # lost a resolve race: already settled
+        self.tracer.span("dispatch", req.trace_id, req.dispatch_s, now,
+                         request_id=req.request_id, replica=rep.index,
+                         outcome="error", error=kind, hop=req.redispatches)
+        self.tracer.span("resolve", req.trace_id, now, time.monotonic(),
+                         request_id=req.request_id, replica=rep.index,
+                         finish="error", error=kind)
         with self._lock:
             self._counts["failed"] += 1
 
@@ -545,27 +605,49 @@ class Router:
 
     # ------------------------------------------------------------------ dispatch
 
-    def _choose(self, prompt: np.ndarray) -> tuple[_Replica | None, bool]:
+    def _choose(self, prompt: np.ndarray) -> tuple[_Replica | None, bool, bool]:
         """Pick the dispatch target (caller holds the lock): the affine replica
         when it has room, else the least-loaded replica with room (spill-over),
-        else None (everyone is at capacity — backpressure holds the request)."""
+        else None (everyone is at capacity — backpressure holds the request).
+        Returns ``(replica, affinity_hit, spilled)`` — ``spilled`` marks an
+        affine replica that existed but had no room (the route span records it:
+        a paid-for warm cache the fleet was too loaded to use)."""
+        spilled = False
         if self._affinity_on:
             idx = self._affinity.lookup(prompt, self._affinity_min)
-            if idx is not None and self.replicas[idx].room():
-                return self.replicas[idx], True
+            if idx is not None:
+                if self.replicas[idx].room():
+                    return self.replicas[idx], True, False
+                spilled = True
         ups = [r for r in self.replicas if r.room()]
         if not ups:
-            return None, False
+            return None, False, spilled
         self._rr += 1
         rep = min(ups, key=lambda r: (len(r.inflight),
                                       (r.index - self._rr) % len(self.replicas)))
-        return rep, False
+        return rep, False, spilled
+
+    @staticmethod
+    def _submit_msg(req: RouterRequest, now: float) -> dict:
+        """The wire-protocol submit line. ``trace_id`` is added ONLY when the
+        request carries one — tracing off keeps the message byte-identical to
+        the pre-tracing protocol (pinned in tests)."""
+        msg = {"op": "submit", "id": req.request_id,
+               "prompt": [int(t) for t in req.prompt],
+               "max_new_tokens": req.max_new_tokens,
+               "temperature": req.sampling.temperature,
+               "top_k": req.sampling.top_k, "top_p": req.sampling.top_p,
+               "timeout_s": (None if req.deadline_s is None
+                             else max(0.001, req.deadline_s - now))}
+        if req.trace_id is not None:
+            msg["trace_id"] = req.trace_id
+        return msg
 
     def _dispatch_one(self, req: RouterRequest) -> bool:
         """Send one request to a chosen replica; False when everyone is full."""
         now = time.monotonic()
         with self._cond:
-            rep, hit = self._choose(req.prompt)
+            rep, hit, spilled = self._choose(req.prompt)
             if rep is None:
                 return False
             # Stamp the LAST dispatch: the client's first token comes from the
@@ -583,13 +665,15 @@ class Router:
             if self._affinity_on:
                 self._affinity.insert(req.prompt, rep.index)
             wfile, wlock = rep.wfile, rep.wlock
-        msg = {"op": "submit", "id": req.request_id,
-               "prompt": [int(t) for t in req.prompt],
-               "max_new_tokens": req.max_new_tokens,
-               "temperature": req.sampling.temperature,
-               "top_k": req.sampling.top_k, "top_p": req.sampling.top_p,
-               "timeout_s": (None if req.deadline_s is None
-                             else max(0.001, req.deadline_s - now))}
+        # This queue stint ends here (enqueued_s -> dispatch); the route span
+        # records the decision itself — target, affinity outcome, spill-over.
+        self.tracer.span("queue_wait", req.trace_id, req.enqueued_s, now,
+                         request_id=req.request_id, hop=req.redispatches)
+        self.tracer.span("route", req.trace_id, now,
+                         request_id=req.request_id, replica=rep.index,
+                         affinity_hit=hit, spilled=spilled,
+                         hop=req.redispatches)
+        msg = self._submit_msg(req, now)
         try:
             with wlock:
                 wfile.write((json.dumps(msg) + "\n").encode())
@@ -599,6 +683,7 @@ class Router:
             # classify the replica. (AttributeError: wfile already cleared.)
             with self._cond:
                 rep.inflight.pop(req.request_id, None)
+            req.enqueued_s = time.monotonic()   # a fresh queue stint begins
             self.queue.requeue(req)
         return True
 
@@ -614,6 +699,10 @@ class Router:
             req.future.set_result(comp)
         except concurrent.futures.InvalidStateError:
             return                        # lost a resolve race: already settled
+        # Expiry is terminal too: a timed-out trace must not read as an orphan.
+        self.tracer.span("resolve", req.trace_id, now, time.monotonic(),
+                         request_id=req.request_id, finish="timeout",
+                         redispatches=req.redispatches)
         self._record(comp)
 
     def _dispatch_loop(self) -> None:
@@ -659,23 +748,44 @@ class Router:
 
     # ------------------------------------------------------------------ monitor
 
-    def _drain_ledger(self, rep: _Replica, now: float) -> int:
+    # Failure reasons as trace-span causes: the vocabulary the redispatch span
+    # (and DESIGN.md §17) uses — crash / preempt / hang, plus the two
+    # connection-level ones.
+    _CAUSES = {"preempted": "preempt", "hung": "hang"}
+
+    def _drain_ledger(self, rep: _Replica, now: float,
+                      cause: str = "conn_lost") -> int:
         """Move a dead/unreachable replica's in-flight work back into the queue
         FRONT (caller holds the lock): FIFO order preserved, already-settled
         requests skipped, past-deadline requests resolved as timeouts instead
         of being replayed. The ONE owner of redispatch accounting — both the
         failure path and the live-process reconnect path go through here.
         Returns how many entries the ledger held."""
+        cause = self._CAUSES.get(cause, cause)
         drained = list(rep.inflight.values())
         rep.inflight.clear()
         for req in reversed(drained):         # appendleft x N keeps FIFO order
             if req.future.done():
                 continue                      # already resolved: nothing to replay
+            # The losing hop closes here (outcome="drained" — the interval the
+            # critical path charges as failed_dispatch, unlike an "ok" dispatch
+            # which merely overlaps the replica's own spans).
+            self.tracer.span("dispatch", req.trace_id, req.dispatch_s, now,
+                             request_id=req.request_id, replica=rep.index,
+                             outcome="drained", hop=req.redispatches)
             if req.deadline_s is not None and now > req.deadline_s:
                 self._expire(req, now)        # past deadline: expired, NOT a
             else:                             # redispatch — don't count one
                 req.redispatches += 1
                 self._counts["redispatches"] += 1
+                # The hop marker: hop number of the attempt about to begin and
+                # why the last one died — the span tree's crash/preempt/hang
+                # evidence (a point span; the replay's own queue stint starts
+                # now).
+                self.tracer.span("redispatch", req.trace_id, now,
+                                 request_id=req.request_id, replica=rep.index,
+                                 cause=cause, hop=req.redispatches)
+                req.enqueued_s = now
                 self.queue.requeue(req)
         return len(drained)
 
@@ -691,7 +801,7 @@ class Router:
             rep.exit_code = exit_code
             self._affinity.drop_replica(rep.index)
             now = time.monotonic()
-            drained = self._drain_ledger(rep, now)
+            drained = self._drain_ledger(rep, now, cause=reason)
             if rep.restarts >= self._max_restarts:
                 rep.state = "dead"
             else:
@@ -752,6 +862,12 @@ class Router:
             try:
                 if not req.future.done():
                     req.future.set_exception(err)
+                    # Terminal span: an aborted future is resolved, not
+                    # stranded — its trace must not read as an orphan.
+                    self.tracer.span("resolve", req.trace_id, now,
+                                     time.monotonic(),
+                                     request_id=req.request_id,
+                                     finish="aborted")
             except concurrent.futures.InvalidStateError:
                 pass      # lost a resolve race — must not kill the monitor thread
 
@@ -797,6 +913,93 @@ class Router:
                     with self._lock:
                         self._spawn(rep)
             time.sleep(self._poll_s)
+
+    # ------------------------------------------------------------------ snapshot
+
+    def _poke_stats(self) -> None:
+        """Fire-and-forget ``stats`` requests to every live replica; the io
+        threads fold the replies into ``rep.stats`` whenever they land. Unlike
+        ``_collect_stats`` this never blocks — the snapshot loop reads whatever
+        the LAST poke brought back (at most one interval stale, which the
+        timeline consumer tolerates by construction: it is a trend signal)."""
+        with self._lock:
+            targets = [(r.wfile, r.wlock) for r in self.replicas
+                       if r.state == "up" and r.wfile is not None]
+        for wfile, wlock in targets:
+            try:
+                with wlock:
+                    wfile.write(b'{"op": "stats", "id": -1}\n')
+                    wfile.flush()
+            except OSError:
+                pass                  # dying replica: the monitor will classify
+
+    def fleet_snapshot(self) -> dict:
+        """One ``fleet_snapshot`` event: the router-side load state (queue
+        depth/oldest-age, per-replica in-flight vs capacity, restart and
+        redispatch counters, affinity rate) joined with each replica's last
+        reported engine counters (slot occupancy, prefill backlog, prefix-cache
+        hit rate, measured decode bytes/token). This is the scale-up/down
+        signal elastic fleet serving (ROADMAP open item 1) consumes: queue
+        depth + oldest-age rising while utilization is pinned at 1.0 means
+        "grow"; utilization falling toward 0 with an empty queue means
+        "shrink"."""
+        now = time.monotonic()
+        with self._lock:
+            counts = dict(self._counts)
+            per_replica = []
+            for r in self.replicas:
+                row = {"replica": r.index, "state": r.state,
+                       "inflight": len(r.inflight), "capacity": r.capacity,
+                       "restarts": r.restarts, "dispatched": r.dispatched,
+                       "completed": r.completed}
+                eng = (r.stats or {}).get("engine") or {}
+                if eng:
+                    row["occupancy"] = eng.get("slot_occupancy")
+                    row["prefill_backlog"] = eng.get("prefill_backlog")
+                    pc = eng.get("prefix_cache") or {}
+                    if pc.get("queries"):
+                        row["prefix_hit_rate"] = pc["hits"] / pc["queries"]
+                    by = eng.get("bytes") or {}
+                    if by:
+                        row["decode_bytes_per_token"] = \
+                            by.get("decode_bytes_per_token")
+                per_replica.append(row)
+        inflight = sum(r["inflight"] for r in per_replica)
+        capacity = sum(r["capacity"] or 0 for r in per_replica
+                       if r["state"] == "up")
+        routed = counts["requests"]
+        return {
+            "event": "fleet_snapshot",
+            "queue": self.queue.snapshot(now),
+            "inflight": inflight,
+            "capacity_up": capacity,
+            "utilization": inflight / capacity if capacity else None,
+            "requests": routed,
+            "ok": counts["ok"],
+            "failed": counts["failed"],
+            "redispatches": counts["redispatches"],
+            "duplicates": counts["duplicates"],
+            "affinity_rate": (counts["affinity_hits"] / routed
+                              if routed else None),
+            "restarts": sum(r["restarts"] for r in per_replica),
+            "per_replica": per_replica,
+        }
+
+    def _snapshot_loop(self) -> None:
+        """The metrics timeline: every ``snapshot_interval_s``, poke the
+        replicas for fresh engine counters and emit one ``fleet_snapshot``
+        line. Emission stops with the writer (stop() closes it; emit on a
+        closed writer is a guarded no-op)."""
+        interval = self._snapshot_interval_s
+        while True:
+            deadline = time.monotonic() + interval
+            self._poke_stats()
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if self._stopping:
+                        return
+                time.sleep(min(self._poll_s, interval / 4))
+            self._writer.emit(self.fleet_snapshot())
 
     # ------------------------------------------------------------------ stop
 
@@ -889,15 +1092,23 @@ class Router:
         if leftover:
             err = ServerStopped(
                 f"router stopped with {len(leftover)} request(s) unfinished")
+            sweep_s = time.monotonic()
             for req in leftover:
                 try:
                     if not req.future.done():
                         req.future.set_exception(err)
+                        # Terminal span, same contract as _expire/_abort_all:
+                        # a swept future's trace must not read as an orphan.
+                        self.tracer.span("resolve", req.trace_id, sweep_s,
+                                         time.monotonic(),
+                                         request_id=req.request_id,
+                                         finish="stopped")
                 except concurrent.futures.InvalidStateError:
                     pass          # lost a resolve race: already settled elsewhere
         self.last_summary = self._summary(end_s=served_until_s)
         self._writer.emit(dict(self.last_summary))
         self._writer.close()
+        self.tracer.close()
         if err is not None:
             raise err
         return self.last_summary

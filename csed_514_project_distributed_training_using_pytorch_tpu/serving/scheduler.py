@@ -76,7 +76,10 @@ class Request:
     (``generate``'s prompt convention: output positions ``0..P-1`` are forced to it,
     its K/V populating the cache); ``max_new_tokens`` bounds the sampled suffix.
     ``deadline_s``/``arrival_s`` are ``time.monotonic()`` stamps (absolute), set by
-    the server front end; both optional for direct engine use."""
+    the server front end; both optional for direct engine use. ``trace_id`` is
+    the distributed-tracing correlation id (``utils/trace.py``): assigned at
+    origin, propagated verbatim — None means untraced (the default; no span is
+    ever emitted for it)."""
 
     prompt: np.ndarray
     max_new_tokens: int
@@ -84,6 +87,7 @@ class Request:
     request_id: int = 0
     deadline_s: float | None = None
     arrival_s: float | None = None
+    trace_id: str | None = None
 
 
 class RequestQueue:

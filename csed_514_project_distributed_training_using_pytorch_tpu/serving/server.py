@@ -44,6 +44,10 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler i
 from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
     telemetry as T,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.trace import (
+    Tracer,
+    new_trace_id,
+)
 
 
 class Server:
@@ -52,13 +56,22 @@ class Server:
     ``telemetry`` is a JSONL path (a stream-mode ``TelemetryWriter`` is created)
     or an existing writer; empty/None disables emission. ``default_timeout_s``
     applies to requests submitted without an explicit ``timeout_s``.
+    ``trace`` enables distributed tracing (``utils/trace.py``): a span JSONL
+    path or an existing ``Tracer``; the engine gets queue_wait/prefill/decode
+    spans, the server the resolve span, and ``submit`` assigns a ``trace_id``
+    to requests that arrive without one (this server as trace origin).
     """
 
     def __init__(self, engine: ContinuousBatchingEngine, *, max_pending: int = 0,
                  default_timeout_s: float | None = None,
                  telemetry: str | T.TelemetryWriter | None = None,
+                 trace: str | Tracer | None = None,
                  idle_wait_s: float = 0.05):
         self.engine = engine
+        self.tracer = (trace if isinstance(trace, Tracer)
+                       else Tracer(trace or "", proc="server"))
+        if self.tracer.enabled:
+            engine.tracer = self.tracer
         self.queue = RequestQueue(max_pending)
         self._default_timeout_s = default_timeout_s
         self._writer = (telemetry if isinstance(telemetry, T.TelemetryWriter)
@@ -162,21 +175,27 @@ class Server:
 
     def submit(self, prompt, *, max_new_tokens: int,
                sampling: SamplingParams = SamplingParams(),
-               timeout_s: float | None = None) -> concurrent.futures.Future:
+               timeout_s: float | None = None,
+               trace_id: str | None = None) -> concurrent.futures.Future:
         """Thread-safe enqueue. Returns a Future resolving to a ``Completion``
         (``finish`` tells ok from timeout). Raises ``QueueFull`` (backpressure)
         or ``ValueError`` (admission control: oversized prompt, bad sampling
-        params) immediately, in the caller's thread."""
+        params) immediately, in the caller's thread. ``trace_id`` joins this
+        request to an existing distributed trace; with tracing on and no id
+        given, this submit is the trace origin and assigns one."""
         now = time.monotonic()
         timeout_s = self._default_timeout_s if timeout_s is None else timeout_s
         with self._id_lock:
             rid = self._next_id
             self._next_id += 1
+        if trace_id is None and self.tracer.enabled:
+            trace_id = new_trace_id()
         req = Request(
             prompt=np.asarray(prompt, np.int32).reshape(-1),
             max_new_tokens=int(max_new_tokens), sampling=sampling,
             request_id=rid, arrival_s=now,
-            deadline_s=None if timeout_s is None else now + timeout_s)
+            deadline_s=None if timeout_s is None else now + timeout_s,
+            trace_id=trace_id)
         self.engine.validate(req)                # fail fast, before queueing
         fut: concurrent.futures.Future = concurrent.futures.Future()
         with self._futures_lock:
@@ -192,6 +211,7 @@ class Server:
     # ------------------------------------------------------------------ loop
 
     def _resolve(self, comp: Completion) -> None:
+        t0 = time.monotonic()
         self._counts["requests"] += 1
         self._counts["ok"] += comp.ok
         self._counts["timeout"] += comp.finish == "timeout"
@@ -210,6 +230,9 @@ class Server:
                 fut.set_result(comp)
             except concurrent.futures.InvalidStateError:
                 pass                      # caller cancelled: must not kill the loop
+        self.tracer.span("resolve", comp.request.trace_id, t0, time.monotonic(),
+                         request_id=comp.request.request_id, finish=comp.finish,
+                         new_tokens=comp.new_tokens)
 
     def _reject_expired(self, req: Request, now: float) -> None:
         self._resolve(Completion(
@@ -243,6 +266,7 @@ class Server:
                 self._emit_summary()
             finally:
                 self._writer.close()
+                self.tracer.close()
 
     def _loop_body(self) -> None:
         eng = self.engine

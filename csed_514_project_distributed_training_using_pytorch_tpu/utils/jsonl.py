@@ -13,6 +13,13 @@ accelerator-owning children must never claim a device itself):
 - :func:`percentiles` — nearest-rank percentiles, the one estimator every
   serving summary and the report CLI agree on. ``utils.telemetry`` re-exports
   it; the backend-free router imports it from here directly.
+- :func:`read_jsonl` — the ONE guarded line-parse: every JSONL reader in the
+  repo (``utils.metrics.load_metrics_jsonl``, the trace reader in
+  ``utils/trace.py``) funnels through it, so the torn-final-line tolerance —
+  an append-per-emit writer killed mid-line (a crashed replica, a killed
+  router) tears at most the trailing line — is defined exactly once. A
+  malformed line anywhere EARLIER is still an error: append-only writers
+  cannot produce one mid-file, so that file is corrupt, not torn.
 """
 
 from __future__ import annotations
@@ -30,6 +37,27 @@ def _finite(x):
         return None
     x = float(x)
     return x if math.isfinite(x) else None
+
+
+def read_jsonl(path: str) -> list[dict]:
+    """Parse a JSONL file, one dict per non-blank line, tolerating a TORN FINAL
+    line (skipped) and raising on a malformed line anywhere earlier. This is the
+    single owner of that guard — ``utils.metrics.load_metrics_jsonl`` and the
+    span reader in ``utils/trace.py`` both delegate here, so a router/trace file
+    left mid-line by a crashed process always loads the same way."""
+    rows = []
+    with open(path) as f:
+        lines = [l.strip() for l in f]
+    for i, line in enumerate(lines):
+        if not line:
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break
+            raise
+    return rows
 
 
 def percentiles(xs, qs=(50, 95, 99)) -> dict | None:

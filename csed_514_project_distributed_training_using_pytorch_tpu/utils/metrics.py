@@ -83,23 +83,15 @@ def load_metrics_jsonl(path: str) -> list[dict]:
       (``TelemetryWriter(path, stream=True)``) appends per event, so a killed
       serving process can leave a partial trailing line; everything before it
       still loads. A malformed line anywhere EARLIER is still an error (atomic
-      writers can't produce one — that file is corrupt, not torn).
+      writers can't produce one — that file is corrupt, not torn). The guard
+      itself has ONE owner — ``utils.jsonl.read_jsonl`` — shared with the
+      trace reader, so router/trace files get the identical tolerance.
     """
-    import json
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
+        read_jsonl,
+    )
 
-    rows = []
-    with open(path) as f:
-        lines = [l.strip() for l in f]
-    for i, line in enumerate(lines):
-        if not line:
-            continue
-        try:
-            rows.append(json.loads(line))
-        except json.JSONDecodeError:
-            if i == len(lines) - 1:
-                break
-            raise
-    return rows
+    return read_jsonl(path)
 
 
 class Stopwatch:

@@ -1,0 +1,352 @@
+"""Distributed request tracing: spans from loadgen to decode tick, one schema.
+
+The serve path is four processes deep — loadgen → ``serving/router.py`` →
+``serving/replica.py`` (TCP) → ``serving/server.py``/``engine.py`` — and the
+per-process JSONL telemetry can report TTFT percentiles but not *where one
+request's milliseconds went* (router queue? affinity spill-over? prefill budget
+stall? a redispatch hop after a crash?). This module is the backend-free
+tracing plane that answers that:
+
+- every request gets a ``trace_id`` at origin (loadgen, ``Server.submit`` or
+  ``Router.submit``) and the id rides the router's newline-JSON TCP protocol
+  into the replica's engine — spans emitted by four different processes join
+  into one tree by id alone;
+- each process emits **spans** — ``{"event": "span", "trace_id", "name",
+  "proc", "ts", "dur_s", ...attrs}`` — through its own :class:`Tracer` (a
+  ``utils.jsonl.JsonlWriter``, the jax-free writer: the router must never
+  initialize a backend). Span names are a fixed vocabulary: ``client``
+  (loadgen submit → future resolved), ``queue_wait`` (router or replica
+  arrival → dispatch/admission), ``route`` (the routing decision, with
+  affinity/spill-over attrs), ``dispatch`` (send → completion line, per hop),
+  ``redispatch`` (a drained hop: hop number + cause crash/preempt/hang),
+  ``prefill`` (per chunk, with ``cache_hit_len``), ``decode`` (decode-ready →
+  done, with the first-token split), ``resolve`` (completion → future
+  resolution);
+- **clock anchoring**: timestamps are ``time.monotonic()`` stamps shifted by a
+  per-process anchor ``time.time() - time.monotonic()`` captured once at
+  Tracer construction. Durations keep monotonic fidelity (immune to NTP
+  steps); absolute positions are wall-clock comparable across processes on the
+  same host (the fleet's deployment unit), so cross-process spans order
+  correctly without any handshake. The residual error is wall-vs-monotonic
+  drift over a process lifetime — microseconds over the minutes a serving run
+  lasts, far under the millisecond spans being ordered.
+
+Each process writes its own file (``<trace_dir>/router.jsonl``,
+``replica<i>.jsonl``, ``server.jsonl``, ``loadgen.jsonl``) — no cross-process
+file locking, restarts append (history survives), and a crashed replica tears
+at most its own final line, which the shared guarded reader
+(``utils.jsonl.read_jsonl``) tolerates. Assembly, critical-path accounting and
+the Chrome trace-event export live here too so ``tools/trace_report.py`` and
+``tools/serve_loadgen.py --summary-json`` render from one implementation.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import os
+import time
+
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
+    JsonlWriter,
+    percentiles,
+    read_jsonl,
+)
+
+_counter = itertools.count()
+
+
+def new_trace_id() -> str:
+    """A process-unique id: pid + per-process counter + a coarse time salt (two
+    processes can share a pid across restarts; same-second reuse does not)."""
+    return f"{os.getpid():x}-{int(time.time()):x}-{next(_counter):x}"
+
+
+class Tracer:
+    """Span emitter for ONE process. ``path`` empty disables everything (every
+    call is a no-op — tracing off costs a truthiness check); ``proc`` names this
+    process's track (``"router"``, ``"replica0"``, ``"server"``, ``"loadgen"``).
+
+    All public stamps are ``time.monotonic()`` values — the same clock every
+    serving component already uses for deadlines — converted to anchored
+    wall-comparable seconds only at emission.
+    """
+
+    def __init__(self, path: str, *, proc: str):
+        self.proc = proc
+        self._writer = JsonlWriter(path)
+        # The per-process anchor: monotonic -> wall, captured once. See the
+        # module docstring for the ordering argument.
+        self._anchor = time.time() - time.monotonic()
+
+    @property
+    def enabled(self) -> bool:
+        return self._writer.enabled
+
+    def anchored(self, mono_s: float) -> float:
+        """A monotonic stamp as wall-comparable absolute seconds."""
+        return self._anchor + mono_s
+
+    def span(self, name: str, trace_id: str | None, t0: float,
+             t1: float | None = None, **attrs) -> None:
+        """Emit one span: ``[t0, t1]`` monotonic stamps (``t1`` None = a point
+        span, dur 0). Silently a no-op when disabled or the request carries no
+        trace id (an untraced request through a traced server)."""
+        if not self.enabled or trace_id is None:
+            return
+        dur = 0.0 if t1 is None else max(0.0, t1 - t0)
+        ev = {"event": "span", "trace_id": trace_id, "name": name,
+              "proc": self.proc, "ts": round(self.anchored(t0), 6),
+              "dur_s": round(dur, 6)}
+        for k, v in attrs.items():
+            if v is not None:
+                ev[k] = (round(self.anchored(v), 6) if k.endswith("_ts")
+                         else v)
+        self._writer.emit(ev)
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+# --------------------------------------------------------------------- reading
+
+
+def span_files(paths) -> list[str]:
+    """Expand files-or-directories into the JSONL files under them (sorted —
+    deterministic assembly order)."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(os.path.join(p, f) for f in os.listdir(p)
+                              if f.endswith(".jsonl")))
+        else:
+            out.append(p)
+    return out
+
+
+def read_spans(paths) -> tuple[list[dict], list[dict]]:
+    """Load spans (and every non-span event, for reconciliation) from files or
+    directories. Returns ``(spans, other_events)``; both use the shared guarded
+    reader, so a crashed process's torn final line never blocks assembly."""
+    spans, other = [], []
+    for path in span_files(paths):
+        for row in read_jsonl(path):
+            (spans if row.get("event") == "span" else other).append(row)
+    return spans, other
+
+
+def assemble(spans) -> dict[str, list[dict]]:
+    """Group spans by ``trace_id``, each trace sorted by anchored start time."""
+    traces: dict[str, list[dict]] = {}
+    for s in spans:
+        tid = s.get("trace_id")
+        if tid:
+            traces.setdefault(tid, []).append(s)
+    for tid in traces:
+        traces[tid].sort(key=lambda s: (s.get("ts") or 0.0, s.get("dur_s") or 0))
+    return traces
+
+
+# The terminal span names: a trace holding none of these never resolved — its
+# spans are ORPHANS (a future stranded, or a trace file lost). trace_report
+# counts them; tests pin the count at zero.
+TERMINAL_SPANS = ("resolve", "client")
+
+# Critical-path segments, in pipeline order. ``dispatch`` spans OVERLAP the
+# replica-side work they contain, so the breakdown uses the replica's own
+# spans for the covered interior and charges only the remainder to overhead.
+SEGMENTS = ("router_queue_wait", "route", "failed_dispatch", "replica_queue_wait",
+            "prefill", "decode_first", "decode_tail", "resolve", "overhead")
+
+
+def trace_breakdown(spans: list[dict]) -> dict:
+    """One trace's critical-path accounting: exclusive per-segment seconds that
+    sum (with ``overhead`` absorbing scheduling/transport gaps) to the trace's
+    end-to-end span. Exclusivity across hops: a losing (drained) dispatch is
+    charged in FULL as ``failed_dispatch``, so replica-side spans that started
+    inside its window — the dead replica's queue_wait/prefill/decode history, a
+    hung zombie's late decode — stay visible in the span tree but are NOT
+    summed into their segments (they would double-charge the same interval).
+    Also surfaces redispatch hops, the span-derived TTFT, and the request ids
+    seen at each tier (router vs replica — they differ: each tier numbers
+    requests independently; the trace id is the join key)."""
+    by_name: dict[str, list[dict]] = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+
+    drained_windows = [(d["ts"], d["ts"] + (d.get("dur_s") or 0.0))
+                       for d in by_name.get("dispatch", ())
+                       if d.get("outcome") == "drained"]
+
+    def losing(s):
+        # Only replica-side spans can be "inside" a losing hop; the router's
+        # own spans legitimately touch window boundaries (a route span at the
+        # dispatch instant, the replay's queue_wait at the drain instant).
+        # 2e-6 absorbs the independent 6-decimal rounding of ts and dur_s; the
+        # winning hop's replica spans start a transport hop AFTER the drain.
+        return (s.get("proc") != "router"
+                and any(a - 2e-6 <= s["ts"] <= b + 2e-6
+                        for a, b in drained_windows))
+
+    def total(name, pred=lambda s: True):
+        return sum(s.get("dur_s") or 0.0 for s in by_name.get(name, ())
+                   if pred(s) and not losing(s))
+
+    start = min(s["ts"] for s in spans)
+    end = max(s["ts"] + (s.get("dur_s") or 0.0) for s in spans)
+    seg = dict.fromkeys(SEGMENTS, 0.0)
+    seg["router_queue_wait"] = total("queue_wait",
+                                     lambda s: s.get("proc") == "router")
+    seg["replica_queue_wait"] = total("queue_wait",
+                                      lambda s: s.get("proc") != "router")
+    seg["route"] = total("route")
+    seg["failed_dispatch"] = sum(b - a for a, b in drained_windows)
+    seg["prefill"] = total("prefill")
+    decodes = [d for d in by_name.get("decode", ()) if not losing(d)]
+    for d in decodes:
+        first = d.get("first_token_s")
+        dur = d.get("dur_s") or 0.0
+        seg["decode_first"] += dur if first is None else min(first, dur)
+        seg["decode_tail"] += 0.0 if first is None else max(0.0, dur - first)
+    seg["resolve"] = total("resolve")
+    e2e = end - start
+    seg["overhead"] = max(0.0, e2e - sum(seg.values()))
+
+    redispatches = sorted(by_name.get("redispatch", ()),
+                          key=lambda s: s["ts"])
+    # Span-derived TTFT: origin (trace start) -> the first token of the attempt
+    # that actually resolved (the LAST decode span — a drained hop's decode
+    # span, when it exists at all, precedes the replay's).
+    ttft = None
+    if decodes:
+        d = max(decodes, key=lambda s: s["ts"])
+        if d.get("first_token_ts") is not None:
+            ttft = max(0.0, d["first_token_ts"] - start)
+    return {
+        "start": start, "end": end, "e2e_s": e2e, "segments": seg,
+        "ttft_s": ttft,
+        "hops": 1 + len(redispatches),
+        "redispatch_causes": [s.get("cause") for s in redispatches],
+        "resolved": any(s["name"] in TERMINAL_SPANS for s in spans),
+        "request_ids": {s.get("proc"): s.get("request_id") for s in spans
+                        if s.get("request_id") is not None},
+        "finish": next((s.get("finish") for s in reversed(spans)
+                        if s.get("finish") is not None), None),
+    }
+
+
+def summarize_traces(spans) -> dict:
+    """Fleet-level reduction of a span set: per-segment p50/p95 over all traces,
+    span-derived TTFT percentiles, hop/orphan accounting, and the per-trace
+    breakdowns (sorted slowest-first) for the slowest-N report."""
+    traces = assemble(spans)
+    downs = {tid: trace_breakdown(t) for tid, t in traces.items()}
+    orphans = [tid for tid, d in downs.items() if not d["resolved"]]
+    seg_pcts = {}
+    for name in SEGMENTS:
+        vals = [d["segments"][name] for d in downs.values()]
+        pcts = percentiles(vals, qs=(50, 95))
+        if pcts and any(v > 0 for v in vals):
+            seg_pcts[name] = {**pcts, "mean": sum(vals) / len(vals)}
+    ttfts = [d["ttft_s"] for d in downs.values() if d["ttft_s"] is not None]
+    return {
+        "traces": len(traces),
+        "spans": len(list(spans)),
+        "orphans": len(orphans),
+        "orphan_ids": orphans,
+        "redispatched": sum(d["hops"] > 1 for d in downs.values()),
+        "segments": seg_pcts,
+        "ttft_s": percentiles(ttfts, qs=(50, 95)),
+        "e2e_s": percentiles([d["e2e_s"] for d in downs.values()], qs=(50, 95)),
+        "by_trace": dict(sorted(downs.items(),
+                                key=lambda kv: -kv[1]["e2e_s"])),
+    }
+
+
+def reconcile_ttft(summary: dict, events) -> dict | None:
+    """Span-derived TTFT percentiles against the serve/route events' own —
+    the cross-check that the tracing plane measures the same reality the
+    latency telemetry reports. Returns p50/p95 for both sides plus the ratio;
+    None when either side is empty. Route events win over serve events when
+    both exist (fleet runs: the replica-local serve ids don't match the
+    router's; route events are the client-facing truth)."""
+    routes = [e for e in events if e.get("event") == "route"]
+    serves = routes or [e for e in events if e.get("event") == "serve"]
+    ev_ttft = percentiles([e.get("ttft_s") for e in serves], qs=(50, 95))
+    span_ttft = summary.get("ttft_s")
+    if not ev_ttft or not span_ttft:
+        return None
+    out = {"span": span_ttft, "events": ev_ttft, "source":
+           "route" if routes else "serve"}
+    for q in ("p50", "p95"):
+        a, b = span_ttft.get(q), ev_ttft.get(q)
+        out[f"{q}_ratio"] = (a / b if a and b else None)
+    return out
+
+
+# ------------------------------------------------------------- chrome export
+
+
+def chrome_trace(spans) -> dict:
+    """The span set as Chrome trace-event JSON (``chrome://tracing`` /
+    Perfetto's legacy loader): one ``pid`` track per process (router, each
+    replica, loadgen/server) named via ``process_name`` metadata, one ``tid``
+    lane per trace within each track (requests overlap freely — a lane per
+    request keeps concurrent spans from nesting into nonsense), ``ph: "X"``
+    complete events with microsecond ``ts``/``dur`` and the span attrs under
+    ``args`` (``trace_id`` included, so Perfetto's search finds a request by
+    id)."""
+    spans = sorted(spans, key=lambda s: (s.get("ts") or 0.0))
+    if not spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    base = min(s["ts"] for s in spans)
+    pids: dict[str, int] = {}
+    lanes: dict[str, int] = {}
+    events = []
+    for s in spans:
+        pid = pids.setdefault(s.get("proc") or "?", len(pids) + 1)
+        tid = lanes.setdefault(s["trace_id"], len(lanes) + 1)
+        args = {k: v for k, v in s.items()
+                if k not in ("event", "name", "proc", "ts", "dur_s", "t_s")}
+        events.append({
+            "name": s["name"], "cat": "serve", "ph": "X",
+            "pid": pid, "tid": tid,
+            "ts": round((s["ts"] - base) * 1e6, 1),
+            "dur": max(round((s.get("dur_s") or 0.0) * 1e6, 1), 1.0),
+            "args": args,
+        })
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": proc}} for proc, pid in sorted(pids.items())]
+    # Sort index pins track order: router first, then replicas, then clients.
+    order = {"router": 0, "loadgen": 90, "server": 91}
+    meta += [{"name": "process_sort_index", "ph": "M", "pid": pid, "tid": 0,
+              "args": {"sort_index": order.get(proc, 10)}}
+             for proc, pid in sorted(pids.items())]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def validate_chrome(doc: dict) -> list[str]:
+    """Schema check for the export (the CI trace-smoke gate): every ``X`` event
+    carries numeric pid/tid/ts/dur, every pid resolves to a ``process_name``
+    metadata record, and every event references a trace (a span that lost its
+    ``trace_id`` would render as an unattributable box). Returns the problems
+    (empty = valid)."""
+    problems = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents is not a list"]
+    named = {e.get("pid") for e in events
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    for i, e in enumerate(events):
+        if e.get("ph") != "X":
+            continue
+        for key in ("pid", "tid", "ts", "dur"):
+            v = e.get(key)
+            if not isinstance(v, (int, float)) or not math.isfinite(v) or v < 0:
+                problems.append(f"event {i} ({e.get('name')}): bad {key}={v!r}")
+        if e.get("pid") not in named:
+            problems.append(f"event {i} ({e.get('name')}): pid {e.get('pid')} "
+                            f"has no process_name record")
+        if not e.get("args", {}).get("trace_id"):
+            problems.append(f"event {i} ({e.get('name')}): no trace_id arg")
+    return problems

@@ -211,6 +211,159 @@ def test_router_all_dead_resolves_every_future_even_expired(tmp_path):
         router.stop(timeout=10)
 
 
+def test_router_echo_traced_kill_span_tree_shows_the_hop(tmp_path, monkeypatch):
+    """The distributed-tracing acceptance gate (jax-free): a 2-replica echo
+    fleet with tracing on, one replica hard-killed mid-flight. The redispatched
+    request's assembled span tree must show the hop — dispatch(outcome=drained)
+    -> redispatch(cause=crash) -> eventual resolve — with monotonically ordered
+    cross-process timestamps (the clock-anchoring contract), zero orphan
+    traces, and a metrics timeline (fleet_snapshot events) in the router
+    telemetry."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        trace,
+    )
+
+    monkeypatch.setenv("RESILIENCE_FAULTS",
+                       f"kill:proc=1,step=5,flag={tmp_path / 'kill'}")
+    trace_dir = str(tmp_path / "trace")
+    router = _router(tmp_path, _echo_cmd(delay=0.05), trace_dir=trace_dir,
+                     snapshot_interval_s=0.2).start()
+    try:
+        assert router.wait_ready(timeout=120)
+        assert router.tracer.enabled
+        rng = np.random.default_rng(3)
+        reqs = [(rng.integers(0, 7, size=1 + i % 5).astype(np.int32), 6)
+                for i in range(12)]
+        futs = [router.submit(p, max_new_tokens=n) for p, n in reqs]
+        comps = [f.result(timeout=120) for f in futs]
+        assert all(c.ok for c in comps)
+        assert any(c.redispatches > 0 for c in comps)        # the kill landed
+        _wait_restart(router, 1)
+    finally:
+        summ = router.stop(timeout=60)
+    assert summ["ok"] == 12
+
+    # Assembly: every span file (router + both replicas, post-restart included)
+    # joins into exactly one trace per request, none orphaned.
+    spans, _ = trace.read_spans([trace_dir])
+    summary = trace.summarize_traces(spans)
+    assert summary["traces"] == 12
+    assert summary["orphans"] == 0, summary["orphan_ids"]
+    assert summary["redispatched"] >= 1
+
+    hopped = [tid for tid, d in summary["by_trace"].items() if d["hops"] > 1]
+    assert hopped
+    traces = trace.assemble(spans)
+    for tid in hopped:
+        tree = traces[tid]
+        down = summary["by_trace"][tid]
+        assert down["redispatch_causes"] == ["crash"] * (down["hops"] - 1)
+        # The hop is visible in the tree: the drained dispatch (on the dead
+        # replica), then the redispatch marker, then a resolve.
+        drained = [s for s in tree if s["name"] == "dispatch"
+                   and s.get("outcome") == "drained"]
+        redis = [s for s in tree if s["name"] == "redispatch"]
+        resolves = [s for s in tree if s["name"] == "resolve"]
+        assert drained and redis and resolves
+        assert all(s["replica"] == 1 for s in drained)       # proc=1 was killed
+        assert all(s["cause"] == "crash" for s in redis)
+        # Monotonic cross-process order: assembly sorted by anchored ts; the
+        # drained hop's END is the redispatch instant, the replay's decode span
+        # (another process's clock) sits inside the winning dispatch, and the
+        # resolve is the last word. Anchoring skew budget: 50ms, far above
+        # wall-vs-monotonic drift over a seconds-long test.
+        eps = 0.05
+        assert all(a["ts"] <= b["ts"] + 1e-9 for a, b in zip(tree, tree[1:]))
+        d0, r0 = drained[0], redis[0]
+        # 1e-5: ts and dur_s are independently rounded to 6 decimals at
+        # emission, so the sum can miss the instant by a few microseconds.
+        assert d0["ts"] + d0["dur_s"] == pytest.approx(r0["ts"], abs=1e-5)
+        winning = [s for s in tree if s["name"] == "dispatch"
+                   and s.get("outcome") == "ok"]
+        decodes = [s for s in tree if s["name"] == "decode"]
+        assert winning and decodes
+        w, dec = winning[-1], decodes[-1]
+        assert w["ts"] >= r0["ts"] - 1e-6                    # replay after hop
+        assert dec["proc"].startswith("replica")             # another process
+        assert w["ts"] - eps <= dec["ts"]
+        assert dec["ts"] + dec["dur_s"] <= w["ts"] + w["dur_s"] + eps
+        last = resolves[-1]
+        assert all(s["ts"] <= last["ts"] + 1e-9 for s in tree)
+
+    # The per-request critical path accounts the failed hop explicitly.
+    assert any(d["segments"]["failed_dispatch"] > 0
+               for d in summary["by_trace"].values())
+
+    # Metrics timeline: the snapshot loop emitted fleet_snapshot events with
+    # the load-signal fields elastic serving will consume.
+    rows = load_metrics_jsonl(str(tmp_path / "router.jsonl"))
+    snaps = [r for r in rows if r["event"] == "fleet_snapshot"]
+    assert snaps
+    for sn in snaps:
+        assert {"queue", "inflight", "capacity_up", "utilization",
+                "redispatches", "restarts", "per_replica"} <= set(sn)
+        assert {"depth", "oldest_age_s"} <= set(sn["queue"])
+        assert len(sn["per_replica"]) == 2
+    # The Chrome export of a real fleet trace passes the schema gate.
+    assert trace.validate_chrome(trace.chrome_trace(spans)) == []
+
+
+def test_router_traced_abort_leaves_no_orphan_traces(tmp_path):
+    """Every replica dead on arrival: futures fail with ServerStopped — and
+    with tracing on, each aborted/expired request still gets its terminal
+    resolve span, so a cleanly-resolved-by-abort run reads as zero orphans
+    (regression: the abort sweep used to settle futures span-lessly)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        trace,
+    )
+
+    trace_dir = str(tmp_path / "trace")
+    router = _router(tmp_path, ["-c", "import sys; sys.exit(3)"], n=2,
+                     max_restarts=0, connect_timeout_s=5.0,
+                     trace_dir=trace_dir).start()
+    try:
+        futs = []
+        for i in range(6):
+            try:
+                futs.append(router.submit(
+                    np.asarray([1, 2], np.int32), max_new_tokens=2,
+                    timeout_s=0.01 if i % 2 == 0 else None))
+            except ServerStopped:
+                pass
+        for f in futs:
+            try:
+                f.result(timeout=60)
+            except ServerStopped:
+                pass
+    finally:
+        router.stop(timeout=10)
+    spans, _ = trace.read_spans([trace_dir])
+    summary = trace.summarize_traces(spans)
+    assert summary["traces"] == len(futs) > 0
+    assert summary["orphans"] == 0, summary["orphan_ids"]
+    finishes = {d["finish"] for d in summary["by_trace"].values()}
+    assert finishes <= {"aborted", "timeout"} and "aborted" in finishes
+
+
+def test_router_untraced_writes_no_span_files(tmp_path):
+    """Tracing off (no trace_dir) leaves NOTHING behind: no tracer file, no
+    --trace flag on the replica argv — the wire protocol byte-identity pin
+    lives in test_trace.py."""
+    router = _router(tmp_path, _echo_cmd())
+    assert not router.tracer.enabled
+    router.start()
+    try:
+        assert router.wait_ready(timeout=120)
+        with router._lock:
+            argv = list(router.replicas[0].fleet.procs[0].args)
+        assert "--trace" not in argv
+        fut = router.submit(np.asarray([1, 2], np.int32), max_new_tokens=3)
+        assert fut.result(timeout=60).ok
+    finally:
+        router.stop(timeout=60)
+    assert not [p for p in os.listdir(tmp_path) if "trace" in p]
+
+
 # -----------------------------------------------------------------------------------------
 # Engine tier: the PR acceptance gate
 # -----------------------------------------------------------------------------------------

@@ -485,6 +485,49 @@ def test_loadgen_open_loop_a_vs_b_comparison(tmp_path, capsys):
     assert "B/A" in out and "serve tokens/s" in out and "ttft_s p50" in out
 
 
+def test_loadgen_traced_run_carries_trace_in_summary(tmp_path, capsys):
+    """--trace-dir: the loadgen is the trace origin (client spans in
+    loadgen.jsonl), the in-process server writes server.jsonl, and
+    --summary-json records the trace dir plus span-derived critical-path
+    percentiles whose TTFT reconciles with the serve events' own."""
+    import json
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        trace,
+    )
+
+    loadgen = _load_tool("serve_loadgen")
+    trace_dir = str(tmp_path / "trace")
+    summary = tmp_path / "summary.json"
+    rc = loadgen.main(["--requests", "8", "--mode", "closed",
+                       "--concurrency", "3", "--num-slots", "3",
+                       "--telemetry", str(tmp_path / "serve.jsonl"),
+                       "--trace-dir", trace_dir,
+                       "--summary-json", str(summary), *_LOADGEN_ARGS])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "trace: 8 traces" in out and "0 orphans" in out
+    assert sorted(os.listdir(trace_dir)) == ["loadgen.jsonl", "server.jsonl"]
+
+    spans, _ = trace.read_spans([trace_dir])
+    ts = trace.summarize_traces(spans)
+    assert ts["traces"] == 8 and ts["orphans"] == 0
+    # Every trace's outermost span is the loadgen's client span.
+    clients = [s for s in spans if s["name"] == "client"]
+    assert len(clients) == 8 and all(s["proc"] == "loadgen" for s in clients)
+    assert {s["name"] for s in spans} >= {"client", "queue_wait", "decode",
+                                          "resolve"}
+
+    doc = json.loads(summary.read_text())
+    tr = doc["trace"]
+    assert tr["dir"] == trace_dir and tr["orphans"] == 0
+    assert tr["segments"]["decode_tail"]["p50"] > 0
+    rec = tr["ttft_reconciliation"]
+    # The span plane and the latency telemetry measure the same reality.
+    assert rec["source"] == "serve"
+    assert 0.8 < rec["p50_ratio"] < 1.25
+
+
 @pytest.mark.slow
 def test_loadgen_sustained_open_loop_with_timeouts(tmp_path):
     """Sustained open-loop load at a rate the engine may not keep up with:

@@ -236,6 +236,41 @@ def run_chat(front, args, vocab_size):
     return comps, rejected[0], done_sessions[0]
 
 
+class _TracedFront:
+    """Wrap a ``Server``/``Router`` front end so every loadgen request is a
+    trace ORIGIN: a fresh ``trace_id`` per submit (propagated through the
+    whole serve path) and a ``client`` span — submit call to future
+    resolution, the outermost span of the tree and the latency the user
+    actually felt. Everything else (``stop`` etc.) passes through."""
+
+    def __init__(self, inner, tracer):
+        self._inner = inner
+        self._tracer = tracer
+
+    def submit(self, prompt, **kw):
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils.trace import (
+            new_trace_id,
+        )
+
+        tid = new_trace_id()
+        t0 = time.monotonic()
+        fut = self._inner.submit(prompt, trace_id=tid, **kw)
+
+        def _done(f, tid=tid, t0=t0):
+            try:
+                finish = f.result().finish
+            except BaseException as e:       # noqa: BLE001 — span records it
+                finish = f"error:{type(e).__name__}"
+            self._tracer.span("client", tid, t0, time.monotonic(),
+                              finish=finish)
+
+        fut.add_done_callback(_done)
+        return fut
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
 def build_replica_command(args) -> list[str]:
     """The ``serving/replica.py`` argv mirroring this run's model/engine flags
     (the router appends --port/--replica-id/--heartbeat-dir per replica)."""
@@ -361,6 +396,16 @@ def main(argv: list[str] | None = None) -> int:
     g.add_argument("--seed", type=int, default=0)
     p.add_argument("--telemetry", default="",
                    help="serve JSONL path (render with tools/telemetry_report.py)")
+    p.add_argument("--trace-dir", default="",
+                   help="distributed-tracing span dir: this loadgen writes "
+                        "loadgen.jsonl (client spans + per-request trace_id "
+                        "origin), the router/server and every replica write "
+                        "their own span files under it — render with "
+                        "tools/trace_report.py")
+    p.add_argument("--snapshot-interval-s", type=float, default=0.0,
+                   help="fleet mode: the router emits a fleet_snapshot "
+                        "metrics-timeline event every N seconds (the "
+                        "elasticity load signal; needs --telemetry, 0 = off)")
     p.add_argument("--summary-json", default="",
                    help="write the run summary (percentiles + prefill stats) "
                         "as one JSON document — the committed-artifact format")
@@ -376,6 +421,17 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit("--max-new-tokens must be >= 1")
 
     vocab_size = args.num_levels + 1
+    tracer = None
+    if args.trace_dir:
+        # This loadgen is the trace ORIGIN: it writes loadgen.jsonl (the
+        # outermost "client" spans) and every downstream process writes its own
+        # span file under the same dir — see utils/trace.py.
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils.trace import (
+            Tracer,
+        )
+
+        tracer = Tracer(os.path.join(args.trace_dir, "loadgen.jsonl"),
+                        proc="loadgen")
     engine = server = router = None
     if args.replicas > 0:
         # Fleet mode: the model lives in the replica processes; this process
@@ -403,7 +459,8 @@ def main(argv: list[str] | None = None) -> int:
                 prefix="serve_hb_"),
             heartbeat_timeout_s=args.heartbeat_timeout_s,
             max_restarts=args.max_restarts, backoff_s=args.backoff_s,
-            telemetry=args.telemetry, env=env)
+            telemetry=args.telemetry, trace_dir=args.trace_dir,
+            snapshot_interval_s=args.snapshot_interval_s, env=env)
         front = router.start()
         if not router.wait_ready(timeout=600):
             router.stop(drain=False)
@@ -419,8 +476,12 @@ def main(argv: list[str] | None = None) -> int:
             build_engine_server,
         )
 
-        engine, server = build_engine_server(args)
+        engine, server = build_engine_server(
+            args, trace=(os.path.join(args.trace_dir, "server.jsonl")
+                         if args.trace_dir else None))
         front = server.start()
+    if tracer is not None:
+        front = _TracedFront(front, tracer)
 
     t0 = time.monotonic()
     sessions_done = None
@@ -452,6 +513,8 @@ def main(argv: list[str] | None = None) -> int:
         router_summary = router.stop(timeout=600)   # graceful drain + stats
     else:
         server.stop()                               # graceful drain (a no-op by now)
+    if tracer is not None:
+        tracer.close()     # after stop(): every client span's callback has run
 
     ok = sum(c.ok for c in comps)
     timeouts = sum(c.finish == "timeout" for c in comps)
@@ -498,6 +561,30 @@ def main(argv: list[str] | None = None) -> int:
     if args.telemetry:
         print(f"serve telemetry -> {args.telemetry} "
               f"(render: python tools/telemetry_report.py {args.telemetry})")
+    trace_summary = None
+    if args.trace_dir:
+        # Reduce the span files the run just wrote (loadgen + router/server +
+        # every replica) to the critical-path summary; the full per-request
+        # trees render via tools/trace_report.py.
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils.trace import (
+            read_spans,
+            summarize_traces,
+        )
+
+        spans, _ = read_spans([args.trace_dir])
+        trace_summary = summarize_traces(spans)
+        seg = trace_summary["segments"]
+        top = sorted(seg, key=lambda n: -(seg[n]["p50"] or 0))[:3]
+        path = ", ".join(f"{n} p50 {(seg[n]['p50'] or 0) * 1e3:.1f}ms"
+                         for n in top)
+        print(f"trace: {trace_summary['traces']} traces, "
+              f"{trace_summary['spans']} spans, "
+              f"{trace_summary['orphans']} orphans, "
+              f"{trace_summary['redispatched']} redispatched"
+              + (f"; critical path {path}" if path else ""))
+        print(f"trace spans -> {args.trace_dir} "
+              f"(render: python tools/trace_report.py {args.trace_dir}"
+              + (f" {args.telemetry}" if args.telemetry else "") + ")")
     if args.summary_json:
         import json
 
@@ -558,6 +645,32 @@ def main(argv: list[str] | None = None) -> int:
                                  if hits and hits["queries"] else None),
                 decode_compilations=engine.trace_count,
                 prefill_compilations=dict(engine.prefill_trace_counts))
+        if trace_summary is not None:
+            # The run carries its trace with it: where the spans live plus the
+            # span-derived critical-path percentiles, next to the serve
+            # percentiles above — an A/B pair of summaries is self-contained.
+            from csed_514_project_distributed_training_using_pytorch_tpu.utils.trace import (
+                reconcile_ttft,
+            )
+
+            events = []
+            if args.telemetry and os.path.exists(args.telemetry):
+                from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
+                    read_jsonl,
+                )
+
+                events = read_jsonl(args.telemetry)
+            doc["trace"] = {
+                "dir": args.trace_dir,
+                "traces": trace_summary["traces"],
+                "spans": trace_summary["spans"],
+                "orphans": trace_summary["orphans"],
+                "redispatched": trace_summary["redispatched"],
+                "segments": trace_summary["segments"],
+                "ttft_s": trace_summary["ttft_s"],
+                "e2e_s": trace_summary["e2e_s"],
+                "ttft_reconciliation": reconcile_ttft(trace_summary, events),
+            }
         with open(args.summary_json, "w") as f:
             json.dump(doc, f, indent=1)
         print(f"summary json -> {args.summary_json}")

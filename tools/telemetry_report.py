@@ -47,6 +47,19 @@ from csed_514_project_distributed_training_using_pytorch_tpu.utils.telemetry imp
 SERVE_SERIES = ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s")
 SERVE_QS = (50, 95, 99)
 
+# Every event kind this reporter understands (or deliberately passes over,
+# like per-span trace lines — those render via tools/trace_report.py). Anything
+# outside this set is counted and surfaced in a footer: schema drift between a
+# writer and this reporter must be visible, not silently dropped.
+KNOWN_EVENTS = frozenset({
+    "manifest", "compile", "epoch", "health", "mfu", "bench",
+    "serve", "serve_config", "serve_summary", "prefill",
+    "route", "replica", "router_config", "router_summary", "fleet_snapshot",
+    "checkpoint", "restart", "preempt", "supervise_summary",
+    "plan", "autotune", "span",
+    "train", "test",                      # loss-curve metrics.jsonl kinds
+})
+
 
 def _median(xs: list) -> float | None:
     xs = sorted(x for x in xs if x is not None)
@@ -74,6 +87,10 @@ def summarize(path: str) -> dict:
         by_event.setdefault(r.get("event", r.get("kind", "?")), []).append(r)
 
     s: dict = {"path": path, "label": os.path.basename(path), "events": len(rows)}
+    unknown = {k: len(v) for k, v in by_event.items() if k not in KNOWN_EVENTS}
+    if unknown:
+        s["unknown_events"] = sum(unknown.values())
+        s["unknown_kinds"] = sorted(unknown)
 
     man = (by_event.get("manifest") or [None])[0]
     if man:
@@ -243,6 +260,22 @@ def summarize(path: str) -> dict:
             for q in SERVE_QS:
                 s.setdefault(f"serve_{name}_p{q}", pcts.get(f"p{q}"))
 
+    # Metrics-timeline snapshots (serving/router.py --snapshot-interval-s): the
+    # elasticity load signal. Reduce to the ranges a scale-up/down decision
+    # reads — queue depth/age peaks vs fleet utilization.
+    snaps = by_event.get("fleet_snapshot", [])
+    if snaps:
+        s["snapshots"] = len(snaps)
+        depths = [(sn.get("queue") or {}).get("depth") or 0 for sn in snaps]
+        ages = [(sn.get("queue") or {}).get("oldest_age_s") or 0 for sn in snaps]
+        utils_ = [sn.get("utilization") for sn in snaps
+                  if sn.get("utilization") is not None]
+        s["snapshot_queue_depth_max"] = max(depths)
+        s["snapshot_oldest_age_max_s"] = max(ages)
+        s["snapshot_utilization_mean"] = (sum(utils_) / len(utils_)
+                                          if utils_ else None)
+        s["snapshot_utilization_max"] = max(utils_) if utils_ else None
+
     # Checkpoint traffic (utils/checkpoint.py savers + restores): how much resume
     # insurance the run paid for, and what it cost in wall time.
     ckpts = by_event.get("checkpoint", [])
@@ -360,6 +393,16 @@ def print_summary(s: dict) -> None:
                 continue
             print("   " + name.ljust(14)
                   + "".join(_fmt(v).rjust(12) for v in vals))
+    if s.get("snapshots"):
+        print(f"   timeline: {s['snapshots']} fleet snapshots  "
+              f"queue depth max {_fmt(s.get('snapshot_queue_depth_max'))}  "
+              f"oldest age max {_fmt(s.get('snapshot_oldest_age_max_s'))}s  "
+              f"utilization mean {_fmt(s.get('snapshot_utilization_mean'))} "
+              f"/ max {_fmt(s.get('snapshot_utilization_max'))}")
+    if s.get("unknown_events"):
+        print(f"   {s['unknown_events']} unrecognized events "
+              f"(kinds: {', '.join(s['unknown_kinds'])}) — writer/reporter "
+              f"schema drift?")
     print()
 
 
