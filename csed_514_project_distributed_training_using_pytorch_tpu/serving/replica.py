@@ -28,6 +28,14 @@ router → replica
                       ``trace_id`` appears ONLY on traced requests (tracing
                       off keeps the line byte-identical — pinned)
 ``stats``             ``{"op", "id"}`` — request the engine/queue counters
+``warm``              ``{"op", "id", "prompts"}`` — prefix-cache warm-start:
+                      replay each prompt through prefill (1 generated token)
+                      so the cache holds the fleet's hot prefixes BEFORE the
+                      router marks this replica ready; acked with
+                      ``warm_done``
+``drain``             graceful retire/reload: refuse new submits
+                      (``error: draining``), finish everything accepted, ack
+                      with ``drained``, exit 0
 ``stop``              graceful drain: finish accepted work, then exit 0
 --------------------  -------------------------------------------------------------
 replica → router
@@ -36,9 +44,16 @@ replica → router
                       (``num_slots``, ``max_pending``) — the router's
                       backpressure cap comes from the replica itself
 ``done``              one completed request: tokens + finish + latency fields
-``error``             ``queue_full`` (backpressure — the router re-queues) or
-                      ``invalid`` (admission rejection — the router fails the
-                      future; replays would fail identically)
+``error``             ``queue_full`` (backpressure — the router re-queues),
+                      ``draining`` (the shrink/submit race: a dispatch crossed
+                      the drain op on the wire — the router re-queues
+                      elsewhere) or ``invalid`` (admission rejection — the
+                      router fails the future; replays would fail identically)
+``warm_done``         warm replay finished: replayed-prompt count + the
+                      prompts themselves (the router re-homes their affinity
+                      entries onto this replica and flips it ready)
+``drained``           drain finished: every accepted request's done line
+                      precedes this ack; the process exits 0 right after
 ``stats``             engine counters (steps, prefill, prefix-cache stats) and
                       the request queue's ``snapshot()``
 ====================  =============================================================
@@ -73,6 +88,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.resilience.preempti
     PreemptionHandler,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
+    QueueClosed,
     QueueFull,
     SamplingParams,
 )
@@ -169,6 +185,28 @@ class _EchoServer:
         self.steps = 0               # protocol parity with engine.steps
         self.tracer = tracer
         self._lock = threading.Lock()
+        # Drain protocol parity with the real server: once draining, admission
+        # raises QueueClosed (the shrink/submit race bounce) while accepted
+        # work finishes; ``drain()`` blocks until the ledger empties.
+        self.draining = False
+        self._inflight = 0
+        self._cond = threading.Condition(self._lock)
+
+    def begin_request(self) -> None:
+        with self._cond:
+            if self.draining:
+                raise QueueClosed("echo replica draining")
+            self._inflight += 1
+
+    def end_request(self) -> None:
+        with self._cond:
+            self._inflight -= 1
+            self._cond.notify_all()
+
+    def drain(self) -> None:
+        with self._cond:
+            self.draining = True
+            self._cond.wait_for(lambda: self._inflight == 0)
 
     def complete(self, prompt: np.ndarray, max_new: int, *,
                  trace_id: str | None = None,
@@ -221,6 +259,13 @@ def _handle_submit(msg, server, wfile, wlock):
     except QueueFull:
         _send(wfile, wlock, {"op": "error", "id": rid, "error": "queue_full",
                              "message": "replica queue at capacity"})
+        return
+    except QueueClosed:
+        # The shrink/submit race: this dispatch crossed the drain op on the
+        # wire. The request is intact — bounce it so the router re-queues it
+        # at the front and tries another replica.
+        _send(wfile, wlock, {"op": "error", "id": rid, "error": "draining",
+                             "message": "replica draining (retire/reload)"})
         return
     except ValueError as e:
         _send(wfile, wlock, {"op": "error", "id": rid, "error": "invalid",
@@ -338,28 +383,110 @@ def serve_forever(args) -> int:
         op = msg.get("op")
         if op == "submit":
             if args.echo:
+                try:
+                    server.begin_request()       # draining => bounce, not accept
+                except QueueClosed:
+                    _send(wfile, wlock, {"op": "error", "id": msg["id"],
+                                         "error": "draining",
+                                         "message": "echo replica draining"})
+                    return True
+
                 def _echo_job(m=msg):
                     prompt = np.asarray(m.get("prompt") or [], np.int32)
                     t0 = time.monotonic()
-                    tokens = server.complete(prompt, m["max_new_tokens"],
-                                             trace_id=m.get("trace_id"),
-                                             request_id=m["id"])
+                    # The done line must hit the wire BEFORE end_request()
+                    # releases the gate: drain() wakes the instant in-flight
+                    # reaches 0, and the drained ack overtaking the last done
+                    # line would make the router retire with this request
+                    # still in its ledger (straggler redispatch + duplicate).
                     try:
-                        _send(wfile, wlock, {
-                            "op": "done", "id": m["id"],
-                            "tokens": [int(t) for t in tokens],
-                            "finish": "ok", "prompt_len": len(prompt),
-                            "new_tokens": len(tokens) - len(prompt),
-                            "e2e_s": time.monotonic() - t0,
-                        })
-                    except OSError:
-                        pass
+                        tokens = server.complete(prompt, m["max_new_tokens"],
+                                                 trace_id=m.get("trace_id"),
+                                                 request_id=m["id"])
+                        try:
+                            _send(wfile, wlock, {
+                                "op": "done", "id": m["id"],
+                                "tokens": [int(t) for t in tokens],
+                                "finish": "ok", "prompt_len": len(prompt),
+                                "new_tokens": len(tokens) - len(prompt),
+                                "e2e_s": time.monotonic() - t0,
+                            })
+                        except OSError:
+                            pass
+                    finally:
+                        server.end_request()
                 threading.Thread(target=_echo_job, daemon=True).start()
             else:
                 _handle_submit(msg, server, wfile, wlock)
         elif op == "stats":
             _send(wfile, wlock, {"op": "stats", "id": msg.get("id"),
                                  **_stats_payload(engine, server)})
+        elif op == "warm":
+            # Prefix-cache warm-start (scale-up/reload): replay the fleet's
+            # hot prefixes through prefill BEFORE taking traffic — one
+            # generated token each, which is what populates the prefix cache
+            # (planes are a pure function of tokens and params, so replay
+            # re-derives the retired/peer replica's paid-for state). The
+            # router keeps this replica in ``warming`` until the ack, so the
+            # replay never competes with real requests.
+            def _warm_job(m=msg):
+                prompts = m.get("prompts") or []
+                count = 0
+                if args.echo:
+                    count = len(prompts)         # protocol parity, no cache
+                else:
+                    # One at a time: a burst would bounce off this replica's
+                    # OWN max_pending backpressure and silently skip prefixes
+                    # (the whole point is that every shipped prefix lands).
+                    for ptoks in prompts:
+                        arr = np.asarray(ptoks, np.int32)
+                        if not 0 < len(arr) < args.seq_len:
+                            continue
+                        try:
+                            # traced=False: the replay must not mint trace
+                            # trees (it is fleet setup, not traffic).
+                            f = server.submit(arr, max_new_tokens=1,
+                                              traced=False)
+                            count += bool(f.result(timeout=120).ok)
+                        except Exception:        # full/closed/invalid: skip
+                            continue
+                    cache = getattr(engine, "prefix_cache", None)
+                    if cache is not None:
+                        # The replay's compulsory misses are setup cost, not
+                        # traffic: the post-ready hit rate must measure what
+                        # the fleet actually served (the warm-vs-cold A/B
+                        # reads it). Counters only — the warmed ENTRIES are
+                        # the whole point and must survive.
+                        cache.queries = cache.hits = cache.hit_tokens = 0
+                try:
+                    _send(wfile, wlock, {"op": "warm_done", "id": m.get("id"),
+                                         "count": count, "prompts": prompts})
+                except OSError:
+                    pass
+            threading.Thread(target=_warm_job, daemon=True,
+                             name="replica-warm").start()
+        elif op == "drain":
+            # Graceful retire/reload: refuse new work (submits racing this op
+            # bounce as ``error: draining``), finish everything accepted —
+            # every done line is flushed before the ack — then exit 0. The
+            # ack-then-exit order lets the router retire this replica without
+            # classifying the exit as a crash.
+            def _drain_job(m=msg):
+                if args.echo:
+                    server.drain()
+                    tracer.close()
+                else:
+                    server.stop(drain=True)      # blocks until the loop exits;
+                                                 # closes telemetry + tracer
+                try:
+                    _send(wfile, wlock, {"op": "drained", "id": m.get("id"),
+                                         "steps": int(engine.steps)})
+                except OSError:
+                    pass
+                print(f"[replica {replica_id}] drained; exiting 0", flush=True)
+                os._exit(0)
+            threading.Thread(target=_drain_job, daemon=True,
+                             name="replica-drain").start()
         elif op == "stop":
             return False
         return True

@@ -30,6 +30,20 @@ restart *individually* — behind one ``submit() -> Future`` door. DESIGN.md §1
   supervisor-style (exponential backoff, capped attempts). When every replica
   has exhausted its budget, outstanding work fails with ``ServerStopped``
   instead of hanging.
+- **runtime elasticity** (DESIGN.md §18) — the replica count is a policy
+  output, not a constant. Replicas move through ``starting → warming → ready →
+  draining → retired`` (plus ``restarting``/``dead`` on the failure path):
+  ``scale_up()`` spawns a new replica and **warm-starts** its prefix cache
+  (the hottest affinity-index prefixes are shipped for replay before it is
+  marked ready, so scale-up doesn't serve cold); ``scale_down()`` retires one
+  **gracefully** — dispatch stops the instant it turns ``draining``, in-flight
+  work finishes under a deadline, stragglers ride the existing
+  ``_drain_ledger`` redispatch (zero lost requests, pinned token-identical);
+  ``reload()`` rolls a new checkpoint through the fleet one replica at a time
+  on the same drain machinery, so capacity never dips below N−1 and no request
+  ever mixes params. A :class:`serving.autoscaler.FleetAutoscaler` (hysteresis
+  over the ``fleet_snapshot`` signal) can drive scale_up/scale_down
+  automatically from the snapshot loop.
 
 The router performs no jax work and never initializes a backend (the
 ``resilience/supervisor.py`` doctrine): it supervises processes that own
@@ -60,6 +74,10 @@ from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.resilience.preemption import (
     EXIT_PREEMPTED,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.autoscaler import (
+    AutoscalePolicy,
+    FleetAutoscaler,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.prefix_cache import (
     common_prefix_len,
@@ -129,11 +147,32 @@ class RouterCompletion:
         return self.finish == "ok"
 
 
+def _with_checkpoint(command: list[str], checkpoint: str) -> list[str]:
+    """The replica argv with its ``--checkpoint`` swapped for ``checkpoint``
+    (appended when the command never had one) — how ``Router.reload`` makes
+    every post-roll spawn pick up the new params. Pure so tests can pin it."""
+    cmd = list(command)
+    for i, tok in enumerate(cmd):
+        if tok == "--checkpoint" and i + 1 < len(cmd):
+            cmd[i + 1] = checkpoint
+            return cmd
+        if tok.startswith("--checkpoint="):
+            cmd[i] = f"--checkpoint={checkpoint}"
+            return cmd
+    return cmd + ["--checkpoint", checkpoint]
+
+
 class _AffinityIndex:
     """Bounded LRU of (prompt tokens -> replica) with longest-common-prefix
     lookup — the router-side mirror of the engine's ``PrefixCache`` matching
     rule (any common prefix length is reusable; ``min_tokens`` floors a useful
-    hit). Entries for a failed replica are dropped: its cache died with it."""
+    hit). Entries for a failed replica are dropped (its cache died with it);
+    entries for a gracefully RETIRED replica are re-homed to a surviving one
+    (``rehome``) so a hot prefix keeps one consistent home instead of
+    scattering across the fleet on the next few dispatches. ``lookup`` only
+    returns replicas in the caller's ``alive`` set — a ``draining`` replica
+    must stop receiving traffic the instant it flips, even though its entries
+    survive until the retire completes."""
 
     def __init__(self, capacity: int = 128, max_tokens: int = 1024):
         self.capacity = int(capacity)
@@ -146,9 +185,17 @@ class _AffinityIndex:
     # the routes-to-warm-cache guarantee silently).
     _common = staticmethod(common_prefix_len)
 
-    def lookup(self, prompt: np.ndarray, min_tokens: int) -> int | None:
+    def lookup(self, prompt: np.ndarray, min_tokens: int,
+               alive: set[int] | None = None) -> int | None:
+        """Best-prefix replica among ``alive`` (None = no filter). Entries
+        homed on a non-alive replica are SKIPPED, not deleted: draining is
+        transient state-side (the entries are re-homed or dropped when the
+        retire/failure actually lands), and a shorter match on a ready replica
+        beats a longer one on a replica that cannot take the request."""
         best_key, best_len = None, 0
-        for key, (tokens, _) in self._entries.items():
+        for key, (tokens, rep) in self._entries.items():
+            if alive is not None and rep not in alive:
+                continue
             m = self._common(tokens, prompt)
             if m > best_len and (m >= min_tokens or m == len(prompt) > 0):
                 best_key, best_len = key, m
@@ -176,13 +223,53 @@ class _AffinityIndex:
         for k in [k for k, (_, r) in self._entries.items() if r == replica]:
             del self._entries[k]
 
+    def rehome(self, replica: int, target: int | None) -> int:
+        """Reassign every entry homed on ``replica`` to ``target`` (the retire
+        path: the prefix's next request routes to ONE consistent survivor,
+        which prefills once and becomes the real home). ``target`` None drops
+        them instead (no survivor to point at). Returns entries moved."""
+        if target is None:
+            self.drop_replica(replica)
+            return 0
+        moved = 0
+        for k, (tokens, r) in list(self._entries.items()):
+            if r == replica:
+                self._entries[k] = (tokens, int(target))
+                moved += 1
+        return moved
+
+    def hot_prefixes(self, n: int) -> list[np.ndarray]:
+        """The ``n`` most-recently-used prefixes, hottest first — the
+        warm-start EXPORT the router ships to a newly spawned replica. The
+        planes themselves never cross a process boundary; the tokens are the
+        portable half: replaying them through the fresh engine's prefill
+        re-derives the planes (rows are a pure function of tokens and
+        params), which is the warm-start IMPORT."""
+        if n <= 0:
+            return []
+        return [tokens.copy()
+                for tokens, _ in list(self._entries.values())[: -n - 1: -1]]
+
 
 class _Replica:
-    """Per-replica state: process handle, connection, in-flight ledger."""
+    """Per-replica state: process handle, connection, in-flight ledger.
+
+    Lifecycle: ``starting`` (spawned, connecting/compiling) → ``warming``
+    (hello received, prefix-cache warm replay in flight) → ``ready`` (serving;
+    the only state ``room()`` dispatches to) → ``draining`` (retire/reload in
+    progress: no new dispatch, in-flight finishing) → ``retired`` (gone for
+    good, slot kept for the ledger/history). Failures branch to ``restarting``
+    (backoff then respawn) or ``dead`` (restart budget exhausted).
+    ``retiring`` names who owns a draining replica (``"retire"`` |
+    ``"reload"``) so the failure paths can tell an expected teardown from a
+    crash."""
 
     def __init__(self, index: int):
         self.index = index
-        self.state = "starting"       # starting | up | restarting | dead
+        self.state = "starting"
+        self.retiring: str | None = None
+        self.drain_deadline = 0.0     # draining: stragglers redispatch at this
+        self.warmed = 0               # prefixes replayed before last ready
         self.generation = 0
         self.fleet: Fleet | None = None
         self.port = 0
@@ -201,7 +288,7 @@ class _Replica:
         self.stats: dict | None = None
 
     def room(self) -> bool:
-        return (self.state == "up"
+        return (self.state == "ready"
                 and (self.capacity is None or len(self.inflight) < self.capacity))
 
 
@@ -212,6 +299,16 @@ class Router:
 
     ``affinity=False`` degrades routing to least-loaded (the A/B baseline);
     everything else — backpressure, redispatch, restart — is identical.
+
+    Elasticity: ``num_replicas`` is the STARTING count, not a constant.
+    ``scale_up()``/``scale_down()`` move the fleet between ``min_replicas``
+    and ``max_replicas`` (``max_replicas=0`` = unbounded manual scaling);
+    passing an ``autoscale`` policy makes the snapshot loop drive them from
+    the ``fleet_snapshot`` load signal (requires ``snapshot_interval_s > 0``).
+    ``warm_prefixes`` is how many hot affinity prefixes a newly spawned
+    replica replays before it is marked ready (0 = cold starts);
+    ``drain_timeout_s`` bounds how long a retiring/reloading replica may
+    finish in-flight work before stragglers are redispatched.
     """
 
     def __init__(self, replica_command: list[str], *, num_replicas: int,
@@ -224,9 +321,33 @@ class Router:
                  backoff_max_s: float = 10.0, connect_timeout_s: float = 240.0,
                  telemetry: str = "", poll_s: float = 0.05,
                  trace_dir: str = "", snapshot_interval_s: float = 0.0,
+                 autoscale: AutoscalePolicy | None = None,
+                 min_replicas: int | None = None,
+                 max_replicas: int | None = None,
+                 warm_prefixes: int = 8, drain_timeout_s: float = 30.0,
                  env: dict | None = None):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+        self._autoscaler = FleetAutoscaler(autoscale) if autoscale else None
+        self._min_replicas = int(
+            min_replicas if min_replicas is not None
+            else autoscale.min_replicas if autoscale else 1)
+        self._max_replicas = int(
+            max_replicas if max_replicas is not None
+            else autoscale.max_replicas if autoscale else 0)
+        if self._min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {self._min_replicas}")
+        if num_replicas < self._min_replicas or (
+                self._max_replicas and num_replicas > self._max_replicas):
+            raise ValueError(
+                f"num_replicas {num_replicas} outside "
+                f"[{self._min_replicas}, {self._max_replicas or 'inf'}]")
+        if autoscale is not None and snapshot_interval_s <= 0:
+            raise ValueError("autoscale needs snapshot_interval_s > 0 — the "
+                             "fleet_snapshot loop is the policy's input")
+        self._warm_prefixes = int(warm_prefixes)
+        self._drain_timeout_s = float(drain_timeout_s)
         self._command = list(replica_command)
         self._platform = platform
         self._env = env
@@ -258,6 +379,18 @@ class Router:
         # (ROADMAP open item 1) will consume. 0 = off.
         self._snapshot_interval_s = float(snapshot_interval_s)
         self.replicas = [_Replica(i) for i in range(num_replicas)]
+        # The DESIRED replica count: scale_up/scale_down move it inside
+        # [min_replicas, max_replicas]; wait_ready and the autoscaler bound
+        # themselves against it (never against the start-time count).
+        self._target = num_replicas
+        self._scale_counts = {"scale_ups": 0, "scale_downs": 0, "retired": 0,
+                              "reloads": 0}
+        self._replica_series: list[int] = []   # ready count per snapshot tick
+        self._reloading = False
+        # Fleet-lifecycle spans (scale/reload) share one synthetic trace id —
+        # they are timeline annotations, not request traces, and the trace
+        # summarizer excludes LIFECYCLE_SPANS from per-request accounting.
+        self._fleet_trace = new_trace_id() if self.tracer.enabled else None
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._next_id = 0
@@ -294,13 +427,20 @@ class Router:
             "affinity": self._affinity_on, "max_pending": self.queue.max_pending,
             "heartbeat_timeout_s": self._hb_timeout_s,
             "max_restarts": self._max_restarts, "backoff_s": self._backoff_s,
+            "min_replicas": self._min_replicas,
+            "max_replicas": self._max_replicas or None,
+            "autoscale": (dataclasses.asdict(self._autoscaler.policy)
+                          if self._autoscaler else None),
+            "warm_prefixes": self._warm_prefixes,
+            "drain_timeout_s": self._drain_timeout_s,
         })
         with self._lock:
             for rep in self.replicas:
                 self._spawn(rep)
         loops = [("router-dispatch", self._dispatch_loop),
                  ("router-monitor", self._monitor_loop)]
-        if self._snapshot_interval_s > 0 and self._writer.enabled:
+        if self._snapshot_interval_s > 0 and (self._writer.enabled
+                                              or self._autoscaler is not None):
             loops.append(("router-snapshot", self._snapshot_loop))
         for name, target in loops:
             t = threading.Thread(target=target, daemon=True, name=name)
@@ -308,21 +448,42 @@ class Router:
             self._threads.append(t)
         return self
 
-    def wait_ready(self, timeout: float | None = None) -> bool:
-        """Block until every replica is connected and serving (or ``timeout``).
+    def wait_ready(self, timeout: float | None = None, *,
+                   min_ready: int | None = None) -> bool:
+        """Block until the fleet serves its CURRENT target (or ``timeout``).
         Load generators call this before offering measured load: replicas cold
         -start at different speeds (jax import + compile), and measuring — or
         A/B-comparing routing policies — against a half-up fleet would skew
-        everything toward whichever replica won the race. Returns False
-        immediately if the fleet aborts first (every replica crash-looped its
-        restart budget away — e.g. a broken replica command)."""
+        everything toward whichever replica won the race.
+
+        Readiness tracks the *current* target, never the start-time replica
+        count: the bar is ``min(target-at-call, current target, live
+        replicas)`` ready replicas. So a scale-up mid-wait (a new replica
+        still compiling) does not extend the wait past the fleet the caller
+        asked for, a scale-down mid-wait lowers the bar with the target, and
+        a replica that dies for good (restart budget exhausted) stops being
+        waited on as long as someone still serves. ``min_ready`` replaces only
+        the target-at-call term — it stays clamped by the current target and
+        live count (demanding more replicas than the fleet will ever spawn
+        would hang forever). Returns False if the fleet aborts first (every
+        live replica crash-looped its restart budget away)."""
+        want0 = min_ready
         with self._cond:
-            self._cond.wait_for(
-                lambda: self._aborted
-                or all(r.state == "up" for r in self.replicas),
-                timeout=timeout)
-            ready = (not self._aborted
-                     and all(r.state == "up" for r in self.replicas))
+            if want0 is None:
+                want0 = self._target
+
+            def bar() -> int:
+                live = sum(r.state not in ("retired", "dead")
+                           for r in self.replicas)
+                return max(1, min(want0, self._target, live))
+
+            def ok() -> bool:
+                return sum(r.state == "ready"
+                           for r in self.replicas) >= bar()
+
+            self._cond.wait_for(lambda: self._aborted or ok(),
+                                timeout=timeout)
+            ready = not self._aborted and ok()
             if ready and self._served_from_s is None:
                 self._served_from_s = time.monotonic()
             return ready
@@ -332,6 +493,221 @@ class Router:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # ------------------------------------------------------------------ elasticity
+
+    def scale_up(self, *, reason: str = "manual") -> int | None:
+        """Spawn one more replica (up to ``max_replicas``); returns its index,
+        or None when the fleet is at its cap or shutting down. The new replica
+        follows the full lifecycle — ``starting`` (spawn + compile), then the
+        prefix-cache warm-start (``warming``: the router ships its hottest
+        affinity prefixes for replay), then ``ready`` — so by the time it takes
+        traffic it is not cold. Dispatch picks it up automatically; nothing in
+        flight moves."""
+        now = time.monotonic()
+        with self._cond:
+            if self._stopping or self._aborted or self._reloading:
+                return None
+            if self._max_replicas and self._target >= self._max_replicas:
+                return None
+            rep = _Replica(len(self.replicas))
+            self.replicas.append(rep)
+            self._target += 1
+            self._scale_counts["scale_ups"] += 1
+            target = self._target
+            self._spawn(rep)
+            self._cond.notify_all()
+        self._writer.emit({"event": "scale", "action": "up",
+                           "replica": rep.index, "target": target,
+                           "reason": reason})
+        self.tracer.span("scale", self._fleet_trace, now, time.monotonic(),
+                         action="up", replica=rep.index, target=target,
+                         reason=reason)
+        return rep.index
+
+    def scale_down(self, *, reason: str = "manual") -> int | None:
+        """Retire one replica gracefully (down to ``min_replicas``); returns
+        its index, or None when the fleet is at its floor, mid-reload, or has
+        no spare ready replica. The victim — the least-loaded ready replica —
+        flips to ``draining`` immediately (dispatch and affinity stop routing
+        to it in the same transaction), finishes its in-flight work under
+        ``drain_timeout_s``, then exits; stragglers ride the normal
+        ``_drain_ledger`` redispatch, so retiring loses zero requests."""
+        now = time.monotonic()
+        with self._cond:
+            if self._stopping or self._aborted or self._reloading:
+                return None
+            if self._target <= self._min_replicas:
+                return None
+            ready = [r for r in self.replicas if r.state == "ready"]
+            if len(ready) <= 1:
+                return None           # never drain the last serving replica
+            victim = min(ready, key=lambda r: (len(r.inflight), -r.index))
+            self._target -= 1
+            self._scale_counts["scale_downs"] += 1
+            target = self._target
+            self._begin_drain(victim, "retire")
+        self._send_drain(victim)
+        self._writer.emit({"event": "scale", "action": "down",
+                           "replica": victim.index, "target": target,
+                           "reason": reason})
+        self.tracer.span("scale", self._fleet_trace, now, time.monotonic(),
+                         action="down", replica=victim.index, target=target,
+                         reason=reason)
+        return victim.index
+
+    def reload(self, checkpoint: str = "", *,
+               timeout_s: float = 600.0) -> dict:
+        """Roll new params through the fleet ONE replica at a time on the
+        retire drain machinery: drain (in-flight finishes, nothing new lands)
+        → restart with the new ``--checkpoint`` → prefix-cache warm → ready —
+        then the next replica. The fleet never dips below N−1 ready replicas
+        and no request ever mixes params (a request is pinned to one process,
+        and a process is pinned to one checkpoint for its whole life).
+        ``checkpoint`` empty rolls the fleet onto its current command (a param
+        refresh from a file that changed in place). Blocks until the roll
+        completes; raises ``RuntimeError`` if a rolled replica fails to come
+        back within ``timeout_s``."""
+        t_start = time.monotonic()
+        with self._cond:
+            if self._reloading:
+                raise RuntimeError("reload already in progress")
+            if self._stopping or self._aborted or self._started_s is None:
+                raise RuntimeError("router is not serving")
+            self._reloading = True
+            if checkpoint:
+                self._command = _with_checkpoint(self._command, checkpoint)
+            # Every replica spawned BEFORE the command rewrite carries the old
+            # params — including ones still mid-spawn (starting/warming).
+            # Those must roll too, or a scale-up racing the reload comes up
+            # ready on stale params and serves a mixed-version fleet forever.
+            # dead/restarting replicas are excluded: their respawn happens
+            # after this point and picks up the rewritten command.
+            targets = [r for r in self.replicas
+                       if r.state in ("starting", "warming", "ready")]
+        rolled: list[int] = []
+        try:
+            for rep in targets:
+                deadline = time.monotonic() + timeout_s
+                with self._cond:
+                    # A target caught mid-spawn must reach ready before it
+                    # can drain (drain rides the ready protocol).
+                    self._cond.wait_for(
+                        lambda: rep.state not in ("starting", "warming")
+                        or self._aborted or self._stopping,
+                        timeout=max(0.0, deadline - time.monotonic()))
+                    if rep.state in ("starting", "warming"):
+                        raise RuntimeError(
+                            f"reload: replica {rep.index} never became "
+                            f"ready to roll (state {rep.state})")
+                    if rep.state != "ready":
+                        continue      # crashed/retired since the roll began:
+                                      # any respawn uses the new command
+                    self._begin_drain(rep, "reload")
+                self._send_drain(rep)
+                self._writer.emit({"event": "scale", "action": "reload_drain",
+                                   "replica": rep.index,
+                                   "checkpoint": checkpoint})
+                with self._cond:
+                    # The monitor bounds this wait: drain deadline, process
+                    # death, and connect timeout all finalize the drain.
+                    self._cond.wait_for(
+                        lambda: rep.state in ("retired", "dead")
+                        or self._aborted or self._stopping,
+                        timeout=max(0.0, deadline - time.monotonic()))
+                    if rep.state != "retired":
+                        raise RuntimeError(
+                            f"reload: replica {rep.index} never drained "
+                            f"(state {rep.state})")
+                    self._spawn(rep)   # picks up the updated self._command
+                with self._cond:
+                    self._cond.wait_for(
+                        lambda: rep.state == "ready" or self._aborted
+                        or rep.state == "dead",
+                        timeout=max(0.0, deadline - time.monotonic()))
+                    if rep.state != "ready":
+                        raise RuntimeError(
+                            f"reload: replica {rep.index} did not come back "
+                            f"ready (state {rep.state})")
+                    self._scale_counts["reloads"] += 1
+                rolled.append(rep.index)
+                self._writer.emit({"event": "scale", "action": "reload",
+                                   "replica": rep.index,
+                                   "checkpoint": checkpoint,
+                                   "warmed": rep.warmed})
+                self.tracer.span("reload", self._fleet_trace,
+                                 deadline - timeout_s, time.monotonic(),
+                                 replica=rep.index, checkpoint=checkpoint)
+        finally:
+            with self._lock:
+                self._reloading = False
+        return {"reloaded": rolled, "checkpoint": checkpoint,
+                "wall_s": time.monotonic() - t_start}
+
+    def _begin_drain(self, rep: _Replica, mode: str) -> None:
+        """Flip one ready replica to ``draining`` (caller holds the lock):
+        ``room()`` refuses it and the affinity alive-filter skips it from this
+        transaction on, so no new work can land; in-flight entries stay in the
+        ledger until the replica's completions (or the drain deadline) settle
+        them. ``mode`` is who owns the retire ("retire" | "reload")."""
+        rep.state = "draining"
+        rep.retiring = mode
+        rep.drain_deadline = time.monotonic() + self._drain_timeout_s
+        self._cond.notify_all()
+
+    def _send_drain(self, rep: _Replica) -> None:
+        """Ship the drain op (outside the lock — it's a blocking socket write).
+        A failed write means the connection is already dying; the monitor's
+        draining branch finalizes via process-exit or deadline either way."""
+        with self._lock:
+            wfile, wlock = rep.wfile, rep.wlock
+        if wfile is None:
+            return
+        try:
+            with wlock:
+                wfile.write(b'{"op": "drain", "id": -3}\n')
+                wfile.flush()
+        except OSError:
+            pass
+
+    def _finish_retire(self, rep: _Replica, *, how: str) -> None:
+        """Terminal half of a graceful retire/reload drain — the ONE owner of
+        the draining→retired transition (the drained ack, the process's own
+        exit, and the drain deadline all land here; the state guard makes a
+        second arrival a no-op). Stragglers still in the ledger are
+        redispatched (zero lost requests), affinity entries re-home to the
+        least-loaded surviving ready replica so a hot prefix keeps ONE
+        consistent home, and the process is reaped."""
+        with self._cond:
+            if rep.state != "draining":
+                return
+            mode = rep.retiring
+            rep.generation += 1       # io thread for this generation stands down
+            sock, rep.sock, rep.wfile = rep.sock, None, None
+            now = time.monotonic()
+            stragglers = self._drain_ledger(rep, now, cause="retire")
+            survivors = [r for r in self.replicas
+                         if r.state == "ready" and r is not rep]
+            target = (min(survivors, key=lambda r: len(r.inflight)).index
+                      if survivors else None)
+            rehomed = self._affinity.rehome(rep.index, target)
+            rep.state = "retired"
+            if mode == "retire":
+                self._scale_counts["retired"] += 1
+            self._cond.notify_all()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if rep.fleet is not None:
+            rep.fleet.terminate(grace=2.0)   # no-op when it already exited 0
+        self._writer.emit({"event": "replica", "replica": rep.index,
+                           "action": "retired", "mode": mode, "how": how,
+                           "stragglers": stragglers, "rehomed": rehomed})
+        print(f"[router] replica {rep.index} retired ({mode}, {how}); "
+              f"{stragglers} straggler(s) redispatched, "
+              f"{rehomed} affinity entries re-homed", flush=True)
 
     # ------------------------------------------------------------------ submit
 
@@ -379,6 +755,8 @@ class Router:
         rep.capacity = None
         rep.stats = None
         rep.exit_code = None
+        rep.retiring = None
+        rep.warmed = 0
         cmd = list(self._command) + ["--port", str(rep.port),
                                      "--replica-id", str(rep.index)]
         if self._hb_dir:
@@ -441,11 +819,32 @@ class Router:
                 slots = int(hello.get("num_slots", 1))
                 pending = int(hello.get("max_pending", 0))
                 rep.capacity = slots + pending if pending else None
-                rep.state = "up"
+                # Prefix-cache warm-start: before this replica takes traffic,
+                # replay the fleet's hottest prefixes into its cache (the
+                # affinity index is the router's view of what is hot). Cold
+                # starts (empty index, warm_prefixes=0, affinity off) skip
+                # straight to ready.
+                warm = (self._affinity.hot_prefixes(self._warm_prefixes)
+                        if self._affinity_on else [])
+                if warm:
+                    rep.state = "warming"
+                else:
+                    rep.state = "ready"
                 self._cond.notify_all()
+            if warm:
+                msg = {"op": "warm", "id": -2,
+                       "prompts": [[int(t) for t in p] for p in warm]}
+                try:
+                    with rep.wlock:
+                        rep.wfile.write((json.dumps(msg) + "\n").encode())
+                        rep.wfile.flush()
+                except OSError:
+                    pass          # conn already dying: handled below as usual
             self._writer.emit({"event": "replica", "replica": rep.index,
-                               "action": "up", "restarts": rep.restarts,
-                               "capacity": rep.capacity})
+                               "action": "warming" if warm else "ready",
+                               "restarts": rep.restarts,
+                               "capacity": rep.capacity,
+                               "warm_prefixes": len(warm)})
             try:
                 for raw in rfile:
                     self._handle_line(rep, gen, json.loads(raw))
@@ -465,7 +864,7 @@ class Router:
                 if rep.generation == gen:
                     rep.sock = None
                     rep.wfile = None
-                    if not self._stopping and rep.state == "up":
+                    if not self._stopping and rep.state in ("ready", "warming"):
                         # Connection lost but generation current (process still
                         # alive): reconnect — but first drain the ledger. The
                         # replica's completion callbacks hold the DEAD socket's
@@ -492,6 +891,33 @@ class Router:
                 rep.stats = {"engine": msg.get("engine"),
                              "queue": msg.get("queue")}
                 self._cond.notify_all()
+        elif op == "drained":
+            # Graceful retire/reload ack: the replica finished everything it
+            # had accepted (its done lines all precede this one on the wire)
+            # and is about to exit 0. Finalize: ledger should be empty — any
+            # entry left is a straggler the redispatch path replays.
+            with self._lock:
+                if rep.generation != gen:
+                    return
+            self._finish_retire(rep, how="drained")
+        elif op == "warm_done":
+            # Warm replay finished: the replica's prefix cache now holds the
+            # shipped prefixes — re-home their affinity entries onto it (it
+            # literally has the paid-for state) and open it for dispatch.
+            with self._cond:
+                if rep.generation != gen or rep.state != "warming":
+                    return
+                rep.warmed = int(msg.get("count") or 0)
+                if self._affinity_on:
+                    for p in msg.get("prompts") or []:
+                        self._affinity.insert(np.asarray(p, np.int32),
+                                              rep.index)
+                rep.state = "ready"
+                self._cond.notify_all()
+            self._writer.emit({"event": "replica", "replica": rep.index,
+                               "action": "ready", "restarts": rep.restarts,
+                               "capacity": rep.capacity,
+                               "warmed": rep.warmed})
 
     def _handle_done(self, rep: _Replica, msg: dict) -> None:
         now = time.monotonic()
@@ -560,9 +986,12 @@ class Router:
             self._cond.notify_all()
         now = time.monotonic()
         kind = msg.get("error")
-        if kind == "queue_full":
-            # Router/replica capacity accounting drifted (e.g. a replica
-            # restarted thinner): bounce back to the queue front, try elsewhere.
+        if kind in ("queue_full", "draining"):
+            # queue_full: router/replica capacity accounting drifted (e.g. a
+            # replica restarted thinner). draining: the shrink/submit race —
+            # a dispatch crossed the drain op on the wire and the replica's
+            # closed queue refused it. Either way the request is intact:
+            # bounce back to the queue front, try elsewhere.
             self.tracer.span("dispatch", req.trace_id, req.dispatch_s, now,
                              request_id=req.request_id, replica=rep.index,
                              outcome="bounced", hop=req.redispatches)
@@ -614,7 +1043,13 @@ class Router:
         a paid-for warm cache the fleet was too loaded to use)."""
         spilled = False
         if self._affinity_on:
-            idx = self._affinity.lookup(prompt, self._affinity_min)
+            # Only ready replicas are candidates: an entry homed on a
+            # draining/retired/dead replica must not route traffic there (the
+            # affinity satellite fix — before, draining replicas kept
+            # receiving affine traffic until they actually died).
+            alive = {r.index for r in self.replicas if r.state == "ready"}
+            idx = self._affinity.lookup(prompt, self._affinity_min,
+                                        alive=alive)
             if idx is not None:
                 if self.replicas[idx].room():
                     return self.replicas[idx], True, False
@@ -872,7 +1307,8 @@ class Router:
                 pass      # lost a resolve race — must not kill the monitor thread
 
     def _stale(self, rep: _Replica) -> bool:
-        if not (self._hb_dir and self._hb_timeout_s > 0 and rep.state == "up"):
+        if not (self._hb_dir and self._hb_timeout_s > 0
+                and rep.state == "ready"):
             return False
         beat = hb.read_heartbeats(self._hb_dir).get(rep.index)
         t = (beat["time"] if beat and beat["time"] >= rep.started_wall
@@ -892,20 +1328,33 @@ class Router:
                 next_hb = now + max(self._poll_s,
                                     self._hb_timeout_s / 10 or self._poll_s)
             for rep in reps:
-                if rep.state in ("starting", "up"):
+                # draining/retired replicas are owned by their retire/reload
+                # thread (an expected exit 0 must never classify as a crash);
+                # the drain deadline bounds a death there instead.
+                if rep.state in ("starting", "warming", "ready"):
                     if not rep.fleet.running:
                         rc = rep.fleet.poll()
                         reason = ("preempted" if rc == EXIT_PREEMPTED
                                   else "crash")
                         self._fail_replica(rep, reason, exit_code=rc)
                         continue
-                    if rep.state == "up" and check_hb and self._stale(rep):
+                    if rep.state == "ready" and check_hb and self._stale(rep):
                         self._fail_replica(rep, "hung")
                         continue
-                    if (rep.state == "starting"
+                    if (rep.state in ("starting", "warming")
                             and now - rep.started_mono > self._connect_timeout_s):
                         self._fail_replica(rep, "connect_timeout")
                         continue
+                elif rep.state == "draining":
+                    # The drain has three exits, all landing in _finish_retire
+                    # (state-guarded — whichever fires first wins): the drained
+                    # ack (io thread), the process's own exit 0, and the drain
+                    # deadline (a wedged replica cannot hold its in-flight work
+                    # hostage — stragglers redispatch, the process is reaped).
+                    if not rep.fleet.running:
+                        self._finish_retire(rep, how="exited")
+                    elif now > rep.drain_deadline:
+                        self._finish_retire(rep, how="deadline")
                 elif rep.state == "restarting" and now >= rep.restart_due:
                     self._writer.emit({"event": "replica", "replica": rep.index,
                                        "action": "restart",
@@ -924,7 +1373,8 @@ class Router:
         timeline consumer tolerates by construction: it is a trend signal)."""
         with self._lock:
             targets = [(r.wfile, r.wlock) for r in self.replicas
-                       if r.state == "up" and r.wfile is not None]
+                       if r.state in ("ready", "draining")
+                       and r.wfile is not None]
         for wfile, wlock in targets:
             try:
                 with wlock:
@@ -946,6 +1396,8 @@ class Router:
         now = time.monotonic()
         with self._lock:
             counts = dict(self._counts)
+            target = self._target
+            scale = dict(self._scale_counts)
             per_replica = []
             for r in self.replicas:
                 row = {"replica": r.index, "state": r.state,
@@ -965,15 +1417,28 @@ class Router:
                             by.get("decode_bytes_per_token")
                 per_replica.append(row)
         inflight = sum(r["inflight"] for r in per_replica)
+        # Utilization is READY in-flight over READY capacity: a draining
+        # replica's stragglers are not dispatchable load, and charging them
+        # against the ready denominator made every graceful drain read as
+        # overload (the autoscaler would scale up right after its own
+        # scale-down — shrink/grow flapping).
+        ready_inflight = sum(r["inflight"] for r in per_replica
+                             if r["state"] == "ready")
         capacity = sum(r["capacity"] or 0 for r in per_replica
-                       if r["state"] == "up")
+                       if r["state"] == "ready")
         routed = counts["requests"]
         return {
             "event": "fleet_snapshot",
             "queue": self.queue.snapshot(now),
             "inflight": inflight,
             "capacity_up": capacity,
-            "utilization": inflight / capacity if capacity else None,
+            "utilization": ready_inflight / capacity if capacity else None,
+            # The elasticity fields the autoscaler reads: the DESIRED count
+            # (an in-flight spawn already counts, so the policy never stacks
+            # spawns) vs what is actually serving right now.
+            "target": target,
+            "replicas_ready": sum(r["state"] == "ready" for r in per_replica),
+            "scale": scale,
             "requests": routed,
             "ok": counts["ok"],
             "failed": counts["failed"],
@@ -989,7 +1454,12 @@ class Router:
         """The metrics timeline: every ``snapshot_interval_s``, poke the
         replicas for fresh engine counters and emit one ``fleet_snapshot``
         line. Emission stops with the writer (stop() closes it; emit on a
-        closed writer is a guarded no-op)."""
+        closed writer is a guarded no-op). With an ``autoscale`` policy this
+        loop is also the ACTUATOR: each snapshot is folded into the
+        hysteresis state and a verdict immediately drives
+        ``scale_up``/``scale_down`` — the signal and the decision share one
+        clock, so the policy's sustain counts translate directly into
+        reaction time."""
         interval = self._snapshot_interval_s
         while True:
             deadline = time.monotonic() + interval
@@ -999,7 +1469,16 @@ class Router:
                     if self._stopping:
                         return
                 time.sleep(min(self._poll_s, interval / 4))
-            self._writer.emit(self.fleet_snapshot())
+            snap = self.fleet_snapshot()
+            with self._lock:
+                self._replica_series.append(snap["replicas_ready"])
+            self._writer.emit(snap)
+            if self._autoscaler is not None:
+                verdict = self._autoscaler.observe(snap, time.monotonic())
+                if verdict == "up":
+                    self.scale_up(reason="autoscale")
+                elif verdict == "down":
+                    self.scale_down(reason="autoscale")
 
     # ------------------------------------------------------------------ stop
 
@@ -1010,7 +1489,8 @@ class Router:
         asked = []
         with self._lock:
             for rep in self.replicas:
-                if rep.state == "up" and rep.wfile is not None:
+                if (rep.state in ("ready", "draining")
+                        and rep.wfile is not None):
                     try:
                         with rep.wlock:
                             rep.wfile.write(
@@ -1135,9 +1615,20 @@ class Router:
                 for k in cache:
                     cache[k] += pc.get(k) or 0
         routed = counts["requests"]
+        with self._lock:
+            scale = dict(self._scale_counts)
+            ready_series = list(self._replica_series)
         return {
             "event": "router_summary",
             "replicas": len(self.replicas),
+            "target": self._target,
+            "scale": scale,
+            "scale_events": (scale["scale_ups"] + scale["scale_downs"]
+                             + scale["reloads"]),
+            "replicas_ready_p50": (percentiles(ready_series, qs=(50,))
+                                   or {"p50": None})["p50"],
+            "replicas_ready_max": max(ready_series) if ready_series else None,
+            "replicas_ready_min": min(ready_series) if ready_series else None,
             "affinity": self._affinity_on,
             "wall_s": wall,
             **counts,

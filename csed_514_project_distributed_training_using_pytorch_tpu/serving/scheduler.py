@@ -46,6 +46,16 @@ class QueueFull(RuntimeError):
     """Backpressure signal: the bounded request queue is at capacity."""
 
 
+class QueueClosed(RuntimeError):
+    """Admission refused because the queue is draining (``close()`` was called).
+
+    Subclasses ``RuntimeError`` because that is what ``submit`` historically
+    raised; the typed subclass exists for the fleet's shrink path — a replica
+    told to ``drain`` closes its queue, and a submit racing that close must be
+    classifiable (the replica bounces it as ``error: draining`` so the router
+    requeues it elsewhere) rather than treated as a hard failure."""
+
+
 class ServerStopped(TimeoutError):
     """A serving front end (``Server`` or ``Router``) was stopped before this
     request could complete: pending futures are failed with this instead of
@@ -116,10 +126,10 @@ class RequestQueue:
 
     def submit(self, request) -> None:
         """Enqueue or refuse — never blocks. Raises ``QueueFull`` (backpressure)
-        or ``RuntimeError`` after ``close()`` (drain in progress)."""
+        or ``QueueClosed`` after ``close()`` (drain in progress)."""
         with self._cond:
             if self._closed:
-                raise RuntimeError("queue is closed (server draining)")
+                raise QueueClosed("queue is closed (server draining)")
             if self.max_pending and len(self._dq) >= self.max_pending:
                 self._rejected += 1
                 raise QueueFull(

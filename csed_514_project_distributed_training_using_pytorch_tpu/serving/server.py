@@ -176,19 +176,23 @@ class Server:
     def submit(self, prompt, *, max_new_tokens: int,
                sampling: SamplingParams = SamplingParams(),
                timeout_s: float | None = None,
-               trace_id: str | None = None) -> concurrent.futures.Future:
+               trace_id: str | None = None,
+               traced: bool = True) -> concurrent.futures.Future:
         """Thread-safe enqueue. Returns a Future resolving to a ``Completion``
         (``finish`` tells ok from timeout). Raises ``QueueFull`` (backpressure)
         or ``ValueError`` (admission control: oversized prompt, bad sampling
         params) immediately, in the caller's thread. ``trace_id`` joins this
         request to an existing distributed trace; with tracing on and no id
-        given, this submit is the trace origin and assigns one."""
+        given, this submit is the trace origin and assigns one —
+        ``traced=False`` opts out (internal traffic like the replica's
+        prefix-cache warm replay is setup, not a request, and must not mint
+        trace trees of its own)."""
         now = time.monotonic()
         timeout_s = self._default_timeout_s if timeout_s is None else timeout_s
         with self._id_lock:
             rid = self._next_id
             self._next_id += 1
-        if trace_id is None and self.tracer.enabled:
+        if trace_id is None and traced and self.tracer.enabled:
             trace_id = new_trace_id()
         req = Request(
             prompt=np.asarray(prompt, np.int32).reshape(-1),

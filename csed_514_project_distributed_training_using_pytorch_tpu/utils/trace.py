@@ -152,6 +152,13 @@ def assemble(spans) -> dict[str, list[dict]]:
 # counts them; tests pin the count at zero.
 TERMINAL_SPANS = ("resolve", "client")
 
+# Fleet-lifecycle spans (the router's scale_up/scale_down/reload timeline
+# annotations, all sharing one synthetic trace id): real spans on the Chrome
+# timeline, but NOT requests — per-request accounting (summarize_traces,
+# orphan counting) excludes them, or every elastic run would report one
+# eternal "orphan" that is actually the fleet's own history.
+LIFECYCLE_SPANS = ("scale", "reload")
+
 # Critical-path segments, in pipeline order. ``dispatch`` spans OVERLAP the
 # replica-side work they contain, so the breakdown uses the replica's own
 # spans for the covered interior and charges only the remainder to overhead.
@@ -235,10 +242,20 @@ def trace_breakdown(spans: list[dict]) -> dict:
     }
 
 
+def lifecycle_timeline(spans) -> list[dict]:
+    """The fleet-lifecycle spans (scale/reload), in time order — the scale
+    timeline ``tools/trace_report.py`` renders alongside per-request trees."""
+    return sorted((s for s in spans if s.get("name") in LIFECYCLE_SPANS),
+                  key=lambda s: s.get("ts") or 0.0)
+
+
 def summarize_traces(spans) -> dict:
     """Fleet-level reduction of a span set: per-segment p50/p95 over all traces,
     span-derived TTFT percentiles, hop/orphan accounting, and the per-trace
-    breakdowns (sorted slowest-first) for the slowest-N report."""
+    breakdowns (sorted slowest-first) for the slowest-N report. Fleet-lifecycle
+    spans (``LIFECYCLE_SPANS``) are excluded — they are timeline annotations,
+    not requests."""
+    spans = [s for s in spans if s.get("name") not in LIFECYCLE_SPANS]
     traces = assemble(spans)
     downs = {tid: trace_breakdown(t) for tid, t in traces.items()}
     orphans = [tid for tid, d in downs.items() if not d["resolved"]]

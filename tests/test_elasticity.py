@@ -1,0 +1,704 @@
+"""Elastic fleet serving: autoscaler policy, drain-to-retire, warm-start, reload.
+
+The PR 9 acceptance contract, in tiers:
+
+- **policy tier** (pure, no processes): ``serving/autoscaler.py`` hysteresis —
+  sustain counters, cooldown dead time, target-bounds — driven with synthetic
+  ``fleet_snapshot`` dicts; plus the router-side pure pieces (affinity
+  alive-filter/re-home, hot-prefix export, checkpoint argv rewrite).
+- **echo tier** (cheap processes, no model): the lifecycle machinery —
+  manual scale_up/scale_down, the graceful drain-to-retire invariant (zero
+  lost requests, zero double-completions, including the shrink/submit race),
+  prefix-cache warm-start protocol, rolling ``Router.reload`` with capacity
+  never below N−1, and the full 2→4→1 elasticity run under a mid-flight kill.
+- **engine tier** (slow, the CI elasticity-smoke job): the same 2→4→1 run
+  against real jax replicas, every completion token-identical to an
+  uninterrupted single-engine run.
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.autoscaler import (
+    AutoscalePolicy,
+    FleetAutoscaler,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.router import (
+    Router,
+    _AffinityIndex,
+    _with_checkpoint,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
+    QueueClosed,
+    RequestQueue,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import trace
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.metrics import (
+    load_metrics_jsonl,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "csed_514_project_distributed_training_using_pytorch_tpu"
+
+
+@pytest.fixture(autouse=True)
+def _child_pythonpath(monkeypatch):
+    existing = os.environ.get("PYTHONPATH", "")
+    monkeypatch.setenv("PYTHONPATH", f"{REPO}:{existing}" if existing else REPO)
+
+
+# -----------------------------------------------------------------------------------------
+# Policy tier: hysteresis over synthetic snapshots
+# -----------------------------------------------------------------------------------------
+
+
+def _snap(depth=0, age=0.0, util=0.0, target=2):
+    return {"queue": {"depth": depth, "oldest_age_s": age},
+            "utilization": util, "target": target}
+
+
+def test_autoscale_policy_validation():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2).validate()
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0).validate()
+    with pytest.raises(ValueError):
+        AutoscalePolicy(sustain_up=0).validate()
+    with pytest.raises(ValueError):
+        AutoscalePolicy(down_utilization=0.9, up_utilization=0.8).validate()
+    AutoscalePolicy().validate()          # defaults are legal
+
+
+def test_autoscaler_scale_up_needs_sustained_overload():
+    a = FleetAutoscaler(AutoscalePolicy(sustain_up=3, up_queue_age_s=0.5,
+                                        cooldown_s=0.0))
+    hot = _snap(depth=4, age=1.0, util=1.0)
+    assert a.observe(hot, 0.0) is None
+    assert a.observe(hot, 1.0) is None
+    assert a.observe(hot, 2.0) == "up"              # third consecutive
+    # One calm snapshot resets the streak: sustain means CONSECUTIVE.
+    assert a.observe(hot, 3.0) is None
+    assert a.observe(_snap(), 4.0) is None
+    assert a.observe(hot, 5.0) is None
+    assert a.observe(hot, 6.0) is None
+    assert a.observe(hot, 7.0) == "up"
+
+
+def test_autoscaler_scale_down_needs_sustained_idle_and_empty_queue():
+    a = FleetAutoscaler(AutoscalePolicy(sustain_down=2, down_utilization=0.25,
+                                        cooldown_s=0.0))
+    idle = _snap(depth=0, util=0.1)
+    assert a.observe(idle, 0.0) is None
+    assert a.observe(idle, 1.0) == "down"
+    # Idle utilization but a non-empty queue is NOT idle.
+    a2 = FleetAutoscaler(AutoscalePolicy(sustain_down=1, cooldown_s=0.0))
+    assert a2.observe(_snap(depth=1, util=0.0), 0.0) is None
+    # util None (no ready capacity at all) must never shrink the fleet.
+    assert a2.observe({"queue": {"depth": 0}, "utilization": None,
+                       "target": 2}, 1.0) is None
+
+
+def test_autoscaler_cooldown_suppresses_then_reacts():
+    a = FleetAutoscaler(AutoscalePolicy(sustain_up=1, up_queue_age_s=0.5,
+                                        cooldown_s=5.0))
+    hot = _snap(depth=4, age=1.0)
+    assert a.observe(hot, 0.0) == "up"
+    assert a.observe(hot, 1.0) is None              # inside the dead time
+    assert a.observe(hot, 4.9) is None
+    assert a.observe(hot, 5.1) == "up"              # still hot after cooldown
+
+
+def test_autoscaler_bounds_check_target_not_ready_count():
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=2, sustain_up=1,
+                          sustain_down=1, up_queue_age_s=0.5, cooldown_s=0.0)
+    a = FleetAutoscaler(pol)
+    # target already at max (a spawn still compiling counts): no stacking.
+    assert a.observe(_snap(depth=4, age=1.0, target=2), 0.0) is None
+    assert a.observe(_snap(depth=4, age=1.0, target=1), 1.0) == "up"
+    # target at min: no shrink below the floor.
+    assert a.observe(_snap(depth=0, util=0.0, target=1), 2.0) is None
+    assert a.observe(_snap(depth=0, util=0.0, target=2), 3.0) == "down"
+    assert a.decisions and a.decisions[-1]["verdict"] == "down"
+
+
+# -----------------------------------------------------------------------------------------
+# Pure router pieces
+# -----------------------------------------------------------------------------------------
+
+
+def test_with_checkpoint_rewrites_or_appends():
+    assert _with_checkpoint(["-m", "x"], "new.ckpt") == \
+        ["-m", "x", "--checkpoint", "new.ckpt"]
+    assert _with_checkpoint(["-m", "x", "--checkpoint", "old.ckpt", "--rope"],
+                            "new.ckpt") == \
+        ["-m", "x", "--checkpoint", "new.ckpt", "--rope"]
+    assert _with_checkpoint(["-m", "x", "--checkpoint=old.ckpt"], "new.ckpt") \
+        == ["-m", "x", "--checkpoint=new.ckpt"]
+    cmd = ["-m", "x"]
+    _with_checkpoint(cmd, "a")
+    assert cmd == ["-m", "x"]             # pure: input never mutated
+
+
+def test_affinity_lookup_skips_non_alive_replicas():
+    idx = _AffinityIndex()
+    long = np.arange(20, dtype=np.int32)
+    idx.insert(long, 0)                   # best match homed on replica 0
+    idx.insert(long[:10].copy(), 1)       # shorter match on replica 1
+    assert idx.lookup(long, 8) == 0
+    # Replica 0 drains: the shorter match on a READY replica wins; entries for
+    # the draining replica are skipped, not deleted.
+    assert idx.lookup(long, 8, alive={1}) == 1
+    assert idx.lookup(long, 8, alive={0, 1}) == 0   # still there
+    assert idx.lookup(long, 8, alive=set()) is None
+
+
+def test_affinity_rehome_moves_entries_to_survivor():
+    idx = _AffinityIndex()
+    idx.insert(np.arange(12, dtype=np.int32), 0)
+    idx.insert(np.arange(50, 62, dtype=np.int32), 0)
+    idx.insert(np.arange(100, 112, dtype=np.int32), 1)
+    assert idx.rehome(0, 2) == 2
+    assert idx.lookup(np.arange(12, dtype=np.int32), 8, alive={1, 2}) == 2
+    assert idx.lookup(np.arange(100, 112, dtype=np.int32), 8) == 1
+    # No survivor: entries drop instead.
+    assert idx.rehome(1, None) == 0
+    assert idx.lookup(np.arange(100, 112, dtype=np.int32), 8) is None
+
+
+def test_affinity_hot_prefixes_mru_first():
+    idx = _AffinityIndex()
+    a = np.arange(10, dtype=np.int32)
+    b = np.arange(20, 30, dtype=np.int32)
+    idx.insert(a, 0)
+    idx.insert(b, 1)
+    idx.lookup(a, 8)                      # touches a: most recently used
+    hot = idx.hot_prefixes(2)
+    np.testing.assert_array_equal(hot[0], a)
+    np.testing.assert_array_equal(hot[1], b)
+    assert idx.hot_prefixes(0) == []
+    hot[0][0] = 99                        # copies: caller cannot poison the index
+    assert idx.lookup(a, 8) == 0
+
+
+def test_queue_closed_is_typed_and_requeue_still_works():
+    q = RequestQueue(4)
+
+    class R:
+        arrival_s = deadline_s = None
+
+    q.close()
+    with pytest.raises(QueueClosed):
+        q.submit(R())
+    q.requeue(R())                        # redispatch ignores close
+    assert len(q) == 1
+
+
+def test_lifecycle_spans_excluded_from_trace_accounting():
+    spans = [
+        {"event": "span", "trace_id": "t1", "name": "queue_wait", "proc":
+         "router", "ts": 1.0, "dur_s": 0.1},
+        {"event": "span", "trace_id": "t1", "name": "resolve", "proc":
+         "router", "ts": 1.2, "dur_s": 0.01},
+        # The fleet's own history: one synthetic trace of scale/reload spans.
+        {"event": "span", "trace_id": "fleet", "name": "scale", "proc":
+         "router", "ts": 1.1, "dur_s": 0.0, "action": "up"},
+        {"event": "span", "trace_id": "fleet", "name": "reload", "proc":
+         "router", "ts": 1.3, "dur_s": 0.5, "replica": 0},
+    ]
+    summ = trace.summarize_traces(spans)
+    assert summ["traces"] == 1            # the fleet trace is not a request
+    assert summ["orphans"] == 0           # ... and never an orphan
+    tl = trace.lifecycle_timeline(spans)
+    assert [s["name"] for s in tl] == ["scale", "reload"]
+
+
+# -----------------------------------------------------------------------------------------
+# Echo tier: lifecycle machinery with model-free replicas
+# -----------------------------------------------------------------------------------------
+
+
+def _echo_cmd(*, num_slots=4, max_pending=8, delay=0.0, seq_len=32, levels=8):
+    cmd = ["-m", f"{PKG}.serving.replica", "--echo",
+           "--num-levels", str(levels), "--seq-len", str(seq_len),
+           "--num-slots", str(num_slots), "--max-pending", str(max_pending)]
+    if delay:
+        cmd += ["--echo-delay-s", str(delay)]
+    return cmd
+
+
+def _echo_expected(prompt: np.ndarray, max_new: int, *, seq_len=32, levels=8):
+    p = len(prompt)
+    total = min(p + max_new, seq_len)
+    base = int(prompt.sum()) if p else 0
+    return np.asarray(list(prompt) + [(base + i) % levels
+                                      for i in range(total - p)], np.int32)
+
+
+def _router(tmp_path, cmd, n=2, **kw):
+    kw.setdefault("heartbeat_dir", str(tmp_path / "hb"))
+    kw.setdefault("heartbeat_timeout_s", 30.0)
+    kw.setdefault("backoff_s", 0.2)
+    kw.setdefault("telemetry", str(tmp_path / "router.jsonl"))
+    kw.setdefault("drain_timeout_s", 20.0)
+    return Router(cmd, num_replicas=n, **kw)
+
+
+def _wait(pred, timeout=30.0, msg=""):
+    deadline = time.monotonic() + timeout
+    while not pred() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert pred(), msg or "condition not reached in time"
+
+
+def test_router_bounds_validation(tmp_path):
+    with pytest.raises(ValueError):
+        Router(_echo_cmd(), num_replicas=1, min_replicas=2)
+    with pytest.raises(ValueError):
+        Router(_echo_cmd(), num_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        Router(_echo_cmd(), num_replicas=1, min_replicas=0)
+    with pytest.raises(ValueError):
+        # Autoscale without the snapshot loop that feeds it.
+        Router(_echo_cmd(), num_replicas=1, autoscale=AutoscalePolicy())
+
+
+def test_router_manual_scale_up_down_full_lifecycle(tmp_path):
+    """2→4→1 on the echo tier: scale_up spawns through the full lifecycle,
+    scale_down drains gracefully (zero lost, zero double-completions), bounds
+    hold at both ends, and wait_ready tracks the CURRENT target — a
+    min_replicas < num_replicas start neither hangs nor returns early."""
+    router = _router(tmp_path, _echo_cmd(delay=0.02), n=2,
+                     min_replicas=1, max_replicas=4).start()
+    try:
+        assert router.wait_ready(timeout=60)      # target-at-start = 2
+        assert router.scale_up() == 2
+        assert router.scale_up() == 3
+        assert router.scale_up() is None          # at max_replicas
+        assert router.wait_ready(timeout=60)      # now waits for 4
+        assert sum(r.state == "ready" for r in router.replicas) == 4
+        rng = np.random.default_rng(7)
+        reqs = [(rng.integers(0, 7, size=1 + i % 5).astype(np.int32), 5)
+                for i in range(24)]
+        futs = [router.submit(p, max_new_tokens=n) for p, n in reqs]
+        # Shrink 4 -> 1 while the work is in flight.
+        retired = [router.scale_down(), router.scale_down(),
+                   router.scale_down()]
+        assert all(v is not None for v in retired)
+        assert router.scale_down() is None        # at min_replicas
+        comps = [f.result(timeout=60) for f in futs]
+        assert all(c.ok for c in comps)           # zero lost
+        for (p, n), c in zip(reqs, comps):
+            np.testing.assert_array_equal(c.tokens, _echo_expected(p, n))
+        _wait(lambda: sum(r.state == "retired" for r in router.replicas) == 3,
+              msg="retires did not complete")
+        # wait_ready after the shrink tracks the NEW target (1), instantly.
+        t0 = time.monotonic()
+        assert router.wait_ready(timeout=10)
+        assert time.monotonic() - t0 < 5.0
+        f = router.submit(np.asarray([1, 2], np.int32), max_new_tokens=3)
+        assert f.result(timeout=30).ok            # the survivor still serves
+    finally:
+        summ = router.stop(timeout=60)
+    assert summ["ok"] == 25                       # 24 + the post-shrink probe
+    assert summ["requests"] == 25                 # zero double-completions
+    assert summ["duplicates"] == 0
+    assert summ["scale"] == {"scale_ups": 2, "scale_downs": 3, "retired": 3,
+                             "reloads": 0}
+    assert summ["scale_events"] == 5
+    rows = load_metrics_jsonl(str(tmp_path / "router.jsonl"))
+    scales = [r for r in rows if r["event"] == "scale"]
+    assert [e["action"] for e in scales] == ["up", "up", "down", "down", "down"]
+    assert [e["target"] for e in scales] == [3, 4, 3, 2, 1]
+    retires = [r for r in rows if r["event"] == "replica"
+               and r.get("action") == "retired"]
+    assert len(retires) == 3 and all(r["mode"] == "retire" for r in retires)
+
+
+def test_router_shrink_submit_race_zero_lost_zero_double(tmp_path):
+    """A request submitted in the same tick a replica flips to draining either
+    lands elsewhere or bounces off the replica's closed queue (``error:
+    draining``) and rides the requeue — never lost, never completed twice."""
+    router = _router(tmp_path, _echo_cmd(delay=0.03, num_slots=2,
+                                         max_pending=4), n=2,
+                     min_replicas=1).start()
+    try:
+        assert router.wait_ready(timeout=60)
+        rng = np.random.default_rng(11)
+        futs = []
+        reqs = []
+        for i in range(40):
+            p = rng.integers(0, 7, size=2 + i % 4).astype(np.int32)
+            reqs.append((p, 4))
+            futs.append(router.submit(p, max_new_tokens=4))
+            if i == 12:                   # mid-stream, work in flight
+                assert router.scale_down() is not None
+        comps = [f.result(timeout=120) for f in futs]
+        assert all(c.ok for c in comps)
+        for (p, n), c in zip(reqs, comps):
+            np.testing.assert_array_equal(c.tokens, _echo_expected(p, n))
+    finally:
+        summ = router.stop(timeout=60)
+    assert summ["ok"] == 40 == summ["requests"]   # exactly-once, all of them
+    assert summ["duplicates"] == 0
+    assert summ["scale"]["retired"] == 1
+
+
+def test_router_scale_up_warm_starts_from_affinity_index(tmp_path):
+    """A newly spawned replica replays the fleet's hottest prefixes before it
+    is marked ready: the router ships them (``warming`` state), the replica
+    acks ``warm_done``, and the affinity index re-homes those prefixes onto
+    the warmed replica."""
+    router = _router(tmp_path, _echo_cmd(delay=0.01), n=1,
+                     max_replicas=2, warm_prefixes=4).start()
+    try:
+        assert router.wait_ready(timeout=60)
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, 7, size=12).astype(np.int32)
+                   for _ in range(6)]
+        futs = [router.submit(p, max_new_tokens=3) for p in prompts]
+        [f.result(timeout=60) for f in futs]
+        idx = router.scale_up()
+        assert router.wait_ready(timeout=60)
+        rep = router.replicas[idx]
+        assert rep.state == "ready"
+        assert rep.warmed == 4            # the shipped prefixes were replayed
+        with router._lock:
+            homes = {r for _, r in router._affinity._entries.values()}
+        assert idx in homes               # re-homed onto the warmed replica
+    finally:
+        router.stop(timeout=60)
+    rows = load_metrics_jsonl(str(tmp_path / "router.jsonl"))
+    evs = [r for r in rows if r["event"] == "replica"
+           and r.get("replica") == idx]
+    assert [e["action"] for e in evs][:2] == ["warming", "ready"]
+    assert evs[0]["warm_prefixes"] == 4 and evs[1]["warmed"] == 4
+
+
+def test_router_warm_prefixes_zero_stays_cold(tmp_path):
+    """``warm_prefixes=0`` (or affinity off) skips the warm phase entirely —
+    the new replica goes straight to ready, no warm op on the wire."""
+    router = _router(tmp_path, _echo_cmd(), n=1, max_replicas=2,
+                     warm_prefixes=0).start()
+    try:
+        assert router.wait_ready(timeout=60)
+        futs = [router.submit(np.arange(10, dtype=np.int32) % 7,
+                              max_new_tokens=2) for _ in range(3)]
+        [f.result(timeout=60) for f in futs]
+        idx = router.scale_up()
+        assert router.wait_ready(timeout=60)
+        assert router.replicas[idx].warmed == 0
+    finally:
+        router.stop(timeout=60)
+    rows = load_metrics_jsonl(str(tmp_path / "router.jsonl"))
+    evs = [r for r in rows if r["event"] == "replica"
+           and r.get("replica") == idx]
+    assert evs[0]["action"] == "ready"
+
+
+def test_router_autoscale_grows_on_burst_shrinks_on_idle(tmp_path):
+    """The full loop: a burst piles the queue up -> the policy's sustained
+    -overload streak fires a scale-up; the idle tail -> a graceful retire.
+    Zero lost requests throughout (the autoscaler must never break the
+    at-least-once contract)."""
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3, up_queue_age_s=0.1,
+                          up_utilization=0.95, down_utilization=0.3,
+                          sustain_up=2, sustain_down=3, cooldown_s=0.5)
+    router = _router(tmp_path, _echo_cmd(delay=0.05, num_slots=1,
+                                         max_pending=1), n=1,
+                     autoscale=pol, snapshot_interval_s=0.15).start()
+    try:
+        assert router.wait_ready(timeout=60)
+        rng = np.random.default_rng(13)
+        futs = [router.submit(rng.integers(0, 7, size=3).astype(np.int32),
+                              max_new_tokens=8) for _ in range(24)]
+        comps = [f.result(timeout=120) for f in futs]
+        assert all(c.ok for c in comps)
+        _wait(lambda: router._scale_counts["scale_ups"] >= 1, timeout=30,
+              msg="no scale-up on a sustained burst")
+        # Idle now: the sustained-idle streak must retire a replica.
+        _wait(lambda: router._scale_counts["retired"] >= 1, timeout=30,
+              msg="no graceful retire on sustained idle")
+    finally:
+        summ = router.stop(timeout=60)
+    assert summ["ok"] == 24 == summ["requests"]
+    assert summ["scale"]["scale_ups"] >= 1
+    assert summ["scale"]["retired"] >= 1
+    rows = load_metrics_jsonl(str(tmp_path / "router.jsonl"))
+    snaps = [r for r in rows if r["event"] == "fleet_snapshot"]
+    assert snaps and all({"target", "replicas_ready", "scale"} <= set(sn)
+                         for sn in snaps)
+    assert max(sn["replicas_ready"] for sn in snaps) >= 2
+
+
+def test_router_reload_rolls_one_at_a_time_capacity_n_minus_1(tmp_path):
+    """``Router.reload`` drains and restarts replicas ONE at a time under
+    load: every request completes, the reload count matches the fleet, the
+    new argv carries the new checkpoint, and the fleet_snapshot timeline
+    never shows ready capacity below N−1 once the fleet is up."""
+    router = _router(tmp_path, _echo_cmd(delay=0.02), n=2,
+                     snapshot_interval_s=0.1).start()
+    try:
+        assert router.wait_ready(timeout=60)
+        stop_load = []
+        import threading
+
+        futs = []
+
+        def load():
+            rng = np.random.default_rng(17)
+            while not stop_load:
+                futs.append(router.submit(
+                    rng.integers(0, 7, size=3).astype(np.int32),
+                    max_new_tokens=4))
+                time.sleep(0.02)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        out = router.reload("new_params.ckpt", timeout_s=120)
+        stop_load.append(True)
+        t.join(timeout=10)
+        assert out["reloaded"] == [0, 1]
+        comps = [f.result(timeout=60) for f in futs]
+        assert all(c.ok for c in comps)
+        assert len(comps) > 0
+        with router._lock:
+            argv = list(router.replicas[0].fleet.procs[0].args)
+        assert "new_params.ckpt" in argv          # post-roll spawns carry it
+        assert router.replicas[0].state == "ready"
+        assert router.replicas[1].state == "ready"
+    finally:
+        summ = router.stop(timeout=60)
+    assert summ["ok"] == summ["requests"] == len(comps)
+    assert summ["scale"]["reloads"] == 2
+    rows = load_metrics_jsonl(str(tmp_path / "router.jsonl"))
+    snaps = [r for r in rows if r["event"] == "fleet_snapshot"]
+    # Capacity never below N-1: after the fleet first reached 2 ready, no
+    # snapshot shows fewer than 1 ready replica — the rolling-reload invariant.
+    ready = [sn["replicas_ready"] for sn in snaps]
+    first_full = next(i for i, v in enumerate(ready) if v == 2)
+    assert min(ready[first_full:]) >= 1
+    reloads = [r for r in rows if r["event"] == "scale"
+               and r.get("action") == "reload"]
+    assert len(reloads) == 2
+    assert all(r["checkpoint"] == "new_params.ckpt" for r in reloads)
+
+
+def test_router_echo_elastic_2_4_1_with_kill_zero_loss(tmp_path, monkeypatch):
+    """The acceptance shape on the echo tier: 2→4→1 under a mid-flight kill.
+    Every request completes token-identical to the deterministic expectation
+    (the echo analog of greedy idempotency), zero lost, zero orphan traces,
+    the killed replica restarts, and the retires are graceful."""
+    monkeypatch.setenv("RESILIENCE_FAULTS",
+                       f"kill:proc=1,step=5,flag={tmp_path / 'kill'}")
+    trace_dir = str(tmp_path / "trace")
+    router = _router(tmp_path, _echo_cmd(delay=0.04), n=2,
+                     min_replicas=1, max_replicas=4,
+                     trace_dir=trace_dir, snapshot_interval_s=0.1).start()
+    try:
+        assert router.wait_ready(timeout=60)
+        rng = np.random.default_rng(3)
+        reqs = [(rng.integers(0, 7, size=1 + i % 5).astype(np.int32), 6)
+                for i in range(24)]
+        futs = [router.submit(p, max_new_tokens=n) for p, n in reqs[:12]]
+        assert router.scale_up() is not None       # 2 -> 3
+        assert router.scale_up() is not None       # 3 -> 4
+        futs += [router.submit(p, max_new_tokens=n) for p, n in reqs[12:]]
+        assert router.wait_ready(timeout=60)
+        comps = [f.result(timeout=120) for f in futs]
+        assert all(c.ok for c in comps)            # zero lost
+        for (p, n), c in zip(reqs, comps):
+            np.testing.assert_array_equal(c.tokens, _echo_expected(p, n))
+        assert any(c.redispatches > 0 for c in comps)   # the kill landed
+        _wait(lambda: router.replicas[1].restarts >= 1, timeout=60,
+              msg="killed replica did not restart")
+        # 4 -> 1.
+        for _ in range(3):
+            assert router.scale_down() is not None
+        _wait(lambda: sum(r.state == "retired" for r in router.replicas) == 3,
+              msg="retires did not complete")
+    finally:
+        summ = router.stop(timeout=60)
+    assert summ["ok"] == 24 == summ["requests"]
+    assert summ["redispatches"] >= 1
+    assert summ["replica_restarts"] >= 1
+    assert summ["scale"] == {"scale_ups": 2, "scale_downs": 3, "retired": 3,
+                             "reloads": 0}
+    spans, _ = trace.read_spans([trace_dir])
+    tsumm = trace.summarize_traces(spans)
+    assert tsumm["traces"] == 24
+    assert tsumm["orphans"] == 0, tsumm["orphan_ids"]
+    # The scale actions are on the trace timeline (excluded from per-request
+    # accounting above, rendered by trace_report's fleet-lifecycle block).
+    assert len(trace.lifecycle_timeline(spans)) == 5
+
+
+# -----------------------------------------------------------------------------------------
+# Engine tier (slow, the CI elasticity-smoke job): jax replicas, token-identity
+# -----------------------------------------------------------------------------------------
+
+
+_TINY = dict(seq_len=16, levels=9, embed=16, layers=1, heads=2, slots=3)
+
+
+def _engine_cmd():
+    return ["-m", f"{PKG}.serving.replica",
+            "--num-levels", str(_TINY["levels"] - 1),
+            "--seq-len", str(_TINY["seq_len"]),
+            "--embed-dim", str(_TINY["embed"]),
+            "--num-layers", str(_TINY["layers"]),
+            "--num-heads", str(_TINY["heads"]),
+            "--num-slots", str(_TINY["slots"]),
+            "--max-pending", "8", "--seed", "0",
+            "--heartbeat-interval-s", "0.02"]
+
+
+def _tiny_workload(n=10, seed=11):
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for _ in range(n):
+        p = rng.integers(0, _TINY["levels"] - 1,
+                         size=int(rng.integers(1, 8))).astype(np.int32)
+        reqs.append((p, int(rng.integers(2, 7))))
+    return reqs
+
+
+def _uninterrupted_reference(reqs):
+    import jax
+    import jax.numpy as jnp
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import lm
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        ContinuousBatchingEngine,
+        Request,
+    )
+
+    model = lm.TransformerLM(vocab_size=_TINY["levels"],
+                             seq_len=_TINY["seq_len"],
+                             embed_dim=_TINY["embed"],
+                             num_layers=_TINY["layers"],
+                             num_heads=_TINY["heads"])
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, model.seq_len), jnp.int32))["params"]
+    engine = ContinuousBatchingEngine(model, params, num_slots=_TINY["slots"])
+    comps = engine.run([Request(prompt=p, max_new_tokens=n, request_id=i)
+                        for i, (p, n) in enumerate(reqs)])
+    return {c.request.request_id: np.asarray(c.tokens) for c in comps}
+
+
+@pytest.mark.slow
+def test_fleet_elastic_2_4_1_kill_mid_decode_token_identical(
+        tmp_path, monkeypatch):
+    """The PR 9 acceptance gate on real engines: a 2→4→1 elasticity run with
+    one replica hard-killed MID-DECODE completes every request with greedy
+    output token-identical to an uninterrupted single-engine run — zero lost,
+    zero orphan traces — and every scale-down retires gracefully."""
+    monkeypatch.setenv("RESILIENCE_FAULTS",
+                       f"kill:proc=1,step=4,flag={tmp_path / 'kill'}")
+    reqs = _tiny_workload(30)
+    ref = _uninterrupted_reference(reqs)
+    trace_dir = str(tmp_path / "trace")
+    router = _router(tmp_path, _engine_cmd(), n=2, min_replicas=1,
+                     max_replicas=4, connect_timeout_s=300.0,
+                     trace_dir=trace_dir, snapshot_interval_s=0.25,
+                     drain_timeout_s=60.0).start()
+    try:
+        assert router.wait_ready(timeout=300)
+        futs = [router.submit(p, max_new_tokens=n) for p, n in reqs[:15]]
+        assert router.scale_up() is not None       # 2 -> 3
+        assert router.scale_up() is not None       # 3 -> 4
+        futs += [router.submit(p, max_new_tokens=n) for p, n in reqs[15:]]
+        assert router.wait_ready(timeout=300)      # all four compiled + ready
+        assert sum(r.state == "ready" for r in router.replicas) == 4
+        comps = [f.result(timeout=300) for f in futs]
+        _wait(lambda: router.replicas[1].restarts >= 1, timeout=120,
+              msg="killed replica did not restart")
+        for _ in range(3):                         # 4 -> 1
+            assert router.scale_down() is not None
+        _wait(lambda: sum(r.state == "retired" for r in router.replicas) == 3,
+              timeout=120, msg="retires did not complete")
+    finally:
+        summ = router.stop(timeout=120)
+    assert all(c.ok for c in comps)                # zero lost
+    assert summ["timeout"] == 0
+    for i, comp in enumerate(comps):
+        np.testing.assert_array_equal(comp.tokens, ref[i])   # greedy idempotency
+    assert summ["redispatches"] >= 1               # the kill landed on work
+    assert summ["scale"] == {"scale_ups": 2, "scale_downs": 3, "retired": 3,
+                             "reloads": 0}
+    spans, _ = trace.read_spans([trace_dir])
+    tsumm = trace.summarize_traces(spans)
+    assert tsumm["traces"] == 30
+    assert tsumm["orphans"] == 0, tsumm["orphan_ids"]
+
+
+# -----------------------------------------------------------------------------------------
+# Report tooling
+# -----------------------------------------------------------------------------------------
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_telemetry_report_renders_scale_timeline(tmp_path, capsys):
+    """The report joins scale events against the fleet_snapshot series and
+    surfaces replicas p50/max + scale events as A-vs-B rows."""
+    path = tmp_path / "router.jsonl"
+    rows = [
+        {"event": "fleet_snapshot", "t_s": 0.1, "queue":
+         {"depth": 9, "oldest_age_s": 0.8}, "utilization": 1.0,
+         "target": 1, "replicas_ready": 1, "inflight": 2, "capacity_up": 2,
+         "redispatches": 0, "restarts": 0, "per_replica": []},
+        {"event": "scale", "t_s": 0.2, "action": "up", "replica": 1,
+         "target": 2, "reason": "autoscale"},
+        {"event": "fleet_snapshot", "t_s": 0.3, "queue":
+         {"depth": 0, "oldest_age_s": None}, "utilization": 0.0,
+         "target": 2, "replicas_ready": 2, "inflight": 0, "capacity_up": 4,
+         "redispatches": 0, "restarts": 0, "per_replica": []},
+        {"event": "scale", "t_s": 0.4, "action": "down", "replica": 1,
+         "target": 1, "reason": "autoscale"},
+        {"event": "router_summary", "replicas": 2, "target": 1,
+         "scale": {"scale_ups": 1, "scale_downs": 1, "retired": 1,
+                   "reloads": 0},
+         "scale_events": 2, "replicas_ready_p50": 1, "replicas_ready_max": 2,
+         "replicas_ready_min": 1, "requests": 5, "ok": 5, "timeout": 0,
+         "failed": 0, "redispatches": 0, "redispatched_requests": 0,
+         "duplicates": 0, "affinity_hits": 0, "new_tokens": 40,
+         "affinity": True, "wall_s": 1.0, "tokens_per_s": 40.0,
+         "affinity_rate": 0.0, "replica_restarts": 0, "per_replica": [],
+         "prefix_cache": None, "queue": {"depth": 0}, "ttft_s": None,
+         "e2e_s": None, "queue_wait_s": None},
+    ]
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    rep = _load_tool("telemetry_report")
+    s = rep.summarize(str(path))
+    assert s["scale_events"] == 2
+    assert s["scale_ups"] == 1 and s["scale_downs"] == 1
+    assert s["replicas_p50"] == 1.5 and s["replicas_max"] == 2
+    # The up action joined the snapshot the autoscaler saw (depth 9, util 1).
+    tl = s["scale_timeline"]
+    assert tl[0]["action"] == "up" and tl[0]["queue_depth"] == 9
+    assert tl[1]["action"] == "down" and tl[1]["queue_depth"] == 0
+    assert not s.get("unknown_events")    # "scale" is a known event kind
+    rep.print_summary(s)
+    out = capsys.readouterr().out
+    assert "scale timeline: 1 up, 1 down" in out
+    assert "replica 1 -> target 2 [autoscale]" in out
+    # A-vs-B rows exist for the elasticity metrics.
+    keys = [k for _, k in rep.COMPARE_ROWS]
+    assert {"replicas_p50", "replicas_max", "scale_events"} <= set(keys)
+    rep.print_comparison([s, s])
+    out = capsys.readouterr().out
+    assert "replicas p50" in out and "scale events" in out

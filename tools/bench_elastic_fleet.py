@@ -1,0 +1,390 @@
+"""Elastic-fleet acceptance harness: 2→4→1 under kill, rolling reload, warm A/B.
+
+Runs the three PR-9 acceptance legs against REAL jax CPU replicas and writes
+the committed artifact (``bench_results/elastic_fleet_cpu/``):
+
+1. **elastic_kill** — a 2→4→1 replica elasticity run (two ``scale_up``s
+   mid-load, three graceful ``scale_down``s at the tail) with replica 1
+   hard-killed MID-DECODE by fault injection. Gate: every request completes
+   with greedy output token-identical to an uninterrupted single-engine run
+   of the same workload (zero lost), and the traced run has zero orphan
+   traces. The scale-event timeline joined against the ``fleet_snapshot``
+   series goes to ``timeline.json``.
+2. **reload** — a live ``Router.reload(new_checkpoint)`` under continuous
+   load. Gate: every request ok, both replicas rolled, and the
+   ``fleet_snapshot`` timeline never shows ready capacity below N−1 once the
+   fleet is up.
+3. **warm_ab** — scale-up warm-start A/B on a shared-prefix workload:
+   ``warm_prefixes=8`` (the new replica replays the fleet's hot prefixes
+   before going ready) vs ``warm_prefixes=0`` (cold). Gate: the new
+   replica's post-ready prefix-cache hit rate is strictly higher warm than
+   cold (the replay's own compulsory misses are excluded by the replica —
+   counters reset after warm).
+
+Exits nonzero if any gate fails — the CI ``elasticity-smoke`` contract.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/bench_elastic_fleet.py \\
+        --out bench_results/elastic_fleet_cpu
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+PKG = "csed_514_project_distributed_training_using_pytorch_tpu"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# One tiny-model config for every leg: small enough that a replica compiles in
+# seconds on CPU, big enough that prompts/prefixes exercise chunked prefill.
+TINY = dict(seq_len=48, levels=9, embed=16, layers=1, heads=2, slots=2,
+            max_pending=2)
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (f"{REPO}:{env['PYTHONPATH']}"
+                         if env.get("PYTHONPATH") else REPO)
+    return env
+
+
+def _engine_cmd(prefix_cache: int = 0):
+    cmd = ["-m", f"{PKG}.serving.replica",
+           "--num-levels", str(TINY["levels"] - 1),
+           "--seq-len", str(TINY["seq_len"]),
+           "--embed-dim", str(TINY["embed"]),
+           "--num-layers", str(TINY["layers"]),
+           "--num-heads", str(TINY["heads"]),
+           "--num-slots", str(TINY["slots"]),
+           "--max-pending", str(TINY["max_pending"]),
+           "--seed", "0", "--heartbeat-interval-s", "0.02"]
+    if prefix_cache:
+        cmd += ["--prefix-cache", str(prefix_cache),
+                "--prefill-chunks", "8,32"]
+    return cmd
+
+
+def _router(out_dir, name, cmd, n, **kw):
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.router import (
+        Router,
+    )
+
+    kw.setdefault("heartbeat_dir", os.path.join(out_dir, f"hb_{name}"))
+    kw.setdefault("heartbeat_timeout_s", 30.0)
+    kw.setdefault("backoff_s", 0.2)
+    kw.setdefault("connect_timeout_s", 300.0)
+    kw.setdefault("drain_timeout_s", 60.0)
+    kw.setdefault("telemetry", os.path.join(out_dir, f"{name}.jsonl"))
+    return Router(cmd, num_replicas=n, env=_env(), **kw)
+
+
+def _workload(n=40, seed=11):
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for _ in range(n):
+        p = rng.integers(0, TINY["levels"] - 1,
+                         size=int(rng.integers(1, 12))).astype(np.int32)
+        reqs.append((p, int(rng.integers(2, 8))))
+    return reqs
+
+
+def _reference(reqs):
+    """The same workload through ONE in-process engine, no faults."""
+    import jax
+    import jax.numpy as jnp
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import lm
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        ContinuousBatchingEngine,
+        Request,
+    )
+
+    model = lm.TransformerLM(vocab_size=TINY["levels"],
+                             seq_len=TINY["seq_len"], embed_dim=TINY["embed"],
+                             num_layers=TINY["layers"],
+                             num_heads=TINY["heads"])
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, model.seq_len), jnp.int32))["params"]
+    engine = ContinuousBatchingEngine(model, params, num_slots=TINY["slots"])
+    comps = engine.run([Request(prompt=p, max_new_tokens=m, request_id=i)
+                        for i, (p, m) in enumerate(reqs)])
+    return {c.request.request_id: np.asarray(c.tokens) for c in comps}
+
+
+def leg_elastic_kill(out_dir: str) -> dict:
+    """2→4→1 with replica 1 killed mid-decode; token-identity gate."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        trace,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
+        read_jsonl,
+    )
+
+    print("== leg 1: 2→4→1 elasticity under kill-mid-decode")
+    reqs = _workload(40)
+    ref = _reference(reqs)
+    trace_dir = os.path.join(out_dir, "trace_elastic")
+    env_key = "RESILIENCE_FAULTS"
+    old = os.environ.get(env_key)
+    os.environ[env_key] = (f"kill:proc=1,step=4,"
+                           f"flag={os.path.join(out_dir, 'kill_flag')}")
+    try:
+        router = _router(out_dir, "elastic", _engine_cmd(), 2,
+                         min_replicas=1, max_replicas=4,
+                         trace_dir=trace_dir,
+                         snapshot_interval_s=0.2).start()
+        try:
+            assert router.wait_ready(timeout=300), "fleet never came up"
+            t0 = time.monotonic()
+            futs = [router.submit(p, max_new_tokens=m) for p, m in reqs[:20]]
+            assert router.scale_up() is not None          # 2 -> 3
+            assert router.scale_up() is not None          # 3 -> 4
+            futs += [router.submit(p, max_new_tokens=m) for p, m in reqs[20:]]
+            assert router.wait_ready(timeout=300), "scale-up never ready"
+            peak_ready = sum(r.state == "ready" for r in router.replicas)
+            comps = [f.result(timeout=300) for f in futs]
+            deadline = time.monotonic() + 120
+            while (router.replicas[1].restarts < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            for _ in range(3):                            # 4 -> 1
+                assert router.scale_down() is not None
+            deadline = time.monotonic() + 120
+            while (sum(r.state == "retired" for r in router.replicas) < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            wall = time.monotonic() - t0
+        finally:
+            summ = router.stop(timeout=120)
+    finally:
+        if old is None:
+            os.environ.pop(env_key, None)
+        else:
+            os.environ[env_key] = old
+    lost = sum(not c.ok for c in comps)
+    mismatched = sum(
+        not np.array_equal(np.asarray(c.tokens), ref[i])
+        for i, c in enumerate(comps))
+    spans, _ = trace.read_spans([trace_dir])
+    tsumm = trace.summarize_traces(spans)
+    rows = read_jsonl(os.path.join(out_dir, "elastic.jsonl"))
+    timeline = {
+        "snapshots": [
+            {"t_s": r.get("t_s"), "queue_depth": (r.get("queue") or {})
+             .get("depth"), "oldest_age_s": (r.get("queue") or {})
+             .get("oldest_age_s"), "utilization": r.get("utilization"),
+             "target": r.get("target"),
+             "replicas_ready": r.get("replicas_ready")}
+            for r in rows if r.get("event") == "fleet_snapshot"],
+        "scale_events": [
+            {k: r.get(k) for k in ("t_s", "action", "replica", "target",
+                                   "reason")}
+            for r in rows if r.get("event") == "scale"],
+    }
+    with open(os.path.join(out_dir, "timeline.json"), "w") as f:
+        json.dump(timeline, f, indent=1)
+    leg = {
+        "requests": len(comps), "lost": lost,
+        "token_mismatches": mismatched,
+        "peak_ready_replicas": peak_ready,
+        "scale": summ["scale"],
+        "redispatches": summ["redispatches"],
+        "replica_restarts": summ["replica_restarts"],
+        "duplicates": summ["duplicates"],
+        "traces": tsumm["traces"], "orphan_traces": tsumm["orphans"],
+        "lifecycle_events": len(trace.lifecycle_timeline(spans)),
+        "wall_s": round(wall, 3),
+        "ok": (lost == 0 and mismatched == 0 and peak_ready == 4
+               and summ["scale"]["retired"] == 3
+               and summ["redispatches"] >= 1
+               and tsumm["orphans"] == 0),
+    }
+    print(f"   {len(comps)} requests, {lost} lost, {mismatched} token "
+          f"mismatches vs single-engine reference; peak {peak_ready} ready; "
+          f"scale {summ['scale']}; {summ['redispatches']} redispatches; "
+          f"{tsumm['orphans']} orphan traces -> "
+          f"{'OK' if leg['ok'] else 'FAIL'}")
+    return leg
+
+
+def leg_reload(out_dir: str) -> dict:
+    """Live rolling reload under load; capacity-never-below-N-1 gate."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
+        read_jsonl,
+    )
+
+    print("== leg 2: rolling Router.reload under load")
+    # A REAL checkpoint to roll onto: the same architecture with fresh params
+    # (seed 1) — the "new params" the fleet picks up without dropping traffic.
+    import jax
+    import jax.numpy as jnp
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import lm
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        checkpoint,
+    )
+
+    model = lm.TransformerLM(vocab_size=TINY["levels"],
+                             seq_len=TINY["seq_len"], embed_dim=TINY["embed"],
+                             num_layers=TINY["layers"],
+                             num_heads=TINY["heads"])
+    new_params = model.init({"params": jax.random.PRNGKey(1)},
+                            jnp.zeros((1, model.seq_len), jnp.int32))["params"]
+    ckpt = os.path.join(out_dir, "rolled_params.msgpack")
+    checkpoint.save_params(ckpt, new_params)
+
+    router = _router(out_dir, "reload", _engine_cmd(), 2,
+                     snapshot_interval_s=0.1).start()
+    try:
+        assert router.wait_ready(timeout=300), "fleet never came up"
+        stop_load = []
+        futs = []
+        rng = np.random.default_rng(17)
+
+        def load():
+            while not stop_load:
+                try:
+                    futs.append(router.submit(
+                        rng.integers(0, TINY["levels"] - 1,
+                                     size=4).astype(np.int32),
+                        max_new_tokens=4))
+                except Exception:     # router stopping under a failed roll
+                    return
+                time.sleep(0.05)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        try:
+            time.sleep(0.5)
+            out = router.reload(ckpt, timeout_s=300)
+        finally:
+            stop_load.append(True)
+            t.join(timeout=10)
+        comps = [f.result(timeout=120) for f in futs]
+    finally:
+        summ = router.stop(timeout=120)
+    rows = read_jsonl(os.path.join(out_dir, "reload.jsonl"))
+    ready = [r["replicas_ready"] for r in rows
+             if r.get("event") == "fleet_snapshot"]
+    first_full = next((i for i, v in enumerate(ready) if v == 2), None)
+    min_ready = min(ready[first_full:]) if first_full is not None else None
+    lost = sum(not c.ok for c in comps)
+    leg = {
+        "requests": len(comps), "lost": lost,
+        "reloaded": out["reloaded"], "reload_wall_s": round(out["wall_s"], 3),
+        "snapshots": len(ready), "min_ready_after_full": min_ready,
+        "ok": (lost == 0 and out["reloaded"] == [0, 1]
+               and min_ready is not None and min_ready >= 1),
+    }
+    print(f"   {len(comps)} requests during roll, {lost} lost; "
+          f"reloaded {out['reloaded']} in {out['wall_s']:.1f}s; ready-replica "
+          f"timeline min {min_ready} (N-1 = 1) over {len(ready)} snapshots "
+          f"-> {'OK' if leg['ok'] else 'FAIL'}")
+    return leg
+
+
+def _warm_run(out_dir: str, warm_prefixes: int) -> dict:
+    """One warm A/B side: build hot prefixes on replica 0, scale up, offer a
+    second wave, read the NEW replica's post-ready prefix-cache hit rate."""
+    name = f"warm{warm_prefixes}"
+    router = _router(out_dir, name, _engine_cmd(prefix_cache=8), 1,
+                     max_replicas=2, warm_prefixes=warm_prefixes).start()
+    rng = np.random.default_rng(23)
+    prefixes = [rng.integers(0, TINY["levels"] - 1, size=24).astype(np.int32)
+                for _ in range(6)]
+
+    def wave(per_prefix, tail, seed):
+        r2 = np.random.default_rng(seed)
+        w = []
+        for p in prefixes:
+            for _ in range(per_prefix):
+                suffix = r2.integers(0, TINY["levels"] - 1,
+                                     size=tail).astype(np.int32)
+                w.append(np.concatenate([p, suffix]))
+        return w
+
+    try:
+        assert router.wait_ready(timeout=300)
+        futs = [router.submit(p, max_new_tokens=3) for p in wave(1, 4, 5)]
+        [f.result(timeout=300) for f in futs]
+        idx = router.scale_up()
+        assert idx is not None
+        assert router.wait_ready(timeout=300)
+        warmed = router.replicas[idx].warmed
+        # The second wave: 3 requests per hot prefix, offered all at once so
+        # replica 0 (capacity 4) overflows and the new replica takes spill.
+        futs = [router.submit(p, max_new_tokens=3) for p in wave(3, 4, 9)]
+        comps = [f.result(timeout=300) for f in futs]
+        lost = sum(not c.ok for c in comps)
+    finally:
+        summ = router.stop(timeout=120)
+    per = {r["replica"]: r for r in summ["per_replica"]}
+    pc = ((per[idx].get("stats") or {}).get("engine") or {}).get(
+        "prefix_cache") or {}
+    rate = (pc["hits"] / pc["queries"]) if pc.get("queries") else None
+    return {"warm_prefixes": warm_prefixes, "warmed": warmed,
+            "new_replica": idx, "lost": lost,
+            "new_replica_queries": pc.get("queries"),
+            "new_replica_hits": pc.get("hits"),
+            "new_replica_hit_rate": rate}
+
+
+def leg_warm_ab(out_dir: str) -> dict:
+    """Warm-start vs cold-start scale-up on a shared-prefix workload."""
+    print("== leg 3: warm-start vs cold-start scale-up A/B")
+    warm = _warm_run(out_dir, 8)
+    cold = _warm_run(out_dir, 0)
+    ok = (warm["lost"] == 0 and cold["lost"] == 0
+          and warm["new_replica_hit_rate"] is not None
+          and (cold["new_replica_hit_rate"] is None
+               or warm["new_replica_hit_rate"]
+               > cold["new_replica_hit_rate"]))
+    leg = {"warm": warm, "cold": cold, "ok": ok}
+    print(f"   new-replica prefix hit rate: warm "
+          f"{warm['new_replica_hit_rate']} "
+          f"({warm['new_replica_hits']}/{warm['new_replica_queries']}, "
+          f"{warm['warmed']} prefixes replayed) vs cold "
+          f"{cold['new_replica_hit_rate']} "
+          f"({cold['new_replica_hits']}/{cold['new_replica_queries']}) -> "
+          f"{'OK' if ok else 'FAIL'}")
+    return leg
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    p.add_argument("--out", default="bench_results/elastic_fleet_cpu",
+                   help="artifact directory (summary.json, timeline.json)")
+    p.add_argument("--legs", default="kill,reload,warm",
+                   help="comma subset of kill,reload,warm")
+    args = p.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    legs = [l for l in args.legs.split(",") if l]
+    doc = {"config": TINY, "platform": os.environ.get("JAX_PLATFORMS", "")}
+    if "kill" in legs:
+        doc["elastic_kill"] = leg_elastic_kill(args.out)
+    if "reload" in legs:
+        doc["reload"] = leg_reload(args.out)
+    if "warm" in legs:
+        doc["warm_ab"] = leg_warm_ab(args.out)
+    ok = all(doc[k]["ok"] for k in ("elastic_kill", "reload", "warm_ab")
+             if k in doc)
+    doc["ok"] = ok
+    path = os.path.join(args.out, "summary.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"{'ALL GATES OK' if ok else 'GATE FAILURE'}; summary -> {path}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

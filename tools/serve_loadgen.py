@@ -108,15 +108,27 @@ def make_workload(args, vocab_size):
     return specs
 
 
-def run_open_loop(server, specs, rate, rng):
-    """Poisson arrivals at ``rate`` req/s; returns (futures, rejected_count)."""
+def run_open_loop(server, specs, rate, rng, *, pattern="poisson",
+                  burst_size=8, burst_idle_s=1.0):
+    """Open-loop arrivals; returns (futures, rejected_count).
+
+    ``pattern="poisson"`` is the classic memoryless stream at ``rate`` req/s.
+    ``pattern="burst"`` is the elasticity workload: ``burst_size`` requests
+    arrive back-to-back (an arrival spike that piles the router queue up and
+    ages its head — the autoscaler's scale-up signal), then ``burst_idle_s``
+    of silence (the valley where utilization falls and a sustained-idle fleet
+    earns a scale-down)."""
     from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
         QueueFull,
     )
 
     futures, rejected = [], 0
-    for prompt, new, sampling in specs:
-        time.sleep(float(rng.exponential(1.0 / rate)))
+    for i, (prompt, new, sampling) in enumerate(specs):
+        if pattern == "burst":
+            if i and i % burst_size == 0:
+                time.sleep(burst_idle_s)
+        else:
+            time.sleep(float(rng.exponential(1.0 / rate)))
         try:
             futures.append(server.submit(prompt, max_new_tokens=new,
                                          sampling=sampling))
@@ -275,6 +287,18 @@ def build_replica_command(args) -> list[str]:
     """The ``serving/replica.py`` argv mirroring this run's model/engine flags
     (the router appends --port/--replica-id/--heartbeat-dir per replica)."""
     pkg = "csed_514_project_distributed_training_using_pytorch_tpu"
+    if getattr(args, "echo", False):
+        # Jax-free replicas: the elasticity/router-mechanics smoke — the
+        # protocol, lifecycle, and scale paths are the same code, only the
+        # engine is a deterministic pure function.
+        cmd = ["-m", f"{pkg}.serving.replica", "--echo",
+               "--seq-len", str(args.seq_len),
+               "--num-levels", str(args.num_levels),
+               "--num-slots", str(args.num_slots),
+               "--max-pending", str(args.max_pending)]
+        if args.echo_delay_s:
+            cmd += ["--echo-delay-s", str(args.echo_delay_s)]
+        return cmd
     cmd = ["-m", f"{pkg}.serving.replica",
            "--seq-len", str(args.seq_len), "--num-levels", str(args.num_levels),
            "--embed-dim", str(args.embed_dim),
@@ -349,6 +373,13 @@ def main(argv: list[str] | None = None) -> int:
     f.add_argument("--affinity", choices=("on", "off"), default="on",
                    help="prefix-affinity routing vs least-loaded baseline "
                         "(the router A/B switch)")
+    f.add_argument("--echo", action="store_true",
+                   help="fleet mode: spawn jax-free --echo replicas "
+                        "(deterministic tokens, --echo-delay-s per token) — "
+                        "the router-mechanics/elasticity smoke workload")
+    f.add_argument("--echo-delay-s", type=float, default=0.0,
+                   help="echo replicas: per-token sleep (keeps work in "
+                        "flight so load actually accumulates)")
     f.add_argument("--replica-platform", default="cpu",
                    help="JAX_PLATFORMS for replica processes; '' = inherit "
                         "the environment (e.g. to put each replica's engine "
@@ -363,6 +394,34 @@ def main(argv: list[str] | None = None) -> int:
                    help="per-replica restart budget")
     f.add_argument("--backoff-s", type=float, default=0.5,
                    help="restart backoff base (exponential, capped)")
+    s = p.add_argument_group("elasticity (fleet mode)")
+    s.add_argument("--autoscale", choices=("on", "off"), default="off",
+                   help="drive scale_up/scale_down from the fleet_snapshot "
+                        "load signal (hysteresis policy below; needs "
+                        "--snapshot-interval-s > 0)")
+    s.add_argument("--min-replicas", type=int, default=0,
+                   help="scale-down floor (0 = --replicas, i.e. never shrink)")
+    s.add_argument("--max-replicas", type=int, default=0,
+                   help="scale-up cap (0 = --replicas when autoscaling, "
+                        "unbounded for manual scaling)")
+    s.add_argument("--scale-up-age-s", type=float, default=0.5,
+                   help="queue head older than this counts as overloaded")
+    s.add_argument("--scale-up-util", type=float, default=0.95,
+                   help="in-flight/capacity at/above this counts as overloaded")
+    s.add_argument("--scale-down-util", type=float, default=0.25,
+                   help="empty queue + utilization at/below this counts idle")
+    s.add_argument("--scale-sustain-up", type=int, default=2,
+                   help="consecutive overloaded snapshots before a scale-up")
+    s.add_argument("--scale-sustain-down", type=int, default=4,
+                   help="consecutive idle snapshots before a scale-down")
+    s.add_argument("--scale-cooldown-s", type=float, default=3.0,
+                   help="dead time after any scale action")
+    s.add_argument("--warm-prefixes", type=int, default=8,
+                   help="hot affinity prefixes a new replica replays before "
+                        "it is marked ready (0 = cold starts)")
+    s.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="how long a retiring/reloading replica may finish "
+                        "in-flight work before stragglers redispatch")
     g = p.add_argument_group("load")
     g.add_argument("--scenario", choices=("batch", "chat"), default="batch",
                    help="'batch' = independent requests (open/closed loop); "
@@ -377,6 +436,15 @@ def main(argv: list[str] | None = None) -> int:
     g.add_argument("--mode", choices=("open", "closed"), default="open")
     g.add_argument("--rate", type=float, default=8.0,
                    help="open loop: Poisson arrival rate, req/s")
+    g.add_argument("--arrival-pattern", choices=("poisson", "burst"),
+                   default="poisson",
+                   help="open loop: 'burst' sends --burst-size requests "
+                        "back-to-back then idles --burst-idle-s (the "
+                        "autoscaler exercise: spike -> grow, valley -> shrink)")
+    g.add_argument("--burst-size", type=int, default=8,
+                   help="burst pattern: requests per spike")
+    g.add_argument("--burst-idle-s", type=float, default=1.0,
+                   help="burst pattern: idle valley between spikes")
     g.add_argument("--concurrency", type=int, default=4,
                    help="closed loop: clients with one request in flight each")
     g.add_argument("--requests", type=int, default=32)
@@ -419,6 +487,9 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit("--sessions and --turns must be >= 1 in chat mode")
     if args.max_new_tokens < 1:
         raise SystemExit("--max-new-tokens must be >= 1")
+    if args.echo and args.replicas < 1:
+        raise SystemExit("--echo needs --replicas N (echo replicas are a "
+                         "fleet-mode workload)")
 
     vocab_size = args.num_levels + 1
     tracer = None
@@ -449,6 +520,21 @@ def main(argv: list[str] | None = None) -> int:
         env = dict(os.environ)
         env["PYTHONPATH"] = (f"{repo_root}:{env['PYTHONPATH']}"
                              if env.get("PYTHONPATH") else repo_root)
+        autoscale = None
+        if args.autoscale == "on":
+            from csed_514_project_distributed_training_using_pytorch_tpu.serving.autoscaler import (
+                AutoscalePolicy,
+            )
+
+            autoscale = AutoscalePolicy(
+                min_replicas=args.min_replicas or args.replicas,
+                max_replicas=args.max_replicas or args.replicas,
+                up_queue_age_s=args.scale_up_age_s,
+                up_utilization=args.scale_up_util,
+                down_utilization=args.scale_down_util,
+                sustain_up=args.scale_sustain_up,
+                sustain_down=args.scale_sustain_down,
+                cooldown_s=args.scale_cooldown_s)
         router = Router(
             build_replica_command(args), num_replicas=args.replicas,
             platform=args.replica_platform or None,
@@ -460,7 +546,12 @@ def main(argv: list[str] | None = None) -> int:
             heartbeat_timeout_s=args.heartbeat_timeout_s,
             max_restarts=args.max_restarts, backoff_s=args.backoff_s,
             telemetry=args.telemetry, trace_dir=args.trace_dir,
-            snapshot_interval_s=args.snapshot_interval_s, env=env)
+            snapshot_interval_s=args.snapshot_interval_s,
+            autoscale=autoscale,
+            min_replicas=args.min_replicas or None,
+            max_replicas=args.max_replicas or None,
+            warm_prefixes=args.warm_prefixes,
+            drain_timeout_s=args.drain_timeout_s, env=env)
         front = router.start()
         if not router.wait_ready(timeout=600):
             router.stop(drain=False)
@@ -492,7 +583,10 @@ def main(argv: list[str] | None = None) -> int:
             specs = make_workload(args, vocab_size)
             if args.mode == "open":
                 futures, rejected = run_open_loop(
-                    front, specs, args.rate, np.random.default_rng(args.seed + 1))
+                    front, specs, args.rate, np.random.default_rng(args.seed + 1),
+                    pattern=args.arrival_pattern,
+                    burst_size=args.burst_size,
+                    burst_idle_s=args.burst_idle_s)
             else:
                 futures, rejected = run_closed_loop(front, specs,
                                                     args.concurrency)
@@ -538,6 +632,15 @@ def main(argv: list[str] | None = None) -> int:
               f"({rs['redispatched_requests']} requests), "
               f"{rs['replica_restarts']} replica restart(s), "
               f"{rs['duplicates']} duplicate completion(s)")
+        sc = rs.get("scale") or {}
+        if rs.get("scale_events"):
+            print(f"elasticity: {sc.get('scale_ups', 0)} scale-up(s), "
+                  f"{sc.get('retired', 0)} graceful retire(s), "
+                  f"{sc.get('reloads', 0)} reload(s); "
+                  f"replicas ready p50 "
+                  f"{rs.get('replicas_ready_p50') or '-'} / max "
+                  f"{rs.get('replicas_ready_max') or '-'} "
+                  f"(target ended at {rs.get('target')})")
     else:
         occ = engine.slot_occupancy             # None when no step ever ran
         print(f"generated {new_tokens} tokens, {new_tokens / wall:.1f} tokens/s, "
@@ -620,6 +723,16 @@ def main(argv: list[str] | None = None) -> int:
             pc = rs.get("prefix_cache") or {}
             doc.update(
                 replicas=args.replicas, affinity=args.affinity,
+                echo=args.echo, autoscale=args.autoscale,
+                arrival_pattern=(args.arrival_pattern
+                                 if args.scenario == "batch"
+                                 and args.mode == "open" else None),
+                scale=rs.get("scale"),
+                scale_events=rs.get("scale_events"),
+                target=rs.get("target"),
+                replicas_ready_p50=rs.get("replicas_ready_p50"),
+                replicas_ready_max=rs.get("replicas_ready_max"),
+                replicas_ready_min=rs.get("replicas_ready_min"),
                 affinity_rate=rs["affinity_rate"],
                 redispatches=rs["redispatches"],
                 redispatched_requests=rs["redispatched_requests"],

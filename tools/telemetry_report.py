@@ -55,6 +55,7 @@ KNOWN_EVENTS = frozenset({
     "manifest", "compile", "epoch", "health", "mfu", "bench",
     "serve", "serve_config", "serve_summary", "prefill",
     "route", "replica", "router_config", "router_summary", "fleet_snapshot",
+    "scale",
     "checkpoint", "restart", "preempt", "supervise_summary",
     "plan", "autotune", "span",
     "train", "test",                      # loss-curve metrics.jsonl kinds
@@ -241,6 +242,13 @@ def summarize(path: str) -> dict:
         s.setdefault("serve_timeout", rsum.get("timeout"))
         s["serve_tokens_per_s"] = rsum.get("tokens_per_s")
         s["router_replicas"] = rsum.get("replicas")
+        s["router_target"] = rsum.get("target")
+        if rsum.get("scale_events") is not None:
+            s.setdefault("scale_events", rsum.get("scale_events"))
+        if rsum.get("replicas_ready_p50") is not None:
+            s.setdefault("replicas_p50", rsum.get("replicas_ready_p50"))
+            s.setdefault("replicas_max", rsum.get("replicas_ready_max"))
+            s.setdefault("replicas_min", rsum.get("replicas_ready_min"))
         s["affinity_rate"] = rsum.get("affinity_rate")
         s["redispatches"] = rsum.get("redispatches")
         s["duplicate_completions"] = rsum.get("duplicates")
@@ -275,6 +283,43 @@ def summarize(path: str) -> dict:
         s["snapshot_utilization_mean"] = (sum(utils_) / len(utils_)
                                           if utils_ else None)
         s["snapshot_utilization_max"] = max(utils_) if utils_ else None
+        ready = [sn.get("replicas_ready") for sn in snaps
+                 if sn.get("replicas_ready") is not None]
+        if ready:
+            s["replicas_p50"] = _median(ready)
+            s["replicas_max"] = max(ready)
+            s["replicas_min"] = min(ready)
+
+    # Scale timeline (serving/router.py scale_up/scale_down/reload events):
+    # each action joined against the nearest preceding fleet_snapshot, so the
+    # rendered timeline shows WHAT the autoscaler saw when it acted.
+    # Only realized transitions count (up/down/reload) — the stream also
+    # carries reload_drain bookkeeping lines, and counting those would make
+    # this disagree with router_summary's ups+downs+reloads in A-vs-B rows.
+    scales = [e for e in by_event.get("scale", [])
+              if e.get("action") in ("up", "down", "reload")]
+    if scales:
+        s["scale_events"] = len(scales)
+        s["scale_ups"] = sum(e.get("action") == "up" for e in scales)
+        s["scale_downs"] = sum(e.get("action") == "down" for e in scales)
+        s["scale_reloads"] = sum(e.get("action") == "reload" for e in scales)
+        timeline = []
+        for e in scales:
+            t = e.get("t_s")
+            before = [sn for sn in snaps
+                      if sn.get("t_s") is not None and t is not None
+                      and sn["t_s"] <= t]
+            sn = before[-1] if before else None
+            timeline.append({
+                "t_s": t, "action": e.get("action"),
+                "replica": e.get("replica"), "target": e.get("target"),
+                "reason": e.get("reason"),
+                "queue_depth": ((sn.get("queue") or {}).get("depth")
+                                if sn else None),
+                "utilization": sn.get("utilization") if sn else None,
+                "replicas_ready": sn.get("replicas_ready") if sn else None,
+            })
+        s["scale_timeline"] = timeline
 
     # Checkpoint traffic (utils/checkpoint.py savers + restores): how much resume
     # insurance the run paid for, and what it cost in wall time.
@@ -394,11 +439,30 @@ def print_summary(s: dict) -> None:
             print("   " + name.ljust(14)
                   + "".join(_fmt(v).rjust(12) for v in vals))
     if s.get("snapshots"):
+        reps = ""
+        if s.get("replicas_p50") is not None:
+            reps = (f"  replicas ready p50 {_fmt(s['replicas_p50'])} / "
+                    f"min {_fmt(s.get('replicas_min'))} / "
+                    f"max {_fmt(s.get('replicas_max'))}")
         print(f"   timeline: {s['snapshots']} fleet snapshots  "
               f"queue depth max {_fmt(s.get('snapshot_queue_depth_max'))}  "
               f"oldest age max {_fmt(s.get('snapshot_oldest_age_max_s'))}s  "
               f"utilization mean {_fmt(s.get('snapshot_utilization_mean'))} "
-              f"/ max {_fmt(s.get('snapshot_utilization_max'))}")
+              f"/ max {_fmt(s.get('snapshot_utilization_max'))}{reps}")
+    if s.get("scale_timeline"):
+        print(f"   scale timeline: {s.get('scale_ups', 0)} up, "
+              f"{s.get('scale_downs', 0)} down, "
+              f"{s.get('scale_reloads', 0)} reload")
+        for e in s["scale_timeline"]:
+            t = "-" if e["t_s"] is None else f"+{e['t_s']:.2f}s"
+            ctx = ""
+            if e.get("queue_depth") is not None:
+                ctx = (f"  (saw queue depth {e['queue_depth']}, "
+                       f"util {_fmt(e.get('utilization'))}, "
+                       f"{_fmt(e.get('replicas_ready'))} ready)")
+            print(f"     {t.rjust(9)}  {(e['action'] or '?').ljust(12)} "
+                  f"replica {e['replica']} -> target {e['target']}"
+                  + (f" [{e['reason']}]" if e.get("reason") else "") + ctx)
     if s.get("unknown_events"):
         print(f"   {s['unknown_events']} unrecognized events "
               f"(kinds: {', '.join(s['unknown_kinds'])}) — writer/reporter "
@@ -425,6 +489,9 @@ COMPARE_ROWS = [
     ("affinity hit rate", "affinity_rate"),
     ("redispatches", "redispatches"),
     ("replica restarts", "replica_restarts"),
+    ("replicas p50", "replicas_p50"),
+    ("replicas max", "replicas_max"),
+    ("scale events", "scale_events"),
     ("ttft_s p50", "serve_ttft_s_p50"),
     ("ttft_s p99", "serve_ttft_s_p99"),
     ("tpot_s p50", "serve_tpot_s_p50"),

@@ -51,6 +51,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.trace import (  # noqa: E402
     SEGMENTS,
     chrome_trace,
+    lifecycle_timeline,
     read_spans,
     reconcile_ttft,
     summarize_traces,
@@ -148,6 +149,22 @@ def main(argv: list[str] | None = None) -> int:
     print_segments(summary)
     print()
     print_reconciliation(reconcile_ttft(summary, events))
+
+    lifecycle = lifecycle_timeline(spans)
+    if lifecycle:
+        # The fleet's own history (scale_up/scale_down/reload), excluded from
+        # the per-request accounting above but rendered as its own timeline —
+        # offsets relative to the earliest REQUEST span so the scale actions
+        # line up with the traffic that caused them.
+        base = min((s["ts"] for s in spans
+                    if s.get("name") not in ("scale", "reload")),
+                   default=lifecycle[0]["ts"])
+        print(f"\nfleet lifecycle ({len(lifecycle)} scale/reload event(s)):")
+        for s in lifecycle:
+            attrs = "".join(f" {k}={s[k]}" for k in
+                            ("action", "replica", "target", "reason",
+                             "checkpoint") if s.get(k) not in (None, ""))
+            print(f"  +{(s['ts'] - base) * 1e3:8.1f}ms  {s['name']}{attrs}")
 
     if args.slowest > 0:
         traces = summary["by_trace"]
