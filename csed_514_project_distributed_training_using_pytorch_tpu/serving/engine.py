@@ -684,7 +684,9 @@ class ContinuousBatchingEngine:
         self._cache, tok = self._step_jit(
             self.params, self._cache, self._ids, self._t, fresh, self._prompt,
             self._prompt_len, self._temp, self._top_k, self._top_p, sub)
-        tok = np.asarray(tok)                        # the per-step host sync
+        # THE per-step host sync: one [num_slots] token fetch per decode tick,
+        # the design's single sanctioned round-trip (DESIGN.md §11).
+        tok = np.asarray(tok)   # graftlint: disable=host-sync-hazard
         now = time.monotonic()
         self.steps += 1
         self.slot_steps += self.num_active

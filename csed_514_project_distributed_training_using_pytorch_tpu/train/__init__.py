@@ -1,19 +1,42 @@
 """Training drivers (the reference's L4/L5 layers): jit-compiled step/epoch functions plus the
 three entry points — single-process (reference ``src/train.py``), distributed
-(``src/train_dist.py``), and the connectivity smoke test (``src/run1.py``/``src/run2.py``)."""
+(``src/train_dist.py``), and the connectivity smoke test (``src/run1.py``/``src/run2.py``).
 
-from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
-    TrainState,
-    create_train_state,
-    make_train_step,
-    make_epoch_fn,
-    make_eval_fn,
-)
+Lazy exports (PEP 562), same pattern as ``serving/__init__``: ``train.step``
+imports jax at module scope, but the backend-free fleet side (``serving/
+router.py``, ``resilience/supervisor.py``) imports ``train.launch.Fleet`` —
+pure stdlib process plumbing — and executing this ``__init__`` is part of that
+import. An eager ``from .step import ...`` here made every ``train.*`` import
+reach jax transitively, which graftlint's backend-purity checker caught when
+it first ran; the attribute shim below keeps ``train.TrainState`` working
+while charging jax's import only to the trainers that touch it.
+"""
 
-__all__ = [
+from __future__ import annotations
+
+_STEP_EXPORTS = (
     "TrainState",
     "create_train_state",
     "make_train_step",
     "make_epoch_fn",
     "make_eval_fn",
-]
+)
+
+__all__ = list(_STEP_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name in _STEP_EXPORTS:
+        from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+            step,
+        )
+
+        value = getattr(step, name)
+        globals()[name] = value      # cache: subsequent lookups skip __getattr__
+        return value
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -328,7 +328,10 @@ def main(config: LMConfig = LMConfig(), *,
         def sample_grid(filename: str, seed_offset: int, batch: int, **gen_kw):
             gen_params = (host_state.ema if host_state.ema is not None
                           else host_state.params)
-            ids = jax.jit(lambda key: lm_mod.generate(
+            # Cold path: runs once per figure AFTER training, and each call's
+            # closure (batch/gen_kw) differs — a cached wrapper would never
+            # be reused, so the per-call jit is sanctioned here.
+            ids = jax.jit(lambda key: lm_mod.generate(  # graftlint: disable=retrace-hazard
                 decode_model, gen_params, key, batch=batch,
                 temperature=config.temperature, top_k=config.top_k,
                 top_p=config.top_p, **gen_kw))(

@@ -1,0 +1,74 @@
+"""THE telemetry event-kind registry: every JSONL event kind this repo emits.
+
+One owner for the schema surface that PR 8's drift footer checks against. Before
+this module existed, ``tools/telemetry_report.py::KNOWN_EVENTS`` was a hand-kept
+frozenset that had to be updated every time a writer grew a new ``"event"`` kind
+— the exact schema-drift failure mode the footer exists to surface, one hop
+earlier. Now:
+
+- every emitter's kind must appear here (enforced statically by the
+  ``telemetry-schema`` checker in ``tools/graftlint`` — an ``{"event": "..."}``
+  literal anywhere in the package or tools with a kind not in this registry is
+  a lint error, so a writer cannot drift from the report tools at commit time);
+- ``tools/telemetry_report.py::KNOWN_EVENTS`` is DERIVED from this module, so
+  the footer can never disagree with the emitters' sanctioned vocabulary.
+
+Kinds map to a one-line producer note (kept next to the kind so adding an event
+forces writing down who emits it). The full field-level schemas live with the
+producers — ``utils/telemetry.py`` event helpers, ``serving/router.py``,
+``resilience/supervisor.py``, ``utils/trace.py`` — this registry pins only the
+``"event"`` vocabulary, the key the readers dispatch on.
+
+This module is stdlib-only and must stay backend-free: ``tools/graftlint``
+reads it (by AST, never by import) and the report CLIs import it; neither may
+pay for — let alone initialize — a jax backend.
+"""
+
+from __future__ import annotations
+
+# kind -> producer (one line). A PURE dict literal: tools/graftlint extracts the
+# keys by parsing this file's AST (no import, no jax), so computed keys,
+# unpacking, or concatenation here would be invisible to the lint gate.
+EVENT_KINDS: dict[str, str] = {
+    # -- training/bench telemetry (utils/telemetry.py helpers) ------------------
+    "manifest": "once per run: config/mesh/device/version snapshot",
+    "compile": "AOT compile timing + cost_analysis of one program",
+    "epoch": "per-epoch wall/execute/eval/data split + losses",
+    "health": "per-epoch grad-norm/loss accumulators (train/step.py carry)",
+    "mfu": "steady-state achieved FLOPs and HBM bytes vs chip peak",
+    "bench": "one bench*.py measurement line",
+    # -- serving: engine/server (utils/telemetry.py serve helpers) --------------
+    "serve": "one served request: TTFT/TPOT/queue-wait/e2e (serving/server.py)",
+    "serve_config": "once per serving run: engine/model knobs (serving/server.py)",
+    "serve_summary": "once per serving run at drain: aggregates + percentiles",
+    "prefill": "one completed prompt prefill: chunks/tokens/cache-hit/wall",
+    # -- serving: fleet router (serving/router.py via utils/jsonl.py) -----------
+    "route": "one routed request: replica, affinity, redispatches, finish",
+    "replica": "replica lifecycle transition: start/fail/restart/dead",
+    "router_config": "once per router run: fleet shape + knobs",
+    "router_summary": "once per router run at drain: fleet-wide counts",
+    "fleet_snapshot": "periodic load signal: queue depth/age, per-replica occupancy",
+    "scale": "autoscaler action: up/down/reload (+reload_drain bookkeeping)",
+    # -- resilience (resilience/supervisor.py, utils/checkpoint.py) -------------
+    "checkpoint": "one checkpoint save/restore: op/kind/bytes/wall",
+    "restart": "supervisor restart: attempt, crash/hung/timeout reason, backoff",
+    "preempt": "cooperative SIGTERM stop at an epoch boundary (exit 75)",
+    "supervise_summary": "once per supervised run: final status + attempts",
+    # -- planner (plan/) --------------------------------------------------------
+    "plan": "once per --plan run: chosen layout + predicted cost",
+    "autotune": "one empirically trialed candidate: predicted vs measured",
+    # -- distributed tracing (utils/trace.py) -----------------------------------
+    "span": "one trace span (rendered by tools/trace_report.py, passed over here)",
+    # -- loss-curve metrics.jsonl kinds (utils/metrics.py history rows) ---------
+    "train": "per-epoch train loss row (reference-parity loss curve)",
+    "test": "per-epoch test loss/accuracy row (reference-parity loss curve)",
+}
+
+# The derived set the report tools dispatch on (tools/telemetry_report.py
+# re-exports this as its KNOWN_EVENTS).
+KNOWN_EVENTS: frozenset[str] = frozenset(EVENT_KINDS)
+
+
+def describe(kind: str) -> str | None:
+    """Producer note for ``kind``, or None for an unregistered kind."""
+    return EVENT_KINDS.get(kind)

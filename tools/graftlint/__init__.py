@@ -1,0 +1,58 @@
+"""graftlint: the repo's invariants as code — an AST/import-graph lint pass.
+
+Every review-hardening list from PR 6 through PR 9 re-broke the same few
+invariant classes: a jax import leaking into a backend-free module, an
+unguarded ``Future.set_result`` resolve race, a telemetry event kind the report
+tools don't know, a writer missing its process-0 gate, a host sync slipping
+into a decode hot loop, a jit call site that retraces per request. Each of
+these is a *convention* the code depends on but nothing enforced — the class
+of failure arxiv 2204.06514 (PAPERS.md) says must be mechanically checked,
+not remembered. This package checks them at commit time:
+
+- ``core``      ``Finding``/``Module`` types, ``# graftlint: disable=`` pragma
+                parsing, the ``Checker`` base API
+- ``graph``     module discovery + the transitive import graph (top-level vs
+                lazy edges, parent-package ``__init__`` edges)
+- ``rules``     the house-rule configuration: which modules are declared
+                backend-free, which functions are hot loops, which trainer
+                modules must gate writes
+- ``checkers``  the six repo-specific checkers (see ``checkers/__init__.py``)
+- ``baseline``  the committed grandfathered-findings file (ships empty: every
+                true finding on the current tree was fixed in the PR that
+                introduced this tool)
+- ``cli``       ``python -m tools.graftlint [--json]`` — exit 0 clean, 1 on
+                any non-baselined finding, 2 on usage/internal error
+
+Deliberately stdlib-only and import-free with respect to the repo: graftlint
+*parses* the tree (including ``utils/telemetry_events.py``, the event-kind
+registry) and never imports it, so the CI gate runs in seconds on a bare
+Python with no jax/flax/numpy installed and can never initialize a backend.
+
+Run it::
+
+    python -m tools.graftlint            # human findings, file:line:col
+    python -m tools.graftlint --json     # machine-readable (CI artifact)
+
+Suppress a single sanctioned line with a trailing
+``# graftlint: disable=<check>`` (a reason comment next to it is house style);
+suppress a whole file with ``# graftlint: disable-file=<check>`` on its own
+line. DESIGN.md §19 documents each checker and how to add one.
+"""
+
+from tools.graftlint.baseline import Baseline, load_baseline
+from tools.graftlint.checkers import ALL_CHECKERS
+from tools.graftlint.core import Checker, Finding, Module
+from tools.graftlint.graph import ImportGraph, build_graph
+from tools.graftlint.runner import run_lint
+
+__all__ = [
+    "ALL_CHECKERS",
+    "Baseline",
+    "Checker",
+    "Finding",
+    "ImportGraph",
+    "Module",
+    "build_graph",
+    "load_baseline",
+    "run_lint",
+]

@@ -1,0 +1,7 @@
+"""Entry point: ``python -m tools.graftlint``."""
+
+import sys
+
+from tools.graftlint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,66 @@
+"""The committed grandfathered-findings file (``tools/graftlint/baseline.json``).
+
+A lint gate that lands red is a gate people turn off — so a new checker with
+pre-existing findings lands GREEN by baselining them: the tool subtracts
+baselined findings from its output, and the gate only fails on NEW ones. The
+file is committed, reviewed, and expected to shrink; this repo's ships EMPTY
+(every true finding on the tree the tool first ran against was fixed in the
+same PR), which is the healthy steady state.
+
+Matching is by ``(check, path, message)`` — line numbers are deliberately
+excluded so a grandfathered finding does not resurface because unrelated code
+above it moved. A baseline entry that no longer matches anything is reported
+as stale (``--json`` carries it; text mode prints a note): baselines must not
+silently rot into dead weight.
+
+``--update-baseline`` rewrites the file from the current findings — an
+explicit, diff-reviewed act, never something the gate does on its own.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+from tools.graftlint.core import Finding
+
+
+@dataclasses.dataclass
+class Baseline:
+    path: str
+    entries: list[dict]
+
+    def split(self, findings: list[Finding]
+              ) -> tuple[list[Finding], list[Finding], list[dict]]:
+        """``(new, baselined, stale_entries)``."""
+        keys = {(e.get("check", ""), e.get("path", ""), e.get("message", ""))
+                for e in self.entries}
+        new = [f for f in findings if f.baseline_key not in keys]
+        old = [f for f in findings if f.baseline_key in keys]
+        live = {f.baseline_key for f in old}
+        stale = [e for e in self.entries
+                 if (e.get("check", ""), e.get("path", ""),
+                     e.get("message", "")) not in live]
+        return new, old, stale
+
+    def save(self, findings: list[Finding]) -> None:
+        payload = [{"check": f.check, "path": f.path, "message": f.message}
+                   for f in sorted(findings)]
+        with open(self.path, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+
+def default_baseline_path(root: str) -> str:
+    return os.path.join(root, "tools", "graftlint", "baseline.json")
+
+
+def load_baseline(path: str) -> Baseline:
+    if not os.path.exists(path):
+        return Baseline(path=path, entries=[])
+    with open(path, encoding="utf-8") as fh:
+        entries = json.load(fh)
+    if not isinstance(entries, list):
+        raise ValueError(f"baseline {path} must be a JSON list")
+    return Baseline(path=path, entries=entries)

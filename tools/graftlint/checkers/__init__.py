@@ -1,0 +1,30 @@
+"""The checker registry: one module per house rule, assembled here.
+
+Adding a checker (DESIGN.md §19): write a ``Checker`` subclass in a new module
+under ``checkers/``, give it a unique kebab-case ``name`` (that name is the
+pragma/baseline/CLI handle), import it below, append an instance to
+``ALL_CHECKERS``, and add a true-positive + false-positive fixture pair to
+``tests/test_graftlint.py``. The meta-test then holds the whole repo to it.
+"""
+
+from __future__ import annotations
+
+from tools.graftlint.checkers.backend_purity import BackendPurity
+from tools.graftlint.checkers.host_sync import HostSyncHazard
+from tools.graftlint.checkers.process0_gate import Process0Gate
+from tools.graftlint.checkers.resolve_guard import ResolveGuard
+from tools.graftlint.checkers.retrace import RetraceHazard
+from tools.graftlint.checkers.telemetry_schema import TelemetrySchema
+
+ALL_CHECKERS = (
+    BackendPurity(),
+    ResolveGuard(),
+    TelemetrySchema(),
+    Process0Gate(),
+    HostSyncHazard(),
+    RetraceHazard(),
+)
+
+CHECKS_BY_NAME = {c.name: c for c in ALL_CHECKERS}
+
+__all__ = ["ALL_CHECKERS", "CHECKS_BY_NAME"]

@@ -1,0 +1,242 @@
+"""host-sync-hazard: no device→host syncs on traced values in the hot loops.
+
+The whole performance argument of this repo is "the step is a program": the
+epoch is one compiled scan, decode is one fixed-shape program per tick, and
+the host only ever forces a device value when the design says so (the engine's
+single per-step token fetch). The reference's per-step ``loss.item()``
+(src/train_dist.py:85) is the anti-pattern — one blocking round-trip per
+step, serializing device against host.
+
+This checker runs a small, function-local DEVICE-TAINT analysis over the
+configured hot regions (rules.HOT_REGIONS):
+
+- **sources** — calls through a ``*_jit``-suffixed binding (``self._step_jit``,
+  ``prefill_jits[size]``), an immediately-invoked ``jax.jit(...)``, and — in
+  ``"scan-bodies"`` mode — every parameter of a function passed to
+  ``lax.scan`` (inside the traced body, everything is a tracer).
+- **propagation** — assignment from a tainted name/subscript taints the
+  target; tuple unpacking taints every element; reassignment from an untainted
+  expression clears.
+- **sinks** — ``float()``/``int()``/``bool()`` on a tainted value, ``.item()``
+  / ``.tolist()``, ``np.asarray``/``np.array``, ``jax.device_get``. Each sink
+  on tainted data is one host sync per loop iteration: a finding.
+
+A sanctioned sync (the engine's one token fetch per decode step) carries a
+line pragma with its justification; everything else is a regression of the
+one-program design. The analysis is deliberately local and conservative-
+in-both-directions: attributes are not tracked (storing to ``self._cache``
+escapes), so a checker miss is possible — but a flagged line is a real sync.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import rules
+from tools.graftlint.core import Checker, Finding, Module, dotted_name
+
+SINK_BUILTINS = {"float", "int", "bool"}
+SINK_METHODS = {"item", "tolist"}
+SINK_NP_ATTRS = {"asarray", "array"}
+
+
+def _is_device_call(node: ast.Call) -> bool:
+    """Call whose result lives on device: ``*_jit(...)`` / ``*_jits[...](...)``
+    bindings and immediately-invoked ``jax.jit(...)``."""
+    func = node.func
+    if isinstance(func, ast.Name) and func.id.endswith("_jit"):
+        return True
+    if isinstance(func, ast.Attribute) and func.attr.endswith("_jit"):
+        return True
+    if isinstance(func, ast.Subscript):
+        base = func.value
+        leaf = (base.attr if isinstance(base, ast.Attribute)
+                else base.id if isinstance(base, ast.Name) else "")
+        if leaf.endswith("_jits"):
+            return True
+    if isinstance(func, ast.Call):
+        inner = dotted_name(func.func) or ""
+        if inner.split(".")[-1] in ("jit", "pjit"):
+            return True
+    return False
+
+
+def _tainted_expr(node: ast.AST, taint: set[str]) -> bool:
+    """Does this expression carry a device value from a tainted local?"""
+    if isinstance(node, ast.Name):
+        return node.id in taint
+    if isinstance(node, (ast.Subscript, ast.Starred)):
+        return _tainted_expr(node.value, taint)
+    if isinstance(node, ast.Call):
+        return _is_device_call(node)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(_tainted_expr(e, taint) for e in node.elts)
+    if isinstance(node, ast.BinOp):
+        return (_tainted_expr(node.left, taint)
+                or _tainted_expr(node.right, taint))
+    return False
+
+
+def _sink(node: ast.Call, taint: set[str]) -> str | None:
+    """If ``node`` is a host-sync sink applied to tainted data, name the sink."""
+    func = node.func
+    args_tainted = any(_tainted_expr(a, taint) for a in node.args)
+    if isinstance(func, ast.Name) and func.id in SINK_BUILTINS:
+        return func.id if args_tainted else None
+    if isinstance(func, ast.Attribute):
+        if func.attr in SINK_METHODS and _tainted_expr(func.value, taint):
+            return f".{func.attr}()"
+        base = dotted_name(func.value) or ""
+        leaf = base.split(".")[-1]
+        if leaf in ("np", "numpy") and func.attr in SINK_NP_ATTRS:
+            return f"{leaf}.{func.attr}" if args_tainted else None
+        if base in ("jax",) and func.attr == "device_get":
+            return "jax.device_get" if args_tainted else None
+    return None
+
+
+class _RegionAnalysis:
+    """One hot function's statement-ordered taint pass."""
+
+    def __init__(self, checker: "HostSyncHazard", module: Module,
+                 pre_tainted: set[str]):
+        self.checker = checker
+        self.module = module
+        self.taint = set(pre_tainted)
+        self.findings: list[Finding] = []
+
+    def run(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[Finding]:
+        for stmt in fn.body:
+            self._stmt(stmt)
+        return self.findings
+
+    # -- statements ---------------------------------------------------------------
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign):
+            self._scan_sinks(stmt.value)
+            tainted = _tainted_expr(stmt.value, self.taint) and not \
+                self._value_is_synced(stmt.value)
+            for target in stmt.targets:
+                self._bind(target, tainted)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            if stmt.value is not None:
+                self._scan_sinks(stmt.value)
+        elif isinstance(stmt, ast.Expr):
+            self._scan_sinks(stmt.value)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self._scan_sinks(stmt.value)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._scan_sinks(stmt.test)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+        elif isinstance(stmt, ast.For):
+            self._scan_sinks(stmt.iter)
+            if _tainted_expr(stmt.iter, self.taint):
+                self._bind(stmt.target, True)
+            for s in stmt.body + stmt.orelse:
+                self._stmt(s)
+        elif isinstance(stmt, (ast.With, ast.Try)):
+            body = list(stmt.body)
+            if isinstance(stmt, ast.Try):
+                for h in stmt.handlers:
+                    body += h.body
+                body += stmt.orelse + stmt.finalbody
+            for s in body:
+                self._stmt(s)
+        # Nested defs/classes: not entered — their bodies run elsewhere.
+
+    def _value_is_synced(self, value: ast.AST) -> bool:
+        """``x = np.asarray(dev)`` — the CALL is the (flagged) sync; the result
+        is host data, so the target must not stay tainted."""
+        return isinstance(value, ast.Call) and _sink(value, self.taint) is not None
+
+    def _bind(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            (self.taint.add if tainted else self.taint.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tainted)
+        # Attribute/subscript stores: escape, untracked.
+
+    def _scan_sinks(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            sink = _sink(node, self.taint)
+            if sink is not None:
+                self.findings.append(self.module.finding(
+                    self.checker.name, node,
+                    f"host sync '{sink}' on a device value inside a hot "
+                    f"loop — this blocks on the accelerator every iteration; "
+                    f"batch the fetch or move it out of the loop"))
+
+
+class HostSyncHazard(Checker):
+    name = "host-sync-hazard"
+    description = ("no .item()/float()/int()/np.asarray/device_get on device "
+                   "values inside the configured decode/step hot loops")
+
+    def visit(self, module: Module, graph) -> list[Finding]:
+        region = None
+        for rule_path, spec in rules.HOT_REGIONS.items():
+            if module.path == rules.package_relpath(graph, rule_path):
+                region = spec
+        if region is None:
+            return []
+        findings: list[Finding] = []
+        if region == "scan-bodies":
+            for fn in _scan_bodies(module.tree):
+                pre = {a.arg for a in fn.args.args + fn.args.posonlyargs
+                       + fn.args.kwonlyargs}
+                findings += _RegionAnalysis(self, module, pre).run(fn)
+        else:
+            for node in ast.walk(module.tree):
+                if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and node.name in region):
+                    findings += _RegionAnalysis(self, module, set()).run(node)
+        return findings
+
+
+def _scan_bodies(tree: ast.Module):
+    """Local functions passed as the first argument to ``lax.scan`` /
+    ``jax.lax.scan`` — inside them, every parameter is a tracer.
+
+    Scoped name resolution: several builders in one module each define their
+    own inner ``body``; a scan call binds to the def sharing its innermost
+    enclosing function, not to the first ``body`` in the file.
+    """
+    from tools.graftlint.core import iter_with_ancestors
+
+    def scope_of(ancestors) -> tuple:
+        return tuple(a for a in ancestors
+                     if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)))
+
+    defs: list[tuple[tuple, ast.FunctionDef]] = []
+    calls: list[tuple[tuple, str]] = []
+    for node, ancestors in iter_with_ancestors(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.append((scope_of(ancestors), node))
+        elif isinstance(node, ast.Call) and node.args:
+            callee = dotted_name(node.func) or ""
+            if callee.split(".")[-1] == "scan" and "lax" in callee \
+                    and isinstance(node.args[0], ast.Name):
+                calls.append((scope_of(ancestors), node.args[0].id))
+
+    yielded: set[int] = set()
+    for call_scope, name in calls:
+        # Deepest def visible from the call site (def's scope is a prefix of
+        # the call's scope chain).
+        best = None
+        for def_scope, fn in defs:
+            if fn.name != name:
+                continue
+            if call_scope[:len(def_scope)] == def_scope:
+                if best is None or len(def_scope) > len(best[0]):
+                    best = (def_scope, fn)
+        if best is not None and id(best[1]) not in yielded:
+            yielded.add(id(best[1]))
+            yield best[1]

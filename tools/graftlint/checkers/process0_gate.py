@@ -1,0 +1,114 @@
+"""process0-gate: SPMD trainer paths write files only through process-0 gates.
+
+Every process in a fleet runs the trainer module (SPMD: the program is the
+same everywhere; only the data differs). A raw file write there executes N
+times against one path — torn JSONL, clobbered checkpoints, duplicated plots.
+The repo's writers are therefore all internally gated (``TelemetryWriter``
+checks ``metrics.is_logging_process()`` in ``enabled``; ``save_metrics_jsonl``,
+``utils.plotting``, the checkpoint savers likewise), and trainer code calls
+them unconditionally. This checker enforces the complement: inside the trainer
+modules (rules.GATED_WRITE_MODULES), a RAW write primitive — ``open`` with a
+writing mode, ``json.dump``, ``pickle.dump``, ``np.save*``, ``savefig``,
+``Path.write_text/bytes``, ``shutil.copy*``, ``_atomic_write`` — must sit
+under an explicit ``if is_logging_process():`` / ``if jax.process_index() ==
+0:`` gate. Calls to the gated helper APIs are not writes at this layer and
+pass untouched.
+
+Multi-host-safety nuance this rule deliberately preserves: SPMD *computation*
+(e.g. the health param-norm program) must run on EVERY process — only the
+WRITE is gated. The checker therefore looks at write primitives, not at
+everything under an ungated branch.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import rules
+from tools.graftlint.core import Checker, Finding, Module, dotted_name, iter_with_ancestors
+
+WRITE_MODES = set("wax+")
+# (module-ish base names, attr) pairs that ARE raw writes when called.
+WRITE_ATTRS = {
+    ("json", "dump"), ("pickle", "dump"), ("shutil", "copy"),
+    ("shutil", "copy2"), ("shutil", "copyfile"), ("shutil", "move"),
+    ("np", "save"), ("np", "savez"), ("np", "savez_compressed"),
+    ("numpy", "save"), ("numpy", "savez"), ("numpy", "savez_compressed"),
+}
+# Attribute calls that write regardless of base (pathlib / matplotlib handles).
+WRITE_ANY_BASE_ATTRS = {"write_text", "write_bytes", "savefig"}
+WRITE_NAMES = {"_atomic_write"}
+GATE_MARKERS = {"is_logging_process", "process_index"}
+
+
+def _is_write_call(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Name):
+        if func.id in WRITE_NAMES:
+            return True
+        if func.id == "open":
+            return _open_mode_writes(node)
+        return False
+    if isinstance(func, ast.Attribute):
+        if func.attr in WRITE_ANY_BASE_ATTRS:
+            return True
+        base = dotted_name(func.value)
+        if not base:
+            return False
+        return (base.split(".")[-1], func.attr) in WRITE_ATTRS
+    return False
+
+
+def _open_mode_writes(node: ast.Call) -> bool:
+    """``open(path, mode)`` with a literal writing mode. Default mode reads."""
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return bool(set(mode.value) & WRITE_MODES)
+    return True                      # dynamic mode: can't prove it reads — flag
+
+
+def _under_gate(ancestors) -> bool:
+    """Any enclosing ``if`` whose test mentions a process-0 gate marker."""
+    for anc in ancestors:
+        if isinstance(anc, ast.If):
+            for n in ast.walk(anc.test):
+                name = None
+                if isinstance(n, ast.Attribute):
+                    name = n.attr
+                elif isinstance(n, ast.Name):
+                    name = n.id
+                if name in GATE_MARKERS:
+                    return True
+    return False
+
+
+class Process0Gate(Checker):
+    name = "process0-gate"
+    description = ("raw file writes in SPMD trainer modules must sit under an "
+                   "is_logging_process()/process_index()==0 gate (or go "
+                   "through the internally-gated writer helpers)")
+
+    def visit(self, module: Module, graph) -> list[Finding]:
+        if not rules.matches(graph, module, rules.GATED_WRITE_MODULES):
+            return []
+        findings: list[Finding] = []
+        for node, ancestors in iter_with_ancestors(module.tree):
+            if not (isinstance(node, ast.Call) and _is_write_call(node)):
+                continue
+            if _under_gate(ancestors):
+                continue
+            what = (dotted_name(node.func) or
+                    getattr(node.func, "attr", "") or "write")
+            findings.append(module.finding(
+                self.name, node,
+                f"raw write '{what}(...)' in an SPMD trainer path without a "
+                f"process-0 gate — every fleet process executes this line; "
+                f"gate it with is_logging_process() or use a gated writer"))
+        return findings

@@ -1,0 +1,94 @@
+"""resolve-guard: every Future resolve must survive losing the resolve race.
+
+The PR 6/8 bug class, twice shipped and twice review-hardened: a
+``concurrent.futures.Future`` in the serving stack can be resolved from
+multiple threads — the decode loop, the router's drain sweep, the monitor's
+abort path, a caller's ``cancel()`` — and whoever loses the race gets
+``InvalidStateError``. An unguarded ``set_result``/``set_exception`` then
+kills its thread: PR 6's review found exactly that taking down the router's
+monitor thread (CHANGES.md), and PR 8 re-found it on the stop()-sweep path.
+
+The rule: a ``.set_result(...)`` / ``.set_exception(...)`` call must sit in
+the BODY of a ``try`` whose handlers catch ``InvalidStateError`` (bare
+``except``/``except Exception`` also qualifies — strictly wider), or inside a
+helper function registered in ``rules.RESOLVE_HELPERS``. Calls in an
+``else``/``finally`` block of such a try are NOT covered — those run outside
+the guarded region.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import rules
+from tools.graftlint.core import Checker, Finding, Module, dotted_name, iter_with_ancestors
+
+RESOLVE_ATTRS = ("set_result", "set_exception")
+GUARD_EXC = "InvalidStateError"
+WIDE_EXC = ("Exception", "BaseException")
+
+
+def _handler_catches(handler: ast.ExceptHandler) -> bool:
+    """Does this except clause catch InvalidStateError (or wider)?"""
+    if handler.type is None:                       # bare except
+        return True
+    types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    for t in types:
+        name = dotted_name(t) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf == GUARD_EXC or leaf in WIDE_EXC:
+            return True
+    return False
+
+
+def _in_guarded_try(node: ast.AST, ancestors) -> bool:
+    """Is ``node`` inside the BODY of a try whose handlers cover the guard?"""
+    chain = list(ancestors) + [node]
+    for i, anc in enumerate(chain[:-1]):
+        if not isinstance(anc, ast.Try):
+            continue
+        # A function defined inside the try runs LATER, outside the guard.
+        if any(isinstance(mid, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)) for mid in chain[i + 1:-1]):
+            continue
+        child = chain[i + 1]
+        # The guarded region is try's body only — else/finally/handlers run
+        # outside it.
+        in_body = any(child is stmt or _contains(stmt, child)
+                      for stmt in anc.body)
+        if in_body and any(_handler_catches(h) for h in anc.handlers):
+            return True
+    return False
+
+
+def _contains(tree: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(tree))
+
+
+class ResolveGuard(Checker):
+    name = "resolve-guard"
+    description = ("Future.set_result/set_exception must be guarded by "
+                   "try/except InvalidStateError (or live in a registered "
+                   "resolve helper)")
+
+    def visit(self, module: Module, graph) -> list[Finding]:
+        findings: list[Finding] = []
+        for node, ancestors in iter_with_ancestors(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in RESOLVE_ATTRS):
+                continue
+            if _in_guarded_try(node, ancestors):
+                continue
+            func_names = {a.name for a in ancestors
+                          if isinstance(a, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))}
+            if func_names & set(rules.RESOLVE_HELPERS):
+                continue
+            findings.append(module.finding(
+                self.name, node,
+                f"unguarded .{node.func.attr}() — losing the resolve race "
+                f"raises InvalidStateError and kills this thread; wrap in "
+                f"try/except concurrent.futures.InvalidStateError"))
+        return findings

@@ -1,0 +1,167 @@
+"""retrace-hazard: jit call sites must compile once, not once per call.
+
+The training side pins this dynamically (``trace_count`` assertions in the
+serving/prefill tests); this checker is the static complement, catching the
+three shapes that defeat jit's cache before a test ever runs:
+
+1. **immediately-invoked jit** — ``jax.jit(f)(x)`` inside a function body
+   builds a FRESH jit wrapper (and usually a fresh lambda) on every call, so
+   nothing is ever cached: one XLA compile per invocation. At module scope it
+   runs once and is fine; inside ``def`` it is the compile-per-call bug.
+   Sanctioned cold paths (a once-per-run sampling helper) carry a line pragma
+   with the justification.
+2. **jit built in a loop** — ``for ...: f = jax.jit(...)`` re-wraps per
+   iteration; hoist it or memoize (the ``cached_sharded_compile`` idiom —
+   jit under an ``if key not in cache`` is the sanctioned memoized form and
+   is not flagged, because it is not lexically inside a loop).
+3. **unhashable static args** — a call site passing a list/dict/set literal
+   in a position the local ``jax.jit(..., static_argnums=/static_argnames=)``
+   wrapper declared static: jax raises ``Unhashable static arguments`` at
+   runtime — or worse, a caller "fixes" it by passing a tuple derived from
+   per-request values, compiling one program per request. Resolved locally:
+   the wrapper assignment and the call site must be in the same module.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import rules
+from tools.graftlint.core import Checker, Finding, Module, dotted_name, iter_with_ancestors
+
+JIT_NAMES = {"jit", "pjit"}
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func) or ""
+    return name.split(".")[-1] in JIT_NAMES
+
+
+class RetraceHazard(Checker):
+    name = "retrace-hazard"
+    description = ("no per-call jax.jit wrappers (immediately-invoked or "
+                   "loop-built) and no unhashable literals in declared-static "
+                   "arg positions")
+
+    def visit(self, module: Module, graph) -> list[Finding]:
+        findings: list[Finding] = []
+        static_decls = _local_static_decls(module.tree)
+        # One-shot scripts (bench sweeps, the dryrun entry) invoke each jit
+        # exactly once by construction — the per-call rules are library rules.
+        library = (not rules.RETRACE_LIBRARY_ONLY
+                   or module.path.startswith(f"{graph.package}/"))
+        for node, ancestors in iter_with_ancestors(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            in_function = any(isinstance(a, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))
+                              for a in ancestors)
+            # 1. jax.jit(f)(args...) inside a function body.
+            if library and _is_jit_call(node.func) and in_function:
+                findings.append(module.finding(
+                    self.name, node,
+                    "immediately-invoked jax.jit builds a fresh wrapper per "
+                    "call — nothing caches, one XLA compile per invocation; "
+                    "hoist the jit (or memoize it) so the program compiles "
+                    "once"))
+            # 2. jax.jit(...) lexically inside a For/While loop.
+            if library and _is_jit_call(node) and any(
+                    isinstance(a, (ast.For, ast.While)) for a in ancestors):
+                findings.append(module.finding(
+                    self.name, node,
+                    "jax.jit built inside a loop re-wraps (and recompiles) "
+                    "per iteration; hoist it out of the loop or memoize by "
+                    "key"))
+            # 3. unhashable literal in a declared-static position.
+            findings += _static_arg_violations(self, module, node, static_decls)
+        return findings
+
+
+def _local_static_decls(tree: ast.Module) -> dict[str, tuple[set[int], set[str]]]:
+    """``name -> (static positions, static kwarg names)`` for every local
+    ``name = jax.jit(f, static_argnums=..., static_argnames=...)`` binding
+    (plain or ``self.name = ...``)."""
+    decls: dict[str, tuple[set[int], set[str]]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not _is_jit_call(node.value):
+            continue
+        nums: set[int] = set()
+        names: set[str] = set()
+        for kw in node.value.keywords:
+            if kw.arg == "static_argnums":
+                nums |= _int_literals(kw.value)
+            elif kw.arg == "static_argnames":
+                names |= _str_literals(kw.value)
+        if not nums and not names:
+            continue
+        for target in node.targets:
+            key = _binding_key(target)
+            if key:
+                decls[key] = (nums, names)
+    return decls
+
+
+def _binding_key(target: ast.AST) -> str | None:
+    if isinstance(target, ast.Name):
+        return target.id
+    if isinstance(target, ast.Attribute):   # self._foo_jit and friends
+        return target.attr
+    return None
+
+
+def _int_literals(node: ast.AST) -> set[int]:
+    out: set[int] = set()
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, int):
+            out.add(e.value)
+    return out
+
+
+def _str_literals(node: ast.AST) -> set[str]:
+    out: set[str] = set()
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            out.add(e.value)
+    return out
+
+
+def _static_arg_violations(checker, module: Module, call: ast.Call,
+                           decls) -> list[Finding]:
+    key = _binding_key(call.func) if isinstance(
+        call.func, (ast.Name, ast.Attribute)) else None
+    if key is None or key not in decls:
+        return []
+    nums, names = decls[key]
+    findings: list[Finding] = []
+    for i, arg in enumerate(call.args):
+        if i in nums and _unhashable_literal(arg):
+            findings.append(module.finding(
+                checker.name, arg,
+                f"unhashable {_literal_kind(arg)} literal passed in static "
+                f"position {i} of '{key}' — jax raises on unhashable static "
+                f"args; pass a tuple (and make sure it is not derived from "
+                f"per-request values)"))
+    for kw in call.keywords:
+        if kw.arg in names and _unhashable_literal(kw.value):
+            findings.append(module.finding(
+                checker.name, kw.value,
+                f"unhashable {_literal_kind(kw.value)} literal passed for "
+                f"static argname '{kw.arg}' of '{key}' — jax raises on "
+                f"unhashable static args; pass a tuple (and make sure it is "
+                f"not derived from per-request values)"))
+    return findings
+
+
+def _unhashable_literal(node: ast.AST) -> bool:
+    return isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp))
+
+
+def _literal_kind(node: ast.AST) -> str:
+    return {ast.List: "list", ast.Dict: "dict", ast.Set: "set",
+            ast.ListComp: "list", ast.DictComp: "dict",
+            ast.SetComp: "set"}.get(type(node), "container")

@@ -1,0 +1,116 @@
+"""telemetry-schema: every emitted event kind must be in the central registry.
+
+PR 8 added the report-side drift footer ("N unrecognized events"); this checker
+kills the drift AT THE SOURCE. The registry — ``utils/telemetry_events.py``'s
+``EVENT_KINDS`` dict literal — is the one sanctioned vocabulary;
+``tools/telemetry_report.py::KNOWN_EVENTS`` is derived from it, and this
+checker closes the loop: any ``{"event": "<literal>"}`` dict display (or
+``.setdefault("event", "<literal>")``) in the package, tools, or bench scripts
+whose kind is not registered is a lint error. Adding an event kind therefore
+HAS to touch the registry, which is what keeps emitters and report tools
+agreeing forever.
+
+The registry is read by AST, never imported: graftlint must run on a bare
+Python with no repo deps installed. That is also why EVENT_KINDS must stay a
+pure dict literal (its module docstring says so) — a computed key would be
+invisible here, and this checker flags the registry itself if it stops being
+parseable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import rules
+from tools.graftlint.core import Checker, Finding, Module
+
+
+def load_registry(graph) -> tuple[set[str] | None, str]:
+    """Extract the registered kinds from the registry module's AST.
+
+    Returns ``(kinds, registry_path)``; ``kinds`` is None when the registry is
+    missing or not a pure dict literal (the checker then reports on the
+    registry instead of silently passing everything).
+    """
+    path = rules.package_relpath(graph, rules.EVENT_REGISTRY)
+    mod = graph.module_for_relpath(path)
+    if mod is None:
+        return None, path
+    for node in ast.walk(mod.tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == rules.EVENT_REGISTRY_NAME
+                   for t in targets):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Dict):
+            return None, path
+        kinds: set[str] = set()
+        for key in value.keys:
+            if not (isinstance(key, ast.Constant) and isinstance(key.value, str)):
+                return None, path       # computed key: registry not static
+            kinds.add(key.value)
+        return kinds, path
+    return None, path
+
+
+class TelemetrySchema(Checker):
+    name = "telemetry-schema"
+    description = ("every {\"event\": \"...\"} literal must use a kind "
+                   "registered in utils/telemetry_events.py::EVENT_KINDS")
+
+    def visit(self, module: Module, graph) -> list[Finding]:
+        kinds, registry_path = load_registry(graph)
+        if kinds is None:
+            if module.path != registry_path and graph.module_for_relpath(
+                    registry_path) is not None:
+                return []               # report once, on the registry module
+            return [Finding(
+                path=module.path if module.path == registry_path else registry_path,
+                line=1, col=1, check=self.name,
+                message=(f"event registry {registry_path} missing or "
+                         f"{rules.EVENT_REGISTRY_NAME} is not a pure dict "
+                         f"literal — the schema gate cannot read it"))]
+        if module.path == registry_path:
+            return []                   # the registry defines, never emits
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            kind_node = _emitted_kind(node)
+            if kind_node is None:
+                continue
+            kind = kind_node.value
+            if kind not in kinds:
+                findings.append(module.finding(
+                    self.name, kind_node,
+                    f"event kind '{kind}' is not in "
+                    f"{registry_path}::{rules.EVENT_REGISTRY_NAME} — register "
+                    f"it (with its producer) or the report tools will count "
+                    f"it as schema drift"))
+        return findings
+
+
+def _emitted_kind(node: ast.AST) -> ast.Constant | None:
+    """The string-literal kind of an emitted event, if ``node`` is one.
+
+    Two shapes: a dict display with an ``"event"`` key whose value is a string
+    literal, and ``payload.setdefault("event", "<kind>")``. Non-literal kinds
+    (variables) pass — the registry gate is for the static vocabulary; dynamic
+    kinds are the readers' passthrough case.
+    """
+    if isinstance(node, ast.Dict):
+        for key, value in zip(node.keys, node.values):
+            if (isinstance(key, ast.Constant) and key.value == "event"
+                    and isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)):
+                return value
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "setdefault" and len(node.args) == 2):
+        key, value = node.args
+        if (isinstance(key, ast.Constant) and key.value == "event"
+                and isinstance(value, ast.Constant)
+                and isinstance(value.value, str)):
+            return value
+    return None

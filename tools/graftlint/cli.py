@@ -1,0 +1,103 @@
+"""``python -m tools.graftlint`` — the CLI and CI gate.
+
+Exit codes: 0 clean (no non-baselined findings), 1 findings, 2 usage/internal
+error. ``--json`` emits one machine-readable document (the CI failure
+artifact); text mode prints ``path:line:col: [check] message`` lines, sorted,
+plus a one-line summary. Stale baseline entries are always surfaced — a
+baseline must shrink, not rot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.graftlint.baseline import default_baseline_path, load_baseline
+from tools.graftlint.checkers import ALL_CHECKERS
+from tools.graftlint.runner import run_lint
+
+
+def default_root() -> str:
+    """The repo root: two levels above this package (tools/graftlint/..)."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST/import-graph lint: this repo's invariants as code")
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: derived from this file)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output (CI artifact)")
+    parser.add_argument("--checks", default="",
+                        help="comma-separated checker names (default: all)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: tools/graftlint/"
+                             "baseline.json under --root)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                             "(explicit, diff-reviewed) and exit 0")
+    parser.add_argument("--list-checks", action="store_true",
+                        help="list checkers and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_checks:
+        for c in ALL_CHECKERS:
+            print(f"{c.name:20s} {c.description}")
+        return 0
+
+    root = os.path.abspath(args.root or default_root())
+    checks = [c.strip() for c in args.checks.split(",") if c.strip()]
+    if args.update_baseline and checks:
+        # A filtered run sees only its own checkers' findings; saving it would
+        # silently delete every OTHER checker's grandfathered entries.
+        print("graftlint: error: --update-baseline requires a full run "
+              "(drop --checks)", file=sys.stderr)
+        return 2
+    try:
+        findings, graph = run_lint(root, checks=checks or None)
+        baseline = load_baseline(args.baseline
+                                 or default_baseline_path(root))
+    except (ValueError, RuntimeError, OSError, SyntaxError) as err:
+        print(f"graftlint: error: {err}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        baseline.save(findings)
+        print(f"graftlint: wrote {len(findings)} entr"
+              f"{'y' if len(findings) == 1 else 'ies'} to {baseline.path}")
+        return 0
+
+    new, baselined, stale = baseline.split(findings)
+
+    if args.as_json:
+        print(json.dumps({
+            "root": root,
+            "modules": len(graph.modules),
+            "checks": [c.name for c in ALL_CHECKERS] if not checks else checks,
+            "findings": [f.to_json() for f in new],
+            "baselined": [f.to_json() for f in baselined],
+            "stale_baseline_entries": stale,
+            "ok": not new,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.format())
+        if stale:
+            print(f"graftlint: note: {len(stale)} stale baseline "
+                  f"entr{'y' if len(stale) == 1 else 'ies'} in "
+                  f"{baseline.path} no longer match anything — remove them")
+        suffix = f" ({len(baselined)} baselined)" if baselined else ""
+        status = "FAILED" if new else "ok"
+        print(f"graftlint: {status}: {len(new)} finding"
+              f"{'' if len(new) == 1 else 's'} across {len(graph.modules)} "
+              f"modules{suffix}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":      # pragma: no cover - exercised via __main__
+    sys.exit(main())

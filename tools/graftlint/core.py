@@ -1,0 +1,171 @@
+"""graftlint core types: Finding, Module (parsed file + pragmas), Checker API.
+
+The contract every checker implements::
+
+    class MyChecker(Checker):
+        name = "my-check"
+        description = "one line for --list-checks"
+        def visit(self, module, graph) -> list[Finding]: ...
+
+``visit`` is called once per discovered module with the shared
+:class:`~tools.graftlint.graph.ImportGraph`; a checker that only cares about
+some modules returns ``[]`` for the rest. Findings are plain data — the runner
+owns pragma suppression, baseline subtraction, ordering, and exit codes, so a
+checker never needs to reason about any of that.
+
+Pragmas (suppression is per-check and deliberately loud in the source)::
+
+    x = f()   # graftlint: disable=host-sync-hazard  (reason next to it)
+    # graftlint: disable-file=telemetry-schema
+
+A line pragma suppresses findings REPORTED ON that physical line (checkers
+report the precise offending line, so the pragma sits next to the sanctioned
+call, not somewhere above it); a file pragma suppresses the check everywhere in
+the file. ``disable=all`` exists for generated files and is not used in-tree.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+
+PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*(?P<scope>disable(?:-file)?)\s*=\s*(?P<checks>[A-Za-z0-9_,-]+)")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at one source location. ``path`` is repo-relative
+    POSIX; ``message`` is self-contained (the baseline matches on it, so it
+    must not embed line numbers — those drift with unrelated edits)."""
+
+    path: str
+    line: int
+    col: int
+    check: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.check}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "check": self.check, "message": self.message}
+
+    @property
+    def baseline_key(self) -> tuple[str, str, str]:
+        """Identity for baseline matching: line numbers excluded on purpose —
+        a grandfathered finding must not resurface because code above it moved."""
+        return (self.check, self.path, self.message)
+
+
+def parse_pragmas(source: str) -> tuple[set[str], dict[int, set[str]]]:
+    """Extract ``(file_level, by_line)`` pragma sets from ``source``.
+
+    Tokenized, not regex-over-raw-lines: only COMMENT tokens count, so pragma
+    syntax QUOTED in a docstring or string literal (someone documenting the
+    mechanism — this module's own docstring does) can never silently disable a
+    check. A trailing comment on line N suppresses findings reported at line N
+    even when the enclosing statement starts earlier.
+    """
+    file_level: set[str] = set()
+    by_line: dict[int, set[str]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):  # pragma: no cover
+        # Unparseable source never gets this far (Module.parse ast-parses),
+        # but fail open rather than crash the whole run.
+        return file_level, by_line
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = PRAGMA_RE.search(tok.string)
+        if not m:
+            continue
+        checks = {c.strip() for c in m.group("checks").split(",") if c.strip()}
+        if m.group("scope") == "disable-file":
+            file_level |= checks
+        else:
+            by_line.setdefault(tok.start[0], set()).update(checks)
+    return file_level, by_line
+
+
+@dataclasses.dataclass
+class Module:
+    """One parsed source file: dotted name, repo-relative path, AST, pragmas.
+
+    ``name`` is the real dotted import name for package modules
+    (``<pkg>.serving.router``); scripts outside a package get a pseudo-name
+    from their path (``tools.serve_loadgen``, ``bench_lm``) which is never used
+    for import resolution — only package names are resolvable targets.
+    """
+
+    name: str
+    path: str                      # repo-relative, posix separators
+    tree: ast.Module
+    source: str
+    is_package_init: bool = False
+    file_pragmas: set[str] = dataclasses.field(default_factory=set)
+    line_pragmas: dict[int, set[str]] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, name: str, path: str, source: str,
+              *, is_package_init: bool = False) -> "Module":
+        file_level, by_line = parse_pragmas(source)
+        return cls(name=name, path=path, tree=ast.parse(source),
+                   source=source, is_package_init=is_package_init,
+                   file_pragmas=file_level, line_pragmas=by_line)
+
+    def suppressed(self, check: str, line: int) -> bool:
+        for got in (self.file_pragmas, self.line_pragmas.get(line, ())):
+            if check in got or "all" in got:
+                return True
+        return False
+
+    def finding(self, check: str, node: ast.AST, message: str) -> Finding:
+        return Finding(path=self.path, line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       check=check, message=message)
+
+
+class Checker:
+    """Base class; subclasses set ``name``/``description`` and implement
+    ``visit``. Stateless across modules by convention — the runner may call
+    ``visit`` in any module order."""
+
+    name: str = ""
+    description: str = ""
+
+    def visit(self, module: Module, graph) -> list[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+def iter_with_ancestors(tree: ast.AST):
+    """Yield ``(node, ancestors)`` for every node, ancestors outermost-first.
+    The shared scaffolding for context-sensitive rules (is this call inside a
+    try/except? inside which function? under which ``if`` gate?)."""
+    stack: list[ast.AST] = []
+
+    def walk(node: ast.AST):
+        yield node, tuple(stack)
+        stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child)
+        stack.pop()
+
+    yield from walk(tree)
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

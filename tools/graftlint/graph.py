@@ -1,0 +1,228 @@
+"""Module discovery + the transitive import graph graftlint checks against.
+
+What counts as an edge (this is the load-bearing design decision, so it is
+written down once, here):
+
+- **top-level edges** — ``import``/``from ... import`` statements that execute
+  at module import time: module body, and bodies of module-level ``if``/
+  ``try``/``with``/class blocks (a conditional import still statically reaches
+  its target — whether it fires is an env question the lint cannot answer, so
+  it counts; a sanctioned one carries a line pragma).
+- **lazy edges** — imports inside function/method bodies. These defer the cost
+  to call time and are this repo's one sanctioned mechanism for a backend-free
+  module to reach heavyweight deps on demand (e.g. the supervisor importing
+  ``utils.checkpoint`` inside its resume path). Recorded, but not traversed by
+  the backend-purity closure.
+- **parent-package edges** — importing ``a.b.c`` executes ``a/__init__`` and
+  ``a/b/__init__`` first. These are real runtime imports and ARE traversed:
+  an eager ``from .step import ...`` in ``train/__init__.py`` makes EVERY
+  ``train.*`` import reach jax, which is exactly the leak class this graph
+  exists to catch (found and fixed when this tool landed).
+- ``from pkg.mod import name`` edges to ``pkg.mod`` and — when ``pkg.mod.name``
+  is itself a repo module — to the submodule too.
+
+External modules (not found in the repo) are terminal nodes identified by
+their top-level name (``jax``, ``numpy``, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+from tools.graftlint.core import Module
+
+# Directories never scanned (data/artifacts/caches, never source).
+SKIP_DIRS = {"__pycache__", ".git", ".github", "bench_results", "images",
+             "tests", "related"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ImportEdge:
+    """One import statement's contribution: ``target`` is a dotted module name
+    (repo or external), ``line`` its statement line in the source module,
+    ``lazy`` True for function-body imports."""
+
+    target: str
+    line: int
+    lazy: bool
+
+
+class ImportGraph:
+    """The parsed repo: ``modules`` by dotted name, plus per-module edges."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.modules: dict[str, Module] = {}
+        self.package: str = ""              # the single top-level package name
+        self._edges: dict[str, list[ImportEdge]] = {}
+
+    # -- discovery ----------------------------------------------------------------
+
+    def add_module(self, module: Module) -> None:
+        self.modules[module.name] = module
+        self._edges[module.name] = _collect_edges(module)
+
+    def module_for_relpath(self, relpath: str) -> Module | None:
+        """Module by repo-relative POSIX path (how rules.py names things)."""
+        for mod in self.modules.values():
+            if mod.path == relpath:
+                return mod
+        return None
+
+    # -- edges --------------------------------------------------------------------
+
+    def edges(self, name: str, *, include_lazy: bool = False) -> list[ImportEdge]:
+        out = self._edges.get(name, [])
+        return out if include_lazy else [e for e in out if not e.lazy]
+
+    @staticmethod
+    def parents(name: str) -> list[str]:
+        """``a.b.c`` -> ``["a", "a.b"]`` — the package inits importing it runs."""
+        parts = name.split(".")
+        return [".".join(parts[:i]) for i in range(1, len(parts))]
+
+    def closure(self, start: str, *, skip_check: str = "") -> dict[str, tuple[str, int]]:
+        """Transitive top-level import closure from repo module ``start``.
+
+        Returns ``{reached_name: (via_module, via_line)}`` for every module —
+        repo or external — reachable through top-level edges, including
+        parent-package edges. ``skip_check``: edges whose source line carries a
+        ``# graftlint: disable=<skip_check>`` pragma are not traversed (the
+        sanctioned-import escape hatch).
+        """
+        seen: dict[str, tuple[str, int]] = {start: ("", 0)}
+        frontier = [start]
+        while frontier:
+            name = frontier.pop()
+            mod = self.modules.get(name)
+            targets: list[tuple[str, str, int]] = []
+            if mod is not None:
+                for edge in self.edges(name):
+                    if skip_check and mod.suppressed(skip_check, edge.line):
+                        continue
+                    targets.append((edge.target, name, edge.line))
+            # Importing any module first executes its parent packages' inits.
+            for parent in self.parents(name):
+                targets.append((parent, name, 0))
+            for target, via, line in targets:
+                if target in seen:
+                    continue
+                seen[target] = (via, line)
+                # External names are terminal; repo modules recurse.
+                frontier.append(target)
+        return seen
+
+    def chain(self, closure: dict[str, tuple[str, int]], target: str) -> list[str]:
+        """Human-readable import chain from the closure start to ``target``."""
+        hops = [target]
+        while True:
+            via, _line = closure[hops[-1]]
+            if not via:
+                break
+            hops.append(via)
+        return list(reversed(hops))
+
+
+def _collect_edges(module: Module) -> list[ImportEdge]:
+    """All import statements in ``module``, classified top-level vs lazy."""
+    edges: list[ImportEdge] = []
+
+    def visit(node: ast.AST, lazy: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_lazy = lazy or isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+            if isinstance(child, ast.Import):
+                for alias in child.names:
+                    edges.append(ImportEdge(alias.name, child.lineno, lazy))
+            elif isinstance(child, ast.ImportFrom):
+                base = _resolve_from(module, child)
+                if base:
+                    edges.append(ImportEdge(base, child.lineno, lazy))
+                    for alias in child.names:
+                        if alias.name != "*":
+                            # Submodule edge; pruned to real modules at
+                            # traversal time (unknown names are terminal and
+                            # harmless — they resolve to nothing).
+                            edges.append(ImportEdge(f"{base}.{alias.name}",
+                                                    child.lineno, lazy))
+            visit(child, child_lazy)
+
+    visit(module.tree, lazy=False)
+    return edges
+
+
+def _resolve_from(module: Module, node: ast.ImportFrom) -> str:
+    """Absolute dotted base of a ``from ... import`` (handles relative levels)."""
+    if node.level == 0:
+        return node.module or ""
+    # Relative: strip `level` trailing components from the module's package.
+    parts = module.name.split(".")
+    if not module.is_package_init:
+        parts = parts[:-1]
+    anchor = parts[: len(parts) - (node.level - 1)] if node.level > 1 else parts
+    base = ".".join(anchor)
+    return f"{base}.{node.module}" if node.module else base
+
+
+def discover_package(root: str) -> str:
+    """The repo's one top-level package (a root dir with ``__init__.py``)."""
+    candidates = []
+    for entry in sorted(os.listdir(root)):
+        if entry in SKIP_DIRS or entry.startswith("."):
+            continue
+        if os.path.isfile(os.path.join(root, entry, "__init__.py")):
+            candidates.append(entry)
+    # tools/ is a namespace dir (no __init__.py) so it never competes.
+    if len(candidates) != 1:
+        raise RuntimeError(
+            f"expected exactly one top-level package under {root}, "
+            f"found {candidates}")
+    return candidates[0]
+
+
+def build_graph(root: str) -> ImportGraph:
+    """Parse the repo into an :class:`ImportGraph`.
+
+    Scanned: the package tree, ``tools/**/*.py`` (including graftlint itself —
+    the linter holds itself to the house rules), and top-level scripts
+    (``bench*.py``, ``__graft_entry__.py``). ``tests/`` is excluded: tests
+    deliberately construct counterexamples (unknown event kinds, synthetic
+    violations) that are correct AS tests.
+    """
+    graph = ImportGraph(root)
+    graph.package = discover_package(root)
+
+    def add(relpath: str, name: str, *, is_package_init: bool = False) -> None:
+        full = os.path.join(root, relpath)
+        with open(full, encoding="utf-8") as f:
+            source = f.read()
+        graph.add_module(Module.parse(name, relpath.replace(os.sep, "/"),
+                                      source, is_package_init=is_package_init))
+
+    def walk_tree(base: str) -> None:
+        """Discover every .py under ``base`` (one rule for package AND tools)."""
+        for dirpath, dirnames, filenames in os.walk(os.path.join(root, base)):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in SKIP_DIRS and not d.startswith("."))
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, fname), root)
+                dotted = rel[:-3].replace(os.sep, ".")
+                is_init = fname == "__init__.py"
+                if is_init:
+                    dotted = dotted.rsplit(".", 1)[0]
+                add(rel, dotted, is_package_init=is_init)
+
+    walk_tree(graph.package)             # the package tree
+    if os.path.isdir(os.path.join(root, "tools")):
+        walk_tree("tools")               # tools/ scripts + graftlint itself
+
+    # Top-level scripts.
+    for entry in sorted(os.listdir(root)):
+        if entry.endswith(".py") and os.path.isfile(os.path.join(root, entry)):
+            add(entry, entry[:-3])
+
+    return graph

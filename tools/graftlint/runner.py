@@ -1,0 +1,55 @@
+"""The lint runner: graph once, every checker over every module, pragmas applied.
+
+Kept separate from the CLI so tests (and future tooling) drive a single
+function: ``run_lint(root)`` returns plain findings; exit codes, baselines,
+and rendering are the CLI's business.
+"""
+
+from __future__ import annotations
+
+from tools.graftlint.checkers import ALL_CHECKERS, CHECKS_BY_NAME
+from tools.graftlint.core import Checker, Finding
+from tools.graftlint.graph import ImportGraph, build_graph
+
+
+def run_lint(root: str, *, checks: list[str] | None = None,
+             graph: ImportGraph | None = None,
+             checkers: tuple[Checker, ...] | None = None,
+             ) -> tuple[list[Finding], ImportGraph]:
+    """Run the selected checkers over every discovered module.
+
+    ``checks`` filters by checker name (unknown names raise — a typo'd
+    ``--checks`` must not silently lint nothing). Pragma suppression happens
+    here, centrally: checkers report every violation they see and never read
+    pragmas themselves.
+    """
+    if graph is None:
+        graph = build_graph(root)
+    if checkers is None:
+        if checks:
+            unknown = sorted(set(checks) - set(CHECKS_BY_NAME))
+            if unknown:
+                raise ValueError(
+                    f"unknown check(s) {unknown}; known: "
+                    f"{sorted(CHECKS_BY_NAME)}")
+            checkers = tuple(CHECKS_BY_NAME[c] for c in checks)
+        else:
+            checkers = ALL_CHECKERS
+    findings: list[Finding] = []
+    seen: set[Finding] = set()
+    for name in sorted(graph.modules):
+        module = graph.modules[name]
+        for checker in checkers:
+            for finding in checker.visit(module, graph):
+                # Dedup: a repo-level problem (e.g. a missing event registry)
+                # is reported identically from several modules' visits.
+                if finding in seen:
+                    continue
+                seen.add(finding)
+                # Pragmas live in the file the finding points AT (a checker
+                # may attribute a repo-level problem to another module).
+                owner = (module if finding.path == module.path
+                         else graph.module_for_relpath(finding.path)) or module
+                if not owner.suppressed(finding.check, finding.line):
+                    findings.append(finding)
+    return sorted(findings), graph

@@ -43,23 +43,19 @@ from csed_514_project_distributed_training_using_pytorch_tpu.utils.telemetry imp
     percentiles as _percentiles,
 )
 
-
-SERVE_SERIES = ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s")
-SERVE_QS = (50, 95, 99)
-
 # Every event kind this reporter understands (or deliberately passes over,
 # like per-span trace lines — those render via tools/trace_report.py). Anything
 # outside this set is counted and surfaced in a footer: schema drift between a
-# writer and this reporter must be visible, not silently dropped.
-KNOWN_EVENTS = frozenset({
-    "manifest", "compile", "epoch", "health", "mfu", "bench",
-    "serve", "serve_config", "serve_summary", "prefill",
-    "route", "replica", "router_config", "router_summary", "fleet_snapshot",
-    "scale",
-    "checkpoint", "restart", "preempt", "supervise_summary",
-    "plan", "autotune", "span",
-    "train", "test",                      # loss-curve metrics.jsonl kinds
-})
+# writer and this reporter must be visible, not silently dropped. DERIVED from
+# the one registry every emitter is statically checked against
+# (utils/telemetry_events.py, enforced by tools/graftlint's telemetry-schema
+# checker) — this reporter can no longer disagree with the writers.
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.telemetry_events import (  # noqa: E402
+    KNOWN_EVENTS,
+)
+
+SERVE_SERIES = ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s")
+SERVE_QS = (50, 95, 99)
 
 
 def _median(xs: list) -> float | None:
