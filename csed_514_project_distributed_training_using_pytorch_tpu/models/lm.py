@@ -595,6 +595,133 @@ def prefill_chunk(model: TransformerLM, params, cache: dict, prompt: jax.Array,
     return cache
 
 
+def verify_chunk(model: TransformerLM, params, cache: dict, ids: jax.Array,
+                 t: jax.Array, draft: jax.Array, *, k: int
+                 ) -> tuple[dict, jax.Array]:
+    """Batched K-token verify: score ``k`` draft tokens per slot in ONE
+    fixed-shape causal forward over the slot planes — the program that lets
+    speculative decoding amortize each full-cache read over up to ``k + 1``
+    emitted tokens instead of one.
+
+    ``ids: [B]`` is each slot's last accepted token, ``t: [B]`` its position
+    (``decode_step_slots`` conventions), ``draft: [B, k]`` the drafter's
+    proposals for positions ``t+1 .. t+k``. ``k`` is the only STATIC argument
+    (one compile per configured width — the engine pins ``verify_trace_counts``
+    at <= 1 per ``k``); everything else is data. The chunk inputs are
+    ``[ids, d_1, .., d_k]`` at positions ``t .. t+k``; row ``j``'s log-probs
+    are the target distribution for the token AT position ``t+j`` — row 0
+    re-derives plain decode, rows ``1..k`` score the drafts, and the last row
+    is the bonus/correction distribution when every draft survives. Returns
+    ``(cache, log_probs [B, k+1, V])``; ACCEPTANCE is the caller's (the
+    engine's jitted verify program folds greedy prefix-match or rejection
+    sampling on top, so the accept rule is data too).
+
+    Cache semantics are ``prefill_chunk``'s, batched over slots: the chunk
+    bulk-writes all ``k+1`` rows into each slot's full ``[S]`` plane FIRST
+    (quantize-on-write with the identical per-head scale math when the planes
+    carry ``k_scale`` — a verify-written row is bit-identical to the row the
+    per-token path would have cached) and then attends against that plane
+    under the same per-position ``pos <= t+j`` (and sliding-window) mask and
+    einsum structure as ``decode_step_slots`` — token-identity of greedy
+    acceptance with sequential decode is by construction. Rows past
+    ``seq_len`` DROP (never clamp onto live rows). Rollback needs no cache
+    surgery: rows written for REJECTED drafts sit at positions strictly
+    beyond the new accepted position, and the next verify/decode step's
+    write-before-attend covers every such row before any query can see it —
+    accepted rows are never rewritten, rejected rows are never read.
+    """
+    s = model.seq_len
+    e, nh = model.embed_dim, model.num_heads
+    hd = e // nh
+    kvh = model.num_kv_heads or nh
+    rep = nh // kvh
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    if not 1 <= k < s:
+        raise ValueError(f"k {k} outside [1, {s})")
+    w = k + 1                                                    # chunk width
+    b = ids.shape[0]
+
+    x = jnp.concatenate([ids[:, None], draft], axis=1).astype(jnp.int32)  # [B,W]
+    positions = t[:, None] + jnp.arange(w, dtype=jnp.int32)      # [B, W]
+    safe_pos = jnp.clip(positions, 0, s - 1)
+    write_pos = jnp.where(positions < s, safe_pos, s)            # s = dropped
+    slot_idx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, w))
+
+    h = params["tok_embed"].astype(jnp.float32)[x]               # [B, W, E]
+    if not model.rope:
+        h = h + params["pos_embed"].astype(jnp.float32)[safe_pos]
+
+    pos_s = jnp.arange(s)[None, None]                            # [1, 1, S]
+    visible = pos_s <= positions[:, :, None]
+    if model.attention_window:
+        visible &= positions[:, :, None] - pos_s < model.attention_window
+    visible = visible[:, :, None, None, :]                       # [B, W, 1, 1, S]
+
+    def flat_dense(y, kern, bias):
+        # The projections run in the [rows, E] 2-D shape decode/prefill use, so
+        # the per-row numerics (and the w8a8 per-row activation quantization)
+        # are position-for-position identical to the per-token path.
+        return quant_ops.dense_any(y.reshape(b * w, -1), kern,
+                                   bias).reshape(b, w, -1)
+
+    for i in range(model.num_layers):
+        p = params[f"block_{i}"]
+        a = p["attn"]
+        xln = ops.layer_norm(h, p["ln1_scale"], p["ln1_bias"])
+        if kvh == nh:
+            qkv = flat_dense(xln, a["qkv_kernel"], a["qkv_bias"])  # [B, W, 3E]
+            q = qkv[..., :e].reshape(b, w, nh, hd)
+            kk = qkv[..., e:2 * e].reshape(b, w, kvh, hd)
+            v = qkv[..., 2 * e:].reshape(b, w, kvh, hd)
+        else:  # GQA: split projections, kvh-head K/V (the smaller cache)
+            q = flat_dense(xln, a["q_kernel"], a["q_bias"]).reshape(b, w, nh, hd)
+            kv = flat_dense(xln, a["kv_kernel"],
+                            a["kv_bias"]).reshape(b, w, 2, kvh, hd)
+            kk, v = kv[:, :, 0], kv[:, :, 1]
+        if model.rope:
+            q = apply_rotary(q, safe_pos)
+            kk = apply_rotary(kk, safe_pos)
+        layer = cache[f"block_{i}"]
+        quantized = "k_scale" in layer
+        if quantized:
+            kk, ks = quant_ops.quantize_rows(kk, layer["k"].dtype)
+            v, vs = quant_ops.quantize_rows(v, layer["v"].dtype)
+        # Bulk row scatter over (slot, position) pairs; out-of-range rows drop.
+        k_cache = layer["k"].at[slot_idx, write_pos].set(
+            kk.astype(layer["k"].dtype), mode="drop")
+        v_cache = layer["v"].at[slot_idx, write_pos].set(
+            v.astype(layer["v"].dtype), mode="drop")
+        new_layer = {"k": k_cache, "v": v_cache}
+        if quantized:
+            ks_cache = layer["k_scale"].at[slot_idx, write_pos].set(
+                ks, mode="drop")
+            vs_cache = layer["v_scale"].at[slot_idx, write_pos].set(
+                vs, mode="drop")
+            new_layer["k_scale"] = ks_cache
+            new_layer["v_scale"] = vs_cache
+            k_read = quant_ops.dequantize_rows(k_cache, ks_cache)
+            v_read = quant_ops.dequantize_rows(v_cache, vs_cache)
+        else:
+            k_read, v_read = k_cache, v_cache
+        cache = {**cache, f"block_{i}": new_layer}
+        qg = q.reshape(b, w, kvh, rep, hd)
+        scores = jnp.einsum("bwgrd,bsgd->bwgrs", qg * scale,
+                            k_read)                              # [B,W,G,R,S]
+        scores = jnp.where(visible, scores, MASK_VALUE)
+        weights = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bwgrs,bsgd->bwgrd", weights,
+                          v_read).reshape(b, w, e)
+        h = h + flat_dense(attn, a["out_kernel"], a["out_bias"])
+
+        xln = ops.layer_norm(h, p["ln2_scale"], p["ln2_bias"])
+        up = ops.gelu(flat_dense(xln, p["mlp_up_kernel"], p["mlp_up_bias"]))
+        h = h + flat_dense(up, p["mlp_down_kernel"], p["mlp_down_bias"])
+
+    h = ops.layer_norm(h, params["ln_f_scale"], params["ln_f_bias"])
+    logits = flat_dense(h, params["head_kernel"], params["head_bias"])
+    return cache, ops.log_softmax(logits.astype(jnp.float32))
+
+
 def reset_slots(cache: dict, fresh: jax.Array) -> dict:
     """Zero the K/V rows of the slots where ``fresh`` (``[B]`` bool) is set — slot
     recycling for the serving engine. Correctness never depends on it (the per-slot

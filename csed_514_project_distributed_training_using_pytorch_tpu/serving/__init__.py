@@ -12,6 +12,11 @@ this package applies the same fixed-shape discipline to inference (DESIGN.md §1
                    per-step chunk budget
 - ``prefix_cache`` host-side LRU of prefilled K/V planes keyed by prompt tokens —
                    repeated prompt prefixes (system prompts) skip prefill
+- ``spec``         speculative decoding (DESIGN.md §20): ``Drafter`` interface,
+                   host n-gram/prompt-lookup self-speculation, and a small
+                   draft-LM drafter — the engine's propose->verify->accept
+                   loop amortizes each full-cache read over up to
+                   ``spec_k + 1`` tokens, token-identical under greedy
 - ``scheduler``    thread-safe bounded request queue (no jax work; home of the
                    shared ``Request``/``SamplingParams`` types): backpressure
                    (``QueueFull``), per-request deadlines enforced while queued,

@@ -32,6 +32,18 @@ the decode loop one token per step — position ``t < prompt_len`` emits the pro
 token and still writes its K/V, exactly ``generate``'s prompt semantics. Both
 paths are pinned token-identical to sequential ``generate`` (the greedy-parity
 tests): chunked prefill is a schedule change, not a math change.
+
+**Speculative decoding** (``spec``/``spec_k``/``drafter`` — ``serving/spec/``,
+DESIGN.md §20) replaces the decode tick with propose->verify->accept: a
+drafter guesses up to ``spec_k`` tokens per slot and ONE fixed-shape verify
+program (``models.lm.verify_chunk`` + an on-device accept rule) emits the
+longest correct prefix plus a correction — 1..spec_k+1 tokens per full-cache
+read, still exactly one host sync per tick. Greedy acceptance is pinned
+token-identical to sequential ``generate``; temperature>0 uses exact rejection
+sampling (drafters are deterministic, so the residual is ``p`` with the draft
+masked). ``verify_trace_counts`` pins one trace per width the way
+``trace_count`` pins the decode program; rollback is position bookkeeping
+only (accepted rows never rewritten, rejected rows never readable).
 """
 
 from __future__ import annotations
@@ -54,6 +66,11 @@ from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention impor
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.prefix_cache import (
     PrefixCache,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.spec.drafter import (
+    Drafter,
+    NGramDrafter,
+    greedy_chunk_plan,
 )
 
 # The shared request types live in the jax-free scheduler module (the fleet
@@ -155,7 +172,10 @@ class ContinuousBatchingEngine:
                  prefill_chunk_budget: int = 1,
                  prefix_cache_entries: int = 0,
                  kv_dtype: str = "model",
-                 quant_policy: str = "off"):
+                 quant_policy: str = "off",
+                 spec: str = "off",
+                 spec_k: int = 4,
+                 drafter: Drafter | None = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.model = model
@@ -254,6 +274,63 @@ class ContinuousBatchingEngine:
         self._hit_len = np.zeros((b,), np.int32)
         self._chunks_done = np.zeros((b,), np.int32)
         self._prefill_records: list[dict] = []
+        # --- speculative decoding (serving/spec/) ----------------------------
+        # propose -> verify -> accept: a drafter guesses up to ``spec_k``
+        # tokens per slot, ONE fixed-shape verify program (the decode
+        # program's K-wide sibling) scores every guess against the target and
+        # emits the longest correct prefix plus a correction — 1..spec_k+1
+        # tokens per full-cache read. ``spec`` names the mode; ``drafter``
+        # injects the implementation (required for "draft-lm": the engine
+        # does not build draft models). The two must AGREE: an injected
+        # drafter with spec="off" (or a mode that isn't the drafter's) is
+        # refused, so an A/B harness toggling ``spec`` with a drafter held
+        # fixed can never silently run speculation on both sides.
+        if drafter is not None:
+            if spec == "off":
+                raise ValueError(
+                    "a drafter was injected but spec='off' — pass "
+                    "spec=drafter.name to enable it (speculation is never "
+                    "enabled implicitly)")
+            if spec != drafter.name:
+                raise ValueError(f"spec={spec!r} does not match the injected "
+                                 f"drafter's mode {drafter.name!r}")
+        elif spec == "draft-lm":
+            raise ValueError("spec='draft-lm' needs a constructed "
+                             "DraftLMDrafter passed as drafter=")
+        elif spec == "ngram":
+            drafter = NGramDrafter()
+        elif spec != "off":
+            raise ValueError(f"unknown spec mode {spec!r} "
+                             f"(choices: off, ngram, draft-lm — or inject a "
+                             f"custom drafter with spec=drafter.name)")
+        self.drafter = drafter
+        self.spec = "off" if drafter is None else drafter.name
+        self.spec_k = int(spec_k)
+        self.verify_trace_counts: dict[int, int] = {}   # per-width (pin <= 1)
+        self._verify_jits: dict[int, object] = {}
+        self.spec_steps = 0           # verify-program invocations
+        self.spec_slot_steps = 0      # per-slot verify participations
+        self.spec_proposed = 0        # draft tokens offered to verify
+        self.spec_accepted = 0        # draft tokens that survived verify
+        self.generated_tokens = 0     # emitted non-forced tokens (all modes)
+        self._spec_records: list[dict] = []
+        if self.drafter is not None:
+            if not 1 <= self.spec_k < model.seq_len:
+                raise ValueError(f"spec_k {self.spec_k} outside "
+                                 f"[1, {model.seq_len})")
+            if not self.prefill_chunk_sizes:
+                # Prefill-as-decode forces prompt tokens inside the decode
+                # program; the verify program has no forcing path (prompts
+                # enter via chunked prefill, the modern admission path).
+                raise ValueError("speculative decoding rides the "
+                                 "chunked-prefill path — enable "
+                                 "prefill_chunk_sizes to use it")
+            self.drafter.bind(num_slots=self.num_slots,
+                              vocab_size=model.vocab_size,
+                              seq_len=model.seq_len)
+            self._verify_jits[self.spec_k] = jax.jit(
+                functools.partial(self._verify_program, self.spec_k),
+                donate_argnums=(1,))
         self._install_jit = jax.jit(self._install_program, donate_argnums=(0,))
         self._snapshot_jit = jax.jit(
             lambda cache, slot: jax.tree_util.tree_map(lambda c: c[slot], cache))
@@ -292,6 +369,80 @@ class ContinuousBatchingEngine:
         forced = jnp.take_along_axis(
             prompt, jnp.clip(t, 0, model.seq_len - 1)[:, None], axis=1)[:, 0]
         return cache, jnp.where(t < prompt_len, forced, tok).astype(jnp.int32)
+
+    def _verify_program(self, k, params, cache, ids, t, fresh, draft,
+                        draft_len, temp, top_k, top_p, key):
+        """THE speculative step: verify ``k`` drafts per slot, accept, emit.
+
+        One fixed-shape program per configured width (``verify_trace_counts``
+        pins <= 1 per ``k``): ``models.lm.verify_chunk`` scores the chunk
+        ``[ids, d_1..d_k]`` and this wrapper folds the ACCEPT rule on device,
+        so the per-step host sync stays one fetch (tokens + counts):
+
+        - greedy (``temp <= 0``): accept the longest prefix where the draft
+          matches the target argmax; every emitted row IS the target argmax,
+          so the emitted stream is token-identical to sequential decode by
+          construction;
+        - temperature > 0: exact rejection sampling against the (temperature-
+          scaled, top-k/top-p filtered) target distribution ``p``. Drafts are
+          deterministic (one-hot proposal ``q``), so the rule reduces to:
+          accept ``d`` w.p. ``p(d)``, else resample from ``p`` with ``d``
+          masked (the normalized residual ``(p - q)^+``) — the emitted
+          distribution at every position is exactly ``p``, pinned by the
+          total-variation test in ``tests/test_spec.py``.
+
+        Returns ``(cache, tokens [B, k+1], counts [B])`` — ``counts[b]`` =
+        accepted drafts + 1 (the correction/bonus row), rows past it garbage
+        the host never reads. Invalid drafts (``j >= draft_len[b]``) can
+        never be accepted, so a slot with no proposals degenerates to plain
+        one-token decode through the same program.
+        """
+        self.verify_trace_counts[k] = self.verify_trace_counts.get(k, 0) + 1
+        model = self.model
+        cache = jax.lax.cond(jnp.any(fresh),
+                             lambda c: lm_mod.reset_slots(c, fresh),
+                             lambda c: c, cache)
+        cache, logp = lm_mod.verify_chunk(model, params, cache, ids, t,
+                                          draft, k=k)
+        # BOS is input-only, exactly as in the decode program.
+        logp = logp.at[:, :, model.vocab_size - 1].set(MASK_VALUE)
+        b, w, v = logp.shape
+        safe_temp = jnp.where(temp > 0.0, temp, 1.0)
+        # Per-slot sampling params broadcast over the chunk rows; the filter
+        # itself is the data-driven per-row one the decode program uses.
+        filt = filter_logits_per_slot(
+            (logp / safe_temp[:, None, None]).reshape(b * w, v),
+            jnp.repeat(top_k, w), jnp.repeat(top_p, w)).reshape(b, w, v)
+        greedy_tok = jnp.argmax(logp, axis=-1)                    # [B, W]
+        key_u, key_r, key_b = jax.random.split(key, 3)
+        probs = jax.nn.softmax(filt, axis=-1)
+        # Row j scores the draft for position t+j: draft[:, j].
+        p_draft = jnp.take_along_axis(probs[:, :k], draft[..., None],
+                                      axis=-1)[..., 0]            # [B, k]
+        valid = jnp.arange(k)[None] < draft_len[:, None]
+        acc_greedy = (greedy_tok[:, :k] == draft) & valid
+        acc_sample = (jax.random.uniform(key_u, (b, k)) < p_draft) & valid
+        acc = jnp.where((temp > 0.0)[:, None], acc_sample, acc_greedy)
+        accepted = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+        counts = accepted + 1
+        # Sampled emissions: accepted rows emit the draft; the stopping row
+        # emits the residual resample (draft masked) when a draft was
+        # rejected there, or a plain draw when the row had no draft (all
+        # proposals accepted / none offered). Greedy emits argmax everywhere
+        # (an accepted draft equals it; the stopping row is the correction).
+        masked = jnp.where(jax.nn.one_hot(draft, v, dtype=bool),
+                           MASK_VALUE, filt[:, :k])
+        resampled = jax.random.categorical(key_r, masked, axis=-1)   # [B, k]
+        plain = jax.random.categorical(key_b, filt, axis=-1)         # [B, W]
+        rows = jnp.arange(w)[None]
+        pad = jnp.zeros((b, 1), draft.dtype)
+        stop_tok = jnp.where(rows < draft_len[:, None],
+                             jnp.concatenate([resampled, pad], axis=1), plain)
+        sampled_tok = jnp.where(rows < accepted[:, None],
+                                jnp.concatenate([draft, pad], axis=1),
+                                stop_tok)
+        tokens = jnp.where((temp > 0.0)[:, None], sampled_tok, greedy_tok)
+        return cache, tokens.astype(jnp.int32), counts.astype(jnp.int32)
 
     def _prefill_program(self, chunk, params, cache, prompt, slot, start, length,
                          fresh):
@@ -348,16 +499,10 @@ class ContinuousBatchingEngine:
         ``[start, end)``: greedily the biggest configured chunk that fits, then
         the smallest chunk PADDED for the tail (padded rows' writes are dropped,
         never clamped) — so a single configured size ``c`` costs exactly
-        ``ceil((end - start) / c)`` invocations."""
-        plan = []
-        while start < end:
-            rem = end - start
-            fit = [c for c in self.prefill_chunk_sizes if c <= rem]
-            size = max(fit) if fit else self.prefill_chunk_sizes[0]
-            length = min(rem, size)
-            plan.append((start, length, size))
-            start += length
-        return plan
+        ``ceil((end - start) / c)`` invocations. Delegates to the one owner of
+        the rule (``serving.spec.drafter.greedy_chunk_plan`` — the draft LM's
+        prompt install uses the same plan on its own cache)."""
+        return greedy_chunk_plan(self.prefill_chunk_sizes, start, end)
 
     def admit(self, slot: int, request: Request, *,
               now: float | None = None) -> None:
@@ -436,6 +581,8 @@ class ContinuousBatchingEngine:
             self._ids[slot] = self.model.vocab_size - 1          # BOS restart
             self._t[slot] = 0
             self._out[slot] = []
+            if self.drafter is not None:         # spec mode implies p == 0 here
+                self.drafter.on_activate(slot, [])
         elif hit_len == p:
             # Full prefix hit: the installed planes ARE the prefill — the slot
             # joins decode at position p with zero chunk invocations.
@@ -466,6 +613,10 @@ class ContinuousBatchingEngine:
         self._t[slot] = p
         self._out[slot] = [int(x) for x in np.asarray(req.prompt, np.int32)]
         self._active[slot] = True
+        if self.drafter is not None:
+            # The drafter mirrors the slot's stream from here (the draft LM
+            # installs the prompt into its own cache via its chunk plan).
+            self.drafter.on_activate(slot, self._out[slot])
         self._ready_s[slot] = time.monotonic()
 
     def _record_prefill(self, slot: int, *, wall_s: float,
@@ -495,6 +646,12 @@ class ContinuousBatchingEngine:
             raise RuntimeError("reset_stats with requests in flight")
         self.steps = 0
         self.slot_steps = 0
+        self.generated_tokens = 0
+        self.spec_steps = 0
+        self.spec_slot_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self._spec_records = []
         self.prefill_invocations = 0
         self.prefill_tokens = 0
         self.prefill_wall_s = 0.0
@@ -605,6 +762,8 @@ class ContinuousBatchingEngine:
         self._out[slot] = []
         self._first_tok_s[slot] = None
         self._hit_len[slot] = 0
+        if self.drafter is not None:
+            self.drafter.on_release(slot)
         return comp
 
     # ------------------------------------------------------------------ stepping
@@ -670,8 +829,9 @@ class ContinuousBatchingEngine:
 
     def step(self) -> list[Completion]:
         """Advance the engine: up to ``prefill_chunk_budget`` prefill chunks,
-        then one decode step over every decode-ready slot; returns the requests
-        that finished. One host sync (the ``[num_slots]`` token fetch)."""
+        then one decode (or speculative propose->verify->accept) step over
+        every decode-ready slot; returns the requests that finished. One host
+        sync either way (the ``[num_slots]`` token/count fetch)."""
         if self.num_active == 0:
             return []
         if self.on_step is not None:
@@ -679,6 +839,8 @@ class ContinuousBatchingEngine:
         self._run_prefill()
         if not self._active.any():            # everything in flight is prefilling
             return []
+        if self.drafter is not None:
+            return self._spec_tick()
         self._key, sub = jax.random.split(self._key)
         fresh = self._active & (self._t == 0)
         self._cache, tok = self._step_jit(
@@ -697,11 +859,117 @@ class ContinuousBatchingEngine:
             self._out[i].append(int(tok[i]))
             if self._first_tok_s[i] is None and self._t[i] >= self._prompt_len[i]:
                 self._first_tok_s[i] = now
+            if self._t[i] >= self._prompt_len[i]:
+                self.generated_tokens += 1        # forced prompt rows are not
             self._t[i] += 1
             self._ids[i] = tok[i]
             if self._t[i] >= self._total_len[i]:
                 done.append(self._finish(i, "ok", now))
         return done
+
+    def _spec_tick(self) -> list[Completion]:
+        """One propose->verify->accept round: host drafts for every
+        decode-ready slot, ONE verify-program invocation over the full slot
+        batch, then per-slot variable acceptance. Rollback after a partial
+        acceptance is pure position bookkeeping (``_t`` advances by the
+        accepted count; the next verify's write-before-attend covers every
+        stale rejected row) — accepted cache rows are never rewritten."""
+        k = self.spec_k
+        b = self.num_slots
+        entries = [(i, self._out[i], int(self._ids[i]))
+                   for i in range(b) if self._active[i]]
+        draft = np.zeros((b, k), np.int32)
+        dlen = np.zeros((b,), np.int32)
+        t0 = time.monotonic()
+        for (i, _, _), d in zip(entries,
+                                self.drafter.propose_batch(entries, k)):
+            d = np.asarray(d, np.int32).reshape(-1)[:k]
+            # The verify window's LAST row always emits the correction/bonus,
+            # so only remaining-1 drafts can ever land — never draft past the
+            # request's budget.
+            room = int(self._total_len[i]) - int(self._t[i]) - 1
+            n = max(min(len(d), room), 0)
+            draft[i, :n] = d[:n]
+            dlen[i] = n
+        t_draft = time.monotonic()
+        self._key, sub = jax.random.split(self._key)
+        fresh = self._active & (self._t == 0)
+        self._cache, tok, counts = self._verify_jits[k](
+            self.params, self._cache, self._ids, self._t, fresh, draft, dlen,
+            self._temp, self._top_k, self._top_p, sub)
+        # THE per-step host sync, spec flavor: one tokens+counts fetch per
+        # verify tick (the decode tick's single sanctioned round-trip).
+        tok = np.asarray(tok)       # graftlint: disable=host-sync-hazard
+        counts = np.asarray(counts)  # graftlint: disable=host-sync-hazard
+        now = time.monotonic()
+        self.steps += 1
+        self.spec_steps += 1
+        self.slot_steps += self.num_active
+        done: list[Completion] = []
+        proposed = accepted = emitted = 0
+        for i in range(b):
+            if not self._active[i]:
+                continue
+            n = min(int(counts[i]), int(self._total_len[i]) - int(self._t[i]))
+            for x in tok[i, :n]:
+                self._out[i].append(int(x))
+            if self._first_tok_s[i] is None and n:
+                self._first_tok_s[i] = now
+            proposed += int(dlen[i])
+            accepted += max(n - 1, 0)
+            emitted += n
+            if self.tracer is not None:
+                req = self._requests[i]
+                self.tracer.span("draft", req.trace_id, t0, t_draft,
+                                 request_id=req.request_id, slot=i, k=k,
+                                 proposed=int(dlen[i]))
+                self.tracer.span("verify", req.trace_id, t_draft, now,
+                                 request_id=req.request_id, slot=i,
+                                 accepted=max(n - 1, 0), emitted=n)
+            self._t[i] += n
+            self._ids[i] = int(tok[i, n - 1])
+            if self._t[i] >= self._total_len[i]:
+                done.append(self._finish(i, "ok", now))
+        self.generated_tokens += emitted
+        self.spec_slot_steps += len(entries)
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self._spec_records.append({
+            "step": self.spec_steps, "active": len(entries),
+            "proposed": proposed, "accepted": accepted, "emitted": emitted,
+            "draft_wall_s": t_draft - t0, "verify_wall_s": now - t_draft})
+        return done
+
+    def spec_stats(self) -> dict | None:
+        """The speculative-decoding ledger (None with spec off): proposal /
+        acceptance totals, acceptance rate, and the headline
+        ``accepted_tokens_per_step`` — emitted tokens per SLOT per verify
+        invocation, i.e. how many tokens one slot's share of the full-cache
+        read amortized over. Plain decode is exactly 1.0 by construction, so
+        the number IS the per-request speedup lever."""
+        if self.drafter is None:
+            return None
+        return {
+            "mode": self.spec,
+            "k": self.spec_k,
+            "steps": self.spec_steps,
+            "slot_steps": self.spec_slot_steps,
+            "proposed": self.spec_proposed,
+            "accepted": self.spec_accepted,
+            "acceptance_rate": (self.spec_accepted / self.spec_proposed
+                                if self.spec_proposed else None),
+            "accepted_tokens_per_step": (
+                self.generated_tokens / self.spec_slot_steps
+                if self.spec_slot_steps else None),
+        }
+
+    def take_spec_records(self) -> list[dict]:
+        """Drain the per-step speculative accept stats (one dict per verify
+        invocation: active slots, proposed/accepted/emitted, draft+verify
+        wall) accumulated since the last call — the server emits them as
+        ``"spec"`` events."""
+        records, self._spec_records = self._spec_records, []
+        return records
 
     def expire(self, now: float | None = None) -> list[Completion]:
         """Force-finish in-flight requests whose deadline passed

@@ -132,13 +132,45 @@ def build_engine_server(args, trace: Tracer | str | None = None):
 
         params = checkpoint.load_params_or_state(args.checkpoint, params)
     chunk_sizes = tuple(int(x) for x in args.prefill_chunks.split(",") if x)
+    # Speculative decoding (serving/spec/): "ngram" is free host-side
+    # self-speculation; "draft-lm" builds a smaller TransformerLM sharing the
+    # tokenizer (defaults: 1 layer, half the embed width) from
+    # --draft-checkpoint or a seeded init.
+    spec = getattr(args, "spec", "off")
+    drafter = None
+    if spec == "draft-lm":
+        from csed_514_project_distributed_training_using_pytorch_tpu.serving.spec.draft_lm import (
+            DraftLMDrafter,
+        )
+
+        draft_model = lm.TransformerLM(
+            vocab_size=args.num_levels + 1, seq_len=args.seq_len,
+            embed_dim=args.draft_embed_dim or max(args.embed_dim // 2,
+                                                  args.num_heads),
+            num_layers=args.draft_layers,
+            num_heads=args.draft_heads or args.num_heads,
+            num_kv_heads=args.kv_heads or None,
+            attention_window=args.attention_window, rope=args.rope)
+        draft_params = draft_model.init(
+            {"params": jax.random.PRNGKey(args.seed + 1)},
+            jnp.zeros((1, draft_model.seq_len), jnp.int32))["params"]
+        if args.draft_checkpoint:
+            from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+                checkpoint,
+            )
+
+            draft_params = checkpoint.load_params_or_state(
+                args.draft_checkpoint, draft_params)
+        drafter = DraftLMDrafter(draft_model, draft_params,
+                                 chunk_sizes=chunk_sizes or (32, 128, 512))
     engine = ContinuousBatchingEngine(
         model, params, num_slots=args.num_slots, seed=args.seed,
         prefill_chunk_sizes=chunk_sizes,
         prefill_chunk_budget=args.prefill_budget,
         prefix_cache_entries=args.prefix_cache,
         kv_dtype=getattr(args, "kv_dtype", "model"),
-        quant_policy=getattr(args, "quant_policy", "off"))
+        quant_policy=getattr(args, "quant_policy", "off"),
+        spec=spec, spec_k=getattr(args, "spec_k", 4), drafter=drafter)
     # The serve-path resilience tick: kill/preempt/stall faults fire between
     # decode dispatches — mid-decode, with requests in flight.
     engine.on_step = lambda step: faults.on_tick(step=step)
@@ -300,9 +332,14 @@ def _handle_submit(msg, server, wfile, wlock):
 def _stats_payload(engine, server) -> dict:
     eng: dict = {"steps": engine.steps}
     for name in ("prefill_tokens", "prefill_invocations", "prefill_wall_s",
-                 "trace_count", "slot_occupancy", "prefill_backlog"):
+                 "trace_count", "slot_occupancy", "prefill_backlog",
+                 "generated_tokens"):
         if hasattr(engine, name):
             eng[name] = getattr(engine, name)
+    if hasattr(engine, "spec_stats"):
+        # Speculative-decoding ledger (None with spec off): the router folds
+        # accepted-tokens/step into fleet_snapshot and router_summary.
+        eng["spec"] = engine.spec_stats()
     cache = getattr(engine, "prefix_cache", None)
     eng["prefix_cache"] = cache.stats() if cache is not None else None
     if hasattr(engine, "byte_accounting"):
@@ -574,6 +611,21 @@ def main(argv: list[str] | None = None) -> int:
                    choices=("model", "fp32", "bf16", "int8", "fp8"))
     e.add_argument("--quant-policy", default="off",
                    choices=("off", "w8", "w8a8"))
+    e.add_argument("--spec", default="off",
+                   choices=("off", "ngram", "draft-lm"),
+                   help="speculative decoding: 'ngram' = host n-gram/prompt-"
+                        "lookup self-speculation (free), 'draft-lm' = a small "
+                        "draft LM sharing the tokenizer")
+    e.add_argument("--spec-k", type=int, default=4,
+                   help="draft tokens per verify step (the verify program's "
+                        "static width is spec_k + 1)")
+    e.add_argument("--draft-layers", type=int, default=1)
+    e.add_argument("--draft-embed-dim", type=int, default=0,
+                   help="draft LM embed dim (0 = half the target's)")
+    e.add_argument("--draft-heads", type=int, default=0,
+                   help="draft LM heads (0 = the target's)")
+    e.add_argument("--draft-checkpoint", default="",
+                   help="trained draft-LM params (default: seeded init)")
     e.add_argument("--warmup", type=int, default=1,
                    help="compile the decode/prefill/install programs before "
                         "accepting traffic (0 = off)")

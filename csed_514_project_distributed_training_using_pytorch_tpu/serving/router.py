@@ -1415,6 +1415,14 @@ class Router:
                     if by:
                         row["decode_bytes_per_token"] = \
                             by.get("decode_bytes_per_token")
+                    sp = eng.get("spec") or {}
+                    if sp:
+                        # Speculative decoding's load-relevant number: tokens
+                        # each slot's cache read amortized over (1.0 = plain
+                        # decode) — an acceptance collapse shows up here
+                        # before it shows up as tokens/s.
+                        row["spec_accepted_per_step"] = \
+                            sp.get("accepted_tokens_per_step")
                 per_replica.append(row)
         inflight = sum(r["inflight"] for r in per_replica)
         # Utilization is READY in-flight over READY capacity: a draining
@@ -1608,12 +1616,35 @@ class Router:
             series = {k: list(v) for k, v in self._series.items()}
         cache = {"queries": 0, "hits": 0, "hit_tokens": 0}
         have_cache = False
+        # Fleet-wide speculative-decoding ledger: the per-replica engine spec
+        # stats summed, with the derived rates recomputed over the sums (a
+        # mean of per-replica rates would weight an idle replica like a busy
+        # one).
+        spec = {"steps": 0, "slot_steps": 0, "proposed": 0, "accepted": 0,
+                "generated_tokens": 0}
+        spec_mode = None
         for row in per_replica:
-            pc = ((row["stats"] or {}).get("engine") or {}).get("prefix_cache")
+            eng = (row["stats"] or {}).get("engine") or {}
+            pc = eng.get("prefix_cache")
             if pc:
                 have_cache = True
                 for k in cache:
                     cache[k] += pc.get(k) or 0
+            sp = eng.get("spec")
+            if sp:
+                spec_mode = sp.get("mode")
+                spec_k = sp.get("k")
+                for k in ("steps", "slot_steps", "proposed", "accepted"):
+                    spec[k] += sp.get(k) or 0
+                spec["generated_tokens"] += eng.get("generated_tokens") or 0
+        if spec_mode is not None:
+            spec.update(
+                mode=spec_mode, k=spec_k,
+                acceptance_rate=(spec["accepted"] / spec["proposed"]
+                                 if spec["proposed"] else None),
+                accepted_tokens_per_step=(
+                    spec["generated_tokens"] / spec["slot_steps"]
+                    if spec["slot_steps"] else None))
         routed = counts["requests"]
         with self._lock:
             scale = dict(self._scale_counts)
@@ -1639,6 +1670,7 @@ class Router:
             "replica_restarts": sum(r["restarts"] for r in per_replica),
             "per_replica": per_replica,
             "prefix_cache": cache if have_cache else None,
+            "spec": spec if spec_mode is not None else None,
             "queue": self.queue.snapshot(),
             "ttft_s": percentiles(series["ttft_s"]),
             "e2e_s": percentiles(series["e2e_s"]),

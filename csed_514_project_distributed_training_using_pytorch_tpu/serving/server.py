@@ -112,6 +112,9 @@ class Server:
                                      if self.engine.prefix_cache else 0),
             "kv_dtype": self.engine.quant.kv_dtype,
             "quant_policy": self.engine.quant.weights,
+            "spec": self.engine.spec,
+            "spec_k": (self.engine.spec_k
+                       if self.engine.drafter is not None else None),
         })
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-loop")
@@ -298,6 +301,8 @@ class Server:
                     self._resolve(comp)
                 for rec in eng.take_prefill_records():
                     self._writer.emit(T.prefill_event(**rec))
+                for rec in eng.take_spec_records():
+                    self._writer.emit(T.spec_event(**rec))
             elif len(self.queue) == 0 and self.queue.closed:
                 break
             else:
@@ -310,6 +315,9 @@ class Server:
         self._writer.emit(T.serve_summary_event(
             **self._counts, wall_s=wall_s,
             steps=eng.steps,
+            decode_invocations=eng.steps,
+            generated_tokens=eng.generated_tokens,
+            spec=eng.spec_stats(),
             slot_occupancy=eng.slot_occupancy,
             prefill_tokens=eng.prefill_tokens,
             prefill_chunks=eng.prefill_invocations,

@@ -1,0 +1,45 @@
+"""Speculative decoding: drafters + the engine's batched K-token verify loop.
+
+Decode at full batch is HBM-bound — every emitted token pays one full KV-cache
+read (DESIGN.md §16 minimized the bytes; §20 amortizes them). This package
+holds the PROPOSE side: a :class:`Drafter` guesses the next ``k`` tokens per
+slot, the engine scores all guesses in one fixed-shape verify program
+(``models.lm.verify_chunk``) and keeps the longest correct prefix plus a
+correction token — up to ``k + 1`` tokens per cache read, token-identical to
+sequential decode under greedy acceptance, distribution-preserving rejection
+sampling at temperature > 0.
+
+- ``drafter``   the interface + :class:`NGramDrafter` (host-side n-gram /
+                prompt-lookup self-speculation — free, numpy-only, the chat /
+                shared-prefix workload's big win)
+- ``draft_lm``  :class:`DraftLMDrafter` — a small ``TransformerLM`` sharing
+                the target's tokenizer, with its own slot cache and one
+                compiled greedy draft-step program
+
+Imports are lazy (PEP 562, the serving package's own convention): the n-gram
+drafter never pays for jax, and importing this package builds nothing.
+"""
+
+_EXPORTS = {
+    "Drafter": "drafter",
+    "NGramDrafter": "drafter",
+    "greedy_chunk_plan": "drafter",
+    "DraftLMDrafter": "draft_lm",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    if name not in _EXPORTS:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(f"{__name__}.{_EXPORTS[name]}")
+    value = getattr(mod, name)
+    globals()[name] = value          # cache: subsequent lookups skip __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,175 @@
+"""Draft-LM drafter: a small ``TransformerLM`` proposes, the big one verifies.
+
+The second :class:`~..spec.drafter.Drafter` implementation: a cheaper model
+sharing the target's tokenizer (same vocab, same ``seq_len``, same BOS
+convention) greedily decodes ``k`` tokens ahead, and the target's batched
+``verify_chunk`` keeps whichever prefix it agrees with. Where the n-gram
+drafter only exploits verbatim repetition, a draft LM generalizes — it can
+accept-ahead on anything the small model predicts the way the big one does.
+
+The drafter is a miniature of the engine's own fixed-shape discipline:
+
+- ONE jitted greedy draft-step program (``decode_step_slots`` + argmax) over
+  the full ``[num_slots]`` batch — proposing ``k`` tokens is ``k`` invocations
+  of that one program (``step_trace_count`` pinned <= 1);
+- its own per-slot KV cache and ``[num_slots, S]`` prompt buffer, prompt
+  installs via the SAME greedy chunk plan (``greedy_chunk_plan``) through
+  ``models.lm.prefill_chunk`` — one compile per configured size
+  (``prefill_trace_counts`` <= 1 each);
+- rollback is position bookkeeping only, exactly like the target cache:
+  proposing wrote rows ``t .. t+k-1``; after the engine accepts ``a`` drafts
+  plus a correction, rows up to the new position hold accepted inputs and
+  every stale row beyond it is overwritten by the next propose's
+  write-before-attend steps before any query can read it. The drafter never
+  receives (or needs) an explicit rollback call — ``propose_batch`` reads
+  each slot's position straight off the accepted stream length.
+
+Inactive slots ride along at a parked position (fixed shapes beat a dynamic
+batch); their clamped writes land on rows that are rewritten before they can
+become visible — the engine's own parking argument.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.spec.drafter import (
+    Drafter,
+    greedy_chunk_plan,
+)
+
+
+class DraftLMDrafter(Drafter):
+    """``model``/``params``: the draft ``models.lm.TransformerLM`` (typically
+    1 layer / half the embed width) and its weights — a trained checkpoint
+    via ``utils.checkpoint.load_params_or_state``, or the target's own params
+    in tests (the perfect-drafter limit). Buffers are sized at :meth:`bind`
+    (the engine calls it with its slot count), so construction stays cheap."""
+
+    name = "draft-lm"
+
+    def __init__(self, model, params, *,
+                 chunk_sizes: tuple[int, ...] = (32, 128, 512)):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self._chunk_sizes = tuple(chunk_sizes)
+        self.step_trace_count = 0                 # pinned <= 1
+        self.prefill_trace_counts: dict[int, int] = {}   # pinned <= 1 per size
+        self._cache = None                        # built at bind()
+
+    # ------------------------------------------------------------------ programs
+
+    def _step_program(self, params, cache, ids, t):
+        import jax.numpy as jnp
+
+        from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+            lm as lm_mod,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
+            MASK_VALUE,
+        )
+
+        self.step_trace_count += 1                # fires per TRACE only
+        cache, logp = lm_mod.decode_step_slots(self.model, params, cache,
+                                               ids, t)
+        # BOS is input-only for the draft exactly as for the target: a BOS
+        # proposal could never be accepted (the verify program masks it), so
+        # drafting it would only burn a speculated position.
+        logp = logp.at[:, self.model.vocab_size - 1].set(MASK_VALUE)
+        return cache, jnp.argmax(logp, axis=-1).astype(jnp.int32)
+
+    def _prefill_program(self, chunk, params, cache, prompt, slot, start,
+                         length, fresh):
+        from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+            lm as lm_mod,
+        )
+
+        self.prefill_trace_counts[chunk] = \
+            self.prefill_trace_counts.get(chunk, 0) + 1
+        return lm_mod.prefill_chunk(self.model, params, cache, prompt, slot,
+                                    start, length, fresh, chunk=chunk)
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def bind(self, *, num_slots: int, vocab_size: int, seq_len: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+            lm as lm_mod,
+        )
+
+        if self.model.vocab_size != vocab_size:
+            raise ValueError(
+                f"draft LM vocab {self.model.vocab_size} != target "
+                f"{vocab_size} — speculation needs a shared tokenizer")
+        if self.model.seq_len != seq_len:
+            raise ValueError(f"draft LM seq_len {self.model.seq_len} != "
+                             f"target {seq_len}")
+        self.num_slots = int(num_slots)
+        self.seq_len = int(seq_len)
+        self._cache = lm_mod.init_cache(self.model, self.num_slots)
+        self._prompt = jnp.zeros((self.num_slots, self.seq_len), jnp.int32)
+        sizes = {min(int(c), self.seq_len) for c in self._chunk_sizes}
+        if any(c < 1 for c in sizes):
+            raise ValueError(f"draft chunk sizes must be >= 1, "
+                             f"got {self._chunk_sizes}")
+        self._sizes = tuple(sorted(sizes))
+        self._prefill_jits = {
+            c: jax.jit(functools.partial(self._prefill_program, c),
+                       donate_argnums=(1,))
+            for c in self._sizes}
+        self._step_jit = jax.jit(self._step_program, donate_argnums=(1,))
+        self._set_prompt_row = jax.jit(
+            lambda buf, slot, row: buf.at[slot].set(row),
+            donate_argnums=(0,))
+
+    def on_activate(self, slot: int, tokens: list[int]) -> None:
+        """Install the slot's prompt into the draft cache: one prompt-row
+        scatter plus the greedy chunk plan through the draft's own
+        ``prefill_chunk`` jits (``fresh`` on the first chunk wipes the
+        recycled slot's planes, the engine's own recycling hygiene)."""
+        p = len(tokens)
+        if p == 0:
+            return          # nothing cached yet; write-before-attend covers it
+        row = np.zeros((self.seq_len,), np.int32)
+        row[:p] = np.asarray(tokens, np.int32)
+        self._prompt = self._set_prompt_row(self._prompt, np.int32(slot), row)
+        for start, length, size in greedy_chunk_plan(self._sizes, 0, p):
+            self._cache = self._prefill_jits[size](
+                self.params, self._cache, self._prompt, np.int32(slot),
+                np.int32(start), np.int32(length),
+                np.asarray(start == 0))
+
+    # ------------------------------------------------------------------ propose
+
+    def propose_batch(self, entries: list[tuple[int, list[int], int]],
+                      k: int) -> list[np.ndarray]:
+        """``k`` greedy draft tokens per active slot: ``k`` invocations of the
+        ONE draft-step program over the full ``[num_slots]`` batch. Step ``j``
+        feeds each slot its previous guess at position ``t+j`` (writing the
+        draft cache row as it goes — the rows the NEXT round's
+        write-before-attend makes stale-safe), so the proposals are exactly
+        what greedy ``generate`` on the draft model would emit next."""
+        if self._cache is None:
+            raise RuntimeError("DraftLMDrafter.bind() was never called")
+        if not entries:
+            return []
+        ids = np.zeros((self.num_slots,), np.int32)
+        t = np.full((self.num_slots,), self.seq_len - 1, np.int32)   # parked
+        for slot, tokens, last in entries:
+            ids[slot] = last
+            t[slot] = min(len(tokens), self.seq_len - 1)
+        drafts = np.zeros((self.num_slots, k), np.int32)
+        for j in range(k):
+            self._cache, tok = self._step_jit(
+                self.params, self._cache, ids,
+                np.minimum(t + j, self.seq_len - 1).astype(np.int32))
+            ids = np.asarray(tok)
+            drafts[:, j] = ids
+        return [drafts[slot] for slot, _, _ in entries]

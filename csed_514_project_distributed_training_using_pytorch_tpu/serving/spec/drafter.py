@@ -1,0 +1,134 @@
+"""Drafters: cheap token proposals for the engine's speculative decode loop.
+
+A drafter guesses the next ``k`` tokens of each in-flight slot; the engine's
+batched verify program (``models.lm.verify_chunk``) then scores all guesses in
+ONE fixed-shape forward and accepts the longest correct prefix plus a
+correction token. Wrong guesses cost nothing extra on the device — the verify
+program's shape (and therefore its compute AND its full-cache HBM read, the
+resource speculation exists to amortize) is fixed at ``k`` regardless of how
+many proposals are real or right — so a drafter's job is purely to maximize
+the accepted prefix, never to ration proposals.
+
+Drafters are DETERMINISTIC by contract: each proposal is a pure function of
+the slot's emitted stream (argmax for the draft LM, exact lookup for n-gram).
+That keeps the draft distribution a point mass, which is what makes the
+engine's rejection-sampling rule exact (accept ``d`` with probability
+``p(d)``, else resample from ``p`` with ``d`` masked — the residual of a
+one-hot proposal) and keeps greedy speculative decode token-identical to
+sequential ``generate`` (an accepted draft IS the target argmax).
+
+This module is numpy-only (the n-gram drafter is pure host work — "free"
+speculation); the jax-backed draft-LM drafter lives in
+``serving/spec/draft_lm.py`` so importing the interface never builds a model.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_EMPTY = np.zeros((0,), np.int32)
+
+
+def greedy_chunk_plan(sizes: tuple[int, ...], start: int,
+                      end: int) -> list[tuple[int, int, int]]:
+    """``(start, length, chunk_size)`` triples covering ``[start, end)``:
+    greedily the biggest configured size that fits, then the smallest size
+    PADDED for the tail. The ONE owner of the chunk-plan rule —
+    ``serving.engine.ContinuousBatchingEngine.plan_prefill`` and the draft
+    LM's prompt install both delegate here, so a single configured size ``c``
+    always costs exactly ``ceil((end - start) / c)`` invocations on both
+    caches."""
+    plan = []
+    while start < end:
+        rem = end - start
+        fit = [c for c in sizes if c <= rem]
+        size = max(fit) if fit else sizes[0]
+        length = min(rem, size)
+        plan.append((start, length, size))
+        start += length
+    return plan
+
+
+class Drafter:
+    """The drafter interface. ``propose_batch`` is the engine's per-step call;
+    the default fans out to per-slot :meth:`propose`, which host-side drafters
+    implement. Lifecycle hooks let stateful drafters (the draft LM's own KV
+    cache) mirror the engine's slot churn; the base class ignores them, so a
+    stateless drafter is just a ``propose`` method.
+
+    ``tokens`` arguments are the slot's full emitted stream so far (teacher-
+    forced prompt included) as a list of ints — rollback after a partial
+    acceptance is already folded in (the stream only ever contains ACCEPTED
+    tokens), so drafters never see, and never need to undo, a rejected guess.
+    """
+
+    name = "none"
+
+    def bind(self, *, num_slots: int, vocab_size: int, seq_len: int) -> None:
+        """Called once by the engine before serving: validate compatibility
+        and size any per-slot state."""
+
+    def on_activate(self, slot: int, tokens: list[int]) -> None:
+        """``slot`` enters the decode batch with ``tokens`` already emitted
+        (its teacher-forced prompt; empty for promptless requests)."""
+
+    def on_release(self, slot: int) -> None:
+        """``slot``'s occupant finished/expired; the slot may be recycled.
+        Called for every release, including occupants that never activated
+        (a mid-prefill expiry) — must tolerate unknown slots."""
+
+    def propose(self, slot: int, tokens: list[int], last: int,
+                k: int) -> np.ndarray:
+        """Up to ``k`` draft tokens continuing ``tokens`` (``last`` is the
+        final accepted token — ``tokens[-1]``, or BOS's stand-in for an empty
+        stream). Fewer (or zero) proposals are always legal."""
+        raise NotImplementedError
+
+    def propose_batch(self, entries: list[tuple[int, list[int], int]],
+                      k: int) -> list[np.ndarray]:
+        """Proposals for every active slot: ``entries`` is
+        ``[(slot, tokens, last), ...]``; returns one array (possibly empty)
+        per entry, in order. Batched drafters (the draft LM) override this
+        with one fixed-shape program per draft position."""
+        return [self.propose(slot, tokens, last, k)
+                for slot, tokens, last in entries]
+
+
+class NGramDrafter(Drafter):
+    """Host-side n-gram / prompt-lookup self-speculation — drafting for free.
+
+    The guess: the stream's trailing n-gram has occurred before, so propose
+    the tokens that followed its most recent earlier occurrence. No model, no
+    device work, no training — pure numpy over a <= ``seq_len``-token history
+    — yet it is the known big win exactly where serving traffic is redundant:
+    chat turns that resubmit prior context (``serve_loadgen --scenario
+    chat``), shared system-prompt prefixes, and low-entropy spans the target
+    model reproduces verbatim (for the pixel LM, the long constant background
+    runs of every digit image). Tries the longest configured suffix first
+    (``max_n`` down to ``min_n``); no match proposes nothing, which
+    degenerates that slot's verify step to plain decode — speculation never
+    costs a token."""
+
+    name = "ngram"
+
+    def __init__(self, *, max_n: int = 3, min_n: int = 1):
+        if not 1 <= min_n <= max_n:
+            raise ValueError(f"need 1 <= min_n <= max_n, "
+                             f"got min_n={min_n} max_n={max_n}")
+        self.max_n = int(max_n)
+        self.min_n = int(min_n)
+
+    def propose(self, slot: int, tokens: list[int], last: int,
+                k: int) -> np.ndarray:
+        hist = np.asarray(tokens, np.int32)
+        m = len(hist)
+        for n in range(min(self.max_n, m - 1), self.min_n - 1, -1):
+            pat = hist[m - n:]
+            # Windows starting at 0 .. m-n-1 (the suffix itself, at m-n, is
+            # excluded — matching it would propose the pattern's own tail).
+            windows = np.lib.stride_tricks.sliding_window_view(hist, n)[:-1]
+            hits = np.flatnonzero((windows == pat).all(axis=1))
+            if hits.size:
+                i = int(hits[-1])                 # most recent occurrence
+                return hist[i + n:i + n + k].astype(np.int32).copy()
+        return _EMPTY

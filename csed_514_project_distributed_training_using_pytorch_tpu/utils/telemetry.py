@@ -556,8 +556,35 @@ def prefill_event(*, request_id: int, prompt_len: int, chunks: int, tokens: int,
     }
 
 
+
+def spec_event(*, step: int, active: int, proposed: int, accepted: int,
+               emitted: int, draft_wall_s: float | None = None,
+               verify_wall_s: float | None = None) -> dict:
+    """One speculative verify step (``serving/engine.py`` spec mode):
+    ``active`` slots offered ``proposed`` draft tokens, ``accepted`` of them
+    survived verification and ``emitted`` tokens landed (accepted drafts plus
+    one correction/bonus per slot). ``emitted_per_slot`` is the step's
+    amortization factor — tokens emitted per slot per full-cache read; its
+    FLOOR is 1.0 even at zero acceptance (the correction token always lands),
+    so monitor acceptance from ``accepted``/``proposed``, not from it."""
+    return {
+        "event": "spec",
+        "step": int(step),
+        "active": int(active),
+        "proposed": int(proposed),
+        "accepted": int(accepted),
+        "emitted": int(emitted),
+        "emitted_per_slot": _finite(emitted / active if active else None),
+        "draft_wall_s": _finite(draft_wall_s),
+        "verify_wall_s": _finite(verify_wall_s),
+    }
+
+
 def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int,
                         wall_s: float | None, steps: int | None = None,
+                        decode_invocations: int | None = None,
+                        generated_tokens: int | None = None,
+                        spec: dict | None = None,
                         slot_occupancy: float | None = None,
                         prefill_tokens: int | None = None,
                         prefill_chunks: int | None = None,
@@ -585,6 +612,17 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
         "tokens_per_s": _finite(new_tokens / wall_s
                                 if new_tokens and wall_s else None),
         "steps": int(steps) if steps is not None else None,
+        # Multi-token decode steps (speculative decoding) break the historical
+        # steps == tokens 1:1: report PROGRAM INVOCATIONS and GENERATED TOKENS
+        # as separate counters so tokens/s and MFU math stay honest at K>1.
+        "decode_invocations": (int(decode_invocations)
+                               if decode_invocations is not None else None),
+        "generated_tokens": (int(generated_tokens)
+                             if generated_tokens is not None else None),
+        "tokens_per_invocation": _finite(
+            generated_tokens / decode_invocations
+            if generated_tokens and decode_invocations else None),
+        "spec": spec,
         "slot_occupancy": _finite(slot_occupancy),
         "prefill_tokens": int(prefill_tokens) if prefill_tokens is not None
         else None,

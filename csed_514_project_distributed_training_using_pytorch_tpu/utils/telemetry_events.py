@@ -42,6 +42,7 @@ EVENT_KINDS: dict[str, str] = {
     "serve_config": "once per serving run: engine/model knobs (serving/server.py)",
     "serve_summary": "once per serving run at drain: aggregates + percentiles",
     "prefill": "one completed prompt prefill: chunks/tokens/cache-hit/wall",
+    "spec": "one speculative verify step: slots, proposed/accepted/emitted",
     # -- serving: fleet router (serving/router.py via utils/jsonl.py) -----------
     "route": "one routed request: replica, affinity, redispatches, finish",
     "replica": "replica lifecycle transition: start/fail/restart/dead",

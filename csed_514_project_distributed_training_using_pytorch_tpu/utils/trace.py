@@ -20,8 +20,10 @@ tracing plane that answers that:
   affinity/spill-over attrs), ``dispatch`` (send → completion line, per hop),
   ``redispatch`` (a drained hop: hop number + cause crash/preempt/hang),
   ``prefill`` (per chunk, with ``cache_hit_len``), ``decode`` (decode-ready →
-  done, with the first-token split), ``resolve`` (completion → future
-  resolution);
+  done, with the first-token split), ``draft``/``verify`` (speculative
+  decoding's children of the decode window — per verify tick: host drafting
+  wall, then the batched verify program, with proposed/accepted counts),
+  ``resolve`` (completion → future resolution);
 - **clock anchoring**: timestamps are ``time.monotonic()`` stamps shifted by a
   per-process anchor ``time.time() - time.monotonic()`` captured once at
   Tracer construction. Durations keep monotonic fidelity (immune to NTP
@@ -162,8 +164,13 @@ LIFECYCLE_SPANS = ("scale", "reload")
 # Critical-path segments, in pipeline order. ``dispatch`` spans OVERLAP the
 # replica-side work they contain, so the breakdown uses the replica's own
 # spans for the covered interior and charges only the remainder to overhead.
+# ``draft``/``verify`` are the speculative-decoding children of the decode
+# window (per verify tick: host drafting wall, then the batched verify
+# program) — carved OUT of decode_first/decode_tail below so the segments
+# stay exclusive and still sum to e2e.
 SEGMENTS = ("router_queue_wait", "route", "failed_dispatch", "replica_queue_wait",
-            "prefill", "decode_first", "decode_tail", "resolve", "overhead")
+            "prefill", "draft", "verify", "decode_first", "decode_tail",
+            "resolve", "overhead")
 
 
 def trace_breakdown(spans: list[dict]) -> dict:
@@ -215,6 +222,16 @@ def trace_breakdown(spans: list[dict]) -> dict:
         dur = d.get("dur_s") or 0.0
         seg["decode_first"] += dur if first is None else min(first, dur)
         seg["decode_tail"] += 0.0 if first is None else max(0.0, dur - first)
+    # Speculative decoding's draft/verify spans lie INSIDE the decode window:
+    # charge them to their own segments and carve the same seconds out of the
+    # decode split (tail first — drafting happens throughout, but the tail is
+    # where the bulk of the window lives), so the sum stays exactly e2e.
+    seg["draft"] = total("draft")
+    seg["verify"] = total("verify")
+    carve = seg["draft"] + seg["verify"]
+    take = min(seg["decode_tail"], carve)
+    seg["decode_tail"] -= take
+    seg["decode_first"] = max(0.0, seg["decode_first"] - (carve - take))
     seg["resolve"] = total("resolve")
     e2e = end - start
     seg["overhead"] = max(0.0, e2e - sum(seg.values()))

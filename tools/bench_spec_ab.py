@@ -1,0 +1,302 @@
+"""Speculative-decoding A/B on the chat scenario — the committed-artifact bench.
+
+Runs the SAME seeded multi-turn chat workload (``tools/serve_loadgen.py
+--scenario chat`` semantics: each turn resubmits prior context + the model's
+reply + fresh user tokens — the traffic n-gram self-speculation exists for)
+through a spec-off and a spec-on serving stack built from a REAL checkpoint,
+and writes one JSON document with the three numbers the subsystem is judged
+by:
+
+- **token_match_rate** — greedy speculative decode must be token-identical to
+  plain decode (1.0, compared request-by-request across the two runs);
+- **accepted_tokens_per_step** — emitted tokens per slot per verify-program
+  invocation (plain decode is exactly 1.0; every 0.1 above it is cache-read
+  amortization);
+- **invocation_ratio** — decode program invocations per generated token,
+  A over B (>= 1.5x fewer invocations is the acceptance bar: the per-request
+  HBM lever, since each invocation streams the full KV working set).
+
+The engine-level pair runs in-process (deterministic, counters readable);
+``--fleet`` additionally drives a 2-replica router fleet through
+``serve_loadgen`` for both sides and embeds the fleet summaries (fleet-wide
+tokens/s + the router's aggregated spec ledger). Without ``--checkpoint`` the
+tool first trains the pixel LM on the committed MNIST IDX fixture
+(``train.lm``, the quant A/B's recipe) so the artifact always reflects a
+trained model, not a random init.
+
+Usage::
+
+    python tools/bench_spec_ab.py --out bench_results/spec_ab_cpu.json
+    python tools/bench_spec_ab.py --checkpoint results/model_lm.ckpt --fleet
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURE = os.path.join(_REPO, "tests", "fixtures", "mnist_idx")
+
+
+def ensure_checkpoint(args) -> str:
+    """``--checkpoint`` verbatim, else train the default pixel LM on the
+    committed MNIST fixture (real gradients, real perplexity — the artifact's
+    'real checkpoint' requirement) and return the saved TrainState path."""
+    if args.checkpoint:
+        return args.checkpoint
+    cached = os.path.join(args.workdir, "model_lm.ckpt")
+    if os.path.exists(cached):
+        print(f"reusing trained checkpoint {cached}")
+        return cached
+    from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+        lm as lm_train,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+        LMConfig,
+    )
+
+    os.makedirs(args.workdir, exist_ok=True)
+    # The committed fixture is 128 train / 100 test images; both batch knobs
+    # must divide their splits.
+    cfg = LMConfig(epochs=args.train_epochs, batch_size=32, eval_batch=50,
+                   data_dir=args.data_dir, generate=0,
+                   results_dir=args.workdir,
+                   images_dir=os.path.join(args.workdir, "images"))
+    print(f"training checkpoint: {args.train_epochs} epochs on {args.data_dir}")
+    lm_train.main(cfg)
+    return os.path.join(args.workdir, "model_lm.ckpt")
+
+
+def chat_args(args):
+    """The ``run_chat`` knob namespace (mirrors serve_loadgen's chat flags)."""
+    return argparse.Namespace(
+        seed=args.seed, sessions=args.sessions, turns=args.turns,
+        turn_user_tokens=4, max_new_tokens=args.max_new_tokens,
+        seq_len=784, temperature=0.0, top_k=0, top_p=1.0,
+        prompt_dist="custom", prompt_lens=args.prompt_lens)
+
+
+def run_side(model, params, args, loadgen, *, spec: str) -> tuple[dict, dict]:
+    """One in-process chat run; returns (metrics, completions-by-prompt)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        ContinuousBatchingEngine,
+        Request,
+        Server,
+    )
+
+    kw = {}
+    if spec != "off":
+        kw = dict(spec=spec, spec_k=args.spec_k)
+        if spec == "draft-lm":
+            # The replica's draft-LM recipe: 1 layer, half the embed width,
+            # seeded init (acceptance is the draft model's quality — train
+            # one and point the fleet legs' --draft-checkpoint at it for a
+            # serious draft-LM artifact; ngram is the committed default).
+            import jax
+            import jax.numpy as jnp
+
+            from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+                lm,
+            )
+            from csed_514_project_distributed_training_using_pytorch_tpu.serving.spec.draft_lm import (
+                DraftLMDrafter,
+            )
+
+            dm = lm.TransformerLM(vocab_size=model.vocab_size,
+                                  seq_len=model.seq_len,
+                                  embed_dim=model.embed_dim // 2,
+                                  num_layers=1, num_heads=model.num_heads)
+            dp = dm.init({"params": jax.random.PRNGKey(args.seed + 1)},
+                         jnp.zeros((1, dm.seq_len), jnp.int32))["params"]
+            kw["drafter"] = DraftLMDrafter(dm, dp)
+    engine = ContinuousBatchingEngine(model, params,
+                                      num_slots=args.num_slots, **kw)
+    # Warmup: compile decode/verify + every chunk size, then measure from a
+    # clean ledger (the loadgen --warmup recipe).
+    rng = np.random.default_rng(args.seed + 17)
+    warm = rng.integers(0, model.vocab_size - 1, size=48).astype(np.int32)
+    engine.run([Request(prompt=warm, max_new_tokens=4)])
+    engine.run([Request(prompt=np.zeros(0, np.int32), max_new_tokens=2)])
+    engine.reset_stats()
+    server = Server(engine).start()
+    t0 = time.monotonic()
+    comps, rejected, _ = loadgen.run_chat(server, chat_args(args),
+                                          model.vocab_size)
+    wall = time.monotonic() - t0
+    server.stop()
+    assert rejected == 0 and all(c.ok for c in comps)
+    new_tokens = sum(c.new_tokens for c in comps)
+    metrics = {
+        "spec": spec,
+        "spec_k": args.spec_k if spec != "off" else None,
+        "requests": len(comps),
+        "new_tokens": new_tokens,
+        "wall_s": wall,
+        "tokens_per_s": new_tokens / wall,
+        "decode_invocations": engine.steps,
+        "generated_tokens": engine.generated_tokens,
+        "invocations_per_token": engine.steps / engine.generated_tokens,
+        "spec_stats": engine.spec_stats(),
+        "decode_compilations": engine.trace_count,
+        "verify_compilations": dict(engine.verify_trace_counts),
+        "prefill_compilations": dict(engine.prefill_trace_counts),
+    }
+    by_prompt = {}
+    for c in comps:
+        by_prompt[tuple(int(x) for x in c.request.prompt)] = \
+            np.asarray(c.tokens, np.int32)
+    return metrics, by_prompt
+
+
+def run_fleet_side(args, loadgen, ckpt: str, *, spec: str) -> dict:
+    """One 2-replica router-fleet chat run via serve_loadgen; returns its
+    --summary-json document (fleet tokens/s + the router's spec ledger)."""
+    out = os.path.join(args.workdir, f"fleet_{spec}.json")
+    argv = ["--replicas", "2", "--scenario", "chat",
+            "--sessions", str(args.sessions), "--turns", str(args.turns),
+            "--max-new-tokens", str(args.max_new_tokens),
+            "--prompt-lens", args.prompt_lens,
+            "--num-slots", str(args.num_slots),
+            "--checkpoint", ckpt, "--seed", str(args.seed),
+            "--spec", spec, "--spec-k", str(args.spec_k),
+            "--summary-json", out]
+    rc = loadgen.main(argv)
+    if rc != 0:
+        raise SystemExit(f"fleet leg ({spec}) failed with rc {rc}")
+    with open(out) as f:
+        return json.load(f)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--checkpoint", default="",
+                   help="trained train.lm TrainState/params (default: train "
+                        "one on the committed MNIST fixture first)")
+    p.add_argument("--train-epochs", type=int, default=12)
+    p.add_argument("--data-dir", default=_FIXTURE)
+    p.add_argument("--workdir", default="/tmp/spec_ab_work",
+                   help="scratch dir for the trained checkpoint + fleet "
+                        "summaries")
+    p.add_argument("--spec", default="ngram", choices=("ngram", "draft-lm"))
+    p.add_argument("--spec-k", type=int, default=4)
+    p.add_argument("--num-slots", type=int, default=4)
+    p.add_argument("--sessions", type=int, default=6)
+    p.add_argument("--turns", type=int, default=3)
+    p.add_argument("--max-new-tokens", type=int, default=48)
+    p.add_argument("--prompt-lens", default="32,64,96")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--fleet", action="store_true",
+                   help="also run the 2-replica router-fleet A/B and embed "
+                        "both fleet summaries")
+    p.add_argument("--gate-tokens-per-step", type=float, default=1.5,
+                   help="minimum accepted-tokens/step (the acceptance bar)")
+    p.add_argument("--gate-invocation-ratio", type=float, default=1.5,
+                   help="minimum A/B decode-invocations-per-token ratio")
+    p.add_argument("--out", default="bench_results/spec_ab_cpu.json")
+    args = p.parse_args(argv)
+
+    import importlib.util
+
+    spec_mod = importlib.util.spec_from_file_location(
+        "serve_loadgen", os.path.join(_REPO, "tools", "serve_loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec_mod)
+    spec_mod.loader.exec_module(loadgen)
+
+    import jax
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        lm,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        checkpoint,
+    )
+
+    ckpt = ensure_checkpoint(args)
+    model = lm.TransformerLM()          # the train.lm default pixel LM
+    import jax.numpy as jnp
+
+    init = model.init({"params": jax.random.PRNGKey(0)},
+                      jnp.zeros((1, model.seq_len), jnp.int32))["params"]
+    params = checkpoint.load_params_or_state(ckpt, init)
+
+    print("== A: spec off")
+    a, toks_a = run_side(model, params, args, loadgen, spec="off")
+    print(f"   {a['new_tokens']} tokens in {a['decode_invocations']} "
+          f"invocations, {a['tokens_per_s']:.1f} tokens/s")
+    print(f"== B: spec {args.spec} k={args.spec_k}")
+    b, toks_b = run_side(model, params, args, loadgen, spec=args.spec)
+    sp = b["spec_stats"]
+    print(f"   {b['new_tokens']} tokens in {b['decode_invocations']} "
+          f"invocations, {b['tokens_per_s']:.1f} tokens/s, "
+          f"accepted tok/step {sp['accepted_tokens_per_step']:.2f}, "
+          f"acceptance rate {sp['acceptance_rate']:.2f}")
+
+    # Greedy chat is deterministic per prompt, so the two runs' completions
+    # join on the exact prompt tokens.
+    assert toks_a.keys() == toks_b.keys(), "workloads diverged"
+    matched = total = 0
+    for key in toks_a:
+        ta, tb = toks_a[key], toks_b[key]
+        total += 1
+        matched += int(len(ta) == len(tb) and bool(np.array_equal(ta, tb)))
+    token_match_rate = matched / total
+    invocation_ratio = (a["invocations_per_token"]
+                        / b["invocations_per_token"])
+    doc = {
+        "metric": f"speculative-decoding A/B ({args.spec} k={args.spec_k}, "
+                  f"chat scenario)",
+        "checkpoint": ckpt,
+        "trained_epochs": None if args.checkpoint else args.train_epochs,
+        "scenario": {"sessions": args.sessions, "turns": args.turns,
+                     "max_new_tokens": args.max_new_tokens,
+                     "prompt_lens": args.prompt_lens,
+                     "num_slots": args.num_slots, "seed": args.seed},
+        "a": a,
+        "b": b,
+        "token_match_rate": token_match_rate,
+        "accepted_tokens_per_step": sp["accepted_tokens_per_step"],
+        "acceptance_rate": sp["acceptance_rate"],
+        "invocation_ratio": invocation_ratio,
+        "tokens_per_s_ratio": b["tokens_per_s"] / a["tokens_per_s"],
+    }
+    print(f"== token match {token_match_rate:.3f}, "
+          f"{invocation_ratio:.2f}x fewer invocations/token, "
+          f"tokens/s ratio {doc['tokens_per_s_ratio']:.2f}x")
+
+    if args.fleet:
+        print("== fleet legs (2 replicas each)")
+        doc["fleet"] = {"a": run_fleet_side(args, loadgen, ckpt, spec="off"),
+                        "b": run_fleet_side(args, loadgen, ckpt,
+                                            spec=args.spec)}
+
+    problems = []
+    if token_match_rate < 1.0:
+        problems.append(f"token match {token_match_rate:.3f} < 1.0")
+    if sp["accepted_tokens_per_step"] < args.gate_tokens_per_step:
+        problems.append(f"accepted tok/step {sp['accepted_tokens_per_step']:.2f} "
+                        f"< {args.gate_tokens_per_step}")
+    if invocation_ratio < args.gate_invocation_ratio:
+        problems.append(f"invocation ratio {invocation_ratio:.2f} "
+                        f"< {args.gate_invocation_ratio}")
+    doc["gates_passed"] = not problems
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"artifact -> {args.out}")
+    if problems:
+        print("GATES FAILED: " + "; ".join(problems))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,7 +63,8 @@ GATED_WRITE_MODULES = (
 # jax.device_get) is a per-iteration sync — the exact tax the one-program
 # design exists to delete (reference src/train_dist.py:85).
 HOT_REGIONS: dict[str, tuple[str, ...] | str] = {
-    "serving/engine.py": ("step", "_run_prefill", "_finish_prefill"),
+    "serving/engine.py": ("step", "_spec_tick", "_run_prefill",
+                          "_finish_prefill"),
     "train/step.py": "scan-bodies",
 }
 

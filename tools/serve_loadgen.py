@@ -314,7 +314,13 @@ def build_replica_command(args) -> list[str]:
            "--prefix-cache", str(args.prefix_cache),
            "--kv-dtype", args.kv_dtype,
            "--quant-policy", args.quant_policy,
+           "--spec", args.spec, "--spec-k", str(args.spec_k),
+           "--draft-layers", str(args.draft_layers),
+           "--draft-embed-dim", str(args.draft_embed_dim),
+           "--draft-heads", str(args.draft_heads),
            "--warmup", str(args.warmup)]
+    if args.draft_checkpoint:
+        cmd += ["--draft-checkpoint", args.draft_checkpoint]
     if args.rope:
         cmd.append("--rope")
     if args.checkpoint:
@@ -361,6 +367,24 @@ def main(argv: list[str] | None = None) -> int:
                    help="weight-matmul path: w8 = int8 kernels + per-channel "
                         "scales (f32 activations), w8a8 = int8 activations "
                         "too (int8 x int8 -> int32 matmul)")
+    e.add_argument("--spec", default="off",
+                   choices=("off", "ngram", "draft-lm"),
+                   help="speculative decoding (the A/B switch): 'ngram' = "
+                        "free host-side n-gram/prompt-lookup self-speculation "
+                        "(big wins on --scenario chat), 'draft-lm' = a small "
+                        "draft LM sharing the tokenizer")
+    e.add_argument("--spec-k", type=int, default=4,
+                   help="draft tokens per verify step (verify program width "
+                        "= spec_k + 1, one compile)")
+    e.add_argument("--draft-layers", type=int, default=1,
+                   help="draft LM: transformer layers")
+    e.add_argument("--draft-embed-dim", type=int, default=0,
+                   help="draft LM: embed dim (0 = half the target's)")
+    e.add_argument("--draft-heads", type=int, default=0,
+                   help="draft LM: heads (0 = the target's)")
+    e.add_argument("--draft-checkpoint", default="",
+                   help="trained draft-LM params msgpack (default: seeded "
+                        "init)")
     e.add_argument("--warmup", type=int, default=1,
                    help="pre-measurement warmup rounds: compile the decode, "
                         "every prefill chunk size, and the prefix-cache install "
@@ -632,6 +656,15 @@ def main(argv: list[str] | None = None) -> int:
               f"({rs['redispatched_requests']} requests), "
               f"{rs['replica_restarts']} replica restart(s), "
               f"{rs['duplicates']} duplicate completion(s)")
+        sp = rs.get("spec") or {}
+        if sp:
+            rate = sp.get("acceptance_rate")
+            tps = sp.get("accepted_tokens_per_step")
+            print(f"spec: {sp.get('mode')} k={sp.get('k')}: "
+                  f"{sp['accepted']}/{sp['proposed']} drafts accepted "
+                  f"(rate {'-' if rate is None else f'{rate:.2f}'}), "
+                  f"{'-' if tps is None else f'{tps:.2f}'} accepted tok/step "
+                  f"fleet-wide")
         sc = rs.get("scale") or {}
         if rs.get("scale_events"):
             print(f"elasticity: {sc.get('scale_ups', 0)} scale-up(s), "
@@ -648,6 +681,16 @@ def main(argv: list[str] | None = None) -> int:
               f"decode compilations {engine.trace_count}")
         prefill_rate = (engine.prefill_tokens / engine.prefill_wall_s
                         if engine.prefill_wall_s else None)
+        sp = engine.spec_stats()
+        if sp:
+            rate = sp.get("acceptance_rate")
+            tps = sp.get("accepted_tokens_per_step")
+            print(f"spec: {sp['mode']} k={sp['k']}: "
+                  f"{sp['accepted']}/{sp['proposed']} drafts accepted "
+                  f"(rate {'-' if rate is None else f'{rate:.2f}'}), "
+                  f"{'-' if tps is None else f'{tps:.2f}'} accepted tok/step, "
+                  f"{engine.generated_tokens} tokens in {engine.steps} "
+                  f"program invocations")
         hits = engine.prefix_cache.stats() if engine.prefix_cache else None
         print(f"prefilled {engine.prefill_tokens} prompt tokens in "
               f"{engine.prefill_invocations} chunks "
@@ -708,6 +751,8 @@ def main(argv: list[str] | None = None) -> int:
             "prefix_cache_entries": args.prefix_cache,
             "kv_dtype": args.kv_dtype,
             "quant_policy": args.quant_policy,
+            "spec": args.spec,
+            "spec_k": args.spec_k if args.spec != "off" else None,
             "new_tokens": new_tokens,
             "tokens_per_s": new_tokens / wall if wall else None,
             "ttft_s": percentiles([c.ttft_s for c in comps]),
@@ -741,6 +786,7 @@ def main(argv: list[str] | None = None) -> int:
                 prefix_cache=rs.get("prefix_cache"),
                 prefix_hit_rate=(pc["hits"] / pc["queries"]
                                  if pc.get("queries") else None),
+                spec_stats=rs.get("spec"),
                 per_replica=[{k: r[k] for k in ("replica", "state", "restarts",
                                                 "dispatched", "completed")}
                              for r in rs["per_replica"]],
@@ -757,7 +803,11 @@ def main(argv: list[str] | None = None) -> int:
                 prefix_hit_rate=(hits["hits"] / hits["queries"]
                                  if hits and hits["queries"] else None),
                 decode_compilations=engine.trace_count,
-                prefill_compilations=dict(engine.prefill_trace_counts))
+                prefill_compilations=dict(engine.prefill_trace_counts),
+                decode_invocations=engine.steps,
+                generated_tokens=engine.generated_tokens,
+                spec_stats=engine.spec_stats(),
+                verify_compilations=dict(engine.verify_trace_counts))
         if trace_summary is not None:
             # The run carries its trace with it: where the spans live plus the
             # span-derived critical-path percentiles, next to the serve

@@ -167,12 +167,36 @@ def summarize(path: str) -> dict:
         s["prefix_hit_tokens"] = sum(r.get("cache_hit_len") or 0
                                      for r in prefills)
         s["prefix_hit_rate"] = len(hits) / len(prefills)
+    # Speculative-decoding accept stats: per-step "spec" events aggregated;
+    # the serve_summary's engine-level spec ledger (below) overrides where it
+    # exists so both sides of an A-vs-B row use the engine's own definitions.
+    specs = by_event.get("spec", [])
+    if specs:
+        s["spec_steps"] = len(specs)
+        proposed = sum(r.get("proposed") or 0 for r in specs)
+        accepted = sum(r.get("accepted") or 0 for r in specs)
+        slot_draws = sum(r.get("active") or 0 for r in specs)
+        emitted = sum(r.get("emitted") or 0 for r in specs)
+        s["spec_acceptance_rate"] = accepted / proposed if proposed else None
+        s["accepted_tokens_per_step"] = (emitted / slot_draws
+                                         if slot_draws else None)
     if summary:
         s.setdefault("serve_requests", summary.get("requests"))
         s.setdefault("serve_ok", summary.get("ok"))
         s.setdefault("serve_timeout", summary.get("timeout"))
         s["serve_tokens_per_s"] = summary.get("tokens_per_s")
         s["serve_occupancy"] = summary.get("slot_occupancy")
+        # Program invocations vs generated tokens (separate counters since
+        # speculative decoding made them diverge from 1:1 per slot).
+        if summary.get("decode_invocations") is not None:
+            s["decode_invocations"] = summary.get("decode_invocations")
+            s["generated_tokens"] = summary.get("generated_tokens")
+        sp = summary.get("spec") or {}
+        if sp:
+            s["spec_mode"] = sp.get("mode")
+            s["spec_k"] = sp.get("k")
+            s["spec_acceptance_rate"] = sp.get("acceptance_rate")
+            s["accepted_tokens_per_step"] = sp.get("accepted_tokens_per_step")
         # The drain-time summary is the ENGINE's ledger (it also counts prompts
         # expired mid-prefill, which never emit a "prefill" event), so where it
         # exists it OVERRIDES the per-event estimates — both sides of an A-vs-B
@@ -259,6 +283,14 @@ def summarize(path: str) -> dict:
             s["prefix_hits"] = pc.get("hits")
             s["prefix_hit_tokens"] = pc.get("hit_tokens")
             s["prefix_hit_rate"] = pc["hits"] / pc["queries"]
+        sp = rsum.get("spec") or {}
+        if sp:
+            s["spec_mode"] = sp.get("mode")
+            s["spec_k"] = sp.get("k")
+            s["spec_acceptance_rate"] = sp.get("acceptance_rate")
+            s["accepted_tokens_per_step"] = sp.get("accepted_tokens_per_step")
+            s.setdefault("decode_invocations", sp.get("steps"))
+            s.setdefault("generated_tokens", sp.get("generated_tokens"))
         for name in SERVE_SERIES:
             pcts = rsum.get(name) or {}
             for q in SERVE_QS:
@@ -420,6 +452,16 @@ def print_summary(s: dict) -> None:
             print(f"   prefill: {_fmt(s['prefill_tokens'])} tokens in "
                   f"{_fmt(s.get('prefill_chunks'))} chunks  "
                   f"tokens/s {_fmt(s.get('prefill_tokens_per_s'))}{hit}")
+        if s.get("spec_mode") or s.get("accepted_tokens_per_step") is not None:
+            inv = ""
+            if s.get("decode_invocations") is not None:
+                inv = (f"  {_fmt(s.get('generated_tokens'))} tokens in "
+                       f"{_fmt(s['decode_invocations'])} program invocations")
+            print(f"   spec: {s.get('spec_mode') or '?'}"
+                  + (f" k={s['spec_k']}" if s.get("spec_k") else "")
+                  + f"  accepted tok/step {_fmt(s.get('accepted_tokens_per_step'))}"
+                  + f"  acceptance rate {_fmt(s.get('spec_acceptance_rate'))}"
+                  + inv)
         if s.get("decode_bytes_per_token") is not None:
             print(f"   bytes: kv {s.get('kv_dtype')} / weights "
                   f"{s.get('quant_policy')}  "
@@ -477,6 +519,9 @@ COMPARE_ROWS = [
     ("ckpt_save_s", "ckpt_save_s"),
     ("restarts", "restarts"),
     ("serve tokens/s", "serve_tokens_per_s"),
+    ("accepted tok/step", "accepted_tokens_per_step"),
+    ("acceptance rate", "spec_acceptance_rate"),
+    ("decode invocations", "decode_invocations"),
     ("prefill tok/s", "prefill_tokens_per_s"),
     ("decode bytes/tok", "decode_bytes_per_token"),
     ("kv bytes/slot", "kv_bytes_per_slot"),

@@ -10,9 +10,9 @@ directories in any mix: span events are assembled into per-request trees by
 The report answers "where did request 1234's milliseconds go":
 
 - **critical path**: per-segment exclusive seconds (router queue wait, routing,
-  failed dispatch hops, replica queue wait, prefill, first-token decode, decode
-  tail, resolve, transport/scheduling overhead) reduced to p50/p95/mean across
-  all traces;
+  failed dispatch hops, replica queue wait, prefill, speculative draft/verify,
+  first-token decode, decode tail, resolve, transport/scheduling overhead)
+  reduced to p50/p95/mean across all traces;
 - **slowest N**: the worst end-to-end traces with their full span trees —
   every span, time-offset and duration, in cross-process anchored order, with
   redispatch hops (and their crash/preempt/hang causes) called out;
