@@ -1,0 +1,31 @@
+"""Run-level observability: histograms, goodput accounting, SLO attainment.
+
+This package sits ABOVE the JSONL telemetry substrate (``utils/jsonl.py``,
+``utils/telemetry.py``) and below the report CLIs: it turns event streams into
+the run-level numbers an operator actually steers by —
+
+- :mod:`obs.hist` — mergeable log-bucket streaming histograms (DDSketch-style
+  fixed relative error), the bounded-memory replacement for the serving
+  summaries' full per-request latency lists;
+- :mod:`obs.goodput` — the exclusive wall-time decomposition of a training
+  run (init/compile, step compute, checkpoint stall, restart badput, data
+  wait, idle) joined from the telemetry/checkpoint/supervisor/trace streams,
+  with the headline goodput fraction;
+- :mod:`obs.slo` — SLO specs (TTFT/TPOT/e2e targets + attainment window) and
+  the sliding-window attainment tracker the serving fleet surfaces in
+  ``serve_summary``/``router_summary``/``fleet_snapshot``.
+
+Everything here is backend-free by doctrine (graftlint ``backend-purity``):
+the router, the supervisor, and the report CLIs all import from this package,
+and none of them may initialize — or even import — a jax backend.
+"""
+
+from csed_514_project_distributed_training_using_pytorch_tpu.obs.hist import (
+    LogHistogram,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.obs.slo import (
+    AttainmentTracker,
+    SLOSpec,
+)
+
+__all__ = ["LogHistogram", "SLOSpec", "AttainmentTracker"]

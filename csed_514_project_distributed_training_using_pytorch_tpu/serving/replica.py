@@ -191,9 +191,14 @@ def build_engine_server(args, trace: Tracer | str | None = None):
                     engine.run([Request(prompt=wp, max_new_tokens=1)])
             engine.run([Request(prompt=np.zeros(0, np.int32), max_new_tokens=2)])
         engine.reset_stats()
+    from csed_514_project_distributed_training_using_pytorch_tpu.obs.slo import (
+        SLOSpec,
+    )
+
     server = Server(engine, max_pending=args.max_pending,
                     default_timeout_s=args.timeout_s or None,
                     telemetry=args.telemetry,
+                    slo=SLOSpec.parse(getattr(args, "slo", "")),
                     trace=trace if trace is not None
                     else getattr(args, "trace", ""))
     return engine, server
@@ -345,9 +350,19 @@ def _stats_payload(engine, server) -> dict:
     if hasattr(engine, "byte_accounting"):
         # Measured bytes/token for the router's fleet_snapshot timeline.
         eng["bytes"] = engine.byte_accounting()
-    return {"engine": eng,
-            "queue": (server.queue.snapshot()
-                      if hasattr(server, "queue") else None)}
+    out = {"engine": eng,
+           "queue": (server.queue.snapshot()
+                     if hasattr(server, "queue") else None)}
+    if hasattr(server, "latency_histograms"):
+        # The replica-local latency sketches (obs/hist.py) ride the stats
+        # protocol as plain JSON; the router MERGES them fleet-wide — the
+        # bounded-memory replacement for shipping per-request series.
+        out["latency_hist"] = server.latency_histograms()
+    if hasattr(server, "slo_summary"):
+        slo = server.slo_summary()
+        if slo is not None:
+            out["slo"] = slo
+    return out
 
 
 def serve_forever(args) -> int:
@@ -629,6 +644,11 @@ def main(argv: list[str] | None = None) -> int:
     e.add_argument("--warmup", type=int, default=1,
                    help="compile the decode/prefill/install programs before "
                         "accepting traffic (0 = off)")
+    e.add_argument("--slo", default="",
+                   help="replica-local SLO spec, e.g. 'ttft=0.5,e2e=2.0,"
+                        "window=30' (obs/slo.py) — attainment lands in the "
+                        "serve_summary and the 'slo' drain event; empty = "
+                        "no promise")
     p.add_argument("--telemetry", default="",
                    help="this replica's own serve JSONL (optional)")
     p.add_argument("--trace", default="",

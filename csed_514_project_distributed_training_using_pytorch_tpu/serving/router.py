@@ -82,6 +82,14 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.autoscaler 
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.prefix_cache import (
     common_prefix_len,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.obs.hist import (
+    LogHistogram,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.obs.slo import (
+    AttainmentTracker,
+    SLOSpec,
+    slo_event,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
     RequestQueue,
     SamplingParams,
@@ -325,6 +333,7 @@ class Router:
                  min_replicas: int | None = None,
                  max_replicas: int | None = None,
                  warm_prefixes: int = 8, drain_timeout_s: float = 30.0,
+                 slo: SLOSpec | None = None, hist_rel_err: float = 0.01,
                  env: dict | None = None):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
@@ -408,12 +417,24 @@ class Router:
         # run, and the single-engine serve_summary this gets A/B'd against
         # starts its clock on an already-built engine.
         self._served_from_s: float | None = None
-        # Aggregates for router_summary (scalars + small float lists only).
+        # Aggregates for router_summary (scalars + bounded sketches only: the
+        # latency series are obs/hist.py LogHistograms — O(buckets) memory,
+        # quantiles within hist_rel_err of the nearest-rank oracle).
         self._counts = {"requests": 0, "ok": 0, "timeout": 0, "failed": 0,
                         "redispatches": 0, "redispatched_requests": 0,
                         "duplicates": 0, "affinity_hits": 0, "new_tokens": 0}
-        self._series: dict[str, list] = {"ttft_s": [], "e2e_s": [],
-                                         "queue_wait_s": []}
+        self._hist_rel_err = float(hist_rel_err)
+        self._series: dict[str, LogHistogram] = {
+            name: LogHistogram(hist_rel_err)
+            for name in ("ttft_s", "e2e_s", "queue_wait_s")}
+        # SLO attainment (obs/slo.py): the fleet-level promise as the CLIENT
+        # sees it (router-side latencies), plus one windowed tracker per
+        # replica index so fleet_snapshot can report per-replica recent
+        # attainment — the signal an attainment-driven autoscaler reads.
+        self._slo_spec = slo
+        self._slo_fleet = (AttainmentTracker(slo) if slo is not None
+                           else None)
+        self._slo_by_replica: dict[int, AttainmentTracker] = {}
         self.last_summary: dict | None = None
 
     # ------------------------------------------------------------------ lifecycle
@@ -433,6 +454,7 @@ class Router:
                           if self._autoscaler else None),
             "warm_prefixes": self._warm_prefixes,
             "drain_timeout_s": self._drain_timeout_s,
+            "slo": (self._slo_spec.describe() if self._slo_spec else None),
         })
         with self._lock:
             for rep in self.replicas:
@@ -1014,6 +1036,7 @@ class Router:
             self._counts["failed"] += 1
 
     def _record(self, comp: RouterCompletion) -> None:
+        now = time.monotonic()
         with self._lock:
             self._counts["requests"] += 1
             self._counts["ok"] += comp.ok
@@ -1022,7 +1045,14 @@ class Router:
             self._counts["affinity_hits"] += comp.affinity_hit
             self._counts["redispatched_requests"] += comp.redispatches > 0
             for name in self._series:
-                self._series[name].append(getattr(comp, name))
+                self._series[name].add(getattr(comp, name))
+            if self._slo_fleet is not None:
+                self._slo_fleet.observe(now, ok=comp.ok, ttft_s=comp.ttft_s,
+                                        tpot_s=comp.tpot_s, e2e_s=comp.e2e_s)
+                per = self._slo_by_replica.setdefault(
+                    comp.replica, AttainmentTracker(self._slo_spec))
+                per.observe(now, ok=comp.ok, ttft_s=comp.ttft_s,
+                            tpot_s=comp.tpot_s, e2e_s=comp.e2e_s)
         self._writer.emit({
             "event": "route", "request_id": comp.request_id,
             "replica": comp.replica, "affinity_hit": comp.affinity_hit,
@@ -1404,6 +1434,10 @@ class Router:
                        "inflight": len(r.inflight), "capacity": r.capacity,
                        "restarts": r.restarts, "dispatched": r.dispatched,
                        "completed": r.completed}
+                if self._slo_fleet is not None:
+                    tracker = self._slo_by_replica.get(r.index)
+                    row["slo"] = (tracker.window(now) if tracker is not None
+                                  else {"attainment": None, "requests": 0})
                 eng = (r.stats or {}).get("engine") or {}
                 if eng:
                     row["occupancy"] = eng.get("slot_occupancy")
@@ -1455,6 +1489,10 @@ class Router:
             "affinity_rate": (counts["affinity_hits"] / routed
                               if routed else None),
             "restarts": sum(r["restarts"] for r in per_replica),
+            # Fleet-level recent attainment: the autoscaler's SLO signal (read
+            # it instead of raw utilization once scaling goes SLO-driven).
+            "slo": (self._slo_fleet.window(now)
+                    if self._slo_fleet is not None else None),
             "per_replica": per_replica,
         }
 
@@ -1593,6 +1631,10 @@ class Router:
                                          finish="stopped")
                 except concurrent.futures.InvalidStateError:
                     pass          # lost a resolve race: already settled elsewhere
+        if self._slo_fleet is not None:
+            self._writer.emit(slo_event(
+                self._slo_fleet, source="router",
+                window=self._slo_fleet.window(time.monotonic())))
         self.last_summary = self._summary(end_s=served_until_s)
         self._writer.emit(dict(self.last_summary))
         self._writer.close()
@@ -1613,9 +1655,18 @@ class Router:
                 "exit_code": r.exit_code,
                 "stats": r.stats,
             } for r in self.replicas]
-            series = {k: list(v) for k, v in self._series.items()}
+            series = {k: LogHistogram(self._hist_rel_err).merge(v)
+                      for k, v in self._series.items()}
+            slo = (self._slo_fleet.summary() if self._slo_fleet is not None
+                   else None)
         cache = {"queries": 0, "hits": 0, "hit_tokens": 0}
         have_cache = False
+        # Replica-side latency sketches, merged across the fleet (obs/hist.py:
+        # bucket-count addition — the merged quantiles keep the same relative
+        # -error bound as one process seeing every sample). These are the
+        # REPLICA-LOCAL latencies (admission -> completion inside one engine);
+        # the router's own series above stay the client-facing truth.
+        replica_hists: dict[str, LogHistogram] = {}
         # Fleet-wide speculative-decoding ledger: the per-replica engine spec
         # stats summed, with the derived rates recomputed over the sums (a
         # mean of per-replica rates would weight an idle replica like a busy
@@ -1624,6 +1675,14 @@ class Router:
                 "generated_tokens": 0}
         spec_mode = None
         for row in per_replica:
+            for name, doc in ((row["stats"] or {}).get("latency_hist")
+                              or {}).items():
+                try:
+                    base = replica_hists.setdefault(
+                        name, LogHistogram(float(doc.get("rel_err", 0.01))))
+                    base.merge(doc)
+                except (ValueError, KeyError, TypeError):
+                    pass          # mismatched/garbled sketch: skip, never crash
             eng = (row["stats"] or {}).get("engine") or {}
             pc = eng.get("prefix_cache")
             if pc:
@@ -1672,7 +1731,11 @@ class Router:
             "prefix_cache": cache if have_cache else None,
             "spec": spec if spec_mode is not None else None,
             "queue": self.queue.snapshot(),
-            "ttft_s": percentiles(series["ttft_s"]),
-            "e2e_s": percentiles(series["e2e_s"]),
-            "queue_wait_s": percentiles(series["queue_wait_s"]),
+            "slo": slo,
+            "ttft_s": series["ttft_s"].percentiles(),
+            "e2e_s": series["e2e_s"].percentiles(),
+            "queue_wait_s": series["queue_wait_s"].percentiles(),
+            "replica_latency": ({name: h.percentiles()
+                                 for name, h in replica_hists.items()}
+                                if replica_hists else None),
         }

@@ -31,6 +31,14 @@ import time
 
 import numpy as np
 
+from csed_514_project_distributed_training_using_pytorch_tpu.obs.hist import (
+    LogHistogram,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.obs.slo import (
+    AttainmentTracker,
+    SLOSpec,
+    slo_event,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
     Completion,
     ContinuousBatchingEngine,
@@ -66,6 +74,8 @@ class Server:
                  default_timeout_s: float | None = None,
                  telemetry: str | T.TelemetryWriter | None = None,
                  trace: str | Tracer | None = None,
+                 slo: SLOSpec | None = None,
+                 hist_rel_err: float = 0.01,
                  idle_wait_s: float = 0.05):
         self.engine = engine
         self.tracer = (trace if isinstance(trace, Tracer)
@@ -87,10 +97,21 @@ class Server:
         self._error: BaseException | None = None
         # Running aggregates only — a long-lived server must not retain per-request
         # Completions (token arrays) for the drain-time summary. The four latency
-        # series are float lists (the percentile inputs), everything else scalars.
+        # series are LogHistogram sketches (obs/hist.py: O(buckets) memory,
+        # quantiles within hist_rel_err of the nearest-rank oracle, mergeable
+        # across replicas via the stats protocol), everything else scalars.
         self._counts = {"requests": 0, "ok": 0, "timeout": 0, "new_tokens": 0}
-        self._series: dict[str, list] = {"ttft_s": [], "tpot_s": [],
-                                         "e2e_s": [], "queue_wait_s": []}
+        self._series: dict[str, LogHistogram] = {
+            name: LogHistogram(hist_rel_err)
+            for name in ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s")}
+        # Run-level SLO attainment (obs/slo.py), None = no promise declared.
+        self._slo = AttainmentTracker(slo) if slo is not None else None
+        # The loop thread mutates the sketches/tracker per completion; the
+        # replica's stats handler serializes them from ITS connection thread
+        # (latency_histograms/slo_summary) — an unguarded to_json() racing an
+        # add() that opens a new bucket is a dict-changed-during-iteration
+        # crash, so both sides take this lock.
+        self._series_lock = threading.Lock()
 
     # ------------------------------------------------------------------ lifecycle
 
@@ -115,6 +136,7 @@ class Server:
             "spec": self.engine.spec,
             "spec_k": (self.engine.spec_k
                        if self.engine.drafter is not None else None),
+            "slo": (self._slo.spec.describe() if self._slo else None),
         })
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-loop")
@@ -223,8 +245,12 @@ class Server:
         self._counts["ok"] += comp.ok
         self._counts["timeout"] += comp.finish == "timeout"
         self._counts["new_tokens"] += comp.new_tokens
-        for name in self._series:
-            self._series[name].append(getattr(comp, name))
+        with self._series_lock:
+            for name in self._series:
+                self._series[name].add(getattr(comp, name))
+            if self._slo is not None:
+                self._slo.observe(t0, ok=comp.ok, ttft_s=comp.ttft_s,
+                                  tpot_s=comp.tpot_s, e2e_s=comp.e2e_s)
         self._writer.emit(T.serve_event(
             request_id=comp.request.request_id, prompt_len=comp.prompt_len,
             new_tokens=comp.new_tokens, finish=comp.finish,
@@ -308,10 +334,28 @@ class Server:
             else:
                 self.queue.wait_for_work(self._idle_wait_s)
 
+    def latency_histograms(self) -> dict:
+        """The four latency sketches, JSON-serialized — what the replica's
+        ``stats`` protocol ships to the router, which MERGES them across the
+        fleet (obs/hist.py merge: same quantile error bound as one process
+        having seen every sample). Thread-safe: the stats protocol calls
+        this from the replica's connection thread while the loop records."""
+        with self._series_lock:
+            return {name: h.to_json() for name, h in self._series.items()}
+
+    def slo_summary(self) -> dict | None:
+        """Run-level SLO attainment (None when no spec was declared)."""
+        with self._series_lock:
+            return self._slo.summary() if self._slo is not None else None
+
     def _emit_summary(self) -> None:
         wall_s = (time.monotonic() - self._started_s
                   if self._started_s is not None else None)
         eng = self.engine
+        if self._slo is not None:
+            self._writer.emit(slo_event(
+                self._slo, source="server",
+                window=self._slo.window(time.monotonic())))
         self._writer.emit(T.serve_summary_event(
             **self._counts, wall_s=wall_s,
             steps=eng.steps,
@@ -326,4 +370,5 @@ class Server:
                           if eng.prefix_cache else None),
             queue=self.queue.snapshot(),
             byte_accounting=eng.byte_accounting(),
+            slo=self.slo_summary(),
             **self._series))

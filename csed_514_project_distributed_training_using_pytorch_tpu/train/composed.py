@@ -150,7 +150,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     if config.health_stats and not config.telemetry:
         raise ValueError("--health-stats emits telemetry 'health' events and has no "
                          "other output — pass --telemetry PATH too")
-    tele = T.TelemetryWriter(config.telemetry)
+    tele = T.TelemetryWriter(config.telemetry,
+                             preserve=bool(config.resume_from))
     tele.emit(T.manifest_event(config, mesh=mesh, run_type="composed"))
     if run_plan is not None:
         tele.emit(T.plan_event(run_plan))

@@ -127,7 +127,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                          "other output — pass --telemetry PATH too")
     info = initialize_cluster()                   # ≙ init_process_group, :146
     mesh = make_mesh(num_devices)
-    tele = T.TelemetryWriter(config.telemetry)
+    tele = T.TelemetryWriter(config.telemetry,
+                             preserve=bool(config.resume_from))
     tele.emit(T.manifest_event(config, mesh=mesh, run_type="distributed"))
     # Resilience wiring (flag-gated, host-side only — the compiled epoch program is
     # untouched, and with both flags off no step fetch or syscall is added).

@@ -201,7 +201,8 @@ def main(config: LMConfig = LMConfig(), *,
           f"seq {seq_len}, data source: {train_ds.source}")
     # Telemetry + resilience wiring live ABOVE the resume so the restore is recorded;
     # resilience hooks are flag-gated, host-side only (zero-cost when off).
-    tele = T.TelemetryWriter(config.telemetry)
+    tele = T.TelemetryWriter(config.telemetry,
+                             preserve=bool(config.resume_from))
     tele.emit(T.manifest_event(config, mesh=mesh, run_type="lm"))
     if run_plan is not None:
         tele.emit(T.plan_event(run_plan))

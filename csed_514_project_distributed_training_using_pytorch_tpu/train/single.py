@@ -80,7 +80,8 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     if config.health_stats and not config.telemetry:
         raise ValueError("--health-stats emits telemetry 'health' events and has no "
                          "other output — pass --telemetry PATH too")
-    tele = T.TelemetryWriter(config.telemetry)
+    tele = T.TelemetryWriter(config.telemetry,
+                             preserve=bool(config.resume_from))
     tele.emit(T.manifest_event(config, run_type="single"))
     # Resilience wiring (flag-gated, host-side only; with both flags off no step
     # fetch or syscall is added — same zero-cost discipline as --health-stats).

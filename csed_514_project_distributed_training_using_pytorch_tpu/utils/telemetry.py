@@ -115,15 +115,33 @@ class TelemetryWriter:
     truncated at the first emit): the serving path's mode, where event volume is
     O(requests) and the atomic full rewrite would go quadratic. A kill can tear at
     most the trailing line; the shared reader skips exactly that.
+
+    History preservation (``preserve=True``, non-stream mode): a NEW writer
+    on an EXISTING path loads the prior events first (through the guarded
+    reader — a crashed writer's torn final line is dropped) and every rewrite
+    carries them. This is the ``JsonlWriter`` append doctrine applied to the
+    rewrite mode, for RESUMED runs only: a supervised restart re-runs the
+    same trainer command — same ``--telemetry`` path — and the crashed
+    attempt's events must survive into the resumed run's file, or run-level
+    accounting (``obs/goodput.py``: replayed-epoch badput needs the FIRST
+    attempt's epoch history) is impossible. Attempts stay distinguishable:
+    each one opens with its own ``manifest`` event. The trainers pass
+    ``preserve=bool(config.resume_from)`` — a FRESH run on a stale path
+    still truncates (two unrelated runs must not blend into one fake
+    multi-attempt history).
     """
 
-    def __init__(self, path: str | None, *, stream: bool = False):
+    def __init__(self, path: str | None, *, stream: bool = False,
+                 preserve: bool = False):
         self.path = path or ""
         self.stream = bool(stream)
+        self.preserve = bool(preserve)
         self._fh = None
         self._truncated = False       # stream mode: first open truncates, later
                                       # reopens (emit after close) append
         self._events: list[dict] = []
+        self._loaded_history = False  # non-stream: prior-run events loaded once,
+                                      # lazily (only the logging process reads)
         self._t0 = time.time()
         # emit() must be thread-safe: the write-behind checkpointer reports its
         # completed writes from its worker thread while the trainer keeps emitting
@@ -164,6 +182,13 @@ class TelemetryWriter:
                 self._fh.write(json.dumps(row, allow_nan=False) + "\n")
                 self._fh.flush()
                 return
+            if not self._loaded_history:
+                self._loaded_history = True
+                if self.preserve and os.path.exists(self.path):
+                    from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
+                        read_jsonl,
+                    )
+                    self._events = read_jsonl(self.path) + self._events
             self._events.append(row)
             payload = "".join(json.dumps(e, allow_nan=False) + "\n"
                               for e in self._events)
@@ -505,9 +530,23 @@ def mfu_event(flops_per_step: float | None, step_s: float | None,
 # Nearest-rank percentiles — the one estimator all serving summaries and the
 # report CLI share. Owned by the jax-free utils.jsonl (the router needs it
 # without importing jax); re-exported here, its historical home.
+from csed_514_project_distributed_training_using_pytorch_tpu.obs.hist import (  # noqa: E402
+    LogHistogram,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (  # noqa: E402
     percentiles,
 )
+
+
+def series_percentiles(series, qs=(50, 95, 99)) -> dict | None:
+    """p50/p95/p99 of a latency series that is EITHER a raw sequence (the
+    nearest-rank oracle, ``utils.jsonl.percentiles``) or an ``obs.hist``
+    ``LogHistogram`` sketch (bounded memory, quantiles within its configured
+    relative error). The serving summaries call this so the schema stays
+    identical while the backing store became O(buckets)."""
+    if isinstance(series, LogHistogram):
+        return series.percentiles(qs)
+    return percentiles(series, qs)
 
 
 def serve_event(*, request_id: int, prompt_len: int, new_tokens: int, finish: str,
@@ -592,6 +631,7 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
                         prefix_cache: dict | None = None,
                         queue: dict | None = None,
                         byte_accounting: dict | None = None,
+                        slo: dict | None = None,
                         ttft_s=(), tpot_s=(), e2e_s=(), queue_wait_s=()) -> dict:
     """The once-per-run serving aggregate, emitted at drain: counts, aggregate
     tokens/s over the server's whole wall clock, slot occupancy, and p50/p95/p99
@@ -601,7 +641,11 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
     oldest-age / rejected count) — the backpressure ledger. ``byte_accounting``
     (emitted as ``"bytes"``) is the engine's byte-TRUE decode working set
     (``ContinuousBatchingEngine.byte_accounting()`` — decode bytes/token, KV
-    bytes/slot, slots-at-budget, kv_dtype), the quantization A/B ledger."""
+    bytes/slot, slots-at-budget, kv_dtype), the quantization A/B ledger.
+    ``slo`` is the run-level SLO attainment dict (``obs.slo
+    .AttainmentTracker.summary()``) when the server carries a spec. The four
+    latency series accept raw sequences or ``obs.hist.LogHistogram`` sketches
+    (the server keeps sketches — O(buckets), not O(requests))."""
     return {
         "event": "serve_summary",
         "requests": int(requests),
@@ -635,8 +679,9 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
         "prefix_cache": prefix_cache,
         "queue": queue,
         "bytes": byte_accounting,
-        "ttft_s": percentiles(ttft_s),
-        "tpot_s": percentiles(tpot_s),
-        "e2e_s": percentiles(e2e_s),
-        "queue_wait_s": percentiles(queue_wait_s),
+        "slo": slo,
+        "ttft_s": series_percentiles(ttft_s),
+        "tpot_s": series_percentiles(tpot_s),
+        "e2e_s": series_percentiles(e2e_s),
+        "queue_wait_s": series_percentiles(queue_wait_s),
     }

@@ -58,6 +58,10 @@ EVENT_KINDS: dict[str, str] = {
     # -- planner (plan/) --------------------------------------------------------
     "plan": "once per --plan run: chosen layout + predicted cost",
     "autotune": "one empirically trialed candidate: predicted vs measured",
+    # -- run-level observability (obs/) -----------------------------------------
+    "slo": "SLO attainment vs spec: serving drain (server/router via obs/slo.py)",
+    "goodput": "exclusive wall-time decomposition of a training run (obs/goodput.py)",
+    "bench_guard": "one perf-gate metric: median-of-N vs baseline (tools/bench_guard.py)",
     # -- distributed tracing (utils/trace.py) -----------------------------------
     "span": "one trace span (rendered by tools/trace_report.py, passed over here)",
     # -- loss-curve metrics.jsonl kinds (utils/metrics.py history rows) ---------

@@ -1,0 +1,91 @@
+"""tools/bench_guard.py: the perf-regression gate's contract.
+
+The acceptance criteria of the gate itself: it exits 0 against a freshly
+seeded baseline, nonzero (exit 3) on an injected synthetic regression, and
+its artifacts (run JSON + bench_guard telemetry lines) carry the per-metric
+medians and ratios. Subprocess-driven like the other tool tests — the gate
+must work from a bare ``python tools/bench_guard.py``, which is exactly how
+the CI job invokes it.
+
+The suite is restricted to ``decode_tick_s`` here: one metric exercises the
+whole measure/gate/artifact pipeline, and tier-1 should not pay four model
+compiles per assertion. The full four-metric suite runs in the (non-blocking)
+``bench-guard`` CI job and seeds ``bench_results/guard_baseline.json``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+_TOOL = os.path.join(_REPO, "tools", "bench_guard.py")
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, _TOOL, *args],
+                          capture_output=True, text=True, timeout=300,
+                          env=_ENV, cwd=_REPO)
+
+
+def test_bench_guard_gate_passes_then_trips_on_injected_regression(tmp_path):
+    baseline = str(tmp_path / "baseline.json")
+    common = ["--baseline", baseline, "--suite", "decode_tick_s", "--runs", "2"]
+
+    # No baseline yet: a distinct exit code that tells "unseeded" from
+    # "regressed".
+    proc = _run(*common)
+    assert proc.returncode == 2, proc.stderr
+
+    proc = _run(*common, "--update-baseline")
+    assert proc.returncode == 0, proc.stderr
+    doc = json.load(open(baseline))
+    assert doc["metrics"]["decode_tick_s"]["median_s"] > 0
+    assert doc["metrics"]["decode_tick_s"]["tolerance"] == 0.6
+    assert doc["host"]["platform"] == "cpu"
+
+    # Same machine, same suite: the gate holds (median-of-N absorbs noise
+    # far below the 1.6x allowance).
+    out_json = str(tmp_path / "run.json")
+    tele = str(tmp_path / "guard.jsonl")
+    proc = _run(*common, "--out", out_json, "--telemetry", tele)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    artifact = json.load(open(out_json))
+    row = artifact["metrics"]["decode_tick_s"]
+    assert row["pass"] is True and row["ratio"] is not None
+    assert len(row["samples"]) == 2
+    assert artifact["pass"] is True and artifact["host_matches_baseline"]
+    events = [json.loads(l) for l in open(tele) if l.strip()]
+    assert [e["event"] for e in events] == ["bench_guard"]
+    assert events[0]["metric"] == "decode_tick_s" and events[0]["pass"]
+
+    # The injected synthetic regression MUST trip the gate (exit 3) and the
+    # artifact must say why.
+    proc = _run(*common, "--out", out_json, "--inject-regression",
+                "decode_tick_s=10")
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert "REGRESSION" in proc.stdout
+    artifact = json.load(open(out_json))
+    assert artifact["pass"] is False
+    assert artifact["metrics"]["decode_tick_s"]["ratio"] > 1.6
+    assert any("decode_tick_s" in f for f in artifact["failures"])
+
+
+def test_bench_guard_rejects_unknown_suite_and_holes(tmp_path):
+    baseline = str(tmp_path / "baseline.json")
+    proc = _run("--suite", "not_a_metric", "--baseline", baseline)
+    assert proc.returncode == 2 and "unknown suite metric" in proc.stderr
+
+    # A baseline metric the run skipped is a HOLE in the gate, not a pass:
+    # seed with decode_tick_s, then gate... nothing.
+    proc = _run("--baseline", baseline, "--suite", "decode_tick_s",
+                "--runs", "1", "--update-baseline")
+    assert proc.returncode == 0, proc.stderr
+    doc = json.load(open(baseline))
+    doc["metrics"]["phantom_metric_s"] = {"median_s": 1.0, "tolerance": 0.5}
+    json.dump(doc, open(baseline, "w"))
+    proc = _run("--baseline", baseline, "--suite", "decode_tick_s",
+                "--runs", "1")
+    assert proc.returncode == 3
+    assert "in baseline but not measured" in proc.stderr

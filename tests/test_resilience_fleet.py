@@ -61,7 +61,13 @@ def test_supervisor_restarts_killed_fleet_skipping_torn_checkpoint(tmp_path,
                                                                    monkeypatch):
     """Kill worker 1 at the epoch-2 tick AND tear the epoch-1 checkpoint write: the
     supervisor must fall back to the epoch-0 checkpoint (never the torn one),
-    restart the fleet, and finish with an uninterrupted run's final step."""
+    restart the fleet, and finish with an uninterrupted run's final step.
+
+    Doubles as the goodput acceptance gate (obs/goodput.py): the joined
+    telemetry + supervisor streams of this faulted run must decompose into
+    exclusive segments that sum to the run's wall time (±1%) with restart
+    badput > 0, while the uninterrupted reference run decomposes with badput
+    exactly 0."""
     work = tmp_path / "supervised"
     work.mkdir()
     monkeypatch.chdir(work)
@@ -76,7 +82,9 @@ def test_supervisor_restarts_killed_fleet_skipping_torn_checkpoint(tmp_path,
                                backoff_s=0.0, checkpoint_dir=store,
                                attempt_timeout_s=300,
                                telemetry=str(work / "supervisor.jsonl"))
-    res = sup.supervise(TRAIN, cfg)
+    # --telemetry is cwd-relative: both supervised attempts write (and the
+    # restarted one PRESERVES) one history at work/run.jsonl.
+    res = sup.supervise(TRAIN + ["--telemetry", "run.jsonl"], cfg)
     assert (res.status, res.exit_code) == ("ok", 0)
     assert res.attempts == 2 and res.restarts == 1
     ckpt4 = os.path.join(store, checkpoint.versioned_name(4))
@@ -94,13 +102,43 @@ def test_supervisor_restarts_killed_fleet_skipping_torn_checkpoint(tmp_path,
     ref = tmp_path / "uninterrupted"
     ref.mkdir()
     monkeypatch.chdir(ref)
-    assert launch(TRAIN, num_processes=2, platform="cpu", devices_per_process=1,
-                  timeout=300) == 0
+    assert launch(TRAIN + ["--telemetry", "run.jsonl"], num_processes=2,
+                  platform="cpu", devices_per_process=1, timeout=300) == 0
     ref_store = str(ref / "results" / "checkpoints")
     ref_final = checkpoint.newest_valid_checkpoint(ref_store)
     supervised_final = checkpoint.newest_valid_checkpoint(store)
     assert _step_of(supervised_final) == _step_of(ref_final) \
         == EPOCHS * STEPS_PER_EPOCH
+
+    # -- goodput accounting over the streams both runs just wrote ------------
+    from csed_514_project_distributed_training_using_pytorch_tpu.obs import (
+        goodput,
+    )
+
+    faulted = goodput.decompose([str(work / "run.jsonl"),
+                                 str(work / "supervisor.jsonl")])
+    # The preserved multi-attempt telemetry history: both attempts present.
+    assert faulted["attempts"] == 2 and faulted["restarts"] == 1
+    # Attempt 2 resumed from step 4 (epoch 0) and re-ran epoch 1: replayed
+    # work is charged to restart badput, never to compute.
+    assert faulted["epochs_replayed"] >= 1
+    assert faulted["replayed_steps"] >= STEPS_PER_EPOCH
+    assert faulted["segments"]["restart_badput_s"] > 0.0
+    assert faulted["segments"]["compute_s"] > 0.0
+    assert sum(faulted["segments"].values()) == pytest.approx(
+        faulted["wall_s"], rel=0.01)
+    assert faulted["unaccounted_s"] <= 0.01 * faulted["wall_s"]
+
+    clean = goodput.decompose([str(ref / "run.jsonl")])
+    assert clean["attempts"] == 1 and clean["restarts"] == 0
+    assert clean["segments"]["restart_badput_s"] == 0.0       # exactly
+    assert clean["epochs_replayed"] == 0
+    assert sum(clean["segments"].values()) == pytest.approx(
+        clean["wall_s"], rel=0.01)
+    # The faulted run burned MORE wall for the same final step — and the
+    # ledger knows where it went.
+    assert faulted["wall_s"] > clean["wall_s"]
+    assert faulted["goodput_frac"] < clean["goodput_frac"]
 
 
 def test_preempted_fleet_exits_75_with_resumable_checkpoint(tmp_path, monkeypatch):

@@ -361,6 +361,63 @@ def test_router_untraced_writes_no_span_files(tmp_path):
         assert fut.result(timeout=60).ok
     finally:
         router.stop(timeout=60)
+
+
+def test_router_slo_attainment_and_hist_percentiles(tmp_path):
+    """SLO + histogram plumbing end-to-end on the echo tier: the router
+    tracks attainment against a spec (run-level in router_summary + the
+    drain 'slo' event; windowed per replica in fleet_snapshot), and the
+    summary's latency percentiles — now backed by obs/hist.py sketches, not
+    per-request lists — agree with the nearest-rank oracle recomputed from
+    the raw route events within the sketch's 1% relative error."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.obs.slo import (
+        SLOSpec,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
+        percentiles as nearest_rank,
+    )
+
+    router = _router(tmp_path, _echo_cmd(delay=0.02),
+                     snapshot_interval_s=0.2,
+                     slo=SLOSpec(e2e_s=60.0, window_s=30.0)).start()
+    try:
+        assert router.wait_ready(timeout=120)
+        rng = np.random.default_rng(5)
+        futs = [router.submit(rng.integers(0, 7, size=1 + i % 4)
+                              .astype(np.int32), max_new_tokens=5)
+                for i in range(12)]
+        comps = [f.result(timeout=120) for f in futs]
+        assert all(c.ok for c in comps)
+        time.sleep(0.5)               # let >=1 snapshot observe completions
+    finally:
+        summ = router.stop(timeout=60)
+    assert summ["slo"]["requests"] == 12
+    assert summ["slo"]["attainment"] == 1.0       # 60s e2e: trivially met
+    assert summ["slo"]["spec"]["e2e_s"] == 60.0
+    rows = load_metrics_jsonl(str(tmp_path / "router.jsonl"))
+    # The drain-time slo event (registered kind) with the router as source.
+    slo_events = [r for r in rows if r["event"] == "slo"]
+    assert slo_events and slo_events[-1]["source"] == "router"
+    assert slo_events[-1]["met"] == 12
+    # fleet_snapshot carries the windowed view, fleet-wide AND per replica.
+    snaps = [r for r in rows if r["event"] == "fleet_snapshot"]
+    assert snaps
+    assert all("slo" in rep for rep in snaps[-1]["per_replica"])
+    observed = [rep["slo"] for sn in snaps for rep in sn["per_replica"]
+                if (rep["slo"] or {}).get("requests")]
+    assert observed and all(o["attainment"] == 1.0 for o in observed)
+    # Sketch-vs-oracle: summary percentiles within the configured rel error
+    # of nearest-rank over the per-request route events.
+    routes = [r for r in rows if r["event"] == "route"]
+    assert len(routes) == 12
+    for name in ("ttft_s", "e2e_s", "queue_wait_s"):
+        exact = nearest_rank([r.get(name) for r in routes])
+        if exact is None:
+            continue
+        for q in ("p50", "p95", "p99"):
+            if exact[q] is not None:
+                assert summ[name][q] == pytest.approx(
+                    exact[q], rel=0.011, abs=1e-9), (name, q)
     assert not [p for p in os.listdir(tmp_path) if "trace" in p]
 
 

@@ -1,0 +1,415 @@
+"""Continuous perf-regression gate: median-of-N microbenches vs a committed baseline.
+
+Tier-1 keeps the repo CORRECT; nothing so far kept it FAST — a PR could halve
+decode tokens/s and land green. This tool is the guard: a small committed
+microbench suite covering the repo's hot paths, run median-of-N (the noise
+defense: the median of 5 short runs is far more stable than any single run on
+a shared machine), compared metric-by-metric against
+``bench_results/guard_baseline.json`` with a per-metric tolerance. Exit 0 =
+within tolerance, exit 3 = regression, with the full measurement written as a
+JSON artifact either way — the repo's bench trajectory, one document per run.
+
+The suite (tiny CPU-fixture models — the gate must run in CI seconds, and a
+regression that shows on the fixture shows on the real model):
+
+=================  ==================================================================
+``decode_tick_s``  one slot-engine decode step, 4 busy slots, empty prompts
+                   (pure decode: the serving hot loop, ``engine.step``)
+``prefill_chunk_s``  one chunked-prefill program invocation (host wall per chunk,
+                   from the engine's own ``prefill_wall_s`` ledger)
+``spec_verify_s``  one speculative verify tick (ngram drafting + the batched
+                   K-token verify program) on a repetitive prompt mixture
+``lm_train_step_s``  one jitted LM train step (next-token loss + SGD) on a
+                   batch-8 fixture — the training hot loop
+=================  ==================================================================
+
+Compile time is excluded everywhere (a warmup invocation precedes every
+timed region): the gate watches steady-state throughput, and compile
+regressions are visible in telemetry's ``compile`` events instead.
+
+Noise policy: each metric's tolerance is a fractional regression allowance
+(default 0.6: fail only on a >1.6x slowdown — shared CI runners jitter tens
+of percent, and the gate's job is catching the 2x-10x accidents, not 5%
+drift). ``--update-baseline`` re-measures and rewrites the baseline; the
+baseline records its host fingerprint and the gate WARNS (never fails) on a
+fingerprint mismatch — absolute seconds only transfer between like machines,
+which is also why the CI job stays non-blocking (advisory trend + artifact).
+
+Telemetry: one ``{"event": "bench_guard", ...}`` line per metric via
+``--telemetry`` (the registered kind — renders in tools/telemetry_report.py),
+so gate runs join the same A-vs-B machinery as every other measurement.
+
+Usage::
+
+    python tools/bench_guard.py                      # gate vs committed baseline
+    python tools/bench_guard.py --update-baseline    # re-seed the baseline
+    python tools/bench_guard.py --runs 7 --out bench_results/guard_run.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import platform
+import statistics
+import sys
+import time
+
+# Script-mode import path: ``python tools/bench_guard.py`` puts tools/ on
+# sys.path, not the repo root the package lives in.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_BASELINE = os.path.join("bench_results", "guard_baseline.json")
+DEFAULT_TOLERANCE = 0.6
+EXIT_REGRESSION = 3
+EXIT_NO_BASELINE = 2
+
+SMALL = dict(vocab_size=17, seq_len=64, embed_dim=32, num_layers=2,
+             num_heads=4)
+
+
+def _host_fingerprint() -> dict:
+    import jax
+    dev = jax.devices()[0]
+    return {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "device_count": len(jax.devices()),
+        "python": platform.python_version(),
+        "jax": jax.__version__,
+        "machine": platform.machine(),
+    }
+
+
+def _build_engine(**overrides):
+    import jax
+    import jax.numpy as jnp
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        lm,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
+        ContinuousBatchingEngine,
+    )
+
+    model = lm.TransformerLM(**SMALL)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, model.seq_len), jnp.int32))["params"]
+    kw = dict(num_slots=4, seed=0, prefill_chunk_sizes=(16,))
+    kw.update(overrides)
+    return model, ContinuousBatchingEngine(model, params, **kw)
+
+
+def _drain(engine) -> int:
+    """Run the engine until every slot resolves; returns the step count."""
+    steps = 0
+    while engine.num_active:
+        engine.step()
+        steps += 1
+    return steps
+
+
+def bench_decode_tick() -> float:
+    """Seconds per decode step with 4 busy slots (empty prompts: no prefill
+    in the timed region — this is the pure decode hot loop)."""
+    import numpy as np
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
+        Request,
+    )
+
+    model, engine = _build_engine()
+
+    def admit(max_new):
+        reqs = [Request(prompt=np.zeros(0, np.int32), max_new_tokens=max_new,
+                        request_id=i) for i in range(4)]
+        engine.admit_many(list(zip(engine.free_slots(), reqs)))
+
+    admit(4)
+    _drain(engine)                      # compile, off the clock
+    admit(32)
+    t0 = time.perf_counter()
+    steps = _drain(engine)
+    return (time.perf_counter() - t0) / steps
+
+
+def bench_prefill_chunk() -> float:
+    """Host wall per chunked-prefill program invocation (the engine's own
+    ``prefill_wall_s / prefill_invocations`` ledger — queueing excluded)."""
+    import numpy as np
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
+        Request,
+    )
+
+    model, engine = _build_engine()
+    rng = np.random.default_rng(7)
+
+    def run_one(rid):
+        prompt = rng.integers(0, model.vocab_size - 1,
+                              size=48).astype(np.int32)
+        engine.admit_many([(engine.free_slots()[0],
+                            Request(prompt=prompt, max_new_tokens=1,
+                                    request_id=rid))])
+        _drain(engine)
+
+    run_one(0)                          # compile, off the clock
+    engine.reset_stats()
+    for rid in range(1, 5):
+        run_one(rid)
+    return engine.prefill_wall_s / max(engine.prefill_invocations, 1)
+
+
+def bench_spec_verify() -> float:
+    """Seconds per speculative verify tick (ngram draft + batched K-token
+    verify) on a repetitive prompt the drafter can actually hit."""
+    import numpy as np
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
+        Request,
+    )
+
+    model, engine = _build_engine(spec="ngram", spec_k=4)
+
+    def admit(max_new):
+        reqs = []
+        for i in range(4):
+            prompt = np.tile(np.arange(1, 5, dtype=np.int32), 4)
+            reqs.append(Request(prompt=prompt, max_new_tokens=max_new,
+                                request_id=i))
+        engine.admit_many(list(zip(engine.free_slots(), reqs)))
+
+    admit(4)
+    _drain(engine)                      # compile draft+verify, off the clock
+    engine.take_spec_records()
+    admit(32)
+    _drain(engine)
+    recs = engine.take_spec_records()
+    walls = [r["verify_wall_s"] + (r.get("draft_wall_s") or 0.0)
+             for r in recs if r.get("verify_wall_s") is not None]
+    if not walls:
+        raise RuntimeError("spec_verify produced no timed verify records")
+    return sum(walls) / len(walls)
+
+
+def bench_lm_train_step() -> float:
+    """Seconds per jitted LM train step (next-token loss, SGD) on the CPU
+    fixture: batch 8, the SMALL transformer."""
+    import jax
+    import jax.numpy as jnp
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        lm,
+    )
+
+    model = lm.TransformerLM(**SMALL)
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        jnp.zeros((1, model.seq_len), jnp.int32))["params"]
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, model.seq_len),
+                                0, model.vocab_size - 1, jnp.int32)
+
+    def loss_fn(p, xs):
+        return lm.next_token_loss(model, p, xs, None, deterministic=True)
+
+    @jax.jit
+    def step(p, xs):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xs)
+        return jax.tree_util.tree_map(lambda a, g: a - 0.01 * g, p, grads), loss
+
+    params, loss = step(params, tokens)     # compile, off the clock
+    loss.block_until_ready()
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, loss = step(params, tokens)
+    loss.block_until_ready()
+    return (time.perf_counter() - t0) / iters
+
+
+SUITE = {
+    "decode_tick_s": bench_decode_tick,
+    "prefill_chunk_s": bench_prefill_chunk,
+    "spec_verify_s": bench_spec_verify,
+    "lm_train_step_s": bench_lm_train_step,
+}
+
+
+def measure(names, runs: int) -> dict:
+    """``runs`` interleaved passes over the suite; per metric the MEDIAN of
+    its samples (interleaving decorrelates a transient machine hiccup from
+    any single metric)."""
+    samples: dict[str, list] = {name: [] for name in names}
+    for _ in range(runs):
+        for name in names:
+            samples[name].append(SUITE[name]())
+    return {name: {"median_s": statistics.median(vals), "samples": vals}
+            for name, vals in samples.items()}
+
+
+def gate(measured: dict, baseline: dict, default_tolerance: float) -> dict:
+    """Compare measured medians against the baseline document. Returns the
+    verdict dict (per-metric ratio/tolerance/pass + overall)."""
+    out: dict = {"metrics": {}, "pass": True, "failures": []}
+    base_metrics = baseline.get("metrics", {})
+    for name, m in measured.items():
+        base = base_metrics.get(name)
+        row = dict(m)
+        if base is None:
+            row.update(baseline_s=None, ratio=None, tolerance=None,
+                       **{"pass": False})
+            out["pass"] = False
+            out["failures"].append(f"{name}: not in baseline "
+                                   f"(--update-baseline to add it)")
+        else:
+            tol = float(base.get("tolerance", default_tolerance))
+            ratio = m["median_s"] / base["median_s"]
+            ok = ratio <= 1.0 + tol
+            row.update(baseline_s=base["median_s"], ratio=ratio,
+                       tolerance=tol, **{"pass": ok})
+            if not ok:
+                out["pass"] = False
+                out["failures"].append(
+                    f"{name}: {m['median_s']:.6f}s vs baseline "
+                    f"{base['median_s']:.6f}s = {ratio:.2f}x "
+                    f"(allowed {1.0 + tol:.2f}x)")
+        out["metrics"][name] = row
+    # A metric the baseline pins but this run skipped is a hole in the gate.
+    for name in base_metrics:
+        if name not in measured:
+            out["pass"] = False
+            out["failures"].append(f"{name}: in baseline but not measured "
+                                   f"(suite filter too narrow?)")
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--baseline", default=DEFAULT_BASELINE)
+    p.add_argument("--runs", type=int, default=5,
+                   help="suite passes; each metric gates on its MEDIAN")
+    p.add_argument("--suite", default=",".join(SUITE),
+                   help="comma-separated metric subset")
+    p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                   help="default fractional regression allowance for metrics "
+                        "whose baseline entry pins none")
+    p.add_argument("--out", default="",
+                   help="write the run's JSON artifact here (the bench "
+                        "trajectory document)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite --baseline from this run instead of gating")
+    p.add_argument("--telemetry", default="",
+                   help="append one bench_guard event per metric (JSONL)")
+    p.add_argument("--inject-regression", default="",
+                   help="TESTING ONLY: 'metric=factor' multiplies that "
+                        "metric's measurement — proves the gate trips")
+    args = p.parse_args(argv)
+
+    names = [n.strip() for n in args.suite.split(",") if n.strip()]
+    unknown = [n for n in names if n not in SUITE]
+    if unknown:
+        p.error(f"unknown suite metric(s) {unknown}; have {list(SUITE)}")
+
+    # Fail the unseeded case BEFORE paying for the measurement: the suite is
+    # minutes of model builds/compiles, and without a baseline there is
+    # nothing to gate against anyway.
+    if not args.update_baseline and not os.path.exists(args.baseline):
+        print(f"[bench_guard] no baseline at {args.baseline} — run with "
+              f"--update-baseline to seed it", file=sys.stderr)
+        return EXIT_NO_BASELINE
+
+    measured = measure(names, max(1, args.runs))
+    if args.inject_regression:
+        name, _, factor = args.inject_regression.partition("=")
+        if name not in measured:
+            p.error(f"--inject-regression names unknown metric {name!r}")
+        measured[name]["median_s"] *= float(factor)
+
+    host = _host_fingerprint()
+    now = time.time()
+
+    if args.update_baseline:
+        doc = {
+            "schema": 1,
+            "created_unix": now,
+            "runs": args.runs,
+            "host": host,
+            "tolerance_default": args.tolerance,
+            "metrics": {name: {"median_s": m["median_s"],
+                               "tolerance": args.tolerance}
+                        for name, m in measured.items()},
+        }
+        os.makedirs(os.path.dirname(args.baseline) or ".", exist_ok=True)
+        with open(args.baseline, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        for name, m in measured.items():
+            print(f"[bench_guard] baseline {name} = {m['median_s']:.6f}s")
+        print(f"[bench_guard] baseline written: {args.baseline}")
+        return 0
+
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+
+    verdict = gate(measured, baseline,
+                   baseline.get("tolerance_default", args.tolerance))
+    base_host = baseline.get("host") or {}
+    host_match = all(base_host.get(k) == host.get(k)
+                     for k in ("platform", "device_kind", "machine"))
+    if not host_match:
+        print(f"[bench_guard] WARNING: host fingerprint differs from the "
+              f"baseline's ({base_host.get('device_kind')} vs "
+              f"{host.get('device_kind')}) — absolute seconds may not "
+              f"transfer; treat this gate as advisory", file=sys.stderr)
+
+    artifact = {
+        "schema": 1,
+        "unix_time": now,
+        "runs": args.runs,
+        "host": host,
+        "host_matches_baseline": host_match,
+        "baseline": args.baseline,
+        **verdict,
+    }
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if args.telemetry:
+        # The jax-free appender: bench_guard events join the shared reader /
+        # report-CLI machinery like every other telemetry stream.
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
+            JsonlWriter,
+        )
+
+        w = JsonlWriter(args.telemetry)
+        for name, row in verdict["metrics"].items():
+            w.emit({"event": "bench_guard", "metric": name,
+                    "median_s": row["median_s"],
+                    "baseline_s": row.get("baseline_s"),
+                    "ratio": row.get("ratio"),
+                    "tolerance": row.get("tolerance"),
+                    "pass": row["pass"], "runs": args.runs,
+                    "unix_time": now})
+        w.close()
+
+    for name, row in sorted(verdict["metrics"].items()):
+        ratio = row.get("ratio")
+        print(f"[bench_guard] {name}: median {row['median_s']:.6f}s"
+              + (f"  baseline {row['baseline_s']:.6f}s  ratio {ratio:.2f}x"
+                 if ratio is not None else "  (no baseline entry)")
+              + ("  ok" if row["pass"] else "  REGRESSION"))
+    if not verdict["pass"]:
+        for failure in verdict["failures"]:
+            print(f"[bench_guard] FAIL {failure}", file=sys.stderr)
+        return EXIT_REGRESSION
+    print(f"[bench_guard] pass: {len(verdict['metrics'])} metric(s) within "
+          f"tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

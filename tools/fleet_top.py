@@ -1,0 +1,232 @@
+"""fleet_top: a live console dashboard over the router's telemetry stream.
+
+``top`` for the serving fleet: tails a ``serving/router.py`` telemetry JSONL
+(the file ``--snapshot-interval-s`` populates with ``fleet_snapshot`` lines)
+and renders the current fleet state in place — queue depth/age, utilization,
+per-replica occupancy and state, scale/restart counters, and SLO attainment
+(fleet-wide and per replica, when the run carries a spec — ``--slo`` on
+``tools/serve_loadgen.py``). Point it at a live run's file from another
+terminal; it follows appends like ``tail -f``.
+
+Backend-free BY DOCTRINE (graftlint ``backend-purity``): this process watches
+a fleet, it must never claim a device — no jax import, transitively. It is
+also crash-tolerant by construction: lines arrive through an incremental
+tailer that only parses COMPLETE lines (a writer mid-line never confuses it)
+and the files it reads are append-only.
+
+Usage::
+
+    python tools/fleet_top.py results/router.jsonl              # follow
+    python tools/fleet_top.py results/router.jsonl --once       # one frame
+    python tools/fleet_top.py results/router.jsonl --interval 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class JsonlTail:
+    """Incremental JSONL follower: each ``poll()`` returns the rows appended
+    since the last one, parsing only COMPLETE lines (the trailing partial line
+    a mid-emit writer leaves stays buffered until its newline arrives). A
+    file that does not exist yet polls as empty — the dashboard can start
+    before the run does. Truncation (a fresh run reusing the path) resets the
+    offset, so the dashboard follows the new run instead of going silent."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._offset = 0
+        self._partial = b""
+
+    def poll(self) -> list[dict]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self._offset:          # truncated: a new run took the path
+            self._offset = 0
+            self._partial = b""
+        rows: list[dict] = []
+        with open(self.path, "rb") as f:
+            f.seek(self._offset)
+            chunk = f.read()
+            self._offset = f.tell()
+        data = self._partial + chunk
+        lines = data.split(b"\n")
+        self._partial = lines.pop()      # b"" after a complete final line
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue                 # a malformed interior line: skip, keep tailing
+        return rows
+
+
+class FleetState:
+    """The dashboard's reduction of the event stream: last snapshot, config,
+    recent scale/replica transitions, drain summary."""
+
+    def __init__(self, events_tail: int = 6):
+        self.config: dict | None = None
+        self.snapshot: dict | None = None
+        self.summary: dict | None = None
+        self.slo: dict | None = None
+        self.snapshots = 0
+        self.recent: list[str] = []
+        self._events_tail = events_tail
+
+    def feed(self, rows) -> None:
+        for r in rows:
+            kind = r.get("event")
+            if kind == "router_config":
+                self.config = r
+                self.summary = None      # a new run superseded the old drain
+            elif kind == "fleet_snapshot":
+                self.snapshot = r
+                self.snapshots += 1
+            elif kind == "router_summary":
+                self.summary = r
+            elif kind == "slo":
+                self.slo = r
+            elif kind in ("scale", "replica"):
+                t = r.get("t_s")
+                stamp = "-" if t is None else f"+{t:.1f}s"
+                what = (f"scale {r.get('action')} -> target {r.get('target')}"
+                        if kind == "scale" else
+                        f"replica {r.get('replica')} {r.get('action')}"
+                        + (f" ({r.get('reason')})" if r.get("reason") else ""))
+                self.recent.append(f"{stamp}  {what}")
+                self.recent = self.recent[-self._events_tail:]
+
+
+def _fmt(x, digits: int = 3) -> str:
+    if x is None:
+        return "-"
+    if isinstance(x, float):
+        return f"{x:.{digits}f}"
+    return str(x)
+
+
+def _bar(frac: float | None, width: int = 12) -> str:
+    if frac is None:
+        return " " * width
+    n = max(0, min(width, round(frac * width)))
+    return "#" * n + "." * (width - n)
+
+
+def render(state: FleetState, path: str) -> str:
+    """One dashboard frame as a string (pure: testable without a tty)."""
+    lines: list[str] = []
+    snap = state.snapshot or {}
+    cfg = state.config or {}
+    queue = snap.get("queue") or {}
+    util = snap.get("utilization")
+    lines.append(f"fleet_top — {path}"
+                 + ("  [DRAINED]" if state.summary else ""))
+    lines.append(
+        f"  target {_fmt(snap.get('target') or cfg.get('replicas'))}"
+        f"  ready {_fmt(snap.get('replicas_ready'))}"
+        f"  util {_bar(util)} {_fmt(util)}"
+        f"  inflight {_fmt(snap.get('inflight'))}"
+        f"/{_fmt(snap.get('capacity_up'))}")
+    lines.append(
+        f"  queue depth {_fmt(queue.get('depth'))}"
+        f"  oldest {_fmt(queue.get('oldest_age_s'))}s"
+        f"  requests {_fmt(snap.get('requests'))}"
+        f"  ok {_fmt(snap.get('ok'))}"
+        f"  redispatches {_fmt(snap.get('redispatches'))}"
+        f"  restarts {_fmt(snap.get('restarts'))}")
+    slo = snap.get("slo")
+    if slo:
+        lines.append(
+            f"  SLO window: attainment {_bar(slo.get('attainment'))} "
+            f"{_fmt(slo.get('attainment'))} over {slo.get('requests')} "
+            f"request(s)")
+    elif state.slo:
+        run = state.slo
+        lines.append(
+            f"  SLO run-level ({run.get('source')}): "
+            f"{_fmt(run.get('attainment'))} "
+            f"({run.get('met')}/{run.get('requests')} met)")
+    per = snap.get("per_replica") or []
+    if per:
+        lines.append("")
+        head = (f"  {'rep':>3} {'state':<9} {'infl':>4} {'cap':>4} "
+                f"{'occ':>6} {'restarts':>8} {'done':>6}")
+        has_slo = any(r.get("slo") for r in per)
+        if has_slo:
+            head += f" {'slo-att':>8} {'slo-n':>5}"
+        lines.append(head)
+        for r in per:
+            row = (f"  {r.get('replica'):>3} {str(r.get('state')):<9} "
+                   f"{_fmt(r.get('inflight')):>4} {_fmt(r.get('capacity')):>4} "
+                   f"{_fmt(r.get('occupancy')):>6} "
+                   f"{_fmt(r.get('restarts')):>8} "
+                   f"{_fmt(r.get('completed')):>6}")
+            if has_slo:
+                rs = r.get("slo") or {}
+                row += (f" {_fmt(rs.get('attainment')):>8} "
+                        f"{_fmt(rs.get('requests')):>5}")
+            lines.append(row)
+    if state.recent:
+        lines.append("")
+        lines.append("  recent events:")
+        lines.extend(f"    {e}" for e in state.recent)
+    if state.summary:
+        s = state.summary
+        lines.append("")
+        lines.append(
+            f"  drained: {_fmt(s.get('requests'))} requests, "
+            f"tokens/s {_fmt(s.get('tokens_per_s'))}, "
+            f"ttft p95 {_fmt(((s.get('ttft_s') or {}).get('p95')))}s"
+            + (f", slo attainment {_fmt((s.get('slo') or {}).get('attainment'))}"
+               if s.get("slo") else ""))
+    if not state.snapshot and not state.summary:
+        lines.append("  (waiting for fleet_snapshot events — is the run "
+                     "emitting with --snapshot-interval-s > 0?)")
+    lines.append("")
+    lines.append(f"  {state.snapshots} snapshot(s) seen — ctrl-c to quit")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("telemetry", help="the router's telemetry JSONL to tail")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="refresh seconds (follow mode)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame from the file's current contents "
+                        "and exit (no ANSI, no loop — scripts/tests)")
+    args = p.parse_args(argv)
+
+    tail = JsonlTail(args.telemetry)
+    state = FleetState()
+    if args.once:
+        state.feed(tail.poll())
+        print(render(state, args.telemetry))
+        return 0
+    try:
+        while True:
+            state.feed(tail.poll())
+            frame = render(state, args.telemetry)
+            # Home + clear-to-end per frame: repaint without scrollback spam.
+            sys.stdout.write("\x1b[H\x1b[2J" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,8 +28,12 @@ BACKEND_FREE = (
     "utils/jsonl.py",
     "utils/trace.py",
     "utils/telemetry_events.py",
+    "obs/hist.py",
+    "obs/slo.py",
+    "obs/goodput.py",
     "tools/serve_loadgen.py",
     "tools/trace_report.py",
+    "tools/fleet_top.py",
 )
 
 # Import targets that count as "the backend" for backend-purity.

@@ -319,6 +319,8 @@ def build_replica_command(args) -> list[str]:
            "--draft-embed-dim", str(args.draft_embed_dim),
            "--draft-heads", str(args.draft_heads),
            "--warmup", str(args.warmup)]
+    if getattr(args, "slo", ""):
+        cmd += ["--slo", args.slo]
     if args.draft_checkpoint:
         cmd += ["--draft-checkpoint", args.draft_checkpoint]
     if args.rope:
@@ -385,6 +387,12 @@ def main(argv: list[str] | None = None) -> int:
     e.add_argument("--draft-checkpoint", default="",
                    help="trained draft-LM params msgpack (default: seeded "
                         "init)")
+    e.add_argument("--slo", default="",
+                   help="SLO spec 'ttft=0.5,e2e=2.0,window=30' (obs/slo.py): "
+                        "the router (fleet mode) and every replica track "
+                        "attainment against it — 'slo' drain events, summary "
+                        "dicts, per-replica windows in fleet_snapshot; empty "
+                        "= no promise")
     e.add_argument("--warmup", type=int, default=1,
                    help="pre-measurement warmup rounds: compile the decode, "
                         "every prefill chunk size, and the prefix-cache install "
@@ -533,6 +541,9 @@ def main(argv: list[str] | None = None) -> int:
         # stays backend-free (the router supervises accelerator owners).
         import tempfile
 
+        from csed_514_project_distributed_training_using_pytorch_tpu.obs.slo import (
+            SLOSpec,
+        )
         from csed_514_project_distributed_training_using_pytorch_tpu.serving.router import (
             Router,
         )
@@ -575,7 +586,8 @@ def main(argv: list[str] | None = None) -> int:
             min_replicas=args.min_replicas or None,
             max_replicas=args.max_replicas or None,
             warm_prefixes=args.warm_prefixes,
-            drain_timeout_s=args.drain_timeout_s, env=env)
+            drain_timeout_s=args.drain_timeout_s,
+            slo=SLOSpec.parse(args.slo), env=env)
         front = router.start()
         if not router.wait_ready(timeout=600):
             router.stop(drain=False)
@@ -665,6 +677,13 @@ def main(argv: list[str] | None = None) -> int:
                   f"(rate {'-' if rate is None else f'{rate:.2f}'}), "
                   f"{'-' if tps is None else f'{tps:.2f}'} accepted tok/step "
                   f"fleet-wide")
+        fleet_slo = rs.get("slo")
+        if fleet_slo:
+            att = fleet_slo.get("attainment")
+            print(f"slo: attainment "
+                  f"{'-' if att is None else f'{att:.3f}'} "
+                  f"({fleet_slo.get('met')}/{fleet_slo.get('requests')} met "
+                  f"vs {args.slo})")
         sc = rs.get("scale") or {}
         if rs.get("scale_events"):
             print(f"elasticity: {sc.get('scale_ups', 0)} scale-up(s), "
@@ -691,6 +710,13 @@ def main(argv: list[str] | None = None) -> int:
                   f"{'-' if tps is None else f'{tps:.2f}'} accepted tok/step, "
                   f"{engine.generated_tokens} tokens in {engine.steps} "
                   f"program invocations")
+        srv_slo = server.slo_summary()
+        if srv_slo:
+            att = srv_slo.get("attainment")
+            print(f"slo: attainment "
+                  f"{'-' if att is None else f'{att:.3f}'} "
+                  f"({srv_slo.get('met')}/{srv_slo.get('requests')} met "
+                  f"vs {args.slo})")
         hits = engine.prefix_cache.stats() if engine.prefix_cache else None
         print(f"prefilled {engine.prefill_tokens} prompt tokens in "
               f"{engine.prefill_invocations} chunks "
@@ -758,6 +784,7 @@ def main(argv: list[str] | None = None) -> int:
             "ttft_s": percentiles([c.ttft_s for c in comps]),
             "e2e_s": percentiles([c.e2e_s for c in comps]),
             "queue_wait_s": percentiles([c.queue_wait_s for c in comps]),
+            "slo": args.slo or None,
         }
         if args.scenario == "chat":
             doc.update(sessions=args.sessions, turns=args.turns,
@@ -790,6 +817,8 @@ def main(argv: list[str] | None = None) -> int:
                 per_replica=[{k: r[k] for k in ("replica", "state", "restarts",
                                                 "dispatched", "completed")}
                              for r in rs["per_replica"]],
+                slo_attainment=rs.get("slo"),
+                replica_latency=rs.get("replica_latency"),
                 router_queue=rs.get("queue"))
         else:
             doc.update(
@@ -807,6 +836,7 @@ def main(argv: list[str] | None = None) -> int:
                 decode_invocations=engine.steps,
                 generated_tokens=engine.generated_tokens,
                 spec_stats=engine.spec_stats(),
+                slo_attainment=server.slo_summary(),
                 verify_compilations=dict(engine.verify_trace_counts))
         if trace_summary is not None:
             # The run carries its trace with it: where the spans live plus the

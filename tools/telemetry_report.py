@@ -19,6 +19,8 @@ Usage::
 
     python tools/telemetry_report.py results/run.jsonl            # one-run summary
     python tools/telemetry_report.py a.jsonl b.jsonl              # A-vs-B table
+    python tools/telemetry_report.py --goodput results/           # wall-time ledger
+    python tools/telemetry_report.py --goodput faulted/ clean/    # badput A-vs-B
 
 One run prints its manifest line, phase-timing/throughput summary, grad-norm
 trajectory, and any bench rows; two or more runs additionally print a side-by-side
@@ -364,6 +366,43 @@ def summarize(path: str) -> dict:
         s["ckpt_restores"] = len(restores)
         s["ckpt_restore_s"] = _median([c.get("wall_s") for c in restores])
 
+    # SLO attainment (obs/slo.py): the drain-time "slo" events and/or the
+    # summaries' embedded attainment dicts. Router (client-facing) wins over
+    # server (replica-local) when a run carries both.
+    slos = by_event.get("slo", [])
+    slo = (next((e for e in reversed(slos) if e.get("source") == "router"),
+                None) or (slos[-1] if slos else None))
+    for doc in ((rsum or {}).get("slo"), (summary or {}).get("slo"), slo):
+        if doc and doc.get("attainment") is not None:
+            s["slo_attainment"] = doc.get("attainment")
+            s["slo_met"] = doc.get("met")
+            s["slo_requests"] = doc.get("requests")
+            s["slo_spec"] = doc.get("spec")
+            break
+
+    # Goodput ledger lines (obs/goodput.py via --goodput --emit): read the
+    # decomposition back without re-joining the streams.
+    gp = (by_event.get("goodput") or [None])[-1]
+    if gp:
+        s["goodput_frac"] = gp.get("goodput_frac")
+        s["badput_frac"] = gp.get("badput_frac")
+        s["compute_s"] = gp.get("compute_s")
+        s["restart_badput_s"] = gp.get("restart_badput_s")
+        s["goodput_wall_s"] = gp.get("wall_s")
+        s["epochs_replayed"] = gp.get("epochs_replayed")
+
+    # Perf-gate lines (tools/bench_guard.py --telemetry): the bench
+    # trajectory's per-metric medians, comparable across runs like any bench.
+    guards = by_event.get("bench_guard", [])
+    if guards:
+        s["bench_guard"] = [
+            {"metric": g.get("metric"), "median_s": g.get("median_s"),
+             "ratio": g.get("ratio"), "pass": g.get("pass")}
+            for g in guards]
+        for g in guards:
+            if g.get("metric"):
+                s[f"guard_{g['metric']}"] = g.get("median_s")
+
     # Resilience events: supervisor restarts (resilience/supervisor.py telemetry)
     # and cooperative preemption stops.
     restarts = by_event.get("restart", [])
@@ -501,6 +540,25 @@ def print_summary(s: dict) -> None:
             print(f"     {t.rjust(9)}  {(e['action'] or '?').ljust(12)} "
                   f"replica {e['replica']} -> target {e['target']}"
                   + (f" [{e['reason']}]" if e.get("reason") else "") + ctx)
+    if s.get("slo_attainment") is not None:
+        spec = s.get("slo_spec") or {}
+        targets = ", ".join(f"{k}<={v}" for k, v in spec.items()
+                            if k != "window_s" and v is not None)
+        print(f"   slo: attainment {_fmt(s['slo_attainment'])} "
+              f"({_fmt(s.get('slo_met'))}/{_fmt(s.get('slo_requests'))} met"
+              + (f"; {targets}" if targets else "") + ")")
+    if s.get("goodput_frac") is not None:
+        print(f"   goodput: {_fmt(s['goodput_frac'])} of "
+              f"{_fmt(s.get('goodput_wall_s'))}s wall "
+              f"(compute {_fmt(s.get('compute_s'))}s, restart badput "
+              f"{_fmt(s.get('restart_badput_s'))}s, "
+              f"{_fmt(s.get('epochs_replayed'))} epoch(s) replayed)")
+    for g in s.get("bench_guard", []):
+        verdict = "" if g.get("pass") is None else \
+            ("  ok" if g["pass"] else "  REGRESSION")
+        print(f"   bench_guard: {g['metric']}: {_fmt(g.get('median_s'))}s"
+              + (f"  ratio {_fmt(g['ratio'])}x" if g.get("ratio") is not None
+                 else "") + verdict)
     if s.get("unknown_events"):
         print(f"   {s['unknown_events']} unrecognized events "
               f"(kinds: {', '.join(s['unknown_kinds'])}) — writer/reporter "
@@ -518,6 +576,9 @@ COMPARE_ROWS = [
     ("val_loss", "final_val_loss"),
     ("ckpt_save_s", "ckpt_save_s"),
     ("restarts", "restarts"),
+    ("goodput frac", "goodput_frac"),
+    ("restart badput s", "restart_badput_s"),
+    ("slo attainment", "slo_attainment"),
     ("serve tokens/s", "serve_tokens_per_s"),
     ("accepted tok/step", "accepted_tokens_per_step"),
     ("acceptance rate", "spec_acceptance_rate"),
@@ -539,6 +600,111 @@ COMPARE_ROWS = [
     ("e2e_s p95", "serve_e2e_s_p95"),
     ("queue_wait p95", "serve_queue_wait_s_p95"),
 ]
+
+
+# ----------------------------------------------------------------- goodput mode
+
+# The A-vs-B rows of a --goodput comparison (label, key into the flattened
+# report) — the faulted-vs-clean run table the resilience story is judged by.
+GOODPUT_ROWS = [
+    ("wall_s", "wall_s"),
+    ("init/compile s", "init_compile_s"),
+    ("compute s", "compute_s"),
+    ("data wait s", "data_wait_s"),
+    ("ckpt stall s", "checkpoint_stall_s"),
+    ("restart badput s", "restart_badput_s"),
+    ("idle s", "idle_s"),
+    ("goodput frac", "goodput_frac"),
+    ("badput frac", "badput_frac"),
+    ("attempts", "attempts"),
+    ("restarts", "restarts"),
+    ("epochs replayed", "epochs_replayed"),
+    ("replayed steps", "replayed_steps"),
+]
+
+
+def _flat_goodput(report: dict, label: str) -> dict:
+    return {"label": label, **report["segments"],
+            **{k: v for k, v in report.items() if k != "segments"}}
+
+
+def print_goodput(report: dict, label: str) -> None:
+    """One run's decomposition: segments as seconds AND fractions of wall —
+    the exclusive ledger sums to wall by construction, so the fractions sum
+    to 1 (modulo the surfaced unaccounted residue)."""
+    wall = report["wall_s"]
+    print(f"== {label}  (goodput ledger over {_fmt(wall)}s wall)")
+    print(f"   attempts {report['attempts']}  restarts {report['restarts']}  "
+          f"epochs {report['epochs']} "
+          f"({report['epochs_replayed']} replayed, "
+          f"{report['replayed_steps']} replayed step(s))"
+          + ("  [preempted]" if report.get("preempted") else ""))
+    for key, value in report["segments"].items():
+        frac = value / wall if wall else None
+        name = key[:-2].replace("_", " ")        # init_compile_s -> init compile
+        print(f"   {name.ljust(16)} {_fmt(value).rjust(10)}s"
+              f"  {_fmt(frac).rjust(8)}")
+    print(f"   {'goodput frac'.ljust(16)} {''.rjust(10)} "
+          f"{_fmt(report['goodput_frac']).rjust(8)}")
+    if report.get("unaccounted_s"):
+        print(f"   unaccounted residue: {_fmt(report['unaccounted_s'])}s "
+              f"(clock skew / overlapping windows)")
+    ck = report.get("checkpoint") or {}
+    if ck.get("saves") or ck.get("restores"):
+        print(f"   checkpoints: {ck.get('saves', 0)} save(s), "
+              f"{ck.get('restores', 0)} restore(s) "
+              f"({_fmt(ck.get('restore_s'))}s restoring)")
+    st = report.get("streams") or {}
+    print(f"   joined {st.get('files', '?')} file(s): {st.get('events', '?')} "
+          f"events, {st.get('supervisor_events', 0)} supervisor, "
+          f"{st.get('spans', 0)} span(s)")
+    print()
+
+
+def print_goodput_comparison(flats: list[dict]) -> None:
+    labels = [f["label"] for f in flats]
+    width = max(12, *(len(l) for l in labels)) + 2
+    head = "metric".ljust(18) + "".join(l.rjust(width) for l in labels)
+    ratio = len(flats) == 2
+    if ratio:
+        head += "B/A".rjust(10)
+    print(head)
+    print("-" * len(head))
+    for name, key in GOODPUT_ROWS:
+        vals = [f.get(key) for f in flats]
+        if all(v is None for v in vals):
+            continue
+        line = name.ljust(18) + "".join(_fmt(v).rjust(width) for v in vals)
+        if ratio and vals[0] and vals[1] is not None:
+            line += f"{vals[1] / vals[0]:.3f}x".rjust(10)
+        print(line)
+
+
+def run_goodput(args) -> int:
+    """--goodput: each positional arg is ONE RUN — a telemetry JSONL, or a
+    directory whose *.jsonl files are joined (trainer telemetry + supervisor
+    stream + trace spans self-classify by event kind)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.obs.goodput import (  # noqa: E402
+        decompose,
+        goodput_event,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (  # noqa: E402
+        JsonlWriter,
+    )
+
+    flats = []
+    for path in args.files:
+        report = decompose([path])
+        label = os.path.basename(os.path.normpath(path))
+        print_goodput(report, label)
+        flats.append(_flat_goodput(report, label))
+        if args.emit:
+            w = JsonlWriter(args.emit)
+            w.emit(goodput_event(report))
+            w.close()
+    if len(flats) > 1:
+        print_goodput_comparison(flats)
+    return 0
 
 
 def print_comparison(summaries: list[dict]) -> None:
@@ -563,8 +729,21 @@ def print_comparison(summaries: list[dict]) -> None:
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
-    p.add_argument("files", nargs="+", help="telemetry/metrics JSONL file(s)")
+    p.add_argument("files", nargs="+",
+                   help="telemetry/metrics JSONL file(s); with --goodput, "
+                        "one RUN each (a file, or a directory of JSONL "
+                        "streams joined by obs/goodput.py)")
+    p.add_argument("--goodput", action="store_true",
+                   help="render each run's exclusive wall-time decomposition "
+                        "(obs/goodput.py) instead of the event summary; two+ "
+                        "runs add the faulted-vs-clean A-vs-B table")
+    p.add_argument("--emit", default="",
+                   help="--goodput only: append each run's ledger as a "
+                        "{'event': 'goodput'} line to this JSONL")
     args = p.parse_args(argv)
+
+    if args.goodput:
+        return run_goodput(args)
 
     summaries = [summarize(f) for f in args.files]
     for s in summaries:
