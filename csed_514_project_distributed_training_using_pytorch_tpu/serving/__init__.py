@@ -49,8 +49,10 @@ modules trigger as their parent — never pulls in the jit-building engine.
 _EXPORTS = {
     "Completion": "engine",
     "ContinuousBatchingEngine": "engine",
+    "Parked": "scheduler",
     "PrefixCache": "prefix_cache",
     "QueueFull": "scheduler",
+    "QuotaExceeded": "scheduler",
     "Request": "scheduler",
     "RequestQueue": "scheduler",
     "Router": "router",
@@ -58,6 +60,10 @@ _EXPORTS = {
     "SamplingParams": "scheduler",
     "Server": "server",
     "ServerStopped": "scheduler",
+    "Shed": "scheduler",
+    "TenantSpec": "scheduler",
+    "TenantTable": "scheduler",
+    "parse_tenants": "scheduler",
 }
 
 __all__ = sorted(_EXPORTS)

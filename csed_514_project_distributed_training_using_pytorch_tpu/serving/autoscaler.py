@@ -14,6 +14,15 @@ snapshots:
 - **scale down** when the fleet is *sustainedly* idle: the queue is empty AND
   utilization is at/below ``down_utilization`` for ``sustain_down`` consecutive
   snapshots;
+- **SLO attainment** (ROADMAP open item 5, the tier the fleet actually
+  promised): with ``slo_floor`` set, a windowed attainment BELOW the floor —
+  fleet-wide, or the named ``slo_tenant``'s own window from the snapshot's
+  ``tenants`` section — counts as overloaded even when utilization looks fine
+  (a fleet at 60% that is missing its TTFT target needs capacity), and a
+  shrink is REFUSED while attainment sags (capacity may only leave when the
+  promise is being kept; an empty window — no recent traffic — is no promise
+  broken and does not block it). ``slo_min_requests`` guards the window
+  against deciding off one request's noise;
 - **hysteresis** is the sustain counters (one hot snapshot must not flap the
   fleet) plus a ``cooldown_s`` dead time after every action (a just-spawned
   replica needs a few intervals to absorb load before the signal is trusted
@@ -50,6 +59,13 @@ class AutoscalePolicy:
     sustain_up: int = 2
     sustain_down: int = 4
     cooldown_s: float = 3.0
+    # The SLO-attainment objective: None = utilization/queue-age only (the
+    # legacy policy). With a floor, windowed attainment below it is
+    # "overloaded" and blocks every shrink; ``slo_tenant`` watches one
+    # tenant's window (the high tier) instead of the fleet-wide one.
+    slo_floor: float | None = None
+    slo_tenant: str | None = None
+    slo_min_requests: int = 5
 
     def validate(self) -> "AutoscalePolicy":
         if not 1 <= self.min_replicas <= self.max_replicas:
@@ -62,6 +78,11 @@ class AutoscalePolicy:
             raise ValueError(
                 f"need 0 <= down_utilization < up_utilization, got "
                 f"{self.down_utilization} vs {self.up_utilization}")
+        if self.slo_floor is not None and not 0.0 < self.slo_floor <= 1.0:
+            raise ValueError(
+                f"slo_floor must be in (0, 1], got {self.slo_floor}")
+        if self.slo_min_requests < 1:
+            raise ValueError("slo_min_requests must be >= 1")
         return self
 
 
@@ -82,17 +103,42 @@ class FleetAutoscaler:
         self._last_action_s: float | None = None
         self.decisions: list[dict] = []   # small audit trail (tests, summary)
 
+    def _attainment(self, snapshot: dict) -> float | None:
+        """The windowed attainment the policy watches: the named tenant's
+        window (from the snapshot's per-tenant section) or the fleet-wide one.
+        None when no floor is set, the window is empty, or it holds fewer than
+        ``slo_min_requests`` completions (too noisy to act on)."""
+        if self.policy.slo_floor is None:
+            return None
+        if self.policy.slo_tenant is not None:
+            row = (snapshot.get("tenants") or {}).get(self.policy.slo_tenant)
+            win = (row or {}).get("slo")
+        else:
+            win = snapshot.get("slo")
+        if not win or (win.get("requests") or 0) < self.policy.slo_min_requests:
+            return None
+        return win.get("attainment")
+
     def _classify(self, snapshot: dict) -> str | None:
         q = snapshot.get("queue") or {}
         depth = q.get("depth") or 0
         age = q.get("oldest_age_s") or 0.0
         util = snapshot.get("utilization")
+        att = self._attainment(snapshot)
+        sagging = att is not None and att < self.policy.slo_floor
+        if sagging:
+            # The promise is being missed: that IS overload, whatever
+            # utilization says (queue age catches saturation; attainment
+            # catches a fleet meeting its queue but missing its latency).
+            return "overloaded"
         if depth > 0 and (age >= self.policy.up_queue_age_s
                           or (util is not None
                               and util >= self.policy.up_utilization)):
             return "overloaded"
         # util None means no ready capacity at all (everything starting or
-        # mid-restart) — not an idle fleet; never shrink on it.
+        # mid-restart) — not an idle fleet; never shrink on it. With an SLO
+        # floor, idleness additionally requires the promise to HOLD (att
+        # None — an empty window — is no promise broken and does not block).
         if depth == 0 and util is not None \
                 and util <= self.policy.down_utilization:
             return "idle"
@@ -126,5 +172,6 @@ class FleetAutoscaler:
                 "verdict": verdict, "target": target,
                 "queue_depth": (snapshot.get("queue") or {}).get("depth"),
                 "utilization": snapshot.get("utilization"),
+                "slo_attainment": self._attainment(snapshot),
             })
         return verdict

@@ -77,6 +77,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.spec.drafte
 # router needs them without importing jax); re-exported here because the engine
 # is their historical home and every engine caller already imports them from it.
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (  # noqa: F401
+    Parked,
     Request,
     SamplingParams,
 )
@@ -98,6 +99,7 @@ class Completion:
     ttft_s: float | None = None       # arrival -> first GENERATED token
     tpot_s: float | None = None       # mean inter-token time after the first
     e2e_s: float | None = None        # arrival -> completion
+    preemptions: int = 0              # times this request was parked mid-decode
 
     @property
     def ok(self) -> bool:
@@ -202,6 +204,8 @@ class ContinuousBatchingEngine:
         self.trace_count = 0          # traces of the decode program (tests pin == 1)
         self.steps = 0                # decode steps executed
         self.slot_steps = 0           # sum of occupied slots over steps (occupancy)
+        self.preemptions = 0          # mid-decode slots parked (priority pressure)
+        self.resumes = 0              # parked requests re-admitted
         self._key = jax.random.PRNGKey(seed)
         self._cache = lm_mod.init_cache(model, self.num_slots,
                                         kv_dtype=self.quant.kv_dtype)
@@ -223,6 +227,18 @@ class ContinuousBatchingEngine:
         self._set_prompt_rows = jax.jit(self._prompt_scatter_program,
                                         donate_argnums=(0,))
         self._prompt_len = np.zeros((b,), np.int32)
+        # The pre-computed stream length: how many positions of this slot's
+        # cache arrive via install/prefill rather than decode. Equal to
+        # prompt_len for a fresh request; on a preemption RESUME it is the
+        # parked stream's full length (prompt + already-generated tokens —
+        # their rows re-enter through the same prefix-cache/chunk path a
+        # prompt's would, because row p is a pure function of tokens[:p]).
+        self._fill_len = np.zeros((b,), np.int32)
+        # The stream backing _fill_len: the request prompt normally, the
+        # parked tokens on resume (what the prompt-row scatter shipped and
+        # what _activate_prefilled restores _out from).
+        self._stream: list[np.ndarray | None] = [None] * b
+        self._parks = np.zeros((b,), np.int32)   # this occupant's park count
         self._total_len = np.zeros((b,), np.int32)
         self._temp = np.zeros((b,), np.float32)
         self._top_k = np.zeros((b,), np.int32)
@@ -517,33 +533,57 @@ class ContinuousBatchingEngine:
         scatter is padded to ``num_slots``, so any admission count reuses one
         program). Each prompt is then either chunk-prefilled (interleaved with
         decode by ``step``), satisfied from the prefix cache, or — with prefill
-        disabled — teacher-forced through the decode loop as before."""
+        disabled — teacher-forced through the decode loop as before.
+
+        An admission may also be a ``Parked`` record (a mid-decode request
+        evicted by ``park``): its scattered row is the full emitted stream —
+        prompt plus already-generated tokens — and resume rides exactly the
+        prefix-cache/chunked-prefill machinery a long prompt would (the parked
+        planes sit in the prefix cache under that token key; a cache miss just
+        recomputes them, rows being a pure function of the tokens)."""
         if not admissions:
             return
         now = time.monotonic() if now is None else now
         seen: set[int] = set()
-        totals: list[int] = []
-        for slot, request in admissions:
+        entries: list[tuple[int, Request, Parked | None, np.ndarray]] = []
+        for slot, item in admissions:
             if self._requests[slot] is not None or slot in seen:
                 raise ValueError(f"slot {slot} is occupied")
             seen.add(slot)
-            totals.append(self.validate(request))
+            parked = item if isinstance(item, Parked) else None
+            request = parked.request if parked is not None else item
+            if parked is not None:
+                if not self.prefill_chunk_sizes:
+                    raise ValueError("preemption resume rides the "
+                                     "chunked-prefill path — enable "
+                                     "prefill_chunk_sizes to use it")
+                stream = np.asarray(parked.tokens, np.int32).reshape(-1)
+                if not len(request.prompt) <= len(stream) < self.model.seq_len:
+                    raise ValueError(
+                        f"parked stream length {len(stream)} outside "
+                        f"[prompt_len, seq_len)")
+            else:
+                self.validate(request)
+                stream = np.asarray(request.prompt, np.int32).reshape(-1)
+            entries.append((slot, request, parked, stream))
         b, s = self.num_slots, self.model.seq_len
         if len(admissions) > b:
             raise ValueError(f"{len(admissions)} admissions > {b} slots")
         slot_idx = np.full((b,), b, np.int32)        # b is out of range: dropped
         rows = np.zeros((b, s), np.int32)
-        for j, (slot, request) in enumerate(admissions):
+        for j, (slot, _, _, stream) in enumerate(entries):
             slot_idx[j] = slot
-            p = len(request.prompt)
-            if p:
-                rows[j, :p] = np.asarray(request.prompt, np.int32)
+            if len(stream):
+                rows[j, :len(stream)] = stream
         self._prompt = self._set_prompt_rows(self._prompt, slot_idx, rows)
-        for (slot, request), total in zip(admissions, totals):
-            self._admit_one(slot, request, total, now)
+        for slot, request, parked, stream in entries:
+            total = min(len(request.prompt) + request.max_new_tokens, s)
+            self._admit_one(slot, request, total, now, parked=parked,
+                            stream=stream)
 
     def _admit_one(self, slot: int, request: Request, total: int,
-                   now: float) -> None:
+                   now: float, *, parked: Parked | None = None,
+                   stream: np.ndarray | None = None) -> None:
         p = len(request.prompt)
         self._requests[slot] = request
         self._prompt_len[slot] = p
@@ -551,50 +591,72 @@ class ContinuousBatchingEngine:
         self._temp[slot] = request.sampling.temperature
         self._top_k[slot] = request.sampling.top_k
         self._top_p[slot] = request.sampling.top_p
-        self._admit_s[slot] = now
-        self._first_tok_s[slot] = None
+        stream = (np.asarray(request.prompt, np.int32).reshape(-1)
+                  if stream is None else stream)
+        fill = len(stream)
+        self._stream[slot] = stream
+        self._fill_len[slot] = fill
         self._chunks_done[slot] = 0
         if request.arrival_s is None:
             request.arrival_s = now
-        if self.tracer is not None:
-            # Replica-side queue wait: front-end arrival -> slot admission.
-            self.tracer.span("queue_wait", request.trace_id,
-                             request.arrival_s, now,
-                             request_id=request.request_id, slot=slot)
+        if parked is None:
+            self._admit_s[slot] = now
+            self._first_tok_s[slot] = None
+            self._parks[slot] = 0
+            if self.tracer is not None:
+                # Replica-side queue wait: front-end arrival -> slot admission.
+                self.tracer.span("queue_wait", request.trace_id,
+                                 request.arrival_s, now,
+                                 request_id=request.request_id, slot=slot)
+        else:
+            # Resume: the latency stamps survive the park — queue wait and
+            # TTFT were paid once, at the original admission; only e2e keeps
+            # growing through the parked gap (that is the squeeze the
+            # best-effort tier absorbed, and it must stay visible).
+            self.resumes += 1
+            self._admit_s[slot] = parked.admit_s
+            self._first_tok_s[slot] = parked.first_tok_s
+            self._parks[slot] = parked.parks
+            if self.tracer is not None:
+                self.tracer.span("resume", request.trace_id,
+                                 parked.parked_s, now,
+                                 request_id=request.request_id, slot=slot,
+                                 parks=parked.parks, resumed_at=fill)
         self._ready_s[slot] = now
-        prompt_np = np.asarray(request.prompt, np.int32).reshape(-1)
         hit_len = 0
-        if self.prefix_cache is not None and p:
+        if self.prefix_cache is not None and fill:
             # layout passed explicitly: a foreign cache object (written by an
             # engine with another dtype policy) must miss, never install.
             hit_len, planes = self.prefix_cache.lookup(
-                prompt_np, min_len=min(self.prefill_chunk_sizes),
+                stream, min_len=min(self.prefill_chunk_sizes),
                 layout=self.plane_layout)
             if hit_len:
                 self._cache = self._install_jit(self._cache, planes,
                                                 np.int32(slot))
         self._hit_len[slot] = hit_len
-        if not self.prefill_chunk_sizes or p == 0:
+        if not self.prefill_chunk_sizes or fill == 0:
             # Legacy prefill-as-decode (or nothing to prefill): the slot joins
             # the decode program at t=0; the next step's ``fresh`` mask wipes it.
             self._active[slot] = True
             self._ids[slot] = self.model.vocab_size - 1          # BOS restart
             self._t[slot] = 0
             self._out[slot] = []
-            if self.drafter is not None:         # spec mode implies p == 0 here
+            if self.drafter is not None:         # spec mode implies fill == 0 here
                 self.drafter.on_activate(slot, [])
-        elif hit_len == p:
+        elif hit_len == fill:
             # Full prefix hit: the installed planes ARE the prefill — the slot
-            # joins decode at position p with zero chunk invocations.
+            # joins decode at position `fill` with zero chunk invocations (a
+            # resumed park whose planes survived in the cache lands here:
+            # resume costs one install program, no recompute).
             self._activate_prefilled(slot)
             self._record_prefill(slot, wall_s=0.0, latency_s=0.0)
         else:
-            # Chunked prefill over [hit_len, p): the slot stays out of the
+            # Chunked prefill over [hit_len, fill): the slot stays out of the
             # decode batch until its plan drains. Its ``t`` parks at seq_len-1
             # so the decode program's unconditional per-slot cache write lands
             # on a row that is rewritten before it can ever become visible —
             # never on the rows prefill is filling.
-            self._pending_chunks[slot] = self.plan_prefill(hit_len, p)
+            self._pending_chunks[slot] = self.plan_prefill(hit_len, fill)
             self._prefill_fifo.append(slot)
             self._prefill_t0[slot] = now
             self._chunk_wall[slot] = 0.0
@@ -604,14 +666,15 @@ class ContinuousBatchingEngine:
                                     # mid-prefill expiry, sliced from the plan)
 
     def _activate_prefilled(self, slot: int) -> None:
-        """Promote a slot whose cache holds its full prompt into the decode
-        batch: the emitted stream so far is the teacher-forced prompt, and the
-        next decode step samples the first generated token at position P."""
-        req = self._requests[slot]
-        p = int(self._prompt_len[slot])
-        self._ids[slot] = int(req.prompt[p - 1])
-        self._t[slot] = p
-        self._out[slot] = [int(x) for x in np.asarray(req.prompt, np.int32)]
+        """Promote a slot whose cache holds its full pre-computed stream into
+        the decode batch: the emitted stream so far is the teacher-forced
+        stream (the prompt; prompt + generated tokens after a resume), and
+        the next decode step samples the next token at position ``fill``."""
+        fill = int(self._fill_len[slot])
+        stream = self._stream[slot]
+        self._ids[slot] = int(stream[fill - 1])
+        self._t[slot] = fill
+        self._out[slot] = [int(x) for x in stream]
         self._active[slot] = True
         if self.drafter is not None:
             # The drafter mirrors the slot's stream from here (the draft LM
@@ -631,7 +694,7 @@ class ContinuousBatchingEngine:
             "request_id": req.request_id,
             "prompt_len": int(self._prompt_len[slot]),
             "chunks": int(self._chunks_done[slot]),
-            "tokens": int(self._prompt_len[slot]) - int(self._hit_len[slot]),
+            "tokens": int(self._fill_len[slot]) - int(self._hit_len[slot]),
             "cache_hit_len": int(self._hit_len[slot]),
             "wall_s": wall_s,
             "latency_s": latency_s,
@@ -647,6 +710,8 @@ class ContinuousBatchingEngine:
         self.steps = 0
         self.slot_steps = 0
         self.generated_tokens = 0
+        self.preemptions = 0
+        self.resumes = 0
         self.spec_steps = 0
         self.spec_slot_steps = 0
         self.spec_proposed = 0
@@ -731,14 +796,15 @@ class ContinuousBatchingEngine:
                 first_token_ts=first)
         if mid_prefill:
             # Mid-prefill expiry: the emitted stream is the teacher-forced
-            # prompt prefix covered so far — the next pending chunk's start.
+            # stream prefix covered so far — the next pending chunk's start.
             # The chunk wall already spent joins the aggregate (its tokens are
             # in prefill_tokens, so its time belongs in prefill_wall_s — else
             # expiries would inflate reported prefill throughput), and the
             # abandoned plan is dropped; the slot's next occupant wipes or
             # overwrites whatever the partial prefill left.
-            tokens = np.asarray(req.prompt[:self._pending_chunks[slot][0][0]],
-                                np.int32)
+            tokens = np.asarray(
+                self._stream[slot][:self._pending_chunks[slot][0][0]],
+                np.int32)
             self.prefill_wall_s += float(self._chunk_wall[slot])
             self._chunk_wall[slot] = 0.0
             self._pending_chunks[slot] = []
@@ -756,12 +822,15 @@ class ContinuousBatchingEngine:
             ttft_s=None if first is None else first - arrival,
             tpot_s=(now - first) / (new - 1)
             if first is not None and new > 1 else None,
-            e2e_s=now - arrival)
+            e2e_s=now - arrival,
+            preemptions=int(self._parks[slot]))
         self._requests[slot] = None
         self._active[slot] = False
         self._out[slot] = []
         self._first_tok_s[slot] = None
         self._hit_len[slot] = 0
+        self._stream[slot] = None
+        self._parks[slot] = 0
         if self.drafter is not None:
             self.drafter.on_release(slot)
         return comp
@@ -780,14 +849,27 @@ class ContinuousBatchingEngine:
         budget means prompts are arriving faster than prefill drains them."""
         return sum(len(c) for c in self._pending_chunks)
 
+    def _next_prefill_slot(self) -> int:
+        """The prefill scheduling rule: highest request PRIORITY first, FIFO
+        within a tier. Admission order alone was the rule before tenancy —
+        and it still is between equals — but a best-effort burst admitted a
+        beat before a paid request must not hold the paid prompt's chunks
+        hostage: TTFT is the promise the high tier pays for, and prefill IS
+        its TTFT (DESIGN.md §22)."""
+        return max(
+            ((i, slot) for i, slot in enumerate(self._prefill_fifo)),
+            key=lambda it: (getattr(self._requests[it[1]], "priority", 0),
+                            -it[0]))[1]
+
     def _run_prefill(self) -> None:
-        """Run up to ``prefill_chunk_budget`` chunk invocations, oldest admitted
-        slot first (FIFO — best TTFT fairness), finishing slots mid-budget. The
-        budget is what keeps a burst of long prompts from starving the decode
-        step that follows: prefill and decode interleave at chunk granularity."""
+        """Run up to ``prefill_chunk_budget`` chunk invocations — highest
+        priority tier first, oldest admitted slot within a tier — finishing
+        slots mid-budget. The budget is what keeps a burst of long prompts
+        from starving the decode step that follows: prefill and decode
+        interleave at chunk granularity."""
         budget = self.prefill_chunk_budget
         while budget > 0 and self._prefill_fifo:
-            slot = self._prefill_fifo[0]
+            slot = self._next_prefill_slot()
             start, length, size = self._pending_chunks[slot].pop(0)
             fresh = self._chunks_done[slot] == 0 and self._hit_len[slot] == 0
             t0 = time.monotonic()
@@ -810,15 +892,15 @@ class ContinuousBatchingEngine:
                 self._finish_prefill(slot)
 
     def _finish_prefill(self, slot: int) -> None:
-        self._prefill_fifo.popleft()          # chunks only run at the FIFO head
+        self._prefill_fifo.remove(slot)       # priority scheduling: the slot
+                                              # finishing need not be the head
         # One fence per PROMPT (decode pays one per token): makes the recorded
         # prefill wall honest and the snapshot below read settled rows.
         t0 = time.monotonic()
         jax.tree_util.tree_leaves(self._cache)[0].block_until_ready()
         self._chunk_wall[slot] += time.monotonic() - t0
         if self.prefix_cache is not None:
-            req = self._requests[slot]
-            self.prefix_cache.insert(np.asarray(req.prompt, np.int32),
+            self.prefix_cache.insert(np.asarray(self._stream[slot], np.int32),
                                      self._snapshot_jit(self._cache,
                                                         np.int32(slot)),
                                      layout=self.plane_layout)
@@ -970,6 +1052,153 @@ class ContinuousBatchingEngine:
         ``"spec"`` events."""
         records, self._spec_records = self._spec_records, []
         return records
+
+    def preemptible_slots(self) -> list[tuple[int, int]]:
+        """The park candidates: occupied slots whose request is marked
+        preemptible — decode-ready ones park their emitted stream (the
+        ``Parked`` path), MID-PREFILL ones abandon their remaining plan with
+        the covered rows saved to the prefix cache (the request itself
+        requeues). Victim order: lowest priority first; within a tier,
+        mid-prefill slots first (no generated tokens yet — the cheapest
+        seats to reclaim), then the most recently admitted (it has waited
+        least — and parking loses nothing either way, the cache preserves
+        the work)."""
+        out = []
+        for i, req in enumerate(self._requests):
+            if req is None or not req.preemptible:
+                continue
+            if self._pending_chunks[i] or (self._active[i]
+                                           and self._t[i] >= 1):
+                out.append((i, req.priority))
+        return sorted(out, key=lambda ip: (
+            ip[1], bool(self._active[ip[0]]), -self._admit_s[ip[0]]))
+
+    def park(self, slot: int, *, now: float | None = None):
+        """Evict one occupied slot (priority preemption): the computed state
+        so far and its K/V planes move to the prefix cache (one snapshot
+        program — the planes ARE the resume state), the slot frees, and the
+        returned record re-queues for later re-admission. A decode-ready
+        slot returns a ``Parked`` (its emitted stream is the resume key); a
+        MID-PREFILL slot returns its plain ``Request`` — the covered prompt
+        prefix is cached under its own token key, so re-admission's normal
+        prefix lookup resumes the prefill where it stopped (no new
+        machinery, and nothing to park when no chunk has landed yet).
+        Resume is token-identical under greedy by construction: the stream
+        is re-admitted exactly like a prompt of the same tokens, whose rows
+        are a pure function of the tokens and params (DESIGN.md §22) — the
+        cache hit only skips the recompute. Requires the chunked-prefill
+        path (the resume lane)."""
+        if not self.prefill_chunk_sizes:
+            raise RuntimeError("park/resume rides the chunked-prefill path — "
+                               "enable prefill_chunk_sizes to use it")
+        req = self._requests[slot]
+        if req is None:
+            raise ValueError(f"slot {slot} is empty")
+        now = time.monotonic() if now is None else now
+        if self._pending_chunks[slot]:
+            return self._park_mid_prefill(slot, req, now)
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} is not decode-ready")
+        t = int(self._t[slot])
+        if t < 1:
+            raise ValueError(f"slot {slot} has no cache rows to park")
+        tokens = np.asarray(self._out[slot], np.int32)
+        assert len(tokens) == t, "emitted stream and position out of sync"
+        if self.prefix_cache is not None:
+            # Evict-to-prefix-cache: the slot's settled rows [0, t) under
+            # their exact token key. The snapshot is one fixed-shape program;
+            # rows past t are donor garbage the position mask hides, exactly
+            # like every other cache entry.
+            self.prefix_cache.insert(tokens,
+                                     self._snapshot_jit(self._cache,
+                                                        np.int32(slot)),
+                                     layout=self.plane_layout)
+        parked = Parked(request=req, tokens=tokens,
+                        first_tok_s=self._first_tok_s[slot],
+                        admit_s=float(self._admit_s[slot]), parked_s=now,
+                        parks=int(self._parks[slot]) + 1)
+        if self.tracer is not None:
+            # The evicted decode stint: decode-ready -> park. The final
+            # decode span (emitted at finish) covers only the post-resume
+            # stint, so the two never double-charge an interval.
+            self.tracer.span("preempt_park", req.trace_id,
+                             float(self._ready_s[slot]), now,
+                             request_id=req.request_id, slot=slot,
+                             tokens_done=t, parks=parked.parks)
+        self.preemptions += 1
+        self._requests[slot] = None
+        self._active[slot] = False
+        self._out[slot] = []
+        self._first_tok_s[slot] = None
+        self._hit_len[slot] = 0
+        self._stream[slot] = None
+        self._parks[slot] = 0
+        if self.drafter is not None:
+            self.drafter.on_release(slot)
+        return parked
+
+    def _park_mid_prefill(self, slot: int, req: Request, now: float):
+        """The mid-prefill eviction: the covered stream prefix's rows go to
+        the prefix cache under their own token key (rows [0, start) are
+        settled — chunks run in order), the abandoned plan's chunk wall joins
+        the aggregate (same accounting as a mid-prefill expiry), and the
+        request re-queues. A FRESH occupant (still prefilling its prompt)
+        re-queues as the plain request — its next admission's prefix lookup
+        installs the covered rows and plans chunks for the remainder. A
+        RESUMED occupant (re-prefilling a previously parked stream after a
+        cache eviction) must keep its ``Parked`` identity: the full stream —
+        prompt plus ALREADY-GENERATED tokens — and the original latency
+        stamps ride the new record, or the generated tokens would be lost
+        under a prompt-only key and TTFT re-stamped."""
+        start = self._pending_chunks[slot][0][0]
+        if self.prefix_cache is not None and start > 0:
+            self.prefix_cache.insert(
+                np.asarray(self._stream[slot][:start], np.int32),
+                self._snapshot_jit(self._cache, np.int32(slot)),
+                layout=self.plane_layout)
+        self.prefill_wall_s += float(self._chunk_wall[slot])
+        self._chunk_wall[slot] = 0.0
+        self._pending_chunks[slot] = []
+        self._prefill_fifo.remove(slot)
+        parks = int(self._parks[slot])
+        if parks > 0:
+            # Re-park of a resumed stream: carry the stream and stamps
+            # forward (the covered rows are cached above; re-admission's
+            # lookup resumes the re-prefill wherever it stopped).
+            back = Parked(request=req,
+                          tokens=np.asarray(self._stream[slot], np.int32),
+                          first_tok_s=self._first_tok_s[slot],
+                          admit_s=float(self._admit_s[slot]),
+                          parked_s=now, parks=parks + 1)
+        else:
+            back = req
+        if self.tracer is not None:
+            self.tracer.span("preempt_park", req.trace_id,
+                             float(self._admit_s[slot]), now,
+                             request_id=req.request_id, slot=slot,
+                             tokens_done=int(start), parks=parks + 1,
+                             mid_prefill=True)
+        self.preemptions += 1
+        self._requests[slot] = None
+        self._active[slot] = False
+        self._out[slot] = []
+        self._first_tok_s[slot] = None
+        self._hit_len[slot] = 0
+        self._stream[slot] = None
+        self._parks[slot] = 0
+        if self.drafter is not None:
+            self.drafter.on_release(slot)
+        return back
+
+    def active_tenant_counts(self) -> dict[str, int]:
+        """Occupied slots per tenant — the server's per-tenant slot-cap
+        input."""
+        counts: dict[str, int] = {}
+        for req in self._requests:
+            if req is not None:
+                t = getattr(req, "tenant", "default")
+                counts[t] = counts.get(t, 0) + 1
+        return counts
 
     def expire(self, now: float | None = None) -> list[Completion]:
         """Force-finish in-flight requests whose deadline passed

@@ -90,7 +90,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.resilience.preempti
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
     QueueClosed,
     QueueFull,
+    QuotaExceeded,
     SamplingParams,
+    Shed,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.trace import (
     Tracer,
@@ -194,11 +196,15 @@ def build_engine_server(args, trace: Tracer | str | None = None):
     from csed_514_project_distributed_training_using_pytorch_tpu.obs.slo import (
         SLOSpec,
     )
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
+        parse_tenants,
+    )
 
     server = Server(engine, max_pending=args.max_pending,
                     default_timeout_s=args.timeout_s or None,
                     telemetry=args.telemetry,
                     slo=SLOSpec.parse(getattr(args, "slo", "")),
+                    tenants=parse_tenants(getattr(args, "tenants", "")),
                     trace=trace if trace is not None
                     else getattr(args, "trace", ""))
     return engine, server
@@ -290,12 +296,29 @@ def _handle_submit(msg, server, wfile, wlock):
     try:
         # trace_id rides the wire verbatim (present only when the router side
         # traces): the replica's spans join the fleet-wide trace by id alone.
+        # Same contract for the tenancy fields — tenant/priority/preemptible
+        # appear only on non-default requests (the router front door already
+        # charged the quota; the replica enforces the ENGINE-side half:
+        # priority preemption and per-tenant slot caps).
         fut = server.submit(prompt, max_new_tokens=msg["max_new_tokens"],
                             sampling=sampling, timeout_s=msg.get("timeout_s"),
-                            trace_id=msg.get("trace_id"))
+                            trace_id=msg.get("trace_id"),
+                            tenant=msg.get("tenant", "default"),
+                            priority=msg.get("priority"),
+                            preemptible=msg.get("preemptible"))
     except QueueFull:
         _send(wfile, wlock, {"op": "error", "id": rid, "error": "queue_full",
                              "message": "replica queue at capacity"})
+        return
+    except QuotaExceeded as e:
+        # Replica-local quota (standalone --tenants): a typed refusal reply,
+        # never a crash — an over-quota request must not kill the process.
+        _send(wfile, wlock, {"op": "error", "id": rid, "error": "quota",
+                             "message": str(e)})
+        return
+    except Shed as e:
+        _send(wfile, wlock, {"op": "error", "id": rid, "error": "shed",
+                             "message": str(e)})
         return
     except QueueClosed:
         # The shrink/submit race: this dispatch crossed the drain op on the
@@ -338,7 +361,7 @@ def _stats_payload(engine, server) -> dict:
     eng: dict = {"steps": engine.steps}
     for name in ("prefill_tokens", "prefill_invocations", "prefill_wall_s",
                  "trace_count", "slot_occupancy", "prefill_backlog",
-                 "generated_tokens"):
+                 "generated_tokens", "preemptions", "resumes"):
         if hasattr(engine, name):
             eng[name] = getattr(engine, name)
     if hasattr(engine, "spec_stats"):
@@ -362,6 +385,13 @@ def _stats_payload(engine, server) -> dict:
         slo = server.slo_summary()
         if slo is not None:
             out["slo"] = slo
+    if hasattr(server, "tenant_summaries"):
+        tenants = server.tenant_summaries()
+        if tenants:
+            # Per-tenant replica-local ledgers (counts + windowed attainment):
+            # the router folds these into fleet_snapshot's tenants section —
+            # what an SLO-driven autoscaler and fleet_top read per tier.
+            out["tenants"] = tenants
     return out
 
 
@@ -649,6 +679,13 @@ def main(argv: list[str] | None = None) -> int:
                         "window=30' (obs/slo.py) — attainment lands in the "
                         "serve_summary and the 'slo' drain event; empty = "
                         "no promise")
+    e.add_argument("--tenants", default="",
+                   help="tenant service classes, e.g. 'paid:w=4,prio=2,"
+                        "slo=ttft:0.3;free:w=1,preempt=1,rate=50' "
+                        "(serving/scheduler.py grammar) — activates per-"
+                        "tenant quotas, weighted-fair dequeue, slot caps, "
+                        "and priority preemption in this replica's server; "
+                        "empty = single implicit tenant")
     p.add_argument("--telemetry", default="",
                    help="this replica's own serve JSONL (optional)")
     p.add_argument("--trace", default="",

@@ -91,9 +91,12 @@ from csed_514_project_distributed_training_using_pytorch_tpu.obs.slo import (
     slo_event,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
+    QuotaExceeded,
     RequestQueue,
     SamplingParams,
     ServerStopped,
+    Shed,
+    TenantTable,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.train.launch import (
     Fleet,
@@ -126,6 +129,9 @@ class RouterRequest:
     dispatch_s: float | None = None     # last dispatch time (queue-wait split)
     affinity_hit: bool = False          # last dispatch landed on the affine replica
     trace_id: str | None = None         # distributed-tracing id (None = untraced)
+    tenant: str = "default"             # service class (DESIGN.md §22)
+    priority: int = 0                   # shed/preempt ordering (higher = paid)
+    preemptible: bool = False           # engine may park this mid-decode
     enqueued_s: float = 0.0             # last (re)entry into the router queue —
                                         # the current queue_wait span's start
 
@@ -139,12 +145,13 @@ class RouterCompletion:
 
     request_id: int
     tokens: np.ndarray
-    finish: str                         # "ok" | "timeout"
+    finish: str                         # "ok" | "timeout" | "shed"
     prompt_len: int
     new_tokens: int
     replica: int
     redispatches: int = 0
     affinity_hit: bool = False
+    tenant: str = "default"
     queue_wait_s: float | None = None   # router queue + replica queue
     ttft_s: float | None = None
     tpot_s: float | None = None
@@ -334,6 +341,7 @@ class Router:
                  max_replicas: int | None = None,
                  warm_prefixes: int = 8, drain_timeout_s: float = 30.0,
                  slo: SLOSpec | None = None, hist_rel_err: float = 0.01,
+                 tenants: TenantTable | None = None,
                  env: dict | None = None):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
@@ -360,7 +368,13 @@ class Router:
         self._command = list(replica_command)
         self._platform = platform
         self._env = env
-        self.queue = RequestQueue(max_pending)
+        # The tenant table: quotas + weighted-fair/priority dequeue live in
+        # the queue (the fleet's one front door — replicas never double-charge
+        # a quota), per-tenant in-flight caps in the dispatch gate below, and
+        # the engine-side half (slot caps, priority preemption) rides the wire
+        # per request. None = the implicit single-tenant class.
+        self.tenants = tenants
+        self.queue = RequestQueue(max_pending, tenants=tenants)
         self._default_timeout_s = default_timeout_s
         self._affinity_on = bool(affinity)
         self._affinity_min = int(affinity_min_tokens)
@@ -420,9 +434,17 @@ class Router:
         # Aggregates for router_summary (scalars + bounded sketches only: the
         # latency series are obs/hist.py LogHistograms — O(buckets) memory,
         # quantiles within hist_rel_err of the nearest-rank oracle).
-        self._counts = {"requests": 0, "ok": 0, "timeout": 0, "failed": 0,
+        self._counts = {"requests": 0, "ok": 0, "timeout": 0, "shed": 0,
+                        "failed": 0,
                         "redispatches": 0, "redispatched_requests": 0,
                         "duplicates": 0, "affinity_hits": 0, "new_tokens": 0}
+        # Per-tenant fleet-level ledgers: counts + client-facing ttft/e2e
+        # sketches + attainment against the tenant's own SLO (global spec as
+        # fallback) — the fleet_snapshot "tenants" section and the
+        # router-sourced tenant_summary events.
+        self._tenant_counts: dict[str, dict] = {}
+        self._tenant_series: dict[str, dict[str, LogHistogram]] = {}
+        self._slo_by_tenant: dict[str, AttainmentTracker] = {}
         self._hist_rel_err = float(hist_rel_err)
         self._series: dict[str, LogHistogram] = {
             name: LogHistogram(hist_rel_err)
@@ -455,6 +477,7 @@ class Router:
             "warm_prefixes": self._warm_prefixes,
             "drain_timeout_s": self._drain_timeout_s,
             "slo": (self._slo_spec.describe() if self._slo_spec else None),
+            "tenants": (self.tenants.describe() if self.tenants else None),
         })
         with self._lock:
             for rep in self.replicas:
@@ -736,15 +759,22 @@ class Router:
     def submit(self, prompt, *, max_new_tokens: int,
                sampling: SamplingParams | None = None,
                timeout_s: float | None = None,
-               trace_id: str | None = None) -> concurrent.futures.Future:
+               trace_id: str | None = None,
+               tenant: str = "default",
+               priority: int | None = None,
+               preemptible: bool | None = None) -> concurrent.futures.Future:
         """Thread-safe enqueue; returns a Future resolving to a
-        ``RouterCompletion``. Raises ``QueueFull`` (router backpressure)
-        immediately in the caller's thread. Deep validation (prompt vs seq_len,
-        sampling bounds) happens replica-side — an ``invalid`` reply fails the
-        future with ``ValueError`` (replays would fail identically, so it is
-        never redispatched). ``trace_id`` joins this request to an existing
-        distributed trace; with tracing on and no id given, this submit is the
-        trace origin and assigns one."""
+        ``RouterCompletion``. Raises ``QueueFull`` (router backpressure),
+        ``QuotaExceeded`` (the tenant's admission quota — the router is the
+        fleet's ONE quota-charging front door), or ``Shed`` (the queue is
+        full of strictly higher-priority work) immediately in the caller's
+        thread; an admission may DISPLACE queued lower-priority requests,
+        whose futures resolve ``finish="shed"``. Deep validation (prompt vs
+        seq_len, sampling bounds) happens replica-side — an ``invalid`` reply
+        fails the future with ``ValueError`` (replays would fail identically,
+        so it is never redispatched). ``trace_id`` joins this request to an
+        existing distributed trace; with tracing on and no id given, this
+        submit is the trace origin and assigns one."""
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if self._aborted:
@@ -756,6 +786,8 @@ class Router:
             self._next_id += 1
         if trace_id is None and self.tracer.enabled:
             trace_id = new_trace_id()
+        spec = (self.tenants.spec_for(tenant) if self.tenants is not None
+                else None)
         req = RouterRequest(
             prompt=np.asarray(prompt, np.int32).reshape(-1),
             max_new_tokens=int(max_new_tokens),
@@ -763,9 +795,47 @@ class Router:
             request_id=rid, future=concurrent.futures.Future(),
             arrival_s=now,
             deadline_s=None if timeout_s is None else now + timeout_s,
-            trace_id=trace_id, enqueued_s=now)
-        self.queue.submit(req)           # may raise QueueFull / closed
+            trace_id=trace_id, tenant=tenant,
+            priority=(priority if priority is not None
+                      else spec.priority if spec else 0),
+            preemptible=(preemptible if preemptible is not None
+                         else spec.preemptible if spec else False),
+            enqueued_s=now)
+        try:
+            shed = self.queue.submit(req)    # may raise QueueFull/Quota/Shed
+        except (Shed, QuotaExceeded) as e:
+            self._writer.emit({
+                "event": "shed", "source": "router", "tenant": tenant,
+                "reason": ("quota" if isinstance(e, QuotaExceeded)
+                           else "refused"),
+                "request_id": rid, "priority": req.priority})
+            raise
+        for victim in shed:
+            self._shed_victim(victim, now)
         return req.future
+
+    def _shed_victim(self, victim: RouterRequest, now: float) -> None:
+        """Resolve a queued request displaced by a higher-priority admission:
+        its future settles ``finish="shed"`` (the typed degradation, distinct
+        from a timeout) and the route/shed telemetry records which tenant
+        absorbed the squeeze."""
+        self._writer.emit({
+            "event": "shed", "source": "router", "tenant": victim.tenant,
+            "reason": "displaced", "request_id": victim.request_id,
+            "priority": victim.priority})
+        comp = RouterCompletion(
+            request_id=victim.request_id, tokens=np.zeros((0,), np.int32),
+            finish="shed", prompt_len=len(victim.prompt), new_tokens=0,
+            replica=-1, redispatches=victim.redispatches,
+            tenant=victim.tenant,
+            queue_wait_s=now - victim.arrival_s, e2e_s=now - victim.arrival_s)
+        try:
+            victim.future.set_result(comp)
+        except concurrent.futures.InvalidStateError:
+            return                        # lost a resolve race: already settled
+        self.tracer.span("resolve", victim.trace_id, now, time.monotonic(),
+                         request_id=victim.request_id, finish="shed")
+        self._record(comp)
 
     # ------------------------------------------------------------------ spawn/io
 
@@ -971,7 +1041,7 @@ class Router:
             prompt_len=int(msg.get("prompt_len", len(req.prompt))),
             new_tokens=int(msg.get("new_tokens", 0)),
             replica=rep.index, redispatches=req.redispatches,
-            affinity_hit=req.affinity_hit,
+            affinity_hit=req.affinity_hit, tenant=req.tenant,
             queue_wait_s=queue_wait,
             ttft_s=None if ttft is None else ttft + router_wait,
             tpot_s=msg.get("tpot_s"),
@@ -1020,8 +1090,9 @@ class Router:
             req.enqueued_s = now
             self.queue.requeue(req)
             return
-        err = (ValueError if kind == "invalid" else RuntimeError)(
-            msg.get("message", kind or "replica error"))
+        err_cls = {"invalid": ValueError, "shed": Shed,
+                   "quota": QuotaExceeded}.get(kind, RuntimeError)
+        err = err_cls(msg.get("message", kind or "replica error"))
         try:
             req.future.set_exception(err)
         except concurrent.futures.InvalidStateError:
@@ -1041,11 +1112,37 @@ class Router:
             self._counts["requests"] += 1
             self._counts["ok"] += comp.ok
             self._counts["timeout"] += comp.finish == "timeout"
+            self._counts["shed"] += comp.finish == "shed"
             self._counts["new_tokens"] += comp.new_tokens
             self._counts["affinity_hits"] += comp.affinity_hit
             self._counts["redispatched_requests"] += comp.redispatches > 0
             for name in self._series:
                 self._series[name].add(getattr(comp, name))
+            row = self._tenant_counts.setdefault(
+                comp.tenant, {"requests": 0, "ok": 0, "timeout": 0,
+                              "shed": 0, "new_tokens": 0})
+            row["requests"] += 1
+            row["ok"] += comp.ok
+            row["timeout"] += comp.finish == "timeout"
+            row["shed"] += comp.finish == "shed"
+            row["new_tokens"] += comp.new_tokens
+            tseries = self._tenant_series.setdefault(comp.tenant, {
+                "ttft_s": LogHistogram(self._hist_rel_err),
+                "e2e_s": LogHistogram(self._hist_rel_err)})
+            tseries["ttft_s"].add(comp.ttft_s)
+            tseries["e2e_s"].add(comp.e2e_s)
+            tspec = ((self.tenants.spec_for(comp.tenant).slo
+                      if self.tenants is not None else None)
+                     or self._slo_spec)
+            if tspec is not None:
+                tracker = self._slo_by_tenant.get(comp.tenant)
+                if tracker is None:
+                    tracker = self._slo_by_tenant[comp.tenant] = \
+                        AttainmentTracker(tspec)
+                # The client-facing per-tenant promise: the windowed view is
+                # what fleet_snapshot ships the SLO-driven autoscaler.
+                tracker.observe(now, ok=comp.ok, ttft_s=comp.ttft_s,
+                                tpot_s=comp.tpot_s, e2e_s=comp.e2e_s)
             if self._slo_fleet is not None:
                 self._slo_fleet.observe(now, ok=comp.ok, ttft_s=comp.ttft_s,
                                         tpot_s=comp.tpot_s, e2e_s=comp.e2e_s)
@@ -1060,6 +1157,7 @@ class Router:
             "prompt_len": comp.prompt_len, "new_tokens": comp.new_tokens,
             "queue_wait_s": comp.queue_wait_s, "ttft_s": comp.ttft_s,
             "tpot_s": comp.tpot_s, "e2e_s": comp.e2e_s,
+            "tenant": comp.tenant,
         })
 
     # ------------------------------------------------------------------ dispatch
@@ -1096,7 +1194,10 @@ class Router:
     def _submit_msg(req: RouterRequest, now: float) -> dict:
         """The wire-protocol submit line. ``trace_id`` is added ONLY when the
         request carries one — tracing off keeps the message byte-identical to
-        the pre-tracing protocol (pinned in tests)."""
+        the pre-tracing protocol (pinned in tests). The tenancy fields follow
+        the same rule: a default-class request (tenant "default", priority 0,
+        not preemptible) ships the exact pre-tenancy line, so single-tenant
+        fleets never change on the wire."""
         msg = {"op": "submit", "id": req.request_id,
                "prompt": [int(t) for t in req.prompt],
                "max_new_tokens": req.max_new_tokens,
@@ -1106,6 +1207,12 @@ class Router:
                              else max(0.001, req.deadline_s - now))}
         if req.trace_id is not None:
             msg["trace_id"] = req.trace_id
+        if req.tenant != "default":
+            msg["tenant"] = req.tenant
+        if req.priority:
+            msg["priority"] = req.priority
+        if req.preemptible:
+            msg["preemptible"] = True
         return msg
 
     def _dispatch_one(self, req: RouterRequest) -> bool:
@@ -1158,7 +1265,7 @@ class Router:
         comp = RouterCompletion(
             request_id=req.request_id, tokens=np.zeros((0,), np.int32),
             finish="timeout", prompt_len=len(req.prompt), new_tokens=0,
-            replica=-1, redispatches=req.redispatches,
+            replica=-1, redispatches=req.redispatches, tenant=req.tenant,
             queue_wait_s=now - req.arrival_s, e2e_s=now - req.arrival_s)
         try:
             req.future.set_result(comp)
@@ -1170,6 +1277,29 @@ class Router:
                          redispatches=req.redispatches)
         self._record(comp)
 
+    def _tenant_inflight_locked(self) -> dict[str, int]:
+        """Concurrent dispatches per tenant, summed over the replica ledgers
+        (on demand — the ledgers are the one source of truth, so no counter
+        can drift through the redispatch/drain/expiry paths)."""
+        counts: dict[str, int] = {}
+        for rep in self.replicas:
+            for req in rep.inflight.values():
+                counts[req.tenant] = counts.get(req.tenant, 0) + 1
+        return counts
+
+    def _tenant_budgets_locked(self) -> dict | None:
+        """Per-tenant dispatch allowance (``max_inflight`` minus the ledger
+        count): the budget decrements inside ``take``, so one pass can never
+        overshoot a cap — a best-effort burst cannot occupy the whole fleet
+        while other tenants' work flows around it."""
+        if self.tenants is None:
+            return None
+        counts = self._tenant_inflight_locked()
+        budgets = {name: spec.max_inflight - counts.get(name, 0)
+                   for name, spec in self.tenants.specs.items()
+                   if spec.max_inflight}
+        return budgets or None
+
     def _dispatch_loop(self) -> None:
         while True:
             with self._lock:
@@ -1179,12 +1309,20 @@ class Router:
             with self._cond:
                 # take-and-mark is one transaction: a request must never be in
                 # neither the queue nor anywhere a shutdown sweep looks.
-                admitted, expired = self.queue.take(now, 1)
+                admitted, expired = self.queue.take(
+                    now, 1, tenant_budgets=self._tenant_budgets_locked())
                 if admitted:
                     self._in_transit = admitted[0]
             for req in expired:
                 self._expire(req, now)
             if not admitted:
+                if len(self.queue):
+                    # Work is queued but nothing was takeable: every queued
+                    # lane is at its tenant's in-flight cap. Throttle — cap
+                    # room opens when a completion lands, not when the queue
+                    # stirs, so spinning on the condition would burn a core.
+                    time.sleep(self._poll_s)
+                    continue
                 # wait_for_work returns immediately once the queue is closed
                 # (drain in progress); don't turn that into a hot spin.
                 if not self.queue.wait_for_work(self._poll_s) and self.queue.closed:
@@ -1469,9 +1607,39 @@ class Router:
         capacity = sum(r["capacity"] or 0 for r in per_replica
                        if r["state"] == "ready")
         routed = counts["requests"]
+        queue_snap = self.queue.snapshot(now)
+        with self._lock:
+            # Per-tenant fleet state: in-flight dispatches (summed over the
+            # ledgers), the queue's lane counters, and the tenant's windowed
+            # attainment — the row an SLO-driven autoscaler (slo_tenant=...)
+            # and fleet_top read per tier.
+            tenant_inflight = self._tenant_inflight_locked()
+            tenant_names = set(tenant_inflight) | set(self._tenant_counts) \
+                | set((queue_snap.get("tenants") or {}))
+            if self.tenants is not None:
+                tenant_names |= set(self.tenants.names())
+            tenants = {}
+            for name in sorted(tenant_names):
+                lane = (queue_snap.get("tenants") or {}).get(name) or {}
+                fleet_row = self._tenant_counts.get(name) or {}
+                tracker = self._slo_by_tenant.get(name)
+                tenants[name] = {
+                    "inflight": tenant_inflight.get(name, 0),
+                    "queued": lane.get("depth", 0),
+                    "oldest_age_s": lane.get("oldest_age_s"),
+                    # The queue's lane tally covers BOTH shed flavors
+                    # (refused arrivals and displaced victims) — the
+                    # completion-side count would double-charge the latter.
+                    "quota_rejected": lane.get("quota_rejected", 0),
+                    "shed": lane.get("shed", 0),
+                    "requests": fleet_row.get("requests", 0),
+                    "slo": (tracker.window(now) if tracker is not None
+                            else None),
+                }
         return {
             "event": "fleet_snapshot",
-            "queue": self.queue.snapshot(now),
+            "queue": queue_snap,
+            "tenants": tenants or None,
             "inflight": inflight,
             "capacity_up": capacity,
             "utilization": ready_inflight / capacity if capacity else None,
@@ -1635,6 +1803,9 @@ class Router:
             self._writer.emit(slo_event(
                 self._slo_fleet, source="router",
                 window=self._slo_fleet.window(time.monotonic())))
+        for tenant, row in self.tenant_summaries().items():
+            self._writer.emit({"event": "tenant_summary", "source": "router",
+                               "tenant": tenant, **row})
         self.last_summary = self._summary(end_s=served_until_s)
         self._writer.emit(dict(self.last_summary))
         self._writer.close()
@@ -1642,6 +1813,36 @@ class Router:
         if err is not None:
             raise err
         return self.last_summary
+
+    def tenant_summaries(self) -> dict[str, dict]:
+        """Per-tenant fleet-level ledgers: client-facing counts + ttft/e2e
+        percentiles + run-level attainment against the tenant's own spec,
+        plus the queue's admission tallies (quota refusals, sheds). The
+        ``tenant_summary`` surface, mirrored into ``router_summary``."""
+        lanes = (self.queue.snapshot().get("tenants") or {})
+        with self._lock:
+            names = (set(self._tenant_counts) | set(lanes)
+                     | (set(self.tenants.names()) if self.tenants else set()))
+            out = {}
+            for name in sorted(names):
+                row = dict(self._tenant_counts.get(name)
+                           or {"requests": 0, "ok": 0, "timeout": 0,
+                               "shed": 0, "new_tokens": 0})
+                lane = lanes.get(name) or {}
+                # Queue-side sheds cover refused arrivals too; use the lane
+                # tally as THE shed count (displaced victims appear in both).
+                row["shed"] = max(row["shed"], lane.get("shed", 0))
+                row["quota_rejected"] = lane.get("quota_rejected", 0)
+                series = self._tenant_series.get(name) or {}
+                tracker = self._slo_by_tenant.get(name)
+                row.update(
+                    ttft_s=(series["ttft_s"].percentiles()
+                            if "ttft_s" in series else None),
+                    e2e_s=(series["e2e_s"].percentiles()
+                           if "e2e_s" in series else None),
+                    slo=tracker.summary() if tracker is not None else None)
+                out[name] = row
+            return out
 
     def _summary(self, end_s: float | None = None) -> dict:
         t0 = self._served_from_s or self._started_s
@@ -1732,6 +1933,13 @@ class Router:
             "spec": spec if spec_mode is not None else None,
             "queue": self.queue.snapshot(),
             "slo": slo,
+            "tenants": self.tenant_summaries() or None,
+            "preemptions": sum(
+                ((r["stats"] or {}).get("engine") or {}).get("preemptions") or 0
+                for r in per_replica),
+            "resumes": sum(
+                ((r["stats"] or {}).get("engine") or {}).get("resumes") or 0
+                for r in per_replica),
             "ttft_s": series["ttft_s"].percentiles(),
             "e2e_s": series["e2e_s"].percentiles(),
             "queue_wait_s": series["queue_wait_s"].percentiles(),

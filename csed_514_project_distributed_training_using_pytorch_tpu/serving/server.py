@@ -45,9 +45,14 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine impo
     Request,
     SamplingParams,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+    scheduler as scheduler_mod,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
+    Parked,
     RequestQueue,
     ServerStopped,
+    TenantTable,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
     telemetry as T,
@@ -75,6 +80,7 @@ class Server:
                  telemetry: str | T.TelemetryWriter | None = None,
                  trace: str | Tracer | None = None,
                  slo: SLOSpec | None = None,
+                 tenants: TenantTable | None = None,
                  hist_rel_err: float = 0.01,
                  idle_wait_s: float = 0.05):
         self.engine = engine
@@ -82,7 +88,13 @@ class Server:
                        else Tracer(trace or "", proc="server"))
         if self.tracer.enabled:
             engine.tracer = self.tracer
-        self.queue = RequestQueue(max_pending)
+        # The tenant table activates the whole SLO-tier discipline (DESIGN.md
+        # §22): per-tenant quotas + weighted-fair/priority dequeue live in the
+        # queue, per-tenant slot caps and priority preemption in the loop
+        # below. None = the implicit single-tenant class, bitwise the old
+        # behavior.
+        self.tenants = tenants
+        self.queue = RequestQueue(max_pending, tenants=tenants)
         self._default_timeout_s = default_timeout_s
         self._writer = (telemetry if isinstance(telemetry, T.TelemetryWriter)
                         else T.TelemetryWriter(telemetry, stream=True))
@@ -100,12 +112,22 @@ class Server:
         # series are LogHistogram sketches (obs/hist.py: O(buckets) memory,
         # quantiles within hist_rel_err of the nearest-rank oracle, mergeable
         # across replicas via the stats protocol), everything else scalars.
-        self._counts = {"requests": 0, "ok": 0, "timeout": 0, "new_tokens": 0}
+        self._counts = {"requests": 0, "ok": 0, "timeout": 0, "shed": 0,
+                        "new_tokens": 0}
+        self._hist_rel_err = float(hist_rel_err)
         self._series: dict[str, LogHistogram] = {
             name: LogHistogram(hist_rel_err)
             for name in ("ttft_s", "tpot_s", "e2e_s", "queue_wait_s")}
         # Run-level SLO attainment (obs/slo.py), None = no promise declared.
+        self._slo_spec = slo
         self._slo = AttainmentTracker(slo) if slo is not None else None
+        # Per-tenant ledgers (counts + ttft/e2e sketches + attainment against
+        # the tenant's own SLO, falling back to the global spec): the
+        # ``tenant_summary`` surface. Lazy — a single-tenant run allocates
+        # exactly one row.
+        self._tenant_stats: dict[str, dict] = {}
+        self._tenant_series: dict[str, dict[str, LogHistogram]] = {}
+        self._slo_by_tenant: dict[str, AttainmentTracker] = {}
         # The loop thread mutates the sketches/tracker per completion; the
         # replica's stats handler serializes them from ITS connection thread
         # (latency_histograms/slo_summary) — an unguarded to_json() racing an
@@ -137,6 +159,7 @@ class Server:
             "spec_k": (self.engine.spec_k
                        if self.engine.drafter is not None else None),
             "slo": (self._slo.spec.describe() if self._slo else None),
+            "tenants": (self.tenants.describe() if self.tenants else None),
         })
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-loop")
@@ -202,16 +225,24 @@ class Server:
                sampling: SamplingParams = SamplingParams(),
                timeout_s: float | None = None,
                trace_id: str | None = None,
+               tenant: str = "default",
+               priority: int | None = None,
+               preemptible: bool | None = None,
                traced: bool = True) -> concurrent.futures.Future:
         """Thread-safe enqueue. Returns a Future resolving to a ``Completion``
-        (``finish`` tells ok from timeout). Raises ``QueueFull`` (backpressure)
-        or ``ValueError`` (admission control: oversized prompt, bad sampling
-        params) immediately, in the caller's thread. ``trace_id`` joins this
-        request to an existing distributed trace; with tracing on and no id
-        given, this submit is the trace origin and assigns one —
-        ``traced=False`` opts out (internal traffic like the replica's
-        prefix-cache warm replay is setup, not a request, and must not mint
-        trace trees of its own)."""
+        (``finish`` tells ok from timeout/shed). Raises ``QueueFull``
+        (backpressure), ``QuotaExceeded`` (the tenant's admission quota),
+        ``Shed`` (the queue is full of strictly higher-priority work), or
+        ``ValueError`` (admission control: oversized prompt, bad sampling
+        params) immediately, in the caller's thread. ``tenant`` names the
+        service class: priority/preemptibility default to the tenant table's
+        spec (overridable per request); an admission may DISPLACE queued
+        lower-priority requests, whose futures resolve ``finish="shed"``.
+        ``trace_id`` joins this request to an existing distributed trace;
+        with tracing on and no id given, this submit is the trace origin and
+        assigns one — ``traced=False`` opts out (internal traffic like the
+        replica's prefix-cache warm replay is setup, not a request, and must
+        not mint trace trees of its own)."""
         now = time.monotonic()
         timeout_s = self._default_timeout_s if timeout_s is None else timeout_s
         with self._id_lock:
@@ -219,43 +250,96 @@ class Server:
             self._next_id += 1
         if trace_id is None and traced and self.tracer.enabled:
             trace_id = new_trace_id()
+        spec = (self.tenants.spec_for(tenant) if self.tenants is not None
+                else None)
         req = Request(
             prompt=np.asarray(prompt, np.int32).reshape(-1),
             max_new_tokens=int(max_new_tokens), sampling=sampling,
             request_id=rid, arrival_s=now,
             deadline_s=None if timeout_s is None else now + timeout_s,
-            trace_id=trace_id)
+            trace_id=trace_id, tenant=tenant,
+            priority=(priority if priority is not None
+                      else spec.priority if spec else 0),
+            preemptible=(preemptible if preemptible is not None
+                         else spec.preemptible if spec else False))
         self.engine.validate(req)                # fail fast, before queueing
         fut: concurrent.futures.Future = concurrent.futures.Future()
         with self._futures_lock:
             self._futures[rid] = fut
         try:
-            self.queue.submit(req)
-        except BaseException:
+            shed = self.queue.submit(req)
+        except BaseException as e:
             with self._futures_lock:
                 self._futures.pop(rid, None)
+            if isinstance(e, scheduler_mod.Shed):
+                self._writer.emit(T.shed_event(
+                    tenant=tenant, reason="refused", request_id=rid,
+                    priority=req.priority))
+            elif isinstance(e, scheduler_mod.QuotaExceeded):
+                self._writer.emit(T.shed_event(
+                    tenant=tenant, reason="quota", request_id=rid,
+                    priority=req.priority))
             raise
+        for victim in shed:
+            # A queued lower-priority request was displaced to admit this one:
+            # resolve its future as shed (the client-visible "you absorbed the
+            # squeeze" signal, distinct from a timeout).
+            self._writer.emit(T.shed_event(
+                tenant=getattr(victim, "tenant", "default"),
+                reason="displaced", request_id=victim.request_id,
+                priority=getattr(victim, "priority", 0)))
+            self._resolve(self._rejected_completion(victim, now,
+                                                    finish="shed"))
         return fut
 
     # ------------------------------------------------------------------ loop
 
     def _resolve(self, comp: Completion) -> None:
         t0 = time.monotonic()
-        self._counts["requests"] += 1
-        self._counts["ok"] += comp.ok
-        self._counts["timeout"] += comp.finish == "timeout"
-        self._counts["new_tokens"] += comp.new_tokens
+        tenant = getattr(comp.request, "tenant", "default")
+        # Under the series lock: shed victims resolve on the SUBMITTER's
+        # thread (Server.submit displaces them), so the counters are no
+        # longer loop-thread-private.
         with self._series_lock:
+            self._counts["requests"] += 1
+            self._counts["ok"] += comp.ok
+            self._counts["timeout"] += comp.finish == "timeout"
+            self._counts["shed"] += comp.finish == "shed"
+            self._counts["new_tokens"] += comp.new_tokens
             for name in self._series:
                 self._series[name].add(getattr(comp, name))
             if self._slo is not None:
                 self._slo.observe(t0, ok=comp.ok, ttft_s=comp.ttft_s,
                                   tpot_s=comp.tpot_s, e2e_s=comp.e2e_s)
+            row = self._tenant_stats.setdefault(
+                tenant, {"requests": 0, "ok": 0, "timeout": 0, "shed": 0,
+                         "new_tokens": 0, "preemptions": 0})
+            row["requests"] += 1
+            row["ok"] += comp.ok
+            row["timeout"] += comp.finish == "timeout"
+            row["shed"] += comp.finish == "shed"
+            row["new_tokens"] += comp.new_tokens
+            row["preemptions"] += comp.preemptions
+            series = self._tenant_series.setdefault(tenant, {
+                "ttft_s": LogHistogram(self._hist_rel_err),
+                "e2e_s": LogHistogram(self._hist_rel_err)})
+            series["ttft_s"].add(comp.ttft_s)
+            series["e2e_s"].add(comp.e2e_s)
+            spec = (self.tenants.spec_for(tenant).slo
+                    if self.tenants is not None else None) or self._slo_spec
+            if spec is not None:
+                tracker = self._slo_by_tenant.get(tenant)
+                if tracker is None:
+                    tracker = self._slo_by_tenant[tenant] = \
+                        AttainmentTracker(spec)
+                tracker.observe(t0, ok=comp.ok, ttft_s=comp.ttft_s,
+                                tpot_s=comp.tpot_s, e2e_s=comp.e2e_s)
         self._writer.emit(T.serve_event(
             request_id=comp.request.request_id, prompt_len=comp.prompt_len,
             new_tokens=comp.new_tokens, finish=comp.finish,
             queue_wait_s=comp.queue_wait_s, ttft_s=comp.ttft_s,
-            tpot_s=comp.tpot_s, e2e_s=comp.e2e_s))
+            tpot_s=comp.tpot_s, e2e_s=comp.e2e_s,
+            tenant=tenant, preemptions=comp.preemptions))
         with self._futures_lock:
             fut = self._futures.pop(comp.request.request_id, None)
         if fut is not None:
@@ -267,12 +351,30 @@ class Server:
                          request_id=comp.request.request_id, finish=comp.finish,
                          new_tokens=comp.new_tokens)
 
-    def _reject_expired(self, req: Request, now: float) -> None:
-        self._resolve(Completion(
-            request=req, tokens=np.zeros((0,), np.int32), finish="timeout",
-            prompt_len=len(req.prompt), new_tokens=0,
+    @staticmethod
+    def _rejected_completion(item, now: float, *,
+                             finish: str) -> Completion:
+        """The completion for a request settled WITHOUT a slot: a queued
+        expiry (``finish="timeout"``) or a shed victim (``finish="shed"``).
+        A displaced ``Parked`` record keeps its partial stream — work the
+        client already half-received must not vanish from the record."""
+        parked = item if isinstance(item, Parked) else None
+        req = parked.request if parked is not None else item
+        tokens = (np.asarray(parked.tokens, np.int32) if parked is not None
+                  else np.zeros((0,), np.int32))
+        plen = len(req.prompt)
+        return Completion(
+            request=req, tokens=tokens, finish=finish,
+            prompt_len=plen, new_tokens=max(len(tokens) - plen, 0),
+            ttft_s=(None if parked is None or parked.first_tok_s is None
+                    or not req.arrival_s
+                    else parked.first_tok_s - req.arrival_s),
             queue_wait_s=now - req.arrival_s if req.arrival_s else None,
-            e2e_s=now - req.arrival_s if req.arrival_s else None))
+            e2e_s=now - req.arrival_s if req.arrival_s else None,
+            preemptions=parked.parks if parked is not None else 0)
+
+    def _reject_expired(self, req, now: float) -> None:
+        self._resolve(self._rejected_completion(req, now, finish="timeout"))
 
     def _loop(self) -> None:
         try:
@@ -301,6 +403,73 @@ class Server:
                 self._writer.close()
                 self.tracer.close()
 
+    def _maybe_preempt(self, now: float) -> None:
+        """Priority preemption, the slot-pressure half of the tenant
+        discipline: when higher-priority work is waiting and no slot is free,
+        park preemptible lower-priority mid-decode slots (lowest tier first)
+        — their state evicts to the prefix cache and the request re-queues at
+        the front of its lane, to resume token-identically when the squeeze
+        passes. One victim per waiting higher-priority request, never more."""
+        eng = self.engine
+        # No tenant table needed: priority/preemptible ride each request (a
+        # fleet replica sees only the wire fields — the router keeps the
+        # table), and a default-class workload never has priority > 0 waiting
+        # over a preemptible slot, so this is zero-cost when tenancy is off.
+        if not eng.prefill_chunk_sizes:
+            return
+        # A capped tenant's waiting work must not trigger evictions its own
+        # cap would then refuse to use (park/resume churn with zero
+        # progress); same for already-expired requests, which the next take
+        # settles without ever needing a slot.
+        waiting = self.queue.waiting_priorities(
+            skip_tenants=self._capped_tenants(), now=now)   # descending
+        if not waiting:
+            return
+        victims = eng.preemptible_slots()              # lowest priority first
+        if not victims:
+            return
+        free = len(eng.free_slots())
+        vi = 0
+        for wp in waiting:
+            if free > 0:
+                free -= 1                  # a free slot serves it; no eviction
+                continue
+            # victims is priority-ascending: once the cheapest remaining
+            # victim is at/above the waiting tier, no later one is below it.
+            if vi >= len(victims) or victims[vi][1] >= wp:
+                break
+            slot, _ = victims[vi]
+            vi += 1
+            parked = eng.park(slot, now=now)
+            self.queue.requeue(parked)
+            # The freed slot is matched to THIS waiting request — it is not
+            # returned to the free pool, or the next iteration would consume
+            # it again and under-park by one per pass.
+
+    def _tenant_budgets(self) -> dict | None:
+        """Per-tenant SLOT allowance for one admission pass (``max_inflight``
+        on the spec minus slots already held): the budget decrements inside
+        ``take``, so a single batched admission can never overshoot a cap —
+        the cap is what keeps a best-effort burst from monopolizing every
+        slot in the first place, so preemption is the exception, not the
+        steady state."""
+        if self.tenants is None:
+            return None
+        counts = self.engine.active_tenant_counts()
+        budgets = {name: spec.max_inflight - counts.get(name, 0)
+                   for name, spec in self.tenants.specs.items()
+                   if spec.max_inflight}
+        return budgets or None
+
+    def _capped_tenants(self) -> set | None:
+        """Tenants whose slot budget is spent right now (the preemption-
+        pressure filter: their waiting work cannot be served anyway)."""
+        budgets = self._tenant_budgets()
+        if not budgets:
+            return None
+        capped = {name for name, left in budgets.items() if left <= 0}
+        return capped or None
+
     def _loop_body(self) -> None:
         eng = self.engine
         while True:
@@ -315,7 +484,10 @@ class Server:
                 self.queue.force_deadline(now - 1.0)
             for comp in eng.expire(now):
                 self._resolve(comp)
-            admitted, expired = self.queue.take(now, len(eng.free_slots()))
+            self._maybe_preempt(now)
+            admitted, expired = self.queue.take(
+                now, len(eng.free_slots()),
+                tenant_budgets=self._tenant_budgets())
             for req in expired:
                 self._reject_expired(req, now)
             # One padded scatter dispatch admits the whole batch of freed slots.
@@ -348,6 +520,41 @@ class Server:
         with self._series_lock:
             return self._slo.summary() if self._slo is not None else None
 
+    def tenant_summaries(self) -> dict[str, dict]:
+        """Per-tenant ledgers: counts, ttft/e2e percentiles, preemptions, and
+        attainment against the tenant's own SLO (global spec as fallback) —
+        the ``tenant_summary`` surface, also shipped over the replica stats
+        protocol so the router can fold fleet-wide per-tenant views.
+        Thread-safe for the same reason ``latency_histograms`` is."""
+        lanes = self.queue.snapshot().get("tenants") or {}
+        with self._series_lock:
+            now = time.monotonic()
+            out = {}
+            for tenant in set(self._tenant_stats) | set(lanes):
+                row = dict(self._tenant_stats.get(tenant)
+                           or {"requests": 0, "ok": 0, "timeout": 0,
+                               "shed": 0, "new_tokens": 0, "preemptions": 0})
+                lane = lanes.get(tenant) or {}
+                # The queue's lane tally also counts REFUSED arrivals (typed
+                # Shed raised at submit — no completion ever exists for
+                # them); the completion-side count covers displaced victims,
+                # which appear in both, so merge by max, as the router does.
+                row["shed"] = max(row["shed"], lane.get("shed", 0))
+                row["quota_rejected"] = lane.get("quota_rejected", 0)
+                series = self._tenant_series.get(tenant) or {}
+                tracker = self._slo_by_tenant.get(tenant)
+                out[tenant] = {
+                    **row,
+                    "ttft_s": (series["ttft_s"].percentiles()
+                               if "ttft_s" in series else None),
+                    "e2e_s": (series["e2e_s"].percentiles()
+                              if "e2e_s" in series else None),
+                    "slo": tracker.summary() if tracker is not None else None,
+                    "slo_window": (tracker.window(now)
+                                   if tracker is not None else None),
+                }
+            return out
+
     def _emit_summary(self) -> None:
         wall_s = (time.monotonic() - self._started_s
                   if self._started_s is not None else None)
@@ -356,6 +563,13 @@ class Server:
             self._writer.emit(slo_event(
                 self._slo, source="server",
                 window=self._slo.window(time.monotonic())))
+        tenants = self.tenant_summaries()
+        for tenant, row in tenants.items():
+            self._writer.emit(T.tenant_summary_event(
+                tenant=tenant, source="server", **{
+                    k: row.get(k) for k in (
+                        "requests", "ok", "timeout", "shed", "new_tokens",
+                        "preemptions", "ttft_s", "e2e_s", "slo")}))
         self._writer.emit(T.serve_summary_event(
             **self._counts, wall_s=wall_s,
             steps=eng.steps,
@@ -371,4 +585,7 @@ class Server:
             queue=self.queue.snapshot(),
             byte_accounting=eng.byte_accounting(),
             slo=self.slo_summary(),
+            preemptions=eng.preemptions,
+            resumes=eng.resumes,
+            tenants=tenants or None,
             **self._series))

@@ -551,10 +551,15 @@ def series_percentiles(series, qs=(50, 95, 99)) -> dict | None:
 
 def serve_event(*, request_id: int, prompt_len: int, new_tokens: int, finish: str,
                 queue_wait_s: float | None = None, ttft_s: float | None = None,
-                tpot_s: float | None = None, e2e_s: float | None = None) -> dict:
+                tpot_s: float | None = None, e2e_s: float | None = None,
+                tenant: str = "default", preemptions: int = 0) -> dict:
     """One served request (``serving/server.py``): the per-request latency record.
     ``tokens_per_s`` is request-local decode throughput — generated tokens over the
-    time since admission (e2e minus queue wait)."""
+    time since admission (e2e minus queue wait). ``tenant`` is the request's
+    service class (``"default"`` = the implicit single-tenant class);
+    ``preemptions`` how many times it was parked mid-decode by priority
+    pressure (DESIGN.md §22) — a parked-then-resumed request finishes
+    ``"ok"``, token-identical, but its e2e carries the squeeze it absorbed."""
     decode_s = (e2e_s - queue_wait_s
                 if e2e_s is not None and queue_wait_s is not None else None)
     return {
@@ -569,6 +574,55 @@ def serve_event(*, request_id: int, prompt_len: int, new_tokens: int, finish: st
         "e2e_s": _finite(e2e_s),
         "tokens_per_s": _finite(new_tokens / decode_s
                                 if new_tokens and decode_s else None),
+        "tenant": tenant,
+        "preemptions": int(preemptions),
+    }
+
+
+def shed_event(*, tenant: str, reason: str, request_id: int | None = None,
+               priority: int | None = None, source: str = "server") -> dict:
+    """One overload-shedding decision (``serving/scheduler.py`` via the
+    server/router front doors): ``reason`` is ``"quota"`` (token-bucket
+    refusal), ``"refused"`` (arrival shed because the queue was full of
+    strictly higher-priority work), or ``"displaced"`` (a queued request
+    evicted so a higher class could be admitted). These are the deliberate
+    degradations — the whole point of SLO tiers is that they land on the
+    best-effort class, which this event makes auditable per tenant."""
+    return {
+        "event": "shed",
+        "source": source,
+        "tenant": tenant,
+        "reason": reason,
+        "request_id": int(request_id) if request_id is not None else None,
+        "priority": int(priority) if priority is not None else None,
+    }
+
+
+def tenant_summary_event(*, tenant: str, source: str = "server",
+                         requests: int = 0, ok: int = 0, timeout: int = 0,
+                         shed: int = 0, new_tokens: int = 0,
+                         preemptions: int = 0,
+                         ttft_s: dict | None = None,
+                         e2e_s: dict | None = None,
+                         slo: dict | None = None) -> dict:
+    """One tenant's drain-time ledger (``serving/server.py`` /
+    ``serving/router.py``): counts, latency percentiles, preemptions
+    absorbed, and attainment against the tenant's own SLO — the per-class
+    A/B surface (the committed tenant-burst artifact compares the paid
+    tenant's row across loaded/unloaded runs)."""
+    return {
+        "event": "tenant_summary",
+        "source": source,
+        "tenant": tenant,
+        "requests": int(requests),
+        "ok": int(ok),
+        "timeout": int(timeout),
+        "shed": int(shed),
+        "new_tokens": int(new_tokens),
+        "preemptions": int(preemptions),
+        "ttft_s": ttft_s,
+        "e2e_s": e2e_s,
+        "slo": slo,
     }
 
 
@@ -621,6 +675,7 @@ def spec_event(*, step: int, active: int, proposed: int, accepted: int,
 
 def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int,
                         wall_s: float | None, steps: int | None = None,
+                        shed: int = 0,
                         decode_invocations: int | None = None,
                         generated_tokens: int | None = None,
                         spec: dict | None = None,
@@ -632,6 +687,9 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
                         queue: dict | None = None,
                         byte_accounting: dict | None = None,
                         slo: dict | None = None,
+                        preemptions: int | None = None,
+                        resumes: int | None = None,
+                        tenants: dict | None = None,
                         ttft_s=(), tpot_s=(), e2e_s=(), queue_wait_s=()) -> dict:
     """The once-per-run serving aggregate, emitted at drain: counts, aggregate
     tokens/s over the server's whole wall clock, slot occupancy, and p50/p95/p99
@@ -680,6 +738,13 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
         "queue": queue,
         "bytes": byte_accounting,
         "slo": slo,
+        # The tenancy ledger (DESIGN.md §22): deliberate degradations (shed)
+        # and mid-decode evictions (preemptions/resumes) are first-class
+        # outcomes, never folded into timeouts — plus the per-tenant rows.
+        "shed": int(shed),
+        "preemptions": int(preemptions) if preemptions is not None else None,
+        "resumes": int(resumes) if resumes is not None else None,
+        "tenants": tenants,
         "ttft_s": series_percentiles(ttft_s),
         "tpot_s": series_percentiles(tpot_s),
         "e2e_s": series_percentiles(e2e_s),

@@ -43,6 +43,8 @@ EVENT_KINDS: dict[str, str] = {
     "serve_summary": "once per serving run at drain: aggregates + percentiles",
     "prefill": "one completed prompt prefill: chunks/tokens/cache-hit/wall",
     "spec": "one speculative verify step: slots, proposed/accepted/emitted",
+    "shed": "one overload-shed decision: tenant, quota/refused/displaced reason",
+    "tenant_summary": "one tenant's drain ledger: counts/percentiles/preemptions/slo",
     # -- serving: fleet router (serving/router.py via utils/jsonl.py) -----------
     "route": "one routed request: replica, affinity, redispatches, finish",
     "replica": "replica lifecycle transition: start/fail/restart/dead",

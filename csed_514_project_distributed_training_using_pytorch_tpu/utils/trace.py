@@ -167,10 +167,15 @@ LIFECYCLE_SPANS = ("scale", "reload")
 # ``draft``/``verify`` are the speculative-decoding children of the decode
 # window (per verify tick: host drafting wall, then the batched verify
 # program) — carved OUT of decode_first/decode_tail below so the segments
-# stay exclusive and still sum to e2e.
+# stay exclusive and still sum to e2e. ``preempt_park`` is the decode stint a
+# priority-preempted slot served before its eviction and ``resume`` the
+# parked wait until re-admission (DESIGN.md §22) — the final ``decode`` span
+# covers only the post-resume stint, so the three never overlap; the padding
+# between park and resume that neither captures lands in ``overhead`` like
+# any other scheduling gap.
 SEGMENTS = ("router_queue_wait", "route", "failed_dispatch", "replica_queue_wait",
-            "prefill", "draft", "verify", "decode_first", "decode_tail",
-            "resolve", "overhead")
+            "prefill", "preempt_park", "resume", "draft", "verify",
+            "decode_first", "decode_tail", "resolve", "overhead")
 
 
 def trace_breakdown(spans: list[dict]) -> dict:
@@ -216,6 +221,11 @@ def trace_breakdown(spans: list[dict]) -> dict:
     seg["route"] = total("route")
     seg["failed_dispatch"] = sum(b - a for a, b in drained_windows)
     seg["prefill"] = total("prefill")
+    # Priority preemption (DESIGN.md §22): the evicted decode stint and the
+    # parked wait are their own segments — a preempted best-effort request's
+    # e2e must show WHERE the squeeze landed, not smear it into overhead.
+    seg["preempt_park"] = total("preempt_park")
+    seg["resume"] = total("resume")
     decodes = [d for d in by_name.get("decode", ()) if not losing(d)]
     for d in decodes:
         first = d.get("first_token_s")

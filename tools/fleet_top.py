@@ -158,6 +158,23 @@ def render(state: FleetState, path: str) -> str:
             f"  SLO run-level ({run.get('source')}): "
             f"{_fmt(run.get('attainment'))} "
             f"({run.get('met')}/{run.get('requests')} met)")
+    tens = snap.get("tenants") or {}
+    if tens:
+        # The per-tenant live rows: who is in flight, who is queued, who is
+        # being shed, and whether each tier's windowed promise holds — the
+        # at-a-glance view of "paid traffic protected, best-effort absorbing".
+        lines.append("")
+        lines.append(f"  {'tenant':<10} {'infl':>4} {'queued':>6} "
+                     f"{'shed':>5} {'quota':>5} {'slo-att':>8} {'slo-n':>5}")
+        for name in sorted(tens):
+            r = tens[name] or {}
+            slo = r.get("slo") or {}
+            lines.append(
+                f"  {name:<10} {_fmt(r.get('inflight')):>4} "
+                f"{_fmt(r.get('queued')):>6} {_fmt(r.get('shed')):>5} "
+                f"{_fmt(r.get('quota_rejected')):>5} "
+                f"{_fmt(slo.get('attainment')):>8} "
+                f"{_fmt(slo.get('requests')):>5}")
     per = snap.get("per_replica") or []
     if per:
         lines.append("")

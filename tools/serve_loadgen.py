@@ -82,10 +82,38 @@ def prompt_len_mix(args) -> list[int]:
     return lens
 
 
+def tenant_shares(text: str) -> dict[str, float]:
+    """The loadgen-side reading of the ``--tenants`` grammar: tenant names
+    plus their ``share=`` traffic fractions (the scheduler ignores ``share`` —
+    it is offered-load mix, not service class), normalized to sum to 1.
+    Tenants without a share split the remainder equally."""
+    shares: dict[str, float] = {}
+    for chunk in (text or "").split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        name, _, body = chunk.partition(":")
+        share = None
+        for part in body.split(","):
+            key, _, value = part.strip().partition("=")
+            if key.strip() == "share":
+                share = float(value)
+        shares[name.strip()] = share
+    named = sum(v for v in shares.values() if v is not None)
+    rest = [k for k, v in shares.items() if v is None]
+    for k in rest:
+        shares[k] = max(0.0, 1.0 - named) / len(rest)
+    total = sum(shares.values()) or 1.0
+    return {k: v / total for k, v in shares.items()}
+
+
 def make_workload(args, vocab_size):
-    """The seeded request mix: ``[(prompt, max_new, sampling), ...]``.
+    """The seeded request mix: ``[(prompt, max_new, sampling, tenant), ...]``.
     ``--shared-prefix-len N`` forces one common first-N-token prefix across all
-    prompts (truncated for shorter ones) so repeated-prefix reuse is testable."""
+    prompts (truncated for shorter ones) so repeated-prefix reuse is testable.
+    With ``--tenants``, each request draws its tenant from the ``share=``
+    traffic mix under the same seed — an A-vs-B pair of runs offers
+    byte-identical per-tenant workloads."""
     from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
         SamplingParams,
     )
@@ -96,6 +124,10 @@ def make_workload(args, vocab_size):
                           size=max(args.shared_prefix_len, 0)).astype(np.int32)
     sampling = SamplingParams(temperature=args.temperature, top_k=args.top_k,
                               top_p=args.top_p)
+    shares = tenant_shares(args.tenants) if getattr(args, "tenants", "") \
+        else {"default": 1.0}
+    names = sorted(shares)
+    probs = np.asarray([shares[n] for n in names])
     specs = []
     for _ in range(args.requests):
         p = int(rng.choice(lens))
@@ -104,51 +136,122 @@ def make_workload(args, vocab_size):
         if k:
             prompt[:k] = shared[:k]
         new = int(rng.integers(1, args.max_new_tokens + 1))
-        specs.append((prompt, new, sampling))
+        tenant = str(rng.choice(names, p=probs))
+        specs.append((prompt, new, sampling, tenant))
     return specs
 
 
+def _tally_refusal(rejections: dict, tenant: str, exc, lock) -> None:
+    """The three-way refusal ledger (one owner — open/closed/chat loops all
+    report through it): ``QueueFull`` (capacity backpressure),
+    ``QuotaExceeded`` (over the tenant's contract), ``Shed`` (priority-
+    ordered overload shedding), totals and per tenant."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        QueueFull,
+        QuotaExceeded,
+    )
+
+    key = ("rejected" if isinstance(exc, QueueFull)
+           else "quota_rejected" if isinstance(exc, QuotaExceeded)
+           else "shed_submits")
+    with lock:
+        rejections[key] += 1
+        rejections["by_tenant"].setdefault(
+            tenant, {"rejected": 0, "quota_rejected": 0,
+                     "shed_submits": 0})[key] += 1
+
+
+def _submit_counted(server, spec, futures, rejections, lock):
+    """One submit through the refusal ledger; returns the future or None."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        QueueFull,
+        QuotaExceeded,
+        Shed,
+    )
+
+    prompt, new, sampling, tenant = spec
+    try:
+        fut = server.submit(prompt, max_new_tokens=new, sampling=sampling,
+                            **({"tenant": tenant}
+                               if tenant != "default" else {}))
+    except (QueueFull, QuotaExceeded, Shed) as e:
+        _tally_refusal(rejections, tenant, e, lock)
+        return None
+    with lock:
+        futures.append(fut)
+    return fut
+
+
+def new_rejections() -> dict:
+    return {"rejected": 0, "quota_rejected": 0, "shed_submits": 0,
+            "by_tenant": {}}
+
+
 def run_open_loop(server, specs, rate, rng, *, pattern="poisson",
-                  burst_size=8, burst_idle_s=1.0):
-    """Open-loop arrivals; returns (futures, rejected_count).
+                  burst_size=8, burst_idle_s=1.0, burst_tenant=""):
+    """Open-loop arrivals; returns (futures, rejections dict).
 
     ``pattern="poisson"`` is the classic memoryless stream at ``rate`` req/s.
     ``pattern="burst"`` is the elasticity workload: ``burst_size`` requests
     arrive back-to-back (an arrival spike that piles the router queue up and
     ages its head — the autoscaler's scale-up signal), then ``burst_idle_s``
     of silence (the valley where utilization falls and a sustained-idle fleet
-    earns a scale-down)."""
-    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
-        QueueFull,
-    )
+    earns a scale-down).
 
-    futures, rejected = [], 0
-    for i, (prompt, new, sampling) in enumerate(specs):
-        if pattern == "burst":
-            if i and i % burst_size == 0:
-                time.sleep(burst_idle_s)
-        else:
-            time.sleep(float(rng.exponential(1.0 / rate)))
-        try:
-            futures.append(server.submit(prompt, max_new_tokens=new,
-                                         sampling=sampling))
-        except QueueFull:
-            rejected += 1                       # backpressure: load is shed, not queued
-    return futures, rejected
+    ``burst_tenant`` (with a multi-tenant workload) is the contended-serving
+    scenario: THAT tenant's stream arrives in bursts while every other tenant
+    stays Poisson at its share of ``rate`` — the committed tenant-burst
+    artifact drives exactly this shape (paid steady, best-effort spiking 3x)."""
+    futures: list = []
+    rejections = new_rejections()
+    tenants = sorted({s[3] for s in specs})
+    if len(tenants) <= 1 and not burst_tenant:
+        lone = threading.Lock()
+        for i, spec in enumerate(specs):
+            if pattern == "burst":
+                if i and i % burst_size == 0:
+                    time.sleep(burst_idle_s)
+            else:
+                time.sleep(float(rng.exponential(1.0 / rate)))
+            _submit_counted(server, spec, futures, rejections, lone)
+        return futures, rejections
+    # Multi-tenant: one arrival stream per tenant (each at its request-count
+    # share of the aggregate rate), so tenant mixes are independent processes
+    # — a burst on one never thins another's offered load.
+    lock = threading.Lock()
+    by_tenant = {t: [s for s in specs if s[3] == t] for t in tenants}
+
+    def stream(tenant: str, tspecs, seed: int):
+        trng = np.random.default_rng(seed)
+        trate = max(rate * len(tspecs) / max(len(specs), 1), 1e-6)
+        bursty = (tenant == burst_tenant
+                  or (pattern == "burst" and not burst_tenant))
+        for i, spec in enumerate(tspecs):
+            if bursty:
+                if i and i % burst_size == 0:
+                    time.sleep(burst_idle_s)
+            else:
+                time.sleep(float(trng.exponential(1.0 / trate)))
+            _submit_counted(server, spec, futures, rejections, lock)
+
+    threads = [threading.Thread(target=stream, args=(t, by_tenant[t], i + 11),
+                                name=f"loadgen-{t}")
+               for i, t in enumerate(tenants)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return futures, rejections
 
 
 def run_closed_loop(server, specs, concurrency):
     """``concurrency`` clients, each one request in flight; returns
-    ``(futures, rejected_count)`` — backpressure sheds the request, the client
-    moves on (mirrors the open loop's accounting)."""
-    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
-        QueueFull,
-    )
-
+    ``(futures, rejections dict)`` — a refused submit sheds the request, the
+    client moves on (mirrors the open loop's accounting)."""
     it = iter(specs)
     lock = threading.Lock()
     futures: list = []
-    rejected = [0]
+    rejections = new_rejections()
 
     def client():
         while True:
@@ -156,16 +259,9 @@ def run_closed_loop(server, specs, concurrency):
                 spec = next(it, None)
             if spec is None:
                 return
-            prompt, new, sampling = spec
-            try:
-                fut = server.submit(prompt, max_new_tokens=new, sampling=sampling)
-            except QueueFull:
-                with lock:
-                    rejected[0] += 1
-                continue
-            with lock:
-                futures.append(fut)
-            fut.result()                        # keep exactly one in flight
+            fut = _submit_counted(server, spec, futures, rejections, lock)
+            if fut is not None:
+                fut.result()                    # keep exactly one in flight
 
     threads = [threading.Thread(target=client, name=f"loadgen-{i}")
                for i in range(concurrency)]
@@ -173,7 +269,7 @@ def run_closed_loop(server, specs, concurrency):
         t.start()
     for t in threads:
         t.join()
-    return futures, rejected[0]
+    return futures, rejections
 
 
 def run_chat(front, args, vocab_size):
@@ -184,11 +280,15 @@ def run_chat(front, args, vocab_size):
     whole workload deterministic given the params, so an A-vs-B pair of runs
     (e.g. affinity on/off) offers byte-identical traffic.
 
-    Returns ``(completions, rejected, sessions_done)`` — a session counts done
-    when it ran all its turns (or cleanly hit the seq_len ceiling)."""
+    Returns ``(completions, rejections, sessions_done)`` — a session counts
+    done when it ran all its turns (or cleanly hit the seq_len ceiling). With
+    ``--tenants``, each SESSION draws its tenant from the ``share=`` mix (a
+    session is one user; its turns share a class)."""
     from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
         QueueFull,
+        QuotaExceeded,
         SamplingParams,
+        Shed,
     )
 
     sampling = SamplingParams(temperature=args.temperature, top_k=args.top_k,
@@ -196,12 +296,17 @@ def run_chat(front, args, vocab_size):
     lens = [l for l in prompt_len_mix(args) if l > 0] or [1]
     lock = threading.Lock()
     comps: list = []
-    rejected = [0]
+    rejections = new_rejections()
     done_sessions = [0]
     errors: list = []
+    shares = (tenant_shares(args.tenants)
+              if getattr(args, "tenants", "") else {"default": 1.0})
+    names = sorted(shares)
+    probs = np.asarray([shares[n] for n in names])
 
     def session(sid: int):
         rng = np.random.default_rng(args.seed + 1000 * (sid + 1))
+        tenant = str(rng.choice(names, p=probs))
         prompt = rng.integers(0, vocab_size - 1,
                               size=int(rng.choice(lens))).astype(np.int32)
         for _ in range(args.turns):
@@ -209,10 +314,12 @@ def run_chat(front, args, vocab_size):
             if len(prompt) + new >= args.seq_len:
                 break                      # context window full: session over
             try:
-                fut = front.submit(prompt, max_new_tokens=new, sampling=sampling)
-            except QueueFull:
-                with lock:
-                    rejected[0] += 1
+                fut = front.submit(prompt, max_new_tokens=new,
+                                   sampling=sampling,
+                                   **({"tenant": tenant}
+                                      if tenant != "default" else {}))
+            except (QueueFull, QuotaExceeded, Shed) as e:
+                _tally_refusal(rejections, tenant, e, lock)
                 return                     # overloaded: the session gives up
             comp = fut.result()
             with lock:
@@ -245,7 +352,7 @@ def run_chat(front, args, vocab_size):
         raise RuntimeError(
             f"{len(errors)}/{args.sessions} chat sessions died "
             f"(first: session {sid}: {type(first).__name__}: {first})") from first
-    return comps, rejected[0], done_sessions[0]
+    return comps, rejections, done_sessions[0]
 
 
 class _TracedFront:
@@ -393,6 +500,15 @@ def main(argv: list[str] | None = None) -> int:
                         "attainment against it — 'slo' drain events, summary "
                         "dicts, per-replica windows in fleet_snapshot; empty "
                         "= no promise")
+    e.add_argument("--tenants", default="",
+                   help="tenant service classes + traffic mix, e.g. "
+                        "'paid:w=4,prio=2,share=0.25,slo=ttft:0.3;"
+                        "free:w=1,preempt=1,share=0.75' — w/prio/rate/burst/"
+                        "cap/preempt/slo are the scheduler's service-class "
+                        "grammar (quotas, weighted-fair + priority dequeue, "
+                        "slot caps, preemption), share= is this loadgen's "
+                        "offered-traffic fraction; empty = one anonymous "
+                        "tenant (the pre-tenancy behavior)")
     e.add_argument("--warmup", type=int, default=1,
                    help="pre-measurement warmup rounds: compile the decode, "
                         "every prefill chunk size, and the prefix-cache install "
@@ -448,6 +564,18 @@ def main(argv: list[str] | None = None) -> int:
                    help="consecutive idle snapshots before a scale-down")
     s.add_argument("--scale-cooldown-s", type=float, default=3.0,
                    help="dead time after any scale action")
+    s.add_argument("--scale-slo-floor", type=float, default=0.0,
+                   help="SLO-attainment objective: windowed attainment below "
+                        "this floor counts as overloaded (grow) and BLOCKS "
+                        "every shrink — the autoscaler scales on the promise, "
+                        "not raw utilization (0 = utilization-only policy)")
+    s.add_argument("--scale-slo-tenant", default="",
+                   help="watch THIS tenant's windowed attainment from "
+                        "fleet_snapshot's tenants section (the high tier) "
+                        "instead of the fleet-wide window")
+    s.add_argument("--scale-slo-min-requests", type=int, default=5,
+                   help="minimum completions in the window before attainment "
+                        "is trusted (noise guard)")
     s.add_argument("--warm-prefixes", type=int, default=8,
                    help="hot affinity prefixes a new replica replays before "
                         "it is marked ready (0 = cold starts)")
@@ -477,6 +605,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="burst pattern: requests per spike")
     g.add_argument("--burst-idle-s", type=float, default=1.0,
                    help="burst pattern: idle valley between spikes")
+    g.add_argument("--burst-tenant", default="",
+                   help="with --tenants: only THIS tenant's arrival stream "
+                        "bursts (back-to-back spikes) while the others stay "
+                        "Poisson — the contended two-tenant scenario the "
+                        "tenant-burst artifact drives (best-effort spikes, "
+                        "paid holds its SLO)")
     g.add_argument("--concurrency", type=int, default=4,
                    help="closed loop: clients with one request in flight each")
     g.add_argument("--requests", type=int, default=32)
@@ -522,6 +656,14 @@ def main(argv: list[str] | None = None) -> int:
     if args.echo and args.replicas < 1:
         raise SystemExit("--echo needs --replicas N (echo replicas are a "
                          "fleet-mode workload)")
+    if args.burst_tenant:
+        known = set(tenant_shares(args.tenants)) if args.tenants else set()
+        if args.burst_tenant not in known:
+            # A typo here would silently disable ALL bursting and report an
+            # unloaded run as the loaded leg of an A/B — fail loudly instead.
+            raise SystemExit(
+                f"--burst-tenant {args.burst_tenant!r} is not one of the "
+                f"--tenants names {sorted(known) or '(none declared)'}")
 
     vocab_size = args.num_levels + 1
     tracer = None
@@ -547,6 +689,9 @@ def main(argv: list[str] | None = None) -> int:
         from csed_514_project_distributed_training_using_pytorch_tpu.serving.router import (
             Router,
         )
+        from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
+            parse_tenants,
+        )
 
         # Replica processes must import this package no matter the caller's
         # cwd — ship the repo root (already first on OUR sys.path, line 53)
@@ -569,7 +714,10 @@ def main(argv: list[str] | None = None) -> int:
                 down_utilization=args.scale_down_util,
                 sustain_up=args.scale_sustain_up,
                 sustain_down=args.scale_sustain_down,
-                cooldown_s=args.scale_cooldown_s)
+                cooldown_s=args.scale_cooldown_s,
+                slo_floor=args.scale_slo_floor or None,
+                slo_tenant=args.scale_slo_tenant or None,
+                slo_min_requests=args.scale_slo_min_requests)
         router = Router(
             build_replica_command(args), num_replicas=args.replicas,
             platform=args.replica_platform or None,
@@ -587,7 +735,12 @@ def main(argv: list[str] | None = None) -> int:
             max_replicas=args.max_replicas or None,
             warm_prefixes=args.warm_prefixes,
             drain_timeout_s=args.drain_timeout_s,
-            slo=SLOSpec.parse(args.slo), env=env)
+            slo=SLOSpec.parse(args.slo),
+            # The router is the fleet's ONE quota-charging front door; the
+            # replica argv deliberately omits --tenants (per-request tenancy
+            # fields ride the wire instead) so admission is never charged
+            # twice.
+            tenants=parse_tenants(args.tenants), env=env)
         front = router.start()
         if not router.wait_ready(timeout=600):
             router.stop(drain=False)
@@ -614,19 +767,21 @@ def main(argv: list[str] | None = None) -> int:
     sessions_done = None
     try:
         if args.scenario == "chat":
-            comps, rejected, sessions_done = run_chat(front, args, vocab_size)
+            comps, rejections, sessions_done = run_chat(front, args, vocab_size)
         else:
             specs = make_workload(args, vocab_size)
             if args.mode == "open":
-                futures, rejected = run_open_loop(
+                futures, rejections = run_open_loop(
                     front, specs, args.rate, np.random.default_rng(args.seed + 1),
                     pattern=args.arrival_pattern,
                     burst_size=args.burst_size,
-                    burst_idle_s=args.burst_idle_s)
+                    burst_idle_s=args.burst_idle_s,
+                    burst_tenant=args.burst_tenant)
             else:
-                futures, rejected = run_closed_loop(front, specs,
-                                                    args.concurrency)
+                futures, rejections = run_closed_loop(front, specs,
+                                                      args.concurrency)
             comps = [f.result() for f in futures]
+        rejected = rejections["rejected"]
     except BaseException:
         # Never orphan replica processes on a failed run.
         try:
@@ -648,13 +803,51 @@ def main(argv: list[str] | None = None) -> int:
 
     ok = sum(c.ok for c in comps)
     timeouts = sum(c.finish == "timeout" for c in comps)
+    shed_comps = sum(c.finish == "shed" for c in comps)
     new_tokens = sum(c.new_tokens for c in comps)
     label = (f"chat ({args.sessions} sessions x {args.turns} turns)"
              if args.scenario == "chat" else f"{args.mode}-loop")
     print(f"{label}: {len(comps)} completed ({ok} ok, {timeouts} timeout, "
-          f"{rejected} rejected) in {wall:.2f}s"
+          f"{shed_comps} shed, {rejected} rejected, "
+          f"{rejections['quota_rejected']} over-quota, "
+          f"{rejections['shed_submits']} shed-at-submit) in {wall:.2f}s"
           + (f", {sessions_done}/{args.sessions} sessions ran to completion"
              if sessions_done is not None else ""))
+
+    def comp_tenant(c) -> str:
+        t = getattr(c, "tenant", None)
+        if t is None:
+            t = getattr(getattr(c, "request", None), "tenant", None)
+        return t or "default"
+
+    tenant_rows = None
+    if args.tenants:
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
+            percentiles as _pcts,
+        )
+
+        tenant_rows = {}
+        for t in sorted({comp_tenant(c) for c in comps}
+                        | set(rejections["by_tenant"])):
+            tc = [c for c in comps if comp_tenant(c) == t]
+            rej = rejections["by_tenant"].get(t) or {}
+            tenant_rows[t] = {
+                "requests": len(tc),
+                "ok": sum(c.ok for c in tc),
+                "timeout": sum(c.finish == "timeout" for c in tc),
+                "shed": sum(c.finish == "shed" for c in tc),
+                "preemptions": sum(getattr(c, "preemptions", 0) for c in tc),
+                "new_tokens": sum(c.new_tokens for c in tc),
+                "ttft_s": _pcts([c.ttft_s for c in tc]),
+                "e2e_s": _pcts([c.e2e_s for c in tc]),
+                **rej,
+            }
+            row = tenant_rows[t]
+            p95 = (row["ttft_s"] or {}).get("p95")
+            print(f"tenant {t}: {row['requests']} requests "
+                  f"({row['ok']} ok, {row['timeout']} timeout, "
+                  f"{row['shed']} shed, {row['preemptions']} preemption(s)), "
+                  f"ttft p95 {'-' if p95 is None else f'{p95:.3f}'}s")
     if router is not None:
         rs = router_summary
         pc = rs.get("prefix_cache") or {}
@@ -684,6 +877,9 @@ def main(argv: list[str] | None = None) -> int:
                   f"{'-' if att is None else f'{att:.3f}'} "
                   f"({fleet_slo.get('met')}/{fleet_slo.get('requests')} met "
                   f"vs {args.slo})")
+        if rs.get("preemptions") or rs.get("resumes"):
+            print(f"preemption: {rs.get('preemptions')} park(s), "
+                  f"{rs.get('resumes')} resume(s) fleet-wide")
         sc = rs.get("scale") or {}
         if rs.get("scale_events"):
             print(f"elasticity: {sc.get('scale_ups', 0)} scale-up(s), "
@@ -717,6 +913,9 @@ def main(argv: list[str] | None = None) -> int:
                   f"{'-' if att is None else f'{att:.3f}'} "
                   f"({srv_slo.get('met')}/{srv_slo.get('requests')} met "
                   f"vs {args.slo})")
+        if engine.preemptions or engine.resumes:
+            print(f"preemption: {engine.preemptions} park(s), "
+                  f"{engine.resumes} resume(s)")
         hits = engine.prefix_cache.stats() if engine.prefix_cache else None
         print(f"prefilled {engine.prefill_tokens} prompt tokens in "
               f"{engine.prefill_invocations} chunks "
@@ -768,7 +967,13 @@ def main(argv: list[str] | None = None) -> int:
             "scenario": args.scenario,
             "mode": args.mode if args.scenario == "batch" else None,
             "requests": len(comps), "ok": ok, "timeout": timeouts,
-            "rejected": rejected, "wall_s": wall,
+            "shed": shed_comps, "rejected": rejected,
+            "quota_rejected": rejections["quota_rejected"],
+            "shed_submits": rejections["shed_submits"],
+            "tenants_spec": args.tenants or None,
+            "burst_tenant": args.burst_tenant or None,
+            "tenants": tenant_rows,
+            "wall_s": wall,
             "prompt_dist": args.prompt_dist,
             "prompt_lens": prompt_len_mix(args),
             "shared_prefix_len": args.shared_prefix_len,
@@ -819,6 +1024,9 @@ def main(argv: list[str] | None = None) -> int:
                              for r in rs["per_replica"]],
                 slo_attainment=rs.get("slo"),
                 replica_latency=rs.get("replica_latency"),
+                tenant_summary=rs.get("tenants"),
+                preemptions=rs.get("preemptions"),
+                resumes=rs.get("resumes"),
                 router_queue=rs.get("queue"))
         else:
             doc.update(
@@ -837,6 +1045,9 @@ def main(argv: list[str] | None = None) -> int:
                 generated_tokens=engine.generated_tokens,
                 spec_stats=engine.spec_stats(),
                 slo_attainment=server.slo_summary(),
+                tenant_summary=server.tenant_summaries() or None,
+                preemptions=engine.preemptions,
+                resumes=engine.resumes,
                 verify_compilations=dict(engine.verify_trace_counts))
         if trace_summary is not None:
             # The run carries its trace with it: where the spans live plus the

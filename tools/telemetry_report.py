@@ -380,6 +380,30 @@ def summarize(path: str) -> dict:
             s["slo_spec"] = doc.get("spec")
             break
 
+    # Multi-tenant serving (DESIGN.md §22): per-tenant drain ledgers (the
+    # router's client-facing rows win over a replica's local ones), shed
+    # decisions by reason, and fleet preemption counters.
+    tsums = by_event.get("tenant_summary", [])
+    tenants = {}
+    for ev in tsums:                       # later rows (router) overwrite
+        if ev.get("tenant"):
+            tenants[ev["tenant"]] = ev
+    if tenants:
+        s["tenants"] = tenants
+    sheds = by_event.get("shed", [])
+    if sheds:
+        s["shed_events"] = len(sheds)
+        by_reason: dict = {}
+        for ev in sheds:
+            by_reason[ev.get("reason")] = by_reason.get(ev.get("reason"), 0) + 1
+        s["shed_by_reason"] = by_reason
+    for doc in (rsum, summary):
+        if doc and (doc.get("preemptions") or doc.get("shed")):
+            s["preemptions"] = doc.get("preemptions")
+            s["resumes"] = doc.get("resumes")
+            s["shed"] = doc.get("shed")
+            break
+
     # Goodput ledger lines (obs/goodput.py via --goodput --emit): read the
     # decomposition back without re-joining the streams.
     gp = (by_event.get("goodput") or [None])[-1]
@@ -547,6 +571,28 @@ def print_summary(s: dict) -> None:
         print(f"   slo: attainment {_fmt(s['slo_attainment'])} "
               f"({_fmt(s.get('slo_met'))}/{_fmt(s.get('slo_requests'))} met"
               + (f"; {targets}" if targets else "") + ")")
+    if s.get("tenants"):
+        # The multi-tenant ledger: one row per service class — who got
+        # served, who absorbed the squeeze (shed/preemptions), and whether
+        # each class kept its own promise.
+        print(f"   {'tenant':<10} {'req':>5} {'ok':>5} {'shed':>5} "
+              f"{'preempt':>7} {'ttft p95':>9} {'e2e p95':>9} {'slo':>7}")
+        for name in sorted(s["tenants"]):
+            row = s["tenants"][name]
+            att = (row.get("slo") or {}).get("attainment")
+            print(f"   {name:<10} {_fmt(row.get('requests')):>5} "
+                  f"{_fmt(row.get('ok')):>5} {_fmt(row.get('shed')):>5} "
+                  f"{_fmt(row.get('preemptions')):>7} "
+                  f"{_fmt((row.get('ttft_s') or {}).get('p95')):>9} "
+                  f"{_fmt((row.get('e2e_s') or {}).get('p95')):>9} "
+                  f"{_fmt(att):>7}")
+    if s.get("shed_events"):
+        reasons = ", ".join(f"{k}: {v}" for k, v in
+                            sorted((s.get("shed_by_reason") or {}).items()))
+        print(f"   shed: {s['shed_events']} decision(s) ({reasons})")
+    if s.get("preemptions"):
+        print(f"   preemption: {_fmt(s['preemptions'])} park(s), "
+              f"{_fmt(s.get('resumes'))} resume(s)")
     if s.get("goodput_frac") is not None:
         print(f"   goodput: {_fmt(s['goodput_frac'])} of "
               f"{_fmt(s.get('goodput_wall_s'))}s wall "
@@ -579,6 +625,8 @@ COMPARE_ROWS = [
     ("goodput frac", "goodput_frac"),
     ("restart badput s", "restart_badput_s"),
     ("slo attainment", "slo_attainment"),
+    ("shed", "shed"),
+    ("preemptions", "preemptions"),
     ("serve tokens/s", "serve_tokens_per_s"),
     ("accepted tok/step", "accepted_tokens_per_step"),
     ("acceptance rate", "spec_acceptance_rate"),
