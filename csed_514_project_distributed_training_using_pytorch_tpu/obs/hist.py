@@ -204,3 +204,68 @@ class LogHistogram:
     def __repr__(self) -> str:
         return (f"LogHistogram(rel_err={self.rel_err}, count={self._count}, "
                 f"buckets={self.num_buckets})")
+
+
+class WindowedLogHistogram:
+    """Sliding-window quantiles over a latency stream, sketch-backed.
+
+    The gray-failure detectors (straggler ejection, the hedge deadline —
+    DESIGN.md §23) need *recent* dispatch-latency quantiles: a replica that
+    was slow ten minutes ago and recovered must not read as a straggler now.
+    This is the classic two-pane rotation: samples land in the CURRENT
+    :class:`LogHistogram` pane; every ``window_s`` the panes rotate (current
+    becomes previous, previous is dropped). A query merges both panes, so the
+    answer always covers between one and two windows of history — bounded
+    staleness with O(buckets) memory and no per-sample ring buffer, the same
+    tradeoff the attainment tracker makes with its time-bucketed window.
+
+    Not thread-safe by itself; the router calls it under its own lock.
+    """
+
+    def __init__(self, rel_err: float = 0.01, window_s: float = 30.0):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.rel_err = float(rel_err)
+        self.window_s = float(window_s)
+        self._cur = LogHistogram(rel_err)
+        self._prev: LogHistogram | None = None
+        self._cur_start: float | None = None
+
+    def _rotate(self, now: float) -> None:
+        if self._cur_start is None:
+            self._cur_start = now
+            return
+        # Catch up over long idle gaps: more than two windows of silence
+        # leaves NO recent evidence — both panes drop.
+        while now - self._cur_start >= self.window_s:
+            self._prev = self._cur if now - self._cur_start < 2 * self.window_s \
+                else None
+            self._cur = LogHistogram(self.rel_err)
+            self._cur_start += self.window_s
+
+    def add(self, x: float | None, now: float) -> None:
+        self._rotate(now)
+        self._cur.add(x)
+
+    def count(self, now: float) -> int:
+        self._rotate(now)
+        return self._cur.count + (self._prev.count if self._prev else 0)
+
+    def quantile(self, q: float, now: float) -> float | None:
+        """The q-th percentile over the last one-to-two windows (None when
+        empty) — merge is bucket addition, so the estimate keeps the panes'
+        ``rel_err`` bound."""
+        self._rotate(now)
+        if self._prev is None or self._prev.count == 0:
+            return self._cur.quantile(q)
+        merged = LogHistogram(self.rel_err)
+        merged.merge(self._cur)
+        merged.merge(self._prev)
+        return merged.quantile(q)
+
+    def reset(self) -> None:
+        """Drop all history — the post-probe fresh start: a recovered
+        replica's score must come from post-recovery evidence only."""
+        self._cur = LogHistogram(self.rel_err)
+        self._prev = None
+        self._cur_start = None

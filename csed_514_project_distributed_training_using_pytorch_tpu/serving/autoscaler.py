@@ -34,6 +34,14 @@ Bounds ride the policy (``min_replicas``/``max_replicas``); the router's
 checked against, so an in-flight spawn (``starting``/``warming``, not yet
 ``ready``) already counts toward the cap — the policy never stacks spawns.
 
+**Degraded replicas** (straggler ejection, DESIGN.md §23) need no special
+casing here BY CONSTRUCTION: the snapshot's ``utilization`` denominator and
+``replicas_ready`` count cover ``ready`` replicas only, so an ejected replica
+reads as missing capacity, not as idle capacity — a fleet squeezed by a
+straggler sees its utilization RISE on the survivors and scales up on the
+same signal as any other load spike, and the ``replicas_degraded`` field is
+there for dashboards, not for the decision function.
+
 The actuators — ``Router.scale_up()`` (spawn + prefix-cache warm-start) and
 ``Router.scale_down()`` (graceful drain-to-retire) — live in
 ``serving/router.py``; DESIGN.md §18 has the full protocol. This module

@@ -18,15 +18,25 @@ replica, via ``train.launch.Fleet(num_processes=1, process_id_base=<replica>)``
   router's exit-75 classification drains and redispatches them rather than
   settling client-visible timeouts), surfacing as a classified exit, not a hang.
 
-Line protocol (one JSON object per line, both directions):
+Line protocol (one JSON object per message, both directions — newline-framed
+by default, length+CRC framed after negotiation, see "wire hardening" below):
 
 ====================  =============================================================
 router → replica
 --------------------  -------------------------------------------------------------
+``hello_ack``         the framing opt-in (newline-JSON, the FIRST router
+                      message when sent): the router accepts a capability the
+                      hello advertised — both directions switch to
+                      length+CRC frames right after. A legacy router never
+                      sends it and the wire stays byte-identical newline JSON
 ``submit``            ``{"op", "id", "prompt", "max_new_tokens", "temperature",
                       "top_k", "top_p", "timeout_s"}`` — enqueue one request;
                       ``trace_id`` appears ONLY on traced requests (tracing
                       off keeps the line byte-identical — pinned)
+``cancel``            ``{"op", "id"}`` — a hedged race this replica lost: the
+                      peer's completion already resolved the request, so this
+                      replica's reply is unwanted — cancel if still queued,
+                      else finish silently (the done line is suppressed)
 ``stats``             ``{"op", "id"}`` — request the engine/queue counters
 ``warm``              ``{"op", "id", "prompts"}`` — prefix-cache warm-start:
                       replay each prompt through prefill (1 generated token)
@@ -40,15 +50,22 @@ router → replica
 --------------------  -------------------------------------------------------------
 replica → router
 --------------------  -------------------------------------------------------------
-``hello``             first line after accept: replica id + capacity
+``hello``             first line after accept (ALWAYS newline JSON — the
+                      negotiation anchor): replica id + capacity
                       (``num_slots``, ``max_pending``) — the router's
-                      backpressure cap comes from the replica itself
+                      backpressure cap comes from the replica itself — plus
+                      ``caps`` (wire capabilities, e.g. ``"framed1"``)
 ``done``              one completed request: tokens + finish + latency fields
 ``error``             ``queue_full`` (backpressure — the router re-queues),
                       ``draining`` (the shrink/submit race: a dispatch crossed
                       the drain op on the wire — the router re-queues
-                      elsewhere) or ``invalid`` (admission rejection — the
-                      router fails the future; replays would fail identically)
+                      elsewhere), ``invalid`` (admission rejection — the
+                      router fails the future; replays would fail
+                      identically), or ``wire_corrupt`` with ``id: null`` (a
+                      line arrived damaged: the replica cannot attribute it,
+                      so the router treats the CONNECTION as suspect and
+                      reconnects — its ledger drain replays everything
+                      outstanding, including whatever the damaged line was)
 ``warm_done``         warm replay finished: replayed-prompt count + the
                       prompts themselves (the router re-homes their affinity
                       entries onto this replica and flips it ready)
@@ -57,6 +74,18 @@ replica → router
 ``stats``             engine counters (steps, prefill, prefix-cache stats) and
                       the request queue's ``snapshot()``
 ====================  =============================================================
+
+Wire hardening (DESIGN.md §23): the hello advertises ``caps: ["framed1"]``;
+a router that replies ``hello_ack`` flips BOTH directions to
+``serving/wire.py`` frames (magic + length + crc32), so one corrupt byte is a
+typed :class:`WireCorrupt` reject-and-reconnect instead of an untyped parse
+death, and a torn frame can never be glued to the next message. Handlers are
+deadline-guarded: a peer that connects and sends nothing, or dribbles half a
+line forever, is disconnected after ``--wire-idle-timeout-s`` and the accept
+loop moves on — a stalling client cannot wedge the (single) handler slot. A
+damaged line in legacy newline mode gets the typed ``wire_corrupt`` error
+reply (never a stack-trace death); a malformed-but-parseable op gets a typed
+``invalid`` reply.
 
 Greedy decode makes replays **token-identical** (argmax consults no RNG), which
 is what makes the router's at-least-once delivery safe; see DESIGN.md §15.
@@ -93,6 +122,13 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler i
     QuotaExceeded,
     SamplingParams,
     Shed,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.wire import (
+    CAP_FRAMED,
+    FrameDecoder,
+    LineDecoder,
+    WireCorrupt,
+    write_msg,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.utils.trace import (
     Tracer,
@@ -253,7 +289,10 @@ class _EchoServer:
 
     def complete(self, prompt: np.ndarray, max_new: int, *,
                  trace_id: str | None = None,
-                 request_id: int | None = None) -> np.ndarray:
+                 request_id: int | None = None) -> tuple[np.ndarray, float | None]:
+        """Returns ``(tokens, ttft_s)`` — the first-token split rides the done
+        line so fleet-level TTFT percentiles (the hedging A/B's gate metric)
+        work on the echo tier too."""
         p = len(prompt)
         total = min(p + max_new, self.seq_len)
         base = int(prompt.sum()) if p else 0
@@ -277,17 +316,37 @@ class _EchoServer:
                 first_token_s=(None if first is None
                                else round(first - t0, 6)),
                 first_token_ts=first)
-        return np.asarray(out, np.int32)
+        return np.asarray(out, np.int32), (None if first is None
+                                           else first - t0)
 
 
-def _send(wfile, wlock, obj: dict) -> None:
-    line = json.dumps(obj) + "\n"
-    with wlock:
-        wfile.write(line.encode())
-        wfile.flush()
+class _WireOut:
+    """The mode-aware reply channel one connection's handlers write through:
+    newline JSON until the router's ``hello_ack`` flips :attr:`framed`, frames
+    after. The flip happens while processing the FIRST router message — before
+    any op that could produce a reply has been handled — so no reply can
+    straddle the mode switch. ``cancelled`` is the hedge-loser ledger: ids
+    whose done line must be suppressed (the router already resolved the
+    request on the winning replica)."""
+
+    def __init__(self, wfile):
+        self.wfile = wfile
+        self.lock = threading.Lock()
+        self.framed = False
+        self.cancelled: set = set()
+        # Engine-mode submit futures still unresolved, by id: a cancel op for
+        # one still queued can abort it outright instead of wasting decode.
+        self.pending_futures: dict = {}
+
+    def send(self, obj: dict) -> None:
+        write_msg(self.wfile, self.lock, obj, framed=self.framed)
 
 
-def _handle_submit(msg, server, wfile, wlock):
+def _send(out: _WireOut, obj: dict) -> None:
+    out.send(obj)
+
+
+def _handle_submit(msg, server, out: _WireOut):
     prompt = np.asarray(msg.get("prompt") or [], np.int32)
     rid = msg["id"]
     sampling = SamplingParams(temperature=msg.get("temperature", 0.0),
@@ -307,43 +366,53 @@ def _handle_submit(msg, server, wfile, wlock):
                             priority=msg.get("priority"),
                             preemptible=msg.get("preemptible"))
     except QueueFull:
-        _send(wfile, wlock, {"op": "error", "id": rid, "error": "queue_full",
-                             "message": "replica queue at capacity"})
+        _send(out, {"op": "error", "id": rid, "error": "queue_full",
+                    "message": "replica queue at capacity"})
         return
     except QuotaExceeded as e:
         # Replica-local quota (standalone --tenants): a typed refusal reply,
         # never a crash — an over-quota request must not kill the process.
-        _send(wfile, wlock, {"op": "error", "id": rid, "error": "quota",
-                             "message": str(e)})
+        _send(out, {"op": "error", "id": rid, "error": "quota",
+                    "message": str(e)})
         return
     except Shed as e:
-        _send(wfile, wlock, {"op": "error", "id": rid, "error": "shed",
-                             "message": str(e)})
+        _send(out, {"op": "error", "id": rid, "error": "shed",
+                    "message": str(e)})
         return
     except QueueClosed:
         # The shrink/submit race: this dispatch crossed the drain op on the
         # wire. The request is intact — bounce it so the router re-queues it
         # at the front and tries another replica.
-        _send(wfile, wlock, {"op": "error", "id": rid, "error": "draining",
-                             "message": "replica draining (retire/reload)"})
+        _send(out, {"op": "error", "id": rid, "error": "draining",
+                    "message": "replica draining (retire/reload)"})
         return
     except ValueError as e:
-        _send(wfile, wlock, {"op": "error", "id": rid, "error": "invalid",
-                             "message": str(e)})
+        _send(out, {"op": "error", "id": rid, "error": "invalid",
+                    "message": str(e)})
         return
 
     def _done(f, rid=rid):
+        with out.lock:
+            out.pending_futures.pop(rid, None)
+            # A hedge this replica lost: the router resolved the request on
+            # the winning peer and asked us to stand down — the reply (result
+            # OR failure) is unwanted. Discard the marker: ids are
+            # router-unique, so it can never match again.
+            cancelled = rid in out.cancelled and (out.cancelled.discard(rid)
+                                                  or True)
+        if cancelled:
+            return
         try:
             comp = f.result()
         except BaseException as e:           # server died mid-request
             try:
-                _send(wfile, wlock, {"op": "error", "id": rid,
-                                     "error": "failed", "message": str(e)})
+                _send(out, {"op": "error", "id": rid,
+                            "error": "failed", "message": str(e)})
             except OSError:
                 pass
             return
         try:
-            _send(wfile, wlock, {
+            _send(out, {
                 "op": "done", "id": rid,
                 "tokens": [int(t) for t in comp.tokens],
                 "finish": comp.finish, "prompt_len": comp.prompt_len,
@@ -354,6 +423,8 @@ def _handle_submit(msg, server, wfile, wlock):
         except OSError:
             pass                             # router gone; it will redispatch
 
+    with out.lock:
+        out.pending_futures[rid] = fut
     fut.add_done_callback(_done)
 
 
@@ -460,20 +531,25 @@ def serve_forever(args) -> int:
     print(f"[replica {replica_id}] listening on 127.0.0.1:{args.port} "
           f"(pid {os.getpid()}, echo={bool(args.echo)})", flush=True)
 
-    def _handle(msg, wfile, wlock) -> bool:
-        """One protocol line; returns False when the replica should stop."""
+    def _handle(msg, out: _WireOut) -> bool:
+        """One protocol message; returns False when the replica should stop."""
         op = msg.get("op")
         if op == "submit":
             if args.echo:
+                # Validate BEFORE the worker thread exists: a malformed
+                # submit must produce the typed `invalid` reply from the
+                # handler (the caller wraps us), never an uncaught KeyError
+                # in a detached thread.
+                rid, max_new = msg["id"], int(msg["max_new_tokens"])
                 try:
                     server.begin_request()       # draining => bounce, not accept
                 except QueueClosed:
-                    _send(wfile, wlock, {"op": "error", "id": msg["id"],
-                                         "error": "draining",
-                                         "message": "echo replica draining"})
+                    _send(out, {"op": "error", "id": rid,
+                                "error": "draining",
+                                "message": "echo replica draining"})
                     return True
 
-                def _echo_job(m=msg):
+                def _echo_job(m=msg, max_new=max_new):
                     prompt = np.asarray(m.get("prompt") or [], np.int32)
                     t0 = time.monotonic()
                     # The done line must hit the wire BEFORE end_request()
@@ -482,15 +558,22 @@ def serve_forever(args) -> int:
                     # line would make the router retire with this request
                     # still in its ledger (straggler redispatch + duplicate).
                     try:
-                        tokens = server.complete(prompt, m["max_new_tokens"],
-                                                 trace_id=m.get("trace_id"),
-                                                 request_id=m["id"])
+                        tokens, ttft = server.complete(
+                            prompt, max_new, trace_id=m.get("trace_id"),
+                            request_id=m["id"])
+                        with out.lock:
+                            cancelled = (m["id"] in out.cancelled
+                                         and (out.cancelled.discard(m["id"])
+                                              or True))
+                        if cancelled:
+                            return           # hedge lost: reply suppressed
                         try:
-                            _send(wfile, wlock, {
+                            _send(out, {
                                 "op": "done", "id": m["id"],
                                 "tokens": [int(t) for t in tokens],
                                 "finish": "ok", "prompt_len": len(prompt),
                                 "new_tokens": len(tokens) - len(prompt),
+                                "ttft_s": ttft,
                                 "e2e_s": time.monotonic() - t0,
                             })
                         except OSError:
@@ -499,10 +582,21 @@ def serve_forever(args) -> int:
                         server.end_request()
                 threading.Thread(target=_echo_job, daemon=True).start()
             else:
-                _handle_submit(msg, server, wfile, wlock)
+                _handle_submit(msg, server, out)
+        elif op == "cancel":
+            # Hedge-loser stand-down: the router resolved this id on a peer.
+            # Still queued here -> abort outright (frees the slot); already
+            # decoding -> let it finish but suppress the reply (the marker).
+            rid = msg.get("id")
+            if rid is not None:
+                with out.lock:
+                    fut = out.pending_futures.get(rid)
+                    out.cancelled.add(rid)
+                if fut is not None:
+                    fut.cancel()         # only wins while it is still queued
         elif op == "stats":
-            _send(wfile, wlock, {"op": "stats", "id": msg.get("id"),
-                                 **_stats_payload(engine, server)})
+            _send(out, {"op": "stats", "id": msg.get("id"),
+                        **_stats_payload(engine, server)})
         elif op == "warm":
             # Prefix-cache warm-start (scale-up/reload): replay the fleet's
             # hot prefixes through prefill BEFORE taking traffic — one
@@ -541,8 +635,8 @@ def serve_forever(args) -> int:
                         # the whole point and must survive.
                         cache.queries = cache.hits = cache.hit_tokens = 0
                 try:
-                    _send(wfile, wlock, {"op": "warm_done", "id": m.get("id"),
-                                         "count": count, "prompts": prompts})
+                    _send(out, {"op": "warm_done", "id": m.get("id"),
+                                "count": count, "prompts": prompts})
                 except OSError:
                     pass
             threading.Thread(target=_warm_job, daemon=True,
@@ -561,8 +655,8 @@ def serve_forever(args) -> int:
                     server.stop(drain=True)      # blocks until the loop exits;
                                                  # closes telemetry + tracer
                 try:
-                    _send(wfile, wlock, {"op": "drained", "id": m.get("id"),
-                                         "steps": int(engine.steps)})
+                    _send(out, {"op": "drained", "id": m.get("id"),
+                                "steps": int(engine.steps)})
                 except OSError:
                     pass
                 print(f"[replica {replica_id}] drained; exiting 0", flush=True)
@@ -572,6 +666,8 @@ def serve_forever(args) -> int:
         elif op == "stop":
             return False
         return True
+
+    idle_timeout = float(getattr(args, "wire_idle_timeout_s", 0.0) or 0.0)
 
     while True:
         try:
@@ -584,38 +680,145 @@ def serve_forever(args) -> int:
         # turn a momentarily full send buffer into a dropped completion.
         wsock = conn.dup()
         wsock.settimeout(None)
-        wfile = wsock.makefile("wb")
-        wlock = threading.Lock()
-        _send(wfile, wlock, {"op": "hello", "replica": replica_id,
-                             "num_slots": args.num_slots,
-                             "max_pending": args.max_pending,
-                             "pid": os.getpid()})
-        buf = b""
+        out = _WireOut(wsock.makefile("wb"))
+        # The hello is ALWAYS newline JSON — the negotiation anchor a legacy
+        # router parses unchanged. ``caps`` advertises what this replica can
+        # speak; only a hello_ack echoing a capability switches modes.
+        _send(out, {"op": "hello", "replica": replica_id,
+                    "num_slots": args.num_slots,
+                    "max_pending": args.max_pending,
+                    "pid": os.getpid(), "caps": [CAP_FRAMED]})
+        # Mode is decided by the FIRST router message: until its newline
+        # arrives, bytes accumulate RAW (feeding them to a line splitter
+        # would mangle frames that share the chunk — frame payloads may
+        # contain 0x0A). A hello_ack carrying the framed capability flips
+        # both directions to frames and the remainder of the buffer is fed to
+        # the frame decoder; anything else is a legacy router: the first line
+        # is handled as a normal message and the wire stays newline JSON.
+        raw_buf = b""
+        decoder: LineDecoder | FrameDecoder | None = None
+        got_msg = False
+        last_progress = time.monotonic()
         try:
             while True:
                 try:
                     chunk = conn.recv(1 << 16)
                 except socket.timeout:
+                    # Recv/idle deadline: a peer that never sent a complete
+                    # message, or has half a message stuck in the buffer,
+                    # is stalling — free the handler slot instead of wedging
+                    # it (the accept loop serves one connection at a time).
+                    # A peer with an EMPTY buffer that already spoke is a
+                    # legitimately idle router and never times out.
+                    pending = (len(raw_buf) if decoder is None
+                               else decoder.pending)
+                    if (idle_timeout > 0
+                            and (not got_msg or pending)
+                            and time.monotonic() - last_progress
+                            > idle_timeout):
+                        how = ("stalled mid-message" if pending
+                               else "sent nothing")
+                        print(f"[replica {replica_id}] wire idle timeout: "
+                              f"peer {how} for {idle_timeout:.1f}s; "
+                              f"disconnecting", flush=True)
+                        break
                     continue        # wakeup: pending signal handlers run here
                 if not chunk:
                     break           # router disconnected
-                buf += chunk
-                while True:
-                    line, sep, buf = buf.partition(b"\n")
+                msgs: list[bytes] = []
+                if decoder is None:
+                    raw_buf += chunk
+                    line, sep, rest = raw_buf.partition(b"\n")
                     if not sep:
-                        buf = line
-                        break
-                    if line and not _handle(json.loads(line), wfile, wlock):
-                        stop_flag.set()
-                        if not args.echo:
-                            server.stop(drain=True)   # loop closes the tracer
+                        continue    # first message still incomplete
+                    raw_buf = b""
+                    first = None
+                    try:
+                        first = json.loads(line) if line else None
+                    except ValueError:
+                        pass        # garbage first line: legacy path below
+                    if (isinstance(first, dict)
+                            and first.get("op") == "hello_ack"
+                            and CAP_FRAMED in (first.get("caps") or [])):
+                        out.framed = True
+                        decoder = FrameDecoder()
+                        print(f"[replica {replica_id}] wire: framed "
+                              f"({CAP_FRAMED})", flush=True)
+                        got_msg = True
+                        chunk = rest        # frames from here on
+                    else:
+                        decoder = LineDecoder()
+                        if isinstance(first, dict) \
+                                and first.get("op") == "hello_ack":
+                            chunk = rest    # ack without a cap we speak: eat it
                         else:
-                            tracer.close()
-                        return 0
-        except (OSError, ValueError, json.JSONDecodeError):
+                            # A legacy router's first op (or a garbage line):
+                            # process it through the common path below.
+                            chunk = (line + b"\n" + rest) if line else rest
+                try:
+                    msgs.extend(decoder.feed(chunk))
+                except WireCorrupt as e:
+                    # Framed mode: typed damage. The stream position is
+                    # untrustworthy — reject and drop the connection; the
+                    # router reconnects and its ledger drain replays.
+                    print(f"[replica {replica_id}] wire corrupt: {e}; "
+                          f"disconnecting for reconnect", flush=True)
+                    break
+                if msgs:
+                    last_progress = time.monotonic()
+                stop_now = False
+                for raw in msgs:
+                    got_msg = True
+                    try:
+                        msg = json.loads(raw)
+                        if not isinstance(msg, dict):
+                            raise ValueError("non-object message")
+                    except ValueError as e:
+                        # A damaged line. Legacy newline mode self-syncs on
+                        # the next newline, so reply typed and keep serving;
+                        # the router treats wire_corrupt as a connection-
+                        # level fault and reconnects (draining its ledger —
+                        # whatever this line was gets replayed).
+                        print(f"[replica {replica_id}] wire corrupt: "
+                              f"unparseable line ({e})", flush=True)
+                        try:
+                            _send(out, {"op": "error", "id": None,
+                                        "error": "wire_corrupt",
+                                        "message": f"unparseable line: {e}"})
+                        except OSError:
+                            pass
+                        continue
+                    try:
+                        keep = _handle(msg, out)
+                    except Exception as e:  # noqa: BLE001 — typed, not a death
+                        # A parseable but malformed op (garbage submit with a
+                        # missing field, wrong types): typed refusal, never a
+                        # stack-trace death of the handler.
+                        print(f"[replica {replica_id}] malformed "
+                              f"{msg.get('op')!r} op: {e!r}", flush=True)
+                        try:
+                            _send(out, {"op": "error", "id": msg.get("id"),
+                                        "error": "invalid",
+                                        "message": f"malformed "
+                                                   f"{msg.get('op')!r} op: "
+                                                   f"{e}"})
+                        except OSError:
+                            pass
+                        continue
+                    if not keep:
+                        stop_now = True
+                        break
+                if stop_now:
+                    stop_flag.set()
+                    if not args.echo:
+                        server.stop(drain=True)   # loop closes the tracer
+                    else:
+                        tracer.close()
+                    return 0
+        except OSError:
             pass
         finally:
-            for f in (wfile, wsock, conn):
+            for f in (out.wfile, wsock, conn):
                 try:
                     f.close()
                 except OSError:
@@ -686,6 +889,16 @@ def main(argv: list[str] | None = None) -> int:
                         "tenant quotas, weighted-fair dequeue, slot caps, "
                         "and priority preemption in this replica's server; "
                         "empty = single implicit tenant")
+    p.add_argument("--wire-idle-timeout-s", type=float, default=120.0,
+                   help="disconnect a peer that connected but never sent a "
+                        "complete message, or stalled mid-message, for this "
+                        "long — a stalling client must not wedge the handler "
+                        "slot (0 = no deadline; a quiet peer that already "
+                        "spoke complete messages never times out). Note: a "
+                        "framed-wire router speaks immediately (hello_ack), "
+                        "so only a LEGACY-mode router with a fully idle "
+                        "fleet trips this — a benign empty-ledger reconnect "
+                        "every interval, the price of the stall protection")
     p.add_argument("--telemetry", default="",
                    help="this replica's own serve JSONL (optional)")
     p.add_argument("--trace", default="",

@@ -30,6 +30,26 @@ restart *individually* — behind one ``submit() -> Future`` door. DESIGN.md §1
   supervisor-style (exponential backoff, capped attempts). When every replica
   has exhausted its budget, outstanding work fails with ``ServerStopped``
   instead of hanging.
+- **gray-failure tolerance** (DESIGN.md §23) — binary failures (crash,
+  preempt, hang) are only half the fleet's reality; a replica that is merely
+  SLOW heartbeats as healthy while it poisons tail latency. Three defenses,
+  all router-side: **straggler ejection** — per-replica windowed dispatch-p95
+  (obs/hist.py sliding sketches) against the fleet median; a replica whose
+  p95 exceeds ``straggler_k``x the median flips to a ``degraded`` lifecycle
+  state (no new dispatch, in-flight finishes, probed back to ``ready`` after
+  ``eject_cooldown_s`` — deliberately DISTINCT from the heartbeat-staleness
+  ``hang`` path, which drains and restarts the process); **hedged dispatch**
+  — after a quantile-derived per-request hedge deadline, a still-pending
+  request is speculatively re-dispatched to a second replica, first
+  completion wins, the loser is cancelled over the wire (correctness rides
+  the same at-least-once idempotency argument as redispatch: greedy decode
+  is deterministic and duplicate completions already dedup); **wire
+  hardening** — length+CRC framing negotiated via the hello's capability
+  list (legacy newline peers byte-identical), with typed ``WireCorrupt``
+  reject-and-reconnect, decorrelated-jitter backoff on every restart and
+  reconnect schedule, and an optional in-process chaos proxy
+  (``resilience/netfaults.py``) between the router and each replica for
+  deterministic network-fault injection.
 - **runtime elasticity** (DESIGN.md §18) — the replica count is a policy
   output, not a constant. Replicas move through ``starting → warming → ready →
   draining → retired`` (plus ``restarting``/``dead`` on the failure path):
@@ -72,6 +92,9 @@ import numpy as np
 from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
     heartbeat as hb,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience.netfaults import (
+    ChaosProxy,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.resilience.preemption import (
     EXIT_PREEMPTED,
 )
@@ -84,6 +107,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.prefix_cach
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.obs.hist import (
     LogHistogram,
+    WindowedLogHistogram,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.obs.slo import (
     AttainmentTracker,
@@ -97,6 +121,16 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler i
     ServerStopped,
     Shed,
     TenantTable,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.wire import (
+    JitterBackoff,
+    FrameDecoder,
+    LineDecoder,
+    WireCorrupt,
+    encode_msg,
+    hello_wants_framing,
+    make_hello_ack,
+    write_msg,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.train.launch import (
     Fleet,
@@ -134,6 +168,12 @@ class RouterRequest:
     preemptible: bool = False           # engine may park this mid-decode
     enqueued_s: float = 0.0             # last (re)entry into the router queue —
                                         # the current queue_wait span's start
+    hedged: bool = False                # a speculative second copy is in flight
+    hedge_replica: int | None = None    # where the hedge copy went
+    # Per-replica dispatch stamps for the CURRENT hop set (primary + hedge):
+    # the winning completion's dispatch span — and its latency sample — must
+    # start at the WINNER's send time, not the primary's.
+    dispatch_by: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -151,6 +191,8 @@ class RouterCompletion:
     replica: int
     redispatches: int = 0
     affinity_hit: bool = False
+    hedged: bool = False                # a hedge copy was in flight
+    hedge_won: bool = False             # ...and the hedge copy resolved first
     tenant: str = "default"
     queue_wait_s: float | None = None   # router queue + replica queue
     ttft_s: float | None = None
@@ -274,7 +316,11 @@ class _Replica:
     the only state ``room()`` dispatches to) → ``draining`` (retire/reload in
     progress: no new dispatch, in-flight finishing) → ``retired`` (gone for
     good, slot kept for the ledger/history). Failures branch to ``restarting``
-    (backoff then respawn) or ``dead`` (restart budget exhausted).
+    (backoff then respawn) or ``dead`` (restart budget exhausted) — plus
+    ``degraded`` (straggler ejection, DESIGN.md §23): alive and connected,
+    in-flight allowed to finish, but no NEW dispatch until the cooldown
+    probes it back to ``ready``. Degraded is deliberately not a failure
+    state: the process keeps running, the ledger stays, nothing restarts.
     ``retiring`` names who owns a draining replica (``"retire"`` |
     ``"reload"``) so the failure paths can tell an expected teardown from a
     crash."""
@@ -288,9 +334,11 @@ class _Replica:
         self.generation = 0
         self.fleet: Fleet | None = None
         self.port = 0
+        self.proxy: ChaosProxy | None = None   # chaos harness: the wire detour
         self.sock: socket.socket | None = None
         self.wfile = None
         self.wlock = threading.Lock()
+        self.framed = False           # negotiated wire mode (this connection)
         self.capacity: int | None = None
         self.inflight: dict[int, RouterRequest] = {}
         self.started_wall = 0.0
@@ -301,10 +349,38 @@ class _Replica:
         self.completed = 0
         self.exit_code: int | None = None
         self.stats: dict | None = None
+        # Gray-failure ledgers: windowed dispatch-latency sketch (send ->
+        # completion line, the router-observed number ejection scores on),
+        # cumulative eject/probe/hedge counters, and the cooldown clock.
+        self.lat: WindowedLogHistogram | None = None
+        self.degraded_until = 0.0
+        self.ejections = 0
+        self.probes = 0
+        self.hedges = 0               # hedge copies dispatched TO this replica
+        # Seeded decorrelated-jitter schedules (serving/wire.py): restart
+        # backoff and connect-retry pacing. Distinct per-replica seeds keep a
+        # fleet-wide blip from producing a synchronized restart storm.
+        self.restart_backoff: JitterBackoff | None = None
+        self.connect_backoff: JitterBackoff | None = None
 
     def room(self) -> bool:
-        return (self.state == "ready"
+        # wfile gates dispatchability too: between a connection dying and the
+        # io thread's teardown (which may sit out a death-classification
+        # grace), the state still reads "ready" — and dispatching into a dead
+        # socket spins send->fail->requeue at poll speed. The first failed
+        # send clears wfile, which closes the room here.
+        return (self.state == "ready" and self.wfile is not None
                 and (self.capacity is None or len(self.inflight) < self.capacity))
+
+    def send(self, obj: dict) -> None:
+        """Mode-aware wire write (newline JSON or negotiated frames); raises
+        ``OSError`` when the connection is gone. One owner for every
+        router->replica message EXCEPT the hello_ack (sent raw by the io
+        thread while still in line mode, before ``framed`` flips)."""
+        wfile = self.wfile
+        if wfile is None:
+            raise OSError("replica connection is down")
+        write_msg(wfile, self.wlock, obj, framed=self.framed)
 
 
 class Router:
@@ -342,6 +418,14 @@ class Router:
                  warm_prefixes: int = 8, drain_timeout_s: float = 30.0,
                  slo: SLOSpec | None = None, hist_rel_err: float = 0.01,
                  tenants: TenantTable | None = None,
+                 straggler_k: float = 0.0, eject_min_samples: int = 8,
+                 eject_cooldown_s: float = 5.0, eject_window_s: float = 30.0,
+                 hedge: bool = False, hedge_quantile: float = 95.0,
+                 hedge_factor: float = 2.0, hedge_min_s: float = 0.05,
+                 hedge_after_s: float = 0.0,
+                 framed_wire: bool = True,
+                 chaos: str = "", chaos_seed: int = 0,
+                 backoff_jitter: bool = True, jitter_seed: int = 0,
                  env: dict | None = None):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
@@ -386,6 +470,34 @@ class Router:
         self._backoff_max_s = backoff_max_s
         self._connect_timeout_s = connect_timeout_s
         self._poll_s = poll_s
+        # Gray-failure knobs (DESIGN.md §23). Ejection: straggler_k=0 is OFF
+        # (the pre-gray-failure behavior, bitwise); k>0 flips a replica whose
+        # windowed dispatch p95 exceeds k x the fleet-median peer p95 to
+        # ``degraded`` for eject_cooldown_s. Hedging: hedge=False is OFF; on,
+        # a request still pending hedge-deadline seconds after dispatch gets
+        # a speculative second copy (deadline = hedge_after_s when set, else
+        # hedge_factor x the fleet-wide windowed dispatch-latency
+        # hedge_quantile, floored at hedge_min_s). framed_wire opts into the
+        # length+CRC framing when a replica's hello advertises it; chaos
+        # routes every replica connection through a seeded
+        # resilience/netfaults.py proxy.
+        self._straggler_k = float(straggler_k)
+        self._eject_min_samples = int(eject_min_samples)
+        self._eject_cooldown_s = float(eject_cooldown_s)
+        self._eject_window_s = float(eject_window_s)
+        self._hedge = bool(hedge)
+        self._hedge_quantile = float(hedge_quantile)
+        self._hedge_factor = float(hedge_factor)
+        self._hedge_min_s = float(hedge_min_s)
+        self._hedge_after_s = float(hedge_after_s)
+        self._framed_wire = bool(framed_wire)
+        self._chaos = chaos
+        self._chaos_seed = int(chaos_seed)
+        self._backoff_jitter = bool(backoff_jitter)
+        self._jitter_seed = int(jitter_seed)
+        # Fleet-wide windowed dispatch-latency sketch: the hedge deadline's
+        # quantile source (per-replica sketches live on the replicas).
+        self._lat_fleet = WindowedLogHistogram(hist_rel_err, eject_window_s)
         self._writer = JsonlWriter(telemetry)
         # Distributed tracing (utils/trace.py): trace_dir holds one span JSONL
         # per process — the router writes router.jsonl, each replica gets
@@ -437,7 +549,9 @@ class Router:
         self._counts = {"requests": 0, "ok": 0, "timeout": 0, "shed": 0,
                         "failed": 0,
                         "redispatches": 0, "redispatched_requests": 0,
-                        "duplicates": 0, "affinity_hits": 0, "new_tokens": 0}
+                        "duplicates": 0, "affinity_hits": 0, "new_tokens": 0,
+                        "hedges": 0, "hedge_wins": 0, "ejections": 0,
+                        "probes": 0, "wire_corrupt": 0}
         # Per-tenant fleet-level ledgers: counts + client-facing ttft/e2e
         # sketches + attainment against the tenant's own SLO (global spec as
         # fallback) — the fleet_snapshot "tenants" section and the
@@ -478,6 +592,14 @@ class Router:
             "drain_timeout_s": self._drain_timeout_s,
             "slo": (self._slo_spec.describe() if self._slo_spec else None),
             "tenants": (self.tenants.describe() if self.tenants else None),
+            "straggler_k": self._straggler_k or None,
+            "eject_cooldown_s": (self._eject_cooldown_s
+                                 if self._straggler_k else None),
+            "hedge": self._hedge,
+            "hedge_after_s": (self._hedge_after_s or None) if self._hedge
+            else None,
+            "framed_wire": self._framed_wire,
+            "chaos": self._chaos or None,
         })
         with self._lock:
             for rep in self.replicas:
@@ -704,14 +826,8 @@ class Router:
         """Ship the drain op (outside the lock — it's a blocking socket write).
         A failed write means the connection is already dying; the monitor's
         draining branch finalizes via process-exit or deadline either way."""
-        with self._lock:
-            wfile, wlock = rep.wfile, rep.wlock
-        if wfile is None:
-            return
         try:
-            with wlock:
-                wfile.write(b'{"op": "drain", "id": -3}\n')
-                wfile.flush()
+            rep.send({"op": "drain", "id": -3})
         except OSError:
             pass
 
@@ -849,6 +965,33 @@ class Router:
         rep.exit_code = None
         rep.retiring = None
         rep.warmed = 0
+        rep.framed = False
+        if rep.lat is None:
+            rep.lat = WindowedLogHistogram(self._hist_rel_err,
+                                           self._eject_window_s)
+        else:
+            rep.lat.reset()       # a fresh process owes nothing to old scores
+        if rep.restart_backoff is None:
+            rep.restart_backoff = JitterBackoff(
+                self._backoff_s, self._backoff_max_s,
+                seed=self._jitter_seed ^ (rep.index * 2654435761 & 0x7FFFFFFF))
+            rep.connect_backoff = JitterBackoff(
+                0.05, 1.0,
+                seed=(self._jitter_seed + 1) ^ (rep.index * 40503 & 0x7FFFFFFF))
+        if rep.proxy is not None:
+            rep.proxy.stop()
+            rep.proxy = None
+        if self._chaos:
+            # The chaos detour: the router connects to the proxy, the proxy
+            # to the replica. One proxy per spawn (the replica's port is
+            # fresh each time); connection ordinals reset with it — the
+            # determinism contract is per-spawn.
+            rep.proxy = ChaosProxy(
+                rep.port, self._chaos, proxy_id=rep.index,
+                seed=self._chaos_seed,
+                on_fault=lambda info: self._writer.emit(
+                    {"event": "chaos", **info}))
+            rep.proxy.start()
         cmd = list(self._command) + ["--port", str(rep.port),
                                      "--replica-id", str(rep.index)]
         if self._hb_dir:
@@ -870,44 +1013,85 @@ class Router:
         t.start()
         self._threads.append(t)
 
+    def _read_hello(self, sock) -> tuple[dict, bytes]:
+        """The handshake: recv until the hello's newline (the one message that
+        is ALWAYS line-framed — the negotiation anchor). Returns the parsed
+        hello plus any bytes that followed it in the same chunks. Raises
+        ``OSError``/``ValueError`` on EOF, timeout, or a non-hello line."""
+        buf = b""
+        while b"\n" not in buf:
+            chunk = sock.recv(1 << 16)      # connect timeout still armed
+            if not chunk:
+                raise OSError("eof before hello")
+            buf += chunk
+            if len(buf) > 1 << 20:
+                raise OSError("oversized hello")
+        line, _, rest = buf.partition(b"\n")
+        hello = json.loads(line or b"null")
+        if not hello or hello.get("op") != "hello":
+            raise OSError("bad hello")
+        return hello, rest
+
     def _io_loop(self, rep: _Replica, gen: int) -> None:
-        """Connect to one replica generation, read its hello, then pump its
-        reply lines until disconnect or the generation is superseded."""
+        """Connect to one replica generation (through its chaos proxy when the
+        harness armed one), read its hello, negotiate the wire mode, then pump
+        its replies until disconnect, typed wire corruption, or the generation
+        is superseded."""
         while True:
             with self._lock:
                 if self._stopping or rep.generation != gen:
                     return
-                port, fleet = rep.port, rep.fleet
+                port = rep.proxy.port if rep.proxy is not None else rep.port
+                fleet = rep.fleet
+                connect_backoff = rep.connect_backoff
             if not fleet.running:
                 return                      # monitor classifies the exit
             try:
                 sock = socket.create_connection(("127.0.0.1", port), timeout=1.0)
             except OSError:
-                time.sleep(0.1)
+                time.sleep(connect_backoff.next())
                 continue
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            rfile = sock.makefile("rb")
             try:
-                hello = json.loads(rfile.readline() or b"null")
-                if not hello or hello.get("op") != "hello":
-                    raise OSError("bad hello")
+                hello, carry = self._read_hello(sock)
             except (OSError, ValueError):
                 sock.close()
-                time.sleep(0.1)
+                time.sleep(connect_backoff.next())
                 continue
+            connect_backoff.reset()         # a good hello forgives the history
+            # Wire-mode negotiation: the replica ADVERTISES (hello caps), the
+            # router OPTS IN (hello_ack) — only then do both directions speak
+            # length+CRC frames. A legacy replica (no caps) or framed_wire
+            # False keeps the byte-identical newline protocol.
+            framed = self._framed_wire and hello_wants_framing(hello)
             # The connect/hello timeout must NOT outlive the handshake: reply
             # gaps are unbounded (a long decode, an idle fleet), and a read
             # timeout here would masquerade as a lost connection — tearing
             # down a healthy replica's ledger every quiet second. Teardown is
             # signalled by the socket being closed (stop/_fail_replica), EOF,
-            # or the process dying — never by silence.
+            # typed wire corruption, or the process dying — never by silence.
             sock.settimeout(None)
+            wfile = sock.makefile("wb")
+            if framed:
+                # The opt-in must be on the wire BEFORE any thread can
+                # dispatch through this connection: a submit overtaking the
+                # hello_ack would leave the two ends disagreeing about the
+                # framing mode forever. The ack itself is the last line-mode
+                # message.
+                try:
+                    wfile.write(encode_msg(make_hello_ack(), framed=False))
+                    wfile.flush()
+                except (OSError, ValueError):
+                    sock.close()
+                    time.sleep(connect_backoff.next())
+                    continue
             with self._cond:
                 if self._stopping or rep.generation != gen:
                     sock.close()
                     return
                 rep.sock = sock
-                rep.wfile = sock.makefile("wb")
+                rep.wfile = wfile
+                rep.framed = framed
                 slots = int(hello.get("num_slots", 1))
                 pending = int(hello.get("max_pending", 0))
                 rep.capacity = slots + pending if pending else None
@@ -917,8 +1101,11 @@ class Router:
                 # starts (empty index, warm_prefixes=0, affinity off) skip
                 # straight to ready.
                 warm = (self._affinity.hot_prefixes(self._warm_prefixes)
-                        if self._affinity_on else [])
-                if warm:
+                        if self._affinity_on and rep.state != "degraded"
+                        else [])
+                if rep.state == "degraded":
+                    pass          # reconnected, but only the probe un-ejects
+                elif warm:
                     rep.state = "warming"
                 else:
                     rep.state = "ready"
@@ -927,47 +1114,96 @@ class Router:
                 msg = {"op": "warm", "id": -2,
                        "prompts": [[int(t) for t in p] for p in warm]}
                 try:
-                    with rep.wlock:
-                        rep.wfile.write((json.dumps(msg) + "\n").encode())
-                        rep.wfile.flush()
+                    rep.send(msg)
                 except OSError:
                     pass          # conn already dying: handled below as usual
             self._writer.emit({"event": "replica", "replica": rep.index,
                                "action": "warming" if warm else "ready",
                                "restarts": rep.restarts,
                                "capacity": rep.capacity,
-                               "warm_prefixes": len(warm)})
+                               "warm_prefixes": len(warm),
+                               "framed": framed})
+            decoder = FrameDecoder() if framed else LineDecoder()
+            corrupt: str | None = None
             try:
-                for raw in rfile:
-                    self._handle_line(rep, gen, json.loads(raw))
-            except (OSError, ValueError, KeyError, TypeError):
-                pass                  # torn/garbage line or dead socket
+                chunk = carry    # bytes that trailed the hello (replicas send
+                while True:      # nothing unsolicited, so in practice empty)
+                    if chunk:
+                        for raw in decoder.feed(chunk):
+                            msg = json.loads(raw)
+                            if not isinstance(msg, dict):
+                                raise WireCorrupt("non-object message")
+                            self._handle_line(rep, gen, msg)
+                    chunk = sock.recv(1 << 16)
+                    if not chunk:
+                        break             # EOF: process death or conn loss
+            except WireCorrupt as e:
+                corrupt = str(e)
+            except (ValueError, KeyError, TypeError) as e:
+                # A reply that passed framing (or legacy line splitting) but
+                # cannot be parsed/attributed — same typed treatment: the
+                # stream is suspect, reconnect and let the ledger drain
+                # replay whatever was outstanding.
+                corrupt = f"garbled reply: {e}"
+            except OSError:
+                pass                      # dead socket
+            if corrupt is not None:
+                with self._lock:
+                    self._counts["wire_corrupt"] += 1
+                self._writer.emit({"event": "replica", "replica": rep.index,
+                                   "action": "wire_corrupt",
+                                   "detail": corrupt})
+                print(f"[router] replica {rep.index} wire corrupt: {corrupt}; "
+                      f"reconnecting", flush=True)
             # EOF usually means the PROCESS died (its exit closed the socket a
             # few ms before the monitor can observe the reaped child). Give
             # that classification a moment: a crash must flow through
             # _fail_replica — one owner for drain + restart accounting — and
             # only a genuine live-process connection loss is handled here.
-            grace = time.monotonic() + 0.5
-            while fleet.running and time.monotonic() < grace:
-                time.sleep(0.02)
+            # Typed corruption skips the grace: the peer was demonstrably
+            # WRITING to us a moment ago, and every 100ms the reconnect waits
+            # is tail latency for the drained ledger's replays (the monitor
+            # still classifies a death that races this independently).
+            if corrupt is None:
+                grace = time.monotonic() + 0.5
+                while fleet.running and time.monotonic() < grace:
+                    time.sleep(0.02)
             if not fleet.running:
                 return                # monitor classifies, drains, restarts
+            reconnect = False
             with self._cond:
                 if rep.generation == gen:
                     rep.sock = None
                     rep.wfile = None
-                    if not self._stopping and rep.state in ("ready", "warming"):
-                        # Connection lost but generation current (process still
-                        # alive): reconnect — but first drain the ledger. The
-                        # replica's completion callbacks hold the DEAD socket's
-                        # write file, so replies for these requests can never
-                        # reach us; without redispatch they would strand their
-                        # futures while heartbeats stay fresh.
-                        self._drain_ledger(rep, time.monotonic())
-                        rep.state = "starting"
+                    rep.framed = False
+                    if not self._stopping and rep.state in ("ready", "warming",
+                                                            "degraded"):
+                        # Connection lost (or typed wire corruption) with the
+                        # generation current and the process alive: reconnect
+                        # — but first drain the ledger. The replica's
+                        # completion callbacks hold the DEAD socket's write
+                        # file, so replies for these requests can never reach
+                        # us; without redispatch they would strand their
+                        # futures while heartbeats stay fresh. A degraded
+                        # replica reconnects too (its in-flight must replay
+                        # elsewhere) but stays degraded until its probe.
+                        self._drain_ledger(
+                            rep, time.monotonic(),
+                            cause="wire_corrupt" if corrupt else "conn_lost")
+                        if rep.state != "degraded":
+                            rep.state = "starting"
                         rep.started_mono = time.monotonic()
                         self._cond.notify_all()
-                        continue
+                        reconnect = True
+            if reconnect:
+                if corrupt is not None:
+                    # Reject-and-reconnect rides the decorrelated-jitter
+                    # schedule: a fleet-wide wire blip must not hammer every
+                    # replica back in lockstep. OUTSIDE the router lock — a
+                    # backoff sleep holding it would stall every other
+                    # replica's completions on one link's damage.
+                    time.sleep(connect_backoff.next())
+                continue
             return
 
     # ------------------------------------------------------------------ replies
@@ -977,6 +1213,15 @@ class Router:
         if op == "done":
             self._handle_done(rep, msg)
         elif op == "error":
+            if msg.get("error") == "wire_corrupt" and msg.get("id") is None:
+                # The replica saw a damaged line it cannot attribute (legacy
+                # newline mode: CRC-less). The CONNECTION is suspect — treat
+                # it as typed corruption on our side too: reconnect, drain,
+                # replay. Whatever the damaged line carried is outstanding in
+                # our ledger and rides the redispatch.
+                raise WireCorrupt(
+                    f"replica {rep.index} reported a corrupt line: "
+                    f"{msg.get('message')}")
             self._handle_error(rep, msg)
         elif op == "stats":
             with self._cond:
@@ -1023,6 +1268,22 @@ class Router:
                 self._counts["duplicates"] += 1
                 return
             rep.completed += 1
+            # The gray-failure evidence: router-observed dispatch latency
+            # (send -> completion line) into this replica's windowed sketch
+            # and the fleet-wide one the hedge deadline derives from — then
+            # score the replica against its peers while the sample is fresh.
+            # One sample per request per replica: a hedged request's PRIMARY
+            # already contributed its censored sample at hedge time, and a
+            # second, correlated sample here would halve the
+            # eject_min_samples noise guard.
+            t0 = req.dispatch_by.get(rep.index, req.dispatch_s)
+            primary_already_sampled = (req.hedged
+                                       and rep.index != req.hedge_replica)
+            if t0 is not None and rep.lat is not None \
+                    and not primary_already_sampled:
+                rep.lat.add(max(0.0, now - t0), now)
+                self._lat_fleet.add(max(0.0, now - t0), now)
+                self._maybe_eject(rep, now)
             self._cond.notify_all()
         if req.future.done():
             # Resolved elsewhere (an earlier attempt completed, or it expired):
@@ -1030,10 +1291,28 @@ class Router:
             with self._lock:
                 self._counts["duplicates"] += 1
             return
-        router_wait = (req.dispatch_s - req.arrival_s
-                       if req.dispatch_s is not None else 0.0)
+        dispatch_s = req.dispatch_by.get(rep.index, req.dispatch_s)
+        router_wait = (dispatch_s - req.arrival_s
+                       if dispatch_s is not None else 0.0)
         queue_wait = router_wait + (msg.get("queue_wait_s") or 0.0)
         ttft = msg.get("ttft_s")
+        if ttft is not None:
+            # Client-facing TTFT must be WIRE-AWARE: ``replica_ttft +
+            # router_wait`` assumes the reply transit is free, which is
+            # exactly what a gray-failing link violates — a done line delayed
+            # 2s would report a 20ms TTFT. Nothing is visible to the client
+            # before the done line lands, so floor the estimate at arrival-of
+            # -done minus the replica-side decode tail (the streaming-
+            # equivalent first-token instant: had the replica streamed, every
+            # token would ride the same slow wire). On a healthy wire the
+            # floor collapses to the classic estimate plus the measured
+            # transit.
+            rep_e2e = msg.get("e2e_s")
+            ttft = ttft + router_wait
+            if rep_e2e is not None and rep_e2e >= msg["ttft_s"]:
+                ttft = max(ttft, (now - req.arrival_s)
+                           - (rep_e2e - msg["ttft_s"]))
+        hedge_won = req.hedged and rep.index == req.hedge_replica
         comp = RouterCompletion(
             request_id=req.request_id,
             tokens=np.asarray(msg.get("tokens") or [], np.int32),
@@ -1042,8 +1321,9 @@ class Router:
             new_tokens=int(msg.get("new_tokens", 0)),
             replica=rep.index, redispatches=req.redispatches,
             affinity_hit=req.affinity_hit, tenant=req.tenant,
+            hedged=req.hedged, hedge_won=hedge_won,
             queue_wait_s=queue_wait,
-            ttft_s=None if ttft is None else ttft + router_wait,
+            ttft_s=ttft,
             tpot_s=msg.get("tpot_s"),
             e2e_s=now - req.arrival_s)
         try:
@@ -1055,18 +1335,53 @@ class Router:
             with self._lock:
                 self._counts["duplicates"] += 1
             return
+        if hedge_won:
+            with self._lock:
+                self._counts["hedge_wins"] += 1
+        # A hedge race this completion just won: stand the loser down (pop its
+        # ledger entry, wire a cancel) so its reply — if any — is a counted
+        # duplicate, not a ledger resident blocking the drain.
+        self._settle_peers(rep, req, now)
         # The winning hop's dispatch span (send -> completion line) plus the
         # terminal resolve span (completion line -> future resolved). ok
         # dispatches OVERLAP the replica's own spans, so the critical-path
         # breakdown charges only drained ones — see utils.trace.SEGMENTS.
-        self.tracer.span("dispatch", req.trace_id, req.dispatch_s, now,
+        self.tracer.span("dispatch", req.trace_id, dispatch_s, now,
                          request_id=req.request_id, replica=rep.index,
-                         outcome="ok", hop=req.redispatches)
+                         outcome="ok", hop=req.redispatches,
+                         hedge=hedge_won or None)
         self.tracer.span("resolve", req.trace_id, now, time.monotonic(),
                          request_id=req.request_id, replica=rep.index,
                          finish=comp.finish, new_tokens=comp.new_tokens,
                          redispatches=req.redispatches)
         self._record(comp)
+
+    def _settle_peers(self, winner: _Replica, req: RouterRequest,
+                      now: float) -> None:
+        """Pop ``req`` from every OTHER replica's ledger (the hedge losers —
+        at most one today) and wire each a ``cancel``: still queued there it
+        aborts outright, already decoding it finishes silently with the done
+        line suppressed. Either way the loser's window closes with a
+        ``hedge_lost`` dispatch span — visible in the tree, excluded from the
+        critical path (the winner's spans cover the same wall clock)."""
+        losers: list[_Replica] = []
+        with self._cond:
+            for other in self.replicas:
+                if other is not winner \
+                        and other.inflight.pop(req.request_id, None) is not None:
+                    losers.append(other)
+            if losers:
+                self._cond.notify_all()
+        for other in losers:
+            self.tracer.span(
+                "dispatch", req.trace_id,
+                req.dispatch_by.get(other.index, req.dispatch_s), now,
+                request_id=req.request_id, replica=other.index,
+                outcome="hedge_lost", hop=req.redispatches)
+            try:
+                other.send({"op": "cancel", "id": req.request_id})
+            except OSError:
+                pass          # conn dying; the duplicate dedup covers it
 
     def _handle_error(self, rep: _Replica, msg: dict) -> None:
         if msg.get("id") is None:
@@ -1078,13 +1393,32 @@ class Router:
             self._cond.notify_all()
         now = time.monotonic()
         kind = msg.get("error")
+        dispatch_s = req.dispatch_by.get(rep.index, req.dispatch_s)
+        with self._lock:
+            # A hedged twin still lives on another replica: this copy's
+            # refusal changes nothing for the client — the live copy resolves
+            # it. Never requeue (a third concurrent copy) and never fail the
+            # future; just close this hop and re-arm hedging.
+            elsewhere = any(req.request_id in r.inflight
+                            for r in self.replicas if r is not rep)
+        if elsewhere:
+            self.tracer.span("dispatch", req.trace_id, dispatch_s, now,
+                             request_id=req.request_id, replica=rep.index,
+                             outcome="bounced", error=kind,
+                             hop=req.redispatches)
+            with self._cond:
+                req.hedged = False
+                req.hedge_replica = None
+                req.dispatch_by.pop(rep.index, None)
+                self._cond.notify_all()
+            return
         if kind in ("queue_full", "draining"):
             # queue_full: router/replica capacity accounting drifted (e.g. a
             # replica restarted thinner). draining: the shrink/submit race —
             # a dispatch crossed the drain op on the wire and the replica's
             # closed queue refused it. Either way the request is intact:
             # bounce back to the queue front, try elsewhere.
-            self.tracer.span("dispatch", req.trace_id, req.dispatch_s, now,
+            self.tracer.span("dispatch", req.trace_id, dispatch_s, now,
                              request_id=req.request_id, replica=rep.index,
                              outcome="bounced", hop=req.redispatches)
             req.enqueued_s = now
@@ -1097,7 +1431,7 @@ class Router:
             req.future.set_exception(err)
         except concurrent.futures.InvalidStateError:
             return                        # lost a resolve race: already settled
-        self.tracer.span("dispatch", req.trace_id, req.dispatch_s, now,
+        self.tracer.span("dispatch", req.trace_id, dispatch_s, now,
                          request_id=req.request_id, replica=rep.index,
                          outcome="error", error=kind, hop=req.redispatches)
         self.tracer.span("resolve", req.trace_id, now, time.monotonic(),
@@ -1150,7 +1484,7 @@ class Router:
                     comp.replica, AttainmentTracker(self._slo_spec))
                 per.observe(now, ok=comp.ok, ttft_s=comp.ttft_s,
                             tpot_s=comp.tpot_s, e2e_s=comp.e2e_s)
-        self._writer.emit({
+        ev = {
             "event": "route", "request_id": comp.request_id,
             "replica": comp.replica, "affinity_hit": comp.affinity_hit,
             "redispatches": comp.redispatches, "finish": comp.finish,
@@ -1158,7 +1492,190 @@ class Router:
             "queue_wait_s": comp.queue_wait_s, "ttft_s": comp.ttft_s,
             "tpot_s": comp.tpot_s, "e2e_s": comp.e2e_s,
             "tenant": comp.tenant,
-        })
+        }
+        if comp.hedged:
+            # Only on hedged requests: hedging off keeps route lines
+            # field-identical to the pre-hedging schema.
+            ev["hedged"] = True
+            ev["hedge_won"] = comp.hedge_won
+        self._writer.emit(ev)
+
+    # ------------------------------------------------------------- gray failures
+
+    def _maybe_eject(self, rep: _Replica, now: float) -> None:
+        """Straggler scoring (caller holds the lock): flip ``rep`` to
+        ``degraded`` when its windowed dispatch p95 exceeds ``straggler_k``
+        times the median of its ready peers' p95s. Guards: enough samples on
+        both sides (one slow request is noise, not a gray failure), at least
+        one OTHER ready replica (never eject the last server — a degraded
+        fleet member still beats an empty fleet), and k=0 disables scoring
+        entirely (the pre-gray-failure path, bitwise).
+
+        Deliberately DISTINCT from the heartbeat hang path: ejection keeps
+        the process, the connection, and the in-flight ledger (work finishes;
+        only NEW dispatch stops), while ``hung`` drains and restarts. A slow
+        replica is an asset cooling off; a hung one is a corpse."""
+        if self._straggler_k <= 0 or rep.state != "ready":
+            return
+        if rep.lat is None or rep.lat.count(now) < self._eject_min_samples:
+            return
+        peer_floor = max(1, self._eject_min_samples // 2)
+        peers = [r for r in self.replicas
+                 if r is not rep and r.state == "ready"
+                 and r.lat is not None and r.lat.count(now) >= peer_floor]
+        if not peers:
+            return                # nobody to compare against / last server
+        p95 = rep.lat.quantile(95, now)
+        peer_p95s = sorted(r.lat.quantile(95, now) for r in peers)
+        median = peer_p95s[len(peer_p95s) // 2]
+        if p95 is None or median is None or median <= 0:
+            return
+        if p95 <= self._straggler_k * median:
+            return
+        rep.state = "degraded"
+        rep.degraded_until = now + self._eject_cooldown_s
+        rep.ejections += 1
+        self._counts["ejections"] += 1
+        # Emit INSIDE the transaction (the _fail_replica precedent): the
+        # moment another thread can see the degraded state, the event is on
+        # disk.
+        self._writer.emit({"event": "eject", "action": "eject",
+                           "replica": rep.index, "p95_s": round(p95, 6),
+                           "fleet_p95_s": round(median, 6),
+                           "k": self._straggler_k,
+                           "cooldown_s": self._eject_cooldown_s,
+                           "inflight": len(rep.inflight),
+                           "ejections": rep.ejections})
+        self._cond.notify_all()
+        self.tracer.span("eject", self._fleet_trace, now, action="eject",
+                         replica=rep.index, p95_s=round(p95, 6),
+                         fleet_p95_s=round(median, 6))
+        print(f"[router] replica {rep.index} EJECTED (degraded): dispatch "
+              f"p95 {p95 * 1e3:.1f}ms vs fleet median {median * 1e3:.1f}ms "
+              f"(k={self._straggler_k:g}); probe in "
+              f"{self._eject_cooldown_s:g}s", flush=True)
+
+    def _probe_replica(self, rep: _Replica, now: float) -> None:
+        """Cooldown expiry: open the degraded replica back up. The probe IS
+        the next real dispatch — the sketch restarts empty, so the verdict
+        comes from post-recovery evidence only: still slow, it re-ejects
+        after ``eject_min_samples`` fresh completions; recovered, it simply
+        serves."""
+        with self._cond:
+            if rep.state != "degraded":
+                return
+            rep.state = "ready"
+            if rep.lat is not None:
+                rep.lat.reset()
+            rep.probes += 1
+            self._counts["probes"] += 1
+            self._writer.emit({"event": "eject", "action": "probe",
+                               "replica": rep.index,
+                               "ejections": rep.ejections,
+                               "probes": rep.probes})
+            self._cond.notify_all()
+        self.tracer.span("eject", self._fleet_trace, now, action="probe",
+                         replica=rep.index)
+        print(f"[router] replica {rep.index} probed back to ready "
+              f"(ejection {rep.ejections})", flush=True)
+
+    def _hedge_deadline(self, now: float) -> float | None:
+        """Seconds a dispatch may stay pending before it earns a hedge:
+        ``hedge_after_s`` verbatim when set, else ``hedge_factor`` x the
+        fleet-wide windowed dispatch-latency ``hedge_quantile`` (floored at
+        ``hedge_min_s``). None while the sketch is empty — with no evidence
+        of what "normal" looks like, a hedge would be a blind duplicate."""
+        if self._hedge_after_s > 0:
+            return self._hedge_after_s
+        with self._lock:
+            if self._lat_fleet.count(now) < max(4, self._eject_min_samples // 2):
+                return None
+            q = self._lat_fleet.quantile(self._hedge_quantile, now)
+        if q is None:
+            return None
+        return max(self._hedge_min_s, q * self._hedge_factor)
+
+    def _hedge_scan(self, now: float) -> None:
+        """Speculative re-dispatch (the monitor tick's hedging half): any
+        request pending past the hedge deadline on a ready/degraded replica
+        gets ONE copy on a second replica — first completion wins
+        (``_handle_done`` resolves; ``_settle_peers`` cancels the loser).
+        Correct by the same argument as crash redispatch: greedy decode is
+        deterministic, so both copies produce identical tokens, and the
+        duplicate-completion dedup already exists."""
+        deadline = self._hedge_deadline(now)
+        if deadline is None:
+            return
+
+        def stuck(r: _Replica) -> bool:
+            # A replica already sitting on work older than the hedge deadline
+            # is visibly slow RIGHT NOW — hedging onto it trades one straggler
+            # for another (pre-ejection, its sketch may not have tripped yet;
+            # its ledger already tells the story).
+            return any(now - (q.dispatch_by.get(r.index) or q.dispatch_s
+                              or now) > deadline
+                       for q in r.inflight.values())
+
+        sends: list[tuple[_Replica, RouterRequest]] = []
+        with self._cond:
+            for rep in self.replicas:
+                if rep.state not in ("ready", "degraded"):
+                    continue      # draining/failed ledgers have their own path
+                for req in list(rep.inflight.values()):
+                    if req.hedged or req.future.done():
+                        continue
+                    t0 = req.dispatch_by.get(rep.index, req.dispatch_s)
+                    if t0 is None or now - t0 < deadline:
+                        continue
+                    ups = [r for r in self.replicas
+                           if r is not rep and r.room()
+                           and req.request_id not in r.inflight
+                           and not stuck(r)]
+                    if not ups:
+                        continue  # no healthy spare: the hedge can wait
+                    tgt = min(ups, key=lambda r: (len(r.inflight), r.index))
+                    # The hedge decision is itself a latency sample — a
+                    # CENSORED one (the true latency is >= elapsed). Without
+                    # it a straggler whose completions keep losing hedge
+                    # races never scores (its late done lines arrive as
+                    # settled duplicates, which record nothing), and the
+                    # ejection detector starves exactly when hedging works.
+                    # One sample per hedge, never per scan tick.
+                    if rep.lat is not None:
+                        rep.lat.add(now - t0, now)
+                        self._maybe_eject(rep, now)
+                    req.hedged = True
+                    req.hedge_replica = tgt.index
+                    req.dispatch_by[tgt.index] = now
+                    tgt.inflight[req.request_id] = req
+                    tgt.dispatched += 1
+                    tgt.hedges += 1
+                    self._counts["hedges"] += 1
+                    sends.append((tgt, req))
+            if sends:
+                self._cond.notify_all()
+        for tgt, req in sends:
+            self._writer.emit({"event": "hedge", "request_id": req.request_id,
+                               "replica": tgt.index,
+                               "deadline_s": round(deadline, 6),
+                               "tenant": req.tenant})
+            # The hedge marker is a point span (like redispatch): the copy's
+            # own dispatch window closes later as "ok" or "hedge_lost".
+            self.tracer.span("hedge", req.trace_id, now,
+                             request_id=req.request_id, replica=tgt.index,
+                             deadline_s=round(deadline, 6))
+            try:
+                tgt.send(self._submit_msg(req, now))
+            except OSError:
+                # The hedge target's connection died under us: unwind — the
+                # primary copy is still in flight, and a later scan may
+                # re-hedge elsewhere.
+                with self._cond:
+                    tgt.inflight.pop(req.request_id, None)
+                    req.hedged = False
+                    req.hedge_replica = None
+                    req.dispatch_by.pop(tgt.index, None)
+                    self._cond.notify_all()
 
     # ------------------------------------------------------------------ dispatch
 
@@ -1227,6 +1744,11 @@ class Router:
             # wait must include the failed attempt + detection + backoff time
             # it sat through, not just its first hop.
             req.dispatch_s = now
+            # A fresh hop set: stale stamps (a drained hop's replica, a past
+            # hedge) must not leak into this attempt's spans or sketches.
+            req.dispatch_by = {rep.index: now}
+            req.hedged = False
+            req.hedge_replica = None
             if self._served_from_s is None:
                 self._served_from_s = now
             req.affinity_hit = hit
@@ -1236,7 +1758,6 @@ class Router:
                 self._in_transit = None
             if self._affinity_on:
                 self._affinity.insert(req.prompt, rep.index)
-            wfile, wlock = rep.wfile, rep.wlock
         # This queue stint ends here (enqueued_s -> dispatch); the route span
         # records the decision itself — target, affinity outcome, spill-over.
         self.tracer.span("queue_wait", req.trace_id, req.enqueued_s, now,
@@ -1247,14 +1768,16 @@ class Router:
                          hop=req.redispatches)
         msg = self._submit_msg(req, now)
         try:
-            with wlock:
-                wfile.write((json.dumps(msg) + "\n").encode())
-                wfile.flush()
-        except (OSError, AttributeError):
-            # Connection died under us: pull the request back; the monitor will
-            # classify the replica. (AttributeError: wfile already cleared.)
+            rep.send(msg)
+        except OSError:
+            # Connection died under us: pull the request back and close the
+            # room (wfile None -> room() False) so the dispatch loop waits
+            # for the io thread's teardown instead of spinning this replica;
+            # the monitor/io thread classifies it.
             with self._cond:
                 rep.inflight.pop(req.request_id, None)
+                rep.wfile = None
+                self._cond.notify_all()
             req.enqueued_s = time.monotonic()   # a fresh queue stint begins
             self.queue.requeue(req)
         return True
@@ -1373,9 +1896,20 @@ class Router:
             # The losing hop closes here (outcome="drained" — the interval the
             # critical path charges as failed_dispatch, unlike an "ok" dispatch
             # which merely overlaps the replica's own spans).
-            self.tracer.span("dispatch", req.trace_id, req.dispatch_s, now,
-                             request_id=req.request_id, replica=rep.index,
+            self.tracer.span("dispatch", req.trace_id,
+                             req.dispatch_by.get(rep.index, req.dispatch_s),
+                             now, request_id=req.request_id,
+                             replica=rep.index,
                              outcome="drained", hop=req.redispatches)
+            if any(req.request_id in r.inflight
+                   for r in self.replicas if r is not rep):
+                # A hedged twin is still live on another replica: no replay
+                # needed (it resolves there) and no redispatch counted — just
+                # re-arm hedging for the surviving copy.
+                req.hedged = False
+                req.hedge_replica = None
+                req.dispatch_by.pop(rep.index, None)
+                continue
             if req.deadline_s is not None and now > req.deadline_s:
                 self._expire(req, now)        # past deadline: expired, NOT a
             else:                             # redispatch — don't count one
@@ -1409,8 +1943,18 @@ class Router:
                 rep.state = "dead"
             else:
                 rep.restarts += 1
-                backoff = min(self._backoff_s * (2 ** (rep.restarts - 1)),
-                              self._backoff_max_s) if self._backoff_s > 0 else 0.0
+                if self._backoff_s <= 0:
+                    backoff = 0.0
+                elif self._backoff_jitter and rep.restart_backoff is not None:
+                    # Decorrelated jitter (serving/wire.py): a fleet-wide blip
+                    # that kills every replica at once must not produce a
+                    # synchronized restart storm N backoffs later. Seeded per
+                    # replica — the schedule is pinned for tests, different
+                    # across peers.
+                    backoff = rep.restart_backoff.next()
+                else:
+                    backoff = min(self._backoff_s * (2 ** (rep.restarts - 1)),
+                                  self._backoff_max_s)
                 rep.restart_due = now + backoff
                 rep.state = "restarting"
             state, backoff_s = rep.state, (rep.restart_due - now
@@ -1475,8 +2019,12 @@ class Router:
                 pass      # lost a resolve race — must not kill the monitor thread
 
     def _stale(self, rep: _Replica) -> bool:
+        # Degraded replicas stay under the hang watch: ejection means "slow,
+        # stop feeding it", but a replica that then STOPS beating is a corpse
+        # holding an in-flight ledger — that rides the hang drain, exactly
+        # like a ready one. The two detectors stay orthogonal.
         if not (self._hb_dir and self._hb_timeout_s > 0
-                and rep.state == "ready"):
+                and rep.state in ("ready", "degraded")):
             return False
         beat = hb.read_heartbeats(self._hb_dir).get(rep.index)
         t = (beat["time"] if beat and beat["time"] >= rep.started_wall
@@ -1499,15 +2047,22 @@ class Router:
                 # draining/retired replicas are owned by their retire/reload
                 # thread (an expected exit 0 must never classify as a crash);
                 # the drain deadline bounds a death there instead.
-                if rep.state in ("starting", "warming", "ready"):
+                if rep.state in ("starting", "warming", "ready", "degraded"):
                     if not rep.fleet.running:
                         rc = rep.fleet.poll()
                         reason = ("preempted" if rc == EXIT_PREEMPTED
                                   else "crash")
                         self._fail_replica(rep, reason, exit_code=rc)
                         continue
-                    if rep.state == "ready" and check_hb and self._stale(rep):
+                    if (rep.state in ("ready", "degraded") and check_hb
+                            and self._stale(rep)):
+                        # Hung beats degraded: a silent heartbeat means the
+                        # process is a corpse whatever its latency score said
+                        # — drain + restart, the PR-6 path.
                         self._fail_replica(rep, "hung")
+                        continue
+                    if rep.state == "degraded" and now >= rep.degraded_until:
+                        self._probe_replica(rep, now)
                         continue
                     if (rep.state in ("starting", "warming")
                             and now - rep.started_mono > self._connect_timeout_s):
@@ -1529,6 +2084,8 @@ class Router:
                                        "restarts": rep.restarts})
                     with self._lock:
                         self._spawn(rep)
+            if self._hedge:
+                self._hedge_scan(now)
             time.sleep(self._poll_s)
 
     # ------------------------------------------------------------------ snapshot
@@ -1540,14 +2097,12 @@ class Router:
         the LAST poke brought back (at most one interval stale, which the
         timeline consumer tolerates by construction: it is a trend signal)."""
         with self._lock:
-            targets = [(r.wfile, r.wlock) for r in self.replicas
-                       if r.state in ("ready", "draining")
+            targets = [r for r in self.replicas
+                       if r.state in ("ready", "degraded", "draining")
                        and r.wfile is not None]
-        for wfile, wlock in targets:
+        for rep in targets:
             try:
-                with wlock:
-                    wfile.write(b'{"op": "stats", "id": -1}\n')
-                    wfile.flush()
+                rep.send({"op": "stats", "id": -1})
             except OSError:
                 pass                  # dying replica: the monitor will classify
 
@@ -1571,7 +2126,8 @@ class Router:
                 row = {"replica": r.index, "state": r.state,
                        "inflight": len(r.inflight), "capacity": r.capacity,
                        "restarts": r.restarts, "dispatched": r.dispatched,
-                       "completed": r.completed}
+                       "completed": r.completed,
+                       "hedges": r.hedges, "ejections": r.ejections}
                 if self._slo_fleet is not None:
                     tracker = self._slo_by_replica.get(r.index)
                     row["slo"] = (tracker.window(now) if tracker is not None
@@ -1654,6 +2210,16 @@ class Router:
             "failed": counts["failed"],
             "redispatches": counts["redispatches"],
             "duplicates": counts["duplicates"],
+            # Gray-failure live counters: how many replicas are currently
+            # sitting out (degraded — excluded from ready capacity above, so
+            # the autoscaler sees their absence, not their slowness), plus
+            # cumulative ejection/hedge/wire-damage tallies.
+            "replicas_degraded": sum(r["state"] == "degraded"
+                                     for r in per_replica),
+            "ejections": counts["ejections"],
+            "hedges": counts["hedges"],
+            "hedge_wins": counts["hedge_wins"],
+            "wire_corrupt": counts["wire_corrupt"],
             "affinity_rate": (counts["affinity_hits"] / routed
                               if routed else None),
             "restarts": sum(r["restarts"] for r in per_replica),
@@ -1703,14 +2269,10 @@ class Router:
         asked = []
         with self._lock:
             for rep in self.replicas:
-                if (rep.state in ("ready", "draining")
+                if (rep.state in ("ready", "degraded", "draining")
                         and rep.wfile is not None):
                     try:
-                        with rep.wlock:
-                            rep.wfile.write(
-                                (json.dumps({"op": "stats", "id": -1}) + "\n")
-                                .encode())
-                            rep.wfile.flush()
+                        rep.send({"op": "stats", "id": -1})
                         asked.append(rep)
                     except OSError:
                         pass
@@ -1769,9 +2331,7 @@ class Router:
         for rep in reps:                      # graceful stop, then hard teardown
             if rep.wfile is not None:
                 try:
-                    with rep.wlock:
-                        rep.wfile.write(b'{"op": "stop"}\n')
-                        rep.wfile.flush()
+                    rep.send({"op": "stop"})
                 except OSError:
                     pass
         stop_deadline = time.monotonic() + 5.0
@@ -1781,6 +2341,8 @@ class Router:
                 time.sleep(0.02)
             if rep.fleet is not None:
                 rep.fleet.terminate(grace=1.0)
+            if rep.proxy is not None:
+                rep.proxy.stop()
         err = None
         leftover = [r for r in leftover if not r.future.done()]
         if leftover:
@@ -1853,6 +2415,8 @@ class Router:
             per_replica = [{
                 "replica": r.index, "state": r.state, "restarts": r.restarts,
                 "dispatched": r.dispatched, "completed": r.completed,
+                "hedges": r.hedges, "ejections": r.ejections,
+                "probes": r.probes,
                 "exit_code": r.exit_code,
                 "stats": r.stats,
             } for r in self.replicas]
@@ -1923,6 +2487,8 @@ class Router:
             "affinity": self._affinity_on,
             "wall_s": wall,
             **counts,
+            "hedge_win_rate": (counts["hedge_wins"] / counts["hedges"]
+                               if counts["hedges"] else None),
             "tokens_per_s": (counts["new_tokens"] / wall
                              if counts["new_tokens"] and wall else None),
             "affinity_rate": (counts["affinity_hits"] / routed

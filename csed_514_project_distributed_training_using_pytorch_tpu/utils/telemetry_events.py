@@ -52,6 +52,9 @@ EVENT_KINDS: dict[str, str] = {
     "router_summary": "once per router run at drain: fleet-wide counts",
     "fleet_snapshot": "periodic load signal: queue depth/age, per-replica occupancy",
     "scale": "autoscaler action: up/down/reload (+reload_drain bookkeeping)",
+    "eject": "straggler ejection lifecycle: eject (degraded) / probe (back to ready)",
+    "hedge": "one speculative re-dispatch: request, second replica, deadline",
+    "chaos": "one injected network fault (resilience/netfaults.py proxy schedule)",
     # -- resilience (resilience/supervisor.py, utils/checkpoint.py) -------------
     "checkpoint": "one checkpoint save/restore: op/kind/bytes/wall",
     "restart": "supervisor restart: attempt, crash/hung/timeout reason, backoff",

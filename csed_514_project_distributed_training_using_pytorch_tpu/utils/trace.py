@@ -19,6 +19,9 @@ tracing plane that answers that:
   arrival → dispatch/admission), ``route`` (the routing decision, with
   affinity/spill-over attrs), ``dispatch`` (send → completion line, per hop),
   ``redispatch`` (a drained hop: hop number + cause crash/preempt/hang),
+  ``hedge`` (a point marker: the router speculatively re-dispatched a
+  still-pending request to a second replica — the copy's own ``dispatch``
+  window closes later as ``ok`` or ``hedge_lost``),
   ``prefill`` (per chunk, with ``cache_hit_len``), ``decode`` (decode-ready →
   done, with the first-token split), ``draft``/``verify`` (speculative
   decoding's children of the decode window — per verify tick: host drafting
@@ -155,11 +158,12 @@ def assemble(spans) -> dict[str, list[dict]]:
 TERMINAL_SPANS = ("resolve", "client")
 
 # Fleet-lifecycle spans (the router's scale_up/scale_down/reload timeline
-# annotations, all sharing one synthetic trace id): real spans on the Chrome
-# timeline, but NOT requests — per-request accounting (summarize_traces,
-# orphan counting) excludes them, or every elastic run would report one
-# eternal "orphan" that is actually the fleet's own history.
-LIFECYCLE_SPANS = ("scale", "reload")
+# annotations plus straggler eject/probe markers, all sharing one synthetic
+# trace id): real spans on the Chrome timeline, but NOT requests —
+# per-request accounting (summarize_traces, orphan counting) excludes them,
+# or every elastic run would report one eternal "orphan" that is actually
+# the fleet's own history.
+LIFECYCLE_SPANS = ("scale", "reload", "eject")
 
 # Critical-path segments, in pipeline order. ``dispatch`` spans OVERLAP the
 # replica-side work they contain, so the breakdown uses the replica's own
@@ -196,6 +200,18 @@ def trace_breakdown(spans: list[dict]) -> dict:
     drained_windows = [(d["ts"], d["ts"] + (d.get("dur_s") or 0.0))
                        for d in by_name.get("dispatch", ())
                        if d.get("outcome") == "drained"]
+    # Hedge-loser windows are SHADOWS, not failures: the losing copy ran
+    # concurrently with the winner, so its wall clock is already covered by
+    # the winning hop — its replica-side spans are excluded from the segment
+    # sums (they would double-charge the interval), but the window itself is
+    # NOT charged anywhere (unlike a drained hop, where the failed interval
+    # was the only thing happening). Shadow exclusion is scoped to the LOSING
+    # replica's own track: the winner's spans cover the same wall clock by
+    # design and must keep counting.
+    shadow_windows = [(d["ts"], d["ts"] + (d.get("dur_s") or 0.0),
+                       f"replica{d.get('replica')}")
+                      for d in by_name.get("dispatch", ())
+                      if d.get("outcome") == "hedge_lost"]
 
     def losing(s):
         # Only replica-side spans can be "inside" a losing hop; the router's
@@ -203,9 +219,13 @@ def trace_breakdown(spans: list[dict]) -> dict:
         # dispatch instant, the replay's queue_wait at the drain instant).
         # 2e-6 absorbs the independent 6-decimal rounding of ts and dur_s; the
         # winning hop's replica spans start a transport hop AFTER the drain.
-        return (s.get("proc") != "router"
-                and any(a - 2e-6 <= s["ts"] <= b + 2e-6
-                        for a, b in drained_windows))
+        if s.get("proc") == "router":
+            return False
+        if any(a - 2e-6 <= s["ts"] <= b + 2e-6 for a, b in drained_windows):
+            return True
+        return any(a - 2e-6 <= s["ts"] <= b + 2e-6
+                   for a, b, proc in shadow_windows
+                   if s.get("proc") == proc)
 
     def total(name, pred=lambda s: True):
         return sum(s.get("dur_s") or 0.0 for s in by_name.get(name, ())
@@ -260,6 +280,7 @@ def trace_breakdown(spans: list[dict]) -> dict:
         "start": start, "end": end, "e2e_s": e2e, "segments": seg,
         "ttft_s": ttft,
         "hops": 1 + len(redispatches),
+        "hedges": len(by_name.get("hedge", ())),
         "redispatch_causes": [s.get("cause") for s in redispatches],
         "resolved": any(s["name"] in TERMINAL_SPANS for s in spans),
         "request_ids": {s.get("proc"): s.get("request_id") for s in spans
@@ -299,6 +320,7 @@ def summarize_traces(spans) -> dict:
         "orphans": len(orphans),
         "orphan_ids": orphans,
         "redispatched": sum(d["hops"] > 1 for d in downs.values()),
+        "hedged": sum(d.get("hedges", 0) > 0 for d in downs.values()),
         "segments": seg_pcts,
         "ttft_s": percentiles(ttfts, qs=(50, 95)),
         "e2e_s": percentiles([d["e2e_s"] for d in downs.values()], qs=(50, 95)),

@@ -98,13 +98,27 @@ class FleetState:
                 self.summary = r
             elif kind == "slo":
                 self.slo = r
-            elif kind in ("scale", "replica"):
+            elif kind in ("scale", "replica", "eject", "hedge", "chaos"):
                 t = r.get("t_s")
                 stamp = "-" if t is None else f"+{t:.1f}s"
-                what = (f"scale {r.get('action')} -> target {r.get('target')}"
-                        if kind == "scale" else
-                        f"replica {r.get('replica')} {r.get('action')}"
-                        + (f" ({r.get('reason')})" if r.get("reason") else ""))
+                if kind == "scale":
+                    what = (f"scale {r.get('action')} -> target "
+                            f"{r.get('target')}")
+                elif kind == "eject":
+                    what = (f"replica {r.get('replica')} "
+                            + ("EJECTED (degraded)"
+                               if r.get("action") == "eject"
+                               else "probed back to ready"))
+                elif kind == "hedge":
+                    what = (f"hedge: request {r.get('request_id')} -> "
+                            f"replica {r.get('replica')}")
+                elif kind == "chaos":
+                    what = (f"chaos {r.get('kind')} on replica "
+                            f"{r.get('replica')} ({r.get('dir')})")
+                else:
+                    what = (f"replica {r.get('replica')} {r.get('action')}"
+                            + (f" ({r.get('reason')})" if r.get("reason")
+                               else ""))
                 self.recent.append(f"{stamp}  {what}")
                 self.recent = self.recent[-self._events_tail:]
 
@@ -146,6 +160,17 @@ def render(state: FleetState, path: str) -> str:
         f"  ok {_fmt(snap.get('ok'))}"
         f"  redispatches {_fmt(snap.get('redispatches'))}"
         f"  restarts {_fmt(snap.get('restarts'))}")
+    if (snap.get("replicas_degraded") or snap.get("ejections")
+            or snap.get("hedges") or snap.get("wire_corrupt")):
+        # The gray-failure row (DESIGN.md §23): who is sitting out, how often
+        # the fleet hedged around slowness, and how much wire damage was
+        # contained as typed faults.
+        lines.append(
+            f"  degraded {_fmt(snap.get('replicas_degraded'))}"
+            f"  ejections {_fmt(snap.get('ejections'))}"
+            f"  hedges {_fmt(snap.get('hedges'))}"
+            f" (wins {_fmt(snap.get('hedge_wins'))})"
+            f"  wire corrupt {_fmt(snap.get('wire_corrupt'))}")
     slo = snap.get("slo")
     if slo:
         lines.append(
@@ -180,6 +205,12 @@ def render(state: FleetState, path: str) -> str:
         lines.append("")
         head = (f"  {'rep':>3} {'state':<9} {'infl':>4} {'cap':>4} "
                 f"{'occ':>6} {'restarts':>8} {'done':>6}")
+        # The gray-failure columns appear once any replica has been ejected
+        # or received a hedge copy — "degraded" shows in the state column;
+        # these show the history.
+        has_gray = any(r.get("ejections") or r.get("hedges") for r in per)
+        if has_gray:
+            head += f" {'eject':>5} {'hedge':>5}"
         has_slo = any(r.get("slo") for r in per)
         if has_slo:
             head += f" {'slo-att':>8} {'slo-n':>5}"
@@ -190,6 +221,9 @@ def render(state: FleetState, path: str) -> str:
                    f"{_fmt(r.get('occupancy')):>6} "
                    f"{_fmt(r.get('restarts')):>8} "
                    f"{_fmt(r.get('completed')):>6}")
+            if has_gray:
+                row += (f" {_fmt(r.get('ejections')):>5} "
+                        f"{_fmt(r.get('hedges')):>5}")
             if has_slo:
                 rs = r.get("slo") or {}
                 row += (f" {_fmt(rs.get('attainment')):>8} "

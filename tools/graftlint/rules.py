@@ -21,10 +21,12 @@ BACKEND_FREE = (
     "serving/autoscaler.py",
     "serving/scheduler.py",
     "serving/prefix_cache.py",
+    "serving/wire.py",
     "resilience/supervisor.py",
     "resilience/heartbeat.py",
     "resilience/preemption.py",
     "resilience/faults.py",
+    "resilience/netfaults.py",
     "utils/jsonl.py",
     "utils/trace.py",
     "utils/telemetry_events.py",

@@ -582,6 +582,44 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--drain-timeout-s", type=float, default=30.0,
                    help="how long a retiring/reloading replica may finish "
                         "in-flight work before stragglers redispatch")
+    gf = p.add_argument_group("gray failures (fleet mode, DESIGN.md §23)")
+    gf.add_argument("--straggler-k", type=float, default=0.0,
+                    help="straggler ejection: a replica whose windowed "
+                         "dispatch p95 exceeds k x the fleet-median peer p95 "
+                         "is flipped to 'degraded' (no new dispatch, "
+                         "in-flight finishes, probed back after the "
+                         "cooldown); 0 = off")
+    gf.add_argument("--eject-min-samples", type=int, default=8,
+                    help="windowed samples required on the scored replica "
+                         "before ejection can trip (noise guard)")
+    gf.add_argument("--eject-cooldown-s", type=float, default=5.0,
+                    help="degraded dwell before the probe re-opens dispatch")
+    gf.add_argument("--hedge", choices=("on", "off"), default="off",
+                    help="hedged dispatch: a request still pending past the "
+                         "hedge deadline gets a speculative second copy on "
+                         "another replica; first completion wins, the loser "
+                         "is cancelled over the wire")
+    gf.add_argument("--hedge-after-s", type=float, default=0.0,
+                    help="fixed hedge deadline in seconds (0 = derive from "
+                         "the fleet's windowed dispatch-latency quantile)")
+    gf.add_argument("--hedge-quantile", type=float, default=95.0,
+                    help="quantile of the windowed fleet dispatch latency "
+                         "the derived hedge deadline starts from")
+    gf.add_argument("--hedge-factor", type=float, default=2.0,
+                    help="multiplier on the quantile for the derived "
+                         "deadline")
+    gf.add_argument("--chaos", default="",
+                    help="network-chaos spec (resilience/netfaults.py "
+                         "grammar, e.g. 'delay:replica=1,ms=800,count=20;"
+                         "corrupt:replica=0,after=5'): route every "
+                         "router<->replica connection through a seeded "
+                         "in-process fault-injecting proxy")
+    gf.add_argument("--chaos-seed", type=int, default=0,
+                    help="seed for the chaos proxy's corrupt-byte positions")
+    gf.add_argument("--framed-wire", choices=("on", "off"), default="on",
+                    help="negotiate length+CRC wire framing with replicas "
+                         "that advertise it ('off' pins the legacy newline "
+                         "protocol — the back-compat A/B switch)")
     g = p.add_argument_group("load")
     g.add_argument("--scenario", choices=("batch", "chat"), default="batch",
                    help="'batch' = independent requests (open/closed loop); "
@@ -735,6 +773,15 @@ def main(argv: list[str] | None = None) -> int:
             max_replicas=args.max_replicas or None,
             warm_prefixes=args.warm_prefixes,
             drain_timeout_s=args.drain_timeout_s,
+            straggler_k=args.straggler_k,
+            eject_min_samples=args.eject_min_samples,
+            eject_cooldown_s=args.eject_cooldown_s,
+            hedge=args.hedge == "on",
+            hedge_after_s=args.hedge_after_s,
+            hedge_quantile=args.hedge_quantile,
+            hedge_factor=args.hedge_factor,
+            chaos=args.chaos, chaos_seed=args.chaos_seed,
+            framed_wire=args.framed_wire == "on",
             slo=SLOSpec.parse(args.slo),
             # The router is the fleet's ONE quota-charging front door; the
             # replica argv deliberately omits --tenants (per-request tenancy
@@ -861,6 +908,14 @@ def main(argv: list[str] | None = None) -> int:
               f"({rs['redispatched_requests']} requests), "
               f"{rs['replica_restarts']} replica restart(s), "
               f"{rs['duplicates']} duplicate completion(s)")
+        if (rs.get("ejections") or rs.get("hedges")
+                or rs.get("wire_corrupt")):
+            win = rs.get("hedge_win_rate")
+            print(f"gray failures: {rs.get('ejections', 0)} ejection(s), "
+                  f"{rs.get('probes', 0)} probe recover(ies), "
+                  f"{rs.get('hedges', 0)} hedge(s) "
+                  f"(win rate {'-' if win is None else f'{win:.2f}'}), "
+                  f"{rs.get('wire_corrupt', 0)} typed wire fault(s)")
         sp = rs.get("spec") or {}
         if sp:
             rate = sp.get("acceptance_rate")
@@ -1014,6 +1069,14 @@ def main(argv: list[str] | None = None) -> int:
                 redispatches=rs["redispatches"],
                 redispatched_requests=rs["redispatched_requests"],
                 duplicate_completions=rs["duplicates"],
+                hedge=args.hedge, straggler_k=args.straggler_k or None,
+                chaos=args.chaos or None,
+                ejections=rs.get("ejections"),
+                probes=rs.get("probes"),
+                hedges=rs.get("hedges"),
+                hedge_wins=rs.get("hedge_wins"),
+                hedge_win_rate=rs.get("hedge_win_rate"),
+                wire_corrupt=rs.get("wire_corrupt"),
                 replica_restarts=rs["replica_restarts"],
                 prefix_cache=rs.get("prefix_cache"),
                 prefix_hit_rate=(pc["hits"] / pc["queries"]

@@ -351,6 +351,30 @@ def summarize(path: str) -> dict:
             })
         s["scale_timeline"] = timeline
 
+    # Gray-failure tolerance (DESIGN.md §23): straggler ejections + probe
+    # recoveries, hedged dispatches + win rate, typed wire-corruption events,
+    # and the chaos harness's injected-fault ledger. The router_summary
+    # counters win; the event stream fills in for a killed run.
+    ejects = by_event.get("eject", [])
+    if ejects:
+        s["ejections"] = sum(e.get("action") == "eject" for e in ejects)
+        s["probe_recoveries"] = sum(e.get("action") == "probe" for e in ejects)
+    hedge_evs = by_event.get("hedge", [])
+    if hedge_evs:
+        s["hedges"] = len(hedge_evs)
+    if rsum:
+        for key in ("ejections", "probes", "hedges", "hedge_wins",
+                    "hedge_win_rate", "wire_corrupt"):
+            if rsum.get(key) is not None:
+                s[key] = rsum[key]
+    chaos_evs = by_event.get("chaos", [])
+    if chaos_evs:
+        s["chaos_faults"] = len(chaos_evs)
+        by_kind: dict = {}
+        for ev in chaos_evs:
+            by_kind[ev.get("kind")] = by_kind.get(ev.get("kind"), 0) + 1
+        s["chaos_by_kind"] = by_kind
+
     # Checkpoint traffic (utils/checkpoint.py savers + restores): how much resume
     # insurance the run paid for, and what it cost in wall time.
     ckpts = by_event.get("checkpoint", [])
@@ -506,6 +530,19 @@ def print_summary(s: dict) -> None:
                       f"{_fmt(r.get('completed'))} completed, "
                       f"{_fmt(r.get('restarts'))} restart(s), "
                       f"{r.get('state')}")
+            if (s.get("ejections") or s.get("hedges")
+                    or s.get("wire_corrupt") or s.get("chaos_faults")):
+                kinds = ", ".join(f"{k}: {v}" for k, v in
+                                  sorted((s.get("chaos_by_kind") or {})
+                                         .items()))
+                probes = s.get("probe_recoveries") or s.get("probes") or 0
+                print(f"   gray failures: {_fmt(s.get('ejections') or 0)} "
+                      f"ejection(s) ({_fmt(probes)} probe recoveries)  "
+                      f"hedges {_fmt(s.get('hedges') or 0)} "
+                      f"(win rate {_fmt(s.get('hedge_win_rate'))})  "
+                      f"wire corrupt {_fmt(s.get('wire_corrupt') or 0)}"
+                      + (f"  chaos {s['chaos_faults']} ({kinds})"
+                         if s.get("chaos_faults") else ""))
         if s.get("prefill_tokens") is not None:
             hit = ""
             if s.get("prefix_hit_rate") is not None:
@@ -638,6 +675,10 @@ COMPARE_ROWS = [
     ("prefix hit rate", "prefix_hit_rate"),
     ("affinity hit rate", "affinity_rate"),
     ("redispatches", "redispatches"),
+    ("ejections", "ejections"),
+    ("hedges", "hedges"),
+    ("hedge win rate", "hedge_win_rate"),
+    ("wire corrupt", "wire_corrupt"),
     ("replica restarts", "replica_restarts"),
     ("replicas p50", "replicas_p50"),
     ("replicas max", "replicas_max"),
