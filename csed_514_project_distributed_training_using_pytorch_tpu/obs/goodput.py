@@ -34,6 +34,14 @@ Segment rules (DESIGN.md §21 — the exclusive-decomposition rule):
   earlier attempt already executed. Replayed step time is badput, not
   compute: those steps re-derive state a checkpoint should have kept. A run
   with zero restarts has ``restart_badput_s == 0.0`` exactly.
+- ``rollback_badput`` — the same accounting for restarts the supervisor
+  classified as ``poisoned`` or ``desync`` (the numerical-immune-system
+  rollback-and-skip path, resilience/poison.py): the teardown gap, the
+  recovery init, and the replayed window of an attempt whose PREDECESSOR
+  tripped the anomaly policy. Split from ``restart_badput`` because the cure
+  differs — process badput says buy better capacity, rollback badput says
+  the detector/skip policy is paying for bad math — and a run with zero
+  rollbacks has ``rollback_badput_s == 0.0`` exactly.
 - ``idle`` — the residual: whatever the instrumented windows do not cover
   (host work between epochs, drain tails, supervisor polling). Computed as
   ``wall - everything_else`` and clamped at zero; a negative residual (clock
@@ -75,7 +83,12 @@ DERIVED_KINDS = ("goodput", "bench_guard")
 
 #: The exclusive segments, in render order.
 SEGMENTS = ("init_compile_s", "compute_s", "data_wait_s",
-            "checkpoint_stall_s", "restart_badput_s", "idle_s")
+            "checkpoint_stall_s", "restart_badput_s", "rollback_badput_s",
+            "idle_s")
+
+#: Supervisor restart reasons whose recovery cost charges to
+#: ``rollback_badput_s`` (the anomaly rollback-and-skip path).
+ROLLBACK_REASONS = ("poisoned", "desync")
 
 
 def _expand(paths) -> list[str]:
@@ -217,6 +230,32 @@ def decompose(paths) -> dict:
     run_start, run_end = min(starts), max(ends)
     wall_s = max(0.0, run_end - run_start)
 
+    # Attribute each restarted attempt's recovery cost by its CAUSE: the
+    # supervisor restart event that spawned it — matched by TIME (the newest
+    # restart stamped at or before the attempt's anchored start), not by
+    # index, because an attempt that died before writing any telemetry leaves
+    # no attempt entry and would shift an index-based join. Poisoned/desync
+    # restarts are the anomaly rollback path and charge to rollback_badput;
+    # everything else (crash, hung, timeout, or no supervisor stream at all)
+    # stays restart_badput.
+    restart_rows = sorted(
+        (r for r in streams["supervisor"] if r.get("event") == "restart"),
+        key=lambda r: float(r.get("unix_time") or 0.0))
+
+    def badput_key(attempt_index: int) -> str:
+        if not restart_rows or attempt_index <= 0:
+            return "restart_badput_s"
+        start = attempts[attempt_index]["start"]
+        cause = None
+        for r in restart_rows:
+            stamp = r.get("unix_time")
+            if stamp is None or float(stamp) <= start + 1e-6:
+                cause = r
+        if cause is None:               # clock skew: fall back to index order
+            cause = restart_rows[min(attempt_index, len(restart_rows)) - 1]
+        return ("rollback_badput_s" if cause.get("reason") in ROLLBACK_REASONS
+                else "restart_badput_s")
+
     seg = dict.fromkeys(SEGMENTS, 0.0)
     seen_epochs: set[int] = set()
     epochs_total = epochs_replayed = replayed_steps = 0
@@ -228,19 +267,19 @@ def decompose(paths) -> dict:
         if not first and prev_end is not None:
             # Crash -> respawn: teardown, supervisor backoff, the new
             # process's imports — none of it happens in an unfaulted run.
-            seg["restart_badput_s"] += max(0.0, a["start"] - prev_end)
+            seg[badput_key(i)] += max(0.0, a["start"] - prev_end)
         if a["epochs"]:
             first_epoch = a["epochs"][0]
             init = max(0.0, (first_epoch["end"] - first_epoch["wall_s"])
                        - a["start"])
-            seg["init_compile_s" if first else "restart_badput_s"] += init
+            seg["init_compile_s" if first else badput_key(i)] += init
         for e in a["epochs"]:
             epochs_total += 1
             if e["epoch"] in seen_epochs:
                 # A replay: an earlier attempt already executed this epoch.
                 epochs_replayed += 1
                 replayed_steps += e["steps"]
-                seg["restart_badput_s"] += e["wall_s"]
+                seg[badput_key(i)] += e["wall_s"]
             else:
                 seg["compute_s"] += e["execute_s"] + e["eval_s"]
                 seg["data_wait_s"] += e["data_s"]
@@ -257,6 +296,8 @@ def decompose(paths) -> dict:
 
     restarts = sum(r.get("event") == "restart"
                    for r in streams["supervisor"])
+    rollbacks = sum(r.get("reason") in ROLLBACK_REASONS
+                    for r in restart_rows)
     sup_summary = next((r for r in reversed(streams["supervisor"])
                         if r.get("event") == "supervise_summary"), None)
     return {
@@ -265,11 +306,12 @@ def decompose(paths) -> dict:
         "end_unix": run_end,
         "segments": seg,
         "goodput_frac": seg["compute_s"] / wall_s if wall_s else None,
-        "badput_frac": (seg["restart_badput_s"] / wall_s if wall_s
-                        else None),
+        "badput_frac": ((seg["restart_badput_s"] + seg["rollback_badput_s"])
+                        / wall_s if wall_s else None),
         "attempts": len(attempts),
         "restarts": restarts if streams["supervisor"] else
         max(0, len(attempts) - 1),
+        "rollbacks": rollbacks,
         "supervise_status": (sup_summary or {}).get("status"),
         "epochs": epochs_total,
         "epochs_replayed": epochs_replayed,
@@ -297,6 +339,7 @@ def goodput_event(report: dict) -> dict:
         "badput_frac": report["badput_frac"],
         "attempts": report["attempts"],
         "restarts": report["restarts"],
+        "rollbacks": report.get("rollbacks", 0),
         "epochs": report["epochs"],
         "epochs_replayed": report["epochs_replayed"],
         "replayed_steps": report["replayed_steps"],

@@ -81,10 +81,14 @@ def state_shardings(mesh: Mesh, state: TrainState, *,
     vel_specs = fsdp_partition_specs(state.velocity, axis_size, axis_name=axis_name,
                                      min_leaf_size=min_leaf_size)
     vel_sh = jax.tree_util.tree_map(to_sh, vel_specs)
+    rep = NamedSharding(mesh, P())
     return TrainState(params=param_sh, velocity=vel_sh,
-                      step=NamedSharding(mesh, P()),
+                      step=rep,
                       # The EMA tree mirrors params exactly — same shards.
-                      ema=param_sh if state.ema is not None else None)
+                      ema=param_sh if state.ema is not None else None,
+                      # Guard scalars (anomaly detector) replicate like step.
+                      guard=jax.tree_util.tree_map(lambda _: rep, state.guard)
+                      if state.guard is not None else None)
 
 
 def shard_train_state(mesh: Mesh, state: TrainState, *,
@@ -178,7 +182,10 @@ def hybrid_state_shardings(mesh: Mesh, state: TrainState, *,
                                  scalar_fn=lambda _: rep),
         step=rep,
         # The EMA tree mirrors params exactly — same shards.
-        ema=param_sh if state.ema is not None else None)
+        ema=param_sh if state.ema is not None else None,
+        # Guard scalars (anomaly detector) replicate like step.
+        guard=jax.tree_util.tree_map(lambda _: rep, state.guard)
+        if state.guard is not None else None)
 
 
 def compile_epoch_hybrid(epoch_fn: Callable, mesh: Mesh, *,

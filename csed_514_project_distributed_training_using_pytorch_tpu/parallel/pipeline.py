@@ -434,4 +434,7 @@ def stacked_state_shardings(mesh: Mesh, state, *, axis_name: str = "stage",
         params=tree_sh(state.params),
         velocity=map_param_trees(state.velocity, tree_sh, scalar_fn=lambda _: rep),
         step=rep,
-        ema=tree_sh(state.ema) if state.ema is not None else None)
+        ema=tree_sh(state.ema) if state.ema is not None else None,
+        # Guard scalars (anomaly detector) replicate like step.
+        guard=jax.tree_util.tree_map(lambda _: rep, state.guard)
+        if state.guard is not None else None)

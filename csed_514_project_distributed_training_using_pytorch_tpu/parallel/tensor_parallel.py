@@ -107,10 +107,14 @@ def state_shardings(mesh: Mesh, state: TrainState, *,
         param_partition_specs(state.velocity, axis_name=axis_name), mesh)
     vel_sh = jax.tree_util.tree_map(to_sharding, vel_specs,
                                     is_leaf=lambda x: isinstance(x, P))
+    rep = NamedSharding(mesh, P())
     return TrainState(params=param_sh, velocity=vel_sh,
-                      step=NamedSharding(mesh, P()),
+                      step=rep,
                       # The EMA tree mirrors params exactly — same shards.
-                      ema=param_sh if state.ema is not None else None)
+                      ema=param_sh if state.ema is not None else None,
+                      # Guard scalars (anomaly detector) replicate like step.
+                      guard=jax.tree_util.tree_map(lambda _: rep, state.guard)
+                      if state.guard is not None else None)
 
 
 def shard_train_state(mesh: Mesh, state: TrainState, *,

@@ -17,7 +17,12 @@ from __future__ import annotations
 from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (  # noqa: F401
     faults,
     heartbeat,
+    poison,
     preemption,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience.poison import (  # noqa: F401
+    EXIT_POISONED,
+    Poisoned,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.resilience.preemption import (  # noqa: F401
     EXIT_PREEMPTED,
@@ -51,16 +56,21 @@ class RunHooks:
         if self.preemption is not None:
             self.preemption.uninstall()
 
-    def epoch_tick(self, state, epoch: int) -> None:
+    def epoch_tick(self, state, epoch: int,
+                   fingerprint: float | None = None) -> None:
         """Call at the top of each epoch: beat the heartbeat, apply armed faults.
-        No-op (without touching ``state``) unless a heartbeat or fault is armed."""
+        No-op (without touching ``state``) unless a heartbeat or fault is armed.
+        ``fingerprint`` (the ``--guard`` trainers' cross-replica param
+        fingerprint, computed at the PREVIOUS epoch's boundary) rides the beat
+        so the supervisor's fingerprint-verify mode can compare replicas at
+        the same step."""
         if not self.active:
             return
         step = int(state.step)                  # host sync — epoch-boundary only
         faults.on_tick(step=step, epoch=epoch)
         if self.heartbeat is not None and not faults.heartbeat_frozen(step=step,
                                                                       epoch=epoch):
-            self.heartbeat.beat(step=step, epoch=epoch)
+            self.heartbeat.beat(step=step, epoch=epoch, fingerprint=fingerprint)
 
     def check_preempt(self, *, epoch: int, state, checkpoint: str = "",
                       tele=None, save=None) -> None:

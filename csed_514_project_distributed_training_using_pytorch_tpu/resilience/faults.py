@@ -34,6 +34,28 @@ Kinds (all host-side — faults never touch the compiled program):
     killing it; combined with ``freeze`` it is the full "hung, not dead" replica
     the router's heartbeat-staleness drain exists for.
 
+Grad-poison kinds (the one sanctioned exception to "faults never touch the
+compiled program": corrupting the *math* requires being in the math — the
+injectors are folded into the train step at TRACE time, env-gated, so an
+unarmed build adds zero ops):
+
+``nan``
+    every gradient leaf becomes NaN at exactly ``step=`` — the non-finite
+    divergence the guarded update (``train/step.py`` ``--guard``) must refuse
+    to apply.
+``spike``
+    every gradient leaf is multiplied by ``scale=`` (default 1e6) at exactly
+    ``step=`` — the loss/grad-norm explosion the z-score detector catches.
+``bitflip``
+    ONE element of the gradient leaf whose path contains ``leaf=`` is set to
+    ``scale=`` (default 1e15) at exactly ``step=`` — the silent-data-corruption
+    analog: globally tiny, locally catastrophic.
+
+Unlike the tick kinds (which fire at step/epoch ``>=`` the threshold, on the
+host), poison kinds fire at step ``==`` exactly, inside the compiled program —
+which is what makes a resumed attempt that replays the same step reproduce the
+same poison, and therefore what makes ``--skip-steps`` a complete cure.
+
 The serve path ticks too: a replica worker wires ``on_tick(step=engine.steps)``
 into the engine's per-step hook, so ``step=N`` on the serving side means "after N
 DECODE steps" — kill/preempt/stall a replica mid-decode, deterministically, with
@@ -42,13 +64,18 @@ each replica with ``JAX_PROCESS_ID`` = its replica id via
 ``train.launch.Fleet(process_id_base=...)``).
 
 Trigger keys: ``proc`` (``JAX_PROCESS_ID`` to match; default: every process), ``step`` /
-``epoch`` (tick-path kinds only — fire when the tick's value is >= the threshold;
-unset = immediately; rejected on ``torn``, whose write path has no tick to compare),
+``epoch`` (tick-path kinds: fire when the tick's value is >= the threshold; unset =
+immediately; rejected on ``torn``, whose write path has no tick to compare — poison
+kinds instead REQUIRE ``step`` and fire at exact equality inside the program),
 ``match`` (path substring, ``torn`` only — required there), ``exit`` (``kill``'s exit
-code, default 41), ``secs`` (``stall``'s sleep, default 5),
+code, default 41), ``secs`` (``stall``'s sleep, default 5), ``scale`` (``spike``'s
+multiplier, default 1e6; ``bitflip``'s planted value, default 1e15), ``leaf``
+(``bitflip``'s grad-leaf path substring — required there),
 ``flag`` (a marker-file path: the fault fires at most ONCE per process — the marker is
 created on firing with a per-process suffix, so a restarted run that replays the same
-step does not re-fire; without ``flag`` the fault fires every time the trigger holds).
+step does not re-fire; without ``flag`` the fault fires every time the trigger holds;
+tick-path kinds only — poison kinds re-fire by design, so a replayed step reproduces
+its poison and ``--skip-steps`` is a complete cure).
 
 Everything here is env-gated: with ``RESILIENCE_FAULTS`` unset, ``active()`` is one dict
 lookup and every hook is a no-op — production code paths pay nothing.
@@ -65,21 +92,30 @@ import time
 
 ENV_VAR = "RESILIENCE_FAULTS"
 
-KINDS = ("kill", "preempt", "freeze", "torn", "stall")
+#: Grad-poison kinds: compiled into the train step (exact-step equality), not
+#: applied on the host tick path.
+POISON_KINDS = ("nan", "spike", "bitflip")
+
+KINDS = ("kill", "preempt", "freeze", "torn", "stall") + POISON_KINDS
 DEFAULT_KILL_EXIT = 41
 DEFAULT_STALL_SECS = 5.0
+DEFAULT_SPIKE_SCALE = 1e6
+DEFAULT_BITFLIP_VALUE = 1e15
 
 
 @dataclasses.dataclass(frozen=True)
 class Fault:
     kind: str
     proc: int | None = None     # None: any process
-    step: int | None = None     # fire when tick step >= this
+    step: int | None = None     # tick kinds: fire when step >= this;
+    #                             poison kinds: fire when step == this
     epoch: int | None = None    # fire when tick epoch >= this
     flag: str = ""              # marker file: fire at most once per process
     exit: int = DEFAULT_KILL_EXIT
     match: str = ""             # path substring (torn)
     secs: float = DEFAULT_STALL_SECS   # stall sleep length
+    scale: float = 0.0          # spike multiplier / bitflip planted value
+    leaf: str = ""              # bitflip: grad-leaf path substring to corrupt
 
 
 def active() -> bool:
@@ -103,13 +139,30 @@ def _parse(spec: str) -> tuple[Fault, ...]:
             key, _, value = kv.partition("=")
             if key in ("proc", "step", "epoch", "exit"):
                 kwargs[key] = int(value)
-            elif key == "secs":
+            elif key in ("secs", "scale"):
                 kwargs[key] = float(value)
-            elif key in ("flag", "match"):
+            elif key in ("flag", "match", "leaf"):
                 kwargs[key] = value
             else:
                 raise ValueError(f"unknown fault key {key!r} in {ENV_VAR} spec {part!r}")
+        if kind in POISON_KINDS and "scale" not in kwargs:
+            kwargs["scale"] = (DEFAULT_BITFLIP_VALUE if kind == "bitflip"
+                               else DEFAULT_SPIKE_SCALE)
         fault = Fault(**kwargs)
+        if fault.kind in POISON_KINDS:
+            # Poison fires INSIDE the compiled step at one exact step — the
+            # trigger must be fully data-independent of the host tick path.
+            if fault.step is None:
+                raise ValueError(f"{fault.kind} faults fire at one exact step "
+                                 f"inside the compiled program — add step= to "
+                                 f"{part!r}")
+            if fault.epoch is not None or fault.flag:
+                raise ValueError(f"{fault.kind} faults trigger by exact step "
+                                 f"equality in-program — epoch=/flag= do not "
+                                 f"apply to {part!r}")
+            if fault.kind == "bitflip" and not fault.leaf:
+                raise ValueError(f"bitflip needs a leaf= grad-path substring: "
+                                 f"{part!r}")
         if fault.kind == "torn":
             # Torn faults fire on the WRITE path, which has no tick step/epoch to
             # compare against — a step/epoch key would silently never trigger.
@@ -155,6 +208,17 @@ def _claim_once(f: Fault) -> bool:
         return True
     except FileExistsError:
         return False
+
+
+def grad_poisons() -> tuple[Fault, ...]:
+    """The armed grad-poison faults that match THIS process — the trace-time
+    accessor ``train/step.py`` folds into the compiled step. Empty (and one
+    dict lookup) when injection is unarmed, so the production step traces
+    identical ops."""
+    if not active():
+        return ()
+    return tuple(f for f in get_faults() if f.kind in POISON_KINDS
+                 and (f.proc is None or f.proc == _proc_index()))
 
 
 def on_tick(*, step: int | None = None, epoch: int | None = None) -> None:

@@ -48,15 +48,27 @@ class HeartbeatWriter:
         self.process_index = int(process_index)
         self.path = heartbeat_path(dir_path, self.process_index)
 
-    def beat(self, *, step: int, epoch: int, status: str = STATUS_RUNNING) -> None:
-        _atomic_write_text(self.path, json.dumps({
+    def beat(self, *, step: int, epoch: int, status: str = STATUS_RUNNING,
+             fingerprint: float | None = None) -> None:
+        doc = {
             "process_index": self.process_index,
             "pid": os.getpid(),
             "step": int(step),
             "epoch": int(epoch),
             "status": status,
             "time": time.time(),
-        }))
+        }
+        if fingerprint is not None:
+            # The cross-replica state fingerprint (--guard trainers): a cheap
+            # host-local per-leaf float-sum of the params at this step. Every
+            # process derives it from state that SPMD replication promises is
+            # identical — the supervisor's fingerprint-verify mode compares
+            # beats at the same step, and a mismatch is silent divergence
+            # (SDC, desync): the fleet is torn down and rolled back strictly
+            # past the mismatch step, so the diverged (already-durable)
+            # checkpoint is never resumed as truth.
+            doc["fingerprint"] = float(fingerprint)
+        _atomic_write_text(self.path, json.dumps(doc))
 
 
 def read_heartbeats(dir_path: str) -> dict[int, dict]:
@@ -91,6 +103,26 @@ def stale_processes(dir_path: str, *, num_processes: int, timeout_s: float,
         if now - t > timeout_s:
             stale.append(i)
     return stale
+
+
+def fingerprint_mismatch(dir_path: str) -> dict | None:
+    """Cross-replica state-divergence check over the latest beats: processes
+    reporting a fingerprint AT THE SAME STEP must agree bitwise (the params
+    they fingerprint are replicated by construction). Returns
+    ``{"step": s, "fingerprints": {proc: fp, ...}}`` for the first step where
+    two processes disagree, else None. Beats at different steps are never
+    compared — an epoch-boundary skew between peers is normal pipelining, not
+    divergence."""
+    by_step: dict[int, dict[int, float]] = {}
+    for i, b in read_heartbeats(dir_path).items():
+        if b.get("fingerprint") is None or b.get("step") is None:
+            continue
+        by_step.setdefault(int(b["step"]), {})[i] = float(b["fingerprint"])
+    for step in sorted(by_step):
+        fps = by_step[step]
+        if len(fps) >= 2 and len(set(fps.values())) > 1:
+            return {"step": step, "fingerprints": fps}
+    return None
 
 
 def clear(dir_path: str, process_index: int | None = None) -> None:

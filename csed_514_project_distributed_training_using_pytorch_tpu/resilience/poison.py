@@ -1,0 +1,155 @@
+"""Rollback-and-skip vocabulary: the typed exit, the skip-window grammar, the marker.
+
+The process-failure story (crash/preempt/hang — supervisor.py) restarts a fleet
+from the newest checkpoint and replays forward. A failure of the *math itself*
+— a non-finite loss, a gradient spike, a silently corrupted gradient — needs a
+different recovery shape: the offending *step window* must not be replayed at
+all, because replaying it deterministically reproduces the poison (the data
+order is a pure function of seed+step, which is exactly what makes the skip
+set well-defined). This module owns the pieces of that contract that both
+sides — the jax-side trainers and the jax-free supervisor — must agree on:
+
+- ``EXIT_POISONED`` (65, BSD's ``EX_DATAERR``: "input data was incorrect") —
+  the trainer's typed exit when anomalies exceed its ``--anomaly-exit``
+  policy. Distinct from crash codes and from ``EXIT_PREEMPTED`` (75) so the
+  supervisor classifies without parsing logs.
+- :class:`Poisoned` — the in-process form (the trainers' epoch loops raise
+  it; ``__main__`` converts to ``SystemExit(EXIT_POISONED)``), carrying the
+  step window the run wants skipped on replay.
+- the ``--skip-steps`` grammar ``"a:b[,c:d...]"`` (half-open step windows)
+  with :func:`parse_skip_steps` / :func:`format_skip_steps` as the one
+  parser/printer pair, and :func:`merge_windows` — the supervisor's
+  escalation arithmetic: a window overlapping an already-skipped one means
+  the skip was too narrow, so the union is *widened* by the new window's
+  length; a disjoint window is appended (and the caller escalates to
+  fingerprint-verify mode — scattered poison looks like silent corruption,
+  not a single bad batch).
+- the poison *marker* (``poison.json`` in the versioned checkpoint store):
+  how the dying trainer hands its window to the supervisor. Written by the
+  logging process at the poisoned epoch boundary, consumed (read + removed)
+  by the supervisor when it classifies the exit.
+
+Deliberately jax-free, like the rest of the resilience process surface: the
+supervisor imports this, and the supervisor must never touch the accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+#: Exit status of a trainer that stopped because training-step anomalies
+#: exceeded its ``--anomaly-exit`` policy (EX_DATAERR). The checkpoint store
+#: then holds a health-stamped history and a ``poison.json`` marker naming the
+#: step window to skip on replay.
+EXIT_POISONED = 65
+
+MARKER_NAME = "poison.json"
+
+
+class Poisoned(RuntimeError):
+    """Raised by a trainer at the epoch boundary where its anomaly count
+    crossed ``--anomaly-exit``. Carries the global step the run stopped at and
+    the half-open ``[lo, hi)`` step window its detector blames, so the
+    supervisor can roll back to the newest *healthy* checkpoint and restart
+    with ``--skip-steps lo:hi``."""
+
+    def __init__(self, step: int, window: tuple[int, int]):
+        self.step = int(step)
+        self.window = (int(window[0]), int(window[1]))
+        super().__init__(f"training poisoned at step {step} "
+                         f"(anomaly window {self.window[0]}:{self.window[1]})")
+
+
+def parse_skip_steps(spec: str) -> tuple[tuple[int, int], ...]:
+    """``"a:b[,c:d...]"`` -> sorted tuple of half-open ``(lo, hi)`` windows.
+    Empty spec -> ``()``. Malformed windows raise at parse time — a typo'd
+    skip set must fail the restart loudly, not silently replay the poison."""
+    if not spec:
+        return ()
+    windows = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        lo, sep, hi = part.partition(":")
+        if not sep:
+            raise ValueError(f"skip window {part!r} is not of the form a:b")
+        lo_i, hi_i = int(lo), int(hi)
+        if lo_i < 0 or hi_i <= lo_i:
+            raise ValueError(f"skip window {part!r} must satisfy 0 <= a < b")
+        windows.append((lo_i, hi_i))
+    return tuple(sorted(windows))
+
+
+def format_skip_steps(windows) -> str:
+    """Inverse of :func:`parse_skip_steps` (round-trip pinned in tests)."""
+    return ",".join(f"{lo}:{hi}" for lo, hi in sorted(windows))
+
+
+def merge_windows(existing, new: tuple[int, int]):
+    """Fold a fresh poison window into the accumulated skip set.
+
+    Returns ``(windows, widened)``. Overlap with (or adjacency to) an existing
+    window means the previous skip did not cover the poison — the merged
+    window is the union *extended by the new window's length* (auto-widening:
+    repeated poison at the same site grows the skip geometrically instead of
+    looping forever one step at a time). A disjoint window is appended
+    unchanged; the caller treats that as *scattered* poison and escalates to
+    fingerprint verification."""
+    lo, hi = int(new[0]), int(new[1])
+    merged = []
+    widened = False
+    for (elo, ehi) in existing:
+        if ehi >= lo and elo <= hi:        # overlap or adjacency
+            lo, hi = min(elo, lo), max(ehi, hi)
+            widened = True
+        else:
+            merged.append((elo, ehi))
+    if widened:
+        hi += max(int(new[1]) - int(new[0]), 1)
+    merged.append((lo, hi))
+    return tuple(sorted(merged)), widened
+
+
+def write_marker(store_dir: str, *, window: tuple[int, int], step: int,
+                 anomalies: int) -> str:
+    """Write the poison marker next to the versioned checkpoints (atomic —
+    the heartbeat module's shared jax-free tmp+rename writer). The caller
+    gates to the logging process — this module stays jax-free and cannot
+    ask."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.resilience.heartbeat import (
+        _atomic_write_text,
+    )
+
+    path = os.path.join(store_dir, MARKER_NAME)
+    _atomic_write_text(path, json.dumps({
+        "window": [int(window[0]), int(window[1])],
+        "step": int(step),
+        "anomalies": int(anomalies),
+        "unix_time": time.time(),
+    }))
+    return path
+
+
+def read_marker(store_dir: str, *, consume: bool = True) -> dict | None:
+    """The supervisor's side: read (and by default remove — a marker vouches
+    only for the exit it was written by) the poison marker. None when absent
+    or unreadable (a half-written marker falls back to no-window rollback)."""
+    path = os.path.join(store_dir, MARKER_NAME)
+    try:
+        with open(path) as f:
+            marker = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if consume:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    if (not isinstance(marker.get("window"), list)
+            or len(marker["window"]) != 2):
+        return None
+    marker["window"] = (int(marker["window"][0]), int(marker["window"][1]))
+    return marker

@@ -12,12 +12,24 @@ time-to-train on preemptible fleets a property of *recovery*, not luck:
    no exit code at all;
 3. **classify**: exit 0 → done; ``EXIT_PREEMPTED`` (75) → a cooperative stop with a
    durable checkpoint — *resumable*, returned to the caller without burning a retry
-   (the outer scheduler re-runs when capacity returns); anything else → crash;
-4. **restart** a crashed/hung fleet from the newest *valid* checkpoint
-   (``utils.checkpoint.newest_valid_checkpoint`` — checksum-verified against the
-   manifest, so the torn write the crash itself may have produced is skipped, never
+   (the outer scheduler re-runs when capacity returns); ``EXIT_POISONED`` (65) → the
+   trainer's anomaly guard tripped its ``--anomaly-exit`` policy (the math, not the
+   process, failed); a cross-replica fingerprint mismatch in the heartbeats
+   (fingerprint-verify mode) → "desync"; anything else → crash;
+4. **restart** a crashed/hung fleet from the newest *healthy* checkpoint
+   (``utils.checkpoint.newest_healthy_checkpoint`` — the ONE resume-scan owner:
+   health-stamped-clean preferred over merely-valid, checksums verified against the
+   manifest so the torn write the crash itself may have produced is skipped, never
    loaded), appending ``--resume-from`` to the child command, with bounded retries
-   and exponential backoff.
+   and exponential backoff;
+5. **rollback-and-skip** a poisoned fleet: read the trainer's poison marker
+   (``resilience/poison.py``), fold its step window into the accumulated skip set,
+   and restart with ``--skip-steps a:b[,c:d]`` — the data order is a pure function
+   of seed+step, so the skip set is well-defined and replayable. Repeated poison
+   overlapping an already-skipped window auto-WIDENS the window (the skip was too
+   narrow); poison at scattered steps escalates to fingerprint-verify mode (it
+   looks like silent corruption, not one bad batch), where heartbeat fingerprints
+   are compared across replicas every staleness check.
 
 Restart-from-checkpoint (not in-place recovery) is the whole design: the trainers'
 sharded checkpoints already interchange across process counts and mesh layouts
@@ -41,6 +53,12 @@ import time
 
 from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
     heartbeat as hb,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+    poison as poison_mod,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience.poison import (
+    EXIT_POISONED,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.resilience.preemption import (
     EXIT_PREEMPTED, PreemptionHandler,
@@ -78,6 +96,11 @@ class SupervisorConfig:
     #                                   are finishing an epoch + final checkpoint,
     #                                   which dwarfs the crash-straggler grace
     telemetry: str = ""               # supervisor JSONL (restart events); "" off
+    fingerprint_verify: bool = False  # compare cross-replica heartbeat param
+    #                                   fingerprints (a mismatch at the same step
+    #                                   is "desync" — silent state divergence);
+    #                                   auto-armed when poison lands at scattered
+    #                                   steps, settable up front for paranoia
     poll_s: float = 0.05
 
 
@@ -88,6 +111,8 @@ class SuperviseResult:
     attempts: int
     restarts: int
     resume_history: list              # checkpoint path (or None) each attempt resumed from
+    skip_windows: tuple = ()          # accumulated rollback-and-skip step windows
+    rollbacks: int = 0                # restarts caused by poison/desync (not crashes)
 
 
 # The supervisor's telemetry writer is the shared jax-free JSONL appender —
@@ -98,15 +123,25 @@ class SuperviseResult:
 _JsonlWriter = JsonlWriter
 
 
-def _newest_valid(checkpoint_dir: str) -> str | None:
+def _newest_healthy(checkpoint_dir: str,
+                    before_step: int | None = None) -> str | None:
+    """The ONE resume-scan owner for every supervised restart path: prefers a
+    health-stamped-CLEAN checkpoint over a merely-valid one (the old
+    ``_newest_valid`` trusted the newest decodable checkpoint even if the run
+    that wrote it was already diverging — exactly the state a rollback must
+    not land on; regression-pinned in tests/test_anomaly.py). ``before_step``
+    is the desync bound: a fingerprint mismatch at step S indicts the step-S
+    checkpoint — durable and clean-STAMPED, because per-process anomaly
+    counters cannot see cross-replica divergence — so that rollback must land
+    strictly before it."""
     if not checkpoint_dir:
         return None
     # Lazy: utils.checkpoint imports jax/flax; the supervisor only pays that (import,
     # never backend init) when it actually has a checkpoint store to scan.
     from csed_514_project_distributed_training_using_pytorch_tpu.utils.checkpoint import (
-        newest_valid_checkpoint,
+        newest_healthy_checkpoint,
     )
-    return newest_valid_checkpoint(checkpoint_dir)
+    return newest_healthy_checkpoint(checkpoint_dir, before_step=before_step)
 
 
 def _sleep_interruptible(seconds: float, handler: PreemptionHandler) -> None:
@@ -125,9 +160,23 @@ def supervise(command: list[str], cfg: SupervisorConfig = SupervisorConfig(), *,
     stop at their next epoch boundary and exit 75)."""
     tele = _JsonlWriter(cfg.telemetry) if cfg.telemetry else None
     handler = PreemptionHandler().install()
-    attempts = restarts = 0
+    attempts = restarts = rollbacks = 0
     resume_history: list = []
     status, exit_code = "failed", 1
+    # Accumulated rollback-and-skip set — SEEDED from any --skip-steps the
+    # caller already put on the command (argparse last-occurrence-wins means
+    # the appended flag REPLACES the original: without the seed, the first
+    # poisoned restart would silently drop the user's known-bad windows).
+    skip_windows: tuple = ()
+    for i, arg in enumerate(command):
+        if arg == "--skip-steps" and i + 1 < len(command):
+            skip_windows = poison_mod.parse_skip_steps(command[i + 1])
+        elif arg.startswith("--skip-steps="):
+            skip_windows = poison_mod.parse_skip_steps(
+                arg.split("=", 1)[1])
+    desync_bound: int | None = None       # mismatch step: that checkpoint is
+    #                                       indicted; roll back strictly past it
+    fingerprint_verify = cfg.fingerprint_verify
     scanned_resume: str | None = None     # restart path pre-scans for its log line;
     have_scanned = False                  # the next attempt reuses it (the store
     #                                       cannot change while the fleet is dead)
@@ -135,12 +184,15 @@ def supervise(command: list[str], cfg: SupervisorConfig = SupervisorConfig(), *,
         while True:
             attempts += 1
             resume = (scanned_resume if have_scanned
-                      else _newest_valid(cfg.checkpoint_dir))
+                      else _newest_healthy(cfg.checkpoint_dir))
             have_scanned = False
             resume_history.append(resume)
             cmd = list(command)
             if resume:
                 cmd += ["--resume-from", resume]     # last occurrence wins in argparse
+            if skip_windows:
+                cmd += ["--skip-steps",
+                        poison_mod.format_skip_steps(skip_windows)]
             if cfg.heartbeat_dir:
                 hb.clear(cfg.heartbeat_dir)
                 if "--heartbeat-dir" not in cmd:
@@ -164,7 +216,9 @@ def supervise(command: list[str], cfg: SupervisorConfig = SupervisorConfig(), *,
                         forwarded = True
                     if first_rc is not None:
                         rc = first_rc
-                        reason = "preempted" if rc == EXIT_PREEMPTED else "crash"
+                        reason = ("preempted" if rc == EXIT_PREEMPTED
+                                  else "poisoned" if rc == EXIT_POISONED
+                                  else "crash")
                         if reason == "preempted":
                             # Peers are latched and still finishing their epoch +
                             # final checkpoint; drain before teardown's SIGKILL
@@ -181,19 +235,47 @@ def supervise(command: list[str], cfg: SupervisorConfig = SupervisorConfig(), *,
                         if final_rc is not None:
                             rc = final_rc
                             reason = ("preempted" if rc == EXIT_PREEMPTED
+                                      else "poisoned" if rc == EXIT_POISONED
                                       else "crash")
                         else:
                             reason = "ok"
                         break
-                    if (cfg.heartbeat_timeout_s > 0 and cfg.heartbeat_dir
+                    if (cfg.heartbeat_dir
+                            and (fingerprint_verify
+                                 or cfg.heartbeat_timeout_s > 0)
                             and time.monotonic() >= next_hb_check):
                         next_hb_check = time.monotonic() + hb_interval
-                        stale = hb.stale_processes(
-                            cfg.heartbeat_dir, num_processes=cfg.num_processes,
-                            timeout_s=cfg.heartbeat_timeout_s, since=started_wall)
-                        if stale:
-                            rc, reason = EXIT_TORN_DOWN, "hung"
-                            break
+                        if fingerprint_verify:
+                            # Fingerprint-verify mode: replicas reporting a
+                            # param fingerprint at the SAME step must agree
+                            # bitwise — disagreement is silent state
+                            # divergence (SDC, desync), torn down BEFORE the
+                            # diverged state can be checkpointed as truth.
+                            # Shares the heartbeat throttle; armed even with
+                            # the staleness timeout off.
+                            mismatch = hb.fingerprint_mismatch(
+                                cfg.heartbeat_dir)
+                            if mismatch is not None:
+                                print(f"[supervisor] fingerprint mismatch at "
+                                      f"step {mismatch['step']}: "
+                                      f"{mismatch['fingerprints']}", flush=True)
+                                # The state AT the mismatch step is the
+                                # diverged one — its checkpoint is already
+                                # durable and clean-stamped (per-process
+                                # counters cannot see divergence), so the
+                                # rollback must land strictly before it.
+                                desync_bound = int(mismatch["step"])
+                                rc, reason = EXIT_TORN_DOWN, "desync"
+                                break
+                        if cfg.heartbeat_timeout_s > 0:
+                            stale = hb.stale_processes(
+                                cfg.heartbeat_dir,
+                                num_processes=cfg.num_processes,
+                                timeout_s=cfg.heartbeat_timeout_s,
+                                since=started_wall)
+                            if stale:
+                                rc, reason = EXIT_TORN_DOWN, "hung"
+                                break
                     if (cfg.attempt_timeout_s > 0
                             and time.monotonic() - started_mono > cfg.attempt_timeout_s):
                         rc, reason = EXIT_TORN_DOWN, "timeout"
@@ -204,7 +286,8 @@ def supervise(command: list[str], cfg: SupervisorConfig = SupervisorConfig(), *,
             if reason == "ok":
                 status, exit_code = "ok", 0
                 break
-            if reason == "preempted" or (handler.requested and reason == "crash"):
+            if reason == "preempted" or (handler.requested
+                                         and reason in ("crash", "poisoned")):
                 # A preemption signal can also surface as teardown collateral on
                 # peers; the supervisor's own latch disambiguates.
                 status, exit_code = "preempted", EXIT_PREEMPTED
@@ -215,16 +298,53 @@ def supervise(command: list[str], cfg: SupervisorConfig = SupervisorConfig(), *,
             backoff = (min(cfg.backoff_s * (2 ** restarts), cfg.backoff_max_s)
                        if cfg.backoff_s > 0 else 0.0)
             restarts += 1
-            next_resume = _newest_valid(cfg.checkpoint_dir)
+            escalation = ""
+            if reason in ("poisoned", "desync"):
+                rollbacks += 1
+            if reason == "poisoned":
+                # Rollback-and-skip: fold the dying trainer's poison window
+                # into the skip set. Overlap with an already-skipped window
+                # means the skip was too narrow — auto-widen; a disjoint
+                # window next to an existing set is SCATTERED poison, which
+                # smells like silent corruption, not one bad batch —
+                # escalate to cross-replica fingerprint verification.
+                marker = poison_mod.read_marker(cfg.checkpoint_dir)
+                if marker is not None:
+                    had_windows = bool(skip_windows)
+                    skip_windows, widened = poison_mod.merge_windows(
+                        skip_windows, marker["window"])
+                    if widened:
+                        escalation = "widened skip"
+                    elif had_windows and not fingerprint_verify:
+                        # Scattered poison: escalate to cross-replica state
+                        # verification — which needs the heartbeat channel to
+                        # carry fingerprints. Without one the mode would be a
+                        # silent no-op, so say so instead of claiming it.
+                        if cfg.heartbeat_dir:
+                            fingerprint_verify = True
+                            escalation = "fingerprint-verify armed"
+                        else:
+                            escalation = ("fingerprint-verify unavailable "
+                                          "(no heartbeat dir)")
+            next_resume = _newest_healthy(
+                cfg.checkpoint_dir,
+                before_step=desync_bound if reason == "desync" else None)
+            desync_bound = None
             scanned_resume, have_scanned = next_resume, True
             if tele:
                 tele.emit({"event": "restart", "attempt": attempts,
                            "restart": restarts, "reason": reason, "exit_code": rc,
                            "resume_from": next_resume or "",
+                           "skip":
+                           poison_mod.format_skip_steps(skip_windows),
+                           "rollback": reason in ("poisoned", "desync"),
                            "backoff_s": backoff, "unix_time": time.time()})
             print(f"[supervisor] attempt {attempts} {reason} (exit {rc}); "
                   f"restart {restarts}/{cfg.max_restarts} in {backoff:.1f}s"
-                  + (f" from {next_resume}" if next_resume else " from scratch"),
+                  + (f" from {next_resume}" if next_resume else " from scratch")
+                  + (f" skipping {poison_mod.format_skip_steps(skip_windows)}"
+                     if skip_windows else "")
+                  + (f" [{escalation}]" if escalation else ""),
                   flush=True)
             _sleep_interruptible(backoff, handler)
             if handler.requested:
@@ -235,7 +355,10 @@ def supervise(command: list[str], cfg: SupervisorConfig = SupervisorConfig(), *,
         if tele:
             tele.emit({"event": "supervise_summary", "status": status,
                        "exit_code": exit_code, "attempts": attempts,
-                       "restarts": restarts, "unix_time": time.time()})
+                       "restarts": restarts, "rollbacks": rollbacks,
+                       "skip": poison_mod.format_skip_steps(skip_windows),
+                       "unix_time": time.time()})
             tele.close()
     return SuperviseResult(status=status, exit_code=exit_code, attempts=attempts,
-                           restarts=restarts, resume_history=resume_history)
+                           restarts=restarts, resume_history=resume_history,
+                           skip_windows=skip_windows, rollbacks=rollbacks)

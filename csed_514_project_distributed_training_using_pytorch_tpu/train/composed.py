@@ -67,6 +67,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
 )
 from csed_514_project_distributed_training_using_pytorch_tpu import resilience
 from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
+from csed_514_project_distributed_training_using_pytorch_tpu.train.guard import (
+    GuardRuntime,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     tensor_parallel as tp,
 )
@@ -161,6 +164,11 @@ def main(config: ComposedConfig = ComposedConfig(), *,
     rt = resilience.RunHooks(heartbeat_dir=config.heartbeat_dir,
                              handle_preemption=config.handle_preemption,
                              process_index=info.process_index)
+    # Numerical immune system (--guard): in-step verdict + identity update;
+    # host side is epoch-boundary bookkeeping only.
+    grt = GuardRuntime(config, tele=tele,
+                       store_dir=os.path.join(config.results_dir, "checkpoints")
+                       if config.results_dir else "")
     data_size = mesh.shape.get("data", 1)
     seq_size = mesh.shape.get("seq", 1)
     model_size = mesh.shape.get("model", 1)
@@ -349,7 +357,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                                      weight_decay=config.weight_decay)
     base_state = create_train_state(model, jax.random.PRNGKey(config.seed),
                                     optimizer=optimizer,
-                                    ema=config.ema_decay > 0)
+                                    ema=config.ema_decay > 0,
+                                    guard=config.guard)
     lr_schedule = optim.make_lr_schedule(config.lr_schedule,
                                          warmup_steps=config.warmup_steps,
                                          total_steps=config.epochs * steps_per_epoch)
@@ -366,6 +375,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(base_state.step)} "
               f"(starting epoch {start_epoch})")
+    grt.baseline(base_state)    # this attempt's anomaly-counter zero point
     # Whole epochs run as ONE compiled scan under the composed shardings (same program
     # structure as train/distributed.py): per-step Python dispatch — an index-plan
     # upload, an on-device gather, a reshard, a step call — dominates at this model
@@ -390,7 +400,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                                                          to_stacked),
                                    base_state.step,
                                    to_stacked(base_state.ema)
-                                   if base_state.ema is not None else None)
+                                   if base_state.ema is not None else None,
+                                   base_state.guard)   # scalars pass through
         state_sh = pipeline.stacked_state_shardings(mesh, stacked_state)
         state = jax.device_put(stacked_state, state_sh)
         idx_sh = (jax.sharding.NamedSharding(mesh, P(None, "data"))
@@ -403,7 +414,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                           clip_grad_norm=config.clip_grad_norm,
                           ema_decay=config.ema_decay,
                           label_smoothing=config.label_smoothing,
-                          health=config.health_stats),
+                          health=config.health_stats, guard=grt.spec),
             in_shardings=(state_sh, rep, rep, idx_sh, rep),
             out_shardings=(state_sh, rep), donate_argnums=(0,))
         param_shardings = state_sh.params
@@ -421,7 +432,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                                    clip_grad_norm=config.clip_grad_norm,
                                    ema_decay=config.ema_decay,
                                    label_smoothing=config.label_smoothing,
-                                   health=config.health_stats)
+                                   health=config.health_stats, guard=grt.spec)
         if config.fsdp:
             # ZeRO x TP hybrid (r5): params + optimizer state shard over BOTH the
             # data axis (largest free dim) and the Megatron model axis — memory
@@ -476,7 +487,8 @@ def main(config: ComposedConfig = ComposedConfig(), *,
                 optim.map_param_trees(host_state.velocity, unstack),
                 host_state.step,
                 unstack(host_state.ema)
-                if host_state.ema is not None else None)
+                if host_state.ema is not None else None,
+                host_state.guard)      # scalars pass through the bridge
         return host_state
 
     ckpt_path = (os.path.join(config.results_dir, "model_composed.ckpt")
@@ -508,7 +520,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x,
             test_y, dropout_rng, plan_spec, n_train, n_test, steps_per_epoch,
             start_epoch, history, watch, saver, ckpt_path, to_host_standard,
-            tele, compile_s, flops_per_step, rt)
+            tele, compile_s, flops_per_step, rt, grt)
     finally:
         # Drain the write-behind queue even on an exception/signal/preemption
         # mid-run — the queued per-epoch checkpoint is the resume artifact a killed
@@ -528,7 +540,7 @@ def main(config: ComposedConfig = ComposedConfig(), *,
 def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x,
                 test_y, dropout_rng, plan_spec, n_train, n_test, steps_per_epoch,
                 start_epoch, history, watch, saver, ckpt_path, to_host_standard,
-                tele, compile_s, flops_per_step, rt):
+                tele, compile_s, flops_per_step, rt, grt=None):
     """The composed trainer's epoch loop, split out so the caller can guarantee the
     async-checkpoint flush in a ``finally`` regardless of where the loop fails."""
     host_state = None
@@ -537,7 +549,10 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x
                   if config.results_dir else "")
     with maybe_profile(config.profile, config.profile_dir):
         for epoch in range(start_epoch, config.epochs):
-            rt.epoch_tick(state, epoch)     # heartbeat + armed faults; no-op off
+            # heartbeat (with the previous boundary's param fingerprint)
+            # + armed faults; no-op off
+            rt.epoch_tick(state, epoch,
+                          fingerprint=grt.fingerprint if grt else None)
             t_epoch = time.perf_counter()
             # (seed, epoch)-keyed permutation — a pure function, so a resumed run
             # replays exactly the epochs it missed (same contract as
@@ -586,6 +601,10 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x
                 if epoch_health is not None:
                     tele.emit(T.health_event(epoch, health_host, steps_per_epoch,
                                              param_norm=param_norm))
+            # Guard boundary: anomaly verdict fetch + event + cross-replica
+            # fingerprint, then the manifest health stamp for the save.
+            stamp = (grt.epoch_end(state, epoch, steps_per_epoch)
+                     if grt else None)
             # Per-epoch full-state checkpoint (standard layout, process-0 gated,
             # atomic) so a killed run resumes with --resume-from on ANY mesh. The
             # final epoch's host copy doubles as the return value — no second
@@ -601,10 +620,14 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x
                 saver.save_train_state(ckpt_path, host_state)
                 if ckpt_store and config.keep_checkpoints:
                     # Versioned store (manifest + checksums + keep-last-N GC) for
-                    # the supervisor's newest-VALID resume scan.
+                    # the supervisor's newest-HEALTHY resume scan.
                     checkpoint.save_versioned(ckpt_store, host_state,
                                               keep=config.keep_checkpoints,
-                                              tele=tele)
+                                              tele=tele, health=stamp)
+            # Anomaly policy AFTER the stamped checkpoint is durable (raises
+            # Poisoned; __main__ exits 65).
+            if grt:
+                grt.check_poisoned(state)
             # Cooperative preemption at the epoch boundary, with this epoch's
             # checkpoint durable (raises Preempted; __main__ exits 75).
             rt.check_preempt(epoch=epoch, state=state, checkpoint=ckpt_path,
@@ -626,3 +649,9 @@ if __name__ == "__main__":
         M.log(f"preempted at step {e.step} (checkpoint {e.checkpoint or 'n/a'}); "
               f"exiting {resilience.EXIT_PREEMPTED} — resume with --resume-from")
         raise SystemExit(resilience.EXIT_PREEMPTED)
+    except resilience.Poisoned as e:
+        M.log(f"poisoned at step {e.step} (anomaly window "
+              f"{e.window[0]}:{e.window[1]}); exiting "
+              f"{resilience.EXIT_POISONED} — the supervisor rolls back to the "
+              f"newest healthy checkpoint and skips the window")
+        raise SystemExit(resilience.EXIT_POISONED)

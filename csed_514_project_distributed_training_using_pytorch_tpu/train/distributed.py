@@ -56,6 +56,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.parallel.sampler im
     ShardedSampler,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu import resilience
+from csed_514_project_distributed_training_using_pytorch_tpu.train.guard import (
+    GuardRuntime,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
     TrainState, create_train_state, make_epoch_fn, make_eval_fn,
 )
@@ -135,6 +138,10 @@ def main(config: DistributedConfig = DistributedConfig(), *,
     rt = resilience.RunHooks(heartbeat_dir=config.heartbeat_dir,
                              handle_preemption=config.handle_preemption,
                              process_index=info.process_index)
+    # Numerical immune system (--guard): in-step anomaly verdict + guarded
+    # identity update; host side is epoch-boundary bookkeeping only.
+    grt = GuardRuntime(config, tele=tele,
+                       store_dir=os.path.join(config.results_dir, "checkpoints"))
     world = mesh.shape["data"]                    # ≙ world_size, :131 — but discovered
     if config.global_batch_size % world:
         raise ValueError(f"global batch {config.global_batch_size} not divisible by "
@@ -172,7 +179,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                                      momentum=config.momentum,
                                      weight_decay=config.weight_decay)
     state = create_train_state(model, init_rng, optimizer=optimizer,
-                               ema=config.ema_decay > 0)
+                               ema=config.ema_decay > 0, guard=config.guard)
     steps_per_epoch = samplers[0].num_samples // per_replica_batch
     lr_schedule = optim.make_lr_schedule(config.lr_schedule,
                                          warmup_steps=config.warmup_steps,
@@ -187,6 +194,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
             M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(state.step)} "
               f"(starting epoch {start_epoch})")
+    grt.baseline(state)     # this attempt's anomaly-counter zero point
     if config.fsdp:
         # ZeRO/FSDP mode (r5): params + SGD/AdamW state shard over the data axis;
         # XLA inserts the per-use all-gathers and gradient reduce-scatters from
@@ -219,7 +227,7 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                                clip_grad_norm=config.clip_grad_norm,
                                ema_decay=config.ema_decay,
                                label_smoothing=config.label_smoothing,
-                               health=health)
+                               health=health, guard=grt.spec)
     if config.fsdp:
         epoch_fn = fsdp.compile_epoch_fsdp(epoch_body, mesh)
     else:
@@ -259,7 +267,8 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                                     optimizer=optimizer, lr_schedule=lr_schedule,
                                     clip_grad_norm=config.clip_grad_norm,
                                     ema_decay=config.ema_decay,
-                                    label_smoothing=config.label_smoothing)
+                                    label_smoothing=config.label_smoothing,
+                                    guard=grt.spec)
         step_fn = (fsdp.compile_step_fsdp(step_body, mesh) if config.fsdp
                    else dp.compile_step(step_body, mesh))
         col_lo, col_hi = _host_local_columns(mesh, per_replica_batch)
@@ -302,7 +311,9 @@ def main(config: DistributedConfig = DistributedConfig(), *,
         with maybe_profile(config.profile, config.profile_dir):
             best_step_s = None
             for epoch in range(start_epoch, config.epochs):   # ≙ the epoch loop, :70
-                rt.epoch_tick(state, epoch)       # heartbeat + armed faults; no-op off
+                # heartbeat (with the previous boundary's param fingerprint)
+                # + armed faults; no-op off
+                rt.epoch_tick(state, epoch, fingerprint=grt.fingerprint)
                 t_epoch = time.perf_counter()
                 plan = epoch_index_plan(samplers, epoch, per_replica_batch)  # ≙ set_epoch, :72
                 data_s = time.perf_counter() - t_epoch
@@ -357,6 +368,11 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                     if health:
                         tele.emit(T.health_event(epoch, health_host, steps,
                                                  param_norm=param_norm))
+                # Guard boundary: fetch the anomaly verdict, emit the anomaly
+                # event, compute the cross-replica fingerprint (host-local by
+                # design — a global reduction would hand every process the
+                # same scalar), and build the manifest health stamp.
+                stamp = grt.epoch_end(state, epoch, steps=int(losses.shape[0]))
                 # Per-epoch full-state checkpoint (process-0 gated, atomic) so a killed run
                 # can resume with --resume-from; the reference only ever saves final params.
                 # Device-resident gathered state: the saver is process-0 gated and
@@ -365,10 +381,14 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                 saver.save_train_state(ckpt_path, ck_state)
                 if config.keep_checkpoints:
                     # Versioned store (manifest + checksums + keep-last-N GC): what
-                    # the fleet supervisor's newest-VALID resume scan reads.
+                    # the fleet supervisor's newest-HEALTHY resume scan reads.
                     checkpoint.save_versioned(ckpt_store, ck_state,
                                               keep=config.keep_checkpoints,
-                                              tele=tele)
+                                              tele=tele, health=stamp)
+                # Anomaly policy AFTER the (stamped) checkpoint is durable: the
+                # supervisor rolls back to the newest CLEAN stamp and restarts
+                # with --skip-steps (raises Poisoned; __main__ exits 65).
+                grt.check_poisoned(state)
                 # Cooperative preemption: honor a pending SIGTERM now, with this
                 # epoch's checkpoint durable (raises Preempted; __main__ exits 75).
                 rt.check_preempt(epoch=epoch, state=state, checkpoint=ckpt_path,
@@ -411,3 +431,9 @@ if __name__ == "__main__":
         M.log(f"preempted at step {e.step} (checkpoint {e.checkpoint or 'n/a'}); "
               f"exiting {resilience.EXIT_PREEMPTED} — resume with --resume-from")
         raise SystemExit(resilience.EXIT_PREEMPTED)
+    except resilience.Poisoned as e:
+        M.log(f"poisoned at step {e.step} (anomaly window "
+              f"{e.window[0]}:{e.window[1]}); exiting "
+              f"{resilience.EXIT_POISONED} — the supervisor rolls back to the "
+              f"newest healthy checkpoint and skips the window")
+        raise SystemExit(resilience.EXIT_POISONED)

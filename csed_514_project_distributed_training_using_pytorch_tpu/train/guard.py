@@ -1,0 +1,129 @@
+"""Host-side wiring of the numerical immune system — shared by all four trainers.
+
+The guard itself lives inside the compiled step (``train/step.py``: the anomaly
+verdict and the identity update are in-program, zero extra host syncs). What is
+left for the host is epoch-boundary bookkeeping, identical across trainers and
+owned here so the four loops stay four-line diffs:
+
+- fetch the :class:`~..train.step.GuardState` carry ONCE per epoch (with the
+  losses — the sanctioned fetch), emit the ``anomaly`` telemetry event;
+- compute the cross-replica param fingerprint (host-LOCAL over this
+  process's addressable shards — a global reduction would all-reduce the
+  corruption into every replica's value) and hand it to the heartbeat via
+  ``RunHooks.epoch_tick``;
+- build the health stamp for ``save_versioned(health=)`` — ``clean`` meaning
+  no anomaly was detected since the previous versioned save, which is what
+  ``newest_healthy_checkpoint`` rolls back to;
+- enforce the ``--anomaly-exit`` policy: once the attempt has detected that
+  many anomalies, raise :class:`~..resilience.poison.Poisoned` (AFTER the
+  epoch's stamped checkpoint is durable) with the step window to skip, and
+  leave the poison marker for the supervisor.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+    poison,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+    GuardSpec,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+    metrics as M,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+    telemetry as T,
+)
+
+
+class GuardRuntime:
+    """One trainer run's guard bookkeeping. Construct unconditionally —
+    every method is a cheap attribute check when ``--guard`` is off, so the
+    flag-off trainer performs identical host and device work (the RunHooks
+    discipline)."""
+
+    def __init__(self, config, *, tele=None, store_dir: str = ""):
+        self.enabled = bool(getattr(config, "guard", False))
+        self.spec = None
+        if self.enabled:
+            self.spec = GuardSpec(
+                zscore=config.guard_zscore,
+                skip=poison.parse_skip_steps(config.skip_steps))
+        self.anomaly_exit = int(getattr(config, "anomaly_exit", 0))
+        self.skip_str = getattr(config, "skip_steps", "")
+        self.tele = tele
+        self.store_dir = store_dir
+        self.fingerprint: float | None = None   # latest epoch-boundary value
+        self.last = None                        # latest host GuardState
+        self._base_anoms = 0                    # attempt-start anomaly counter
+        self._base_first = -1                   # attempt-start first-anomaly step
+        self._prev_anoms = 0                    # previous SAVE's counter (stamp)
+        self._attempt_lo: int | None = None     # first NEW anomaly's lower bound
+
+    def baseline(self, state) -> None:
+        """Call once after (a possible) resume: the restored checkpoint's
+        counters are this attempt's zero point — a rolled-back run must not be
+        poisoned by the history its clean checkpoint already absorbed."""
+        if not self.enabled:
+            return
+        gh = jax.device_get(state.guard)
+        self._base_anoms = self._prev_anoms = int(gh.anomalies)
+        self._base_first = int(gh.first_anomaly_step)
+
+    def epoch_end(self, state, epoch: int, steps: int) -> dict | None:
+        """The per-epoch boundary: fetch the carry, emit telemetry, compute
+        the fingerprint. Returns the health stamp for ``save_versioned`` (None
+        when the guard is off — legacy unstamped manifest entries)."""
+        if not self.enabled:
+            return None
+        gh = jax.device_get(state.guard)
+        self.last = gh
+        self.fingerprint = T.param_fingerprint(state.params)
+        if self.tele is not None and self.tele.enabled:
+            self.tele.emit(T.anomaly_event(epoch, gh, steps,
+                                           fingerprint=self.fingerprint,
+                                           skip=self.skip_str))
+        anoms = int(gh.anomalies)
+        if anoms > self._prev_anoms and self._attempt_lo is None:
+            # First epoch of THIS attempt with a fresh anomaly: pin the skip
+            # window's lower bound. first_anomaly_step is exact when it was
+            # set this attempt; a stale value (carried by a clean checkpoint
+            # from already-skipped history) falls back to the epoch's start
+            # step — a slightly wider window, never a hole.
+            first = int(gh.first_anomaly_step)
+            if first >= 0 and first != self._base_first:
+                self._attempt_lo = first
+            else:
+                self._attempt_lo = max(int(state.step) - int(steps), 0)
+        stamp = {"clean": anoms == self._prev_anoms, "anomalies": anoms,
+                 "skipped": int(gh.skipped), "step": int(state.step),
+                 "fingerprint": self.fingerprint}
+        self._prev_anoms = anoms
+        return stamp
+
+    def check_poisoned(self, state) -> None:
+        """Enforce ``--anomaly-exit`` at the epoch boundary, AFTER this
+        epoch's (unclean-stamped) checkpoint is durable: write the poison
+        marker naming the anomaly step window and raise :class:`Poisoned`
+        (``__main__`` converts to ``SystemExit(EXIT_POISONED)``). The window
+        spans this ATTEMPT's anomalies: exact when ``first_anomaly_step`` was
+        set this attempt, bounded by the first offending epoch's start step
+        when a clean checkpoint carried older (already-skipped) history — a
+        wider window is safe (the oracle uses the same skip set), a hole
+        would re-poison the replay."""
+        if not self.enabled or not self.anomaly_exit or self.last is None:
+            return
+        gh = self.last
+        if int(gh.anomalies) - self._base_anoms < self.anomaly_exit:
+            return
+        last = int(gh.last_anomaly_step)
+        first = last if self._attempt_lo is None else min(self._attempt_lo,
+                                                          last)
+        window = (first, last + 1)
+        if self.store_dir and M.is_logging_process():
+            poison.write_marker(self.store_dir, window=window,
+                                step=int(state.step),
+                                anomalies=int(gh.anomalies))
+        raise poison.Poisoned(int(state.step), window)

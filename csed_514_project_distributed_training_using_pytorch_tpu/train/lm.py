@@ -35,6 +35,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.models import (
 )
 from csed_514_project_distributed_training_using_pytorch_tpu import resilience
 from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
+from csed_514_project_distributed_training_using_pytorch_tpu.train.guard import (
+    GuardRuntime,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
     data_parallel as dp,
 )
@@ -211,6 +214,11 @@ def main(config: LMConfig = LMConfig(), *,
     rt = resilience.RunHooks(heartbeat_dir=config.heartbeat_dir,
                              handle_preemption=config.handle_preemption,
                              process_index=info.process_index)
+    # Numerical immune system (--guard): in-step verdict + identity update;
+    # host side is epoch-boundary bookkeeping only.
+    grt = GuardRuntime(config, tele=tele,
+                       store_dir=os.path.join(config.results_dir, "checkpoints")
+                       if config.results_dir else "")
 
     optimizer = optim.make_optimizer(config.optimizer,
                                      learning_rate=config.learning_rate,
@@ -218,7 +226,8 @@ def main(config: LMConfig = LMConfig(), *,
                                      weight_decay=config.weight_decay)
     state = create_train_state(model, jax.random.PRNGKey(config.seed),
                                sample_input_shape=(1, seq_len),
-                               optimizer=optimizer, ema=config.ema_decay > 0)
+                               optimizer=optimizer, ema=config.ema_decay > 0,
+                               guard=config.guard)
     steps_per_epoch = n_train // config.batch_size
     if steps_per_epoch == 0:
         raise ValueError(f"batch {config.batch_size} larger than the train split "
@@ -236,6 +245,7 @@ def main(config: LMConfig = LMConfig(), *,
             M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(state.step)} "
               f"(starting epoch {start_epoch})")
+    grt.baseline(state)     # this attempt's anomaly-counter zero point
     if model_size > 1:
         # Megatron TP (r5): column/row kernels shard over `model` (the LM blocks
         # reuse TransformerBlock's leaf names, so the classifier's partition rules
@@ -269,7 +279,7 @@ def main(config: LMConfig = LMConfig(), *,
                               optimizer=optimizer, lr_schedule=lr_schedule,
                               clip_grad_norm=config.clip_grad_norm,
                               ema_decay=config.ema_decay, loss_fn=lm_loss,
-                              with_metrics=health)
+                              with_metrics=health, guard=grt.spec)
     epoch_fn = compile_lm_epoch(make_epoch_from_step(step_fn, health=health))
     eval_fn = jax.jit(make_eval_nll_fn(model, batch_size=config.eval_batch))
 
@@ -312,7 +322,7 @@ def main(config: LMConfig = LMConfig(), *,
                             zeros_d, test_d, dropout_rng, n_train, n_test, seq_len,
                             steps_per_epoch, start_epoch, history, watch, saver,
                             ckpt_path, gather, tele, compile_s, flops_per_step,
-                            rt, bytes_per_step)
+                            rt, bytes_per_step, grt)
     finally:
         # Drain the write-behind queue even on an exception/signal/preemption
         # mid-run — the queued per-epoch checkpoint is the resume artifact a killed
@@ -362,14 +372,17 @@ def main(config: LMConfig = LMConfig(), *,
 def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_d,
                 dropout_rng, n_train, n_test, seq_len, steps_per_epoch, start_epoch,
                 history, watch, saver, ckpt_path, gather, tele, compile_s,
-                flops_per_step, rt, bytes_per_step=None):
+                flops_per_step, rt, bytes_per_step=None, grt=None):
     """The LM trainer's epoch loop, split out so the caller can guarantee the
     async-checkpoint flush in a ``finally`` regardless of where the loop fails."""
     best_step_s = None
     ckpt_store = (os.path.join(config.results_dir, "checkpoints")
                   if config.results_dir else "")
     for epoch in range(start_epoch, config.epochs):
-        rt.epoch_tick(state, epoch)         # heartbeat + armed faults; no-op off
+        # heartbeat (with the previous boundary's param fingerprint) + armed
+        # faults; no-op off
+        rt.epoch_tick(state, epoch,
+                      fingerprint=grt.fingerprint if grt else None)
         t_epoch = time.perf_counter()
         # (seed, epoch)-keyed permutation — the parallel/sampler contract, so resumed
         # runs replay exactly the epochs they missed.
@@ -416,6 +429,9 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_
             if epoch_health is not None:
                 tele.emit(T.health_event(epoch, health_host, steps_per_epoch,
                                          param_norm=param_norm))
+        # Guard boundary: anomaly verdict fetch + event + cross-replica
+        # fingerprint, then the manifest health stamp for the versioned save.
+        stamp = grt.epoch_end(state, epoch, steps_per_epoch) if grt else None
         if ckpt_path:
             # Device-resident gathered state: the saver is process-0 gated and
             # device_gets internally — non-0 processes must not pay a host fetch.
@@ -423,9 +439,14 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_
             saver.save_train_state(ckpt_path, ck_state)
             if ckpt_store and config.keep_checkpoints:
                 # Versioned store (manifest + checksums + keep-last-N GC) for the
-                # supervisor's newest-VALID resume scan.
+                # supervisor's newest-HEALTHY resume scan.
                 checkpoint.save_versioned(ckpt_store, ck_state,
-                                          keep=config.keep_checkpoints, tele=tele)
+                                          keep=config.keep_checkpoints, tele=tele,
+                                          health=stamp)
+        # Anomaly policy AFTER the stamped checkpoint is durable (raises
+        # Poisoned; __main__ exits 65).
+        if grt:
+            grt.check_poisoned(state)
         # Cooperative preemption at the epoch boundary, with this epoch's
         # checkpoint durable (raises Preempted; __main__ exits 75).
         rt.check_preempt(epoch=epoch, state=state, checkpoint=ckpt_path, tele=tele)
@@ -444,3 +465,9 @@ if __name__ == "__main__":
         M.log(f"preempted at step {e.step} (checkpoint {e.checkpoint or 'n/a'}); "
               f"exiting {resilience.EXIT_PREEMPTED} — resume with --resume-from")
         raise SystemExit(resilience.EXIT_PREEMPTED)
+    except resilience.Poisoned as e:
+        M.log(f"poisoned at step {e.step} (anomaly window "
+              f"{e.window[0]}:{e.window[1]}); exiting "
+              f"{resilience.EXIT_POISONED} — the supervisor rolls back to the "
+              f"newest healthy checkpoint and skips the window")
+        raise SystemExit(resilience.EXIT_POISONED)

@@ -36,6 +36,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.models import (
 )
 from csed_514_project_distributed_training_using_pytorch_tpu import resilience
 from csed_514_project_distributed_training_using_pytorch_tpu.ops import optim
+from csed_514_project_distributed_training_using_pytorch_tpu.train.guard import (
+    GuardRuntime,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
     TrainState, create_train_state, init_health, make_epoch_fn, make_eval_fn,
     make_train_step, merge_health, update_health,
@@ -87,6 +90,10 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
     # fetch or syscall is added — same zero-cost discipline as --health-stats).
     rt = resilience.RunHooks(heartbeat_dir=config.heartbeat_dir,
                              handle_preemption=config.handle_preemption)
+    # Numerical immune system (--guard): in-step verdict + identity update;
+    # host side is epoch-boundary bookkeeping only.
+    grt = GuardRuntime(config, tele=tele,
+                       store_dir=os.path.join(config.results_dir, "checkpoints"))
     if config.download_data and datasets is None:
         download_mnist(config.data_dir)   # ≙ torchvision download=True, src/train.py:26-31
     train_ds, test_ds = datasets if datasets is not None else load_mnist(config.data_dir)
@@ -116,7 +123,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         raise ValueError("--use-pallas-kernels fuses the SGD-momentum update — it "
                          "requires --optimizer sgd")
     state = create_train_state(model, init_rng, optimizer=optimizer,
-                               ema=config.ema_decay > 0)
+                               ema=config.ema_decay > 0, guard=config.guard)
     resume_from = resume_from or config.resume_from or None
     if resume_from:                             # the restore path the reference lacks
         t_restore = time.perf_counter()
@@ -127,6 +134,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                 nbytes=os.path.getsize(resume_from),
                 wall_s=time.perf_counter() - t_restore, step=int(state.step)))
         M.log(f"Resumed from {resume_from} at step {int(state.step)}")
+    grt.baseline(state)     # this attempt's anomaly-counter zero point
     # Schedule horizon = THIS invocation's planned end: the restored step plus
     # n_epochs of updates (single-trainer resume means "train n_epochs MORE", unlike
     # the distributed/composed trainers' skip-completed-epochs semantics). Anchoring
@@ -158,7 +166,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                       clip_grad_norm=config.clip_grad_norm,
                       ema_decay=config.ema_decay,
                       label_smoothing=config.label_smoothing,
-                      health=health),
+                      health=health, guard=grt.spec),
         donate_argnums=(0,))
     step_fn = jax.jit(
         make_train_step(model, learning_rate=config.learning_rate,
@@ -169,7 +177,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                         clip_grad_norm=config.clip_grad_norm,
                         ema_decay=config.ema_decay,
                         label_smoothing=config.label_smoothing,
-                        with_metrics=health),
+                        with_metrics=health, guard=grt.spec),
         donate_argnums=(0,))
     # The final partial batch (drop_last=False) is ragged and need not divide by
     # grad_accum; accumulation is a memory knob, so the tail just steps unaccumulated.
@@ -184,7 +192,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                             clip_grad_norm=config.clip_grad_norm,
                             ema_decay=config.ema_decay,
                             label_smoothing=config.label_smoothing,
-                            with_metrics=health),
+                            with_metrics=health, guard=grt.spec),
             donate_argnums=(0,))
     eval_fn = jax.jit(make_eval_fn(model, batch_size=config.batch_size_test))
 
@@ -342,7 +350,9 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                 evaluate(state, 0)              # baseline eval, ≙ src/train.py:106
             best_step_s = None
             for epoch in range(1, config.n_epochs + 1):
-                rt.epoch_tick(state, epoch)     # heartbeat + armed faults; no-op off
+                # heartbeat (with the previous boundary's param fingerprint)
+                # + armed faults; no-op off
+                rt.epoch_tick(state, epoch, fingerprint=grt.fingerprint)
                 step_before = int(state.step)
                 t_epoch = time.perf_counter()
                 with annotate(f"train_epoch_{epoch}"):
@@ -376,12 +386,19 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                     if epoch_health is not None:
                         tele.emit(T.health_event(epoch, health_host, steps,
                                                  param_norm=param_norm))
+                # Guard boundary: anomaly verdict fetch + event + fingerprint,
+                # then the manifest health stamp for the versioned save.
+                stamp = grt.epoch_end(state, epoch,
+                                      steps=int(state.step) - step_before)
                 if config.keep_checkpoints:
                     # Versioned store (manifest + checksums + keep-last-N GC) for
-                    # the supervisor's newest-VALID resume scan.
+                    # the supervisor's newest-HEALTHY resume scan.
                     checkpoint.save_versioned(ckpt_store, state,
                                               keep=config.keep_checkpoints,
-                                              tele=tele)
+                                              tele=tele, health=stamp)
+                # Anomaly policy AFTER the stamped checkpoint is durable
+                # (raises Poisoned; __main__ exits 65).
+                grt.check_poisoned(state)
                 # Cooperative preemption at the epoch boundary. The per-tick
                 # overwrite checkpoint lags the tail batch, so save explicitly
                 # before raising (raises Preempted; __main__ exits 75).
@@ -413,3 +430,9 @@ if __name__ == "__main__":
         M.log(f"preempted at step {e.step} (checkpoint {e.checkpoint or 'n/a'}); "
               f"exiting {resilience.EXIT_PREEMPTED} — resume with --resume-from")
         raise SystemExit(resilience.EXIT_PREEMPTED)
+    except resilience.Poisoned as e:
+        M.log(f"poisoned at step {e.step} (anomaly window "
+              f"{e.window[0]}:{e.window[1]}); exiting "
+              f"{resilience.EXIT_POISONED} — the supervisor rolls back to the "
+              f"newest healthy checkpoint and skips the window")
+        raise SystemExit(resilience.EXIT_POISONED)

@@ -85,6 +85,98 @@ def merge_health(a: HealthStats, b: HealthStats) -> HealthStats:
                        jnp.maximum(a.grad_norm_max, b.grad_norm_max))
 
 
+class GuardSpec(NamedTuple):
+    """Static knobs of the numerical guard (``--guard``): the anomaly verdict
+    computed INSIDE the compiled step and the replay windows to skip.
+
+    ``zscore``/``rel_floor`` parameterize the spike detector: a step whose
+    pre-clip global grad norm exceeds ``ema_mean + zscore * max(ema_std,
+    rel_floor * ema_mean)`` is a spike (the floor keeps a near-zero-variance
+    warm stream from tripping on ordinary jitter). ``warmup_steps`` clean
+    steps must be observed before the z-test arms — non-finite detection is
+    always armed. ``ema_decay`` is the detector's window. ``skip`` is the
+    static tuple of half-open ``(lo, hi)`` step windows a supervised restart
+    replays as identity updates (``--skip-steps``; baked at trace time — each
+    restart is a fresh process and compiles anyway)."""
+
+    zscore: float = 8.0
+    warmup_steps: int = 4
+    ema_decay: float = 0.9
+    rel_floor: float = 0.5
+    skip: tuple = ()
+
+
+class GuardState(NamedTuple):
+    """The guard's scan-carry accumulators — nine scalars riding the
+    ``TrainState`` pytree (an optional field, like ``ema``: absent = zero
+    cost, and guard-off checkpoints stay byte-identical). Checkpointing the
+    detector state is deliberate: a rollback resumes with the EMA it had at
+    the healthy point, so the z-test re-arms exactly where the oracle's
+    would — the bitwise-replay contract extends to the guard itself."""
+
+    ema_mean: jax.Array            # EMA of clean pre-clip grad norms
+    ema_sq: jax.Array              # EMA of their squares (variance source)
+    count: jax.Array               # clean steps folded into the EMA (i32)
+    anomalies: jax.Array           # detected anomalies (nonfinite + spikes)
+    nonfinite: jax.Array           # non-finite loss/grad verdicts
+    spikes: jax.Array              # z-score verdicts
+    skipped: jax.Array             # identity updates applied (anomaly + window)
+    first_anomaly_step: jax.Array  # -1 until the first anomaly
+    last_anomaly_step: jax.Array   # -1 until the first anomaly
+
+
+def init_guard() -> GuardState:
+    # One fresh array per field: the state is donated into the compiled step,
+    # and aliased leaves would be the same buffer donated twice.
+    f0 = lambda: jnp.zeros((), jnp.float32)
+    i0 = lambda: jnp.zeros((), jnp.int32)
+    none = lambda: jnp.asarray(-1, jnp.int32)
+    return GuardState(f0(), f0(), i0(), i0(), i0(), i0(), i0(), none(), none())
+
+
+def _grad_poison_fn():
+    """Trace-time fold of any armed grad-poison faults (``resilience/faults.py``
+    ``nan``/``spike``/``bitflip``) into the step: returns ``None`` (zero added
+    ops — the flag-off bitwise pin) unless ``RESILIENCE_FAULTS`` arms a poison
+    matching this process. Poison fires at EXACT step equality, so a resumed
+    attempt replaying the step reproduces it — determinism is what makes the
+    skip set a complete cure."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+        faults,
+    )
+
+    specs = faults.grad_poisons()
+    if not specs:
+        return None
+
+    def poison(grads, step):
+        for f in specs:
+            hit = step == f.step
+            if f.kind == "nan":
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(hit, jnp.full_like(g, jnp.nan), g),
+                    grads)
+            elif f.kind == "spike":
+                scale = jnp.asarray(f.scale, jnp.float32)
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(hit, (g.astype(jnp.float32)
+                                              * scale).astype(g.dtype), g),
+                    grads)
+            else:                          # bitflip: one element of one leaf
+                def flip(path, g, f=f):
+                    if f.leaf not in jax.tree_util.keystr(path):
+                        return g
+                    flat = g.reshape(-1)
+                    planted = jnp.where(hit, jnp.asarray(f.scale, g.dtype),
+                                        flat[0])
+                    return flat.at[0].set(planted).reshape(g.shape)
+
+                grads = jax.tree_util.tree_map_with_path(flip, grads)
+        return grads
+
+    return poison
+
+
 class TrainState(NamedTuple):
     """Model + optimizer state as one pytree (params, optimizer state, global step).
 
@@ -96,31 +188,40 @@ class TrainState(NamedTuple):
     (``--ema-decay``); ``None`` (the default, and the reference-parity surface) keeps
     the pytree free of it. It shards exactly like ``params`` under every layout, and
     ``utils.checkpoint.restore_train_state`` reconciles checkpoints written on either
-    side of the flag."""
+    side of the flag.
+
+    ``guard`` is the optional :class:`GuardState` (``--guard``): nine scalar
+    anomaly-detector accumulators that ride the same optional-field contract —
+    ``None`` keeps the pytree (and the checkpoint bytes) identical to before
+    the guard existed; the restore paths reconcile across the flag exactly
+    like ``ema``."""
 
     params: dict
     velocity: dict
     step: jax.Array  # int32 scalar
     ema: dict | None = None
+    guard: GuardState | None = None
 
 
 def create_train_state(model, rng: jax.Array,
                        sample_input_shape=(1, 28, 28, 1), *,
                        optimizer: Optimizer | None = None,
-                       ema: bool = False) -> TrainState:
+                       ema: bool = False, guard: bool = False) -> TrainState:
     """Initialize params (PyTorch-default distributions, see ``ops/initializers.py``) and
     zero optimizer state (SGD velocity by default). Under SPMD every process derives
     identical state from the same seed — the replica-consistency analog of DDP's initial
     parameter broadcast (reference ``src/train_dist.py:63``).
 
     ``ema=True`` seeds the EMA tree as a copy of the initial params (torch
-    ``swa_utils.AveragedModel``'s construction-time copy)."""
+    ``swa_utils.AveragedModel``'s construction-time copy). ``guard=True``
+    attaches a fresh :class:`GuardState` (the ``--guard`` anomaly detector)."""
     variables = model.init({"params": rng}, jnp.zeros(sample_input_shape))
     params = variables["params"]
     opt_init = optimizer.init if optimizer is not None else sgd_init
     return TrainState(params=params, velocity=opt_init(params),
                       step=jnp.zeros((), jnp.int32),
-                      ema=jax.tree_util.tree_map(jnp.array, params) if ema else None)
+                      ema=jax.tree_util.tree_map(jnp.array, params) if ema else None,
+                      guard=init_guard() if guard else None)
 
 
 def make_train_step(model, *, learning_rate: float, momentum: float,
@@ -132,7 +233,8 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
                     ema_decay: float = 0.0,
                     label_smoothing: float = 0.0,
                     loss_fn: Callable | None = None,
-                    with_metrics: bool = False) -> Callable:
+                    with_metrics: bool = False,
+                    guard: GuardSpec | None = None) -> Callable:
     """Build ``step(state, images, labels, rng) -> (state, loss)``.
 
     The loss is the canonical ``nll(log_probs)`` formulation (see
@@ -189,6 +291,20 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     new ops enter the compiled program (pinned in ``tests/test_telemetry.py``),
     and the update math is identical either way (the norm only READS the grads),
     so metered and unmetered training produce bitwise-identical params.
+
+    ``guard`` (a :class:`GuardSpec`) arms the numerical immune system: the step
+    computes a fixed-shape anomaly verdict (non-finite loss/grads, grad-norm
+    z-score against the EMA threaded through ``state.guard``) and a poisoned
+    step deterministically selects the IDENTITY update — params/opt-state/EMA
+    unchanged, skip counters bumped, ``step`` still advanced so the data order
+    and per-step RNG folds of a run with skips stay aligned with one without.
+    Steps inside ``guard.skip`` windows take the identity update without
+    counting as anomalies (the supervised-replay contract). The state must
+    come from ``create_train_state(..., guard=True)``. ``guard=None`` adds
+    zero ops (bitwise flag-off pin), and a guard whose verdict never fires
+    selects the freshly-computed update exactly (``jnp.where`` on a false
+    predicate is bitwise the false branch) — anomaly-free guard-on training is
+    bitwise identical to guard-off.
     """
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
@@ -226,14 +342,21 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
     if loss_fn is None:
         loss_fn = default_loss_fn
 
+    poison = _grad_poison_fn()
+
     def apply_update(state, grads, loss):
+        if poison is not None:
+            # Armed grad-poison injection (deterministic, exact-step) — applied
+            # to the (accumulation-averaged) grads BEFORE the norm is measured,
+            # so the detector sees exactly what the update would apply.
+            grads = poison(grads, state.step)
         # The health-stats grad norm is PRE-clip (clipping must not hide an
         # explosion) — which is exactly the norm the clip computes and returns, so
         # the metered clipped step measures it once.
         gnorm = None
         if clip_grad_norm > 0.0:
             grads, gnorm = clip_by_global_norm(grads, clip_grad_norm)
-        elif with_metrics:
+        elif with_metrics or guard is not None:
             gnorm = global_l2_norm(grads)
         if use_pallas:
             # Hyperparams come from the Optimizer (not this function's kwargs) so an
@@ -259,7 +382,69 @@ def make_train_step(model, *, learning_rate: float, momentum: float,
                 lambda e, p: jnp.where(first, p,
                                        ema_decay * e + (1.0 - ema_decay) * p),
                 ema, params)
-        new_state = TrainState(params, velocity, state.step + 1, ema)
+        new_guard = state.guard
+        if guard is not None:
+            if state.guard is None:
+                raise ValueError("a guarded step needs "
+                                 "create_train_state(..., guard=True)")
+            g = state.guard
+            loss32 = loss.astype(jnp.float32)
+            gnorm32 = gnorm.astype(jnp.float32)
+            finite = jnp.isfinite(loss32) & jnp.isfinite(gnorm32)
+            # Spike test: deviation from the clean-step EMA, with a relative
+            # floor under the std so a flat warm stream's jitter cannot trip.
+            std = jnp.sqrt(jnp.maximum(g.ema_sq - g.ema_mean * g.ema_mean, 0.0))
+            threshold = g.ema_mean + guard.zscore * jnp.maximum(
+                std, guard.rel_floor * g.ema_mean)
+            warm = g.count >= guard.warmup_steps
+            spike = warm & finite & (gnorm32 > threshold)
+            in_window = jnp.zeros((), bool)
+            for lo, hi in guard.skip:
+                in_window = in_window | ((state.step >= lo) & (state.step < hi))
+            # Replay-window steps are deliberate skips, never anomalies — a
+            # resumed attempt re-detecting the poison it is skipping would
+            # immediately re-trip the --anomaly-exit policy.
+            nonfinite = ~finite & ~in_window
+            spike = spike & ~in_window
+            anomaly = nonfinite | spike
+            skip = anomaly | in_window
+            # A poisoned/window step selects the IDENTITY update. jnp.where
+            # selects exactly (no arithmetic on the unselected branch), so a
+            # NaN update can never leak and a clean step is bitwise the
+            # unguarded update.
+            keep = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(skip, o, n), new, old)
+            params = keep(params, state.params)
+            velocity = keep(velocity, state.velocity)
+            if ema_decay > 0.0:
+                ema = keep(ema, state.ema)
+            clean = ~skip
+            gsafe = jnp.where(finite, gnorm32, 0.0)
+            d = jnp.asarray(guard.ema_decay, jnp.float32)
+            seeded = g.count > 0   # first clean sample seeds the EMA directly
+            new_mean = jnp.where(
+                clean, jnp.where(seeded, d * g.ema_mean + (1.0 - d) * gsafe,
+                                 gsafe), g.ema_mean)
+            new_sq = jnp.where(
+                clean, jnp.where(seeded, d * g.ema_sq
+                                 + (1.0 - d) * gsafe * gsafe,
+                                 gsafe * gsafe), g.ema_sq)
+            one = jnp.ones((), jnp.int32)
+            zero = jnp.zeros((), jnp.int32)
+            new_guard = GuardState(
+                ema_mean=new_mean, ema_sq=new_sq,
+                count=g.count + jnp.where(clean, one, zero),
+                anomalies=g.anomalies + jnp.where(anomaly, one, zero),
+                nonfinite=g.nonfinite + jnp.where(nonfinite, one, zero),
+                spikes=g.spikes + jnp.where(spike, one, zero),
+                skipped=g.skipped + jnp.where(skip, one, zero),
+                first_anomaly_step=jnp.where(
+                    anomaly & (g.first_anomaly_step < 0),
+                    state.step.astype(jnp.int32), g.first_anomaly_step),
+                last_anomaly_step=jnp.where(anomaly,
+                                            state.step.astype(jnp.int32),
+                                            g.last_anomaly_step))
+        new_state = TrainState(params, velocity, state.step + 1, ema, new_guard)
         if with_metrics:
             return new_state, (loss, gnorm)
         return new_state, loss
@@ -307,7 +492,8 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
                   clip_grad_norm: float = 0.0,
                   ema_decay: float = 0.0,
                   label_smoothing: float = 0.0,
-                  health: bool = False) -> Callable:
+                  health: bool = False,
+                  guard: GuardSpec | None = None) -> Callable:
     """Build ``epoch(state, images, labels, idx_matrix, rng) -> (state, losses)``.
 
     ``images``/``labels`` are the full (device-resident) training split; ``idx_matrix`` is a
@@ -330,13 +516,19 @@ def make_epoch_fn(model, *, learning_rate: float, momentum: float,
     ``HealthStats`` accumulators through the scan carry; the epoch then returns
     ``(state, (losses, health))`` — same program otherwise, bitwise-identical
     params (pinned in ``tests/test_telemetry.py``).
+
+    ``guard`` (a :class:`GuardSpec`) arms the in-scan anomaly verdict +
+    guarded identity update (see ``make_train_step``); the detector state
+    rides ``state.guard`` through the carry — no signature change, no extra
+    host syncs (the verdict is fetched with the epoch's one sanctioned
+    ``state`` read).
     """
     train_step = make_train_step(model, learning_rate=learning_rate, momentum=momentum,
                                  use_pallas=use_pallas, grad_accum=grad_accum,
                                  optimizer=optimizer, lr_schedule=lr_schedule,
                                  clip_grad_norm=clip_grad_norm, ema_decay=ema_decay,
                                  label_smoothing=label_smoothing,
-                                 with_metrics=health)
+                                 with_metrics=health, guard=guard)
     return make_epoch_from_step(train_step, unroll=unroll, pregather=pregather,
                                 health=health)
 

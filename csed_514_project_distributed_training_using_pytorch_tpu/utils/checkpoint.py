@@ -66,11 +66,13 @@ def _decode_msgpack(path: str):
 
 def _state_dict_for_save(state: TrainState) -> dict:
     """Serialization form: absent optional fields are OMITTED (not stored as None),
-    so EMA-off checkpoints stay byte-identical to the pre-EMA format — and raw
-    msgpack consumers never see a None leaf."""
+    so EMA-off (and guard-off) checkpoints stay byte-identical to the format
+    that predates each optional field — and raw msgpack consumers never see a
+    None leaf."""
     d = state._asdict()
-    if d.get("ema") is None:
-        d.pop("ema", None)
+    for opt in ("ema", "guard"):
+        if d.get(opt) is None:
+            d.pop(opt, None)
     return d
 
 
@@ -91,7 +93,11 @@ def restore_train_state(path: str, reference_state: TrainState) -> TrainState:
     checkpoint written without EMA restores into an EMA-enabled reference by seeding
     the EMA tree from the checkpoint's params (exactly what the first
     ``AveragedModel`` update would do); a checkpoint carrying EMA restores into a
-    plain reference by dropping the tree.
+    plain reference by dropping the tree. The optional ``guard`` field (the
+    ``--guard`` anomaly detector, ``train/step.py::GuardState``) reconciles the
+    same way: a pre-guard checkpoint restores into a guarded reference keeping
+    the reference's (fresh) detector state; a guarded checkpoint restores into
+    a plain reference by dropping it.
 
     Raises :class:`CheckpointCorrupt` (naming the path) when the bytes do not decode
     — a truncated file surfaces as a torn write, not a raw msgpack stack trace."""
@@ -102,13 +108,18 @@ def restore_train_state(path: str, reference_state: TrainState) -> TrainState:
     elif ref.get("ema") is None:
         raw.pop("ema", None)
     raw.setdefault("ema", None)
+    if ref.get("guard") is not None and raw.get("guard") is None:
+        raw["guard"] = serialization.to_state_dict(ref["guard"])
+    elif ref.get("guard") is None:
+        raw.pop("guard", None)
+    raw.setdefault("guard", None)
     restored = serialization.from_state_dict(ref, raw)
     return TrainState(**restored)
 
 
 def restore_for_resume(path: str, reference_state: TrainState, *,
                        process_index: int, process_count: int,
-                       steps_per_epoch: int, tele=None):
+                       steps_per_epoch: int, tele=None, shardings=None):
     """Shared resume prologue of the distributed and composed trainers: process-0
     restore, full-state broadcast to the fleet (the resume analog of DDP's initial
     param broadcast — checkpoints are process-0-gated writes, so on a fleet without a
@@ -125,12 +136,19 @@ def restore_for_resume(path: str, reference_state: TrainState, *,
 
     ``tele`` (a ``TelemetryWriter``) records the restore as a ``checkpoint`` event
     (op=restore, kind, bytes, wall seconds); emission is process-0 gated by the
-    writer itself."""
+    writer itself.
+
+    ``shardings`` (a ``TrainState``-shaped sharding pytree for the CURRENT
+    mesh) places the restored state straight onto the mesh — the
+    rollback-on-a-reshaped-fleet path: a checkpoint written under one layout
+    restores bitwise onto any other (the sharded interchange contract above;
+    pinned in ``tests/test_anomaly.py``)."""
     t0 = time.perf_counter()
     state = reference_state
     if os.path.isdir(path):
         result = _derive_resume_epoch(
-            restore_train_state_sharded(path, reference_state), steps_per_epoch)
+            restore_train_state_sharded(path, reference_state,
+                                        shardings=shardings), steps_per_epoch)
         _emit_restore_event(tele, path, "sharded", t0, result[0])
         return result
     if process_index == 0:
@@ -140,6 +158,8 @@ def restore_for_resume(path: str, reference_state: TrainState, *,
         from jax.experimental import multihost_utils
         state = jax.tree_util.tree_map(
             np.asarray, multihost_utils.broadcast_one_to_all(state))
+    if shardings is not None:
+        state = jax.device_put(state, shardings)
     result = _derive_resume_epoch(state, steps_per_epoch)
     _emit_restore_event(tele, path, "full", t0, result[0])
     return result
@@ -350,6 +370,21 @@ def restore_train_state_sharded(dir_path: str, reference_state: TrainState,
         for k in [k for k in full if k.startswith("ema/")]:
             del full[k]
         none_keys.add("ema")
+    # Guard reconciliation across the --guard flag: a pre-guard checkpoint
+    # (guard recorded as absent OR predating the field entirely) keeps the
+    # reference's fresh detector scalars; a guarded checkpoint restoring into
+    # a plain reference drops them.
+    if reference_state.guard is not None and not any(
+            k.startswith("guard/") for k in full):
+        for k, leaf in _flatten_state_dict(
+                {"guard": serialization.to_state_dict(
+                    reference_state.guard)}).items():
+            full[k] = np.asarray(leaf)
+        none_keys.discard("guard")
+    elif reference_state.guard is None:
+        for k in [k for k in full if k.startswith("guard/")]:
+            del full[k]
+        none_keys.add("guard")
     for key in none_keys:
         full[key] = None
     restored = serialization.from_state_dict(reference_state._asdict(),
@@ -393,7 +428,7 @@ def load_manifest(dir_path: str) -> dict:
 
 
 def save_versioned(dir_path: str, state: TrainState, *, keep: int = 3,
-                   tele=None) -> str | None:
+                   tele=None, health: dict | None = None) -> str | None:
     """Write ``state`` as ``ckpt_{step:08d}.msgpack`` into the versioned store:
     atomic file write, then an atomic manifest update (file, step, sha256, bytes),
     then GC of everything beyond the newest ``keep`` steps. Process-0 gated (returns
@@ -401,6 +436,14 @@ def save_versioned(dir_path: str, state: TrainState, *, keep: int = 3,
     in-memory payload BEFORE the write — a torn write therefore mismatches its own
     manifest entry and is skipped by :func:`newest_valid_checkpoint`, which is the
     entire point of recording it.
+
+    ``health`` stamps the manifest entry with the run's integrity verdict at
+    save time (``--guard`` trainers pass ``{"clean": bool, "anomalies": N,
+    "skipped": N, "step": N, "fingerprint": F}`` — clean meaning no anomaly
+    was detected since the PREVIOUS versioned save). The stamp is what
+    :func:`newest_healthy_checkpoint` prefers over blind newest-valid; old
+    manifests without it remain loadable and keep their merely-valid standing
+    (back-compat pinned in tests).
 
     Synchronous BY DESIGN, even next to ``--async-checkpoint``: this store is the
     supervisor's resume substrate and the preemption contract's "checkpoint already
@@ -419,9 +462,12 @@ def save_versioned(dir_path: str, state: TrainState, *, keep: int = 3,
     _atomic_write(path, data)
     manifest = load_manifest(dir_path)
     entries = [e for e in manifest["entries"] if e.get("file") != name]
-    entries.append({"file": name, "step": step,
-                    "sha256": hashlib.sha256(data).hexdigest(),
-                    "bytes": len(data), "unix_time": time.time()})
+    entry = {"file": name, "step": step,
+             "sha256": hashlib.sha256(data).hexdigest(),
+             "bytes": len(data), "unix_time": time.time()}
+    if health is not None:
+        entry["health"] = dict(health)
+    entries.append(entry)
     entries.sort(key=lambda e: e["step"])
     dropped, entries = entries[:-keep], entries[-keep:]
     _atomic_write(os.path.join(dir_path, MANIFEST_NAME),
@@ -450,14 +496,8 @@ def newest_valid_checkpoint(dir_path: str) -> str | None:
                      key=lambda e: e["step"], reverse=True)
     if entries:
         for e in entries:
-            path = os.path.join(dir_path, e["file"])
-            try:
-                with open(path, "rb") as f:
-                    data = f.read()
-            except OSError:
-                continue
-            if hashlib.sha256(data).hexdigest() == e.get("sha256"):
-                return path
+            if _entry_verifies(dir_path, e):
+                return os.path.join(dir_path, e["file"])
         return None
     candidates = sorted((f for f in os.listdir(dir_path)
                          if f.startswith(_VERSIONED_PREFIX)
@@ -470,6 +510,55 @@ def newest_valid_checkpoint(dir_path: str) -> str | None:
         except (CheckpointCorrupt, OSError):
             continue
     return None
+
+
+def _entry_verifies(dir_path: str, entry: dict) -> bool:
+    path = os.path.join(dir_path, entry.get("file", ""))
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return False
+    return hashlib.sha256(data).hexdigest() == entry.get("sha256")
+
+
+def newest_healthy_checkpoint(dir_path: str, *,
+                              before_step: int | None = None) -> str | None:
+    """The resume scan every supervised rollback goes through: the newest
+    checkpoint that is NOT health-stamped-unclean — stamped-clean and legacy
+    unstamped entries (old manifests stay loadable, and a guard-off run's
+    newer progress must not be discarded in favor of an older stamp) rank
+    purely by step; only entries a ``--guard`` run explicitly stamped
+    ``clean: false`` are skipped. When nothing else survives, fall back to
+    :func:`newest_valid_checkpoint` (an unclean checkpoint beats no resume at
+    all, and the caller's skip window makes even that safe to replay from).
+
+    ``before_step`` additionally excludes entries at or past that step — the
+    DESYNC rollback path: a cross-replica fingerprint mismatch at step S
+    indicts the step-S state, whose checkpoint is already durable and (the
+    per-process anomaly counters cannot see divergence) clean-STAMPED, so the
+    supervisor must roll back strictly before it.
+
+    This supersedes blind newest-valid in resume paths: ``_newest_valid``'s
+    old behavior trusted the newest decodable checkpoint even when the run
+    that wrote it was already diverging — the exact state a rollback must NOT
+    land on (regression-pinned in ``tests/test_anomaly.py``). Checksums are
+    verified against the manifest exactly like :func:`newest_valid_checkpoint`
+    (torn writes are skipped, never raised)."""
+    if not os.path.isdir(dir_path):
+        return None
+    entries = sorted(load_manifest(dir_path)["entries"],
+                     key=lambda e: e["step"], reverse=True)
+    if not entries:
+        return newest_valid_checkpoint(dir_path)    # manifest-less fallback
+    for e in entries:
+        if before_step is not None and e.get("step", 0) >= before_step:
+            continue                                # indicted by the mismatch
+        if (e.get("health") or {}).get("clean") is False:
+            continue                                # a known-diverging save
+        if _entry_verifies(dir_path, e):
+            return os.path.join(dir_path, e["file"])
+    return newest_valid_checkpoint(dir_path)
 
 
 class AsyncCheckpointer:

@@ -102,8 +102,30 @@ class SingleProcessConfig:
     keep_checkpoints: int = 0         # ALSO keep the last N per-epoch checkpoints
                                       # under results_dir/checkpoints/ with a
                                       # checksummed manifest + GC — the versioned
-                                      # store the supervisor's newest-VALID resume
-                                      # scan reads (utils/checkpoint.py); 0 off
+                                      # store the supervisor's newest-HEALTHY
+                                      # resume scan reads (utils/checkpoint.py);
+                                      # 0 off
+    guard: bool = False               # numerical immune system: a fixed-shape
+                                      # anomaly verdict (non-finite loss/grads,
+                                      # grad-norm z-score) computed INSIDE the
+                                      # compiled step; a poisoned step applies
+                                      # the IDENTITY update instead of garbage
+                                      # (train/step.py::GuardSpec). Off = zero
+                                      # added ops, bitwise-pinned
+    guard_zscore: float = 8.0         # spike threshold: grad norm above
+                                      # ema_mean + z*max(ema_std, 0.5*ema_mean)
+                                      # is an anomaly (guard only)
+    anomaly_exit: int = 0             # exit 65 ("poisoned", EX_DATAERR) at the
+                                      # epoch boundary once >= N anomalies were
+                                      # detected — the supervisor then rolls
+                                      # back to the newest HEALTHY checkpoint
+                                      # and restarts with --skip-steps; 0 =
+                                      # never exit, keep skipping silently
+    skip_steps: str = ""              # half-open step windows "a:b[,c:d]" that
+                                      # take the identity update on replay (the
+                                      # supervisor's rollback-and-skip handoff;
+                                      # deterministic because data order is a
+                                      # pure function of seed+step)
     use_host_pipeline: bool = False   # feed batches through the native C++ threaded
                                       # prefetcher (the DataLoader num_workers=4 analog,
                                       # src/train_dist.py:43-45) instead of the device-
@@ -183,6 +205,13 @@ class DistributedConfig:
     keep_checkpoints: int = 0         # keep-last-N versioned checkpoint store with
                                       # manifest under results_dir/checkpoints/
                                       # (see SingleProcessConfig); 0 off
+    guard: bool = False               # in-step anomaly verdict + guarded identity
+                                      # update (see SingleProcessConfig.guard)
+    guard_zscore: float = 8.0         # spike threshold (see SingleProcessConfig)
+    anomaly_exit: int = 0             # exit 65 "poisoned" once >= N anomalies
+                                      # (see SingleProcessConfig); 0 off
+    skip_steps: str = ""              # identity-update replay windows "a:b[,c:d]"
+                                      # (see SingleProcessConfig.skip_steps)
     host_local_feed: bool = False     # multi-host input pipeline: each process gathers and
                                       # feeds ONLY its addressable devices' shard of every
                                       # batch (SURVEY.md §7 hard part (d)) instead of the
@@ -312,6 +341,13 @@ class ComposedConfig:
                                         # boundary, exit 75 (see SingleProcessConfig)
     keep_checkpoints: int = 0           # keep-last-N versioned checkpoint store with
                                         # manifest (see SingleProcessConfig); 0 off
+    guard: bool = False                 # in-step anomaly verdict + guarded identity
+                                        # update (see SingleProcessConfig.guard)
+    guard_zscore: float = 8.0           # spike threshold (see SingleProcessConfig)
+    anomaly_exit: int = 0               # exit 65 "poisoned" once >= N anomalies
+                                        # (see SingleProcessConfig); 0 off
+    skip_steps: str = ""                # identity-update replay windows "a:b[,c:d]"
+                                        # (see SingleProcessConfig.skip_steps)
     dropout_rate: float = 0.0           # 0 keeps composed runs comparable across meshes
     seed: int = 1
     data_dir: str = "files"
@@ -390,6 +426,13 @@ class LMConfig:
                                         # boundary, exit 75 (see SingleProcessConfig)
     keep_checkpoints: int = 0           # keep-last-N versioned checkpoint store with
                                         # manifest (see SingleProcessConfig); 0 off
+    guard: bool = False                 # in-step anomaly verdict + guarded identity
+                                        # update (see SingleProcessConfig.guard)
+    guard_zscore: float = 8.0           # spike threshold (see SingleProcessConfig)
+    anomaly_exit: int = 0               # exit 65 "poisoned" once >= N anomalies
+                                        # (see SingleProcessConfig); 0 off
+    skip_steps: str = ""                # identity-update replay windows "a:b[,c:d]"
+                                        # (see SingleProcessConfig.skip_steps)
     telemetry: str = ""                 # structured run-telemetry JSONL path (see
                                         # SingleProcessConfig.telemetry); "" off
     health_stats: bool = False          # in-scan training-health accumulators (see

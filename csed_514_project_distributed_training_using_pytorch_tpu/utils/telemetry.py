@@ -370,6 +370,88 @@ def health_event(epoch: int, health, steps: int, *,
     }
 
 
+def anomaly_event(epoch: int, guard, steps: int, *,
+                  fingerprint: float | None = None, skip: str = "") -> dict:
+    """The per-epoch ``anomaly`` event from a ``train.step.GuardState`` carry
+    (host-fetched once per epoch with the losses — no extra syncs). Counters
+    are CUMULATIVE for the attempt (a rollback resumes the healthy
+    checkpoint's counters, so a resumed attempt restarts from its baseline);
+    ``fingerprint`` is the cross-replica param fingerprint
+    (``param_fingerprint``), ``skip`` the active ``--skip-steps`` windows."""
+    import math as _math
+
+    mean = float(guard.ema_mean)
+    std = _math.sqrt(max(float(guard.ema_sq) - mean * mean, 0.0))
+    return {
+        "event": "anomaly",
+        "epoch": int(epoch),
+        "steps": int(steps),
+        "anomalies": int(guard.anomalies),
+        "nonfinite": int(guard.nonfinite),
+        "spikes": int(guard.spikes),
+        "skipped": int(guard.skipped),
+        "clean_steps": int(guard.count),
+        "first_anomaly_step": int(guard.first_anomaly_step),
+        "last_anomaly_step": int(guard.last_anomaly_step),
+        "grad_norm_ema": _finite(mean),
+        "grad_norm_std": _finite(std),
+        "fingerprint": _finite(fingerprint),
+        "skip": skip,
+    }
+
+
+def _local_blocks(leaf):
+    """This process's deduped addressable blocks of ``leaf`` as host arrays
+    (sorted by global offset for a deterministic fold), or None when the
+    local blocks do not cover the full logical array — the multi-host-sharded
+    case, where per-process fingerprints would differ by construction."""
+    import numpy as np
+
+    if not hasattr(leaf, "addressable_shards"):
+        return [np.asarray(leaf)]
+    blocks: dict[tuple, object] = {}
+    covered = 0
+    for sh in leaf.addressable_shards:
+        key = tuple(0 if s.start is None else int(s.start) for s in sh.index)
+        if key in blocks:
+            continue                     # a replica of an already-seen block
+        data = np.asarray(sh.data)
+        blocks[key] = data
+        covered += data.size
+    if covered != leaf.size:
+        return None
+    return [blocks[k] for k in sorted(blocks)]
+
+
+def param_fingerprint(tree) -> float | None:
+    """Cross-replica state fingerprint: the f32 per-leaf absolute-sum folded
+    over this process's LOCAL view of the tree — cheap, deterministic, and
+    identical across replicas iff their replicated state actually is.
+    Deliberately NOT a jitted global reduction: on a multi-host fleet that
+    would all-reduce, handing every process the identical (corruption
+    included) scalar — the detector would be structurally blind. Host-local
+    math means each process vouches only for the bytes it holds. Computed
+    once per epoch at the sanctioned boundary fetch and compared by the
+    supervisor's fingerprint-verify mode through the heartbeat files
+    (``resilience/heartbeat.py::fingerprint_mismatch``) — post-update
+    divergence (SDC, desync) is detected before the diverged state can be
+    RESUMED as truth (the supervisor rolls back strictly past the mismatch
+    step). Returns None when this process's addressable shards do not cover
+    the full state (multi-host FSDP/TP: per-process fingerprints would differ
+    by construction, and a beat without a fingerprint is simply not
+    compared)."""
+    import numpy as np
+
+    total = np.float32(0.0)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        blocks = _local_blocks(leaf)
+        if blocks is None:
+            return None
+        for data in blocks:
+            total += np.abs(data.astype(np.float32)).sum(dtype=np.float32)
+    return float(total)
+
+
 def checkpoint_event(*, op: str, path: str, kind: str = "full",
                      nbytes: int | None = None, wall_s: float | None = None,
                      step: int | None = None, coalesced: int | None = None,

@@ -57,7 +57,8 @@ EVENT_KINDS: dict[str, str] = {
     "chaos": "one injected network fault (resilience/netfaults.py proxy schedule)",
     # -- resilience (resilience/supervisor.py, utils/checkpoint.py) -------------
     "checkpoint": "one checkpoint save/restore: op/kind/bytes/wall",
-    "restart": "supervisor restart: attempt, crash/hung/timeout reason, backoff",
+    "restart": "supervisor restart: attempt, crash/hung/poisoned reason, backoff",
+    "anomaly": "per-epoch --guard verdict: anomalies/skipped/EMA/fingerprint",
     "preempt": "cooperative SIGTERM stop at an epoch boundary (exit 75)",
     "supervise_summary": "once per supervised run: final status + attempts",
     # -- planner (plan/) --------------------------------------------------------

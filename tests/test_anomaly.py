@@ -1,0 +1,888 @@
+"""The numerical immune system (train/step.py --guard + rollback-and-skip).
+
+Layers under test, bottom-up:
+
+- the in-step verdict + guarded IDENTITY update: nan/spike/bitflip grad poison
+  is detected and never applied; a run whose poisoned step was skipped is
+  bitwise identical to an oracle run with the same static ``--skip-steps``
+  window; guard-off and anomaly-free-guard-on are bitwise identical to the
+  unguarded trainer (the PR-3 flag-off pinning discipline);
+- the checkpoint layer: GuardState rides the TrainState optional-field
+  contract (reconciled across the flag, full + sharded), manifests carry
+  health stamps, and ``newest_healthy_checkpoint`` prefers stamped-clean over
+  merely-valid (the ``_newest_valid``-trusted-a-diverging-run regression);
+- the supervisor: EXIT_POISONED classification, rollback to the newest
+  HEALTHY checkpoint, ``--skip-steps`` accumulation with auto-widening and
+  the scattered-poison fingerprint-verify escalation, and the cross-replica
+  heartbeat-fingerprint desync detector;
+- the observability surfaces: the ``anomaly`` event, the goodput ledger's
+  ``rollback_badput`` segment, report/fleet_top rendering;
+- doc-vs-grammar agreement: the README fault table must list exactly the
+  ``resilience/faults.py`` + ``resilience/netfaults.py`` kinds.
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+    faults,
+    heartbeat,
+    netfaults,
+    poison,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "csed_514_project_distributed_training_using_pytorch_tpu"
+
+
+# ---------------------------------------------------------------- jax-free units
+
+
+class TestSkipWindows:
+    def test_parse_format_roundtrip(self):
+        spec = "4:7,9:10"
+        windows = poison.parse_skip_steps(spec)
+        assert windows == ((4, 7), (9, 10))
+        assert poison.format_skip_steps(windows) == spec
+        assert poison.parse_skip_steps("") == ()
+
+    @pytest.mark.parametrize("bad", ["5", "7:5", "-1:3", "a:b"])
+    def test_malformed_windows_raise(self, bad):
+        with pytest.raises(ValueError):
+            poison.parse_skip_steps(bad)
+
+    def test_merge_disjoint_appends(self):
+        merged, widened = poison.merge_windows(((4, 5),), (9, 10))
+        assert merged == ((4, 5), (9, 10)) and not widened
+
+    def test_merge_overlap_widens_by_new_length(self):
+        # Repeated poison at an already-skipped site: union + one new-window
+        # length of extra headroom — geometric escape from skip-one-loop-again.
+        merged, widened = poison.merge_windows(((4, 6),), (5, 7))
+        assert widened and merged == ((4, 9),)
+
+    def test_marker_roundtrip_consumes(self, tmp_path):
+        store = str(tmp_path)
+        poison.write_marker(store, window=(6, 7), step=8, anomalies=1)
+        marker = poison.read_marker(store)
+        assert marker["window"] == (6, 7) and marker["anomalies"] == 1
+        assert poison.read_marker(store) is None       # consumed
+        assert poison.read_marker(str(tmp_path / "nope")) is None
+
+
+class TestPoisonGrammar:
+    def test_poison_kinds_registered(self):
+        assert set(faults.POISON_KINDS) <= set(faults.KINDS)
+
+    def test_poison_requires_exact_step(self):
+        with pytest.raises(ValueError, match="exact step"):
+            faults._parse("nan:proc=0")
+
+    def test_poison_rejects_tick_keys(self):
+        with pytest.raises(ValueError, match="epoch=/flag="):
+            faults._parse("spike:step=3,flag=/tmp/x")
+
+    def test_bitflip_requires_leaf(self):
+        with pytest.raises(ValueError, match="leaf="):
+            faults._parse("bitflip:step=3")
+
+    def test_defaults(self):
+        (spike,) = faults._parse("spike:step=3")
+        assert spike.scale == faults.DEFAULT_SPIKE_SCALE
+        (flip,) = faults._parse("bitflip:step=3,leaf=kernel,scale=1e12")
+        assert flip.scale == 1e12 and flip.leaf == "kernel"
+
+    def test_grad_poisons_filters_by_process(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_VAR, "nan:step=3,proc=1;spike:step=4")
+        monkeypatch.setenv("JAX_PROCESS_ID", "0")
+        faults._parse.cache_clear()
+        kinds = [f.kind for f in faults.grad_poisons()]
+        assert kinds == ["spike"]
+        monkeypatch.delenv(faults.ENV_VAR)
+        assert faults.grad_poisons() == ()
+
+
+def test_fingerprint_mismatch_detector(tmp_path):
+    d = str(tmp_path)
+    heartbeat.HeartbeatWriter(d, process_index=0).beat(
+        step=8, epoch=2, fingerprint=672.5)
+    heartbeat.HeartbeatWriter(d, process_index=1).beat(
+        step=8, epoch=2, fingerprint=672.5)
+    assert heartbeat.fingerprint_mismatch(d) is None
+    # Different STEPS never compare (epoch-boundary skew is not divergence).
+    heartbeat.HeartbeatWriter(d, process_index=1).beat(
+        step=12, epoch=3, fingerprint=9.0)
+    assert heartbeat.fingerprint_mismatch(d) is None
+    heartbeat.HeartbeatWriter(d, process_index=1).beat(
+        step=8, epoch=2, fingerprint=673.0)
+    mismatch = heartbeat.fingerprint_mismatch(d)
+    assert mismatch["step"] == 8
+    assert mismatch["fingerprints"] == {0: 672.5, 1: 673.0}
+    # Beats without fingerprints (guard-off trainers) never trip it.
+    heartbeat.clear(d)
+    heartbeat.HeartbeatWriter(d, process_index=0).beat(step=8, epoch=2)
+    heartbeat.HeartbeatWriter(d, process_index=1).beat(step=8, epoch=2)
+    assert heartbeat.fingerprint_mismatch(d) is None
+
+
+def test_readme_fault_table_matches_grammar():
+    """Doc-vs-grammar agreement: the README fault-injection table must list
+    exactly the kinds both grammars implement — it drifted once (the PR-14
+    chaos/stall additions predated it); this pins it closed."""
+    with open(os.path.join(REPO, "README.md")) as f:
+        readme = f.read()
+    m = re.search(r"<!-- fault-grammar:begin -->(.*?)<!-- fault-grammar:end -->",
+                  readme, re.S)
+    assert m, "README fault-grammar table (marker comments) is missing"
+    rows = re.findall(r"^\| `(\w+)` \| `(\w+)` \|", m.group(1), re.M)
+    by_env: dict = {}
+    for kind, env in rows:
+        by_env.setdefault(env, set()).add(kind)
+    assert by_env.get("RESILIENCE_FAULTS") == set(faults.KINDS)
+    assert by_env.get("NETWORK_FAULTS") == set(netfaults.KINDS)
+
+
+# ------------------------------------------------------------ in-program guard
+
+@pytest.fixture(scope="module")
+def cnn_setup():
+    import jax
+    import jax.numpy as jnp
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        build_model,
+    )
+
+    model = build_model("cnn")
+    rng = jax.random.PRNGKey(0)
+    gen = np.random.default_rng(0)
+    x = jnp.asarray(gen.normal(size=(8, 28, 28, 1)).astype(np.float32))
+    y = jnp.asarray((np.arange(8) % 10).astype(np.int32))
+    return model, rng, x, y
+
+
+def _run_steps(cnn_setup, *, steps=6, guard=None, guard_state=False,
+               faults_env="", monkeypatch=None, **step_kw):
+    import jax
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+        step as S,
+    )
+
+    model, rng, x, y = cnn_setup
+    if monkeypatch is not None:
+        if faults_env:
+            monkeypatch.setenv(faults.ENV_VAR, faults_env)
+        else:
+            monkeypatch.delenv(faults.ENV_VAR, raising=False)
+    faults._parse.cache_clear()
+    st = S.create_train_state(model, rng, guard=guard_state)
+    fn = jax.jit(S.make_train_step(model, learning_rate=0.01, momentum=0.5,
+                                   guard=guard, **step_kw))
+    for _ in range(steps):
+        st, _ = fn(st, x, y, rng)
+    return st
+
+
+def _assert_trees_equal(a, b):
+    import jax
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestGuardedStep:
+    def test_clean_guard_bitwise_equals_unguarded(self, cnn_setup, monkeypatch):
+        from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+            step as S,
+        )
+
+        off = _run_steps(cnn_setup, monkeypatch=monkeypatch)
+        on = _run_steps(cnn_setup, guard=S.GuardSpec(), guard_state=True,
+                        monkeypatch=monkeypatch)
+        _assert_trees_equal(off.params, on.params)
+        _assert_trees_equal(off.velocity, on.velocity)
+        g = on.guard
+        assert int(g.anomalies) == 0 and int(g.skipped) == 0
+        assert int(g.count) == 6
+
+    @pytest.mark.parametrize("env,field,at", [
+        # nan detection is always armed; the z-test needs its warmup
+        # (GuardSpec.warmup_steps clean samples) before a spike can trip.
+        ("nan:step=2", "nonfinite", 2),
+        ("spike:step=4,scale=1e6", "spikes", 4),
+        ("bitflip:step=4,leaf=kernel,scale=1e15", "spikes", 4),
+    ])
+    def test_poison_detected_and_skipped(self, cnn_setup, monkeypatch, env,
+                                         field, at):
+        import jax
+
+        from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+            step as S,
+        )
+
+        st = _run_steps(cnn_setup, guard=S.GuardSpec(), guard_state=True,
+                        faults_env=env, monkeypatch=monkeypatch)
+        g = jax.device_get(st.guard)
+        assert int(g.anomalies) == 1 and int(g.skipped) == 1
+        assert int(getattr(g, field)) == 1
+        assert int(g.first_anomaly_step) == int(g.last_anomaly_step) == at
+        # The poisoned update never landed: every param is finite, and the
+        # step counter still advanced through the skip (data/RNG alignment).
+        for leaf in jax.tree_util.tree_leaves(st.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+        assert int(st.step) == 6
+
+    def test_poisoned_run_equals_skip_window_oracle(self, cnn_setup,
+                                                    monkeypatch):
+        """THE rollback-and-skip contract at step level: a guarded run whose
+        poison was skipped is bitwise the oracle trained with the same static
+        skip window — params, optimizer state, AND detector EMA."""
+        from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+            step as S,
+        )
+
+        poisoned = _run_steps(cnn_setup, guard=S.GuardSpec(), guard_state=True,
+                              faults_env="nan:step=3", monkeypatch=monkeypatch)
+        oracle = _run_steps(cnn_setup, guard=S.GuardSpec(skip=((3, 4),)),
+                            guard_state=True, monkeypatch=monkeypatch)
+        _assert_trees_equal(poisoned.params, oracle.params)
+        _assert_trees_equal(poisoned.velocity, oracle.velocity)
+        np.testing.assert_array_equal(np.asarray(poisoned.guard.ema_mean),
+                                      np.asarray(oracle.guard.ema_mean))
+        # Window skips are deliberate: skipped counted, anomaly NOT.
+        assert int(oracle.guard.anomalies) == 0
+        assert int(oracle.guard.skipped) == 1
+
+    def test_window_suppresses_redetection(self, cnn_setup, monkeypatch):
+        """A replayed attempt skipping the poisoned step must not re-count the
+        anomaly — or the --anomaly-exit policy would re-trip forever."""
+        from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+            step as S,
+        )
+
+        st = _run_steps(cnn_setup, guard=S.GuardSpec(skip=((3, 4),)),
+                        guard_state=True, faults_env="nan:step=3",
+                        monkeypatch=monkeypatch)
+        g = st.guard
+        assert int(g.anomalies) == 0 and int(g.skipped) == 1
+
+    def test_guard_composes_with_accum_clip_ema(self, cnn_setup, monkeypatch):
+        import jax
+
+        from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+            step as S,
+        )
+
+        st = _run_steps(cnn_setup, guard=S.GuardSpec(), guard_state=True,
+                        faults_env="nan:step=2", monkeypatch=monkeypatch,
+                        grad_accum=2, clip_grad_norm=1.0)
+        g = jax.device_get(st.guard)
+        assert int(g.anomalies) == 1
+        for leaf in jax.tree_util.tree_leaves(st.params):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_guard_needs_guard_state(self, cnn_setup, monkeypatch):
+        from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+            step as S,
+        )
+
+        with pytest.raises(ValueError, match="guard=True"):
+            _run_steps(cnn_setup, guard=S.GuardSpec(), guard_state=False,
+                       monkeypatch=monkeypatch)
+
+
+# ----------------------------------------------------- checkpoint health layer
+
+
+class TestHealthyCheckpoints:
+    def _store(self, tmp_path, stamps):
+        import jax.numpy as jnp
+
+        from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+            build_model,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+            create_train_state,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint as C,
+        )
+        import jax
+
+        store = str(tmp_path / "store")
+        st = create_train_state(build_model("cnn"), jax.random.PRNGKey(0))
+        for step, health in stamps:
+            C.save_versioned(store, st._replace(step=jnp.asarray(step,
+                                                                 jnp.int32)),
+                             keep=10, health=health)
+        return store
+
+    def test_clean_stamp_preferred_over_newest_valid(self, tmp_path):
+        """The satellite-2 regression: the newest checkpoint decodes fine but
+        its run was diverging — the rollback must land on the older CLEAN
+        stamp, not the newest merely-valid file."""
+        from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+            supervisor as sup,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint as C,
+        )
+
+        store = self._store(tmp_path, [
+            (4, {"clean": True, "anomalies": 0}),
+            (8, {"clean": False, "anomalies": 2}),
+        ])
+        assert C.newest_valid_checkpoint(store).endswith("00000008.msgpack")
+        assert C.newest_healthy_checkpoint(store).endswith("00000004.msgpack")
+        # The supervisor's one resume-scan owner makes the same choice.
+        assert sup._newest_healthy(store).endswith("00000004.msgpack")
+
+    def test_legacy_unstamped_manifest_back_compat(self, tmp_path):
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint as C,
+        )
+
+        store = self._store(tmp_path, [(4, None), (8, None)])
+        assert C.newest_healthy_checkpoint(store) == \
+            C.newest_valid_checkpoint(store)
+
+    def test_newer_legacy_progress_beats_older_clean_stamp(self, tmp_path):
+        """A guard-off run's NEWER unstamped checkpoints must not be
+        discarded in favor of an older stamped-clean one — only explicit
+        clean:false stamps are skipped; unstamped entries rank by step."""
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint as C,
+        )
+
+        store = self._store(tmp_path, [
+            (4, {"clean": True, "anomalies": 0}),
+            (8, None),
+            (12, None),
+        ])
+        assert C.newest_healthy_checkpoint(store).endswith("00000012.msgpack")
+
+    def test_all_unclean_falls_back_to_newest_valid(self, tmp_path):
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint as C,
+        )
+
+        store = self._store(tmp_path, [
+            (4, {"clean": False, "anomalies": 1}),
+            (8, {"clean": False, "anomalies": 2}),
+        ])
+        # An unclean resume beats no resume; the caller's skip window makes
+        # the replay safe.
+        assert C.newest_healthy_checkpoint(store).endswith("00000008.msgpack")
+
+    def test_missing_store(self, tmp_path):
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint as C,
+        )
+
+        assert C.newest_healthy_checkpoint(str(tmp_path / "nope")) is None
+
+    def test_before_step_excludes_indicted_checkpoint(self, tmp_path):
+        """The desync rollback bound: a fingerprint mismatch at step S
+        indicts the step-S checkpoint even though it is clean-STAMPED
+        (per-process counters cannot see cross-replica divergence) — the
+        scan must land strictly before it."""
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint as C,
+        )
+
+        store = self._store(tmp_path, [
+            (4, {"clean": True, "anomalies": 0}),
+            (8, {"clean": True, "anomalies": 0}),   # diverged, stamp blind
+        ])
+        assert C.newest_healthy_checkpoint(store).endswith("00000008.msgpack")
+        assert C.newest_healthy_checkpoint(
+            store, before_step=8).endswith("00000004.msgpack")
+
+
+class TestGuardStateCheckpointing:
+    def test_full_roundtrip_and_flag_reconciliation(self, tmp_path):
+        import jax
+
+        from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+            build_model,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+            create_train_state,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint as C,
+        )
+
+        model = build_model("cnn")
+        guarded = create_train_state(model, jax.random.PRNGKey(0), guard=True)
+        plain = create_train_state(model, jax.random.PRNGKey(0))
+        pg, pp = str(tmp_path / "g.ckpt"), str(tmp_path / "p.ckpt")
+        C.save_train_state(pg, guarded)
+        C.save_train_state(pp, plain)
+        # Guard-off checkpoint bytes carry NO guard key (format pin): the raw
+        # msgpack doc must look exactly like the pre-guard format.
+        from flax import serialization
+
+        raw = serialization.msgpack_restore(open(pp, "rb").read())
+        assert "guard" not in raw
+        # Cross-flag restores reconcile like ema.
+        r = C.restore_train_state(pp, guarded)       # plain -> guarded ref
+        assert r.guard is not None and int(r.guard.count) == 0
+        assert C.restore_train_state(pg, plain).guard is None
+        rt = C.restore_train_state(pg, guarded)      # roundtrip
+        assert int(rt.guard.anomalies) == 0
+
+    def test_sharded_roundtrip_and_reconciliation(self, tmp_path):
+        import jax
+
+        from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+            build_model,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+            create_train_state,
+        )
+        from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+            checkpoint as C,
+        )
+
+        model = build_model("cnn")
+        guarded = create_train_state(model, jax.random.PRNGKey(0), guard=True)
+        plain = create_train_state(model, jax.random.PRNGKey(0))
+        d = str(tmp_path / "sh.ckpt")
+        C.save_train_state_sharded(d, guarded)
+        assert C.restore_train_state_sharded(d, guarded).guard is not None
+        assert C.restore_train_state_sharded(d, plain).guard is None
+        d2 = str(tmp_path / "sh2.ckpt")
+        C.save_train_state_sharded(d2, plain)
+        seeded = C.restore_train_state_sharded(d2, guarded)
+        assert seeded.guard is not None and int(seeded.guard.count) == 0
+
+
+def test_cross_mesh_resume_interchange_bitwise(tmp_path):
+    """The rollback-on-a-reshaped-fleet contract (utils/checkpoint.py:221):
+    a sharded checkpoint written under an FSDP data-mesh layout restores
+    through ``restore_for_resume(..., shardings=)`` onto a TP model-mesh
+    BITWISE — tier-1 direct coverage for the interchange claim every
+    supervised rollback on a reshaped fleet leans on."""
+    import jax
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        TransformerClassifier,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        fsdp,
+        make_mesh,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        tensor_parallel as tp,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        checkpoint as C,
+    )
+
+    model = TransformerClassifier(dropout_rate=0.0)
+    mesh_a = make_mesh(8)                                  # data=8 (FSDP)
+    state = fsdp.shard_train_state(
+        mesh_a, create_train_state(model, jax.random.PRNGKey(0), guard=True))
+    d = str(tmp_path / "sharded.ckpt")
+    C.save_train_state_sharded(d, state)
+
+    mesh_b = make_mesh(4, axis_names=("model",))           # TP, different shape
+    template = create_train_state(model, jax.random.PRNGKey(9), guard=True)
+    shardings = tp.state_shardings(mesh_b, template)
+    restored, start_epoch, warning = C.restore_for_resume(
+        d, template, process_index=0, process_count=1, steps_per_epoch=4,
+        shardings=shardings)
+    assert start_epoch == 0 and warning is None
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(restored)),
+                    jax.tree_util.tree_leaves(jax.device_get(state))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ...and the restored copy actually lives on mesh B's layout.
+    leaf = jax.tree_util.tree_leaves(restored.params)[0]
+    assert leaf.sharding.mesh.shape.get("model") == 4
+
+
+# ----------------------------------------------------------- goodput attribution
+
+
+def _fake_streams(tmp_path, restart_reason):
+    """Two-attempt telemetry + supervisor streams with epoch 1 replayed."""
+    t0 = 1000.0
+    run = tmp_path / "run.jsonl"
+    rows = [
+        {"event": "manifest", "unix_time": t0, "t_s": 0.0},
+        {"event": "epoch", "epoch": 0, "steps": 4, "wall_s": 5.0,
+         "execute_s": 4.0, "eval_s": 0.5, "data_s": 0.2, "t_s": 10.0},
+        {"event": "epoch", "epoch": 1, "steps": 4, "wall_s": 5.0,
+         "execute_s": 4.0, "eval_s": 0.5, "data_s": 0.2, "t_s": 16.0},
+        # attempt 2 (resumed after the restart below), replays epoch 1
+        {"event": "manifest", "unix_time": t0 + 25.0, "t_s": 0.0},
+        {"event": "epoch", "epoch": 1, "steps": 4, "wall_s": 5.0,
+         "execute_s": 4.0, "eval_s": 0.5, "data_s": 0.2, "t_s": 10.0},
+        {"event": "epoch", "epoch": 2, "steps": 4, "wall_s": 5.0,
+         "execute_s": 4.0, "eval_s": 0.5, "data_s": 0.2, "t_s": 16.0},
+    ]
+    with open(run, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    sup = tmp_path / "supervisor.jsonl"
+    with open(sup, "w") as f:
+        f.write(json.dumps({"event": "restart", "attempt": 1, "restart": 1,
+                            "reason": restart_reason, "exit_code": 65,
+                            "unix_time": t0 + 20.0, "t_s": 20.0}) + "\n")
+        f.write(json.dumps({"event": "supervise_summary", "status": "ok",
+                            "exit_code": 0, "attempts": 2, "restarts": 1,
+                            "unix_time": t0 + 42.0, "t_s": 42.0}) + "\n")
+    return [str(run), str(sup)]
+
+
+@pytest.mark.parametrize("reason,rollback", [("poisoned", True),
+                                             ("desync", True),
+                                             ("crash", False)])
+def test_goodput_attributes_rollback_badput_by_cause(tmp_path, reason,
+                                                     rollback):
+    from csed_514_project_distributed_training_using_pytorch_tpu.obs import (
+        goodput,
+    )
+
+    report = goodput.decompose(_fake_streams(tmp_path, reason))
+    seg = report["segments"]
+    charged = seg["rollback_badput_s"] if rollback else seg["restart_badput_s"]
+    other = seg["restart_badput_s"] if rollback else seg["rollback_badput_s"]
+    # Gap (5s) + recovery init (5s) + replayed epoch 1 (5s) all charge to the
+    # CAUSE's segment; the other badput account stays exactly zero.
+    assert charged > 0.0 and other == 0.0
+    assert report["rollbacks"] == (1 if rollback else 0)
+    assert report["epochs_replayed"] == 1
+    assert sum(seg.values()) == pytest.approx(report["wall_s"], rel=0.01)
+    ev = goodput.goodput_event(report)
+    assert ev["rollback_badput_s"] == seg["rollback_badput_s"]
+    assert ev["rollbacks"] == report["rollbacks"]
+
+
+def test_param_fingerprint_is_local_and_sensitive(tmp_path):
+    """The fingerprint is a host-local fold over this process's addressable
+    shards (a jitted global reduction would all-reduce the corruption into
+    every replica's value): equal state -> equal value, one perturbed element
+    -> different value, and a sharded-but-locally-covering layout (the
+    8-virtual-device FSDP mesh) still fingerprints."""
+    import jax
+    import jax.numpy as jnp
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        build_model,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.step import (
+        create_train_state,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        telemetry as T,
+    )
+
+    st = create_train_state(build_model("cnn"), jax.random.PRNGKey(0))
+    fp = T.param_fingerprint(st.params)
+    assert fp is not None and fp > 0
+    assert T.param_fingerprint(st.params) == fp          # deterministic
+    leaves, treedef = jax.tree_util.tree_flatten(st.params)
+    flat0 = leaves[0].reshape(-1)
+    leaves[0] = flat0.at[0].set(flat0[0] + 1.0).reshape(leaves[0].shape)
+    assert T.param_fingerprint(
+        jax.tree_util.tree_unflatten(treedef, leaves)) != fp
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        TransformerClassifier,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+        fsdp,
+        make_mesh,
+    )
+
+    model = TransformerClassifier(dropout_rate=0.0)
+    state = create_train_state(model, jax.random.PRNGKey(0))
+    sharded = fsdp.shard_train_state(make_mesh(8), state)
+    fp_plain = T.param_fingerprint(state.params)
+    fp_sharded = T.param_fingerprint(sharded.params)
+    assert fp_sharded is not None
+    # Layout-invariant to f32 round-off (the fold order differs per layout).
+    assert fp_sharded == pytest.approx(fp_plain, rel=1e-5)
+
+
+def test_supervisor_seeds_skip_windows_from_command(tmp_path):
+    """argparse last-occurrence-wins means the supervisor's appended
+    --skip-steps REPLACES any user-supplied flag — so the supervisor must
+    seed its skip set from the command, or the first poisoned restart would
+    silently drop the user's known-bad windows."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+        poison,
+        supervisor as sup,
+    )
+
+    store = tmp_path / "store"
+    store.mkdir()
+    argv_log = tmp_path / "argv.jsonl"
+    # Synthetic trainer: first run writes a poison marker for step 9 and
+    # exits 65; the rerun (marker consumed by the supervisor -> absent)
+    # records its argv and exits 0.
+    child = (
+        "import json, os, sys\n"
+        f"store = {str(store)!r}\n"
+        f"log = {str(argv_log)!r}\n"
+        "with open(log, 'a') as f:\n"
+        "    f.write(json.dumps(sys.argv) + '\\n')\n"
+        "marker = os.path.join(store, 'poison.json')\n"
+        "flag = os.path.join(store, 'fired')\n"
+        "if not os.path.exists(flag):\n"
+        "    open(flag, 'w').close()\n"
+        "    json.dump({'window': [9, 10], 'step': 12, 'anomalies': 1},\n"
+        "              open(marker, 'w'))\n"
+        "    sys.exit(65)\n"
+        "sys.exit(0)\n"
+    )
+    cfg = sup.SupervisorConfig(num_processes=1, platform="cpu",
+                               devices_per_process=1, max_restarts=1,
+                               backoff_s=0.0, checkpoint_dir=str(store),
+                               attempt_timeout_s=60)
+    res = sup.supervise(["-c", child, "--skip-steps", "3:4"], cfg)
+    assert res.status == "ok" and res.rollbacks == 1
+    # The union, not just the new window: the user's 3:4 survived.
+    assert res.skip_windows == ((3, 4), (9, 10))
+    argvs = [json.loads(l) for l in open(argv_log)]
+    final = argvs[-1]
+    skips = [final[i + 1] for i, a in enumerate(final)
+             if a == "--skip-steps"]
+    assert skips[-1] == poison.format_skip_steps(((3, 4), (9, 10)))
+
+
+def test_goodput_cause_alignment_survives_silent_attempt(tmp_path):
+    """An attempt that died before writing ANY telemetry leaves no attempt
+    entry — the restart-cause join is by TIME, so the surviving attempt still
+    charges to the restart that actually spawned it (index-based alignment
+    would read the earlier crash row and mis-charge the rollback)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.obs import (
+        goodput,
+    )
+
+    t0 = 1000.0
+    run = tmp_path / "run.jsonl"
+    rows = [
+        {"event": "manifest", "unix_time": t0, "t_s": 0.0},
+        {"event": "epoch", "epoch": 0, "steps": 4, "wall_s": 5.0,
+         "execute_s": 4.0, "eval_s": 0.5, "data_s": 0.2, "t_s": 10.0},
+        # attempt 2 (spawned by the crash restart) wrote nothing at all;
+        # attempt 3 (spawned by the poisoned restart) replays epoch 0.
+        {"event": "manifest", "unix_time": t0 + 35.0, "t_s": 0.0},
+        {"event": "epoch", "epoch": 0, "steps": 4, "wall_s": 5.0,
+         "execute_s": 4.0, "eval_s": 0.5, "data_s": 0.2, "t_s": 10.0},
+    ]
+    with open(run, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    sup_path = tmp_path / "supervisor.jsonl"
+    with open(sup_path, "w") as f:
+        f.write(json.dumps({"event": "restart", "attempt": 1, "restart": 1,
+                            "reason": "crash", "exit_code": 41,
+                            "unix_time": t0 + 20.0, "t_s": 20.0}) + "\n")
+        f.write(json.dumps({"event": "restart", "attempt": 2, "restart": 2,
+                            "reason": "poisoned", "exit_code": 65,
+                            "unix_time": t0 + 30.0, "t_s": 30.0}) + "\n")
+    report = goodput.decompose([str(run), str(sup_path)])
+    assert report["rollbacks"] == 1
+    # The replayed epoch belongs to the attempt the POISONED restart spawned.
+    assert report["segments"]["rollback_badput_s"] > 0.0
+
+
+# -------------------------------------------------------- report + fleet_top
+
+
+def test_report_renders_anomaly_and_rollback_rows(tmp_path, capsys):
+    import tools.telemetry_report as tr
+
+    path = tmp_path / "t.jsonl"
+    rows = [
+        {"event": "anomaly", "epoch": 2, "steps": 4, "anomalies": 2,
+         "nonfinite": 1, "spikes": 1, "skipped": 3, "clean_steps": 9,
+         "first_anomaly_step": 6, "last_anomaly_step": 9,
+         "grad_norm_ema": 2.5, "grad_norm_std": 0.1, "fingerprint": 672.4,
+         "skip": "6:7"},
+        {"event": "restart", "attempt": 1, "restart": 1, "reason": "poisoned",
+         "exit_code": 65, "resume_from": "x", "skip": "6:7",
+         "rollback": True, "backoff_s": 0.0},
+        {"event": "restart", "attempt": 2, "restart": 2, "reason": "crash",
+         "exit_code": 41, "resume_from": "x", "backoff_s": 0.0},
+    ]
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    s = tr.summarize(str(path))
+    assert s["anomalies"] == 2 and s["skipped_steps"] == 3
+    assert s["rollbacks"] == 1 and s["restarts"] == 2
+    assert s.get("unknown_events") is None     # "anomaly" is registered
+    tr.print_summary(s)
+    out = capsys.readouterr().out
+    assert "anomaly guard: 2 anomalies" in out
+    assert "1 rollback(s)" in out
+    # The A-vs-B table carries the new rows.
+    keys = [k for _, k in tr.COMPARE_ROWS]
+    assert {"anomalies", "skipped_steps", "rollbacks",
+            "rollback_badput_s"} <= set(keys)
+    gp_keys = [k for _, k in tr.GOODPUT_ROWS]
+    assert {"rollback_badput_s", "rollbacks"} <= set(gp_keys)
+
+
+def test_fleet_top_renders_anomaly_line(tmp_path):
+    from tools.fleet_top import FleetState, JsonlTail, render
+
+    path = tmp_path / "f.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"event": "anomaly", "anomalies": 2, "nonfinite": 1,
+                            "spikes": 1, "skipped": 3, "skip": "6:7",
+                            "t_s": 1.0}) + "\n")
+        f.write(json.dumps({"event": "restart", "reason": "poisoned",
+                            "skip": "6:7", "t_s": 2.0}) + "\n")
+    state = FleetState()
+    state.feed(JsonlTail(str(path)).poll())
+    frame = render(state, str(path))
+    assert "anomalies 2" in frame and "skipped 3" in frame
+    assert "rollbacks 1" in frame
+    assert "restart (poisoned) skipping 6:7" in frame
+
+
+# ------------------------------------------------- supervised rollback e2e
+
+
+@pytest.fixture(autouse=True)
+def _child_pythonpath(monkeypatch):
+    existing = os.environ.get("PYTHONPATH", "")
+    monkeypatch.setenv("PYTHONPATH", f"{REPO}:{existing}" if existing else REPO)
+
+
+TRAIN = ["-m", f"{PKG}.train.distributed",
+         "--epochs", "3", "--global-batch-size", "64",
+         "--batch-size-test", "256",
+         "--max-train-examples", "256", "--max-test-examples", "256",
+         "--keep-checkpoints", "5", "--guard", "--anomaly-exit", "1"]
+
+
+def test_supervisor_rolls_back_and_skips_to_bitwise_oracle(tmp_path,
+                                                           monkeypatch):
+    """The acceptance path in miniature (the committed
+    bench_results/anomaly_train_cpu/ artifact runs the two-injection flavor):
+    one spike injected mid-run -> the guard detects it, the trainer exits 65,
+    the supervisor rolls back to the older CLEAN checkpoint (the unclean
+    stamp is skipped — resume_history pins the choice) and restarts with
+    --skip-steps; the finished run is bitwise identical to an unfaulted
+    oracle trained with the same skip set."""
+    import jax
+    from flax import serialization
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+        supervisor as sup,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.launch import (
+        launch,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        checkpoint as C,
+    )
+
+    work = tmp_path / "supervised"
+    work.mkdir()
+    monkeypatch.chdir(work)
+    monkeypatch.setenv("RESILIENCE_FAULTS", "spike:step=6,scale=1e6")
+    store = str(work / "results" / "checkpoints")
+    cfg = sup.SupervisorConfig(num_processes=1, platform="cpu",
+                               devices_per_process=1, max_restarts=2,
+                               backoff_s=0.0, checkpoint_dir=store,
+                               attempt_timeout_s=300,
+                               telemetry=str(work / "supervisor.jsonl"))
+    res = sup.supervise(TRAIN + ["--telemetry", "run.jsonl"], cfg)
+    assert (res.status, res.exit_code) == ("ok", 0)
+    assert res.rollbacks == 1 and res.skip_windows == ((6, 7),)
+    # Rollback landed on the CLEAN step-4 checkpoint, not the newest (step-8,
+    # stamped unclean) one — the _newest_valid regression, pinned end-to-end.
+    ckpt4 = os.path.join(store, C.versioned_name(4))
+    assert res.resume_history == [None, ckpt4]
+    restarts = [json.loads(l) for l in open(work / "supervisor.jsonl")
+                if '"restart"' in l]
+    assert restarts[0]["reason"] == "poisoned" and restarts[0]["skip"] == "6:7"
+
+    monkeypatch.delenv("RESILIENCE_FAULTS")
+    oracle = tmp_path / "oracle"
+    oracle.mkdir()
+    monkeypatch.chdir(oracle)
+    assert launch(TRAIN + ["--skip-steps", "6:7"], num_processes=1,
+                  platform="cpu", devices_per_process=1, timeout=300) == 0
+    final_sup = C.newest_valid_checkpoint(store)
+    final_or = C.newest_valid_checkpoint(
+        str(oracle / "results" / "checkpoints"))
+    a = serialization.msgpack_restore(open(final_sup, "rb").read())
+    b = serialization.msgpack_restore(open(final_or, "rb").read())
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb) and int(a["step"]) == 12
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    # The anomaly events survived into the preserved multi-attempt history,
+    # and the goodput ledger charges the replay to rollback (not restart)
+    # badput, summing to wall.
+    from csed_514_project_distributed_training_using_pytorch_tpu.obs import (
+        goodput,
+    )
+
+    report = goodput.decompose([str(work / "run.jsonl"),
+                                str(work / "supervisor.jsonl")])
+    assert report["rollbacks"] == 1
+    assert report["segments"]["rollback_badput_s"] > 0.0
+    assert report["segments"]["restart_badput_s"] == 0.0
+    assert sum(report["segments"].values()) == pytest.approx(
+        report["wall_s"], rel=0.01)
+
+
+def test_supervisor_desync_classification(tmp_path, monkeypatch):
+    """Fingerprint-verify mode end-to-end with a synthetic fleet: two children
+    report DIFFERENT param fingerprints at the same step -> the supervisor
+    tears the fleet down with reason 'desync' (a rollback, not a crash); the
+    restarted children (flag file present) exit clean."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+        supervisor as sup,
+    )
+
+    hb_dir = tmp_path / "hb"
+    flag = tmp_path / "attempt2"
+    child = (
+        "import json, os, sys, time\n"
+        f"flag = {str(flag)!r}\n"
+        "if os.path.exists(flag):\n"
+        "    sys.exit(0)\n"
+        "open(flag + '.p' + os.environ['JAX_PROCESS_ID'], 'w').close()\n"
+        "if len([f for f in os.listdir(os.path.dirname(flag))\n"
+        "        if f.startswith(os.path.basename(flag))]) >= 2:\n"
+        "    open(flag, 'w').close()\n"
+        "from csed_514_project_distributed_training_using_pytorch_tpu."
+        "resilience import heartbeat\n"
+        "i = int(os.environ['JAX_PROCESS_ID'])\n"
+        f"w = heartbeat.HeartbeatWriter({str(hb_dir)!r}, process_index=i)\n"
+        "w.beat(step=8, epoch=2, fingerprint=100.0 + i)\n"
+        "time.sleep(60)\n"
+    )
+    cfg = sup.SupervisorConfig(num_processes=2, platform="cpu",
+                               devices_per_process=1, max_restarts=1,
+                               backoff_s=0.0, heartbeat_dir=str(hb_dir),
+                               fingerprint_verify=True, attempt_timeout_s=60,
+                               telemetry=str(tmp_path / "supervisor.jsonl"))
+    res = sup.supervise(["-c", child], cfg)
+    assert res.status == "ok" and res.rollbacks == 1
+    restarts = [json.loads(l) for l in open(tmp_path / "supervisor.jsonl")
+                if '"restart"' in l]
+    assert restarts[0]["reason"] == "desync"
+    assert restarts[0]["exit_code"] == sup.EXIT_TORN_DOWN

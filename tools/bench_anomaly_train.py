@@ -1,0 +1,282 @@
+"""Acceptance harness for the numerical immune system (train/step.py --guard).
+
+Four legs over the SAME tiny supervised workload (train.distributed, one CPU
+process, synthetic MNIST fixture, 4 steps/epoch x 3 epochs), gates asserted by
+exit code and the whole ledger written to ``--out-dir``:
+
+1. **faulted** — grad poison armed (``spike:step=6,scale=1e6`` +
+   ``nan:step=9``) under the supervisor with ``--guard --anomaly-exit 1``: the
+   guard must detect BOTH injections, apply identity updates instead of
+   garbage, exit 65 ("poisoned") at each offending epoch boundary; the
+   supervisor must roll back to the newest HEALTHY checkpoint and restart with
+   the accumulated ``--skip-steps`` set (scattered second poison also arms
+   fingerprint-verify), and the run must complete.
+2. **oracle** — NO faults, trained start-to-finish with the faulted leg's
+   final skip set: final params must be **bitwise identical** to the faulted
+   supervised run's final checkpoint (the rollback-and-skip contract: a cured
+   run IS the run that never saw the poison).
+3/4. **flag pins** — guard-on-no-faults vs guard-off: bitwise identical
+   (the guard adds verdict+select ops but an anomaly-free verdict selects the
+   fresh update exactly), pinning today's trainer behavior.
+
+Goodput: the faulted leg's joined telemetry+supervisor streams must decompose
+with ``rollback_badput_s > 0``, ``restart_badput_s == 0`` (no process crashed
+— the math did), and segments summing to wall +/-1%; the oracle leg must show
+both badputs exactly 0.0.
+
+Checkpoint hygiene: every file in the faulted store decodes with all-finite
+params, and every rollback resume target carried a clean health stamp — a
+poisoned state is never checkpointed, and never resumed from.
+
+Committed artifact: ``bench_results/anomaly_train_cpu/`` (summary.json +
+goodput.json + the two telemetry streams). ``--quick`` skips the flag-pin
+legs (CI smoke).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PKG = "csed_514_project_distributed_training_using_pytorch_tpu"
+
+SPIKE_STEP, NAN_STEP = 6, 9
+FAULTS = f"spike:step={SPIKE_STEP},scale=1e6;nan:step={NAN_STEP}"
+INJECTIONS = 2
+
+
+def train_cmd(*extra: str) -> list[str]:
+    return ["-m", f"{PKG}.train.distributed",
+            "--epochs", "3", "--global-batch-size", "64",
+            "--batch-size-test", "256",
+            "--max-train-examples", "256", "--max-test-examples", "256",
+            "--keep-checkpoints", "5", *extra]
+
+
+def leaves_of(path: str, *, params_only: bool = False):
+    import jax
+    from flax import serialization
+
+    with open(path, "rb") as f:
+        tree = serialization.msgpack_restore(f.read())
+    if params_only:
+        # The flag-pin comparison: a guarded checkpoint carries 9 extra
+        # detector scalars by design — the pin is about the MODEL trajectory
+        # (params + optimizer state + step), not the carry bookkeeping.
+        tree = {k: tree[k] for k in ("params", "velocity", "step")}
+    return jax.tree_util.tree_leaves(tree)
+
+
+def assert_bitwise(path_a: str, path_b: str, what: str, *,
+                   params_only: bool = False) -> int:
+    import numpy as np
+
+    la = leaves_of(path_a, params_only=params_only)
+    lb = leaves_of(path_b, params_only=params_only)
+    assert len(la) == len(lb), f"{what}: leaf count {len(la)} != {len(lb)}"
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=what)
+    return len(la)
+
+
+def run_leg(workdir: str, cmd_extra: list[str], *, faults: str = "",
+            supervised: bool = False, telemetry: str = "run.jsonl"):
+    """One training leg in its own cwd; returns (store_dir, supervise result or
+    exit code)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+        supervisor as sup,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.train.launch import (
+        launch,
+    )
+
+    os.makedirs(workdir, exist_ok=True)
+    cwd = os.getcwd()
+    # Children run from the leg's scratch cwd — they must still find the repo.
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    existing = os.environ.get("PYTHONPATH", "")
+    if repo not in existing.split(os.pathsep):
+        os.environ["PYTHONPATH"] = (f"{repo}{os.pathsep}{existing}"
+                                    if existing else repo)
+    if faults:
+        os.environ["RESILIENCE_FAULTS"] = faults
+    else:
+        os.environ.pop("RESILIENCE_FAULTS", None)
+    try:
+        os.chdir(workdir)
+        store = os.path.join(os.getcwd(), "results", "checkpoints")
+        cmd = train_cmd(*cmd_extra) + ["--telemetry", telemetry]
+        if supervised:
+            cfg = sup.SupervisorConfig(
+                num_processes=1, platform="cpu", devices_per_process=1,
+                max_restarts=4, backoff_s=0.0, checkpoint_dir=store,
+                attempt_timeout_s=600,
+                telemetry=os.path.join(os.getcwd(), "supervisor.jsonl"))
+            return store, sup.supervise(cmd, cfg)
+        rc = launch(cmd, num_processes=1, platform="cpu",
+                    devices_per_process=1, timeout=600)
+        return store, rc
+    finally:
+        os.environ.pop("RESILIENCE_FAULTS", None)
+        os.chdir(cwd)
+
+
+def attempt_anomaly_counts(run_jsonl: str) -> list[int]:
+    """Per-attempt detected-anomaly count: split the preserved multi-attempt
+    telemetry at each manifest, take the attempt's final cumulative counter
+    (each attempt resumes from a CLEAN checkpoint, so its baseline is 0)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.jsonl import (
+        read_jsonl,
+    )
+
+    counts: list[int] = []
+    for row in read_jsonl(run_jsonl):
+        if row.get("event") == "manifest":
+            counts.append(0)
+        elif row.get("event") == "anomaly" and counts:
+            counts[-1] = max(counts[-1], int(row.get("anomalies") or 0))
+    return counts
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    p.add_argument("--out-dir", default="bench_results/anomaly_train_cpu")
+    p.add_argument("--work-dir", default="",
+                   help="scratch dir for the runs (default: <out-dir>/work, "
+                        "removed on success)")
+    p.add_argument("--quick", action="store_true",
+                   help="skip the flag-pin legs (CI smoke)")
+    args = p.parse_args(argv)
+
+    import numpy as np  # noqa: F401  (assert_bitwise)
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.obs import (
+        goodput,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.resilience import (
+        poison,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        checkpoint as ckpt,
+    )
+
+    out_dir = os.path.abspath(args.out_dir)
+    work = os.path.abspath(args.work_dir or os.path.join(out_dir, "work"))
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(out_dir, exist_ok=True)
+    summary: dict = {"faults": FAULTS, "injections": INJECTIONS}
+    gates: dict[str, bool] = {}
+
+    # -- leg 1: faulted supervised run --------------------------------------
+    print(f"[anomaly-bench] leg 1/4: faulted supervised run ({FAULTS})")
+    f_store, res = run_leg(os.path.join(work, "faulted"),
+                           ["--guard", "--anomaly-exit", "1"],
+                           faults=FAULTS, supervised=True)
+    skip = poison.format_skip_steps(res.skip_windows)
+    summary["faulted"] = {
+        "status": res.status, "attempts": res.attempts,
+        "restarts": res.restarts, "rollbacks": res.rollbacks,
+        "skip_windows": skip,
+        "resume_history": res.resume_history,
+    }
+    gates["faulted_completes"] = res.status == "ok"
+    gates["two_rollbacks"] = res.rollbacks == INJECTIONS
+    gates["skip_covers_injections"] = res.skip_windows == (
+        (SPIKE_STEP, SPIKE_STEP + 1), (NAN_STEP, NAN_STEP + 1))
+
+    # Every injection detected (per-attempt anomaly counters sum to the
+    # injection count — each injection is detected exactly once, by the
+    # attempt that first met it outside a skip window).
+    run_jsonl = os.path.join(work, "faulted", "run.jsonl")
+    counts = attempt_anomaly_counts(run_jsonl)
+    summary["faulted"]["per_attempt_anomalies"] = counts
+    gates["every_injection_detected"] = sum(counts) == INJECTIONS
+
+    # No poisoned state ever checkpointed: every surviving store file decodes
+    # with all-finite params; every rollback resume target was stamped clean.
+    manifest = ckpt.load_manifest(f_store)
+    finite = True
+    for e in manifest["entries"]:
+        for leaf in leaves_of(os.path.join(f_store, e["file"])):
+            import numpy as _np
+            arr = _np.asarray(leaf)
+            if arr.dtype.kind == "f" and not _np.isfinite(arr).all():
+                finite = False
+    gates["checkpoints_all_finite"] = finite
+    stamps = {e["file"]: (e.get("health") or {}) for e in manifest["entries"]}
+    resumed_clean = all(
+        stamps.get(os.path.basename(r), {}).get("clean", True) is True
+        for r in res.resume_history if r)
+    gates["rollback_targets_clean"] = resumed_clean
+    summary["faulted"]["manifest_stamps"] = {
+        e["file"]: e.get("health") for e in manifest["entries"]}
+
+    # -- leg 2: unfaulted oracle with the same skip set ---------------------
+    print(f"[anomaly-bench] leg 2/4: oracle (no faults, --skip-steps {skip})")
+    o_store, rc = run_leg(os.path.join(work, "oracle"),
+                          ["--guard", "--skip-steps", skip])
+    gates["oracle_completes"] = rc == 0
+    n_leaves = assert_bitwise(ckpt.newest_valid_checkpoint(f_store),
+                              ckpt.newest_valid_checkpoint(o_store),
+                              "faulted-final vs oracle-final")
+    gates["bitwise_oracle_match"] = True
+    summary["oracle"] = {"exit": rc, "leaves_compared": n_leaves}
+
+    # -- goodput: rollback replay charged to rollback_badput ----------------
+    faulted_gp = goodput.decompose([os.path.join(work, "faulted")])
+    seg = faulted_gp["segments"]
+    gates["rollback_badput_positive"] = seg["rollback_badput_s"] > 0.0
+    gates["no_crash_badput"] = seg["restart_badput_s"] == 0.0
+    total = sum(seg.values())
+    gates["segments_sum_to_wall"] = (
+        abs(total - faulted_gp["wall_s"]) <= 0.01 * faulted_gp["wall_s"]
+        and faulted_gp["unaccounted_s"] <= 0.01 * faulted_gp["wall_s"])
+    oracle_gp = goodput.decompose([os.path.join(work, "oracle", "run.jsonl")])
+    gates["oracle_zero_badput"] = (
+        oracle_gp["segments"]["restart_badput_s"] == 0.0
+        and oracle_gp["segments"]["rollback_badput_s"] == 0.0)
+    summary["goodput"] = {"faulted": faulted_gp, "oracle": oracle_gp}
+
+    # -- legs 3/4: flag-off pins --------------------------------------------
+    if not args.quick:
+        print("[anomaly-bench] leg 3/4: guard-on clean pin")
+        g_store, rc_g = run_leg(os.path.join(work, "pin_guard"), ["--guard"])
+        print("[anomaly-bench] leg 4/4: guard-off pin")
+        p_store, rc_p = run_leg(os.path.join(work, "pin_plain"), [])
+        gates["pin_legs_complete"] = rc_g == 0 and rc_p == 0
+        assert_bitwise(ckpt.newest_valid_checkpoint(g_store),
+                       ckpt.newest_valid_checkpoint(p_store),
+                       "guard-on-clean vs guard-off", params_only=True)
+        gates["guard_flag_bitwise_inert"] = True
+
+    summary["gates"] = gates
+    summary["ok"] = all(gates.values())
+
+    # Commit the artifact: summary + goodput + the two faulted streams.
+    with open(os.path.join(out_dir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=1, default=str)
+    with open(os.path.join(out_dir, "goodput.json"), "w") as f:
+        json.dump(summary["goodput"], f, indent=1, default=str)
+    for name in ("run.jsonl", "supervisor.jsonl"):
+        src = os.path.join(work, "faulted", name)
+        if os.path.exists(src):
+            shutil.copy(src, os.path.join(out_dir, name))
+
+    print(f"[anomaly-bench] gates: "
+          + "  ".join(f"{k}={'PASS' if v else 'FAIL'}"
+                      for k, v in gates.items()))
+    print(f"[anomaly-bench] artifact: {out_dir} "
+          f"({'OK' if summary['ok'] else 'FAILED'})")
+    if summary["ok"]:
+        shutil.rmtree(work, ignore_errors=True)
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,12 @@ from the last checkpoint whose checksum verifies. SIGTERM the supervisor itself 
 preempt the whole run: it forwards the signal, the trainers stop at the next epoch
 boundary with a durable checkpoint, and everything exits 75 ("preempted, resumable").
 
+A ``--guard`` trainer that trips its ``--anomaly-exit`` policy exits 65
+("poisoned": the math failed, not the process) — the supervisor then rolls back
+to the newest HEALTHY (health-stamped-clean) checkpoint and restarts with a
+``--skip-steps`` window covering the poisoned steps; repeated poison widens the
+window, scattered poison arms cross-replica fingerprint verification.
+
 Exit status: 0 on success, 75 when preempted, otherwise the fleet's failing exit code.
 Render the supervisor's telemetry (restart events) with ``tools/telemetry_report.py``.
 """
@@ -64,6 +70,12 @@ def main(argv: list[str] | None = None) -> int:
                         "set comfortably above one epoch's wall time")
     p.add_argument("--attempt-timeout", type=float, default=0.0,
                    help="wall-clock bound per attempt (0 = unbounded)")
+    p.add_argument("--fingerprint-verify", action="store_true",
+                   help="compare cross-replica heartbeat param fingerprints "
+                        "(--guard trainers emit them): a mismatch at the same "
+                        "step is classified 'desync' and rolled back like "
+                        "poison. Auto-armed when poison lands at scattered "
+                        "steps")
     p.add_argument("--telemetry", default="",
                    help="supervisor JSONL (restart events) path")
     p.add_argument("command", nargs=argparse.REMAINDER,
@@ -80,7 +92,8 @@ def main(argv: list[str] | None = None) -> int:
         backoff_max_s=args.backoff_max, checkpoint_dir=args.checkpoint_dir,
         heartbeat_dir=args.heartbeat_dir,
         heartbeat_timeout_s=args.heartbeat_timeout,
-        attempt_timeout_s=args.attempt_timeout, telemetry=args.telemetry)
+        attempt_timeout_s=args.attempt_timeout, telemetry=args.telemetry,
+        fingerprint_verify=args.fingerprint_verify)
     result = supervise(command, cfg)
     print(f"[supervisor] {result.status}: exit {result.exit_code}, "
           f"{result.attempts} attempt(s), {result.restarts} restart(s)")

@@ -81,6 +81,8 @@ class FleetState:
         self.snapshot: dict | None = None
         self.summary: dict | None = None
         self.slo: dict | None = None
+        self.anomaly: dict | None = None     # latest --guard verdict
+        self.rollbacks = 0                   # poisoned/desync restarts seen
         self.snapshots = 0
         self.recent: list[str] = []
         self._events_tail = events_tail
@@ -98,7 +100,10 @@ class FleetState:
                 self.summary = r
             elif kind == "slo":
                 self.slo = r
-            elif kind in ("scale", "replica", "eject", "hedge", "chaos"):
+            elif kind == "anomaly":
+                self.anomaly = r
+            elif kind in ("scale", "replica", "eject", "hedge", "chaos",
+                          "restart"):
                 t = r.get("t_s")
                 stamp = "-" if t is None else f"+{t:.1f}s"
                 if kind == "scale":
@@ -115,6 +120,12 @@ class FleetState:
                 elif kind == "chaos":
                     what = (f"chaos {r.get('kind')} on replica "
                             f"{r.get('replica')} ({r.get('dir')})")
+                elif kind == "restart":
+                    if r.get("reason") in ("poisoned", "desync"):
+                        self.rollbacks += 1
+                    what = (f"restart ({r.get('reason')})"
+                            + (f" skipping {r['skip']}" if r.get("skip")
+                               else ""))
                 else:
                     what = (f"replica {r.get('replica')} {r.get('action')}"
                             + (f" ({r.get('reason')})" if r.get("reason")
@@ -171,6 +182,18 @@ def render(state: FleetState, path: str) -> str:
             f"  hedges {_fmt(snap.get('hedges'))}"
             f" (wins {_fmt(snap.get('hedge_wins'))})"
             f"  wire corrupt {_fmt(snap.get('wire_corrupt'))}")
+    if state.anomaly or state.rollbacks:
+        # The training-integrity row (--guard runs): detected anomalies, the
+        # identity-skipped steps, and how many supervised rollbacks the run
+        # has absorbed.
+        a = state.anomaly or {}
+        lines.append(
+            f"  anomalies {_fmt(a.get('anomalies'))}"
+            f" ({_fmt(a.get('nonfinite'))} nonfinite,"
+            f" {_fmt(a.get('spikes'))} spikes)"
+            f"  skipped {_fmt(a.get('skipped'))}"
+            f"  rollbacks {_fmt(state.rollbacks)}"
+            + (f"  skip {a['skip']}" if a.get("skip") else ""))
     slo = snap.get("slo")
     if slo:
         lines.append(

@@ -27,6 +27,7 @@ BACKEND_FREE = (
     "resilience/preemption.py",
     "resilience/faults.py",
     "resilience/netfaults.py",
+    "resilience/poison.py",
     "utils/jsonl.py",
     "utils/trace.py",
     "utils/telemetry_events.py",

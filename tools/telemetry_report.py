@@ -436,6 +436,7 @@ def summarize(path: str) -> dict:
         s["badput_frac"] = gp.get("badput_frac")
         s["compute_s"] = gp.get("compute_s")
         s["restart_badput_s"] = gp.get("restart_badput_s")
+        s["rollback_badput_s"] = gp.get("rollback_badput_s")
         s["goodput_wall_s"] = gp.get("wall_s")
         s["epochs_replayed"] = gp.get("epochs_replayed")
 
@@ -451,12 +452,29 @@ def summarize(path: str) -> dict:
             if g.get("metric"):
                 s[f"guard_{g['metric']}"] = g.get("median_s")
 
+    # Numerical-immune-system verdicts (train/step.py --guard): the last
+    # anomaly event carries the attempt's cumulative counters; rollbacks are
+    # the poisoned/desync restarts the supervisor performed in response.
+    anomaly_evs = by_event.get("anomaly", [])
+    if anomaly_evs:
+        last = anomaly_evs[-1]
+        s["anomalies"] = last.get("anomalies")
+        s["anomaly_nonfinite"] = last.get("nonfinite")
+        s["anomaly_spikes"] = last.get("spikes")
+        s["skipped_steps"] = last.get("skipped")
+        s["anomaly_fingerprint"] = last.get("fingerprint")
+        s["anomaly_skip_windows"] = last.get("skip") or None
+
     # Resilience events: supervisor restarts (resilience/supervisor.py telemetry)
     # and cooperative preemption stops.
     restarts = by_event.get("restart", [])
     if restarts:
         s["restarts"] = len(restarts)
         s["restart_reasons"] = [r.get("reason") for r in restarts]
+        rollbacks = sum(r.get("reason") in ("poisoned", "desync")
+                        for r in restarts)
+        if rollbacks:
+            s["rollbacks"] = rollbacks
     preempts = by_event.get("preempt", [])
     if preempts:
         s["preempted_step"] = preempts[-1].get("step")
@@ -502,8 +520,20 @@ def print_summary(s: dict) -> None:
             parts.append(f"{s['ckpt_restores']} restore(s) "
                          f"(median {_fmt(s.get('ckpt_restore_s'))}s)")
         print(f"   checkpoint: {', '.join(parts)}")
+    if s.get("anomalies") is not None:
+        # The immune-system line: what the guard saw, what it refused to
+        # apply, and the replay windows in force.
+        skip = (f"  skip windows {s['anomaly_skip_windows']}"
+                if s.get("anomaly_skip_windows") else "")
+        print(f"   anomaly guard: {_fmt(s['anomalies'])} anomalies "
+              f"({_fmt(s.get('anomaly_nonfinite'))} nonfinite, "
+              f"{_fmt(s.get('anomaly_spikes'))} spikes)  "
+              f"{_fmt(s.get('skipped_steps'))} skipped step(s)  "
+              f"fingerprint {_fmt(s.get('anomaly_fingerprint'))}{skip}")
     if s.get("restarts"):
-        print(f"   restarts: {s['restarts']} ({', '.join(s['restart_reasons'])})")
+        rb = (f", {s['rollbacks']} rollback(s)" if s.get("rollbacks") else "")
+        print(f"   restarts: {s['restarts']} "
+              f"({', '.join(s['restart_reasons'])}{rb})")
     if s.get("preempted_step") is not None:
         ck = f" -> {s['preempted_ckpt']}" if s.get("preempted_ckpt") else ""
         print(f"   preempted at step {s['preempted_step']}{ck}")
@@ -659,8 +689,12 @@ COMPARE_ROWS = [
     ("val_loss", "final_val_loss"),
     ("ckpt_save_s", "ckpt_save_s"),
     ("restarts", "restarts"),
+    ("anomalies", "anomalies"),
+    ("skipped steps", "skipped_steps"),
+    ("rollbacks", "rollbacks"),
     ("goodput frac", "goodput_frac"),
     ("restart badput s", "restart_badput_s"),
+    ("rollback badput s", "rollback_badput_s"),
     ("slo attainment", "slo_attainment"),
     ("shed", "shed"),
     ("preemptions", "preemptions"),
@@ -702,11 +736,13 @@ GOODPUT_ROWS = [
     ("data wait s", "data_wait_s"),
     ("ckpt stall s", "checkpoint_stall_s"),
     ("restart badput s", "restart_badput_s"),
+    ("rollback badput s", "rollback_badput_s"),
     ("idle s", "idle_s"),
     ("goodput frac", "goodput_frac"),
     ("badput frac", "badput_frac"),
     ("attempts", "attempts"),
     ("restarts", "restarts"),
+    ("rollbacks", "rollbacks"),
     ("epochs replayed", "epochs_replayed"),
     ("replayed steps", "replayed_steps"),
 ]
@@ -723,8 +759,10 @@ def print_goodput(report: dict, label: str) -> None:
     to 1 (modulo the surfaced unaccounted residue)."""
     wall = report["wall_s"]
     print(f"== {label}  (goodput ledger over {_fmt(wall)}s wall)")
-    print(f"   attempts {report['attempts']}  restarts {report['restarts']}  "
-          f"epochs {report['epochs']} "
+    print(f"   attempts {report['attempts']}  restarts {report['restarts']}"
+          + (f" ({report['rollbacks']} rollback(s))"
+             if report.get("rollbacks") else "")
+          + f"  epochs {report['epochs']} "
           f"({report['epochs_replayed']} replayed, "
           f"{report['replayed_steps']} replayed step(s))"
           + ("  [preempted]" if report.get("preempted") else ""))
