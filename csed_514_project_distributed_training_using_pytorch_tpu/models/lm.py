@@ -201,6 +201,19 @@ DECODE_SEGMENT = 128   # generate()'s static-prefix growth unit: segment j atten
                        # of per-segment scan bodies compile in seconds
 
 
+# Axis SEMANTICS of the cache planes init_cache builds, by leaf name — the
+# contract serving/shard.py maps onto a device mesh (slots are independent
+# requests -> slot-DP; attention is embarrassingly parallel over KV heads ->
+# TP). Kept here, next to the allocation, so a plane-layout change and its
+# sharding rule can never drift apart.
+KV_PLANE_AXES: dict[str, tuple[str, ...]] = {
+    "k": ("slot", "position", "kv_head", "head_dim"),
+    "v": ("slot", "position", "kv_head", "head_dim"),
+    "k_scale": ("slot", "position", "kv_head"),
+    "v_scale": ("slot", "position", "kv_head"),
+}
+
+
 def init_cache(model: TransformerLM, batch: int, *,
                kv_dtype: str | None = None) -> dict:
     """Zeroed per-layer K/V caches ``[B, seq_len, KV_H, Dh]`` in the model's

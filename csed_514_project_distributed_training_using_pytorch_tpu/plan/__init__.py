@@ -34,10 +34,12 @@ from csed_514_project_distributed_training_using_pytorch_tpu.plan.artifact impor
     Plan,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.plan.costs import (
-    Candidate, CostBreakdown, ModelStats, Topology, predict,
+    Candidate, CostBreakdown, ModelStats, ServeCostBreakdown, ServeStats,
+    Topology, predict, predict_serve,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.plan.search import (
-    Ranked, Scenario, enumerate_candidates, search,
+    Ranked, Scenario, ServeRanked, ServeScenario, enumerate_candidates,
+    enumerate_serve_candidates, search, search_serve,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.plan import (
     autotune, scenarios,
@@ -47,6 +49,8 @@ __all__ = [
     "Plan", "Candidate", "CostBreakdown", "ModelStats", "Topology", "Ranked",
     "Scenario", "predict", "enumerate_candidates", "search", "autotune",
     "scenarios", "resolve", "apply_plan", "AUTOTUNE_TOP_K",
+    "ServeStats", "ServeCostBreakdown", "ServeScenario", "ServeRanked",
+    "predict_serve", "enumerate_serve_candidates", "search_serve",
 ]
 
 AUTOTUNE_TOP_K = 3

@@ -187,6 +187,25 @@ class CostBreakdown:
         return dataclasses.asdict(self)
 
 
+# Nominal per-chip HBM streaming bandwidth (bytes/s) by device_kind substring —
+# the serving cost model's denominator (batched decode is memory-bound: every
+# step re-reads the params and the resident KV planes). Same contract as the
+# ICI table: ranking-only nominal values, falsified by measured tokens/s.
+HBM_BYTES_BY_KIND = [
+    ("v6", 1.6e12), ("v5p", 2.8e12), ("v5", 8.2e11), ("v4", 1.2e12),
+    ("v3", 9.0e11), ("v2", 7.0e11),
+]
+DEFAULT_HBM_BYTES = 5.0e10    # unknown kind / CPU test platform: deterministic
+
+
+def hbm_bytes_per_s(device_kind: str) -> float:
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.benchmarks import (
+        lookup_by_kind,
+    )
+
+    return lookup_by_kind(HBM_BYTES_BY_KIND, device_kind, DEFAULT_HBM_BYTES)
+
+
 def _ring_time(nbytes: float, participants: int, link_bytes_per_s: float) -> float:
     """Ring all-reduce wall time for ``nbytes`` of payload per participant:
     ``2(n-1)/n`` traversals of the payload over one link's bandwidth (the
@@ -295,3 +314,129 @@ def predict(stats: ModelStats, topo: Topology, cand: Candidate, *,
         grad_bytes_per_chip=grad_pc, act_bytes_per_chip=act_pc,
         total_bytes_per_chip=total_pc, hbm_budget_bytes=budget,
         fits=total_pc <= budget)
+
+
+# =========================================================================================
+# Serving: price a TP×(slot-DP) replica mesh (serving/shard.py) — the decode
+# regime is the inverse of training: no optimizer/grad state, memory-BOUND
+# steps (every decode step re-reads params + resident KV), and the objective
+# is tokens/s and admissible slots under the HBM budget and a TTFT SLO.
+# =========================================================================================
+
+
+@dataclass(frozen=True)
+class ServeStats:
+    """Static per-model serving quantities (built exactly, via ``jax.eval_shape``
+    over the model's init and ``models.lm.init_cache``, by
+    ``plan.scenarios.for_serve`` — no hand formulas to drift).
+
+    ``kv_bytes_per_slot`` is ONE slot's full cache planes across all layers
+    (narrow K/V plus any scale planes — the int8 layout prices itself);
+    ``prompt_bytes_per_slot`` the engine's per-slot host-prompt row.
+    ``flops_per_token`` is the decode forward for one token (2·params plus the
+    attention einsums); ``shardable_fraction`` the parameter bytes
+    ``tensor_parallel.param_partition_specs`` actually splits over heads."""
+
+    name: str
+    param_bytes: float
+    kv_bytes_per_slot: float
+    prompt_bytes_per_slot: float = 0.0
+    flops_per_token: float = 0.0
+    num_layers: int = 1
+    num_heads: int = 1
+    num_kv_heads: int = 1
+    seq_len: int = 1
+    embed_dim: int = 1
+    dtype_bytes: int = 4
+    shardable_fraction: float = 1.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclass(frozen=True)
+class ServeCostBreakdown:
+    """One priced serve mesh: per-chip residency, the decode-step roofline,
+    the prefill-derived TTFT estimate, and both feasibility gates."""
+
+    decode_step_s: float           # one token for every slot of the replica
+    decode_mem_s: float            # HBM-stream term (usually the binding one)
+    decode_compute_s: float        # FLOPs term
+    tp_comm_s: float               # per-step TP activation collectives
+    ttft_s: float                  # prefill of one prompt_len prompt
+    tokens_per_s: float            # num_slots / decode_step_s — the objective
+    params_bytes_per_chip: float
+    kv_bytes_per_chip: float
+    total_bytes_per_chip: float
+    hbm_budget_bytes: float
+    slots_at_budget: int           # max admissible slots under the budget
+    fits: bool                     # per-chip residency within the budget
+    meets_ttft: bool               # TTFT estimate within the SLO (True if none)
+
+    @property
+    def feasible(self) -> bool:
+        return self.fits and self.meets_ttft
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["feasible"] = self.feasible
+        return d
+
+
+def predict_serve(stats: ServeStats, topo: Topology, *, tp: int, dp: int,
+                  num_slots: int, prompt_len: int,
+                  ttft_slo_s: float | None = None,
+                  hbm_fraction: float = 0.9) -> ServeCostBreakdown:
+    """Price one TP×(slot-DP) serve mesh.
+
+    Residency follows ``serving/shard.py``'s byte-true accounting exactly:
+    params replicate their unshardable fraction and split the shardable one
+    over ``tp``; a dp group holds ``num_slots/dp`` slots whose KV planes split
+    over ``tp`` (heads axis); the host-prompt rows shard over slots only. The
+    decode step is a roofline — ``max(HBM stream, FLOPs)`` of one token for
+    every resident slot — plus Megatron-style per-layer TP all-reduces of the
+    step's activations. TTFT is the compute-bound prefill of one
+    ``prompt_len`` prompt on one dp group (slot-DP doesn't speed up a single
+    request — exactly why the disaggregated prefill tier exists)."""
+    group_slots = max(num_slots // max(dp, 1), 1)
+    params_pc = (stats.param_bytes * stats.shardable_fraction / tp
+                 + stats.param_bytes * (1.0 - stats.shardable_fraction))
+    kv_slot_pc = stats.kv_bytes_per_slot / tp
+    kv_pc = kv_slot_pc * group_slots
+    prompt_pc = stats.prompt_bytes_per_slot * group_slots
+    total_pc = params_pc + kv_pc + prompt_pc
+    budget = topo.hbm_bytes * hbm_fraction
+    slot_cost = max(kv_slot_pc + stats.prompt_bytes_per_slot, 1.0)
+    slots_at_budget = max(dp, 1) * int(max(budget - params_pc, 0.0) // slot_cost)
+
+    hbm_bw = hbm_bytes_per_s(topo.device_kind)
+    # One decode step streams the param shard once (batched over the group's
+    # slots) and each slot's resident KV once.
+    decode_mem_s = (params_pc + kv_pc) / hbm_bw
+    decode_compute_s = stats.flops_per_token * group_slots / (tp * topo.peak_flops)
+    # Two all-reduces per layer per step (attention out-proj + MLP row-parallel)
+    # over the step's [group_slots, embed] activations.
+    step_act_bytes = group_slots * stats.embed_dim * stats.dtype_bytes
+    tp_comm_s = (2 * stats.num_layers * _ring_time(step_act_bytes, tp,
+                                                   topo.ici_bytes)
+                 if tp > 1 else 0.0)
+    decode_step_s = max(decode_mem_s, decode_compute_s) + tp_comm_s
+    tokens_per_s = (num_slots / decode_step_s) if decode_step_s > 0 else 0.0
+
+    # TTFT: prefill is compute-bound (the whole prompt's forward in chunks),
+    # parallel over tp only, plus the same per-layer collectives over the
+    # prompt's activations.
+    prefill_act_bytes = prompt_len * stats.embed_dim * stats.dtype_bytes
+    ttft_s = (stats.flops_per_token * prompt_len / (tp * topo.peak_flops)
+              + (2 * stats.num_layers * _ring_time(prefill_act_bytes, tp,
+                                                   topo.ici_bytes)
+                 if tp > 1 else 0.0))
+    return ServeCostBreakdown(
+        decode_step_s=decode_step_s, decode_mem_s=decode_mem_s,
+        decode_compute_s=decode_compute_s, tp_comm_s=tp_comm_s,
+        ttft_s=ttft_s, tokens_per_s=tokens_per_s,
+        params_bytes_per_chip=params_pc, kv_bytes_per_chip=kv_pc,
+        total_bytes_per_chip=total_pc, hbm_budget_bytes=budget,
+        slots_at_budget=slots_at_budget,
+        fits=total_pc <= budget,
+        meets_ttft=(ttft_slo_s is None or ttft_s <= ttft_slo_s))

@@ -334,3 +334,53 @@ def for_cnn(global_batch: int, topo: Topology | None = None) -> Scenario:
     return Scenario(run_type="cnn", stats=stats, topo=topo,
                     global_batch=global_batch, axes=("data",),
                     allow_fsdp=False, allow_grad_accum=False)
+
+
+def for_serve(model, *, num_slots: int, prompt_len: int,
+              topo: Topology | None = None, ttft_slo_s: float | None = None,
+              kv_dtype: str | None = None, hbm_fraction: float = 0.9,
+              measure=None) -> "ServeScenario":
+    """ServeScenario for a ``TransformerLM`` behind the continuous-batching
+    engine: param bytes (and the TP-shardable fraction) counted exactly via
+    ``jax.eval_shape`` over the model's init, KV bytes per slot counted
+    exactly via ``jax.eval_shape`` over ``models.lm.init_cache`` for ONE slot
+    under the requested ``kv_dtype`` — the int8 layout's scale planes price
+    themselves, so the planner and the engine can never disagree about what a
+    slot costs. ``measure`` (optional, ``(tp, dp) -> tokens/s | None``) hands
+    the final ranking to measurement — see ``plan.search.search_serve``."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        lm as lm_mod,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.plan.costs import (
+        ServeStats,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.plan.search import (
+        ServeScenario,
+    )
+
+    if topo is None:
+        topo = Topology.detect()
+    param_bytes, shardable = _param_bytes(
+        model, jnp.zeros((1, model.seq_len), jnp.int32))
+    cache_shapes = jax.eval_shape(
+        lambda: lm_mod.init_cache(model, 1, kv_dtype=kv_dtype))
+    kv_bytes = float(sum(np.prod(leaf.shape) * leaf.dtype.itemsize
+                         for leaf in jax.tree_util.tree_leaves(cache_shapes)))
+    kvh = model.num_kv_heads or model.num_heads
+    dtype_bytes = jnp.zeros((), model.dtype).dtype.itemsize
+    # Decode forward per token: the 2·P matmul rule plus the attention
+    # score/value einsums against the cached prefix (2·2·S·E per layer stack).
+    flops_per_token = (2.0 * param_bytes / 4
+                       + 4.0 * model.num_layers * model.seq_len
+                       * model.embed_dim)
+    stats = ServeStats(
+        name="transformer_lm_serve", param_bytes=param_bytes,
+        kv_bytes_per_slot=kv_bytes,
+        prompt_bytes_per_slot=float(model.seq_len * 4),   # int32 prompt row
+        flops_per_token=flops_per_token,
+        num_layers=model.num_layers, num_heads=model.num_heads,
+        num_kv_heads=kvh, seq_len=model.seq_len, embed_dim=model.embed_dim,
+        dtype_bytes=dtype_bytes, shardable_fraction=shardable)
+    return ServeScenario(stats=stats, topo=topo, num_slots=num_slots,
+                         prompt_len=prompt_len, ttft_slo_s=ttft_slo_s,
+                         hbm_fraction=hbm_fraction, measure=measure)

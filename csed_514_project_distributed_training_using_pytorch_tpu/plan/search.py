@@ -13,11 +13,13 @@ never dies in the trainer's own validation.
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Callable
 
 from csed_514_project_distributed_training_using_pytorch_tpu.plan.costs import (
-    Candidate, CostBreakdown, ModelStats, Topology, predict,
+    Candidate, CostBreakdown, ModelStats, ServeCostBreakdown, ServeStats,
+    Topology, predict, predict_serve,
 )
 
 MAX_GRAD_ACCUM = 8       # accumulation splits tried when the scenario allows
@@ -172,3 +174,120 @@ def search(scenario: Scenario, *, top: int = 10) -> list[Ranked]:
             f"smallest candidate footprint is {tightest / 2**30:.2f} GiB — "
             f"add devices, enable grad accumulation, or shrink the model")
     return rows[:top]
+
+
+# =========================================================================================
+# Serving mesh search (the serve-plan half of DESIGN.md §25): enumerate the
+# TP×(slot-DP) factorizations serving/shard.py can legally build, price them
+# with plan.costs.predict_serve, and — when the scenario carries a measure
+# hook — let MEASUREMENT pick the winner among the analytically-shortlisted
+# candidates. The analytical model prunes; it never outranks a measurement.
+# =========================================================================================
+
+
+@dataclass
+class ServeScenario:
+    """One serve-planning run: the model's serving stats, the topology, the
+    slot count, the workload shape (typical prompt length), and the SLO.
+    ``measure`` is an optional empirical hook ``(tp, dp) -> tokens/s | None``
+    (None = candidate unmeasurable); installed by the bench/loadgen caller,
+    never by the scenario builder — measuring means serving real traffic."""
+
+    stats: ServeStats
+    topo: Topology
+    num_slots: int
+    prompt_len: int
+    ttft_slo_s: float | None = None
+    hbm_fraction: float = 0.9
+    measure: Callable | None = field(default=None, repr=False)
+
+
+@dataclass(frozen=True)
+class ServeRanked:
+    """One serve search row: the (tp, dp) mesh, its predicted costs, and —
+    after the measure pass — the observed tokens/s."""
+
+    tp: int
+    dp: int
+    costs: ServeCostBreakdown
+    measured_tokens_per_s: float | None = None
+
+    @property
+    def best_tokens_per_s(self) -> float:
+        return (self.measured_tokens_per_s
+                if self.measured_tokens_per_s is not None
+                else self.costs.tokens_per_s)
+
+    def shard_spec(self) -> str:
+        """The replica-facing ``--shard`` string (serving/tiers.py twin)."""
+        return f"tp={self.tp},dp={self.dp}"
+
+    def to_dict(self) -> dict:
+        return {"tp": self.tp, "dp": self.dp,
+                "shard_spec": self.shard_spec(),
+                "costs": self.costs.to_dict(),
+                "measured_tokens_per_s": self.measured_tokens_per_s}
+
+
+def enumerate_serve_candidates(scenario: ServeScenario) -> list[tuple[int, int]]:
+    """Every legal ``(tp, dp)`` pair for the device count: legality mirrors
+    ``serving.shard.validate_engine_mesh`` exactly — ``tp`` divides both the
+    query heads and the KV heads (head-sharded attention + cache planes),
+    ``dp`` divides the slot count (whole slots per data group). Deterministic
+    order: tp ascending."""
+    st, n = scenario.stats, scenario.topo.num_devices
+    out: list[tuple[int, int]] = []
+    for tp, dp in _factor_pairs(n):
+        if st.num_heads % tp or st.num_kv_heads % tp:
+            continue
+        if scenario.num_slots % dp:
+            continue
+        out.append((tp, dp))
+    return out
+
+
+def _serve_sort_key(row: ServeRanked):
+    """Feasible first, highest throughput first, then simplicity (less TP —
+    fewer collectives and a smaller blast radius) so model ties never flap."""
+    return (not row.costs.feasible, -row.best_tokens_per_s, row.tp, row.dp)
+
+
+def search_serve(scenario: ServeScenario, *, top: int = 10,
+                 measure_top: int = 3) -> list[ServeRanked]:
+    """Enumerate, price, rank — then, when the scenario carries a ``measure``
+    hook, run it over the analytical top ``measure_top`` candidates and
+    re-rank by measurement: the head of the returned list is the PICK, and it
+    is always the measured-best among the measured set (the plan artifact's
+    acceptance gate). Raises when no candidate is legal or none fits."""
+    cands = enumerate_serve_candidates(scenario)
+    if not cands:
+        raise ValueError(
+            f"no legal serve mesh for {scenario.topo.num_devices} devices "
+            f"(heads {scenario.stats.num_heads}/{scenario.stats.num_kv_heads}, "
+            f"slots {scenario.num_slots})")
+    rows = [ServeRanked(tp, dp, predict_serve(
+                scenario.stats, scenario.topo, tp=tp, dp=dp,
+                num_slots=scenario.num_slots, prompt_len=scenario.prompt_len,
+                ttft_slo_s=scenario.ttft_slo_s,
+                hbm_fraction=scenario.hbm_fraction))
+            for tp, dp in cands]
+    rows.sort(key=_serve_sort_key)
+    if not rows[0].costs.fits:
+        tightest = min(r.costs.total_bytes_per_chip for r in rows)
+        raise ValueError(
+            f"no serve mesh fits the per-chip memory budget "
+            f"({rows[0].costs.hbm_budget_bytes / 2**30:.2f} GiB usable): the "
+            f"smallest candidate footprint is {tightest / 2**30:.2f} GiB — "
+            f"add devices, shrink slots, or quantize the KV cache")
+    rows = rows[:top]
+    if scenario.measure is not None:
+        measured = [dataclasses.replace(
+                        r, measured_tokens_per_s=scenario.measure(r.tp, r.dp))
+                    for r in rows[:measure_top]]
+        # Measured rows outrank unmeasured ones outright; among measured,
+        # observed tokens/s decides — the model only chose WHO got measured.
+        measured.sort(key=lambda r: (r.measured_tokens_per_s is None,
+                                     -(r.measured_tokens_per_s or 0.0),
+                                     r.tp, r.dp))
+        rows = measured + rows[measure_top:]
+    return rows

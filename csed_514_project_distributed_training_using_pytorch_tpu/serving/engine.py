@@ -64,6 +64,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.ops import (
 from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention import (
     MASK_VALUE,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+    shard as shard_mod,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.prefix_cache import (
     PrefixCache,
 )
@@ -177,10 +180,19 @@ class ContinuousBatchingEngine:
                  quant_policy: str = "off",
                  spec: str = "off",
                  spec_k: int = 4,
-                 drafter: Drafter | None = None):
+                 drafter: Drafter | None = None,
+                 mesh: "shard_mod.ServeMesh | None" = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.model = model
+        # The serve mesh (serving/shard.py): None is the single-chip engine,
+        # bitwise-unchanged. With a mesh, params/cache/prompt are PLACED with
+        # NamedShardings below and every jitted program partitions by GSPMD —
+        # computation follows data, so the program set, the trace counts, and
+        # the emitted token stream are exactly the single-chip ones.
+        self.mesh = mesh
+        if mesh is not None:
+            shard_mod.validate_engine_mesh(model, int(num_slots), mesh)
         # The dtype/scale policy: kv_dtype picks the KV-cache plane format
         # (quantize-on-write for int8/fp8), quant_policy the weight-matmul
         # path ("off" | "w8" | "w8a8" — ops.quant.WEIGHT_POLICIES). Both off
@@ -213,6 +225,17 @@ class ContinuousBatchingEngine:
         # on every prefix-cache snapshot and checked on every lookup, so planes
         # written under a different dtype policy can never install here.
         self.plane_layout = quant_ops.cache_layout(self._cache)
+        self._cache_shardings = None
+        if mesh is not None:
+            # Placement IS the sharding story: params by the train-side TP
+            # rules (heads column-parallel, projections row-parallel), KV and
+            # scale planes over slot(data)×kv_head(model) per
+            # models.lm.KV_PLANE_AXES. Donated steps keep the placement.
+            self.params = jax.device_put(
+                self.params, shard_mod.param_shardings(self.params, mesh))
+            self._cache_shardings = shard_mod.cache_shardings(self._cache,
+                                                              mesh)
+            self._cache = jax.device_put(self._cache, self._cache_shardings)
         b, s = self.num_slots, model.seq_len
         self._ids = np.full((b,), model.vocab_size - 1, np.int32)   # BOS
         self._t = np.zeros((b,), np.int32)
@@ -223,9 +246,14 @@ class ContinuousBatchingEngine:
         # scatters ALL newly admitted rows in one padded jitted update (a
         # separate program from the decode step — trace_count counts decode).
         self._prompt = jnp.zeros((b, s), jnp.int32)
+        if mesh is not None:
+            self._prompt = jax.device_put(self._prompt,
+                                          shard_mod.prompt_sharding(mesh))
         self.admit_trace_count = 0    # traces of the admission scatter (pin == 1)
-        self._set_prompt_rows = jax.jit(self._prompt_scatter_program,
-                                        donate_argnums=(0,))
+        self._set_prompt_rows = jax.jit(
+            self._prompt_scatter_program, donate_argnums=(0,),
+            **({} if mesh is None
+               else {"out_shardings": shard_mod.prompt_sharding(mesh)}))
         self._prompt_len = np.zeros((b,), np.int32)
         # The pre-computed stream length: how many positions of this slot's
         # cache arrive via install/prefill rather than decode. Equal to
@@ -347,9 +375,22 @@ class ContinuousBatchingEngine:
             self._verify_jits[self.spec_k] = jax.jit(
                 functools.partial(self._verify_program, self.spec_k),
                 donate_argnums=(1,))
-        self._install_jit = jax.jit(self._install_program, donate_argnums=(0,))
+        # Snapshot/install stay ONE fixed-shape program each under a mesh, but
+        # with EXPLICIT shardings (the sharded-snapshot bugfix): a snapshot
+        # exports REPLICATED planes — fully addressable, so the host-side
+        # prefix cache and the tier-handoff codec read real buffers, never a
+        # shard view — and install re-scatters them back onto the cache's own
+        # shardings. Without the annotations GSPMD would be free to leave the
+        # export sharded over heads, and every np.asarray on it would be a
+        # cross-device gather at an unplanned point (or a crash multi-host).
+        self._install_jit = jax.jit(
+            self._install_program, donate_argnums=(0,),
+            **({} if mesh is None
+               else {"out_shardings": self._cache_shardings}))
         self._snapshot_jit = jax.jit(
-            lambda cache, slot: jax.tree_util.tree_map(lambda c: c[slot], cache))
+            lambda cache, slot: jax.tree_util.tree_map(lambda c: c[slot], cache),
+            **({} if mesh is None
+               else {"out_shardings": mesh.replicated()}))
         # The cache (arg 1 after params) is donated: each step's updated cache
         # reuses the previous buffer instead of allocating a second full copy —
         # on the serving path the KV cache IS the memory footprint.
@@ -753,7 +794,7 @@ class ContinuousBatchingEngine:
         prompt_bytes = int(self._prompt.size) * self._prompt.dtype.itemsize
         per_slot = kv_bytes // self.num_slots
         per_step = kv_bytes + params_bytes + prompt_bytes
-        return {
+        doc = {
             "kv_dtype": self.quant.kv_dtype,
             "quant_policy": self.quant.weights,
             "plane_layout": self.plane_layout,
@@ -768,6 +809,41 @@ class ContinuousBatchingEngine:
                 (budget - params_bytes) // (per_slot + prompt_bytes
                                             // self.num_slots), 0),
         }
+        # Per-CHIP residency (the sharded-byte-math bugfix): the logical
+        # totals above count each array once, but a sharded leaf is resident
+        # as per-device shards and a replicated leaf N times — sum per-shard
+        # nbytes per device (serving/shard.py). Unsharded, the single chip's
+        # row equals the logical totals exactly (the regression pin).
+        params_dev = shard_mod.per_device_bytes(self.params)
+        kv_dev = shard_mod.per_device_bytes(self._cache)
+        prompt_dev = shard_mod.per_device_bytes(self._prompt)
+        devs = sorted(set(params_dev) | set(kv_dev) | set(prompt_dev))
+        per_chip = {
+            d: {"params_bytes": params_dev.get(d, 0),
+                "kv_bytes": kv_dev.get(d, 0),
+                "prompt_bytes": prompt_dev.get(d, 0),
+                "total_bytes": (params_dev.get(d, 0) + kv_dev.get(d, 0)
+                                + prompt_dev.get(d, 0))}
+            for d in devs}
+        doc["per_chip"] = per_chip
+        doc["bytes_per_chip_max"] = max(
+            (row["total_bytes"] for row in per_chip.values()), default=0)
+        doc["params_kv_bytes_per_chip_max"] = max(
+            (row["params_bytes"] + row["kv_bytes"]
+             for row in per_chip.values()), default=0)
+        doc["mesh"] = self.mesh.describe() if self.mesh is not None else None
+        if self.mesh is not None and per_chip:
+            # The budget is PER CHIP: a dp group holds num_slots/dp slots, so
+            # one extra slot costs each chip of one group kv_slot/tp bytes —
+            # slots_at_budget is the per-chip fit times the dp group count.
+            group = max(self.num_slots // self.mesh.dp, 1)
+            params_chip = max(r["params_bytes"] for r in per_chip.values())
+            kv_chip = max(r["kv_bytes"] for r in per_chip.values())
+            prompt_chip = max(r["prompt_bytes"] for r in per_chip.values())
+            slot_cost = max(kv_chip // group + prompt_chip // group, 1)
+            doc["slots_at_budget"] = self.mesh.dp * max(
+                (budget - params_chip) // slot_cost, 0)
+        return doc
 
     def take_prefill_records(self) -> list[dict]:
         """Drain the completed-prefill telemetry records (one dict per prompt:

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import threading
 
 import numpy as np
 
@@ -73,6 +74,10 @@ class PrefixCache:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self.layout = layout
+        # Tiered serving inserts from a handoff listener thread while the
+        # engine thread looks up/inserts — one reentrant lock serializes the
+        # OrderedDict mutations (lookup mutates too: move_to_end + counters).
+        self._lock = threading.RLock()
         self._entries: collections.OrderedDict[int, PrefixEntry] = \
             collections.OrderedDict()
         self._next_key = 0
@@ -103,27 +108,29 @@ class PrefixCache:
 
         ``layout`` (default: the cache's own) must match an entry's recorded
         plane layout for it to hit — the dtype/scale compatibility guard."""
-        self.queries += 1
-        want = self.layout if layout is None else layout
-        prompt = np.asarray(prompt, np.int32)
-        best_key, best_len, rejected = None, 0, False
-        for key, entry in self._entries.items():
-            if entry.layout != want:
-                rejected = True
-                continue
-            m = self._common_prefix(entry.tokens, prompt)
-            if m > best_len and (m == len(prompt) or m >= min_len):
-                best_key, best_len = key, m
-        # At most one reject per LOOKUP: the counter answers "how many lookups
-        # saw a layout-incompatible entry", not "entry comparisons".
-        if rejected:
-            self.layout_rejects += 1
-        if best_key is None:
-            return 0, None
-        self._entries.move_to_end(best_key)
-        self.hits += 1
-        self.hit_tokens += best_len
-        return best_len, self._entries[best_key].planes
+        with self._lock:
+            self.queries += 1
+            want = self.layout if layout is None else layout
+            prompt = np.asarray(prompt, np.int32)
+            best_key, best_len, rejected = None, 0, False
+            for key, entry in self._entries.items():
+                if entry.layout != want:
+                    rejected = True
+                    continue
+                m = self._common_prefix(entry.tokens, prompt)
+                if m > best_len and (m == len(prompt) or m >= min_len):
+                    best_key, best_len = key, m
+            # At most one reject per LOOKUP: the counter answers "how many
+            # lookups saw a layout-incompatible entry", not "entry
+            # comparisons".
+            if rejected:
+                self.layout_rejects += 1
+            if best_key is None:
+                return 0, None
+            self._entries.move_to_end(best_key)
+            self.hits += 1
+            self.hit_tokens += best_len
+            return best_len, self._entries[best_key].planes
 
     def insert(self, tokens: np.ndarray, planes: dict, *,
                layout: str | None = None) -> None:
@@ -132,29 +139,32 @@ class PrefixCache:
         layout, so every future lookup the old entry could win, the new one
         wins longer). The entry is stamped with ``layout`` (default: the
         cache's own) — the key :meth:`lookup` filters on."""
-        layout = self.layout if layout is None else layout
-        tokens = np.asarray(tokens, np.int32).copy()
-        covered = [k for k, e in self._entries.items()
-                   if e.layout == layout and len(e.tokens) <= len(tokens)
-                   and self._common_prefix(e.tokens, tokens) == len(e.tokens)]
-        for k in covered:
-            del self._entries[k]
-        self._entries[self._next_key] = PrefixEntry(tokens=tokens, planes=planes,
-                                                    layout=layout)
-        self._next_key += 1
-        self.insertions += 1
-        while len(self._entries) > self.capacity:
-            self._entries.popitem(last=False)
-            self.evictions += 1
+        with self._lock:
+            layout = self.layout if layout is None else layout
+            tokens = np.asarray(tokens, np.int32).copy()
+            covered = [
+                k for k, e in self._entries.items()
+                if e.layout == layout and len(e.tokens) <= len(tokens)
+                and self._common_prefix(e.tokens, tokens) == len(e.tokens)]
+            for k in covered:
+                del self._entries[k]
+            self._entries[self._next_key] = PrefixEntry(
+                tokens=tokens, planes=planes, layout=layout)
+            self._next_key += 1
+            self.insertions += 1
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
 
     def stats(self) -> dict:
-        return {
-            "entries": len(self._entries),
-            "capacity": self.capacity,
-            "queries": self.queries,
-            "hits": self.hits,
-            "hit_tokens": self.hit_tokens,
-            "insertions": self.insertions,
-            "evictions": self.evictions,
-            "layout_rejects": self.layout_rejects,
-        }
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "capacity": self.capacity,
+                "queries": self.queries,
+                "hits": self.hits,
+                "hit_tokens": self.hit_tokens,
+                "insertions": self.insertions,
+                "evictions": self.evictions,
+                "layout_rejects": self.layout_rejects,
+            }

@@ -123,6 +123,9 @@ from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler i
     SamplingParams,
     Shed,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+    tiers as tiers_mod,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.wire import (
     CAP_FRAMED,
     FrameDecoder,
@@ -201,14 +204,34 @@ def build_engine_server(args, trace: Tracer | str | None = None):
                 args.draft_checkpoint, draft_params)
         drafter = DraftLMDrafter(draft_model, draft_params,
                                  chunk_sizes=chunk_sizes or (32, 128, 512))
+    # In-replica serve mesh (--shard "tp=2,dp=2"): the engine's programs run
+    # unchanged under GSPMD over tp*dp local devices (serving/shard.py). The
+    # default "" keeps the single-chip engine bitwise-unchanged.
+    mesh = None
+    tp, dp = tiers_mod.parse_shard_spec(getattr(args, "shard", ""))
+    if tp * dp > 1:
+        from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+            shard as shard_mod,
+        )
+
+        mesh = shard_mod.build_serve_mesh(tp, dp)
+    # Tiered roles ride the prefix cache (the prefill tier SNAPSHOTS finished
+    # prompts into it, the decode tier INSTALLS handed-off planes from it), so
+    # a tier flag without an explicit --prefix-cache gets a working default
+    # rather than a silently disabled handoff path.
+    prefix_entries = args.prefix_cache
+    if getattr(args, "tier", tiers_mod.ROLE_UNIFIED) != tiers_mod.ROLE_UNIFIED \
+            and not prefix_entries:
+        prefix_entries = 32
     engine = ContinuousBatchingEngine(
         model, params, num_slots=args.num_slots, seed=args.seed,
         prefill_chunk_sizes=chunk_sizes,
         prefill_chunk_budget=args.prefill_budget,
-        prefix_cache_entries=args.prefix_cache,
+        prefix_cache_entries=prefix_entries,
         kv_dtype=getattr(args, "kv_dtype", "model"),
         quant_policy=getattr(args, "quant_policy", "off"),
-        spec=spec, spec_k=getattr(args, "spec_k", 4), drafter=drafter)
+        spec=spec, spec_k=getattr(args, "spec_k", 4), drafter=drafter,
+        mesh=mesh)
     # The serve-path resilience tick: kill/preempt/stall faults fire between
     # decode dispatches — mid-decode, with requests in flight.
     engine.on_step = lambda step: faults.on_tick(step=step)
@@ -428,7 +451,7 @@ def _handle_submit(msg, server, out: _WireOut):
     fut.add_done_callback(_done)
 
 
-def _stats_payload(engine, server) -> dict:
+def _stats_payload(engine, server, handoff=None) -> dict:
     eng: dict = {"steps": engine.steps}
     for name in ("prefill_tokens", "prefill_invocations", "prefill_wall_s",
                  "trace_count", "slot_occupancy", "prefill_backlog",
@@ -463,7 +486,239 @@ def _stats_payload(engine, server) -> dict:
             # the router folds these into fleet_snapshot's tenants section —
             # what an SLO-driven autoscaler and fleet_top read per tier.
             out["tenants"] = tenants
+    if handoff is not None:
+        # Tiered-serving ledger (decode tier: received/installed; prefill
+        # tier: shipped): the router folds these into fleet_snapshot per-tier.
+        out["handoff"] = handoff.snapshot()
     return out
+
+
+class _HandoffState:
+    """The tiered replica's KV-handoff ledger + (decode tier) listener.
+
+    The listener is a DEDICATED port: the main protocol socket is a
+    single-connection ``listen(1)`` owned by the router, so bulk plane bytes
+    ride a second, always-framed socket replica↔replica — the router only
+    learns the port (via the hello) and never sees a plane byte. Counters are
+    lock-guarded: per-connection handler threads race the stats op."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.port = 0
+        self.received = 0
+        self.shipped = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.crc_failures = 0
+        self.layout_rejects = 0
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {"port": self.port, "received": self.received,
+                    "shipped": self.shipped, "bytes_in": self.bytes_in,
+                    "bytes_out": self.bytes_out,
+                    "crc_failures": self.crc_failures,
+                    "layout_rejects": self.layout_rejects}
+
+
+def _start_handoff_listener(args, engine, state: _HandoffState,
+                            stop_flag: threading.Event) -> int:
+    """Bind the handoff listener (port 0 = ephemeral — the actual port rides
+    the hello) and serve one framed ``kv_handoff`` per connection: verify
+    CRC + layout, insert the planes into the engine's prefix cache (the
+    decode engine's next admission of that prompt is a full-prefix hit —
+    install rides the existing one-fixed-shape-program path), ack, close.
+    Echo mode (no prefix cache) counts + acks only: the router's chaos tests
+    exercise the real wire without jax."""
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", int(getattr(args, "handoff_port", 0) or 0)))
+    lsock.listen(4)
+    lsock.settimeout(0.5)
+    port = lsock.getsockname()[1]
+    with state.lock:
+        state.port = port
+
+    def _one(conn):
+        rid = None
+        try:
+            conn.settimeout(10.0)
+            msg = tiers_mod.read_handoff(conn)
+            if msg is None:
+                return
+            rid = msg.get("id")
+            tokens = np.asarray(msg.get("tokens") or [], np.int32)
+            cache = getattr(engine, "prefix_cache", None)
+            nbytes = int(msg.get("bytes") or 0)
+            if cache is not None and len(tokens):
+                layout = getattr(engine, "plane_layout", None)
+                try:
+                    planes = tiers_mod.decode_planes(msg, layout=layout)
+                except WireCorrupt as e:
+                    with state.lock:
+                        state.crc_failures += 1
+                    tiers_mod.send_ack(conn, request_id=rid, ok=False,
+                                       reason=f"crc: {e}")
+                    return
+                except ValueError as e:
+                    with state.lock:
+                        state.layout_rejects += 1
+                    tiers_mod.send_ack(conn, request_id=rid, ok=False,
+                                       reason=f"layout: {e}")
+                    return
+                # PrefixCache is lock-guarded precisely for this thread: the
+                # engine thread looks up / inserts concurrently.
+                cache.insert(tokens, planes, layout=layout)
+            with state.lock:
+                state.received += 1
+                state.bytes_in += nbytes
+            tiers_mod.send_ack(conn, request_id=rid, ok=True, nbytes=nbytes)
+        except (OSError, WireCorrupt) as e:
+            # A torn connection mid-handoff: no ack ever leaves, the prefill
+            # side reports prefill_failed, the router falls back to local
+            # prefill — zero requests lost (the chaos contract).
+            with state.lock:
+                state.crc_failures += isinstance(e, WireCorrupt)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _loop():
+        while not stop_flag.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=_one, args=(conn,), daemon=True,
+                             name="handoff-recv").start()
+        try:
+            lsock.close()
+        except OSError:
+            pass
+
+    threading.Thread(target=_loop, daemon=True, name="handoff-listen").start()
+    return port
+
+
+def _handle_prefill(msg, args, engine, server, out: _WireOut,
+                    state: _HandoffState):
+    """The prefill-tier op: prefill the prompt here (1 generated token — the
+    admission that populates the prefix cache), snapshot the planes, ship
+    them to the decode replica named in ``msg["handoff"]``, and report
+    ``prefill_done`` (the router then dispatches the request to that decode
+    replica as a full-prefix hit) or ``prefill_failed`` (the router falls
+    back to classic local prefill — disaggregation is an optimization, never
+    a dependency)."""
+    rid = msg["id"]
+    prompt = np.asarray(msg.get("prompt") or [], np.int32)
+    target = msg.get("handoff") or {}
+    host = target.get("host", "127.0.0.1")
+    port = int(target.get("port") or 0)
+
+    def _fail(reason):
+        try:
+            _send(out, {"op": "prefill_failed", "id": rid, "reason": reason})
+        except OSError:
+            pass
+
+    if not len(prompt) or not port:
+        _fail("bad_prefill_op")
+        return
+
+    def _ship(ttft_s):
+        # Worker thread: the cache lookup is lock-safe, the np conversion and
+        # base64 walk pull the (replicated) planes to host, and the socket
+        # ship must never block the decode loop.
+        t0 = time.monotonic()
+        try:
+            if args.echo:
+                payload = tiers_mod.encode_planes(
+                    {"echo": prompt if len(prompt) else
+                     np.zeros(1, np.int32)})
+            else:
+                cache = getattr(engine, "prefix_cache", None)
+                layout = getattr(engine, "plane_layout", None)
+                hit, planes = (0, None)
+                if cache is not None:
+                    hit, planes = cache.lookup(prompt, min_len=1,
+                                               layout=layout)
+                if planes is None or hit < len(prompt):
+                    _fail("no_planes")
+                    return
+                payload = tiers_mod.encode_planes(planes, layout=layout)
+            ack = tiers_mod.ship_planes(host, port, request_id=rid,
+                                        tokens=prompt, payload=payload,
+                                        timeout_s=args.handoff_timeout_s)
+        except (OSError, WireCorrupt) as e:
+            _fail(f"ship: {e}")
+            return
+        if not ack.get("ok"):
+            _fail(f"nack: {ack.get('reason', 'rejected')}")
+            return
+        wall = time.monotonic() - t0
+        with state.lock:
+            state.shipped += 1
+            state.bytes_out += int(payload["bytes"])
+        try:
+            _send(out, {"op": "prefill_done", "id": rid,
+                        "prompt_len": int(len(prompt)),
+                        "handoff_bytes": int(payload["bytes"]),
+                        "handoff_wall_s": round(wall, 6),
+                        "ttft_s": ttft_s})
+        except OSError:
+            pass
+
+    if args.echo:
+        try:
+            server.begin_request()
+        except QueueClosed:
+            _send(out, {"op": "error", "id": rid, "error": "draining",
+                        "message": "echo replica draining"})
+            return
+
+        def _echo_job():
+            try:
+                _tokens, ttft = server.complete(
+                    prompt, 1, trace_id=msg.get("trace_id"), request_id=rid)
+                _ship(ttft)
+            finally:
+                server.end_request()
+
+        threading.Thread(target=_echo_job, daemon=True,
+                         name="prefill-echo").start()
+        return
+    try:
+        fut = server.submit(prompt, max_new_tokens=1,
+                            trace_id=msg.get("trace_id"),
+                            tenant=msg.get("tenant", "default"),
+                            priority=msg.get("priority"),
+                            preemptible=msg.get("preemptible"))
+    except QueueFull:
+        _send(out, {"op": "error", "id": rid, "error": "queue_full",
+                    "message": "replica queue at capacity"})
+        return
+    except QueueClosed:
+        _send(out, {"op": "error", "id": rid, "error": "draining",
+                    "message": "replica draining (retire/reload)"})
+        return
+    except (QuotaExceeded, Shed, ValueError) as e:
+        _fail(f"admit: {e}")
+        return
+
+    def _done(f):
+        try:
+            comp = f.result()
+        except BaseException as e:           # server died mid-prefill
+            _fail(f"prefill: {e}")
+            return
+        threading.Thread(target=_ship, args=(comp.ttft_s,), daemon=True,
+                         name="handoff-ship").start()
+
+    fut.add_done_callback(_done)
 
 
 def serve_forever(args) -> int:
@@ -485,6 +740,16 @@ def serve_forever(args) -> int:
                               process_index=replica_id) if args.heartbeat_dir \
         else None
     stop_flag = threading.Event()
+
+    # Tiered serving (DESIGN.md §25): the decode tier opens its dedicated
+    # handoff listener BEFORE the hello so the advertised port is live the
+    # moment the router reads it.
+    tier = getattr(args, "tier", tiers_mod.ROLE_UNIFIED)
+    handoff = _HandoffState()
+    handoff_port = 0
+    if tier == tiers_mod.ROLE_DECODE:
+        handoff_port = _start_handoff_listener(args, engine, handoff,
+                                               stop_flag)
 
     def _ticker():
         # Liveness + preemption watch. A `freeze` fault silences the beat while
@@ -594,9 +859,16 @@ def serve_forever(args) -> int:
                     out.cancelled.add(rid)
                 if fut is not None:
                     fut.cancel()         # only wins while it is still queued
+        elif op == "prefill":
+            # Prefill-tier dispatch: prefill here, ship the planes to the
+            # decode replica the router named, report prefill_done/failed.
+            _handle_prefill(msg, args, engine, server, out, handoff)
         elif op == "stats":
             _send(out, {"op": "stats", "id": msg.get("id"),
-                        **_stats_payload(engine, server)})
+                        **_stats_payload(
+                            engine, server,
+                            handoff if tier != tiers_mod.ROLE_UNIFIED
+                            else None)})
         elif op == "warm":
             # Prefix-cache warm-start (scale-up/reload): replay the fleet's
             # hot prefixes through prefill BEFORE taking traffic — one
@@ -683,11 +955,18 @@ def serve_forever(args) -> int:
         out = _WireOut(wsock.makefile("wb"))
         # The hello is ALWAYS newline JSON — the negotiation anchor a legacy
         # router parses unchanged. ``caps`` advertises what this replica can
-        # speak; only a hello_ack echoing a capability switches modes.
-        _send(out, {"op": "hello", "replica": replica_id,
-                    "num_slots": args.num_slots,
-                    "max_pending": args.max_pending,
-                    "pid": os.getpid(), "caps": [CAP_FRAMED]})
+        # speak; only a hello_ack echoing a capability switches modes. Tier
+        # fields appear ONLY on tiered replicas (an untiered fleet's hello
+        # stays byte-identical — pinned).
+        hello = {"op": "hello", "replica": replica_id,
+                 "num_slots": args.num_slots,
+                 "max_pending": args.max_pending,
+                 "pid": os.getpid(), "caps": [CAP_FRAMED]}
+        if tier != tiers_mod.ROLE_UNIFIED:
+            hello["tier"] = tier
+            if handoff_port:
+                hello["handoff_port"] = handoff_port
+        _send(out, hello)
         # Mode is decided by the FIRST router message: until its newline
         # arrives, bytes accumulate RAW (feeding them to a line splitter
         # would mangle frames that share the chunk — frame payloads may
@@ -889,6 +1168,24 @@ def main(argv: list[str] | None = None) -> int:
                         "tenant quotas, weighted-fair dequeue, slot caps, "
                         "and priority preemption in this replica's server; "
                         "empty = single implicit tenant")
+    t = p.add_argument_group("tiered / sharded serving")
+    t.add_argument("--tier", default=tiers_mod.ROLE_UNIFIED,
+                   choices=tiers_mod.ROLES,
+                   help="replica role: 'prefill' serves only prefill ops and "
+                        "ships finished KV planes; 'decode' runs a handoff "
+                        "listener and serves decode traffic; 'unified' "
+                        "(default) is the classic do-everything replica")
+    t.add_argument("--handoff-port", type=int, default=0,
+                   help="decode tier: the KV-handoff listener port (0 = "
+                        "ephemeral; the actual port rides the hello)")
+    t.add_argument("--handoff-timeout-s", type=float, default=10.0,
+                   help="prefill tier: per-handoff connect/ack deadline — a "
+                        "dead decode peer becomes prefill_failed (router "
+                        "falls back to local prefill), never a hang")
+    t.add_argument("--shard", default="",
+                   help="in-replica serve mesh, e.g. 'tp=2,dp=2': shard the "
+                        "engine over tp*dp local devices (serving/shard.py); "
+                        "empty = single-chip, bitwise-unchanged")
     p.add_argument("--wire-idle-timeout-s", type=float, default=120.0,
                    help="disconnect a peer that connected but never sent a "
                         "complete message, or stalled mid-message, for this "

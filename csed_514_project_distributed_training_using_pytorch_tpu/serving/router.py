@@ -174,6 +174,15 @@ class RouterRequest:
     # the winning completion's dispatch span — and its latency sample — must
     # start at the WINNER's send time, not the primary's.
     dispatch_by: dict = dataclasses.field(default_factory=dict)
+    # Disaggregated-serving phase marker: "prefill" while the request sits in
+    # a prefill-tier replica's ledger awaiting the KV handoff; None otherwise.
+    phase: str | None = None
+    # Latched after any handoff-path fault (prefill rejection, ship failure,
+    # mid-handoff replica death): this request falls back to classic local
+    # prefill on a decode/unified replica and never re-enters the disagg path.
+    no_disagg: bool = False
+    disagg: bool = False                # completed via a prefill-tier handoff
+    decode_target: int | None = None    # decode replica the planes shipped to
 
 
 @dataclasses.dataclass
@@ -198,6 +207,7 @@ class RouterCompletion:
     ttft_s: float | None = None
     tpot_s: float | None = None
     e2e_s: float | None = None          # router arrival -> resolution
+    disagg: bool = False                # prefilled on a prefill-tier replica
 
     @property
     def ok(self) -> bool:
@@ -357,6 +367,13 @@ class _Replica:
         self.ejections = 0
         self.probes = 0
         self.hedges = 0               # hedge copies dispatched TO this replica
+        # Disaggregated serving (serving/tiers.py): the role this replica's
+        # hello declared, its direct KV-handoff listener port (decode tier
+        # only), and how many handoffs it took part in (prefills shipped from
+        # a prefill replica, planes received on a decode replica).
+        self.tier = "unified"
+        self.handoff_port: int | None = None
+        self.handoffs = 0
         # Seeded decorrelated-jitter schedules (serving/wire.py): restart
         # backoff and connect-retry pacing. Distinct per-replica seeds keep a
         # fleet-wide blip from producing a synchronized restart storm.
@@ -426,7 +443,9 @@ class Router:
                  framed_wire: bool = True,
                  chaos: str = "", chaos_seed: int = 0,
                  backoff_jitter: bool = True, jitter_seed: int = 0,
-                 env: dict | None = None):
+                 env: dict | None = None,
+                 replica_extra_args: list[list[str]] | None = None,
+                 disagg_min_prompt: int = 1):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
         self._autoscaler = FleetAutoscaler(autoscale) if autoscale else None
@@ -452,6 +471,14 @@ class Router:
         self._command = list(replica_command)
         self._platform = platform
         self._env = env
+        # Tiered fleets: per-index argv suffixes (cycled by replica index) —
+        # how a launcher assigns ``--tier prefill`` to replica 0 and ``--tier
+        # decode`` to the rest without forking the shared base command. None/
+        # empty keeps every spawn byte-identical to the untiered fleet.
+        self._extra_args = [list(a) for a in (replica_extra_args or [])]
+        # Prompts shorter than this never take the disagg detour: shipping
+        # whole KV planes to save a one-chunk prefill costs more than it buys.
+        self._disagg_min_prompt = int(disagg_min_prompt)
         # The tenant table: quotas + weighted-fair/priority dequeue live in
         # the queue (the fleet's one front door — replicas never double-charge
         # a quota), per-tenant in-flight caps in the dispatch gate below, and
@@ -551,7 +578,9 @@ class Router:
                         "redispatches": 0, "redispatched_requests": 0,
                         "duplicates": 0, "affinity_hits": 0, "new_tokens": 0,
                         "hedges": 0, "hedge_wins": 0, "ejections": 0,
-                        "probes": 0, "wire_corrupt": 0}
+                        "probes": 0, "wire_corrupt": 0,
+                        "handoffs": 0, "handoff_bytes": 0,
+                        "handoff_failures": 0}
         # Per-tenant fleet-level ledgers: counts + client-facing ttft/e2e
         # sketches + attainment against the tenant's own SLO (global spec as
         # fallback) — the fleet_snapshot "tenants" section and the
@@ -994,6 +1023,11 @@ class Router:
             rep.proxy.start()
         cmd = list(self._command) + ["--port", str(rep.port),
                                      "--replica-id", str(rep.index)]
+        if self._extra_args:
+            # Role assignment is positional and survives restarts: the same
+            # index always restarts into the same tier (cycled when the fleet
+            # scales past the suffix list).
+            cmd += self._extra_args[rep.index % len(self._extra_args)]
         if self._hb_dir:
             hb.clear(self._hb_dir, rep.index)
             cmd += ["--heartbeat-dir", self._hb_dir]
@@ -1095,6 +1129,12 @@ class Router:
                 slots = int(hello.get("num_slots", 1))
                 pending = int(hello.get("max_pending", 0))
                 rep.capacity = slots + pending if pending else None
+                # Tiered serving: the hello declares the replica's role and
+                # (decode tier) its direct KV-handoff listener port — the
+                # address prefill replicas ship planes to. Untiered hellos
+                # carry neither field and the defaults keep routing classic.
+                rep.tier = hello.get("tier") or "unified"
+                rep.handoff_port = hello.get("handoff_port") or None
                 # Prefix-cache warm-start: before this replica takes traffic,
                 # replay the fleet's hottest prefixes into its cache (the
                 # affinity index is the router's view of what is hot). Cold
@@ -1123,6 +1163,13 @@ class Router:
                                "capacity": rep.capacity,
                                "warm_prefixes": len(warm),
                                "framed": framed})
+            if rep.tier != "unified":
+                # Tier membership as a telemetry fact: fleet_top and the
+                # report can attribute load per role without parsing argv.
+                self._writer.emit({"event": "tier", "replica": rep.index,
+                                   "tier": rep.tier,
+                                   "handoff_port": rep.handoff_port,
+                                   "restarts": rep.restarts})
             decoder = FrameDecoder() if framed else LineDecoder()
             corrupt: str | None = None
             try:
@@ -1212,6 +1259,10 @@ class Router:
         op = msg.get("op")
         if op == "done":
             self._handle_done(rep, msg)
+        elif op == "prefill_done":
+            self._handle_prefill_done(rep, msg)
+        elif op == "prefill_failed":
+            self._handle_prefill_failed(rep, msg)
         elif op == "error":
             if msg.get("error") == "wire_corrupt" and msg.get("id") is None:
                 # The replica saw a damaged line it cannot attribute (legacy
@@ -1321,7 +1372,7 @@ class Router:
             new_tokens=int(msg.get("new_tokens", 0)),
             replica=rep.index, redispatches=req.redispatches,
             affinity_hit=req.affinity_hit, tenant=req.tenant,
-            hedged=req.hedged, hedge_won=hedge_won,
+            hedged=req.hedged, hedge_won=hedge_won, disagg=req.disagg,
             queue_wait_s=queue_wait,
             ttft_s=ttft,
             tpot_s=msg.get("tpot_s"),
@@ -1440,6 +1491,124 @@ class Router:
         with self._lock:
             self._counts["failed"] += 1
 
+    def _handle_prefill_done(self, rep: _Replica, msg: dict) -> None:
+        """Disaggregated phase 2: the prefill-tier replica finished the
+        prompt AND its KV planes were CRC-acked by the decode replica's
+        handoff listener. Close the prefill hop, record the handoff, and
+        dispatch the request to the decode replica that now holds the planes
+        — its admission is a full prefix-cache hit, so it decodes without
+        ever prefilling. Any invalidation in between (decode replica died,
+        lost its room, restarted into a new generation) falls back to the
+        classic path via a front requeue with ``no_disagg`` latched."""
+        now = time.monotonic()
+        if msg.get("id") is None:
+            return
+        with self._cond:
+            req = rep.inflight.pop(msg["id"], None)
+            if req is None:
+                return
+            req.phase = None
+            rep.completed += 1
+            rep.handoffs += 1
+            self._cond.notify_all()
+        nbytes = int(msg.get("handoff_bytes") or 0)
+        wall = float(msg.get("handoff_wall_s") or 0.0)
+        t0 = req.dispatch_by.get(rep.index, req.dispatch_s)
+        with self._lock:
+            self._counts["handoffs"] += 1
+            self._counts["handoff_bytes"] += nbytes
+        # The disagg span pair: the prefill-tier service interval (dispatch ->
+        # prefill_done line, which CONTAINS the handoff) and the handoff ship
+        # itself (replica-measured wall, anchored at the line's arrival) —
+        # the trace evidence for "did disaggregation buy TTFT".
+        self.tracer.span("prefill_tier", req.trace_id, t0, now,
+                         request_id=req.request_id, replica=rep.index,
+                         prompt_len=int(msg.get("prompt_len") or 0),
+                         ttft_s=msg.get("ttft_s"))
+        self.tracer.span("handoff", req.trace_id, now - wall, now,
+                         request_id=req.request_id, replica=rep.index,
+                         to_replica=req.decode_target, bytes=nbytes)
+        self._writer.emit({"event": "kv_handoff", "ok": True,
+                           "request_id": req.request_id,
+                           "from_replica": rep.index,
+                           "to_replica": req.decode_target,
+                           "bytes": nbytes, "wall_s": round(wall, 6),
+                           "prefill_ttft_s": msg.get("ttft_s"),
+                           "prompt_len": int(msg.get("prompt_len") or 0)})
+        if req.future.done():
+            return                        # expired mid-prefill: nothing to run
+        with self._cond:
+            dec = (self.replicas[req.decode_target]
+                   if req.decode_target is not None
+                   and req.decode_target < len(self.replicas) else None)
+            if dec is None or not dec.room() or dec.handoff_port is None:
+                # The planes' owner can't take the request: the shipped state
+                # is stranded, so the classic path (local prefill elsewhere)
+                # is the only correct continuation.
+                req.no_disagg = True
+                req.decode_target = None
+                req.enqueued_s = now
+                self.queue.requeue(req)
+                self._cond.notify_all()
+                return
+            req.disagg = True
+            req.dispatch_by[dec.index] = now
+            dec.inflight[req.request_id] = req
+            dec.dispatched += 1
+            dec.handoffs += 1
+            if self._affinity_on:
+                # The planes live in dec's prefix cache now — future prompts
+                # sharing this prefix should route there.
+                self._affinity.insert(req.prompt, dec.index)
+            self._cond.notify_all()
+        try:
+            dec.send(self._submit_msg(req, now))
+        except OSError:
+            with self._cond:
+                dec.inflight.pop(req.request_id, None)
+                dec.wfile = None
+                req.no_disagg = True
+                req.enqueued_s = time.monotonic()
+                self.queue.requeue(req)
+                self._cond.notify_all()
+
+    def _handle_prefill_failed(self, rep: _Replica, msg: dict) -> None:
+        """Any prefill-tier fault (no planes, admission refusal, ship/CRC
+        failure, decode-side nack): the request is intact in our custody —
+        latch ``no_disagg`` and bounce it to the queue front for classic
+        local prefill. Zero requests lost is the contract."""
+        now = time.monotonic()
+        if msg.get("id") is None:
+            return
+        with self._cond:
+            req = rep.inflight.pop(msg["id"], None)
+            if req is None:
+                return
+            req.phase = None
+            self._cond.notify_all()
+        reason = msg.get("reason") or "prefill_failed"
+        with self._lock:
+            self._counts["handoff_failures"] += 1
+        self.tracer.span("dispatch", req.trace_id,
+                         req.dispatch_by.get(rep.index, req.dispatch_s), now,
+                         request_id=req.request_id, replica=rep.index,
+                         outcome="bounced", error=f"prefill:{reason}",
+                         hop=req.redispatches)
+        self._writer.emit({"event": "kv_handoff", "ok": False,
+                           "request_id": req.request_id,
+                           "from_replica": rep.index,
+                           "to_replica": req.decode_target,
+                           "reason": reason})
+        if req.future.done():
+            return
+        with self._cond:
+            req.no_disagg = True
+            req.decode_target = None
+            req.dispatch_by.pop(rep.index, None)
+            req.enqueued_s = now
+            self.queue.requeue(req)
+            self._cond.notify_all()
+
     def _record(self, comp: RouterCompletion) -> None:
         now = time.monotonic()
         with self._lock:
@@ -1498,6 +1667,10 @@ class Router:
             # field-identical to the pre-hedging schema.
             ev["hedged"] = True
             ev["hedge_won"] = comp.hedge_won
+        if comp.disagg:
+            # Same rule for disaggregation: only requests that actually rode
+            # the prefill-tier handoff mark their route line.
+            ev["disagg"] = True
         self._writer.emit(ev)
 
     # ------------------------------------------------------------- gray failures
@@ -1622,7 +1795,11 @@ class Router:
                 if rep.state not in ("ready", "degraded"):
                     continue      # draining/failed ledgers have their own path
                 for req in list(rep.inflight.values()):
-                    if req.hedged or req.future.done():
+                    # A prefill-phase entry is not a decode in progress: its
+                    # planes are mid-handoff, and a hedged submit copy would
+                    # race the decode-tier dispatch prefill_done triggers.
+                    if req.hedged or req.future.done() \
+                            or req.phase == "prefill":
                         continue
                     t0 = req.dispatch_by.get(rep.index, req.dispatch_s)
                     if t0 is None or now - t0 < deadline:
@@ -1692,7 +1869,8 @@ class Router:
             # draining/retired/dead replica must not route traffic there (the
             # affinity satellite fix — before, draining replicas kept
             # receiving affine traffic until they actually died).
-            alive = {r.index for r in self.replicas if r.state == "ready"}
+            alive = {r.index for r in self.replicas
+                     if r.state == "ready" and r.tier != "prefill"}
             idx = self._affinity.lookup(prompt, self._affinity_min,
                                         alive=alive)
             if idx is not None:
@@ -1700,12 +1878,43 @@ class Router:
                     return self.replicas[idx], True, False
                 spilled = True
         ups = [r for r in self.replicas if r.room()]
+        if any(r.tier == "prefill" for r in self.replicas):
+            # Tiered fleet: classic (decode-holding) dispatch never lands on
+            # the prefill tier — those replicas take ``prefill`` ops only.
+            # Degenerate all-prefill fleets keep serving (misconfig beats
+            # deadlock).
+            serve = [r for r in ups if r.tier != "prefill"]
+            if serve or any(r.tier != "prefill" for r in self.replicas):
+                ups = serve
         if not ups:
             return None, False, spilled
         self._rr += 1
         rep = min(ups, key=lambda r: (len(r.inflight),
                                       (r.index - self._rr) % len(self.replicas)))
         return rep, False, spilled
+
+    def _choose_disagg(self, req: RouterRequest) \
+            -> tuple[_Replica, _Replica] | None:
+        """Disaggregated target pair (caller holds the lock): a ready
+        prefill-tier replica with room plus a ready decode-tier replica with
+        a handoff listener and room. None whenever the detour isn't
+        available or isn't worth it (no tiers, a latched ``no_disagg``, a
+        short prompt, either tier at capacity) — the caller falls through to
+        classic dispatch, because disaggregation is an optimization, never a
+        dependency."""
+        if req.no_disagg or len(req.prompt) < self._disagg_min_prompt:
+            return None
+        pres = [r for r in self.replicas if r.tier == "prefill" and r.room()]
+        if not pres:
+            return None
+        decs = [r for r in self.replicas
+                if r.tier == "decode" and r.room()
+                and r.handoff_port is not None]
+        if not decs:
+            return None
+        pre = min(pres, key=lambda r: (len(r.inflight), r.index))
+        dec = min(decs, key=lambda r: (len(r.inflight), r.index))
+        return pre, dec
 
     @staticmethod
     def _submit_msg(req: RouterRequest, now: float) -> dict:
@@ -1733,31 +1942,88 @@ class Router:
         return msg
 
     def _dispatch_one(self, req: RouterRequest) -> bool:
-        """Send one request to a chosen replica; False when everyone is full."""
+        """Send one request to a chosen replica; False when everyone is full.
+        On a tiered fleet a qualifying request takes the disaggregated detour
+        instead: a ``prefill`` op to the prefill tier naming the decode-tier
+        replica whose handoff listener will receive the planes — the decode
+        dispatch itself happens when ``prefill_done`` lands."""
         now = time.monotonic()
         with self._cond:
-            rep, hit, spilled = self._choose(req.prompt)
-            if rep is None:
-                return False
-            # Stamp the LAST dispatch: the client's first token comes from the
-            # attempt that succeeds, so a redispatched request's ttft/queue
-            # wait must include the failed attempt + detection + backoff time
-            # it sat through, not just its first hop.
-            req.dispatch_s = now
-            # A fresh hop set: stale stamps (a drained hop's replica, a past
-            # hedge) must not leak into this attempt's spans or sketches.
-            req.dispatch_by = {rep.index: now}
-            req.hedged = False
-            req.hedge_replica = None
-            if self._served_from_s is None:
-                self._served_from_s = now
-            req.affinity_hit = hit
-            rep.inflight[req.request_id] = req
-            rep.dispatched += 1
-            if self._in_transit is req:   # visible in the ledger from here on
-                self._in_transit = None
-            if self._affinity_on:
-                self._affinity.insert(req.prompt, rep.index)
+            pair = self._choose_disagg(req)
+            if pair is not None:
+                pre, dec = pair
+                req.dispatch_s = now
+                req.dispatch_by = {pre.index: now}
+                req.hedged = False
+                req.hedge_replica = None
+                req.affinity_hit = False
+                req.phase = "prefill"
+                req.decode_target = dec.index
+                if self._served_from_s is None:
+                    self._served_from_s = now
+                pre.inflight[req.request_id] = req
+                pre.dispatched += 1
+                if self._in_transit is req:
+                    self._in_transit = None
+                handoff_port = dec.handoff_port
+            if pair is None:
+                rep, hit, spilled = self._choose(req.prompt)
+                if rep is None:
+                    return False
+                # Stamp the LAST dispatch: the client's first token comes
+                # from the attempt that succeeds, so a redispatched request's
+                # ttft/queue wait must include the failed attempt + detection
+                # + backoff time it sat through, not just its first hop.
+                req.dispatch_s = now
+                # A fresh hop set: stale stamps (a drained hop's replica, a
+                # past hedge) must not leak into this attempt's spans or
+                # sketches.
+                req.dispatch_by = {rep.index: now}
+                req.hedged = False
+                req.hedge_replica = None
+                req.phase = None
+                req.decode_target = None
+                if self._served_from_s is None:
+                    self._served_from_s = now
+                req.affinity_hit = hit
+                rep.inflight[req.request_id] = req
+                rep.dispatched += 1
+                if self._in_transit is req:  # visible in the ledger from here
+                    self._in_transit = None
+                if self._affinity_on:
+                    self._affinity.insert(req.prompt, rep.index)
+        if pair is not None:
+            self.tracer.span("queue_wait", req.trace_id, req.enqueued_s, now,
+                             request_id=req.request_id, hop=req.redispatches)
+            self.tracer.span("route", req.trace_id, now,
+                             request_id=req.request_id, replica=pre.index,
+                             disagg=True, decode_replica=req.decode_target,
+                             hop=req.redispatches)
+            msg = {"op": "prefill", "id": req.request_id,
+                   "prompt": [int(t) for t in req.prompt],
+                   "handoff": {"host": "127.0.0.1", "port": handoff_port}}
+            if req.trace_id is not None:
+                msg["trace_id"] = req.trace_id
+            if req.tenant != "default":
+                msg["tenant"] = req.tenant
+            if req.priority:
+                msg["priority"] = req.priority
+            if req.preemptible:
+                msg["preemptible"] = True
+            try:
+                pre.send(msg)
+            except OSError:
+                # Prefill connection died under us: same pull-back as below,
+                # plus the no_disagg latch — the retry goes classic.
+                with self._cond:
+                    pre.inflight.pop(req.request_id, None)
+                    pre.wfile = None
+                    req.phase = None
+                    req.no_disagg = True
+                    self._cond.notify_all()
+                req.enqueued_s = time.monotonic()
+                self.queue.requeue(req)
+            return True
         # This queue stint ends here (enqueued_s -> dispatch); the route span
         # records the decision itself — target, affinity outcome, spill-over.
         self.tracer.span("queue_wait", req.trace_id, req.enqueued_s, now,
@@ -1910,6 +2176,13 @@ class Router:
                 req.hedge_replica = None
                 req.dispatch_by.pop(rep.index, None)
                 continue
+            if req.phase == "prefill":
+                # Mid-handoff death: the prefill-tier replica (and whatever
+                # planes it shipped) died with the work — latch the classic
+                # path so the replay prefills locally. Zero requests lost.
+                req.phase = None
+                req.no_disagg = True
+                req.decode_target = None
             if req.deadline_s is not None and now > req.deadline_s:
                 self._expire(req, now)        # past deadline: expired, NOT a
             else:                             # redispatch — don't count one
@@ -2128,6 +2401,11 @@ class Router:
                        "restarts": r.restarts, "dispatched": r.dispatched,
                        "completed": r.completed,
                        "hedges": r.hedges, "ejections": r.ejections}
+                if r.tier != "unified":
+                    # Only on tiered fleets: untiered snapshots keep the
+                    # pre-disaggregation row schema field-identical.
+                    row["tier"] = r.tier
+                    row["handoffs"] = r.handoffs
                 if self._slo_fleet is not None:
                     tracker = self._slo_by_replica.get(r.index)
                     row["slo"] = (tracker.window(now) if tracker is not None
@@ -2220,6 +2498,9 @@ class Router:
             "hedges": counts["hedges"],
             "hedge_wins": counts["hedge_wins"],
             "wire_corrupt": counts["wire_corrupt"],
+            "handoffs": counts["handoffs"],
+            "handoff_bytes": counts["handoff_bytes"],
+            "handoff_failures": counts["handoff_failures"],
             "affinity_rate": (counts["affinity_hits"] / routed
                               if routed else None),
             "restarts": sum(r["restarts"] for r in per_replica),
@@ -2419,6 +2700,9 @@ class Router:
                 "probes": r.probes,
                 "exit_code": r.exit_code,
                 "stats": r.stats,
+                # Tier fields only when tiered (schema-stable untiered).
+                **({"tier": r.tier, "handoffs": r.handoffs}
+                   if r.tier != "unified" else {}),
             } for r in self.replicas]
             series = {k: LogHistogram(self._hist_rel_err).merge(v)
                       for k, v in self._series.items()}

@@ -1,0 +1,224 @@
+"""The serve-mesh owner: TP×(slot-DP) sharding for the decode engine.
+
+A replica stops being "one chip running one whole model" here: the engine's
+three program families (``decode_step_slots``, ``prefill_chunk``,
+``verify_chunk``) run unchanged under GSPMD on an in-replica mesh —
+
+- ``model`` axis (**tensor parallel**): weight matrices shard by
+  ``parallel.tensor_parallel.param_partition_specs`` (column/row-parallel
+  Megatron layout, collectives derived by XLA from the annotations), and the
+  KV/scale planes shard over their ``kv_head`` dim — the attention einsums
+  (``bgrd,bsgd->bgrs``) are embarrassingly parallel over heads, so a TP chip
+  holds exactly its heads' K/V rows and no psum touches the cache;
+- ``data`` axis (**slot data parallel**): the KV planes and the prompt buffer
+  shard over their leading ``slot`` dim — slots are independent requests, so
+  DP shards carry disjoint slot groups and the only cross-slot structure (the
+  ``[num_slots]`` token fetch) is a gather the compiler already owes us.
+
+Sharding is COMPUTATION-FOLLOWS-DATA: the engine's jitted programs are not
+re-annotated — the params/cache/prompt are placed once with ``NamedSharding``
+and every donated step keeps the placement. The one-program-per-shape-family
+discipline is untouched (``trace_count`` pins hold on a mesh), and the token
+stream is pinned identical to the single-chip engine: sharding a reduction
+axis never reorders the math XLA was already doing.
+
+This module also owns the per-CHIP byte accounting: ``tree_bytes`` counts a
+logical array once, but a sharded plane is resident as per-device shards (and
+a replicated leaf is resident per device, N times) — ``per_device_bytes``
+sums ``addressable_shards`` so the engine's ``byte_accounting`` can report
+what each chip actually holds, which is the number the planner's serving
+scenario budgets against.
+
+CPU note: tests and the committed bench run this on virtual devices
+(``--xla_force_host_platform_device_count``) — the GSPMD partitioning is the
+same program a TPU mesh would run; only the interconnect is fake.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from csed_514_project_distributed_training_using_pytorch_tpu.models import lm as lm_mod
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel import (
+    mesh as mesh_mod,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.parallel.tensor_parallel import (
+    _filter_to_mesh,
+    param_partition_specs,
+)
+
+# Mesh axis names for the serve mesh: slots ride the ``data`` axis, heads the
+# ``model`` axis — the SAME axis vocabulary the train-side meshes use
+# (parallel.mesh._KNOWN_AXES), so the planner and the topology summary speak
+# one language for both scenarios.
+SLOT_AXIS = "data"
+HEAD_AXIS = "model"
+
+# KV plane axis-name -> serve-mesh axis (None = never sharded). Derived from
+# ``models.lm.KV_PLANE_AXES`` — the plane-semantics contract lives with
+# ``init_cache``, the mapping onto a mesh lives here.
+_PLANE_AXIS_TO_MESH = {"slot": SLOT_AXIS, "kv_head": HEAD_AXIS,
+                       "position": None, "head_dim": None}
+
+
+def parse_shard_spec(spec: str | None) -> tuple[int, int]:
+    """``"tp=2,dp=4"`` -> ``(tp, dp)``. Order-free, both keys optional
+    (missing = 1), empty/None = the unsharded ``(1, 1)``. Pure string math —
+    callers that must stay jax-free (argparse plumbing) can import this
+    without paying for a backend only if they import the module lazily; the
+    jax-free twin used by the router/loadgen lives in ``serving.tiers``."""
+    tp = dp = 1
+    for part in (spec or "").replace(" ", "").split(","):
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        if key not in ("tp", "dp") or not val.isdigit() or int(val) < 1:
+            raise ValueError(f"bad shard spec entry {part!r} "
+                             f"(want tp=<n>,dp=<n>)")
+        if key == "tp":
+            tp = int(val)
+        else:
+            dp = int(val)
+    return tp, dp
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeMesh:
+    """One replica's device mesh: ``tp`` chips over ``HEAD_AXIS`` ×
+    ``dp`` chips over ``SLOT_AXIS``."""
+
+    mesh: Mesh
+    tp: int
+    dp: int
+
+    @property
+    def num_devices(self) -> int:
+        return self.tp * self.dp
+
+    def describe(self) -> dict:
+        return {"tp": self.tp, "dp": self.dp,
+                "num_devices": self.num_devices,
+                "devices": [int(d.id) for d in self.mesh.devices.flat]}
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+def build_serve_mesh(tp: int = 1, dp: int = 1, *, devices=None) -> ServeMesh:
+    """A ``(dp, tp)`` mesh over the first ``dp*tp`` local devices:
+    ``SLOT_AXIS`` outermost (slot groups are independent — put them across
+    the slower links on real topologies), ``HEAD_AXIS`` innermost (the
+    row-parallel psums ride the fastest links)."""
+    if tp < 1 or dp < 1:
+        raise ValueError(f"tp/dp must be >= 1, got tp={tp} dp={dp}")
+    if devices is None:
+        mesh = mesh_mod.make_mesh(num_devices=tp * dp,
+                                  axis_names=(SLOT_AXIS, HEAD_AXIS),
+                                  axis_shape=(dp, tp))
+    else:
+        if len(devices) != tp * dp:
+            raise ValueError(f"{len(devices)} devices != tp*dp = {tp * dp}")
+        mesh = Mesh(np.asarray(devices).reshape(dp, tp),
+                    (SLOT_AXIS, HEAD_AXIS))
+    return ServeMesh(mesh=mesh, tp=tp, dp=dp)
+
+
+def validate_engine_mesh(model: lm_mod.TransformerLM, num_slots: int,
+                         sm: ServeMesh) -> None:
+    """The divisibility contract, checked at engine construction (never at
+    trace time): TP must divide BOTH head counts (Q heads for the
+    column-parallel projections, KV heads for the cache planes — a GQA model
+    with 2 KV heads caps tp at 2) and slot-DP must divide ``num_slots``."""
+    kvh = model.num_kv_heads or model.num_heads
+    if model.num_heads % sm.tp or kvh % sm.tp:
+        raise ValueError(
+            f"tp={sm.tp} must divide num_heads={model.num_heads} and "
+            f"num_kv_heads={kvh}")
+    if num_slots % sm.dp:
+        raise ValueError(f"dp={sm.dp} must divide num_slots={num_slots}")
+
+
+def cache_pspecs(cache) -> dict:
+    """Per-leaf ``PartitionSpec`` for a ``models.lm.init_cache`` tree, derived
+    from ``KV_PLANE_AXES``: k/v ``[slot, position, kv_head, head_dim]`` ->
+    ``P(data, None, model, None)``; scale planes ``[slot, position, kv_head]``
+    -> ``P(data, None, model)``. Unknown leaves replicate (fail-safe: a future
+    plane kind serves correctly before it serves sharded)."""
+
+    def spec_for(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        axes = lm_mod.KV_PLANE_AXES.get(name)
+        if axes is None or len(axes) != leaf.ndim:
+            return P()
+        return P(*(_PLANE_AXIS_TO_MESH[a] for a in axes))
+
+    return jax.tree_util.tree_map_with_path(spec_for, cache)
+
+
+def cache_shardings(cache, sm: ServeMesh):
+    """``NamedSharding`` tree for the engine's resident KV cache."""
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(sm.mesh, spec),
+        _filter_to_mesh(cache_pspecs(cache), sm.mesh),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def plane_shardings(planes, sm: ServeMesh):
+    """Shardings for ONE slot's snapshot planes (``cache[slot]`` — the slot
+    dim is gone, the head dim still shards): the fixed-shape install program's
+    input contract."""
+
+    def spec_for(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        axes = lm_mod.KV_PLANE_AXES.get(name)
+        if axes is None or len(axes) != leaf.ndim + 1:
+            return NamedSharding(sm.mesh, P())
+        entries = tuple(_PLANE_AXIS_TO_MESH[a] for a in axes[1:])
+        return NamedSharding(
+            sm.mesh,
+            _filter_to_mesh(P(*entries), sm.mesh))
+
+    return jax.tree_util.tree_map_with_path(spec_for, planes)
+
+
+def param_shardings(params, sm: ServeMesh):
+    """``NamedSharding`` tree for the (possibly quantized) serving params via
+    the train-side TP rules — the quantized tree keeps the kernel leaf names
+    (``ops.quant`` swaps dtypes, not structure), so one rule set serves both.
+    Scale leaves a quantized kernel grows (if any) fall to replication via the
+    rules' default."""
+    specs = _filter_to_mesh(param_partition_specs(params, axis_name=HEAD_AXIS),
+                            sm.mesh)
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(sm.mesh, spec), specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def prompt_sharding(sm: ServeMesh) -> NamedSharding:
+    """The ``[num_slots, seq_len]`` prompt buffer shards with its slots."""
+    return NamedSharding(sm.mesh,
+                         _filter_to_mesh(P(SLOT_AXIS, None), sm.mesh))
+
+
+def per_device_bytes(*trees) -> dict[int, int]:
+    """Resident bytes PER DEVICE, summed over every leaf's
+    ``addressable_shards``: a sharded leaf charges each device its shard's
+    ``size * itemsize``; a replicated leaf charges every device the full
+    array (it is genuinely resident N times — the honesty ``tree_bytes``
+    cannot provide). Non-device leaves (host numpy) are skipped: they are not
+    HBM. On an unsharded engine this returns one entry whose value equals
+    ``tree_bytes`` exactly — the regression pin."""
+    out: dict[int, int] = {}
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards is None:
+                continue
+            for sh in shards:
+                d = int(sh.device.id)
+                out[d] = out.get(d, 0) + int(sh.data.size) * sh.data.dtype.itemsize
+    return dict(sorted(out.items()))

@@ -1,0 +1,229 @@
+"""Replica roles and the prefill→decode KV-handoff protocol (backend-free).
+
+Disaggregated serving splits a fleet into a **prefill tier** (compute-bound:
+chew through prompt chunks, never hold a decode slot hostage) and a **decode
+tier** (memory-bound: slots, KV residency, token streaming). The router
+steers by request phase — a long-prompt request prefills on a prefill-tier
+replica, then its finished KV planes move prefill→decode and the decode-tier
+replica admits the request as a full prefix-cache hit, skipping its own
+prefill entirely. Disaggregation is an OPTIMIZATION, never a dependency: any
+step of it failing (no prefill capacity, a mid-handoff kill, a CRC fault)
+falls back to classic local prefill on a decode/unified replica — zero
+requests lost is the contract the chaos tests pin.
+
+The handoff rides the warm-start machinery (DESIGN.md §9): the prefill engine
+already snapshots a finished prompt's planes into its prefix cache
+(``_finish_prefill``), and the decode engine already installs planes through
+one fixed-shape program (``_install_jit``). What this module adds is the wire
+between those two facts: a codec that turns one slot's plane pytree into a
+JSON-safe, CRC-stamped payload, and the tiny always-framed socket protocol
+the replicas speak directly to each other (``kv_handoff`` →
+``kv_handoff_ack``). Bulk KV bytes move replica↔replica — the router only
+brokers WHICH decode replica receives the planes; it never sees them. That is
+why this module must stay backend-free (stdlib + numpy, graftlint-enforced):
+the router imports it for role parsing and must never initialize a backend.
+
+Layout safety is signature-equality, not trust: both ends compute
+``ops.quant.cache_layout`` over their OWN engine's cache and the handoff
+carries the sender's signature — a decode engine running a different KV dtype
+rejects the planes (they would be reinterpreted garbage), exactly the prefix
+cache's own layout guard.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import zlib
+
+import numpy as np
+
+from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+    wire as wire_mod,
+)
+
+# Replica roles. ``unified`` is the classic do-everything replica (the default
+# — a fleet with no tier flags behaves byte-identically to pre-tier builds).
+ROLE_UNIFIED = "unified"
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLES = (ROLE_UNIFIED, ROLE_PREFILL, ROLE_DECODE)
+
+
+def parse_tier_spec(spec: str | None) -> list[str]:
+    """``"prefill:1,decode:2"`` -> ``["prefill", "decode", "decode"]`` — the
+    per-index role list a fleet launcher assigns replicas by position.
+    Empty/None -> ``[]`` (an untiered fleet). Roles must be known; counts must
+    be positive."""
+    roles: list[str] = []
+    for part in (spec or "").replace(" ", "").split(","):
+        if not part:
+            continue
+        role, _, count = part.partition(":")
+        count = count or "1"
+        if role not in ROLES or not count.isdigit() or int(count) < 1:
+            raise ValueError(f"bad tier spec entry {part!r} "
+                             f"(want role:count, role in {ROLES})")
+        roles.extend([role] * int(count))
+    return roles
+
+
+def parse_shard_spec(spec: str | None) -> tuple[int, int]:
+    """``"tp=2,dp=4"`` -> ``(tp, dp)``: the jax-free twin of
+    ``serving.shard.parse_shard_spec`` for backend-free callers (the router
+    and loadgen validate/forward the flag; only the replica process, which
+    owns a backend anyway, builds the actual mesh)."""
+    tp = dp = 1
+    for part in (spec or "").replace(" ", "").split(","):
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        if key not in ("tp", "dp") or not val.isdigit() or int(val) < 1:
+            raise ValueError(f"bad shard spec entry {part!r} "
+                             f"(want tp=<n>,dp=<n>)")
+        if key == "tp":
+            tp = int(val)
+        else:
+            dp = int(val)
+    return tp, dp
+
+
+# -----------------------------------------------------------------------------------------
+# Plane codec: one slot's KV pytree <-> a JSON-safe, CRC-stamped payload
+# -----------------------------------------------------------------------------------------
+
+
+def _flatten(tree, prefix=""):
+    """Deterministic (sorted-key, '/'-joined) flatten of a nested-dict plane
+    tree — a backend-free stand-in for ``jax.tree_util`` that preserves enough
+    structure to rebuild the exact pytree on the far side."""
+    if isinstance(tree, dict):
+        out = []
+        for key in sorted(tree):
+            out.extend(_flatten(tree[key], f"{prefix}{key}/"))
+        return out
+    return [(prefix[:-1], tree)]
+
+
+def _unflatten(entries: dict) -> dict:
+    tree: dict = {}
+    for path, leaf in entries.items():
+        node = tree
+        *parents, name = path.split("/")
+        for part in parents:
+            node = node.setdefault(part, {})
+        node[name] = leaf
+    return tree
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # Extended dtypes (bfloat16) register via ml_dtypes — numpy-only, so
+        # importing it here keeps this module backend-free.
+        import ml_dtypes  # noqa: F401
+        return np.dtype(name)
+
+
+def encode_planes(planes: dict, *, layout: str | None = None) -> dict:
+    """One slot's plane pytree as a JSON-safe handoff payload: per-leaf
+    base64 raw bytes each stamped with its own ``crc32`` (defense in depth —
+    the framed wire CRCs the whole message, the per-plane CRCs localize WHICH
+    plane a fault hit), plus the sender's plane-layout signature and the total
+    raw byte count (the telemetry/accounting number, pre-base64)."""
+    entries = []
+    total = 0
+    for path, leaf in _flatten(planes):
+        arr = np.asarray(leaf)
+        raw = arr.tobytes()
+        total += len(raw)
+        entries.append({
+            "path": path,
+            "dtype": arr.dtype.name,
+            "shape": list(arr.shape),
+            "crc32": zlib.crc32(raw),
+            "data": base64.b64encode(raw).decode("ascii"),
+        })
+    return {"layout": layout, "bytes": total, "planes": entries}
+
+
+def decode_planes(payload: dict, *, layout: str | None = None) -> dict:
+    """Rebuild the plane pytree from :func:`encode_planes` output, verifying
+    every per-plane CRC and (when ``layout`` is given) the sender's layout
+    signature. Raises :class:`serving.wire.WireCorrupt` on a CRC mismatch and
+    ``ValueError`` on a layout mismatch — distinct faults: damage is retried
+    by the connection owner, incompatibility falls back to local prefill."""
+    if layout is not None and payload.get("layout") != layout:
+        raise ValueError(
+            f"plane layout mismatch: sender {payload.get('layout')!r} != "
+            f"receiver {layout!r}")
+    leaves = {}
+    for entry in payload["planes"]:
+        raw = base64.b64decode(entry["data"])
+        crc = zlib.crc32(raw)
+        if crc != entry["crc32"]:
+            raise wire_mod.WireCorrupt(
+                f"handoff plane {entry['path']!r} crc mismatch "
+                f"(want {entry['crc32']:#010x}, got {crc:#010x})")
+        leaves[entry["path"]] = np.frombuffer(
+            raw, dtype=_np_dtype(entry["dtype"])).reshape(entry["shape"])
+    return _unflatten(leaves)
+
+
+# -----------------------------------------------------------------------------------------
+# The replica↔replica handoff socket protocol (always framed — both ends are
+# new in this build, so unlike the router wire there is no legacy mode to
+# negotiate away from)
+# -----------------------------------------------------------------------------------------
+
+
+def ship_planes(host: str, port: int, *, request_id, tokens, payload: dict,
+                timeout_s: float = 10.0) -> dict:
+    """Prefill side: open a connection to a decode replica's handoff
+    listener, send one framed ``kv_handoff`` message, await the framed ack,
+    close. Returns the ack dict (``{"op": "kv_handoff_ack", "id", "ok", ...}``).
+    Socket/timeout faults surface as ``OSError``; a corrupt ack as
+    :class:`WireCorrupt` — the caller (the prefill replica's ship thread)
+    reports either to the router as ``prefill_failed`` and the router falls
+    back to local prefill."""
+    msg = {"op": "kv_handoff", "id": request_id,
+           "tokens": [int(t) for t in tokens], **payload}
+    with socket.create_connection((host, port), timeout=timeout_s) as sock:
+        sock.sendall(wire_mod.encode_msg(msg, framed=True))
+        dec = wire_mod.FrameDecoder()
+        while True:
+            chunk = sock.recv(65536)
+            if not chunk:
+                raise OSError("handoff peer closed before ack")
+            frames = dec.feed(chunk)
+            if frames:
+                return json.loads(frames[0])
+
+
+def read_handoff(sock, *, max_bytes: int | None = None) -> dict | None:
+    """Decode side: read exactly one framed message off an accepted handoff
+    connection (None on clean EOF before a complete frame). ``max_bytes``
+    (default: the wire's frame cap) bounds a runaway peer."""
+    dec = wire_mod.FrameDecoder()
+    while True:
+        chunk = sock.recv(65536)
+        if not chunk:
+            return None
+        frames = dec.feed(chunk)
+        if frames:
+            return json.loads(frames[0])
+        if max_bytes is not None and dec.pending > max_bytes:
+            raise wire_mod.WireCorrupt(
+                f"handoff message exceeds {max_bytes} bytes")
+
+
+def send_ack(sock, *, request_id, ok: bool, nbytes: int = 0,
+             reason: str | None = None) -> None:
+    """Decode side: the framed ack closing one handoff exchange."""
+    msg = {"op": "kv_handoff_ack", "id": request_id, "ok": bool(ok),
+           "bytes": int(nbytes)}
+    if reason:
+        msg["reason"] = reason
+    sock.sendall(wire_mod.encode_msg(msg, framed=True))

@@ -55,6 +55,8 @@ EVENT_KINDS: dict[str, str] = {
     "eject": "straggler ejection lifecycle: eject (degraded) / probe (back to ready)",
     "hedge": "one speculative re-dispatch: request, second replica, deadline",
     "chaos": "one injected network fault (resilience/netfaults.py proxy schedule)",
+    "tier": "replica tier membership at ready: role + handoff port (disaggregation)",
+    "kv_handoff": "one prefill→decode KV plane handoff: bytes/wall/ok (serving/tiers.py)",
     # -- resilience (resilience/supervisor.py, utils/checkpoint.py) -------------
     "checkpoint": "one checkpoint save/restore: op/kind/bytes/wall",
     "restart": "supervisor restart: attempt, crash/hung/poisoned reason, backoff",

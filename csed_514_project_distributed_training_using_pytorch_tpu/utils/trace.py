@@ -177,7 +177,8 @@ LIFECYCLE_SPANS = ("scale", "reload", "eject")
 # covers only the post-resume stint, so the three never overlap; the padding
 # between park and resume that neither captures lands in ``overhead`` like
 # any other scheduling gap.
-SEGMENTS = ("router_queue_wait", "route", "failed_dispatch", "replica_queue_wait",
+SEGMENTS = ("router_queue_wait", "route", "failed_dispatch", "prefill_tier",
+            "handoff", "replica_queue_wait",
             "prefill", "preempt_park", "resume", "draft", "verify",
             "decode_first", "decode_tail", "resolve", "overhead")
 
@@ -212,6 +213,14 @@ def trace_breakdown(spans: list[dict]) -> dict:
                        f"replica{d.get('replica')}")
                       for d in by_name.get("dispatch", ())
                       if d.get("outcome") == "hedge_lost"]
+    # Disaggregated prefill (DESIGN.md §25): the router's ``prefill_tier``
+    # span covers the whole prefill-replica stint (dispatch → prefill_done),
+    # so the prefill replica's own interior spans (its queue_wait/prefill)
+    # are excluded from their segments — the tier window already charges
+    # that wall, exclusively. The decode replica's spans start after the
+    # window closes, so the decode-tier wall stays in decode_first/tail.
+    tier_windows = [(d["ts"], d["ts"] + (d.get("dur_s") or 0.0))
+                    for d in by_name.get("prefill_tier", ())]
 
     def losing(s):
         # Only replica-side spans can be "inside" a losing hop; the router's
@@ -222,6 +231,8 @@ def trace_breakdown(spans: list[dict]) -> dict:
         if s.get("proc") == "router":
             return False
         if any(a - 2e-6 <= s["ts"] <= b + 2e-6 for a, b in drained_windows):
+            return True
+        if any(a - 2e-6 <= s["ts"] <= b + 2e-6 for a, b in tier_windows):
             return True
         return any(a - 2e-6 <= s["ts"] <= b + 2e-6
                    for a, b, proc in shadow_windows
@@ -240,6 +251,11 @@ def trace_breakdown(spans: list[dict]) -> dict:
                                       lambda s: s.get("proc") != "router")
     seg["route"] = total("route")
     seg["failed_dispatch"] = sum(b - a for a, b in drained_windows)
+    # The handoff span lies INSIDE the prefill_tier window (the router closes
+    # both at prefill_done): charge the shipping wall to its own segment and
+    # carve the same seconds out of the tier window, so the sum stays e2e.
+    seg["handoff"] = total("handoff")
+    seg["prefill_tier"] = max(0.0, total("prefill_tier") - seg["handoff"])
     seg["prefill"] = total("prefill")
     # Priority preemption (DESIGN.md §22): the evicted decode stint and the
     # parked wait are their own segments — a preempted best-effort request's

@@ -1,0 +1,656 @@
+"""Sharded & disaggregated serving (DESIGN.md §25, PR 17 gates).
+
+Three acceptance families:
+
+- **mesh tier**: the engine on a TP×(slot-DP) serve mesh (8 virtual CPU
+  devices) emits a token stream bitwise-identical to the single-chip oracle —
+  across MHA/GQA/windowed/RoPE attention, int8 KV, speculative decoding, and
+  slot recycling — with every trace-count pin intact, and ``byte_accounting``
+  reports per-chip residency measured from the arrays' own shards (the
+  sharded-byte-math bugfix, with the unsharded regression pin).
+- **tier tier**: the prefill→decode KV handoff — codec roundtrip + CRC/layout
+  refusal, the jax-free doctrine for ``serving/tiers.py``, and an echo fleet
+  where the router steers phases, counts handoffs, and keeps the zero-loss
+  guarantee through a prefill-replica kill (fallback to local prefill).
+- **plan tier**: ``search_serve`` enumerates exactly the meshes
+  ``validate_engine_mesh`` accepts and the measured-best candidate is always
+  the pick; the trace segment table separates prefill-tier/handoff/decode
+  wall exclusively.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from csed_514_project_distributed_training_using_pytorch_tpu.models import (  # noqa: E402
+    lm,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving import (  # noqa: E402
+    ContinuousBatchingEngine,
+    Request,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving import (  # noqa: E402
+    shard as shard_mod,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving import (  # noqa: E402
+    tiers as tiers_mod,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.wire import (  # noqa: E402
+    WireCorrupt,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "csed_514_project_distributed_training_using_pytorch_tpu"
+
+SMALL = dict(vocab_size=9, seq_len=16, embed_dim=32, num_layers=2, num_heads=4)
+
+
+def _build(**overrides):
+    model = lm.TransformerLM(**{**SMALL, **overrides})
+    ids = jnp.zeros((1, model.seq_len), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, ids)["params"]
+    return model, params
+
+
+def _workload(model, n=8, seed=7):
+    """Mixed prompt lengths (including empty) and generation lengths; with
+    ``n`` > ``num_slots`` the engine recycles slots mid-run."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        plen = int(rng.integers(0, model.seq_len // 2))
+        reqs.append(Request(
+            prompt=rng.integers(0, 8, size=plen).astype(np.int32),
+            max_new_tokens=int(rng.integers(1, model.seq_len)),
+            request_id=i))
+    return reqs
+
+
+def _tokens(engine, reqs):
+    return {c.request.request_id: tuple(np.asarray(c.tokens).tolist())
+            for c in engine.run(reqs)}
+
+
+# -----------------------------------------------------------------------------------------
+# Mesh tier: cross-mesh token identity + trace-count pins
+# -----------------------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("variant,model_kw,engine_kw", [
+    ("mha", {}, {}),
+    ("gqa", {"num_kv_heads": 2}, {}),
+    pytest.param("window", {"attention_window": 8}, {},
+                 marks=pytest.mark.slow),
+    pytest.param("rope", {"rope": True}, {}, marks=pytest.mark.slow),
+    ("int8_kv", {}, {"kv_dtype": "int8"}),
+    ("spec_ngram", {}, {"spec": "ngram", "spec_k": 4}),
+])
+def test_sharded_engine_token_identical_to_single_chip(variant, model_kw,
+                                                       engine_kw, devices8):
+    model, params = _build(**model_kw)
+    reqs = _workload(model, n=8)
+
+    oracle = ContinuousBatchingEngine(model, params, num_slots=4, **engine_kw)
+    want = _tokens(oracle, reqs)
+
+    # GQA with 2 KV heads caps tp at 2 (validate_engine_mesh).
+    tp = 2
+    dp = 2
+    sm = shard_mod.build_serve_mesh(tp=tp, dp=dp)
+    sharded = ContinuousBatchingEngine(model, params, num_slots=4, mesh=sm,
+                                       **engine_kw)
+    got = _tokens(sharded, reqs)
+
+    assert got == want, f"{variant}: sharded tokens diverged from oracle"
+    # One compiled program per shape family survives the mesh. (With spec
+    # decoding the plain decode program may never run — == oracle, <= 1.)
+    assert sharded.trace_count == oracle.trace_count <= 1
+    assert sharded.admit_trace_count == 1
+    assert sharded.prefill_trace_counts == oracle.prefill_trace_counts
+    assert all(v <= 1 for v in sharded.prefill_trace_counts.values())
+    if engine_kw.get("spec") == "ngram":
+        assert sharded.verify_trace_counts == oracle.verify_trace_counts
+        assert all(v <= 1 for v in sharded.verify_trace_counts.values())
+
+
+@pytest.mark.slow      # redundant with the matrix above; CI smoke runs it
+def test_sharded_engine_tp_only_and_dp_only_meshes(devices8):
+    model, params = _build()
+    reqs = _workload(model, n=6, seed=13)
+    want = _tokens(ContinuousBatchingEngine(model, params, num_slots=4), reqs)
+    for tp, dp in ((2, 1), (1, 2), (4, 2)):
+        sm = shard_mod.build_serve_mesh(tp=tp, dp=dp)
+        got = _tokens(ContinuousBatchingEngine(model, params, num_slots=4,
+                                               mesh=sm), reqs)
+        assert got == want, f"tp={tp},dp={dp} diverged"
+
+
+@pytest.mark.slow      # two prefix-cache engines; CI smoke runs it
+def test_sharded_prefix_cache_hit_token_identical(devices8):
+    model, params = _build()
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 8, size=10).astype(np.int32)
+    reqs = [Request(prompt=prompt.copy(), max_new_tokens=4, request_id=i)
+            for i in range(2)]
+    oracle = ContinuousBatchingEngine(model, params, num_slots=2,
+                                      prefix_cache_entries=4)
+    # Run the repeats SEQUENTIALLY: the second must observe the first's
+    # snapshot (concurrent admission would race past the cache fill).
+    want = {**_tokens(oracle, reqs[:1]), **_tokens(oracle, reqs[1:])}
+    sm = shard_mod.build_serve_mesh(tp=2, dp=2)
+    sharded = ContinuousBatchingEngine(model, params, num_slots=2,
+                                       prefix_cache_entries=4, mesh=sm)
+    got = {**_tokens(sharded, reqs[:1]), **_tokens(sharded, reqs[1:])}
+    assert got == want
+    # The snapshot/install path actually exercised a hit under the mesh.
+    stats = sharded.prefix_cache.stats()
+    assert stats["hits"] >= 1
+
+
+def test_validate_engine_mesh_rejects_illegal_splits(devices8):
+    model, _ = _build(num_kv_heads=2)
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        shard_mod.validate_engine_mesh(
+            model, 4, shard_mod.build_serve_mesh(tp=4, dp=1))
+    with pytest.raises(ValueError, match="num_slots"):
+        shard_mod.validate_engine_mesh(
+            model, 3, shard_mod.build_serve_mesh(tp=1, dp=2))
+
+
+def test_parse_shard_spec_twins_agree():
+    for spec, want in (("", (1, 1)), ("tp=2", (2, 1)), ("tp=2,dp=4", (2, 4)),
+                       ("dp=2, tp=2", (2, 2))):
+        assert shard_mod.parse_shard_spec(spec) == want
+        assert tiers_mod.parse_shard_spec(spec) == want
+    for bad in ("tp=0", "tp=x", "pp=2", "tp"):
+        with pytest.raises(ValueError):
+            shard_mod.parse_shard_spec(bad)
+        with pytest.raises(ValueError):
+            tiers_mod.parse_shard_spec(bad)
+
+
+# -----------------------------------------------------------------------------------------
+# Byte accounting: per-chip residency measured from shards
+# -----------------------------------------------------------------------------------------
+
+
+def test_unsharded_byte_accounting_per_chip_regression_pin():
+    """The bugfix's back-compat pin: on a single chip the one per-chip row
+    equals the legacy logical totals EXACTLY."""
+    model, params = _build()
+    e = ContinuousBatchingEngine(model, params, num_slots=4)
+    acct = e.byte_accounting()
+    assert acct["mesh"] is None
+    assert len(acct["per_chip"]) == 1
+    row = next(iter(acct["per_chip"].values()))
+    assert row["params_bytes"] == acct["params_bytes"]
+    assert row["kv_bytes"] == acct["kv_bytes_resident"]
+    assert row["prompt_bytes"] == acct["prompt_bytes"]
+    assert acct["bytes_per_chip_max"] == row["total_bytes"]
+    assert (acct["params_kv_bytes_per_chip_max"]
+            == acct["params_bytes"] + acct["kv_bytes_resident"])
+
+
+def test_sharded_byte_accounting_sums_shards_per_chip(devices8):
+    model, params = _build()
+    single = ContinuousBatchingEngine(model, params, num_slots=4)
+    s_acct = single.byte_accounting()
+    sm = shard_mod.build_serve_mesh(tp=2, dp=2)
+    e = ContinuousBatchingEngine(model, params, num_slots=4, mesh=sm)
+    acct = e.byte_accounting()
+    assert acct["mesh"]["tp"] == 2 and acct["mesh"]["dp"] == 2
+    assert len(acct["per_chip"]) == 4
+    # KV planes shard fully (heads × slots): the 4 chips' kv bytes sum to the
+    # logical total; params shard partially (embeddings/norms replicate), so
+    # the per-chip sum is >= logical but each chip holds < the whole.
+    kv_sum = sum(r["kv_bytes"] for r in acct["per_chip"].values())
+    assert kv_sum == s_acct["kv_bytes_resident"]
+    assert all(r["params_bytes"] < s_acct["params_bytes"]
+               for r in acct["per_chip"].values())
+    # The PR acceptance ratio: params+KV per chip <= single-chip / 1.8.
+    single_total = s_acct["params_bytes"] + s_acct["kv_bytes_resident"]
+    assert acct["params_kv_bytes_per_chip_max"] <= single_total / 1.8
+    # Capacity scales with the per-chip budget: dp groups × per-chip fit.
+    assert acct["slots_at_budget"] >= s_acct["slots_at_budget"]
+
+
+def test_per_device_bytes_counts_replicated_leaves_per_device(devices8):
+    sm = shard_mod.build_serve_mesh(tp=2, dp=1)
+    x = jax.device_put(jnp.zeros((8, 8), jnp.float32), sm.replicated())
+    per = shard_mod.per_device_bytes({"x": x})
+    assert len(per) == 2
+    assert all(v == 8 * 8 * 4 for v in per.values())
+    y = jax.device_put(
+        jnp.zeros((8, 8), jnp.float32),
+        jax.sharding.NamedSharding(sm.mesh,
+                                   jax.sharding.PartitionSpec(None, "model")))
+    per = shard_mod.per_device_bytes({"y": y})
+    assert sum(per.values()) == 8 * 8 * 4
+
+
+# -----------------------------------------------------------------------------------------
+# Tier tier: the handoff codec + the jax-free doctrine
+# -----------------------------------------------------------------------------------------
+
+
+def _fake_planes():
+    rng = np.random.default_rng(0)
+    return {"layer0": {"k": rng.standard_normal((4, 2, 3)).astype(np.float32),
+                       "v": rng.standard_normal((4, 2, 3)).astype(np.float32),
+                       "k_scale": rng.standard_normal((4, 2)).astype(np.float32)}}
+
+
+def test_plane_codec_roundtrip_bitwise():
+    planes = _fake_planes()
+    payload = tiers_mod.encode_planes(planes, layout="L")
+    assert payload["bytes"] == sum(
+        a.nbytes for a in (planes["layer0"]["k"], planes["layer0"]["v"],
+                           planes["layer0"]["k_scale"]))
+    back = tiers_mod.decode_planes(payload, layout="L")
+    for name in ("k", "v", "k_scale"):
+        np.testing.assert_array_equal(back["layer0"][name],
+                                      planes["layer0"][name])
+        assert back["layer0"][name].dtype == planes["layer0"][name].dtype
+
+
+def test_plane_codec_crc_mismatch_is_typed():
+    payload = tiers_mod.encode_planes(_fake_planes())
+    payload["planes"][0]["crc32"] ^= 1
+    with pytest.raises(WireCorrupt):
+        tiers_mod.decode_planes(payload)
+
+
+def test_plane_codec_layout_mismatch_refused():
+    payload = tiers_mod.encode_planes(_fake_planes(), layout="int8-planes")
+    with pytest.raises(ValueError, match="layout"):
+        tiers_mod.decode_planes(payload, layout="fp32-planes")
+
+
+def test_parse_tier_spec():
+    assert tiers_mod.parse_tier_spec("") == []
+    assert tiers_mod.parse_tier_spec("prefill:1,decode:2") == \
+        ["prefill", "decode", "decode"]
+    assert tiers_mod.parse_tier_spec("prefill,decode") == ["prefill", "decode"]
+    with pytest.raises(ValueError):
+        tiers_mod.parse_tier_spec("prefil:1")
+    with pytest.raises(ValueError):
+        tiers_mod.parse_tier_spec("prefill:0")
+
+
+def test_tiers_module_is_jax_free():
+    """The router imports serving.tiers for role parsing — it must never drag
+    a backend in (graftlint pins the static import graph; this pins the live
+    interpreter). JAX_PLATFORMS is cleared: the package __init__ eagerly
+    imports jax only when that env knob is set."""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PYTHONPATH"] = REPO
+    probe = (f"import sys; sys.path.insert(0, {REPO!r}); "
+             f"import {PKG}.serving.tiers; "
+             "assert 'jax' not in sys.modules, 'tiers imported jax'")
+    subprocess.run([sys.executable, "-c", probe], check=True, env=env)
+
+
+# -----------------------------------------------------------------------------------------
+# Tiered echo fleet: phase steering, handoff telemetry, kill fallback
+# -----------------------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _child_pythonpath(monkeypatch):
+    existing = os.environ.get("PYTHONPATH", "")
+    monkeypatch.setenv("PYTHONPATH", f"{REPO}:{existing}" if existing else REPO)
+
+
+def _echo_cmd(*, num_slots=4, max_pending=8):
+    return ["-m", f"{PKG}.serving.replica", "--echo",
+            "--num-levels", "8", "--seq-len", "32",
+            "--num-slots", str(num_slots), "--max-pending", str(max_pending)]
+
+
+def _tier_router(tmp_path, n=2, roles=("prefill", "decode"), **kw):
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.router import (
+        Router,
+    )
+
+    kw.setdefault("heartbeat_dir", str(tmp_path / "hb"))
+    kw.setdefault("heartbeat_timeout_s", 30.0)
+    kw.setdefault("backoff_s", 0.2)
+    kw.setdefault("telemetry", str(tmp_path / "router.jsonl"))
+    return Router(_echo_cmd(), num_replicas=n, platform=None,
+                  replica_extra_args=[["--tier", r] for r in roles], **kw)
+
+
+def _submit_n(router, n, *, max_new=4, seed=5):
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.scheduler import (
+        SamplingParams,
+    )
+
+    rng = np.random.default_rng(seed)
+    futs = []
+    for _ in range(n):
+        prompt = rng.integers(1, 6, size=int(rng.integers(4, 12))).astype(
+            np.int32)
+        futs.append(router.submit(prompt, max_new_tokens=max_new,
+                                  sampling=SamplingParams()))
+    return [f.result(timeout=60) for f in futs]
+
+
+def test_tiered_echo_fleet_disaggregates_and_counts_handoffs(tmp_path):
+    r = _tier_router(tmp_path)
+    r.start()
+    assert r.wait_ready(60.0)
+    try:
+        comps = _submit_n(r, 6)
+        assert all(c.ok for c in comps)
+        assert all(c.disagg for c in comps), \
+            "every request should take the prefill->decode path"
+        snap = r.fleet_snapshot()
+        assert snap["handoffs"] == 6
+        assert snap["handoff_bytes"] > 0
+        assert snap["handoff_failures"] == 0
+        tiers = {row["replica"]: row.get("tier")
+                 for row in snap["per_replica"]}
+        assert tiers == {0: "prefill", 1: "decode"}
+    finally:
+        summ = r.stop()
+    assert summ["ok"] == 6 and summ["failed"] == 0
+    assert summ["handoffs"] == 6
+    kinds = {}
+    for row in (json.loads(l) for l in open(tmp_path / "router.jsonl")):
+        kinds[row.get("event")] = kinds.get(row.get("event"), 0) + 1
+    assert kinds.get("tier", 0) >= 2
+    assert kinds.get("kv_handoff", 0) >= 6
+
+
+def test_tiered_fleet_prefill_kill_falls_back_zero_loss(tmp_path, monkeypatch):
+    """The PR's loss gate: kill the prefill-tier replica mid-run — in-flight
+    prefill-phase requests latch no_disagg and complete via classic local
+    prefill on the decode tier. Zero requests lost."""
+    monkeypatch.setenv("RESILIENCE_FAULTS", "kill:proc=0,step=2")
+    r = _tier_router(tmp_path, max_restarts=3)
+    r.start()
+    assert r.wait_ready(60.0)
+    try:
+        comps = _submit_n(r, 8, seed=9)
+    finally:
+        summ = r.stop()
+    assert len(comps) == 8
+    assert all(c.ok for c in comps), [c.finish for c in comps]
+    assert summ["ok"] == 8 and summ["failed"] == 0
+
+
+def test_untiered_fleet_snapshot_schema_unchanged(tmp_path):
+    """A fleet with no --tier flags must not grow tier/handoff per-replica
+    fields (schema-stable for every existing consumer)."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.router import (
+        Router,
+    )
+
+    r = Router(_echo_cmd(), num_replicas=1, platform=None,
+               heartbeat_dir=str(tmp_path / "hb"),
+               telemetry=str(tmp_path / "router.jsonl"))
+    r.start()
+    assert r.wait_ready(60.0)
+    try:
+        comps = _submit_n(r, 2)
+        assert all(c.ok for c in comps)
+        assert not any(c.disagg for c in comps)
+        snap = r.fleet_snapshot()
+        assert "tier" not in snap["per_replica"][0]
+        assert snap["handoffs"] == 0
+    finally:
+        r.stop()
+
+
+# -----------------------------------------------------------------------------------------
+# Plan tier: the serve scenario, legality, and measured-best pick
+# -----------------------------------------------------------------------------------------
+
+
+def _serve_scenario(measure=None, num_devices=4, num_slots=8, **stats_kw):
+    from csed_514_project_distributed_training_using_pytorch_tpu.plan import (
+        ServeScenario, ServeStats, Topology,
+    )
+
+    kw = dict(name="t", param_bytes=1 << 20, kv_bytes_per_slot=1 << 16,
+              flops_per_token=1e6, num_layers=2, num_heads=4, num_kv_heads=4,
+              seq_len=64, embed_dim=32, dtype_bytes=4, shardable_fraction=0.8)
+    kw.update(stats_kw)
+    stats = ServeStats(**kw)
+    topo = Topology(num_devices=num_devices, device_kind="cpu",
+                    hbm_bytes=1 << 30)
+    return ServeScenario(stats=stats, topo=topo, num_slots=num_slots,
+                         prompt_len=32, measure=measure)
+
+
+def test_enumerate_serve_candidates_mirrors_mesh_legality():
+    from csed_514_project_distributed_training_using_pytorch_tpu.plan import (
+        enumerate_serve_candidates,
+    )
+
+    sc = _serve_scenario()
+    assert enumerate_serve_candidates(sc) == [(1, 4), (2, 2), (4, 1)]
+    # GQA caps tp; odd slot counts cap dp — exactly validate_engine_mesh.
+    sc2 = _serve_scenario(num_kv_heads=2)
+    assert all(tp <= 2 for tp, _ in enumerate_serve_candidates(sc2))
+    sc3 = _serve_scenario(num_slots=9)
+    assert all(dp in (1, 3, 9) for _, dp in enumerate_serve_candidates(sc3))
+
+
+def test_predict_serve_bytes_mirror_shard_split():
+    from csed_514_project_distributed_training_using_pytorch_tpu.plan import (
+        predict_serve,
+    )
+
+    sc = _serve_scenario()
+    c1 = predict_serve(sc.stats, sc.topo, tp=1, dp=1, num_slots=8,
+                       prompt_len=32)
+    c2 = predict_serve(sc.stats, sc.topo, tp=2, dp=2, num_slots=8,
+                       prompt_len=32)
+    # tp halves the shardable params; dp halves each chip's slot group.
+    shardable = sc.stats.param_bytes * sc.stats.shardable_fraction
+    assert c2.params_bytes_per_chip == pytest.approx(
+        shardable / 2 + sc.stats.param_bytes - shardable)
+    assert c2.kv_bytes_per_chip == pytest.approx(c1.kv_bytes_per_chip / 4)
+    assert c2.slots_at_budget >= c1.slots_at_budget
+    assert c1.fits and c2.fits
+
+
+def test_search_serve_measured_best_is_the_pick():
+    measured = {(1, 4): 10.0, (2, 2): 30.0, (4, 1): 20.0}
+
+    def measure(tp, dp):
+        return measured[(tp, dp)]
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.plan import (
+        search_serve,
+    )
+
+    rows = search_serve(_serve_scenario(measure=measure))
+    assert rows[0].measured_tokens_per_s == 30.0
+    assert (rows[0].tp, rows[0].dp) == (2, 2)
+    assert rows[0].shard_spec() == "tp=2,dp=2"
+    # Measured rows outrank every unmeasured prediction.
+    head = [r for r in rows if r.measured_tokens_per_s is not None]
+    assert [r.measured_tokens_per_s for r in head] == \
+        sorted((r.measured_tokens_per_s for r in head), reverse=True)
+
+
+def test_search_serve_raises_when_nothing_fits():
+    from csed_514_project_distributed_training_using_pytorch_tpu.plan import (
+        ServeScenario, ServeStats, Topology, search_serve,
+    )
+
+    stats = ServeStats(name="fat", param_bytes=1 << 40,
+                       kv_bytes_per_slot=1 << 30, num_heads=4, num_kv_heads=4)
+    sc = ServeScenario(stats=stats,
+                       topo=Topology(num_devices=4, hbm_bytes=1 << 20),
+                       num_slots=4, prompt_len=8)
+    with pytest.raises(ValueError, match="quantize"):
+        search_serve(sc)
+
+
+def test_for_serve_counts_kv_and_params_exactly():
+    from csed_514_project_distributed_training_using_pytorch_tpu.plan.scenarios import (
+        for_serve,
+    )
+
+    model, _ = _build()
+    sc = for_serve(model, num_slots=4, prompt_len=8)
+    cache = jax.eval_shape(lambda: lm.init_cache(model, 1))
+    kv = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+             for l in jax.tree_util.tree_leaves(cache))
+    assert sc.stats.kv_bytes_per_slot == kv
+    assert sc.stats.param_bytes > 0
+    assert 0 < sc.stats.shardable_fraction <= 1
+    # int8 KV prices its own scale planes (the engine can't disagree).
+    sc8 = for_serve(model, num_slots=4, prompt_len=8, kv_dtype="int8")
+    assert sc8.stats.kv_bytes_per_slot < sc.stats.kv_bytes_per_slot
+
+
+# -----------------------------------------------------------------------------------------
+# Trace segments: prefill_tier / handoff / decode wall are exclusive
+# -----------------------------------------------------------------------------------------
+
+
+def test_trace_breakdown_separates_tier_handoff_decode_wall():
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.trace import (
+        SEGMENTS, trace_breakdown,
+    )
+
+    assert "prefill_tier" in SEGMENTS and "handoff" in SEGMENTS
+    tid = "t1"
+    spans = [
+        {"event": "span", "trace_id": tid, "name": "queue_wait",
+         "proc": "router", "ts": 0.0, "dur_s": 0.1},
+        {"event": "span", "trace_id": tid, "name": "route",
+         "proc": "router", "ts": 0.1, "dur_s": 0.0},
+        # The tier window: dispatch -> prefill_done, handoff inside it.
+        {"event": "span", "trace_id": tid, "name": "prefill_tier",
+         "proc": "router", "ts": 0.1, "dur_s": 0.5},
+        {"event": "span", "trace_id": tid, "name": "handoff",
+         "proc": "router", "ts": 0.5, "dur_s": 0.1},
+        # The prefill replica's interior spans: covered by the window,
+        # must NOT be double-charged into their own segments.
+        {"event": "span", "trace_id": tid, "name": "queue_wait",
+         "proc": "replica0", "ts": 0.15, "dur_s": 0.05},
+        {"event": "span", "trace_id": tid, "name": "prefill",
+         "proc": "replica0", "ts": 0.2, "dur_s": 0.2},
+        # The decode tier, after the window closes.
+        {"event": "span", "trace_id": tid, "name": "decode",
+         "proc": "replica1", "ts": 0.7, "dur_s": 0.3,
+         "first_token_s": 0.05, "first_token_ts": 0.75},
+        {"event": "span", "trace_id": tid, "name": "resolve",
+         "proc": "router", "ts": 1.0, "dur_s": 0.0},
+    ]
+    d = trace_breakdown(spans)
+    seg = d["segments"]
+    assert seg["handoff"] == pytest.approx(0.1)
+    assert seg["prefill_tier"] == pytest.approx(0.4)   # window minus handoff
+    assert seg["replica_queue_wait"] == 0.0            # covered by the window
+    assert seg["prefill"] == 0.0
+    assert seg["decode_first"] == pytest.approx(0.05)
+    assert seg["decode_tail"] == pytest.approx(0.25)
+    # Exclusivity: the segments (plus overhead) sum exactly to e2e.
+    assert sum(seg.values()) == pytest.approx(d["e2e_s"])
+    assert d["resolved"]
+
+
+# -----------------------------------------------------------------------------------------
+# Report tools: handoff rows + per-tier rendering
+# -----------------------------------------------------------------------------------------
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_telemetry_report_summarizes_handoffs(tmp_path):
+    path = tmp_path / "run.jsonl"
+    rows = [
+        {"event": "tier", "replica": 0, "tier": "prefill", "handoff_port": 0},
+        {"event": "tier", "replica": 1, "tier": "decode", "handoff_port": 401},
+        {"event": "kv_handoff", "ok": True, "request_id": 1,
+         "from_replica": 0, "to_replica": 1, "bytes": 100, "wall_s": 0.02,
+         "prefill_ttft_s": 0.3, "prompt_len": 8},
+        {"event": "kv_handoff", "ok": True, "request_id": 2,
+         "from_replica": 0, "to_replica": 1, "bytes": 200, "wall_s": 0.04,
+         "prefill_ttft_s": 0.5, "prompt_len": 8},
+        {"event": "kv_handoff", "ok": False, "request_id": 3,
+         "from_replica": 0, "to_replica": 1, "reason": "dead"},
+        {"event": "router_summary", "requests": 3, "ok": 3, "timeout": 0,
+         "handoffs": 2, "handoff_bytes": 300, "handoff_failures": 1,
+         "per_replica": [
+             {"replica": 0, "state": "ready", "restarts": 0,
+              "dispatched": 3, "completed": 3, "tier": "prefill",
+              "handoffs": 2},
+             {"replica": 1, "state": "ready", "restarts": 0,
+              "dispatched": 2, "completed": 2, "tier": "decode",
+              "handoffs": 2}]},
+    ]
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    rep = _load_tool("telemetry_report")
+    s = rep.summarize(str(path))
+    assert not s.get("unknown_events"), s.get("unknown_kinds")
+    assert s["handoffs"] == 2
+    assert s["handoff_bytes"] == 300
+    assert s["handoff_failures"] == 1
+    assert s["handoff_wall_s"] == pytest.approx(0.03)
+    assert s["tier_ttft_s"] == pytest.approx(0.4)
+    assert s["tier_replicas"] == {"prefill": 1, "decode": 1}
+    assert s["replica_table"][0]["tier"] == "prefill"
+    # The A-vs-B rows exist under the names the comparison table renders.
+    keys = {k for _, k in rep.COMPARE_ROWS}
+    assert {"handoffs", "handoff_bytes", "handoff_wall_s",
+            "tier_ttft_s"} <= keys
+    rep.print_summary(s)   # must render without raising
+
+
+def test_fleet_top_renders_tier_columns_and_handoff_row():
+    top = _load_tool("fleet_top")
+    state = top.FleetState()
+    state.feed([
+        {"event": "tier", "replica": 0, "tier": "prefill", "t_s": 0.1},
+        {"event": "kv_handoff", "ok": True, "from_replica": 0,
+         "to_replica": 1, "bytes": 128, "t_s": 0.2},
+        {"event": "fleet_snapshot", "t_s": 1.0, "replicas_ready": 2,
+         "requests": 4, "ok": 4, "handoffs": 3, "handoff_bytes": 384,
+         "handoff_failures": 0,
+         "queue": {"depth": 0, "oldest_age_s": 0.0},
+         "per_replica": [
+             {"replica": 0, "state": "ready", "inflight": 0, "capacity": 8,
+              "occupancy": 0.0, "restarts": 0, "completed": 4,
+              "tier": "prefill", "handoffs": 3},
+             {"replica": 1, "state": "ready", "inflight": 0, "capacity": 8,
+              "occupancy": 0.0, "restarts": 0, "completed": 4,
+              "tier": "decode", "handoffs": 3}]},
+    ])
+    frame = top.render(state, "x.jsonl")
+    assert "handoffs 3" in frame
+    assert "prefill" in frame and "decode" in frame
+    assert "tier" in frame
+    assert "joined tier" in frame          # the recent-events line
+    assert "kv handoff 0 -> 1" in frame
+
+
+def test_graftlint_declares_tiers_backend_free():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from graftlint import rules
+    finally:
+        sys.path.pop(0)
+    assert "serving/tiers.py" in rules.BACKEND_FREE

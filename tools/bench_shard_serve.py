@@ -1,0 +1,421 @@
+"""Sharded & disaggregated serving bench — the committed artifact (DESIGN.md §25).
+
+Produces ``--out-dir`` (default ``bench_results/shard_serve_cpu/``) with the
+three documents the subsystem is judged by:
+
+- ``shard_serve.json`` — (a) a 2-chip TP replica (``tp=2`` serve mesh over
+  virtual CPU devices) driven by the SAME seeded workload as a single-chip
+  oracle: ``token_identical`` must be 1.0, the trace-count pins must hold
+  under the mesh, and measured params+KV bytes per chip must be at most
+  single-chip / 1.8 (GSPMD actually sharded the planes; nothing silently
+  replicated). Plus (b)'s summaries and the trace segment table separating
+  prefill-tier / handoff / decode wall.
+- ``tiered.jsonl`` — the telemetry stream of a real prefill-tier/decode-tier
+  fleet run (render: ``python tools/telemetry_report.py tiered.jsonl``):
+  every completion CRC-verified over the framed handoff wire
+  (``handoff_failures == 0``), and a second leg that kills the prefill
+  replica mid-run and still loses zero requests (the no_disagg fallback).
+- ``plan_serve.json`` — the serving scenario planner's candidate table with
+  real measured tokens/s for the top predictions; the gate is that the
+  picked mesh IS the measured-best candidate.
+
+Without ``--checkpoint`` the tool first trains the pixel LM on the committed
+MNIST IDX fixture (the spec/quant A/B recipe) so the artifact reflects a
+trained model. ``--quick`` shrinks training and load for the CI smoke job.
+
+Usage::
+
+    python tools/bench_shard_serve.py --out-dir bench_results/shard_serve_cpu
+    python tools/bench_shard_serve.py --quick --out-dir /tmp/sss --work-dir /tmp/ssw
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+# The TP legs need multiple chips; on CPU that is the host-platform device
+# split, which must be set before jax initializes.
+_DEVCOUNT_FLAG = "--xla_force_host_platform_device_count=8"
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = \
+        (os.environ.get("XLA_FLAGS", "") + " " + _DEVCOUNT_FLAG).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURE = os.path.join(_REPO, "tests", "fixtures", "mnist_idx")
+
+
+def ensure_checkpoint(args) -> str:
+    """``--checkpoint`` verbatim, else train the default pixel LM on the
+    committed MNIST fixture and return the saved TrainState path."""
+    if args.checkpoint:
+        return args.checkpoint
+    cached = os.path.join(args.work_dir, "model_lm.ckpt")
+    if os.path.exists(cached):
+        print(f"reusing trained checkpoint {cached}")
+        return cached
+    from csed_514_project_distributed_training_using_pytorch_tpu.train import (
+        lm as lm_train,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.config import (
+        LMConfig,
+    )
+
+    os.makedirs(args.work_dir, exist_ok=True)
+    cfg = LMConfig(epochs=args.train_epochs, batch_size=32, eval_batch=50,
+                   data_dir=args.data_dir, generate=0,
+                   results_dir=args.work_dir,
+                   images_dir=os.path.join(args.work_dir, "images"))
+    print(f"training checkpoint: {args.train_epochs} epochs on {args.data_dir}")
+    lm_train.main(cfg)
+    return os.path.join(args.work_dir, "model_lm.ckpt")
+
+
+def _workload(model, n, max_new, seed):
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        Request,
+    )
+
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        plen = int(rng.integers(0, 96))
+        reqs.append(Request(
+            prompt=rng.integers(0, model.vocab_size - 1,
+                                size=plen).astype(np.int32),
+            max_new_tokens=int(rng.integers(max(1, max_new // 2), max_new + 1)),
+            request_id=i))
+    return reqs
+
+
+def _run(engine, reqs):
+    t0 = time.monotonic()
+    comps = engine.run(reqs)
+    wall = time.monotonic() - t0
+    toks = {c.request.request_id: np.asarray(c.tokens, np.int32)
+            for c in comps}
+    return toks, wall
+
+
+def run_shard_identity(model, params, args) -> dict:
+    """Part (a): 2-chip TP replica vs the single-chip oracle — token identity,
+    trace pins, and the measured per-chip byte gate."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        ContinuousBatchingEngine,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        shard as shard_mod,
+    )
+
+    reqs = _workload(model, args.requests, args.max_new_tokens, args.seed)
+    print(f"== shard identity: {len(reqs)} requests, "
+          f"single chip vs {args.shard}")
+
+    oracle = ContinuousBatchingEngine(model, params, num_slots=args.num_slots)
+    want, wall_1 = _run(oracle, reqs)
+    acct_1 = oracle.byte_accounting()
+
+    tp, dp = shard_mod.parse_shard_spec(args.shard)
+    sm = shard_mod.build_serve_mesh(tp=tp, dp=dp)
+    sharded = ContinuousBatchingEngine(model, params,
+                                       num_slots=args.num_slots, mesh=sm)
+    got, wall_n = _run(sharded, [r for r in reqs])
+    acct_n = sharded.byte_accounting()
+
+    matched = sum(int(np.array_equal(want[i], got[i])) for i in want)
+    identical = matched / len(want)
+    single_pk = acct_1["params_bytes"] + acct_1["kv_bytes_resident"]
+    per_chip_pk = acct_n["params_kv_bytes_per_chip_max"]
+    ratio = per_chip_pk / single_pk
+    pins_ok = (sharded.trace_count <= 1
+               and sharded.trace_count == oracle.trace_count
+               and sharded.admit_trace_count == 1
+               and all(v <= 1 for v in sharded.prefill_trace_counts.values()))
+    doc = {
+        "shard": acct_n["mesh"],
+        "requests": len(reqs),
+        "token_identical": identical,
+        "trace_pins_ok": pins_ok,
+        "decode_compilations": sharded.trace_count,
+        "prefill_compilations": dict(sharded.prefill_trace_counts),
+        "single_chip": {"params_bytes": acct_1["params_bytes"],
+                        "kv_bytes_resident": acct_1["kv_bytes_resident"],
+                        "params_kv_bytes": single_pk,
+                        "wall_s": wall_1},
+        "per_chip": {str(k): v for k, v in acct_n["per_chip"].items()},
+        "params_kv_bytes_per_chip_max": per_chip_pk,
+        "per_chip_over_single_ratio": ratio,
+        "byte_gate": f"per-chip params+KV <= single-chip / 1.8 "
+                     f"(measured ratio {ratio:.4f})",
+        "sharded_wall_s": wall_n,
+    }
+    print(f"   token identity {matched}/{len(want)}, per-chip params+KV "
+          f"ratio {ratio:.4f} (gate <= {1 / 1.8:.4f}), trace pins "
+          f"{'OK' if pins_ok else 'BROKEN'}")
+    if identical != 1.0:
+        raise SystemExit("sharded tokens diverged from the single-chip oracle")
+    if ratio > 1 / 1.8:
+        raise SystemExit(f"per-chip byte ratio {ratio:.4f} > 1/1.8 — "
+                         "sharding did not reduce residency")
+    if not pins_ok:
+        raise SystemExit("trace-count pins broke under the mesh")
+    return doc
+
+
+def run_tier_leg(args, loadgen, ckpt, *, name, telemetry, kill=False) -> dict:
+    """One tiered-fleet run through serve_loadgen; returns its summary doc."""
+    out = os.path.join(args.work_dir, f"{name}_summary.json")
+    trace_dir = os.path.join(args.work_dir, f"{name}_trace")
+    argv = ["--replicas", "2", "--tiers", "prefill:1,decode:1",
+            "--checkpoint", ckpt, "--seed", str(args.seed),
+            "--num-slots", str(args.num_slots),
+            "--requests", str(args.requests),
+            "--max-new-tokens", str(args.max_new_tokens),
+            "--prompt-lens", "8,32,64", "--mode", "closed",
+            "--concurrency", "4", "--max-restarts", "3",
+            "--heartbeat-timeout-s", "60",
+            "--telemetry", telemetry, "--trace-dir", trace_dir,
+            "--summary-json", out]
+    label = "kill prefill replica mid-run" if kill else "clean"
+    print(f"== tiered fleet ({label}): prefill:1,decode:1, "
+          f"{args.requests} requests")
+    old = os.environ.pop("RESILIENCE_FAULTS", None)
+    try:
+        if kill:
+            os.environ["RESILIENCE_FAULTS"] = f"kill:proc=0,step={args.kill_step}"
+        rc = loadgen.main(argv)
+    finally:
+        os.environ.pop("RESILIENCE_FAULTS", None)
+        if old is not None:
+            os.environ["RESILIENCE_FAULTS"] = old
+    if rc != 0:
+        raise SystemExit(f"tiered fleet leg ({name}) failed with rc {rc}")
+    with open(out) as f:
+        summ = json.load(f)
+    if summ["ok"] != args.requests or summ.get("failed"):
+        raise SystemExit(f"tiered leg ({name}): "
+                         f"{summ['ok']}/{args.requests} ok — requests lost")
+    if not kill and (summ.get("handoff_failures") or 0):
+        raise SystemExit(f"clean tiered leg had "
+                         f"{summ['handoff_failures']} handoff failures")
+    summ["_trace_dir"] = trace_dir
+    return summ
+
+
+def trace_segment_table(trace_dir) -> dict:
+    """Reduce a tiered run's spans to the per-segment wall table — the gate
+    is that prefill_tier / handoff / decode are separated, exclusively."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.trace import (
+        read_spans,
+        summarize_traces,
+    )
+
+    spans, _ = read_spans([trace_dir])
+    summary = summarize_traces(spans)
+    seg = summary["segments"]
+    table = {name: {"p50_s": row.get("p50"), "total_s": row.get("total")}
+             for name, row in seg.items()
+             if (row.get("total") or 0) > 0}
+    print("   trace segments (p50):")
+    for name in ("prefill_tier", "handoff", "decode_first", "decode_tail"):
+        row = seg.get(name) or {}
+        print(f"     {name:>14}  {((row.get('p50') or 0)) * 1e3:8.2f} ms")
+    return {"traces": summary["traces"], "segments": table}
+
+
+def run_plan_serve(model, args) -> dict:
+    """Part (c): the serving scenario planner with REAL measurement — the
+    committed gate is pick == measured-best."""
+    import jax
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.plan import (
+        Topology,
+        search_serve,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.plan.scenarios import (
+        for_serve,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        ContinuousBatchingEngine,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        shard as shard_mod,
+    )
+
+    params = model.init({"params": jax.random.PRNGKey(0)},
+                        np.zeros((1, model.seq_len), np.int32))["params"]
+    n_meas = args.measure_requests
+
+    def measure(tp, dp):
+        sm = shard_mod.build_serve_mesh(tp=tp, dp=dp)
+        engine = ContinuousBatchingEngine(model, params,
+                                          num_slots=args.num_slots,
+                                          mesh=(None if tp == dp == 1 else sm))
+        reqs = _workload(model, n_meas, args.max_new_tokens, args.seed + 31)
+        engine.run(reqs[:1])        # compile outside the measured window
+        toks, wall = _run(engine, reqs)
+        new = sum(len(t) for t in toks.values())
+        print(f"   measured tp={tp},dp={dp}: {new / wall:.1f} tokens/s")
+        return new / wall
+
+    topo = Topology(num_devices=4, device_kind="cpu", hbm_bytes=16 << 30)
+    sc = for_serve(model, num_slots=args.num_slots, prompt_len=64, topo=topo,
+                   measure=measure)
+    print(f"== plan serve: {topo.num_devices} devices, "
+          f"{args.num_slots} slots, measure top {args.measure_top}")
+    rows = search_serve(sc, measure_top=args.measure_top)
+    measured = [r for r in rows if r.measured_tokens_per_s is not None]
+    best = max(measured, key=lambda r: r.measured_tokens_per_s)
+    pick_is_best = rows[0] is best
+    doc = {
+        "metric": "serving scenario planner (predict -> prune -> measure)",
+        "topology": {"num_devices": topo.num_devices, "device_kind": "cpu",
+                     "hbm_bytes": topo.hbm_bytes},
+        "num_slots": args.num_slots,
+        "prompt_len": 64,
+        "candidates": [
+            {"shard": r.shard_spec(), "tp": r.tp, "dp": r.dp,
+             "predicted_tokens_per_s": r.costs.tokens_per_s,
+             "params_bytes_per_chip": r.costs.params_bytes_per_chip,
+             "kv_bytes_per_chip": r.costs.kv_bytes_per_chip,
+             "slots_at_budget": r.costs.slots_at_budget,
+             "fits": r.costs.fits,
+             "measured_tokens_per_s": r.measured_tokens_per_s}
+            for r in rows],
+        "picked": rows[0].shard_spec(),
+        "measured_best": best.shard_spec(),
+        "pick_is_measured_best": pick_is_best,
+    }
+    print(f"   picked {doc['picked']} "
+          f"({rows[0].measured_tokens_per_s:.1f} tokens/s measured); "
+          f"measured-best {doc['measured_best']}")
+    if not pick_is_best:
+        raise SystemExit("planner pick is not the measured-best candidate")
+    return doc
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--checkpoint", default="",
+                   help="trained train.lm TrainState/params (default: train "
+                        "one on the committed MNIST fixture first)")
+    p.add_argument("--train-epochs", type=int, default=12)
+    p.add_argument("--data-dir", default=_FIXTURE)
+    p.add_argument("--work-dir", default="/tmp/shard_serve_work",
+                   help="scratch dir for the checkpoint, traces + summaries")
+    p.add_argument("--out-dir", default="bench_results/shard_serve_cpu")
+    p.add_argument("--shard", default="tp=2",
+                   help="the sharded replica's mesh for the identity leg")
+    p.add_argument("--num-slots", type=int, default=8)
+    p.add_argument("--requests", type=int, default=16)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--measure-top", type=int, default=3)
+    p.add_argument("--measure-requests", type=int, default=6)
+    p.add_argument("--kill-step", type=int, default=3,
+                   help="RESILIENCE_FAULTS step for the prefill-kill leg")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--quick", action="store_true",
+                   help="CI smoke sizing: tiny training + load")
+    args = p.parse_args(argv)
+    if args.quick:
+        args.train_epochs = min(args.train_epochs, 2)
+        args.requests = min(args.requests, 8)
+        args.max_new_tokens = min(args.max_new_tokens, 12)
+        args.measure_top = min(args.measure_top, 2)
+        args.measure_requests = min(args.measure_requests, 3)
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    os.makedirs(args.work_dir, exist_ok=True)
+
+    spec_mod = importlib.util.spec_from_file_location(
+        "serve_loadgen", os.path.join(_REPO, "tools", "serve_loadgen.py"))
+    loadgen = importlib.util.module_from_spec(spec_mod)
+    spec_mod.loader.exec_module(loadgen)
+
+    import jax
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.models import (
+        lm,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils import (
+        checkpoint,
+    )
+
+    ckpt = ensure_checkpoint(args)
+    model = lm.TransformerLM()          # the train.lm default pixel LM
+    import jax.numpy as jnp
+
+    init = model.init({"params": jax.random.PRNGKey(0)},
+                      jnp.zeros((1, model.seq_len), jnp.int32))["params"]
+    params = checkpoint.load_params_or_state(ckpt, init)
+
+    shard_doc = run_shard_identity(model, params, args)
+
+    telemetry = os.path.join(args.out_dir, "tiered.jsonl")
+    clean = run_tier_leg(args, loadgen, ckpt, name="tiered",
+                         telemetry=telemetry)
+    segments = trace_segment_table(clean.pop("_trace_dir"))
+    kill = run_tier_leg(args, loadgen, ckpt, name="tiered_kill",
+                        telemetry=os.path.join(args.work_dir,
+                                               "tiered_kill.jsonl"),
+                        kill=True)
+    kill.pop("_trace_dir", None)
+    print(f"   clean: {clean['handoffs']} handoffs "
+          f"({clean['handoff_bytes']} B, {clean['handoff_failures']} failed); "
+          f"kill: {kill['ok']}/{args.requests} ok after "
+          f"{sum(r.get('restarts', 0) for r in kill.get('per_replica', []))} "
+          f"restart(s)")
+
+    plan_doc = run_plan_serve(model, args)
+    with open(os.path.join(args.out_dir, "plan_serve.json"), "w") as f:
+        json.dump(plan_doc, f, indent=1)
+
+    doc = {
+        "metric": "sharded + disaggregated serving (DESIGN.md §25)",
+        "checkpoint": ckpt,
+        "trained_epochs": None if args.checkpoint else args.train_epochs,
+        "quick": args.quick,
+        "shard_identity": shard_doc,
+        "tiered_fleet": {
+            "clean": clean,
+            "prefill_kill": kill,
+            "zero_lost_under_kill": kill["ok"] == args.requests,
+            "trace": segments,
+        },
+        "plan_serve": {"picked": plan_doc["picked"],
+                       "pick_is_measured_best":
+                           plan_doc["pick_is_measured_best"],
+                       "file": "plan_serve.json"},
+        "gates": {
+            "token_identical": shard_doc["token_identical"] == 1.0,
+            "per_chip_bytes_le_single_over_1p8":
+                shard_doc["per_chip_over_single_ratio"] <= 1 / 1.8,
+            "trace_pins_ok": shard_doc["trace_pins_ok"],
+            "handoffs_crc_verified_zero_failures":
+                (clean.get("handoff_failures") or 0) == 0,
+            "zero_requests_lost_under_prefill_kill":
+                kill["ok"] == args.requests,
+            "plan_pick_is_measured_best": plan_doc["pick_is_measured_best"],
+        },
+    }
+    out = os.path.join(args.out_dir, "shard_serve.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1)
+    ok = all(doc["gates"].values())
+    print(f"gates: {doc['gates']}")
+    print(f"wrote {out}, {telemetry}, "
+          f"{os.path.join(args.out_dir, 'plan_serve.json')}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -103,7 +103,7 @@ class FleetState:
             elif kind == "anomaly":
                 self.anomaly = r
             elif kind in ("scale", "replica", "eject", "hedge", "chaos",
-                          "restart"):
+                          "restart", "tier", "kv_handoff"):
                 t = r.get("t_s")
                 stamp = "-" if t is None else f"+{t:.1f}s"
                 if kind == "scale":
@@ -120,6 +120,14 @@ class FleetState:
                 elif kind == "chaos":
                     what = (f"chaos {r.get('kind')} on replica "
                             f"{r.get('replica')} ({r.get('dir')})")
+                elif kind == "tier":
+                    what = (f"replica {r.get('replica')} joined tier "
+                            f"{r.get('tier')}")
+                elif kind == "kv_handoff":
+                    what = (f"kv handoff {r.get('from_replica')} -> "
+                            f"{r.get('to_replica')}: "
+                            + (f"{r.get('bytes')} bytes" if r.get("ok")
+                               else f"FAILED ({r.get('reason')})"))
                 elif kind == "restart":
                     if r.get("reason") in ("poisoned", "desync"):
                         self.rollbacks += 1
@@ -182,6 +190,14 @@ def render(state: FleetState, path: str) -> str:
             f"  hedges {_fmt(snap.get('hedges'))}"
             f" (wins {_fmt(snap.get('hedge_wins'))})"
             f"  wire corrupt {_fmt(snap.get('wire_corrupt'))}")
+    if snap.get("handoffs") or snap.get("handoff_failures"):
+        # The disaggregation row (DESIGN.md §25): how much prefill→decode KV
+        # traffic the tiers are moving, and whether any handoffs bounced back
+        # to a classic local prefill.
+        lines.append(
+            f"  handoffs {_fmt(snap.get('handoffs'))}"
+            f"  bytes {_fmt(snap.get('handoff_bytes'))}"
+            f"  failed {_fmt(snap.get('handoff_failures'))}")
     if state.anomaly or state.rollbacks:
         # The training-integrity row (--guard runs): detected anomalies, the
         # identity-skipped steps, and how many supervised rollbacks the run
@@ -237,6 +253,11 @@ def render(state: FleetState, path: str) -> str:
         has_slo = any(r.get("slo") for r in per)
         if has_slo:
             head += f" {'slo-att':>8} {'slo-n':>5}"
+        # The tier columns appear once any replica declares a non-unified
+        # role — which tier it serves and how many handoffs it took part in.
+        has_tier = any(r.get("tier") for r in per)
+        if has_tier:
+            head += f" {'tier':>8} {'hand':>5}"
         lines.append(head)
         for r in per:
             row = (f"  {r.get('replica'):>3} {str(r.get('state')):<9} "
@@ -251,6 +272,9 @@ def render(state: FleetState, path: str) -> str:
                 rs = r.get("slo") or {}
                 row += (f" {_fmt(rs.get('attainment')):>8} "
                         f"{_fmt(rs.get('requests')):>5}")
+            if has_tier:
+                row += (f" {str(r.get('tier') or '-'):>8} "
+                        f"{_fmt(r.get('handoffs')):>5}")
             lines.append(row)
     if state.recent:
         lines.append("")

@@ -21,6 +21,7 @@ BACKEND_FREE = (
     "serving/autoscaler.py",
     "serving/scheduler.py",
     "serving/prefix_cache.py",
+    "serving/tiers.py",
     "serving/wire.py",
     "resilience/supervisor.py",
     "resilience/heartbeat.py",

@@ -434,6 +434,8 @@ def build_replica_command(args) -> list[str]:
         cmd.append("--rope")
     if args.checkpoint:
         cmd += ["--checkpoint", args.checkpoint]
+    if getattr(args, "shard", ""):
+        cmd += ["--shard", args.shard]
     return cmd
 
 
@@ -514,7 +516,19 @@ def main(argv: list[str] | None = None) -> int:
                         "every prefill chunk size, and the prefix-cache install "
                         "path, then reset the engine's counters — so latency "
                         "percentiles measure the schedule, not XLA (0 = off)")
+    e.add_argument("--shard", default="",
+                   help="replica-internal serve mesh, e.g. 'tp=2,dp=2' "
+                        "(serving/shard.py): every replica shards its params "
+                        "over tp chips and its slots over dp groups; on CPU "
+                        "the loadgen grows the replicas' host-device count "
+                        "via XLA_FLAGS to fit tp*dp virtual chips")
     f = p.add_argument_group("fleet (0 replicas = the in-process server)")
+    f.add_argument("--tiers", default="",
+                   help="disaggregated prefill/decode tiers, e.g. "
+                        "'prefill:1,decode:2' (roles assigned to replicas by "
+                        "position, DESIGN.md §25): prefill-tier replicas "
+                        "prefill and ship KV planes to decode-tier replicas "
+                        "over the framed wire; empty = a unified fleet")
     f.add_argument("--replicas", type=int, default=0,
                    help="run a serving.Router fleet of N replica PROCESSES "
                         "(serving/replica.py) instead of the in-process server")
@@ -694,6 +708,37 @@ def main(argv: list[str] | None = None) -> int:
     if args.echo and args.replicas < 1:
         raise SystemExit("--echo needs --replicas N (echo replicas are a "
                          "fleet-mode workload)")
+    tier_roles: list[str] = []
+    if args.tiers:
+        from csed_514_project_distributed_training_using_pytorch_tpu.serving.tiers import (
+            parse_tier_spec,
+        )
+
+        if args.replicas < 1:
+            raise SystemExit("--tiers needs --replicas N (tiered serving is "
+                             "a fleet-mode workload)")
+        try:
+            tier_roles = parse_tier_spec(args.tiers)
+        except ValueError as exc:
+            raise SystemExit(str(exc))
+        if len(tier_roles) != args.replicas:
+            raise SystemExit(
+                f"--tiers names {len(tier_roles)} replica role(s) but "
+                f"--replicas is {args.replicas} — the spec assigns roles by "
+                f"position and must cover the whole fleet")
+    shard_tp = shard_dp = 1
+    if args.shard:
+        from csed_514_project_distributed_training_using_pytorch_tpu.serving.tiers import (
+            parse_shard_spec,
+        )
+
+        if args.echo:
+            raise SystemExit("--shard needs a real engine (echo replicas "
+                             "build no mesh)")
+        try:
+            shard_tp, shard_dp = parse_shard_spec(args.shard)
+        except ValueError as exc:
+            raise SystemExit(str(exc))
     if args.burst_tenant:
         known = set(tenant_shares(args.tenants)) if args.tenants else set()
         if args.burst_tenant not in known:
@@ -738,6 +783,14 @@ def main(argv: list[str] | None = None) -> int:
         env = dict(os.environ)
         env["PYTHONPATH"] = (f"{repo_root}:{env['PYTHONPATH']}"
                              if env.get("PYTHONPATH") else repo_root)
+        if shard_tp * shard_dp > 1 and (args.replica_platform or "cpu") == "cpu":
+            # A CPU replica has one host device by default; grow it so the
+            # tp*dp serve mesh has chips to land on (the same trick the test
+            # suite uses — a multi-process CPU "mesh" of virtual devices).
+            flag = (f"--xla_force_host_platform_device_count="
+                    f"{shard_tp * shard_dp}")
+            env["XLA_FLAGS"] = (f"{env['XLA_FLAGS']} {flag}"
+                                if env.get("XLA_FLAGS") else flag)
         autoscale = None
         if args.autoscale == "on":
             from csed_514_project_distributed_training_using_pytorch_tpu.serving.autoscaler import (
@@ -787,7 +840,9 @@ def main(argv: list[str] | None = None) -> int:
             # replica argv deliberately omits --tenants (per-request tenancy
             # fields ride the wire instead) so admission is never charged
             # twice.
-            tenants=parse_tenants(args.tenants), env=env)
+            tenants=parse_tenants(args.tenants), env=env,
+            replica_extra_args=([["--tier", role] for role in tier_roles]
+                                if tier_roles else None))
         front = router.start()
         if not router.wait_ready(timeout=600):
             router.stop(drain=False)
@@ -799,6 +854,17 @@ def main(argv: list[str] | None = None) -> int:
         # replica (model construction, checkpoint-format fallback, warmup
         # recipe) — one owner, so the single-engine and fleet sides of an A/B
         # can never drift apart.
+        if (shard_tp * shard_dp > 1
+                and os.environ.get("JAX_PLATFORMS", "cpu") == "cpu"):
+            # Same trick as the fleet path, applied to OUR process: grow the
+            # single host CPU device into tp*dp virtual chips. XLA reads the
+            # flag at backend INITIALIZATION (first devices() call, inside
+            # the engine build below), so setting it here is early enough
+            # even though the package import already loaded the jax module.
+            flag = (f"--xla_force_host_platform_device_count="
+                    f"{shard_tp * shard_dp}")
+            os.environ["XLA_FLAGS"] = \
+                (os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
         from csed_514_project_distributed_training_using_pytorch_tpu.serving.replica import (
             build_engine_server,
         )
@@ -916,6 +982,12 @@ def main(argv: list[str] | None = None) -> int:
                   f"{rs.get('hedges', 0)} hedge(s) "
                   f"(win rate {'-' if win is None else f'{win:.2f}'}), "
                   f"{rs.get('wire_corrupt', 0)} typed wire fault(s)")
+        if rs.get("handoffs") or rs.get("handoff_failures"):
+            disagg = sum(getattr(c, "disagg", False) for c in comps)
+            print(f"tiers ({args.tiers or '?'}): {rs.get('handoffs', 0)} "
+                  f"kv handoff(s), {rs.get('handoff_bytes', 0)} bytes shipped, "
+                  f"{rs.get('handoff_failures', 0)} bounced to local prefill, "
+                  f"{disagg} request(s) served disaggregated")
         sp = rs.get("spec") or {}
         if sp:
             rate = sp.get("acceptance_rate")
@@ -1082,8 +1154,17 @@ def main(argv: list[str] | None = None) -> int:
                 prefix_hit_rate=(pc["hits"] / pc["queries"]
                                  if pc.get("queries") else None),
                 spec_stats=rs.get("spec"),
+                tiers=args.tiers or None,
+                shard=args.shard or None,
+                handoffs=rs.get("handoffs"),
+                handoff_bytes=rs.get("handoff_bytes"),
+                handoff_failures=rs.get("handoff_failures"),
+                disagg_requests=sum(getattr(c, "disagg", False)
+                                    for c in comps),
                 per_replica=[{k: r[k] for k in ("replica", "state", "restarts",
-                                                "dispatched", "completed")}
+                                                "dispatched", "completed",
+                                                "tier", "handoffs")
+                              if k in r}
                              for r in rs["per_replica"]],
                 slo_attainment=rs.get("slo"),
                 replica_latency=rs.get("replica_latency"),
@@ -1093,6 +1174,7 @@ def main(argv: list[str] | None = None) -> int:
                 router_queue=rs.get("queue"))
         else:
             doc.update(
+                shard=args.shard or None,
                 bytes=engine.byte_accounting(),
                 prefill_chunk_sizes=list(engine.prefill_chunk_sizes),
                 prefill_tokens=engine.prefill_tokens,

@@ -278,7 +278,8 @@ def summarize(path: str) -> dict:
         s["replica_table"] = [
             {"replica": r.get("replica"), "state": r.get("state"),
              "restarts": r.get("restarts"), "dispatched": r.get("dispatched"),
-             "completed": r.get("completed")}
+             "completed": r.get("completed"), "tier": r.get("tier"),
+             "handoffs": r.get("handoffs")}
             for r in rsum.get("per_replica") or []]
         pc = rsum.get("prefix_cache") or {}
         if pc.get("queries"):
@@ -374,6 +375,30 @@ def summarize(path: str) -> dict:
         for ev in chaos_evs:
             by_kind[ev.get("kind")] = by_kind.get(ev.get("kind"), 0) + 1
         s["chaos_by_kind"] = by_kind
+
+    # Disaggregated serving (DESIGN.md §25): tier membership + the prefill→
+    # decode KV handoff ledger. Per-event "kv_handoff" lines give the wall/TTFT
+    # medians (the summary only carries counts); router_summary counters win
+    # for the totals so both sides of an A-vs-B row use the router's ledger.
+    tier_evs = by_event.get("tier", [])
+    if tier_evs:
+        tiers: dict = {}
+        for ev in tier_evs:
+            if ev.get("tier"):
+                tiers[ev["tier"]] = tiers.get(ev["tier"], 0) + 1
+        s["tier_replicas"] = tiers
+    handoff_evs = by_event.get("kv_handoff", [])
+    if handoff_evs:
+        oks = [e for e in handoff_evs if e.get("ok")]
+        s["handoffs"] = len(oks)
+        s["handoff_failures"] = len(handoff_evs) - len(oks)
+        s["handoff_bytes"] = sum(e.get("bytes") or 0 for e in oks)
+        s["handoff_wall_s"] = _median([e.get("wall_s") for e in oks])
+        s["tier_ttft_s"] = _median([e.get("prefill_ttft_s") for e in oks])
+    if rsum:
+        for key in ("handoffs", "handoff_bytes", "handoff_failures"):
+            if rsum.get(key) is not None:
+                s[key] = rsum[key]
 
     # Checkpoint traffic (utils/checkpoint.py savers + restores): how much resume
     # insurance the run paid for, and what it cost in wall time.
@@ -555,11 +580,13 @@ def print_summary(s: dict) -> None:
                   f"restarts {_fmt(s.get('replica_restarts'))}"
                   + (f" ({', '.join(reasons)})" if reasons else ""))
             for r in s.get("replica_table") or []:
+                tier = (f" [{r['tier']}, {_fmt(r.get('handoffs'))} handoffs]"
+                        if r.get("tier") else "")
                 print(f"     replica {r['replica']}: "
                       f"{_fmt(r.get('dispatched'))} dispatched, "
                       f"{_fmt(r.get('completed'))} completed, "
                       f"{_fmt(r.get('restarts'))} restart(s), "
-                      f"{r.get('state')}")
+                      f"{r.get('state')}{tier}")
             if (s.get("ejections") or s.get("hedges")
                     or s.get("wire_corrupt") or s.get("chaos_faults")):
                 kinds = ", ".join(f"{k}: {v}" for k, v in
@@ -573,6 +600,15 @@ def print_summary(s: dict) -> None:
                       f"wire corrupt {_fmt(s.get('wire_corrupt') or 0)}"
                       + (f"  chaos {s['chaos_faults']} ({kinds})"
                          if s.get("chaos_faults") else ""))
+        if s.get("handoffs") is not None or s.get("handoff_failures"):
+            tiers = ", ".join(f"{k}: {v}" for k, v in
+                              sorted((s.get("tier_replicas") or {}).items()))
+            print(f"   tiers: {_fmt(s.get('handoffs') or 0)} handoff(s) "
+                  f"({_fmt(s.get('handoff_bytes') or 0)} bytes, "
+                  f"{_fmt(s.get('handoff_failures') or 0)} failed)  "
+                  f"handoff wall p50 {_fmt(s.get('handoff_wall_s'))}s  "
+                  f"tier ttft p50 {_fmt(s.get('tier_ttft_s'))}s"
+                  + (f"  [{tiers}]" if tiers else ""))
         if s.get("prefill_tokens") is not None:
             hit = ""
             if s.get("prefix_hit_rate") is not None:
@@ -709,6 +745,10 @@ COMPARE_ROWS = [
     ("prefix hit rate", "prefix_hit_rate"),
     ("affinity hit rate", "affinity_rate"),
     ("redispatches", "redispatches"),
+    ("handoffs", "handoffs"),
+    ("handoff bytes", "handoff_bytes"),
+    ("handoff wall", "handoff_wall_s"),
+    ("tier TTFT", "tier_ttft_s"),
     ("ejections", "ejections"),
     ("hedges", "hedges"),
     ("hedge win rate", "hedge_win_rate"),
