@@ -21,6 +21,10 @@ from csed_514_project_distributed_training_using_pytorch_tpu.data.loader import 
 from csed_514_project_distributed_training_using_pytorch_tpu.data.download import (
     download_mnist,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.data.stream import (
+    StreamLoader,
+    eval_tokens,
+)
 
 __all__ = ["MNIST_MEAN", "MNIST_STD", "Dataset", "load_mnist", "BatchLoader",
-           "download_mnist", "iter_plan_batches"]
+           "download_mnist", "iter_plan_batches", "StreamLoader", "eval_tokens"]

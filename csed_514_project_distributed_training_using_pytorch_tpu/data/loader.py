@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import queue as queue_mod
 import threading
+import time
 from typing import Iterator
 
 import numpy as np
@@ -107,6 +108,13 @@ class BatchLoader:
     pipeline: batches arrive as device-resident ``jax.Array``s, gathered and
     ``device_put`` N deep on a background thread while the consumer's batch is in
     flight. Batch order and values are unchanged — only residency and overlap.
+
+    Stall accounting: every second the CONSUMER spends blocked pulling the next
+    batch — the prefetch queue empty, the native prefetcher behind, or the plain
+    gather itself — accumulates in ``wait_s`` (read the per-window delta with
+    ``pop_wait_s()``). Before this the loader's stalls were invisible: a
+    data-starved run reported ``data_s ~ 0`` and the goodput ``data_wait``
+    segment read zero while the stall hid inside execute/idle (DESIGN.md §26).
     """
 
     def __init__(self, dataset: Dataset, batch_size: int, *,
@@ -124,9 +132,33 @@ class BatchLoader:
         self.sampler = sampler or ShardedSampler(
             len(dataset), num_replicas=1, rank=0, shuffle=shuffle, seed=seed)
         self._epoch = 0
+        #: Consumer-blocked seconds (queue waits + gathers); see class docstring.
+        self.wait_s = 0.0
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
+
+    def pop_wait_s(self) -> float:
+        """Return and reset the accumulated consumer-blocked seconds — the
+        per-epoch ``data_s`` charge the trainers emit (goodput's ``data_wait``
+        input, obs/goodput.py)."""
+        w, self.wait_s = self.wait_s, 0.0
+        return w
+
+    def _timed(self, base: Iterator) -> Iterator:
+        """Wrap an iterator so time the consumer spends blocked in ``next()``
+        accumulates in ``wait_s``. Pull-side by construction: overlapped
+        producer work (prefetch threads ahead of the consumer) charges
+        nothing — only actual stalls count."""
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(base)
+            except StopIteration:
+                self.wait_s += time.perf_counter() - t0
+                return
+            self.wait_s += time.perf_counter() - t0
+            yield item
 
     def __len__(self) -> int:
         n = self.sampler.num_samples
@@ -134,8 +166,9 @@ class BatchLoader:
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         if self.prefetch:
-            return _device_prefetch_iter(self._host_iter(), self.prefetch)
-        return self._host_iter()
+            return self._timed(
+                _device_prefetch_iter(self._host_iter(), self.prefetch))
+        return self._timed(self._host_iter())
 
     def _host_iter(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         from csed_514_project_distributed_training_using_pytorch_tpu.data import native
@@ -166,7 +199,8 @@ class BatchLoader:
         # (advisor finding r1: the old allow_empty=False raised where the scan path
         # trained fine).
         plan = self.epoch_index_matrix(epoch, allow_empty=True)
-        yield from iter_plan_batches(self.dataset, plan, num_workers=num_workers)
+        yield from self._timed(
+            iter_plan_batches(self.dataset, plan, num_workers=num_workers))
 
     def epoch_index_matrix(self, epoch: int | None = None, steps_multiple: int = 1,
                            allow_empty: bool = False) -> np.ndarray:

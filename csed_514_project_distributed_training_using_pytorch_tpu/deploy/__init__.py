@@ -1,0 +1,24 @@
+"""Continuous deployment: checkpoint promotion with canary rollout.
+
+``deploy/promoter.py`` closes the train→serve loop: it watches a versioned
+checkpoint store (``utils/checkpoint.py``), qualifies each new candidate at a
+gate (health stamp → accuracy budget → perf tolerance), canaries survivors on
+ONE fleet replica via the router's rolling-reload path, and promotes
+fleet-wide or auto-rolls-back on regression (DESIGN.md §26).
+"""
+
+from csed_514_project_distributed_training_using_pytorch_tpu.deploy.promoter import (
+    CanaryConfig,
+    GateConfig,
+    Promoter,
+    PromotionLedger,
+    read_ledger,
+)
+
+__all__ = [
+    "CanaryConfig",
+    "GateConfig",
+    "Promoter",
+    "PromotionLedger",
+    "read_ledger",
+]

@@ -144,6 +144,22 @@ def _newest_healthy(checkpoint_dir: str,
     return newest_healthy_checkpoint(checkpoint_dir, before_step=before_step)
 
 
+def _cursor_for(path: str | None) -> dict | None:
+    """The input-stream resume cursor the chosen checkpoint's manifest entry
+    carries (``data/stream.py``-fed trainers key it in at save time): put it
+    on the restart event so the stream alone answers WHERE the next attempt
+    resumes in the data order, not just which file it restores."""
+    if not path:
+        return None
+    from csed_514_project_distributed_training_using_pytorch_tpu.utils.checkpoint import (
+        cursor_for,
+    )
+    try:
+        return cursor_for(path)
+    except Exception:
+        return None
+
+
 def _sleep_interruptible(seconds: float, handler: PreemptionHandler) -> None:
     deadline = time.monotonic() + seconds
     while time.monotonic() < deadline and not handler.requested:
@@ -335,6 +351,7 @@ def supervise(command: list[str], cfg: SupervisorConfig = SupervisorConfig(), *,
                 tele.emit({"event": "restart", "attempt": attempts,
                            "restart": restarts, "reason": reason, "exit_code": rc,
                            "resume_from": next_resume or "",
+                           "cursor": _cursor_for(next_resume),
                            "skip":
                            poison_mod.format_skip_steps(skip_windows),
                            "rollback": reason in ("poisoned", "desync"),

@@ -379,6 +379,12 @@ class _Replica:
         # fleet-wide blip from producing a synchronized restart storm.
         self.restart_backoff: JitterBackoff | None = None
         self.connect_backoff: JitterBackoff | None = None
+        # Canary rollout (deploy/promoter.py): a per-replica checkpoint that
+        # OVERRIDES the fleet command's --checkpoint for every spawn of this
+        # replica — including monitor respawns after a crash, so a canary
+        # that dies mid-window comes back on the candidate params, not on a
+        # silent rollback. None = spawn on the shared fleet command.
+        self.checkpoint_override: str | None = None
 
     def room(self) -> bool:
         # wfile gates dispatchability too: between a connection dying and the
@@ -445,7 +451,8 @@ class Router:
                  backoff_jitter: bool = True, jitter_seed: int = 0,
                  env: dict | None = None,
                  replica_extra_args: list[list[str]] | None = None,
-                 disagg_min_prompt: int = 1):
+                 disagg_min_prompt: int = 1,
+                 sample_completions: int = 0):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
         self._autoscaler = FleetAutoscaler(autoscale) if autoscale else None
@@ -600,6 +607,16 @@ class Router:
         self._slo_fleet = (AttainmentTracker(slo) if slo is not None
                            else None)
         self._slo_by_replica: dict[int, AttainmentTracker] = {}
+        # Canary rollout state + sampled-completion evidence
+        # (deploy/promoter.py): at most ONE replica canaries a candidate
+        # checkpoint at a time; while sampling is on (sample_completions > 0)
+        # every replica keeps a bounded ring of its recent ok completions
+        # (prompt + generated tokens) so the promoter can score canary-served
+        # vs fleet-served tokens under one fixed scorer.
+        self._canary: int | None = None
+        self._canary_checkpoint = ""
+        self._sample_keep = int(sample_completions)
+        self._samples_by_replica: dict[int, collections.deque] = {}
         self.last_summary: dict | None = None
 
     # ------------------------------------------------------------------ lifecycle
@@ -784,60 +801,237 @@ class Router:
         rolled: list[int] = []
         try:
             for rep in targets:
-                deadline = time.monotonic() + timeout_s
-                with self._cond:
-                    # A target caught mid-spawn must reach ready before it
-                    # can drain (drain rides the ready protocol).
-                    self._cond.wait_for(
-                        lambda: rep.state not in ("starting", "warming")
-                        or self._aborted or self._stopping,
-                        timeout=max(0.0, deadline - time.monotonic()))
-                    if rep.state in ("starting", "warming"):
-                        raise RuntimeError(
-                            f"reload: replica {rep.index} never became "
-                            f"ready to roll (state {rep.state})")
-                    if rep.state != "ready":
-                        continue      # crashed/retired since the roll began:
-                                      # any respawn uses the new command
-                    self._begin_drain(rep, "reload")
-                self._send_drain(rep)
-                self._writer.emit({"event": "scale", "action": "reload_drain",
-                                   "replica": rep.index,
-                                   "checkpoint": checkpoint})
-                with self._cond:
-                    # The monitor bounds this wait: drain deadline, process
-                    # death, and connect timeout all finalize the drain.
-                    self._cond.wait_for(
-                        lambda: rep.state in ("retired", "dead")
-                        or self._aborted or self._stopping,
-                        timeout=max(0.0, deadline - time.monotonic()))
-                    if rep.state != "retired":
-                        raise RuntimeError(
-                            f"reload: replica {rep.index} never drained "
-                            f"(state {rep.state})")
-                    self._spawn(rep)   # picks up the updated self._command
-                with self._cond:
-                    self._cond.wait_for(
-                        lambda: rep.state == "ready" or self._aborted
-                        or rep.state == "dead",
-                        timeout=max(0.0, deadline - time.monotonic()))
-                    if rep.state != "ready":
-                        raise RuntimeError(
-                            f"reload: replica {rep.index} did not come back "
-                            f"ready (state {rep.state})")
-                    self._scale_counts["reloads"] += 1
-                rolled.append(rep.index)
-                self._writer.emit({"event": "scale", "action": "reload",
-                                   "replica": rep.index,
-                                   "checkpoint": checkpoint,
-                                   "warmed": rep.warmed})
-                self.tracer.span("reload", self._fleet_trace,
-                                 deadline - timeout_s, time.monotonic(),
-                                 replica=rep.index, checkpoint=checkpoint)
+                if self._roll_one(rep, timeout_s, checkpoint):
+                    rolled.append(rep.index)
         finally:
             with self._lock:
                 self._reloading = False
         return {"reloaded": rolled, "checkpoint": checkpoint,
+                "wall_s": time.monotonic() - t_start}
+
+    def _roll_one(self, rep: _Replica, timeout_s: float, checkpoint: str,
+                  *, action: str = "reload") -> bool:
+        """Roll ONE replica through the drain→respawn→ready sequence (the
+        shared leg of ``reload``/``canary_reload``/``promote_canary``/
+        ``rollback_canary``; caller owns ``_reloading``). Returns False when
+        the replica crashed/retired before the roll could start (its respawn
+        picks up the current command anyway); raises ``RuntimeError`` when it
+        fails to drain or come back ready within ``timeout_s``. ``action``
+        labels the scale telemetry/trace lines."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            # A target caught mid-spawn must reach ready before it
+            # can drain (drain rides the ready protocol).
+            self._cond.wait_for(
+                lambda: rep.state not in ("starting", "warming")
+                or self._aborted or self._stopping,
+                timeout=max(0.0, deadline - time.monotonic()))
+            if rep.state in ("starting", "warming"):
+                raise RuntimeError(
+                    f"{action}: replica {rep.index} never became "
+                    f"ready to roll (state {rep.state})")
+            if rep.state != "ready":
+                return False      # crashed/retired since the roll began:
+                                  # any respawn uses the new command
+            self._begin_drain(rep, "reload")
+        self._send_drain(rep)
+        self._writer.emit({"event": "scale", "action": f"{action}_drain",
+                           "replica": rep.index,
+                           "checkpoint": checkpoint})
+        with self._cond:
+            # The monitor bounds this wait: drain deadline, process
+            # death, and connect timeout all finalize the drain.
+            self._cond.wait_for(
+                lambda: rep.state in ("retired", "dead")
+                or self._aborted or self._stopping,
+                timeout=max(0.0, deadline - time.monotonic()))
+            if rep.state != "retired":
+                raise RuntimeError(
+                    f"{action}: replica {rep.index} never drained "
+                    f"(state {rep.state})")
+            self._spawn(rep)   # picks up the updated self._command
+        with self._cond:
+            self._cond.wait_for(
+                lambda: rep.state == "ready" or self._aborted
+                or rep.state == "dead",
+                timeout=max(0.0, deadline - time.monotonic()))
+            if rep.state != "ready":
+                raise RuntimeError(
+                    f"{action}: replica {rep.index} did not come back "
+                    f"ready (state {rep.state})")
+            self._scale_counts["reloads"] += 1
+        self._writer.emit({"event": "scale", "action": action,
+                           "replica": rep.index,
+                           "checkpoint": checkpoint,
+                           "warmed": rep.warmed})
+        self.tracer.span(action, self._fleet_trace,
+                         deadline - timeout_s, time.monotonic(),
+                         replica=rep.index, checkpoint=checkpoint)
+        return True
+
+    # ------------------------------------------------------------------ canary
+
+    def canary_reload(self, checkpoint: str, *, replica: int | None = None,
+                      timeout_s: float = 600.0) -> dict:
+        """Roll a candidate checkpoint onto ONE replica (the canary) while the
+        rest of the fleet keeps serving the incumbent — the qualification
+        half of checkpoint promotion (deploy/promoter.py, DESIGN.md §26).
+        The canary's per-replica attainment window and completion samples are
+        reset at readiness so ``canary_report`` compares post-roll evidence
+        only. The override sticks across crash-respawns until
+        ``promote_canary``/``rollback_canary`` settles the verdict."""
+        t_start = time.monotonic()
+        with self._cond:
+            if self._reloading:
+                raise RuntimeError("reload already in progress")
+            if self._stopping or self._aborted or self._started_s is None:
+                raise RuntimeError("router is not serving")
+            if self._canary is not None:
+                raise RuntimeError(
+                    f"canary already active on replica {self._canary}")
+            ready = [r for r in self.replicas if r.state == "ready"]
+            if replica is not None:
+                picks = [r for r in ready if r.index == replica]
+                if not picks:
+                    raise RuntimeError(
+                        f"canary_reload: replica {replica} is not ready")
+                rep = picks[0]
+            else:
+                if len(ready) < 2:
+                    raise RuntimeError(
+                        "canary_reload needs >= 2 ready replicas (one canary "
+                        "plus a fleet to compare against)")
+                # Highest index: on tiered fleets the low indices hold the
+                # positional roles (prefill first), and the autoscaler also
+                # retires from the top — a canary there never collides with a
+                # role assignment.
+                rep = max(ready, key=lambda r: r.index)
+            rep.checkpoint_override = checkpoint
+            self._reloading = True
+        try:
+            self._roll_one(rep, timeout_s, checkpoint, action="canary")
+        except BaseException:
+            with self._cond:
+                rep.checkpoint_override = None
+            raise
+        finally:
+            with self._lock:
+                self._reloading = False
+        with self._lock:
+            self._canary = rep.index
+            self._canary_checkpoint = checkpoint
+            # Fresh evidence only: attainment observed before the roll (and
+            # samples generated by the incumbent) must not dilute the canary
+            # comparison window.
+            self._slo_by_replica.pop(rep.index, None)
+            self._samples_by_replica.pop(rep.index, None)
+        return {"replica": rep.index, "checkpoint": checkpoint,
+                "wall_s": time.monotonic() - t_start}
+
+    def canary_report(self) -> dict:
+        """The canary-vs-fleet evidence the promoter judges: the canary's
+        windowed SLO attainment against the aggregated window of every OTHER
+        serving replica (windows, not raw latencies — see DESIGN.md §26), plus
+        both sides' sampled completions (prompt + generated tokens) for the
+        fixed-scorer NLL comparison. Raises when no canary is active."""
+        now = time.monotonic()
+        with self._lock:
+            if self._canary is None:
+                raise RuntimeError("no canary is active")
+            idx = self._canary
+            tracker = self._slo_by_replica.get(idx)
+            canary_win = (tracker.window(now) if tracker is not None
+                          else {"attainment": None, "requests": 0})
+            met = n = 0
+            for other, tr in self._slo_by_replica.items():
+                if other == idx:
+                    continue
+                win = tr.window(now)
+                if win["attainment"] is not None:
+                    n += win["requests"]
+                    met += round(win["attainment"] * win["requests"])
+            fleet_win = {"attainment": met / n if n else None, "requests": n}
+            canary_samples = list(self._samples_by_replica.get(idx) or ())
+            fleet_samples = [s for other, ring in
+                             self._samples_by_replica.items()
+                             if other != idx for s in ring]
+        return {"replica": idx, "checkpoint": self._canary_checkpoint,
+                "canary": canary_win, "fleet": fleet_win,
+                "canary_samples": canary_samples,
+                "fleet_samples": fleet_samples}
+
+    def promote_canary(self, *, timeout_s: float = 600.0) -> dict:
+        """The canary passed: make its checkpoint THE fleet checkpoint and
+        roll every other replica onto it one at a time (same
+        never-below-N−1-ready drain machinery as ``reload``). The canary
+        itself is NOT restarted — its running process already serves the
+        candidate params, and with the fleet command rewritten its override
+        becomes redundant and is cleared."""
+        t_start = time.monotonic()
+        with self._cond:
+            if self._reloading:
+                raise RuntimeError("reload already in progress")
+            if self._stopping or self._aborted or self._started_s is None:
+                raise RuntimeError("router is not serving")
+            if self._canary is None:
+                raise RuntimeError("no canary is active")
+            canary_rep = self.replicas[self._canary]
+            checkpoint = self._canary_checkpoint
+            self._reloading = True
+            self._command = _with_checkpoint(self._command, checkpoint)
+            canary_rep.checkpoint_override = None
+            targets = [r for r in self.replicas
+                       if r is not canary_rep
+                       and r.state in ("starting", "warming", "ready")]
+        rolled: list[int] = []
+        try:
+            for rep in targets:
+                if self._roll_one(rep, timeout_s, checkpoint,
+                                  action="promote"):
+                    rolled.append(rep.index)
+        finally:
+            with self._lock:
+                self._reloading = False
+        with self._lock:
+            self._canary = None
+            self._canary_checkpoint = ""
+        self._writer.emit({"event": "scale", "action": "promoted",
+                           "replica": canary_rep.index,
+                           "checkpoint": checkpoint, "rolled": rolled})
+        return {"promoted": rolled, "canary": canary_rep.index,
+                "checkpoint": checkpoint,
+                "wall_s": time.monotonic() - t_start}
+
+    def rollback_canary(self, *, timeout_s: float = 600.0) -> dict:
+        """The canary failed: clear its override and roll it back onto the
+        fleet command (still the last-good checkpoint — ``promote_canary`` is
+        the only writer of ``self._command`` on this path). Its attainment
+        window and samples reset so the restored incumbent starts clean."""
+        t_start = time.monotonic()
+        with self._cond:
+            if self._reloading:
+                raise RuntimeError("reload already in progress")
+            if self._stopping or self._aborted or self._started_s is None:
+                raise RuntimeError("router is not serving")
+            if self._canary is None:
+                raise RuntimeError("no canary is active")
+            rep = self.replicas[self._canary]
+            checkpoint = self._canary_checkpoint
+            rep.checkpoint_override = None
+            self._reloading = True
+        try:
+            self._roll_one(rep, timeout_s, "", action="rollback")
+        finally:
+            with self._lock:
+                self._reloading = False
+        with self._lock:
+            self._canary = None
+            self._canary_checkpoint = ""
+            self._slo_by_replica.pop(rep.index, None)
+            self._samples_by_replica.pop(rep.index, None)
+        self._writer.emit({"event": "scale", "action": "rolled_back",
+                           "replica": rep.index, "checkpoint": checkpoint})
+        return {"replica": rep.index, "rolled_back": checkpoint,
                 "wall_s": time.monotonic() - t_start}
 
     def _begin_drain(self, rep: _Replica, mode: str) -> None:
@@ -1021,8 +1215,13 @@ class Router:
                 on_fault=lambda info: self._writer.emit(
                     {"event": "chaos", **info}))
             rep.proxy.start()
-        cmd = list(self._command) + ["--port", str(rep.port),
-                                     "--replica-id", str(rep.index)]
+        cmd = list(self._command)
+        if rep.checkpoint_override:
+            # The canary exception: this replica spawns on ITS checkpoint, not
+            # the fleet's — and keeps doing so across crash-respawns until
+            # promote_canary/rollback_canary clears the override.
+            cmd = _with_checkpoint(cmd, rep.checkpoint_override)
+        cmd += ["--port", str(rep.port), "--replica-id", str(rep.index)]
         if self._extra_args:
             # Role assignment is positional and survives restarts: the same
             # index always restarts into the same tier (cycled when the fleet
@@ -1406,6 +1605,25 @@ class Router:
                          finish=comp.finish, new_tokens=comp.new_tokens,
                          redispatches=req.redispatches)
         self._record(comp)
+        self._note_sample(rep.index, req, comp)
+
+    def _note_sample(self, replica: int, req: RouterRequest,
+                     comp: RouterCompletion) -> None:
+        """Keep this ok completion (prompt + generated tokens) in the
+        replica's bounded sample ring — the canary NLL evidence. Only the
+        resolved-ok path records (a shed/timeout has no tokens to score), and
+        ``sample_completions=0`` keeps the router byte-identical to the
+        pre-canary behavior."""
+        if self._sample_keep <= 0 or not comp.ok or comp.new_tokens <= 0:
+            return
+        sample = {"prompt": np.asarray(req.prompt, np.int32).tolist(),
+                  "tokens": np.asarray(comp.tokens, np.int32).tolist()}
+        with self._lock:
+            ring = self._samples_by_replica.get(replica)
+            if ring is None:
+                ring = self._samples_by_replica[replica] = \
+                    collections.deque(maxlen=self._sample_keep)
+            ring.append(sample)
 
     def _settle_peers(self, winner: _Replica, req: RouterRequest,
                       now: float) -> None:
@@ -2267,17 +2485,22 @@ class Router:
         err = ServerStopped("router aborted: every replica is dead")
         self.queue.close()
         now = time.monotonic()
-        leftovers, expired = self.queue.take(now, 1 << 30)
-        for req in expired:         # past-deadline: resolve as timeouts — NEVER
-            self._expire(req, now)        # drop them with their futures pending
         with self._cond:
             self._aborted = True
+            # Sweep the queue INSIDE the lock: the dispatch thread's
+            # failed-dispatch path requeues its in-transit request under this
+            # cond, so a sweep taken before acquiring it can race — the
+            # request hops from _in_transit back into an already-swept queue
+            # and its future hangs forever.
+            leftovers, expired = self.queue.take(now, 1 << 30)
             if self._in_transit is not None:
                 leftovers.append(self._in_transit)
             for rep in self.replicas:
                 leftovers.extend(rep.inflight.values())
                 rep.inflight.clear()
             self._cond.notify_all()
+        for req in expired:         # past-deadline: resolve as timeouts — NEVER
+            self._expire(req, now)        # drop them with their futures pending
         for req in leftovers:
             try:
                 if not req.future.done():
@@ -2394,6 +2617,9 @@ class Router:
             counts = dict(self._counts)
             target = self._target
             scale = dict(self._scale_counts)
+            canary = ({"replica": self._canary,
+                       "checkpoint": self._canary_checkpoint}
+                      if self._canary is not None else None)
             per_replica = []
             for r in self.replicas:
                 row = {"replica": r.index, "state": r.state,
@@ -2406,6 +2632,11 @@ class Router:
                     # pre-disaggregation row schema field-identical.
                     row["tier"] = r.tier
                     row["handoffs"] = r.handoffs
+                if self._canary == r.index:
+                    # Only while a canary is live: rows stay field-identical
+                    # to the pre-promotion schema otherwise.
+                    row["canary"] = True
+                    row["canary_checkpoint"] = self._canary_checkpoint
                 if self._slo_fleet is not None:
                     tracker = self._slo_by_replica.get(r.index)
                     row["slo"] = (tracker.window(now) if tracker is not None
@@ -2442,6 +2673,7 @@ class Router:
                        if r["state"] == "ready")
         routed = counts["requests"]
         queue_snap = self.queue.snapshot(now)
+        extra = {"canary": canary} if canary else {}
         with self._lock:
             # Per-tenant fleet state: in-flight dispatches (summed over the
             # ledgers), the queue's lane counters, and the tenant's windowed
@@ -2509,6 +2741,9 @@ class Router:
             "slo": (self._slo_fleet.window(now)
                     if self._slo_fleet is not None else None),
             "per_replica": per_replica,
+            # Only while a canary is live: the pre-promotion snapshot schema
+            # stays field-identical otherwise.
+            **extra,
         }
 
     def _snapshot_loop(self) -> None:

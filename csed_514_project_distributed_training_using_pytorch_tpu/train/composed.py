@@ -375,6 +375,14 @@ def main(config: ComposedConfig = ComposedConfig(), *,
             M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(base_state.step)} "
               f"(starting epoch {start_epoch})")
+        # Manifest cursor cross-check (DESIGN.md §26): the checkpoint's stamped
+        # data position must agree with the derived start epoch.
+        note = checkpoint.check_cursor_resume(config.resume_from,
+                                              seed=config.seed,
+                                              step=int(base_state.step),
+                                              start_epoch=start_epoch)
+        if note:
+            M.log(f"WARNING: {note}")
     grt.baseline(base_state)    # this attempt's anomaly-counter zero point
     # Whole epochs run as ONE compiled scan under the composed shardings (same program
     # structure as train/distributed.py): per-step Python dispatch — an index-plan
@@ -621,9 +629,14 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, train_x, train_y, test_x
                 if ckpt_store and config.keep_checkpoints:
                     # Versioned store (manifest + checksums + keep-last-N GC) for
                     # the supervisor's newest-HEALTHY resume scan.
-                    checkpoint.save_versioned(ckpt_store, host_state,
-                                              keep=config.keep_checkpoints,
-                                              tele=tele, health=stamp)
+                    checkpoint.save_versioned(
+                        ckpt_store, host_state, keep=config.keep_checkpoints,
+                        tele=tele, health=stamp,
+                        # The manifest's data cursor: the (seed, epoch)-pure
+                        # permutation's resume anchor (DESIGN.md §26).
+                        cursor={"version": 1, "kind": "epoch",
+                                "seed": config.seed, "epoch": epoch + 1,
+                                "batch": 0, "step": int(host_state.step)})
             # Anomaly policy AFTER the stamped checkpoint is durable (raises
             # Poisoned; __main__ exits 65).
             if grt:

@@ -194,6 +194,14 @@ def main(config: DistributedConfig = DistributedConfig(), *,
             M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(state.step)} "
               f"(starting epoch {start_epoch})")
+        # Manifest cursor cross-check (DESIGN.md §26): the checkpoint's stamped
+        # data position must agree with the derived start epoch.
+        note = checkpoint.check_cursor_resume(config.resume_from,
+                                              seed=config.seed,
+                                              step=int(state.step),
+                                              start_epoch=start_epoch)
+        if note:
+            M.log(f"WARNING: {note}")
     grt.baseline(state)     # this attempt's anomaly-counter zero point
     if config.fsdp:
         # ZeRO/FSDP mode (r5): params + SGD/AdamW state shard over the data axis;
@@ -382,9 +390,14 @@ def main(config: DistributedConfig = DistributedConfig(), *,
                 if config.keep_checkpoints:
                     # Versioned store (manifest + checksums + keep-last-N GC): what
                     # the fleet supervisor's newest-HEALTHY resume scan reads.
-                    checkpoint.save_versioned(ckpt_store, ck_state,
-                                              keep=config.keep_checkpoints,
-                                              tele=tele, health=stamp)
+                    checkpoint.save_versioned(
+                        ckpt_store, ck_state, keep=config.keep_checkpoints,
+                        tele=tele, health=stamp,
+                        # The manifest's data cursor: the (seed, epoch)-pure
+                        # permutation's resume anchor (DESIGN.md §26).
+                        cursor={"version": 1, "kind": "epoch",
+                                "seed": config.seed, "epoch": epoch + 1,
+                                "batch": 0, "step": int(ck_state.step)})
                 # Anomaly policy AFTER the (stamped) checkpoint is durable: the
                 # supervisor rolls back to the newest CLEAN stamp and restarts
                 # with --skip-steps (raises Poisoned; __main__ exits 65).

@@ -20,6 +20,7 @@ from __future__ import annotations
 import functools
 import os
 import time
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +29,9 @@ from jax.sharding import PartitionSpec as P
 
 from csed_514_project_distributed_training_using_pytorch_tpu.data import (
     download_mnist, load_mnist, mnist,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.data import (
+    stream as stream_mod,
 )
 from csed_514_project_distributed_training_using_pytorch_tpu.models import lm as lm_mod
 from csed_514_project_distributed_training_using_pytorch_tpu.models import (
@@ -141,19 +145,47 @@ def main(config: LMConfig = LMConfig(), *,
         raise ValueError(f"batch {config.batch_size} not divisible by data axis "
                          f"{world}")
 
-    if config.download_data and datasets is None:
-        download_mnist(config.data_dir)
-    train_ds, test_ds = datasets if datasets is not None else load_mnist(config.data_dir)
-    train_ds = mnist.truncate(train_ds, config.max_train_examples)
-    test_ds = mnist.truncate(test_ds, config.max_test_examples)
+    loader = None
+    eval_batch = config.eval_batch
+    if config.corpus:
+        # Streaming token-shard corpus (data/stream.py, DESIGN.md §26): the
+        # epoch feed comes off disk through the deterministic cursor loader;
+        # vocab/seq_len are the corpus's, not MNIST's. The scanned epoch
+        # program is unchanged — each epoch's batches materialize into the
+        # device-resident token array and the plan is the identity (the
+        # loader already emitted them in stream order).
+        loader = stream_mod.StreamLoader(config.corpus, config.batch_size,
+                                         seed=config.seed,
+                                         throttle_s=config.data_throttle_s)
+        seq_len = loader.seq_len
+        vocab = loader.vocab
+        test_tokens = stream_mod.eval_tokens(config.corpus)
+        if test_tokens is None or not len(test_tokens):
+            raise ValueError(f"--corpus {config.corpus} has no eval split — "
+                             f"rebuild with tools/build_corpus.py --eval-frac")
+        n_train = loader.batches_per_epoch * config.batch_size
+        eval_batch = min(config.eval_batch, len(test_tokens))
+        n_test = len(test_tokens) - len(test_tokens) % eval_batch
+        test_tokens = test_tokens[:n_test]
+        train_tokens = None
+        data_source = f"corpus:{config.corpus}"
+    else:
+        if config.download_data and datasets is None:
+            download_mnist(config.data_dir)
+        train_ds, test_ds = (datasets if datasets is not None
+                             else load_mnist(config.data_dir))
+        train_ds = mnist.truncate(train_ds, config.max_train_examples)
+        test_ds = mnist.truncate(test_ds, config.max_test_examples)
 
-    # Tokenize ONCE on host; the token arrays are the device-resident dataset.
-    train_tokens = np.asarray(lm_mod.tokenize_images_to_ids(
-        jnp.asarray(train_ds.images), num_levels=config.num_levels))
-    test_tokens = np.asarray(lm_mod.tokenize_images_to_ids(
-        jnp.asarray(test_ds.images), num_levels=config.num_levels))
-    n_train, n_test = len(train_tokens), len(test_tokens)
-    seq_len = train_tokens.shape[1]
+        # Tokenize ONCE on host; the token arrays are the device-resident dataset.
+        train_tokens = np.asarray(lm_mod.tokenize_images_to_ids(
+            jnp.asarray(train_ds.images), num_levels=config.num_levels))
+        test_tokens = np.asarray(lm_mod.tokenize_images_to_ids(
+            jnp.asarray(test_ds.images), num_levels=config.num_levels))
+        n_train, n_test = len(train_tokens), len(test_tokens)
+        seq_len = train_tokens.shape[1]
+        vocab = config.num_levels
+        data_source = train_ds.source
 
     lm_kwargs = {}
     if seq_size > 1:
@@ -177,12 +209,12 @@ def main(config: LMConfig = LMConfig(), *,
             window=config.attention_window)
     # Fail fast on sampling knobs: generate() re-checks these, but its first call is
     # AFTER the full training loop — a bad flag must not cost the whole run.
-    if not 0 <= config.top_k <= config.num_levels + 1:
-        raise ValueError(f"top_k {config.top_k} outside [0, {config.num_levels + 1}]")
+    if not 0 <= config.top_k <= vocab + 1:
+        raise ValueError(f"top_k {config.top_k} outside [0, {vocab + 1}]")
     if not 0.0 < config.top_p <= 1.0:
         raise ValueError(f"top_p {config.top_p} outside (0, 1]")
     model = lm_mod.TransformerLM(
-        vocab_size=config.num_levels + 1, seq_len=seq_len,
+        vocab_size=vocab + 1, seq_len=seq_len,
         embed_dim=config.embed_dim, num_layers=config.num_layers,
         num_heads=config.num_heads, dropout_rate=config.dropout_rate,
         num_kv_heads=config.kv_heads or None,
@@ -200,8 +232,8 @@ def main(config: LMConfig = LMConfig(), *,
                                 attention_window=config.attention_window)
                     if seq_size > 1 else model)
     M.log(f"LM training: mesh {dict(mesh.shape)} on {info.process_count} process(es), "
-          f"batch {config.batch_size}, vocab {config.num_levels}+BOS, "
-          f"seq {seq_len}, data source: {train_ds.source}")
+          f"batch {config.batch_size}, vocab {vocab}+BOS, "
+          f"seq {seq_len}, data source: {data_source}")
     # Telemetry + resilience wiring live ABOVE the resume so the restore is recorded;
     # resilience hooks are flag-gated, host-side only (zero-cost when off).
     tele = T.TelemetryWriter(config.telemetry,
@@ -245,6 +277,26 @@ def main(config: LMConfig = LMConfig(), *,
             M.log(f"WARNING: {warning}")
         M.log(f"Resumed from {config.resume_from} at step {int(state.step)} "
               f"(starting epoch {start_epoch})")
+        # Manifest cursor (DESIGN.md §26): the checkpoint and the stream
+        # position that produced it are one artifact. Stream cursors VERIFY
+        # against this corpus (drift raises — silently resuming a reshuffled
+        # or edited corpus would feed different bytes than the step count
+        # paid for) and override the step-derived start epoch; epoch cursors
+        # cross-check it.
+        man_cursor = checkpoint.cursor_for(config.resume_from)
+        if loader is not None and man_cursor is not None:
+            cur_epoch, cur_batch = loader.verify_cursor(man_cursor)
+            if cur_batch:
+                M.log(f"WARNING: stream cursor resumes mid-epoch (batch "
+                      f"{cur_batch}) but the epoch program replays whole "
+                      f"epochs — starting at epoch {cur_epoch}")
+            start_epoch = cur_epoch
+        else:
+            note = checkpoint.check_cursor_resume(
+                config.resume_from, seed=config.seed, step=int(state.step),
+                start_epoch=start_epoch)
+            if note:
+                M.log(f"WARNING: {note}")
     grt.baseline(state)     # this attempt's anomaly-counter zero point
     if model_size > 1:
         # Megatron TP (r5): column/row kernels shard over `model` (the LM blocks
@@ -281,9 +333,14 @@ def main(config: LMConfig = LMConfig(), *,
                               ema_decay=config.ema_decay, loss_fn=lm_loss,
                               with_metrics=health, guard=grt.spec)
     epoch_fn = compile_lm_epoch(make_epoch_from_step(step_fn, health=health))
-    eval_fn = jax.jit(make_eval_nll_fn(model, batch_size=config.eval_batch))
+    eval_fn = jax.jit(make_eval_nll_fn(model, batch_size=eval_batch))
 
-    tokens_d = dp.put_global(mesh, train_tokens, P())
+    # Corpus mode: the device token array is REFILLED per epoch from the
+    # streaming loader (same shape every epoch — the compiled program is
+    # oblivious); seed it with zeros so AOT compile below sees real arrays.
+    tokens_d = dp.put_global(
+        mesh, (np.zeros((n_train, seq_len), np.int32) if loader is not None
+               else train_tokens), P())
     # ys is unused by the LM loss; a zero vector keeps the epoch program's
     # (images, labels, plan) signature without a second token gather per step.
     zeros_d = dp.put_global(mesh, np.zeros(n_train, np.int32), P())
@@ -322,7 +379,7 @@ def main(config: LMConfig = LMConfig(), *,
                             zeros_d, test_d, dropout_rng, n_train, n_test, seq_len,
                             steps_per_epoch, start_epoch, history, watch, saver,
                             ckpt_path, gather, tele, compile_s, flops_per_step,
-                            rt, bytes_per_step, grt)
+                            rt, bytes_per_step, grt, loader)
     finally:
         # Drain the write-behind queue even on an exception/signal/preemption
         # mid-run — the queued per-epoch checkpoint is the resume artifact a killed
@@ -335,7 +392,9 @@ def main(config: LMConfig = LMConfig(), *,
     host_state = jax.device_get(gather(state))
     if ckpt_path:
         M.log(f"Saved {ckpt_path}")
-    if config.generate > 0:
+    if config.generate > 0 and loader is None:
+        # Corpus-trained models skip the digit grids: ids_to_images only means
+        # something for the pixel-stream tokenizer.
         def sample_grid(filename: str, seed_offset: int, batch: int, **gen_kw):
             gen_params = (host_state.ema if host_state.ema is not None
                           else host_state.params)
@@ -372,7 +431,7 @@ def main(config: LMConfig = LMConfig(), *,
 def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_d,
                 dropout_rng, n_train, n_test, seq_len, steps_per_epoch, start_epoch,
                 history, watch, saver, ckpt_path, gather, tele, compile_s,
-                flops_per_step, rt, bytes_per_step=None, grt=None):
+                flops_per_step, rt, bytes_per_step=None, grt=None, loader=None):
     """The LM trainer's epoch loop, split out so the caller can guarantee the
     async-checkpoint flush in a ``finally`` regardless of where the loop fails."""
     best_step_s = None
@@ -384,14 +443,30 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_
         rt.epoch_tick(state, epoch,
                       fingerprint=grt.fingerprint if grt else None)
         t_epoch = time.perf_counter()
-        # (seed, epoch)-keyed permutation — the parallel/sampler contract, so resumed
-        # runs replay exactly the epochs they missed.
-        perm = np.random.default_rng(
-            np.random.SeedSequence([config.seed, epoch])).permutation(n_train)
-        plan = dp.put_global(
-            mesh,
-            perm[:steps_per_epoch * config.batch_size].astype(np.int32)
-            .reshape(steps_per_epoch, config.batch_size), P(None, "data"))
+        stream_wait_s = stream_digest = None
+        if loader is not None:
+            # Streaming corpus feed (data/stream.py): the loader's
+            # (seed, epoch)-pure shard shuffle IS the permutation, already in
+            # batch order — refill the device token array and run the identity
+            # plan. Loader stall (shard IO, sha256, --data-throttle-s) lands in
+            # this epoch's data_s and therefore in goodput's data_wait.
+            epoch_np = loader.epoch_tokens(epoch)
+            stream_wait_s = loader.pop_wait_s()
+            stream_digest = zlib.crc32(epoch_np.tobytes())
+            tokens_d = dp.put_global(mesh, epoch_np, P())
+            plan = dp.put_global(
+                mesh,
+                np.arange(steps_per_epoch * config.batch_size, dtype=np.int32)
+                .reshape(steps_per_epoch, config.batch_size), P(None, "data"))
+        else:
+            # (seed, epoch)-keyed permutation — the parallel/sampler contract,
+            # so resumed runs replay exactly the epochs they missed.
+            perm = np.random.default_rng(
+                np.random.SeedSequence([config.seed, epoch])).permutation(n_train)
+            plan = dp.put_global(
+                mesh,
+                perm[:steps_per_epoch * config.batch_size].astype(np.int32)
+                .reshape(steps_per_epoch, config.batch_size), P(None, "data"))
         data_s = time.perf_counter() - t_epoch
         t_exec = time.perf_counter()
         state, out = epoch_fn(state, tokens_d, zeros_d, plan, dropout_rng)
@@ -429,6 +504,17 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_
             if epoch_health is not None:
                 tele.emit(T.health_event(epoch, health_host, steps_per_epoch,
                                          param_norm=param_norm))
+            if loader is not None:
+                # The stream ledger next to the epoch event: stall wall,
+                # next-epoch cursor (the one the checkpoint below stamps),
+                # and the epoch's token CRC — the bitwise pin the
+                # deterministic-resume tests compare across a kill.
+                tele.emit(T.data_event(
+                    epoch, batches=steps_per_epoch,
+                    sequences=steps_per_epoch * config.batch_size,
+                    wait_s=stream_wait_s, throttle_s=config.data_throttle_s,
+                    cursor=loader.cursor(epoch + 1, 0),
+                    stream_digest=stream_digest))
         # Guard boundary: anomaly verdict fetch + event + cross-replica
         # fingerprint, then the manifest health stamp for the versioned save.
         stamp = grt.epoch_end(state, epoch, steps_per_epoch) if grt else None
@@ -439,10 +525,15 @@ def _run_epochs(config, state, mesh, epoch_fn, eval_fn, tokens_d, zeros_d, test_
             saver.save_train_state(ckpt_path, ck_state)
             if ckpt_store and config.keep_checkpoints:
                 # Versioned store (manifest + checksums + keep-last-N GC) for the
-                # supervisor's newest-HEALTHY resume scan.
+                # supervisor's newest-HEALTHY resume scan. The cursor stamps the
+                # NEXT epoch's stream position into the manifest (DESIGN.md §26).
+                cursor = (loader.cursor(epoch + 1, 0) if loader is not None
+                          else {"version": 1, "kind": "epoch",
+                                "seed": config.seed, "epoch": epoch + 1,
+                                "batch": 0, "step": int(ck_state.step)})
                 checkpoint.save_versioned(ckpt_store, ck_state,
                                           keep=config.keep_checkpoints, tele=tele,
-                                          health=stamp)
+                                          health=stamp, cursor=cursor)
         # Anomaly policy AFTER the stamped checkpoint is durable (raises
         # Poisoned; __main__ exits 65).
         if grt:

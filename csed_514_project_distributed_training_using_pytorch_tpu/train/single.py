@@ -134,6 +134,13 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                 nbytes=os.path.getsize(resume_from),
                 wall_s=time.perf_counter() - t_restore, step=int(state.step)))
         M.log(f"Resumed from {resume_from} at step {int(state.step)}")
+        # Manifest cursor cross-check (DESIGN.md §26): a versioned checkpoint
+        # carries the data position that produced it; a disagreeing config
+        # resumes a DIFFERENT stream and should say so up front.
+        note = checkpoint.check_cursor_resume(resume_from, seed=config.seed,
+                                              step=int(state.step))
+        if note:
+            M.log(f"WARNING: {note}")
     grt.baseline(state)     # this attempt's anomaly-counter zero point
     # Schedule horizon = THIS invocation's planned end: the restored step plus
     # n_epochs of updates (single-trainer resume means "train n_epochs MORE", unlike
@@ -304,6 +311,7 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
         front on this path — the accumulators ride the scan carry.)"""
         t_epoch = time.perf_counter()
         train_loader.set_epoch(epoch)
+        train_loader.pop_wait_s()       # this epoch's stall ledger starts at zero
         full_steps = train_loader.epoch_index_matrix(epoch, allow_empty=True).shape[0]
         step_losses = []      # device scalars — fetched ONCE at epoch end
         # Live per-batch bar (≙ the reference's tqdm, src/train_dist.py:76) — only
@@ -336,8 +344,15 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
             step_losses.append(tail_loss)
         losses = np.asarray(jax.device_get(step_losses)) if step_losses else np.zeros(0)
         # Per-batch host dispatch: device execution overlaps the feed, so the
-        # compile/execute split doesn't decompose here — report the loop as execute.
-        return state, None, {"execute": time.perf_counter() - t_epoch, "data": 0.0,
+        # compile/execute split doesn't decompose here — but the loader now
+        # meters the seconds the CONSUMER actually blocked on it, so report
+        # loop-minus-stall as execute and the stall as data (the goodput
+        # data_wait input; before this the split read data=0 even on a
+        # data-starved run, DESIGN.md §26).
+        wait_s = train_loader.pop_wait_s()
+        loop_s = time.perf_counter() - t_epoch
+        return state, None, {"execute": max(0.0, loop_s - wait_s),
+                             "data": wait_s,
                              "loss_sum": float(losses.sum()),
                              "loss_steps": int(losses.size)}
 
@@ -393,9 +408,14 @@ def main(config: SingleProcessConfig = SingleProcessConfig(), *,
                 if config.keep_checkpoints:
                     # Versioned store (manifest + checksums + keep-last-N GC) for
                     # the supervisor's newest-HEALTHY resume scan.
-                    checkpoint.save_versioned(ckpt_store, state,
-                                              keep=config.keep_checkpoints,
-                                              tele=tele, health=stamp)
+                    checkpoint.save_versioned(
+                        ckpt_store, state, keep=config.keep_checkpoints,
+                        tele=tele, health=stamp,
+                        # The manifest's data cursor: the (seed, epoch)-pure
+                        # permutation's resume anchor (DESIGN.md §26).
+                        cursor={"version": 1, "kind": "epoch",
+                                "seed": config.seed, "epoch": epoch + 1,
+                                "batch": 0, "step": int(state.step)})
                 # Anomaly policy AFTER the stamped checkpoint is durable
                 # (raises Poisoned; __main__ exits 65).
                 grt.check_poisoned(state)

@@ -428,7 +428,8 @@ def load_manifest(dir_path: str) -> dict:
 
 
 def save_versioned(dir_path: str, state: TrainState, *, keep: int = 3,
-                   tele=None, health: dict | None = None) -> str | None:
+                   tele=None, health: dict | None = None,
+                   cursor: dict | None = None) -> str | None:
     """Write ``state`` as ``ckpt_{step:08d}.msgpack`` into the versioned store:
     atomic file write, then an atomic manifest update (file, step, sha256, bytes),
     then GC of everything beyond the newest ``keep`` steps. Process-0 gated (returns
@@ -444,6 +445,15 @@ def save_versioned(dir_path: str, state: TrainState, *, keep: int = 3,
     :func:`newest_healthy_checkpoint` prefers over blind newest-valid; old
     manifests without it remain loadable and keep their merely-valid standing
     (back-compat pinned in tests).
+
+    ``cursor`` keys the trainer's DATA position into the same manifest entry —
+    for the streaming loader (``data/stream.py``) the shard/intra-shard-offset/
+    plan-CRC triple, for the in-memory trainers the ``(seed, epoch, step)``
+    anchor of the ``(seed, epoch)``-pure permutation. The invariant (DESIGN.md
+    §26): a checkpoint and the position of the batch stream that produced it
+    are ONE durable artifact, so preemption-resume replays the exact remaining
+    stream bitwise instead of guessing an epoch boundary from the step count.
+    Read back with :func:`cursor_for`.
 
     Synchronous BY DESIGN, even next to ``--async-checkpoint``: this store is the
     supervisor's resume substrate and the preemption contract's "checkpoint already
@@ -467,6 +477,8 @@ def save_versioned(dir_path: str, state: TrainState, *, keep: int = 3,
              "bytes": len(data), "unix_time": time.time()}
     if health is not None:
         entry["health"] = dict(health)
+    if cursor is not None:
+        entry["cursor"] = dict(cursor)
     entries.append(entry)
     entries.sort(key=lambda e: e["step"])
     dropped, entries = entries[:-keep], entries[-keep:]
@@ -482,6 +494,58 @@ def save_versioned(dir_path: str, state: TrainState, *, keep: int = 3,
                            nbytes=len(data), wall_s=time.perf_counter() - t0,
                            step=step)
     return path
+
+
+def manifest_entry_for(path: str) -> dict | None:
+    """The manifest entry of one versioned-store file (by its directory +
+    basename), or None when the file is outside any store / not listed —
+    overwrite checkpoints and hand-copied files resolve to None, never
+    raise."""
+    name = os.path.basename(path)
+    for entry in load_manifest(os.path.dirname(path) or ".")["entries"]:
+        if entry.get("file") == name:
+            return entry
+    return None
+
+
+def cursor_for(path: str) -> dict | None:
+    """The data cursor ``save_versioned(cursor=...)`` stamped next to this
+    checkpoint, or None (pre-cursor manifests, non-store files). The resume
+    prologue of every trainer consults this so the batch stream restarts where
+    the checkpoint's stream actually stopped (DESIGN.md §26)."""
+    entry = manifest_entry_for(path)
+    return dict(entry["cursor"]) if entry and entry.get("cursor") else None
+
+
+def check_cursor_resume(path: str, *, seed: int, step: int,
+                        start_epoch: int | None = None) -> str | None:
+    """Cross-check a resume target's manifest cursor against what the trainer
+    is about to do; returns a log-worthy warning on mismatch, None when
+    consistent or when no ``kind: "epoch"`` cursor exists (stream cursors are
+    the :class:`data.stream.StreamLoader`'s to verify — it RAISES, because a
+    streaming mismatch silently feeds different bytes; here the permutation is
+    re-derived from ``(seed, epoch)`` regardless, so a mismatch means the
+    RESUMING CONFIG disagrees with the saving one and deserves a warning, not
+    a refusal)."""
+    cursor = cursor_for(path)
+    if not cursor or cursor.get("kind") != "epoch":
+        return None
+    problems = []
+    if int(cursor.get("seed", seed)) != int(seed):
+        problems.append(f"cursor seed {cursor.get('seed')} != config seed {seed} "
+                        f"(the resumed epochs will reshuffle)")
+    if int(cursor.get("step", step)) != int(step):
+        problems.append(f"cursor step {cursor.get('step')} != checkpoint step "
+                        f"{step} (manifest drifted from its file)")
+    if (start_epoch is not None and cursor.get("epoch") is not None
+            and int(cursor["epoch"]) != int(start_epoch)):
+        problems.append(f"cursor epoch {cursor['epoch']} != derived start epoch "
+                        f"{start_epoch} (a different batch size than the saving "
+                        f"run?)")
+    if not problems:
+        return None
+    return ("resume cursor mismatch for " + os.path.basename(path) + ": "
+            + "; ".join(problems))
 
 
 def newest_valid_checkpoint(dir_path: str) -> str | None:

@@ -417,6 +417,15 @@ class LMConfig:
     seed: int = 1
     data_dir: str = "files"
     download_data: bool = False
+    corpus: str = ""                    # sharded token-corpus directory
+                                        # (tools/build_corpus.py output): train on
+                                        # its streaming shards instead of MNIST
+                                        # pixel streams; seq_len/vocab come from
+                                        # corpus.json, the resume cursor from the
+                                        # checkpoint manifest (DESIGN.md §26)
+    data_throttle_s: float = 0.0        # per-batch streaming-loader brake (debug/
+                                        # bench: proves goodput's data_wait is
+                                        # actually measured); 0 off
     results_dir: str = "results"
     images_dir: str = "images"
     resume_from: str = ""               # per-epoch checkpoint to resume from

@@ -351,6 +351,28 @@ def epoch_event(epoch: int, *, examples: int, steps: int | None = None,
     }
 
 
+def data_event(epoch: int, *, batches: int, sequences: int,
+               wait_s: float | None = None, throttle_s: float = 0.0,
+               cursor: dict | None = None,
+               stream_digest: int | None = None) -> dict:
+    """Per-epoch streaming-loader ledger (``data/stream.py``): how many
+    batches the epoch consumed, the seconds the consumer spent blocked on the
+    loader (the goodput ``data_wait`` input, charged inside the epoch event's
+    ``data_s``), the resume cursor the matching checkpoint manifest carries,
+    and the epoch's stream CRC — the bitwise pin deterministic-resume tests
+    compare across a kill/resume boundary."""
+    return {
+        "event": "data",
+        "epoch": int(epoch),
+        "batches": int(batches),
+        "sequences": int(sequences),
+        "wait_s": _finite(wait_s),
+        "throttle_s": _finite(throttle_s),
+        "cursor": dict(cursor) if cursor else None,
+        "stream_digest": int(stream_digest) if stream_digest is not None else None,
+    }
+
+
 def health_event(epoch: int, health, steps: int, *,
                  param_norm: float | None = None) -> dict:
     """The ``health`` event from a ``train.step.HealthStats`` carry (host-fetched
@@ -831,4 +853,61 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
         "tpot_s": series_percentiles(tpot_s),
         "e2e_s": series_percentiles(e2e_s),
         "queue_wait_s": series_percentiles(queue_wait_s),
+    }
+
+
+def promote_event(*, action: str, candidate: str, step: int | None = None,
+                  reason: str = "", incumbent: str = "",
+                  nll: float | None = None, incumbent_nll: float | None = None,
+                  perf_s: float | None = None,
+                  incumbent_perf_s: float | None = None) -> dict:
+    """One promotion-gate lifecycle transition (``deploy/promoter.py``):
+    ``action`` is ``candidate_seen`` / ``gate_pass`` / ``gate_fail`` /
+    ``canary_start`` / ``promoted`` / ``rolled_back``. ``candidate`` and
+    ``incumbent`` are checkpoint paths; the NLL and perf pairs record the
+    gate's actual measurements so a rejected candidate's margin is auditable
+    from the stream alone."""
+    return {
+        "event": "promote",
+        "action": action,
+        "candidate": candidate,
+        "step": int(step) if step is not None else None,
+        "reason": reason,
+        "incumbent": incumbent,
+        "nll": _finite(nll),
+        "incumbent_nll": _finite(incumbent_nll),
+        "perf_s": _finite(perf_s),
+        "incumbent_perf_s": _finite(incumbent_perf_s),
+    }
+
+
+def canary_event(*, candidate: str, replica: int, verdict: str,
+                 window_s: float | None = None,
+                 canary_attainment: float | None = None,
+                 fleet_attainment: float | None = None,
+                 canary_nll: float | None = None,
+                 fleet_nll: float | None = None,
+                 canary_requests: int | None = None,
+                 fleet_requests: int | None = None,
+                 reason: str = "") -> dict:
+    """One canary-window verdict (``deploy/promoter.py``): the candidate on
+    ONE replica vs the rest of the fleet over the same attainment window —
+    windowed SLO attainment (fractions) and sampled-token NLL under the
+    shared last-good scorer. ``verdict`` is ``pass`` / ``fail`` /
+    ``inconclusive`` (too few requests to judge)."""
+    return {
+        "event": "canary",
+        "candidate": candidate,
+        "replica": int(replica),
+        "verdict": verdict,
+        "window_s": _finite(window_s),
+        "canary_attainment": _finite(canary_attainment),
+        "fleet_attainment": _finite(fleet_attainment),
+        "canary_nll": _finite(canary_nll),
+        "fleet_nll": _finite(fleet_nll),
+        "canary_requests": (int(canary_requests)
+                            if canary_requests is not None else None),
+        "fleet_requests": (int(fleet_requests)
+                           if fleet_requests is not None else None),
+        "reason": reason,
     }

@@ -34,6 +34,7 @@ EVENT_KINDS: dict[str, str] = {
     "manifest": "once per run: config/mesh/device/version snapshot",
     "compile": "AOT compile timing + cost_analysis of one program",
     "epoch": "per-epoch wall/execute/eval/data split + losses",
+    "data": "per-epoch streaming-loader ledger: batches/stall wall/cursor (data/stream.py)",
     "health": "per-epoch grad-norm/loss accumulators (train/step.py carry)",
     "mfu": "steady-state achieved FLOPs and HBM bytes vs chip peak",
     "bench": "one bench*.py measurement line",
@@ -59,10 +60,13 @@ EVENT_KINDS: dict[str, str] = {
     "kv_handoff": "one prefill→decode KV plane handoff: bytes/wall/ok (serving/tiers.py)",
     # -- resilience (resilience/supervisor.py, utils/checkpoint.py) -------------
     "checkpoint": "one checkpoint save/restore: op/kind/bytes/wall",
-    "restart": "supervisor restart: attempt, crash/hung/poisoned reason, backoff",
+    "restart": "supervisor restart: attempt, reason, backoff, resume cursor",
     "anomaly": "per-epoch --guard verdict: anomalies/skipped/EMA/fingerprint",
     "preempt": "cooperative SIGTERM stop at an epoch boundary (exit 75)",
     "supervise_summary": "once per supervised run: final status + attempts",
+    # -- continuous deployment (deploy/promoter.py) -----------------------------
+    "promote": "promotion-gate lifecycle: candidate seen/qualified/rejected/promoted/rolled_back",
+    "canary": "one canary window verdict: attainment + sampled-token NLL vs fleet",
     # -- planner (plan/) --------------------------------------------------------
     "plan": "once per --plan run: chosen layout + predicted cost",
     "autotune": "one empirically trialed candidate: predicted vs measured",

@@ -322,8 +322,12 @@ def test_router_corrupt_and_torn_wire_zero_loss(tmp_path, framed):
     router = _router(
         tmp_path, _echo_cmd(delay=0.02), n=2,
         framed_wire=framed == "on",
-        chaos=("corrupt:replica=0,dir=s2c,after=4;"
-               "truncate:replica=1,dir=s2c,after=6"),
+        # Units are recv() chunks, so concurrent done lines can coalesce:
+        # pin the faults to the FIRST connection's first post-ready units
+        # (hello_ack and ready are always separate chunks) so the schedule
+        # fires deterministically regardless of TCP chunking.
+        chaos=("corrupt:replica=0,conn=0,dir=s2c,after=2;"
+               "truncate:replica=1,conn=0,dir=s2c,after=3"),
     ).start()
     try:
         assert router.wait_ready(timeout=120)

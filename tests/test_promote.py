@@ -1,0 +1,393 @@
+"""Checkpoint promotion with canary rollout (DESIGN.md §26, the deploy half).
+
+Tiers mirror the serving tests: **unit tier** exercises the promoter's gate
+ordering, newest-wins superseding, ledger durability, and canary judgment on
+hand-built manifests with injected probes (no processes, no jax); **echo
+tier** drives the router's real canary machinery — per-replica checkpoint
+override, one-replica roll, evidence windows, fleet-wide promote, rollback —
+against model-free echo replicas, where ``--checkpoint`` is accepted and
+ignored so the roll mechanics are exact without a model. The full
+train→canary→promote loop with real ``decode_nll`` scorers is the committed
+bench (``tools/train_serve_loop.py``)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.deploy import (
+    CanaryConfig,
+    GateConfig,
+    Promoter,
+    PromotionLedger,
+    read_ledger,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.obs.slo import (
+    SLOSpec,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.router import (
+    Router,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.utils.telemetry_events import (
+    EVENT_KINDS,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "csed_514_project_distributed_training_using_pytorch_tpu"
+
+
+@pytest.fixture(autouse=True)
+def _child_pythonpath(monkeypatch):
+    existing = os.environ.get("PYTHONPATH", "")
+    monkeypatch.setenv("PYTHONPATH", f"{REPO}:{existing}" if existing else REPO)
+
+
+def _store(tmp_path, entries):
+    """A hand-built versioned store: dummy checkpoint bytes + a manifest of
+    ``(step, health)`` pairs — the promoter trusts the manifest, so the gate
+    logic tests need no real msgpack."""
+    store = tmp_path / "ckpts"
+    store.mkdir(parents=True, exist_ok=True)
+    rows = []
+    for step, health in entries:
+        name = f"ckpt_{step:08d}.msgpack"
+        (store / name).write_bytes(b"x" * 8)
+        rows.append({"file": name, "step": step, "sha256": "", "bytes": 8,
+                     "unix_time": 0.0, "health": health})
+    (store / "manifest.json").write_text(
+        json.dumps({"version": 1, "entries": rows}))
+    return str(store)
+
+
+def _add(store, step, health):
+    name = f"ckpt_{step:08d}.msgpack"
+    with open(os.path.join(store, name), "wb") as f:
+        f.write(b"y" * 8)
+    with open(os.path.join(store, "manifest.json")) as f:
+        man = json.load(f)
+    man["entries"].append({"file": name, "step": step, "sha256": "",
+                           "bytes": 8, "unix_time": 0.0, "health": health})
+    with open(os.path.join(store, "manifest.json"), "w") as f:
+        json.dump(man, f)
+    return name
+
+
+# -----------------------------------------------------------------------------------------
+# Unit tier: gate ordering, superseding, ledger
+# -----------------------------------------------------------------------------------------
+
+
+def test_gate_rejects_unclean_stamp_before_probes(tmp_path):
+    """Gate order is cheapest-first: an unclean health stamp rejects without
+    ever invoking the (expensive) probes."""
+    store = _store(tmp_path, [(10, {"clean": False})])
+    probed = []
+    p = Promoter(store, nll_fn=lambda path: probed.append(path) or 1.0)
+    assert p.run_once() == ["gate_fail"]
+    assert probed == []
+    assert p.counts["gate_fail"] == 1
+
+
+def test_gate_nll_budget_and_perf_tolerance(tmp_path):
+    """The accuracy budget is absolute, the perf tolerance relative; the
+    incumbent baseline is measured lazily, once."""
+    store = _store(tmp_path, [(10, {"clean": True}), (20, {"clean": True})])
+    nlls = {"ckpt_00000010": 1.0, "ckpt_00000020": 1.2}
+    calls = []
+
+    def nll_fn(path):
+        key = os.path.basename(path).split(".")[0]
+        calls.append(key)
+        return nlls[key]
+
+    inc = os.path.join(store, "ckpt_00000010.msgpack")
+    p = Promoter(store, nll_fn=nll_fn, gate=GateConfig(nll_budget=0.05),
+                 incumbent=inc)
+    assert p.run_once() == ["gate_fail"]      # 1.2 > 1.0 + 0.05
+    assert calls.count("ckpt_00000010") == 1  # baseline measured once
+    # Within budget passes; gate-only mode promotes and re-baselines.
+    name = _add(store, 30, {"clean": True})
+    nlls["ckpt_00000030"] = 1.03
+    assert p.run_once() == ["promoted"]
+    assert os.path.basename(p.incumbent) == name
+    assert p.incumbent_nll == 1.03            # candidate's own measurement
+    assert calls.count("ckpt_00000010") == 1
+
+    # Perf: relative tolerance over the median of perf_probes.
+    store2 = _store(tmp_path / "p2", [(10, {"clean": True})])
+    perfs = {"ckpt_00000010": 1.0, "ckpt_00000020": 1.8}
+    p2 = Promoter(store2,
+                  perf_fn=lambda path: perfs[
+                      os.path.basename(path).split(".")[0]],
+                  gate=GateConfig(perf_tolerance=0.5),
+                  incumbent=os.path.join(store2, "ckpt_00000010.msgpack"))
+    _add(store2, 20, {"clean": True})
+    assert p2.run_once() == ["gate_fail"]     # 1.8 > 1.0 * 1.5
+
+
+def test_gate_require_stamp(tmp_path):
+    store = _store(tmp_path, [(10, None)])
+    assert Promoter(store).run_once() == ["promoted"]     # lenient default
+    store2 = _store(tmp_path / "strict", [(10, None)])
+    p = Promoter(store2, gate=GateConfig(require_stamp=True))
+    assert p.run_once() == ["gate_fail"]
+
+
+def test_newest_wins_and_superseded(tmp_path):
+    """A trainer faster than the promoter must not queue a canary backlog:
+    one poll processes only the NEWEST unseen candidate and marks elders
+    superseded."""
+    store = _store(tmp_path, [(10, {"clean": True}), (20, {"clean": True}),
+                              (30, {"clean": True})])
+    led = str(tmp_path / "ledger.jsonl")
+    p = Promoter(store, ledger_path=led)
+    assert p.run_once() == ["promoted"]
+    assert p.counts["superseded"] == 2
+    assert os.path.basename(p.incumbent) == "ckpt_00000030.msgpack"
+    assert p.run_once() == []                  # everything seen
+    actions = [r["action"] for r in read_ledger(led)]
+    assert actions == ["superseded", "superseded", "candidate_seen",
+                       "gate_pass", "promoted"]
+
+
+def test_torn_publish_invisible(tmp_path):
+    """A manifest entry whose bytes never landed is a torn publish: not a
+    candidate."""
+    store = _store(tmp_path, [(10, {"clean": True})])
+    name = _add(store, 20, {"clean": True})
+    os.remove(os.path.join(store, name))
+    p = Promoter(store)
+    assert [e["file"] for e in p.candidates()] == ["ckpt_00000010.msgpack"]
+
+
+def test_ledger_append_only_and_torn_tail(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    led = PromotionLedger(path)
+    led.record("candidate_seen", "a", step=1)
+    led.record("promoted", "a", step=1)
+    with open(path, "a") as f:
+        f.write('{"action": "gate_f')       # torn mid-append
+    rows = read_ledger(path)
+    assert [r["action"] for r in rows] == ["candidate_seen", "promoted"]
+    assert all("t" in r and r["candidate"] == "a" for r in rows)
+
+
+def test_judge_canary_verdicts():
+    p = Promoter(".", canary=CanaryConfig(min_requests=3,
+                                          attainment_margin=0.1,
+                                          nll_margin=0.1))
+
+    def report(c_req, f_req, c_att=1.0, f_att=1.0):
+        return {"canary": {"requests": c_req, "attainment": c_att},
+                "fleet": {"requests": f_req, "attainment": f_att}}
+
+    assert p.judge_canary(report(1, 50), None, None)[0] == "inconclusive"
+    assert p.judge_canary(report(50, 2), None, None)[0] == "inconclusive"
+    verdict, reason = p.judge_canary(report(10, 10, c_att=0.5, f_att=0.9),
+                                     None, None)
+    assert verdict == "fail" and "attainment" in reason
+    verdict, reason = p.judge_canary(report(10, 10), 2.0, 1.0)
+    assert verdict == "fail" and "nll" in reason
+    assert p.judge_canary(report(10, 10, c_att=0.85, f_att=0.9),
+                          1.05, 1.0)[0] == "pass"
+
+
+def test_event_registry_has_deploy_kinds():
+    """The telemetry registry (graftlint's telemetry-schema source of truth)
+    carries the three kinds this subsystem emits."""
+    for kind in ("data", "promote", "canary"):
+        assert kind in EVENT_KINDS
+
+
+# -----------------------------------------------------------------------------------------
+# Echo tier: the router's canary machinery
+# -----------------------------------------------------------------------------------------
+
+
+def _echo_cmd(checkpoint):
+    return ["-m", f"{PKG}.serving.replica", "--echo",
+            "--num-levels", "8", "--seq-len", "32",
+            "--num-slots", "4", "--max-pending", "8",
+            "--checkpoint", checkpoint]
+
+
+def _canary_router(tmp_path, n=3):
+    return Router(_echo_cmd("ckptA"), num_replicas=n, platform="cpu",
+                  affinity=False,
+                  heartbeat_dir=str(tmp_path / "hb"),
+                  heartbeat_timeout_s=30.0, backoff_s=0.2,
+                  drain_timeout_s=15.0,
+                  telemetry=str(tmp_path / "router.jsonl"),
+                  slo=SLOSpec.parse("ttft=5,e2e=10,window=60"),
+                  sample_completions=4)
+
+
+def _burst(router, n, base=0):
+    futs = [router.submit(np.arange(1, 5, dtype=np.int32) + (base + i) % 3,
+                          max_new_tokens=4, timeout_s=30.0)
+            for i in range(n)]
+    comps = [f.result(30.0) for f in futs]
+    assert all(c.ok for c in comps), [c.finish for c in comps]
+    return comps
+
+
+def test_canary_roll_promote_and_snapshot_schema(tmp_path):
+    """canary_reload rolls ONE replica onto the candidate (override survives
+    in its spawn command), the snapshot gains canary fields only while one is
+    active, promote_canary rewrites the fleet command and rolls the rest —
+    and the canary replica itself is NOT restarted (it already serves the
+    candidate)."""
+    router = _canary_router(tmp_path).start()
+    try:
+        assert router.wait_ready(120.0)
+        base_snap_keys = set(router.fleet_snapshot())
+        _burst(router, 9)
+        roll = router.canary_reload("ckptB", timeout_s=120.0)
+        rep = router.replicas[roll["replica"]]
+        assert rep.checkpoint_override == "ckptB"
+        restarts_before = rep.restarts
+        _burst(router, 12)
+        report = router.canary_report()
+        assert report["checkpoint"] == "ckptB"
+        assert report["canary"]["requests"] >= 1
+        assert report["fleet"]["requests"] >= 1
+        assert report["canary_samples"] and report["fleet_samples"]
+        # Samples carry full token sequences (prompt + generated).
+        s = report["canary_samples"][0]
+        assert len(s["tokens"]) >= len(s["prompt"])
+
+        snap = router.fleet_snapshot()
+        assert snap["canary"] == {"replica": roll["replica"],
+                                  "checkpoint": "ckptB"}
+        flagged = [r for r in snap["per_replica"] if r.get("canary")]
+        assert [r["replica"] for r in flagged] == [roll["replica"]]
+
+        promoted = router.promote_canary(timeout_s=240.0)
+        assert sorted(promoted["promoted"] + [promoted["canary"]]) == [0, 1, 2]
+        i = router._command.index("--checkpoint")
+        assert router._command[i + 1] == "ckptB"
+        assert all(r.checkpoint_override is None for r in router.replicas)
+        assert rep.restarts == restarts_before   # canary kept, not re-rolled
+        # Schema identical again once no canary is active.
+        assert set(router.fleet_snapshot()) == base_snap_keys
+        _burst(router, 6)
+    finally:
+        summ = router.stop()
+    assert summ["failed"] == 0
+
+
+def test_canary_rollback_restores_fleet(tmp_path):
+    router = _canary_router(tmp_path).start()
+    try:
+        assert router.wait_ready(120.0)
+        _burst(router, 6)
+        roll = router.canary_reload("ckptC", timeout_s=120.0)
+        _burst(router, 6)
+        router.rollback_canary(timeout_s=120.0)
+        i = router._command.index("--checkpoint")
+        assert router._command[i + 1] == "ckptA"
+        assert router.replicas[roll["replica"]].checkpoint_override is None
+        assert "canary" not in router.fleet_snapshot()
+        _burst(router, 6)
+    finally:
+        summ = router.stop()
+    assert summ["failed"] == 0
+
+
+def test_promoter_full_loop_on_echo_fleet(tmp_path):
+    """End-to-end promoter lifecycle against a live echo fleet: a clean
+    candidate canaries and promotes; a 'regressed' one (its canary-side
+    sampled NLL scored high by the injected scorer) canaries and rolls
+    back, leaving the fleet on last-good. Traffic runs throughout so the
+    evidence windows fill."""
+    store = _store(tmp_path, [(10, {"clean": True})])
+    inc = os.path.join(store, "ckpt_00000010.msgpack")
+    router = Router(_echo_cmd(inc), num_replicas=3, platform="cpu",
+                    affinity=False,
+                    heartbeat_dir=str(tmp_path / "hb"),
+                    heartbeat_timeout_s=30.0, backoff_s=0.2,
+                    drain_timeout_s=15.0,
+                    telemetry=str(tmp_path / "router.jsonl"),
+                    slo=SLOSpec.parse("ttft=5,e2e=10,window=60"),
+                    sample_completions=4).start()
+    stop = threading.Event()
+
+    def traffic():
+        i = 0
+        while not stop.is_set():
+            try:
+                _burst(router, 3, base=i)
+            except Exception:
+                if not stop.is_set():
+                    raise
+            i += 1
+            time.sleep(0.02)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    # The injected scorer: promoter scores canary samples first, fleet
+    # second; "bad" makes the canary side read high — what the real fixed
+    # scorer reports when a canary serves regressed params.
+    state = {"bad": False, "calls": 0}
+
+    def sample_nll_fn(samples):
+        state["calls"] += 1
+        return 3.0 if (state["bad"] and state["calls"] % 2 == 1) else 1.0
+
+    led = str(tmp_path / "ledger.jsonl")
+    tele = str(tmp_path / "promote.jsonl")
+    try:
+        assert router.wait_ready(120.0)
+        t.start()
+        time.sleep(0.5)
+        p = Promoter(store, router=router, sample_nll_fn=sample_nll_fn,
+                     canary=CanaryConfig(window_s=1.0, min_requests=2,
+                                         nll_margin=0.5),
+                     ledger_path=led, telemetry=tele, incumbent=inc)
+        good = _add(store, 20, {"clean": True})
+        assert p.run_once() == ["promoted"]
+        i = router._command.index("--checkpoint")
+        assert router._command[i + 1].endswith(good)
+
+        state["bad"] = True
+        state["calls"] = 0
+        _add(store, 30, {"clean": True})
+        assert p.run_once() == ["rolled_back"]
+        assert router._command[
+            router._command.index("--checkpoint") + 1].endswith(good)
+        assert os.path.basename(p.incumbent) == good
+        p.close()
+    finally:
+        stop.set()
+        if t.is_alive():
+            t.join(10.0)
+        summ = router.stop()
+    assert summ["failed"] == 0
+    actions = [r["action"] for r in read_ledger(led)]
+    assert actions == ["candidate_seen", "gate_pass", "canary_start",
+                       "canary_pass", "promoted", "candidate_seen",
+                       "gate_pass", "canary_start", "canary_fail",
+                       "rolled_back"]
+    # The telemetry stream alone reconstructs the trajectory.
+    events = [json.loads(line) for line in open(tele)]
+    kinds = [(e["event"], e.get("action") or e.get("verdict"))
+             for e in events]
+    assert ("promote", "promoted") in kinds
+    assert ("promote", "rolled_back") in kinds
+    assert ("canary", "pass") in kinds and ("canary", "fail") in kinds
+
+
+def test_canary_requires_quorum(tmp_path):
+    """A 1-ready fleet cannot canary (the comparison needs a fleet side),
+    and a second canary cannot start while one is active."""
+    router = _canary_router(tmp_path, n=2).start()
+    try:
+        assert router.wait_ready(120.0)
+        router.canary_reload("ckptB", timeout_s=120.0)
+        with pytest.raises(RuntimeError, match="canary"):
+            router.canary_reload("ckptC", timeout_s=120.0)
+        router.rollback_canary(timeout_s=120.0)
+    finally:
+        router.stop()

@@ -1,0 +1,202 @@
+"""Streaming corpus pipeline (DESIGN.md §26, the data half): corpus build
+determinism against the committed fixture, epoch-plan purity in ``(seed,
+epoch)``, the durable cursor's bitwise resume contract (kill mid-epoch,
+resume from the manifest cursor, remaining stream identical), cursor-drift
+detection (corpus changed under a checkpoint must RAISE, never reshuffle),
+shard integrity hashing, and the loader-stall instrumentation both loaders
+feed into the goodput ``data_wait`` segment."""
+
+import importlib.util
+import os
+import time
+
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.data import (
+    BatchLoader, Dataset,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.data.stream import (
+    CorpusError,
+    StreamLoader,
+    eval_tokens,
+    load_meta,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "corpus_tiny")
+
+
+def _load_build_corpus():
+    spec = importlib.util.spec_from_file_location(
+        "build_corpus", os.path.join(REPO, "tools", "build_corpus.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -----------------------------------------------------------------------------------------
+# Corpus build + fixture integrity
+# -----------------------------------------------------------------------------------------
+
+
+def test_build_corpus_reproduces_committed_fixture(tmp_path):
+    """The committed fixture is exact ``tools/build_corpus.py`` output: the
+    same synthetic flags rebuild it bitwise (shards AND manifest hashes).
+    If this fails, someone edited the fixture by hand or the builder's
+    determinism broke — both corrupt every cursor pinned against it."""
+    bc = _load_build_corpus()
+    out = str(tmp_path / "corpus")
+    rc = bc.main(["--out", out, "--seq-len", "64", "--shard-sequences", "48",
+                  "--eval-frac", "0.2", "--synthetic-chars", "12000",
+                  "--synthetic-seed", "7"])
+    assert rc == 0
+    ref, new = load_meta(FIXTURE), load_meta(out)
+    assert [s["sha256"] for s in new["shards"]] == \
+        [s["sha256"] for s in ref["shards"]]
+    assert new.get("eval", {}).get("sha256") == ref.get("eval", {}).get("sha256")
+    for entry in ref["shards"]:
+        with open(os.path.join(FIXTURE, entry["file"]), "rb") as fa, \
+                open(os.path.join(out, entry["file"]), "rb") as fb:
+            assert fa.read() == fb.read()
+
+
+def test_fixture_shape_contract():
+    meta = load_meta(FIXTURE)
+    assert meta["seq_len"] == 64 and meta["vocab"] == 256
+    ev = eval_tokens(FIXTURE)
+    assert ev is not None and ev.shape[1] == 64 and ev.dtype == np.int32
+    loader = StreamLoader(FIXTURE, 16, seed=1)
+    assert loader.num_sequences == sum(
+        s["sequences"] for s in meta["shards"])
+    assert loader.batches_per_epoch == loader.num_sequences // 16
+
+
+# -----------------------------------------------------------------------------------------
+# Epoch-plan purity + stream determinism
+# -----------------------------------------------------------------------------------------
+
+
+def test_epoch_plan_pure_in_seed_and_epoch():
+    a = StreamLoader(FIXTURE, 16, seed=3)
+    b = StreamLoader(FIXTURE, 16, seed=3)
+    assert a.epoch_plan(2)["crc"] == b.epoch_plan(2)["crc"]
+    assert a.epoch_plan(2)["crc"] != a.epoch_plan(3)["crc"]
+    assert (StreamLoader(FIXTURE, 16, seed=4).epoch_plan(2)["crc"]
+            != a.epoch_plan(2)["crc"])
+
+
+def test_stream_batches_shape_and_determinism():
+    a = StreamLoader(FIXTURE, 16, seed=1)
+    batches = list(a.iter_batches(0))
+    assert len(batches) == a.batches_per_epoch
+    assert all(b.shape == (16, a.seq_len) and b.dtype == np.int32
+               for b in batches)
+    b = StreamLoader(FIXTURE, 16, seed=1)
+    np.testing.assert_array_equal(a.epoch_tokens(0), b.epoch_tokens(0))
+    assert not np.array_equal(a.epoch_tokens(0), a.epoch_tokens(1))
+
+
+# -----------------------------------------------------------------------------------------
+# The cursor: bitwise resume + drift detection
+# -----------------------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("resume_batch", [1, 4, 8])
+def test_cursor_resume_bitwise_identical(resume_batch):
+    """Kill mid-epoch, resume from the manifest cursor in a FRESH loader:
+    the remaining batch stream is bitwise identical to the uninterrupted
+    one — the tentpole's deterministic-resume contract at loader level
+    (tools/train_serve_loop.py proves the same through a full trainer)."""
+    epoch = 2
+    full = StreamLoader(FIXTURE, 16, seed=1)
+    uninterrupted = full.epoch_tokens(epoch)
+    cursor = full.cursor(epoch, resume_batch)
+    resumed = StreamLoader(FIXTURE, 16, seed=1)     # a new process
+    e, b = resumed.verify_cursor(cursor)
+    assert (e, b) == (epoch, resume_batch)
+    np.testing.assert_array_equal(
+        resumed.epoch_tokens(e, start_batch=b),
+        uninterrupted[resume_batch * 16:])
+    assert (resumed.stream_digest(e, start_batch=b)
+            == StreamLoader(FIXTURE, 16, seed=1).stream_digest(
+                epoch, start_batch=resume_batch))
+
+
+def test_cursor_drift_raises():
+    loader = StreamLoader(FIXTURE, 16, seed=1)
+    good = loader.cursor(1, 3)
+    with pytest.raises(CorpusError, match="seed"):
+        loader.verify_cursor({**good, "seed": 99})
+    with pytest.raises(CorpusError, match="plan_crc"):
+        loader.verify_cursor({**good, "plan_crc": good["plan_crc"] ^ 1})
+    with pytest.raises(CorpusError, match="offset"):
+        loader.verify_cursor({**good, "offset": good["offset"] + 1})
+    with pytest.raises(CorpusError, match="version"):
+        loader.verify_cursor({**good, "version": 999})
+    with pytest.raises(CorpusError, match="stream cursor"):
+        loader.verify_cursor({"kind": "epoch"})
+
+
+def test_shard_corruption_detected(tmp_path):
+    """A corpus edited under its manifest is an error, not a reshuffle."""
+    import shutil
+    out = tmp_path / "corrupt"
+    shutil.copytree(FIXTURE, out)
+    meta = load_meta(str(out))
+    victim = out / meta["shards"][0]["file"]
+    raw = bytearray(victim.read_bytes())
+    raw[-1] ^= 0xFF
+    victim.write_bytes(bytes(raw))
+    loader = StreamLoader(str(out), 16, seed=1)
+    with pytest.raises(CorpusError, match="sha256 mismatch"):
+        loader.epoch_tokens(0)
+
+
+# -----------------------------------------------------------------------------------------
+# Stall instrumentation: the goodput data_wait input
+# -----------------------------------------------------------------------------------------
+
+
+def test_stream_loader_throttle_charges_wait():
+    """The regression this instrumentation exists for: a stalled loader must
+    show up in ``wait_s`` (the trainers charge it to the epoch event's
+    ``data_s``, goodput's ``data_wait`` segment) — not hide inside idle."""
+    loader = StreamLoader(FIXTURE, 16, seed=1, throttle_s=0.01)
+    n = sum(1 for _ in loader.iter_batches(0))
+    assert n == loader.batches_per_epoch
+    # Lower bound only: sleep() can overshoot but never undershoot.
+    accrued = loader.wait_s
+    assert accrued >= n * 0.01 * 0.9
+    assert loader.pop_wait_s() == accrued
+    assert loader.wait_s == 0.0 and loader.pop_wait_s() == 0.0
+
+
+class _SlowImages(np.ndarray):
+    """An image array whose gathers stall — the throttled-loader stand-in."""
+
+    DELAY_S = 0.004
+
+    def __getitem__(self, idx):
+        if isinstance(idx, np.ndarray):
+            time.sleep(self.DELAY_S)
+        return super().__getitem__(idx)
+
+
+def test_batchloader_stall_charges_wait(monkeypatch):
+    """BatchLoader's consumer-blocked accounting: a slow gather per batch
+    lands in ``wait_s``; ``pop_wait_s`` drains it."""
+    from csed_514_project_distributed_training_using_pytorch_tpu.data import (
+        native,
+    )
+    monkeypatch.setattr(native, "available", lambda: False)
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(64, 28, 28, 1)).astype(np.float32) \
+        .view(_SlowImages)
+    ds = Dataset(images, rng.integers(0, 10, 64).astype(np.int32), "test")
+    loader = BatchLoader(ds, 16, shuffle=True, seed=1)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert loader.wait_s >= 4 * _SlowImages.DELAY_S * 0.9
+    assert loader.pop_wait_s() > 0.0
+    assert loader.wait_s == 0.0

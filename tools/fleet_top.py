@@ -103,7 +103,8 @@ class FleetState:
             elif kind == "anomaly":
                 self.anomaly = r
             elif kind in ("scale", "replica", "eject", "hedge", "chaos",
-                          "restart", "tier", "kv_handoff"):
+                          "restart", "tier", "kv_handoff", "promote",
+                          "canary"):
                 t = r.get("t_s")
                 stamp = "-" if t is None else f"+{t:.1f}s"
                 if kind == "scale":
@@ -133,6 +134,17 @@ class FleetState:
                         self.rollbacks += 1
                     what = (f"restart ({r.get('reason')})"
                             + (f" skipping {r['skip']}" if r.get("skip")
+                               else ""))
+                elif kind == "promote":
+                    what = (f"promote {r.get('action')}: "
+                            f"{os.path.basename(r.get('candidate') or '?')}"
+                            + (f" ({r.get('reason')})" if r.get("reason")
+                               else ""))
+                elif kind == "canary":
+                    what = (f"canary {r.get('verdict')} on replica "
+                            f"{r.get('replica')}: "
+                            f"{os.path.basename(r.get('candidate') or '?')}"
+                            + (f" ({r.get('reason')})" if r.get("reason")
                                else ""))
                 else:
                     what = (f"replica {r.get('replica')} {r.get('action')}"

@@ -505,6 +505,55 @@ def summarize(path: str) -> dict:
         s["preempted_step"] = preempts[-1].get("step")
         s["preempted_ckpt"] = preempts[-1].get("checkpoint")
 
+    # Streaming-loader ledger (data/stream.py per-epoch "data" events): how
+    # many sequences the run consumed, the consumer's total stall behind the
+    # prefetcher (the goodput ``data_wait`` input), and the per-epoch stream
+    # CRCs the deterministic-resume tests pin across a kill/resume boundary.
+    data_evs = by_event.get("data", [])
+    if data_evs:
+        s["data_epochs"] = len(data_evs)
+        s["data_sequences"] = sum(e.get("sequences") or 0 for e in data_evs)
+        s["data_wait_s"] = sum(e.get("wait_s") or 0.0 for e in data_evs)
+        s["data_throttle_s"] = max((e.get("throttle_s") or 0.0)
+                                   for e in data_evs)
+        digests = [e.get("stream_digest") for e in data_evs]
+        if any(d is not None for d in digests):
+            s["stream_digests"] = digests
+
+    # Continuous-deployment lifecycle (deploy/promoter.py "promote"/"canary"
+    # events): verdict counts plus the ordered timeline — who was seen, who
+    # failed which gate by what measured margin, who canaried on which
+    # replica against what fleet evidence, and what the fleet rolled to.
+    promos = by_event.get("promote", [])
+    canary_evs = by_event.get("canary", [])
+    if promos or canary_evs:
+        by_action: dict = {}
+        for ev in promos:
+            by_action[ev.get("action")] = by_action.get(ev.get("action"), 0) + 1
+        s["promote_actions"] = by_action
+        s["promotions"] = by_action.get("promoted", 0)
+        s["promote_rollbacks"] = by_action.get("rolled_back", 0)
+        timeline = [
+            {"t_s": ev.get("t_s"), "kind": "promote",
+             "action": ev.get("action"),
+             "candidate": os.path.basename(ev.get("candidate") or "?"),
+             "reason": ev.get("reason"),
+             "nll": ev.get("nll"), "incumbent_nll": ev.get("incumbent_nll")}
+            for ev in promos
+        ] + [
+            {"t_s": ev.get("t_s"), "kind": "canary",
+             "action": f"canary_{ev.get('verdict')}",
+             "candidate": os.path.basename(ev.get("candidate") or "?"),
+             "replica": ev.get("replica"), "reason": ev.get("reason"),
+             "canary_attainment": ev.get("canary_attainment"),
+             "fleet_attainment": ev.get("fleet_attainment"),
+             "canary_nll": ev.get("canary_nll"),
+             "fleet_nll": ev.get("fleet_nll")}
+            for ev in canary_evs
+        ]
+        timeline.sort(key=lambda r: (r["t_s"] is None, r["t_s"] or 0.0))
+        s["promotion_timeline"] = timeline
+
     # Loss-curve metrics.jsonl rows (the companion artifact) — final losses.
     for kind, key in (("train", "final_train_loss"), ("test", "final_val_loss")):
         pts = [r for r in by_event.get(kind, []) if "loss" in r]
@@ -562,6 +611,37 @@ def print_summary(s: dict) -> None:
     if s.get("preempted_step") is not None:
         ck = f" -> {s['preempted_ckpt']}" if s.get("preempted_ckpt") else ""
         print(f"   preempted at step {s['preempted_step']}{ck}")
+    if s.get("data_epochs"):
+        thr = (f"  (throttled {_fmt(s['data_throttle_s'])}s/batch)"
+               if s.get("data_throttle_s") else "")
+        dig = ""
+        if s.get("stream_digests"):
+            shown = [d for d in s["stream_digests"] if d is not None]
+            dig = (f"  digests {' '.join(f'{d:08x}' for d in shown[:4])}"
+                   + (" ..." if len(shown) > 4 else ""))
+        print(f"   data: {s['data_epochs']} streamed epoch(s), "
+              f"{_fmt(s['data_sequences'])} sequences  "
+              f"loader wait {_fmt(s['data_wait_s'])}s{thr}{dig}")
+    if s.get("promotion_timeline"):
+        acts = s.get("promote_actions") or {}
+        print(f"   promotion: {s.get('promotions', 0)} promoted, "
+              f"{acts.get('gate_fail', 0)} gate failure(s), "
+              f"{s.get('promote_rollbacks', 0)} rollback(s)")
+        for e in s["promotion_timeline"]:
+            t = "-" if e["t_s"] is None else f"+{e['t_s']:.2f}s"
+            if e["kind"] == "canary":
+                ctx = (f"  replica {_fmt(e.get('replica'))}  attainment "
+                       f"{_fmt(e.get('canary_attainment'))} vs fleet "
+                       f"{_fmt(e.get('fleet_attainment'))}  nll "
+                       f"{_fmt(e.get('canary_nll'))} vs fleet "
+                       f"{_fmt(e.get('fleet_nll'))}")
+            else:
+                ctx = ("" if e.get("nll") is None else
+                       f"  nll {_fmt(e['nll'])} vs incumbent "
+                       f"{_fmt(e.get('incumbent_nll'))}")
+            print(f"     {t.rjust(9)}  {(e['action'] or '?').ljust(14)} "
+                  f"{e['candidate']}"
+                  + (f" [{e['reason']}]" if e.get("reason") else "") + ctx)
     for b in s.get("bench", []):
         extra = "".join(f"  {k} {_fmt(b[k])}" for k in ("examples_per_s", "mfu")
                         if b.get(k) is not None)
@@ -728,6 +808,9 @@ COMPARE_ROWS = [
     ("anomalies", "anomalies"),
     ("skipped steps", "skipped_steps"),
     ("rollbacks", "rollbacks"),
+    ("data wait s", "data_wait_s"),
+    ("promotions", "promotions"),
+    ("promote rollbacks", "promote_rollbacks"),
     ("goodput frac", "goodput_frac"),
     ("restart badput s", "restart_badput_s"),
     ("rollback badput s", "rollback_badput_s"),
