@@ -749,6 +749,210 @@ def reset_slots(cache: dict, fresh: jax.Array) -> dict:
     return jax.tree_util.tree_map(wipe, cache)
 
 
+# =============================================================================
+# Paged KV cache (DESIGN.md §27): the serving cache as a fixed page pool
+# =============================================================================
+#
+# The contiguous serving cache above prices every slot at worst-case context —
+# ``[num_slots, S]`` planes whether a request uses 8 tokens or all S. The paged
+# layout replaces those planes with per-layer PAGE POOLS
+# ``[num_pages, page_size, KV_H, Dh]`` plus ONE page table ``[B, P_max]``
+# (int32, ``P_max = ceil(S / page_size)``) carried as DATA into every jitted
+# call: slot ``b``'s logical position ``p`` lives at
+# ``pool[table[b, p // page_size], p % page_size]``. Slot count decouples from
+# max context — the pool is sized for the tokens actually resident, and
+# prefix-cache hits / park / resume become page refcount bumps in the host
+# allocator (``serving/pagepool.py``) instead of whole-plane copies.
+#
+# The paged model functions below are ADAPTERS over the contiguous trio, not
+# re-implementations: gather the table's view (``pool[table] → [B, S, ...]``),
+# run the EXISTING function on that view, then scatter the rows it wrote back
+# into the pool at their ``(page, offset)`` coordinates. Every arithmetic op —
+# projections, quantize-on-write scales, masked einsums, softmax — is the same
+# traced code, so greedy decode is token-IDENTICAL to the contiguous oracle by
+# construction (pinned across the engine matrix in tests/test_paged_kv.py),
+# and a math edit to the contiguous path cannot drift from the paged one.
+# Masked garbage is the one place the layouts differ (a fresh slot's gathered
+# view shows recycled-page junk where the contiguous plane shows zeros), and
+# it is harmless by the same argument ``reset_slots`` documents: every masked
+# score becomes ``MASK_VALUE`` exactly, its softmax weight underflows to 0.0,
+# and ``0 · finite == 0`` — the pool never holds non-finite values (every page
+# starts zeroed and only ever receives projected rows/scales). Paged mode
+# therefore needs NO wipe-on-recycle at all.
+#
+# Unmapped table entries point at the allocator's reserved NULL page, so the
+# fixed-shape programs' out-of-reservation writes (a parked slot's decode row,
+# verify rows past a short reservation) land somewhere harmless instead of in
+# a neighbour's page. The engine's reservation-at-admission invariant
+# guarantees every position ``<= t`` of a LIVE slot is mapped, which is all
+# the visibility mask ever reads.
+#
+# ``ops/paged_attention.py`` holds the TPU decode kernel (page-table-steered
+# gather-attend with the dequant fused in, scalar-prefetch table); these
+# adapters are its pure-XLA gather fallback and the tier-1 identity oracle.
+
+# Axis semantics of the pool planes, by leaf name — the paged counterpart of
+# KV_PLANE_AXES, mapped onto the serve mesh by serving/shard.py (pages are
+# slot-owned -> slot-DP axis; KV heads -> TP axis, same as contiguous).
+PAGE_PLANE_AXES: dict[str, tuple[str, ...]] = {
+    "k": ("page", "offset", "kv_head", "head_dim"),
+    "v": ("page", "offset", "kv_head", "head_dim"),
+    "k_scale": ("page", "offset", "kv_head"),
+    "v_scale": ("page", "offset", "kv_head"),
+}
+
+
+def pages_per_slot(seq_len: int, page_size: int) -> int:
+    """P_max — the page-table width that can map a full-context slot."""
+    if not 0 < page_size:
+        raise ValueError(f"page_size must be positive, got {page_size}")
+    return -(-seq_len // page_size)
+
+
+def init_page_pool(model: TransformerLM, num_pages: int, *, page_size: int,
+                   kv_dtype: str | None = None) -> dict:
+    """Zeroed per-layer page pools ``[num_pages, page_size, KV_H, Dh]`` —
+    ``init_cache``'s paged twin, same dtype/scale-plane rules (``kv_dtype``
+    int8/fp8 adds ``k_scale``/``v_scale`` pools ``[num_pages, page_size,
+    KV_H]`` f32). Total token capacity is ``num_pages * page_size`` split
+    however the allocator hands out pages — the knob that decouples slot
+    count from max context."""
+    head_dim = model.embed_dim // model.num_heads
+    kvh = model.num_kv_heads or model.num_heads
+    shape = (num_pages, page_size, kvh, head_dim)
+    dtype, scaled = quant_ops.resolve_kv_dtype(kv_dtype or "model", model.dtype)
+
+    def layer():
+        planes = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        if scaled:
+            planes["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+            planes["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        return planes
+
+    return {f"block_{i}": layer() for i in range(model.num_layers)}
+
+
+def pool_page_size(pool: dict) -> int:
+    """The pool's static page size, read off a K plane (one owner — callers
+    never carry it separately and drift)."""
+    return pool["block_0"]["k"].shape[1]
+
+
+def _gather_view(pool: dict, table: jax.Array, seq_len: int) -> dict:
+    """Materialize each slot's logical ``[S]`` cache view through the table:
+    ``pool[table] → [B, P_max·ps, ...]`` truncated to ``[B, S, ...]``. The
+    view is positionally identical to the contiguous plane at every mapped
+    position; unmapped positions show null/recycled-page garbage the masks
+    hide (module comment above)."""
+    b, p_max = table.shape
+
+    def leaf(x):
+        ps = x.shape[1]
+        v = x[table]                                   # [B, P, ps, ...]
+        return v.reshape((b, p_max * ps) + x.shape[2:])[:, :seq_len]
+
+    return jax.tree_util.tree_map(leaf, pool)
+
+
+def paged_decode_step_slots(model: TransformerLM, params, pool: dict,
+                            table: jax.Array, ids_t: jax.Array, t: jax.Array
+                            ) -> tuple[dict, jax.Array]:
+    """``decode_step_slots`` through a page table: ``pool`` per
+    ``init_page_pool``, ``table: [B, P_max]`` int32 (data — the zero-retrace
+    property extends to ANY page assignment), ``ids_t``/``t`` as contiguous.
+
+    Gathers the table's view, runs the contiguous step on it (identical math,
+    including quantize-on-write when scale pools are present), then scatters
+    each slot's one written row back to ``(table[b, t//ps], t % ps)``. Slots
+    whose table rows are null-mapped (inactive/parked) write their row into
+    the null page — harmless by the reservation invariant."""
+    b = ids_t.shape[0]
+    s = model.seq_len
+    ps = pool_page_size(pool)
+    view = _gather_view(pool, table, s)
+    new_view, log_probs = decode_step_slots(model, params, view, ids_t, t)
+
+    safe_t = jnp.clip(t, 0, s - 1)      # decode's write clamps the same way
+    pages = table[jnp.arange(b), safe_t // ps]                   # [B]
+    offs = safe_t % ps
+
+    def put(pool_leaf, view_leaf):
+        rows = view_leaf[jnp.arange(b), safe_t]                  # [B, ...]
+        return pool_leaf.at[pages, offs].set(rows)
+
+    new_pool = jax.tree_util.tree_map(put, pool, new_view)
+    return new_pool, log_probs
+
+
+def paged_prefill_chunk(model: TransformerLM, params, pool: dict,
+                        table: jax.Array, prompt: jax.Array, slot: jax.Array,
+                        start: jax.Array, length: jax.Array, *,
+                        chunk: int) -> dict:
+    """``prefill_chunk`` through a page table — gathers only the ONE slot's
+    view (``[1, S, ...]``, so per-chunk cost stays O(S) not O(B·S)), runs the
+    contiguous chunk on it at batch index 0, and scatters the chunk's valid
+    rows to their pages. No ``fresh`` wipe: paged slots never need one
+    (module comment above)."""
+    s = model.seq_len
+    ps = pool_page_size(pool)
+    p_max = table.shape[1]
+    row_table = table[slot]                                      # [P_max]
+
+    def leaf(x):
+        v = x[row_table]                                         # [P, ps, ...]
+        return v.reshape((p_max * ps,) + x.shape[2:])[:s][None]  # [1, S, ...]
+
+    view = jax.tree_util.tree_map(leaf, pool)
+    new_view = prefill_chunk(model, params, view, prompt[slot][None],
+                             jnp.int32(0), start, length,
+                             jnp.asarray(False), chunk=chunk)
+
+    positions = start + jnp.arange(chunk, dtype=jnp.int32)       # [C]
+    valid = (jnp.arange(chunk) < length) & (positions < s)
+    safe_pos = jnp.clip(positions, 0, s - 1)
+    page_of = row_table[safe_pos // ps]                          # [C]
+    offs = safe_pos % ps
+
+    def put(pool_leaf, view_leaf):
+        rows = view_leaf[0, safe_pos]                            # [C, ...]
+        pages = jnp.where(valid, page_of, pool_leaf.shape[0])    # OOB → drop
+        return pool_leaf.at[pages, offs].set(rows, mode="drop")
+
+    return jax.tree_util.tree_map(put, pool, new_view)
+
+
+def paged_verify_chunk(model: TransformerLM, params, pool: dict,
+                       table: jax.Array, ids: jax.Array, t: jax.Array,
+                       draft: jax.Array, *, k: int
+                       ) -> tuple[dict, jax.Array]:
+    """``verify_chunk`` through a page table: full gather (verify reads every
+    slot's cache, like decode), contiguous verify on the view, then a bulk
+    ``[B, k+1]``-row scatter. Rows past ``seq_len`` drop; rows past a slot's
+    reservation land in the null page — both rewritten-before-visible, same
+    rollback argument as the contiguous docstring."""
+    b = ids.shape[0]
+    s = model.seq_len
+    ps = pool_page_size(pool)
+    w = k + 1
+    view = _gather_view(pool, table, s)
+    new_view, log_probs = verify_chunk(model, params, view, ids, t, draft, k=k)
+
+    positions = t[:, None] + jnp.arange(w, dtype=jnp.int32)      # [B, W]
+    safe_pos = jnp.clip(positions, 0, s - 1)
+    in_range = positions < s
+    page_of = jnp.take_along_axis(table, safe_pos // ps, axis=1)  # [B, W]
+    offs = safe_pos % ps
+    slot_idx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, w))
+
+    def put(pool_leaf, view_leaf):
+        rows = view_leaf[slot_idx, safe_pos]                     # [B, W, ...]
+        pages = jnp.where(in_range, page_of, pool_leaf.shape[0])
+        return pool_leaf.at[pages, offs].set(rows, mode="drop")
+
+    new_pool = jax.tree_util.tree_map(put, pool, new_view)
+    return new_pool, log_probs
+
+
 def filter_logits(log_probs: jax.Array, *, top_k: int = 0,
                   top_p: float = 1.0) -> jax.Array:
     """Mask ``[..., V]`` logits outside the top-k set and/or the top-p nucleus.

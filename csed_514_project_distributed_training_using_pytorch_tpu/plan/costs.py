@@ -386,7 +386,9 @@ class ServeCostBreakdown:
 def predict_serve(stats: ServeStats, topo: Topology, *, tp: int, dp: int,
                   num_slots: int, prompt_len: int,
                   ttft_slo_s: float | None = None,
-                  hbm_fraction: float = 0.9) -> ServeCostBreakdown:
+                  hbm_fraction: float = 0.9,
+                  kv_layout: str = "contiguous", page_size: int = 64,
+                  context_tokens: int | None = None) -> ServeCostBreakdown:
     """Price one TP×(slot-DP) serve mesh.
 
     Residency follows ``serving/shard.py``'s byte-true accounting exactly:
@@ -397,11 +399,33 @@ def predict_serve(stats: ServeStats, topo: Topology, *, tp: int, dp: int,
     every resident slot — plus Megatron-style per-layer TP all-reduces of the
     step's activations. TTFT is the compute-bound prefill of one
     ``prompt_len`` prompt on one dp group (slot-DP doesn't speed up a single
-    request — exactly why the disaggregated prefill tier exists)."""
+    request — exactly why the disaggregated prefill tier exists).
+
+    ``kv_layout="paged"`` prices page-pool residency instead of whole-context
+    planes: a slot serving ``context_tokens`` (default: the full ``seq_len`` —
+    the conservative pin) holds ``pages_for(context, page_size)`` pages, so
+    both the per-slot HBM charge and the decode step's KV stream shrink to the
+    page span actually reserved (the fused kernel's dead-page fetch elision
+    makes the stream term real, not aspirational). The page-count formula is
+    ``serving.pagepool.pages_for`` — the engine's own reservation math — and
+    the contiguous default leaves every number bitwise unchanged."""
+    if kv_layout not in ("contiguous", "paged"):
+        raise ValueError(f"unknown kv_layout {kv_layout!r} "
+                         f"(want 'contiguous' or 'paged')")
+    kv_bytes_slot = stats.kv_bytes_per_slot
+    if kv_layout == "paged":
+        from csed_514_project_distributed_training_using_pytorch_tpu.serving.pagepool import (
+            pages_for,
+        )
+
+        ctx = min(int(context_tokens or stats.seq_len), stats.seq_len)
+        ps = max(1, min(int(page_size), stats.seq_len))
+        kv_bytes_slot = (stats.kv_bytes_per_slot / max(stats.seq_len, 1)
+                         * pages_for(ctx, ps) * ps)
     group_slots = max(num_slots // max(dp, 1), 1)
     params_pc = (stats.param_bytes * stats.shardable_fraction / tp
                  + stats.param_bytes * (1.0 - stats.shardable_fraction))
-    kv_slot_pc = stats.kv_bytes_per_slot / tp
+    kv_slot_pc = kv_bytes_slot / tp
     kv_pc = kv_slot_pc * group_slots
     prompt_pc = stats.prompt_bytes_per_slot * group_slots
     total_pc = params_pc + kv_pc + prompt_pc

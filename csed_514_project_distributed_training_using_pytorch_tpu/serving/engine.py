@@ -67,6 +67,11 @@ from csed_514_project_distributed_training_using_pytorch_tpu.ops.attention impor
 from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
     shard as shard_mod,
 )
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.pagepool import (
+    PagePool,
+    PagePoolExhausted,
+    pages_for,
+)
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.prefix_cache import (
     PrefixCache,
 )
@@ -107,6 +112,29 @@ class Completion:
     @property
     def ok(self) -> bool:
         return self.finish == "ok"
+
+
+class KVPagesExhausted(RuntimeError):
+    """Typed admission backpressure from the paged KV store: the page pool
+    could not cover every requested reservation. Raised by ``admit_many``
+    AFTER binding what fit — never mid-decode, never as a device OOM.
+
+    ``admitted`` holds the ``(slot, request)`` pairs this call DID bind (they
+    are in flight and will drain normally); ``refused`` the original items
+    (``Request``/``Parked``, FIFO order) left unbound with their slots free —
+    requeue them and retry once decode frees pages. ``needed``/``free`` carry
+    the first refusal's shortfall for logs and tests."""
+
+    def __init__(self, admitted: list, refused: list,
+                 cause: PagePoolExhausted):
+        self.admitted = admitted
+        self.refused = refused
+        self.needed = cause.needed
+        self.free = cause.free
+        super().__init__(
+            f"kv page pool exhausted: {len(refused)} admission(s) refused "
+            f"(first needs {cause.needed} pages, {cause.free} free), "
+            f"{len(admitted)} admitted — requeue and retry after a drain")
 
 
 def filter_logits_per_slot(log_probs: jax.Array, top_k: jax.Array,
@@ -176,8 +204,12 @@ class ContinuousBatchingEngine:
                  prefill_chunk_sizes: tuple[int, ...] = lm_mod.PREFILL_CHUNK_SIZES,
                  prefill_chunk_budget: int = 1,
                  prefix_cache_entries: int = 0,
+                 prefix_cache_bytes: int | None = None,
                  kv_dtype: str = "model",
                  quant_policy: str = "off",
+                 kv_layout: str = "contiguous",
+                 page_size: int = 64,
+                 num_pages: int | None = None,
                  spec: str = "off",
                  spec_k: int = 4,
                  drafter: Drafter | None = None,
@@ -219,22 +251,78 @@ class ContinuousBatchingEngine:
         self.preemptions = 0          # mid-decode slots parked (priority pressure)
         self.resumes = 0              # parked requests re-admitted
         self._key = jax.random.PRNGKey(seed)
-        self._cache = lm_mod.init_cache(model, self.num_slots,
-                                        kv_dtype=self.quant.kv_dtype)
-        # The plane-layout signature (dtypes + scale-plane structure): stamped
-        # on every prefix-cache snapshot and checked on every lookup, so planes
-        # written under a different dtype policy can never install here.
-        self.plane_layout = quant_ops.cache_layout(self._cache)
+        # --- KV store layout ------------------------------------------------
+        # "contiguous" is the legacy per-slot planes ([num_slots, S, KV_H, Dh],
+        # every slot priced at worst-case context); "paged" rebuilds the store
+        # as fixed-size page pools ([num_pages, page_size, KV_H, Dh]) with a
+        # host allocator (serving/pagepool.py) and a per-slot page table
+        # carried as DATA into every jitted call — slot count decouples from
+        # max context, and prefix hits / park / resume become page refcount
+        # bumps instead of whole-plane copies (DESIGN.md §27).
+        if kv_layout not in ("contiguous", "paged"):
+            raise ValueError(f"unknown kv_layout {kv_layout!r} "
+                             f"(choices: contiguous, paged)")
+        self.kv_layout = kv_layout
+        self._pagepool: PagePool | None = None
+        self._table: np.ndarray | None = None
+        dp = mesh.dp if mesh is not None else 1
+        if kv_layout == "paged":
+            if not tuple(prefill_chunk_sizes or ()):
+                raise ValueError("the paged KV layout rides the chunked-"
+                                 "prefill path — enable prefill_chunk_sizes "
+                                 "to use it")
+            # Page size clips to seq_len (a tiny test model never pages wider
+            # than its context); default pool capacity matches the contiguous
+            # layout token-for-token (group_slots full-context reservations
+            # per dp group, plus each group's null page) so the default is a
+            # pure layout change, not a capacity change.
+            ps = max(1, min(int(page_size), model.seq_len))
+            p_max = lm_mod.pages_per_slot(model.seq_len, ps)
+            if num_pages is None:
+                group_slots = max(self.num_slots // dp, 1)
+                num_pages = dp * (group_slots * p_max + 1)
+            self.page_size = ps
+            self._pagepool = PagePool(int(num_pages), page_size=ps, groups=dp)
+            self._cache = lm_mod.init_page_pool(
+                model, int(num_pages), page_size=ps,
+                kv_dtype=self.quant.kv_dtype)
+            # Paged snapshots are page-id payloads, not planes — a distinct
+            # layout signature keeps them from ever installing into a
+            # contiguous engine (and vice versa), same guard as dtype.
+            self.plane_layout = (f"paged:{ps}:"
+                                 + quant_ops.cache_layout(self._cache))
+            self._table = np.empty((self.num_slots, p_max), np.int32)
+            for i in range(self.num_slots):
+                self._table[i, :] = self._pagepool.null_page(
+                    self._slot_group(i))
+            self._slot_pages: list[list[int]] = \
+                [[] for _ in range(self.num_slots)]
+            self.cow_copies = 0            # boundary-page copy-on-writes
+            self.cow_trace_count = 0       # traces of the COW program (pin <= 1)
+        else:
+            self.page_size = None
+            self._cache = lm_mod.init_cache(model, self.num_slots,
+                                            kv_dtype=self.quant.kv_dtype)
+            # The plane-layout signature (dtypes + scale-plane structure):
+            # stamped on every prefix-cache snapshot and checked on every
+            # lookup, so planes written under a different dtype policy can
+            # never install here.
+            self.plane_layout = quant_ops.cache_layout(self._cache)
         self._cache_shardings = None
         if mesh is not None:
             # Placement IS the sharding story: params by the train-side TP
             # rules (heads column-parallel, projections row-parallel), KV and
             # scale planes over slot(data)×kv_head(model) per
-            # models.lm.KV_PLANE_AXES. Donated steps keep the placement.
+            # models.lm.KV_PLANE_AXES — or, paged, pages(data)×kv_head(model)
+            # per PAGE_PLANE_AXES (the allocator's group partitioning keeps
+            # every slot's pages inside its dp group's shard). Donated steps
+            # keep the placement.
             self.params = jax.device_put(
                 self.params, shard_mod.param_shardings(self.params, mesh))
-            self._cache_shardings = shard_mod.cache_shardings(self._cache,
-                                                              mesh)
+            self._cache_shardings = (
+                shard_mod.pool_shardings(self._cache, mesh)
+                if self._pagepool is not None
+                else shard_mod.cache_shardings(self._cache, mesh))
             self._cache = jax.device_put(self._cache, self._cache_shardings)
         b, s = self.num_slots, model.seq_len
         self._ids = np.full((b,), model.vocab_size - 1, np.int32)   # BOS
@@ -291,18 +379,23 @@ class ContinuousBatchingEngine:
             raise ValueError(f"prefill_chunk_budget must be >= 1, "
                              f"got {prefill_chunk_budget}")
         self.prefill_chunk_budget = int(prefill_chunk_budget)
-        if prefix_cache_entries and not self.prefill_chunk_sizes:
+        if (prefix_cache_entries or prefix_cache_bytes) \
+                and not self.prefill_chunk_sizes:
             raise ValueError("the prefix cache rides the chunked-prefill path — "
                              "enable prefill_chunk_sizes to use it")
-        self.prefix_cache = (PrefixCache(prefix_cache_entries,
-                                         layout=self.plane_layout)
-                             if prefix_cache_entries else None)
+        self._prefix_cache_entries = int(prefix_cache_entries)
+        self._prefix_cache_bytes = (None if prefix_cache_bytes is None
+                                    else int(prefix_cache_bytes))
+        self.prefix_cache = self._build_prefix_cache()
         self.prefill_invocations = 0  # chunk-program executions
         self.prefill_tokens = 0       # prompt tokens prefilled (cache hits excluded)
         self.prefill_wall_s = 0.0     # host wall across completed prefills
         self.prefill_trace_counts: dict[int, int] = {}   # per-size (pin <= 1 each)
+        _prefill_fn = (self._paged_prefill_program
+                       if self._pagepool is not None
+                       else self._prefill_program)
         self._prefill_jits = {
-            c: jax.jit(functools.partial(self._prefill_program, c),
+            c: jax.jit(functools.partial(_prefill_fn, c),
                        donate_argnums=(1,))
             for c in self.prefill_chunk_sizes}
         self._pending_chunks: list[list[tuple[int, int, int]]] = \
@@ -372,8 +465,11 @@ class ContinuousBatchingEngine:
             self.drafter.bind(num_slots=self.num_slots,
                               vocab_size=model.vocab_size,
                               seq_len=model.seq_len)
+            _verify_fn = (self._paged_verify_program
+                          if self._pagepool is not None
+                          else self._verify_program)
             self._verify_jits[self.spec_k] = jax.jit(
-                functools.partial(self._verify_program, self.spec_k),
+                functools.partial(_verify_fn, self.spec_k),
                 donate_argnums=(1,))
         # Snapshot/install stay ONE fixed-shape program each under a mesh, but
         # with EXPLICIT shardings (the sharded-snapshot bugfix): a snapshot
@@ -383,18 +479,32 @@ class ContinuousBatchingEngine:
         # shardings. Without the annotations GSPMD would be free to leave the
         # export sharded over heads, and every np.asarray on it would be a
         # cross-device gather at an unplanned point (or a crash multi-host).
-        self._install_jit = jax.jit(
-            self._install_program, donate_argnums=(0,),
-            **({} if mesh is None
-               else {"out_shardings": self._cache_shardings}))
-        self._snapshot_jit = jax.jit(
-            lambda cache, slot: jax.tree_util.tree_map(lambda c: c[slot], cache),
-            **({} if mesh is None
-               else {"out_shardings": mesh.replicated()}))
+        if self._pagepool is None:
+            self._install_jit = jax.jit(
+                self._install_program, donate_argnums=(0,),
+                **({} if mesh is None
+                   else {"out_shardings": self._cache_shardings}))
+            self._snapshot_jit = jax.jit(
+                lambda cache, slot: jax.tree_util.tree_map(
+                    lambda c: c[slot], cache),
+                **({} if mesh is None
+                   else {"out_shardings": mesh.replicated()}))
+        else:
+            # Paged mode has no snapshot/install: sharing is a host-side
+            # refcount bump, and the only device copy left is the boundary-
+            # page copy-on-write — ONE fixed-shape program
+            # (``cow_trace_count`` pins it).
+            self._cow_jit = jax.jit(
+                self._cow_program, donate_argnums=(0,),
+                **({} if mesh is None
+                   else {"out_shardings": self._cache_shardings}))
         # The cache (arg 1 after params) is donated: each step's updated cache
         # reuses the previous buffer instead of allocating a second full copy —
         # on the serving path the KV cache IS the memory footprint.
-        self._step_jit = jax.jit(self._step_program, donate_argnums=(1,))
+        self._step_jit = jax.jit(
+            self._paged_step_program if self._pagepool is not None
+            else self._step_program,
+            donate_argnums=(1,))
 
     # ------------------------------------------------------------------ program
 
@@ -415,6 +525,15 @@ class ContinuousBatchingEngine:
                              lambda c: lm_mod.reset_slots(c, fresh),
                              lambda c: c, cache)
         cache, log_probs = lm_mod.decode_step_slots(model, params, cache, ids, t)
+        return cache, self._sample_token(log_probs, t, prompt, prompt_len,
+                                         temp, top_k, top_p, key)
+
+    def _sample_token(self, log_probs, t, prompt, prompt_len, temp, top_k,
+                      top_p, key):
+        """The decode program's emission tail (shared verbatim by the paged
+        step program, so the two layouts cannot drift): BOS mask, per-slot
+        sampling, prompt forcing."""
+        model = self.model
         # BOS is input-only, exactly as in generate() — mask it before any rule.
         log_probs = log_probs.at[:, model.vocab_size - 1].set(MASK_VALUE)
         safe_temp = jnp.where(temp > 0.0, temp, 1.0)
@@ -425,7 +544,22 @@ class ContinuousBatchingEngine:
         tok = jnp.where(temp > 0.0, sampled, greedy)
         forced = jnp.take_along_axis(
             prompt, jnp.clip(t, 0, model.seq_len - 1)[:, None], axis=1)[:, 0]
-        return cache, jnp.where(t < prompt_len, forced, tok).astype(jnp.int32)
+        return jnp.where(t < prompt_len, forced, tok).astype(jnp.int32)
+
+    def _paged_step_program(self, params, pool, table, ids, t, prompt,
+                            prompt_len, temp, top_k, top_p, key):
+        """THE decode program, paged layout: ``models.lm.paged_decode_step_slots``
+        through the page table (data — any page assignment reuses this one
+        trace), then the exact emission tail of the contiguous program. No
+        ``fresh`` wipe: recycled pages hold only finite projected rows, and
+        every masked score becomes ``MASK_VALUE`` exactly (the masked-garbage
+        argument in models/lm.py) — so greedy decode is token-identical to the
+        contiguous program by construction."""
+        self.trace_count += 1         # Python side effect: fires per TRACE only
+        pool, log_probs = lm_mod.paged_decode_step_slots(
+            self.model, params, pool, table, ids, t)
+        return pool, self._sample_token(log_probs, t, prompt, prompt_len,
+                                        temp, top_k, top_p, key)
 
     def _verify_program(self, k, params, cache, ids, t, fresh, draft,
                         draft_len, temp, top_k, top_p, key):
@@ -461,6 +595,16 @@ class ContinuousBatchingEngine:
                              lambda c: c, cache)
         cache, logp = lm_mod.verify_chunk(model, params, cache, ids, t,
                                           draft, k=k)
+        tokens, counts = self._accept_fold(k, logp, draft, draft_len, temp,
+                                           top_k, top_p, key)
+        return cache, tokens, counts
+
+    def _accept_fold(self, k, logp, draft, draft_len, temp, top_k, top_p,
+                     key):
+        """The verify program's on-device accept rule (shared verbatim by the
+        paged verify program): greedy prefix-match or exact rejection
+        sampling, emitting ``(tokens [B, k+1], counts [B])``."""
+        model = self.model
         # BOS is input-only, exactly as in the decode program.
         logp = logp.at[:, :, model.vocab_size - 1].set(MASK_VALUE)
         b, w, v = logp.shape
@@ -499,7 +643,20 @@ class ContinuousBatchingEngine:
                                 jnp.concatenate([draft, pad], axis=1),
                                 stop_tok)
         tokens = jnp.where((temp > 0.0)[:, None], sampled_tok, greedy_tok)
-        return cache, tokens.astype(jnp.int32), counts.astype(jnp.int32)
+        return tokens.astype(jnp.int32), counts.astype(jnp.int32)
+
+    def _paged_verify_program(self, k, params, pool, table, ids, t, draft,
+                              draft_len, temp, top_k, top_p, key):
+        """THE speculative step, paged layout: ``models.lm.paged_verify_chunk``
+        through the page table, then the contiguous program's exact accept
+        fold. No ``fresh`` wipe (same masked-garbage argument as the paged
+        decode program)."""
+        self.verify_trace_counts[k] = self.verify_trace_counts.get(k, 0) + 1
+        pool, logp = lm_mod.paged_verify_chunk(self.model, params, pool,
+                                               table, ids, t, draft, k=k)
+        tokens, counts = self._accept_fold(k, logp, draft, draft_len, temp,
+                                           top_k, top_p, key)
+        return pool, tokens, counts
 
     def _prefill_program(self, chunk, params, cache, prompt, slot, start, length,
                          fresh):
@@ -528,6 +685,129 @@ class ContinuousBatchingEngine:
         return jax.tree_util.tree_map(
             lambda c, pl: jax.lax.dynamic_update_index_in_dim(c, pl, slot, 0),
             cache, planes)
+
+    def _paged_prefill_program(self, chunk, params, pool, table, prompt, slot,
+                               start, length):
+        """One chunked-prefill invocation, paged layout
+        (``models.lm.paged_prefill_chunk``): same static-chunk contract as the
+        contiguous program, no ``fresh`` (paged slots never wipe)."""
+        self.prefill_trace_counts[chunk] = \
+            self.prefill_trace_counts.get(chunk, 0) + 1
+        return lm_mod.paged_prefill_chunk(self.model, params, pool, table,
+                                          prompt, slot, start, length,
+                                          chunk=chunk)
+
+    def _cow_program(self, pool, dst, src):
+        """Copy-on-write: duplicate ONE page (every leaf's rows and scales)
+        into a freshly allocated page. The only device copy sharing ever pays
+        in paged mode — a prefix hit whose boundary lands mid-page copies
+        that one page so the new slot's writes can't corrupt the shared
+        entry; full pages are shared by refcount alone."""
+        self.cow_trace_count += 1
+        return jax.tree_util.tree_map(lambda x: x.at[dst].set(x[src]), pool)
+
+    # ------------------------------------------------------------------ paging
+
+    def _slot_group(self, slot: int) -> int:
+        """The dp group owning ``slot`` — and therefore the allocator group
+        its pages must come from (pages never cross dp shards)."""
+        return slot // max(self.num_slots // self._pagepool.groups, 1)
+
+    def _page_bytes(self) -> int:
+        """Measured bytes of one page across every leaf (codes + scales)."""
+        return quant_ops.tree_bytes(self._cache) // self._pagepool.num_pages
+
+    def _build_prefix_cache(self) -> "PrefixCache | None":
+        if not self._prefix_cache_entries and not self._prefix_cache_bytes:
+            return None
+        # A bytes-only budget leaves the entry count effectively unbounded —
+        # measured nbytes is then the sole eviction pressure (satellite: an
+        # int8 engine fits ~3-4x the fp32 entry count in the same budget).
+        entries = self._prefix_cache_entries or (1 << 30)
+        return PrefixCache(
+            entries, layout=self.plane_layout,
+            capacity_bytes=self._prefix_cache_bytes,
+            on_evict=(self._on_prefix_evict if self._pagepool is not None
+                      else None))
+
+    def _on_prefix_evict(self, planes: dict) -> None:
+        """Prefix-cache eviction hook (paged mode): the entry's refcount on
+        its pages returns to the pool — eviction IS the free."""
+        self._pagepool.unref(int(p) for p in planes["pages"])
+
+    def _page_reserve(self, slot: int, stream: np.ndarray, total: int) -> int:
+        """Reservation-at-admission: prefix lookup, then an ALL-OR-NOTHING
+        allocation of every page ``total`` positions can ever touch — so pool
+        exhaustion only ever surfaces here (as :class:`PagePoolExhausted`,
+        re-raised by ``admit_many`` as the typed :class:`KVPagesExhausted`
+        refusal), never as a mid-decode OOM.
+
+        On a prefix hit, the hit's FULL pages are shared by refcount; a
+        boundary page (hit length mid-page) is copy-on-write duplicated so
+        this slot's writes at positions ``>= hit_len`` stay private. Returns
+        the hit length (0 on miss); on failure the slot owns nothing."""
+        pool = self._pagepool
+        ps = pool.page_size
+        group = self._slot_group(slot)
+        hit_len, entry_pages = 0, None
+        if self.prefix_cache is not None and len(stream):
+            hit_len, payload = self.prefix_cache.lookup(
+                stream, min_len=min(self.prefill_chunk_sizes),
+                layout=self.plane_layout)
+            if hit_len:
+                entry_pages = [int(p) for p in payload["pages"]]
+                if pool.group_of(entry_pages[0]) != group:
+                    # A cross-group entry would map pages from another dp
+                    # shard into this slot's table — treat as a miss (the
+                    # router's affinity keeps this rare).
+                    hit_len, entry_pages = 0, None
+        shared = hit_len // ps
+        needed = pages_for(int(total), ps)
+        new_pages = pool.alloc(needed - shared, group=group)   # may raise
+        shared_pages = entry_pages[:shared] if shared else []
+        if shared_pages:
+            pool.ref(shared_pages)
+        pages = shared_pages + new_pages
+        if hit_len % ps:
+            # Boundary COW: entry page `shared` holds rows [0, hit_len % ps)
+            # this slot needs — copy them into its own fresh page.
+            self._cache = self._cow_jit(self._cache,
+                                        np.int32(pages[shared]),
+                                        np.int32(entry_pages[shared]))
+            self.cow_copies += 1
+        self._slot_pages[slot] = pages
+        row = self._table[slot]
+        row[:] = pool.null_page(group)
+        row[:len(pages)] = pages
+        return hit_len
+
+    def _release_pages(self, slot: int) -> None:
+        """Drop the slot's ownership of its reservation (finish/park/expire);
+        pages shared with prefix-cache entries stay alive under the entry's
+        refcount. The table row returns to the group's null page so the
+        fixed-shape programs' writes for this (now inactive) slot land
+        somewhere harmless."""
+        pages = self._slot_pages[slot]
+        if pages:
+            self._pagepool.unref(pages)
+        self._slot_pages[slot] = []
+        self._table[slot, :] = self._pagepool.null_page(self._slot_group(slot))
+
+    def _prefix_insert_pages(self, slot: int, tokens: np.ndarray) -> None:
+        """Prefix-cache insert, paged flavor: the entry takes a refcount on
+        the pages covering ``tokens`` — no snapshot copy. The slot may keep
+        writing the last covered page at positions ``>= len(tokens)``; those
+        rows are outside every claim the entry makes, so sharing is safe."""
+        n = pages_for(len(tokens), self._pagepool.page_size)
+        pages = self._slot_pages[slot][:n]
+        if not pages:
+            return
+        self._pagepool.ref(pages)
+        self.prefix_cache.insert(
+            np.asarray(tokens, np.int32),
+            {"pages": np.asarray(pages, np.int32)},
+            layout=self.plane_layout,
+            nbytes=len(pages) * self._page_bytes())
 
     # ------------------------------------------------------------------ slots
 
@@ -610,6 +890,26 @@ class ContinuousBatchingEngine:
         b, s = self.num_slots, self.model.seq_len
         if len(admissions) > b:
             raise ValueError(f"{len(admissions)} admissions > {b} slots")
+        page_hits: dict[int, int] = {}
+        refused: list = []
+        refusal: PagePoolExhausted | None = None
+        if self._pagepool is not None:
+            # Reservation FIRST, per entry: an entry whose full page span
+            # can't be covered is refused before any state binds to it (its
+            # slot stays free, nothing to roll back); the rest admit
+            # normally. Exhaustion is a typed refusal at this one point —
+            # never a mid-decode OOM.
+            kept = []
+            for entry in entries:
+                slot, request, parked, stream = entry
+                total = min(len(request.prompt) + request.max_new_tokens, s)
+                try:
+                    page_hits[slot] = self._page_reserve(slot, stream, total)
+                    kept.append(entry)
+                except PagePoolExhausted as exc:
+                    refused.append(parked if parked is not None else request)
+                    refusal = refusal or exc
+            entries = kept
         slot_idx = np.full((b,), b, np.int32)        # b is out of range: dropped
         rows = np.zeros((b, s), np.int32)
         for j, (slot, _, _, stream) in enumerate(entries):
@@ -620,11 +920,16 @@ class ContinuousBatchingEngine:
         for slot, request, parked, stream in entries:
             total = min(len(request.prompt) + request.max_new_tokens, s)
             self._admit_one(slot, request, total, now, parked=parked,
-                            stream=stream)
+                            stream=stream, page_hit=page_hits.get(slot))
+        if refused:
+            raise KVPagesExhausted(
+                [(slot, request) for slot, request, _, _ in entries],
+                refused, refusal)
 
     def _admit_one(self, slot: int, request: Request, total: int,
                    now: float, *, parked: Parked | None = None,
-                   stream: np.ndarray | None = None) -> None:
+                   stream: np.ndarray | None = None,
+                   page_hit: int | None = None) -> None:
         p = len(request.prompt)
         self._requests[slot] = request
         self._prompt_len[slot] = p
@@ -665,7 +970,11 @@ class ContinuousBatchingEngine:
                                  parks=parked.parks, resumed_at=fill)
         self._ready_s[slot] = now
         hit_len = 0
-        if self.prefix_cache is not None and fill:
+        if page_hit is not None:
+            # Paged mode: the lookup AND the install (refcount share + COW)
+            # already ran inside the admission reservation pass.
+            hit_len = page_hit
+        elif self.prefix_cache is not None and fill:
             # layout passed explicitly: a foreign cache object (written by an
             # engine with another dtype policy) must miss, never install.
             hit_len, planes = self.prefix_cache.lookup(
@@ -763,8 +1072,13 @@ class ContinuousBatchingEngine:
         self.prefill_wall_s = 0.0
         self._prefill_records = []
         if self.prefix_cache is not None:
-            self.prefix_cache = PrefixCache(self.prefix_cache.capacity,
-                                            layout=self.plane_layout)
+            # clear() fires the eviction hook per entry, so in paged mode the
+            # cache's page refcounts return to the pool before the rebuild.
+            self.prefix_cache.clear()
+            self.prefix_cache = self._build_prefix_cache()
+        if self._pagepool is not None:
+            self._pagepool.reset_counters()
+            self.cow_copies = 0
 
     # Reference HBM budget for the slots-per-chip figure: 1 GiB is small enough
     # to be meaningful for the tiny CPU models AND scales linearly, so the A/B
@@ -792,9 +1106,18 @@ class ContinuousBatchingEngine:
         params_bytes = quant_ops.tree_bytes(self.params)
         kv_bytes = quant_ops.tree_bytes(self._cache)
         prompt_bytes = int(self._prompt.size) * self._prompt.dtype.itemsize
-        per_slot = kv_bytes // self.num_slots
+        if self._pagepool is not None:
+            # A paged slot's cost is its RESERVATION, not a fixed plane: the
+            # conservative per-slot figure here is the full-context span
+            # (P_max pages); workload-measured reservations (the actual
+            # capacity win) are priced by tools/bench_decode_analysis.py
+            # --paged-ab from per-request page spans.
+            per_slot = self._table.shape[1] * self._page_bytes()
+        else:
+            per_slot = kv_bytes // self.num_slots
         per_step = kv_bytes + params_bytes + prompt_bytes
         doc = {
+            "kv_layout": self.kv_layout,
             "kv_dtype": self.quant.kv_dtype,
             "quant_policy": self.quant.weights,
             "plane_layout": self.plane_layout,
@@ -809,6 +1132,12 @@ class ContinuousBatchingEngine:
                 (budget - params_bytes) // (per_slot + prompt_bytes
                                             // self.num_slots), 0),
         }
+        if self._pagepool is not None:
+            doc["page_size"] = self._pagepool.page_size
+            doc["num_pages"] = self._pagepool.num_pages
+            doc["page_bytes"] = self._page_bytes()
+            doc["page_token_capacity"] = (self._pagepool.usable_pages
+                                          * self._pagepool.page_size)
         # Per-CHIP residency (the sharded-byte-math bugfix): the logical
         # totals above count each array once, but a sharded leaf is resident
         # as per-device shards and a replicated leaf N times — sum per-shard
@@ -844,6 +1173,34 @@ class ContinuousBatchingEngine:
             doc["slots_at_budget"] = self.mesh.dp * max(
                 (budget - params_chip) // slot_cost, 0)
         return doc
+
+    def page_stats(self) -> dict | None:
+        """The ``kv_pages`` telemetry payload (None in contiguous mode): the
+        allocator ledger plus the engine-side figures only it can compute —
+        internal fragmentation (reserved-but-unwritten fraction of slot-held
+        pages) and the copy-on-write count."""
+        if self._pagepool is None:
+            return None
+        s = self._pagepool.stats()
+        held = live = 0
+        for i in range(self.num_slots):
+            pages = self._slot_pages[i]
+            if not pages:
+                continue
+            held += len(pages)
+            if self._pending_chunks[i]:
+                live += int(self._pending_chunks[i][0][0])   # rows settled
+            elif self._active[i]:
+                live += int(self._t[i])
+            elif self._requests[i] is not None:
+                live += int(self._fill_len[i])
+        s["slot_pages_held"] = held
+        s["slot_tokens_live"] = live
+        s["fragmentation"] = (
+            round(1.0 - live / (held * self._pagepool.page_size), 4)
+            if held else 0.0)
+        s["cow_copies"] = self.cow_copies
+        return s
 
     def take_prefill_records(self) -> list[dict]:
         """Drain the completed-prefill telemetry records (one dict per prompt:
@@ -907,6 +1264,8 @@ class ContinuousBatchingEngine:
         self._hit_len[slot] = 0
         self._stream[slot] = None
         self._parks[slot] = 0
+        if self._pagepool is not None:
+            self._release_pages(slot)
         if self.drafter is not None:
             self.drafter.on_release(slot)
         return comp
@@ -947,11 +1306,17 @@ class ContinuousBatchingEngine:
         while budget > 0 and self._prefill_fifo:
             slot = self._next_prefill_slot()
             start, length, size = self._pending_chunks[slot].pop(0)
-            fresh = self._chunks_done[slot] == 0 and self._hit_len[slot] == 0
             t0 = time.monotonic()
-            self._cache = self._prefill_jits[size](
-                self.params, self._cache, self._prompt, np.int32(slot),
-                np.int32(start), np.int32(length), np.asarray(bool(fresh)))
+            if self._pagepool is not None:
+                self._cache = self._prefill_jits[size](
+                    self.params, self._cache, self._table, self._prompt,
+                    np.int32(slot), np.int32(start), np.int32(length))
+            else:
+                fresh = (self._chunks_done[slot] == 0
+                         and self._hit_len[slot] == 0)
+                self._cache = self._prefill_jits[size](
+                    self.params, self._cache, self._prompt, np.int32(slot),
+                    np.int32(start), np.int32(length), np.asarray(bool(fresh)))
             t1 = time.monotonic()
             self._chunk_wall[slot] += t1 - t0
             if self.tracer is not None:
@@ -976,10 +1341,14 @@ class ContinuousBatchingEngine:
         jax.tree_util.tree_leaves(self._cache)[0].block_until_ready()
         self._chunk_wall[slot] += time.monotonic() - t0
         if self.prefix_cache is not None:
-            self.prefix_cache.insert(np.asarray(self._stream[slot], np.int32),
-                                     self._snapshot_jit(self._cache,
-                                                        np.int32(slot)),
-                                     layout=self.plane_layout)
+            if self._pagepool is not None:
+                self._prefix_insert_pages(
+                    slot, np.asarray(self._stream[slot], np.int32))
+            else:
+                self.prefix_cache.insert(
+                    np.asarray(self._stream[slot], np.int32),
+                    self._snapshot_jit(self._cache, np.int32(slot)),
+                    layout=self.plane_layout)
         self._activate_prefilled(slot)
         self._record_prefill(
             slot, wall_s=float(self._chunk_wall[slot]),
@@ -1000,10 +1369,19 @@ class ContinuousBatchingEngine:
         if self.drafter is not None:
             return self._spec_tick()
         self._key, sub = jax.random.split(self._key)
-        fresh = self._active & (self._t == 0)
-        self._cache, tok = self._step_jit(
-            self.params, self._cache, self._ids, self._t, fresh, self._prompt,
-            self._prompt_len, self._temp, self._top_k, self._top_p, sub)
+        if self._pagepool is not None:
+            # The page table rides in as data each call — same shape/dtype
+            # every step, so the one-trace pin holds for any page assignment.
+            self._cache, tok = self._step_jit(
+                self.params, self._cache, self._table, self._ids, self._t,
+                self._prompt, self._prompt_len, self._temp, self._top_k,
+                self._top_p, sub)
+        else:
+            fresh = self._active & (self._t == 0)
+            self._cache, tok = self._step_jit(
+                self.params, self._cache, self._ids, self._t, fresh,
+                self._prompt, self._prompt_len, self._temp, self._top_k,
+                self._top_p, sub)
         # THE per-step host sync: one [num_slots] token fetch per decode tick,
         # the design's single sanctioned round-trip (DESIGN.md §11).
         tok = np.asarray(tok)   # graftlint: disable=host-sync-hazard
@@ -1051,10 +1429,15 @@ class ContinuousBatchingEngine:
             dlen[i] = n
         t_draft = time.monotonic()
         self._key, sub = jax.random.split(self._key)
-        fresh = self._active & (self._t == 0)
-        self._cache, tok, counts = self._verify_jits[k](
-            self.params, self._cache, self._ids, self._t, fresh, draft, dlen,
-            self._temp, self._top_k, self._top_p, sub)
+        if self._pagepool is not None:
+            self._cache, tok, counts = self._verify_jits[k](
+                self.params, self._cache, self._table, self._ids, self._t,
+                draft, dlen, self._temp, self._top_k, self._top_p, sub)
+        else:
+            fresh = self._active & (self._t == 0)
+            self._cache, tok, counts = self._verify_jits[k](
+                self.params, self._cache, self._ids, self._t, fresh, draft,
+                dlen, self._temp, self._top_k, self._top_p, sub)
         # THE per-step host sync, spec flavor: one tokens+counts fetch per
         # verify tick (the decode tick's single sanctioned round-trip).
         tok = np.asarray(tok)       # graftlint: disable=host-sync-hazard
@@ -1182,13 +1565,16 @@ class ContinuousBatchingEngine:
         assert len(tokens) == t, "emitted stream and position out of sync"
         if self.prefix_cache is not None:
             # Evict-to-prefix-cache: the slot's settled rows [0, t) under
-            # their exact token key. The snapshot is one fixed-shape program;
-            # rows past t are donor garbage the position mask hides, exactly
-            # like every other cache entry.
-            self.prefix_cache.insert(tokens,
-                                     self._snapshot_jit(self._cache,
-                                                        np.int32(slot)),
-                                     layout=self.plane_layout)
+            # their exact token key. Contiguous pays one snapshot program;
+            # paged just moves ownership of the covering pages to the cache
+            # entry (a refcount bump — park becomes O(pages) host work).
+            if self._pagepool is not None:
+                self._prefix_insert_pages(slot, tokens)
+            else:
+                self.prefix_cache.insert(tokens,
+                                         self._snapshot_jit(self._cache,
+                                                            np.int32(slot)),
+                                         layout=self.plane_layout)
         parked = Parked(request=req, tokens=tokens,
                         first_tok_s=self._first_tok_s[slot],
                         admit_s=float(self._admit_s[slot]), parked_s=now,
@@ -1209,6 +1595,8 @@ class ContinuousBatchingEngine:
         self._hit_len[slot] = 0
         self._stream[slot] = None
         self._parks[slot] = 0
+        if self._pagepool is not None:
+            self._release_pages(slot)
         if self.drafter is not None:
             self.drafter.on_release(slot)
         return parked
@@ -1228,10 +1616,14 @@ class ContinuousBatchingEngine:
         under a prompt-only key and TTFT re-stamped."""
         start = self._pending_chunks[slot][0][0]
         if self.prefix_cache is not None and start > 0:
-            self.prefix_cache.insert(
-                np.asarray(self._stream[slot][:start], np.int32),
-                self._snapshot_jit(self._cache, np.int32(slot)),
-                layout=self.plane_layout)
+            if self._pagepool is not None:
+                self._prefix_insert_pages(
+                    slot, np.asarray(self._stream[slot][:start], np.int32))
+            else:
+                self.prefix_cache.insert(
+                    np.asarray(self._stream[slot][:start], np.int32),
+                    self._snapshot_jit(self._cache, np.int32(slot)),
+                    layout=self.plane_layout)
         self.prefill_wall_s += float(self._chunk_wall[slot])
         self._chunk_wall[slot] = 0.0
         self._pending_chunks[slot] = []
@@ -1262,6 +1654,8 @@ class ContinuousBatchingEngine:
         self._hit_len[slot] = 0
         self._stream[slot] = None
         self._parks[slot] = 0
+        if self._pagepool is not None:
+            self._release_pages(slot)
         if self.drafter is not None:
             self.drafter.on_release(slot)
         return back
@@ -1304,7 +1698,21 @@ class ContinuousBatchingEngine:
                 if not pending:
                     break
                 batch.append((slot, pending.pop(0)))
-            self.admit_many(batch)
+            try:
+                self.admit_many(batch)
+            except KVPagesExhausted as exc:
+                # Typed backpressure, not an error: requeue the refused items
+                # in order and let the in-flight work drain pages. If NOTHING
+                # is in flight, stepping can't free anything — drop the
+                # prefix cache's holdings (it is a cache; its refcounts are
+                # droppable by definition) and retry; still stuck means the
+                # pool genuinely cannot fit one request, so surface it.
+                pending[:0] = exc.refused
+                if not exc.admitted and self.num_active == 0:
+                    if self.prefix_cache is not None and len(self.prefix_cache):
+                        self.prefix_cache.clear()
+                        continue
+                    raise
             out.extend(self.step())
             if budget is not None:
                 budget -= 1

@@ -45,17 +45,36 @@ def common_prefix_len(a: np.ndarray, b: np.ndarray) -> int:
     return int(neq[0]) if len(neq) else n
 
 
+def _tree_nbytes(planes) -> int:
+    """Measured bytes of a planes payload: ``size * itemsize`` summed over
+    every array leaf of a (possibly nested) dict — so an int8 entry is charged
+    its int8 codes plus its f32 scale planes, never a logical fp32 size.
+    Duck-typed (works on numpy and device arrays alike) to keep this module
+    jax-free; reads only shape metadata, never a buffer. Non-array leaves
+    (layout stamps, step counters, test sentinels) charge zero bytes."""
+    total, stack = 0, [planes]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            stack.extend(node.values())
+        elif hasattr(node, "size") and hasattr(node, "dtype"):
+            total += int(node.size) * node.dtype.itemsize
+    return total
+
+
 @dataclasses.dataclass
 class PrefixEntry:
     """One stored prefill: the prompt tokens whose rows the planes hold, the
     per-layer ``{"k": [S, KV_H, Dh], "v": ...}`` device planes (rows
-    ``[0, len(tokens))`` valid, the rest donor garbage), and the plane
+    ``[0, len(tokens))`` valid, the rest donor garbage), the plane
     ``layout`` signature (``ops.quant.cache_layout``) the planes were written
-    under — dtype + scale-plane structure, the compatibility key."""
+    under — dtype + scale-plane structure, the compatibility key — and the
+    entry's measured ``nbytes`` (what it charges a byte budget)."""
 
     tokens: np.ndarray
     planes: dict
     layout: str | None = None
+    nbytes: int = 0
 
 
 class PrefixCache:
@@ -67,12 +86,32 @@ class PrefixCache:
     into an engine running another (int8 planes + per-head scales) — the
     bytes would be reinterpreted garbage. Mismatches are counted in
     ``layout_rejects`` rather than raised: a foreign-layout entry is simply
-    not a hit (the regression case is a cache object handed across engines)."""
+    not a hit (the regression case is a cache object handed across engines).
 
-    def __init__(self, capacity: int, *, layout: str | None = None):
+    ``capacity_bytes`` adds a MEASURED byte budget on top of the entry count:
+    every insert is charged its leaves' actual ``size * itemsize`` (or an
+    explicit ``nbytes`` — the paged engine passes its page-span cost), so an
+    int8 engine's entries cost what int8 planes plus f32 scales cost, not a
+    logical fp32 figure — the same budget holds ~3-4x the entries. ``None``
+    (the default) keeps the pure entry-count LRU.
+
+    ``on_evict`` is called with the dropped entry's ``planes`` whenever an
+    entry leaves for ANY reason (LRU pressure, byte pressure, covered-drop,
+    ``clear``) — the paged engine's hook for returning page refcounts; the
+    callback runs under the cache lock, so it must not re-enter the cache."""
+
+    def __init__(self, capacity: int, *, layout: str | None = None,
+                 capacity_bytes: int | None = None,
+                 on_evict=None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if capacity_bytes is not None and capacity_bytes < 1:
+            raise ValueError(f"capacity_bytes must be >= 1, "
+                             f"got {capacity_bytes}")
         self.capacity = int(capacity)
+        self.capacity_bytes = (None if capacity_bytes is None
+                               else int(capacity_bytes))
+        self.on_evict = on_evict
         self.layout = layout
         # Tiered serving inserts from a handoff listener thread while the
         # engine thread looks up/inserts — one reentrant lock serializes the
@@ -81,6 +120,7 @@ class PrefixCache:
         self._entries: collections.OrderedDict[int, PrefixEntry] = \
             collections.OrderedDict()
         self._next_key = 0
+        self.bytes = 0                # measured bytes of the resident entries
         self.queries = 0
         self.hits = 0
         self.hit_tokens = 0
@@ -133,34 +173,59 @@ class PrefixCache:
             return best_len, self._entries[best_key].planes
 
     def insert(self, tokens: np.ndarray, planes: dict, *,
-               layout: str | None = None) -> None:
+               layout: str | None = None, nbytes: int | None = None) -> None:
         """Store a finished prefill (and drop any entry the new one strictly
         covers — same tokens as a prefix of the new entry's AND the same plane
         layout, so every future lookup the old entry could win, the new one
         wins longer). The entry is stamped with ``layout`` (default: the
-        cache's own) — the key :meth:`lookup` filters on."""
+        cache's own) — the key :meth:`lookup` filters on — and charged
+        ``nbytes`` against the byte budget (default: the planes' measured
+        leaf bytes)."""
         with self._lock:
             layout = self.layout if layout is None else layout
             tokens = np.asarray(tokens, np.int32).copy()
+            nbytes = _tree_nbytes(planes) if nbytes is None else int(nbytes)
             covered = [
                 k for k, e in self._entries.items()
                 if e.layout == layout and len(e.tokens) <= len(tokens)
                 and self._common_prefix(e.tokens, tokens) == len(e.tokens)]
             for k in covered:
-                del self._entries[k]
+                self._drop(k)
             self._entries[self._next_key] = PrefixEntry(
-                tokens=tokens, planes=planes, layout=layout)
+                tokens=tokens, planes=planes, layout=layout, nbytes=nbytes)
+            self.bytes += nbytes
             self._next_key += 1
             self.insertions += 1
-            while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
+            while len(self._entries) > self.capacity or (
+                    self.capacity_bytes is not None
+                    and self.bytes > self.capacity_bytes
+                    and len(self._entries) > 1):
+                self._drop(next(iter(self._entries)))     # LRU victim
                 self.evictions += 1
+
+    def _drop(self, key: int) -> None:
+        """Remove one entry (lock held), settle the byte ledger, and hand its
+        planes to ``on_evict`` — the ONE exit path for entries, so a paged
+        engine's page refcounts can never leak through an eviction flavor."""
+        entry = self._entries.pop(key)
+        self.bytes -= entry.nbytes
+        if self.on_evict is not None:
+            self.on_evict(entry.planes)
+
+    def clear(self) -> None:
+        """Drop every entry (``on_evict`` fires per entry) — engine
+        ``reset_stats`` and allocator-pressure recovery."""
+        with self._lock:
+            while self._entries:
+                self._drop(next(iter(self._entries)))
 
     def stats(self) -> dict:
         with self._lock:
             return {
                 "entries": len(self._entries),
                 "capacity": self.capacity,
+                "bytes": self.bytes,
+                "capacity_bytes": self.capacity_bytes,
                 "queries": self.queries,
                 "hits": self.hits,
                 "hit_tokens": self.hit_tokens,

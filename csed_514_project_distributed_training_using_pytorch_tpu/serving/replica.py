@@ -223,13 +223,28 @@ def build_engine_server(args, trace: Tracer | str | None = None):
     if getattr(args, "tier", tiers_mod.ROLE_UNIFIED) != tiers_mod.ROLE_UNIFIED \
             and not prefix_entries:
         prefix_entries = 32
+    kv_layout = getattr(args, "kv_layout", "contiguous")
+    if kv_layout != "contiguous" and \
+            getattr(args, "tier", tiers_mod.ROLE_UNIFIED) != tiers_mod.ROLE_UNIFIED:
+        # The KV handoff wire ships whole contiguous planes; a paged engine's
+        # prefix entries are page-id refcounts with no planes to encode, and a
+        # received planes entry would have no pages for the reservation path
+        # to share. Refuse loudly at startup rather than fail per-request.
+        raise ValueError(
+            f"--kv-layout {kv_layout} is incompatible with --tier "
+            f"{args.tier}: the prefill/decode KV handoff ships contiguous "
+            f"planes (run paged engines as unified replicas)")
     engine = ContinuousBatchingEngine(
         model, params, num_slots=args.num_slots, seed=args.seed,
         prefill_chunk_sizes=chunk_sizes,
         prefill_chunk_budget=args.prefill_budget,
         prefix_cache_entries=prefix_entries,
+        prefix_cache_bytes=getattr(args, "prefix_cache_bytes", 0) or None,
         kv_dtype=getattr(args, "kv_dtype", "model"),
         quant_policy=getattr(args, "quant_policy", "off"),
+        kv_layout=kv_layout,
+        page_size=getattr(args, "page_size", 64),
+        num_pages=getattr(args, "num_pages", 0) or None,
         spec=spec, spec_k=getattr(args, "spec_k", 4), drafter=drafter,
         mesh=mesh)
     # The serve-path resilience tick: kill/preempt/stall faults fire between
@@ -467,6 +482,10 @@ def _stats_payload(engine, server, handoff=None) -> dict:
     if hasattr(engine, "byte_accounting"):
         # Measured bytes/token for the router's fleet_snapshot timeline.
         eng["bytes"] = engine.byte_accounting()
+    if hasattr(engine, "page_stats"):
+        # Paged-KV pool ledger (None on contiguous engines): the router folds
+        # free/in_use/refusals into fleet_snapshot, fleet_top renders a column.
+        eng["kv_pages"] = engine.page_stats()
     out = {"engine": eng,
            "queue": (server.queue.snapshot()
                      if hasattr(server, "queue") else None)}
@@ -1134,6 +1153,18 @@ def main(argv: list[str] | None = None) -> int:
     e.add_argument("--prefill-chunks", default="32,128,512")
     e.add_argument("--prefill-budget", type=int, default=1)
     e.add_argument("--prefix-cache", type=int, default=0)
+    e.add_argument("--prefix-cache-bytes", type=int, default=0,
+                   help="measured-byte budget for the prefix cache on top of "
+                        "the entry count (0 = entry-count LRU only)")
+    e.add_argument("--kv-layout", default="contiguous",
+                   choices=("contiguous", "paged"),
+                   help="KV store layout: 'paged' decouples slot count from "
+                        "max context via a fixed page pool (DESIGN.md §27)")
+    e.add_argument("--page-size", type=int, default=64,
+                   help="paged layout: tokens per KV page")
+    e.add_argument("--num-pages", type=int, default=0,
+                   help="paged layout: pool size in pages (0 = capacity "
+                        "parity with the contiguous cache)")
     e.add_argument("--kv-dtype", default="model",
                    choices=("model", "fp32", "bf16", "int8", "fp8"))
     e.add_argument("--quant-policy", default="off",

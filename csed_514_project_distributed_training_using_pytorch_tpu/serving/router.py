@@ -2652,6 +2652,16 @@ class Router:
                     if by:
                         row["decode_bytes_per_token"] = \
                             by.get("decode_bytes_per_token")
+                    kp = eng.get("kv_pages") or {}
+                    if kp:
+                        # Paged replicas only (contiguous rows stay
+                        # field-identical): pool pressure for the autoscaler
+                        # and fleet_top's pages column — refusals rising with
+                        # free pinned at 0 is KV pressure, not compute load.
+                        row["kv_pages"] = {
+                            k: kp.get(k) for k in
+                            ("free", "in_use", "shared", "refusals",
+                             "fragmentation")}
                     sp = eng.get("spec") or {}
                     if sp:
                         # Speculative decoding's load-relevant number: tokens

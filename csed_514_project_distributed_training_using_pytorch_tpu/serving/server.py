@@ -42,6 +42,7 @@ from csed_514_project_distributed_training_using_pytorch_tpu.obs.slo import (
 from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
     Completion,
     ContinuousBatchingEngine,
+    KVPagesExhausted,
     Request,
     SamplingParams,
 )
@@ -491,7 +492,21 @@ class Server:
             for req in expired:
                 self._reject_expired(req, now)
             # One padded scatter dispatch admits the whole batch of freed slots.
-            eng.admit_many(list(zip(eng.free_slots(), admitted)), now=now)
+            try:
+                eng.admit_many(list(zip(eng.free_slots(), admitted)), now=now)
+            except KVPagesExhausted as exc:
+                # Paged engine out of pages: the refusal is typed and PARTIAL
+                # (whoever fit is in and decoding) — requeue the refused at
+                # their lanes' front and let the drain free pages. Only when
+                # nothing at all is running can nothing ever drain; then the
+                # prefix cache's shared pages are the only reclaimable bytes.
+                for req in exc.refused:
+                    self.queue.requeue(req)
+                if not exc.admitted and eng.num_active == 0:
+                    if eng.prefix_cache is not None and len(eng.prefix_cache):
+                        eng.prefix_cache.clear()
+                    else:
+                        raise
             if eng.num_active:
                 # step() interleaves prefill chunks (budgeted) with the decode
                 # step, so a burst of long prompts can't starve active decodes.
@@ -559,6 +574,9 @@ class Server:
         wall_s = (time.monotonic() - self._started_s
                   if self._started_s is not None else None)
         eng = self.engine
+        pages = eng.page_stats()
+        if pages is not None:
+            self._writer.emit(T.kv_pages_event(source="server", stats=pages))
         if self._slo is not None:
             self._writer.emit(slo_event(
                 self._slo, source="server",
@@ -584,6 +602,7 @@ class Server:
                           if eng.prefix_cache else None),
             queue=self.queue.snapshot(),
             byte_accounting=eng.byte_accounting(),
+            kv_pages=pages,
             slo=self.slo_summary(),
             preemptions=eng.preemptions,
             resumes=eng.resumes,

@@ -64,6 +64,14 @@ HEAD_AXIS = "model"
 _PLANE_AXIS_TO_MESH = {"slot": SLOT_AXIS, "kv_head": HEAD_AXIS,
                        "position": None, "head_dim": None}
 
+# Paged twin (``models.lm.PAGE_PLANE_AXES``): the page axis takes the slot
+# axis's place on the mesh — pages are slot-owned, and the allocator's group
+# partitioning (one ``PagePool`` group per dp rank, ``serving/pagepool.py``)
+# keeps every slot's pages inside its dp group's contiguous page range, so
+# the paged gather has no structural reason to cross dp shards.
+_POOL_AXIS_TO_MESH = {"page": SLOT_AXIS, "kv_head": HEAD_AXIS,
+                      "offset": None, "head_dim": None}
+
 
 def parse_shard_spec(spec: str | None) -> tuple[int, int]:
     """``"tp=2,dp=4"`` -> ``(tp, dp)``. Order-free, both keys optional
@@ -164,6 +172,32 @@ def cache_shardings(cache, sm: ServeMesh):
     return jax.tree_util.tree_map(
         lambda spec: NamedSharding(sm.mesh, spec),
         _filter_to_mesh(cache_pspecs(cache), sm.mesh),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def pool_pspecs(pool) -> dict:
+    """Per-leaf ``PartitionSpec`` for a ``models.lm.init_page_pool`` tree,
+    derived from ``PAGE_PLANE_AXES``: k/v ``[page, offset, kv_head, head_dim]``
+    -> ``P(data, None, model, None)``; scale pools ``[page, offset, kv_head]``
+    -> ``P(data, None, model)``. Unknown leaves replicate, same fail-safe as
+    ``cache_pspecs``."""
+
+    def spec_for(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        axes = lm_mod.PAGE_PLANE_AXES.get(name)
+        if axes is None or len(axes) != leaf.ndim:
+            return P()
+        return P(*(_POOL_AXIS_TO_MESH[a] for a in axes))
+
+    return jax.tree_util.tree_map_with_path(spec_for, pool)
+
+
+def pool_shardings(pool, sm: ServeMesh):
+    """``NamedSharding`` tree for the engine's resident page pools (the paged
+    counterpart of ``cache_shardings``)."""
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(sm.mesh, spec),
+        _filter_to_mesh(pool_pspecs(pool), sm.mesh),
         is_leaf=lambda x: isinstance(x, P))
 
 

@@ -790,6 +790,7 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
                         prefix_cache: dict | None = None,
                         queue: dict | None = None,
                         byte_accounting: dict | None = None,
+                        kv_pages: dict | None = None,
                         slo: dict | None = None,
                         preemptions: int | None = None,
                         resumes: int | None = None,
@@ -805,7 +806,10 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
     (``ContinuousBatchingEngine.byte_accounting()`` — decode bytes/token, KV
     bytes/slot, slots-at-budget, kv_dtype), the quantization A/B ledger.
     ``slo`` is the run-level SLO attainment dict (``obs.slo
-    .AttainmentTracker.summary()``) when the server carries a spec. The four
+    .AttainmentTracker.summary()``) when the server carries a spec.
+    ``kv_pages`` is the paged engine's ``page_stats()`` ledger (pool
+    occupancy / sharing / refusals / COW copies) — None on a contiguous
+    engine, so the field's presence is itself the layout A/B marker. The four
     latency series accept raw sequences or ``obs.hist.LogHistogram`` sketches
     (the server keeps sketches — O(buckets), not O(requests))."""
     return {
@@ -841,6 +845,7 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
         "prefix_cache": prefix_cache,
         "queue": queue,
         "bytes": byte_accounting,
+        "kv_pages": kv_pages,
         "slo": slo,
         # The tenancy ledger (DESIGN.md §22): deliberate degradations (shed)
         # and mid-decode evictions (preemptions/resumes) are first-class
@@ -854,6 +859,16 @@ def serve_summary_event(*, requests: int, ok: int, timeout: int, new_tokens: int
         "e2e_s": series_percentiles(e2e_s),
         "queue_wait_s": series_percentiles(queue_wait_s),
     }
+
+
+def kv_pages_event(*, source: str = "server", stats: dict) -> dict:
+    """One paged-KV pool ledger line (``serving/server.py`` at drain, paged
+    engines only): the engine's ``page_stats()`` dict — pool shape
+    (num_pages/page_size/groups), occupancy (free/in_use/shared/peak_in_use),
+    the alloc/free/refusal counters, live-token fragmentation, and COW copies.
+    A standalone kind (not just the ``serve_summary`` field) so ``fleet_top``
+    and the report's A-vs-B table can scan for it without parsing summaries."""
+    return {"event": "kv_pages", "source": source, **stats}
 
 
 def promote_event(*, action: str, candidate: str, step: int | None = None,

@@ -46,6 +46,7 @@ EVENT_KINDS: dict[str, str] = {
     "spec": "one speculative verify step: slots, proposed/accepted/emitted",
     "shed": "one overload-shed decision: tenant, quota/refused/displaced reason",
     "tenant_summary": "one tenant's drain ledger: counts/percentiles/preemptions/slo",
+    "kv_pages": "paged-KV pool ledger at drain: in_use/shared/refusals/COW (serving/server.py)",
     # -- serving: fleet router (serving/router.py via utils/jsonl.py) -----------
     "route": "one routed request: replica, affinity, redispatches, finish",
     "replica": "replica lifecycle transition: start/fail/restart/dead",

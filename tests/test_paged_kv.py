@@ -1,0 +1,472 @@
+"""Paged KV cache (DESIGN.md §27): engine identity, allocator discipline, COW.
+
+The paged store's whole contract, pinned at tier-1 sizes:
+
+1. **Token identity** — a ``kv_layout="paged"`` engine is token-IDENTICAL to
+   the contiguous oracle on the same workload, across MHA/GQA/window/RoPE,
+   int8 planes, prefix-cache sharing, and speculative decoding: the adapters
+   gather the table-mapped view and run the SAME attention program, so this is
+   bitwise by construction — any drift is a page-mapping bug.
+2. **One program per family** — paging adds page tables as DATA, never shape:
+   ``trace_count`` pins hold, plus exactly one COW program
+   (``cow_trace_count``) no matter how many boundary pages get copied.
+3. **Reservation-at-admission** — exhaustion is a typed ``KVPagesExhausted``
+   refusal carrying who got in and who must requeue, never a partial bind or
+   a mid-decode failure; a drain frees pages and the refused re-admit.
+4. **No leaks** — park/resume/expire/prefix-share all settle through page
+   refcounts; after everything finishes and the prefix cache clears, the pool
+   is byte-for-byte empty (``in_use == 0``).
+
+Plus the satellite pins: PrefixCache's MEASURED byte budget (an int8 engine
+fits ~3x the fp32 entry count in the same bytes), the ``kv_pages`` telemetry
+surface end to end through the server, and the planner's paged residency.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.models import lm
+from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+    ContinuousBatchingEngine,
+    Request,
+    Server,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
+    KVPagesExhausted,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.pagepool import (
+    PagePool,
+    PagePoolExhausted,
+    pages_for,
+)
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.prefix_cache import (
+    PrefixCache,
+    _tree_nbytes,
+)
+
+SMALL = dict(vocab_size=9, seq_len=16, embed_dim=32, num_layers=2, num_heads=4)
+
+
+def _model(**kw):
+    return lm.TransformerLM(**{**SMALL, **kw})
+
+
+def _params(model, seed=0):
+    ids = jnp.zeros((1, model.seq_len), jnp.int32)
+    return model.init({"params": jax.random.PRNGKey(seed)}, ids)["params"]
+
+
+def _mixed_requests(model, n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [Request(
+        prompt=rng.integers(0, model.vocab_size - 1,
+                            size=int(rng.integers(0, model.seq_len // 2))
+                            ).astype(np.int32),
+        max_new_tokens=int(rng.integers(1, model.seq_len)), request_id=i)
+        for i in range(n)]
+
+
+def _run_pair(model, params, reqs, *, paged_kw=None, **common):
+    """The same workload through contiguous and paged engines; returns both
+    engines plus their {request_id: tokens} maps."""
+    a = ContinuousBatchingEngine(model, params, **common)
+    ta = {c.request.request_id: c.tokens for c in a.run(list(reqs))}
+    b = ContinuousBatchingEngine(model, params, kv_layout="paged",
+                                 **{**common, **(paged_kw or {})})
+    tb = {c.request.request_id: c.tokens for c in b.run(list(reqs))}
+    return a, b, ta, tb
+
+
+# -----------------------------------------------------------------------------------------
+# Token identity + trace pins
+# -----------------------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cfg", [
+    dict(), dict(num_kv_heads=2), dict(attention_window=5), dict(rope=True),
+], ids=["mha", "gqa", "window", "rope"])
+def test_paged_identical_to_contiguous_with_prefix_cache(cfg):
+    """The tentpole pin: paged == contiguous token-for-token on a mixed
+    workload through fewer slots than requests, prefix cache on, with the
+    decode/prefill one-program pins intact on the paged side."""
+    model = _model(**cfg)
+    params = _params(model)
+    reqs = _mixed_requests(model, 6, seed=7)
+    a, b, ta, tb = _run_pair(model, params, reqs, num_slots=3,
+                             prefix_cache_entries=4,
+                             paged_kw=dict(page_size=4))
+    for i in ta:
+        np.testing.assert_array_equal(ta[i], tb[i])
+    assert b.trace_count == 1
+    assert all(v <= 1 for v in b.prefill_trace_counts.values())
+    # Everything drained and nothing parked: only prefix-cache entries may
+    # still hold pages.
+    stats = b.page_stats()
+    assert stats["slot_pages_held"] == 0
+    b.prefix_cache.clear()
+    assert b.page_stats()["in_use"] == 0
+
+
+def test_paged_identical_int8_planes():
+    """Quantize-on-write planes ride the paged pools (codes + scale pools):
+    int8 paged == int8 contiguous exactly."""
+    model = _model()
+    params = _params(model)
+    reqs = _mixed_requests(model, 5, seed=11)
+    a, b, ta, tb = _run_pair(model, params, reqs, num_slots=3,
+                             kv_dtype="int8", paged_kw=dict(page_size=4))
+    for i in ta:
+        np.testing.assert_array_equal(ta[i], tb[i])
+    assert b.plane_layout.startswith("paged:4:")
+    assert b.plane_layout != a.plane_layout
+
+
+def test_paged_identical_under_speculation():
+    """Spec mode (ngram draft + batched verify): the paged verify program is
+    the one that runs — the decode program legitimately never traces
+    (``trace_count == 0`` on BOTH sides), the verify pin carries the
+    one-program contract."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(5)
+    reqs = []
+    for i in range(4):
+        prompt = np.tile(np.arange(1, 4, dtype=np.int32), 3)
+        reqs.append(Request(prompt=prompt,
+                            max_new_tokens=int(rng.integers(3, 8)),
+                            request_id=i))
+    a, b, ta, tb = _run_pair(model, params, reqs, num_slots=2,
+                             spec="ngram", spec_k=3,
+                             paged_kw=dict(page_size=4))
+    for i in ta:
+        np.testing.assert_array_equal(ta[i], tb[i])
+    assert b.trace_count == a.trace_count
+    assert dict(b.verify_trace_counts) == dict(a.verify_trace_counts)
+    assert all(v <= 1 for v in b.verify_trace_counts.values())
+
+
+def test_paged_prefix_sharing_cow_single_program():
+    """A partial prefix hit whose length is not page-aligned shares the full
+    pages by refcount and copies exactly the boundary page (COW) — tokens
+    identical to the contiguous engine, one compiled COW program no matter
+    how many copies run."""
+    model = _model()
+    params = _params(model)
+    base = np.asarray([1, 2, 3, 4, 5, 6, 7], np.int32)
+    first = [Request(prompt=base.copy(), max_new_tokens=2, request_id=0)]
+    later = [Request(prompt=np.concatenate([base[:6], [8]]).astype(np.int32),
+                     max_new_tokens=4, request_id=1),
+             Request(prompt=base.copy(), max_new_tokens=4, request_id=2)]
+
+    def run(engine):
+        out = {c.request.request_id: c.tokens for c in engine.run(list(first))}
+        out.update({c.request.request_id: c.tokens
+                    for c in engine.run(list(later))})
+        return out
+
+    a = ContinuousBatchingEngine(model, params, num_slots=2,
+                                 prefix_cache_entries=4,
+                                 prefill_chunk_sizes=(4, 8))
+    b = ContinuousBatchingEngine(model, params, num_slots=2, kv_layout="paged",
+                                 page_size=4, prefix_cache_entries=4,
+                                 prefill_chunk_sizes=(4, 8))
+    ta, tb = run(a), run(b)
+    for i in ta:
+        np.testing.assert_array_equal(ta[i], tb[i])
+    assert b.prefix_cache.hits >= 1
+    assert b.cow_copies >= 1
+    assert b.cow_trace_count == 1
+    pool = b.page_stats()
+    assert pool["shared"] >= 1                 # full pages genuinely refcounted
+
+
+# -----------------------------------------------------------------------------------------
+# Exhaustion -> typed refusal -> drain recovers
+# -----------------------------------------------------------------------------------------
+
+
+def test_pool_exhaustion_typed_refusal_then_drain_recovers():
+    """Over-admitting full-context requests on an undersized pool raises
+    KVPagesExhausted AFTER binding what fit: the admitted decode normally, the
+    refused carry their original Request objects, and after a drain the same
+    requests admit cleanly — backpressure, never OOM."""
+    model = _model()
+    params = _params(model)
+    eng = ContinuousBatchingEngine(model, params, num_slots=4,
+                                   kv_layout="paged", page_size=4, num_pages=9)
+    reqs = [Request(prompt=(np.arange(1, 8) % 8).astype(np.int32),
+                    max_new_tokens=16, request_id=i) for i in range(4)]
+    with pytest.raises(KVPagesExhausted) as exc_info:
+        eng.admit_many(list(zip(eng.free_slots(), reqs)))
+    exc = exc_info.value
+    assert len(exc.admitted) == 2 and len(exc.refused) == 2
+    assert exc.refused == reqs[2:]             # FIFO order, original objects
+    assert exc.needed > exc.free
+    while eng.num_active:
+        eng.step()
+    # The drain returned every page: the refused now admit without incident.
+    eng.admit_many(list(zip(eng.free_slots(), exc.refused)))
+    while eng.num_active:
+        eng.step()
+    stats = eng.page_stats()
+    assert stats["in_use"] == 0
+    assert stats["refusals"] >= 1
+
+
+def test_run_requeues_refusals_and_stays_identical():
+    """engine.run() under pool pressure: refusals are requeued and retried as
+    decode frees pages — the final streams are identical to the contiguous
+    engine's, pressure only reorders WHEN work starts."""
+    model = _model()
+    params = _params(model)
+    reqs = _mixed_requests(model, 10, seed=3)
+    a, b, ta, tb = _run_pair(model, params, reqs, num_slots=4,
+                             paged_kw=dict(page_size=4, num_pages=9))
+    for i in ta:
+        np.testing.assert_array_equal(ta[i], tb[i])
+    assert b.page_stats()["in_use"] == 0
+
+
+def test_park_resume_expire_returns_every_page():
+    """The preemption lifecycle settles through refcounts: park moves the
+    slot's pages into the prefix-cache entry, resume re-shares them, expiry
+    plus a cache clear returns the pool to empty."""
+    model = _model()
+    params = _params(model)
+    eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                   kv_layout="paged", page_size=4,
+                                   prefix_cache_entries=4)
+    req = Request(prompt=np.asarray([1, 2, 3, 4, 5], np.int32),
+                  max_new_tokens=8, request_id=0, preemptible=True)
+    eng.admit(0, req)
+    for _ in range(4):
+        eng.step()
+    parked = eng.park(0)
+    assert eng.page_stats()["in_use"] > 0      # the entry owns the pages
+    eng.admit(0, parked)
+    for _ in range(2):
+        eng.step()
+    req.deadline_s = time.monotonic() - 1.0
+    comps = eng.expire()
+    assert len(comps) == 1 and comps[0].finish == "timeout"
+    eng.prefix_cache.clear()
+    assert eng.page_stats()["in_use"] == 0
+
+
+def test_server_loop_requeues_page_refusals():
+    """End to end through the Server: more concurrent submissions than the
+    pool can hold all complete ok — the loop catches KVPagesExhausted and
+    requeues, callers only ever see their futures resolve."""
+    model = _model()
+    params = _params(model)
+    eng = ContinuousBatchingEngine(model, params, num_slots=4,
+                                   kv_layout="paged", page_size=4, num_pages=9)
+    server = Server(eng).start()
+    futs = [server.submit((np.arange(1, 7) % 8).astype(np.int32),
+                          max_new_tokens=8) for _ in range(8)]
+    comps = [f.result(timeout=60) for f in futs]
+    server.stop()
+    assert all(c.ok for c in comps)
+    assert eng.page_stats()["refusals"] >= 0   # pressure is workload-timing
+    assert eng.page_stats()["in_use"] == 0
+
+
+# -----------------------------------------------------------------------------------------
+# Byte accounting + telemetry surface
+# -----------------------------------------------------------------------------------------
+
+
+def test_paged_byte_accounting_and_page_stats():
+    model = _model()
+    params = _params(model)
+    eng = ContinuousBatchingEngine(model, params, num_slots=3,
+                                   kv_layout="paged", page_size=4)
+    doc = eng.byte_accounting()
+    assert doc["kv_layout"] == "paged"
+    assert doc["page_size"] == 4
+    assert doc["num_pages"] == eng._pagepool.num_pages
+    assert doc["page_bytes"] * doc["num_pages"] == doc["kv_bytes_resident"]
+    contiguous = ContinuousBatchingEngine(model, params, num_slots=3)
+    assert contiguous.byte_accounting()["kv_layout"] == "contiguous"
+    assert contiguous.page_stats() is None
+    stats = eng.page_stats()
+    assert stats["free"] == stats["usable"] and stats["in_use"] == 0
+
+
+def test_serve_summary_and_kv_pages_event(tmp_path):
+    """The telemetry chain: a paged server run emits a standalone kv_pages
+    event and a serve_summary whose kv_pages field carries the same ledger;
+    a contiguous run emits neither (field null, no event)."""
+    model = _model()
+    params = _params(model)
+
+    def drain(eng):
+        path = tmp_path / f"t_{id(eng)}.jsonl"
+        server = Server(eng, telemetry=str(path)).start()
+        futs = [server.submit([1, 2, 3], max_new_tokens=4) for _ in range(3)]
+        for f in futs:
+            f.result(timeout=60)
+        server.stop()
+        return [json.loads(line) for line in path.read_text().splitlines()]
+
+    paged = drain(ContinuousBatchingEngine(model, params, num_slots=2,
+                                           kv_layout="paged", page_size=4))
+    kinds = [e["event"] for e in paged]
+    assert "kv_pages" in kinds
+    summary = next(e for e in paged if e["event"] == "serve_summary")
+    event = next(e for e in paged if e["event"] == "kv_pages")
+    assert summary["kv_pages"]["page_size"] == 4
+    assert event["page_size"] == 4
+    assert summary["bytes"]["kv_layout"] == "paged"
+
+    flat = drain(ContinuousBatchingEngine(model, params, num_slots=2))
+    assert "kv_pages" not in [e["event"] for e in flat]
+    summary = next(e for e in flat if e["event"] == "serve_summary")
+    assert summary["kv_pages"] is None
+
+
+# -----------------------------------------------------------------------------------------
+# PrefixCache: measured bytes, on_evict, the int8 regression
+# -----------------------------------------------------------------------------------------
+
+
+def test_prefix_cache_measured_bytes_and_on_evict_all_paths():
+    evicted = []
+    cache = PrefixCache(8, capacity_bytes=64, on_evict=evicted.append)
+    mk = lambda v, n: {"k": np.full(n, v, np.int8)}
+    cache.insert([1, 2], mk(1, 24))
+    assert cache.bytes == 24
+    cache.insert([3, 4], mk(2, 24))
+    cache.insert([5, 6], mk(3, 24))                # byte pressure: entry 1 out
+    assert len(cache) == 2 and cache.bytes == 48
+    assert [p["k"][0] for p in evicted] == [1]
+    cache.insert([3, 4, 9], mk(4, 8))              # covered-drop fires it too
+    assert [p["k"][0] for p in evicted] == [1, 2]
+    cache.clear()                                  # and clear, per entry
+    assert [p["k"][0] for p in evicted] == [1, 2, 3, 4]
+    assert cache.bytes == 0 and len(cache) == 0
+    # Explicit nbytes (the paged engine's page-span charge) overrides measure.
+    cache.insert([7], mk(5, 2), nbytes=1000)
+    assert cache.bytes == 1000
+    # The byte budget never evicts the LAST entry (an oversized single entry
+    # is resident-until-displaced, not a permanently empty cache).
+    assert len(cache) == 1
+
+
+def test_prefix_cache_nbytes_counts_scale_planes():
+    planes = {"k": np.zeros((4, 2), np.int8), "k_scale": np.zeros(4, np.float32),
+              "nested": {"v": np.zeros(3, np.float64)}}
+    assert _tree_nbytes(planes) == 8 + 16 + 24
+
+
+def test_int8_engine_fits_3x_entries_in_same_byte_budget():
+    """THE satellite regression: with capacity counted in MEASURED bytes, an
+    int8 engine's prefix entries (int8 codes + f32 scales) fit >= 3x the
+    fp32 entry count in the same budget — before, capacity-in-entries charged
+    both layouts identically and the int8 engine wasted its savings."""
+    model = _model()
+    params = _params(model)
+
+    def fill(kv_dtype, budget):
+        eng = ContinuousBatchingEngine(model, params, num_slots=2,
+                                       kv_dtype=kv_dtype,
+                                       prefix_cache_bytes=budget)
+        rng = np.random.default_rng(1)
+        for i in range(16):
+            prompt = np.concatenate([
+                [i % (model.vocab_size - 1)],
+                rng.integers(0, model.vocab_size - 1, size=5)]
+            ).astype(np.int32)
+            eng.run([Request(prompt=prompt, max_new_tokens=1, request_id=i)])
+        return eng.prefix_cache
+
+    probe = fill("model", 1 << 40)
+    entry_bytes = probe.bytes // max(len(probe), 1)
+    budget = int(3.5 * entry_bytes)
+    fp32 = fill("model", budget)
+    int8 = fill("int8", budget)
+    assert fp32.bytes <= budget and int8.bytes <= budget
+    assert len(int8) >= 3 * len(fp32)
+
+
+# -----------------------------------------------------------------------------------------
+# Allocator property tests (engine-free)
+# -----------------------------------------------------------------------------------------
+
+
+def test_pagepool_random_walk_conserves_pages():
+    """Random alloc/ref/unref walk: refcounts and free lists stay consistent,
+    and releasing everything returns the pool to fully free."""
+    rng = np.random.default_rng(0)
+    pool = PagePool(32, page_size=4, groups=2)
+    held: list[list[int]] = []
+    for _ in range(300):
+        op = rng.integers(0, 3)
+        if op == 0:
+            try:
+                held.append(pool.alloc(int(rng.integers(1, 4)),
+                                       group=int(rng.integers(0, 2))))
+            except PagePoolExhausted:
+                pass
+        elif op == 1 and held:
+            span = held[int(rng.integers(0, len(held)))]
+            pool.ref(span)
+            held.append(list(span))
+        elif op == 2 and held:
+            pool.unref(held.pop(int(rng.integers(0, len(held)))))
+        total_refs = sum(pool.refcount(p) for p in range(pool.num_pages))
+        assert total_refs == pool.groups + sum(len(s) for s in held)
+        assert pool.free_pages() == pool.usable_pages - len(
+            {p for s in held for p in s})
+    for span in held:
+        pool.unref(span)
+    assert pool.free_pages() == pool.usable_pages
+
+
+def test_pages_for_matches_reservation_arithmetic():
+    assert pages_for(0, 4) == 0
+    assert pages_for(1, 4) == 1
+    assert pages_for(4, 4) == 1
+    assert pages_for(5, 4) == 2
+    with pytest.raises(ValueError):
+        pages_for(-1, 4)
+
+
+# -----------------------------------------------------------------------------------------
+# Planner: paged residency pricing
+# -----------------------------------------------------------------------------------------
+
+
+def test_predict_serve_paged_prices_page_residency():
+    from csed_514_project_distributed_training_using_pytorch_tpu.plan.costs import (
+        ServeStats,
+        Topology,
+        predict_serve,
+    )
+
+    stats = ServeStats(name="fixture", param_bytes=1e6,
+                       kv_bytes_per_slot=1024 * 64.0, seq_len=1024,
+                       flops_per_token=1e6, num_layers=2, embed_dim=64)
+    topo = Topology(num_devices=1, device_kind="cpu", hbm_bytes=int(16e6))
+    kw = dict(tp=1, dp=1, num_slots=8, prompt_len=128)
+    flat = predict_serve(stats, topo, **kw)
+    # The contiguous default is bitwise-unchanged by the new kwargs.
+    assert predict_serve(stats, topo, **kw,
+                         kv_layout="contiguous").to_dict() == flat.to_dict()
+    # Full-context paged (the conservative pin) rounds UP to page multiples:
+    # never cheaper than contiguous per slot, here equal (1024 % 64 == 0).
+    full = predict_serve(stats, topo, **kw, kv_layout="paged", page_size=64)
+    assert full.kv_bytes_per_chip == flat.kv_bytes_per_chip
+    # A short-context mix shrinks residency by the measured page span, and
+    # the freed bytes buy admissible slots.
+    short = predict_serve(stats, topo, **kw, kv_layout="paged", page_size=64,
+                          context_tokens=128)
+    assert short.kv_bytes_per_chip < flat.kv_bytes_per_chip
+    assert short.slots_at_budget > flat.slots_at_budget
+    with pytest.raises(ValueError):
+        predict_serve(stats, topo, **kw, kv_layout="ragged")

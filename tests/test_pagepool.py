@@ -1,0 +1,169 @@
+"""Allocator invariants for serving/pagepool.py (ISSUE 20 satellite).
+
+Pure host-side tests — no jax import, so these run even where the backend is
+broken. The engine-level paged tests (identity matrix, exhaustion-as-refusal,
+park/resume page return) live in tests/test_paged_kv.py; here we pin the
+ledger itself: no double-free, no leak across churn, all-or-nothing alloc,
+null-page pinning, and group partitioning.
+"""
+
+import random
+
+import pytest
+
+from csed_514_project_distributed_training_using_pytorch_tpu.serving.pagepool import (
+    PagePool,
+    PagePoolExhausted,
+    pages_for,
+)
+
+
+def test_pages_for_is_ceil_div():
+    assert pages_for(0, 4) == 0
+    assert pages_for(1, 4) == 1
+    assert pages_for(4, 4) == 1
+    assert pages_for(5, 4) == 2
+    assert pages_for(64, 64) == 1
+    with pytest.raises(ValueError):
+        pages_for(-1, 4)
+
+
+def test_alloc_returns_distinct_owned_pages():
+    pool = PagePool(8, page_size=4)
+    pages = pool.alloc(3)
+    assert len(set(pages)) == 3
+    assert all(pool.refcount(p) == 1 for p in pages)
+    assert pool.null_page() not in pages
+    assert pool.free_pages() == pool.usable_pages - 3
+
+
+def test_alloc_is_all_or_nothing():
+    pool = PagePool(8, page_size=4)  # 7 usable
+    pool.alloc(5)
+    free_before = pool.free_pages()
+    with pytest.raises(PagePoolExhausted) as ei:
+        pool.alloc(3)
+    # Nothing was taken by the failed alloc.
+    assert pool.free_pages() == free_before == 2
+    assert ei.value.needed == 3 and ei.value.free == 2
+    assert pool.stats()["refusals"] == 1
+    # The refusal is recoverable: the 2 remaining still allocate.
+    assert len(pool.alloc(2)) == 2
+
+
+def test_unref_frees_at_zero_and_double_free_raises():
+    pool = PagePool(8, page_size=4)
+    (p,) = pool.alloc(1)
+    pool.ref([p])                     # second owner (prefix-cache share)
+    pool.unref([p])
+    assert pool.refcount(p) == 1      # still owned by the first
+    pool.unref([p])
+    assert pool.refcount(p) == 0
+    assert pool.free_pages() == pool.usable_pages
+    with pytest.raises(ValueError, match="double free"):
+        pool.unref([p])
+
+
+def test_ref_of_free_page_raises():
+    pool = PagePool(8, page_size=4)
+    (p,) = pool.alloc(1)
+    pool.unref([p])
+    with pytest.raises(ValueError, match="free"):
+        pool.ref([p])
+
+
+def test_null_page_is_pinned():
+    pool = PagePool(8, page_size=4)
+    null = pool.null_page()
+    assert pool.refcount(null) == 1
+    with pytest.raises(ValueError, match="null"):
+        pool.unref([null])
+    with pytest.raises(ValueError, match="null"):
+        pool.ref([null])
+    # Draining the whole pool never hands out the null page.
+    got = pool.alloc(pool.usable_pages)
+    assert null not in got
+
+
+def test_groups_partition_page_ids():
+    pool = PagePool(12, page_size=4, groups=3)
+    assert pool.usable_pages == 9
+    for g in range(3):
+        assert pool.null_page(g) == g * 4
+        pages = pool.alloc(3, group=g)
+        assert all(pool.group_of(p) == g for p in pages)
+    # Every group is now drained independently.
+    for g in range(3):
+        with pytest.raises(PagePoolExhausted):
+            pool.alloc(1, group=g)
+
+
+def test_group_exhaustion_is_per_group():
+    pool = PagePool(8, page_size=4, groups=2)
+    pool.alloc(3, group=0)
+    with pytest.raises(PagePoolExhausted) as ei:
+        pool.alloc(1, group=0)
+    assert ei.value.group == 0
+    assert len(pool.alloc(3, group=1)) == 3   # other group unaffected
+
+
+def test_shared_counter_in_stats():
+    pool = PagePool(8, page_size=4)
+    pages = pool.alloc(2)
+    pool.ref(pages)
+    s = pool.stats()
+    assert s["shared"] == 2 and s["in_use"] == 2
+    pool.unref(pages)
+    assert pool.stats()["shared"] == 0
+
+
+def test_randomized_churn_never_leaks(seed=0):
+    """Property sweep: random alloc/share/release interleavings conserve
+    pages — at quiescence every page is back on a free list exactly once."""
+    rng = random.Random(seed)
+    pool = PagePool(32, page_size=8, groups=2)
+    live = []                          # (group, pages, extra_refs)
+    for _ in range(2000):
+        op = rng.random()
+        if op < 0.4:
+            g = rng.randrange(2)
+            n = rng.randint(0, 6)
+            try:
+                live.append([g, pool.alloc(n, group=g), 0])
+            except PagePoolExhausted:
+                pass
+        elif op < 0.6 and live:
+            ent = rng.choice(live)
+            pool.ref(ent[1])          # share (park / prefix hit)
+            ent[2] += 1
+        elif live:
+            i = rng.randrange(len(live))
+            g, pages, extra = live[i]
+            if extra and rng.random() < 0.5:
+                pool.unref(pages)     # drop one shared owner
+                live[i][2] -= 1
+            else:
+                for _ in range(extra + 1):
+                    pool.unref(pages)
+                live.pop(i)
+        # Conservation mid-flight: free + in_use == usable.
+        s = pool.stats()
+        assert s["free"] + s["in_use"] == s["usable"]
+    for g, pages, extra in live:      # drain
+        for _ in range(extra + 1):
+            pool.unref(pages)
+    s = pool.stats()
+    assert s["free"] == s["usable"] and s["in_use"] == 0
+    # Free lists hold each page exactly once (no double-insert).
+    for g in range(pool.groups):
+        lst = pool._free[g]
+        assert len(lst) == len(set(lst))
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        PagePool(8, page_size=0)
+    with pytest.raises(ValueError):
+        PagePool(7, page_size=4, groups=2)   # uneven split
+    with pytest.raises(ValueError):
+        PagePool(2, page_size=4, groups=2)   # 1 page/group: null only

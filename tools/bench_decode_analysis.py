@@ -37,6 +37,13 @@ for real weights); (3) the compile pins: the quantized engine must still trace
 exactly one decode program and <= 1 prefill program per chunk size. The output
 JSON is the committed ``bench_results/`` artifact format.
 
+``--paged-ab`` runs the paged-KV layout A/B (``bench_results/paged_kv_cpu/``):
+the SAME mixed short/long greedy workload through a contiguous-oracle engine
+and a ``kv_layout="paged"`` engine — token identity (the adapters' bitwise
+contract), measured slots-at-HBM-budget from the workload's actual page
+reservations, and the long-prompt TTFT/TPOT tails that prove capacity wasn't
+bought by taxing full-context requests.
+
 All byte accounting in this tool is **byte-true**: cache and weight bytes are
 summed from the actual arrays a run holds (``ops.quant.tree_bytes``), so a
 quantized run's roofline denominator shrinks exactly as far as its buffers did.
@@ -242,6 +249,142 @@ def quant_ab(model, params, args) -> dict:
     return doc
 
 
+def paged_ab(model, params, args) -> dict:
+    """The paged-KV A/B (``bench_results/paged_kv_cpu/``): one seeded mixed
+    workload — short interactive requests (~32 total tokens) interleaved with
+    near-``seq_len`` prompts — through a contiguous-oracle engine (A) and a
+    paged engine (B), reporting (1) greedy token identity (the bitwise
+    contract the paged adapters are built on); (2) byte-true residency:
+    contiguous charges every slot the full ``[S]`` planes, paged charges the
+    page span each request actually reserved, so slots-at-HBM-budget is
+    measured from THIS workload's page costs, not a dtype formula; (3) the
+    long-prompt latency tails (TTFT/TPOT p50/p95 per side) — the paged layout
+    must buy capacity without taxing the requests that DO use full context;
+    (4) the compile pins and the pool's own ledger (allocs/frees/refusals)."""
+    import time as _time
+
+    import numpy as np
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving import (
+        ContinuousBatchingEngine, Request,
+    )
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.pagepool import (
+        pages_for,
+    )
+
+    s = args.seq
+    chunks = tuple(int(x) for x in args.curve_chunks.split(",") if x)
+    rng = np.random.default_rng(13)
+    long_len = max(s - args.paged_new_tokens - 1, s // 2)
+    specs = []      # (kind, prompt, max_new) — shorts with longs interleaved
+    for i in range(args.paged_requests):
+        p_len = int(rng.integers(8, 24))
+        new = max(32 - p_len + int(rng.integers(0, 8)), 1)
+        specs.append(("short",
+                      rng.integers(0, args.vocab, size=p_len).astype(np.int32),
+                      new))
+        if i % max(args.paged_requests // args.paged_long_requests, 1) == 0 \
+                and sum(k == "long" for k, _, _ in specs) \
+                < args.paged_long_requests:
+            specs.append((
+                "long",
+                rng.integers(0, args.vocab, size=long_len).astype(np.int32),
+                args.paged_new_tokens))
+    warm = rng.integers(0, args.vocab, size=s - 2).astype(np.int32)
+
+    def run_engine(**layout_kw):
+        eng = ContinuousBatchingEngine(
+            model, params, num_slots=args.paged_slots,
+            prefill_chunk_sizes=chunks, **layout_kw)
+        # Compile every chunk size + the decode program off the clock (one
+        # request per size plans as exactly one chunk), then wipe the ledger.
+        for c in eng.prefill_chunk_sizes:
+            eng.run([Request(prompt=warm[:min(c, s - 1)], max_new_tokens=1)])
+        eng.run([Request(prompt=warm, max_new_tokens=2)])
+        eng.reset_stats()
+        reqs = [Request(prompt=p, max_new_tokens=n, request_id=i)
+                for i, (_, p, n) in enumerate(specs)]
+        t0 = _time.monotonic()
+        comps = eng.run(reqs)
+        wall = _time.monotonic() - t0
+        return eng, {c.request.request_id: c for c in comps}, wall
+
+    eng_a, comps_a, wall_a = run_engine()
+    eng_b, comps_b, wall_b = run_engine(kv_layout="paged",
+                                        page_size=args.paged_page_size)
+
+    identical = all(
+        np.array_equal(comps_a[i].tokens, comps_b[i].tokens)
+        for i in range(len(specs)))
+
+    def tails(comps, kind):
+        rows = [comps[i] for i, (k, _, _) in enumerate(specs) if k == kind]
+        out = {}
+        for field in ("ttft_s", "tpot_s"):
+            vals = [getattr(c, field) for c in rows
+                    if getattr(c, field) is not None]
+            out[field] = ({"p50": float(np.percentile(vals, 50)),
+                           "p95": float(np.percentile(vals, 95))}
+                          if vals else None)
+        return out
+
+    acct_a, acct_b = eng_a.byte_accounting(), eng_b.byte_accounting()
+    ps = eng_b.page_size
+    page_bytes = acct_b["page_bytes"]
+    # Slots at a fixed HBM budget, measured from THIS workload: contiguous
+    # charges kv_bytes_per_slot regardless of context; paged charges the mean
+    # page reservation of the mix (each request's ceil(total/ps) pages).
+    budget = float(args.paged_hbm_budget
+                   or args.paged_slots * acct_a["kv_bytes_per_slot"])
+    req_pages = [pages_for(len(p) + n, ps) for _, p, n in specs]
+    mean_req_bytes = sum(req_pages) / len(req_pages) * page_bytes
+    slots_a = int(budget // acct_a["kv_bytes_per_slot"])
+    slots_b = int(budget // mean_req_bytes)
+    t_a, t_b = tails(comps_a, "long"), tails(comps_b, "long")
+    ttft_ratio = (t_b["ttft_s"]["p95"] / t_a["ttft_s"]["p95"]
+                  if t_a.get("ttft_s") and t_b.get("ttft_s")
+                  and t_a["ttft_s"]["p95"] else None)
+    gen_tokens = sum(c.new_tokens for c in comps_a.values())
+    doc = {
+        "metric": "paged-KV A/B (page_size %d, %d short + %d long requests)"
+                  % (ps, sum(k == "short" for k, _, _ in specs),
+                     sum(k == "long" for k, _, _ in specs)),
+        "requests": len(specs),
+        "seq_len": s,
+        "long_prompt_len": long_len,
+        "token_identical": bool(identical),
+        "a": {"kv_layout": "contiguous", "bytes": acct_a, "wall_s": wall_a,
+              "tokens_per_s": gen_tokens / wall_a if wall_a else None,
+              "trace_count": eng_a.trace_count,
+              "prefill_trace_counts": dict(eng_a.prefill_trace_counts),
+              "long": t_a, "short": tails(comps_a, "short")},
+        "b": {"kv_layout": "paged", "bytes": acct_b, "wall_s": wall_b,
+              "tokens_per_s": gen_tokens / wall_b if wall_b else None,
+              "trace_count": eng_b.trace_count,
+              "prefill_trace_counts": dict(eng_b.prefill_trace_counts),
+              "long": t_b, "short": tails(comps_b, "short"),
+              "kv_pages": eng_b.page_stats()},
+        # The committed capacity claim: how many of THIS mix's requests fit
+        # the same HBM budget under each layout.
+        "hbm_budget_bytes": budget,
+        "mean_request_pages": sum(req_pages) / len(req_pages),
+        "page_bytes": page_bytes,
+        "slots_at_budget_contiguous": slots_a,
+        "slots_at_budget_paged": slots_b,
+        "slots_at_budget_ratio": slots_b / slots_a if slots_a else None,
+        "slots_ratio_bound": args.paged_slots_bound,
+        "long_ttft_p95_ratio": ttft_ratio,
+        "long_ttft_bound": args.paged_ttft_bound,
+        "capacity_ok": (slots_a > 0
+                        and slots_b / slots_a >= args.paged_slots_bound),
+        "latency_ok": (ttft_ratio is not None
+                       and ttft_ratio <= args.paged_ttft_bound),
+        "accounting": ("byte-true: per-slot/page bytes from live buffers; "
+                       "page costs from the engine's own pages_for"),
+    }
+    return doc
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--vocab", type=int, default=16)
@@ -282,6 +425,27 @@ def main() -> int:
                         "(the documented accuracy budget)")
     p.add_argument("--ab-nll-bound", type=float, default=0.05,
                    help="max |NLL delta| through the quantized decode path")
+    p.add_argument("--paged-ab", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="run the paged-KV A/B (contiguous oracle vs "
+                        "kv_layout='paged'): token identity, measured "
+                        "slots-at-HBM-budget on a mixed short/long workload, "
+                        "long-prompt TTFT/TPOT tails, compile pins")
+    p.add_argument("--paged-page-size", type=int, default=64)
+    p.add_argument("--paged-requests", type=int, default=12,
+                   help="short (~32 total tokens) requests in the mix")
+    p.add_argument("--paged-long-requests", type=int, default=4,
+                   help="near-seq_len prompts interleaved into the mix")
+    p.add_argument("--paged-new-tokens", type=int, default=8,
+                   help="generated tokens per long request")
+    p.add_argument("--paged-slots", type=int, default=4)
+    p.add_argument("--paged-hbm-budget", type=float, default=0.0,
+                   help="HBM budget (bytes) for the slots-at-budget claim; "
+                        "0 = paged_slots contiguous slots' worth")
+    p.add_argument("--paged-slots-bound", type=float, default=2.0,
+                   help="min paged/contiguous slots-at-budget ratio")
+    p.add_argument("--paged-ttft-bound", type=float, default=1.25,
+                   help="max long-prompt p95 TTFT ratio (paged/contiguous)")
     p.add_argument("--out", default=None)
     args = p.parse_args()
 
@@ -400,6 +564,8 @@ def main() -> int:
         doc["ttft_curve"] = ttft_curve(model, params, args)
     if args.quant_ab:
         doc["quant_ab"] = quant_ab(model, params, args)
+    if args.paged_ab:
+        doc["paged_ab"] = paged_ab(model, params, args)
     print(json.dumps(doc))
     if args.out:
         with open(args.out, "w") as f:

@@ -15,6 +15,8 @@ regression that shows on the fixture shows on the real model):
 =================  ==================================================================
 ``decode_tick_s``  one slot-engine decode step, 4 busy slots, empty prompts
                    (pure decode: the serving hot loop, ``engine.step``)
+``paged_decode_tick_s``  the same decode step on the paged-KV engine
+                   (``kv_layout="paged"`` — the gather-adapter overhead gate)
 ``prefill_chunk_s``  one chunked-prefill program invocation (host wall per chunk,
                    from the engine's own ``prefill_wall_s`` ledger)
 ``spec_verify_s``  one speculative verify tick (ngram drafting + the batched
@@ -134,6 +136,31 @@ def bench_decode_tick() -> float:
     return (time.perf_counter() - t0) / steps
 
 
+def bench_paged_decode_tick() -> float:
+    """Seconds per decode step on the PAGED engine, same workload as
+    ``decode_tick_s`` — the gather-adapter overhead over the contiguous hot
+    loop is exactly the ratio of these two metrics."""
+    import numpy as np
+
+    from csed_514_project_distributed_training_using_pytorch_tpu.serving.engine import (
+        Request,
+    )
+
+    model, engine = _build_engine(kv_layout="paged", page_size=16)
+
+    def admit(max_new):
+        reqs = [Request(prompt=np.zeros(0, np.int32), max_new_tokens=max_new,
+                        request_id=i) for i in range(4)]
+        engine.admit_many(list(zip(engine.free_slots(), reqs)))
+
+    admit(4)
+    _drain(engine)                      # compile, off the clock
+    admit(32)
+    t0 = time.perf_counter()
+    steps = _drain(engine)
+    return (time.perf_counter() - t0) / steps
+
+
 def bench_prefill_chunk() -> float:
     """Host wall per chunked-prefill program invocation (the engine's own
     ``prefill_wall_s / prefill_invocations`` ledger — queueing excluded)."""
@@ -229,6 +256,7 @@ def bench_lm_train_step() -> float:
 
 SUITE = {
     "decode_tick_s": bench_decode_tick,
+    "paged_decode_tick_s": bench_paged_decode_tick,
     "prefill_chunk_s": bench_prefill_chunk,
     "spec_verify_s": bench_spec_verify,
     "lm_train_step_s": bench_lm_train_step,

@@ -270,6 +270,12 @@ def render(state: FleetState, path: str) -> str:
         has_tier = any(r.get("tier") for r in per)
         if has_tier:
             head += f" {'tier':>8} {'hand':>5}"
+        # The pages column appears once any replica runs the paged KV layout:
+        # in-use/free pool pages plus cumulative admission refusals — pool
+        # pressure reads here before it reads as queue depth.
+        has_pages = any(r.get("kv_pages") for r in per)
+        if has_pages:
+            head += f" {'pages':>11} {'refuse':>6}"
         lines.append(head)
         for r in per:
             row = (f"  {r.get('replica'):>3} {str(r.get('state')):<9} "
@@ -287,6 +293,11 @@ def render(state: FleetState, path: str) -> str:
             if has_tier:
                 row += (f" {str(r.get('tier') or '-'):>8} "
                         f"{_fmt(r.get('handoffs')):>5}")
+            if has_pages:
+                kp = r.get("kv_pages") or {}
+                pages = (f"{_fmt(kp.get('in_use'))}/{_fmt(kp.get('free'))}"
+                         if kp else "-")
+                row += (f" {pages:>11} {_fmt(kp.get('refusals')):>6}")
             lines.append(row)
     if state.recent:
         lines.append("")

@@ -467,6 +467,18 @@ def main(argv: list[str] | None = None) -> int:
                         "interleaving)")
     e.add_argument("--prefix-cache", type=int, default=0,
                    help="prefix KV cache LRU entries, 0 = off")
+    e.add_argument("--prefix-cache-bytes", type=int, default=0,
+                   help="measured-byte budget for the prefix cache on top of "
+                        "the entry count (0 = entry-count LRU only)")
+    e.add_argument("--kv-layout", default="contiguous",
+                   choices=("contiguous", "paged"),
+                   help="KV store layout: 'paged' decouples slot count from "
+                        "max context via a fixed page pool (DESIGN.md §27)")
+    e.add_argument("--page-size", type=int, default=64,
+                   help="paged layout: tokens per KV page")
+    e.add_argument("--num-pages", type=int, default=0,
+                   help="paged layout: pool size in pages (0 = capacity "
+                        "parity with the contiguous cache)")
     e.add_argument("--kv-dtype", default="model",
                    choices=("model", "fp32", "bf16", "int8", "fp8"),
                    help="KV-cache plane dtype: int8/fp8 = quantize-on-write "

@@ -220,6 +220,20 @@ def summarize(path: str) -> dict:
             s["decode_bytes_per_token"] = by.get("decode_bytes_per_token")
             s["kv_bytes_per_slot"] = by.get("kv_bytes_per_slot")
             s["slots_at_budget"] = by.get("slots_at_budget")
+            s["kv_layout"] = by.get("kv_layout")
+        # Paged-KV pool ledger (paged engines only — the summary field and the
+        # standalone kv_pages line carry the same page_stats() dict; prefer
+        # the summary, fall back to the last standalone line on a killed run).
+        kp = summary.get("kv_pages") \
+            or (by_event.get("kv_pages") or [None])[-1] or {}
+        if kp:
+            s["kv_page_size"] = kp.get("page_size")
+            s["kv_pages_in_use"] = kp.get("in_use")
+            s["kv_pages_free"] = kp.get("free")
+            s["kv_pages_shared"] = kp.get("shared")
+            s["kv_page_refusals"] = kp.get("refusals")
+            s["kv_page_fragmentation"] = kp.get("fragmentation")
+            s["kv_cow_copies"] = kp.get("cow_copies")
         for name in SERVE_SERIES:          # summary percentiles fill any gaps
             pcts = summary.get(name) or {}
             for q in SERVE_QS:
@@ -714,6 +728,14 @@ def print_summary(s: dict) -> None:
                   f"decode/token {_fmt(s['decode_bytes_per_token'])}  "
                   f"kv/slot {_fmt(s.get('kv_bytes_per_slot'))}  "
                   f"slots@budget {_fmt(s.get('slots_at_budget'))}")
+        if s.get("kv_pages_in_use") is not None:
+            print(f"   kv pages: {_fmt(s['kv_pages_in_use'])} in use / "
+                  f"{_fmt(s.get('kv_pages_free'))} free "
+                  f"(size {_fmt(s.get('kv_page_size'))} tok)  "
+                  f"shared {_fmt(s.get('kv_pages_shared'))}  "
+                  f"cow {_fmt(s.get('kv_cow_copies'))}  "
+                  f"refusals {_fmt(s.get('kv_page_refusals'))}  "
+                  f"frag {_fmt(s.get('kv_page_fragmentation'))}")
         head = "   " + "".ljust(14) + "".join(f"p{q}".rjust(12) for q in SERVE_QS)
         print(head)
         for name in SERVE_SERIES:
@@ -825,6 +847,11 @@ COMPARE_ROWS = [
     ("decode bytes/tok", "decode_bytes_per_token"),
     ("kv bytes/slot", "kv_bytes_per_slot"),
     ("slots @ budget", "slots_at_budget"),
+    ("kv pages in use", "kv_pages_in_use"),
+    ("kv pages shared", "kv_pages_shared"),
+    ("kv page refusals", "kv_page_refusals"),
+    ("kv cow copies", "kv_cow_copies"),
+    ("kv page frag", "kv_page_fragmentation"),
     ("prefix hit rate", "prefix_hit_rate"),
     ("affinity hit rate", "affinity_rate"),
     ("redispatches", "redispatches"),
